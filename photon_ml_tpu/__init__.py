@@ -1,0 +1,30 @@
+"""photon_ml_tpu — a TPU-native framework with the capabilities of LinkedIn Photon ML.
+
+Photon ML (reference: /root/reference) is a Spark/Scala library for Generalized
+Linear Models and GLMix / GAME (Generalized Additive Mixed Effects) models trained
+by block coordinate descent. This package re-designs those capabilities TPU-first:
+
+- ``core``      pure-JAX pointwise losses, GLM objectives, normalization algebra
+                (reference: photon-lib .../function, .../normalization)
+- ``opt``       jittable + vmappable L-BFGS / OWLQN / TRON solvers
+                (reference: photon-lib .../optimization)
+- ``parallel``  device mesh, shard_map'd SPMD objective reductions, entity bucketing
+                (reference substrate: Spark treeAggregate / broadcast / shuffle)
+- ``game``      coordinates + coordinate descent + estimator/transformer
+                (reference: photon-lib .../algorithm, photon-api estimators)
+- ``models``    GLM + GAME model containers
+                (reference: photon-api supervised/**, model/**)
+- ``evaluation``AUC/RMSE/... evaluators and suites (reference: .../evaluation)
+- ``tune``      Sobol random search + Gaussian-process Bayesian optimization
+                (reference: photon-lib .../hyperparameter)
+- ``data``      Avro/libsvm readers, feature index maps, synthetic generators
+                (reference: photon-client .../data, .../index)
+- ``utils``     logging, timing, linalg helpers (reference: .../util)
+
+Everything device-side is functional JAX: static shapes, ``lax``-control flow,
+collectives via ``shard_map`` over a ``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.types import TaskType  # noqa: F401

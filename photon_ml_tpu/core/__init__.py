@@ -1,0 +1,16 @@
+from photon_ml_tpu.core.losses import (  # noqa: F401
+    PointwiseLoss,
+    logistic_loss,
+    squared_loss,
+    poisson_loss,
+    smoothed_hinge_loss,
+    loss_for_task,
+)
+from photon_ml_tpu.core.batch import DenseBatch, SparseBatch, Batch  # noqa: F401
+from photon_ml_tpu.core.normalization import (  # noqa: F401
+    NormalizationContext,
+    no_normalization,
+    FeatureStats,
+)
+from photon_ml_tpu.core.regularization import Regularization  # noqa: F401
+from photon_ml_tpu.core.objective import GLMObjective  # noqa: F401

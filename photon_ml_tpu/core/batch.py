@@ -1,0 +1,120 @@
+"""Batched training-data containers (pytrees).
+
+Reference data model: LabeledPoint(label, features, offset, weight)
+(photon-lib .../data/LabeledPoint.scala:106).  The reference streams one
+LabeledPoint at a time through aggregator objects; on TPU the unit of work is a
+statically-shaped batch so every margin is one matmul / gather on the MXU.
+
+Two physical layouts:
+
+- ``DenseBatch``:  x[n, d] — for moderate d or post-projection entity blocks.
+- ``SparseBatch``: padded per-row COO (indices[n, k], values[n, k]) — for wide
+  sparse data (CTR-style).  Rows pad with (index=0, value=0); zero values make
+  padded slots contribute nothing to margins or gradients.  This replaces the
+  reference's Breeze SparseVector path; gradient scatter-adds become XLA
+  segment-sums through autodiff of the gather.
+
+Padded/invalid examples carry weight 0 — the aggregation algebra (weighted sums
+everywhere, reference ValueAndGradientAggregator.scala:137-161) then ignores
+them with no separate mask plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Array = jax.Array
+
+
+@struct.dataclass
+class DenseBatch:
+    """Dense design-matrix batch: margins are x @ w on the MXU."""
+
+    x: Array  # [n, d]
+    y: Array  # [n]
+    offset: Array  # [n]
+    weight: Array  # [n]
+
+    @property
+    def num_examples(self) -> int:
+        return self.x.shape[-2]
+
+    @property
+    def dim(self) -> int:
+        return self.x.shape[-1]
+
+    def margins(self, w: Array) -> Array:
+        """Raw margins x·w (no offset; callers add offset + normalization shift)."""
+        return self.x @ w
+
+    def rescale_weights(self, scale: Array) -> "DenseBatch":
+        return self.replace(weight=self.weight * scale)
+
+
+@struct.dataclass
+class SparseBatch:
+    """Row-padded sparse batch.
+
+    ``indices[n, k]`` column ids, ``values[n, k]`` entries, padded with
+    value 0.  ``dim`` is static (needed for gradient shapes).
+
+    CONTRACT: within a row, non-padded indices must be unique and in
+    [0, dim) — feature index maps guarantee this.  Duplicate indices would
+    make ``hessian_diag`` (which squares per-slot values) disagree with the
+    margin/gradient semantics; out-of-range indices clamp in gathers but drop
+    in scatters.  The data layer validates on host at construction.
+    """
+
+    indices: Array  # [n, k] int32
+    values: Array  # [n, k]
+    y: Array  # [n]
+    offset: Array  # [n]
+    weight: Array  # [n]
+    dim: int = struct.field(pytree_node=False)
+
+    @property
+    def num_examples(self) -> int:
+        return self.values.shape[-2]
+
+    def margins(self, w: Array) -> Array:
+        # Gather + row-sum; transpose (for grad) is a segment-sum scatter-add,
+        # which XLA derives from this expression.
+        return jnp.sum(self.values * w[self.indices], axis=-1)
+
+    def rescale_weights(self, scale: Array) -> "SparseBatch":
+        return self.replace(weight=self.weight * scale)
+
+    def to_dense(self) -> DenseBatch:
+        """Materialize a dense design matrix (tests / tiny problems only)."""
+        n, k = self.values.shape
+        x = jnp.zeros((n, self.dim), self.values.dtype)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
+        x = x.at[rows, self.indices].add(self.values)
+        return DenseBatch(x=x, y=self.y, offset=self.offset, weight=self.weight)
+
+
+Batch = Union[DenseBatch, SparseBatch]
+
+
+def dense_batch(x, y, offset=None, weight=None, dtype=None) -> DenseBatch:
+    """Convenience constructor with default offset 0 / weight 1."""
+    x = jnp.asarray(x, dtype)
+    y = jnp.asarray(y, x.dtype)
+    n = x.shape[-2]
+    offset = jnp.zeros((n,), x.dtype) if offset is None else jnp.asarray(offset, x.dtype)
+    weight = jnp.ones((n,), x.dtype) if weight is None else jnp.asarray(weight, x.dtype)
+    return DenseBatch(x=x, y=y, offset=offset, weight=weight)
+
+
+def sparse_batch(indices, values, y, dim, offset=None, weight=None, dtype=None) -> SparseBatch:
+    values = jnp.asarray(values, dtype)
+    indices = jnp.asarray(indices, jnp.int32)
+    y = jnp.asarray(y, values.dtype)
+    n = values.shape[-2]
+    offset = jnp.zeros((n,), values.dtype) if offset is None else jnp.asarray(offset, values.dtype)
+    weight = jnp.ones((n,), values.dtype) if weight is None else jnp.asarray(weight, values.dtype)
+    return SparseBatch(indices=indices, values=values, y=y, offset=offset, weight=weight, dim=dim)

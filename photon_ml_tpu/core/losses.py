@@ -1,0 +1,172 @@
+"""Pointwise GLM losses: l(z, y) at margin z = w·x + offset, with first and
+second derivatives in z.
+
+Reference contract: photon-lib .../function/glm/PointwiseLossFunction.scala:36-54
+(``lossAndDzLoss``, ``DzzLoss``); concrete losses:
+  - LogisticLossFunction.scala:45-90   (labels in {0,1}; stable log1pExp)
+  - SquaredLossFunction.scala          (l = (z-y)^2 / 2)
+  - PoissonLossFunction.scala          (l = exp(z) - y*z)
+  - svm/SmoothedHingeLossFunction.scala:28-70 (Rennie smoothed hinge)
+
+TPU-first design: each loss is a trio of pure elementwise functions over arrays —
+XLA fuses them into the surrounding matmul/reduction; no per-example Scala-style
+aggregator objects. Autodiff is NOT used for d1/d2 here because the reference
+semantics (e.g. the smoothed hinge's sub-differential convention) must be exact,
+and closed forms are cheaper under ``vmap`` + ``while_loop``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+def log1p_exp(z: Array) -> Array:
+    """Numerically stable log(1 + exp(z)) (reference util/MathUtils.log1pExp)."""
+    return jnp.logaddexp(0.0, z)
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with derivatives and the GLM mean (inverse link).
+
+    Attributes:
+      name: stable identifier (used in model metadata files).
+      loss: elementwise l(z, y).
+      d1:   elementwise dl/dz.
+      d2:   elementwise d2l/dz2 (>= 0 for the convex losses here).
+      mean: inverse link E[y|z] used for prediction
+            (reference supervised/model/*Model.computeMean).
+    """
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    mean: Callable[[Array], Array]
+
+    def loss_and_d1(self, z: Array, y: Array) -> tuple[Array, Array]:
+        """Reference PointwiseLossFunction.lossAndDzLoss:36-54."""
+        return self.loss(z, y), self.d1(z, y)
+
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    # l = log(1 + exp(z)) - y*z, stable for large |z|.  Reference
+    # LogisticLossFunction.scala:45-90 (equivalent form with labels in {0,1}).
+    return log1p_exp(z) - y * z
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    return jax.nn.sigmoid(z) - y
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+logistic_loss = PointwiseLoss(
+    name="logistic",
+    loss=_logistic_loss,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=jax.nn.sigmoid,
+)
+
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+squared_loss = PointwiseLoss(
+    name="squared",
+    loss=_squared_loss,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+
+def _poisson_loss(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y * z
+
+
+poisson_loss = PointwiseLoss(
+    name="poisson",
+    loss=_poisson_loss,
+    d1=lambda z, y: jnp.exp(z) - y,
+    d2=lambda z, y: jnp.exp(z),
+    mean=jnp.exp,
+)
+
+
+def _hinge_sign(y: Array) -> Array:
+    # Labels arrive in {0,1}; the reference thresholds soft labels at 0.5 to
+    # s in {-1,+1} (SmoothedHingeLossFunction.scala) — do the same.
+    return jnp.where(y >= 0.5, 1.0, -1.0)
+
+
+def _hinge_t(z: Array, y: Array) -> Array:
+    return _hinge_sign(y) * z
+
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    # Rennie's smoothed hinge (reference SmoothedHingeLossFunction.scala:28-70):
+    #   t >= 1: 0;  t <= 0: 1/2 - t;  else: (1-t)^2 / 2.
+    t = _hinge_t(z, y)
+    quad = 0.5 * (1.0 - t) ** 2
+    return jnp.where(t >= 1.0, 0.0, jnp.where(t <= 0.0, 0.5 - t, quad))
+
+
+def _smoothed_hinge_d1(z: Array, y: Array) -> Array:
+    s = _hinge_sign(y)
+    t = s * z
+    dldt = jnp.where(t >= 1.0, 0.0, jnp.where(t <= 0.0, -1.0, t - 1.0))
+    return s * dldt
+
+
+def _smoothed_hinge_d2(z: Array, y: Array) -> Array:
+    # 1 inside the quadratic region, 0 outside (reference convention).
+    t = _hinge_t(z, y)
+    return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+smoothed_hinge_loss = PointwiseLoss(
+    name="smoothed_hinge",
+    loss=_smoothed_hinge_loss,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    # Score-based classifier: "mean" is the raw margin, thresholded at 0
+    # (reference SmoothedHingeLossLinearSVMModel).
+    mean=lambda z: z,
+)
+
+
+_TASK_LOSS = {
+    TaskType.LOGISTIC_REGRESSION: logistic_loss,
+    TaskType.LINEAR_REGRESSION: squared_loss,
+    TaskType.POISSON_REGRESSION: poisson_loss,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: smoothed_hinge_loss,
+}
+
+_NAME_LOSS = {l.name: l for l in _TASK_LOSS.values()}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    """Reference ObjectiveFunctionHelper.buildFactory per TaskType (:39-46)."""
+    try:
+        return _TASK_LOSS[task]
+    except KeyError:
+        raise ValueError(f"no pointwise loss for task {task!r}")
+
+
+def loss_by_name(name: str) -> PointwiseLoss:
+    return _NAME_LOSS[name]

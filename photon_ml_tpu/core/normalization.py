@@ -1,0 +1,159 @@
+"""Feature-normalization algebra.
+
+Reference: photon-lib .../normalization/NormalizationContext.scala:37-215 and the
+aggregator algebra in function/glm/ValueAndGradientAggregator.scala:36-49.
+
+The transform is affine per feature: x' = (x - shift) .* factor.  The key trick
+(kept from the reference because it is also exactly what a TPU wants) is to never
+materialize x': with
+
+    eff(w)        = w .* factor                      ("effectiveCoefficients")
+    margin_shift(w) = -dot(eff(w), shift)            ("totalShift")
+
+we have  w·x' = eff(w)·x + margin_shift(w),  so margins — and, through autodiff,
+gradients/Hessians — are computed against the RAW sparse/dense x.  The intercept
+column has factor 1 / shift 0 by construction (factory below), matching
+NormalizationContext.scala:137-186.
+
+Coefficient-space maps (NormalizationContext.scala:73-124), margin-invariant:
+  to original space:    w_j = w'_j * factor_j ;  b = b' - Σ_j w'_j factor_j shift_j
+  to transformed space: w'_j = w_j / factor_j ;  b' = b + Σ_j w_j shift_j
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from photon_ml_tpu.types import NormalizationType
+
+Array = jax.Array
+
+
+@struct.dataclass
+class FeatureStats:
+    """Per-feature summary statistics (reference stat/FeatureDataStatistics.scala:139)."""
+
+    mean: Array
+    variance: Array
+    min: Array
+    max: Array
+    abs_max: Array
+    num_nonzeros: Array
+    count: Array  # scalar: number of (weighted) examples
+    intercept_index: Optional[int] = struct.field(pytree_node=False, default=None)
+
+
+def compute_feature_stats(x: Array, weight: Optional[Array] = None,
+                          intercept_index: Optional[int] = None) -> FeatureStats:
+    """Dense-batch feature stats; the sharded variant psums the moments."""
+    n = x.shape[0]
+    if weight is None:
+        mean = jnp.mean(x, axis=0)
+        var = jnp.var(x, axis=0, ddof=1) if n > 1 else jnp.zeros_like(mean)
+        count = jnp.asarray(float(n), x.dtype)
+    else:
+        wsum = jnp.sum(weight)
+        mean = jnp.sum(weight[:, None] * x, axis=0) / wsum
+        var = jnp.sum(weight[:, None] * (x - mean) ** 2, axis=0) / jnp.maximum(wsum - 1.0, 1.0)
+        count = wsum
+    return FeatureStats(
+        mean=mean,
+        variance=var,
+        min=jnp.min(x, axis=0),
+        max=jnp.max(x, axis=0),
+        abs_max=jnp.max(jnp.abs(x), axis=0),
+        num_nonzeros=jnp.sum(x != 0, axis=0).astype(x.dtype),
+        count=count,
+        intercept_index=intercept_index,
+    )
+
+
+@struct.dataclass
+class NormalizationContext:
+    """Affine feature normalization; ``factors``/``shifts`` may be None (identity).
+
+    Replaces the reference's BroadcastWrapper plumbing (util/BroadcastWrapper.scala):
+    under SPMD the arrays are simply replicated leaves of the jitted step's inputs.
+    """
+
+    factors: Optional[Array]  # [d] or None
+    shifts: Optional[Array]  # [d] or None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def effective_coefficients(self, w: Array) -> Array:
+        return w if self.factors is None else w * self.factors
+
+    def margin_shift(self, w: Array) -> Array:
+        """-dot(eff(w), shift); add to every margin."""
+        if self.shifts is None:
+            return jnp.zeros((), w.dtype)
+        return -jnp.vdot(self.effective_coefficients(w), self.shifts)
+
+    def model_to_original_space(self, w: Array, intercept_index: Optional[int]) -> Array:
+        """NormalizationContext.scala:73-99 — map transformed-space coefficients
+        to original space, folding shift into the intercept."""
+        out = self.effective_coefficients(w)
+        if self.shifts is not None:
+            if intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            out = out.at[intercept_index].add(-jnp.vdot(out, self.shifts))
+        return out
+
+    def model_to_transformed_space(self, w: Array, intercept_index: Optional[int]) -> Array:
+        """NormalizationContext.scala:101-124 — inverse of the above."""
+        out = w
+        if self.shifts is not None:
+            if intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            out = out.at[intercept_index].add(jnp.vdot(w, self.shifts))
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
+
+def no_normalization() -> NormalizationContext:
+    """Reference NoNormalization."""
+    return NormalizationContext(factors=None, shifts=None)
+
+
+def build_normalization(kind: NormalizationType, stats: FeatureStats) -> NormalizationContext:
+    """Factory from feature stats (reference NormalizationContext.scala:137-186).
+
+    The intercept column keeps factor 1 / shift 0 so its coefficient is the
+    actual intercept.
+    """
+    if kind == NormalizationType.NONE:
+        return no_normalization()
+
+    std = jnp.sqrt(stats.variance)
+    safe = lambda a: jnp.where(a == 0.0, 1.0, a)  # features with no spread: factor 1
+
+    if kind == NormalizationType.STANDARDIZATION and stats.intercept_index is None:
+        # Shift normalization needs an intercept column to absorb the margin
+        # shift or the model is not representable in original space; the
+        # reference fails fast here too (NormalizationContext.scala:137-186
+        # calls summary.interceptIndex.get).
+        raise ValueError("STANDARDIZATION requires feature stats with an intercept_index")
+
+    if kind == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors, shifts = 1.0 / safe(stats.abs_max), None
+    elif kind == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors, shifts = 1.0 / safe(std), None
+    elif kind == NormalizationType.STANDARDIZATION:
+        factors, shifts = 1.0 / safe(std), stats.mean
+    else:
+        raise ValueError(f"unknown normalization type {kind!r}")
+
+    ii = stats.intercept_index
+    if ii is not None:
+        factors = factors.at[ii].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[ii].set(0.0)
+    return NormalizationContext(factors=factors, shifts=shifts)

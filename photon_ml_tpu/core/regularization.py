@@ -1,0 +1,53 @@
+"""Regularization configuration.
+
+Reference: photon-api .../optimization/RegularizationContext.scala:134 (the
+elastic-net split: l1 = alpha * lambda, l2 = (1 - alpha) * lambda) and the
+stackable L2 mixins in photon-lib .../function/L2Regularization.scala:26-200.
+
+Here regularization is plain data threaded into the objective: the smooth L2
+part joins value/gradient/Hessian; the L1 part is handled by the OWLQN solver's
+orthant-wise machinery (as in the reference, where Breeze OWLQN owns L1).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from flax import struct
+
+
+class RegularizationType(enum.Enum):
+    NONE = "none"
+    L1 = "l1"
+    L2 = "l2"
+    ELASTIC_NET = "elastic_net"
+
+
+@struct.dataclass
+class Regularization:
+    """Smooth + non-smooth regularization weights.
+
+    ``l2`` adds (l2/2)·‖w‖² to the objective (L2Regularization.scala:26);
+    ``l1`` adds l1·‖w‖₁, applied orthant-wise by OWLQN, never differentiated.
+    """
+
+    l1: float = 0.0
+    l2: float = 0.0
+
+    @classmethod
+    def from_context(cls, kind: RegularizationType, weight: float, alpha: float = 1.0) -> "Regularization":
+        """RegularizationContext.scala:134 semantics."""
+        if kind == RegularizationType.NONE:
+            return cls()
+        if kind == RegularizationType.L1:
+            return cls(l1=weight)
+        if kind == RegularizationType.L2:
+            return cls(l2=weight)
+        if kind == RegularizationType.ELASTIC_NET:
+            return cls(l1=alpha * weight, l2=(1.0 - alpha) * weight)
+        raise ValueError(f"unknown regularization type {kind!r}")
+
+    def with_weight(self, kind: RegularizationType, weight: float, alpha: float = 1.0) -> "Regularization":
+        """Reg-path sweeps mutate the weight between runs
+        (reference DistributedOptimizationProblem.updateRegularizationWeight:64-75)."""
+        return Regularization.from_context(kind, weight, alpha)

@@ -1,0 +1,40 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE the jax backend initializes.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference exercises
+distributed code on Spark local[*] in one JVM; we exercise SPMD code on
+xla_force_host_platform_device_count=8 virtual CPU devices in one process.
+float64 is enabled so parity tests against scipy/numpy are tight; library code
+is dtype-agnostic (TPU runs follow input dtypes, normally bf16/f32).
+
+NOTE: jax is pre-imported at interpreter startup in this image, so env vars are
+set via jax.config.update (still effective pre-backend-init); XLA_FLAGS is read
+at backend-client creation, which lazily happens at first device use.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Force CPU even if the ambient environment points at a TPU.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(20260729)

@@ -1,0 +1,83 @@
+"""Pointwise-loss unit tests (reference parity: photon-lib function/glm tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core import losses
+from photon_ml_tpu.types import TaskType
+
+ALL = [losses.logistic_loss, losses.squared_loss, losses.poisson_loss, losses.smoothed_hinge_loss]
+
+
+def _labels_for(loss, rng, n):
+    if loss.name == "squared":
+        return rng.normal(size=n)
+    if loss.name == "poisson":
+        return rng.poisson(2.0, size=n).astype(float)
+    return (rng.random(n) > 0.5).astype(float)
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_d1_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=64) * 3)
+    y = jnp.asarray(_labels_for(loss, rng, 64))
+    ad = jax.vmap(jax.grad(lambda zi, yi: loss.loss(zi, yi)))(z, y)
+    np.testing.assert_allclose(loss.d1(z, y), ad, rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_d2_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=64) * 3)
+    y = jnp.asarray(_labels_for(loss, rng, 64))
+    ad = jax.vmap(jax.grad(jax.grad(lambda zi, yi: loss.loss(zi, yi))))(z, y)
+    np.testing.assert_allclose(loss.d2(z, y), ad, rtol=1e-10, atol=1e-12)
+
+
+def test_logistic_stable_at_extremes():
+    z = jnp.asarray([-1e4, -50.0, 0.0, 50.0, 1e4])
+    y = jnp.asarray([0.0, 1.0, 1.0, 0.0, 1.0])
+    l = losses.logistic_loss.loss(z, y)
+    assert np.all(np.isfinite(l))
+    # log(1+e^z) - y z: at z=1e4,y=1 -> ~0; at z=1e4,y=0 -> ~1e4
+    np.testing.assert_allclose(l[3], 50.0, rtol=1e-12)
+    np.testing.assert_allclose(l[4], 0.0, atol=1e-12)
+    np.testing.assert_allclose(l[2], np.log(2.0), rtol=1e-12)
+
+
+def test_logistic_value_known():
+    # l(0, y) = log 2 regardless of label; l'(0, y) = 0.5 - y
+    z = jnp.zeros(2)
+    y = jnp.asarray([0.0, 1.0])
+    np.testing.assert_allclose(losses.logistic_loss.loss(z, y), np.log(2.0))
+    np.testing.assert_allclose(losses.logistic_loss.d1(z, y), [0.5, -0.5])
+
+
+def test_smoothed_hinge_piecewise():
+    # Rennie smoothed hinge with positive label: t=z.
+    l = losses.smoothed_hinge_loss
+    y = jnp.ones(5)
+    z = jnp.asarray([-1.0, 0.0, 0.5, 1.0, 2.0])
+    np.testing.assert_allclose(l.loss(z, y), [1.5, 0.5, 0.125, 0.0, 0.0])
+    np.testing.assert_allclose(l.d1(z, y), [-1.0, -1.0, -0.5, 0.0, 0.0])
+    np.testing.assert_allclose(l.d2(z, y), [0.0, 0.0, 1.0, 0.0, 0.0])
+    # negative label mirrors: t=-z
+    y0 = jnp.zeros(5)
+    np.testing.assert_allclose(l.loss(-z, y0), l.loss(z, y))
+
+
+def test_poisson_forms():
+    z = jnp.asarray([0.0, 1.0, -1.0])
+    y = jnp.asarray([1.0, 2.0, 0.0])
+    np.testing.assert_allclose(losses.poisson_loss.loss(z, y), np.exp(z) - np.asarray(y) * np.asarray(z))
+    np.testing.assert_allclose(losses.poisson_loss.mean(z), np.exp(z))
+
+
+def test_task_mapping():
+    assert losses.loss_for_task(TaskType.LOGISTIC_REGRESSION) is losses.logistic_loss
+    assert losses.loss_for_task(TaskType.LINEAR_REGRESSION) is losses.squared_loss
+    assert losses.loss_for_task(TaskType.POISSON_REGRESSION) is losses.poisson_loss
+    assert losses.loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM) is losses.smoothed_hinge_loss
+    with pytest.raises(ValueError):
+        losses.loss_for_task(TaskType.NONE)

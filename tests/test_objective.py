@@ -1,0 +1,194 @@
+"""GLM objective tests: closed-form vs autodiff, sparse vs dense, normalization
+algebra invariants (reference: photon-lib function/glm/*AggregatorTest,
+NormalizationContextIntegTest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core import (
+    DenseBatch,
+    GLMObjective,
+    NormalizationContext,
+    Regularization,
+    losses,
+)
+from photon_ml_tpu.core.batch import dense_batch, sparse_batch
+from photon_ml_tpu.core.normalization import (
+    FeatureStats,
+    build_normalization,
+    compute_feature_stats,
+    no_normalization,
+)
+from photon_ml_tpu.types import NormalizationType
+
+N, D, K = 48, 7, 4
+
+
+def _data(rng, sparse=False):
+    y = (rng.random(N) > 0.5).astype(float)
+    offset = rng.normal(size=N) * 0.1
+    weight = rng.random(N) + 0.5
+    if sparse:
+        idx = np.stack([rng.choice(D, size=K, replace=False) for _ in range(N)])
+        val = rng.normal(size=(N, K))
+        # pad last slot of some rows
+        val[::5, -1] = 0.0
+        return sparse_batch(idx, val, y, dim=D, offset=offset, weight=weight)
+    x = rng.normal(size=(N, D))
+    return dense_batch(x, y, offset=offset, weight=weight)
+
+
+def _norms(rng):
+    factors = jnp.asarray(rng.random(D) + 0.5)
+    shifts = jnp.asarray(rng.normal(size=D))
+    return [
+        no_normalization(),
+        NormalizationContext(factors=factors, shifts=None),
+        NormalizationContext(factors=factors, shifts=shifts),
+    ]
+
+
+@pytest.mark.parametrize("sparse", [False, True], ids=["dense", "sparse"])
+@pytest.mark.parametrize("normi", [0, 1, 2], ids=["none", "scale", "affine"])
+def test_grad_and_hvp_match_autodiff(rng, sparse, normi):
+    batch = _data(rng, sparse)
+    norm = _norms(rng)[normi]
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.3), norm=norm)
+    w = jnp.asarray(rng.normal(size=D))
+    v = jnp.asarray(rng.normal(size=D))
+
+    val, g = obj.value_and_grad(w, batch)
+    np.testing.assert_allclose(val, obj.value(w, batch), rtol=1e-12)
+    ad_g = jax.grad(obj.value)(w, batch)
+    np.testing.assert_allclose(g, ad_g, rtol=1e-9, atol=1e-11)
+
+    hv = obj.hvp(w, batch, v)
+    ad_hv = jax.jvp(lambda u: jax.grad(obj.value)(u, batch), (w,), (v,))[1]
+    np.testing.assert_allclose(hv, ad_hv, rtol=1e-9, atol=1e-11)
+
+
+@pytest.mark.parametrize("normi", [0, 1, 2], ids=["none", "scale", "affine"])
+def test_hessian_diag_and_full(rng, normi):
+    batch = _data(rng)
+    norm = _norms(rng)[normi]
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.1), norm=norm)
+    w = jnp.asarray(rng.normal(size=D))
+    h = jax.hessian(obj.value)(w, batch)
+    np.testing.assert_allclose(obj.hessian(w, batch), h, rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(obj.hessian_diag(w, batch), jnp.diagonal(h), rtol=1e-9, atol=1e-11)
+
+
+def test_sparse_matches_dense(rng):
+    sb = _data(rng, sparse=True)
+    db = sb.to_dense()
+    obj = GLMObjective(loss=losses.poisson_loss)
+    obj_d = GLMObjective(loss=losses.poisson_loss)
+    w = jnp.asarray(rng.normal(size=D) * 0.3)
+    y = jnp.abs(sb.y)
+    sb = sb.replace(y=y)
+    db = db.replace(y=y)
+    v_s, g_s = obj.value_and_grad(w, sb)
+    v_d, g_d = obj_d.value_and_grad(w, db)
+    np.testing.assert_allclose(v_s, v_d, rtol=1e-12)
+    np.testing.assert_allclose(g_s, g_d, rtol=1e-12)
+
+
+def test_normalization_margin_invariance(rng):
+    """Objective on raw X with normalization algebra == objective on explicitly
+    transformed X (the whole point of the effective-coef trick,
+    ValueAndGradientAggregator.scala:36-49)."""
+    batch = _data(rng)
+    factors = jnp.asarray(rng.random(D) + 0.5)
+    shifts = jnp.asarray(rng.normal(size=D))
+    norm = NormalizationContext(factors=factors, shifts=shifts)
+    obj = GLMObjective(loss=losses.logistic_loss, norm=norm)
+    w = jnp.asarray(rng.normal(size=D))
+
+    x_t = (batch.x - shifts) * factors
+    batch_t = DenseBatch(x=x_t, y=batch.y, offset=batch.offset, weight=batch.weight)
+    obj_t = GLMObjective(loss=losses.logistic_loss)
+    np.testing.assert_allclose(obj.value(w, batch), obj_t.value(w, batch_t), rtol=1e-12)
+    np.testing.assert_allclose(
+        obj.gradient(w, batch), obj_t.gradient(w, batch_t), rtol=1e-9, atol=1e-11
+    )
+
+
+def test_model_space_roundtrip(rng):
+    """modelToOriginalSpace / modelToTransformedSpace are inverse and margin-
+    invariant (NormalizationContext.scala:73-124)."""
+    x = rng.normal(size=(N, D))
+    x[:, 0] = 1.0  # intercept column
+    stats = compute_feature_stats(jnp.asarray(x), intercept_index=0)
+    norm = build_normalization(NormalizationType.STANDARDIZATION, stats)
+    assert norm.factors[0] == 1.0 and norm.shifts[0] == 0.0
+
+    w_t = jnp.asarray(rng.normal(size=D))
+    w_o = norm.model_to_original_space(w_t, intercept_index=0)
+    np.testing.assert_allclose(
+        norm.model_to_transformed_space(w_o, intercept_index=0), w_t, rtol=1e-9, atol=1e-12
+    )
+    # margin invariance: w_t over transformed x == w_o over raw x
+    xj = jnp.asarray(x)
+    m_t = ((xj - norm.shifts) * norm.factors) @ w_t
+    np.testing.assert_allclose(xj @ w_o, m_t, rtol=1e-9, atol=1e-9)
+
+
+def test_feature_stats(rng):
+    x = rng.normal(size=(N, D))
+    s = compute_feature_stats(jnp.asarray(x))
+    np.testing.assert_allclose(s.mean, x.mean(0), rtol=1e-12)
+    np.testing.assert_allclose(s.variance, x.var(0, ddof=1), rtol=1e-10)
+    np.testing.assert_allclose(s.abs_max, np.abs(x).max(0), rtol=1e-12)
+
+
+def test_weight_zero_padding_is_inert(rng):
+    """Padded rows (weight 0) must not affect value/grad — the masking contract
+    that every vmapped/sharded path relies on."""
+    batch = _data(rng)
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.2))
+    w = jnp.asarray(rng.normal(size=D))
+    # append garbage rows with weight 0
+    pad = 5
+    xg = jnp.concatenate([batch.x, jnp.full((pad, D), 1e6)], 0)
+    yg = jnp.concatenate([batch.y, jnp.ones(pad)])
+    og = jnp.concatenate([batch.offset, jnp.full((pad,), 1e6)])
+    wg = jnp.concatenate([batch.weight, jnp.zeros(pad)])
+    padded = DenseBatch(x=xg, y=yg, offset=og, weight=wg)
+    v0, g0 = obj.value_and_grad(w, batch)
+    v1, g1 = obj.value_and_grad(w, padded)
+    np.testing.assert_allclose(v0, v1, rtol=1e-12)
+    np.testing.assert_allclose(g0, g1, rtol=1e-12)
+
+
+def test_weight_zero_padding_unbounded_loss(rng):
+    """0-weight rows must not poison reductions via 0*inf (poisson exp)."""
+    x = np.concatenate([rng.normal(size=(8, D)), np.full((2, D), 1e4)])
+    y = np.concatenate([rng.poisson(2.0, size=8).astype(float), np.zeros(2)])
+    w8 = np.concatenate([np.ones(8), np.zeros(2)])
+    batch = dense_batch(x, y, weight=w8)
+    obj = GLMObjective(loss=losses.poisson_loss)
+    w = jnp.asarray(rng.normal(size=D) * 0.1)
+    v, g = obj.value_and_grad(w, batch)
+    assert np.isfinite(v) and np.all(np.isfinite(g))
+    ref = GLMObjective(loss=losses.poisson_loss).value(w, dense_batch(x[:8], y[:8]))
+    np.testing.assert_allclose(v, ref, rtol=1e-12)
+    assert np.all(np.isfinite(obj.hvp(w, batch, w)))
+    assert np.all(np.isfinite(obj.hessian_diag(w, batch)))
+    assert np.all(np.isfinite(obj.hessian(w, batch)))
+
+
+def test_smoothed_hinge_soft_labels_thresholded():
+    """Reference thresholds soft labels at 0.5 (SmoothedHingeLossFunction.scala)."""
+    l = losses.smoothed_hinge_loss
+    z = jnp.asarray([0.3, 0.3])
+    np.testing.assert_allclose(l.loss(z, jnp.asarray([0.7, 1.0]))[0], l.loss(z, jnp.ones(2))[1])
+    np.testing.assert_allclose(l.d1(z, jnp.asarray([0.2, 0.0]))[0], l.d1(z, jnp.zeros(2))[1])
+
+
+def test_standardization_requires_intercept(rng):
+    from photon_ml_tpu.core.normalization import build_normalization as bn
+    stats = compute_feature_stats(jnp.asarray(rng.normal(size=(N, D))))
+    with pytest.raises(ValueError, match="intercept"):
+        bn(NormalizationType.STANDARDIZATION, stats)
