@@ -169,4 +169,7 @@ def loss_for_task(task: TaskType) -> PointwiseLoss:
 
 
 def loss_by_name(name: str) -> PointwiseLoss:
-    return _NAME_LOSS[name]
+    try:
+        return _NAME_LOSS[name]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; valid: {sorted(_NAME_LOSS)}")

@@ -46,8 +46,3 @@ class Regularization:
         if kind == RegularizationType.ELASTIC_NET:
             return cls(l1=alpha * weight, l2=(1.0 - alpha) * weight)
         raise ValueError(f"unknown regularization type {kind!r}")
-
-    def with_weight(self, kind: RegularizationType, weight: float, alpha: float = 1.0) -> "Regularization":
-        """Reg-path sweeps mutate the weight between runs
-        (reference DistributedOptimizationProblem.updateRegularizationWeight:64-75)."""
-        return Regularization.from_context(kind, weight, alpha)

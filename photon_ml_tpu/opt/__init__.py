@@ -1,0 +1,5 @@
+from photon_ml_tpu.opt.types import SolverConfig, SolverResult  # noqa: F401
+from photon_ml_tpu.opt.lbfgs import minimize_lbfgs, minimize_owlqn  # noqa: F401
+from photon_ml_tpu.opt.tron import minimize_tron  # noqa: F401
+from photon_ml_tpu.opt.constraints import project_to_box, box_arrays  # noqa: F401
+from photon_ml_tpu.opt.solve import make_solver  # noqa: F401

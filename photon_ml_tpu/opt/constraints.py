@@ -1,0 +1,47 @@
+"""Box-constraint projection.
+
+Reference: photon-lib .../optimization/OptimizationUtils.scala:71
+(``projectCoefficientsToSubspace`` with ``constraintMap: Map[Int, (lo, hi)]``)
+and LBFGSB.scala:30-95 (box-constrained LBFGS used by the GP kernel fit).
+
+TPU shape: the sparse Map[Int, (lo, hi)] becomes a dense ([d], [d]) pair of
+(lower, upper) arrays with ±inf for unconstrained entries — one fused clip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.types import ConstraintMap
+
+Array = jax.Array
+
+
+def box_arrays(constraint_map: Optional[ConstraintMap], dim: int, dtype=np.float32
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Densify a {feature index: (lo, hi)} map into (lower[d], upper[d])."""
+    if not constraint_map:
+        return None
+    lower = np.full((dim,), -np.inf, dtype)
+    upper = np.full((dim,), np.inf, dtype)
+    for idx, (lo, hi) in constraint_map.items():
+        if not 0 <= idx < dim:
+            raise ValueError(f"constraint index {idx} out of range [0, {dim})")
+        if lo > hi:
+            raise ValueError(f"constraint lo > hi at index {idx}: ({lo}, {hi})")
+        lower[idx] = lo
+        upper[idx] = hi
+    return lower, upper
+
+
+def project_to_box(lower: Array, upper: Array) -> Callable[[Array], Array]:
+    """Return a projection w -> clip(w, lower, upper) for solver use."""
+
+    def project(w: Array) -> Array:
+        return jnp.clip(w, lower, upper)
+
+    return project

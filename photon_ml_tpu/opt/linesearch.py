@@ -1,0 +1,205 @@
+"""Strong-Wolfe line search (bracket + zoom) as a single ``lax.while_loop``
+state machine — jittable and vmappable.
+
+The reference delegates line search to Breeze's StrongWolfeLineSearch
+(optimization/LBFGS.scala:39-108 wraps Breeze LBFGS which owns the search).
+On TPU each function evaluation is one fused value+grad pass (psum'd under
+SPMD), so the search is written to (a) evaluate at most ``max_evals`` times
+with static control flow and (b) carry the full gradient of the best point so
+the optimizer never re-evaluates it.
+
+Algorithm: Nocedal & Wright, Algorithms 3.5 (bracketing) / 3.6 (zoom), with a
+safeguarded quadratic-interpolation zoom step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+_BRACKET, _ZOOM, _DONE, _FAILED = 0, 1, 2, 3
+
+
+class LineSearchResult(NamedTuple):
+    alpha: Array  # accepted step (0.0 on failure)
+    phi: Array  # f(w + alpha*d)
+    g: Array  # grad f(w + alpha*d)  [d]
+    success: Array  # bool: some Armijo-satisfying step found
+    wolfe: Array  # bool: strong Wolfe conditions met
+    num_evals: Array  # int32
+
+
+class _State(NamedTuple):
+    stage: Array
+    i: Array  # eval count
+    alpha: Array  # next trial step
+    # bracketing history
+    alpha_prev: Array
+    phi_prev: Array
+    # zoom interval
+    lo: Array
+    hi: Array
+    phi_lo: Array
+    dphi_lo: Array
+    phi_hi: Array
+    # best Armijo point so far (its full gradient rides along)
+    best_alpha: Array
+    best_phi: Array
+    best_g: Array
+    wolfe: Array
+
+
+def strong_wolfe(
+    phi_fn: Callable[[Array], Tuple[Array, Array]],
+    phi0: Array,
+    g0: Array,
+    d: Array,
+    alpha0: Array,
+    c1: float = 1e-4,
+    c2: float = 0.9,
+    max_evals: int = 25,
+    max_alpha: float = 1e10,
+) -> LineSearchResult:
+    """Find alpha satisfying strong Wolfe conditions along direction d.
+
+    phi_fn(alpha) -> (f(w + alpha d), grad f(w + alpha d)).
+    phi0/g0: objective value/gradient at alpha=0.
+    """
+    dtype = phi0.dtype
+    dphi0 = jnp.vdot(g0, d).astype(dtype)
+
+    def eval_at(alpha):
+        phi, g = phi_fn(alpha)
+        return phi, g, jnp.vdot(g, d).astype(dtype)
+
+    def armijo_ok(alpha, phi):
+        return phi <= phi0 + c1 * alpha * dphi0
+
+    def curvature_ok(dphi):
+        return jnp.abs(dphi) <= -c2 * dphi0
+
+    def bracket_step(s: _State, phi, g, dphi):
+        fail_cond = ~armijo_ok(s.alpha, phi) | ((s.i > 0) & (phi >= s.phi_prev))
+        curv = curvature_ok(dphi)
+        pos = dphi >= 0
+
+        # case 1: Armijo violated (or no decrease) -> zoom(alpha_prev, alpha).
+        # phi_lo/dphi_lo describe alpha_prev; its gradient is already in best_g
+        # (alpha_prev always satisfied Armijo, or is 0 with best_g = g0).
+        z1 = s._replace(
+            stage=jnp.int32(_ZOOM),
+            lo=s.alpha_prev, hi=s.alpha,
+            phi_lo=s.phi_prev, dphi_lo=jnp.where(s.i > 0, s.dphi_lo, dphi0),
+            phi_hi=phi,
+        )
+        # case 2: strong Wolfe satisfied -> done at alpha.
+        z2 = s._replace(stage=jnp.int32(_DONE), best_alpha=s.alpha, best_phi=phi,
+                        best_g=g, wolfe=jnp.bool_(True))
+        # case 3: derivative >= 0 -> zoom(alpha, alpha_prev); alpha is best.
+        z3 = s._replace(
+            stage=jnp.int32(_ZOOM),
+            lo=s.alpha, hi=s.alpha_prev,
+            phi_lo=phi, dphi_lo=dphi, phi_hi=s.phi_prev,
+            best_alpha=s.alpha, best_phi=phi, best_g=g,
+        )
+        # case 4: keep expanding; alpha satisfies Armijo and decreases -> best.
+        z4 = s._replace(
+            alpha=jnp.minimum(2.0 * s.alpha, max_alpha),
+            alpha_prev=s.alpha, phi_prev=phi, dphi_lo=dphi,
+            best_alpha=s.alpha, best_phi=phi, best_g=g,
+        )
+
+        out = jax.tree.map(
+            lambda a, b, c, dd: jnp.where(fail_cond, a, jnp.where(curv, b, jnp.where(pos, c, dd))),
+            z1, z2, z3, z4,
+        )
+        return out
+
+    def zoom_step(s: _State, phi, g, dphi):
+        # s.alpha is the interpolated trial inside [lo, hi].
+        fail_cond = ~armijo_ok(s.alpha, phi) | (phi >= s.phi_lo)
+        curv = curvature_ok(dphi)
+        flip = dphi * (s.hi - s.lo) >= 0
+
+        # shrink from the hi side
+        z1 = s._replace(hi=s.alpha, phi_hi=phi)
+        # done
+        z2 = s._replace(stage=jnp.int32(_DONE), best_alpha=s.alpha, best_phi=phi,
+                        best_g=g, wolfe=jnp.bool_(True))
+        # new lo, possibly flipping hi to old lo
+        z3 = s._replace(
+            lo=s.alpha, phi_lo=phi, dphi_lo=dphi,
+            hi=jnp.where(flip, s.lo, s.hi),
+            phi_hi=jnp.where(flip, s.phi_lo, s.phi_hi),
+            best_alpha=s.alpha, best_phi=phi, best_g=g,
+        )
+        out = jax.tree.map(
+            lambda a, b, c: jnp.where(fail_cond, a, jnp.where(curv, b, c)),
+            z1, z2, z3,
+        )
+        # interval collapse -> stop at best
+        tiny = jnp.abs(out.hi - out.lo) <= 1e-12 * jnp.maximum(1.0, jnp.abs(out.hi))
+        return out._replace(
+            stage=jnp.where((out.stage == _ZOOM) & tiny, jnp.int32(_DONE), out.stage)
+        )
+
+    def next_zoom_alpha(s: _State) -> Array:
+        """Safeguarded quadratic interpolation using (phi_lo, dphi_lo, phi_hi)."""
+        dx = s.hi - s.lo
+        denom = 2.0 * (s.phi_hi - s.phi_lo - s.dphi_lo * dx)
+        quad = s.lo - s.dphi_lo * dx * dx / jnp.where(denom == 0, 1.0, denom)
+        bad = (denom == 0) | ~jnp.isfinite(quad)
+        mid = s.lo + 0.5 * dx
+        a_min = s.lo + 0.1 * dx
+        a_max = s.lo + 0.9 * dx
+        safe = jnp.clip(quad, jnp.minimum(a_min, a_max), jnp.maximum(a_min, a_max))
+        return jnp.where(bad, mid, safe)
+
+    def body(s: _State) -> _State:
+        phi, g, dphi = eval_at(s.alpha)
+        s2 = lax.cond(s.stage == _BRACKET,
+                      lambda: bracket_step(s, phi, g, dphi),
+                      lambda: zoom_step(s, phi, g, dphi))
+        s2 = s2._replace(i=s.i + 1)
+        # pick the next zoom trial point
+        nz = next_zoom_alpha(s2)
+        s2 = s2._replace(alpha=jnp.where(s2.stage == _ZOOM, nz, s2.alpha))
+        return s2
+
+    def cond(s: _State) -> Array:
+        return (s.stage < _DONE) & (s.i < max_evals)
+
+    init = _State(
+        stage=jnp.int32(_BRACKET),
+        i=jnp.int32(0),
+        alpha=jnp.asarray(alpha0, dtype),
+        alpha_prev=jnp.zeros((), dtype),
+        phi_prev=phi0,
+        lo=jnp.zeros((), dtype),
+        hi=jnp.zeros((), dtype),
+        phi_lo=phi0,
+        dphi_lo=dphi0,
+        phi_hi=phi0,
+        best_alpha=jnp.zeros((), dtype),
+        best_phi=phi0,
+        best_g=g0,
+        wolfe=jnp.bool_(False),
+    )
+    # Non-descent direction: fail immediately (caller restarts with -g).
+    init = init._replace(stage=jnp.where(dphi0 >= 0, jnp.int32(_FAILED), init.stage))
+
+    final = lax.while_loop(cond, body, init)
+    success = final.best_alpha > 0
+    return LineSearchResult(
+        alpha=final.best_alpha,
+        phi=final.best_phi,
+        g=final.best_g,
+        success=success,
+        wolfe=final.wolfe,
+        num_evals=final.i,
+    )

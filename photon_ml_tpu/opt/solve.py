@@ -1,0 +1,104 @@
+"""Solver factory binding a GLMObjective + optimizer choice into a jittable
+``solve(w0, batch) -> SolverResult`` function, plus coefficient-variance
+computation.
+
+Reference: OptimizerFactory.scala:80, GeneralizedLinearOptimizationProblem.scala:173,
+DistributedOptimizationProblem.scala:46-217 (variance: 84-108 — SIMPLE is
+1/diag(H), FULL is diag(H^-1) via Cholesky, Linalg.choleskyInverse:104).
+
+The returned ``solve`` is the SINGLE kernel reused in both deployment shapes
+(SURVEY.md §1): jit it plainly (or shard_map its objective) for the fixed
+effect; ``jax.vmap(solve)`` over padded entity buckets for random effects.
+The reference selects OWLQN automatically when L1 regularization is present
+(LBFGS.scala init) — same rule here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.batch import Batch
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.opt.lbfgs import minimize_lbfgs, minimize_owlqn
+from photon_ml_tpu.opt.tron import minimize_tron
+from photon_ml_tpu.opt.types import SolverConfig, SolverResult
+from photon_ml_tpu.types import OptimizerType, VarianceComputationType
+
+Array = jax.Array
+
+
+def make_solver(
+    objective: GLMObjective,
+    optimizer: OptimizerType = OptimizerType.LBFGS,
+    config: Optional[SolverConfig] = None,
+    box: Optional[Tuple[Array, Array]] = None,
+) -> Callable[[Array, Batch], SolverResult]:
+    """Build solve(w0, batch) for one GLM coordinate.
+
+    ``box``: optional (lower[d], upper[d]) constraint arrays
+    (reference constrained-coefficients path, OptimizationUtils.scala).
+    """
+    if config is None:
+        config = SolverConfig.tron_default() if optimizer == OptimizerType.TRON else SolverConfig.lbfgs_default()
+    has_l1 = objective.reg.l1 > 0.0
+
+    if optimizer == OptimizerType.TRON and has_l1:
+        raise ValueError("TRON does not support L1 regularization (reference parity)")
+    if optimizer == OptimizerType.TRON and box is not None:
+        raise ValueError("TRON does not support box constraints")
+    if optimizer == OptimizerType.OWLQN or (optimizer == OptimizerType.LBFGS and has_l1):
+        if box is not None:
+            raise ValueError("OWLQN does not support box constraints")
+
+        def solve_owlqn(w0: Array, batch: Batch) -> SolverResult:
+            vg = lambda w: objective.value_and_grad(w, batch)
+            return minimize_owlqn(vg, w0, objective.reg.l1, config)
+
+        return solve_owlqn
+
+    if optimizer == OptimizerType.LBFGS:
+
+        def solve_lbfgs(w0: Array, batch: Batch) -> SolverResult:
+            vg = lambda w: objective.value_and_grad(w, batch)
+            return minimize_lbfgs(vg, w0, config, box=box)
+
+        return solve_lbfgs
+
+    if optimizer == OptimizerType.TRON:
+
+        def solve_tron(w0: Array, batch: Batch) -> SolverResult:
+            vg = lambda w: objective.value_and_grad(w, batch)
+            hvp_at = lambda w, v: objective.hvp(w, batch, v)
+            return minimize_tron(vg, hvp_at, w0, config)
+
+        return solve_tron
+
+    raise ValueError(f"unknown optimizer {optimizer!r}")
+
+
+def compute_variances(
+    objective: GLMObjective,
+    w: Array,
+    batch: Batch,
+    kind: VarianceComputationType,
+) -> Optional[Array]:
+    """Coefficient variances (reference DistributedOptimizationProblem.scala:84-108).
+
+    SIMPLE: 1 / diag(H)  (NOT the inverse-Hessian diagonal — reference parity).
+    FULL:   diag(H^-1) via Cholesky (reference Linalg.choleskyInverse:104).
+    """
+    if kind == VarianceComputationType.NONE:
+        return None
+    if kind == VarianceComputationType.SIMPLE:
+        d = objective.hessian_diag(w, batch)
+        return 1.0 / jnp.where(d == 0, jnp.inf, d)
+    if kind == VarianceComputationType.FULL:
+        h = objective.hessian(w, batch)
+        eye = jnp.eye(h.shape[-1], dtype=h.dtype)
+        chol = jnp.linalg.cholesky(h)
+        hinv = jax.scipy.linalg.cho_solve((chol, True), eye)
+        return jnp.diagonal(hinv)
+    raise ValueError(f"unknown variance computation type {kind!r}")

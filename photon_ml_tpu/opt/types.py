@@ -1,0 +1,132 @@
+"""Solver configuration / result / state-tracking containers.
+
+Reference: photon-lib .../optimization/Optimizer.scala:36-249 (iteration loop,
+convergence reasons, rel->abs tolerance derived from the FIRST state) and
+OptimizationStatesTracker.scala (per-iteration value/gradient-norm history).
+
+TPU-first: everything is a statically-shaped pytree so solvers run inside
+``lax.while_loop`` and under ``vmap`` (per-entity random-effect solves with
+per-lane convergence masks).  The tracker is a pre-allocated [max_iters] array
+written with ``.at[iter].set`` — the device-side analog of the reference's
+mutable state list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from photon_ml_tpu.types import ConvergenceReason
+
+Array = jax.Array
+
+
+@struct.dataclass
+class SolverConfig:
+    """Solver hyperparameters.  Static fields shape the compiled program.
+
+    Defaults follow the reference: LBFGS m=10, tol=1e-7, maxIter=100
+    (LBFGS.scala:152-157); TRON tol=1e-5, maxIter=15, CG<=20 (TRON.scala:256-262).
+    """
+
+    max_iters: int = struct.field(pytree_node=False, default=100)
+    tolerance: float = struct.field(pytree_node=False, default=1e-7)
+    history: int = struct.field(pytree_node=False, default=10)  # L-BFGS m
+    max_linesearch: int = struct.field(pytree_node=False, default=25)
+    c1: float = struct.field(pytree_node=False, default=1e-4)  # Armijo
+    c2: float = struct.field(pytree_node=False, default=0.9)  # Wolfe curvature
+    # TRON (reference TRON.scala:80-253):
+    max_cg: int = struct.field(pytree_node=False, default=20)
+    track_states: bool = struct.field(pytree_node=False, default=True)
+
+    @classmethod
+    def lbfgs_default(cls) -> "SolverConfig":
+        return cls(max_iters=100, tolerance=1e-7)
+
+    @classmethod
+    def tron_default(cls) -> "SolverConfig":
+        return cls(max_iters=15, tolerance=1e-5, max_cg=20)
+
+
+@struct.dataclass
+class StateTracker:
+    """Stacked per-iteration history (reference OptimizationStatesTracker).
+
+    ``values[i]`` / ``grad_norms[i]`` are valid for i < num_states; unused
+    slots stay at their init sentinel (nan).  Shape [max_iters + 1]: slot 0 is
+    the initial state, matching the reference which records the state at the
+    initial coefficients before iterating (Optimizer.scala:181).
+    """
+
+    values: Array
+    grad_norms: Array
+    num_states: Array  # int32 scalar
+
+    @classmethod
+    def init(cls, max_iters: int, dtype) -> "StateTracker":
+        n = max_iters + 1
+        return cls(
+            values=jnp.full((n,), jnp.nan, dtype),
+            grad_norms=jnp.full((n,), jnp.nan, dtype),
+            num_states=jnp.zeros((), jnp.int32),
+        )
+
+    def record(self, value: Array, grad_norm: Array) -> "StateTracker":
+        i = self.num_states
+        return StateTracker(
+            values=self.values.at[i].set(value),
+            grad_norms=self.grad_norms.at[i].set(grad_norm),
+            num_states=i + 1,
+        )
+
+
+@struct.dataclass
+class SolverResult:
+    """Final solver output.
+
+    ``reason`` encodes ConvergenceReason as int32 (device-friendly); use
+    ``convergence_reason()`` host-side.
+    """
+
+    w: Array
+    value: Array
+    grad_norm: Array
+    iterations: Array  # int32
+    reason: Array  # int32 ConvergenceReason
+    tracker: Optional[StateTracker] = None
+
+    def convergence_reason(self) -> ConvergenceReason:
+        return ConvergenceReason(int(self.reason))
+
+
+def convergence_check(value, prev_value, init_value, grad_norm, init_grad_norm,
+                      iteration, max_iters, tolerance):
+    """The reference's convergence logic (Optimizer.scala:135-149), vectorized.
+
+    Tolerances are RELATIVE to the initial state (rel->abs conversion at
+    iteration 0, Optimizer.scala:181):
+      - FunctionValuesConverged: |f_k - f_{k-1}| <= tol * max(|f_0|, eps)
+      - GradientConverged:       ||g_k|| <= tol * max(||g_0||, eps)
+      - MaxIterations:           k >= max_iters
+    Returns int32 reason (0 = not converged).  Priority order matches the
+    reference's check order: function values, gradient, max-iterations.
+    """
+    eps = jnp.asarray(jnp.finfo(value.dtype).tiny, value.dtype)
+    f_tol = tolerance * jnp.maximum(jnp.abs(init_value), eps)
+    g_tol = tolerance * jnp.maximum(init_grad_norm, eps)
+    func_conv = jnp.abs(value - prev_value) <= f_tol
+    grad_conv = grad_norm <= g_tol
+    max_iter = iteration >= max_iters
+    reason = jnp.where(
+        func_conv,
+        ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+        jnp.where(
+            grad_conv,
+            ConvergenceReason.GRADIENT_CONVERGED,
+            jnp.where(max_iter, ConvergenceReason.MAX_ITERATIONS, ConvergenceReason.NOT_CONVERGED),
+        ),
+    )
+    return reason.astype(jnp.int32)
