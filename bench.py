@@ -1,0 +1,127 @@
+"""Benchmark: GLMix 2-coordinate training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Config #3 of BASELINE.md (GLMix 2-coordinate: global fixed + per-user random
+effect, logistic).  The reference publishes no numbers (BASELINE.json
+published: {}), so vs_baseline is measured against a self-contained CPU
+numpy/scipy implementation of the same training loop run on this machine —
+the stand-in for the reference's Spark-CPU execution model (single-node
+local[*] is also how the reference's own regression baselines were captured,
+GameTrainingDriverIntegTest.scala:79-80).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _synth(rng, n_users=512, per_user=256, d_global=128, d_user=16, dtype=np.float32):
+    n = n_users * per_user
+    xg = rng.normal(size=(n, d_global)).astype(dtype)
+    xu = rng.normal(size=(n, d_user)).astype(dtype)
+    uids = np.repeat(np.arange(n_users), per_user)
+    wg = (rng.normal(size=d_global) * 0.5).astype(dtype)
+    wu = (rng.normal(size=(n_users, d_user)) * 1.0).astype(dtype)
+    logits = xg @ wg + np.einsum("nd,nd->n", xu, wu[uids])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(dtype)
+    perm = rng.permutation(n)
+    return xg[perm], xu[perm], uids[perm], y[perm]
+
+
+def bench_tpu(xg, xu, uids, y, outer_iters=2):
+    """Steady-state training throughput: coordinates (device data layout +
+    jitted solvers) are built once; we time full coordinate-descent sweeps —
+    the analog of timing the reference's training loop after the RDDs are
+    materialized (not the Avro load)."""
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import CoordinateDescent, FixedEffectConfig, GameData, RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import TaskType
+
+    data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
+    solver = SolverConfig(max_iters=30, tolerance=1e-7)
+    task = TaskType.LOGISTIC_REGRESSION
+    coords = {
+        "fixed": build_coordinate(
+            "fixed", data, FixedEffectConfig(feature_shard="g", solver=solver,
+                                             reg=Regularization(l2=1.0)), task),
+        "per-user": build_coordinate(
+            "per-user", data,
+            RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                               solver=solver, reg=Regularization(l2=1.0)), task),
+    }
+    descent = CoordinateDescent(coords, num_iterations=outer_iters)
+    descent.run()  # warm-up: compiles every solver once
+    t0 = time.perf_counter()
+    model, _, _ = descent.run()
+    dt = time.perf_counter() - t0
+    return dt, model
+
+
+def bench_cpu_reference(xg, xu, uids, y, outer_iters=2, l2=1.0):
+    """Spark-CPU stand-in: scipy L-BFGS fixed effect + per-user serial scipy
+    solves, same residual coordinate-descent loop."""
+    import scipy.optimize as sopt
+    import scipy.special as sp
+
+    n, dg = xg.shape
+    du = xu.shape[1]
+    users = np.unique(uids)
+    rows_of = {u: np.nonzero(uids == u)[0] for u in users}
+
+    def nll(w, X, yy, off):
+        z = X @ w + off
+        return np.sum(np.logaddexp(0, z) - yy * z) + 0.5 * l2 * w @ w
+
+    def grad(w, X, yy, off):
+        z = X @ w + off
+        return X.T @ (sp.expit(z) - yy) + l2 * w
+
+    wg = np.zeros(dg)
+    wu = np.zeros((len(users), du))
+    fixed_scores = np.zeros(n)
+    rand_scores = np.zeros(n)
+    t0 = time.perf_counter()
+    for _ in range(outer_iters):
+        off = rand_scores
+        r = sopt.minimize(nll, wg, jac=grad, args=(xg, y, off), method="L-BFGS-B",
+                          options={"maxiter": 30})
+        wg = r.x
+        fixed_scores = xg @ wg
+        for ui, u in enumerate(users):
+            idx = rows_of[u]
+            r = sopt.minimize(nll, wu[ui], jac=grad,
+                              args=(xu[idx], y[idx], fixed_scores[idx]),
+                              method="L-BFGS-B", options={"maxiter": 30})
+            wu[ui] = r.x
+        rand_scores = np.einsum("nd,nd->n", xu, wu[np.searchsorted(users, uids)])
+    return time.perf_counter() - t0
+
+
+def main():
+    rng = np.random.default_rng(42)
+    xg, xu, uids, y = _synth(rng)
+    n = len(y)
+    outer = 2
+
+    dt_tpu, _ = bench_tpu(xg, xu, uids, y, outer)
+    examples_per_sec = n * outer / dt_tpu
+
+    dt_cpu = bench_cpu_reference(xg, xu, uids, y, outer)
+    speedup = dt_cpu / dt_tpu
+
+    print(json.dumps({
+        "metric": "glmix_2coord_examples_per_sec_per_chip",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

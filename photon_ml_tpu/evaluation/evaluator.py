@@ -1,0 +1,178 @@
+"""Evaluator objects, suites, and grouped (per-id-tag) evaluation.
+
+Reference: photon-lib .../evaluation/Evaluator.scala:69 (betterThan + evaluate),
+EvaluatorType.scala (AUC, AUPR, RMSE, LogisticLoss, PoissonLoss, SquaredLoss,
+SmoothedHingeLoss, PrecisionAtK), MultiEvaluator.scala:36-70 (group by id tag,
+evaluate each group with a LocalEvaluator, average the per-group metrics),
+EvaluationSuite.scala:33-115 (evaluator set + distinguished primary).
+
+Grouped evaluation on TPU: groups are padded to a common size and the metric
+is ``vmap``-ed over the group lane (weight-0 padding rows are inert in every
+metric) — the reference's shuffle-and-iterate becomes one batched kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.evaluation import metrics as M
+
+Array = jax.Array
+MetricFn = Callable[[Array, Array, Array], Array]
+
+
+class EvaluatorType(enum.Enum):
+    AUC = "auc"
+    AUPR = "aupr"
+    RMSE = "rmse"
+    LOGISTIC_LOSS = "logistic_loss"
+    POISSON_LOSS = "poisson_loss"
+    SQUARED_LOSS = "squared_loss"
+    SMOOTHED_HINGE_LOSS = "smoothed_hinge_loss"
+    PRECISION_AT_K = "precision_at_k"
+
+
+_LARGER_IS_BETTER = {
+    EvaluatorType.AUC, EvaluatorType.AUPR, EvaluatorType.PRECISION_AT_K,
+}
+
+_METRIC_FNS: Dict[EvaluatorType, MetricFn] = {
+    EvaluatorType.AUC: M.auc_roc,
+    EvaluatorType.AUPR: M.auc_pr,
+    EvaluatorType.RMSE: M.rmse,
+    EvaluatorType.LOGISTIC_LOSS: M.logistic_loss_metric,
+    EvaluatorType.POISSON_LOSS: M.poisson_loss_metric,
+    EvaluatorType.SQUARED_LOSS: M.squared_loss_metric,
+    EvaluatorType.SMOOTHED_HINGE_LOSS: M.smoothed_hinge_loss_metric,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Evaluator:
+    """A named metric with an ordering (reference Evaluator.betterThan).
+
+    ``group_ids`` (set at construction for Multi- evaluators): per-sample group
+    labels; the metric is computed per group and averaged, reference
+    MultiEvaluator semantics.
+    """
+
+    kind: EvaluatorType
+    k: int = 0  # PRECISION_AT_K only
+    group_name: Optional[str] = None  # None = single evaluator
+
+    @property
+    def name(self) -> str:
+        base = f"{self.kind.value}@{self.k}" if self.kind == EvaluatorType.PRECISION_AT_K else self.kind.value
+        return f"{base}:{self.group_name}" if self.group_name else base
+
+    @property
+    def larger_is_better(self) -> bool:
+        return self.kind in _LARGER_IS_BETTER
+
+    def better_than(self, a: float, b: float) -> bool:
+        return a > b if self.larger_is_better else a < b
+
+    def metric_fn(self) -> MetricFn:
+        if self.kind == EvaluatorType.PRECISION_AT_K:
+            k = self.k
+            return lambda s, l, w: M.precision_at_k(k, s, l, w)
+        return _METRIC_FNS[self.kind]
+
+    def evaluate(self, scores: Array, labels: Array, weights: Array,
+                 group_ids: Optional[np.ndarray] = None) -> float:
+        fn = self.metric_fn()
+        if self.group_name is None:
+            return float(fn(scores, labels, weights))
+        if group_ids is None:
+            raise ValueError(f"evaluator {self.name} needs group ids '{self.group_name}'")
+        return float(grouped_evaluate(fn, group_ids, scores, labels, weights))
+
+
+def make_evaluator(spec: str) -> Evaluator:
+    """Parse an evaluator spec: 'auc', 'rmse', 'precision@5', 'auc:userId'
+    (grouped), 'precision@3:songId' (reference MultiEvaluatorType grammar)."""
+    group = None
+    if ":" in spec:
+        spec, group = spec.split(":", 1)
+    if spec.startswith("precision@"):
+        return Evaluator(EvaluatorType.PRECISION_AT_K, k=int(spec.split("@")[1]), group_name=group)
+    return Evaluator(EvaluatorType(spec), group_name=group)
+
+
+def grouped_evaluate(metric_fn: MetricFn, group_ids: np.ndarray, scores: Array,
+                     labels: Array, weights: Array) -> float:
+    """Per-group metric, unweighted-averaged over groups with >0 total weight
+    (reference MultiEvaluator.evaluate:36-70).
+
+    Pads groups to the max group size and vmaps the metric; padding rows have
+    weight 0 and score -inf is NOT needed because every metric is weight-aware.
+    """
+    group_ids = np.asarray(group_ids)
+    uniq, inverse, counts = np.unique(group_ids, return_inverse=True, return_counts=True)
+    g, smax = len(uniq), int(counts.max()) if len(counts) else 0
+    if g == 0:
+        return float("nan")
+    order = np.argsort(inverse, kind="stable")
+    # slot position of each sample within its group
+    pos = np.arange(len(group_ids)) - np.concatenate([[0], np.cumsum(counts)])[inverse[order]]
+
+    def pad(a, fill=0.0):
+        out = np.full((g, smax), fill, np.asarray(a).dtype)
+        out[inverse[order], pos] = np.asarray(a)[order]
+        return jnp.asarray(out)
+
+    ps, pl, pw = pad(np.asarray(scores)), pad(np.asarray(labels)), pad(np.asarray(weights))
+    vals = jax.vmap(metric_fn)(ps, pl, pw)
+    has_w = jnp.sum(pw, axis=1) > 0
+    denom = jnp.maximum(jnp.sum(has_w), 1)
+    return float(jnp.sum(jnp.where(has_w, vals, 0.0)) / denom)
+
+
+@dataclasses.dataclass
+class EvaluationResults:
+    """Metric name -> value, with the primary distinguished
+    (reference EvaluationResults.scala)."""
+
+    values: Dict[str, float]
+    primary_name: str
+
+    @property
+    def primary(self) -> float:
+        return self.values[self.primary_name]
+
+
+@dataclasses.dataclass
+class EvaluationSuite:
+    """Evaluator set + primary (reference EvaluationSuite.scala:33-115)."""
+
+    evaluators: List[Evaluator]
+    primary: Evaluator
+
+    def __post_init__(self):
+        if self.primary not in self.evaluators:
+            self.evaluators = [self.primary] + list(self.evaluators)
+
+    @classmethod
+    def from_specs(cls, specs: Sequence[str], primary: Optional[str] = None) -> "EvaluationSuite":
+        evs = [make_evaluator(s) for s in specs]
+        prim = make_evaluator(primary) if primary else evs[0]
+        return cls(evaluators=evs, primary=prim)
+
+    def evaluate(self, scores: Array, labels: Array, weights: Array,
+                 group_ids: Optional[Dict[str, np.ndarray]] = None) -> EvaluationResults:
+        out = {}
+        for ev in self.evaluators:
+            gids = (group_ids or {}).get(ev.group_name) if ev.group_name else None
+            out[ev.name] = ev.evaluate(scores, labels, weights, gids)
+        return EvaluationResults(values=out, primary_name=self.primary.name)
+
+    def better_than(self, a: EvaluationResults, b: Optional[EvaluationResults]) -> bool:
+        if b is None:
+            return True
+        return self.primary.better_than(a.primary, b.primary)

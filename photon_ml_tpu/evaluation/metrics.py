@@ -1,0 +1,127 @@
+"""Evaluation metrics as pure JAX reductions.
+
+Reference: photon-api .../evaluation/** — AreaUnderROCCurveLocalEvaluator.scala:33-72
+(exact sort-based AUC with tie handling), AUPR, RMSE, pointwise-loss metrics,
+PrecisionAtKLocalEvaluator.
+
+TPU shape: metrics are weighted, statically-shaped reductions over
+(score, label, weight) arrays; invalid/padded rows carry weight 0.  AUC uses a
+full sort (jnp.argsort) — exact, like the reference's local evaluator, not a
+histogram approximation; ties are handled by trapezoidal integration over
+tied-score groups.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _wsum(x: Array, w: Array) -> Array:
+    return jnp.sum(x * w)
+
+
+def rmse(scores: Array, labels: Array, weights: Array) -> Array:
+    """Weighted RMSE (reference RMSEEvaluator.scala)."""
+    tot = jnp.sum(weights)
+    se = _wsum((scores - labels) ** 2, weights)
+    return jnp.sqrt(se / jnp.where(tot == 0, 1.0, tot))
+
+
+def squared_loss_metric(scores: Array, labels: Array, weights: Array) -> Array:
+    from photon_ml_tpu.core.losses import squared_loss
+
+    return _wsum(squared_loss.loss(scores, labels), weights)
+
+
+def logistic_loss_metric(scores: Array, labels: Array, weights: Array) -> Array:
+    from photon_ml_tpu.core.losses import logistic_loss
+
+    return _wsum(logistic_loss.loss(scores, labels), weights)
+
+
+def poisson_loss_metric(scores: Array, labels: Array, weights: Array) -> Array:
+    from photon_ml_tpu.core.losses import poisson_loss
+
+    return _wsum(poisson_loss.loss(scores, labels), weights)
+
+
+def smoothed_hinge_loss_metric(scores: Array, labels: Array, weights: Array) -> Array:
+    from photon_ml_tpu.core.losses import smoothed_hinge_loss
+
+    return _wsum(smoothed_hinge_loss.loss(scores, labels), weights)
+
+
+def _rank_stats(scores: Array, labels: Array, weights: Array):
+    """Sort by score desc; return cumulative weighted TP/FP plus totals.
+
+    Tie handling: within a tied-score group every point gets the group-end
+    cumulative counts (equivalent to the trapezoid over the tie, matching the
+    reference's grouped iteration, AreaUnderROCCurveLocalEvaluator.scala:45-70).
+    """
+    order = jnp.argsort(-scores, stable=True)
+    s = scores[order]
+    pos_w = (weights * (labels > 0.5))[order]
+    neg_w = (weights * (labels <= 0.5))[order]
+    ctp = jnp.cumsum(pos_w)
+    cfp = jnp.cumsum(neg_w)
+
+    # Tied-score groups: position i ends a group if s[i] != s[i+1].
+    n = s.shape[0]
+    is_end = jnp.concatenate([s[:-1] != s[1:], jnp.ones((1,), bool)])
+    is_start = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg = jnp.cumsum(is_start) - 1  # segment id per element
+    # Per-segment group-end cumulative counts (segment-indexed slots 0..G-1),
+    # gathered back per element.
+    seg_end_tp = jnp.zeros((n,), ctp.dtype).at[seg].max(jnp.where(is_end, ctp, 0.0))
+    seg_end_fp = jnp.zeros((n,), cfp.dtype).at[seg].max(jnp.where(is_end, cfp, 0.0))
+    end_ctp = seg_end_tp[seg]
+    end_cfp = seg_end_fp[seg]
+    prev_ctp = jnp.where(seg > 0, seg_end_tp[jnp.maximum(seg - 1, 0)], 0.0)
+    prev_cfp = jnp.where(seg > 0, seg_end_fp[jnp.maximum(seg - 1, 0)], 0.0)
+    return seg, is_end, end_ctp, end_cfp, prev_ctp, prev_cfp, ctp[-1], cfp[-1]
+
+
+def auc_roc(scores: Array, labels: Array, weights: Array) -> Array:
+    """Exact weighted ROC AUC with tie handling (trapezoidal).
+
+    Degenerate inputs (no positives or no negatives) return 0.5, the
+    convention downstream model selection relies on.
+    """
+    seg, is_end, end_tp, end_fp, prev_tp, prev_fp, tot_p, tot_n = _rank_stats(
+        scores, labels, weights
+    )
+    # Per tied group (counted once at its end): trapezoid on the ROC curve
+    # between (prev_fp, prev_tp) and (end_fp, end_tp).
+    area = jnp.where(is_end, (end_fp - prev_fp) * 0.5 * (end_tp + prev_tp), 0.0)
+    auc = jnp.sum(area) / jnp.where((tot_p == 0) | (tot_n == 0), 1.0, tot_p * tot_n)
+    return jnp.where((tot_p == 0) | (tot_n == 0), 0.5, auc)
+
+
+def auc_pr(scores: Array, labels: Array, weights: Array) -> Array:
+    """Weighted area under the precision-recall curve (linear interpolation
+    in recall, like the reference's Spark BinaryClassificationMetrics)."""
+    seg, is_end, end_tp, end_fp, prev_tp, prev_fp, tot_p, tot_n = _rank_stats(
+        scores, labels, weights
+    )
+    prec_end = end_tp / jnp.maximum(end_tp + end_fp, 1e-30)
+    prec_prev = jnp.where(prev_tp + prev_fp > 0, prev_tp / jnp.maximum(prev_tp + prev_fp, 1e-30), 1.0)
+    rec_end = end_tp / jnp.where(tot_p == 0, 1.0, tot_p)
+    rec_prev = prev_tp / jnp.where(tot_p == 0, 1.0, tot_p)
+    area = jnp.where(is_end, (rec_end - rec_prev) * 0.5 * (prec_end + prec_prev), 0.0)
+    return jnp.where(tot_p == 0, 0.0, jnp.sum(area))
+
+
+def precision_at_k(k: int, scores: Array, labels: Array, weights: Array) -> Array:
+    """Unweighted precision among the top-k scores (reference
+    PrecisionAtKLocalEvaluator; the reference ignores weights here too).
+    Rows with weight 0 (padding) are pushed out of the ranking."""
+    masked = jnp.where(weights > 0, scores, -jnp.inf)
+    order = jnp.argsort(-masked, stable=True)
+    topk = order[:k]
+    valid = weights[topk] > 0
+    hits = jnp.sum((labels[topk] > 0.5) & valid)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return hits / denom

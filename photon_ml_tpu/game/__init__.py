@@ -1,0 +1,14 @@
+from photon_ml_tpu.game.data import GameData  # noqa: F401
+from photon_ml_tpu.game.config import (  # noqa: F401
+    CoordinateConfig,
+    FixedEffectConfig,
+    RandomEffectConfig,
+)
+from photon_ml_tpu.game.coordinate import (  # noqa: F401
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+    build_coordinate,
+)
+from photon_ml_tpu.game.descent import CoordinateDescent, DescentHistory  # noqa: F401
+from photon_ml_tpu.game.estimator import GameEstimator, GameTransformer  # noqa: F401

@@ -1,0 +1,57 @@
+"""Per-coordinate configuration.
+
+Reference: photon-api .../data/CoordinateDataConfiguration.scala:94 (fixed/
+random data configs: randomEffectType, featureShard, active-data bounds),
+optimization/game/CoordinateOptimizationConfiguration.scala:99 (optimizer +
+regularization + downSamplingRate per coordinate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.opt.types import SolverConfig
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectConfig:
+    """One global GLM coordinate (reference FixedEffectDataConfiguration +
+    FixedEffectOptimizationConfiguration)."""
+
+    feature_shard: str
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    solver: Optional[SolverConfig] = None
+    reg: Regularization = Regularization()
+    down_sampling_rate: float = 1.0  # negative down-sampling (binary tasks)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectConfig:
+    """One per-entity coordinate (reference RandomEffectDataConfiguration +
+    RandomEffectOptimizationConfiguration)."""
+
+    random_effect_type: str  # id-tag column with entity ids
+    feature_shard: str
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    solver: Optional[SolverConfig] = None
+    reg: Regularization = Regularization()
+    active_cap: Optional[int] = None  # per-entity sample cap (reservoir)
+    min_active_samples: int = 1  # lower-bound entity filter
+
+
+CoordinateConfig = Union[FixedEffectConfig, RandomEffectConfig]
+
+
+@dataclasses.dataclass(frozen=True)
+class GameConfig:
+    """Full GAME training configuration: ordered coordinates + task.
+
+    The coordinate ORDER is the descent order (reference
+    GameTrainingDriver coordinate update sequence)."""
+
+    task: TaskType
+    coordinates: "dict[str, CoordinateConfig]"
+    num_outer_iterations: int = 1

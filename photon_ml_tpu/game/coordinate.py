@@ -1,0 +1,325 @@
+"""Training coordinates: the per-coordinate update/score contract.
+
+Reference: photon-lib .../algorithm/Coordinate.scala:28-81 (updateModel folds
+residual scores into offsets then optimizes; score produces this coordinate's
+contribution), photon-api .../algorithm/FixedEffectCoordinate.scala:35-166 and
+RandomEffectCoordinate.scala:39-232.
+
+TPU-native shape:
+- Data is laid out on device ONCE at coordinate construction (the reference
+  re-broadcasts/joins per update).  Updates re-enter the same jitted solver
+  with new residual offsets — same shapes, zero recompilation.
+- The fixed effect solves over the ``data``-sharded batch (GSPMD all-reduce).
+- The random effect solves all entities at once: vmapped solver over padded
+  entity buckets (parallel/bucketing.py), replacing per-entity serial
+  executor solves (RandomEffectCoordinate.scala:114-127).
+- Scoring is total: every sample gets this coordinate's raw score (the
+  reference's active+passive union), so residual bookkeeping in the descent
+  loop is positionally aligned.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.losses import loss_for_task
+from photon_ml_tpu.core.normalization import NormalizationContext, no_normalization
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.game.config import CoordinateConfig, FixedEffectConfig, RandomEffectConfig
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.models.game import DatumScoringModel, FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.opt.solve import make_solver
+from photon_ml_tpu.opt.types import SolverResult
+from photon_ml_tpu.parallel.bucketing import bucket_by_entity, stacked_coefficients
+from photon_ml_tpu.parallel.mesh import replicate, shard_batch
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+Array = jax.Array
+
+
+def _slots_from(slot_of: Dict[int, int], entity_ids: np.ndarray) -> np.ndarray:
+    """Vectorized entity-id -> slot lookup (-1 for unknown ids)."""
+    if not slot_of:
+        return np.full(len(entity_ids), -1, np.int32)
+    keys = np.fromiter(slot_of.keys(), np.int64, len(slot_of))
+    vals = np.fromiter(slot_of.values(), np.int32, len(slot_of))
+    order = np.argsort(keys)
+    keys, vals = keys[order], vals[order]
+    pos = np.searchsorted(keys, entity_ids)
+    pos = np.clip(pos, 0, len(keys) - 1)
+    hit = keys[pos] == entity_ids
+    return np.where(hit, vals[pos], -1).astype(np.int32)
+
+
+class Coordinate:
+    """update/score contract (reference Coordinate.scala:28-81)."""
+
+    coordinate_id: str
+    _n: int
+
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    def _base_offset_host(self) -> np.ndarray:
+        """Dataset base offsets [n] (residual offsets are added on top)."""
+        return self._base_offset
+
+    def update(self, total_offsets: np.ndarray, seed: int,
+               init: Optional[DatumScoringModel]) -> Tuple[DatumScoringModel, object]:
+        """Train with residual-folded offsets; returns (model, tracker)."""
+        raise NotImplementedError
+
+    def score(self, model: DatumScoringModel) -> np.ndarray:
+        """This coordinate's raw score for every training sample."""
+        raise NotImplementedError
+
+
+class FixedEffectCoordinate(Coordinate):
+    """Global GLM coordinate (reference FixedEffectCoordinate.scala:35-166)."""
+
+    def __init__(self, coordinate_id: str, data: GameData, config: FixedEffectConfig,
+                 task: TaskType, mesh: Optional[Mesh] = None,
+                 norm: Optional[NormalizationContext] = None, dtype=np.float32):
+        self.coordinate_id = coordinate_id
+        self.config = config
+        self.task = task
+        self.mesh = mesh
+        self.dim = data.shard_dim(config.feature_shard)
+        self._n = data.num_samples
+        self._dtype = dtype
+        self._base_offset = np.asarray(data.offset, np.float64)
+
+        x = np.asarray(data.features[config.feature_shard], dtype)
+        batch = DenseBatch(
+            x=jnp.asarray(x),
+            y=jnp.asarray(np.asarray(data.y, dtype)),
+            offset=jnp.asarray(np.asarray(data.offset, dtype)),
+            weight=jnp.asarray(np.asarray(data.weight, dtype)),
+        )
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        self._batch = batch
+        self._padded_n = batch.num_examples
+        self._base_weight = batch.weight
+
+        self._norm = norm or no_normalization()
+        self._bind_solver()
+        batch = self._batch
+        self._score = jax.jit(lambda w: batch.x @ w)
+
+    def _bind_solver(self) -> None:
+        objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
+                                 norm=self._norm)
+        solve = make_solver(objective, self.config.optimizer, self.config.solver)
+        batch = self._batch
+
+        def _solve(w0: Array, offsets: Array, weights: Array) -> SolverResult:
+            return solve(w0, batch.replace(offset=offsets, weight=weights))
+
+        out_shard = replicate(self.mesh) if self.mesh is not None else None
+        self._solve = (jax.jit(_solve, out_shardings=out_shard)
+                       if self.mesh is not None else jax.jit(_solve))
+
+    def data_key(self) -> tuple:
+        """Identity of the device data layout (reuse across optimization
+        configs — reference GameEstimator prepares datasets once, fit:454-557)."""
+        return ("fixed", self.config.feature_shard)
+
+    def rebind(self, config: FixedEffectConfig) -> "FixedEffectCoordinate":
+        """New optimization settings over the SAME device-resident data."""
+        import copy
+
+        if config.feature_shard != self.config.feature_shard:
+            raise ValueError("rebind cannot change the feature shard")
+        new = copy.copy(self)
+        new.config = config
+        new._bind_solver()
+        return new
+
+    def _pad(self, a: np.ndarray) -> np.ndarray:
+        pad = self._padded_n - len(a)
+        return a if pad == 0 else np.concatenate([a, np.zeros(pad, a.dtype)])
+
+    def _down_sample_weights(self, seed: int) -> Array:
+        """Negative down-sampling with 1/rate weight compensation (reference
+        BinaryClassificationDownSampler.scala:32-55); resampled per update."""
+        rate = self.config.down_sampling_rate
+        if rate >= 1.0:
+            return self._base_weight
+        rng = np.random.default_rng(seed)
+        keep = rng.random(self._padded_n) < rate
+        mult = np.where(keep, 1.0 / rate, 0.0).astype(self._dtype)
+        y = np.asarray(self._batch.y)
+        mult = np.where(y > 0.5, 1.0, mult)  # keep all positives
+        return self._base_weight * jnp.asarray(mult)
+
+    def update(self, total_offsets: np.ndarray, seed: int = 0,
+               init: Optional[FixedEffectModel] = None) -> Tuple[FixedEffectModel, SolverResult]:
+        w0 = (jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
+              if init is not None else jnp.zeros(self.dim, self._dtype))
+        offs = jnp.asarray(self._pad(np.asarray(total_offsets, self._dtype)))
+        weights = self._down_sample_weights(seed)
+        res = self._solve(w0, offs, weights)
+        model = FixedEffectModel(
+            coefficients=Coefficients(means=np.asarray(res.w)),
+            feature_shard=self.config.feature_shard,
+            task=self.task,
+        )
+        return model, res
+
+    def score(self, model: FixedEffectModel) -> np.ndarray:
+        s = self._score(jnp.asarray(np.asarray(model.coefficients.means, self._dtype)))
+        return np.asarray(s)[: self._n]
+
+
+class RandomEffectCoordinate(Coordinate):
+    """Per-entity GLM coordinate (reference RandomEffectCoordinate.scala:39-232).
+
+    All entities are bucketed once at construction; every update solves every
+    bucket with a vmapped jitted solver.  Scoring covers ALL samples —
+    including those capped out of the active set — via the stacked-coefficient
+    gather (the reference's passive-data path).
+    """
+
+    def __init__(self, coordinate_id: str, data: GameData, config: RandomEffectConfig,
+                 task: TaskType, mesh: Optional[Mesh] = None, seed: int = 0,
+                 dtype=np.float32):
+        self.coordinate_id = coordinate_id
+        self.config = config
+        self.task = task
+        self.mesh = mesh
+        self._n = data.num_samples
+        self._dtype = dtype
+        self.dim = data.shard_dim(config.feature_shard)
+        self._base_offset = np.asarray(data.offset, np.float64)
+
+        x = np.asarray(data.features[config.feature_shard], dtype)
+        entity_ids = data.id_tags[config.random_effect_type]
+        lane_multiple = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
+        self.buckets = bucket_by_entity(
+            entity_ids, x, np.asarray(data.y, dtype),
+            offset=np.asarray(data.offset, dtype),
+            weight=np.asarray(data.weight, dtype),
+            active_cap=config.active_cap,
+            min_active_samples=config.min_active_samples,
+            lane_multiple=lane_multiple,
+            seed=seed, dtype=dtype,
+        )
+        # slot order for the stacked model = sorted entity id (stacked_coefficients)
+        self._sorted_ids = sorted(self.buckets.lane_of)
+        self._slot_of = {eid: i for i, eid in enumerate(self._sorted_ids)}
+        self._entity_ids = np.asarray(entity_ids, np.int64)
+        self._sample_slots = jnp.asarray(_slots_from(self._slot_of, self._entity_ids))
+        self._x_full = jnp.asarray(x)
+
+        self._bind_solver()
+
+        # Device-resident bucket arrays, entity lane sharded over ALL mesh
+        # devices (the reference's balanced entity partitioner,
+        # RandomEffectDatasetPartitioner.scala:30-171).
+        def put(a):
+            a = jnp.asarray(a)
+            if mesh is None:
+                return a
+            spec = PartitionSpec(tuple(mesh.axis_names), *([None] * (a.ndim - 1)))
+            return jax.device_put(a, NamedSharding(mesh, spec))
+
+        self._put_entity = put
+        self._dev = [
+            dict(x=put(b.x), y=put(b.y), w=put(b.weight),
+                 rows=put(np.where(b.rows < 0, 0, b.rows)),
+                 valid=put(b.rows >= 0))
+            for b in self.buckets.buckets
+        ]
+
+    def _bind_solver(self) -> None:
+        objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg)
+        solve = make_solver(objective, self.config.optimizer, self.config.solver)
+
+        def _vsolve(w0, x_b, y_b, off_b, wt_b):
+            return jax.vmap(
+                lambda w, xx, yy, oo, ww: solve(w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww))
+            )(w0, x_b, y_b, off_b, wt_b)
+
+        self._vsolve = jax.jit(_vsolve)
+
+    def data_key(self) -> tuple:
+        return ("random", self.config.random_effect_type, self.config.feature_shard,
+                self.config.active_cap, self.config.min_active_samples)
+
+    def rebind(self, config: RandomEffectConfig) -> "RandomEffectCoordinate":
+        """New optimization settings over the SAME buckets/device arrays."""
+        import copy
+
+        old = self.config
+        if (config.random_effect_type, config.feature_shard, config.active_cap,
+                config.min_active_samples) != (old.random_effect_type, old.feature_shard,
+                                               old.active_cap, old.min_active_samples):
+            raise ValueError("rebind cannot change the data configuration")
+        new = copy.copy(self)
+        new.config = config
+        new._bind_solver()
+        return new
+
+    def update(self, total_offsets: np.ndarray, seed: int = 0,
+               init: Optional[RandomEffectModel] = None
+               ) -> Tuple[RandomEffectModel, List[SolverResult]]:
+        offs = jnp.asarray(np.asarray(total_offsets, self._dtype))
+        coeffs = []
+        results = []
+        for bi, (b, dev) in enumerate(zip(self.buckets.buckets, self._dev)):
+            if init is not None:
+                w0 = np.zeros((b.num_lanes, self.dim), self._dtype)
+                for lane, eid in enumerate(b.entity_lanes):
+                    slot = init.slot_of.get(int(eid)) if eid >= 0 else None
+                    if slot is not None:
+                        w0[lane] = init.w_stack[slot]
+                w0 = self._put_entity(w0)
+            else:
+                w0 = self._put_entity(np.zeros((b.num_lanes, self.dim), self._dtype))
+            # residual offsets gathered into the bucket layout
+            off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
+            res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"])
+            coeffs.append(res.w)
+            results.append(res)
+
+        w_stack, slot_of = stacked_coefficients(coeffs, self.buckets)
+        model = RandomEffectModel(
+            w_stack=np.asarray(w_stack), slot_of=slot_of,
+            random_effect_type=self.config.random_effect_type,
+            feature_shard=self.config.feature_shard, task=self.task,
+        )
+        return model, results
+
+    def score(self, model: RandomEffectModel) -> np.ndarray:
+        from photon_ml_tpu.parallel.bucketing import score_samples
+
+        w = jnp.asarray(np.asarray(model.w_stack, self._dtype))
+        if model.slot_of == self._slot_of:
+            slots = self._sample_slots
+        else:
+            # model trained elsewhere: remap the RAW entity ids through its
+            # slot map (an entity may be absent from our training buckets yet
+            # present in the model)
+            slots = jnp.asarray(_slots_from(model.slot_of, self._entity_ids))
+        return np.asarray(score_samples(w, slots, self._x_full))[: self._n]
+
+
+def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfig,
+                     task: TaskType, mesh: Optional[Mesh] = None,
+                     norm: Optional[NormalizationContext] = None,
+                     seed: int = 0) -> Coordinate:
+    """Reference CoordinateFactory.build (CoordinateFactory.scala:34-113)."""
+    if isinstance(config, FixedEffectConfig):
+        return FixedEffectCoordinate(coordinate_id, data, config, task, mesh, norm)
+    if isinstance(config, RandomEffectConfig):
+        return RandomEffectCoordinate(coordinate_id, data, config, task, mesh, seed)
+    raise TypeError(f"unknown coordinate config {type(config)!r}")

@@ -1,0 +1,57 @@
+"""GAME dataset container.
+
+Reference data model: GameDatum(response, offsetOpt, weightOpt,
+featureShardContainer: Map[shard -> Vector], idTagToValueMap)
+(photon-lib .../data/GameDatum.scala:39-74) held as
+RDD[(UniqueSampleId, GameDatum)] after GameConverters (photon-api
+.../data/GameConverters.scala:173).
+
+TPU-native shape: one host-side columnar container for the WHOLE dataset —
+labels/offsets/weights as flat arrays, one design matrix per feature shard,
+and integer id columns per id-tag (entity ids already passed through a feature
+index map / entity index).  Sample order IS the unique-sample-id space: row i
+everywhere refers to the same example, which replaces the reference's
+uniqueId-keyed joins with positional alignment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class GameData:
+    """Columnar GAME dataset (training or validation)."""
+
+    y: np.ndarray  # [n]
+    features: Dict[str, np.ndarray]  # shard id -> [n, d_shard] design matrix
+    offset: Optional[np.ndarray] = None  # [n]
+    weight: Optional[np.ndarray] = None  # [n]
+    id_tags: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)  # tag -> [n] int64
+
+    def __post_init__(self):
+        n = len(self.y)
+        self.y = np.asarray(self.y)
+        if self.offset is None:
+            self.offset = np.zeros(n, self.y.dtype if self.y.dtype.kind == "f" else np.float32)
+        if self.weight is None:
+            self.weight = np.ones(n, self.offset.dtype)
+        self.offset = np.asarray(self.offset)
+        self.weight = np.asarray(self.weight)
+        for shard, x in self.features.items():
+            if x.shape[0] != n:
+                raise ValueError(f"feature shard {shard!r} has {x.shape[0]} rows, expected {n}")
+        for tag, ids in self.id_tags.items():
+            if len(ids) != n:
+                raise ValueError(f"id tag {tag!r} has {len(ids)} rows, expected {n}")
+            self.id_tags[tag] = np.asarray(ids, np.int64)
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.y)
+
+    def shard_dim(self, shard: str) -> int:
+        return self.features[shard].shape[1]

@@ -1,0 +1,131 @@
+"""GameEstimator / GameTransformer — the top-level fit/transform API.
+
+Reference: photon-api .../estimators/GameEstimator.scala:299-781 (fit:
+prepare per-coordinate datasets, validation suite, build coordinates, run
+coordinate descent per optimization configuration with warm start between
+configurations) and transformers/GameTransformer.scala:150-318 (score a
+prepared GAME dataset with a GameModel + optional evaluation).
+
+TPU-native: "preparing datasets" is building device-resident coordinates
+(one-time layout, no shuffles); each (coordinate-config -> fit) pair reuses
+the same jitted solvers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from photon_ml_tpu.evaluation.evaluator import EvaluationResults, EvaluationSuite
+from photon_ml_tpu.game.config import CoordinateConfig, GameConfig
+from photon_ml_tpu.game.coordinate import build_coordinate
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.descent import CoordinateDescent, DescentHistory
+from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.types import TaskType
+
+
+@dataclasses.dataclass(eq=False)  # identity equality: fields hold arrays
+class GameFitResult:
+    """One (configuration, model, validation) outcome
+    (reference fit() returns Seq[(GameModel, config, Option[EvaluationResults])])."""
+
+    model: GameModel
+    config: GameConfig
+    evaluation: Optional[EvaluationResults]
+    history: DescentHistory
+
+
+class GameEstimator:
+    """fit() over one or more GAME configurations with warm start between them.
+
+    ``locked_coordinates``: partial retraining — these coordinates keep their
+    model from ``initial_model`` and are only re-scored
+    (reference GameEstimator.scala:110-112, 237-269).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 validation_suite: Optional[EvaluationSuite] = None):
+        self.mesh = mesh
+        self.validation_suite = validation_suite
+
+    def fit(
+        self,
+        data: GameData,
+        configs: Sequence[GameConfig],
+        validation_data: Optional[GameData] = None,
+        initial_model: Optional[GameModel] = None,
+        locked_coordinates: Optional[Set[str]] = None,
+        seed: int = 0,
+    ) -> List[GameFitResult]:
+        results: List[GameFitResult] = []
+        warm = initial_model
+        prev: Dict[str, object] = {}
+        for config in configs:
+            coordinates = {}
+            for cid, ccfg in config.coordinates.items():
+                old = prev.get(cid)
+                if old is not None and old.config == ccfg:
+                    coordinates[cid] = old  # identical config: reuse jits too
+                elif old is not None:
+                    try:
+                        coordinates[cid] = old.rebind(ccfg)  # same data, new opt settings
+                    except ValueError:
+                        coordinates[cid] = build_coordinate(
+                            cid, data, ccfg, config.task, self.mesh, seed=seed)
+                else:
+                    coordinates[cid] = build_coordinate(
+                        cid, data, ccfg, config.task, self.mesh, seed=seed)
+            prev = coordinates
+            validation = None
+            if validation_data is not None and self.validation_suite is not None:
+                validation = (validation_data, self.validation_suite)
+            descent = CoordinateDescent(
+                coordinates,
+                order=list(config.coordinates),
+                num_iterations=config.num_outer_iterations,
+                validation=validation,
+                locked=locked_coordinates,
+            )
+            model, history, ev = descent.run(initial=warm, seed=seed)
+            results.append(GameFitResult(model=model, config=config, evaluation=ev,
+                                         history=history))
+            warm = model  # warm start the next configuration (fit:344-360)
+        return results
+
+    def best(self, results: List[GameFitResult]) -> GameFitResult:
+        """Model selection by primary validation metric
+        (reference GameTrainingDriver.selectBestModel:683-748)."""
+        if self.validation_suite is None or all(r.evaluation is None for r in results):
+            return results[-1]
+        best = None
+        for r in results:
+            if r.evaluation is None:
+                continue
+            if best is None or self.validation_suite.primary.better_than(
+                    r.evaluation.primary, best.evaluation.primary):
+                best = r
+        return best if best is not None else results[-1]
+
+
+class GameTransformer:
+    """Score/evaluate a GameData with a trained GameModel
+    (reference GameTransformer.scala:150-318)."""
+
+    def __init__(self, model: GameModel, task: TaskType):
+        self.model = model
+        self.task = task
+
+    def score(self, data: GameData) -> np.ndarray:
+        """Raw total scores (no offset; reference scoreGameDataset:263)."""
+        return np.asarray(self.model.score(data))
+
+    def predict(self, data: GameData) -> np.ndarray:
+        return np.asarray(self.model.predict(data, self.task))
+
+    def evaluate(self, data: GameData, suite: EvaluationSuite) -> EvaluationResults:
+        scores = self.score(data) + np.asarray(data.offset)
+        return suite.evaluate(scores, data.y, data.weight, group_ids=data.id_tags)

@@ -1,0 +1,7 @@
+from photon_ml_tpu.models.glm import Coefficients, GLMModel  # noqa: F401
+from photon_ml_tpu.models.game import (  # noqa: F401
+    DatumScoringModel,
+    FixedEffectModel,
+    RandomEffectModel,
+    GameModel,
+)

@@ -1,0 +1,125 @@
+"""GAME model containers: fixed-effect, random-effect, and the composite model.
+
+Reference: photon-api .../model/FixedEffectModel.scala:146 (Broadcast[GLM] +
+feature shard), RandomEffectModel.scala:304 (RDD[(REId, GLM)] + REType +
+shard, score via join by REId), photon-lib .../model/GameModel.scala:32-110
+(Map[CoordinateId -> DatumScoringModel], score = sum of coordinate scores).
+
+TPU-native shape: the random-effect "RDD of models" is a dense stacked matrix
+W[num_entities, d] plus a host-side entity-id -> row map; scoring any sample
+set is a gather + row-wise dot (parallel/bucketing.score_samples).  Missing
+entities score 0, matching the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.models.glm import Coefficients, GLMModel
+from photon_ml_tpu.parallel.bucketing import score_samples
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class DatumScoringModel:
+    """Contract: score a GameData (reference DatumScoringModel.scala)."""
+
+    def score(self, data: GameData) -> Array:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel(DatumScoringModel):
+    """Global GLM over one feature shard (reference FixedEffectModel.scala:146).
+
+    No Broadcast wrapper: under SPMD the coefficient vector is a replicated
+    array; nothing is shipped per evaluation.
+    """
+
+    coefficients: Coefficients
+    feature_shard: str
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    def score(self, data: GameData) -> Array:
+        return self.coefficients.score(data.features[self.feature_shard])
+
+    def glm(self) -> GLMModel:
+        return GLMModel(coefficients=self.coefficients, task=self.task)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel(DatumScoringModel):
+    """Per-entity GLMs as a stacked coefficient matrix
+    (reference RandomEffectModel.scala:304).
+
+    ``w_stack[slot_of[entity_id]]`` is that entity's coefficient vector;
+    samples whose entity has no model score 0 (reference convention).
+    ``variances`` optional, aligned with w_stack rows.
+    """
+
+    w_stack: np.ndarray  # [num_entities, d]
+    slot_of: Dict[int, int]
+    random_effect_type: str  # the id-tag column name
+    feature_shard: str
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+    variances: Optional[np.ndarray] = None
+
+    @property
+    def num_entities(self) -> int:
+        return self.w_stack.shape[0]
+
+    def slots_for(self, data: GameData) -> np.ndarray:
+        from photon_ml_tpu.game.coordinate import _slots_from
+
+        return _slots_from(self.slot_of, data.id_tags[self.random_effect_type])
+
+    def score(self, data: GameData) -> Array:
+        slots = jnp.asarray(self.slots_for(data))
+        x = jnp.asarray(data.features[self.feature_shard])
+        return score_samples(jnp.asarray(self.w_stack), slots, x)
+
+    def coefficients_for(self, entity_id: int) -> Optional[Coefficients]:
+        slot = self.slot_of.get(int(entity_id))
+        if slot is None:
+            return None
+        var = self.variances[slot] if self.variances is not None else None
+        return Coefficients(means=self.w_stack[slot], variances=var)
+
+
+@dataclasses.dataclass
+class GameModel:
+    """Composite model: coordinate id -> scoring model
+    (reference GameModel.scala:32-110)."""
+
+    models: Dict[str, DatumScoringModel]
+
+    def score(self, data: GameData) -> Array:
+        """Sum of coordinate raw scores (GameModel.score:99-110)."""
+        total = jnp.zeros((data.num_samples,))
+        for model in self.models.values():
+            total = total + model.score(data)
+        return total
+
+    def predict(self, data: GameData, task: TaskType) -> Array:
+        from photon_ml_tpu.core.losses import loss_for_task
+
+        z = self.score(data) + jnp.asarray(data.offset)
+        return loss_for_task(task).mean(z)
+
+    def updated(self, coordinate_id: str, model: DatumScoringModel) -> "GameModel":
+        out = dict(self.models)
+        out[coordinate_id] = model
+        return GameModel(models=out)
+
+    def __getitem__(self, cid: str) -> DatumScoringModel:
+        return self.models[cid]
+
+    def __contains__(self, cid: str) -> bool:
+        return cid in self.models

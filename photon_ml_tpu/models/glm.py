@@ -1,0 +1,66 @@
+"""GLM model containers.
+
+Reference: photon-lib .../model/Coefficients.scala:31-53 (means + optional
+variances + computeScore) and photon-api supervised/model/** —
+GeneralizedLinearModel.scala:168 with LogisticRegression/LinearRegression/
+PoissonRegression/SmoothedHingeLossLinearSVM subclasses whose only real
+difference is the inverse link (computeMean).  Here the subclass hierarchy
+collapses to GLMModel carrying its TaskType; the mean function comes from the
+task's PointwiseLoss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.core.losses import loss_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """means[d] + optional variances[d] (reference Coefficients.scala:31)."""
+
+    means: np.ndarray
+    variances: Optional[np.ndarray] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def score(self, x: Array) -> Array:
+        """Raw dot-product score (reference Coefficients.computeScore:53)."""
+        return jnp.asarray(x) @ jnp.asarray(self.means)
+
+    @classmethod
+    def zeros(cls, dim: int, dtype=np.float32) -> "Coefficients":
+        return cls(means=np.zeros(dim, dtype))
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMModel:
+    """A trained GLM: coefficients + task (reference GeneralizedLinearModel).
+
+    The reference's per-task subclasses only override ``computeMean``; here
+    ``predict`` dispatches through the task's loss inverse-link.
+    """
+
+    coefficients: Coefficients
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    def score(self, x: Array) -> Array:
+        return self.coefficients.score(x)
+
+    def predict(self, x: Array, offset: Optional[Array] = None) -> Array:
+        """Inverse-link mean at margin x·w + offset (computeMeanFunction)."""
+        z = self.score(x)
+        if offset is not None:
+            z = z + offset
+        return loss_for_task(self.task).mean(z)

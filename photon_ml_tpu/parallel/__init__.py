@@ -1,0 +1,14 @@
+from photon_ml_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    ENTITY_AXIS,
+    make_mesh,
+    shard_batch,
+    replicate,
+)
+from photon_ml_tpu.parallel.fixed import fit_fixed_effect  # noqa: F401
+from photon_ml_tpu.parallel.bucketing import (  # noqa: F401
+    EntityBuckets,
+    bucket_by_entity,
+    fit_random_effects,
+    score_random_effects,
+)

@@ -1,0 +1,294 @@
+"""Entity bucketing: the TPU-native replacement for the reference's
+random-effect data layout (groupByKey shuffle -> RDD[(REId, LocalDataset)]).
+
+Reference machinery being replaced (SURVEY.md §2.2):
+  - RandomEffectDataset.apply: groupBy REId shuffle, deterministic reservoir
+    cap with weight rescale count/cap (RandomEffectDataset.scala:358-420)
+  - RandomEffectDatasetPartitioner: balanced entity->partition assignment
+    (RandomEffectDatasetPartitioner.scala:30-171)
+  - RandomEffectCoordinate.updateModel: per-entity serial solves inside
+    mapValues (RandomEffectCoordinate.scala:104-153)
+
+TPU-native design: entities are grouped ONCE on host into statically-shaped
+buckets — all entities in a bucket share a sample capacity S (next power of
+two of their active count) — then every entity in a bucket is solved
+SIMULTANEOUSLY by ``vmap``-ing the jittable solver over the entity lane, with
+the entity lane sharded across the whole mesh.  Padding rows carry weight 0
+(inert by the core masking contract); padding lanes are whole fake entities
+whose solves are discarded.  Millions of serial executor-core solves become a
+handful of dense [E, S, d] batched programs on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.opt.solve import make_solver
+from photon_ml_tpu.opt.types import SolverConfig, SolverResult
+from photon_ml_tpu.types import OptimizerType
+
+Array = jax.Array
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Deterministic 64-bit mix for reservoir keys (the reference uses
+    byteswap64(hash ^ uniqueId), RandomEffectDataset.scala:394-401 — any
+    fixed avalanche mix gives the same recompute-stable property)."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)).astype(np.uint64)
+    x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)).astype(np.uint64)
+    return x ^ (x >> np.uint64(31))
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One capacity class of entities, device-ready.
+
+    Arrays: x [E, S, d], y/offset/weight [E, S], rows [E, S] int32 (original
+    sample row of each slot, -1 for padding), counts [E] int32 (real samples
+    per entity), entity_lanes [E] int64 (original entity id per lane, -1 for
+    padding lanes).
+    """
+
+    x: np.ndarray
+    y: np.ndarray
+    offset: np.ndarray
+    weight: np.ndarray
+    rows: np.ndarray
+    counts: np.ndarray
+    entity_lanes: np.ndarray
+
+    @property
+    def num_lanes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.x.shape[1]
+
+    def batch(self) -> DenseBatch:
+        return DenseBatch(
+            x=jnp.asarray(self.x), y=jnp.asarray(self.y),
+            offset=jnp.asarray(self.offset), weight=jnp.asarray(self.weight),
+        )
+
+
+@dataclasses.dataclass
+class EntityBuckets:
+    """All buckets for one random-effect coordinate + the entity directory.
+
+    ``lane_of``: entity id -> (bucket index, lane) for model lookup/update.
+    """
+
+    buckets: List[Bucket]
+    lane_of: Dict[int, Tuple[int, int]]
+    dim: int
+    num_entities: int
+    num_samples: int  # original sample-row count (scores vector length)
+
+    def entity_ids(self) -> np.ndarray:
+        return np.asarray(sorted(self.lane_of), np.int64)
+
+
+def bucket_by_entity(
+    entity_ids: np.ndarray,
+    x: np.ndarray,
+    y: np.ndarray,
+    offset: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+    active_cap: Optional[int] = None,
+    min_active_samples: int = 1,
+    lane_multiple: int = 1,
+    seed: int = 0,
+    dtype=np.float32,
+) -> EntityBuckets:
+    """Group samples by entity into power-of-two-capacity buckets.
+
+    - ``active_cap``: deterministic reservoir cap per entity with weight
+      rescale count/cap (reference RandomEffectDataset.scala:358-420).
+      Overflow samples are DROPPED from training here; the score-only
+      "passive" path keeps them via score_random_effects on the full data.
+    - ``min_active_samples``: entities with fewer samples are excluded
+      (reference lower-bound filter, RandomEffectDataset.scala:319-341).
+    - ``lane_multiple``: pad each bucket's entity count to a multiple (set to
+      the mesh device count so the entity axis shards evenly).
+    """
+    n = len(entity_ids)
+    entity_ids = np.asarray(entity_ids, np.int64)
+    x = np.asarray(x, dtype)
+    y = np.asarray(y, dtype)
+    offset = np.zeros(n, dtype) if offset is None else np.asarray(offset, dtype)
+    weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
+    d = x.shape[1]
+
+    uniq, inverse, counts = np.unique(entity_ids, return_inverse=True, return_counts=True)
+    order = np.argsort(inverse, kind="stable")  # rows grouped by entity
+    starts = np.concatenate([[0], np.cumsum(counts)])
+
+    # Per-entity row lists (+ deterministic reservoir cap).
+    kept_rows: List[np.ndarray] = []
+    kept_entities: List[int] = []
+    rescale: List[float] = []
+    for e in range(len(uniq)):
+        rows = order[starts[e]: starts[e + 1]]
+        if len(rows) < min_active_samples:
+            continue
+        scale = 1.0
+        if active_cap is not None and len(rows) > active_cap:
+            keys = _splitmix64(rows.astype(np.uint64) ^ np.uint64(seed))
+            rows = rows[np.argsort(keys, kind="stable")[:active_cap]]
+            scale = len(keys) / active_cap  # weight rescale count/cap
+        kept_rows.append(np.sort(rows))
+        kept_entities.append(int(uniq[e]))
+        rescale.append(scale)
+
+    # Capacity classes: next power of two of the active count.
+    caps = np.asarray([max(1, 1 << (len(r) - 1).bit_length()) for r in kept_rows])
+    buckets: List[Bucket] = []
+    lane_of: Dict[int, Tuple[int, int]] = {}
+    for cap in sorted(set(caps.tolist())):
+        idxs = np.nonzero(caps == cap)[0]
+        n_lanes = ((len(idxs) + lane_multiple - 1) // lane_multiple) * lane_multiple
+        bx = np.zeros((n_lanes, cap, d), dtype)
+        by = np.zeros((n_lanes, cap), dtype)
+        boff = np.zeros((n_lanes, cap), dtype)
+        bw = np.zeros((n_lanes, cap), dtype)
+        brows = np.full((n_lanes, cap), -1, np.int32)
+        bcounts = np.zeros((n_lanes,), np.int32)
+        blanes = np.full((n_lanes,), -1, np.int64)
+        for lane, ei in enumerate(idxs):
+            rows = kept_rows[ei]
+            k = len(rows)
+            bx[lane, :k] = x[rows]
+            by[lane, :k] = y[rows]
+            boff[lane, :k] = offset[rows]
+            bw[lane, :k] = weight[rows] * rescale[ei]
+            brows[lane, :k] = rows
+            bcounts[lane] = k
+            blanes[lane] = kept_entities[ei]
+            lane_of[kept_entities[ei]] = (len(buckets), lane)
+        buckets.append(Bucket(x=bx, y=by, offset=boff, weight=bw, rows=brows,
+                              counts=bcounts, entity_lanes=blanes))
+
+    return EntityBuckets(buckets=buckets, lane_of=lane_of, dim=d,
+                         num_entities=len(kept_entities), num_samples=n)
+
+
+def _entity_sharding(mesh: Optional[Mesh]):
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))  # E over ALL devices
+
+
+def fit_random_effects(
+    objective: GLMObjective,
+    buckets: EntityBuckets,
+    mesh: Optional[Mesh] = None,
+    optimizer: OptimizerType = OptimizerType.LBFGS,
+    config: Optional[SolverConfig] = None,
+    init: Optional[List[Array]] = None,
+) -> Tuple[List[Array], List[SolverResult]]:
+    """Solve every entity's GLM; returns per-bucket coefficients [E, d].
+
+    The reference solves each entity SERIALLY inside a Spark mapValues
+    (RandomEffectCoordinate.scala:114-127); here each capacity class is one
+    vmapped solver launch with the entity lane sharded over the mesh.
+    ``init``: per-bucket warm-start coefficients (e.g. from the previous
+    coordinate-descent iteration).
+    """
+    solve = make_solver(objective, optimizer, config)
+    vsolve = jax.jit(jax.vmap(lambda w0, batch: solve(w0, batch)))
+    shard = _entity_sharding(mesh)
+
+    coeffs: List[Array] = []
+    results: List[SolverResult] = []
+    for bi, b in enumerate(buckets.buckets):
+        w0 = (init[bi] if init is not None
+              else jnp.zeros((b.num_lanes, buckets.dim), b.batch().x.dtype))
+        batch = b.batch()
+        if shard is not None:
+            w0 = jax.device_put(w0, shard)
+            batch = jax.tree.map(lambda a: jax.device_put(a, _spec_for(mesh, a)), batch)
+        res = vsolve(w0, batch)
+        coeffs.append(res.w)
+        results.append(res)
+    return coeffs, results
+
+
+def _spec_for(mesh: Mesh, a: Array) -> NamedSharding:
+    axes = tuple(mesh.axis_names)
+    spec = P(axes, *([None] * (a.ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def score_random_effects(
+    coeffs: Sequence[Array],
+    buckets: EntityBuckets,
+) -> Array:
+    """Per-sample raw scores w_entity · x for every ACTIVE sample.
+
+    Returns scores[num_samples] aligned with the original sample-row order
+    (reference RandomEffectCoordinate.score:167-196, which shuffles scored
+    tuples back to the uniqueId partitioner — here a scatter by row index).
+    Samples of excluded/capped-out entities get 0.
+    """
+    total = jnp.zeros((buckets.num_samples,), coeffs[0].dtype if coeffs else jnp.float32)
+    for b, w in zip(buckets.buckets, coeffs):
+        margins = jnp.einsum("esd,ed->es", jnp.asarray(b.x), w)
+        valid = b.rows >= 0
+        safe_rows = jnp.where(valid, b.rows, 0)
+        total = total.at[safe_rows.ravel()].add(
+            jnp.where(valid, margins, 0.0).ravel()
+        )
+    return total
+
+
+def stacked_coefficients(
+    coeffs: Sequence[Array], buckets: EntityBuckets
+) -> Tuple[Array, Dict[int, int]]:
+    """Stack per-bucket lane coefficients into W[num_entities, d] + id->slot map.
+
+    The dense W is the device-resident form of the reference's
+    RDD[(REId, GLM)] model (RandomEffectModel.scala) — scoring any sample set
+    becomes a gather + row-wise dot (see score_samples), covering the
+    reference's "passive data" path (samples capped out of training still get
+    scored, RandomEffectDataset passiveData / RandomEffectCoordinate.scala:210-231).
+    """
+    # ONE host transfer per bucket, then numpy gathers — indexing device
+    # arrays per entity would issue thousands of tiny dispatches.
+    host = [np.asarray(c) for c in coeffs]
+    slot_of: Dict[int, int] = {}
+    parts = []
+    for eid in sorted(buckets.lane_of):
+        bi, lane = buckets.lane_of[eid]
+        slot_of[eid] = len(slot_of)
+        parts.append(host[bi][lane])
+    w = jnp.asarray(np.stack(parts)) if parts else jnp.zeros((0, buckets.dim))
+    return w, slot_of
+
+
+def score_samples(w_stack: Array, slots: Array, x: Array) -> Array:
+    """Raw per-sample scores (x_i · w_entity(i)) for ANY sample set.
+
+    ``slots``: per-sample row index into w_stack, -1 for samples whose entity
+    has no model (score 0 — reference scores missing random effects as 0).
+    """
+    safe = jnp.where(slots >= 0, slots, 0)
+    margins = jnp.einsum("nd,nd->n", x, w_stack[safe])
+    return jnp.where(slots >= 0, margins, 0.0)
+
+
+def gather_entity_coefficients(
+    coeffs: Sequence[Array], buckets: EntityBuckets
+) -> Dict[int, np.ndarray]:
+    """Entity id -> coefficient vector (host-side model export)."""
+    host = [np.asarray(c) for c in coeffs]
+    return {eid: host[bi][lane] for eid, (bi, lane) in buckets.lane_of.items()}

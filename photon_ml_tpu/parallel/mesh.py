@@ -1,0 +1,100 @@
+"""Device mesh + sharding helpers.
+
+Replaces the reference's Spark communication substrate (SURVEY.md §2.7):
+  - broadcast of coefficients per evaluation (DistributedObjectiveFunction.scala:61)
+      -> weights live REPLICATED in HBM; nothing is re-shipped per step.
+  - treeAggregate gradient reductions (ValueAndGradientAggregator.scala:248)
+      -> XLA all-reduce over the ``data`` mesh axis, inserted by GSPMD when the
+         batch is sharded on ``data`` and outputs are replicated.  ICI
+         all-reduce is already tree/torus-optimal, so the reference's
+         ``treeAggregateDepth`` knob has no analog.
+  - shuffle/groupBy for per-entity data (RandomEffectDataset.scala:302-341)
+      -> one-time host-side bucketing (parallel/bucketing.py) + ``entity``-axis
+         sharding.
+
+Mesh axes:
+  - ``data``   : examples of the fixed-effect batch (DP)
+  - ``entity`` : independent random-effect problems (the reference's
+                 "per-entity model parallelism", RandomEffectCoordinate.scala:109-127)
+Multi-host later slices these over DCN by constructing the mesh from
+``jax.devices()`` spanning hosts; the code below is agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.core.batch import Batch, DenseBatch, SparseBatch
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def make_mesh(n_data: Optional[int] = None, n_entity: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Create a (data, entity) mesh over the available devices.
+
+    Default: all devices on the data axis.  A single-device mesh is valid and
+    produces the exact same program (collectives become no-ops), so every code
+    path is mesh-agnostic — the chip-count-invariance property the tests rely
+    on (SURVEY.md §4).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_entity
+    need = n_data * n_entity
+    if need > len(devices):
+        raise ValueError(f"mesh {n_data}x{n_entity} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(n_data, n_entity)
+    return Mesh(arr, (DATA_AXIS, ENTITY_AXIS))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _pad_rows(a: np.ndarray, target: int) -> np.ndarray:
+    pad = target - a.shape[0]
+    if pad == 0:
+        return a
+    return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+
+
+def shard_batch(batch: Batch, mesh: Mesh, axis: str = DATA_AXIS) -> Batch:
+    """Place a batch with its example dimension sharded over ``axis``.
+
+    Pads the example count up to a multiple of the axis size with weight-0
+    rows (inert by the core masking contract), then device_puts each leaf with
+    a NamedSharding.  This is the one-time data layout step that replaces the
+    reference's per-step broadcast + shuffle choreography.
+    """
+    size = mesh.shape[axis]
+    n = batch.num_examples
+    target = ((n + size - 1) // size) * size
+
+    def place(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    row = P(axis)
+
+    if isinstance(batch, DenseBatch):
+        return DenseBatch(
+            x=place(_pad_rows(np.asarray(batch.x), target), P(axis, None)),
+            y=place(_pad_rows(np.asarray(batch.y), target), row),
+            offset=place(_pad_rows(np.asarray(batch.offset), target), row),
+            weight=place(_pad_rows(np.asarray(batch.weight), target), row),
+        )
+    if isinstance(batch, SparseBatch):
+        return SparseBatch(
+            indices=place(_pad_rows(np.asarray(batch.indices), target), P(axis, None)),
+            values=place(_pad_rows(np.asarray(batch.values), target), P(axis, None)),
+            y=place(_pad_rows(np.asarray(batch.y), target), row),
+            offset=place(_pad_rows(np.asarray(batch.offset), target), row),
+            weight=place(_pad_rows(np.asarray(batch.weight), target), row),
+            dim=batch.dim,
+        )
+    raise TypeError(f"unknown batch type {type(batch)!r}")

@@ -1,0 +1,127 @@
+"""Metric tests vs sklearn-free references (closed forms + scipy/numpy)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import (
+    EvaluationSuite,
+    auc_pr,
+    auc_roc,
+    make_evaluator,
+    precision_at_k,
+    rmse,
+)
+from photon_ml_tpu.evaluation.evaluator import EvaluatorType, grouped_evaluate
+from photon_ml_tpu.evaluation.metrics import logistic_loss_metric
+
+
+def _np_auc(scores, labels, weights):
+    """Reference implementation: probability that a random positive outranks a
+    random negative, ties count half (exact, O(n^2))."""
+    pos = [(s, w) for s, l, w in zip(scores, labels, weights) if l > 0.5 and w > 0]
+    neg = [(s, w) for s, l, w in zip(scores, labels, weights) if l <= 0.5 and w > 0]
+    num = den = 0.0
+    for sp, wp in pos:
+        for sn, wn in neg:
+            num += wp * wn * (1.0 if sp > sn else 0.5 if sp == sn else 0.0)
+            den += wp * wn
+    return num / den if den else 0.5
+
+
+def test_auc_exact(rng):
+    n = 64
+    scores = rng.normal(size=n)
+    labels = (rng.random(n) > 0.4).astype(float)
+    weights = rng.random(n) + 0.1
+    got = float(auc_roc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)))
+    np.testing.assert_allclose(got, _np_auc(scores, labels, weights), rtol=1e-10)
+
+
+def test_auc_with_ties(rng):
+    scores = np.asarray([1.0, 1.0, 1.0, 0.0, 0.0, 0.0, 0.5, 0.5])
+    labels = np.asarray([1.0, 0.0, 1.0, 0.0, 1.0, 0.0, 1.0, 0.0])
+    weights = np.ones(8)
+    got = float(auc_roc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)))
+    np.testing.assert_allclose(got, _np_auc(scores, labels, weights), rtol=1e-12)
+
+
+def test_auc_degenerate():
+    one = jnp.ones(4)
+    assert float(auc_roc(jnp.arange(4.0), one, one)) == 0.5
+    assert float(auc_roc(jnp.arange(4.0), jnp.zeros(4), one)) == 0.5
+
+
+def test_auc_padding_inert(rng):
+    n = 32
+    scores = rng.normal(size=n)
+    labels = (rng.random(n) > 0.5).astype(float)
+    w = np.ones(n)
+    a0 = float(auc_roc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(w)))
+    scores2 = np.concatenate([scores, rng.normal(size=7)])
+    labels2 = np.concatenate([labels, np.ones(7)])
+    w2 = np.concatenate([w, np.zeros(7)])
+    a1 = float(auc_roc(jnp.asarray(scores2), jnp.asarray(labels2), jnp.asarray(w2)))
+    np.testing.assert_allclose(a0, a1, rtol=1e-12)
+
+
+def test_aupr_perfect_and_random():
+    s = jnp.asarray([3.0, 2.0, 1.0, 0.0])
+    l = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    w = jnp.ones(4)
+    np.testing.assert_allclose(float(auc_pr(s, l, w)), 1.0, rtol=1e-12)
+
+
+def test_rmse_weighted(rng):
+    s = rng.normal(size=20)
+    l = rng.normal(size=20)
+    w = rng.random(20) + 0.1
+    expect = np.sqrt(np.sum(w * (s - l) ** 2) / np.sum(w))
+    np.testing.assert_allclose(
+        float(rmse(jnp.asarray(s), jnp.asarray(l), jnp.asarray(w))), expect, rtol=1e-12
+    )
+
+
+def test_precision_at_k():
+    s = jnp.asarray([0.9, 0.8, 0.7, 0.6, 0.5])
+    l = jnp.asarray([1.0, 0.0, 1.0, 1.0, 0.0])
+    w = jnp.ones(5)
+    np.testing.assert_allclose(float(precision_at_k(3, s, l, w)), 2.0 / 3.0, rtol=1e-12)
+    # padding pushed out of ranking
+    w0 = jnp.asarray([0.0, 1.0, 1.0, 1.0, 1.0])
+    np.testing.assert_allclose(float(precision_at_k(3, s, l, w0)), 2.0 / 3.0, rtol=1e-12)
+
+
+def test_grouped_evaluate_matches_per_group(rng):
+    n = 60
+    gids = rng.integers(0, 5, size=n)
+    s = rng.normal(size=n)
+    l = (rng.random(n) > 0.5).astype(float)
+    w = np.ones(n)
+    got = grouped_evaluate(
+        lambda a, b, c: auc_roc(a, b, c), gids, jnp.asarray(s), jnp.asarray(l), jnp.asarray(w)
+    )
+    per = [_np_auc(s[gids == g], l[gids == g], w[gids == g]) for g in np.unique(gids)]
+    np.testing.assert_allclose(got, np.mean(per), rtol=1e-9)
+
+
+def test_evaluator_specs_and_ordering():
+    ev = make_evaluator("auc")
+    assert ev.kind == EvaluatorType.AUC and ev.larger_is_better
+    assert ev.better_than(0.9, 0.8)
+    ev2 = make_evaluator("rmse")
+    assert not ev2.larger_is_better and ev2.better_than(0.1, 0.2)
+    ev3 = make_evaluator("precision@5:userId")
+    assert ev3.k == 5 and ev3.group_name == "userId" and ev3.name == "precision_at_k@5:userId"
+
+
+def test_evaluation_suite(rng):
+    n = 40
+    s = jnp.asarray(rng.normal(size=n))
+    l = jnp.asarray((rng.random(n) > 0.5).astype(float))
+    w = jnp.ones(n)
+    suite = EvaluationSuite.from_specs(["auc", "logistic_loss"], primary="auc")
+    res = suite.evaluate(s, l, w)
+    assert set(res.values) == {"auc", "logistic_loss"}
+    np.testing.assert_allclose(res.primary, float(auc_roc(s, l, w)), rtol=1e-12)
+    np.testing.assert_allclose(res.values["logistic_loss"], float(logistic_loss_metric(s, l, w)), rtol=1e-12)

@@ -1,0 +1,181 @@
+"""GAME layer tests: coordinate semantics, residual descent, estimator.
+
+Reference analogs: FixedEffectCoordinateIntegTest, RandomEffectCoordinateIntegTest,
+GameEstimatorIntegTest (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.evaluation import EvaluationSuite
+from photon_ml_tpu.game import (
+    CoordinateDescent,
+    FixedEffectConfig,
+    GameData,
+    GameEstimator,
+    GameTransformer,
+    RandomEffectConfig,
+    build_coordinate,
+)
+from photon_ml_tpu.game.config import GameConfig
+from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.opt.types import SolverConfig
+from photon_ml_tpu.types import TaskType
+
+
+def _glmix_data(rng, n_users=20, per_user=60, d_global=6, d_user=3):
+    """Generative GLMix: logit = x_g·w_g + x_u·w_user(u)."""
+    n = n_users * per_user
+    xg = rng.normal(size=(n, d_global))
+    xu = rng.normal(size=(n, d_user))
+    uid = np.repeat(np.arange(n_users) * 3 + 11, per_user)
+    wg = rng.normal(size=d_global) * 0.8
+    wu = rng.normal(size=(n_users, d_user)) * 1.2
+    logits = xg @ wg + np.einsum("nd,nd->n", xu, wu[np.repeat(np.arange(n_users), per_user)])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(float)
+    data = GameData(
+        y=y,
+        features={"global": xg, "per_user": xu},
+        id_tags={"userId": uid},
+    )
+    return data, wg, wu, logits
+
+
+def _configs(num_iters=3):
+    solver = SolverConfig(max_iters=100, tolerance=1e-8)
+    return GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="global", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "per-user": RandomEffectConfig(random_effect_type="userId",
+                                           feature_shard="per_user", solver=solver,
+                                           reg=Regularization(l2=1.0)),
+        },
+        num_outer_iterations=num_iters,
+    )
+
+
+def test_fixed_coordinate_update_and_score(rng):
+    data, wg, _, _ = _glmix_data(rng, n_users=4, per_user=50)
+    cfg = _configs().coordinates["fixed"]
+    coord = build_coordinate("fixed", data, cfg, TaskType.LOGISTIC_REGRESSION)
+    model, res = coord.update(np.zeros(data.num_samples))
+    s = coord.score(model)
+    np.testing.assert_allclose(
+        s, data.features["global"] @ model.coefficients.means, rtol=1e-5, atol=1e-6
+    )
+
+
+
+def test_residual_offsets_matter(rng):
+    """A coordinate trained with the other coordinate's score as offset must
+    differ from one trained without (the residual trick)."""
+    data, *_ = _glmix_data(rng, n_users=4, per_user=50)
+    cfg = _configs().coordinates["fixed"]
+    coord = build_coordinate("fixed", data, cfg, TaskType.LOGISTIC_REGRESSION)
+    m0, _ = coord.update(np.zeros(data.num_samples))
+    m1, _ = coord.update(rng.normal(size=data.num_samples) * 2.0)
+    assert not np.allclose(m0.coefficients.means, m1.coefficients.means)
+
+
+def test_glmix_descent_beats_fixed_only(rng):
+    data, wg, wu, logits = _glmix_data(rng)
+    suite = EvaluationSuite.from_specs(["auc", "logistic_loss"], primary="auc")
+    est = GameEstimator(validation_suite=suite)
+    # fixed-only
+    fixed_only = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={"fixed": _configs().coordinates["fixed"]},
+    )
+    r_fixed = est.fit(data, [fixed_only], validation_data=data)[0]
+    # full GLMix
+    r_full = est.fit(data, [_configs()], validation_data=data)[0]
+    auc_fixed = r_fixed.evaluation.values["auc"]
+    auc_full = r_full.evaluation.values["auc"]
+    assert auc_full > auc_fixed + 0.05, (auc_fixed, auc_full)
+    assert auc_full > 0.8
+
+
+def test_glmix_recovers_fixed_coefficients(rng):
+    """With random effects absorbing per-user structure, the fixed coordinate
+    should approach the generative global coefficients."""
+    data, wg, wu, _ = _glmix_data(rng, n_users=30, per_user=80)
+    res = GameEstimator().fit(data, [_configs(num_iters=4)])[0]
+    w_hat = res.model["fixed"].coefficients.means
+    corr = np.corrcoef(w_hat, wg)[0, 1]
+    assert corr > 0.95, corr
+
+
+def test_descent_converges_training_loss(rng):
+    """Each outer iteration must not worsen the training objective."""
+    data, *_ = _glmix_data(rng, n_users=8, per_user=40)
+    suite = EvaluationSuite.from_specs(["logistic_loss"])
+    est = GameEstimator(validation_suite=suite)
+    res = est.fit(data, [_configs(num_iters=3)], validation_data=data)[0]
+    losses = [s["validation"].values["logistic_loss"] for s in res.history.steps]
+    assert losses[-1] <= losses[0]
+    # best-model tracking returned the minimum seen
+    assert res.evaluation.values["logistic_loss"] <= min(losses) + 1e-9
+
+
+def test_warm_start_and_locked_coordinates(rng):
+    data, *_ = _glmix_data(rng, n_users=6, per_user=40)
+    est = GameEstimator()
+    first = est.fit(data, [_configs(num_iters=2)])[0]
+    # partial retrain: lock the fixed effect, retrain only random effects
+    res = est.fit(data, [_configs(num_iters=1)], initial_model=first.model,
+                  locked_coordinates={"fixed"})[0]
+    np.testing.assert_array_equal(
+        res.model["fixed"].coefficients.means, first.model["fixed"].coefficients.means
+    )
+    # locked without initial model -> error
+    with pytest.raises(ValueError, match="locked"):
+        est.fit(data, [_configs(num_iters=1)], locked_coordinates={"fixed"})
+
+
+def test_transformer_scores_new_data(rng):
+    full, wg, wu, _ = _glmix_data(rng, per_user=80)
+    n = full.num_samples
+    idx = rng.permutation(n)
+    tr, te = idx[: n // 2], idx[n // 2:]
+
+    def take(i):
+        return GameData(
+            y=full.y[i],
+            features={k: v[i] for k, v in full.features.items()},
+            id_tags={k: v[i] for k, v in full.id_tags.items()},
+        )
+
+    data, new_data = take(tr), take(te)
+    res = GameEstimator().fit(data, [_configs(num_iters=2)])[0]
+    tf = GameTransformer(res.model, TaskType.LOGISTIC_REGRESSION)
+    scores = tf.score(new_data)
+    assert scores.shape == (new_data.num_samples,)
+    preds = tf.predict(new_data)
+    assert np.all((preds >= 0) & (preds <= 1))
+    suite = EvaluationSuite.from_specs(["auc"])
+    ev = tf.evaluate(new_data, suite)
+    assert ev.values["auc"] > 0.6  # generalizes (same users, new samples)
+
+
+def test_grouped_validation_metric(rng):
+    data, *_ = _glmix_data(rng, n_users=6, per_user=50)
+    suite = EvaluationSuite.from_specs(["auc", "auc:userId"], primary="auc")
+    est = GameEstimator(validation_suite=suite)
+    res = est.fit(data, [_configs(num_iters=1)], validation_data=data)[0]
+    assert "auc:userId" in res.evaluation.values
+    assert 0.0 <= res.evaluation.values["auc:userId"] <= 1.0
+
+
+def test_multiple_configs_warm_start(rng):
+    """Reg-path over two configs: second fit warm-starts from the first."""
+    data, *_ = _glmix_data(rng, n_users=5, per_user=40)
+    suite = EvaluationSuite.from_specs(["auc"])
+    est = GameEstimator(validation_suite=suite)
+    c1 = _configs(num_iters=1)
+    results = est.fit(data, [c1, c1], validation_data=data)
+    assert len(results) == 2
+    best = est.best(results)
+    assert best in results

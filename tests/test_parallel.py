@@ -1,0 +1,170 @@
+"""Distributed-layer tests on the 8-device virtual CPU mesh.
+
+Reference analog (SURVEY.md §4): distributed-vs-local parity tests
+(DistributedOptimizationProblemIntegTest) — here: chip-count invariance
+(1-device vs 8-device mesh gives identical solutions) and bucketed-vmap
+random effects vs per-entity serial solves.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core import GLMObjective, Regularization, losses
+from photon_ml_tpu.core.batch import dense_batch, sparse_batch
+from photon_ml_tpu.opt import SolverConfig, make_solver
+from photon_ml_tpu.parallel import (
+    bucket_by_entity,
+    fit_fixed_effect,
+    fit_random_effects,
+    make_mesh,
+    score_random_effects,
+)
+from photon_ml_tpu.parallel.bucketing import (
+    gather_entity_coefficients,
+    score_samples,
+    stacked_coefficients,
+)
+from photon_ml_tpu.types import OptimizerType
+
+D = 5
+
+
+def _problem(rng, n=333):  # deliberately not divisible by 8
+    x = rng.normal(size=(n, D))
+    w = rng.normal(size=D)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-x @ w))).astype(float)
+    wt = rng.random(n) + 0.5
+    return dense_batch(x, y, weight=wt), GLMObjective(
+        loss=losses.logistic_loss, reg=Regularization(l2=0.2)
+    )
+
+
+def test_fixed_effect_chip_count_invariance(rng, devices):
+    batch, obj = _problem(rng)
+    mesh1 = make_mesh(n_data=1, devices=devices[:1])
+    mesh8 = make_mesh(n_data=8, devices=devices)
+    r1 = fit_fixed_effect(obj, batch, jnp.zeros(D), mesh1)
+    r8 = fit_fixed_effect(obj, batch, jnp.zeros(D), mesh8)
+    np.testing.assert_allclose(r1.value, r8.value, rtol=1e-9)
+    np.testing.assert_allclose(r1.w, r8.w, rtol=1e-6, atol=1e-9)
+    # and both match the plain single-device solver
+    plain = jax.jit(make_solver(obj, OptimizerType.LBFGS))(jnp.zeros(D), batch)
+    np.testing.assert_allclose(r8.value, plain.value, rtol=1e-9)
+
+
+def test_fixed_effect_sparse_sharded(rng, devices):
+    n, k = 100, 3
+    idx = np.stack([rng.choice(D, size=k, replace=False) for _ in range(n)])
+    val = rng.normal(size=(n, k))
+    y = (rng.random(n) > 0.5).astype(float)
+    sb = sparse_batch(idx, val, y, dim=D)
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.1))
+    mesh8 = make_mesh(n_data=8, devices=devices)
+    r8 = fit_fixed_effect(obj, sb, jnp.zeros(D), mesh8)
+    plain = jax.jit(make_solver(obj, OptimizerType.LBFGS))(jnp.zeros(D), sb)
+    np.testing.assert_allclose(r8.value, plain.value, rtol=1e-9)
+    np.testing.assert_allclose(r8.w, plain.w, rtol=1e-6, atol=1e-9)
+
+
+def _entity_data(rng, n_entities=13, dim=3):
+    sizes = rng.integers(2, 40, size=n_entities)
+    rows = []
+    eids = []
+    for e in range(n_entities):
+        xe = rng.normal(size=(sizes[e], dim))
+        we = rng.normal(size=dim)
+        ye = (rng.random(sizes[e]) < 1.0 / (1.0 + np.exp(-xe @ we))).astype(float)
+        rows.append((xe, ye))
+        eids.extend([e * 7 + 100] * sizes[e])  # non-contiguous ids
+    x = np.concatenate([r[0] for r in rows])
+    y = np.concatenate([r[1] for r in rows])
+    return np.asarray(eids), x, y
+
+
+def test_bucketing_layout(rng):
+    eids, x, y = _entity_data(rng)
+    b = bucket_by_entity(eids, x, y, dtype=np.float64)
+    # every real sample appears exactly once across buckets
+    all_rows = np.concatenate([bk.rows.ravel() for bk in b.buckets])
+    real = all_rows[all_rows >= 0]
+    assert sorted(real.tolist()) == list(range(len(eids)))
+    # capacities are powers of two and counts fit
+    for bk in b.buckets:
+        assert bk.capacity & (bk.capacity - 1) == 0
+        assert np.all(bk.counts <= bk.capacity)
+        # padding slots have weight 0
+        pad = bk.rows < 0
+        assert np.all(bk.weight[pad] == 0.0)
+
+
+def test_random_effects_match_serial(rng, devices):
+    """Bucketed vmapped solves == per-entity serial solves (reference
+    RandomEffectCoordinate semantics)."""
+    eids, x, y = _entity_data(rng)
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.4))
+    cfg = SolverConfig(max_iters=100, tolerance=1e-9)
+    mesh = make_mesh(n_data=8, devices=devices)
+    b = bucket_by_entity(eids, x, y, lane_multiple=8, dtype=np.float64)
+    coeffs, results = fit_random_effects(obj, b, mesh=mesh, config=cfg)
+    per_entity = gather_entity_coefficients(coeffs, b)
+
+    solve = make_solver(obj, OptimizerType.LBFGS, cfg)
+    dim = x.shape[1]
+    for eid in np.unique(eids):
+        m = eids == eid
+        ref = solve(jnp.zeros(dim), dense_batch(x[m], y[m]))
+        np.testing.assert_allclose(per_entity[int(eid)], ref.w, rtol=1e-5, atol=1e-7)
+
+
+def test_reservoir_cap_deterministic_and_rescaled(rng):
+    eids = np.zeros(100, np.int64)
+    x = rng.normal(size=(100, 2))
+    y = (rng.random(100) > 0.5).astype(float)
+    b1 = bucket_by_entity(eids, x, y, active_cap=16, seed=3)
+    b2 = bucket_by_entity(eids, x, y, active_cap=16, seed=3)
+    np.testing.assert_array_equal(b1.buckets[0].rows, b2.buckets[0].rows)
+    bk = b1.buckets[0]
+    assert int(bk.counts[0]) == 16
+    # weight rescale count/cap = 100/16 (reference RandomEffectDataset.scala:408-417)
+    np.testing.assert_allclose(bk.weight[0, :16], 100.0 / 16.0)
+    # different seed -> different sample (overwhelmingly likely)
+    b3 = bucket_by_entity(eids, x, y, active_cap=16, seed=4)
+    assert not np.array_equal(b1.buckets[0].rows, b3.buckets[0].rows)
+
+
+def test_min_active_samples_filter(rng):
+    eids = np.asarray([1, 1, 1, 2, 3, 3], np.int64)
+    x = rng.normal(size=(6, 2))
+    y = np.ones(6)
+    b = bucket_by_entity(eids, x, y, min_active_samples=2)
+    assert set(b.lane_of) == {1, 3}
+    assert b.num_entities == 2
+
+
+def test_scoring_roundtrip(rng):
+    eids, x, y = _entity_data(rng)
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.4))
+    b = bucket_by_entity(eids, x, y, dtype=np.float64)
+    coeffs, _ = fit_random_effects(obj, b, config=SolverConfig(max_iters=50))
+    # bucket-layout scoring == gather-based scoring == manual dot
+    s_active = np.asarray(score_random_effects(coeffs, b))
+    w_stack, slot_of = stacked_coefficients(coeffs, b)
+    slots = np.asarray([slot_of.get(int(e), -1) for e in eids], np.int32)
+    s_gather = np.asarray(score_samples(w_stack, jnp.asarray(slots), jnp.asarray(x)))
+    np.testing.assert_allclose(s_active, s_gather, rtol=1e-9, atol=1e-12)
+    per_entity = gather_entity_coefficients(coeffs, b)
+    manual = np.asarray([x[i] @ per_entity[int(eids[i])] for i in range(len(eids))])
+    np.testing.assert_allclose(s_gather, manual, rtol=1e-9, atol=1e-12)
+
+
+def test_scoring_unknown_entity_is_zero(rng):
+    eids, x, y = _entity_data(rng, n_entities=3)
+    obj = GLMObjective(loss=losses.logistic_loss)
+    b = bucket_by_entity(eids, x, y, dtype=np.float64)
+    coeffs, _ = fit_random_effects(obj, b, config=SolverConfig(max_iters=20))
+    w_stack, slot_of = stacked_coefficients(coeffs, b)
+    slots = jnp.asarray([-1, 0], jnp.int32)
+    s = score_samples(w_stack, slots, jnp.asarray(np.ones((2, x.shape[1]))))
+    assert float(s[0]) == 0.0
