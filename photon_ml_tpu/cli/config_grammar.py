@@ -1,0 +1,110 @@
+"""Coordinate-configuration mini-grammar for the CLI.
+
+Reference: photon-client .../io/scopt/ScoptParserHelpers.scala:495 — parses
+specs like
+  "name=global,feature.shard=shardA,optimizer=LBFGS,tolerance=1e-7,
+   max.iter=50,reg.weights=0.1|1|10"
+  "name=per-user,random.effect.type=userId,feature.shard=shardB,
+   active.data.lower.bound=2,reg.weights=1"
+and io/CoordinateConfiguration.scala:164 ``expandOptimizationConfigurations``
+(cartesian grid over per-coordinate reg weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from photon_ml_tpu.core.regularization import Regularization, RegularizationType
+from photon_ml_tpu.game.config import (
+    CoordinateConfig,
+    FixedEffectConfig,
+    GameConfig,
+    RandomEffectConfig,
+)
+from photon_ml_tpu.opt.types import SolverConfig
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+
+@dataclasses.dataclass
+class CoordinateSpec:
+    """One parsed --coordinate flag: config template + reg-weight sweep."""
+
+    name: str
+    reg_weights: List[float]
+    reg_type: RegularizationType
+    alpha: float
+    template: CoordinateConfig  # reg filled per grid point
+
+    def with_weight(self, w: float) -> CoordinateConfig:
+        reg = Regularization.from_context(self.reg_type, w, self.alpha)
+        return dataclasses.replace(self.template, reg=reg)
+
+
+def parse_coordinate_spec(spec: str) -> CoordinateSpec:
+    kv: Dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad coordinate spec fragment {part!r} (expected key=value)")
+        k, v = part.split("=", 1)
+        kv[k.strip()] = v.strip()
+
+    name = kv.pop("name", None)
+    if not name:
+        raise ValueError(f"coordinate spec missing name=: {spec!r}")
+    shard = kv.pop("feature.shard", None)
+    if not shard:
+        raise ValueError(f"coordinate {name!r} missing feature.shard=")
+
+    optimizer = OptimizerType[kv.pop("optimizer", "LBFGS").upper()]
+    solver = SolverConfig(
+        max_iters=int(kv.pop("max.iter", 100)),
+        tolerance=float(kv.pop("tolerance", 1e-7)),
+    )
+    reg_type = RegularizationType[kv.pop("reg.type", "L2").upper()]
+    alpha = float(kv.pop("reg.alpha", 0.5))
+    weights = [float(w) for w in kv.pop("reg.weights", "0").split("|")]
+    down_sampling = float(kv.pop("down.sampling.rate", 1.0))
+
+    re_type = kv.pop("random.effect.type", None)
+    if re_type is not None:
+        template: CoordinateConfig = RandomEffectConfig(
+            random_effect_type=re_type,
+            feature_shard=shard,
+            optimizer=optimizer,
+            solver=solver,
+            active_cap=(int(kv["active.data.upper.bound"])
+                        if "active.data.upper.bound" in kv else None),
+            min_active_samples=int(kv.pop("active.data.lower.bound", 1)),
+        )
+        kv.pop("active.data.upper.bound", None)
+    else:
+        template = FixedEffectConfig(
+            feature_shard=shard,
+            optimizer=optimizer,
+            solver=solver,
+            down_sampling_rate=down_sampling,
+        )
+    if kv:
+        raise ValueError(f"unknown coordinate spec keys for {name!r}: {sorted(kv)}")
+    return CoordinateSpec(name=name, reg_weights=weights, reg_type=reg_type,
+                          alpha=alpha, template=template)
+
+
+def expand_game_configs(specs: List[CoordinateSpec], task: TaskType,
+                        num_outer_iterations: int) -> List[GameConfig]:
+    """Cartesian grid over per-coordinate reg weights
+    (reference GameTrainingDriver.prepareGameOptConfigs:624-633)."""
+    grids = [[(s.name, s.with_weight(w)) for w in s.reg_weights] for s in specs]
+    configs = []
+    for combo in itertools.product(*grids):
+        configs.append(GameConfig(
+            task=task,
+            coordinates=dict(combo),
+            num_outer_iterations=num_outer_iterations,
+        ))
+    return configs

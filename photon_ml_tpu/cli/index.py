@@ -1,0 +1,50 @@
+"""Feature-indexing driver: scan data, build per-shard index maps, save.
+
+Reference: photon-client .../index/FeatureIndexingDriver.scala:41-320 (builds
+partitioned PalDB stores; here the compact binary IndexMap format) and
+NameAndTermFeatureBagsDriver (feature-bag scans).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import List
+
+from photon_ml_tpu.data.index_map import build_index_maps_from_avro
+
+logger = logging.getLogger("photon_ml_tpu.index")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-tpu-index",
+                                description="Build feature index maps from Avro data")
+    p.add_argument("--data", nargs="+", required=True)
+    p.add_argument("--feature-shards", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--no-intercept", action="store_true")
+    return p
+
+
+def run(argv: List[str]) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    shards = [s for s in args.feature_shards.split(",") if s]
+    maps = build_index_maps_from_avro(args.data, {s: [] for s in shards},
+                                      add_intercept=not args.no_intercept)
+    os.makedirs(args.output_dir, exist_ok=True)
+    for shard, m in maps.items():
+        path = os.path.join(args.output_dir, f"{shard}.idx")
+        m.save(path)
+        logger.info("shard %s: %d features -> %s", shard, m.size, path)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

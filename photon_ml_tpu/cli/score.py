@@ -1,0 +1,95 @@
+"""GAME scoring driver.
+
+Reference: photon-client .../cli/game/scoring/GameScoringDriver.scala:39-263 —
+load model -> read data -> GameTransformer -> write ScoringResultAvro ->
+optional evaluation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List
+
+import numpy as np
+
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.reader import EntityIndex, read_game_data_avro
+from photon_ml_tpu.data.schemas import SCORING_RESULT
+from photon_ml_tpu.evaluation.evaluator import EvaluationSuite
+from photon_ml_tpu.game.estimator import GameTransformer
+from photon_ml_tpu.storage.model_io import load_game_model
+
+logger = logging.getLogger("photon_ml_tpu.score")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-tpu-score",
+                                description="Score data with a trained GAME model")
+    p.add_argument("--data", nargs="+", required=True)
+    p.add_argument("--model-dir", required=True,
+                   help="directory produced by the training driver (contains "
+                        "best/, *.idx, *.entities.json)")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--evaluators", default="")
+    p.add_argument("--model-id", default="", help="stamped into score metadata")
+    p.add_argument("--predict-mean", action="store_true",
+                   help="write inverse-link means instead of raw scores")
+    return p
+
+
+def run(argv: List[str]) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+
+    index_maps = {}
+    entity_indexes = {}
+    for name in os.listdir(args.model_dir):
+        if name.endswith(".idx"):
+            index_maps[name[:-4]] = IndexMap.load(os.path.join(args.model_dir, name))
+        elif name.endswith(".entities.json"):
+            entity_indexes[name[: -len(".entities.json")]] = EntityIndex.load(
+                os.path.join(args.model_dir, name))
+
+    model, task = load_game_model(os.path.join(args.model_dir, "best"),
+                                  index_maps, entity_indexes)
+    id_tags = sorted(entity_indexes)
+    data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
+                                  entity_indexes=entity_indexes)
+    logger.info("scoring %d samples", data.num_samples)
+
+    tf = GameTransformer(model, task)
+    scores = tf.predict(data) if args.predict_mean else tf.score(data) + np.asarray(data.offset)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    out_path = os.path.join(args.output_dir, "scores.avro")
+    meta = {"modelId": args.model_id} if args.model_id else None
+    uids = data.uids if data.uids is not None else range(data.num_samples)
+    records = (
+        {"uid": (int(u) if isinstance(u, (int, np.integer)) else u),
+         "predictionScore": float(scores[i]),
+         "label": float(data.y[i]), "metadataMap": meta}
+        for i, u in enumerate(uids)
+    )
+    n = avro_io.write_container(out_path, SCORING_RESULT, records)
+    logger.info("wrote %d scores -> %s", n, out_path)
+
+    if args.evaluators:
+        suite = EvaluationSuite.from_specs(args.evaluators.split(","))
+        res = suite.evaluate(scores, data.y, data.weight, group_ids=data.id_tags)
+        logger.info("metrics: %s", res.values)
+        with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
+            json.dump(res.values, f, indent=2)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

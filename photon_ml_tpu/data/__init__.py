@@ -1,0 +1,17 @@
+from photon_ml_tpu.data.index_map import IndexMap, feature_key, split_key  # noqa: F401
+from photon_ml_tpu.data.reader import (  # noqa: F401
+    EntityIndex,
+    read_game_data_avro,
+    read_libsvm,
+    index_map_for_libsvm,
+)
+from photon_ml_tpu.data.validation import (  # noqa: F401
+    DataValidationType,
+    validate_game_data,
+)
+from photon_ml_tpu.data.synthetic import (  # noqa: F401
+    generate_binary_classification,
+    generate_poisson,
+    generate_linear,
+    generate_glmix,
+)

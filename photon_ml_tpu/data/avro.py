@@ -1,0 +1,374 @@
+"""Self-contained Avro binary codec + object-container-file reader/writer.
+
+The execution image has no avro library, and the reference's wire/storage
+formats are Avro (photon-avro-schemas/src/main/avro/*.avsc; AvroUtils.scala,
+AvroDataReader.scala) — so the codec lives here, implemented from the Avro
+1.x specification: zigzag-varint ints/longs, little-endian float/double,
+length-prefixed strings/bytes, index-prefixed unions, block-encoded
+arrays/maps, and the ``Obj\\x01`` container framing with a metadata map and
+16-byte sync markers.  Supports null/deflate codecs, generic schema-driven
+decode (reader uses the writer schema embedded in the header, as the spec
+requires).
+
+This is the Python fallback; the C++ extension (native/) accelerates the
+hot TrainingExample decode path when built.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Optional, Union
+
+Schema = Union[str, dict, list]
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# primitive codecs
+# ---------------------------------------------------------------------------
+
+
+def _encode_long(n: int, out: bytearray) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag
+    while (n & ~0x7F) != 0:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n & 0x7F)
+
+
+def _decode_long(buf: memoryview, pos: int) -> tuple[int, int]:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, data: bytes):
+        self.buf = memoryview(data)
+        self.pos = 0
+
+    def long(self) -> int:
+        v, self.pos = _decode_long(self.buf, self.pos)
+        return v
+
+    def raw(self, n: int) -> bytes:
+        b = bytes(self.buf[self.pos: self.pos + n])
+        self.pos += n
+        return b
+
+    def string(self) -> str:
+        return self.raw(self.long()).decode("utf-8")
+
+    def bytes_(self) -> bytes:
+        return self.raw(self.long())
+
+    def float_(self) -> float:
+        (v,) = struct.unpack_from("<f", self.buf, self.pos)
+        self.pos += 4
+        return v
+
+    def double(self) -> float:
+        (v,) = struct.unpack_from("<d", self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    def boolean(self) -> bool:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b != 0
+
+
+# ---------------------------------------------------------------------------
+# schema-driven decode / encode
+# ---------------------------------------------------------------------------
+
+
+def _schema_type(schema: Schema) -> str:
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    return schema["type"]
+
+
+def decode(schema: Schema, r: _Reader, named: Dict[str, dict]) -> Any:
+    t = _schema_type(schema)
+    if t == "null":
+        return None
+    if t == "boolean":
+        return r.boolean()
+    if t in ("int", "long"):
+        return r.long()
+    if t == "float":
+        return r.float_()
+    if t == "double":
+        return r.double()
+    if t == "string":
+        return r.string()
+    if t == "bytes":
+        return r.bytes_()
+    if t == "union":
+        idx = r.long()
+        return decode(schema[idx], r, named)
+    if t == "record":
+        _register(schema, named)
+        return {f["name"]: decode(f["type"], r, named) for f in schema["fields"]}
+    if t == "array":
+        out: List[Any] = []
+        while True:
+            count = r.long()
+            if count == 0:
+                break
+            if count < 0:
+                r.long()  # block byte size, unused
+                count = -count
+            for _ in range(count):
+                out.append(decode(schema["items"], r, named))
+        return out
+    if t == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            count = r.long()
+            if count == 0:
+                break
+            if count < 0:
+                r.long()
+                count = -count
+            for _ in range(count):
+                k = r.string()
+                m[k] = decode(schema["values"], r, named)
+        return m
+    if t == "enum":
+        _register(schema, named)
+        return schema["symbols"][r.long()]
+    if t == "fixed":
+        _register(schema, named)
+        return r.raw(schema["size"])
+    # named-type reference
+    if t in named:
+        return decode(named[t], r, named)
+    raise ValueError(f"unsupported avro schema type {t!r}")
+
+
+def _register(schema: dict, named: Dict[str, dict]) -> None:
+    name = schema.get("name")
+    if name:
+        ns = schema.get("namespace")
+        named[name] = schema
+        if ns:
+            named[f"{ns}.{name}"] = schema
+
+
+def _union_index(schema: list, value: Any) -> int:
+    def matches(s: Schema, v: Any) -> bool:
+        t = _schema_type(s)
+        if t == "null":
+            return v is None
+        if t == "boolean":
+            return isinstance(v, bool)
+        if t in ("int", "long"):
+            return isinstance(v, int) and not isinstance(v, bool)
+        if t in ("float", "double"):
+            return isinstance(v, float) or (isinstance(v, int) and not isinstance(v, bool))
+        if t == "string":
+            return isinstance(v, str)
+        if t == "bytes":
+            return isinstance(v, bytes)
+        if t == "record":
+            return isinstance(v, dict)
+        if t == "array":
+            return isinstance(v, list)
+        if t == "map":
+            return isinstance(v, dict)
+        if t == "enum":
+            return isinstance(v, str)
+        return False
+
+    for i, s in enumerate(schema):
+        if matches(s, value):
+            return i
+    raise ValueError(f"value {value!r} matches no branch of union {schema!r}")
+
+
+def encode(schema: Schema, value: Any, out: bytearray, named: Dict[str, dict]) -> None:
+    t = _schema_type(schema)
+    if t == "null":
+        return
+    if t == "boolean":
+        out.append(1 if value else 0)
+        return
+    if t in ("int", "long"):
+        _encode_long(int(value), out)
+        return
+    if t == "float":
+        out.extend(struct.pack("<f", value))
+        return
+    if t == "double":
+        out.extend(struct.pack("<d", value))
+        return
+    if t == "string":
+        b = value.encode("utf-8")
+        _encode_long(len(b), out)
+        out.extend(b)
+        return
+    if t == "bytes":
+        _encode_long(len(value), out)
+        out.extend(value)
+        return
+    if t == "union":
+        idx = _union_index(schema, value)
+        _encode_long(idx, out)
+        encode(schema[idx], value, out, named)
+        return
+    if t == "record":
+        _register(schema, named)
+        for f in schema["fields"]:
+            if f["name"] not in value and "default" in f:
+                encode(f["type"], f["default"], out, named)
+            else:
+                encode(f["type"], value[f["name"]], out, named)
+        return
+    if t == "array":
+        if value:
+            _encode_long(len(value), out)
+            for item in value:
+                encode(schema["items"], item, out, named)
+        _encode_long(0, out)
+        return
+    if t == "map":
+        if value:
+            _encode_long(len(value), out)
+            for k, v in value.items():
+                encode("string", k, out, named)
+                encode(schema["values"], v, out, named)
+        _encode_long(0, out)
+        return
+    if t == "enum":
+        _register(schema, named)
+        _encode_long(schema["symbols"].index(value), out)
+        return
+    if t == "fixed":
+        out.extend(value)
+        return
+    if t in named:
+        encode(named[t], value, out, named)
+        return
+    raise ValueError(f"unsupported avro schema type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# object container files
+# ---------------------------------------------------------------------------
+
+_META_SCHEMA: Schema = {"type": "map", "values": "bytes"}
+
+
+def read_container(path: str) -> Iterator[dict]:
+    """Iterate records of an Avro object container file (null/deflate codecs)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    if r.raw(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    named: Dict[str, dict] = {}
+    meta = decode(_META_SCHEMA, r, named)  # str keys, bytes values
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = r.raw(16)
+    named = {}
+    while r.pos < len(data):
+        count = r.long()
+        size = r.long()
+        block = r.raw(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        br = _Reader(block)
+        for _ in range(count):
+            yield decode(schema, br, named)
+        if r.raw(16) != sync:
+            raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+
+
+def read_schema(path: str) -> dict:
+    """Read just the writer schema from a container file header."""
+    with open(path, "rb") as f:
+        data = f.read(1 << 20)
+    r = _Reader(data)
+    if r.raw(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta = decode(_META_SCHEMA, r, {})
+    raw = meta["avro.schema"]
+    return json.loads(raw if isinstance(raw, (str, bytes)) else bytes(raw))
+
+
+def write_container(path: str, schema: Schema, records: Iterable[dict],
+                    codec: str = "deflate", sync: bytes = b"photon-ml-tpu-sm",
+                    block_records: int = 4096) -> int:
+    """Write records to an Avro object container file; returns record count."""
+    assert len(sync) == 16
+    named: Dict[str, dict] = {}
+    n_total = 0
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        header = bytearray()
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        encode(_META_SCHEMA, meta, header, named)
+        f.write(bytes(header))
+        f.write(sync)
+
+        block = bytearray()
+        n_block = 0
+
+        def flush():
+            nonlocal block, n_block
+            if n_block == 0:
+                return
+            payload = bytes(block)
+            if codec == "deflate":
+                comp = zlib.compressobj(wbits=-15)
+                payload = comp.compress(payload) + comp.flush()
+            head = bytearray()
+            _encode_long(n_block, head)
+            _encode_long(len(payload), head)
+            f.write(bytes(head))
+            f.write(payload)
+            f.write(sync)
+            block = bytearray()
+            n_block = 0
+
+        for rec in records:
+            encode(schema, rec, block, named)
+            n_block += 1
+            n_total += 1
+            if n_block >= block_records:
+                flush()
+        flush()
+    return n_total
+
+
+def read_directory(path: str) -> Iterator[dict]:
+    """Read all .avro files under a directory (the reference reads
+    part-files from an HDFS dir, AvroUtils.readAvroFiles)."""
+    if os.path.isfile(path):
+        yield from read_container(path)
+        return
+    for name in sorted(os.listdir(path)):
+        if name.endswith(".avro"):
+            yield from read_container(os.path.join(path, name))

@@ -1,0 +1,160 @@
+"""Feature index maps: (name, term) <-> contiguous integer id.
+
+Reference: photon-api .../index/IndexMap.scala:54 (getIndex/getFeatureName),
+DefaultIndexMap/DefaultIndexMapLoader (in-memory from distinct features),
+PalDBIndexMap (off-heap partitioned store for ~1e8-feature vocabularies,
+PalDBIndexMap.scala:16-278) and the FeatureIndexingDriver
+(photon-client .../index/FeatureIndexingDriver.scala:41-320).
+
+TPU-native stance: the DEVICE only ever sees dense integer ids; the map is a
+host-side dictionary with a compact binary file format (sorted key blob +
+offsets, mmap-friendly — the PalDB replacement; a C++ loader can consume the
+same format).  Keys are "name\\x1fterm" (the reference joins name.term with a
+separator for model files).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from photon_ml_tpu.data.schemas import INTERCEPT_NAME, INTERCEPT_TERM
+
+SEP = "\x1f"
+MAGIC = b"PHIDX001"
+
+
+def feature_key(name: str, term: str = "") -> str:
+    return f"{name}{SEP}{term}"
+
+
+def split_key(key: str) -> Tuple[str, str]:
+    name, _, term = key.partition(SEP)
+    return name, term
+
+
+class IndexMap:
+    """Immutable feature index map (reference IndexMap contract)."""
+
+    def __init__(self, key_to_id: Dict[str, int]):
+        self._fwd = key_to_id
+        self._rev: Optional[List[str]] = None
+
+    @property
+    def size(self) -> int:
+        return len(self._fwd)
+
+    def get_index(self, name: str, term: str = "") -> int:
+        """-1 if absent (reference IndexMap.NULL_KEY semantics)."""
+        return self._fwd.get(feature_key(name, term), -1)
+
+    def get_feature_name(self, idx: int) -> Optional[Tuple[str, str]]:
+        if self._rev is None:
+            rev = [""] * len(self._fwd)
+            for k, i in self._fwd.items():
+                rev[i] = k
+            self._rev = rev
+        if 0 <= idx < len(self._rev):
+            return split_key(self._rev[idx])
+        return None
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        i = self.get_index(INTERCEPT_NAME, INTERCEPT_TERM)
+        return None if i < 0 else i
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._fwd
+
+    def items(self) -> Iterator[Tuple[str, int]]:
+        return iter(self._fwd.items())
+
+    # -- builders -----------------------------------------------------------
+
+    @classmethod
+    def build(cls, keys: Iterable[str], add_intercept: bool = True) -> "IndexMap":
+        """Deterministic map: intercept first (if requested), then sorted keys
+        (the reference sorts per-partition then offsets; sorted-global is the
+        single-host equivalent and is reproducible)."""
+        uniq = sorted(set(keys))
+        fwd: Dict[str, int] = {}
+        if add_intercept:
+            fwd[feature_key(INTERCEPT_NAME, INTERCEPT_TERM)] = 0
+        for k in uniq:
+            if k not in fwd:
+                fwd[k] = len(fwd)
+        return cls(fwd)
+
+    @classmethod
+    def from_features(cls, features: Iterable[Tuple[str, str]], add_intercept: bool = True
+                      ) -> "IndexMap":
+        return cls.build((feature_key(n, t) for n, t in features), add_intercept)
+
+    # -- binary store (PalDB replacement) -----------------------------------
+
+    def save(self, path: str) -> None:
+        """Compact binary layout: header, id-ordered key blob + offset table."""
+        rev = [""] * len(self._fwd)
+        for k, i in self._fwd.items():
+            rev[i] = k
+        blob = bytearray()
+        offsets = []
+        for k in rev:
+            offsets.append(len(blob))
+            blob.extend(k.encode("utf-8"))
+        offsets.append(len(blob))
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack("<q", len(rev)))
+            f.write(struct.pack(f"<{len(offsets)}q", *offsets))
+            f.write(bytes(blob))
+
+    @classmethod
+    def load(cls, path: str) -> "IndexMap":
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:8] != MAGIC:
+            raise ValueError(f"{path}: not a photon index map")
+        (n,) = struct.unpack_from("<q", data, 8)
+        offsets = struct.unpack_from(f"<{n + 1}q", data, 16)
+        base = 16 + 8 * (n + 1)
+        fwd = {}
+        for i in range(n):
+            fwd[data[base + offsets[i]: base + offsets[i + 1]].decode("utf-8")] = i
+        return cls(fwd)
+
+
+def build_index_maps_from_records(
+    records: Iterable[dict],
+    shards: Iterable[str],
+    add_intercept: bool = True,
+) -> Dict[str, IndexMap]:
+    """Build per-shard IndexMaps from already-decoded TrainingExampleAvro
+    records.  The single-bag Avro layout puts every feature in every shard,
+    so ONE map is built and shared (IndexMap is immutable); per-bag shard
+    filtering (reference FeatureShardConfiguration) lands with the multi-bag
+    reader."""
+    seen: set = set()
+    for rec in records:
+        for feat in rec.get("features", []):
+            seen.add(feature_key(feat["name"], feat.get("term") or ""))
+    shared = IndexMap.build(seen, add_intercept)
+    return {shard: shared for shard in shards}
+
+
+def build_index_maps_from_avro(
+    paths: Iterable[str],
+    feature_bags: Dict[str, List[str]],
+    add_intercept: bool = True,
+) -> Dict[str, IndexMap]:
+    """Scan TrainingExampleAvro files and build IndexMaps (see
+    build_index_maps_from_records; ``feature_bags`` keys = shard names)."""
+    from photon_ml_tpu.data.avro import read_directory
+
+    def all_records():
+        for path in paths:
+            yield from read_directory(path)
+
+    return build_index_maps_from_records(all_records(), list(feature_bags), add_intercept)

@@ -1,0 +1,173 @@
+"""Readers producing GameData from Avro / libsvm sources.
+
+Reference: photon-client .../data/avro/AvroDataReader.scala:54-475 (Avro ->
+rows with per-shard vectors via index maps), GameConverters.scala:173
+(rows -> GameDatum with id tags), io/deprecated/GLMSuite (libsvm for the
+legacy driver).
+
+Host-side, columnar output: the device only ever sees the dense design
+matrices and integer id columns that GameData carries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.schemas import INTERCEPT_NAME
+from photon_ml_tpu.game.data import GameData
+
+
+class EntityIndex:
+    """String entity ids -> dense int ids (grow-on-first-sight).
+
+    The reference keeps REIds as strings everywhere; on TPU the id columns
+    must be integers, so each id-tag column owns one of these.
+    """
+
+    def __init__(self, ids: Optional[Dict[str, int]] = None):
+        self._fwd: Dict[str, int] = dict(ids or {})
+        self._rev: Optional[List[str]] = None
+
+    def get_or_add(self, key: str) -> int:
+        i = self._fwd.get(key)
+        if i is None:
+            i = len(self._fwd)
+            self._fwd[key] = i
+            self._rev = None
+        return i
+
+    def get(self, key: str) -> int:
+        return self._fwd.get(key, -1)
+
+    def name_of(self, idx: int) -> Optional[str]:
+        if self._rev is None:
+            rev = [""] * len(self._fwd)
+            for k, i in self._fwd.items():
+                rev[i] = k
+            self._rev = rev
+        return self._rev[idx] if 0 <= idx < len(self._rev) else None
+
+    @property
+    def size(self) -> int:
+        return len(self._fwd)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self._fwd, f)
+
+    @classmethod
+    def load(cls, path: str) -> "EntityIndex":
+        with open(path) as f:
+            return cls(json.load(f))
+
+
+def read_game_data_avro(
+    paths: Iterable[str],
+    index_maps: Dict[str, IndexMap],
+    id_tag_names: Iterable[str] = (),
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    dtype=np.float32,
+    records: Optional[List[dict]] = None,
+) -> Tuple[GameData, Dict[str, EntityIndex]]:
+    """TrainingExampleAvro files -> GameData.
+
+    Every feature shard in ``index_maps`` gets a dense [n, d_shard] design
+    matrix (intercept column filled with 1 when the map has one).  ``id_tag``
+    values come from metadataMap[tag] (reference GameConverters id-tag
+    extraction); entity string ids pass through EntityIndex.
+    """
+    from photon_ml_tpu.data.avro import read_directory
+
+    if records is None:
+        records = []
+        for path in paths:
+            records.extend(read_directory(path))
+    n = len(records)
+
+    y = np.zeros(n, dtype)
+    offset = np.zeros(n, dtype)
+    weight = np.ones(n, dtype)
+    uids = np.empty(n, object)
+    mats = {shard: np.zeros((n, m.size), dtype) for shard, m in index_maps.items()}
+    id_tag_names = list(id_tag_names)
+    entity_indexes = entity_indexes or {}
+    for tag in id_tag_names:
+        entity_indexes.setdefault(tag, EntityIndex())
+    tags = {tag: np.full(n, -1, np.int64) for tag in id_tag_names}
+
+    for i, rec in enumerate(records):
+        uids[i] = rec.get("uid")
+        y[i] = rec["response"]
+        if rec.get("offset") is not None:
+            offset[i] = rec["offset"]
+        if rec.get("weight") is not None:
+            weight[i] = rec["weight"]
+        meta = rec.get("metadataMap") or {}
+        for tag in id_tag_names:
+            if tag in meta:
+                tags[tag][i] = entity_indexes[tag].get_or_add(str(meta[tag]))
+        for shard, m in index_maps.items():
+            x = mats[shard]
+            ii = m.intercept_index
+            if ii is not None:
+                x[i, ii] = 1.0
+            for feat in rec.get("features", []):
+                j = m.get_index(feat["name"], feat.get("term") or "")
+                if j >= 0:
+                    x[i, j] += feat["value"]
+
+    data = GameData(y=y, features=mats, offset=offset, weight=weight, id_tags=tags,
+                    uids=uids)
+    return data, entity_indexes
+
+
+def read_libsvm(path: str, num_features: Optional[int] = None,
+                add_intercept: bool = True, binary_labels_01: bool = True,
+                dtype=np.float32) -> Tuple[np.ndarray, np.ndarray, Optional[int]]:
+    """Read a libsvm file (e.g. a1a): returns (X dense, y, intercept_index).
+
+    Labels -1/+1 are mapped to 0/1 when ``binary_labels_01`` (the losses here
+    use {0,1}, core/losses.py).  Indices are 1-based in the format.
+    """
+    rows: List[List[Tuple[int, float]]] = []
+    labels: List[float] = []
+    max_idx = 0
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            row = []
+            for tok in parts[1:]:
+                k, _, v = tok.partition(":")
+                j = int(k)
+                max_idx = max(max_idx, j)
+                row.append((j, float(v)))
+            rows.append(row)
+    d = max_idx if num_features is None else num_features
+    if d < max_idx:
+        raise ValueError(
+            f"{path}: feature index {max_idx} exceeds num_features={num_features}")
+    extra = 1 if add_intercept else 0
+    x = np.zeros((len(rows), d + extra), dtype)
+    if add_intercept:
+        x[:, 0] = 1.0
+    for i, row in enumerate(rows):
+        for j, v in row:
+            x[i, j - 1 + extra] = v
+    y = np.asarray(labels, dtype)
+    if binary_labels_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y > 0).astype(dtype)
+    return x, y, (0 if add_intercept else None)
+
+
+def index_map_for_libsvm(dim: int, add_intercept: bool = True) -> IndexMap:
+    """Positional index map for libsvm features (feature name = column number)."""
+    keys = [feature_key(str(j + 1), "") for j in range(dim)]
+    return IndexMap.build(keys, add_intercept=add_intercept)

@@ -1,0 +1,100 @@
+"""Avro schemas matching the reference's wire/storage formats.
+
+Reference: photon-avro-schemas/src/main/avro/*.avsc — field names, types and
+union shapes mirror the reference so files written by either implementation
+are mutually readable:
+  - TrainingExampleAvro(uid?, response, label?, features[FeatureAvro],
+    weight?, offset?, metadataMap?)
+  - FeatureAvro(name, term, value)
+  - BayesianLinearModelAvro(modelId, modelClass?, modelType?,
+    means[NameTermValueAvro], variances?, lossFunction?)
+  - NameTermValueAvro(name, term, value)
+  - ScoringResultAvro(uid?, predictionScore, label?, metadataMap?)
+"""
+
+from __future__ import annotations
+
+NAMESPACE = "com.linkedin.photon.avro.generated"
+
+FEATURE = {
+    "type": "record",
+    "name": "FeatureAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+TRAINING_EXAMPLE = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long", "int"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "features", "type": {"type": "array", "items": FEATURE}},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+NAME_TERM_VALUE = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+BAYESIAN_LINEAR_MODEL = {
+    "type": "record",
+    "name": "BayesianLinearModelAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "means", "type": {"type": "array", "items": NAME_TERM_VALUE}},
+        {"name": "variances",
+         "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+         "default": None},
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+    ],
+}
+
+SCORING_RESULT = {
+    "type": "record",
+    "name": "ScoringResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long", "int"], "default": None},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+# Feature-summarization output (reference FeatureSummarizationResultAvro)
+FEATURE_SUMMARY = {
+    "type": "record",
+    "name": "FeatureSummarizationResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "metrics", "type": {"type": "map", "values": "double"}},
+    ],
+}
+
+# The reference encodes an intercept as name=(INTERCEPT), term=""
+# (Constants.scala INTERCEPT_KEY).
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""

@@ -1,0 +1,89 @@
+"""Seeded synthetic data generators.
+
+Reference: photon-test-utils .../SparkTestUtils.scala:86-120+ (seeded draws of
+dense/sparse features for binary/poisson/linear problems) and GameTestUtils
+(per-entity GAME datasets).  Used by tests and benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.game.data import GameData
+
+
+def generate_binary_classification(n: int, d: int, seed: int = 0, intercept: bool = True,
+                                   dtype=np.float32) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (x, y, w_true); logits = x @ w_true."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(dtype)
+    if intercept:
+        x[:, 0] = 1.0
+    w = (rng.normal(size=d) * 0.5).astype(dtype)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-(x @ w)))).astype(dtype)
+    return x, y, w
+
+
+def generate_poisson(n: int, d: int, seed: int = 0, dtype=np.float32
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    x = (rng.normal(size=(n, d)) * 0.3).astype(dtype)
+    w = (rng.normal(size=d) * 0.3).astype(dtype)
+    lam = np.exp(np.clip(x @ w, -10, 3))
+    y = rng.poisson(lam).astype(dtype)
+    return x, y, w
+
+
+def generate_linear(n: int, d: int, noise: float = 0.1, seed: int = 0, dtype=np.float32
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(dtype)
+    w = rng.normal(size=d).astype(dtype)
+    y = (x @ w + noise * rng.normal(size=n)).astype(dtype)
+    return x, y, w
+
+
+def generate_glmix(
+    n_users: int = 64,
+    per_user: int = 128,
+    d_global: int = 32,
+    d_user: int = 8,
+    n_items: Optional[int] = None,
+    d_item: int = 8,
+    seed: int = 0,
+    dtype=np.float32,
+) -> Tuple[GameData, Dict[str, np.ndarray]]:
+    """2- or 3-coordinate GLMix data (fixed + per-user [+ per-item]),
+    logistic response.  Returns (GameData, true parameter dict)."""
+    rng = np.random.default_rng(seed)
+    n = n_users * per_user
+    xg = rng.normal(size=(n, d_global)).astype(dtype)
+    xu = rng.normal(size=(n, d_user)).astype(dtype)
+    uid = np.repeat(np.arange(n_users, dtype=np.int64), per_user)
+    wg = (rng.normal(size=d_global) * 0.5).astype(dtype)
+    wu = (rng.normal(size=(n_users, d_user))).astype(dtype)
+    logits = xg @ wg + np.einsum("nd,nd->n", xu, wu[uid])
+
+    features = {"global": xg, "per_user": xu}
+    id_tags = {"userId": uid}
+    truth = {"wg": wg, "wu": wu}
+
+    if n_items is not None:
+        xi = rng.normal(size=(n, d_item)).astype(dtype)
+        iid = rng.integers(0, n_items, size=n).astype(np.int64)
+        wi = rng.normal(size=(n_items, d_item)).astype(dtype)
+        logits = logits + np.einsum("nd,nd->n", xi, wi[iid])
+        features["per_item"] = xi
+        id_tags["itemId"] = iid
+        truth["wi"] = wi
+
+    perm = rng.permutation(n)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(dtype)
+    data = GameData(
+        y=y[perm],
+        features={k: v[perm] for k, v in features.items()},
+        id_tags={k: v[perm] for k, v in id_tags.items()},
+    )
+    return data, truth

@@ -1,0 +1,70 @@
+"""Row-level input validation.
+
+Reference: photon-client .../data/DataValidators.scala:405 — per-task checks
+(finite features/offset/weight, binary labels for logistic/hinge, non-negative
+labels for poisson) with modes VALIDATE_FULL / VALIDATE_SAMPLE /
+VALIDATE_DISABLED (DataValidationType.scala:23).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List
+
+import numpy as np
+
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.types import TaskType
+
+
+class DataValidationType(enum.Enum):
+    VALIDATE_FULL = "validate_full"
+    VALIDATE_SAMPLE = "validate_sample"
+    VALIDATE_DISABLED = "validate_disabled"
+
+
+SAMPLE_FRACTION = 0.1
+
+
+def validate_game_data(data: GameData, task: TaskType,
+                       mode: DataValidationType = DataValidationType.VALIDATE_FULL,
+                       seed: int = 0) -> List[str]:
+    """Returns a list of human-readable violations (empty = valid).
+
+    Raises nothing itself — drivers decide (the reference throws on the first
+    failed check; CLI callers here do the same on a non-empty list).
+    """
+    if mode == DataValidationType.VALIDATE_DISABLED:
+        return []
+    n = data.num_samples
+    if mode == DataValidationType.VALIDATE_SAMPLE and n > 0:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(n, size=max(1, int(n * SAMPLE_FRACTION)), replace=False)
+    else:
+        idx = slice(None)
+
+    errors: List[str] = []
+    y = np.asarray(data.y)[idx]
+    offset = np.asarray(data.offset)[idx]
+    weight = np.asarray(data.weight)[idx]
+
+    if not np.all(np.isfinite(y)):
+        errors.append("labels contain non-finite values")
+    if not np.all(np.isfinite(offset)):
+        errors.append("offsets contain non-finite values")
+    if not np.all(np.isfinite(weight)):
+        errors.append("weights contain non-finite values")
+    if np.any(weight <= 0):
+        errors.append("weights must be positive (reference: zero/negative weight rows rejected)")
+
+    for shard, x in data.features.items():
+        if not np.all(np.isfinite(np.asarray(x)[idx])):
+            errors.append(f"feature shard {shard!r} contains non-finite values")
+
+    if task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        if not np.all(np.isin(y, (0.0, 1.0))):
+            errors.append(f"{task.value}: labels must be binary 0/1")
+    elif task == TaskType.POISSON_REGRESSION:
+        if np.any(y < 0):
+            errors.append("poisson_regression: labels must be non-negative")
+    return errors

@@ -31,6 +31,7 @@ class GameData:
     offset: Optional[np.ndarray] = None  # [n]
     weight: Optional[np.ndarray] = None  # [n]
     id_tags: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)  # tag -> [n] int64
+    uids: Optional[np.ndarray] = None  # [n] original unique sample ids (object)
 
     def __post_init__(self):
         n = len(self.y)
@@ -44,6 +45,8 @@ class GameData:
         for shard, x in self.features.items():
             if x.shape[0] != n:
                 raise ValueError(f"feature shard {shard!r} has {x.shape[0]} rows, expected {n}")
+        if self.uids is not None and len(self.uids) != n:
+            raise ValueError(f"uids has {len(self.uids)} rows, expected {n}")
         for tag, ids in self.id_tags.items():
             if len(ids) != n:
                 raise ValueError(f"id tag {tag!r} has {len(ids)} rows, expected {n}")

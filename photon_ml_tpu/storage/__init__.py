@@ -1,0 +1,6 @@
+from photon_ml_tpu.storage.model_io import (  # noqa: F401
+    save_game_model,
+    load_game_model,
+    save_glm_text,
+)
+from photon_ml_tpu.storage.checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

@@ -1,0 +1,174 @@
+"""GAME model persistence in the reference's on-disk layout.
+
+Reference: photon-client .../data/avro/ModelProcessingUtils.scala:59-625 —
+  <dir>/metadata.json                      (model-level metadata)
+  <dir>/fixed-effect/<coord>/coefficients.avro   (one BayesianLinearModelAvro)
+  <dir>/random-effect/<coord>/part-00000.avro    (one record per entity)
+  <dir>/random-effect/<coord>/id-index.json      (entity string id <-> int)
+Coefficients are stored as (name, term, value) triples remapped through the
+feature IndexMap per shard, so models survive re-indexing — the same contract
+the reference maintains (feature-index remapping, save:77-141 / load:143-265).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.data.schemas import BAYESIAN_LINEAR_MODEL
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.types import TaskType
+
+FORMAT_VERSION = 1
+
+
+def _coeff_to_record(model_id: str, means: np.ndarray, variances: Optional[np.ndarray],
+                     index_map: IndexMap, loss_name: str) -> dict:
+    triples = []
+    var_triples = []
+    for j in range(len(means)):
+        v = float(means[j])
+        if v == 0.0:
+            continue  # sparse storage, like the reference's NTV lists
+        name, term = index_map.get_feature_name(j)
+        triples.append({"name": name, "term": term, "value": v})
+        if variances is not None:
+            var_triples.append({"name": name, "term": term, "value": float(variances[j])})
+    return {
+        "modelId": model_id,
+        "modelClass": "photon_ml_tpu.GLMModel",
+        "means": triples,
+        "variances": var_triples if variances is not None else None,
+        "lossFunction": loss_name,
+    }
+
+
+def _record_to_coeff(rec: dict, index_map: IndexMap) -> Coefficients:
+    means = np.zeros(index_map.size, np.float64)
+    for ntv in rec["means"]:
+        j = index_map.get_index(ntv["name"], ntv.get("term") or "")
+        if j >= 0:
+            means[j] = ntv["value"]
+    variances = None
+    if rec.get("variances"):
+        variances = np.zeros(index_map.size, np.float64)
+        for ntv in rec["variances"]:
+            j = index_map.get_index(ntv["name"], ntv.get("term") or "")
+            if j >= 0:
+                variances[j] = ntv["value"]
+    return Coefficients(means=means, variances=variances)
+
+
+def save_game_model(
+    model: GameModel,
+    out_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    task: TaskType = TaskType.LOGISTIC_REGRESSION,
+) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    meta = {"version": FORMAT_VERSION, "task": task.value, "coordinates": {}}
+    entity_indexes = entity_indexes or {}
+
+    for cid, m in model.models.items():
+        if isinstance(m, FixedEffectModel):
+            cdir = os.path.join(out_dir, "fixed-effect", cid)
+            os.makedirs(cdir, exist_ok=True)
+            imap = index_maps[m.feature_shard]
+            rec = _coeff_to_record(cid, m.coefficients.means, m.coefficients.variances,
+                                   imap, m.task.value)
+            avro_io.write_container(os.path.join(cdir, "coefficients.avro"),
+                                    BAYESIAN_LINEAR_MODEL, [rec])
+            meta["coordinates"][cid] = {"type": "fixed", "feature_shard": m.feature_shard}
+        elif isinstance(m, RandomEffectModel):
+            cdir = os.path.join(out_dir, "random-effect", cid)
+            os.makedirs(cdir, exist_ok=True)
+            imap = index_maps[m.feature_shard]
+            eidx = entity_indexes.get(m.random_effect_type)
+
+            def records():
+                for eid, slot in sorted(m.slot_of.items()):
+                    name = eidx.name_of(eid) if eidx is not None else None
+                    var = m.variances[slot] if m.variances is not None else None
+                    yield _coeff_to_record(
+                        name if name is not None else str(eid),
+                        m.w_stack[slot], var, imap, m.task.value)
+
+            avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
+                                    BAYESIAN_LINEAR_MODEL, records())
+            id_map = {str(eid): (eidx.name_of(eid) if eidx is not None else str(eid))
+                      for eid in m.slot_of}
+            with open(os.path.join(cdir, "id-index.json"), "w") as f:
+                json.dump(id_map, f)
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "feature_shard": m.feature_shard,
+                "random_effect_type": m.random_effect_type,
+            }
+        else:
+            raise TypeError(f"cannot save model type {type(m)!r}")
+
+    with open(os.path.join(out_dir, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(
+    model_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+) -> Tuple[GameModel, TaskType]:
+    with open(os.path.join(model_dir, "metadata.json")) as f:
+        meta = json.load(f)
+    task = TaskType(meta["task"])
+    entity_indexes = entity_indexes or {}
+    models: Dict[str, object] = {}
+
+    for cid, info in meta["coordinates"].items():
+        shard = info["feature_shard"]
+        imap = index_maps[shard]
+        if info["type"] == "fixed":
+            path = os.path.join(model_dir, "fixed-effect", cid, "coefficients.avro")
+            rec = next(iter(avro_io.read_container(path)))
+            models[cid] = FixedEffectModel(
+                coefficients=_record_to_coeff(rec, imap), feature_shard=shard, task=task)
+        else:
+            cdir = os.path.join(model_dir, "random-effect", cid)
+            re_type = info["random_effect_type"]
+            eidx = entity_indexes.get(re_type)
+            recs = list(avro_io.read_directory(cdir))
+            w = np.zeros((len(recs), imap.size), np.float64)
+            slot_of: Dict[int, int] = {}
+            any_var = any(r.get("variances") for r in recs)
+            variances = np.zeros((len(recs), imap.size), np.float64) if any_var else None
+            for slot, rec in enumerate(recs):
+                c = _record_to_coeff(rec, imap)
+                w[slot] = c.means
+                if variances is not None and c.variances is not None:
+                    variances[slot] = c.variances
+                if eidx is not None:
+                    eid = eidx.get_or_add(rec["modelId"])
+                else:
+                    eid = int(rec["modelId"])
+                slot_of[eid] = slot
+            models[cid] = RandomEffectModel(
+                w_stack=w, slot_of=slot_of, random_effect_type=re_type,
+                feature_shard=shard, task=task, variances=variances)
+    return GameModel(models=models), task
+
+
+def save_glm_text(model: FixedEffectModel, index_map: IndexMap, path: str) -> None:
+    """Human-readable text model (reference GLMSuite.writeModelsToText)."""
+    with open(path, "w") as f:
+        means = model.coefficients.means
+        for j in np.argsort(-np.abs(means)):
+            if means[j] == 0.0:
+                continue
+            name, term = index_map.get_feature_name(int(j))
+            f.write(f"{name}\t{term}\t{means[j]:.17g}\n")

@@ -1,0 +1,13 @@
+from photon_ml_tpu.tune.search import (  # noqa: F401
+    RandomSearch,
+    GaussianProcessSearch,
+    SearchDomain,
+)
+from photon_ml_tpu.tune.gp import GaussianProcess  # noqa: F401
+from photon_ml_tpu.tune.kernels import Matern52, RBF  # noqa: F401
+from photon_ml_tpu.tune.acquisition import expected_improvement, confidence_bound  # noqa: F401
+from photon_ml_tpu.tune.slice_sampler import slice_sample  # noqa: F401
+from photon_ml_tpu.tune.game_tuning import (  # noqa: F401
+    GameEstimatorEvaluationFunction,
+    tune_game_model,
+)

@@ -1,0 +1,24 @@
+"""Acquisition criteria for Bayesian search.
+
+Reference: photon-lib .../hyperparameter/criteria/ExpectedImprovement.scala:58
+and ConfidenceBound.scala:48.  Convention here: MINIMIZATION — the search
+negates metrics where larger is better (as the reference's evaluation
+function does with isOptMax).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.stats import norm
+
+
+def expected_improvement(mu: np.ndarray, sigma: np.ndarray, best: float) -> np.ndarray:
+    """EI for minimization: E[max(best - f, 0)]."""
+    sigma = np.maximum(sigma, 1e-12)
+    z = (best - mu) / sigma
+    return (best - mu) * norm.cdf(z) + sigma * norm.pdf(z)
+
+
+def confidence_bound(mu: np.ndarray, sigma: np.ndarray, beta: float = 2.0) -> np.ndarray:
+    """Lower confidence bound, returned NEGATED so argmax = most promising."""
+    return -(mu - beta * sigma)

@@ -1,0 +1,94 @@
+"""GAME hyperparameter tuning glue: vectorize per-coordinate regularization
+weights and retrain through the estimator.
+
+Reference: photon-client .../estimators/GameEstimatorEvaluationFunction.scala:40-244
+(GameOptimizationConfiguration <-> log-scale DenseVector; apply() retrains via
+estimator.fit) and GameTrainingDriver.runHyperparameterTuning:643-674
+(HyperparameterTuningMode RANDOM | BAYESIAN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.evaluation.evaluator import EvaluationSuite
+from photon_ml_tpu.game.config import FixedEffectConfig, GameConfig, RandomEffectConfig
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.estimator import GameEstimator, GameFitResult
+from photon_ml_tpu.tune.search import DomainDim, GaussianProcessSearch, RandomSearch, SearchDomain
+
+
+def _with_l2(cfg, l2: float):
+    reg = Regularization(l1=cfg.reg.l1, l2=l2)
+    return dataclasses.replace(cfg, reg=reg)
+
+
+class GameEstimatorEvaluationFunction:
+    """params vector (one L2 weight per coordinate, log-tuned) -> validation
+    metric via a full GAME retrain (the reference retrains per tuning
+    iteration too, GameEstimatorEvaluationFunction.apply)."""
+
+    def __init__(self, estimator: GameEstimator, base_config: GameConfig,
+                 data: GameData, validation_data: GameData, seed: int = 0):
+        if estimator.validation_suite is None:
+            raise ValueError("tuning needs an estimator with a validation suite")
+        self.estimator = estimator
+        self.base_config = base_config
+        self.data = data
+        self.validation_data = validation_data
+        self.seed = seed
+        self.coordinate_ids = list(base_config.coordinates)
+        self.results: List[GameFitResult] = []
+
+    def config_for(self, params: np.ndarray) -> GameConfig:
+        coords = {
+            cid: _with_l2(self.base_config.coordinates[cid], float(params[i]))
+            for i, cid in enumerate(self.coordinate_ids)
+        }
+        return dataclasses.replace(self.base_config, coordinates=coords)
+
+    def __call__(self, params: np.ndarray) -> float:
+        config = self.config_for(params)
+        res = self.estimator.fit(self.data, [config],
+                                 validation_data=self.validation_data, seed=self.seed)[0]
+        self.results.append(res)
+        return res.evaluation.primary
+
+    def vectorize(self, config: GameConfig) -> np.ndarray:
+        """Config -> params vector (reference configurationToVector)."""
+        return np.asarray([config.coordinates[cid].reg.l2 for cid in self.coordinate_ids])
+
+
+def tune_game_model(
+    estimator: GameEstimator,
+    base_config: GameConfig,
+    data: GameData,
+    validation_data: GameData,
+    n_iterations: int = 10,
+    mode: str = "bayesian",  # reference HyperparameterTuningMode {RANDOM, BAYESIAN}
+    l2_range: Tuple[float, float] = (1e-4, 1e4),
+    seed: int = 0,
+) -> Tuple[GameFitResult, "RandomSearch"]:
+    """Search per-coordinate L2 weights; returns (best fit, search object)."""
+    fn = GameEstimatorEvaluationFunction(estimator, base_config, data, validation_data, seed)
+    domain = SearchDomain([
+        DomainDim(name=f"l2:{cid}", low=l2_range[0], high=l2_range[1], log_scale=True)
+        for cid in fn.coordinate_ids
+    ])
+    minimize = not estimator.validation_suite.primary.larger_is_better
+    cls = GaussianProcessSearch if mode == "bayesian" else RandomSearch
+    search = cls(domain, minimize=minimize, seed=seed)
+    # prior: the base config's own weights, evaluated first (warm prior,
+    # reference ShrinkSearchRange / prior JSON defaults)
+    prior_params = fn.vectorize(base_config)
+    if np.all(prior_params > 0):
+        search.find(fn, n=n_iterations, priors=[(prior_params, fn(prior_params))])
+    else:
+        search.find(fn, n=n_iterations)
+
+    best = estimator.best(fn.results)
+    return best, search

@@ -1,0 +1,103 @@
+"""Gaussian-process regression with slice-sampled kernel hyperparameters.
+
+Reference: photon-lib .../hyperparameter/estimators/GaussianProcessEstimator.scala:54-142 —
+fit: slice-sample (amplitude, noise, lengthscale) in log space from the GP
+posterior given the observations, keep a handful of kernel samples, and
+predict by averaging the per-sample posteriors (MCMC marginalization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+from scipy.linalg import cho_factor, cho_solve
+
+from photon_ml_tpu.tune.kernels import Kernel, Matern52
+from photon_ml_tpu.tune.slice_sampler import slice_sample
+
+
+def _kernel_from_log_params(base: Kernel, theta: np.ndarray, d: int) -> Kernel:
+    amplitude = float(np.exp(theta[0]))
+    noise = float(np.exp(theta[1]))
+    lengthscale = np.exp(theta[2: 2 + d])
+    return base.with_params(amplitude, noise, lengthscale)
+
+
+@dataclasses.dataclass
+class GaussianProcess:
+    """GP regressor whose kernel parameters are marginalized by slice sampling."""
+
+    base_kernel: Kernel = dataclasses.field(default_factory=Matern52)
+    n_kernel_samples: int = 3
+    burn_in: int = 10
+    normalize_y: bool = True
+
+    _x: Optional[np.ndarray] = None
+    _y_mean: float = 0.0
+    _y_std: float = 1.0
+    _posteriors: List[Tuple[Kernel, np.ndarray, object]] = dataclasses.field(default_factory=list)
+
+    def fit(self, x: np.ndarray, y: np.ndarray, seed: int = 0) -> "GaussianProcess":
+        x = np.asarray(x, float)
+        y = np.asarray(y, float)
+        n, d = x.shape
+        self._x = x
+        if self.normalize_y and len(y) > 1 and y.std() > 0:
+            self._y_mean, self._y_std = float(y.mean()), float(y.std())
+        else:
+            self._y_mean, self._y_std = float(np.mean(y)), 1.0
+        yn = (y - self._y_mean) / self._y_std
+
+        rng = np.random.default_rng(seed)
+
+        def log_density(theta: np.ndarray) -> float:
+            # log posterior = log likelihood + weak log-normal prior on params
+            if np.any(np.abs(theta) > 10.0):
+                return -np.inf
+            kern = _kernel_from_log_params(self.base_kernel, theta, d)
+            return kern.log_likelihood(x, yn) - 0.5 * float(theta @ theta) / 9.0
+
+        theta0 = np.zeros(2 + d)
+        theta0[1] = np.log(1e-2)  # start with small noise
+        samples = slice_sample(log_density, theta0, self.n_kernel_samples, rng,
+                               burn_in=self.burn_in)
+
+        self._posteriors = []
+        for theta in samples:
+            kern = _kernel_from_log_params(self.base_kernel, theta, d)
+            k = kern(x, x) + kern.noise * np.eye(n)
+            try:
+                c = cho_factor(k)
+            except np.linalg.LinAlgError:
+                continue
+            alpha = cho_solve(c, yn)
+            self._posteriors.append((kern, alpha, c))
+        if not self._posteriors:
+            # fall back to the prior kernel with jitter
+            kern = self.base_kernel
+            k = kern(x, x) + (kern.noise + 1e-6) * np.eye(n)
+            c = cho_factor(k)
+            self._posteriors.append((kern, cho_solve(c, yn), c))
+        return self
+
+    def predict(self, x_new: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior (mean, std), averaged over kernel samples
+        (reference GaussianProcessEstimator.predict)."""
+        assert self._x is not None, "fit first"
+        x_new = np.asarray(x_new, float)
+        means, variances = [], []
+        for kern, alpha, c in self._posteriors:
+            ks = kern(x_new, self._x)
+            mu = ks @ alpha
+            v = cho_solve(c, ks.T)
+            var = np.maximum(kern.amplitude - np.sum(ks * v.T, axis=1), 1e-12)
+            means.append(mu)
+            variances.append(var)
+        means = np.asarray(means)
+        variances = np.asarray(variances)
+        # moment-match the mixture
+        mu = means.mean(0)
+        var = variances.mean(0) + means.var(0)
+        return mu * self._y_std + self._y_mean, np.sqrt(var) * self._y_std

@@ -1,0 +1,75 @@
+"""GP covariance kernels.
+
+Reference: photon-lib .../hyperparameter/estimators/kernels/StationaryKernel.scala:35-177,
+Matern52.scala, RBF.scala — stationary kernels with amplitude, noise, and ARD
+lengthscales, plus the log-likelihood used for kernel-parameter sampling.
+
+Small-matrix (n_obs <= a few hundred) host-side numpy: the GP tuner drives
+full GAME retrains (each costing seconds of TPU time), so the kernel algebra
+is never the bottleneck; float64 numpy keeps Cholesky stable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+from scipy.linalg import cho_factor, cho_solve
+
+
+def _scaled_sqdist(x1: np.ndarray, x2: np.ndarray, lengthscale: np.ndarray) -> np.ndarray:
+    a = x1 / lengthscale
+    b = x2 / lengthscale
+    return np.maximum(
+        np.sum(a * a, 1)[:, None] + np.sum(b * b, 1)[None, :] - 2.0 * a @ b.T, 0.0
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Kernel:
+    """amplitude * k(r) + noise on the diagonal (reference StationaryKernel)."""
+
+    amplitude: float = 1.0
+    noise: float = 1e-4
+    lengthscale: np.ndarray = dataclasses.field(default_factory=lambda: np.ones(1))
+
+    def _k(self, sq: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
+        ls = np.broadcast_to(np.asarray(self.lengthscale, float), (x1.shape[1],))
+        return self.amplitude * self._k(_scaled_sqdist(x1, x2, ls))
+
+    def with_params(self, amplitude: float, noise: float, lengthscale: np.ndarray) -> "Kernel":
+        return dataclasses.replace(self, amplitude=amplitude, noise=noise,
+                                   lengthscale=np.asarray(lengthscale, float))
+
+    def log_likelihood(self, x: np.ndarray, y: np.ndarray) -> float:
+        """GP marginal log-likelihood (reference StationaryKernel.logLikelihood)."""
+        n = len(x)
+        k = self(x, x) + self.noise * np.eye(n)
+        try:
+            c, lower = cho_factor(k)
+        except np.linalg.LinAlgError:
+            return -np.inf
+        alpha = cho_solve((c, lower), y)
+        logdet = 2.0 * np.sum(np.log(np.diagonal(c)))
+        return float(-0.5 * y @ alpha - 0.5 * logdet - 0.5 * n * np.log(2 * np.pi))
+
+
+@dataclasses.dataclass(frozen=True)
+class RBF(Kernel):
+    """exp(-r^2 / 2) (reference RBF.scala)."""
+
+    def _k(self, sq: np.ndarray) -> np.ndarray:
+        return np.exp(-0.5 * sq)
+
+
+@dataclasses.dataclass(frozen=True)
+class Matern52(Kernel):
+    """(1 + sqrt(5) r + 5 r^2 / 3) exp(-sqrt(5) r) (reference Matern52.scala)."""
+
+    def _k(self, sq: np.ndarray) -> np.ndarray:
+        r = np.sqrt(sq)
+        s5r = np.sqrt(5.0) * r
+        return (1.0 + s5r + 5.0 * sq / 3.0) * np.exp(-s5r)

@@ -1,0 +1,124 @@
+"""Hyperparameter search: Sobol quasi-random + GP Bayesian optimization.
+
+Reference: photon-lib .../hyperparameter/search/RandomSearch.scala:46-124
+(Sobol sequence candidates; find/findWithPriors loop) and
+GaussianProcessSearch.scala:52-123 (fit GP on observations, draw 250 Sobol
+candidates, pick the best Expected Improvement, evaluate, repeat).
+
+``SearchDomain`` handles the reference's VectorRescaling (hyperparameters live
+in [0,1]^d for the search; linear or log transform to the real range).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_ml_tpu.tune.acquisition import expected_improvement
+from photon_ml_tpu.tune.gp import GaussianProcess
+
+EvalFn = Callable[[np.ndarray], float]  # real-space params -> metric
+
+
+@dataclasses.dataclass(frozen=True)
+class DomainDim:
+    name: str
+    low: float
+    high: float
+    log_scale: bool = False  # reg weights etc. tune in log space
+
+
+@dataclasses.dataclass
+class SearchDomain:
+    """[0,1]^d <-> real-space transform (reference VectorRescaling.scala:150)."""
+
+    dims: List[DomainDim]
+
+    @property
+    def d(self) -> int:
+        return len(self.dims)
+
+    def to_real(self, unit: np.ndarray) -> np.ndarray:
+        out = np.empty_like(unit, float)
+        for j, dim in enumerate(self.dims):
+            u = unit[..., j]
+            if dim.log_scale:
+                lo, hi = np.log(dim.low), np.log(dim.high)
+                out[..., j] = np.exp(lo + u * (hi - lo))
+            else:
+                out[..., j] = dim.low + u * (dim.high - dim.low)
+        return out
+
+    def to_unit(self, real: np.ndarray) -> np.ndarray:
+        out = np.empty_like(real, float)
+        for j, dim in enumerate(self.dims):
+            r = real[..., j]
+            if dim.log_scale:
+                lo, hi = np.log(dim.low), np.log(dim.high)
+                out[..., j] = (np.log(r) - lo) / (hi - lo)
+            else:
+                out[..., j] = (r - dim.low) / (dim.high - dim.low)
+        return np.clip(out, 0.0, 1.0)
+
+
+@dataclasses.dataclass
+class Observation:
+    params: np.ndarray  # real space
+    value: float  # metric, minimization orientation
+
+
+class RandomSearch:
+    """Sobol quasi-random search (reference RandomSearch.scala:46-124)."""
+
+    def __init__(self, domain: SearchDomain, minimize: bool = True, seed: int = 0):
+        self.domain = domain
+        self.minimize = minimize
+        self.seed = seed
+        self._sobol = qmc.Sobol(domain.d, scramble=True, seed=seed)
+        self.observations: List[Observation] = []
+
+    def _record(self, params: np.ndarray, raw_value: float) -> None:
+        v = raw_value if self.minimize else -raw_value
+        self.observations.append(Observation(params=params, value=v))
+
+    def next_candidate(self) -> np.ndarray:
+        return self.domain.to_real(self._sobol.random(1)[0])
+
+    def find(self, evaluate: EvalFn, n: int,
+             priors: Optional[Sequence[Tuple[np.ndarray, float]]] = None
+             ) -> Tuple[np.ndarray, float]:
+        """Evaluate n candidates; returns (best params, best raw value).
+        ``priors``: previous observations to seed the search
+        (reference findWithPriors:61-93)."""
+        for p, v in priors or []:
+            self._record(np.asarray(p, float), v)
+        for _ in range(n):
+            params = self.next_candidate()
+            self._record(params, evaluate(params))
+        best = min(self.observations, key=lambda o: o.value)
+        return best.params, (best.value if self.minimize else -best.value)
+
+
+class GaussianProcessSearch(RandomSearch):
+    """Bayesian search: GP posterior + Expected Improvement over Sobol
+    candidates (reference GaussianProcessSearch.scala:52-123)."""
+
+    def __init__(self, domain: SearchDomain, minimize: bool = True, seed: int = 0,
+                 n_candidates: int = 250, n_initial: int = 3):
+        super().__init__(domain, minimize, seed)
+        self.n_candidates = n_candidates  # reference draws 250
+        self.n_initial = n_initial
+
+    def next_candidate(self) -> np.ndarray:
+        if len(self.observations) < self.n_initial:
+            return super().next_candidate()
+        x = self.domain.to_unit(np.stack([o.params for o in self.observations]))
+        y = np.asarray([o.value for o in self.observations])
+        gp = GaussianProcess().fit(x, y, seed=self.seed + len(self.observations))
+        cand = self._sobol.random(self.n_candidates)
+        mu, sigma = gp.predict(cand)
+        ei = expected_improvement(mu, sigma, best=float(y.min()))
+        return self.domain.to_real(cand[int(np.argmax(ei))])

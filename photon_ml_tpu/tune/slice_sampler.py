@@ -1,0 +1,66 @@
+"""Univariate stepping-out slice sampler, applied coordinate-wise.
+
+Reference: photon-lib .../hyperparameter/SliceSampler.scala:52-207 (Neal 2003
+slice sampling with stepping-out and shrinkage, used to sample GP kernel
+hyperparameters from their posterior).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+LogDensity = Callable[[np.ndarray], float]
+
+
+def _slice_1d(log_density: LogDensity, x: np.ndarray, dim: int, rng: np.random.Generator,
+              step: float = 1.0, max_steps: int = 32) -> np.ndarray:
+    x = x.copy()
+    f0 = log_density(x)
+    log_u = f0 + np.log(rng.random() + 1e-300)
+
+    # stepping out
+    left = x[dim] - step * rng.random()
+    right = left + step
+    j = int(rng.integers(0, max_steps))
+    k = max_steps - 1 - j
+    xt = x.copy()
+    while j > 0:
+        xt[dim] = left
+        if log_density(xt) <= log_u:
+            break
+        left -= step
+        j -= 1
+    while k > 0:
+        xt[dim] = right
+        if log_density(xt) <= log_u:
+            break
+        right += step
+        k -= 1
+
+    # shrinkage
+    for _ in range(100):
+        xt[dim] = left + rng.random() * (right - left)
+        if log_density(xt) > log_u:
+            return xt
+        if xt[dim] < x[dim]:
+            left = xt[dim]
+        else:
+            right = xt[dim]
+    return x  # shrunk to nothing: keep the current point
+
+
+def slice_sample(log_density: LogDensity, x0: np.ndarray, n_samples: int,
+                 rng: np.random.Generator, step: float = 1.0,
+                 burn_in: int = 10) -> np.ndarray:
+    """Draw n_samples points (coordinate-wise sweeps) from exp(log_density)."""
+    x = np.asarray(x0, float).copy()
+    out = np.empty((n_samples, len(x)))
+    total = burn_in + n_samples
+    for i in range(total):
+        for dim in range(len(x)):
+            x = _slice_1d(log_density, x, dim, rng, step=step)
+        if i >= burn_in:
+            out[i - burn_in] = x
+    return out

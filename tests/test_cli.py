@@ -1,0 +1,135 @@
+"""End-to-end CLI driver tests (reference analog: GameTrainingDriverIntegTest,
+GameScoringDriverIntegTest — full pipeline runs on small fixtures)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.config_grammar import expand_game_configs, parse_coordinate_spec
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+from photon_ml_tpu.game.config import FixedEffectConfig, RandomEffectConfig
+from photon_ml_tpu.types import TaskType
+
+
+def _write_fixture(path, n=300, seed=0):
+    """GLMix-ish avro fixture: global features f0/f1/f2 + per-user structure."""
+    rng = np.random.default_rng(seed)
+    n_users = 6
+    uw = rng.normal(size=(n_users, 1)) * 1.5
+    gw = np.asarray([0.8, -1.2, 0.5])
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        xg = rng.normal(size=3)
+        xu = rng.normal(size=1)
+        logit = xg @ gw + xu @ uw[u]
+        y = float(rng.random() < 1.0 / (1.0 + np.exp(-logit)))
+        feats = [{"name": f"g{j}", "term": "", "value": float(xg[j])} for j in range(3)]
+        feats.append({"name": "ux", "term": "", "value": float(xu[0])})
+        records.append({"uid": i, "response": y, "label": None, "features": feats,
+                        "weight": None, "offset": None,
+                        "metadataMap": {"userId": f"user{u}"}})
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+
+
+def test_config_grammar():
+    spec = parse_coordinate_spec(
+        "name=global,feature.shard=s,optimizer=TRON,max.iter=7,tolerance=1e-5,"
+        "reg.weights=0.1|1|10,reg.type=L2")
+    assert spec.name == "global" and len(spec.reg_weights) == 3
+    assert isinstance(spec.template, FixedEffectConfig)
+    assert spec.template.solver.max_iters == 7
+
+    spec_re = parse_coordinate_spec(
+        "name=user,random.effect.type=userId,feature.shard=u,"
+        "active.data.lower.bound=2,active.data.upper.bound=100,reg.weights=1")
+    assert isinstance(spec_re.template, RandomEffectConfig)
+    assert spec_re.template.active_cap == 100
+    assert spec_re.template.min_active_samples == 2
+
+    configs = expand_game_configs([spec, spec_re], TaskType.LOGISTIC_REGRESSION, 2)
+    assert len(configs) == 3  # 3 weights x 1 weight
+    assert configs[0].coordinates["global"].reg.l2 == 0.1
+    assert configs[0].num_outer_iterations == 2
+
+    with pytest.raises(ValueError, match="unknown"):
+        parse_coordinate_spec("name=x,feature.shard=s,bogus.key=1")
+    with pytest.raises(ValueError, match="name"):
+        parse_coordinate_spec("feature.shard=s")
+
+
+def test_train_score_pipeline(tmp_path):
+    from photon_ml_tpu.cli import score as score_cli
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    val_path = str(tmp_path / "val.avro")
+    _write_fixture(train_path, n=400, seed=1)
+    _write_fixture(val_path, n=150, seed=2)
+    out = str(tmp_path / "out")
+
+    rc = train_cli.run([
+        "--train-data", train_path,
+        "--validation-data", val_path,
+        "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1|10",
+        "--coordinate", "name=user,random.effect.type=userId,feature.shard=all,reg.weights=1",
+        "--id-tags", "userId",
+        "--evaluators", "auc,logistic_loss",
+        "--coordinate-descent-iterations", "2",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["train_samples"] == 400
+    assert summary["validation"]["auc"] > 0.6
+    assert os.path.isdir(os.path.join(out, "best", "fixed-effect", "fixed"))
+    assert os.path.isdir(os.path.join(out, "best", "random-effect", "user"))
+
+    score_out = str(tmp_path / "scores")
+    rc = score_cli.run([
+        "--data", val_path,
+        "--model-dir", out,
+        "--output-dir", score_out,
+        "--evaluators", "auc",
+    ])
+    assert rc == 0
+    metrics = json.load(open(os.path.join(score_out, "metrics.json")))
+    assert abs(metrics["auc"] - summary["validation"]["auc"]) < 0.15
+    scores = list(avro_io.read_container(os.path.join(score_out, "scores.avro")))
+    assert len(scores) == 150
+    assert all(np.isfinite(s["predictionScore"]) for s in scores)
+
+
+def test_index_driver(tmp_path):
+    from photon_ml_tpu.cli import index as index_cli
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=50)
+    out = str(tmp_path / "idx")
+    rc = index_cli.run(["--data", train_path, "--feature-shards", "all",
+                        "--output-dir", out])
+    assert rc == 0
+    m = IndexMap.load(os.path.join(out, "all.idx"))
+    assert m.size == 5  # intercept + g0,g1,g2,ux
+    assert m.intercept_index == 0
+
+
+def test_train_rejects_invalid_data(tmp_path):
+    from photon_ml_tpu.cli import train as train_cli
+
+    path = str(tmp_path / "bad.avro")
+    records = [{"uid": 0, "response": 0.5, "label": None,
+                "features": [{"name": "f", "term": "", "value": 1.0}],
+                "weight": None, "offset": None, "metadataMap": None}]
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+    rc = train_cli.run([
+        "--train-data", path, "--feature-shards", "s",
+        "--coordinate", "name=fixed,feature.shard=s,reg.weights=1",
+        "--output-dir", str(tmp_path / "o"),
+    ])
+    assert rc == 1  # 0.5 label fails logistic validation

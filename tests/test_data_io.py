@@ -1,0 +1,235 @@
+"""Data/IO tests: Avro codec roundtrips, index maps, readers, model
+persistence, validation, checkpoints."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data import (
+    DataValidationType,
+    EntityIndex,
+    IndexMap,
+    feature_key,
+    generate_glmix,
+    index_map_for_libsvm,
+    read_game_data_avro,
+    read_libsvm,
+    validate_game_data,
+)
+from photon_ml_tpu.data.schemas import (
+    BAYESIAN_LINEAR_MODEL,
+    INTERCEPT_NAME,
+    TRAINING_EXAMPLE,
+)
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.storage import load_game_model, save_game_model, save_glm_text
+from photon_ml_tpu.storage.checkpoint import load_checkpoint, save_checkpoint
+from photon_ml_tpu.types import TaskType
+
+
+def _example(uid, y, feats, weight=None, offset=None, meta=None):
+    return {
+        "uid": uid, "response": y, "label": None,
+        "features": [{"name": n, "term": t, "value": v} for n, t, v in feats],
+        "weight": weight, "offset": offset, "metadataMap": meta,
+    }
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_container_roundtrip(tmp_path, codec):
+    path = str(tmp_path / "data.avro")
+    records = [
+        _example("a", 1.0, [("f1", "", 0.5), ("f2", "t", -2.0)], weight=2.0,
+                 meta={"userId": "u1"}),
+        _example(17, 0.0, [], offset=0.25),
+        _example(None, 1.0, [("f1", "", 1.0)]),
+    ]
+    n = avro_io.write_container(path, TRAINING_EXAMPLE, records, codec=codec)
+    assert n == 3
+    back = list(avro_io.read_container(path))
+    assert back == records
+    assert avro_io.read_schema(path)["name"] == "TrainingExampleAvro"
+
+
+def test_avro_many_records_blocks(tmp_path):
+    path = str(tmp_path / "big.avro")
+    records = [_example(i, float(i % 2), [("f", "", float(i))]) for i in range(10000)]
+    avro_io.write_container(path, TRAINING_EXAMPLE, records, block_records=512)
+    back = list(avro_io.read_container(path))
+    assert len(back) == 10000
+    assert back[9999]["features"][0]["value"] == 9999.0
+
+
+def test_avro_corrupt_sync_detected(tmp_path):
+    path = str(tmp_path / "x.avro")
+    avro_io.write_container(path, TRAINING_EXAMPLE, [_example(1, 1.0, [])], codec="null")
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF  # flip a sync byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="sync"):
+        list(avro_io.read_container(path))
+
+
+def test_index_map_build_save_load(tmp_path):
+    m = IndexMap.from_features([("b", ""), ("a", "t1"), ("a", "")], add_intercept=True)
+    assert m.intercept_index == 0
+    assert m.size == 4
+    assert m.get_index("a", "t1") >= 0
+    assert m.get_index("nope") == -1
+    name, term = m.get_feature_name(m.get_index("a", "t1"))
+    assert (name, term) == ("a", "t1")
+    path = str(tmp_path / "idx.bin")
+    m.save(path)
+    m2 = IndexMap.load(path)
+    assert dict(m2.items()) == dict(m.items())
+
+
+def test_read_game_data_avro(tmp_path):
+    path = str(tmp_path / "train.avro")
+    records = [
+        _example("1", 1.0, [("x", "", 2.0)], meta={"userId": "alice"}),
+        _example("2", 0.0, [("y", "", 3.0)], weight=2.0, meta={"userId": "bob"}),
+        _example("3", 1.0, [("x", "", -1.0), ("y", "", 1.0)], offset=0.5,
+                 meta={"userId": "alice"}),
+    ]
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+    imap = IndexMap.from_features([("x", ""), ("y", "")])
+    data, eidx = read_game_data_avro([path], {"s": imap}, id_tag_names=["userId"])
+    assert data.num_samples == 3
+    x = data.features["s"]
+    assert x[:, imap.intercept_index].tolist() == [1.0, 1.0, 1.0]
+    assert x[0, imap.get_index("x")] == 2.0
+    assert x[2, imap.get_index("y")] == 1.0
+    assert data.weight[1] == 2.0 and data.offset[2] == 0.5
+    # same user -> same entity id
+    uids = data.id_tags["userId"]
+    assert uids[0] == uids[2] != uids[1]
+    assert eidx["userId"].name_of(int(uids[1])) == "bob"
+
+
+def test_read_libsvm(tmp_path):
+    path = str(tmp_path / "a1a.t")
+    with open(path, "w") as f:
+        f.write("-1 3:1 11:0.5\n+1 1:2\n")
+    x, y, ii = read_libsvm(path, num_features=12)
+    assert x.shape == (2, 13) and ii == 0
+    assert y.tolist() == [0.0, 1.0]
+    assert x[0, 3] == 1.0 and x[0, 11] == 0.5 and x[1, 1] == 2.0
+    assert np.all(x[:, 0] == 1.0)
+    m = index_map_for_libsvm(12)
+    assert m.size == 13 and m.intercept_index == 0
+
+
+def test_validation(rng):
+    data, _ = generate_glmix(n_users=3, per_user=10, d_global=4, d_user=2, seed=1)
+    assert validate_game_data(data, TaskType.LOGISTIC_REGRESSION) == []
+    bad = GameData(y=np.asarray([0.5, 1.0]), features={"g": np.ones((2, 2))})
+    errs = validate_game_data(bad, TaskType.LOGISTIC_REGRESSION)
+    assert any("binary" in e for e in errs)
+    bad2 = GameData(y=np.asarray([1.0, np.nan]), features={"g": np.ones((2, 2))})
+    errs = validate_game_data(bad2, TaskType.LINEAR_REGRESSION)
+    assert any("labels" in e for e in errs)
+    nw = GameData(y=np.ones(2), features={"g": np.ones((2, 2))},
+                  weight=np.asarray([1.0, 0.0]))
+    errs = validate_game_data(nw, TaskType.LINEAR_REGRESSION)
+    assert any("positive" in e for e in errs)
+    assert validate_game_data(bad, TaskType.LOGISTIC_REGRESSION,
+                              DataValidationType.VALIDATE_DISABLED) == []
+
+
+def test_game_model_roundtrip(tmp_path):
+    d = 5
+    imap = IndexMap.from_features([(f"f{j}", "") for j in range(d - 1)])
+    eidx = EntityIndex()
+    for name in ("alice", "bob", "carol"):
+        eidx.get_or_add(name)
+    fixed = FixedEffectModel(
+        coefficients=Coefficients(means=np.asarray([0.0, 1.5, -2.0, 0.25, 0.0])),
+        feature_shard="s", task=TaskType.LOGISTIC_REGRESSION)
+    w = np.arange(15, dtype=np.float64).reshape(3, 5) / 10.0
+    re = RandomEffectModel(w_stack=w, slot_of={0: 0, 1: 1, 2: 2},
+                           random_effect_type="userId", feature_shard="s",
+                           task=TaskType.LOGISTIC_REGRESSION)
+    model = GameModel(models={"fixed": fixed, "per-user": re})
+
+    out = str(tmp_path / "model")
+    save_game_model(model, out, {"s": imap}, {"userId": eidx})
+    assert os.path.exists(os.path.join(out, "fixed-effect", "fixed", "coefficients.avro"))
+    assert os.path.exists(os.path.join(out, "random-effect", "per-user", "part-00000.avro"))
+
+    eidx2 = EntityIndex()
+    for name in ("alice", "bob", "carol"):
+        eidx2.get_or_add(name)
+    loaded, task = load_game_model(out, {"s": imap}, {"userId": eidx2})
+    assert task == TaskType.LOGISTIC_REGRESSION
+    np.testing.assert_allclose(loaded["fixed"].coefficients.means,
+                               fixed.coefficients.means, rtol=1e-12)
+    lre = loaded["per-user"]
+    for eid in range(3):
+        np.testing.assert_allclose(lre.w_stack[lre.slot_of[eid]], w[eid], rtol=1e-12)
+
+
+def test_model_scores_survive_roundtrip(tmp_path, rng):
+    """Loaded model must score identically to the in-memory one."""
+    data, _ = generate_glmix(n_users=4, per_user=20, d_global=6, d_user=3, seed=2)
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import FixedEffectConfig, GameEstimator, RandomEffectConfig
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="global",
+                                       solver=SolverConfig(max_iters=30),
+                                       reg=Regularization(l2=1.0)),
+            "user": RandomEffectConfig(random_effect_type="userId",
+                                       feature_shard="per_user",
+                                       solver=SolverConfig(max_iters=30),
+                                       reg=Regularization(l2=1.0)),
+        },
+    )
+    res = GameEstimator().fit(data, [config])[0]
+    imaps = {
+        "global": IndexMap.from_features([(f"g{j}", "") for j in range(5)]),
+        "per_user": IndexMap.from_features([(f"u{j}", "") for j in range(2)]),
+    }
+    out = str(tmp_path / "m")
+    save_game_model(res.model, out, imaps, task=TaskType.LOGISTIC_REGRESSION)
+    loaded, _ = load_game_model(out, imaps)
+    s0 = np.asarray(res.model.score(data))
+    s1 = np.asarray(loaded.score(data))
+    np.testing.assert_allclose(s0, s1, rtol=1e-6, atol=1e-7)
+
+
+def test_glm_text_export(tmp_path):
+    imap = IndexMap.from_features([("age", ""), ("income", "high")])
+    m = FixedEffectModel(
+        coefficients=Coefficients(means=np.asarray([0.5, -1.25, 3.0])),
+        feature_shard="s")
+    path = str(tmp_path / "model.txt")
+    save_glm_text(m, imap, path)
+    lines = open(path).read().strip().split("\n")
+    assert lines[0].startswith("income\thigh\t3")
+    assert any(INTERCEPT_NAME in l for l in lines)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    imap = IndexMap.from_features([("f", "")])
+    fixed = FixedEffectModel(
+        coefficients=Coefficients(means=np.asarray([1.0, 2.0])), feature_shard="s")
+    model = GameModel(models={"fixed": fixed})
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 2, "coordinate": 1})
+    loaded, task, cursor = load_checkpoint(ckpt, {"s": imap})
+    assert cursor == {"iteration": 2, "coordinate": 1}
+    np.testing.assert_allclose(loaded["fixed"].coefficients.means, [1.0, 2.0])
+    # overwrite with newer state is atomic
+    save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 3, "coordinate": 0})
+    _, _, cursor = load_checkpoint(ckpt, {"s": imap})
+    assert cursor["iteration"] == 3

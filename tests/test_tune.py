@@ -1,0 +1,122 @@
+"""Hyperparameter-tuning tests: GP regression quality, slice sampler, search
+convergence on closed-form objectives, GAME tuning end-to-end."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.tune import (
+    GaussianProcess,
+    GaussianProcessSearch,
+    Matern52,
+    RBF,
+    RandomSearch,
+    SearchDomain,
+    expected_improvement,
+    slice_sample,
+)
+from photon_ml_tpu.tune.search import DomainDim
+
+
+def test_kernels_psd_and_forms(rng):
+    x = rng.normal(size=(20, 3))
+    for kern in (RBF(), Matern52()):
+        k = kern(x, x)
+        np.testing.assert_allclose(k, k.T, atol=1e-12)
+        w = np.linalg.eigvalsh(k)
+        assert w.min() > -1e-9
+        np.testing.assert_allclose(np.diagonal(k), kern.amplitude, rtol=1e-10)
+    # RBF closed form on a simple pair
+    k = RBF()(np.zeros((1, 1)), np.ones((1, 1)))
+    np.testing.assert_allclose(k[0, 0], np.exp(-0.5), rtol=1e-12)
+
+
+def test_gp_interpolates_smooth_function(rng):
+    f = lambda x: np.sin(3 * x[:, 0]) + 0.5 * x[:, 0]
+    x = rng.random((25, 1))
+    y = f(x)
+    gp = GaussianProcess().fit(x, y, seed=1)
+    xt = rng.random((50, 1))
+    mu, sigma = gp.predict(xt)
+    err = np.abs(mu - f(xt))
+    assert np.mean(err) < 0.1, np.mean(err)
+    # posterior mean interpolates the observations
+    mu0, _ = gp.predict(x)
+    np.testing.assert_allclose(mu0, y, atol=0.05)
+
+
+def test_slice_sampler_matches_gaussian(rng):
+    logp = lambda x: float(-0.5 * ((x[0] - 2.0) / 1.5) ** 2)
+    samples = slice_sample(logp, np.zeros(1), 2000, np.random.default_rng(0), burn_in=50)
+    assert abs(samples.mean() - 2.0) < 0.15
+    assert abs(samples.std() - 1.5) < 0.2
+
+
+def test_expected_improvement_properties():
+    # lower mean -> higher EI; zero sigma at worse point -> 0 EI
+    ei = expected_improvement(np.asarray([0.0, 1.0]), np.asarray([0.5, 0.5]), best=0.5)
+    assert ei[0] > ei[1]
+    ei0 = expected_improvement(np.asarray([1.0]), np.asarray([1e-15]), best=0.5)
+    assert ei0[0] < 1e-10
+
+
+def test_domain_roundtrip_log_and_linear():
+    dom = SearchDomain([
+        DomainDim("a", 1e-3, 1e3, log_scale=True),
+        DomainDim("b", -2.0, 5.0),
+    ])
+    u = np.asarray([[0.5, 0.5], [0.0, 0.0], [1.0, 1.0]])
+    real = dom.to_real(u)
+    np.testing.assert_allclose(real[0], [1.0, 1.5], rtol=1e-10)
+    np.testing.assert_allclose(dom.to_unit(real), u, atol=1e-12)
+
+
+@pytest.mark.parametrize("cls", [RandomSearch, GaussianProcessSearch])
+def test_search_finds_minimum(cls):
+    dom = SearchDomain([DomainDim("x", 0.0, 1.0), DomainDim("y", 0.0, 1.0)])
+    f = lambda p: float((p[0] - 0.3) ** 2 + (p[1] - 0.7) ** 2)
+    search = cls(dom, minimize=True, seed=0)
+    best, val = search.find(f, n=25)
+    assert val < 0.05, (best, val)
+    # GP search should do at least as well as pure random with same budget
+    if cls is GaussianProcessSearch:
+        assert val < 0.02, (best, val)
+
+
+def test_search_maximize_orientation():
+    dom = SearchDomain([DomainDim("x", 0.0, 1.0)])
+    f = lambda p: float(-((p[0] - 0.6) ** 2))  # max at 0.6
+    search = RandomSearch(dom, minimize=False, seed=1)
+    best, val = search.find(f, n=30)
+    assert abs(best[0] - 0.6) < 0.1
+    assert val <= 0.0
+
+
+def test_game_tuning_end_to_end(rng):
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, GameEstimator
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.tune import tune_game_model
+    from photon_ml_tpu.types import TaskType
+
+    n, d = 400, 8
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-x @ w))).astype(float)
+    tr = GameData(y=y[:300], features={"g": x[:300]})
+    va = GameData(y=y[300:], features={"g": x[300:]})
+
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={"fixed": FixedEffectConfig(
+            feature_shard="g", solver=SolverConfig(max_iters=50),
+            reg=Regularization(l2=1.0))},
+    )
+    est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
+    best, search = tune_game_model(est, config, tr, va, n_iterations=4,
+                                   mode="bayesian", seed=0)
+    assert best.evaluation.values["auc"] > 0.7
+    assert len(search.observations) == 5  # prior + 4 iterations
+    tuned_l2 = best.config.coordinates["fixed"].reg.l2
+    assert 1e-4 <= tuned_l2 <= 1e4
