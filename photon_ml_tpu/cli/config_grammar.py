@@ -24,7 +24,7 @@ from photon_ml_tpu.game.config import (
     RandomEffectConfig,
 )
 from photon_ml_tpu.opt.types import SolverConfig
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import OptimizerType, ProjectorType, TaskType
 
 
 @dataclasses.dataclass
@@ -68,10 +68,12 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     reg_type = RegularizationType[kv.pop("reg.type", "L2").upper()]
     alpha = float(kv.pop("reg.alpha", 0.5))
     weights = [float(w) for w in kv.pop("reg.weights", "0").split("|")]
-    down_sampling = float(kv.pop("down.sampling.rate", 1.0))
 
     re_type = kv.pop("random.effect.type", None)
     if re_type is not None:
+        # projection keys (reference RandomEffectDataConfiguration projector +
+        # featuresToSamplesRatio grammar, ScoptParserHelpers.scala:495)
+        projector = ProjectorType[kv.pop("projector", "IDENTITY").upper()]
         template: CoordinateConfig = RandomEffectConfig(
             random_effect_type=re_type,
             feature_shard=shard,
@@ -80,14 +82,23 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             active_cap=(int(kv["active.data.upper.bound"])
                         if "active.data.upper.bound" in kv else None),
             min_active_samples=int(kv.pop("active.data.lower.bound", 1)),
+            projector=projector,
+            projected_dim=(int(kv["projected.dim"])
+                           if "projected.dim" in kv else None),
+            features_to_samples_ratio=(float(kv["features.to.samples.ratio"])
+                                       if "features.to.samples.ratio" in kv else None),
+            intercept_index=(int(kv["intercept.index"])
+                             if "intercept.index" in kv else None),
         )
-        kv.pop("active.data.upper.bound", None)
+        for consumed in ("active.data.upper.bound", "projected.dim",
+                         "features.to.samples.ratio", "intercept.index"):
+            kv.pop(consumed, None)
     else:
         template = FixedEffectConfig(
             feature_shard=shard,
             optimizer=optimizer,
             solver=solver,
-            down_sampling_rate=down_sampling,
+            down_sampling_rate=float(kv.pop("down.sampling.rate", 1.0)),
         )
     if kv:
         raise ValueError(f"unknown coordinate spec keys for {name!r}: {sorted(kv)}")

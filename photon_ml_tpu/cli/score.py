@@ -63,7 +63,10 @@ def run(argv: List[str]) -> int:
     logger.info("scoring %d samples", data.num_samples)
 
     tf = GameTransformer(model, task)
-    scores = tf.predict(data) if args.predict_mean else tf.score(data) + np.asarray(data.offset)
+    raw_scores = None
+    if not args.predict_mean or args.evaluators:
+        raw_scores = tf.score(data) + np.asarray(data.offset)
+    scores = tf.predict(data) if args.predict_mean else raw_scores
 
     os.makedirs(args.output_dir, exist_ok=True)
     out_path = os.path.join(args.output_dir, "scores.avro")
@@ -79,8 +82,9 @@ def run(argv: List[str]) -> int:
     logger.info("wrote %d scores -> %s", n, out_path)
 
     if args.evaluators:
+        # evaluators expect RAW margins regardless of the output format flag
         suite = EvaluationSuite.from_specs(args.evaluators.split(","))
-        res = suite.evaluate(scores, data.y, data.weight, group_ids=data.id_tags)
+        res = suite.evaluate(raw_scores, data.y, data.weight, group_ids=data.id_tags)
         logger.info("metrics: %s", res.values)
         with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
             json.dump(res.values, f, indent=2)

@@ -93,7 +93,15 @@ def read_game_data_avro(
     offset = np.zeros(n, dtype)
     weight = np.ones(n, dtype)
     uids = np.empty(n, object)
-    mats = {shard: np.zeros((n, m.size), dtype) for shard, m in index_maps.items()}
+    # Shards sharing one IndexMap object get ONE matrix filled once and
+    # aliased (read-only downstream) — k identical shards would otherwise cost
+    # k decode passes and k copies of an [n, d] dense block.
+    groups: Dict[int, List[str]] = {}
+    for shard, m in index_maps.items():
+        groups.setdefault(id(m), []).append(shard)
+    group_maps = {gid: index_maps[shards[0]] for gid, shards in groups.items()}
+    group_mats = {gid: np.zeros((n, m.size), dtype) for gid, m in group_maps.items()}
+    mats = {shard: group_mats[gid] for gid, shards in groups.items() for shard in shards}
     id_tag_names = list(id_tag_names)
     entity_indexes = entity_indexes or {}
     for tag in id_tag_names:
@@ -111,8 +119,8 @@ def read_game_data_avro(
         for tag in id_tag_names:
             if tag in meta:
                 tags[tag][i] = entity_indexes[tag].get_or_add(str(meta[tag]))
-        for shard, m in index_maps.items():
-            x = mats[shard]
+        for gid, m in group_maps.items():
+            x = group_mats[gid]
             ii = m.intercept_index
             if ii is not None:
                 x[i, ii] = 1.0
@@ -168,6 +176,18 @@ def read_libsvm(path: str, num_features: Optional[int] = None,
 
 
 def index_map_for_libsvm(dim: int, add_intercept: bool = True) -> IndexMap:
-    """Positional index map for libsvm features (feature name = column number)."""
-    keys = [feature_key(str(j + 1), "") for j in range(dim)]
-    return IndexMap.build(keys, add_intercept=add_intercept)
+    """Positional index map for libsvm features (feature name = column number).
+
+    Built directly so 1-based feature j lands at dense column j-1+intercept —
+    IndexMap.build would sort keys LEXICOGRAPHICALLY ('10' < '2') and disagree
+    with read_libsvm's positional layout for dim >= 10.
+    """
+    from photon_ml_tpu.data.schemas import INTERCEPT_NAME, INTERCEPT_TERM
+
+    fwd = {}
+    extra = 1 if add_intercept else 0
+    if add_intercept:
+        fwd[feature_key(INTERCEPT_NAME, INTERCEPT_TERM)] = 0
+    for j in range(dim):
+        fwd[feature_key(str(j + 1), "")] = j + extra
+    return IndexMap(fwd)

@@ -13,7 +13,7 @@ from typing import Optional, Union
 
 from photon_ml_tpu.core.regularization import Regularization
 from photon_ml_tpu.opt.types import SolverConfig
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import OptimizerType, ProjectorType, TaskType
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,6 +40,12 @@ class RandomEffectConfig:
     reg: Regularization = Regularization()
     active_cap: Optional[int] = None  # per-entity sample cap (reservoir)
     min_active_samples: int = 1  # lower-bound entity filter
+    # Feature projection (reference ProjectorType.scala:30 + featuresToSamplesRatio,
+    # RandomEffectDataConfiguration): solve each entity in a reduced feature space.
+    projector: ProjectorType = ProjectorType.IDENTITY
+    projected_dim: Optional[int] = None  # required for ProjectorType.RANDOM
+    features_to_samples_ratio: Optional[float] = None  # per-entity Pearson top-k cap
+    intercept_index: Optional[int] = None  # column the Pearson filter must keep
 
 
 CoordinateConfig = Union[FixedEffectConfig, RandomEffectConfig]

@@ -39,7 +39,7 @@ from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverResult
 from photon_ml_tpu.parallel.bucketing import bucket_by_entity, stacked_coefficients
 from photon_ml_tpu.parallel.mesh import replicate, shard_batch
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import OptimizerType, ProjectorType, TaskType
 
 Array = jax.Array
 
@@ -180,6 +180,14 @@ class FixedEffectCoordinate(Coordinate):
         return np.asarray(s)[: self._n]
 
 
+def _re_data_key(c: RandomEffectConfig) -> tuple:
+    """Every field that affects the DATA layout (buckets + projection); a
+    config differing only in optimization settings may reuse device arrays."""
+    return ("random", c.random_effect_type, c.feature_shard, c.active_cap,
+            c.min_active_samples, c.projector, c.projected_dim,
+            c.features_to_samples_ratio, c.intercept_index)
+
+
 class RandomEffectCoordinate(Coordinate):
     """Per-entity GLM coordinate (reference RandomEffectCoordinate.scala:39-232).
 
@@ -220,6 +228,23 @@ class RandomEffectCoordinate(Coordinate):
         self._sample_slots = jnp.asarray(_slots_from(self._slot_of, self._entity_ids))
         self._x_full = jnp.asarray(x)
 
+        # Optional per-entity feature projection (reference
+        # RandomEffectCoordinateInProjectedSpace.scala:149): solve each bucket
+        # in a compact feature space, back-project coefficients to full dim.
+        self._proj = None
+        solve_buckets = self.buckets.buckets
+        if config.projector != ProjectorType.IDENTITY:
+            from photon_ml_tpu.parallel.projection import project_buckets
+
+            self._proj = project_buckets(
+                self.buckets, config.projector,
+                projected_dim=config.projected_dim,
+                features_to_samples_ratio=config.features_to_samples_ratio,
+                intercept_index=config.intercept_index,
+                seed=seed,
+            )
+            solve_buckets = self._proj.buckets
+
         self._bind_solver()
 
         # Device-resident bucket arrays, entity lane sharded over ALL mesh
@@ -237,7 +262,7 @@ class RandomEffectCoordinate(Coordinate):
             dict(x=put(b.x), y=put(b.y), w=put(b.weight),
                  rows=put(np.where(b.rows < 0, 0, b.rows)),
                  valid=put(b.rows >= 0))
-            for b in self.buckets.buckets
+            for b in solve_buckets
         ]
 
     def _bind_solver(self) -> None:
@@ -252,22 +277,39 @@ class RandomEffectCoordinate(Coordinate):
         self._vsolve = jax.jit(_vsolve)
 
     def data_key(self) -> tuple:
-        return ("random", self.config.random_effect_type, self.config.feature_shard,
-                self.config.active_cap, self.config.min_active_samples)
+        return _re_data_key(self.config)
 
     def rebind(self, config: RandomEffectConfig) -> "RandomEffectCoordinate":
         """New optimization settings over the SAME buckets/device arrays."""
         import copy
 
-        old = self.config
-        if (config.random_effect_type, config.feature_shard, config.active_cap,
-                config.min_active_samples) != (old.random_effect_type, old.feature_shard,
-                                               old.active_cap, old.min_active_samples):
+        if _re_data_key(config) != _re_data_key(self.config):
             raise ValueError("rebind cannot change the data configuration")
         new = copy.copy(self)
         new.config = config
         new._bind_solver()
         return new
+
+    def _warm_start(self, bucket_index: int, init: RandomEffectModel) -> np.ndarray:
+        """Full-dim warm-start lanes, projected into the solve space if needed."""
+        b = self.buckets.buckets[bucket_index]
+        w0 = np.zeros((b.num_lanes, self.dim), self._dtype)
+        for lane, eid in enumerate(b.entity_lanes):
+            slot = init.slot_of.get(int(eid)) if eid >= 0 else None
+            if slot is not None:
+                w0[lane] = init.w_stack[slot]
+        if self._proj is not None:
+            from photon_ml_tpu.parallel.projection import BucketProjection
+
+            proj = self._proj.projections[bucket_index]
+            if isinstance(proj, BucketProjection):
+                safe = np.where(proj.indices < 0, 0, proj.indices)
+                w0 = np.where(proj.indices >= 0,
+                              np.take_along_axis(w0, safe, axis=1), 0.0)
+            else:
+                # Gaussian projection has no exact inverse; restart cold.
+                w0 = np.zeros((b.num_lanes, proj.d_proj), self._dtype)
+        return w0.astype(self._dtype)
 
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[RandomEffectModel] = None
@@ -276,21 +318,19 @@ class RandomEffectCoordinate(Coordinate):
         coeffs = []
         results = []
         for bi, (b, dev) in enumerate(zip(self.buckets.buckets, self._dev)):
+            solve_dim = dev["x"].shape[2]
             if init is not None:
-                w0 = np.zeros((b.num_lanes, self.dim), self._dtype)
-                for lane, eid in enumerate(b.entity_lanes):
-                    slot = init.slot_of.get(int(eid)) if eid >= 0 else None
-                    if slot is not None:
-                        w0[lane] = init.w_stack[slot]
-                w0 = self._put_entity(w0)
+                w0 = self._put_entity(self._warm_start(bi, init))
             else:
-                w0 = self._put_entity(np.zeros((b.num_lanes, self.dim), self._dtype))
+                w0 = self._put_entity(np.zeros((b.num_lanes, solve_dim), self._dtype))
             # residual offsets gathered into the bucket layout
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
             res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"])
             coeffs.append(res.w)
             results.append(res)
 
+        if self._proj is not None:
+            coeffs = self._proj.back_project([np.asarray(c) for c in coeffs])
         w_stack, slot_of = stacked_coefficients(coeffs, self.buckets)
         model = RandomEffectModel(
             w_stack=np.asarray(w_stack), slot_of=slot_of,

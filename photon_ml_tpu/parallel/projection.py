@@ -1,0 +1,235 @@
+"""Per-entity feature projection for random-effect coordinates.
+
+Reference machinery being replaced (SURVEY.md §2.2 "Projectors", ~776 LoC):
+  - IndexMapProjector / IndexMapProjectorRDD: per-entity observed-feature
+    compaction — each entity's local problem is solved in the subspace of
+    features it has actually seen (projector/IndexMapProjectorRDD.scala:34-262,
+    build: 222-261).
+  - ProjectionMatrix / ProjectionMatrixBroadcast: shared Gaussian random
+    projection to a fixed low dimension (projector/ProjectionMatrix.scala:127,
+    ProjectionMatrixBroadcast.scala:150).
+  - LocalDataset.filterFeaturesByPearsonCorrelationScore: per-entity top-k
+    feature selection by |Pearson correlation| with the label
+    (data/LocalDataset.scala:185-247), driven by
+    RandomEffectDataConfiguration.featuresToSamplesRatio.
+
+TPU-native design: the reference keeps a projector OBJECT per entity inside an
+RDD and maps every vector through it.  Here projection is a static data-layout
+step over the already-bucketed entity arrays:
+
+  - INDEX_MAP: per-lane gather indices ``idx[E, d_proj]`` (−1 = padding);
+    projected design block ``x[E, S, d_proj] = x_full[..., idx]`` built once on
+    host; solvers run vmapped in the small d_proj space (a dense [E, S, d_proj]
+    MXU program instead of [E, S, d_full]); trained coefficients are scattered
+    back to full dimension, so margins are EXACTLY preserved and scoring stays
+    full-dimensional.
+  - RANDOM: one shared Gaussian matrix A[d_full, d_proj] (the reference
+    broadcasts one ProjectionMatrix per coordinate too); x' = x·A, and
+    back-projection w = A·w' preserves margins by construction
+    (w'ᵀ(Aᵀx) = (Aw')ᵀx).
+
+Solving in the observed subspace is loss-identical to the full-space solve for
+GLMs: an unobserved feature has zero data gradient, and with zero-initialised
+coefficients L2/L1 keep it at exactly 0 — the reference relies on the same
+fact when it projects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.parallel.bucketing import Bucket, EntityBuckets
+from photon_ml_tpu.types import ProjectorType
+
+
+def _pow2_at_least(k: int) -> int:
+    return max(1, 1 << (max(0, k - 1)).bit_length())
+
+
+def pearson_scores(x: np.ndarray, y: np.ndarray, weight: np.ndarray) -> np.ndarray:
+    """|Pearson correlation| of each column of x with y over weighted samples.
+
+    Reference LocalDataset.scala:185-247 computes the same score per entity to
+    rank features (constant columns — e.g. the intercept — get score 1 so they
+    are always kept, matching the reference's intercept carve-out).
+    """
+    w = weight / max(float(weight.sum()), 1e-12)
+    mx = w @ x
+    my = float(w @ y)
+    dx = x - mx
+    dy = y - my
+    cov = (w * dy) @ dx
+    vx = w @ (dx * dx)
+    vy = float(w @ (dy * dy))
+    denom = np.sqrt(np.maximum(vx * vy, 0.0))
+    near_const = vx <= 1e-12 * np.maximum(1.0, np.abs(mx) ** 2)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        score = np.abs(cov) / np.where(denom > 0, denom, 1.0)
+    out = np.where(denom > 0, score, 0.0)
+    # Only the FIRST constant column (the intercept) scores 1; later constant
+    # columns are redundant with it and score 0, as in the reference.
+    const_cols = np.nonzero(near_const)[0]
+    out[const_cols] = 0.0
+    if const_cols.size:
+        out[const_cols[0]] = 1.0
+    return out
+
+
+@dataclasses.dataclass
+class BucketProjection:
+    """INDEX_MAP projection of one bucket: per-lane gather indices."""
+
+    indices: np.ndarray  # [E, d_proj] int32, -1 padding
+    d_full: int
+
+    @property
+    def d_proj(self) -> int:
+        return self.indices.shape[1]
+
+    def project_x(self, x: np.ndarray) -> np.ndarray:
+        """[E, S, d_full] -> [E, S, d_proj]; padding columns are zero."""
+        safe = np.where(self.indices < 0, 0, self.indices)  # [E, d_proj]
+        out = np.take_along_axis(x, safe[:, None, :], axis=2)
+        return np.where((self.indices >= 0)[:, None, :], out, 0.0).astype(x.dtype)
+
+    def back_project(self, w_proj: np.ndarray) -> np.ndarray:
+        """[E, d_proj] -> [E, d_full] scatter (margin-exact)."""
+        e = w_proj.shape[0]
+        out = np.zeros((e, self.d_full), w_proj.dtype)
+        lanes = np.repeat(np.arange(e), self.d_proj)
+        idx = self.indices.reshape(-1)
+        vals = np.asarray(w_proj).reshape(-1)
+        keep = idx >= 0
+        out[lanes[keep], idx[keep]] = vals[keep]
+        return out
+
+
+@dataclasses.dataclass
+class RandomProjection:
+    """Shared Gaussian projection (reference ProjectionMatrix.scala:127)."""
+
+    matrix: np.ndarray  # [d_full, d_proj]
+
+    @property
+    def d_full(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def d_proj(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_x(self, x: np.ndarray) -> np.ndarray:
+        return (x @ self.matrix).astype(x.dtype)
+
+    def back_project(self, w_proj: np.ndarray) -> np.ndarray:
+        return (np.asarray(w_proj) @ self.matrix.T).astype(w_proj.dtype)
+
+
+def build_random_projection(d_full: int, d_proj: int, seed: int = 0,
+                            dtype=np.float32) -> RandomProjection:
+    rng = np.random.default_rng(seed)
+    m = rng.normal(scale=1.0 / np.sqrt(d_proj), size=(d_full, d_proj))
+    return RandomProjection(matrix=m.astype(dtype))
+
+
+def build_observed_indices(
+    bucket: Bucket,
+    d_full: int,
+    features_to_samples_ratio: Optional[float] = None,
+    intercept_index: Optional[int] = None,
+) -> BucketProjection:
+    """Observed-feature gather indices for every lane of one bucket.
+
+    A feature is "observed" for an entity when any of its active samples has a
+    nonzero value in that column (the reference builds the same set from
+    active+passive indices, IndexMapProjectorRDD.scala:222-261).  When
+    ``features_to_samples_ratio`` is set, each entity keeps at most
+    ``ratio * active_count`` features, ranked by |Pearson| with the label
+    (LocalDataset.scala:185-247); the intercept column is always kept.
+    """
+    e, s, _ = bucket.x.shape
+    per_lane: List[np.ndarray] = []
+    for lane in range(e):
+        k = int(bucket.counts[lane])
+        if k == 0:
+            per_lane.append(np.empty(0, np.int32))
+            continue
+        x = bucket.x[lane, :k]
+        observed = np.nonzero(np.any(x != 0.0, axis=0))[0]
+        if features_to_samples_ratio is not None and observed.size > 0:
+            keep_n = max(1, int(np.ceil(features_to_samples_ratio * k)))
+            if observed.size > keep_n:
+                scores = pearson_scores(x[:, observed], bucket.y[lane, :k],
+                                        bucket.weight[lane, :k])
+                if intercept_index is not None:
+                    at = np.nonzero(observed == intercept_index)[0]
+                    if at.size:
+                        scores[at[0]] = np.inf  # intercept always survives
+                top = np.argsort(-scores, kind="stable")[:keep_n]
+                observed = np.sort(observed[top])
+        per_lane.append(observed.astype(np.int32))
+
+    d_proj = _pow2_at_least(max((len(o) for o in per_lane), default=1))
+    d_proj = min(d_proj, d_full)
+    indices = np.full((e, d_proj), -1, np.int32)
+    for lane, obs in enumerate(per_lane):
+        obs = obs[:d_proj]
+        indices[lane, : len(obs)] = obs
+    return BucketProjection(indices=indices, d_full=d_full)
+
+
+@dataclasses.dataclass
+class ProjectedBuckets:
+    """Entity buckets re-laid-out in projected feature space.
+
+    ``buckets[i]`` has design blocks of width ``projections[i].d_proj``;
+    everything else (lanes, rows, weights, directory) is unchanged, so the
+    descent/score plumbing in RandomEffectCoordinate applies as-is.
+    """
+
+    base: EntityBuckets
+    buckets: List[Bucket]
+    projections: List[object]  # BucketProjection | RandomProjection per bucket
+
+    def back_project(self, coeffs: List[np.ndarray]) -> List[np.ndarray]:
+        return [p.back_project(np.asarray(w)) for p, w in zip(self.projections, coeffs)]
+
+
+def project_buckets(
+    buckets: EntityBuckets,
+    kind: ProjectorType,
+    projected_dim: Optional[int] = None,
+    features_to_samples_ratio: Optional[float] = None,
+    intercept_index: Optional[int] = None,
+    seed: int = 0,
+) -> ProjectedBuckets:
+    """Apply a ProjectorType to every bucket (host-side, one-time layout)."""
+    if kind == ProjectorType.IDENTITY:
+        raise ValueError("IDENTITY projection needs no ProjectedBuckets")
+    if kind == ProjectorType.RANDOM and (features_to_samples_ratio is not None
+                                         or intercept_index is not None):
+        raise ValueError(
+            "features_to_samples_ratio / intercept_index apply only to "
+            "INDEX_MAP projection; RANDOM would silently ignore them")
+    new_buckets: List[Bucket] = []
+    projections: List[object] = []
+    shared: Optional[RandomProjection] = None
+    for b in buckets.buckets:
+        if kind == ProjectorType.INDEX_MAP:
+            proj: object = build_observed_indices(
+                b, buckets.dim, features_to_samples_ratio, intercept_index)
+        elif kind == ProjectorType.RANDOM:
+            if projected_dim is None:
+                raise ValueError("RANDOM projection requires projected_dim")
+            if shared is None:
+                shared = build_random_projection(buckets.dim, projected_dim, seed,
+                                                 dtype=b.x.dtype)
+            proj = shared
+        else:
+            raise ValueError(f"unknown projector {kind!r}")
+        new_buckets.append(dataclasses.replace(b, x=proj.project_x(b.x)))
+        projections.append(proj)
+    return ProjectedBuckets(base=buckets, buckets=new_buckets, projections=projections)

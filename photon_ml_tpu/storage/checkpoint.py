@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import tempfile
 from typing import Dict, Optional, Tuple
@@ -48,8 +49,12 @@ def save_checkpoint(
 ) -> None:
     """``cursor``: {"iteration": i, "coordinate": k} — the NEXT update to run."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    prev = _read_pointer(ckpt_dir)
-    version = f"v{int(prev[1:]) + 1}" if prev else "v1"
+    # Version = max existing v<N> + 1, NOT pointer+1: a crash between the
+    # version rename and the pointer swap leaves an orphaned v<N+1>, and
+    # deriving from the pointer would collide with it forever after.
+    existing = [int(name[1:]) for name in os.listdir(ckpt_dir)
+                if re.fullmatch(r"v\d+", name)]
+    version = f"v{max(existing, default=0) + 1}"
 
     tmp = tempfile.mkdtemp(prefix=".tmp-", dir=ckpt_dir)
     try:
@@ -61,15 +66,17 @@ def save_checkpoint(
         shutil.rmtree(tmp, ignore_errors=True)
         raise
 
-    # atomic pointer swap, then prune the superseded version
+    # atomic pointer swap, then prune every superseded/orphaned version
     ptr_tmp = os.path.join(ckpt_dir, f".{_POINTER}.tmp")
     with open(ptr_tmp, "w") as f:
         f.write(version)
         f.flush()
         os.fsync(f.fileno())
     os.replace(ptr_tmp, os.path.join(ckpt_dir, _POINTER))
-    if prev:
-        shutil.rmtree(os.path.join(ckpt_dir, prev), ignore_errors=True)
+    for name in os.listdir(ckpt_dir):
+        stale = (re.fullmatch(r"v\d+", name) and name != version) or name.startswith(".tmp-")
+        if stale:
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
 def load_checkpoint(

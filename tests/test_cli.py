@@ -61,6 +61,30 @@ def test_config_grammar():
         parse_coordinate_spec("feature.shard=s")
 
 
+def test_config_grammar_projection_keys():
+    from photon_ml_tpu.types import ProjectorType
+
+    spec = parse_coordinate_spec(
+        "name=user,random.effect.type=userId,feature.shard=u,projector=INDEX_MAP,"
+        "features.to.samples.ratio=0.5,intercept.index=3,reg.weights=1")
+    assert spec.template.projector == ProjectorType.INDEX_MAP
+    assert spec.template.features_to_samples_ratio == 0.5
+    assert spec.template.intercept_index == 3
+
+    spec = parse_coordinate_spec(
+        "name=user,random.effect.type=userId,feature.shard=u,"
+        "projector=RANDOM,projected.dim=16,reg.weights=1")
+    assert spec.template.projector == ProjectorType.RANDOM
+    assert spec.template.projected_dim == 16
+
+    # down.sampling.rate is a FIXED-effect key: rejected on random effects
+    # rather than silently dropped
+    with pytest.raises(ValueError, match="unknown"):
+        parse_coordinate_spec(
+            "name=user,random.effect.type=userId,feature.shard=u,"
+            "down.sampling.rate=0.1,reg.weights=1")
+
+
 def test_train_score_pipeline(tmp_path):
     from photon_ml_tpu.cli import score as score_cli
     from photon_ml_tpu.cli import train as train_cli

@@ -123,6 +123,10 @@ def test_read_libsvm(tmp_path):
     assert np.all(x[:, 0] == 1.0)
     m = index_map_for_libsvm(12)
     assert m.size == 13 and m.intercept_index == 0
+    # POSITIONAL, not lexicographic: feature "2" is column 2, "10" column 10
+    assert m.get_index("2") == 2
+    assert m.get_index("10") == 10
+    assert m.get_index("12") == 12
 
 
 def test_validation(rng):
@@ -233,3 +237,23 @@ def test_checkpoint_roundtrip(tmp_path):
     save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 3, "coordinate": 0})
     _, _, cursor = load_checkpoint(ckpt, {"s": imap})
     assert cursor["iteration"] == 3
+
+
+def test_checkpoint_recovers_from_orphaned_version(tmp_path):
+    """Crash between version rename and pointer swap must not wedge saves."""
+    import os
+
+    imap = IndexMap.from_features([("f", "")])
+    fixed = FixedEffectModel(
+        coefficients=Coefficients(means=np.asarray([1.0, 2.0])), feature_shard="s")
+    model = GameModel(models={"fixed": fixed})
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 1, "coordinate": 0})
+    # simulate the crash: an orphaned v2 exists but LATEST still points at v1
+    os.makedirs(os.path.join(ckpt, "v2"))
+    with open(os.path.join(ckpt, "v2", "junk"), "w") as f:
+        f.write("partial")
+    save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 2, "coordinate": 0})
+    _, _, cursor = load_checkpoint(ckpt, {"s": imap})
+    assert cursor["iteration"] == 2
+    assert not os.path.exists(os.path.join(ckpt, "v2"))  # orphan pruned

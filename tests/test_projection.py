@@ -1,0 +1,147 @@
+"""Projector tests (reference analog: IndexMapProjectorRDDIntegTest,
+ProjectionMatrixTest, LocalDataset Pearson-filter tests — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.game.config import RandomEffectConfig
+from photon_ml_tpu.game.coordinate import RandomEffectCoordinate
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.opt.types import SolverConfig
+from photon_ml_tpu.parallel.bucketing import bucket_by_entity
+from photon_ml_tpu.parallel.projection import (
+    build_observed_indices,
+    build_random_projection,
+    pearson_scores,
+    project_buckets,
+)
+from photon_ml_tpu.types import ProjectorType, TaskType
+
+
+def _sparse_entity_data(rng, n_entities=12, per_entity=20, d=32):
+    """Each entity observes only a small random subset of features."""
+    n = n_entities * per_entity
+    eids = np.repeat(np.arange(n_entities), per_entity).astype(np.int64)
+    x = np.zeros((n, d), np.float32)
+    for e in range(n_entities):
+        cols = rng.choice(d - 1, size=5, replace=False)  # leave col d-1 = intercept
+        rows = slice(e * per_entity, (e + 1) * per_entity)
+        x[rows, cols] = rng.normal(size=(per_entity, 5)).astype(np.float32)
+    x[:, d - 1] = 1.0  # intercept column observed everywhere
+    w = rng.normal(size=d).astype(np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-(x @ w)))).astype(np.float32)
+    return eids, x, y
+
+
+def test_pearson_scores_match_numpy(rng):
+    n, d = 200, 6
+    x = rng.normal(size=(n, d))
+    y = x[:, 0] * 2.0 + rng.normal(size=n) * 0.1
+    w = np.ones(n)
+    got = pearson_scores(x, y, w)
+    for j in range(d):
+        expect = abs(np.corrcoef(x[:, j], y)[0, 1])
+        assert got[j] == pytest.approx(expect, abs=1e-6)
+    # FIRST constant column scores 1 (the intercept carve-out); later constant
+    # columns are redundant with it and score 0 (reference LocalDataset rule)
+    xc = np.concatenate([x, np.ones((n, 1)), np.full((n, 1), 2.0)], axis=1)
+    s = pearson_scores(xc, y, w)
+    assert s[-2] == 1.0 and s[-1] == 0.0
+
+
+def test_observed_projection_margin_exact(rng):
+    eids, x, y = _sparse_entity_data(rng)
+    buckets = bucket_by_entity(eids, x, y)
+    assert len(buckets.buckets) == 1
+    b = buckets.buckets[0]
+    proj = build_observed_indices(b, buckets.dim)
+    assert proj.d_proj < buckets.dim  # actually compacted
+    xp = proj.project_x(b.x)
+    w_proj = rng.normal(size=(b.num_lanes, proj.d_proj)).astype(np.float32)
+    w_full = proj.back_project(w_proj)
+    # margins identical in both spaces for every lane/sample
+    m_proj = np.einsum("esd,ed->es", xp, w_proj)
+    m_full = np.einsum("esd,ed->es", b.x, w_full)
+    np.testing.assert_allclose(m_proj, m_full, rtol=1e-5, atol=1e-5)
+
+
+def test_random_projection_margin_exact(rng):
+    d, dp = 32, 8
+    proj = build_random_projection(d, dp, seed=3)
+    x = rng.normal(size=(4, 10, d)).astype(np.float32)
+    xp = proj.project_x(x)
+    w_proj = rng.normal(size=(4, dp)).astype(np.float32)
+    w_full = proj.back_project(w_proj)
+    np.testing.assert_allclose(
+        np.einsum("esd,ed->es", xp, w_proj),
+        np.einsum("esd,ed->es", x, w_full), rtol=1e-4, atol=1e-4)
+
+
+def test_pearson_ratio_caps_features_and_keeps_intercept(rng):
+    eids, x, y = _sparse_entity_data(rng, per_entity=16)
+    buckets = bucket_by_entity(eids, x, y)
+    b = buckets.buckets[0]
+    d = buckets.dim
+    proj = build_observed_indices(b, d, features_to_samples_ratio=0.25,
+                                  intercept_index=d - 1)
+    for lane in range(b.num_lanes):
+        k = int(b.counts[lane])
+        kept = proj.indices[lane][proj.indices[lane] >= 0]
+        assert len(kept) <= max(1, int(np.ceil(0.25 * k)))
+        assert (d - 1) in kept  # intercept survives the cut
+
+
+def test_re_coordinate_index_map_matches_identity(rng):
+    eids, x, y = _sparse_entity_data(rng)
+    data = GameData(y=y, features={"s": x}, id_tags={"e": eids})
+    solver = SolverConfig(max_iters=60, tolerance=1e-9)
+    kw = dict(random_effect_type="e", feature_shard="s", solver=solver,
+              reg=Regularization(l2=0.5))
+    base = RandomEffectCoordinate(
+        "re", data, RandomEffectConfig(**kw), TaskType.LOGISTIC_REGRESSION)
+    projected = RandomEffectCoordinate(
+        "re", data, RandomEffectConfig(projector=ProjectorType.INDEX_MAP, **kw),
+        TaskType.LOGISTIC_REGRESSION)
+    offs = np.zeros(len(y), np.float32)
+    m0, _ = base.update(offs)
+    m1, _ = projected.update(offs)
+    # zero-init + L2 ==> unobserved coords stay 0; optima coincide
+    np.testing.assert_allclose(np.asarray(m1.w_stack), np.asarray(m0.w_stack),
+                               rtol=1e-3, atol=2e-3)
+    np.testing.assert_allclose(projected.score(m1), base.score(m0),
+                               rtol=1e-3, atol=2e-3)
+    # warm start from the projected model converges immediately to itself
+    m2, _ = projected.update(offs, init=m1)
+    np.testing.assert_allclose(np.asarray(m2.w_stack), np.asarray(m1.w_stack),
+                               rtol=1e-3, atol=2e-3)
+
+
+def test_re_coordinate_random_projection_runs(rng):
+    eids, x, y = _sparse_entity_data(rng)
+    data = GameData(y=y, features={"s": x}, id_tags={"e": eids})
+    coord = RandomEffectCoordinate(
+        "re", data,
+        RandomEffectConfig(random_effect_type="e", feature_shard="s",
+                           solver=SolverConfig(max_iters=20),
+                           reg=Regularization(l2=0.5),
+                           projector=ProjectorType.RANDOM, projected_dim=8),
+        TaskType.LOGISTIC_REGRESSION)
+    model, _ = coord.update(np.zeros(len(y), np.float32))
+    assert np.asarray(model.w_stack).shape[1] == x.shape[1]  # full-dim model
+    assert np.all(np.isfinite(np.asarray(model.w_stack)))
+    scores = coord.score(model)
+    assert np.all(np.isfinite(scores))
+
+
+def test_project_buckets_requires_dim_for_random(rng):
+    eids, x, y = _sparse_entity_data(rng, n_entities=3, per_entity=4)
+    buckets = bucket_by_entity(eids, x, y)
+    with pytest.raises(ValueError):
+        project_buckets(buckets, ProjectorType.RANDOM)
+    with pytest.raises(ValueError):
+        project_buckets(buckets, ProjectorType.IDENTITY)
+    # Pearson/intercept knobs are INDEX_MAP-only: rejected, not ignored
+    with pytest.raises(ValueError, match="INDEX_MAP"):
+        project_buckets(buckets, ProjectorType.RANDOM, projected_dim=4,
+                        features_to_samples_ratio=0.5)
