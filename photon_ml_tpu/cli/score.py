@@ -63,10 +63,14 @@ def run(argv: List[str]) -> int:
     logger.info("scoring %d samples", data.num_samples)
 
     tf = GameTransformer(model, task)
-    raw_scores = None
-    if not args.predict_mean or args.evaluators:
-        raw_scores = tf.score(data) + np.asarray(data.offset)
-    scores = tf.predict(data) if args.predict_mean else raw_scores
+    # One scoring pass; the inverse-link mean is a pointwise function of the
+    # raw margin (models/game.py:110-114), so --predict-mean never re-scores.
+    raw_scores = tf.score(data) + np.asarray(data.offset)
+    if args.predict_mean:
+        from photon_ml_tpu.core.losses import loss_for_task
+        scores = np.asarray(loss_for_task(task).mean(raw_scores))
+    else:
+        scores = raw_scores
 
     os.makedirs(args.output_dir, exist_ok=True)
     out_path = os.path.join(args.output_dir, "scores.avro")

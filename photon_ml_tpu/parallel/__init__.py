@@ -1,8 +1,11 @@
 from photon_ml_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     ENTITY_AXIS,
+    FEATURE_AXIS,
     make_mesh,
+    padded_dim,
     shard_batch,
+    shard_coefficients,
     replicate,
 )
 from photon_ml_tpu.parallel.fixed import fit_fixed_effect  # noqa: F401

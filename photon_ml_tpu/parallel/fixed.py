@@ -18,13 +18,20 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from photon_ml_tpu.core.batch import Batch
+from photon_ml_tpu.core.batch import Batch, DenseBatch
 from photon_ml_tpu.core.objective import GLMObjective
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverConfig, SolverResult
-from photon_ml_tpu.parallel.mesh import replicate, shard_batch
+from photon_ml_tpu.parallel.mesh import (
+    FEATURE_AXIS,
+    padded_dim,
+    replicate,
+    shard_batch,
+    shard_coefficients,
+)
 from photon_ml_tpu.types import OptimizerType
 
 Array = jax.Array
@@ -39,18 +46,66 @@ def fit_fixed_effect(
     config: Optional[SolverConfig] = None,
     box: Optional[Tuple[Array, Array]] = None,
     batch_presharded: bool = False,
+    feature_sharded: bool = False,
 ) -> SolverResult:
     """Fit one fixed-effect GLM coordinate over the mesh.
 
     ``batch_presharded``: skip the device_put when the caller already laid the
     batch out (the coordinate-descent loop places data once and reuses it).
+
+    ``feature_sharded``: shard w (and the dense design matrix's columns) over
+    the mesh's ``feature`` axis for huge-d problems — no device holds the full
+    coefficient vector, and each objective evaluation's margin contraction /
+    per-feature gradient partial sums become GSPMD-inserted collectives over
+    ICI.  This is the TPU analog of the reference keeping 1e8-feature models
+    out of any single JVM heap (PalDB index maps + treeAggregate, SURVEY §5).
+    The returned w is sliced back to the caller's d (padding is trimmed).
     """
+    d = int(w0.shape[0])
+    if feature_sharded and not isinstance(batch, DenseBatch):
+        # Sparse batches address w by global index; a feature-sharded w would
+        # force an all-gather per lookup.  Shard-local-id sparse layouts are
+        # the data layer's job — refuse loudly rather than silently
+        # replicating a vector the caller asked to keep sharded.
+        raise ValueError(
+            "feature_sharded=True requires a DenseBatch; sparse batches use "
+            "global feature ids (project/densify first, or keep w replicated)")
     if not batch_presharded:
-        batch = shard_batch(batch, mesh)
+        batch = shard_batch(batch, mesh,
+                            feature_axis=FEATURE_AXIS if feature_sharded else None)
     rep = replicate(mesh)
-    w0 = jax.device_put(w0, rep)
+    if feature_sharded:
+        d_pad = padded_dim(d, mesh)
+        if batch.x.shape[-1] != d_pad:
+            raise ValueError(
+                f"feature-sharded batch has {batch.x.shape[-1]} feature "
+                f"columns but w pads to {d_pad}; preshard with "
+                f"shard_batch(..., feature_axis=FEATURE_AXIS)")
+        if d_pad != d:
+            # Pad every (d,)-shaped companion of w so padded slots stay
+            # pinned at 0: box bounds pad with [0, 0], normalization factors
+            # with 1 (identity scale) and shifts with 0 (no shift).
+            pad = d_pad - d
+            if box is not None:
+                box = (jnp.pad(box[0], (0, pad)), jnp.pad(box[1], (0, pad)))
+            norm = objective.norm
+            if norm.factors is not None or norm.shifts is not None:
+                objective = objective.replace(norm=norm.replace(
+                    factors=None if norm.factors is None
+                    else jnp.pad(norm.factors, (0, pad), constant_values=1.0),
+                    shifts=None if norm.shifts is None
+                    else jnp.pad(norm.shifts, (0, pad)),
+                ))
+        w0 = shard_coefficients(w0, mesh)
+    else:
+        w0 = jax.device_put(w0, rep)
     solve = make_solver(objective, optimizer, config, box=box)
-    # Replicated outputs force GSPMD to all-reduce the sharded loss/grad
-    # reductions inside the solver loop.
-    fitted = jax.jit(solve, out_shardings=rep)
-    return fitted(w0, batch)
+    # Without feature sharding, replicated outputs force GSPMD to all-reduce
+    # the data-sharded loss/grad reductions inside the solver loop.  With it,
+    # sharding propagates from the inputs (w stays P("feature") throughout,
+    # scalars come out replicated).
+    fitted = jax.jit(solve) if feature_sharded else jax.jit(solve, out_shardings=rep)
+    result = fitted(w0, batch)
+    if feature_sharded and result.w.shape[0] != d:
+        result = result.replace(w=result.w[:d])
+    return result

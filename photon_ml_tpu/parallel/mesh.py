@@ -13,9 +13,16 @@ Replaces the reference's Spark communication substrate (SURVEY.md §2.7):
          sharding.
 
 Mesh axes:
-  - ``data``   : examples of the fixed-effect batch (DP)
-  - ``entity`` : independent random-effect problems (the reference's
-                 "per-entity model parallelism", RandomEffectCoordinate.scala:109-127)
+  - ``data``    : examples of the fixed-effect batch (DP)
+  - ``entity``  : independent random-effect problems (the reference's
+                  "per-entity model parallelism", RandomEffectCoordinate.scala:109-127)
+  - ``feature`` : model/feature-axis sharding for huge-d fixed effects — the
+                  TPU counterpart of the reference's feature-axis scaling story
+                  (PalDB 1e8-feature index maps + treeAggregateDepth keeping
+                  driver merge memory flat, SURVEY.md §5): w and the per-feature
+                  gradient partial sums are sharded so no single device holds
+                  the full coefficient vector, and the feature-axis reduction of
+                  margins rides ICI (GSPMD inserts the psum from the shardings).
 Multi-host later slices these over DCN by constructing the mesh from
 ``jax.devices()`` spanning hosts; the code below is agnostic.
 """
@@ -32,11 +39,12 @@ from photon_ml_tpu.core.batch import Batch, DenseBatch, SparseBatch
 
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
+FEATURE_AXIS = "feature"
 
 
-def make_mesh(n_data: Optional[int] = None, n_entity: int = 1,
+def make_mesh(n_data: Optional[int] = None, n_entity: int = 1, n_feature: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Create a (data, entity) mesh over the available devices.
+    """Create a (data, entity, feature) mesh over the available devices.
 
     Default: all devices on the data axis.  A single-device mesh is valid and
     produces the exact same program (collectives become no-ops), so every code
@@ -45,12 +53,13 @@ def make_mesh(n_data: Optional[int] = None, n_entity: int = 1,
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
-        n_data = len(devices) // n_entity
-    need = n_data * n_entity
+        n_data = len(devices) // (n_entity * n_feature)
+    need = n_data * n_entity * n_feature
     if need > len(devices):
-        raise ValueError(f"mesh {n_data}x{n_entity} needs {need} devices, have {len(devices)}")
-    arr = np.asarray(devices[:need]).reshape(n_data, n_entity)
-    return Mesh(arr, (DATA_AXIS, ENTITY_AXIS))
+        raise ValueError(
+            f"mesh {n_data}x{n_entity}x{n_feature} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(n_data, n_entity, n_feature)
+    return Mesh(arr, (DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS))
 
 
 def replicate(mesh: Mesh) -> NamedSharding:
@@ -64,13 +73,53 @@ def _pad_rows(a: np.ndarray, target: int) -> np.ndarray:
     return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
 
 
-def shard_batch(batch: Batch, mesh: Mesh, axis: str = DATA_AXIS) -> Batch:
+def _pad_cols(a: np.ndarray, target: int) -> np.ndarray:
+    pad = target - a.shape[1]
+    if pad == 0:
+        return a
+    return np.concatenate([a, np.zeros((a.shape[0], pad), a.dtype)], axis=1)
+
+
+def padded_dim(d: int, mesh: Mesh, axis: str = FEATURE_AXIS) -> int:
+    """Feature count padded up to a multiple of the feature-axis size."""
+    size = mesh.shape[axis]
+    return ((d + size - 1) // size) * size
+
+
+def shard_coefficients(w, mesh: Mesh, axis: str = FEATURE_AXIS):
+    """Place a coefficient vector sharded over the feature axis (zero-padded).
+
+    Padded slots see only zero feature columns, so their gradient is exactly
+    the regularization term at w=0, which is 0 — they stay 0 through any solve.
+
+    Device arrays stay on device (pad + reshard, no host round-trip) so
+    warm-starting from a previous sweep's sharded w never all-gathers the
+    full vector to the host.
+    """
+    import jax.numpy as jnp
+
+    w = jnp.asarray(w)
+    pad = padded_dim(w.shape[0], mesh, axis) - w.shape[0]
+    if pad:
+        w = jnp.pad(w, (0, pad))
+    return jax.device_put(w, NamedSharding(mesh, P(axis)))
+
+
+def shard_batch(batch: Batch, mesh: Mesh, axis: str = DATA_AXIS,
+                feature_axis: Optional[str] = None) -> Batch:
     """Place a batch with its example dimension sharded over ``axis``.
 
     Pads the example count up to a multiple of the axis size with weight-0
     rows (inert by the core masking contract), then device_puts each leaf with
     a NamedSharding.  This is the one-time data layout step that replaces the
     reference's per-step broadcast + shuffle choreography.
+
+    ``feature_axis``: additionally shard the feature dimension of a dense
+    design matrix (zero-padding d up to a multiple of the axis size) so the
+    margin matmul contracts over a sharded axis — GSPMD turns the row of
+    per-shard partial margins into one psum over ``feature_axis``.  Sparse
+    batches address w by global index and are deliberately left unsharded on
+    features (their w stays replicated; see parallel/fixed.py).
     """
     size = mesh.shape[axis]
     n = batch.num_examples
@@ -82,8 +131,11 @@ def shard_batch(batch: Batch, mesh: Mesh, axis: str = DATA_AXIS) -> Batch:
     row = P(axis)
 
     if isinstance(batch, DenseBatch):
+        x = _pad_rows(np.asarray(batch.x), target)
+        if feature_axis is not None:
+            x = _pad_cols(x, padded_dim(x.shape[1], mesh, feature_axis))
         return DenseBatch(
-            x=place(_pad_rows(np.asarray(batch.x), target), P(axis, None)),
+            x=place(x, P(axis, feature_axis)),
             y=place(_pad_rows(np.asarray(batch.y), target), row),
             offset=place(_pad_rows(np.asarray(batch.offset), target), row),
             weight=place(_pad_rows(np.asarray(batch.weight), target), row),

@@ -53,8 +53,11 @@ def pearson_scores(x: np.ndarray, y: np.ndarray, weight: np.ndarray) -> np.ndarr
     """|Pearson correlation| of each column of x with y over weighted samples.
 
     Reference LocalDataset.scala:185-247 computes the same score per entity to
-    rank features (constant columns — e.g. the intercept — get score 1 so they
-    are always kept, matching the reference's intercept carve-out).
+    rank features.  Near-constant columns carry no per-entity signal and score
+    0; the intercept's guaranteed survival is handled by the caller pinning
+    ``intercept_index`` (build_observed_indices), not by guessing which
+    constant column is the intercept — an entity-constant attribute feature
+    would otherwise hijack the carve-out.
     """
     w = weight / max(float(weight.sum()), 1e-12)
     mx = w @ x
@@ -69,12 +72,7 @@ def pearson_scores(x: np.ndarray, y: np.ndarray, weight: np.ndarray) -> np.ndarr
     with np.errstate(invalid="ignore", divide="ignore"):
         score = np.abs(cov) / np.where(denom > 0, denom, 1.0)
     out = np.where(denom > 0, score, 0.0)
-    # Only the FIRST constant column (the intercept) scores 1; later constant
-    # columns are redundant with it and score 0, as in the reference.
-    const_cols = np.nonzero(near_const)[0]
-    out[const_cols] = 0.0
-    if const_cols.size:
-        out[const_cols[0]] = 1.0
+    out[near_const] = 0.0
     return out
 
 
@@ -214,6 +212,10 @@ def project_buckets(
         raise ValueError(
             "features_to_samples_ratio / intercept_index apply only to "
             "INDEX_MAP projection; RANDOM would silently ignore them")
+    if kind == ProjectorType.INDEX_MAP and projected_dim is not None:
+        raise ValueError(
+            "projected_dim applies only to RANDOM projection; INDEX_MAP "
+            "derives its dimension from observed features per entity")
     new_buckets: List[Bucket] = []
     projections: List[object] = []
     shared: Optional[RandomProjection] = None

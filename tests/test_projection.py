@@ -43,11 +43,12 @@ def test_pearson_scores_match_numpy(rng):
     for j in range(d):
         expect = abs(np.corrcoef(x[:, j], y)[0, 1])
         assert got[j] == pytest.approx(expect, abs=1e-6)
-    # FIRST constant column scores 1 (the intercept carve-out); later constant
-    # columns are redundant with it and score 0 (reference LocalDataset rule)
+    # Constant columns carry no per-entity signal and score 0; the intercept's
+    # survival is the caller's intercept_index pin (build_observed_indices),
+    # so an entity-constant attribute feature can't hijack the carve-out.
     xc = np.concatenate([x, np.ones((n, 1)), np.full((n, 1), 2.0)], axis=1)
     s = pearson_scores(xc, y, w)
-    assert s[-2] == 1.0 and s[-1] == 0.0
+    assert s[-2] == 0.0 and s[-1] == 0.0
 
 
 def test_observed_projection_margin_exact(rng):
