@@ -1,0 +1,19 @@
+"""Model diagnostics.
+
+Reference: photon-diagnostics (SURVEY.md §2.4) — bootstrap confidence
+intervals (BootstrapTraining.scala:29-181), learning-curve fitting diagnostic
+(diagnostics/fitting/FittingDiagnostic.scala:33-131), Hosmer-Lemeshow
+calibration (diagnostics/hl/), feature importance
+(diagnostics/featureimportance/), Kendall-tau independence analysis
+(diagnostics/independence/KendallTauAnalysis.scala:131), and the
+logical→physical report tree with HTML rendering (diagnostics/reporting/**).
+"""
+
+from photon_ml_tpu.diagnostics.bootstrap import BootstrapReport, bootstrap_training  # noqa: F401
+from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic  # noqa: F401
+from photon_ml_tpu.diagnostics.hosmer_lemeshow import HosmerLemeshowReport, hosmer_lemeshow  # noqa: F401
+from photon_ml_tpu.diagnostics.feature_importance import (  # noqa: F401
+    FeatureImportanceReport, expected_magnitude_importance, variance_importance)
+from photon_ml_tpu.diagnostics.independence import KendallTauReport, kendall_tau_analysis  # noqa: F401
+from photon_ml_tpu.diagnostics.reporting import (  # noqa: F401
+    Chapter, Document, Section, render_html, render_text)

@@ -1,0 +1,56 @@
+"""Per-feature importance reports.
+
+Reference: photon-diagnostics diagnostics/featureimportance/*.scala —
+ExpectedMagnitudeFeatureImportanceDiagnostic (|w_j|·E[|x_j|]: how much a
+feature moves the margin in expectation) and
+VarianceFeatureImportanceDiagnostic (w_j²·Var[x_j]: margin-variance
+contribution), each reporting the top-k ranked features.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureImportanceReport:
+    kind: str
+    importance: np.ndarray  # [d]
+    ranked: List[Tuple[str, float]]  # top-k (name, importance) desc
+
+    def summary(self) -> str:
+        return "\n".join(f"{n}\t{v:.6g}" for n, v in self.ranked)
+
+
+def _rank(importance: np.ndarray, feature_names: Optional[Sequence[str]],
+          top_k: int) -> List[Tuple[str, float]]:
+    order = np.argsort(-importance)[:top_k]
+    names = feature_names if feature_names is not None else [str(i) for i in range(len(importance))]
+    return [(str(names[i]), float(importance[i])) for i in order]
+
+
+def expected_magnitude_importance(
+    coefficients: np.ndarray,
+    mean_abs_features: np.ndarray,
+    feature_names: Optional[Sequence[str]] = None,
+    top_k: int = 20,
+) -> FeatureImportanceReport:
+    """|w_j| · E[|x_j|] (reference ExpectedMagnitudeFeatureImportance)."""
+    imp = np.abs(np.asarray(coefficients, np.float64)) * np.asarray(mean_abs_features, np.float64)
+    return FeatureImportanceReport("expected_magnitude", imp,
+                                   _rank(imp, feature_names, top_k))
+
+
+def variance_importance(
+    coefficients: np.ndarray,
+    feature_variances: np.ndarray,
+    feature_names: Optional[Sequence[str]] = None,
+    top_k: int = 20,
+) -> FeatureImportanceReport:
+    """w_j² · Var[x_j] (reference VarianceFeatureImportance)."""
+    w = np.asarray(coefficients, np.float64)
+    imp = w * w * np.asarray(feature_variances, np.float64)
+    return FeatureImportanceReport("variance", imp, _rank(imp, feature_names, top_k))

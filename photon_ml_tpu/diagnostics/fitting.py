@@ -1,0 +1,71 @@
+"""Learning-curve (fitting) diagnostic.
+
+Reference: photon-diagnostics diagnostics/fitting/FittingDiagnostic.scala:33-131
+— train on growing fractions of the training set (default 10%..100%), compute
+each metric on the training portion and on a holdout, and report the two
+curves; diverging train/holdout curves indicate over/under-fitting.
+
+TPU-first: a "fraction" is a weight mask over the full static-shape batch (the
+first ⌈f·n⌉ examples keep their weight, the rest get 0) so every fraction
+reuses one compiled solve — no reshaping, no recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.core.batch import Batch
+from photon_ml_tpu.models.glm import GLMModel
+
+TrainFn = Callable[[Batch], GLMModel]
+# metric_fn(model, batch) -> float, evaluated on train portion and holdout
+MetricFn = Callable[[GLMModel, Batch], float]
+
+
+@dataclasses.dataclass(frozen=True)
+class FittingReport:
+    """metric -> (fractions, train curve, holdout curve)."""
+
+    fractions: np.ndarray  # [f]
+    train_metrics: Dict[str, np.ndarray]  # name -> [f]
+    holdout_metrics: Dict[str, np.ndarray]  # name -> [f]
+
+
+def fitting_diagnostic(
+    train_fn: TrainFn,
+    metrics: Dict[str, MetricFn],
+    train_batch: Batch,
+    holdout_batch: Batch,
+    fractions: Sequence[float] = (0.1, 0.25, 0.5, 0.75, 1.0),
+    seed: int = 0,
+) -> FittingReport:
+    """Train at each fraction; report train-vs-holdout metric curves.
+
+    Examples are shuffled once (seeded) then prefix-masked, so smaller
+    fractions are nested subsets of larger ones, as in the reference's
+    ``downSample`` chain.
+    """
+    weight = np.asarray(train_batch.weight)
+    alive = np.flatnonzero(weight > 0)
+    order = np.random.default_rng(seed).permutation(alive)
+
+    train_curves: Dict[str, List[float]] = {k: [] for k in metrics}
+    holdout_curves: Dict[str, List[float]] = {k: [] for k in metrics}
+    for f in fractions:
+        take = order[: max(1, int(round(f * len(order))))]
+        w = np.zeros_like(weight)
+        w[take] = weight[take]
+        sub = train_batch.replace(weight=w)
+        model = train_fn(sub)
+        for name, fn in metrics.items():
+            train_curves[name].append(float(fn(model, sub)))
+            holdout_curves[name].append(float(fn(model, holdout_batch)))
+
+    return FittingReport(
+        fractions=np.asarray(list(fractions)),
+        train_metrics={k: np.asarray(v) for k, v in train_curves.items()},
+        holdout_metrics={k: np.asarray(v) for k, v in holdout_curves.items()},
+    )

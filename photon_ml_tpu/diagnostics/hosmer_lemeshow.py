@@ -1,0 +1,102 @@
+"""Hosmer-Lemeshow calibration diagnostic for logistic models.
+
+Reference: photon-diagnostics diagnostics/hl/HosmerLemeshowDiagnostic.scala:98
++ binners — bin predicted probabilities (default deciles), compare observed
+positive counts against expected within each bin, form the χ² statistic
+Σ_bins [(O₁-E₁)²/E₁ + (O₀-E₀)²/E₀], and report the p-value against
+χ²(bins-2) plus the per-bin table.
+
+TPU-first: binning is one histogram pass (``np.digitize`` host-side or
+segment sums on device); no sort needed for equal-width bins; equal-mass
+(decile) bins use a quantile split of the scores.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+from scipy.stats import chi2
+
+
+@dataclasses.dataclass(frozen=True)
+class HosmerLemeshowReport:
+    bin_edges: np.ndarray  # [b+1]
+    observed_pos: np.ndarray  # [b] weighted positive counts
+    expected_pos: np.ndarray  # [b] sum of predicted probabilities
+    totals: np.ndarray  # [b] weighted example counts
+    chi_square: float
+    degrees_of_freedom: int
+    p_value: float
+
+    def summary(self) -> str:
+        lines = ["bin    total    obs+    exp+"]
+        for i in range(len(self.totals)):
+            lines.append(f"[{self.bin_edges[i]:.3f},{self.bin_edges[i+1]:.3f})"
+                         f"  {self.totals[i]:.1f}  {self.observed_pos[i]:.1f}"
+                         f"  {self.expected_pos[i]:.1f}")
+        lines.append(f"chi2={self.chi_square:.4f} df={self.degrees_of_freedom} "
+                     f"p={self.p_value:.4g}")
+        return "\n".join(lines)
+
+
+def hosmer_lemeshow(
+    probabilities: np.ndarray,
+    labels: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+    num_bins: int = 10,
+    equal_mass: bool = True,
+) -> HosmerLemeshowReport:
+    """HL χ² over probability bins (reference HosmerLemeshowDiagnostic).
+
+    ``equal_mass=True`` splits at score quantiles (the reference's default
+    decile binning); ``False`` uses equal-width bins on [0, 1].
+    """
+    p = np.asarray(probabilities, np.float64)
+    y = np.asarray(labels, np.float64)
+    w = np.ones_like(p) if weights is None else np.asarray(weights, np.float64)
+    keep = w > 0
+    p, y, w = p[keep], y[keep], w[keep]
+
+    if equal_mass:
+        qs = np.quantile(p, np.linspace(0.0, 1.0, num_bins + 1))
+        # collapse duplicate edges (heavy ties) to keep bins well-defined
+        edges = np.unique(qs)
+    else:
+        edges = np.linspace(0.0, 1.0, num_bins + 1)
+    edges = edges.copy()
+    edges[0], edges[-1] = -np.inf, np.inf
+    idx = np.digitize(p, edges[1:-1])
+
+    b = len(edges) - 1
+    totals = np.bincount(idx, weights=w, minlength=b)
+    obs_pos = np.bincount(idx, weights=w * y, minlength=b)
+    exp_pos = np.bincount(idx, weights=w * p, minlength=b)
+
+    if b < 3:
+        raise ValueError(
+            f"Hosmer-Lemeshow needs >= 3 distinct probability bins, got {b} "
+            "(scores are (near-)constant; the test is undefined, df = bins-2 <= 0)")
+
+    def _chi_terms(obs, exp):
+        # exp == 0 with obs > 0 is infinite evidence of miscalibration;
+        # exp == obs == 0 (empty bin) contributes nothing.
+        return np.where(exp > 0, (obs - exp) ** 2 / np.where(exp > 0, exp, 1.0),
+                        np.where(obs > 0, np.inf, 0.0))
+
+    exp_neg = totals - exp_pos
+    obs_neg = totals - obs_pos
+    chi = float(np.sum(_chi_terms(obs_pos, exp_pos) + _chi_terms(obs_neg, exp_neg)))
+    df = b - 2
+    finite_edges = edges.copy()
+    finite_edges[0], finite_edges[-1] = 0.0, 1.0
+    return HosmerLemeshowReport(
+        bin_edges=finite_edges,
+        observed_pos=obs_pos,
+        expected_pos=exp_pos,
+        totals=totals,
+        chi_square=chi,
+        degrees_of_freedom=df,
+        p_value=float(chi2.sf(chi, df)),
+    )

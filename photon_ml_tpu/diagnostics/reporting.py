@@ -1,0 +1,158 @@
+"""Logical→physical diagnostic report tree with HTML and text renderers.
+
+Reference: photon-diagnostics diagnostics/reporting/** (~45 files) — a
+logical document tree (Document/Chapter/Section containing Text/Plot items)
+rendered by pluggable strategies (xhtml renderer with JFreeChart plots, and a
+ToString renderer).  Here: the same tree with two renderers — plain text and
+self-contained HTML whose plots are inline SVG polylines (no image deps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html
+from typing import Dict, List, Sequence, Union
+
+
+@dataclasses.dataclass
+class Text:
+    body: str
+
+
+@dataclasses.dataclass
+class Table:
+    headers: List[str]
+    rows: List[List[str]]
+
+
+@dataclasses.dataclass
+class Plot:
+    """A line plot: shared x values, named y series."""
+
+    title: str
+    x: Sequence[float]
+    series: Dict[str, Sequence[float]]
+    x_label: str = ""
+    y_label: str = ""
+
+
+Item = Union[Text, Table, Plot]
+
+
+@dataclasses.dataclass
+class Section:
+    title: str
+    items: List[Item] = dataclasses.field(default_factory=list)
+
+    def add(self, item: Item) -> "Section":
+        self.items.append(item)
+        return self
+
+
+@dataclasses.dataclass
+class Chapter:
+    title: str
+    sections: List[Section] = dataclasses.field(default_factory=list)
+
+    def section(self, title: str) -> Section:
+        s = Section(title)
+        self.sections.append(s)
+        return s
+
+
+@dataclasses.dataclass
+class Document:
+    title: str
+    chapters: List[Chapter] = dataclasses.field(default_factory=list)
+
+    def chapter(self, title: str) -> Chapter:
+        c = Chapter(title)
+        self.chapters.append(c)
+        return c
+
+
+# -- renderers -----------------------------------------------------------------
+
+_SVG_W, _SVG_H, _PAD = 480, 240, 36
+_COLORS = ("#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e")
+
+
+def _svg_plot(plot: Plot) -> str:
+    xs = [float(v) for v in plot.x]
+    all_ys = [float(v) for ys in plot.series.values() for v in ys]
+    if not xs or not all_ys:
+        return "<svg/>"
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(all_ys), max(all_ys)
+    xr = (x1 - x0) or 1.0
+    yr = (y1 - y0) or 1.0
+
+    def sx(v): return _PAD + (v - x0) / xr * (_SVG_W - 2 * _PAD)
+    def sy(v): return _SVG_H - _PAD - (v - y0) / yr * (_SVG_H - 2 * _PAD)
+
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{_SVG_W}" height="{_SVG_H}">',
+             f'<text x="{_SVG_W//2}" y="16" text-anchor="middle" font-size="13">'
+             f"{html.escape(plot.title)}</text>",
+             f'<rect x="{_PAD}" y="{_PAD}" width="{_SVG_W-2*_PAD}" height="{_SVG_H-2*_PAD}" '
+             'fill="none" stroke="#999"/>']
+    for i, (name, ys) in enumerate(plot.series.items()):
+        pts = " ".join(f"{sx(x):.1f},{sy(float(y)):.1f}" for x, y in zip(xs, ys))
+        color = _COLORS[i % len(_COLORS)]
+        parts.append(f'<polyline points="{pts}" fill="none" stroke="{color}" stroke-width="1.5"/>')
+        parts.append(f'<text x="{_SVG_W-_PAD+4}" y="{_PAD+14*i+10}" font-size="11" '
+                     f'fill="{color}">{html.escape(name)}</text>')
+    parts.append(f'<text x="{_PAD}" y="{_SVG_H-8}" font-size="10">'
+                 f"[{x0:.3g}, {x1:.3g}] {html.escape(plot.x_label)}</text>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _html_item(item: Item) -> str:
+    if isinstance(item, Text):
+        return f"<p>{html.escape(item.body)}</p>"
+    if isinstance(item, Table):
+        head = "".join(f"<th>{html.escape(h)}</th>" for h in item.headers)
+        rows = "".join("<tr>" + "".join(f"<td>{html.escape(str(c))}</td>" for c in r) + "</tr>"
+                       for r in item.rows)
+        return f"<table border='1' cellspacing='0' cellpadding='3'><tr>{head}</tr>{rows}</table>"
+    if isinstance(item, Plot):
+        return _svg_plot(item)
+    raise TypeError(f"unknown report item {type(item)!r}")
+
+
+def render_html(doc: Document) -> str:
+    out = [f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
+           f"<title>{html.escape(doc.title)}</title></head><body>"
+           f"<h1>{html.escape(doc.title)}</h1>"]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        out.append(f"<h2>{ci}. {html.escape(chapter.title)}</h2>")
+        for si, section in enumerate(chapter.sections, 1):
+            out.append(f"<h3>{ci}.{si}. {html.escape(section.title)}</h3>")
+            out.extend(_html_item(item) for item in section.items)
+    out.append("</body></html>")
+    return "".join(out)
+
+
+def _text_item(item: Item) -> str:
+    if isinstance(item, Text):
+        return item.body
+    if isinstance(item, Table):
+        lines = ["\t".join(item.headers)]
+        lines += ["\t".join(str(c) for c in r) for r in item.rows]
+        return "\n".join(lines)
+    if isinstance(item, Plot):
+        lines = [f"[plot] {item.title}"]
+        for name, ys in item.series.items():
+            lines.append(f"  {name}: " + ", ".join(f"{float(y):.4g}" for y in ys))
+        return "\n".join(lines)
+    raise TypeError(f"unknown report item {type(item)!r}")
+
+
+def render_text(doc: Document) -> str:
+    out = [doc.title, "=" * len(doc.title)]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        out.append(f"\n{ci}. {chapter.title}")
+        for si, section in enumerate(chapter.sections, 1):
+            out.append(f"\n{ci}.{si}. {section.title}")
+            out.extend(_text_item(item) for item in section.items)
+    return "\n".join(out)

@@ -20,7 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.game.data import GameData
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only: avoids models<->game import cycle
+    from photon_ml_tpu.game.data import GameData
+
 from photon_ml_tpu.models.glm import Coefficients, GLMModel
 from photon_ml_tpu.parallel.bucketing import score_samples
 from photon_ml_tpu.types import TaskType

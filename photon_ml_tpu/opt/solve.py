@@ -26,6 +26,7 @@ from photon_ml_tpu.opt.lbfgs import minimize_lbfgs, minimize_owlqn
 from photon_ml_tpu.opt.tron import minimize_tron
 from photon_ml_tpu.opt.types import SolverConfig, SolverResult
 from photon_ml_tpu.types import OptimizerType, VarianceComputationType
+from photon_ml_tpu.utils.linalg import cholesky_inverse
 
 Array = jax.Array
 
@@ -97,8 +98,5 @@ def compute_variances(
         return 1.0 / jnp.where(d == 0, jnp.inf, d)
     if kind == VarianceComputationType.FULL:
         h = objective.hessian(w, batch)
-        eye = jnp.eye(h.shape[-1], dtype=h.dtype)
-        chol = jnp.linalg.cholesky(h)
-        hinv = jax.scipy.linalg.cho_solve((chol, True), eye)
-        return jnp.diagonal(hinv)
+        return jnp.diagonal(cholesky_inverse(h))
     raise ValueError(f"unknown variance computation type {kind!r}")

@@ -1,0 +1,9 @@
+"""Utilities: structured job logging, timing, lifecycle events, linalg helpers.
+
+Reference: photon-lib .../util/{PhotonLogger,Timed,Linalg}.scala and
+photon-client .../event/{Event,EventEmitter,EventListener}.scala.
+"""
+
+from photon_ml_tpu.utils.logging import PhotonLogger, Timed, timed  # noqa: F401
+from photon_ml_tpu.utils.events import Event, EventEmitter, EventListener  # noqa: F401
+from photon_ml_tpu.utils.linalg import cholesky_inverse  # noqa: F401

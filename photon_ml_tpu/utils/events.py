@@ -1,0 +1,79 @@
+"""Training lifecycle event pub-sub.
+
+Reference: photon-client .../event/{Event.scala:64, EventEmitter.scala:20-73,
+EventListener.scala:32} — drivers emit lifecycle events (training start/end,
+phase transitions, metric reports) to listeners registered by class name via
+reflection.  Here listeners register as callables or ``EventListener``
+subclasses; name-based registration resolves ``module:Class`` strings so CLI
+flags can wire listeners the way the reference's reflection path did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import time
+from typing import Any, Callable, Dict, List, Union
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """A lifecycle event (reference Event.scala): name + payload + timestamp."""
+
+    name: str
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+class EventListener:
+    """Listener contract (reference EventListener.scala:32)."""
+
+    def on_event(self, event: Event) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class _CallableListener(EventListener):
+    def __init__(self, fn: Callable[[Event], None]):
+        self._fn = fn
+
+    def on_event(self, event: Event) -> None:
+        self._fn(event)
+
+
+class EventEmitter:
+    """Emitter mixin/base (reference EventEmitter.scala:20-73).
+
+    ``register`` accepts an ``EventListener``, a plain callable, or a
+    ``"module.path:ClassName"`` string (the reference registers listener
+    classes by reflected name, Driver.scala:95-104).
+    """
+
+    def __init__(self) -> None:
+        self._listeners: List[EventListener] = []
+
+    def register(self, listener: Union[EventListener, Callable[[Event], None], str]) -> EventListener:
+        if isinstance(listener, str):
+            module_name, sep, class_name = listener.partition(":")
+            if not sep or not class_name:
+                raise ValueError(
+                    f"listener spec {listener!r} must be 'module.path:ClassName'")
+            cls = getattr(importlib.import_module(module_name), class_name)
+            listener = cls()
+        if not isinstance(listener, EventListener):
+            listener = _CallableListener(listener)
+        self._listeners.append(listener)
+        return listener
+
+    def emit(self, name: str, **payload: Any) -> Event:
+        event = Event(name=name, payload=payload)
+        for listener in self._listeners:
+            listener.on_event(event)
+        return event
+
+    def close_listeners(self) -> None:
+        for listener in self._listeners:
+            listener.close()
+        self._listeners.clear()

@@ -1,0 +1,39 @@
+"""Dense linear-algebra helpers.
+
+Reference: util/Linalg.scala:104 ``choleskyInverse`` (used for FULL variance:
+diag(H^-1) via Cholesky, DistributedOptimizationProblem.scala:84-108) — there
+backed by netlib-java LAPACK; here by XLA's ``cholesky`` +
+``triangular_solve`` so it runs on-device and fuses under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def cholesky_inverse(a: Array, jitter: float = 0.0) -> Array:
+    """Inverse of a symmetric positive-definite matrix via Cholesky.
+
+    ``jitter`` adds ``jitter * I`` first (GP kernel matrices need it;
+    reference GaussianProcessEstimator adds a noise nugget).
+    """
+    a = jnp.asarray(a)
+    if jitter:
+        a = a + jitter * jnp.eye(a.shape[-1], dtype=a.dtype)
+    chol = jnp.linalg.cholesky(a)
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+    inv_l = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
+    return inv_l.T @ inv_l
+
+
+def solve_psd(a: Array, b: Array, jitter: float = 0.0) -> Array:
+    """Solve ``a x = b`` for symmetric positive-definite ``a`` via Cholesky."""
+    a = jnp.asarray(a)
+    if jitter:
+        a = a + jitter * jnp.eye(a.shape[-1], dtype=a.dtype)
+    chol = jnp.linalg.cholesky(a)
+    y = jax.scipy.linalg.solve_triangular(chol, b, lower=True)
+    return jax.scipy.linalg.solve_triangular(chol.T, y, lower=False)

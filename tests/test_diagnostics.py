@@ -1,0 +1,162 @@
+"""Diagnostics subsystem tests (reference photon-diagnostics, SURVEY.md §2.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.losses import logistic_loss, squared_loss
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.diagnostics import (
+    Document, bootstrap_training, expected_magnitude_importance,
+    fitting_diagnostic, hosmer_lemeshow, kendall_tau_analysis, render_html,
+    render_text, variance_importance)
+from photon_ml_tpu.diagnostics.bootstrap import bagged_model, bootstrap_weights
+from photon_ml_tpu.diagnostics.reporting import Plot, Table, Text
+from photon_ml_tpu.models.glm import Coefficients, GLMModel
+from photon_ml_tpu.opt.solve import make_solver
+from photon_ml_tpu.types import TaskType
+
+
+def _linear_batch(rng, n=512, d=4, noise=0.05):
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    w_true = np.arange(1, d + 1, dtype=np.float64)
+    y = x @ w_true + noise * rng.normal(size=n)
+    return DenseBatch(x=jnp.asarray(x), y=jnp.asarray(y),
+                      offset=jnp.zeros(n), weight=jnp.ones(n)), w_true
+
+
+def _linear_train_fn():
+    obj = GLMObjective(loss=squared_loss, reg=Regularization(l2=1e-6))
+    solve = jax.jit(make_solver(obj))
+
+    def train(batch):
+        res = solve(jnp.zeros(batch.dim, batch.x.dtype), batch)
+        return GLMModel(coefficients=Coefficients(means=np.asarray(res.w)),
+                        task=TaskType.LINEAR_REGRESSION)
+
+    return train
+
+
+class TestBootstrap:
+    def test_weights_preserve_total_and_padding(self, rng):
+        w = np.ones(100)
+        w[-10:] = 0.0
+        bw = bootstrap_weights(rng, w)
+        assert bw[-10:].sum() == 0.0
+        assert bw.sum() == pytest.approx(90.0)  # multinomial total = n alive
+
+    def test_intervals_cover_truth(self, rng):
+        batch, w_true = _linear_batch(rng)
+        report = bootstrap_training(_linear_train_fn(), batch, num_replicates=16,
+                                    seed=3)
+        lo, hi = report.coefficient_intervals[:, 0], report.coefficient_intervals[:, 1]
+        # a 95% CI can marginally miss per-coordinate; allow noise-scale slack
+        assert np.all(lo - 0.05 <= w_true) and np.all(w_true <= hi + 0.05)
+        assert np.all(lo < hi)
+        assert np.all(hi - lo < 0.5)  # tight on easy data
+        bag = bagged_model(report, TaskType.LINEAR_REGRESSION)
+        np.testing.assert_allclose(bag.coefficients.means, w_true, atol=0.1)
+
+    def test_metric_distributions(self, rng):
+        batch, _ = _linear_batch(rng, n=128)
+
+        def rmse_on_train(model):
+            pred = np.asarray(model.predict(batch.x))
+            return float(np.sqrt(np.mean((pred - np.asarray(batch.y)) ** 2)))
+
+        report = bootstrap_training(_linear_train_fn(), batch, num_replicates=4,
+                                    metrics={"rmse": rmse_on_train}, seed=0)
+        assert report.metric_distributions["rmse"].shape == (4,)
+        mean, std = report.metric_summary()["rmse"]
+        assert 0 <= mean < 0.2
+
+
+class TestFitting:
+    def test_learning_curves(self, rng):
+        train_batch, _ = _linear_batch(rng, n=400, noise=0.5)
+        holdout, _ = _linear_batch(rng, n=200, noise=0.5)
+
+        def rmse(model, batch):
+            w = np.asarray(batch.weight)
+            pred = np.asarray(model.predict(batch.x))
+            err = (pred - np.asarray(batch.y)) ** 2
+            return float(np.sqrt((w * err).sum() / w.sum()))
+
+        report = fitting_diagnostic(_linear_train_fn(), {"rmse": rmse},
+                                    train_batch, holdout,
+                                    fractions=(0.1, 0.5, 1.0), seed=1)
+        assert report.train_metrics["rmse"].shape == (3,)
+        # more data -> holdout metric should improve (or stay flat)
+        h = report.holdout_metrics["rmse"]
+        assert h[-1] <= h[0] + 0.05
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_vs_miscalibrated(self, rng):
+        n = 20000
+        p = rng.uniform(0.05, 0.95, size=n)
+        y = (rng.random(n) < p).astype(np.float64)
+        good = hosmer_lemeshow(p, y)
+        assert good.p_value > 1e-3  # calibrated: cannot reject
+        # squash probabilities toward 0.5 -> miscalibrated
+        bad = hosmer_lemeshow(0.5 + 0.25 * (p - 0.5), y)
+        assert bad.chi_square > good.chi_square * 5
+        assert bad.p_value < 1e-6
+        assert good.totals.sum() == pytest.approx(n)
+        assert "chi2=" in good.summary()
+
+    def test_equal_width_bins(self, rng):
+        p = rng.uniform(0, 1, size=1000)
+        y = (rng.random(1000) < p).astype(np.float64)
+        rep = hosmer_lemeshow(p, y, num_bins=5, equal_mass=False)
+        assert len(rep.totals) == 5
+
+
+class TestFeatureImportance:
+    def test_rankings(self):
+        w = np.array([0.1, -2.0, 0.5])
+        mean_abs = np.array([1.0, 1.0, 1.0])
+        var = np.array([1.0, 0.01, 4.0])
+        em = expected_magnitude_importance(w, mean_abs, feature_names=["a", "b", "c"])
+        assert em.ranked[0][0] == "b"
+        vi = variance_importance(w, var)
+        # w^2*var: a=0.01, b=0.04, c=1.0
+        assert vi.ranked[0][0] == "2"
+        assert "\t" in em.summary()
+
+
+class TestKendallTau:
+    def test_independent_vs_dependent(self, rng):
+        n = 2000
+        pred = rng.normal(size=n)
+        indep = kendall_tau_analysis(pred, pred + rng.normal(size=n))
+        assert abs(indep.tau) < 0.05
+        # monotone residual structure: error grows with prediction
+        # (tau is a rank statistic — it detects monotone dependence)
+        dep = kendall_tau_analysis(pred, pred * 1.5 + 0.1 * rng.normal(size=n))
+        assert abs(dep.tau) > 0.3
+        assert indep.num_samples == n
+
+    def test_subsampling(self, rng):
+        pred = rng.normal(size=500)
+        rep = kendall_tau_analysis(pred, pred + rng.normal(size=500), max_samples=100)
+        assert rep.num_samples == 100
+
+
+class TestReporting:
+    def test_render_html_and_text(self):
+        doc = Document("GLM diagnostics")
+        ch = doc.chapter("Fit quality")
+        sec = ch.section("Learning curve")
+        sec.add(Text("train vs holdout RMSE"))
+        sec.add(Table(["fraction", "rmse"], [["0.1", "1.2"], ["1.0", "0.9"]]))
+        sec.add(Plot("rmse", [0.1, 0.5, 1.0],
+                     {"train": [1.0, 0.8, 0.7], "holdout": [1.2, 1.0, 0.9]}))
+        html_out = render_html(doc)
+        assert "<h2>1. Fit quality</h2>" in html_out
+        assert "<svg" in html_out and "polyline" in html_out
+        text_out = render_text(doc)
+        assert "1.1. Learning curve" in text_out and "[plot] rmse" in text_out

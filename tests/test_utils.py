@@ -1,0 +1,71 @@
+"""Utils tests: PhotonLogger, Timed, EventEmitter, linalg helpers."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.utils import (Event, EventEmitter, EventListener,
+                                 PhotonLogger, Timed, cholesky_inverse, timed)
+from photon_ml_tpu.utils.linalg import solve_psd
+
+
+class TestLogging:
+    def test_photon_logger_writes_file(self, tmp_path):
+        path = str(tmp_path / "out" / "log-message.txt")
+        with PhotonLogger(path, name="test.photon") as log:
+            log.info("phase %s done", "train")
+            log.logger.handlers[0].flush()
+        with open(path) as f:
+            assert "phase train done" in f.read()
+
+    def test_timed_sink(self):
+        seen = {}
+        with Timed("phase", sink=lambda label, s: seen.update({label: s})):
+            pass
+        assert "phase" in seen and seen["phase"] >= 0
+
+    def test_timed_decorator(self):
+        @timed("work")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+
+
+class TestEvents:
+    def test_emit_and_listen(self):
+        emitter = EventEmitter()
+        got = []
+        emitter.register(lambda e: got.append(e))
+        ev = emitter.emit("training_start", task="logistic")
+        assert got == [ev]
+        assert got[0].payload["task"] == "logistic"
+
+    def test_register_by_name(self):
+        emitter = EventEmitter()
+        listener = emitter.register(
+            "photon_ml_tpu.utils.events:EventListener")
+        assert isinstance(listener, EventListener)
+        emitter.close_listeners()
+
+
+class TestLinalg:
+    def test_cholesky_inverse(self, rng):
+        a = rng.normal(size=(6, 6))
+        spd = a @ a.T + 6 * np.eye(6)
+        inv = np.asarray(cholesky_inverse(spd))
+        np.testing.assert_allclose(inv, np.linalg.inv(spd), atol=1e-8)
+
+    def test_solve_psd(self, rng):
+        a = rng.normal(size=(5, 5))
+        spd = a @ a.T + 5 * np.eye(5)
+        b = rng.normal(size=5)
+        x = np.asarray(solve_psd(spd, b))
+        np.testing.assert_allclose(spd @ x, b, atol=1e-8)
+
+    def test_jitter(self):
+        near_singular = np.zeros((3, 3))
+        inv = np.asarray(cholesky_inverse(near_singular, jitter=1.0))
+        np.testing.assert_allclose(inv, np.eye(3), atol=1e-10)
