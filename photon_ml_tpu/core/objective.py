@@ -60,6 +60,18 @@ class GLMObjective:
     loss: PointwiseLoss = struct.field(pytree_node=False)
     reg: Regularization = Regularization()
     norm: NormalizationContext = struct.field(default_factory=no_normalization)
+    # Opt-in pallas fused kernels (ops/fused_glm.py): X streams through VMEM
+    # once per value_and_grad / hvp instead of 2-3 XLA passes.  Dense batches
+    # on TPU with lane-aligned dim only; silently identical math otherwise.
+    fused: bool = struct.field(pytree_node=False, default=False)
+
+    @staticmethod
+    def _fused_eligible(batch: Batch) -> bool:
+        """Trace-time gate for the pallas kernels; ineligible batches fall
+        through to the reference XLA path below (single home for that math)."""
+        from photon_ml_tpu.ops.fused_glm import eligible
+
+        return eligible(batch)
 
     # -- margins ----------------------------------------------------------------
 
@@ -112,6 +124,15 @@ class GLMObjective:
     def value_and_grad(self, w: Array, batch: Batch) -> Tuple[Array, Array]:
         """Reference ValueAndGradientAggregator.calculateValueAndGradient:240-255,
         collapsed to one fused pass."""
+        if self.fused and self._fused_eligible(batch):
+            from photon_ml_tpu.ops.fused_glm import fused_value_and_grad
+
+            raw_val, g_raw, r_sum = fused_value_and_grad(
+                self.loss, self.norm.effective_coefficients(w), batch,
+                margin_shift=self.norm.margin_shift(w))
+            val = raw_val.astype(w.dtype) + self.l2_term(w)
+            g = self._chain(g_raw.astype(w.dtype), r_sum.astype(w.dtype)) + self.reg.l2 * w
+            return val, g
         z = self._safe_margins(w, batch)
         l, d1 = self.loss.loss_and_d1(z, batch.y)
         val = jnp.sum(batch.weight * l) + self.l2_term(w)
@@ -127,6 +148,15 @@ class GLMObjective:
     def hvp(self, w: Array, batch: Batch, v: Array) -> Array:
         """H·v = Xn^T diag(weight · l'') Xn v + l2·v
         (reference HessianVectorAggregator.calcHessianVector:30-80)."""
+        if self.fused and self._fused_eligible(batch):
+            from photon_ml_tpu.ops.fused_glm import fused_hvp
+
+            eff_v = self.norm.effective_coefficients(v)
+            hv_raw, q_sum = fused_hvp(
+                self.loss, self.norm.effective_coefficients(w), eff_v, batch,
+                margin_shift=self.norm.margin_shift(w),
+                v_shift=self.norm.margin_shift(v))
+            return self._chain(hv_raw.astype(w.dtype), q_sum.astype(w.dtype)) + self.reg.l2 * v
         z = self._safe_margins(w, batch)
         eff_v = self.norm.effective_coefficients(v)
         # margin directional derivative: factor*(x - shift)·v

@@ -106,6 +106,13 @@ class FixedEffectCoordinate(Coordinate):
         )
         if mesh is not None:
             batch = shard_batch(batch, mesh)
+        else:
+            # One-time row padding to the fused-kernel block granule so the
+            # pallas path never re-pads (and re-copies X) per solver call.
+            from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
+
+            if eligible(batch):
+                batch = _pad_rows(batch, _pick_block_rows(*batch.x.shape))
         self._batch = batch
         self._padded_n = batch.num_examples
         self._base_weight = batch.weight
@@ -116,8 +123,12 @@ class FixedEffectCoordinate(Coordinate):
         self._score = jax.jit(lambda w: batch.x @ w)
 
     def _bind_solver(self) -> None:
+        # Single-chip path uses the pallas fused kernels (ops/fused_glm.py):
+        # X streams through VMEM once per value_and_grad instead of 2-3 XLA
+        # passes.  Under a mesh the objective is auto-partitioned by XLA and a
+        # pallas custom-call cannot be, so fused stays off there.
         objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
-                                 norm=self._norm)
+                                 norm=self._norm, fused=self.mesh is None)
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
         batch = self._batch
 

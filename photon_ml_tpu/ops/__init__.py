@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+The framework's hot loop (SURVEY.md §3.2) is one fused pass over the design
+matrix per optimizer iteration: margins -> pointwise loss -> residuals ->
+gradient reduction (reference ValueAndGradientAggregator.scala:137-161 runs it
+one datum at a time on executors; XLA runs it as matmul + elementwise +
+transposed matmul).  The pallas kernels here stream each row-block of X
+through VMEM ONCE, computing the margin matmul, the loss/residual VPU work,
+and the gradient back-matmul per block — halving HBM traffic for X, the
+usual bottleneck.
+"""
+
+from photon_ml_tpu.ops.fused_glm import (  # noqa: F401
+    eligible, fused_hvp, fused_value_and_grad, has_tpu)

@@ -1,0 +1,119 @@
+"""Pallas fused-kernel parity tests (interpret mode on the CPU test mesh).
+
+The kernels must be bit-for-bit the same *math* as GLMObjective's reference
+path; tolerances cover f64 summation-order differences only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.losses import (logistic_loss, poisson_loss,
+                                       smoothed_hinge_loss, squared_loss)
+from photon_ml_tpu.core.normalization import NormalizationContext, no_normalization
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.ops.fused_glm import (_pad_rows, _pick_block_rows, fused_hvp,
+                                         fused_value_and_grad)
+
+LOSSES = [logistic_loss, squared_loss, poisson_loss, smoothed_hinge_loss]
+
+
+def _batch(rng, loss, n=100, d=12):
+    x = rng.normal(size=(n, d)) * 0.3
+    if loss is logistic_loss or loss is smoothed_hinge_loss:
+        y = (rng.random(n) < 0.5).astype(np.float64)
+    elif loss is poisson_loss:
+        y = rng.poisson(2.0, size=n).astype(np.float64)
+    else:
+        y = rng.normal(size=n)
+    weight = rng.uniform(0.5, 2.0, size=n)
+    weight[: n // 10] = 0.0  # padded/masked rows
+    return DenseBatch(x=jnp.asarray(x), y=jnp.asarray(y),
+                      offset=jnp.asarray(rng.normal(size=n) * 0.1),
+                      weight=jnp.asarray(weight))
+
+
+def _norm(rng, d):
+    return NormalizationContext(factors=jnp.asarray(rng.uniform(0.5, 2.0, size=d)),
+                                shifts=jnp.asarray(rng.normal(size=d) * 0.2))
+
+
+@pytest.mark.parametrize("loss", LOSSES, ids=lambda l: l.name)
+@pytest.mark.parametrize("normed", [False, True], ids=["nonorm", "norm"])
+def test_value_grad_parity(rng, loss, normed):
+    batch = _batch(rng, loss)
+    norm = _norm(rng, batch.dim) if normed else no_normalization()
+    obj = GLMObjective(loss=loss, reg=Regularization(l2=0.1), norm=norm)
+    w = jnp.asarray(rng.normal(size=batch.dim) * 0.2)
+
+    ref_val, ref_grad = obj.value_and_grad(w, batch)
+    w_eff = norm.effective_coefficients(w)
+    val, g_raw, r_sum = fused_value_and_grad(loss, w_eff, batch,
+                                             margin_shift=norm.margin_shift(w),
+                                             block_rows=32, interpret=True)
+    got_val = val + obj.l2_term(w)
+    got_grad = obj._chain(g_raw, r_sum) + 0.1 * w
+    np.testing.assert_allclose(got_val, ref_val, rtol=1e-12)
+    np.testing.assert_allclose(got_grad, ref_grad, rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("loss", [logistic_loss, poisson_loss], ids=lambda l: l.name)
+def test_hvp_parity(rng, loss):
+    batch = _batch(rng, loss)
+    norm = _norm(rng, batch.dim)
+    obj = GLMObjective(loss=loss, reg=Regularization(l2=0.05), norm=norm)
+    w = jnp.asarray(rng.normal(size=batch.dim) * 0.2)
+    v = jnp.asarray(rng.normal(size=batch.dim))
+
+    ref = obj.hvp(w, batch, v)
+    hv_raw, q_sum = fused_hvp(loss, norm.effective_coefficients(w),
+                              norm.effective_coefficients(v), batch,
+                              margin_shift=norm.margin_shift(w),
+                              v_shift=norm.margin_shift(v),
+                              block_rows=32, interpret=True)
+    got = obj._chain(hv_raw, q_sum) + 0.05 * v
+    np.testing.assert_allclose(got, ref, rtol=1e-10, atol=1e-12)
+
+
+def test_row_padding_is_invisible(rng):
+    batch = _batch(rng, squared_loss, n=70)  # 70 % 32 != 0 -> padded to 96
+    padded = _pad_rows(batch, 32)
+    assert padded.num_examples == 96
+    assert float(jnp.sum(padded.weight[70:])) == 0.0
+    w = jnp.asarray(rng.normal(size=batch.dim))
+    obj = GLMObjective(loss=squared_loss)
+    v_ref, g_ref = obj.value_and_grad(w, batch)
+    v, g, r = fused_value_and_grad(squared_loss, w, batch, block_rows=32, interpret=True)
+    np.testing.assert_allclose(v, v_ref, rtol=1e-12)
+    np.testing.assert_allclose(g, g_ref, rtol=1e-10)
+
+
+def test_ineligible_raises(rng):
+    batch = _batch(rng, squared_loss, n=16)
+    w = jnp.asarray(rng.normal(size=batch.dim))
+    with pytest.raises(ValueError, match="eligible"):
+        fused_value_and_grad(squared_loss, w, batch)  # CPU, unaligned dim
+
+
+def test_pick_block_rows():
+    assert _pick_block_rows(10_000, 128) % 128 == 0
+    assert _pick_block_rows(4, 128) >= 128
+    # huge d -> smallest legal block, the lane granule
+    assert _pick_block_rows(10_000, 1 << 20) == 128
+
+
+def test_objective_fused_flag_cpu_fallback(rng):
+    """fused=True on CPU uses the XLA fallback — same results, still jittable."""
+    batch = _batch(rng, logistic_loss)
+    w = jnp.asarray(rng.normal(size=batch.dim) * 0.1)
+    plain = GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.01))
+    fused = GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.01), fused=True)
+    v1, g1 = jax.jit(plain.value_and_grad)(w, batch)
+    v2, g2 = jax.jit(fused.value_and_grad)(w, batch)
+    np.testing.assert_allclose(v1, v2, rtol=1e-12)
+    np.testing.assert_allclose(g1, g2, rtol=1e-12)
+    np.testing.assert_allclose(plain.hvp(w, batch, g1), fused.hvp(w, batch, g2),
+                               rtol=1e-12)
