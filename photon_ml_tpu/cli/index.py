@@ -25,6 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--feature-shards", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--no-intercept", action="store_true")
+    p.add_argument("--format", choices=["idx", "store"], default="idx",
+                   help="'idx' = compact dict-loaded format; 'store' = "
+                        "mmap'd off-heap PHIDX002 store (PalDB equivalent, "
+                        "for huge vocabularies)")
     return p
 
 
@@ -36,8 +40,14 @@ def run(argv: List[str]) -> int:
                                       add_intercept=not args.no_intercept)
     os.makedirs(args.output_dir, exist_ok=True)
     for shard, m in maps.items():
-        path = os.path.join(args.output_dir, f"{shard}.idx")
-        m.save(path)
+        if args.format == "store":
+            from photon_ml_tpu.data.native_index import build_store
+
+            path = os.path.join(args.output_dir, f"{shard}.phidx")
+            build_store(path, m)
+        else:
+            path = os.path.join(args.output_dir, f"{shard}.idx")
+            m.save(path)
         logger.info("shard %s: %d features -> %s", shard, m.size, path)
     return 0
 

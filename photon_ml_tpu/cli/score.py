@@ -49,8 +49,11 @@ def run(argv: List[str]) -> int:
     index_maps = {}
     entity_indexes = {}
     for name in os.listdir(args.model_dir):
-        if name.endswith(".idx"):
-            index_maps[name[:-4]] = IndexMap.load(os.path.join(args.model_dir, name))
+        if name.endswith(".idx") or name.endswith(".phidx"):
+            from photon_ml_tpu.data.index_map import load_index
+
+            shard = name.rsplit(".", 1)[0]
+            index_maps[shard] = load_index(os.path.join(args.model_dir, name))
         elif name.endswith(".entities.json"):
             entity_indexes[name[: -len(".entities.json")]] = EntityIndex.load(
                 os.path.join(args.model_dir, name))

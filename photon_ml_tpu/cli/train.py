@@ -86,8 +86,16 @@ def run(argv: List[str]) -> int:
     for path in args.train_data:
         train_records.extend(read_directory(path))
     if args.index_map_dir:
-        index_maps = {s: IndexMap.load(os.path.join(args.index_map_dir, f"{s}.idx"))
-                      for s in shards}
+        from photon_ml_tpu.data.index_map import load_index
+
+        def _resolve(s):
+            for ext in (".idx", ".phidx"):
+                p = os.path.join(args.index_map_dir, s + ext)
+                if os.path.exists(p):
+                    return load_index(p)
+            raise FileNotFoundError(f"no index map for shard {s!r} in {args.index_map_dir}")
+
+        index_maps = {s: _resolve(s) for s in shards}
     else:
         logger.info("building index maps from training data")
         index_maps = build_index_maps_from_records(
@@ -145,7 +153,10 @@ def run(argv: List[str]) -> int:
     save_game_model(best.model, os.path.join(args.output_dir, "best"),
                     index_maps, entity_indexes, task)
     for s in shards:
-        index_maps[s].save(os.path.join(args.output_dir, f"{s}.idx"))
+        from photon_ml_tpu.data.native_index import StoreIndexMap
+
+        ext = ".phidx" if isinstance(index_maps[s], StoreIndexMap) else ".idx"
+        index_maps[s].save(os.path.join(args.output_dir, f"{s}{ext}"))
     for tag, eidx in entity_indexes.items():
         eidx.save(os.path.join(args.output_dir, f"{tag}.entities.json"))
     summary = {

@@ -126,6 +126,21 @@ class IndexMap:
         return cls(fwd)
 
 
+def load_index(path: str):
+    """Open an index file of either format, dispatching on its magic bytes:
+    PHIDX001 (compact, dict-loaded) or PHIDX002 (mmap off-heap store —
+    the PalDB-equivalent, data/native_index.py)."""
+    with open(path, "rb") as f:
+        magic = f.read(8)
+    if magic == MAGIC:
+        return IndexMap.load(path)
+    from photon_ml_tpu.data.native_index import MAGIC2, StoreIndexMap
+
+    if magic == MAGIC2:
+        return StoreIndexMap(path)
+    raise ValueError(f"{path}: unknown index map format {magic!r}")
+
+
 def build_index_maps_from_records(
     records: Iterable[dict],
     shards: Iterable[str],

@@ -1,0 +1,250 @@
+"""Off-heap feature index store (PalDB replacement) — Python side.
+
+Reference: photon-api .../index/PalDBIndexMap.scala:16-278 (off-heap store,
+binary-search reverse lookup) + PalDBIndexMapBuilder/Loader.  Here the store
+is one mmap'd file with a precomputed open-addressing table
+(native/index_store.cpp); this module provides:
+
+- ``StoreIndexMap``: IndexMap-compatible reader backed by the C++ library
+  when g++ is available, else a pure-Python mmap prober on the SAME file —
+  either way the key data stays off the Python heap (contrast
+  ``IndexMap.load`` which materializes a dict).
+- ``build_store``: writer (from an IndexMap or an iterable of keys).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import struct
+from typing import Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key, split_key
+from photon_ml_tpu.data.schemas import INTERCEPT_NAME, INTERCEPT_TERM
+from photon_ml_tpu.native.build import compile_library
+
+MAGIC2 = b"PHIDX002"
+
+_lib = None
+_lib_tried = False
+
+
+def _native_lib():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = compile_library("index_store")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.phidx_build.restype = ctypes.c_int64
+    lib.phidx_build.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                ctypes.c_void_p, ctypes.c_int64]
+    lib.phidx_open.restype = ctypes.c_void_p
+    lib.phidx_open.argtypes = [ctypes.c_char_p]
+    lib.phidx_size.restype = ctypes.c_int64
+    lib.phidx_size.argtypes = [ctypes.c_void_p]
+    lib.phidx_get.restype = ctypes.c_int64
+    lib.phidx_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.phidx_get_batch.restype = None
+    lib.phidx_get_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.phidx_name.restype = ctypes.c_int64
+    lib.phidx_name.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.POINTER(ctypes.c_void_p),
+                               ctypes.POINTER(ctypes.c_int64)]
+    lib.phidx_close.restype = None
+    lib.phidx_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def _pack_keys(keys: List[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    for i, k in enumerate(keys):
+        offsets[i + 1] = offsets[i] + len(k)
+    blob = np.frombuffer(b"".join(keys), np.uint8) if keys else np.zeros(0, np.uint8)
+    return blob.copy(), offsets
+
+
+def build_store(path: str, source: Union[IndexMap, Iterable[str]]) -> None:
+    """Write a PHIDX002 store from an IndexMap (id order preserved) or an
+    iterable of keys (ids assigned in iteration order)."""
+    if isinstance(source, IndexMap):
+        rev: List[Optional[str]] = [None] * source.size
+        for k, i in source.items():
+            rev[i] = k
+        keys = [k.encode("utf-8") for k in rev]  # type: ignore[union-attr]
+    else:
+        keys = [k.encode("utf-8") for k in source]
+    blob, offsets = _pack_keys(keys)
+
+    lib = _native_lib()
+    if lib is not None:
+        rc = lib.phidx_build(path.encode(), blob.ctypes.data, offsets.ctypes.data,
+                             len(keys))
+        if rc != 0:
+            raise ValueError(f"phidx_build failed with code {rc} (duplicate keys?)")
+        return
+    _py_build(path, blob, offsets, len(keys))
+
+
+# -- pure-python writer/reader on the same format ------------------------------
+
+_FNV_OFF, _FNV_PRIME, _MASK64 = 1469598103934665603, 1099511628211, (1 << 64) - 1
+
+
+def _fnv1a(data: bytes) -> int:
+    h = _FNV_OFF
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h
+
+
+def _py_build(path: str, blob: np.ndarray, offsets: np.ndarray, n: int) -> None:
+    table_size = 8
+    while table_size < max(8, 2 * n):
+        table_size <<= 1
+    mask = table_size - 1
+    slots = np.full(table_size, -1, np.int64)
+    raw = blob.tobytes()
+    for idx in range(n):
+        key = raw[offsets[idx]: offsets[idx + 1]]
+        i = _fnv1a(key) & mask
+        while slots[i] >= 0:
+            other = slots[i]
+            if raw[offsets[other]: offsets[other + 1]] == key:
+                raise ValueError(f"duplicate key {key!r}")
+            i = (i + 1) & mask
+        slots[i] = idx
+    with open(path, "wb") as f:
+        f.write(MAGIC2)
+        f.write(struct.pack("<qq", n, table_size))
+        f.write(slots.tobytes())
+        f.write(offsets[: n + 1].tobytes())
+        f.write(raw[: int(offsets[n])])
+
+
+class StoreIndexMap:
+    """IndexMap-compatible reader over a PHIDX002 store.
+
+    Native path: C++ mmap + ctypes (zero-copy, off-heap).  Fallback: Python
+    mmap with the same probing — still off-heap (no dict materialization).
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._handle = None
+        self._mm: Optional[mmap.mmap] = None
+        lib = _native_lib()
+        if lib is not None:
+            handle = lib.phidx_open(path.encode())
+            if not handle:
+                raise ValueError(f"{path}: cannot open PHIDX002 store")
+            self._handle = handle
+            self._n = int(lib.phidx_size(handle))
+            return
+        f = open(path, "rb")
+        self._mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        f.close()
+        if self._mm[:8] != MAGIC2:
+            raise ValueError(f"{path}: not a PHIDX002 store")
+        self._n, self._table_size = struct.unpack_from("<qq", self._mm, 8)
+        self._slots_off = 24
+        self._offsets_off = self._slots_off + 8 * self._table_size
+        self._blob_off = self._offsets_off + 8 * (self._n + 1)
+        # reject truncated/corrupt stores (same checks as phidx_open)
+        ts, n = self._table_size, self._n
+        if n < 0 or ts < 8 or ts & (ts - 1) or n > ts or self._blob_off > len(self._mm):
+            raise ValueError(f"{path}: corrupt PHIDX002 store header")
+        (blob_len,) = struct.unpack_from("<q", self._mm, self._offsets_off + 8 * n)
+        if blob_len < 0 or self._blob_off + blob_len > len(self._mm):
+            raise ValueError(f"{path}: truncated PHIDX002 store")
+
+    # -- IndexMap contract --------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self._n
+
+    def get_index(self, name: str, term: str = "") -> int:
+        return self.get_key(feature_key(name, term))
+
+    def get_key(self, key: str) -> int:
+        kb = key.encode("utf-8")
+        if self._handle is not None:
+            return int(_native_lib().phidx_get(self._handle, kb, len(kb)))
+        return self._py_probe(kb)
+
+    def get_indices(self, keys: Iterable[str]) -> np.ndarray:
+        """Vectorized lookup (the data-load hot path: every (name, term) of
+        every record resolves through this)."""
+        enc = [k.encode("utf-8") for k in keys]
+        if self._handle is not None:
+            blob, offsets = _pack_keys(enc)
+            out = np.empty(len(enc), np.int64)
+            _native_lib().phidx_get_batch(self._handle, blob.ctypes.data,
+                                          offsets.ctypes.data, len(enc),
+                                          out.ctypes.data)
+            return out
+        return np.asarray([self._py_probe(k) for k in enc], np.int64)
+
+    def get_feature_name(self, idx: int) -> Optional[Tuple[str, str]]:
+        if not 0 <= idx < self._n:
+            return None
+        if self._handle is not None:
+            lib = _native_lib()
+            ptr, ln = ctypes.c_void_p(), ctypes.c_int64()
+            if not lib.phidx_name(self._handle, idx, ctypes.byref(ptr), ctypes.byref(ln)):
+                return None
+            raw = ctypes.string_at(ptr.value, ln.value)
+        else:
+            o0, o1 = struct.unpack_from("<qq", self._mm, self._offsets_off + 8 * idx)
+            raw = self._mm[self._blob_off + o0: self._blob_off + o1]
+        return split_key(raw.decode("utf-8"))
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        i = self.get_index(INTERCEPT_NAME, INTERCEPT_TERM)
+        return None if i < 0 else i
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_key(key) >= 0
+
+    def _py_probe(self, key: bytes) -> int:
+        mask = self._table_size - 1
+        i = _fnv1a(key) & mask
+        while True:
+            (idx,) = struct.unpack_from("<q", self._mm, self._slots_off + 8 * i)
+            if idx < 0:
+                return -1
+            o0, o1 = struct.unpack_from("<qq", self._mm, self._offsets_off + 8 * idx)
+            if self._mm[self._blob_off + o0: self._blob_off + o1] == key:
+                return int(idx)
+            i = (i + 1) & mask
+
+    def save(self, path: str) -> None:
+        """Persist = copy the backing store file (drivers re-save maps next
+        to trained models; IndexMap.save parity)."""
+        import shutil
+
+        if os.path.abspath(path) != os.path.abspath(self._path):
+            shutil.copyfile(self._path, path)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            _native_lib().phidx_close(self._handle)
+            self._handle = None
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+
+    def __enter__(self) -> "StoreIndexMap":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,10 @@
+"""Native (C++) runtime components.
+
+The reference's native-performance substrate is JVM-adjacent (netlib BLAS via
+JNI, PalDB off-heap stores — SURVEY.md §2.7); this package holds the C++
+equivalents for the host-side runtime.  Compiled lazily with g++ into shared
+libraries loaded via ctypes; every consumer has a pure-Python fallback so the
+framework works without a toolchain.
+"""
+
+from photon_ml_tpu.native.build import compile_library, library_path  # noqa: F401

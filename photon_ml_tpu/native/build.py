@@ -1,0 +1,55 @@
+"""Lazy g++ compilation of the native components.
+
+One .so per translation unit, cached next to the source with an mtime check.
+No pybind11 in this image — C ABI + ctypes only (plain-C signatures keep the
+boundary trivially stable).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_FLAGS = ["-O2", "-shared", "-fPIC", "-std=c++17", "-Wall"]
+
+
+def library_path(name: str) -> str:
+    return os.path.join(_DIR, f"_lib{name}.so")
+
+
+def compile_library(name: str, force: bool = False) -> Optional[str]:
+    """Compile native/<name>.cpp -> native/_lib<name>.so; None if unavailable.
+
+    Rebuilds when the source is newer than the cached .so.  Compiles to a
+    temp file then renames (atomic on POSIX) so concurrent processes never
+    load a half-written library.
+    """
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = library_path(name)
+    if not os.path.exists(src):
+        return None
+    if not force and os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        subprocess.run(["g++", *_FLAGS, "-o", tmp, src], check=True,
+                       capture_output=True, text=True)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.CalledProcessError, OSError) as e:
+        # OSError covers both a missing g++ and an unwritable package dir —
+        # either way the pure-python fallback takes over.
+        stderr = getattr(e, "stderr", "") or str(e)
+        logger.warning("native build of %s failed (pure-python fallback): %s",
+                       name, stderr.strip()[:500])
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)
+        return None

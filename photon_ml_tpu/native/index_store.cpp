@@ -1,0 +1,204 @@
+// Off-heap feature index store: the PalDB replacement.
+//
+// Reference: photon-api .../index/PalDBIndexMap.scala:16-278 — the reference
+// keeps ~1e8-entry feature name<->index maps OFF the JVM heap in PalDB stores
+// shared by executors.  TPU-native equivalent: one mmap'd file holding an
+// open-addressing hash table over an id-ordered key blob.  Lookups touch two
+// cache lines (slot + key bytes); no load/deserialize step; the page cache
+// shares the store across processes the way PalDB shared it across executors.
+//
+// File layout (PHIDX002, little-endian):
+//   0   8B   magic "PHIDX002"
+//   8   i64  n               (number of keys; ids are 0..n-1)
+//   16  i64  table_size      (power of two, >= 2n)
+//   24  i64  slots[table_size]   key id, or -1 for empty
+//   ..  i64  offsets[n + 1]      byte offsets into blob, id-ordered
+//   ..  u8   blob[]              concatenated utf-8 keys
+//
+// C ABI only (consumed via ctypes).  Thread-safe for concurrent reads.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'H', 'I', 'D', 'X', '0', '0', '2'};
+
+inline uint64_t fnv1a(const uint8_t* data, int64_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+inline int64_t next_pow2(int64_t x) {
+  int64_t p = 1;
+  while (p < x) p <<= 1;
+  return p;
+}
+
+struct Store {
+  void* map = nullptr;
+  size_t map_len = 0;
+  int64_t n = 0;
+  int64_t table_size = 0;
+  const int64_t* slots = nullptr;
+  const int64_t* offsets = nullptr;
+  const uint8_t* blob = nullptr;
+};
+
+inline int64_t probe(const Store* s, const uint8_t* key, int64_t len) {
+  const uint64_t mask = static_cast<uint64_t>(s->table_size - 1);
+  uint64_t i = fnv1a(key, len) & mask;
+  while (true) {
+    const int64_t id = s->slots[i];
+    if (id < 0 || id >= s->n) return -1;  // empty (or corrupt slot)
+    const int64_t off = s->offsets[id];
+    const int64_t klen = s->offsets[id + 1] - off;
+    if (klen == len && std::memcmp(s->blob + off, key, len) == 0) return id;
+    i = (i + 1) & mask;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build the store file from an id-ordered key blob + offsets (offsets has
+// n+1 entries).  Returns 0 on success, negative errno-style codes otherwise.
+int64_t phidx_build(const char* path, const uint8_t* blob,
+                    const int64_t* offsets, int64_t n) {
+  if (n < 0) return -1;
+  const int64_t table_size = next_pow2(n < 4 ? 8 : 2 * n);
+  const uint64_t mask = static_cast<uint64_t>(table_size - 1);
+
+  int64_t* slots = new int64_t[table_size];
+  for (int64_t i = 0; i < table_size; ++i) slots[i] = -1;
+  for (int64_t id = 0; id < n; ++id) {
+    const int64_t off = offsets[id];
+    const int64_t len = offsets[id + 1] - off;
+    uint64_t i = fnv1a(blob + off, len) & mask;
+    while (slots[i] >= 0) {
+      const int64_t other = slots[i];
+      const int64_t ooff = offsets[other];
+      if (offsets[other + 1] - ooff == len &&
+          std::memcmp(blob + ooff, blob + off, len) == 0) {
+        delete[] slots;
+        return -2;  // duplicate key
+      }
+      i = (i + 1) & mask;
+    }
+    slots[i] = id;
+  }
+
+  FILE* f = std::fopen(path, "wb");
+  if (!f) {
+    delete[] slots;
+    return -3;
+  }
+  int64_t ok = 1;
+  ok &= std::fwrite(kMagic, 1, 8, f) == 8;
+  ok &= std::fwrite(&n, 8, 1, f) == 1;
+  ok &= std::fwrite(&table_size, 8, 1, f) == 1;
+  ok &= std::fwrite(slots, 8, static_cast<size_t>(table_size), f) ==
+        static_cast<size_t>(table_size);
+  ok &= std::fwrite(offsets, 8, static_cast<size_t>(n + 1), f) ==
+        static_cast<size_t>(n + 1);
+  const int64_t blob_len = offsets[n];
+  if (blob_len > 0)
+    ok &= std::fwrite(blob, 1, static_cast<size_t>(blob_len), f) ==
+          static_cast<size_t>(blob_len);
+  delete[] slots;
+  if (std::fclose(f) != 0 || !ok) return -4;
+  return 0;
+}
+
+void* phidx_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 24) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);  // mapping persists
+  if (map == MAP_FAILED) return nullptr;
+  const uint8_t* base = static_cast<const uint8_t*>(map);
+  if (std::memcmp(base, kMagic, 8) != 0) {
+    munmap(map, st.st_size);
+    return nullptr;
+  }
+  int64_t n, table_size;
+  std::memcpy(&n, base + 8, 8);
+  std::memcpy(&table_size, base + 16, 8);
+  // Reject truncated/corrupt stores BEFORE handing out pointers: a file cut
+  // mid-write still has valid magic; probing it would fault off the mapping.
+  bool ok = n >= 0 && table_size >= 8 &&
+            (table_size & (table_size - 1)) == 0 &&
+            table_size <= (1LL << 40) && n <= table_size;
+  const int64_t fixed = 24 + 8 * table_size + 8 * (n + 1);
+  ok = ok && fixed <= st.st_size;
+  if (ok) {
+    const int64_t* offs = reinterpret_cast<const int64_t*>(base + 24 + 8 * table_size);
+    int64_t prev = 0;
+    for (int64_t i = 0; i <= n && ok; ++i) {
+      ok = offs[i] >= prev;
+      prev = offs[i];
+    }
+    ok = ok && fixed + (n >= 0 ? offs[n] : 0) <= st.st_size;
+  }
+  if (!ok) {
+    munmap(map, st.st_size);
+    return nullptr;
+  }
+  Store* s = new Store;
+  s->map = map;
+  s->map_len = st.st_size;
+  s->n = n;
+  s->table_size = table_size;
+  s->slots = reinterpret_cast<const int64_t*>(base + 24);
+  s->offsets = s->slots + s->table_size;
+  s->blob = reinterpret_cast<const uint8_t*>(s->offsets + s->n + 1);
+  return s;
+}
+
+int64_t phidx_size(const void* h) { return static_cast<const Store*>(h)->n; }
+
+int64_t phidx_get(const void* h, const uint8_t* key, int64_t len) {
+  return probe(static_cast<const Store*>(h), key, len);
+}
+
+// Batch lookup: keys packed as blob + (nkeys+1) offsets; ids written to out.
+void phidx_get_batch(const void* h, const uint8_t* keys, const int64_t* offs,
+                     int64_t nkeys, int64_t* out) {
+  const Store* s = static_cast<const Store*>(h);
+  for (int64_t i = 0; i < nkeys; ++i)
+    out[i] = probe(s, keys + offs[i], offs[i + 1] - offs[i]);
+}
+
+// Reverse lookup: pointer+length of key bytes for an id (0 on bad id).
+int64_t phidx_name(const void* h, int64_t id, const uint8_t** ptr,
+                   int64_t* len) {
+  const Store* s = static_cast<const Store*>(h);
+  if (id < 0 || id >= s->n) return 0;
+  const int64_t off = s->offsets[id];
+  *ptr = s->blob + off;
+  *len = s->offsets[id + 1] - off;
+  return 1;
+}
+
+void phidx_close(void* h) {
+  Store* s = static_cast<Store*>(h);
+  munmap(s->map, s->map_len);
+  delete s;
+}
+
+}  // extern "C"

@@ -51,9 +51,15 @@ def _pick_block_rows(n: int, d: int, vmem_budget_bytes: int = 1 << 20) -> int:
     """Multiple of 128: block_rows is the LANE dim of the (3, bn) yow block
     (and the sublane dim of the X block), so 128 is the only always-legal
     granule.  Budget counts only the X tile; double-buffering + accumulators
-    bring actual VMEM use to ~3-4x this, against the ~16MB/core limit."""
-    rows = max(_LANE, min(n, vmem_budget_bytes // max(4 * d, 1)))
-    return int(max(_LANE, (rows // _LANE) * _LANE))
+    bring actual VMEM use to ~3-4x this, against the ~16MB/core limit.
+
+    IDEMPOTENT under its own padding: pick(pad(n, pick(n))) == pick(n), so a
+    caller that pre-pads once (FixedEffectCoordinate) never re-pads per call.
+    """
+    budget_rows = max(_LANE, (vmem_budget_bytes // max(4 * d, 1) // _LANE) * _LANE)
+    if n <= budget_rows:
+        return int(-(-max(n, 1) // _LANE) * _LANE)  # one block: ceil to 128
+    return int(budget_rows)
 
 
 def _pad_rows(batch: DenseBatch, block_rows: int) -> DenseBatch:

@@ -143,6 +143,33 @@ def test_index_driver(tmp_path):
     assert m.intercept_index == 0
 
 
+def test_index_driver_store_format(tmp_path):
+    """--format store builds the off-heap PHIDX002 store and training loads it."""
+    from photon_ml_tpu.cli import index as index_cli
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.data.index_map import load_index
+    from photon_ml_tpu.data.native_index import StoreIndexMap
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=80)
+    out = str(tmp_path / "idx")
+    rc = index_cli.run(["--data", train_path, "--feature-shards", "all",
+                        "--output-dir", out, "--format", "store"])
+    assert rc == 0
+    m = load_index(os.path.join(out, "all.phidx"))
+    assert isinstance(m, StoreIndexMap)
+    assert m.size == 5 and m.intercept_index == 0
+
+    model_dir = str(tmp_path / "model")
+    rc = train_cli.run([
+        "--train-data", train_path, "--task", "LOGISTIC_REGRESSION",
+        "--feature-shards", "all", "--index-map-dir", out,
+        "--coordinate", "name=global,feature.shard=all,reg.weights=1.0",
+        "--output-dir", model_dir])
+    assert rc == 0
+    assert os.path.exists(os.path.join(model_dir, "all.phidx"))
+
+
 def test_train_rejects_invalid_data(tmp_path):
     from photon_ml_tpu.cli import train as train_cli
 

@@ -1,0 +1,114 @@
+"""Native (C++) off-heap index store tests (reference PalDBIndexMap parity)."""
+
+import numpy as np
+import pytest
+
+import photon_ml_tpu.data.native_index as ni
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.native_index import StoreIndexMap, build_store
+
+
+@pytest.fixture(scope="module")
+def store_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("idx") / "features.phidx")
+    imap = IndexMap.build([feature_key(f"f{i}", f"t{i % 7}") for i in range(1000)])
+    build_store(path, imap)
+    return path, imap
+
+
+class TestNativeStore:
+    def test_native_lib_compiles(self):
+        assert ni._native_lib() is not None, "g++ compile of index_store.cpp failed"
+
+    def test_forward_lookup_parity(self, store_path):
+        path, imap = store_path
+        with StoreIndexMap(path) as store:
+            assert store.size == imap.size
+            for k, i in list(imap.items())[::97]:
+                assert store.get_key(k) == i
+            assert store.get_index("f3", "t3") == imap.get_index("f3", "t3")
+            assert store.get_index("nope") == -1
+            assert store.intercept_index == imap.intercept_index
+
+    def test_reverse_lookup(self, store_path):
+        path, imap = store_path
+        with StoreIndexMap(path) as store:
+            for i in range(0, imap.size, 131):
+                assert store.get_feature_name(i) == imap.get_feature_name(i)
+            assert store.get_feature_name(-1) is None
+            assert store.get_feature_name(imap.size) is None
+
+    def test_batch_lookup(self, store_path):
+        path, imap = store_path
+        keys = [feature_key(f"f{i}", f"t{i % 7}") for i in (0, 5, 999)] + ["missing"]
+        with StoreIndexMap(path) as store:
+            got = store.get_indices(keys)
+        want = np.asarray([imap.get_index(*k.split("\x1f")) for k in keys[:3]] + [-1])
+        np.testing.assert_array_equal(got, want)
+
+    def test_duplicate_keys_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            build_store(str(tmp_path / "dup.phidx"), ["a", "b", "a"])
+
+    def test_python_fallback_same_file(self, store_path, monkeypatch):
+        """The pure-python prober reads files written by the C++ builder."""
+        path, imap = store_path
+        monkeypatch.setattr(ni, "_lib", None)
+        monkeypatch.setattr(ni, "_lib_tried", True)
+        with StoreIndexMap(path) as store:
+            assert store._handle is None  # really on the fallback
+            assert store.size == imap.size
+            assert store.get_key(feature_key("f42", "t0")) == imap.get_index("f42", "t0")
+            assert store.get_key("missing") == -1
+            assert store.get_feature_name(3) == imap.get_feature_name(3)
+            got = store.get_indices([feature_key("f1", "t1"), "zzz"])
+            np.testing.assert_array_equal(
+                got, [imap.get_index("f1", "t1"), -1])
+
+    def test_python_builder_native_reader(self, tmp_path, store_path):
+        """And the C++ prober reads files written by the python builder."""
+        if ni._native_lib() is None:
+            pytest.skip("no native lib")
+        path = str(tmp_path / "py.phidx")
+        ni._py_build(path, *ni._pack_keys([b"alpha", b"beta"]), 2)
+        with StoreIndexMap(path) as store:
+            assert store.get_key("alpha") == 0
+            assert store.get_key("beta") == 1
+            assert store.get_key("gamma") == -1
+
+    def test_empty_store(self, tmp_path):
+        path = str(tmp_path / "empty.phidx")
+        build_store(path, [])
+        with StoreIndexMap(path) as store:
+            assert store.size == 0
+            assert store.get_key("anything") == -1
+
+    def test_truncated_store_rejected(self, tmp_path, store_path):
+        """A file cut mid-write has valid magic; both readers must refuse it
+        instead of faulting off the mapping."""
+        src, _ = store_path
+        data = open(src, "rb").read()
+        trunc = str(tmp_path / "trunc.phidx")
+        with open(trunc, "wb") as f:
+            f.write(data[: len(data) // 3])
+        with pytest.raises(ValueError):
+            StoreIndexMap(trunc)
+        # pure-python reader path too
+        import photon_ml_tpu.data.native_index as mod
+        orig, orig_tried = mod._lib, mod._lib_tried
+        try:
+            mod._lib, mod._lib_tried = None, True
+            with pytest.raises(ValueError):
+                StoreIndexMap(trunc)
+        finally:
+            mod._lib, mod._lib_tried = orig, orig_tried
+
+    def test_large_store_smoke(self, tmp_path):
+        n = 200_000
+        path = str(tmp_path / "big.phidx")
+        keys = [f"name{i}\x1fterm{i % 13}" for i in range(n)]
+        build_store(path, keys)
+        with StoreIndexMap(path) as store:
+            assert store.size == n
+            idx = store.get_indices([keys[0], keys[n // 2], keys[-1], "x"])
+            np.testing.assert_array_equal(idx, [0, n // 2, n - 1, -1])

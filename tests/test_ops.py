@@ -105,6 +105,17 @@ def test_pick_block_rows():
     assert _pick_block_rows(10_000, 1 << 20) == 128
 
 
+@pytest.mark.parametrize("n", [4, 100, 2000, 2048, 5000, 131072])
+@pytest.mark.parametrize("d", [128, 512, 4096])
+def test_pick_block_rows_idempotent_under_padding(n, d):
+    """pick(pad(n)) must divide the padded n — otherwise the coordinate's
+    one-time pre-pad still re-pads (full X copy) inside every jitted call."""
+    bn = _pick_block_rows(n, d)
+    n_pad = n + (-n) % bn
+    assert _pick_block_rows(n_pad, d) == bn
+    assert n_pad % bn == 0
+
+
 def test_objective_fused_flag_cpu_fallback(rng):
     """fused=True on CPU uses the XLA fallback — same results, still jittable."""
     batch = _batch(rng, logistic_loss)
