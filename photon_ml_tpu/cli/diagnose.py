@@ -1,0 +1,230 @@
+"""Model diagnostics driver: bootstrap CIs, learning curve, calibration,
+feature importance, residual independence -> HTML + text report.
+
+Reference: the legacy Driver's DIAGNOSED stage (photon-client Driver.scala:431,
+photon-diagnostics **) — bootstrap training, fitting diagnostic,
+Hosmer-Lemeshow, feature importance, Kendall-tau, rendered via the reporting
+tree (diagnostics/reporting/**).  Operates on a trained model dir (the
+training driver's output) plus the data it was trained on.
+
+Usage:
+  python -m photon_ml_tpu.cli.diagnose \\
+    --data train.avro --holdout val.avro --model-dir out \\
+    --coordinate fixed --output-dir out/diagnostics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.core.batch import DenseBatch, dense_batch
+from photon_ml_tpu.core.losses import loss_for_task
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.data.index_map import load_index
+from photon_ml_tpu.data.reader import EntityIndex, read_game_data_avro
+from photon_ml_tpu.diagnostics import (bootstrap_training, expected_magnitude_importance,
+                                       fitting_diagnostic, hosmer_lemeshow,
+                                       kendall_tau_analysis, render_html, render_text,
+                                       variance_importance)
+from photon_ml_tpu.diagnostics.reporting import Chapter, Document, Plot, Table, Text
+from photon_ml_tpu.models.glm import Coefficients, GLMModel
+from photon_ml_tpu.opt.solve import make_solver
+from photon_ml_tpu.storage.model_io import load_game_model
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger("photon_ml_tpu.diagnose")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-tpu-diagnose",
+                                description="Diagnose a trained GAME model")
+    p.add_argument("--data", nargs="+", required=True, help="training data (Avro)")
+    p.add_argument("--holdout", nargs="*", default=[],
+                   help="holdout data for the fitting diagnostic")
+    p.add_argument("--model-dir", required=True,
+                   help="training driver output dir (best/, *.idx, ...)")
+    p.add_argument("--coordinate", default=None,
+                   help="fixed-effect coordinate to diagnose (default: the only one)")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--bootstrap-replicates", type=int, default=16)
+    p.add_argument("--l2", type=float, default=1.0,
+                   help="L2 weight for the diagnostic re-trains")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--top-k", type=int, default=20)
+    return p
+
+
+def _load_dir(model_dir):
+    index_maps, entity_indexes = {}, {}
+    for name in os.listdir(model_dir):
+        if name.endswith(".idx") or name.endswith(".phidx"):
+            index_maps[name.rsplit(".", 1)[0]] = load_index(os.path.join(model_dir, name))
+        elif name.endswith(".entities.json"):
+            entity_indexes[name[: -len(".entities.json")]] = EntityIndex.load(
+                os.path.join(model_dir, name))
+    model, task = load_game_model(os.path.join(model_dir, "best"),
+                                  index_maps, entity_indexes)
+    return model, task, index_maps, entity_indexes
+
+
+def _dense_batch(data, shard: str) -> DenseBatch:
+    return dense_batch(data.features[shard], data.y, data.offset, data.weight,
+                       dtype=np.float64)
+
+
+def run(argv: List[str]) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    model, task, index_maps, entity_indexes = _load_dir(args.model_dir)
+
+    from photon_ml_tpu.models.game import FixedEffectModel
+
+    fixed = {cid: m for cid, m in model.models.items()
+             if isinstance(m, FixedEffectModel)}
+    if not fixed:
+        logger.error("no fixed-effect coordinate in the model")
+        return 1
+    cid = args.coordinate or next(iter(fixed))
+    if cid not in fixed:
+        logger.error("coordinate %r not found (have: %s)", cid, sorted(fixed))
+        return 1
+    fe = fixed[cid]
+    shard = fe.feature_shard
+    imap = index_maps[shard]
+    loss = loss_for_task(task)
+
+    id_tags = sorted(entity_indexes)
+    data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
+                                  entity_indexes=entity_indexes)
+    batch = _dense_batch(data, shard)
+    logger.info("diagnosing coordinate %r on %d samples", cid, data.num_samples)
+
+    obj = GLMObjective(loss=loss, reg=Regularization(l2=args.l2))
+    solve = jax.jit(make_solver(obj))
+
+    def train_fn(b):
+        res = solve(jnp.zeros(b.dim, b.x.dtype), b)
+        return GLMModel(coefficients=Coefficients(means=np.asarray(res.w)), task=task)
+
+    def point_metric(m, b):
+        z = np.asarray(m.coefficients.score(b.x)) + np.asarray(b.offset)
+        w = np.asarray(b.weight)
+        l = np.asarray(loss.loss(jnp.asarray(z), b.y))
+        return float((w * l).sum() / max(w.sum(), 1e-12))
+
+    doc = Document(f"Diagnostics: coordinate {cid!r} ({task.value})")
+
+    def _label(j: int) -> str:
+        nm = imap.get_feature_name(int(j))
+        return f"{nm[0]}:{nm[1]}" if nm else str(j)
+
+    names = [_label(j) for j in range(batch.dim)]
+
+    # 1. bootstrap confidence intervals (BootstrapTraining.scala:29-181)
+    report = bootstrap_training(train_fn, batch, num_replicates=args.bootstrap_replicates,
+                                metrics={"mean_loss": lambda m: point_metric(m, batch)},
+                                seed=args.seed)
+    ch = doc.chapter("Bootstrap")
+    sec = ch.section(f"Coefficient {95.0:.0f}% intervals ({args.bootstrap_replicates} replicates)")
+    rows = []
+    order = np.argsort(-np.abs(report.coefficient_means))[: args.top_k]
+    for j in order:
+        lo, hi = report.coefficient_intervals[j]
+        rows.append([names[j], f"{report.coefficient_means[j]:.5g}",
+                     f"{lo:.5g}", f"{hi:.5g}"])
+    sec.add(Table(["feature", "mean", "lo", "hi"], rows))
+    mean, std = report.metric_summary()["mean_loss"]
+    sec.add(Text(f"bootstrap mean loss: {mean:.6g} ± {std:.3g}"))
+
+    # 2. learning curve (FittingDiagnostic.scala:33-131)
+    fit_payload = None
+    if args.holdout:
+        holdout_data, _ = read_game_data_avro(args.holdout, index_maps,
+                                              id_tag_names=id_tags,
+                                              entity_indexes=entity_indexes)
+        fit = fitting_diagnostic(train_fn, {"mean_loss": point_metric}, batch,
+                                 _dense_batch(holdout_data, shard), seed=args.seed)
+        sec = doc.chapter("Fitting").section("Learning curve (train vs holdout)")
+        sec.add(Plot("mean loss vs training fraction", list(fit.fractions),
+                     {"train": list(fit.train_metrics["mean_loss"]),
+                      "holdout": list(fit.holdout_metrics["mean_loss"])},
+                     x_label="fraction"))
+        fit_payload = {"fractions": fit.fractions.tolist(),
+                       "train": fit.train_metrics["mean_loss"].tolist(),
+                       "holdout": fit.holdout_metrics["mean_loss"].tolist()}
+
+    # predictions of the ACTUAL trained model for calibration/independence
+    margins = np.asarray(fe.coefficients.score(batch.x)) + np.asarray(batch.offset)
+    preds = np.asarray(loss.mean(jnp.asarray(margins)))
+    y = np.asarray(batch.y)
+
+    # 3. calibration (logistic only; HosmerLemeshowDiagnostic)
+    hl_payload = None
+    if task == TaskType.LOGISTIC_REGRESSION:
+        try:
+            hl = hosmer_lemeshow(preds, y, np.asarray(batch.weight))
+            sec = doc.chapter("Calibration").section("Hosmer-Lemeshow")
+            sec.add(Text(f"chi2={hl.chi_square:.4f} df={hl.degrees_of_freedom} "
+                         f"p={hl.p_value:.4g}"))
+            sec.add(Table(["bin_lo", "bin_hi", "total", "obs+", "exp+"],
+                          [[f"{hl.bin_edges[i]:.3f}", f"{hl.bin_edges[i+1]:.3f}",
+                            f"{hl.totals[i]:.1f}", f"{hl.observed_pos[i]:.1f}",
+                            f"{hl.expected_pos[i]:.1f}"]
+                           for i in range(len(hl.totals))]))
+            hl_payload = {"chi_square": hl.chi_square, "df": hl.degrees_of_freedom,
+                          "p_value": hl.p_value}
+        except ValueError as e:
+            logger.warning("Hosmer-Lemeshow skipped: %s", e)
+
+    # 4. feature importance (featureimportance/*)
+    x_np = np.asarray(batch.x)
+    em = expected_magnitude_importance(np.asarray(fe.coefficients.means),
+                                       np.abs(x_np).mean(0), names, args.top_k)
+    vi = variance_importance(np.asarray(fe.coefficients.means),
+                             x_np.var(0), names, args.top_k)
+    ch = doc.chapter("Feature importance")
+    ch.section("Expected magnitude |w|*E|x|").add(
+        Table(["feature", "importance"], [[n, f"{v:.5g}"] for n, v in em.ranked]))
+    ch.section("Variance w^2*Var[x]").add(
+        Table(["feature", "importance"], [[n, f"{v:.5g}"] for n, v in vi.ranked]))
+
+    # 5. residual independence (KendallTauAnalysis.scala)
+    kt = kendall_tau_analysis(preds, y, seed=args.seed)
+    doc.chapter("Residuals").section("Kendall tau (prediction vs error)").add(
+        Text(kt.summary()))
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    with open(os.path.join(args.output_dir, "report.html"), "w") as f:
+        f.write(render_html(doc))
+    with open(os.path.join(args.output_dir, "report.txt"), "w") as f:
+        f.write(render_text(doc))
+    summary = {
+        "coordinate": cid,
+        "bootstrap": {"replicates": report.num_replicates,
+                      "mean_loss": [mean, std]},
+        "fitting": fit_payload,
+        "hosmer_lemeshow": hl_payload,
+        "kendall_tau": {"tau": kt.tau, "p_value": kt.p_value},
+    }
+    with open(os.path.join(args.output_dir, "diagnostics.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    logger.info("report -> %s", os.path.join(args.output_dir, "report.html"))
+    return 0
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

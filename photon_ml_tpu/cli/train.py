@@ -65,6 +65,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GP hyperparameter tuning iterations (0 = off)")
     p.add_argument("--tuning-mode", default="bayesian", choices=["bayesian", "random"])
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model-input-dir", default=None,
+                   help="existing model dir for warm start "
+                        "(reference GameTrainingDriver modelInputDirectory)")
+    p.add_argument("--lock-coordinates", default="",
+                   help="comma-separated coordinate ids kept from the input "
+                        "model and only re-scored (partial retraining, "
+                        "reference partialRetrainLockedCoordinates)")
+    p.add_argument("--event-listener", action="append", default=[], dest="event_listeners",
+                   help="'module.path:ClassName' lifecycle EventListener (repeatable)")
     return p
 
 
@@ -73,6 +82,28 @@ def run(argv: List[str]) -> int:
     args = build_parser().parse_args(argv)
     t_start = time.time()
     task = TaskType[args.task]
+
+    # Job log next to the outputs + lifecycle events
+    # (reference PhotonLogger @ GameTrainingDriver.scala:840-841; EventEmitter).
+    from photon_ml_tpu.utils import EventEmitter, PhotonLogger
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    # handler on the PACKAGE logger: descent/coordinate/etc records propagate
+    # up the 'photon_ml_tpu.*' hierarchy into the job log
+    job_log = PhotonLogger(os.path.join(args.output_dir, "log-message.txt"),
+                           name="photon_ml_tpu")
+    emitter = EventEmitter()
+    for spec in args.event_listeners:
+        emitter.register(spec)
+    emitter.emit("training_start", task=args.task, output_dir=args.output_dir)
+    try:
+        return _run(args, task, t_start, emitter)
+    finally:
+        emitter.close_listeners()
+        job_log.close()
+
+
+def _run(args, task, t_start, emitter) -> int:
     shards = [s for s in args.feature_shards.split(",") if s]
     id_tags = [s for s in args.id_tags.split(",") if s]
     specs = [parse_coordinate_spec(s) for s in args.coordinates]
@@ -130,9 +161,45 @@ def run(argv: List[str]) -> int:
              if args.evaluators else None)
     est = GameEstimator(validation_suite=suite)
 
+    # Warm start / partial retraining (reference GameTrainingDriver.scala:370-379
+    # -> GameEstimator initialModel + partial retraining :106-112).
+    initial_model = None
+    locked = {c for c in args.lock_coordinates.split(",") if c} or None
+    if locked:
+        known = {cid for cfg in configs for cid in cfg.coordinates}
+        bad = locked - known
+        if bad:
+            logger.error("--lock-coordinates %s not among configured coordinates %s",
+                         sorted(bad), sorted(known))
+            return 1
+    if args.model_input_dir:
+        from photon_ml_tpu.storage.model_io import load_game_model
+
+        # accept either the training output dir (contains best/) or a model
+        # dir itself (contains metadata.json)
+        mdir = args.model_input_dir
+        if not os.path.exists(os.path.join(mdir, "metadata.json")):
+            mdir = os.path.join(mdir, "best")
+        if not os.path.exists(os.path.join(mdir, "metadata.json")):
+            logger.error("--model-input-dir %s: no model found (missing metadata.json)",
+                         args.model_input_dir)
+            return 1
+        initial_model, loaded_task = load_game_model(mdir, index_maps, entity_indexes)
+        if loaded_task != task:
+            logger.error("input model task %s != --task %s", loaded_task, task)
+            return 1
+        logger.info("warm start from %s (%d coordinates%s)", args.model_input_dir,
+                    len(initial_model.models),
+                    f", locked: {sorted(locked)}" if locked else "")
+    elif locked:
+        logger.error("--lock-coordinates requires --model-input-dir")
+        return 1
+
     # Always fit the explicit reg-weight grid; tuning then explores FROM the
     # best grid point (reference: grid first, tuner after, :643-674).
-    results = est.fit(data, configs, validation_data=val_data, seed=args.seed)
+    emitter.emit("fit_start", configs=len(configs))
+    results = est.fit(data, configs, validation_data=val_data, seed=args.seed,
+                      initial_model=initial_model, locked_coordinates=locked)
     best = est.best(results)
     if args.tuning_iterations > 0:
         if val_data is None or suite is None:
@@ -142,7 +209,9 @@ def run(argv: List[str]) -> int:
 
         tuned, _search = tune_game_model(est, best.config, data, val_data,
                                          n_iterations=args.tuning_iterations,
-                                         mode=args.tuning_mode, seed=args.seed)
+                                         mode=args.tuning_mode, seed=args.seed,
+                                         initial_model=initial_model,
+                                         locked_coordinates=locked)
         best = est.best(results + [tuned])
 
     if best.evaluation is not None:
@@ -168,6 +237,8 @@ def run(argv: List[str]) -> int:
     }
     with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
+    emitter.emit("training_end", seconds=summary["seconds"],
+                 validation=summary["validation"])
     logger.info("done in %.1fs -> %s", summary["seconds"], args.output_dir)
     return 0
 

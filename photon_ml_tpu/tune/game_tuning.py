@@ -33,7 +33,8 @@ class GameEstimatorEvaluationFunction:
     iteration too, GameEstimatorEvaluationFunction.apply)."""
 
     def __init__(self, estimator: GameEstimator, base_config: GameConfig,
-                 data: GameData, validation_data: GameData, seed: int = 0):
+                 data: GameData, validation_data: GameData, seed: int = 0,
+                 initial_model=None, locked_coordinates=None):
         if estimator.validation_suite is None:
             raise ValueError("tuning needs an estimator with a validation suite")
         self.estimator = estimator
@@ -41,20 +42,30 @@ class GameEstimatorEvaluationFunction:
         self.data = data
         self.validation_data = validation_data
         self.seed = seed
-        self.coordinate_ids = list(base_config.coordinates)
+        self.initial_model = initial_model
+        self.locked = set(locked_coordinates or ())
+        # locked coordinates are never retrained, so their L2 is not a
+        # tunable dimension (partial retraining, GameEstimator :106-112)
+        self.coordinate_ids = [c for c in base_config.coordinates
+                               if c not in self.locked]
+        if not self.coordinate_ids:
+            raise ValueError("all coordinates are locked; nothing to tune")
         self.results: List[GameFitResult] = []
 
     def config_for(self, params: np.ndarray) -> GameConfig:
-        coords = {
-            cid: _with_l2(self.base_config.coordinates[cid], float(params[i]))
-            for i, cid in enumerate(self.coordinate_ids)
-        }
+        # keep every coordinate (locked ones must stay in the config so the
+        # descent can re-score them); override only the tuned L2s
+        coords = dict(self.base_config.coordinates)
+        for i, cid in enumerate(self.coordinate_ids):
+            coords[cid] = _with_l2(coords[cid], float(params[i]))
         return dataclasses.replace(self.base_config, coordinates=coords)
 
     def __call__(self, params: np.ndarray) -> float:
         config = self.config_for(params)
         res = self.estimator.fit(self.data, [config],
-                                 validation_data=self.validation_data, seed=self.seed)[0]
+                                 validation_data=self.validation_data, seed=self.seed,
+                                 initial_model=self.initial_model,
+                                 locked_coordinates=self.locked or None)[0]
         self.results.append(res)
         return res.evaluation.primary
 
@@ -72,9 +83,17 @@ def tune_game_model(
     mode: str = "bayesian",  # reference HyperparameterTuningMode {RANDOM, BAYESIAN}
     l2_range: Tuple[float, float] = (1e-4, 1e4),
     seed: int = 0,
+    initial_model=None,
+    locked_coordinates=None,
 ) -> Tuple[GameFitResult, "RandomSearch"]:
-    """Search per-coordinate L2 weights; returns (best fit, search object)."""
-    fn = GameEstimatorEvaluationFunction(estimator, base_config, data, validation_data, seed)
+    """Search per-coordinate L2 weights; returns (best fit, search object).
+
+    ``initial_model``/``locked_coordinates``: forwarded to every tuning fit
+    (warm start + partial retraining); locked coordinates are excluded from
+    the search space."""
+    fn = GameEstimatorEvaluationFunction(estimator, base_config, data, validation_data,
+                                         seed, initial_model=initial_model,
+                                         locked_coordinates=locked_coordinates)
     domain = SearchDomain([
         DomainDim(name=f"l2:{cid}", low=l2_range[0], high=l2_range[1], log_scale=True)
         for cid in fn.coordinate_ids

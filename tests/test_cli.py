@@ -170,6 +170,113 @@ def test_index_driver_store_format(tmp_path):
     assert os.path.exists(os.path.join(model_dir, "all.phidx"))
 
 
+def test_warm_start_and_partial_retrain(tmp_path):
+    """--model-input-dir warm start + --lock-coordinates partial retraining
+    (reference GameTrainingDriver.scala:370-379, GameEstimator :106-112)."""
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.storage.model_io import load_game_model
+    from photon_ml_tpu.data.index_map import load_index
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=300, seed=3)
+    out1 = str(tmp_path / "round1")
+    base = ["--train-data", train_path, "--feature-shards", "all",
+            "--id-tags", "userId",
+            "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+            "--coordinate", "name=user,random.effect.type=userId,feature.shard=all,reg.weights=1"]
+    assert train_cli.run(base + ["--output-dir", out1]) == 0
+
+    # partial retrain: lock the fixed effect, retrain only the random effect
+    out2 = str(tmp_path / "round2")
+    events = []
+    import tests.test_cli as self_mod
+    self_mod._seen_events = events
+    assert train_cli.run(base + [
+        "--output-dir", out2, "--model-input-dir", out1,
+        "--lock-coordinates", "fixed",
+        "--event-listener", "tests.test_cli:_RecordingListener"]) == 0
+
+    imaps = {"all": load_index(os.path.join(out1, "all.idx"))}
+    from photon_ml_tpu.data.reader import EntityIndex
+    eidx = {"userId": EntityIndex.load(os.path.join(out1, "userId.entities.json"))}
+    m1, _ = load_game_model(os.path.join(out1, "best"), imaps, eidx)
+    m2, _ = load_game_model(os.path.join(out2, "best"), imaps, eidx)
+    np.testing.assert_allclose(m1.models["fixed"].coefficients.means,
+                               m2.models["fixed"].coefficients.means)  # locked
+    # lifecycle events fired
+    names = [e.name for e in events]
+    assert names[0] == "training_start" and names[-1] == "training_end"
+    assert "fit_start" in names
+    # log file written next to outputs (PhotonLogger parity)
+    assert os.path.getsize(os.path.join(out2, "log-message.txt")) > 0
+
+    # locking without an input model is a usage error
+    assert train_cli.run(base + ["--output-dir", str(tmp_path / "bad"),
+                                 "--lock-coordinates", "fixed"]) == 1
+    # unknown locked coordinate name is a clean usage error, not a traceback
+    assert train_cli.run(base + ["--output-dir", str(tmp_path / "bad2"),
+                                 "--model-input-dir", out1,
+                                 "--lock-coordinates", "fxied"]) == 1
+    # missing model dir likewise
+    assert train_cli.run(base + ["--output-dir", str(tmp_path / "bad3"),
+                                 "--model-input-dir", str(tmp_path / "nope")]) == 1
+
+    # tuning + partial retraining: locked coefficients survive the tuner
+    out3 = str(tmp_path / "round3")
+    val_path = str(tmp_path / "val.avro")
+    _write_fixture(val_path, n=120, seed=4)
+    assert train_cli.run(base + [
+        "--output-dir", out3, "--model-input-dir", out1,
+        "--lock-coordinates", "fixed", "--validation-data", val_path,
+        "--evaluators", "auc", "--tuning-iterations", "2",
+        "--tuning-mode", "random"]) == 0
+    m3, _ = load_game_model(os.path.join(out3, "best"), imaps, eidx)
+    np.testing.assert_allclose(m1.models["fixed"].coefficients.means,
+                               m3.models["fixed"].coefficients.means)
+
+
+from photon_ml_tpu.utils.events import EventListener  # noqa: E402
+
+
+class _RecordingListener(EventListener):
+    """Registered by name via --event-listener (reflection-style wiring)."""
+
+    def on_event(self, event):
+        _seen_events.append(event)
+
+
+_seen_events: list = []
+
+
+def test_diagnose_driver(tmp_path):
+    from photon_ml_tpu.cli import diagnose as diag_cli
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    val_path = str(tmp_path / "val.avro")
+    _write_fixture(train_path, n=400, seed=5)
+    _write_fixture(val_path, n=150, seed=6)
+    out = str(tmp_path / "model")
+    assert train_cli.run([
+        "--train-data", train_path, "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--output-dir", out]) == 0
+
+    diag_out = str(tmp_path / "diag")
+    rc = diag_cli.run(["--data", train_path, "--holdout", val_path,
+                       "--model-dir", out, "--output-dir", diag_out,
+                       "--bootstrap-replicates", "4"])
+    assert rc == 0
+    html = open(os.path.join(diag_out, "report.html")).read()
+    assert "Bootstrap" in html and "Feature importance" in html
+    assert "<svg" in html  # learning-curve plot rendered
+    summary = json.load(open(os.path.join(diag_out, "diagnostics.json")))
+    assert summary["coordinate"] == "fixed"
+    assert summary["fitting"] is not None
+    assert summary["hosmer_lemeshow"] is not None
+    assert abs(summary["kendall_tau"]["tau"]) <= 1.0
+
+
 def test_train_rejects_invalid_data(tmp_path):
     from photon_ml_tpu.cli import train as train_cli
 
