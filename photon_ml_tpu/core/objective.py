@@ -121,33 +121,44 @@ class GLMObjective:
             g = g * self.norm.factors
         return g
 
-    def value_and_grad(self, w: Array, batch: Batch) -> Tuple[Array, Array]:
-        """Reference ValueAndGradientAggregator.calculateValueAndGradient:240-255,
-        collapsed to one fused pass."""
+    def raw_value_and_grad(self, w: Array, batch: Batch) -> Tuple[Array, Array, Array]:
+        """(Σ wt·l, X^T r, Σ r) — raw-space sums with NO regularization or
+        normalization chain applied.  These are plain data-sums, so SPMD
+        callers (parallel/fixed.ShardMapObjective) psum them across shards
+        before finishing with ``finish_value_and_grad``."""
         if self.fused and self._fused_eligible(batch):
             from photon_ml_tpu.ops.fused_glm import fused_value_and_grad
 
             raw_val, g_raw, r_sum = fused_value_and_grad(
                 self.loss, self.norm.effective_coefficients(w), batch,
                 margin_shift=self.norm.margin_shift(w))
-            val = raw_val.astype(w.dtype) + self.l2_term(w)
-            g = self._chain(g_raw.astype(w.dtype), r_sum.astype(w.dtype)) + self.reg.l2 * w
-            return val, g
+            return (raw_val.astype(w.dtype), g_raw.astype(w.dtype),
+                    r_sum.astype(w.dtype))
         z = self._safe_margins(w, batch)
         l, d1 = self.loss.loss_and_d1(z, batch.y)
-        val = jnp.sum(batch.weight * l) + self.l2_term(w)
         r = batch.weight * d1
-        g = self._chain(_xt_dot(batch, r, w.shape[-1]), jnp.sum(r)) + self.reg.l2 * w
+        return (jnp.sum(batch.weight * l), _xt_dot(batch, r, w.shape[-1]),
+                jnp.sum(r))
+
+    def finish_value_and_grad(self, w: Array, raw_val: Array, g_raw: Array,
+                              r_sum: Array) -> Tuple[Array, Array]:
+        """Apply normalization chain rule + regularization to raw sums."""
+        val = raw_val + self.l2_term(w)
+        g = self._chain(g_raw, r_sum) + self.reg.l2 * w
         return val, g
+
+    def value_and_grad(self, w: Array, batch: Batch) -> Tuple[Array, Array]:
+        """Reference ValueAndGradientAggregator.calculateValueAndGradient:240-255,
+        collapsed to one fused pass."""
+        return self.finish_value_and_grad(w, *self.raw_value_and_grad(w, batch))
 
     def gradient(self, w: Array, batch: Batch) -> Array:
         return self.value_and_grad(w, batch)[1]
 
     # -- Hessian-vector product --------------------------------------------------
 
-    def hvp(self, w: Array, batch: Batch, v: Array) -> Array:
-        """H·v = Xn^T diag(weight · l'') Xn v + l2·v
-        (reference HessianVectorAggregator.calcHessianVector:30-80)."""
+    def raw_hvp(self, w: Array, batch: Batch, v: Array) -> Tuple[Array, Array]:
+        """(X^T q, Σ q) raw sums — psum-able like raw_value_and_grad."""
         if self.fused and self._fused_eligible(batch):
             from photon_ml_tpu.ops.fused_glm import fused_hvp
 
@@ -156,7 +167,7 @@ class GLMObjective:
                 self.loss, self.norm.effective_coefficients(w), eff_v, batch,
                 margin_shift=self.norm.margin_shift(w),
                 v_shift=self.norm.margin_shift(v))
-            return self._chain(hv_raw.astype(w.dtype), q_sum.astype(w.dtype)) + self.reg.l2 * v
+            return hv_raw.astype(w.dtype), q_sum.astype(w.dtype)
         z = self._safe_margins(w, batch)
         eff_v = self.norm.effective_coefficients(v)
         # margin directional derivative: factor*(x - shift)·v
@@ -164,7 +175,15 @@ class GLMObjective:
         if self.norm.shifts is not None:
             mv = mv - jnp.vdot(eff_v, self.norm.shifts)
         q = batch.weight * self.loss.d2(z, batch.y) * mv
-        return self._chain(_xt_dot(batch, q, w.shape[-1]), jnp.sum(q)) + self.reg.l2 * v
+        return _xt_dot(batch, q, w.shape[-1]), jnp.sum(q)
+
+    def finish_hvp(self, v: Array, hv_raw: Array, q_sum: Array) -> Array:
+        return self._chain(hv_raw, q_sum) + self.reg.l2 * v
+
+    def hvp(self, w: Array, batch: Batch, v: Array) -> Array:
+        """H·v = Xn^T diag(weight · l'') Xn v + l2·v
+        (reference HessianVectorAggregator.calcHessianVector:30-80)."""
+        return self.finish_hvp(v, *self.raw_hvp(w, batch, v))
 
     # -- Hessian diagonal / full matrix (variance computation) --------------------
 

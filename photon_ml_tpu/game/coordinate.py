@@ -104,15 +104,22 @@ class FixedEffectCoordinate(Coordinate):
             offset=jnp.asarray(np.asarray(data.offset, dtype)),
             weight=jnp.asarray(np.asarray(data.weight, dtype)),
         )
-        if mesh is not None:
-            batch = shard_batch(batch, mesh)
-        else:
-            # One-time row padding to the fused-kernel block granule so the
-            # pallas path never re-pads (and re-copies X) per solver call.
-            from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
+        # One-time row padding to the fused-kernel block granule so the
+        # pallas path never re-pads (and re-copies X) per solver call.
+        from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
 
+        if mesh is not None:
             if eligible(batch):
-                batch = _pad_rows(batch, _pick_block_rows(*batch.x.shape))
+                # pad so each device's LOCAL shard is a block multiple
+                from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+                n_dev = mesh.shape[DATA_AXIS]
+                local = -(-batch.num_examples // n_dev)
+                bn = _pick_block_rows(local, batch.dim)
+                batch = _pad_rows(batch, (-(-local // bn) * bn) * n_dev)
+            batch = shard_batch(batch, mesh)
+        elif eligible(batch):
+            batch = _pad_rows(batch, _pick_block_rows(*batch.x.shape))
         self._batch = batch
         self._padded_n = batch.num_examples
         self._base_weight = batch.weight
@@ -123,12 +130,18 @@ class FixedEffectCoordinate(Coordinate):
         self._score = jax.jit(lambda w: batch.x @ w)
 
     def _bind_solver(self) -> None:
-        # Single-chip path uses the pallas fused kernels (ops/fused_glm.py):
-        # X streams through VMEM once per value_and_grad instead of 2-3 XLA
-        # passes.  Under a mesh the objective is auto-partitioned by XLA and a
-        # pallas custom-call cannot be, so fused stays off there.
+        # Both paths use the pallas fused kernels (ops/fused_glm.py) where
+        # eligible: X streams through VMEM once per value_and_grad instead of
+        # 2-3 XLA passes.  Under a mesh the objective runs as explicit SPMD
+        # (shard_map + one psum per evaluation, parallel/fixed.py) — GSPMD
+        # cannot auto-partition a pallas custom call, shard_map runs it
+        # per-device on local rows.
         objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
-                                 norm=self._norm, fused=self.mesh is None)
+                                 norm=self._norm, fused=True)
+        if self.mesh is not None:
+            from photon_ml_tpu.parallel.fixed import ShardMapObjective
+
+            objective = ShardMapObjective(objective, self.mesh)
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
         batch = self._batch
 

@@ -19,13 +19,14 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from photon_ml_tpu.core.batch import Batch, DenseBatch
 from photon_ml_tpu.core.objective import GLMObjective
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverConfig, SolverResult
 from photon_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
     FEATURE_AXIS,
     padded_dim,
     replicate,
@@ -35,6 +36,61 @@ from photon_ml_tpu.parallel.mesh import (
 from photon_ml_tpu.types import OptimizerType
 
 Array = jax.Array
+
+
+class ShardMapObjective:
+    """GLMObjective computed as EXPLICIT SPMD: per-shard raw sums + psum.
+
+    The psum over the ``data`` mesh axis is the reference's treeAggregate
+    (ValueAndGradientAggregator.scala:248-252) mapped onto ICI.  Two reasons
+    to be explicit rather than letting GSPMD partition the math:
+
+    - pallas kernels (ops/fused_glm.py) are custom calls GSPMD cannot
+      auto-partition; under shard_map each device runs the kernel on its
+      LOCAL rows, so the fused path works multi-chip;
+    - the communication pattern is pinned (exactly one all-reduce per
+      objective evaluation), not left to the partitioner's cost model.
+
+    Presents the same (reg / value_and_grad / hvp) surface the solvers bind
+    (opt/solve.make_solver), so it drops into any of them.  The batch must
+    arrive sharded on the leading example axis (parallel/mesh.shard_batch).
+    """
+
+    def __init__(self, objective: GLMObjective, mesh: Mesh, axis: str = DATA_AXIS):
+        self.obj = objective
+        self.mesh = mesh
+        self.axis = axis
+
+    @property
+    def reg(self):
+        return self.obj.reg
+
+    def _specs(self, batch: Batch):
+        row_sharded = lambda a: P(self.axis, *([None] * (a.ndim - 1)))
+        return jax.tree.map(row_sharded, batch)
+
+    def value_and_grad(self, w: Array, batch: Batch) -> Tuple[Array, Array]:
+        obj, axis = self.obj, self.axis
+
+        def local(w, b):
+            # one psum call over the tuple = one pinned fused all-reduce
+            return jax.lax.psum(obj.raw_value_and_grad(w, b), axis)
+
+        rv, gr, rs = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P(), self._specs(batch)),
+            out_specs=(P(), P(), P()))(w, batch)
+        return obj.finish_value_and_grad(w, rv, gr, rs)
+
+    def hvp(self, w: Array, batch: Batch, v: Array) -> Array:
+        obj, axis = self.obj, self.axis
+
+        def local(w, b, v):
+            return jax.lax.psum(obj.raw_hvp(w, b, v), axis)
+
+        hv, qs = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P(), self._specs(batch), P()),
+            out_specs=(P(), P()))(w, batch, v)
+        return obj.finish_hvp(v, hv, qs)
 
 
 def fit_fixed_effect(
@@ -99,12 +155,18 @@ def fit_fixed_effect(
         w0 = shard_coefficients(w0, mesh)
     else:
         w0 = jax.device_put(w0, rep)
-    solve = make_solver(objective, optimizer, config, box=box)
-    # Without feature sharding, replicated outputs force GSPMD to all-reduce
-    # the data-sharded loss/grad reductions inside the solver loop.  With it,
-    # sharding propagates from the inputs (w stays P("feature") throughout,
-    # scalars come out replicated).
-    fitted = jax.jit(solve) if feature_sharded else jax.jit(solve, out_shardings=rep)
+    if feature_sharded:
+        # w stays P("feature") throughout; sharding propagates from inputs
+        # and GSPMD inserts the feature-axis contractions.
+        solve = make_solver(objective, optimizer, config, box=box)
+        fitted = jax.jit(solve)
+    else:
+        # Explicit SPMD (one psum per evaluation); the caller's fused flag is
+        # honored as-is — under shard_map the pallas kernels run per-device
+        # on local rows, so fused=True works multi-chip too.
+        sm = ShardMapObjective(objective, mesh)
+        solve = make_solver(sm, optimizer, config, box=box)
+        fitted = jax.jit(solve, out_shardings=rep)
     result = fitted(w0, batch)
     if feature_sharded and result.w.shape[0] != d:
         result = result.replace(w=result.w[:d])
