@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference partialRetrainLockedCoordinates)")
     p.add_argument("--event-listener", action="append", default=[], dest="event_listeners",
                    help="'module.path:ClassName' lifecycle EventListener (repeatable)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="flush descent state after every coordinate update and "
+                        "auto-resume from it if present (preemption recovery; "
+                        "mid-job checkpointing the reference lacks, SURVEY §5)")
     return p
 
 
@@ -195,11 +199,64 @@ def _run(args, task, t_start, emitter) -> int:
         logger.error("--lock-coordinates requires --model-input-dir")
         return 1
 
+    # Checkpoint/resume (storage/checkpoint.py): resume wins over
+    # --model-input-dir because it includes everything that dir did plus the
+    # mid-job progress.
+    checkpoint_hook = None
+    resume_cursor = None
+    resume_best = None
+    if args.checkpoint_dir:
+        import hashlib
+
+        from photon_ml_tpu.storage.checkpoint import load_checkpoint, save_checkpoint
+
+        # Fingerprint of everything the positional cursor and best-model
+        # tracking depend on: a rerun with ANY of these changed must NOT
+        # silently resume (wrong grid indices, skipped-but-never-ran locked
+        # updates, best-metric comparisons across different primaries, or a
+        # cursor applied to different data).
+        fp_src = json.dumps({"coordinates": args.coordinates, "task": args.task,
+                             "iterations": args.coordinate_descent_iterations,
+                             "seed": args.seed,
+                             "train_data": sorted(args.train_data),
+                             "validation_data": sorted(args.validation_data),
+                             "evaluators": args.evaluators,
+                             "lock": args.lock_coordinates,
+                             "model_input": args.model_input_dir}, sort_keys=True)
+        fingerprint = hashlib.sha256(fp_src.encode()).hexdigest()[:16]
+
+        try:
+            initial_model, ck_task, resume_cursor, resume_best = load_checkpoint(
+                args.checkpoint_dir, index_maps, entity_indexes)
+            if ck_task != task:
+                logger.error("checkpoint task %s != --task %s", ck_task, task)
+                return 1
+            saved_fp = resume_cursor.pop("fingerprint", None)
+            if saved_fp != fingerprint:
+                logger.error(
+                    "checkpoint in %s was written by a DIFFERENT configuration "
+                    "(fingerprint %s != %s); refusing to resume — clear the "
+                    "checkpoint dir or rerun with the original flags",
+                    args.checkpoint_dir, saved_fp, fingerprint)
+                return 1
+            logger.info("resuming from checkpoint %s at %s", args.checkpoint_dir,
+                        resume_cursor)
+        except FileNotFoundError:
+            pass
+
+        def checkpoint_hook(model, cursor, updated=None, best=None, best_changed=True):
+            save_checkpoint(args.checkpoint_dir, model, index_maps, cursor,
+                            entity_indexes, task, updated_coordinate=updated,
+                            best=best, best_changed=best_changed,
+                            fingerprint=fingerprint)
+
     # Always fit the explicit reg-weight grid; tuning then explores FROM the
     # best grid point (reference: grid first, tuner after, :643-674).
     emitter.emit("fit_start", configs=len(configs))
     results = est.fit(data, configs, validation_data=val_data, seed=args.seed,
-                      initial_model=initial_model, locked_coordinates=locked)
+                      initial_model=initial_model, locked_coordinates=locked,
+                      checkpoint_hook=checkpoint_hook, resume_cursor=resume_cursor,
+                      resume_best=resume_best)
     best = est.best(results)
     if args.tuning_iterations > 0:
         if val_data is None or suite is None:

@@ -67,8 +67,18 @@ class CoordinateDescent:
         if missing:
             raise ValueError(f"locked coordinates not present: {missing}")
 
-    def run(self, initial: Optional[GameModel] = None, seed: int = 0
+    def run(self, initial: Optional[GameModel] = None, seed: int = 0,
+            checkpoint_hook=None, resume_cursor: Optional[Dict[str, int]] = None,
+            resume_best: Optional[Tuple[GameModel, EvaluationResults]] = None,
             ) -> Tuple[GameModel, DescentHistory, Optional[EvaluationResults]]:
+        """``checkpoint_hook(model, cursor, updated=cid, best=(m, ev) | None,
+        best_changed=bool)``: called after every coordinate update with the
+        current full model and the cursor of the NEXT update
+        ({"iteration": i, "coordinate": k} indices).  ``resume_cursor``: skip
+        updates before it — ``initial`` must then be the checkpointed model
+        (storage/checkpoint.py; mid-job resume the reference lacks,
+        SURVEY.md §5).  ``resume_best``: seeds best-model tracking so the
+        best-by-primary-metric retention survives preemption."""
         coords = self.coordinates
         n = next(iter(coords.values()))._n if coords else 0
         history = DescentHistory()
@@ -89,13 +99,19 @@ class CoordinateDescent:
         total = np.sum(list(scores.values()), axis=0) if scores else np.zeros(n)
         best_model: Optional[GameModel] = None
         best_eval: Optional[EvaluationResults] = None
+        if resume_best is not None:
+            best_model, best_eval = resume_best
         last_eval: Optional[EvaluationResults] = None
 
         for it in range(self.num_iterations):
-            for cid in self.order:
+            for k, cid in enumerate(self.order):
                 coord = coords[cid]
                 if cid in self.locked:
                     continue  # locked: score already folded into total
+                if resume_cursor is not None and (
+                        (it, k) < (resume_cursor.get("iteration", 0),
+                                   resume_cursor.get("coordinate", 0))):
+                    continue  # already done before the checkpoint
                 t0 = time.perf_counter()
                 # Residual trick (CoordinateDescent.scala:197-204): everything
                 # the OTHER coordinates explain becomes an offset.
@@ -110,6 +126,7 @@ class CoordinateDescent:
                 dt = time.perf_counter() - t0
 
                 val_res = None
+                best_changed = False
                 if self.validation is not None:
                     val_data, suite = self.validation
                     current = GameModel(models=dict(models))
@@ -121,8 +138,16 @@ class CoordinateDescent:
                     if suite.better_than(val_res, best_eval):
                         best_eval = val_res
                         best_model = current
+                        best_changed = True
                     logger.info("iter %d coord %s: %s (%.2fs)", it, cid, val_res.values, dt)
                 history.add(it, cid, dt, val_res)
+                if checkpoint_hook is not None:
+                    nxt = ((it, k + 1) if k + 1 < len(self.order) else (it + 1, 0))
+                    best = ((best_model, best_eval)
+                            if best_model is not None and best_eval is not None else None)
+                    checkpoint_hook(GameModel(models=dict(models)),
+                                    {"iteration": nxt[0], "coordinate": nxt[1]},
+                                    updated=cid, best=best, best_changed=best_changed)
 
         final = GameModel(models=models)
         if best_model is not None:

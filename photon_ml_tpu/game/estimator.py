@@ -60,11 +60,22 @@ class GameEstimator:
         initial_model: Optional[GameModel] = None,
         locked_coordinates: Optional[Set[str]] = None,
         seed: int = 0,
+        checkpoint_hook=None,
+        resume_cursor: Optional[Dict[str, int]] = None,
+        resume_best=None,
     ) -> List[GameFitResult]:
+        """``checkpoint_hook(model, cursor, **kw)`` fires after every coordinate
+        update with cursor {"config": ci, "iteration": i, "coordinate": k}.
+        ``resume_cursor``: skip work before it (``initial_model`` must be the
+        checkpointed model).  NOTE on resume: configs before the cursor are
+        skipped entirely, so model selection only considers the resumed-and-
+        later grid points."""
         results: List[GameFitResult] = []
         warm = initial_model
         prev: Dict[str, object] = {}
-        for config in configs:
+        for ci, config in enumerate(configs):
+            if resume_cursor is not None and ci < resume_cursor.get("config", 0):
+                continue
             coordinates = {}
             for cid, ccfg in config.coordinates.items():
                 old = prev.get(cid)
@@ -90,7 +101,15 @@ class GameEstimator:
                 validation=validation,
                 locked=locked_coordinates,
             )
-            model, history, ev = descent.run(initial=warm, seed=seed)
+            hook = (None if checkpoint_hook is None else
+                    (lambda m, cur, ci=ci, **kw:
+                     checkpoint_hook(m, {**cur, "config": ci}, **kw)))
+            resuming_here = (resume_cursor is not None
+                             and ci == resume_cursor.get("config", 0))
+            model, history, ev = descent.run(
+                initial=warm, seed=seed, checkpoint_hook=hook,
+                resume_cursor=resume_cursor if resuming_here else None,
+                resume_best=resume_best if resuming_here else None)
             results.append(GameFitResult(model=model, config=config, evaluation=ev,
                                          history=history))
             warm = model  # warm start the next configuration (fit:344-360)

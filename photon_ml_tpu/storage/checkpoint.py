@@ -3,14 +3,24 @@
 The reference has NO mid-job checkpointing (SURVEY.md §5: fault tolerance is
 Spark lineage + persist).  This is an improvement the survey calls for
 (§7 layer 7): after every coordinate update the descent state (models +
-iteration cursor) can be flushed so a preempted TPU job resumes instead of
-restarting — preemption being the TPU-world failure mode that Spark lineage
-addressed on YARN.
+iteration cursor + best-so-far model) can be flushed so a preempted TPU job
+resumes instead of restarting — preemption being the TPU-world failure mode
+that Spark lineage addressed on YARN.
 
 Crash safety: versioned subdirectories + an atomically-replaced LATEST
 pointer file.  A kill at ANY instant leaves either the previous or the new
 checkpoint fully loadable; stale versions are pruned only after the pointer
 moves.
+
+Incremental cost: a coordinate update changes ONE coordinate, so only that
+coordinate's files are re-serialized; every other coordinate directory (and
+the best-model snapshot when unchanged) is hard-linked from the previous
+version — per-update checkpoint cost is O(updated coordinate), not O(model).
+
+``fingerprint``: an opaque caller-supplied string (hash of the config grid /
+coordinate order) stored in the cursor and surfaced on load, so a resume
+against a CHANGED configuration can be rejected instead of silently applying
+a positional cursor to the wrong grid.
 """
 
 from __future__ import annotations
@@ -24,11 +34,15 @@ from typing import Dict, Optional, Tuple
 
 from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.evaluation.evaluator import EvaluationResults
 from photon_ml_tpu.models.game import GameModel
-from photon_ml_tpu.storage.model_io import load_game_model, save_game_model
+from photon_ml_tpu.storage.model_io import (FORMAT_VERSION, coordinate_rel_dir,
+                                            load_game_model, save_coordinate,
+                                            save_game_model)
 from photon_ml_tpu.types import TaskType
 
 _POINTER = "LATEST"
+_BEST = "best-model"
 
 
 def _read_pointer(ckpt_dir: str) -> Optional[str]:
@@ -39,6 +53,15 @@ def _read_pointer(ckpt_dir: str) -> Optional[str]:
         return None
 
 
+def _link_tree(src: str, dst: str) -> None:
+    """Hard-link a directory tree (fallback to copy on cross-device/EPERM)."""
+    try:
+        shutil.copytree(src, dst, copy_function=os.link)
+    except OSError:
+        shutil.rmtree(dst, ignore_errors=True)
+        shutil.copytree(src, dst)
+
+
 def save_checkpoint(
     ckpt_dir: str,
     model: GameModel,
@@ -46,9 +69,23 @@ def save_checkpoint(
     cursor: Dict[str, int],
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     task: TaskType = TaskType.LOGISTIC_REGRESSION,
+    updated_coordinate: Optional[str] = None,
+    best: Optional[Tuple[GameModel, EvaluationResults]] = None,
+    best_changed: bool = True,
+    fingerprint: Optional[str] = None,
 ) -> None:
-    """``cursor``: {"iteration": i, "coordinate": k} — the NEXT update to run."""
+    """``cursor``: {"iteration": i, "coordinate": k} — the NEXT update to run.
+
+    ``updated_coordinate``: when given and a previous version exists, only
+    that coordinate is re-serialized; the rest hard-link to the previous
+    version.  ``best``: best-so-far (model, evaluation) retained across
+    resume; re-serialized only when ``best_changed``.
+    """
     os.makedirs(ckpt_dir, exist_ok=True)
+    prev = _read_pointer(ckpt_dir)
+    prev_dir = os.path.join(ckpt_dir, prev) if prev else None
+    if prev_dir is not None and not os.path.isdir(prev_dir):
+        prev_dir = None
     # Version = max existing v<N> + 1, NOT pointer+1: a crash between the
     # version rename and the pointer swap leaves an orphaned v<N+1>, and
     # deriving from the pointer would collide with it forever after.
@@ -58,9 +95,55 @@ def save_checkpoint(
 
     tmp = tempfile.mkdtemp(prefix=".tmp-", dir=ckpt_dir)
     try:
-        save_game_model(model, tmp, index_maps, entity_indexes, task)
+        prev_meta = None
+        if prev_dir is not None:
+            with open(os.path.join(prev_dir, "metadata.json")) as f:
+                prev_meta = json.load(f)["coordinates"]
+        meta = {"version": FORMAT_VERSION, "task": task.value, "coordinates": {}}
+        for cid, m in model.models.items():
+            rel = coordinate_rel_dir(cid, m)
+            src = os.path.join(prev_dir, rel) if prev_dir is not None else None
+            if (updated_coordinate is not None and cid != updated_coordinate
+                    and src is not None and os.path.isdir(src)):
+                _link_tree(src, os.path.join(tmp, rel))
+                meta["coordinates"][cid] = prev_meta[cid]
+            else:
+                meta["coordinates"][cid] = save_coordinate(
+                    cid, m, tmp, index_maps, entity_indexes)
+        with open(os.path.join(tmp, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+
+        if best is not None:
+            best_model, best_eval = best
+            bdir = os.path.join(tmp, _BEST)
+            prev_best = (os.path.join(prev_dir, _BEST)
+                         if prev_dir is not None else None)
+            # common case during the improving phase: the new best IS the
+            # current model — link the coordinate trees just written above
+            # instead of re-serializing the whole model
+            best_is_current = (best_model.models.keys() == model.models.keys()
+                               and all(best_model.models[k] is model.models[k]
+                                       for k in model.models))
+            if not best_changed and prev_best is not None and os.path.isdir(prev_best):
+                _link_tree(prev_best, bdir)
+            elif best_is_current:
+                os.makedirs(bdir, exist_ok=True)
+                for cid, m in model.models.items():
+                    rel = coordinate_rel_dir(cid, m)
+                    os.makedirs(os.path.dirname(os.path.join(bdir, rel)), exist_ok=True)
+                    _link_tree(os.path.join(tmp, rel), os.path.join(bdir, rel))
+                shutil.copyfile(os.path.join(tmp, "metadata.json"),
+                                os.path.join(bdir, "metadata.json"))
+            else:
+                save_game_model(best_model, bdir, index_maps, entity_indexes, task)
+        cursor_doc = dict(cursor)
+        if fingerprint is not None:
+            cursor_doc["fingerprint"] = fingerprint
+        if best is not None:
+            cursor_doc["best_eval"] = {"values": best[1].values,
+                                       "primary_name": best[1].primary_name}
         with open(os.path.join(tmp, "cursor.json"), "w") as f:
-            json.dump(cursor, f)
+            json.dump(cursor_doc, f)
         os.rename(tmp, os.path.join(ckpt_dir, version))  # atomic: new name
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -83,7 +166,11 @@ def load_checkpoint(
     ckpt_dir: str,
     index_maps: Dict[str, IndexMap],
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
-) -> Tuple[GameModel, TaskType, Dict[str, int]]:
+) -> Tuple[GameModel, TaskType, Dict[str, int],
+           Optional[Tuple[GameModel, EvaluationResults]]]:
+    """Returns (model, task, cursor, best) — ``best`` is the retained
+    best-so-far (model, evaluation) or None.  ``cursor`` carries the saved
+    ``fingerprint`` (if any) for the caller to validate against its config."""
     version = _read_pointer(ckpt_dir)
     if version is None:
         raise FileNotFoundError(f"no checkpoint pointer in {ckpt_dir}")
@@ -91,4 +178,11 @@ def load_checkpoint(
     model, task = load_game_model(vdir, index_maps, entity_indexes)
     with open(os.path.join(vdir, "cursor.json")) as f:
         cursor = json.load(f)
-    return model, task, cursor
+    best = None
+    best_eval_doc = cursor.pop("best_eval", None)
+    bdir = os.path.join(vdir, _BEST)
+    if best_eval_doc is not None and os.path.isdir(bdir):
+        best_model, _ = load_game_model(bdir, index_maps, entity_indexes)
+        best = (best_model, EvaluationResults(values=best_eval_doc["values"],
+                                              primary_name=best_eval_doc["primary_name"]))
+    return model, task, cursor, best

@@ -66,6 +66,59 @@ def _record_to_coeff(rec: dict, index_map: IndexMap) -> Coefficients:
     return Coefficients(means=means, variances=variances)
 
 
+def coordinate_rel_dir(cid: str, m) -> str:
+    """Relative directory of one coordinate inside a model dir."""
+    kind = "fixed-effect" if isinstance(m, FixedEffectModel) else "random-effect"
+    return os.path.join(kind, cid)
+
+
+def save_coordinate(
+    cid: str,
+    m,
+    out_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+) -> dict:
+    """Serialize ONE coordinate's model files; returns its metadata entry.
+
+    Split out so incremental checkpoints (storage/checkpoint.py) can rewrite
+    only the coordinate that changed and link the rest."""
+    entity_indexes = entity_indexes or {}
+    cdir = os.path.join(out_dir, coordinate_rel_dir(cid, m))
+    os.makedirs(cdir, exist_ok=True)
+    if isinstance(m, FixedEffectModel):
+        imap = index_maps[m.feature_shard]
+        rec = _coeff_to_record(cid, m.coefficients.means, m.coefficients.variances,
+                               imap, m.task.value)
+        avro_io.write_container(os.path.join(cdir, "coefficients.avro"),
+                                BAYESIAN_LINEAR_MODEL, [rec])
+        return {"type": "fixed", "feature_shard": m.feature_shard}
+    if isinstance(m, RandomEffectModel):
+        imap = index_maps[m.feature_shard]
+        eidx = entity_indexes.get(m.random_effect_type)
+
+        def records():
+            for eid, slot in sorted(m.slot_of.items()):
+                name = eidx.name_of(eid) if eidx is not None else None
+                var = m.variances[slot] if m.variances is not None else None
+                yield _coeff_to_record(
+                    name if name is not None else str(eid),
+                    m.w_stack[slot], var, imap, m.task.value)
+
+        avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
+                                BAYESIAN_LINEAR_MODEL, records())
+        id_map = {str(eid): (eidx.name_of(eid) if eidx is not None else str(eid))
+                  for eid in m.slot_of}
+        with open(os.path.join(cdir, "id-index.json"), "w") as f:
+            json.dump(id_map, f)
+        return {
+            "type": "random",
+            "feature_shard": m.feature_shard,
+            "random_effect_type": m.random_effect_type,
+        }
+    raise TypeError(f"cannot save model type {type(m)!r}")
+
+
 def save_game_model(
     model: GameModel,
     out_dir: str,
@@ -75,46 +128,9 @@ def save_game_model(
 ) -> None:
     os.makedirs(out_dir, exist_ok=True)
     meta = {"version": FORMAT_VERSION, "task": task.value, "coordinates": {}}
-    entity_indexes = entity_indexes or {}
-
     for cid, m in model.models.items():
-        if isinstance(m, FixedEffectModel):
-            cdir = os.path.join(out_dir, "fixed-effect", cid)
-            os.makedirs(cdir, exist_ok=True)
-            imap = index_maps[m.feature_shard]
-            rec = _coeff_to_record(cid, m.coefficients.means, m.coefficients.variances,
-                                   imap, m.task.value)
-            avro_io.write_container(os.path.join(cdir, "coefficients.avro"),
-                                    BAYESIAN_LINEAR_MODEL, [rec])
-            meta["coordinates"][cid] = {"type": "fixed", "feature_shard": m.feature_shard}
-        elif isinstance(m, RandomEffectModel):
-            cdir = os.path.join(out_dir, "random-effect", cid)
-            os.makedirs(cdir, exist_ok=True)
-            imap = index_maps[m.feature_shard]
-            eidx = entity_indexes.get(m.random_effect_type)
-
-            def records():
-                for eid, slot in sorted(m.slot_of.items()):
-                    name = eidx.name_of(eid) if eidx is not None else None
-                    var = m.variances[slot] if m.variances is not None else None
-                    yield _coeff_to_record(
-                        name if name is not None else str(eid),
-                        m.w_stack[slot], var, imap, m.task.value)
-
-            avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
-                                    BAYESIAN_LINEAR_MODEL, records())
-            id_map = {str(eid): (eidx.name_of(eid) if eidx is not None else str(eid))
-                      for eid in m.slot_of}
-            with open(os.path.join(cdir, "id-index.json"), "w") as f:
-                json.dump(id_map, f)
-            meta["coordinates"][cid] = {
-                "type": "random",
-                "feature_shard": m.feature_shard,
-                "random_effect_type": m.random_effect_type,
-            }
-        else:
-            raise TypeError(f"cannot save model type {type(m)!r}")
-
+        meta["coordinates"][cid] = save_coordinate(cid, m, out_dir, index_maps,
+                                                   entity_indexes)
     with open(os.path.join(out_dir, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
 

@@ -248,6 +248,36 @@ class _RecordingListener(EventListener):
 _seen_events: list = []
 
 
+def test_checkpoint_dir_cli(tmp_path):
+    """--checkpoint-dir writes per-update checkpoints; a rerun resumes
+    (skipping completed work) and produces a valid model."""
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.storage.checkpoint import load_checkpoint
+    from photon_ml_tpu.data.index_map import load_index
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=200, seed=9)
+    out = str(tmp_path / "out")
+    ckpt = str(tmp_path / "ckpt")
+    argv = ["--train-data", train_path, "--feature-shards", "all",
+            "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+            "--coordinate-descent-iterations", "2",
+            "--output-dir", out, "--checkpoint-dir", ckpt]
+    assert train_cli.run(argv) == 0
+    imaps = {"all": load_index(os.path.join(out, "all.idx"))}
+    model, _, cursor, _best = load_checkpoint(ckpt, imaps)
+    assert cursor.pop("fingerprint")
+    assert cursor == {"config": 0, "iteration": 2, "coordinate": 0}
+    assert "fixed" in model.models
+    # rerun: everything before the cursor is skipped, still succeeds
+    assert train_cli.run(argv + ["--output-dir", str(tmp_path / "out2")]) == 0
+    # a rerun with a CHANGED grid must refuse to resume (wrong-cursor guard)
+    changed = list(argv)
+    changed[changed.index("name=fixed,feature.shard=all,reg.weights=1")] = \
+        "name=fixed,feature.shard=all,reg.weights=1|10"
+    assert train_cli.run(changed + ["--output-dir", str(tmp_path / "out3")]) == 1
+
+
 def test_diagnose_driver(tmp_path):
     from photon_ml_tpu.cli import diagnose as diag_cli
     from photon_ml_tpu.cli import train as train_cli

@@ -230,12 +230,13 @@ def test_checkpoint_roundtrip(tmp_path):
     model = GameModel(models={"fixed": fixed})
     ckpt = str(tmp_path / "ckpt")
     save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 2, "coordinate": 1})
-    loaded, task, cursor = load_checkpoint(ckpt, {"s": imap})
+    loaded, task, cursor, best = load_checkpoint(ckpt, {"s": imap})
     assert cursor == {"iteration": 2, "coordinate": 1}
+    assert best is None
     np.testing.assert_allclose(loaded["fixed"].coefficients.means, [1.0, 2.0])
     # overwrite with newer state is atomic
     save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 3, "coordinate": 0})
-    _, _, cursor = load_checkpoint(ckpt, {"s": imap})
+    _, _, cursor, _ = load_checkpoint(ckpt, {"s": imap})
     assert cursor["iteration"] == 3
 
 
@@ -254,6 +255,49 @@ def test_checkpoint_recovers_from_orphaned_version(tmp_path):
     with open(os.path.join(ckpt, "v2", "junk"), "w") as f:
         f.write("partial")
     save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 2, "coordinate": 0})
-    _, _, cursor = load_checkpoint(ckpt, {"s": imap})
+    _, _, cursor, _ = load_checkpoint(ckpt, {"s": imap})
     assert cursor["iteration"] == 2
     assert not os.path.exists(os.path.join(ckpt, "v2"))  # orphan pruned
+
+
+def test_checkpoint_incremental_and_best(tmp_path, monkeypatch):
+    """updated_coordinate re-serializes one coordinate (others hard-linked);
+    the best-so-far model + evaluation survive the roundtrip."""
+    import os
+
+    from photon_ml_tpu.evaluation.evaluator import EvaluationResults
+
+    imap = IndexMap.from_features([("f", "")])
+    fixed = FixedEffectModel(
+        coefficients=Coefficients(means=np.asarray([1.0, 2.0])), feature_shard="s")
+    other = FixedEffectModel(
+        coefficients=Coefficients(means=np.asarray([3.0, 4.0])), feature_shard="s")
+    model = GameModel(models={"a": fixed, "b": other})
+    ev = EvaluationResults(values={"auc": 0.9}, primary_name="auc")
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, model, {"s": imap}, {"iteration": 0, "coordinate": 1},
+                    best=(model, ev), fingerprint="fp1")
+    # incremental save: only "a" changed -> only "a" re-serialized
+    import photon_ml_tpu.storage.checkpoint as ckpt_mod
+    serialized = []
+    real_save = ckpt_mod.save_coordinate
+    monkeypatch.setattr(ckpt_mod, "save_coordinate",
+                        lambda cid, *a, **k: (serialized.append(cid),
+                                              real_save(cid, *a, **k))[1])
+    model2 = GameModel(models={
+        "a": FixedEffectModel(coefficients=Coefficients(means=np.asarray([9.0, 9.0])),
+                              feature_shard="s"),
+        "b": other})
+    save_checkpoint(ckpt, model2, {"s": imap}, {"iteration": 1, "coordinate": 0},
+                    updated_coordinate="a", best=(model, ev), best_changed=False,
+                    fingerprint="fp1")
+    assert serialized == ["a"]  # "b" and the best snapshot were linked
+    loaded, _, cursor, best = load_checkpoint(ckpt, {"s": imap})
+    assert cursor["fingerprint"] == "fp1"
+    np.testing.assert_allclose(loaded["a"].coefficients.means, [9.0, 9.0])
+    np.testing.assert_allclose(loaded["b"].coefficients.means, [3.0, 4.0])
+    assert best is not None
+    best_model, best_eval = best
+    assert best_eval.primary == 0.9 and best_eval.primary_name == "auc"
+    np.testing.assert_allclose(best_model["a"].coefficients.means, [1.0, 2.0])
+

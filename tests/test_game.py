@@ -120,6 +120,54 @@ def test_descent_converges_training_loss(rng):
     assert res.evaluation.values["logistic_loss"] <= min(losses) + 1e-9
 
 
+def test_checkpoint_resume_matches_uninterrupted(rng):
+    """Preemption mid-descent: resuming from the captured (model, cursor)
+    reproduces the uninterrupted run exactly (storage/checkpoint wiring)."""
+    data, *_ = _glmix_data(rng, n_users=6, per_user=40)
+    est = GameEstimator()
+    cfg = _configs(num_iters=3)
+
+    states = []
+    full = est.fit(data, [cfg],
+                   checkpoint_hook=lambda m, cur, **kw: states.append((m, cur)))[0]
+    assert len(states) == 3 * len(cfg.coordinates)
+    assert states[0][1] == {"config": 0, "iteration": 0, "coordinate": 1}
+
+    # "crash" after the 3rd update; resume from that checkpoint
+    model_ck, cursor_ck = states[2]
+    resumed = est.fit(data, [cfg], initial_model=model_ck,
+                      resume_cursor=cursor_ck)[0]
+    # resume rebuilds `total` as a fresh sum while the uninterrupted run
+    # accumulated it incrementally — f32 ordering noise only
+    np.testing.assert_allclose(resumed.model["fixed"].coefficients.means,
+                               full.model["fixed"].coefficients.means, atol=2e-3)
+    for cid in cfg.coordinates:
+        if cid != "fixed":
+            np.testing.assert_allclose(np.asarray(resumed.model[cid].w_stack),
+                                       np.asarray(full.model[cid].w_stack), atol=2e-3)
+
+
+def test_checkpoint_preserves_best_model_across_resume(rng):
+    """Best-by-primary-metric retention must survive preemption: the hook
+    captures (best, best_changed) and resume seeds the tracker with it."""
+    data, *_ = _glmix_data(rng, n_users=6, per_user=40)
+    suite = EvaluationSuite.from_specs(["auc", "logistic_loss"], primary="auc")
+    est = GameEstimator(validation_suite=suite)
+    cfg = _configs(num_iters=3)
+
+    snaps = []
+    full = est.fit(data, [cfg], validation_data=data,
+                   checkpoint_hook=lambda m, cur, **kw: snaps.append((m, cur, kw)))[0]
+    # every snapshot after a validated update carries the best-so-far
+    assert all(kw["best"] is not None for _, _, kw in snaps)
+    m_ck, cur_ck, kw_ck = snaps[2]
+    resumed = est.fit(data, [cfg], validation_data=data, initial_model=m_ck,
+                      resume_cursor=cur_ck, resume_best=kw_ck["best"])[0]
+    # the resumed run may only return something at least as good as the
+    # checkpointed best (it can improve later, never regress below it)
+    assert resumed.evaluation.values["auc"] >= kw_ck["best"][1].primary - 1e-9
+
+
 def test_warm_start_and_locked_coordinates(rng):
     data, *_ = _glmix_data(rng, n_users=6, per_user=40)
     est = GameEstimator()
