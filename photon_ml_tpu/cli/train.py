@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-intercept", action="store_true")
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
+    p.add_argument("--normalization", default="NONE",
+                   choices=["NONE", "SCALE_WITH_MAX_MAGNITUDE",
+                            "SCALE_WITH_STANDARD_DEVIATION", "STANDARDIZATION"],
+                   help="feature normalization built from training stats "
+                        "(reference NormalizationType.scala:42); models are "
+                        "saved in original space")
     p.add_argument("--tuning-iterations", type=int, default=0,
                    help="GP hyperparameter tuning iterations (0 = off)")
     p.add_argument("--tuning-mode", default="bayesian", choices=["bayesian", "random"])
@@ -158,12 +164,64 @@ def _run(args, task, t_start, emitter) -> int:
             logger.error("validation: %s", e)
         return 1
 
-    # 4. config grid (reference prepareGameOptConfigs) + fit
+    # 4. normalization from training stats (reference GameTrainingDriver
+    # :430-436 FeatureDataStatistics + NormalizationContext per shard)
+    normalization = None
+    feature_stats = {}
+    if args.normalization != "NONE":
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.core.normalization import (build_normalization,
+                                                      compute_feature_stats)
+        from photon_ml_tpu.game.config import FixedEffectConfig
+        from photon_ml_tpu.types import NormalizationType
+
+        kind = NormalizationType[args.normalization]
+        # normalization applies to FIXED-effect solves only (the reference's
+        # per-entity NormalizationContextRDD for random effects is not
+        # implemented); compute stats just for shards fixed effects use
+        fixed_shards = {spec.template.feature_shard for spec in specs
+                        if isinstance(spec.template, FixedEffectConfig)}
+        re_shards = {spec.template.feature_shard for spec in specs
+                     if not isinstance(spec.template, FixedEffectConfig)}
+        if re_shards:
+            logger.warning(
+                "--normalization applies to fixed-effect coordinates only; "
+                "random-effect coordinates (shards %s) train unnormalized",
+                sorted(re_shards))
+        normalization = {}
+        for s in sorted(fixed_shards):
+            ii = index_maps[s].intercept_index
+            stats = compute_feature_stats(jnp.asarray(data.features[s]),
+                                          jnp.asarray(data.weight),
+                                          intercept_index=ii)
+            normalization[s] = build_normalization(kind, stats)
+            feature_stats[s] = {
+                "mean": np.asarray(stats.mean).tolist(),
+                "variance": np.asarray(stats.variance).tolist(),
+                "abs_max": np.asarray(stats.abs_max).tolist(),
+                "intercept_index": ii,
+            }
+        logger.info("normalization %s over %d shard(s)", kind.name, len(normalization))
+
+    # 5. config grid (reference prepareGameOptConfigs) + fit
     configs = expand_game_configs(specs, task, args.coordinate_descent_iterations)
+    if normalization:
+        # shift-normalized solves need the intercept column id (conversion
+        # between model and transformed space, NormalizationContext.scala)
+        configs = [
+            _dc.replace(cfg, coordinates={
+                cid: (_dc.replace(c, intercept_index=index_maps[c.feature_shard].intercept_index)
+                      if isinstance(c, FixedEffectConfig) else c)
+                for cid, c in cfg.coordinates.items()})
+            for cfg in configs
+        ]
     logger.info("fitting %d configuration(s)", len(configs))
     suite = (EvaluationSuite.from_specs(args.evaluators.split(","))
              if args.evaluators else None)
-    est = GameEstimator(validation_suite=suite)
+    est = GameEstimator(validation_suite=suite, normalization=normalization)
 
     # Warm start / partial retraining (reference GameTrainingDriver.scala:370-379
     # -> GameEstimator initialModel + partial retraining :106-112).
@@ -222,7 +280,12 @@ def _run(args, task, t_start, emitter) -> int:
                              "validation_data": sorted(args.validation_data),
                              "evaluators": args.evaluators,
                              "lock": args.lock_coordinates,
-                             "model_input": args.model_input_dir}, sort_keys=True)
+                             "model_input": args.model_input_dir,
+                             "normalization": args.normalization,
+                             "feature_shards": args.feature_shards,
+                             "id_tags": args.id_tags,
+                             "no_intercept": args.no_intercept,
+                             "index_map_dir": args.index_map_dir}, sort_keys=True)
         fingerprint = hashlib.sha256(fp_src.encode()).hexdigest()[:16]
 
         try:
@@ -274,7 +337,7 @@ def _run(args, task, t_start, emitter) -> int:
     if best.evaluation is not None:
         logger.info("best model validation: %s", best.evaluation.values)
 
-    # 5. save (reference saveModelToHDFS / ModelProcessingUtils)
+    # 6. save (reference saveModelToHDFS / ModelProcessingUtils)
     os.makedirs(args.output_dir, exist_ok=True)
     save_game_model(best.model, os.path.join(args.output_dir, "best"),
                     index_maps, entity_indexes, task)
@@ -285,6 +348,10 @@ def _run(args, task, t_start, emitter) -> int:
         index_maps[s].save(os.path.join(args.output_dir, f"{s}{ext}"))
     for tag, eidx in entity_indexes.items():
         eidx.save(os.path.join(args.output_dir, f"{tag}.entities.json"))
+    if feature_stats:
+        # reference ModelProcessingUtils.writeBasicStatistics:516
+        with open(os.path.join(args.output_dir, "feature-stats.json"), "w") as f:
+            json.dump(feature_stats, f)
     summary = {
         "task": task.value,
         "train_samples": int(data.num_samples),

@@ -26,6 +26,7 @@ class FixedEffectConfig:
     solver: Optional[SolverConfig] = None
     reg: Regularization = Regularization()
     down_sampling_rate: float = 1.0  # negative down-sampling (binary tasks)
+    intercept_index: Optional[int] = None  # needed by shift normalization
 
 
 @dataclasses.dataclass(frozen=True)

@@ -124,7 +124,12 @@ class FixedEffectCoordinate(Coordinate):
         self._padded_n = batch.num_examples
         self._base_weight = batch.weight
 
-        self._norm = norm or no_normalization()
+        norm = norm or no_normalization()
+        # match the batch dtype or the normalization algebra promotes the
+        # whole solver carry (f64 stats ctx x f32 batch -> while_loop error)
+        self._norm = norm.replace(
+            factors=None if norm.factors is None else jnp.asarray(norm.factors, dtype),
+            shifts=None if norm.shifts is None else jnp.asarray(norm.shifts, dtype))
         self._bind_solver()
         batch = self._batch
         self._score = jax.jit(lambda w: batch.x @ w)
@@ -187,13 +192,23 @@ class FixedEffectCoordinate(Coordinate):
 
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[FixedEffectModel] = None) -> Tuple[FixedEffectModel, SolverResult]:
-        w0 = (jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
-              if init is not None else jnp.zeros(self.dim, self._dtype))
+        """Solve in TRANSFORMED space, publish the model in ORIGINAL space
+        (reference Optimizer.optimize:175 modelToTransformedSpace on entry,
+        GeneralizedLinearOptimizationProblem.createModel original-space exit;
+        NormalizationContext.scala:73-124).  Models/scores everywhere else are
+        original-space, so warm starts convert back in."""
+        ii = self.config.intercept_index
+        if init is not None:
+            w0 = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
+            w0 = self._norm.model_to_transformed_space(w0, ii)
+        else:
+            w0 = jnp.zeros(self.dim, self._dtype)
         offs = jnp.asarray(self._pad(np.asarray(total_offsets, self._dtype)))
         weights = self._down_sample_weights(seed)
         res = self._solve(w0, offs, weights)
+        w_orig = self._norm.model_to_original_space(res.w, ii)
         model = FixedEffectModel(
-            coefficients=Coefficients(means=np.asarray(res.w)),
+            coefficients=Coefficients(means=np.asarray(w_orig)),
             feature_shard=self.config.feature_shard,
             task=self.task,
         )

@@ -48,9 +48,15 @@ class GameEstimator:
     """
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 validation_suite: Optional[EvaluationSuite] = None):
+                 validation_suite: Optional[EvaluationSuite] = None,
+                 normalization: Optional[Dict[str, "NormalizationContext"]] = None):
+        """``normalization``: per-feature-shard NormalizationContext applied
+        to fixed-effect coordinates (reference GameEstimator normalization
+        wrappers, fit:430-436; models come out in original space).  Living on
+        the estimator (not fit()) so tuning retrains inherit it."""
         self.mesh = mesh
         self.validation_suite = validation_suite
+        self.normalization = normalization or {}
 
     def fit(
         self,
@@ -86,10 +92,14 @@ class GameEstimator:
                         coordinates[cid] = old.rebind(ccfg)  # same data, new opt settings
                     except ValueError:
                         coordinates[cid] = build_coordinate(
-                            cid, data, ccfg, config.task, self.mesh, seed=seed)
+                            cid, data, ccfg, config.task, self.mesh,
+                            norm=self.normalization.get(ccfg.feature_shard),
+                            seed=seed)
                 else:
                     coordinates[cid] = build_coordinate(
-                        cid, data, ccfg, config.task, self.mesh, seed=seed)
+                        cid, data, ccfg, config.task, self.mesh,
+                        norm=self.normalization.get(ccfg.feature_shard),
+                        seed=seed)
             prev = coordinates
             validation = None
             if validation_data is not None and self.validation_suite is not None:
@@ -101,9 +111,21 @@ class GameEstimator:
                 validation=validation,
                 locked=locked_coordinates,
             )
-            hook = (None if checkpoint_hook is None else
-                    (lambda m, cur, ci=ci, **kw:
-                     checkpoint_hook(m, {**cur, "config": ci}, **kw)))
+            if checkpoint_hook is None:
+                hook = None
+            else:
+                # First save of each config forces a FULL snapshot: the
+                # in-memory baseline (warm start = previous config's BEST
+                # model when validation is on) can differ from the previous
+                # checkpoint version's final iterate, so hard-linking
+                # "unchanged" coordinates from it would capture stale data.
+                first_save = {"pending": True}
+
+                def hook(m, cur, ci=ci, first_save=first_save, **kw):
+                    if first_save["pending"]:
+                        kw["updated"] = None
+                        first_save["pending"] = False
+                    checkpoint_hook(m, {**cur, "config": ci}, **kw)
             resuming_here = (resume_cursor is not None
                              and ci == resume_cursor.get("config", 0))
             model, history, ev = descent.run(

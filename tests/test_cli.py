@@ -248,6 +248,26 @@ class _RecordingListener(EventListener):
 _seen_events: list = []
 
 
+def test_normalization_cli(tmp_path):
+    """--normalization STANDARDIZATION trains e2e and writes feature stats."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=300, seed=11)
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--validation-data", train_path,
+        "--feature-shards", "all", "--evaluators", "auc",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--normalization", "STANDARDIZATION",
+        "--output-dir", out])
+    assert rc == 0
+    stats = json.load(open(os.path.join(out, "feature-stats.json")))
+    assert "all" in stats and stats["all"]["intercept_index"] == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["validation"]["auc"] > 0.6
+
+
 def test_checkpoint_dir_cli(tmp_path):
     """--checkpoint-dir writes per-update checkpoints; a rerun resumes
     (skipping completed work) and produces a valid model."""

@@ -120,6 +120,54 @@ def test_descent_converges_training_loss(rng):
     assert res.evaluation.values["logistic_loss"] <= min(losses) + 1e-9
 
 
+def test_normalization_returns_original_space_model(rng):
+    """A standardized solve must publish ORIGINAL-space coefficients: with
+    negligible regularization the optimum is normalization-invariant, so the
+    published models must agree (NormalizationContext.scala:73-124 parity)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import (build_normalization,
+                                                  compute_feature_stats)
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game.config import FixedEffectConfig
+    from photon_ml_tpu.types import NormalizationType
+
+    n, d = 600, 4
+    # badly scaled features (bad conditioning, margins still O(1))
+    scales = np.asarray([100.0, 0.01, 5.0, 1.0])
+    x = rng.normal(size=(n, d)) * scales + np.asarray([10.0, 0.0, 0.0, 2.0])
+    x = np.concatenate([x, np.ones((n, 1))], axis=1)  # intercept col 4
+    w_true = np.asarray([0.01, 60.0, -0.2, 0.8, 0.5])
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(np.float64)
+    data = GameData(features={"s": x}, y=y, offset=np.zeros(n), weight=np.ones(n),
+                    id_tags={})
+
+    def fit(norm):
+        cfg = GameConfig(task=TaskType.LOGISTIC_REGRESSION, coordinates={
+            "fixed": FixedEffectConfig(feature_shard="s",
+                                       reg=Regularization(l2=1e-6),
+                                       intercept_index=4)})
+        est = GameEstimator(normalization=norm)
+        return est.fit(data, [cfg])[0].model["fixed"].coefficients.means
+
+    stats = compute_feature_stats(jnp.asarray(x), jnp.asarray(np.ones(n)),
+                                  intercept_index=4)
+    ctx = build_normalization(NormalizationType.STANDARDIZATION, stats)
+    w_plain = fit(None)
+    w_norm = fit({"s": ctx})
+    # the published coefficients are ORIGINAL-space: they recover the
+    # generative weights (including the tiny-scale feature's w=60 that the
+    # unnormalized solve cannot move within its iteration budget)
+    np.testing.assert_allclose(w_norm, w_true, rtol=0.25, atol=0.5)
+
+    def logloss(w):
+        z = np.clip(x @ w, -30, 30)
+        return float(np.mean(np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - z * y))
+
+    # conditioning win: the normalized solve reaches a better optimum
+    assert logloss(w_norm) < logloss(w_plain) - 0.01, (logloss(w_norm), logloss(w_plain))
+
+
 def test_checkpoint_resume_matches_uninterrupted(rng):
     """Preemption mid-descent: resuming from the captured (model, cursor)
     reproduces the uninterrupted run exactly (storage/checkpoint wiring)."""
@@ -160,6 +208,10 @@ def test_checkpoint_preserves_best_model_across_resume(rng):
                    checkpoint_hook=lambda m, cur, **kw: snaps.append((m, cur, kw)))[0]
     # every snapshot after a validated update carries the best-so-far
     assert all(kw["best"] is not None for _, _, kw in snaps)
+    # first save of a config is a FULL snapshot (no stale hard-link baseline);
+    # later saves are incremental with the updated coordinate named
+    assert snaps[0][2]["updated"] is None
+    assert snaps[1][2]["updated"] is not None
     m_ck, cur_ck, kw_ck = snaps[2]
     resumed = est.fit(data, [cfg], validation_data=data, initial_model=m_ck,
                       resume_cursor=cur_ck, resume_best=kw_ck["best"])[0]
