@@ -118,14 +118,26 @@ def _run(args, task, t_start, emitter) -> int:
     id_tags = [s for s in args.id_tags.split(",") if s]
     specs = [parse_coordinate_spec(s) for s in args.coordinates]
 
-    # 1. decode training data ONCE; index maps + design matrices come from
-    # the same decoded records (reference prepareFeatureMaps + readMerged)
-    from photon_ml_tpu.data.avro import read_directory
-    from photon_ml_tpu.data.index_map import build_index_maps_from_records
+    # 1. index maps + training data.  Native loader (native/avro_loader.cpp):
+    # columnar decode, no per-record Python objects — index maps and design
+    # matrices both come from interned columnar buffers.  Python fallback:
+    # decode ONCE, reuse the records for both steps.
+    from photon_ml_tpu.data.avro import list_avro_files
+    from photon_ml_tpu.data.index_map import (build_index_maps_from_avro,
+                                              build_index_maps_from_records)
+    from photon_ml_tpu.data.native_avro import schema_eligible
 
-    train_records = []
-    for path in args.train_data:
-        train_records.extend(read_directory(path))
+    # native columnar path only when EVERY file qualifies — otherwise decode
+    # once through the Python codec and reuse the records for both steps
+    use_native = all(schema_eligible(f) for p in args.train_data
+                     for f in list_avro_files(p))
+    train_records = None
+    if not use_native:
+        from photon_ml_tpu.data.avro import read_directory
+
+        train_records = []
+        for path in args.train_data:
+            train_records.extend(read_directory(path))
     if args.index_map_dir:
         from photon_ml_tpu.data.index_map import load_index
 
@@ -137,6 +149,11 @@ def _run(args, task, t_start, emitter) -> int:
             raise FileNotFoundError(f"no index map for shard {s!r} in {args.index_map_dir}")
 
         index_maps = {s: _resolve(s) for s in shards}
+    elif train_records is None:
+        logger.info("building index maps from training data (native scan)")
+        index_maps = build_index_maps_from_avro(
+            args.train_data, {s: [] for s in shards},
+            add_intercept=not args.no_intercept)
     else:
         logger.info("building index maps from training data")
         index_maps = build_index_maps_from_records(
@@ -144,7 +161,7 @@ def _run(args, task, t_start, emitter) -> int:
     for s in shards:
         logger.info("shard %s: %d features", s, index_maps[s].size)
 
-    # 2. assemble GameData from the decoded records
+    # 2. assemble GameData (columnar fast path inside when native is up)
     data, entity_indexes = read_game_data_avro(args.train_data, index_maps,
                                                id_tag_names=id_tags,
                                                records=train_records)
@@ -156,6 +173,9 @@ def _run(args, task, t_start, emitter) -> int:
                                           id_tag_names=id_tags,
                                           entity_indexes=entity_indexes)
         logger.info("validation: %d samples", val_data.num_samples)
+    from photon_ml_tpu.data.native_avro import clear_columnar_cache
+
+    clear_columnar_cache()  # decoded columns are folded into GameData now
 
     # 3. validate (reference DataValidators)
     errors = validate_game_data(data, task, DataValidationType[args.data_validation])

@@ -363,12 +363,16 @@ def write_container(path: str, schema: Schema, records: Iterable[dict],
     return n_total
 
 
+def list_avro_files(path: str) -> List[str]:
+    """A file itself, or the sorted .avro part-files under a directory."""
+    if os.path.isfile(path):
+        return [path]
+    return [os.path.join(path, name) for name in sorted(os.listdir(path))
+            if name.endswith(".avro")]
+
+
 def read_directory(path: str) -> Iterator[dict]:
     """Read all .avro files under a directory (the reference reads
     part-files from an HDFS dir, AvroUtils.readAvroFiles)."""
-    if os.path.isfile(path):
-        yield from read_container(path)
-        return
-    for name in sorted(os.listdir(path)):
-        if name.endswith(".avro"):
-            yield from read_container(os.path.join(path, name))
+    for f in list_avro_files(path):
+        yield from read_container(f)

@@ -68,6 +68,14 @@ class IndexMap:
     def __contains__(self, key: str) -> bool:
         return key in self._fwd
 
+    def get_indices(self, keys) -> "np.ndarray":
+        """Vectorized key lookup (-1 missing) — same surface as the native
+        StoreIndexMap, so readers can batch-resolve either kind."""
+        import numpy as np
+
+        get = self._fwd.get
+        return np.asarray([get(k, -1) for k in keys], np.int64)
+
     def items(self) -> Iterator[Tuple[str, int]]:
         return iter(self._fwd.items())
 
@@ -167,6 +175,12 @@ def build_index_maps_from_avro(
     """Scan TrainingExampleAvro files and build IndexMaps (see
     build_index_maps_from_records; ``feature_bags`` keys = shard names)."""
     from photon_ml_tpu.data.avro import read_directory
+    from photon_ml_tpu.data.reader import unique_feature_keys
+
+    keys = unique_feature_keys(paths)  # native columnar scan when available
+    if keys is not None:
+        shared = IndexMap.build(keys, add_intercept)
+        return {shard: shared for shard in feature_bags}
 
     def all_records():
         for path in paths:

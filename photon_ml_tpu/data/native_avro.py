@@ -1,0 +1,431 @@
+"""Native Avro data-loader — Python side.
+
+Compiles the container file's embedded WRITER SCHEMA into the int32 pre-order
+tree the C++ decoder walks (native/avro_loader.cpp), tagging the
+TrainingExample-shaped fields with capture roles.  Decoding returns columnar
+numpy arrays with all strings interned — the per-record Python work of the
+fallback codec (data/avro.py) disappears, and feature-name -> column-id
+resolution becomes one vectorized lookup over UNIQUE keys.
+
+Eligibility is structural, not by name matching the full schema: any
+top-level record qualifies; recognized field names (uid/response/label/
+offset/weight/features/metadataMap) capture, everything else is decoded
+generically and discarded.  Ineligible shapes (recursive named types) fall
+back to the Python codec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.avro import read_schema
+from photon_ml_tpu.native.build import compile_library
+
+# ---- type codes / roles (keep in sync with native/avro_loader.cpp) ----------
+T_NULL, T_BOOL, T_INT, T_LONG, T_FLOAT, T_DOUBLE, T_STRING, T_BYTES = range(8)
+T_UNION, T_ARRAY, T_MAP, T_RECORD, T_ENUM, T_FIXED = range(8, 14)
+
+R_NONE = 0
+# numeric capture columns (role = R_NUM0 + column)
+R_NUM0 = 1
+NUM_FIELDS = {"response": 0, "label": 1, "offset": 2, "weight": 3}
+R_UID_LONG, R_UID_STR = 10, 11
+R_FEAT_ARRAY, R_FEAT_NAME, R_FEAT_TERM, R_FEAT_VALUE = 20, 21, 22, 23
+R_META_MAP, R_META_KEY, R_META_VALUE = 30, 31, 32
+
+_PRIMS = {"null": T_NULL, "boolean": T_BOOL, "int": T_INT, "long": T_LONG,
+          "float": T_FLOAT, "double": T_DOUBLE, "string": T_STRING,
+          "bytes": T_BYTES}
+
+_lib = None
+_lib_tried = False
+
+
+def _native_lib():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = compile_library("avro_loader")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.avl_open.restype = ctypes.c_void_p
+    lib.avl_open.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.avl_num_records.restype = ctypes.c_int64
+    lib.avl_num_records.argtypes = [ctypes.c_void_p]
+    pp_d = ctypes.POINTER(ctypes.c_double)
+    pp_u8 = ctypes.POINTER(ctypes.c_uint8)
+    pp_i32 = ctypes.POINTER(ctypes.c_int32)
+    pp_i64 = ctypes.POINTER(ctypes.c_int64)
+    lib.avl_numeric_col.restype = ctypes.c_int64
+    lib.avl_numeric_col.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.POINTER(pp_d), ctypes.POINTER(pp_u8)]
+    lib.avl_uid.restype = ctypes.c_int64
+    lib.avl_uid.argtypes = [ctypes.c_void_p, ctypes.POINTER(pp_i64),
+                            ctypes.POINTER(pp_u8)]
+    lib.avl_features.restype = ctypes.c_int64
+    lib.avl_features.argtypes = [ctypes.c_void_p, ctypes.POINTER(pp_i32),
+                                 ctypes.POINTER(pp_i32), ctypes.POINTER(pp_d)]
+    lib.avl_feature_table.restype = ctypes.c_int64
+    lib.avl_feature_table.argtypes = [ctypes.c_void_p, ctypes.POINTER(pp_u8),
+                                      ctypes.POINTER(pp_i64)]
+    lib.avl_meta.restype = ctypes.c_int64
+    lib.avl_meta.argtypes = [ctypes.c_void_p, ctypes.POINTER(pp_i32),
+                             ctypes.POINTER(pp_i32), ctypes.POINTER(pp_i32)]
+    lib.avl_meta_table.restype = ctypes.c_int64
+    lib.avl_meta_table.argtypes = [ctypes.c_void_p, ctypes.POINTER(pp_u8),
+                                   ctypes.POINTER(pp_i64)]
+    lib.avl_uid_table.restype = ctypes.c_int64
+    lib.avl_uid_table.argtypes = [ctypes.c_void_p, ctypes.POINTER(pp_u8),
+                                  ctypes.POINTER(pp_i64)]
+    lib.avl_close.restype = None
+    lib.avl_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _native_lib() is not None
+
+
+# (path, mtime, size) -> ColumnarFile.  Lets index building and GameData
+# assembly share ONE decode per file (the "decode training data ONCE"
+# invariant); callers clear it when the data is assembled.
+_cache: Dict[tuple, "ColumnarFile"] = {}
+
+
+def clear_columnar_cache() -> None:
+    _cache.clear()
+
+
+def schema_eligible(path: str) -> bool:
+    """Cheap check (header read + tree compile only — no decode)."""
+    if not native_available():
+        return False
+    try:
+        compile_schema(read_schema(path))
+        return True
+    except Exception:
+        return False
+
+
+# ---- schema -> int32 tree ----------------------------------------------------
+
+
+class _Ineligible(Exception):
+    pass
+
+
+def _compile_type(schema, out: List[int], role: int, named: Dict[str, dict],
+                  seen: tuple) -> None:
+    if isinstance(schema, str):
+        if schema in _PRIMS:
+            out.extend([_PRIMS[schema], role])
+            return
+        if schema in named:
+            if schema in seen:
+                raise _Ineligible(f"recursive named type {schema}")
+            _compile_type(named[schema], out, role, named, seen + (schema,))
+            return
+        raise _Ineligible(f"unknown type {schema!r}")
+    if isinstance(schema, list):
+        out.extend([T_UNION, role, len(schema)])
+        for branch in schema:
+            # roles distribute over union branches (e.g. nullable numerics)
+            _compile_type(branch, out, role, named, seen)
+        return
+    t = schema["type"]
+    if t in _PRIMS and len(schema) <= 2:
+        out.extend([_PRIMS[t], role])
+        return
+    if t in ("record", "error"):
+        name = schema.get("name")
+        if name:
+            if name in seen:
+                raise _Ineligible(f"recursive named type {name}")
+            named.setdefault(name, schema)
+            seen = seen + (name,)
+        fields = schema.get("fields", [])
+        out.extend([T_RECORD, role, len(fields)])
+        for f in fields:
+            _compile_type(f["type"], out, R_NONE, named, seen)
+        return
+    if t == "array":
+        out.extend([T_ARRAY, role])
+        _compile_type(schema["items"], out, R_NONE, named, seen)
+        return
+    if t == "map":
+        out.extend([T_MAP, role])
+        _compile_type(schema["values"], out, R_NONE, named, seen)
+        return
+    if t == "enum":
+        named.setdefault(schema.get("name", ""), schema)
+        out.extend([T_ENUM, role])
+        return
+    if t == "fixed":
+        named.setdefault(schema.get("name", ""), schema)
+        out.extend([T_FIXED, role, int(schema["size"])])
+        return
+    _compile_type(t, out, role, named, seen)  # {"type": <nested>}
+
+
+def _resolve(schema, named: Dict[str, dict]):
+    """Follow string references / {"type": ...} wrappers to a concrete node."""
+    while True:
+        if isinstance(schema, str) and schema in named:
+            schema = named[schema]
+        elif isinstance(schema, dict) and isinstance(schema.get("type"), (dict, list)) \
+                and len(schema) == 1:
+            schema = schema["type"]
+        else:
+            return schema
+
+
+def compile_schema(schema: dict) -> np.ndarray:
+    """Writer schema -> role-tagged int32 tree; raises _Ineligible on shapes
+    the C++ walker cannot handle (recursion)."""
+    named: Dict[str, dict] = {}
+    schema = _resolve(schema, named)
+    if not (isinstance(schema, dict) and schema.get("type") == "record"):
+        raise _Ineligible("top-level schema is not a record")
+    if schema.get("name"):
+        named.setdefault(schema["name"], schema)
+
+    out: List[int] = []
+    fields = schema.get("fields", [])
+    out.extend([T_RECORD, R_NONE, len(fields)])
+    for f in fields:
+        fname, ftype = f["name"], f["type"]
+        if fname in NUM_FIELDS:
+            _compile_with_role(ftype, out, R_NUM0 + NUM_FIELDS[fname],
+                               {"long": None, "int": None}, named)
+        elif fname == "uid":
+            _compile_uid(ftype, out, named)
+        elif fname == "features":
+            _compile_features(ftype, out, named)
+        elif fname == "metadataMap":
+            _compile_meta(ftype, out, named)
+        else:
+            _compile_type(ftype, out, R_NONE, named, ())
+    return np.asarray(out, np.int32)
+
+
+def _compile_with_role(ftype, out, role, _unused, named) -> None:
+    """Numeric field (possibly nullable union): role lands on every numeric
+    branch; null branches capture nothing."""
+    if isinstance(ftype, list):
+        out.extend([T_UNION, R_NONE, len(ftype)])
+        for b in ftype:
+            b_res = _resolve(b, named)
+            is_num = b_res in ("int", "long", "float", "double", "boolean")
+            _compile_type(b, out, role if is_num else R_NONE, named, ())
+        return
+    _compile_type(ftype, out, role, named, ())
+
+
+def _compile_uid(ftype, out, named) -> None:
+    if isinstance(ftype, list):
+        out.extend([T_UNION, R_NONE, len(ftype)])
+        for b in ftype:
+            b_res = _resolve(b, named)
+            if b_res in ("int", "long"):
+                _compile_type(b, out, R_UID_LONG, named, ())
+            elif b_res == "string":
+                _compile_type(b, out, R_UID_STR, named, ())
+            else:
+                _compile_type(b, out, R_NONE, named, ())
+        return
+    res = _resolve(ftype, named)
+    role = R_UID_LONG if res in ("int", "long") else (
+        R_UID_STR if res == "string" else R_NONE)
+    _compile_type(ftype, out, role, named, ())
+
+
+def _compile_features(ftype, out, named) -> None:
+    res = _resolve(ftype, named)
+    if isinstance(res, list):  # nullable array
+        out.extend([T_UNION, R_NONE, len(res)])
+        for b in res:
+            br = _resolve(b, named)
+            if isinstance(br, dict) and br.get("type") == "array":
+                _compile_feature_array(br, out, named)
+            else:
+                _compile_type(b, out, R_NONE, named, ())
+        return
+    if isinstance(res, dict) and res.get("type") == "array":
+        _compile_feature_array(res, out, named)
+        return
+    _compile_type(ftype, out, R_NONE, named, ())
+
+
+def _compile_feature_array(arr_schema, out, named) -> None:
+    item = _resolve(arr_schema["items"], named)
+    if not (isinstance(item, dict) and item.get("type") == "record"):
+        _compile_type(arr_schema, out, R_NONE, named, ())
+        return
+    out.extend([T_ARRAY, R_FEAT_ARRAY])
+    fields = item.get("fields", [])
+    if item.get("name"):
+        named.setdefault(item["name"], item)
+    out.extend([T_RECORD, R_NONE, len(fields)])
+    for f in fields:
+        fname = f["name"]
+        if fname == "name":
+            _compile_string_role(f["type"], out, R_FEAT_NAME, named)
+        elif fname == "term":
+            _compile_string_role(f["type"], out, R_FEAT_TERM, named)
+        elif fname == "value":
+            _compile_with_role(f["type"], out, R_FEAT_VALUE, None, named)
+        else:
+            _compile_type(f["type"], out, R_NONE, named, ())
+
+
+def _compile_string_role(ftype, out, role, named) -> None:
+    if isinstance(ftype, list):
+        out.extend([T_UNION, R_NONE, len(ftype)])
+        for b in ftype:
+            _compile_type(b, out, role if _resolve(b, named) == "string" else R_NONE,
+                          named, ())
+        return
+    _compile_type(ftype, out, role if _resolve(ftype, named) == "string" else R_NONE,
+                  named, ())
+
+
+def _compile_meta(ftype, out, named) -> None:
+    res = _resolve(ftype, named)
+    if isinstance(res, list):
+        out.extend([T_UNION, R_NONE, len(res)])
+        for b in res:
+            br = _resolve(b, named)
+            if isinstance(br, dict) and br.get("type") == "map":
+                out.extend([T_MAP, R_META_MAP])
+                _compile_string_role(br["values"], out, R_META_VALUE, named)
+            else:
+                _compile_type(b, out, R_NONE, named, ())
+        return
+    if isinstance(res, dict) and res.get("type") == "map":
+        out.extend([T_MAP, R_META_MAP])
+        _compile_string_role(res["values"], out, R_META_VALUE, named)
+        return
+    _compile_type(ftype, out, R_NONE, named, ())
+
+
+# ---- decode ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ColumnarFile:
+    """One container file decoded to columns (all numpy, zero per-record
+    Python objects)."""
+
+    n: int
+    numeric: Dict[str, np.ndarray]       # field -> f64 values
+    numeric_valid: Dict[str, np.ndarray]  # field -> bool present-mask
+    uids: np.ndarray                     # object array (int/str/None)
+    feat_counts: np.ndarray              # [n] int32
+    feat_ids: np.ndarray                 # [total] int32 into feat_table
+    feat_values: np.ndarray              # [total] f64
+    feat_table: List[str]                # interned "name\x1fterm" keys
+    meta_counts: np.ndarray              # [n] int32
+    meta_keys: np.ndarray                # [entries] int32 into meta_table
+    meta_vals: np.ndarray                # [entries] int32 (-1 = null value)
+    meta_table: List[str]
+
+
+def _table(lib, fn, handle) -> List[str]:
+    blob = ctypes.POINTER(ctypes.c_uint8)()
+    offs = ctypes.POINTER(ctypes.c_int64)()
+    count = fn(handle, ctypes.byref(blob), ctypes.byref(offs))
+    if count == 0:
+        return []
+    offsets = np.ctypeslib.as_array(offs, shape=(count + 1,))
+    raw = bytes(np.ctypeslib.as_array(blob, shape=(int(offsets[-1]),))) if offsets[-1] else b""
+    return [raw[offsets[i]: offsets[i + 1]].decode("utf-8") for i in range(count)]
+
+
+def load_columnar(path: str, cache: bool = False) -> Optional[ColumnarFile]:
+    """Decode one container file natively; None when the library is missing
+    or the schema shape is ineligible (callers fall back to data/avro.py).
+
+    ``cache=True`` memoizes by (path, mtime, size) so a pipeline that needs
+    both the feature vocabulary and the data pays ONE decode per file."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    key = None
+    if cache:
+        import os
+
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
+        hit = _cache.get(key)
+        if hit is not None:
+            return hit
+    try:
+        tree = compile_schema(read_schema(path))
+    except _Ineligible:
+        return None
+    handle = lib.avl_open(path.encode(), tree.ctypes.data, len(tree))
+    if not handle:
+        return None
+    try:
+        n = int(lib.avl_num_records(handle))
+
+        numeric, valid = {}, {}
+        for field, col in NUM_FIELDS.items():
+            pv = ctypes.POINTER(ctypes.c_double)()
+            pm = ctypes.POINTER(ctypes.c_uint8)()
+            lib.avl_numeric_col(handle, col, ctypes.byref(pv), ctypes.byref(pm))
+            numeric[field] = (np.ctypeslib.as_array(pv, shape=(n,)).copy()
+                              if n else np.zeros(0))
+            valid[field] = (np.ctypeslib.as_array(pm, shape=(n,)).copy().astype(bool)
+                            if n else np.zeros(0, bool))
+
+        pu = ctypes.POINTER(ctypes.c_int64)()
+        pk = ctypes.POINTER(ctypes.c_uint8)()
+        lib.avl_uid(handle, ctypes.byref(pu), ctypes.byref(pk))
+        uid_raw = np.ctypeslib.as_array(pu, shape=(n,)).copy() if n else np.zeros(0, np.int64)
+        uid_kind = np.ctypeslib.as_array(pk, shape=(n,)).copy() if n else np.zeros(0, np.uint8)
+
+        pc = ctypes.POINTER(ctypes.c_int32)()
+        pi = ctypes.POINTER(ctypes.c_int32)()
+        pvv = ctypes.POINTER(ctypes.c_double)()
+        total = int(lib.avl_features(handle, ctypes.byref(pc), ctypes.byref(pi),
+                                     ctypes.byref(pvv)))
+        feat_counts = np.ctypeslib.as_array(pc, shape=(n,)).copy() if n else np.zeros(0, np.int32)
+        feat_ids = np.ctypeslib.as_array(pi, shape=(total,)).copy() if total else np.zeros(0, np.int32)
+        feat_values = np.ctypeslib.as_array(pvv, shape=(total,)).copy() if total else np.zeros(0)
+        feat_table = _table(lib, lib.avl_feature_table, handle)
+
+        pmc = ctypes.POINTER(ctypes.c_int32)()
+        pmk = ctypes.POINTER(ctypes.c_int32)()
+        pmv = ctypes.POINTER(ctypes.c_int32)()
+        entries = int(lib.avl_meta(handle, ctypes.byref(pmc), ctypes.byref(pmk),
+                                   ctypes.byref(pmv)))
+        meta_counts = np.ctypeslib.as_array(pmc, shape=(n,)).copy() if n else np.zeros(0, np.int32)
+        meta_keys = np.ctypeslib.as_array(pmk, shape=(entries,)).copy() if entries else np.zeros(0, np.int32)
+        meta_vals = np.ctypeslib.as_array(pmv, shape=(entries,)).copy() if entries else np.zeros(0, np.int32)
+        meta_table = _table(lib, lib.avl_meta_table, handle)
+        uid_table = _table(lib, lib.avl_uid_table, handle)
+
+        uids = np.empty(n, object)
+        for i in range(n):  # small: uid decode only (kinds are rare-branch)
+            k = uid_kind[i]
+            uids[i] = (int(uid_raw[i]) if k == 1
+                       else uid_table[uid_raw[i]] if k == 2 else None)
+
+        out = ColumnarFile(
+            n=n, numeric=numeric, numeric_valid=valid, uids=uids,
+            feat_counts=feat_counts, feat_ids=feat_ids, feat_values=feat_values,
+            feat_table=feat_table, meta_counts=meta_counts, meta_keys=meta_keys,
+            meta_vals=meta_vals, meta_table=meta_table)
+        if key is not None:
+            _cache[key] = out
+        return out
+    finally:
+        lib.avl_close(handle)

@@ -84,6 +84,10 @@ def read_game_data_avro(
     from photon_ml_tpu.data.avro import read_directory
 
     if records is None:
+        fast = _read_game_data_columnar(paths, index_maps, id_tag_names,
+                                        entity_indexes, dtype)
+        if fast is not None:
+            return fast
         records = []
         for path in paths:
             records.extend(read_directory(path))
@@ -132,6 +136,108 @@ def read_game_data_avro(
     data = GameData(y=y, features=mats, offset=offset, weight=weight, id_tags=tags,
                     uids=uids)
     return data, entity_indexes
+
+
+def _read_game_data_columnar(paths, index_maps, id_tag_names, entity_indexes,
+                             dtype) -> Optional[Tuple[GameData, Dict[str, EntityIndex]]]:
+    """Native-loader fast path: columnar decode (native/avro_loader.cpp) +
+    fully vectorized assembly.  Feature keys resolve through the index map
+    ONCE per unique key; the design matrices fill with one np.add.at per
+    file.  Returns None (caller falls back to the record loop) when the
+    native library or an eligible schema is unavailable."""
+    from photon_ml_tpu.data.avro import list_avro_files
+    from photon_ml_tpu.data.native_avro import load_columnar, native_available
+
+    if not native_available():
+        return None
+    files = [f for p in paths for f in list_avro_files(p)]
+    cols = []
+    for f in files:
+        c = load_columnar(f, cache=True)  # shared with index building
+        if c is None:
+            return None  # ineligible schema: single decode via fallback
+        cols.append(c)
+
+    n = sum(c.n for c in cols)
+    y = np.zeros(n, dtype)
+    offset = np.zeros(n, dtype)
+    weight = np.ones(n, dtype)
+    uids = np.empty(n, object)
+
+    # shards sharing one IndexMap share one matrix (see caller docstring)
+    groups: Dict[int, List[str]] = {}
+    for shard, m in index_maps.items():
+        groups.setdefault(id(m), []).append(shard)
+    group_maps = {gid: index_maps[shards[0]] for gid, shards in groups.items()}
+    group_mats = {gid: np.zeros((n, m.size), dtype) for gid, m in group_maps.items()}
+    mats = {shard: group_mats[gid] for gid, shards in groups.items() for shard in shards}
+
+    id_tag_names = list(id_tag_names)
+    entity_indexes = entity_indexes or {}
+    for tag in id_tag_names:
+        entity_indexes.setdefault(tag, EntityIndex())
+    tags = {tag: np.full(n, -1, np.int64) for tag in id_tag_names}
+
+    base = 0
+    for c in cols:
+        sl = slice(base, base + c.n)
+        rv, lv = c.numeric_valid["response"], c.numeric_valid["label"]
+        y[sl] = np.where(rv, c.numeric["response"],
+                         np.where(lv, c.numeric["label"], 0.0)).astype(dtype)
+        offset[sl] = np.where(c.numeric_valid["offset"], c.numeric["offset"], 0.0)
+        weight[sl] = np.where(c.numeric_valid["weight"], c.numeric["weight"], 1.0)
+        uids[sl] = c.uids
+
+        rec_of_feat = base + np.repeat(np.arange(c.n), c.feat_counts)
+        for gid, m in group_maps.items():
+            x = group_mats[gid]
+            ii = m.intercept_index
+            if ii is not None:
+                x[sl, ii] = 1.0
+            col_of = m.get_indices(c.feat_table)  # UNIQUE keys only
+            feat_cols = col_of[c.feat_ids] if len(c.feat_ids) else np.zeros(0, np.int64)
+            ok = feat_cols >= 0
+            # += accumulation for duplicate (row, col) pairs (fallback parity)
+            np.add.at(x, (rec_of_feat[ok], feat_cols[ok]),
+                      c.feat_values[ok].astype(dtype))
+
+        if id_tag_names and len(c.meta_keys):
+            rec_of_meta = base + np.repeat(np.arange(c.n), c.meta_counts)
+            key_strs = np.asarray(c.meta_table, object)
+            for tag in id_tag_names:
+                matches = np.flatnonzero(key_strs == tag)
+                if len(matches) == 0:
+                    continue
+                hit = (c.meta_keys == matches[0]) & (c.meta_vals >= 0)
+                vals = c.meta_vals[hit]
+                uniq = np.unique(vals)
+                eidx = entity_indexes[tag]
+                remap = {int(v): eidx.get_or_add(c.meta_table[v]) for v in uniq}
+                tags[tag][rec_of_meta[hit]] = [remap[int(v)] for v in vals]
+        base += c.n
+
+    data = GameData(y=y, features=mats, offset=offset, weight=weight,
+                    id_tags=tags, uids=uids)
+    return data, entity_indexes
+
+
+def unique_feature_keys(paths) -> Optional[Dict[str, None]]:
+    """Distinct feature keys across files via the native loader (insertion
+    order preserved); None when unavailable — used by index building."""
+    from photon_ml_tpu.data.avro import list_avro_files
+    from photon_ml_tpu.data.native_avro import load_columnar, native_available
+
+    if not native_available():
+        return None
+    out: Dict[str, None] = {}
+    for p in paths:
+        for f in list_avro_files(p):
+            c = load_columnar(f, cache=True)  # shared with GameData assembly
+            if c is None:
+                return None
+            for k in c.feat_table:
+                out.setdefault(k)
+    return out
 
 
 def read_libsvm(path: str, num_features: Optional[int] = None,

@@ -17,6 +17,8 @@ logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _FLAGS = ["-O2", "-shared", "-fPIC", "-std=c++17", "-Wall"]
+# per-translation-unit link flags
+_EXTRA = {"avro_loader": ["-lz"]}
 
 
 def library_path(name: str) -> str:
@@ -40,8 +42,8 @@ def compile_library(name: str, force: bool = False) -> Optional[str]:
     try:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
         os.close(fd)
-        subprocess.run(["g++", *_FLAGS, "-o", tmp, src], check=True,
-                       capture_output=True, text=True)
+        subprocess.run(["g++", *_FLAGS, "-o", tmp, src, *_EXTRA.get(name, [])],
+                       check=True, capture_output=True, text=True)
         os.replace(tmp, out)
         return out
     except (subprocess.CalledProcessError, OSError) as e:

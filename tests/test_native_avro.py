@@ -1,0 +1,194 @@
+"""Native Avro loader: columnar decode parity vs the Python record path."""
+
+import time
+
+import numpy as np
+import pytest
+
+import photon_ml_tpu.data.native_avro as na
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data.index_map import IndexMap, build_index_maps_from_avro, feature_key
+from photon_ml_tpu.data.reader import EntityIndex, read_game_data_avro
+from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+
+
+def _fixture(path, n=400, seed=0, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    records = []
+    for i in range(n):
+        feats = [{"name": f"f{j}", "term": f"t{j % 3}",
+                  "value": float(rng.normal())} for j in rng.integers(0, 6, size=3)]
+        feats.append({"name": "dup", "term": "", "value": 1.0})
+        feats.append({"name": "dup", "term": "", "value": 0.5})  # accumulation
+        rec = {"uid": i, "response": float(rng.random() < 0.5),
+               "label": None,
+               "offset": None if (with_nulls and i % 3 == 0) else float(rng.normal() * 0.1),
+               "weight": None if (with_nulls and i % 4 == 0) else float(rng.uniform(0.5, 2)),
+               "features": feats,
+               "metadataMap": {"userId": f"u{i % 7}", "other": f"x{i % 2}"}}
+        records.append(rec)
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+    return records
+
+
+@pytest.fixture(scope="module")
+def avro_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("avro") / "train.avro")
+    return path, _fixture(path)
+
+
+def test_native_lib_compiles():
+    assert na.native_available(), "g++ compile of avro_loader.cpp failed"
+
+
+def test_columnar_matches_python_codec(avro_path):
+    path, records = avro_path
+    c = na.load_columnar(path)
+    assert c is not None and c.n == len(records)
+    for i in (0, 3, 4, 57):
+        rec = records[i]
+        assert c.numeric["response"][i] == rec["response"]
+        assert bool(c.numeric_valid["offset"][i]) == (rec["offset"] is not None)
+        if rec["offset"] is not None:
+            assert c.numeric["offset"][i] == pytest.approx(rec["offset"])
+        assert c.uids[i] == rec["uid"]
+        assert c.feat_counts[i] == len(rec["features"])
+    # interning: every (name, term) appears exactly once in the table
+    assert len(set(c.feat_table)) == len(c.feat_table)
+    assert "dup\x1f" in c.feat_table
+
+
+def test_game_data_parity_fast_vs_fallback(avro_path, monkeypatch):
+    """read_game_data_avro must produce IDENTICAL GameData either way."""
+    path, _ = avro_path
+    imap = build_index_maps_from_avro([path], {"all": []})["all"]
+    fast, eidx_fast = read_game_data_avro([path], {"all": imap},
+                                          id_tag_names=["userId"])
+    assert na.native_available()
+
+    monkeypatch.setattr(na, "_lib", None)
+    monkeypatch.setattr(na, "_lib_tried", True)
+    slow, eidx_slow = read_game_data_avro([path], {"all": imap},
+                                          id_tag_names=["userId"])
+
+    np.testing.assert_array_equal(fast.y, slow.y)
+    np.testing.assert_allclose(fast.offset, slow.offset, rtol=1e-6)
+    np.testing.assert_allclose(fast.weight, slow.weight, rtol=1e-6)
+    np.testing.assert_allclose(fast.features["all"], slow.features["all"], rtol=1e-6)
+    # entity ids may be assigned in different order; compare via names
+    names_fast = [eidx_fast["userId"].name_of(i) for i in fast.id_tags["userId"]]
+    names_slow = [eidx_slow["userId"].name_of(i) for i in slow.id_tags["userId"]]
+    assert names_fast == names_slow
+    assert list(fast.uids) == list(slow.uids)
+
+
+def test_multiple_files_concatenate(tmp_path):
+    p1, p2 = str(tmp_path / "a.avro"), str(tmp_path / "b.avro")
+    r1, r2 = _fixture(p1, n=50, seed=1), _fixture(p2, n=30, seed=2)
+    imap = build_index_maps_from_avro([p1, p2], {"all": []})["all"]
+    data, _ = read_game_data_avro([p1, p2], {"all": imap}, id_tag_names=["userId"])
+    assert data.num_samples == 80
+    assert data.y[0] == r1[0]["response"] and data.y[50] == r2[0]["response"]
+
+
+def test_ineligible_schema_falls_back(tmp_path):
+    """A non-TrainingExample schema decodes via the Python codec path."""
+    weird = {"type": "record", "name": "W", "fields": [
+        {"name": "a", "type": {"type": "array", "items": {
+            "type": "array", "items": "long"}}}]}
+    path = str(tmp_path / "weird.avro")
+    avro_io.write_container(path, weird, [{"a": [[1, 2], [3]]}])
+    # generic walk handles nested arrays; eligibility only rejects recursion —
+    # columnar decode succeeds but captures nothing
+    c = na.load_columnar(path)
+    assert c is None or c.feat_counts.sum() == 0
+
+
+def test_recursive_schema_rejected(tmp_path):
+    rec = {"type": "record", "name": "Node", "fields": [
+        {"name": "next", "type": ["null", "Node"]}]}
+    path = str(tmp_path / "rec.avro")
+    avro_io.write_container(path, rec, [{"next": None}])
+    assert na.load_columnar(path) is None  # falls back, no crash
+
+
+def test_string_uids_not_in_feature_table(tmp_path):
+    """String uids intern into their OWN table — natively-built index maps
+    must not grow a column per distinct uid."""
+    schema = {"type": "record", "name": "T", "fields": [
+        {"name": "uid", "type": ["null", "string", "long"]},
+        {"name": "response", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": {
+            "type": "record", "name": "F", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": "string"},
+                {"name": "value", "type": "double"}]}}}]}
+    path = str(tmp_path / "s.avro")
+    avro_io.write_container(path, schema, [
+        {"uid": "user-abc", "response": 1.0,
+         "features": [{"name": "f", "term": "t", "value": 2.0}]},
+        {"uid": 42, "response": 0.0, "features": []},
+    ])
+    c = na.load_columnar(path)
+    assert c is not None
+    assert list(c.uids) == ["user-abc", 42]
+    assert c.feat_table == [feature_key("f", "t")]  # no uid pollution
+    keys = build_index_maps_from_avro([path], {"all": []})["all"]
+    assert keys.get_index("user-abc") == -1
+
+
+def test_long_typed_feature_values(tmp_path):
+    """int/long feature values must capture (not silently decode to 0)."""
+    schema = {"type": "record", "name": "T", "fields": [
+        {"name": "response", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": {
+            "type": "record", "name": "F", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": "string"},
+                {"name": "value", "type": "long"}]}}}]}
+    path = str(tmp_path / "l.avro")
+    avro_io.write_container(path, schema, [
+        {"response": 1.0, "features": [{"name": "a", "term": "", "value": 7}]}])
+    c = na.load_columnar(path)
+    assert c is not None
+    np.testing.assert_array_equal(c.feat_values, [7.0])
+
+
+def test_columnar_cache_single_decode(tmp_path, monkeypatch):
+    """Index building + GameData assembly share one decode per file."""
+    import photon_ml_tpu.data.native_avro as mod
+
+    path = str(tmp_path / "c.avro")
+    _fixture(path, n=20, seed=4)
+    mod.clear_columnar_cache()
+    opens = []
+    lib = mod._native_lib()
+    real_open = lib.avl_open
+    monkeypatch.setattr(lib, "avl_open",
+                        lambda *a: (opens.append(1), real_open(*a))[1])
+    imap = build_index_maps_from_avro([path], {"all": []})["all"]
+    read_game_data_avro([path], {"all": imap})
+    assert len(opens) == 1
+    mod.clear_columnar_cache()
+
+
+def test_native_speedup_smoke(tmp_path):
+    """The native decode should beat the Python codec comfortably."""
+    path = str(tmp_path / "big.avro")
+    _fixture(path, n=4000, seed=3)
+    imap = build_index_maps_from_avro([path], {"all": []})["all"]
+
+    t0 = time.perf_counter()
+    read_game_data_avro([path], {"all": imap}, id_tag_names=["userId"])
+    t_fast = time.perf_counter() - t0
+
+    import photon_ml_tpu.data.native_avro as mod
+    orig, tried = mod._lib, mod._lib_tried
+    try:
+        mod._lib, mod._lib_tried = None, True
+        t0 = time.perf_counter()
+        read_game_data_avro([path], {"all": imap}, id_tag_names=["userId"])
+        t_slow = time.perf_counter() - t0
+    finally:
+        mod._lib, mod._lib_tried = orig, tried
+    assert t_fast < t_slow, (t_fast, t_slow)
