@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-intercept", action="store_true")
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
+    p.add_argument("--sparse-threshold", type=int, default=0,
+                   help="shards with >= this many features load as row-padded "
+                        "sparse layouts (0 = always dense); the huge-vocabulary "
+                        "path (reference scale story, SURVEY §2.7)")
     p.add_argument("--normalization", default="NONE",
                    choices=["NONE", "SCALE_WITH_MAX_MAGNITUDE",
                             "SCALE_WITH_STANDARD_DEVIATION", "STANDARDIZATION"],
@@ -114,6 +118,8 @@ def run(argv: List[str]) -> int:
 
 
 def _run(args, task, t_start, emitter) -> int:
+    from photon_ml_tpu.game.config import FixedEffectConfig
+
     shards = [s for s in args.feature_shards.split(",") if s]
     id_tags = [s for s in args.id_tags.split(",") if s]
     specs = [parse_coordinate_spec(s) for s in args.coordinates]
@@ -161,17 +167,33 @@ def _run(args, task, t_start, emitter) -> int:
     for s in shards:
         logger.info("shard %s: %d features", s, index_maps[s].size)
 
+    sparse_shards = set()
+    if args.sparse_threshold > 0:
+        sparse_shards = {s for s in shards
+                         if index_maps[s].size >= args.sparse_threshold}
+        re_shards = {spec.template.feature_shard for spec in specs
+                     if not isinstance(spec.template, FixedEffectConfig)}
+        forced_dense = sparse_shards & re_shards
+        if forced_dense:
+            logger.warning("shards %s stay dense: random-effect coordinates "
+                           "need dense shards", sorted(forced_dense))
+            sparse_shards -= forced_dense
+        if sparse_shards:
+            logger.info("sparse shards: %s", sorted(sparse_shards))
+
     # 2. assemble GameData (columnar fast path inside when native is up)
     data, entity_indexes = read_game_data_avro(args.train_data, index_maps,
                                                id_tag_names=id_tags,
-                                               records=train_records)
+                                               records=train_records,
+                                               sparse_shards=sparse_shards)
     del train_records
     logger.info("train: %d samples", data.num_samples)
     val_data = None
     if args.validation_data:
         val_data, _ = read_game_data_avro(args.validation_data, index_maps,
                                           id_tag_names=id_tags,
-                                          entity_indexes=entity_indexes)
+                                          entity_indexes=entity_indexes,
+                                          sparse_shards=sparse_shards)
         logger.info("validation: %d samples", val_data.num_samples)
     from photon_ml_tpu.data.native_avro import clear_columnar_cache
 
@@ -195,7 +217,6 @@ def _run(args, task, t_start, emitter) -> int:
 
         from photon_ml_tpu.core.normalization import (build_normalization,
                                                       compute_feature_stats)
-        from photon_ml_tpu.game.config import FixedEffectConfig
         from photon_ml_tpu.types import NormalizationType
 
         kind = NormalizationType[args.normalization]
@@ -211,6 +232,11 @@ def _run(args, task, t_start, emitter) -> int:
                 "--normalization applies to fixed-effect coordinates only; "
                 "random-effect coordinates (shards %s) train unnormalized",
                 sorted(re_shards))
+        skipped = fixed_shards & sparse_shards
+        if skipped:
+            logger.warning("normalization skipped for sparse shards %s "
+                           "(needs dense stats)", sorted(skipped))
+            fixed_shards -= skipped
         normalization = {}
         for s in sorted(fixed_shards):
             ii = index_maps[s].intercept_index
@@ -302,6 +328,7 @@ def _run(args, task, t_start, emitter) -> int:
                              "lock": args.lock_coordinates,
                              "model_input": args.model_input_dir,
                              "normalization": args.normalization,
+                             "sparse_threshold": args.sparse_threshold,
                              "feature_shards": args.feature_shards,
                              "id_tags": args.id_tags,
                              "no_intercept": args.no_intercept,

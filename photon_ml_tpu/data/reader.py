@@ -73,19 +73,23 @@ def read_game_data_avro(
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     dtype=np.float32,
     records: Optional[List[dict]] = None,
+    sparse_shards: Optional[Iterable[str]] = None,
 ) -> Tuple[GameData, Dict[str, EntityIndex]]:
     """TrainingExampleAvro files -> GameData.
 
     Every feature shard in ``index_maps`` gets a dense [n, d_shard] design
-    matrix (intercept column filled with 1 when the map has one).  ``id_tag``
-    values come from metadataMap[tag] (reference GameConverters id-tag
-    extraction); entity string ids pass through EntityIndex.
+    matrix (intercept column filled with 1 when the map has one) — except
+    shards named in ``sparse_shards``, which become row-padded SparseShard
+    layouts (O(n*k) not O(n*d); the 1e6-feature scale path, SURVEY §2.7).
+    ``id_tag`` values come from metadataMap[tag] (reference GameConverters
+    id-tag extraction); entity string ids pass through EntityIndex.
     """
     from photon_ml_tpu.data.avro import read_directory
 
+    sparse_shards = set(sparse_shards or ())
     if records is None:
         fast = _read_game_data_columnar(paths, index_maps, id_tag_names,
-                                        entity_indexes, dtype)
+                                        entity_indexes, dtype, sparse_shards)
         if fast is not None:
             return fast
         records = []
@@ -100,12 +104,9 @@ def read_game_data_avro(
     # Shards sharing one IndexMap object get ONE matrix filled once and
     # aliased (read-only downstream) — k identical shards would otherwise cost
     # k decode passes and k copies of an [n, d] dense block.
-    groups: Dict[int, List[str]] = {}
-    for shard, m in index_maps.items():
-        groups.setdefault(id(m), []).append(shard)
-    group_maps = {gid: index_maps[shards[0]] for gid, shards in groups.items()}
-    group_mats = {gid: np.zeros((n, m.size), dtype) for gid, m in group_maps.items()}
-    mats = {shard: group_mats[gid] for gid, shards in groups.items() for shard in shards}
+    groups, group_maps, group_sparse = _shard_groups(index_maps, sparse_shards)
+    group_mats = {gid: np.zeros((n, m.size), dtype)
+                  for gid, m in group_maps.items() if not group_sparse[gid]}
     id_tag_names = list(id_tag_names)
     entity_indexes = entity_indexes or {}
     for tag in id_tag_names:
@@ -124,6 +125,8 @@ def read_game_data_avro(
             if tag in meta:
                 tags[tag][i] = entity_indexes[tag].get_or_add(str(meta[tag]))
         for gid, m in group_maps.items():
+            if group_sparse[gid]:
+                continue
             x = group_mats[gid]
             ii = m.intercept_index
             if ii is not None:
@@ -133,13 +136,62 @@ def read_game_data_avro(
                 if j >= 0:
                     x[i, j] += feat["value"]
 
+    mats: Dict[str, object] = {}
+    for gid, shards_of in groups.items():
+        m = group_maps[gid]
+        if group_sparse[gid]:
+            sparse = _sparse_from_records(records, m, dtype)
+            for shard in shards_of:
+                mats[shard] = sparse
+        else:
+            for shard in shards_of:
+                mats[shard] = group_mats[gid]
+
     data = GameData(y=y, features=mats, offset=offset, weight=weight, id_tags=tags,
                     uids=uids)
     return data, entity_indexes
 
 
+def _shard_groups(index_maps, sparse_shards):
+    """Group shards sharing one IndexMap object (one matrix per group);
+    a group is sparse when any of its shards was requested sparse."""
+    groups: Dict[int, List[str]] = {}
+    for shard, m in index_maps.items():
+        groups.setdefault(id(m), []).append(shard)
+    group_maps = {gid: index_maps[shards[0]] for gid, shards in groups.items()}
+    group_sparse = {gid: any(sh in sparse_shards for sh in shards)
+                    for gid, shards in groups.items()}
+    return groups, group_maps, group_sparse
+
+
+def _sparse_from_records(records, m, dtype):
+    """Row-padded COO from decoded records (fallback path)."""
+    from photon_ml_tpu.game.data import SparseShard
+
+    n = len(records)
+    ii = m.intercept_index
+    extra = 1 if ii is not None else 0
+    k = max((len(r.get("features") or ()) for r in records), default=0) + extra
+    k = max(k, 1)
+    idx = np.zeros((n, k), np.int32)
+    vals = np.zeros((n, k), dtype)
+    for i, rec in enumerate(records):
+        p = 0
+        for feat in rec.get("features", []):
+            j = m.get_index(feat["name"], feat.get("term") or "")
+            if j >= 0:
+                idx[i, p] = j
+                vals[i, p] = feat["value"]
+                p += 1
+        if ii is not None:
+            idx[i, p] = ii
+            vals[i, p] = 1.0
+    return SparseShard(indices=idx, values=vals, dim=m.size)
+
+
 def _read_game_data_columnar(paths, index_maps, id_tag_names, entity_indexes,
-                             dtype) -> Optional[Tuple[GameData, Dict[str, EntityIndex]]]:
+                             dtype, sparse_shards=frozenset()
+                             ) -> Optional[Tuple[GameData, Dict[str, EntityIndex]]]:
     """Native-loader fast path: columnar decode (native/avro_loader.cpp) +
     fully vectorized assembly.  Feature keys resolve through the index map
     ONCE per unique key; the design matrices fill with one np.add.at per
@@ -165,12 +217,18 @@ def _read_game_data_columnar(paths, index_maps, id_tag_names, entity_indexes,
     uids = np.empty(n, object)
 
     # shards sharing one IndexMap share one matrix (see caller docstring)
-    groups: Dict[int, List[str]] = {}
-    for shard, m in index_maps.items():
-        groups.setdefault(id(m), []).append(shard)
-    group_maps = {gid: index_maps[shards[0]] for gid, shards in groups.items()}
-    group_mats = {gid: np.zeros((n, m.size), dtype) for gid, m in group_maps.items()}
-    mats = {shard: group_mats[gid] for gid, shards in groups.items() for shard in shards}
+    groups, group_maps, group_sparse = _shard_groups(index_maps, sparse_shards)
+    group_mats = {gid: np.zeros((n, m.size), dtype)
+                  for gid, m in group_maps.items() if not group_sparse[gid]}
+    # sparse groups: one row-padded COO per group, k = global max active + intercept
+    k_raw = max((int(c.feat_counts.max()) if len(c.feat_counts) else 0)
+                for c in cols) if cols else 0
+    group_coo = {}
+    for gid, m in group_maps.items():
+        if group_sparse[gid]:
+            extra = 1 if m.intercept_index is not None else 0
+            k = max(k_raw + extra, 1)
+            group_coo[gid] = (np.zeros((n, k), np.int32), np.zeros((n, k), dtype))
 
     id_tag_names = list(id_tag_names)
     entity_indexes = entity_indexes or {}
@@ -189,17 +247,30 @@ def _read_game_data_columnar(paths, index_maps, id_tag_names, entity_indexes,
         uids[sl] = c.uids
 
         rec_of_feat = base + np.repeat(np.arange(c.n), c.feat_counts)
+        starts = np.concatenate([[0], np.cumsum(c.feat_counts)])
+        pos_in_rec = (np.arange(len(c.feat_ids))
+                      - np.repeat(starts[:-1], c.feat_counts))
         for gid, m in group_maps.items():
-            x = group_mats[gid]
             ii = m.intercept_index
-            if ii is not None:
-                x[sl, ii] = 1.0
             col_of = m.get_indices(c.feat_table)  # UNIQUE keys only
             feat_cols = col_of[c.feat_ids] if len(c.feat_ids) else np.zeros(0, np.int64)
             ok = feat_cols >= 0
-            # += accumulation for duplicate (row, col) pairs (fallback parity)
-            np.add.at(x, (rec_of_feat[ok], feat_cols[ok]),
-                      c.feat_values[ok].astype(dtype))
+            if group_sparse[gid]:
+                idx, vals = group_coo[gid]
+                # padded COO: place valid features at their raw slot; invalid
+                # ones stay (0, 0) which is inert (SparseBatch contract)
+                idx[rec_of_feat[ok], pos_in_rec[ok]] = feat_cols[ok]
+                vals[rec_of_feat[ok], pos_in_rec[ok]] = c.feat_values[ok].astype(dtype)
+                if ii is not None:
+                    idx[sl, -1] = ii
+                    vals[sl, -1] = 1.0
+            else:
+                x = group_mats[gid]
+                if ii is not None:
+                    x[sl, ii] = 1.0
+                # += accumulation for duplicate (row, col) pairs (fallback parity)
+                np.add.at(x, (rec_of_feat[ok], feat_cols[ok]),
+                          c.feat_values[ok].astype(dtype))
 
         if id_tag_names and len(c.meta_keys):
             rec_of_meta = base + np.repeat(np.arange(c.n), c.meta_counts)
@@ -215,6 +286,19 @@ def _read_game_data_columnar(paths, index_maps, id_tag_names, entity_indexes,
                 remap = {int(v): eidx.get_or_add(c.meta_table[v]) for v in uniq}
                 tags[tag][rec_of_meta[hit]] = [remap[int(v)] for v in vals]
         base += c.n
+
+    from photon_ml_tpu.game.data import SparseShard
+
+    mats: Dict[str, object] = {}
+    for gid, shards_of in groups.items():
+        if group_sparse[gid]:
+            idx, vals = group_coo[gid]
+            shard_data = SparseShard(indices=idx, values=vals,
+                                     dim=group_maps[gid].size)
+        else:
+            shard_data = group_mats[gid]
+        for shard in shards_of:
+            mats[shard] = shard_data
 
     data = GameData(y=y, features=mats, offset=offset, weight=weight,
                     id_tags=tags, uids=uids)

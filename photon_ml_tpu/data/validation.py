@@ -58,7 +58,8 @@ def validate_game_data(data: GameData, task: TaskType,
         errors.append("weights must be positive (reference: zero/negative weight rows rejected)")
 
     for shard, x in data.features.items():
-        if not np.all(np.isfinite(np.asarray(x)[idx])):
+        arr = x.values if hasattr(x, "indices") else np.asarray(x)  # SparseShard
+        if not np.all(np.isfinite(np.asarray(arr)[idx])):
             errors.append(f"feature shard {shard!r} contains non-finite values")
 
     if task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):

@@ -27,12 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.batch import DenseBatch, SparseBatch
 from photon_ml_tpu.core.losses import loss_for_task
 from photon_ml_tpu.core.normalization import NormalizationContext, no_normalization
 from photon_ml_tpu.core.objective import GLMObjective
 from photon_ml_tpu.game.config import CoordinateConfig, FixedEffectConfig, RandomEffectConfig
-from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.data import GameData, SparseShard
 from photon_ml_tpu.models.game import DatumScoringModel, FixedEffectModel, RandomEffectModel
 from photon_ml_tpu.models.glm import Coefficients
 from photon_ml_tpu.opt.solve import make_solver
@@ -97,13 +97,18 @@ class FixedEffectCoordinate(Coordinate):
         self._dtype = dtype
         self._base_offset = np.asarray(data.offset, np.float64)
 
-        x = np.asarray(data.features[config.feature_shard], dtype)
-        batch = DenseBatch(
-            x=jnp.asarray(x),
-            y=jnp.asarray(np.asarray(data.y, dtype)),
-            offset=jnp.asarray(np.asarray(data.offset, dtype)),
-            weight=jnp.asarray(np.asarray(data.weight, dtype)),
-        )
+        shard_data = data.features[config.feature_shard]
+        y = jnp.asarray(np.asarray(data.y, dtype))
+        offs0 = jnp.asarray(np.asarray(data.offset, dtype))
+        wt0 = jnp.asarray(np.asarray(data.weight, dtype))
+        if isinstance(shard_data, SparseShard):
+            batch = SparseBatch(
+                indices=jnp.asarray(shard_data.indices),
+                values=jnp.asarray(np.asarray(shard_data.values, dtype)),
+                y=y, offset=offs0, weight=wt0, dim=shard_data.dim)
+        else:
+            batch = DenseBatch(x=jnp.asarray(np.asarray(shard_data, dtype)),
+                               y=y, offset=offs0, weight=wt0)
         # One-time row padding to the fused-kernel block granule so the
         # pallas path never re-pads (and re-copies X) per solver call.
         from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
@@ -132,7 +137,7 @@ class FixedEffectCoordinate(Coordinate):
             shifts=None if norm.shifts is None else jnp.asarray(norm.shifts, dtype))
         self._bind_solver()
         batch = self._batch
-        self._score = jax.jit(lambda w: batch.x @ w)
+        self._score = jax.jit(lambda w: batch.margins(w))
 
     def _bind_solver(self) -> None:
         # Both paths use the pallas fused kernels (ops/fused_glm.py) where
@@ -248,7 +253,14 @@ class RandomEffectCoordinate(Coordinate):
         self.dim = data.shard_dim(config.feature_shard)
         self._base_offset = np.asarray(data.offset, np.float64)
 
-        x = np.asarray(data.features[config.feature_shard], dtype)
+        shard_data = data.features[config.feature_shard]
+        if isinstance(shard_data, SparseShard):
+            raise NotImplementedError(
+                f"random-effect coordinate {coordinate_id!r} needs a dense "
+                f"feature shard; {config.feature_shard!r} is sparse — use a "
+                "separate (projected/smaller) dense shard for random effects, "
+                "as the reference does via per-entity projection (SURVEY §2.7)")
+        x = np.asarray(shard_data, dtype)
         entity_ids = data.id_tags[config.random_effect_type]
         lane_multiple = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
         self.buckets = bucket_by_entity(

@@ -17,9 +17,36 @@ uniqueId-keyed joins with positional alignment.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
 
 import numpy as np
+
+
+@dataclasses.dataclass
+class SparseShard:
+    """Row-padded COO design "matrix" for wide sparse vocabularies.
+
+    The reference streams Breeze SparseVectors per datum; here the whole
+    shard is two [n, k] arrays (k = max active features per row + intercept)
+    matching core/batch.SparseBatch's layout, so a 1e6-feature CTR shard
+    costs O(n*k), not O(n*d).  Padded slots carry (index 0, value 0) —
+    inert in margins and gradients.  Duplicate indices within a row are
+    tolerated (they accumulate in margins/gradients, like repeated (name,
+    term) entries accumulate in the dense path) but make SIMPLE-variance
+    Hessian diagonals approximate.
+    """
+
+    indices: np.ndarray  # [n, k] int32 column ids
+    values: np.ndarray   # [n, k] float
+    dim: int             # vocabulary size (d)
+
+    @property
+    def shape(self):
+        # mimics a dense [n, d] matrix so shard_dim / row checks just work
+        return (self.indices.shape[0], self.dim)
+
+
+ShardData = Union[np.ndarray, SparseShard]
 
 
 @dataclasses.dataclass
@@ -27,7 +54,7 @@ class GameData:
     """Columnar GAME dataset (training or validation)."""
 
     y: np.ndarray  # [n]
-    features: Dict[str, np.ndarray]  # shard id -> [n, d_shard] design matrix
+    features: Dict[str, "ShardData"]  # shard id -> [n, d] dense matrix or SparseShard
     offset: Optional[np.ndarray] = None  # [n]
     weight: Optional[np.ndarray] = None  # [n]
     id_tags: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)  # tag -> [n] int64

@@ -52,7 +52,12 @@ class FixedEffectModel(DatumScoringModel):
     task: TaskType = TaskType.LOGISTIC_REGRESSION
 
     def score(self, data: GameData) -> Array:
-        return self.coefficients.score(data.features[self.feature_shard])
+        shard = data.features[self.feature_shard]
+        if hasattr(shard, "indices"):  # SparseShard: gather-based margins
+            w = jnp.asarray(self.coefficients.means)
+            vals = jnp.asarray(shard.values)
+            return jnp.einsum("nk,nk->n", vals, w[jnp.asarray(shard.indices)])
+        return self.coefficients.score(shard)
 
     def glm(self) -> GLMModel:
         return GLMModel(coefficients=self.coefficients, task=self.task)
@@ -85,8 +90,13 @@ class RandomEffectModel(DatumScoringModel):
         return _slots_from(self.slot_of, data.id_tags[self.random_effect_type])
 
     def score(self, data: GameData) -> Array:
+        shard = data.features[self.feature_shard]
+        if hasattr(shard, "indices"):
+            raise NotImplementedError(
+                "random-effect models score dense shards only "
+                f"({self.feature_shard!r} is sparse)")
         slots = jnp.asarray(self.slots_for(data))
-        x = jnp.asarray(data.features[self.feature_shard])
+        x = jnp.asarray(shard)
         return score_samples(jnp.asarray(self.w_stack), slots, x)
 
     def coefficients_for(self, entity_id: int) -> Optional[Coefficients]:

@@ -1,0 +1,150 @@
+"""Sparse feature shards: row-padded COO end-to-end (the huge-vocabulary
+path — reference scale story, SURVEY §2.7)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data.index_map import build_index_maps_from_avro
+from photon_ml_tpu.data.reader import read_game_data_avro
+from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+from photon_ml_tpu.game.config import FixedEffectConfig, GameConfig
+from photon_ml_tpu.game.data import SparseShard
+from photon_ml_tpu.game.estimator import GameEstimator
+from photon_ml_tpu.types import TaskType
+
+
+def _write(path, n=300, vocab=40, k=5, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=vocab) * 0.7
+    records = []
+    for i in range(n):
+        js = rng.choice(vocab, size=k, replace=False)
+        vs = rng.normal(size=k)
+        logit = float(vs @ w[js])
+        yv = float(rng.random() < 1 / (1 + np.exp(-logit)))
+        feats = [{"name": f"f{j}", "term": "", "value": float(v)}
+                 for j, v in zip(js, vs)]
+        records.append({"uid": i, "response": yv, "label": None,
+                        "features": feats, "weight": None, "offset": None,
+                        "metadataMap": {}})
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+
+
+@pytest.fixture(scope="module")
+def sparse_setup(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("sp") / "train.avro")
+    _write(path)
+    imap = build_index_maps_from_avro([path], {"all": []})["all"]
+    return path, imap
+
+
+def test_sparse_load_layout(sparse_setup):
+    path, imap = sparse_setup
+    data, _ = read_game_data_avro([path], {"all": imap}, sparse_shards={"all"})
+    shard = data.features["all"]
+    assert isinstance(shard, SparseShard)
+    n, d = shard.shape
+    assert n == 300 and d == imap.size
+    assert shard.indices.shape == shard.values.shape
+    assert shard.indices.shape[1] <= 5 + 1  # k features + intercept slot
+    # intercept slot present on every row
+    ii = imap.intercept_index
+    assert np.all(np.any((shard.indices == ii) & (shard.values == 1.0), axis=1))
+
+
+def test_sparse_dense_margin_parity(sparse_setup):
+    path, imap = sparse_setup
+    dense, _ = read_game_data_avro([path], {"all": imap})
+    sparse, _ = read_game_data_avro([path], {"all": imap}, sparse_shards={"all"})
+    w = np.random.default_rng(1).normal(size=imap.size)
+    dense_margins = np.asarray(dense.features["all"]) @ w
+    sh = sparse.features["all"]
+    sparse_margins = np.einsum("nk,nk->n", sh.values, w[sh.indices])
+    np.testing.assert_allclose(sparse_margins, dense_margins, rtol=1e-5)
+
+
+@pytest.mark.parametrize("opt", ["LBFGS", "TRON"])
+def test_sparse_dense_solve_parity(sparse_setup, opt):
+    """The fixed-effect solve must reach the same optimum either layout."""
+    from photon_ml_tpu.types import OptimizerType
+
+    path, imap = sparse_setup
+    cfg = GameConfig(task=TaskType.LOGISTIC_REGRESSION, coordinates={
+        "fixed": FixedEffectConfig(feature_shard="all",
+                                   optimizer=OptimizerType[opt],
+                                   reg=Regularization(l2=0.1))})
+    out = {}
+    for mode, sparse_set in (("dense", set()), ("sparse", {"all"})):
+        data, _ = read_game_data_avro([path], {"all": imap},
+                                      sparse_shards=sparse_set)
+        res = GameEstimator().fit(data, [cfg])[0]
+        out[mode] = np.asarray(res.model["fixed"].coefficients.means)
+    # different computation orders (matmul vs gather/scatter) -> optima agree
+    # only to solver-tolerance scale in f32
+    np.testing.assert_allclose(out["sparse"], out["dense"], atol=2e-3)
+
+
+def test_sparse_fallback_records_path(sparse_setup, monkeypatch):
+    """The Python-codec fallback builds the same SparseShard."""
+    import photon_ml_tpu.data.native_avro as na
+
+    path, imap = sparse_setup
+    fast, _ = read_game_data_avro([path], {"all": imap}, sparse_shards={"all"})
+    monkeypatch.setattr(na, "_lib", None)
+    monkeypatch.setattr(na, "_lib_tried", True)
+    slow, _ = read_game_data_avro([path], {"all": imap}, sparse_shards={"all"})
+    f, s = fast.features["all"], slow.features["all"]
+    assert isinstance(s, SparseShard)
+    w = np.random.default_rng(2).normal(size=imap.size)
+    np.testing.assert_allclose(np.einsum("nk,nk->n", f.values, w[f.indices]),
+                               np.einsum("nk,nk->n", s.values, w[s.indices]),
+                               rtol=1e-5)
+
+
+def test_huge_vocab_memory(tmp_path):
+    """100k-feature shard: sparse layout is O(n*k); dense would be 4.8GB at
+    this n — the load itself is the test."""
+    path = str(tmp_path / "wide.avro")
+    n, vocab = 1200, 100_000
+    _write(path, n=n, vocab=vocab, k=8, seed=3)
+    imap = build_index_maps_from_avro([path], {"all": []})["all"]
+    assert imap.size == vocab + 1 or imap.size > 8  # observed features + intercept
+    data, _ = read_game_data_avro([path], {"all": imap}, sparse_shards={"all"})
+    shard = data.features["all"]
+    assert isinstance(shard, SparseShard)
+    assert shard.values.nbytes < 10 * n * 16  # O(n*k), nowhere near n*d
+
+    cfg = GameConfig(task=TaskType.LOGISTIC_REGRESSION, coordinates={
+        "fixed": FixedEffectConfig(feature_shard="all", reg=Regularization(l2=1.0))})
+    res = GameEstimator().fit(data, [cfg])[0]
+    w = np.asarray(res.model["fixed"].coefficients.means)
+    assert w.shape == (shard.dim,) and np.all(np.isfinite(w))
+
+
+def test_sparse_cli_end_to_end(tmp_path):
+    from photon_ml_tpu.cli import score as score_cli
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    _write(train_path, n=400, vocab=60, seed=4)
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--validation-data", train_path,
+        "--feature-shards", "all", "--evaluators", "auc",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=0.1",
+        "--sparse-threshold", "10",  # vocab 60 > 10 -> sparse
+        "--output-dir", out])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["validation"]["auc"] > 0.6
+
+    score_out = str(tmp_path / "scores")
+    rc = score_cli.run(["--data", train_path, "--model-dir", out,
+                        "--output-dir", score_out, "--evaluators", "auc"])
+    assert rc == 0
+    assert json.load(open(os.path.join(score_out, "metrics.json")))["auc"] > 0.6
