@@ -39,12 +39,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-id", default="", help="stamped into score metadata")
     p.add_argument("--predict-mean", action="store_true",
                    help="write inverse-link means instead of raw scores")
+    p.add_argument("--input-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd daily-partition range (reference "
+                        "IOUtils.getInputPathsWithinDateRange:113-153)")
+    p.add_argument("--input-days-range", default=None,
+                   help="START-END days ago (reference DaysRange.scala:28-48)")
+    p.add_argument("--error-on-missing-date", action="store_true")
     return p
 
 
 def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+
+    from photon_ml_tpu.utils.dates import input_paths_within_date_range, resolve_range
+
+    date_range = resolve_range(args.input_date_range, args.input_days_range)
+    if date_range is not None:
+        args.data = input_paths_within_date_range(
+            args.data, date_range, args.error_on_missing_date)
 
     index_maps = {}
     entity_indexes = {}

@@ -45,6 +45,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train-data", nargs="+", required=True,
                    help="Avro files/dirs of TrainingExampleAvro records")
     p.add_argument("--validation-data", nargs="*", default=[])
+    p.add_argument("--input-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd: treat --train-data entries as base "
+                        "dirs of daily <base>/yyyy/MM/dd partitions and read "
+                        "the days in range (reference DateRange + "
+                        "IOUtils.getInputPathsWithinDateRange:113-153)")
+    p.add_argument("--input-days-range", default=None,
+                   help="START-END in days ago, e.g. 90-1 (reference "
+                        "DaysRange.scala:28-48); mutually exclusive with "
+                        "--input-date-range")
+    p.add_argument("--error-on-missing-date", action="store_true",
+                   help="fail if any day in range has no data dir")
     p.add_argument("--feature-shards", required=True,
                    help="comma-separated feature shard names")
     p.add_argument("--coordinate", action="append", required=True, dest="coordinates",
@@ -119,6 +130,14 @@ def run(argv: List[str]) -> int:
 
 def _run(args, task, t_start, emitter) -> int:
     from photon_ml_tpu.game.config import FixedEffectConfig
+    from photon_ml_tpu.utils.dates import input_paths_within_date_range, resolve_range
+
+    date_range = resolve_range(args.input_date_range, args.input_days_range)
+    if date_range is not None:
+        args.train_data = input_paths_within_date_range(
+            args.train_data, date_range, args.error_on_missing_date)
+        logging.getLogger(__name__).info(
+            "date range %s -> %d daily input dirs", date_range, len(args.train_data))
 
     shards = [s for s in args.feature_shards.split(",") if s]
     id_tags = [s for s in args.id_tags.split(",") if s]

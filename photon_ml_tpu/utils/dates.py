@@ -1,0 +1,131 @@
+"""Date-range input resolution for daily-partitioned datasets.
+
+Reference: photon-client util/DateRange.scala (DEFAULT_PATTERN "yyyyMMdd",
+split on "-", :39-83), util/DaysRange.scala (days-ago pair, toDateRange
+:43-48, :64-67), and util/IOUtils.getInputPathsWithinDateRange:113-153
+(expand ``<base>/yyyy/MM/dd`` per day, filter missing dirs, optionally
+error on missing, require at least one match).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+from typing import Iterable, List, Optional, Sequence
+
+_PATTERN = "%Y%m%d"  # reference DateRange.DEFAULT_PATTERN "yyyyMMdd"
+_DELIMITER = "-"
+
+
+@dataclasses.dataclass(frozen=True)
+class DateRange:
+    """Inclusive [start, end] calendar-day range (DateRange.scala:28-34)."""
+
+    start: datetime.date
+    end: datetime.date
+
+    def __post_init__(self):
+        if self.start > self.end:
+            raise ValueError(
+                f"invalid date range: start {self.start} is after end {self.end}")
+
+    @classmethod
+    def from_string(cls, range_str: str) -> "DateRange":
+        """Parse ``yyyyMMdd-yyyyMMdd`` (DateRange.fromDateString:70-76)."""
+        start_str, end_str = _split_range(range_str)
+        try:
+            start = datetime.datetime.strptime(start_str, _PATTERN).date()
+            end = datetime.datetime.strptime(end_str, _PATTERN).date()
+        except ValueError as e:
+            raise ValueError(f"couldn't parse date range '{range_str}': {e}") from e
+        return cls(start, end)
+
+    def days(self) -> List[datetime.date]:
+        n = (self.end - self.start).days
+        return [self.start + datetime.timedelta(days=i) for i in range(n + 1)]
+
+    def __str__(self) -> str:
+        return (self.start.strftime(_PATTERN) + _DELIMITER
+                + self.end.strftime(_PATTERN))
+
+
+@dataclasses.dataclass(frozen=True)
+class DaysRange:
+    """Range in days-ago-from-today, e.g. ``90-1`` = from 90 days ago to
+    yesterday (DaysRange.scala:28-48).  start_days > end_days because the
+    larger days-ago value is further in the past."""
+
+    start_days: int
+    end_days: int
+
+    def __post_init__(self):
+        if self.start_days < self.end_days:
+            raise ValueError(
+                f"invalid days range: start {self.start_days} must be >= end "
+                f"{self.end_days} (days ago, larger = further back)")
+        if self.end_days < 0:
+            raise ValueError("days-ago values must be non-negative")
+
+    @classmethod
+    def from_string(cls, range_str: str) -> "DaysRange":
+        start_str, end_str = _split_range(range_str)
+        return cls(int(start_str), int(end_str))
+
+    def to_date_range(self, today: Optional[datetime.date] = None) -> DateRange:
+        """DaysRange.toDateRange:43-48."""
+        today = today or datetime.date.today()
+        return DateRange(today - datetime.timedelta(days=self.start_days),
+                         today - datetime.timedelta(days=self.end_days))
+
+    def __str__(self) -> str:
+        return f"{self.start_days}{_DELIMITER}{self.end_days}"
+
+
+def _split_range(range_str: str) -> Sequence[str]:
+    """DateRange.splitRange:83-85."""
+    parts = range_str.split(_DELIMITER)
+    if len(parts) != 2:
+        raise ValueError(f"couldn't parse range '{range_str}': expected "
+                         f"'start{_DELIMITER}end'")
+    return parts
+
+
+def resolve_range(date_range: Optional[str],
+                  days_range: Optional[str],
+                  today: Optional[datetime.date] = None) -> Optional[DateRange]:
+    """IOUtils.resolveRange:47-61: at most one of the two may be given;
+    a days range converts relative to today."""
+    if date_range and days_range:
+        raise ValueError("specify at most one of date range / days range")
+    if date_range:
+        return DateRange.from_string(date_range)
+    if days_range:
+        return DaysRange.from_string(days_range).to_date_range(today)
+    return None
+
+
+def input_paths_within_date_range(base_dirs: Iterable[str],
+                                  date_range: DateRange,
+                                  error_on_missing: bool = False) -> List[str]:
+    """Expand each base dir to its existing ``<base>/yyyy/MM/dd`` daily dirs
+    within the inclusive range (IOUtils.getInputPathsWithinDateRange:113-153).
+
+    Missing daily dirs are skipped unless ``error_on_missing``; it is an
+    error for a base dir to contribute no day at all.
+    """
+    out: List[str] = []
+    for base in base_dirs:
+        candidates = [os.path.join(base, d.strftime("%Y/%m/%d"))
+                      for d in date_range.days()]
+        if error_on_missing:
+            missing = [p for p in candidates if not os.path.exists(p)]
+            if missing:
+                raise FileNotFoundError(f"path {missing[0]} does not exist")
+        existing = [p for p in candidates if os.path.exists(p)]
+        if not existing:
+            raise FileNotFoundError(
+                f"no data folder found between {date_range.start} and "
+                f"{date_range.end} in {base}")
+        out.extend(existing)
+    return out

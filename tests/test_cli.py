@@ -341,3 +341,27 @@ def test_train_rejects_invalid_data(tmp_path):
         "--output-dir", str(tmp_path / "o"),
     ])
     assert rc == 1  # 0.5 label fails logistic validation
+
+
+def test_train_with_date_range_partitions(tmp_path):
+    """Daily-partitioned input dirs resolved via --input-date-range
+    (reference IOUtils.getInputPathsWithinDateRange:113-153)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    base = tmp_path / "daily"
+    for i, day in enumerate(("2017/03/01", "2017/03/02", "2017/03/03")):
+        d = base / day
+        d.mkdir(parents=True)
+        _write_fixture(str(d / "part-0.avro"), n=120, seed=10 + i)
+
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", str(base),
+        "--input-date-range", "20170301-20170310",  # missing days skipped
+        "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["train_samples"] == 360  # all three days read

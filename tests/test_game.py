@@ -279,3 +279,49 @@ def test_multiple_configs_warm_start(rng):
     assert len(results) == 2
     best = est.best(results)
     assert best in results
+
+
+def test_down_sampling_weights_semantics(rng):
+    """Reference BinaryClassificationDownSampler.scala:32-55: keep every
+    positive at weight 1, keep negatives with prob=rate at weight 1/rate,
+    drop the rest (weight 0); deterministic per seed; rate>=1 is a no-op."""
+    import dataclasses
+
+    data, _, _, _ = _glmix_data(rng, n_users=8, per_user=40)
+    cfg = FixedEffectConfig(feature_shard="global",
+                            solver=SolverConfig(max_iters=20),
+                            reg=Regularization(l2=1.0), down_sampling_rate=0.5)
+    coord = build_coordinate("fixed", data, cfg, TaskType.LOGISTIC_REGRESSION)
+
+    base = np.asarray(coord._base_weight)
+    w = np.asarray(coord._down_sample_weights(seed=7))
+    y = np.asarray(coord._batch.y)
+
+    pos = y > 0.5
+    np.testing.assert_allclose(w[pos], base[pos])  # positives untouched
+    neg = ~pos & (base > 0)  # padded rows have base weight 0
+    kept = neg & (w > 0)
+    dropped = neg & (w == 0)
+    assert kept.sum() > 0 and dropped.sum() > 0
+    np.testing.assert_allclose(w[kept], base[kept] / 0.5)
+    # survivor mass ~= original negative mass in expectation
+    assert abs(w[neg].sum() - base[neg].sum()) / base[neg].sum() < 0.25
+    # deterministic per seed, different across seeds
+    np.testing.assert_array_equal(w, np.asarray(coord._down_sample_weights(seed=7)))
+    assert not np.array_equal(w, np.asarray(coord._down_sample_weights(seed=8)))
+
+    # rate >= 1 is the identity
+    full = build_coordinate(
+        "fixed", data,
+        dataclasses.replace(cfg, down_sampling_rate=1.0),
+        TaskType.LOGISTIC_REGRESSION)
+    np.testing.assert_array_equal(np.asarray(full._down_sample_weights(seed=7)),
+                                  np.asarray(full._base_weight))
+
+    # and the down-sampled solve still lands near the full-data solution
+    model_ds, _ = coord.update(np.zeros(data.num_samples))
+    model_full, _ = full.update(np.zeros(data.num_samples))
+    cos = (model_ds.coefficients.means @ model_full.coefficients.means) / (
+        np.linalg.norm(model_ds.coefficients.means)
+        * np.linalg.norm(model_full.coefficients.means))
+    assert cos > 0.95

@@ -69,3 +69,58 @@ class TestLinalg:
         near_singular = np.zeros((3, 3))
         inv = np.asarray(cholesky_inverse(near_singular, jitter=1.0))
         np.testing.assert_allclose(inv, np.eye(3), atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# Date ranges (reference DateRange.scala / DaysRange.scala / IOUtils:113-153)
+# ---------------------------------------------------------------------------
+
+def test_date_range_parsing():
+    import datetime
+
+    import pytest
+
+    from photon_ml_tpu.utils.dates import DateRange, DaysRange, resolve_range
+
+    r = DateRange.from_string("20170101-20170105")
+    assert r.start == datetime.date(2017, 1, 1)
+    assert r.end == datetime.date(2017, 1, 5)
+    assert len(r.days()) == 5
+    assert str(r) == "20170101-20170105"
+
+    with pytest.raises(ValueError):
+        DateRange.from_string("20170105-20170101")  # start after end
+    with pytest.raises(ValueError):
+        DateRange.from_string("2017-01-01")  # wrong grammar
+
+    d = DaysRange.from_string("90-1")
+    today = datetime.date(2017, 4, 11)
+    dr = d.to_date_range(today)
+    assert dr.start == today - datetime.timedelta(days=90)
+    assert dr.end == today - datetime.timedelta(days=1)
+    with pytest.raises(ValueError):
+        DaysRange.from_string("1-90")  # start must be further back
+
+    with pytest.raises(ValueError):
+        resolve_range("20170101-20170105", "90-1")  # mutually exclusive
+    assert resolve_range(None, None) is None
+
+
+def test_input_paths_within_date_range(tmp_path):
+    import pytest
+
+    from photon_ml_tpu.utils.dates import DateRange, input_paths_within_date_range
+
+    base = tmp_path / "daily"
+    for day in ("2017/01/01", "2017/01/02", "2017/01/04"):
+        (base / day).mkdir(parents=True)
+
+    r = DateRange.from_string("20170101-20170105")
+    paths = input_paths_within_date_range([str(base)], r)
+    assert [p[-10:] for p in paths] == ["2017/01/01", "2017/01/02", "2017/01/04"]
+
+    with pytest.raises(FileNotFoundError):  # Jan 3 missing
+        input_paths_within_date_range([str(base)], r, error_on_missing=True)
+    with pytest.raises(FileNotFoundError):  # no day at all in range
+        input_paths_within_date_range([str(base)], DateRange.from_string(
+            "20180101-20180102"))
