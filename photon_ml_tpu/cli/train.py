@@ -85,6 +85,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tuning-iterations", type=int, default=0,
                    help="GP hyperparameter tuning iterations (0 = off)")
     p.add_argument("--tuning-mode", default="bayesian", choices=["bayesian", "random"])
+    p.add_argument("--tuning-config", default=None,
+                   help="JSON file in the reference HyperparameterSerialization "
+                        "format ({tuning_mode, variables:{name:{transform,min,"
+                        "max}}}); overrides --tuning-mode and the default L2 "
+                        "search ranges (dims in unlocked-coordinate order)")
+    p.add_argument("--tuning-priors", default=None,
+                   help="JSON file of prior observations ({records:[{param:"
+                        "value,...,evaluationValue:v}]}) seeded into the "
+                        "search (reference priorFromJson)")
+    p.add_argument("--model-output-mode", default="BEST",
+                   choices=["NONE", "BEST", "EXPLICIT", "TUNED", "ALL"],
+                   help="which trained models to save (reference "
+                        "ModelOutputMode.scala: NONE = logs only; BEST = best "
+                        "only; EXPLICIT = best + the reg-weight grid models; "
+                        "TUNED = best + tuner-explored models; ALL = best + "
+                        "everything)")
+    p.add_argument("--output-models-limit", type=int, default=None,
+                   help="cap on the number of extra models saved under models/ "
+                        "(reference outputFilesLimit)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--model-input-dir", default=None,
                    help="existing model dir for warm start "
@@ -387,33 +406,87 @@ def _run(args, task, t_start, emitter) -> int:
                       checkpoint_hook=checkpoint_hook, resume_cursor=resume_cursor,
                       resume_best=resume_best)
     best = est.best(results)
+    tuned_results = []
     if args.tuning_iterations > 0:
         if val_data is None or suite is None:
             logger.error("tuning requires --validation-data and --evaluators")
             return 1
         from photon_ml_tpu.tune.game_tuning import tune_game_model
 
-        tuned, _search = tune_game_model(est, best.config, data, val_data,
-                                         n_iterations=args.tuning_iterations,
-                                         mode=args.tuning_mode, seed=args.seed,
-                                         initial_model=initial_model,
-                                         locked_coordinates=locked)
-        best = est.best(results + [tuned])
+        tuning_mode, search_domain, prior_obs = args.tuning_mode, None, None
+        unlocked = [c for c in best.config.coordinates if c not in (locked or ())]
+        if args.tuning_config:
+            from photon_ml_tpu.tune.serialization import config_from_json
+
+            with open(args.tuning_config) as f:
+                mode_str, search_domain = config_from_json(f.read())
+            tuning_mode = mode_str.lower()
+        if args.tuning_priors:
+            from photon_ml_tpu.tune.serialization import (game_prior_default,
+                                                          prior_from_json)
+
+            names = ([d.name for d in search_domain.dims] if search_domain
+                     else [f"l2:{c}" for c in unlocked])
+            defaults = game_prior_default(unlocked)
+            defaults.update({n: "0.0" for n in names})
+            with open(args.tuning_priors) as f:
+                prior_obs = prior_from_json(f.read(), defaults, names)
+
+        _tuned, _search, tuned_results = tune_game_model(
+            est, best.config, data, val_data,
+            n_iterations=args.tuning_iterations,
+            mode=tuning_mode, seed=args.seed,
+            initial_model=initial_model,
+            locked_coordinates=locked,
+            search_domain=search_domain,
+            prior_observations=prior_obs)
+        best = est.best(results + tuned_results)
 
     if best.evaluation is not None:
         logger.info("best model validation: %s", best.evaluation.values)
 
-    # 6. save (reference saveModelToHDFS / ModelProcessingUtils)
+    # 6. save (reference saveModelToHDFS / ModelProcessingUtils /
+    # selectModels:683-701 — output mode picks which extra models go under
+    # models/<i>/ alongside best/)
     os.makedirs(args.output_dir, exist_ok=True)
-    save_game_model(best.model, os.path.join(args.output_dir, "best"),
-                    index_maps, entity_indexes, task)
-    for s in shards:
-        from photon_ml_tpu.data.native_index import StoreIndexMap
+    extra_models = {
+        "NONE": [], "BEST": [],
+        "EXPLICIT": results,
+        "TUNED": tuned_results,
+        "ALL": results + tuned_results,
+    }[args.model_output_mode]
+    if args.output_models_limit is not None:
+        extra_models = extra_models[: args.output_models_limit]
 
-        ext = ".phidx" if isinstance(index_maps[s], StoreIndexMap) else ".idx"
-        index_maps[s].save(os.path.join(args.output_dir, f"{s}{ext}"))
-    for tag, eidx in entity_indexes.items():
-        eidx.save(os.path.join(args.output_dir, f"{tag}.entities.json"))
+    def _config_spec(cfg):
+        """Per-coordinate optimization spec (reference
+        IOUtils.writeOptimizationConfigToHDFS:195)."""
+        spec = {}
+        for cid, c in cfg.coordinates.items():
+            spec[cid] = {"l1": c.reg.l1, "l2": c.reg.l2,
+                         "optimizer": c.optimizer.name}
+        return spec
+
+    if args.model_output_mode != "NONE":
+        save_game_model(best.model, os.path.join(args.output_dir, "best"),
+                        index_maps, entity_indexes, task)
+        with open(os.path.join(args.output_dir, "best",
+                               "model-spec.json"), "w") as f:
+            json.dump(_config_spec(best.config), f, indent=2)
+        for i, res in enumerate(extra_models):
+            mdir = os.path.join(args.output_dir, "models", str(i))
+            save_game_model(res.model, mdir, index_maps, entity_indexes, task)
+            with open(os.path.join(mdir, "model-spec.json"), "w") as f:
+                json.dump({"config": _config_spec(res.config),
+                           "validation": res.evaluation.values
+                           if res.evaluation else None}, f, indent=2)
+        for s in shards:
+            from photon_ml_tpu.data.native_index import StoreIndexMap
+
+            ext = ".phidx" if isinstance(index_maps[s], StoreIndexMap) else ".idx"
+            index_maps[s].save(os.path.join(args.output_dir, f"{s}{ext}"))
+        for tag, eidx in entity_indexes.items():
+            eidx.save(os.path.join(args.output_dir, f"{tag}.entities.json"))
     if feature_stats:
         # reference ModelProcessingUtils.writeBasicStatistics:516
         with open(os.path.join(args.output_dir, "feature-stats.json"), "w") as f:

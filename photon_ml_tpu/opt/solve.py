@@ -41,6 +41,14 @@ def make_solver(
 
     ``box``: optional (lower[d], upper[d]) constraint arrays
     (reference constrained-coefficients path, OptimizationUtils.scala).
+
+    The returned callable accepts an optional ``objective=`` override with the
+    SAME static structure (loss, fused) but different reg/norm leaves — under
+    one ``jax.jit`` this makes regularization-path sweeps recompile-free
+    (the reference mutates ``l1RegularizationWeight``/L2 mixins in place for
+    the same reason, DistributedOptimizationProblem.updateRegularizationWeight
+    :64-75).  The optimizer/L1 dispatch below stays keyed to the λ=build-time
+    reg, so an override must not move between the smooth and L1 regimes.
     """
     if config is None:
         config = SolverConfig.tron_default() if optimizer == OptimizerType.TRON else SolverConfig.lbfgs_default()
@@ -54,7 +62,8 @@ def make_solver(
         if box is not None:
             raise ValueError("OWLQN does not support box constraints")
 
-        def solve_owlqn(w0: Array, batch: Batch) -> SolverResult:
+        def solve_owlqn(w0: Array, batch: Batch,
+                        objective: GLMObjective = objective) -> SolverResult:
             vg = lambda w: objective.value_and_grad(w, batch)
             return minimize_owlqn(vg, w0, objective.reg.l1, config)
 
@@ -62,7 +71,8 @@ def make_solver(
 
     if optimizer == OptimizerType.LBFGS:
 
-        def solve_lbfgs(w0: Array, batch: Batch) -> SolverResult:
+        def solve_lbfgs(w0: Array, batch: Batch,
+                        objective: GLMObjective = objective) -> SolverResult:
             vg = lambda w: objective.value_and_grad(w, batch)
             return minimize_lbfgs(vg, w0, config, box=box)
 
@@ -70,7 +80,8 @@ def make_solver(
 
     if optimizer == OptimizerType.TRON:
 
-        def solve_tron(w0: Array, batch: Batch) -> SolverResult:
+        def solve_tron(w0: Array, batch: Batch,
+                       objective: GLMObjective = objective) -> SolverResult:
             vg = lambda w: objective.value_and_grad(w, batch)
             hvp_at = lambda w, v: objective.hvp(w, batch, v)
             return minimize_tron(vg, hvp_at, w0, config)

@@ -11,3 +11,9 @@ from photon_ml_tpu.tune.game_tuning import (  # noqa: F401
     GameEstimatorEvaluationFunction,
     tune_game_model,
 )
+from photon_ml_tpu.tune.serialization import (  # noqa: F401
+    config_from_json,
+    config_to_json,
+    game_prior_default,
+    prior_from_json,
+)

@@ -85,29 +85,48 @@ def tune_game_model(
     seed: int = 0,
     initial_model=None,
     locked_coordinates=None,
-) -> Tuple[GameFitResult, "RandomSearch"]:
-    """Search per-coordinate L2 weights; returns (best fit, search object).
+    search_domain: Optional[SearchDomain] = None,
+    prior_observations: Optional[List[Tuple[np.ndarray, float]]] = None,
+) -> Tuple[GameFitResult, "RandomSearch", List[GameFitResult]]:
+    """Search per-coordinate L2 weights; returns (best fit, search object,
+    all tuned fits in evaluation order — the driver's TUNED/ALL output modes
+    save these, reference GameTrainingDriver.selectModels:683-701).
 
     ``initial_model``/``locked_coordinates``: forwarded to every tuning fit
     (warm start + partial retraining); locked coordinates are excluded from
-    the search space."""
+    the search space.
+
+    ``search_domain``: override the per-coordinate L2 domain (e.g. parsed
+    from a reference-format JSON config, tune/serialization.py); dim order
+    must match the unlocked-coordinate order.  ``prior_observations``:
+    (params, value) pairs seeded into the search
+    (HyperparameterSerialization.priorFromJson)."""
     fn = GameEstimatorEvaluationFunction(estimator, base_config, data, validation_data,
                                          seed, initial_model=initial_model,
                                          locked_coordinates=locked_coordinates)
-    domain = SearchDomain([
-        DomainDim(name=f"l2:{cid}", low=l2_range[0], high=l2_range[1], log_scale=True)
-        for cid in fn.coordinate_ids
-    ])
+    if search_domain is not None:
+        if search_domain.d != len(fn.coordinate_ids):
+            raise ValueError(
+                f"search domain has {search_domain.d} dims but there are "
+                f"{len(fn.coordinate_ids)} tunable coordinates")
+        domain = search_domain
+    else:
+        domain = SearchDomain([
+            DomainDim(name=f"l2:{cid}", low=l2_range[0], high=l2_range[1],
+                      log_scale=True)
+            for cid in fn.coordinate_ids
+        ])
     minimize = not estimator.validation_suite.primary.larger_is_better
     cls = GaussianProcessSearch if mode == "bayesian" else RandomSearch
     search = cls(domain, minimize=minimize, seed=seed)
-    # prior: the base config's own weights, evaluated first (warm prior,
-    # reference ShrinkSearchRange / prior JSON defaults)
+    # prior: supplied observations (values already in the primary metric's
+    # raw orientation), then the base config's own weights, evaluated first
+    # (warm prior, reference ShrinkSearchRange / prior JSON defaults)
+    priors = list(prior_observations or [])
     prior_params = fn.vectorize(base_config)
     if np.all(prior_params > 0):
-        search.find(fn, n=n_iterations, priors=[(prior_params, fn(prior_params))])
-    else:
-        search.find(fn, n=n_iterations)
+        priors.append((prior_params, fn(prior_params)))
+    search.find(fn, n=n_iterations, priors=priors or None)
 
     best = estimator.best(fn.results)
-    return best, search
+    return best, search, list(fn.results)

@@ -1,0 +1,85 @@
+"""Hyperparameter config / prior-observation JSON de-serialization.
+
+Reference: photon-lib hyperparameter/HyperparameterSerialization.scala —
+``configFromJson`` parses ``{"tuning_mode": "BAYESIAN"|"RANDOM", "variables":
+{name: {"type", "transform": "LOG"|"SQRT"|absent, "min", "max"}}}`` (for LOG
+variables min/max are base-10 exponents, VectorRescaling.scala:150);
+``priorFromJson`` parses ``{"records": [{<param>: "<value>", ...,
+"evaluationValue": "<value>"}]}`` filling missing params from defaults
+(GameHyperparameterDefaults.priorDefault).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.tune.search import DomainDim, SearchDomain
+
+LOG_TRANSFORM = "LOG"
+SQRT_TRANSFORM = "SQRT"
+
+
+def config_from_json(json_config: str) -> Tuple[str, SearchDomain]:
+    """JSON -> (tuning mode, search domain).
+
+    LOG-transform variables are declared by exponent (min=-3, max=3 means
+    10^-3..10^3) and search in log space; SQRT/linear variables are declared
+    by value.  SQRT searching is approximated as linear (the reference uses
+    SQRT only to soften rounding of integer dims).
+    """
+    cfg = json.loads(json_config)
+    mode = str(cfg["tuning_mode"]).upper()
+    if mode not in ("BAYESIAN", "RANDOM"):
+        raise ValueError(f"unknown tuning mode {mode!r}")
+    dims: List[DomainDim] = []
+    for name, spec in cfg["variables"].items():
+        transform = spec.get("transform")
+        lo, hi = float(spec["min"]), float(spec["max"])
+        if transform == LOG_TRANSFORM:
+            dims.append(DomainDim(name=name, low=10.0 ** lo, high=10.0 ** hi,
+                                  log_scale=True))
+        else:
+            dims.append(DomainDim(name=name, low=lo, high=hi))
+    return mode, SearchDomain(dims)
+
+
+def prior_from_json(
+    prior_json: str,
+    prior_default: Dict[str, str],
+    hyperparameter_names: Sequence[str],
+) -> List[Tuple[np.ndarray, float]]:
+    """JSON records -> [(params vector ordered by ``hyperparameter_names``,
+    evaluation value)] (HyperparameterSerialization.priorFromJson)."""
+    data = json.loads(prior_json)
+    records = data["records"]
+    out: List[Tuple[np.ndarray, float]] = []
+    for rec in records:
+        value = float(rec["evaluationValue"])
+        params = np.asarray([
+            float(rec.get(name, prior_default[name]))
+            for name in hyperparameter_names
+        ])
+        out.append((params, value))
+    return out
+
+
+def config_to_json(mode: str, domain: SearchDomain) -> str:
+    """Inverse of config_from_json (round-trips LOG dims to exponents)."""
+    variables = {}
+    for dim in domain.dims:
+        if dim.log_scale:
+            variables[dim.name] = {"type": "FLOAT", "transform": LOG_TRANSFORM,
+                                   "min": float(np.log10(dim.low)),
+                                   "max": float(np.log10(dim.high))}
+        else:
+            variables[dim.name] = {"type": "FLOAT", "min": dim.low, "max": dim.high}
+    return json.dumps({"tuning_mode": mode, "variables": variables}, indent=2)
+
+
+def game_prior_default(coordinate_ids: Sequence[str]) -> Dict[str, str]:
+    """Per-coordinate L2 prior defaults (GameHyperparameterDefaults.priorDefault
+    uses 0.0 per regularizer)."""
+    return {f"l2:{cid}": "0.0" for cid in coordinate_ids}

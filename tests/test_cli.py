@@ -365,3 +365,53 @@ def test_train_with_date_range_partitions(tmp_path):
     assert rc == 0
     summary = json.load(open(os.path.join(out, "training-summary.json")))
     assert summary["train_samples"] == 360  # all three days read
+
+
+def test_model_output_modes(tmp_path):
+    """Reference ModelOutputMode.scala + selectModels:683-701: NONE saves no
+    models, BEST saves best/ only, EXPLICIT also saves the grid under
+    models/<i>/ with a model-spec."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    val_path = str(tmp_path / "val.avro")
+    _write_fixture(train_path, n=200, seed=3)
+    _write_fixture(val_path, n=100, seed=4)
+    base = [
+        "--train-data", train_path, "--validation-data", val_path,
+        "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=0.1|1|10",
+        "--evaluators", "auc",
+    ]
+
+    out_none = str(tmp_path / "none")
+    assert train_cli.run(base + ["--output-dir", out_none,
+                                 "--model-output-mode", "NONE"]) == 0
+    assert not os.path.exists(os.path.join(out_none, "best"))
+    assert os.path.exists(os.path.join(out_none, "training-summary.json"))
+
+    out_best = str(tmp_path / "best")
+    assert train_cli.run(base + ["--output-dir", out_best]) == 0
+    assert os.path.isdir(os.path.join(out_best, "best"))
+    assert json.load(open(os.path.join(out_best, "best", "model-spec.json")))
+    assert not os.path.exists(os.path.join(out_best, "models"))
+
+    out_all = str(tmp_path / "explicit")
+    assert train_cli.run(base + ["--output-dir", out_all,
+                                 "--model-output-mode", "EXPLICIT"]) == 0
+    # 3 reg weights -> models/0..2, each with spec + saved validation metric
+    for i in range(3):
+        spec = json.load(open(os.path.join(out_all, "models", str(i),
+                                           "model-spec.json")))
+        assert "fixed" in spec["config"] and spec["validation"]["auc"] > 0.5
+    l2s = [json.load(open(os.path.join(out_all, "models", str(i),
+                                       "model-spec.json")))["config"]["fixed"]["l2"]
+           for i in range(3)]
+    assert sorted(l2s) == [0.1, 1.0, 10.0]
+
+    out_lim = str(tmp_path / "limited")
+    assert train_cli.run(base + ["--output-dir", out_lim,
+                                 "--model-output-mode", "EXPLICIT",
+                                 "--output-models-limit", "1"]) == 0
+    assert os.path.isdir(os.path.join(out_lim, "models", "0"))
+    assert not os.path.exists(os.path.join(out_lim, "models", "1"))

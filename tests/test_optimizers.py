@@ -199,3 +199,66 @@ def test_warm_start_fewer_iterations(rng):
     warm = solve(cold.w, batch)
     assert int(warm.iterations) <= 2
     np.testing.assert_allclose(warm.value, cold.value, rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Legacy reg-path training API (reference ModelTraining.scala:106-228)
+# ---------------------------------------------------------------------------
+
+def test_train_glm_reg_path(rng):
+    import scipy.optimize as sopt
+    import scipy.special as spec
+
+    from photon_ml_tpu.models.training import train_glm_reg_path
+    from photon_ml_tpu.types import OptimizerType, TaskType
+
+    n, d = 500, 6
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-x @ w_true))).astype(float)
+
+    lams = [0.1, 10.0, 1.0]
+    path, trackers = train_glm_reg_path(x, y, TaskType.LOGISTIC_REGRESSION,
+                                        lams, dtype=np.float64)
+
+    # trained (and returned) in descending-λ order
+    assert [lam for lam, _ in path] == [10.0, 1.0, 0.1]
+    assert set(trackers) == {0.1, 1.0, 10.0}
+
+    # each path point matches an independent scipy fit of the same objective
+    for lam, model in path:
+        def nll(w):
+            z = x @ w
+            return np.sum(np.logaddexp(0, z) - y * z) + 0.5 * lam * w @ w
+
+        def grad(w):
+            return x.T @ (spec.expit(x @ w) - y) + lam * w
+
+        ref = sopt.minimize(nll, np.zeros(d), jac=grad, method="L-BFGS-B",
+                            options={"maxiter": 200, "gtol": 1e-10})
+        np.testing.assert_allclose(model.coefficients.means, ref.x,
+                                   rtol=2e-4, atol=2e-4)
+
+    # heavier regularization -> smaller coefficients
+    norms = {lam: np.linalg.norm(m.coefficients.means) for lam, m in path}
+    assert norms[10.0] < norms[1.0] < norms[0.1]
+
+
+def test_train_glm_reg_path_warm_start_model(rng):
+    from photon_ml_tpu.models.glm import Coefficients, GLMModel
+    from photon_ml_tpu.models.training import train_glm_reg_path
+    from photon_ml_tpu.types import TaskType
+
+    n, d = 200, 4
+    x = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+
+    warm = {5.0: GLMModel(Coefficients(means=np.full(d, 0.3)),
+                          TaskType.LOGISTIC_REGRESSION)}
+    path, _ = train_glm_reg_path(x, y, TaskType.LOGISTIC_REGRESSION, [1.0],
+                                 warm_start_models=warm, dtype=np.float64)
+    path0, _ = train_glm_reg_path(x, y, TaskType.LOGISTIC_REGRESSION, [1.0],
+                                  dtype=np.float64)
+    # both converge to the same optimum; warm start just changes the route
+    np.testing.assert_allclose(path[0][1].coefficients.means,
+                               path0[0][1].coefficients.means, atol=1e-4)

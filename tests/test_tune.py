@@ -114,9 +114,98 @@ def test_game_tuning_end_to_end(rng):
             reg=Regularization(l2=1.0))},
     )
     est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
-    best, search = tune_game_model(est, config, tr, va, n_iterations=4,
-                                   mode="bayesian", seed=0)
+    best, search, tuned = tune_game_model(est, config, tr, va, n_iterations=4,
+                                          mode="bayesian", seed=0)
     assert best.evaluation.values["auc"] > 0.7
     assert len(search.observations) == 5  # prior + 4 iterations
+    assert len(tuned) == 5 and best in tuned
     tuned_l2 = best.config.coordinates["fixed"].reg.l2
     assert 1e-4 <= tuned_l2 <= 1e4
+
+
+def test_hyperparameter_serialization_roundtrip():
+    """Reference HyperparameterSerialization.configFromJson/priorFromJson:
+    LOG variables are declared by base-10 exponent; prior records fill
+    missing params from defaults."""
+    import numpy as np
+
+    from photon_ml_tpu.tune.serialization import (config_from_json,
+                                                  config_to_json,
+                                                  prior_from_json)
+
+    # the reference's GameHyperparameterDefaults.configDefault shape
+    cfg = """
+    { "tuning_mode" : "BAYESIAN",
+      "variables" : {
+        "global_regularizer" : {"type": "FLOAT", "transform": "LOG",
+                                "min": -3, "max": 3},
+        "member_regularizer" : {"type": "FLOAT", "min": 0.5, "max": 2.0}
+      }
+    }"""
+    mode, domain = config_from_json(cfg)
+    assert mode == "BAYESIAN"
+    assert domain.d == 2
+    g, m = domain.dims
+    assert g.log_scale and np.isclose(g.low, 1e-3) and np.isclose(g.high, 1e3)
+    assert not m.log_scale and m.low == 0.5 and m.high == 2.0
+
+    mode2, domain2 = config_from_json(config_to_json(mode, domain))
+    assert mode2 == mode
+    for a, b in zip(domain.dims, domain2.dims):
+        assert a.name == b.name and np.isclose(a.low, b.low) and np.isclose(a.high, b.high)
+
+    priors = prior_from_json(
+        '{"records": [{"global_regularizer": "10", "evaluationValue": "0.8"},'
+        ' {"member_regularizer": "1.5", "evaluationValue": "0.6"}]}',
+        {"global_regularizer": "0.0", "member_regularizer": "1.0"},
+        ["global_regularizer", "member_regularizer"])
+    np.testing.assert_allclose(priors[0][0], [10.0, 1.0])
+    assert priors[0][1] == 0.8
+    np.testing.assert_allclose(priors[1][0], [0.0, 1.5])
+    assert priors[1][1] == 0.6
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        config_from_json('{"tuning_mode": "GRID", "variables": {}}')
+
+
+def test_tuning_with_json_config_and_priors(tmp_path, rng):
+    """tune_game_model honors a serialized search domain + prior records."""
+    import numpy as np
+
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, GameEstimator
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.tune import tune_game_model
+    from photon_ml_tpu.tune.serialization import config_from_json
+    from photon_ml_tpu.types import TaskType
+
+    n, d = 300, 5
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-x @ w))).astype(float)
+    tr = GameData(y=y[:220], features={"g": x[:220]})
+    va = GameData(y=y[220:], features={"g": x[220:]})
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={"fixed": FixedEffectConfig(
+            feature_shard="g", solver=SolverConfig(max_iters=40),
+            reg=Regularization(l2=1.0))})
+
+    mode, domain = config_from_json(
+        '{"tuning_mode": "RANDOM", "variables": '
+        '{"l2:fixed": {"type": "FLOAT", "transform": "LOG", "min": -2, "max": 2}}}')
+    est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
+    best, search, tuned = tune_game_model(
+        est, config, tr, va, n_iterations=3, mode=mode.lower(), seed=0,
+        search_domain=domain,
+        prior_observations=[(np.asarray([0.5]), 0.55)])
+    # 3 evaluated + 1 prior record + 1 base-config warm prior
+    assert len(search.observations) == 5
+    assert len(tuned) == 4  # prior observations don't retrain
+    for obs in search.observations[2:]:
+        assert 1e-2 <= obs.params[0] <= 1e2  # respects the JSON domain
+    assert best.evaluation.values["auc"] > 0.6
