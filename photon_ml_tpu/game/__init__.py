@@ -11,4 +11,5 @@ from photon_ml_tpu.game.coordinate import (  # noqa: F401
     build_coordinate,
 )
 from photon_ml_tpu.game.descent import CoordinateDescent, DescentHistory  # noqa: F401
+from photon_ml_tpu.game.fused import FusedSweep  # noqa: F401
 from photon_ml_tpu.game.estimator import GameEstimator, GameTransformer  # noqa: F401

@@ -325,3 +325,64 @@ def test_down_sampling_weights_semantics(rng):
         np.linalg.norm(model_ds.coefficients.means)
         * np.linalg.norm(model_full.coefficients.means))
     assert cos > 0.95
+
+
+def test_fused_sweep_matches_host_descent(rng):
+    """FusedSweep (one jitted scan program) must reproduce the host-paced
+    CoordinateDescent trajectory: same residual semantics, same warm starts
+    across outer iterations, same final model."""
+    from photon_ml_tpu.game.fused import FusedSweep
+
+    data, _, _, _ = _glmix_data(rng, n_users=12, per_user=50)
+    cfg = _configs(num_iters=3)
+    coords = {cid: build_coordinate(cid, data, c, cfg.task)
+              for cid, c in cfg.coordinates.items()}
+
+    host_model, _, _ = CoordinateDescent(coords, num_iterations=3).run()
+    fused_model, fused_scores = FusedSweep(coords, num_iterations=3).run()
+
+    wf_h = host_model["fixed"].coefficients.means
+    wf_f = fused_model["fixed"].coefficients.means
+    np.testing.assert_allclose(wf_f, wf_h, rtol=2e-3, atol=2e-3)
+
+    re_h, re_f = host_model["per-user"], fused_model["per-user"]
+    assert re_h.slot_of == re_f.slot_of
+    np.testing.assert_allclose(re_f.w_stack, re_h.w_stack, rtol=2e-3, atol=2e-3)
+
+    # fused final scores equal the model's own re-scoring
+    np.testing.assert_allclose(
+        fused_scores["fixed"], np.asarray(coords["fixed"].score(fused_model["fixed"])),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_fused_sweep_warm_start(rng):
+    """initial= warm start feeds both coordinate types."""
+    from photon_ml_tpu.game.fused import FusedSweep
+
+    data, _, _, _ = _glmix_data(rng, n_users=8, per_user=40)
+    cfg = _configs(num_iters=2)
+    coords = {cid: build_coordinate(cid, data, c, cfg.task)
+              for cid, c in cfg.coordinates.items()}
+    sweep = FusedSweep(coords, num_iterations=2)
+    m1, _ = sweep.run()
+    # warm-started fused run must track the warm-started host descent
+    m2, _ = sweep.run(initial=m1)
+    h2, _, _ = CoordinateDescent(coords, num_iterations=2).run(initial=m1)
+    np.testing.assert_allclose(m2["fixed"].coefficients.means,
+                               h2["fixed"].coefficients.means,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(m2["per-user"].w_stack,
+                               h2["per-user"].w_stack, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_sweep_rejects_downsampling(rng):
+    import dataclasses
+
+    from photon_ml_tpu.game.fused import FusedSweep
+
+    data, _, _, _ = _glmix_data(rng, n_users=4, per_user=30)
+    cfg = _configs()
+    fixed_ds = dataclasses.replace(cfg.coordinates["fixed"], down_sampling_rate=0.5)
+    coords = {"fixed": build_coordinate("fixed", data, fixed_ds, cfg.task)}
+    with pytest.raises(NotImplementedError):
+        FusedSweep(coords)
