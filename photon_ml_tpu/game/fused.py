@@ -56,7 +56,9 @@ class FusedSweep:
             raise ValueError("FusedSweep needs at least one coordinate")
         self.coordinates = coordinates
         self.order = list(order) if order is not None else list(coordinates)
-        if set(self.order) != set(coordinates):
+        # positional carries double-count a repeated coordinate's score, so a
+        # duplicate id must be rejected (the host descent tolerates repeats)
+        if len(self.order) != len(coordinates) or set(self.order) != set(coordinates):
             raise ValueError(f"order {self.order} != ids {set(coordinates)}")
         self.num_iterations = num_iterations
 

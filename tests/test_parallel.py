@@ -213,3 +213,48 @@ def test_scoring_unknown_entity_is_zero(rng):
     slots = jnp.asarray([-1, 0], jnp.int32)
     s = score_samples(w_stack, slots, jnp.asarray(np.ones((2, x.shape[1]))))
     assert float(s[0]) == 0.0
+
+
+def test_fused_sweep_on_mesh_matches_single_device(devices, rng):
+    """FusedSweep under an 8-device mesh == FusedSweep single-device
+    (chip-count invariance for the fully-jitted descent program)."""
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.fused import FusedSweep
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import TaskType
+
+    n_users, per_user, dg, du = 16, 32, 6, 3
+    n = n_users * per_user
+    xg = rng.normal(size=(n, dg))
+    xu = rng.normal(size=(n, du))
+    uids = np.repeat(np.arange(n_users), per_user)
+    y = (rng.random(n) < 0.5).astype(float)
+    data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
+    solver = SolverConfig(max_iters=40, tolerance=1e-9)
+    task = TaskType.LOGISTIC_REGRESSION
+    cfgs = {
+        "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                   reg=Regularization(l2=1.0)),
+        "user": RandomEffectConfig(random_effect_type="userId",
+                                   feature_shard="u", solver=solver,
+                                   reg=Regularization(l2=1.0)),
+    }
+
+    models = {}
+    for label, mesh in (("one", make_mesh(n_data=1, devices=devices[:1])),
+                        ("eight", make_mesh(n_data=8, devices=devices))):
+        coords = {cid: build_coordinate(cid, data, c, task, mesh=mesh)
+                  for cid, c in cfgs.items()}
+        m, _ = FusedSweep(coords, num_iterations=2).run()
+        models[label] = m
+
+    # psum/reduction order differs across device counts: f32 noise only
+    np.testing.assert_allclose(models["one"]["fixed"].coefficients.means,
+                               models["eight"]["fixed"].coefficients.means,
+                               rtol=2e-3, atol=2e-4)
+    assert models["one"]["user"].slot_of == models["eight"]["user"].slot_of
+    np.testing.assert_allclose(models["one"]["user"].w_stack,
+                               models["eight"]["user"].w_stack,
+                               rtol=2e-3, atol=2e-4)
