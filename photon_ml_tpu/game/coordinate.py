@@ -81,6 +81,35 @@ class Coordinate:
         """This coordinate's raw score for every training sample."""
         raise NotImplementedError
 
+    # --- traceable-step interface (fully-jitted sweeps, game/fused.py) ---
+    # The host-paced contract above crosses the device boundary per call; the
+    # methods below keep the whole descent on device: ``state`` is a pytree of
+    # device arrays carried through lax.scan.  A coordinate whose
+    # configuration can't run inside one jitted program (per-update
+    # down-sampling, non-identity projection) raises NotImplementedError from
+    # init_sweep_state.
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def init_sweep_state(self, init: Optional[DatumScoringModel] = None):
+        """Host: initial device state (cold or warm-started from a model)."""
+        raise NotImplementedError
+
+    def trace_update(self, state, offsets: Array) -> Tuple[object, Array]:
+        """Traceable: one update against residual-folded ``offsets[n]``;
+        returns (state', this coordinate's new score[n])."""
+        raise NotImplementedError
+
+    def trace_publish(self, state) -> Array:
+        """Traceable: state -> the publishable coefficient array."""
+        raise NotImplementedError
+
+    def export_model(self, published: np.ndarray) -> DatumScoringModel:
+        """Host: the array from trace_publish -> this coordinate's model."""
+        raise NotImplementedError
+
 
 class FixedEffectCoordinate(Coordinate):
     """Global GLM coordinate (reference FixedEffectCoordinate.scala:35-166)."""
@@ -223,6 +252,35 @@ class FixedEffectCoordinate(Coordinate):
         s = self._score(jnp.asarray(np.asarray(model.coefficients.means, self._dtype)))
         return np.asarray(s)[: self._n]
 
+    # --- traceable-step interface (game/fused.py) ---
+    # State = transformed-space coefficient vector [d].
+
+    def init_sweep_state(self, init: Optional[FixedEffectModel] = None) -> Array:
+        if self.config.down_sampling_rate < 1.0:
+            raise NotImplementedError(
+                f"coordinate {self.coordinate_id!r} resamples per update "
+                "(down_sampling_rate < 1) — use the host-paced CoordinateDescent")
+        if init is not None:
+            w = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
+            return self._norm.model_to_transformed_space(
+                w, self.config.intercept_index)
+        return jnp.zeros(self.dim, self._dtype)
+
+    def trace_update(self, state: Array, offsets: Array) -> Tuple[Array, Array]:
+        pad = self._padded_n - self._n
+        offs = jnp.pad(offsets, (0, pad)) if pad else offsets
+        res = self._solve(state, offs.astype(self._dtype), self._base_weight)
+        return res.w, self._batch.margins(self.trace_publish(res.w))[: self._n]
+
+    def trace_publish(self, state: Array) -> Array:
+        return self._norm.model_to_original_space(state,
+                                                  self.config.intercept_index)
+
+    def export_model(self, published: np.ndarray) -> FixedEffectModel:
+        return FixedEffectModel(
+            coefficients=Coefficients(means=np.asarray(published)),
+            feature_shard=self.config.feature_shard, task=self.task)
+
 
 def _re_data_key(c: RandomEffectConfig) -> tuple:
     """Every field that affects the DATA layout (buckets + projection); a
@@ -275,6 +333,16 @@ class RandomEffectCoordinate(Coordinate):
         # slot order for the stacked model = sorted entity id (stacked_coefficients)
         self._sorted_ids = sorted(self.buckets.lane_of)
         self._slot_of = {eid: i for i, eid in enumerate(self._sorted_ids)}
+        # per-bucket lane -> stacked-model row; invalid lanes get an
+        # out-of-range index so device scatters drop them (stack_bucket_lanes)
+        ne = len(self._sorted_ids)
+        self._slot_idx_dev = [
+            jnp.asarray(np.where(
+                (s := _slots_from(self._slot_of,
+                                  np.asarray(b.entity_lanes, np.int64))) < 0,
+                ne, s).astype(np.int32))
+            for b in self.buckets.buckets
+        ]
         self._entity_ids = np.asarray(entity_ids, np.int64)
         self._sample_slots = jnp.asarray(_slots_from(self._slot_of, self._entity_ids))
         self._x_full = jnp.asarray(x)
@@ -402,6 +470,49 @@ class RandomEffectCoordinate(Coordinate):
             # present in the model)
             slots = jnp.asarray(_slots_from(model.slot_of, self._entity_ids))
         return np.asarray(score_samples(w, slots, self._x_full))[: self._n]
+
+    # --- traceable-step interface (game/fused.py) ---
+    # State = tuple of per-bucket lane coefficient arrays [(lanes, d), ...].
+
+    def init_sweep_state(self, init: Optional[RandomEffectModel] = None) -> Tuple[Array, ...]:
+        if self._proj is not None:
+            raise NotImplementedError(
+                f"coordinate {self.coordinate_id!r} solves in a projected "
+                "space — use the host-paced CoordinateDescent")
+        lanes = []
+        for bi, b in enumerate(self.buckets.buckets):
+            if init is not None:
+                lanes.append(self._put_entity(self._warm_start(bi, init)))
+            else:
+                lanes.append(self._put_entity(
+                    np.zeros((b.num_lanes, self.dim), self._dtype)))
+        return tuple(lanes)
+
+    def trace_update(self, state: Tuple[Array, ...], offsets: Array
+                     ) -> Tuple[Tuple[Array, ...], Array]:
+        from photon_ml_tpu.parallel.bucketing import score_samples
+
+        offsets = offsets.astype(self._dtype)
+        new_lanes = []
+        for lanes, dev in zip(state, self._dev):
+            off_b = jnp.where(dev["valid"], offsets[dev["rows"]], 0.0)
+            res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"])
+            new_lanes.append(res.w)
+        w_stack = self.trace_publish(tuple(new_lanes))
+        score = score_samples(w_stack, self._sample_slots, self._x_full)[: self._n]
+        return tuple(new_lanes), score
+
+    def trace_publish(self, state: Tuple[Array, ...]) -> Array:
+        from photon_ml_tpu.parallel.bucketing import stack_bucket_lanes
+
+        return stack_bucket_lanes(state, self._slot_idx_dev,
+                                  len(self._sorted_ids))
+
+    def export_model(self, published: np.ndarray) -> RandomEffectModel:
+        return RandomEffectModel(
+            w_stack=np.asarray(published), slot_of=dict(self._slot_of),
+            random_effect_type=self.config.random_effect_type,
+            feature_shard=self.config.feature_shard, task=self.task)
 
 
 def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfig,

@@ -5,37 +5,32 @@ reference's driver loop (CoordinateDescent.scala:119-346): one device
 dispatch per solve/score plus host-side residual bookkeeping between
 coordinates.  That loop is the right place for validation, checkpointing and
 locked coordinates — but for raw training throughput the whole sweep can be
-ONE XLA program: ``lax.scan`` over outer iterations whose body inlines every
-coordinate's solver, residual fold, and re-scoring.  No host round-trips, no
-per-phase dispatch latency, and XLA overlaps/fuses across phases (e.g. the
-residual subtraction folds into the next solver's first objective pass).
+ONE XLA program: ``lax.scan`` over outer iterations whose body chains every
+coordinate's traceable step (``Coordinate.trace_update``), residual fold, and
+re-scoring.  No host round-trips, no per-phase dispatch latency, and XLA
+overlaps/fuses across phases (e.g. the residual subtraction folds into the
+next solver's first objective pass).
 
 This is the TPU-native answer to the reference's persist/broadcast
 choreography between coordinate updates (CoordinateDescent.scala:208-232):
 instead of caching RDD scores between Spark jobs, the scores never leave HBM.
 
-Supported (v1): FixedEffectCoordinate over a dense batch with
-down_sampling_rate >= 1 (no per-update resampling inside the scan), and
-RandomEffectCoordinate with the IDENTITY projector.  Anything else -> use
-CoordinateDescent (identical semantics, host-paced).
+Eligibility is decided by each coordinate's ``init_sweep_state``: per-update
+down-sampling and projected random effects need the host-paced loop and raise
+NotImplementedError there (identical semantics either way).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from photon_ml_tpu.core.batch import DenseBatch
-from photon_ml_tpu.game.coordinate import (Coordinate, FixedEffectCoordinate,
-                                           RandomEffectCoordinate)
-from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
-                                       RandomEffectModel)
-from photon_ml_tpu.models.glm import Coefficients
-from photon_ml_tpu.parallel.bucketing import score_samples
+from photon_ml_tpu.game.coordinate import Coordinate
+from photon_ml_tpu.models.game import GameModel
 
 Array = jax.Array
 
@@ -63,155 +58,55 @@ class FusedSweep:
         self.num_iterations = num_iterations
 
         first = coordinates[self.order[0]]
-        self._n = first._n
-        self._dtype = first._dtype
-
-        self._kinds: List[str] = []
-        self._slot_idx: Dict[str, List[Array]] = {}
-        for cid in self.order:
-            coord = coordinates[cid]
-            if isinstance(coord, FixedEffectCoordinate):
-                if not isinstance(coord._batch, DenseBatch):
-                    raise NotImplementedError(
-                        f"fused sweep needs a dense fixed-effect batch ({cid!r})")
-                if coord.config.down_sampling_rate < 1.0:
-                    raise NotImplementedError(
-                        f"fused sweep does not resample per update; coordinate "
-                        f"{cid!r} has down_sampling_rate < 1 — use CoordinateDescent")
-                self._kinds.append("fixed")
-            elif isinstance(coord, RandomEffectCoordinate):
-                if coord._proj is not None:
-                    raise NotImplementedError(
-                        f"fused sweep supports IDENTITY projection only ({cid!r})")
-                self._kinds.append("random")
-                # per-bucket lane -> slot row in the stacked model; invalid
-                # lanes scatter out of range and are dropped
-                from photon_ml_tpu.game.coordinate import _slots_from
-
-                num_entities = len(coord._sorted_ids)
-                self._slot_idx[cid] = [
-                    jnp.asarray(np.where(
-                        (s := _slots_from(coord._slot_of,
-                                          np.asarray(b.entity_lanes, np.int64))) < 0,
-                        num_entities, s).astype(np.int32))
-                    for b in coord.buckets.buckets
-                ]
-            else:
-                raise TypeError(f"unknown coordinate type {type(coord)!r}")
-
-        base = jnp.asarray(np.asarray(first._base_offset, self._dtype))
-        n, order, coords = self._n, self.order, self.coordinates
-        kinds, slot_idx = self._kinds, self._slot_idx
+        self._n = first.num_samples
+        self._dtype = first.dtype
+        base = jnp.asarray(np.asarray(first._base_offset_host(), self._dtype))
+        order, coords = self.order, self.coordinates
 
         def body(carry, _):
-            ws, lanes, scores = carry
-            ws, lanes, scores = list(ws), list(lanes), list(scores)
+            states, scores = list(carry[0]), list(carry[1])
             total = scores[0]
             for s in scores[1:]:
                 total = total + s
             for i, cid in enumerate(order):
-                coord = coords[cid]
                 # residual trick (CoordinateDescent.scala:197-204)
                 partial = total - scores[i]
-                offs = base + partial
-                if kinds[i] == "fixed":
-                    pad = coord._padded_n - n
-                    offs_p = jnp.pad(offs, (0, pad)) if pad else offs
-                    res = coord._solve(ws[i], offs_p, coord._base_weight)
-                    ws[i] = res.w
-                    w_orig = coord._norm.model_to_original_space(
-                        res.w, coord.config.intercept_index)
-                    s = coord._batch.margins(w_orig)[:n]
-                else:
-                    new_lanes = []
-                    for bi, dev in enumerate(coord._dev):
-                        off_b = jnp.where(dev["valid"], offs[dev["rows"]],
-                                          0.0).astype(offs.dtype)
-                        res = coord._vsolve(lanes[i][bi], dev["x"], dev["y"],
-                                            off_b, dev["w"])
-                        new_lanes.append(res.w)
-                    lanes[i] = tuple(new_lanes)
-                    w_stack = self._stack(cid, new_lanes)
-                    s = score_samples(w_stack, coord._sample_slots, coord._x_full)[:n]
-                scores[i] = s
-                total = partial + s
-            return (tuple(ws), tuple(lanes), tuple(scores)), None
+                states[i], scores[i] = coords[cid].trace_update(
+                    states[i], base + partial)
+                total = partial + scores[i]
+            return (tuple(states), tuple(scores)), None
 
-        def program(ws0, lanes0, scores0):
-            carry, _ = lax.scan(body, (ws0, lanes0, scores0), None,
+        def program(states0, scores0):
+            carry, _ = lax.scan(body, (states0, scores0), None,
                                 length=self.num_iterations)
-            ws, lanes, scores = carry
-            outs = []
-            for i, cid in enumerate(order):
-                coord = coords[cid]
-                if kinds[i] == "fixed":
-                    outs.append(coord._norm.model_to_original_space(
-                        ws[i], coord.config.intercept_index))
-                else:
-                    outs.append(self._stack(cid, list(lanes[i])))
-            return tuple(outs), scores
+            states, scores = carry
+            published = tuple(coords[cid].trace_publish(states[i])
+                              for i, cid in enumerate(order))
+            return published, scores
 
         self._program = jax.jit(program)
-
-    def _stack(self, cid: str, lane_ws: List[Array]) -> Array:
-        coord = self.coordinates[cid]
-        num_entities = len(coord._sorted_ids)
-        d = lane_ws[0].shape[-1]
-        w_stack = jnp.zeros((num_entities, d), lane_ws[0].dtype)
-        for bi, lw in enumerate(lane_ws):
-            w_stack = w_stack.at[self._slot_idx[cid][bi]].set(lw, mode="drop")
-        return w_stack
+        # Cold-start carry built eagerly: validates every coordinate's
+        # fused-eligibility at construction time and is reused by run().
+        self._cold = self._init_carry(None)
 
     def _init_carry(self, initial: Optional[GameModel]):
-        ws, lanes, scores = [], [], []
-        for i, cid in enumerate(self.order):
+        states, scores = [], []
+        for cid in self.order:
             coord = self.coordinates[cid]
             init = initial[cid] if initial is not None and cid in initial else None
-            if self._kinds[i] == "fixed":
-                if init is not None:
-                    w0 = coord._norm.model_to_transformed_space(
-                        jnp.asarray(np.asarray(init.coefficients.means,
-                                               self._dtype)),
-                        coord.config.intercept_index)
-                else:
-                    w0 = jnp.zeros(coord.dim, self._dtype)
-                ws.append(w0)
-                lanes.append(())
-            else:
-                # entity-lane sharding must match the closed-over bucket data
-                # (RandomEffectCoordinate.update routes w0 the same way)
-                bucket_ws = []
-                for bi, b in enumerate(coord.buckets.buckets):
-                    if init is not None:
-                        bucket_ws.append(coord._put_entity(
-                            coord._warm_start(bi, init)))
-                    else:
-                        bucket_ws.append(coord._put_entity(
-                            np.zeros((b.num_lanes, coord.dim), self._dtype)))
-                ws.append(())
-                lanes.append(tuple(bucket_ws))
+            states.append(coord.init_sweep_state(init))
             scores.append(jnp.zeros(self._n, self._dtype) if init is None
                           else jnp.asarray(np.asarray(coord.score(init),
                                                       self._dtype)))
-        return tuple(ws), tuple(lanes), tuple(scores)
+        return tuple(states), tuple(scores)
 
     def run(self, initial: Optional[GameModel] = None
             ) -> Tuple[GameModel, Dict[str, np.ndarray]]:
         """One fused descent; returns (model, per-coordinate final scores)."""
-        ws0, lanes0, scores0 = self._init_carry(initial)
-        outs, scores = self._program(ws0, lanes0, scores0)
-        models: Dict[str, object] = {}
-        final_scores: Dict[str, np.ndarray] = {}
-        for i, cid in enumerate(self.order):
-            coord = self.coordinates[cid]
-            if self._kinds[i] == "fixed":
-                models[cid] = FixedEffectModel(
-                    coefficients=Coefficients(means=np.asarray(outs[i])),
-                    feature_shard=coord.config.feature_shard, task=coord.task)
-            else:
-                models[cid] = RandomEffectModel(
-                    w_stack=np.asarray(outs[i]), slot_of=dict(coord._slot_of),
-                    random_effect_type=coord.config.random_effect_type,
-                    feature_shard=coord.config.feature_shard, task=coord.task)
-            final_scores[cid] = np.asarray(scores[i])
+        carry = self._cold if initial is None else self._init_carry(initial)
+        published, scores = self._program(*carry)
+        models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
+                  for i, cid in enumerate(self.order)}
+        final_scores = {cid: np.asarray(scores[i])
+                        for i, cid in enumerate(self.order)}
         return GameModel(models=models), final_scores

@@ -275,6 +275,20 @@ def stacked_coefficients(
     return w, slot_of
 
 
+def stack_bucket_lanes(lane_ws: Sequence[Array], slot_idx: Sequence[Array],
+                       num_entities: int) -> Array:
+    """Traceable stacked_coefficients: scatter per-bucket lane coefficient
+    rows into W[num_entities, d].  ``slot_idx[bi][lane]`` is the stacked row
+    (out-of-range for invalid/padded lanes, which the 'drop' scatter
+    discards).  Device-side counterpart of ``stacked_coefficients`` for
+    fully-jitted sweeps (game/fused.py)."""
+    d = lane_ws[0].shape[-1]
+    w = jnp.zeros((num_entities, d), lane_ws[0].dtype)
+    for idx, lw in zip(slot_idx, lane_ws):
+        w = w.at[idx].set(lw, mode="drop")
+    return w
+
+
 def score_samples(w_stack: Array, slots: Array, x: Array) -> Array:
     """Raw per-sample scores (x_i · w_entity(i)) for ANY sample set.
 
