@@ -24,7 +24,8 @@ from photon_ml_tpu.game.config import (
     RandomEffectConfig,
 )
 from photon_ml_tpu.opt.types import SolverConfig
-from photon_ml_tpu.types import OptimizerType, ProjectorType, TaskType
+from photon_ml_tpu.types import (OptimizerType, ProjectorType, TaskType,
+                                 VarianceComputationType)
 
 
 @dataclasses.dataclass
@@ -66,6 +67,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
         tolerance=float(kv.pop("tolerance", 1e-7)),
     )
     reg_type = RegularizationType[kv.pop("reg.type", "L2").upper()]
+    variance = VarianceComputationType[kv.pop("variance.type", "NONE").upper()]
     alpha = float(kv.pop("reg.alpha", 0.5))
     weights = [float(w) for w in kv.pop("reg.weights", "0").split("|")]
 
@@ -89,6 +91,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
                                        if "features.to.samples.ratio" in kv else None),
             intercept_index=(int(kv["intercept.index"])
                              if "intercept.index" in kv else None),
+            variance=variance,
         )
         for consumed in ("active.data.upper.bound", "projected.dim",
                          "features.to.samples.ratio", "intercept.index"):
@@ -99,6 +102,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             optimizer=optimizer,
             solver=solver,
             down_sampling_rate=float(kv.pop("down.sampling.rate", 1.0)),
+            variance=variance,
         )
     if kv:
         raise ValueError(f"unknown coordinate spec keys for {name!r}: {sorted(kv)}")

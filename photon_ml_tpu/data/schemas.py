@@ -94,6 +94,33 @@ FEATURE_SUMMARY = {
     ],
 }
 
+# Truncated response-prediction input (reference ResponsePredictionAvro.avsc:
+# "the only field[s] photon is expecting").
+RESPONSE_PREDICTION = {
+    "type": "record",
+    "name": "SimplifiedResponsePrediction",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "response", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": FEATURE}},
+        {"name": "weight", "type": "double", "default": 1.0},
+        {"name": "offset", "type": "double", "default": 0.0},
+    ],
+}
+
+# Matrix-factorization latent factor (reference LatentFactorAvro.avsc — a
+# schema stub with no implementation behind it in the reference either,
+# SURVEY.md §2.5).
+LATENT_FACTOR = {
+    "type": "record",
+    "name": "LatentFactorAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "effectId", "type": "string"},
+        {"name": "latentFactor", "type": {"type": "array", "items": "double"}},
+    ],
+}
+
 # The reference encodes an intercept as name=(INTERCEPT), term=""
 # (Constants.scala INTERCEPT_KEY).
 INTERCEPT_NAME = "(INTERCEPT)"

@@ -13,7 +13,8 @@ from typing import Optional, Union
 
 from photon_ml_tpu.core.regularization import Regularization
 from photon_ml_tpu.opt.types import SolverConfig
-from photon_ml_tpu.types import OptimizerType, ProjectorType, TaskType
+from photon_ml_tpu.types import (OptimizerType, ProjectorType, TaskType,
+                                 VarianceComputationType)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -27,6 +28,10 @@ class FixedEffectConfig:
     reg: Regularization = Regularization()
     down_sampling_rate: float = 1.0  # negative down-sampling (binary tasks)
     intercept_index: Optional[int] = None  # needed by shift normalization
+    # Coefficient variances on the final model (reference
+    # DistributedOptimizationProblem.scala:84-108; stored in
+    # BayesianLinearModelAvro.variances)
+    variance: VarianceComputationType = VarianceComputationType.NONE
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +52,7 @@ class RandomEffectConfig:
     projected_dim: Optional[int] = None  # required for ProjectorType.RANDOM
     features_to_samples_ratio: Optional[float] = None  # per-entity Pearson top-k cap
     intercept_index: Optional[int] = None  # column the Pearson filter must keep
+    variance: VarianceComputationType = VarianceComputationType.NONE
 
 
 CoordinateConfig = Union[FixedEffectConfig, RandomEffectConfig]

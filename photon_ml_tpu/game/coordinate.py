@@ -39,7 +39,8 @@ from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverResult
 from photon_ml_tpu.parallel.bucketing import bucket_by_entity, stacked_coefficients
 from photon_ml_tpu.parallel.mesh import replicate, shard_batch
-from photon_ml_tpu.types import OptimizerType, ProjectorType, TaskType
+from photon_ml_tpu.types import (OptimizerType, ProjectorType, TaskType,
+                                 VarianceComputationType)
 
 Array = jax.Array
 
@@ -181,6 +182,7 @@ class FixedEffectCoordinate(Coordinate):
             from photon_ml_tpu.parallel.fixed import ShardMapObjective
 
             objective = ShardMapObjective(objective, self.mesh)
+        self._objective = objective
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
         batch = self._batch
 
@@ -241,8 +243,23 @@ class FixedEffectCoordinate(Coordinate):
         weights = self._down_sample_weights(seed)
         res = self._solve(w0, offs, weights)
         w_orig = self._norm.model_to_original_space(res.w, ii)
+        variances = None
+        if self.config.variance != VarianceComputationType.NONE:
+            # Computed at the optimization-space coefficients, then mapped
+            # through the SAME coefficient transform as the means — exact
+            # reference behavior (DistributedOptimizationProblem.scala:84-108;
+            # GeneralizedLinearOptimizationProblem.createModel:89-95 applies
+            # modelToOriginalSpace to the variances vector verbatim).
+            from photon_ml_tpu.opt.solve import compute_variances
+
+            v = compute_variances(
+                self._objective, res.w,
+                self._batch.replace(offset=offs, weight=weights),
+                self.config.variance)
+            variances = np.asarray(self._norm.model_to_original_space(v, ii))
         model = FixedEffectModel(
-            coefficients=Coefficients(means=np.asarray(w_orig)),
+            coefficients=Coefficients(means=np.asarray(w_orig),
+                                      variances=variances),
             feature_shard=self.config.feature_shard,
             task=self.task,
         )
@@ -260,6 +277,11 @@ class FixedEffectCoordinate(Coordinate):
             raise NotImplementedError(
                 f"coordinate {self.coordinate_id!r} resamples per update "
                 "(down_sampling_rate < 1) — use the host-paced CoordinateDescent")
+        if self.config.variance != VarianceComputationType.NONE:
+            raise NotImplementedError(
+                f"coordinate {self.coordinate_id!r} requests coefficient "
+                "variances, which the fused sweep does not produce — use the "
+                "host-paced CoordinateDescent")
         if init is not None:
             w = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
             return self._norm.model_to_transformed_space(
@@ -386,6 +408,7 @@ class RandomEffectCoordinate(Coordinate):
 
     def _bind_solver(self) -> None:
         objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg)
+        self._objective = objective
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
 
         def _vsolve(w0, x_b, y_b, off_b, wt_b):
@@ -394,6 +417,26 @@ class RandomEffectCoordinate(Coordinate):
             )(w0, x_b, y_b, off_b, wt_b)
 
         self._vsolve = jax.jit(_vsolve)
+
+        kind = self.config.variance
+        if kind != VarianceComputationType.NONE:
+            if self.config.projector != ProjectorType.IDENTITY:
+                raise ValueError(
+                    "per-entity variances are not defined in a projected "
+                    "solve space; use ProjectorType.IDENTITY "
+                    f"(coordinate {self.coordinate_id!r})")
+            from photon_ml_tpu.opt.solve import compute_variances
+
+            def _vvar(w_b, x_b, y_b, off_b, wt_b):
+                return jax.vmap(
+                    lambda w, xx, yy, oo, ww: compute_variances(
+                        objective, w,
+                        DenseBatch(x=xx, y=yy, offset=oo, weight=ww), kind)
+                )(w_b, x_b, y_b, off_b, wt_b)
+
+            self._vvar = jax.jit(_vvar)
+        else:
+            self._vvar = None
 
     def data_key(self) -> tuple:
         return _re_data_key(self.config)
@@ -435,6 +478,7 @@ class RandomEffectCoordinate(Coordinate):
                ) -> Tuple[RandomEffectModel, List[SolverResult]]:
         offs = jnp.asarray(np.asarray(total_offsets, self._dtype))
         coeffs = []
+        variances = [] if self._vvar is not None else None
         results = []
         for bi, (b, dev) in enumerate(zip(self.buckets.buckets, self._dev)):
             solve_dim = dev["x"].shape[2]
@@ -447,14 +491,24 @@ class RandomEffectCoordinate(Coordinate):
             res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"])
             coeffs.append(res.w)
             results.append(res)
+            if variances is not None:
+                # per-entity variances, vmapped over the bucket's lanes
+                # (reference computes them per SingleNodeOptimizationProblem)
+                variances.append(self._vvar(res.w, dev["x"], dev["y"],
+                                            off_b, dev["w"]))
 
         if self._proj is not None:
             coeffs = self._proj.back_project([np.asarray(c) for c in coeffs])
         w_stack, slot_of = stacked_coefficients(coeffs, self.buckets)
+        var_stack = None
+        if variances is not None:
+            var_stack, _ = stacked_coefficients(variances, self.buckets)
+            var_stack = np.asarray(var_stack)
         model = RandomEffectModel(
             w_stack=np.asarray(w_stack), slot_of=slot_of,
             random_effect_type=self.config.random_effect_type,
             feature_shard=self.config.feature_shard, task=self.task,
+            variances=var_stack,
         )
         return model, results
 
@@ -479,6 +533,11 @@ class RandomEffectCoordinate(Coordinate):
             raise NotImplementedError(
                 f"coordinate {self.coordinate_id!r} solves in a projected "
                 "space — use the host-paced CoordinateDescent")
+        if self.config.variance != VarianceComputationType.NONE:
+            raise NotImplementedError(
+                f"coordinate {self.coordinate_id!r} requests coefficient "
+                "variances, which the fused sweep does not produce — use the "
+                "host-paced CoordinateDescent")
         lanes = []
         for bi, b in enumerate(self.buckets.buckets):
             if init is not None:

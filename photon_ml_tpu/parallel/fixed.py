@@ -92,6 +92,36 @@ class ShardMapObjective:
             out_specs=(P(), P()))(w, batch, v)
         return obj.finish_hvp(v, hv, qs)
 
+    # Variance computation (opt/solve.compute_variances) needs the Hessian
+    # diagonal / matrix.  Both are sums over examples followed by elementwise
+    # (linear) normalization maps, so per-shard values psum exactly — except
+    # the L2 term, which every shard adds once; subtract it locally and re-add
+    # after the reduction (reference treeAggregate reduces UN-regularized
+    # aggregators for the same reason, HessianDiagonalAggregator.scala:128).
+
+    def hessian_diag(self, w: Array, batch: Batch) -> Array:
+        obj, axis = self.obj, self.axis
+
+        def local(w, b):
+            return jax.lax.psum(obj.hessian_diag(w, b) - obj.reg.l2, axis)
+
+        return jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P(), self._specs(batch)),
+            out_specs=P())(w, batch) + obj.reg.l2
+
+    def hessian(self, w: Array, batch: Batch) -> Array:
+        obj, axis = self.obj, self.axis
+        d = w.shape[-1]
+
+        def local(w, b):
+            eye = jnp.eye(d, dtype=w.dtype)
+            return jax.lax.psum(obj.hessian(w, b) - obj.reg.l2 * eye, axis)
+
+        h = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P(), self._specs(batch)),
+            out_specs=P())(w, batch)
+        return h + obj.reg.l2 * jnp.eye(d, dtype=h.dtype)
+
 
 def fit_fixed_effect(
     objective: GLMObjective,

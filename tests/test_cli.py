@@ -415,3 +415,14 @@ def test_model_output_modes(tmp_path):
                                  "--output-models-limit", "1"]) == 0
     assert os.path.isdir(os.path.join(out_lim, "models", "0"))
     assert not os.path.exists(os.path.join(out_lim, "models", "1"))
+
+
+def test_variance_type_in_coordinate_spec():
+    from photon_ml_tpu.types import VarianceComputationType
+
+    spec = parse_coordinate_spec(
+        "name=fixed,feature.shard=g,reg.weights=1,variance.type=SIMPLE")
+    assert spec.template.variance == VarianceComputationType.SIMPLE
+    spec2 = parse_coordinate_spec(
+        "name=u,random.effect.type=uid,feature.shard=g,variance.type=FULL")
+    assert spec2.template.variance == VarianceComputationType.FULL

@@ -258,3 +258,34 @@ def test_fused_sweep_on_mesh_matches_single_device(devices, rng):
     np.testing.assert_allclose(models["one"]["user"].w_stack,
                                models["eight"]["user"].w_stack,
                                rtol=2e-3, atol=2e-4)
+
+
+def test_variance_on_mesh_matches_single_device(devices, rng):
+    """ShardMapObjective hessian_diag/hessian: variances computed under an
+    8-device mesh equal the single-device ones (the L2 term must be added
+    once, not once per shard)."""
+    import dataclasses
+
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import FixedEffectConfig, GameData
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+    n, d = 512, 6
+    x = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+    data = GameData(y=y, features={"g": x})
+    for kind in (VarianceComputationType.SIMPLE, VarianceComputationType.FULL):
+        cfg = FixedEffectConfig(feature_shard="g",
+                                solver=SolverConfig(max_iters=40),
+                                reg=Regularization(l2=2.0), variance=kind)
+        got = {}
+        for label, mesh in (("one", make_mesh(n_data=1, devices=devices[:1])),
+                            ("eight", make_mesh(n_data=8, devices=devices))):
+            coord = build_coordinate("fixed", data, cfg, TaskType.LOGISTIC_REGRESSION,
+                                     mesh=mesh)
+            model, _ = coord.update(np.zeros(n))
+            assert model.coefficients.variances is not None
+            got[label] = model.coefficients.variances
+        np.testing.assert_allclose(got["one"], got["eight"], rtol=1e-3, atol=1e-6)
