@@ -488,9 +488,32 @@ def _run(args, task, t_start, emitter) -> int:
         for tag, eidx in entity_indexes.items():
             eidx.save(os.path.join(args.output_dir, f"{tag}.entities.json"))
     if feature_stats:
-        # reference ModelProcessingUtils.writeBasicStatistics:516
+        # reference ModelProcessingUtils.writeBasicStatistics:516 — JSON for
+        # humans plus the reference's FeatureSummarizationResultAvro records
+        # (per-feature metric map) for tool compatibility
         with open(os.path.join(args.output_dir, "feature-stats.json"), "w") as f:
             json.dump(feature_stats, f)
+        from photon_ml_tpu.data import avro as avro_io
+        from photon_ml_tpu.data.schemas import FEATURE_SUMMARY
+
+        for s, st in feature_stats.items():
+            imap = index_maps[s]
+
+            def records(st=st, imap=imap):
+                for j in range(len(st["mean"])):
+                    name_term = imap.get_feature_name(j)
+                    if name_term is None:
+                        continue
+                    name, term = name_term
+                    yield {"name": name, "term": term, "metrics": {
+                        "mean": st["mean"][j],
+                        "variance": st["variance"][j],
+                        "absMax": st["abs_max"][j],
+                    }}
+
+            avro_io.write_container(
+                os.path.join(args.output_dir, f"{s}.feature-summary.avro"),
+                FEATURE_SUMMARY, records())
     summary = {
         "task": task.value,
         "train_samples": int(data.num_samples),

@@ -49,14 +49,23 @@ class GameEstimator:
 
     def __init__(self, mesh: Optional[Mesh] = None,
                  validation_suite: Optional[EvaluationSuite] = None,
-                 normalization: Optional[Dict[str, "NormalizationContext"]] = None):
+                 normalization: Optional[Dict[str, "NormalizationContext"]] = None,
+                 fused: "bool | str" = "auto"):
         """``normalization``: per-feature-shard NormalizationContext applied
         to fixed-effect coordinates (reference GameEstimator normalization
         wrappers, fit:430-436; models come out in original space).  Living on
-        the estimator (not fit()) so tuning retrains inherit it."""
+        the estimator (not fit()) so tuning retrains inherit it.
+
+        ``fused``: "auto" (default) runs each configuration as ONE jitted
+        program (game/fused.FusedSweep — no host round-trips between
+        coordinate updates) whenever the fit has no per-update host work
+        (no validation suite, checkpointing, locked coordinates, or resume)
+        and every coordinate is fused-eligible; True requires it (raising
+        when ineligible); False always uses the host-paced loop."""
         self.mesh = mesh
         self.validation_suite = validation_suite
         self.normalization = normalization or {}
+        self.fused = fused
 
     def fit(
         self,
@@ -79,6 +88,9 @@ class GameEstimator:
         results: List[GameFitResult] = []
         warm = initial_model
         prev: Dict[str, object] = {}
+        prev_sweep = None  # (key, FusedSweep) — reuse the compiled program
+        # when every coordinate object survived config-to-config (same `prev`
+        # reuse that keeps solver jits alive)
         for ci, config in enumerate(configs):
             if resume_cursor is not None and ci < resume_cursor.get("config", 0):
                 continue
@@ -104,6 +116,39 @@ class GameEstimator:
             validation = None
             if validation_data is not None and self.validation_suite is not None:
                 validation = (validation_data, self.validation_suite)
+
+            fused_ok = (self.fused is not False and validation is None
+                        and checkpoint_hook is None and not locked_coordinates
+                        and resume_cursor is None)
+            if fused_ok:
+                from photon_ml_tpu.game.fused import FusedSweep
+
+                key = (tuple((cid, id(coordinates[cid]))
+                             for cid in config.coordinates),
+                       config.num_outer_iterations)
+                try:
+                    if prev_sweep is not None and prev_sweep[0] == key:
+                        sweep = prev_sweep[1]
+                    else:
+                        sweep = FusedSweep(coordinates,
+                                           order=list(config.coordinates),
+                                           num_iterations=config.num_outer_iterations)
+                        prev_sweep = (key, sweep)
+                except NotImplementedError:
+                    if self.fused is True:
+                        raise
+                else:
+                    model, _scores = sweep.run(initial=warm)
+                    results.append(GameFitResult(model=model, config=config,
+                                                 evaluation=None,
+                                                 history=DescentHistory()))
+                    warm = model
+                    continue
+            elif self.fused is True:
+                raise ValueError(
+                    "fused=True needs a fit with no per-update host work "
+                    "(no validation suite, checkpoint hook, locked "
+                    "coordinates, or resume)")
             descent = CoordinateDescent(
                 coordinates,
                 order=list(config.coordinates),

@@ -426,3 +426,25 @@ def test_variance_type_in_coordinate_spec():
     spec2 = parse_coordinate_spec(
         "name=u,random.effect.type=uid,feature.shard=g,variance.type=FULL")
     assert spec2.template.variance == VarianceComputationType.FULL
+
+
+def test_feature_summary_avro_output(tmp_path):
+    """Normalization runs emit the reference's FeatureSummarizationResultAvro
+    records next to the JSON stats."""
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.data import avro as avro_io
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=150, seed=9)
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--normalization", "STANDARDIZATION",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    recs = list(avro_io.read_container(os.path.join(out, "all.feature-summary.avro")))
+    assert len(recs) >= 4  # g0..g2, ux (+ intercept row if mapped)
+    by_name = {r["name"]: r["metrics"] for r in recs}
+    assert "g0" in by_name and set(by_name["g0"]) == {"mean", "variance", "absMax"}

@@ -460,3 +460,36 @@ def test_variance_computation_game_path(rng, tmp_path):
     got = np.asarray(sorted(np.round(lv.sum(axis=1), 6)))
     want = np.asarray(sorted(np.round(re_model.variances.sum(axis=1), 6)))
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_estimator_fused_auto_matches_host(rng):
+    """fused="auto" (no validation) must produce the same models as the
+    host-paced loop (fused=False)."""
+    data, *_ = _glmix_data(rng, n_users=8, per_user=40)
+    cfg = _configs(num_iters=2)
+    m_auto = GameEstimator(fused="auto").fit(data, [cfg])[0].model
+    m_host = GameEstimator(fused=False).fit(data, [cfg])[0].model
+    np.testing.assert_allclose(m_auto["fixed"].coefficients.means,
+                               m_host["fixed"].coefficients.means,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(m_auto["per-user"].w_stack,
+                               m_host["per-user"].w_stack, rtol=2e-3, atol=2e-3)
+
+    # validation present -> auto falls back to the host loop (metrics needed)
+    suite = EvaluationSuite.from_specs(["auc"])
+    r = GameEstimator(validation_suite=suite, fused="auto").fit(
+        data, [cfg], validation_data=data)[0]
+    assert r.evaluation is not None
+
+    # fused=True raises when the fit needs per-update host work
+    with pytest.raises(ValueError):
+        GameEstimator(validation_suite=suite, fused=True).fit(
+            data, [cfg], validation_data=data)
+
+    # fused=True surfaces coordinate ineligibility (downsampling)
+    import dataclasses
+
+    ds = dataclasses.replace(cfg.coordinates["fixed"], down_sampling_rate=0.5)
+    bad = GameConfig(task=cfg.task, coordinates={"fixed": ds})
+    with pytest.raises(NotImplementedError):
+        GameEstimator(fused=True).fit(data, [bad])
