@@ -65,6 +65,11 @@ class GLMObjective:
     # on TPU with lane-aligned dim only; silently identical math otherwise.
     fused: bool = struct.field(pytree_node=False, default=False)
 
+    def with_reg(self, reg: Regularization) -> "GLMObjective":
+        """Same objective, different (possibly traced) regularization weights
+        — the vehicle for recompile-free reg-path sweeps."""
+        return self.replace(reg=reg)
+
     @staticmethod
     def _fused_eligible(batch: Batch) -> bool:
         """Trace-time gate for the pallas kernels; ineligible batches fall

@@ -31,6 +31,7 @@ from photon_ml_tpu.core.batch import DenseBatch, SparseBatch
 from photon_ml_tpu.core.losses import loss_for_task
 from photon_ml_tpu.core.normalization import NormalizationContext, no_normalization
 from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.core.regularization import Regularization
 from photon_ml_tpu.game.config import CoordinateConfig, FixedEffectConfig, RandomEffectConfig
 from photon_ml_tpu.game.data import GameData, SparseShard
 from photon_ml_tpu.models.game import DatumScoringModel, FixedEffectModel, RandomEffectModel
@@ -98,9 +99,12 @@ class Coordinate:
         """Host: initial device state (cold or warm-started from a model)."""
         raise NotImplementedError
 
-    def trace_update(self, state, offsets: Array) -> Tuple[object, Array]:
+    def trace_update(self, state, offsets: Array,
+                     reg: "Optional[Regularization]" = None) -> Tuple[object, Array]:
         """Traceable: one update against residual-folded ``offsets[n]``;
-        returns (state', this coordinate's new score[n])."""
+        returns (state', this coordinate's new score[n]).  ``reg`` (possibly
+        traced) overrides the config's regularization weights so one compiled
+        sweep serves a whole reg grid."""
         raise NotImplementedError
 
     def trace_publish(self, state) -> Array:
@@ -110,6 +114,19 @@ class Coordinate:
     def export_model(self, published: np.ndarray) -> DatumScoringModel:
         """Host: the array from trace_publish -> this coordinate's model."""
         raise NotImplementedError
+
+    def sweep_key(self) -> tuple:
+        """Identity of this coordinate's compiled sweep contribution: the
+        device data layout + every config field EXCEPT the regularization
+        VALUES (those enter the program as traced arguments).  The L1 regime
+        (l1 > 0) must survive in the key: make_solver dispatches OWLQN vs
+        L-BFGS statically on it, so a reg override may never cross the
+        smooth/L1 boundary inside one compiled sweep."""
+        import dataclasses
+
+        regime = Regularization(l1=1.0 if self.config.reg.l1 > 0.0 else 0.0)
+        return (self.data_key(),
+                dataclasses.replace(self.config, reg=regime))
 
 
 class FixedEffectCoordinate(Coordinate):
@@ -186,12 +203,24 @@ class FixedEffectCoordinate(Coordinate):
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
         batch = self._batch
 
-        def _solve(w0: Array, offsets: Array, weights: Array) -> SolverResult:
-            return solve(w0, batch.replace(offset=offsets, weight=weights))
+        # reg is a TRACED argument: a reg-weight grid re-enters this exact
+        # compiled program (the optimizer/L1-regime dispatch inside
+        # make_solver stays keyed to the build-time reg — see _solver_key)
+        def _solve(w0: Array, offsets: Array, weights: Array,
+                   reg: Regularization) -> SolverResult:
+            return solve(w0, batch.replace(offset=offsets, weight=weights),
+                         objective=objective.with_reg(reg))
 
         out_shard = replicate(self.mesh) if self.mesh is not None else None
         self._solve = (jax.jit(_solve, out_shardings=out_shard)
                        if self.mesh is not None else jax.jit(_solve))
+        self._solver_key = self._make_solver_key()
+
+    def _make_solver_key(self) -> tuple:
+        """Everything (besides reg VALUES) that shapes the compiled solver."""
+        c = self.config
+        return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance,
+                c.intercept_index)
 
     def data_key(self) -> tuple:
         """Identity of the device data layout (reuse across optimization
@@ -199,14 +228,17 @@ class FixedEffectCoordinate(Coordinate):
         return ("fixed", self.config.feature_shard)
 
     def rebind(self, config: FixedEffectConfig) -> "FixedEffectCoordinate":
-        """New optimization settings over the SAME device-resident data."""
+        """New optimization settings over the SAME device-resident data.
+        A reg-weight-only change keeps the compiled solver (reg is a traced
+        argument of ``_solve``) — zero recompilation across a λ grid."""
         import copy
 
         if config.feature_shard != self.config.feature_shard:
             raise ValueError("rebind cannot change the feature shard")
         new = copy.copy(self)
         new.config = config
-        new._bind_solver()
+        if new._make_solver_key() != self._solver_key:
+            new._bind_solver()
         return new
 
     def _pad(self, a: np.ndarray) -> np.ndarray:
@@ -241,7 +273,7 @@ class FixedEffectCoordinate(Coordinate):
             w0 = jnp.zeros(self.dim, self._dtype)
         offs = jnp.asarray(self._pad(np.asarray(total_offsets, self._dtype)))
         weights = self._down_sample_weights(seed)
-        res = self._solve(w0, offs, weights)
+        res = self._solve(w0, offs, weights, self.config.reg)
         w_orig = self._norm.model_to_original_space(res.w, ii)
         variances = None
         if self.config.variance != VarianceComputationType.NONE:
@@ -253,7 +285,7 @@ class FixedEffectCoordinate(Coordinate):
             from photon_ml_tpu.opt.solve import compute_variances
 
             v = compute_variances(
-                self._objective, res.w,
+                self._objective.with_reg(self.config.reg), res.w,
                 self._batch.replace(offset=offs, weight=weights),
                 self.config.variance)
             variances = np.asarray(self._norm.model_to_original_space(v, ii))
@@ -288,10 +320,12 @@ class FixedEffectCoordinate(Coordinate):
                 w, self.config.intercept_index)
         return jnp.zeros(self.dim, self._dtype)
 
-    def trace_update(self, state: Array, offsets: Array) -> Tuple[Array, Array]:
+    def trace_update(self, state: Array, offsets: Array,
+                     reg: Optional[Regularization] = None) -> Tuple[Array, Array]:
         pad = self._padded_n - self._n
         offs = jnp.pad(offsets, (0, pad)) if pad else offsets
-        res = self._solve(state, offs.astype(self._dtype), self._base_weight)
+        res = self._solve(state, offs.astype(self._dtype), self._base_weight,
+                          self.config.reg if reg is None else reg)
         return res.w, self._batch.margins(self.trace_publish(res.w))[: self._n]
 
     def trace_publish(self, state: Array) -> Array:
@@ -411,9 +445,12 @@ class RandomEffectCoordinate(Coordinate):
         self._objective = objective
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
 
-        def _vsolve(w0, x_b, y_b, off_b, wt_b):
+        # reg traced (broadcast over lanes): λ sweeps reuse this compilation
+        def _vsolve(w0, x_b, y_b, off_b, wt_b, reg):
             return jax.vmap(
-                lambda w, xx, yy, oo, ww: solve(w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww))
+                lambda w, xx, yy, oo, ww: solve(
+                    w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
+                    objective=objective.with_reg(reg))
             )(w0, x_b, y_b, off_b, wt_b)
 
         self._vsolve = jax.jit(_vsolve)
@@ -427,29 +464,36 @@ class RandomEffectCoordinate(Coordinate):
                     f"(coordinate {self.coordinate_id!r})")
             from photon_ml_tpu.opt.solve import compute_variances
 
-            def _vvar(w_b, x_b, y_b, off_b, wt_b):
+            def _vvar(w_b, x_b, y_b, off_b, wt_b, reg):
                 return jax.vmap(
                     lambda w, xx, yy, oo, ww: compute_variances(
-                        objective, w,
+                        objective.with_reg(reg), w,
                         DenseBatch(x=xx, y=yy, offset=oo, weight=ww), kind)
                 )(w_b, x_b, y_b, off_b, wt_b)
 
             self._vvar = jax.jit(_vvar)
         else:
             self._vvar = None
+        self._solver_key = self._make_solver_key()
+
+    def _make_solver_key(self) -> tuple:
+        c = self.config
+        return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance)
 
     def data_key(self) -> tuple:
         return _re_data_key(self.config)
 
     def rebind(self, config: RandomEffectConfig) -> "RandomEffectCoordinate":
-        """New optimization settings over the SAME buckets/device arrays."""
+        """New optimization settings over the SAME buckets/device arrays.
+        Reg-weight-only changes keep the compiled vmapped solver."""
         import copy
 
         if _re_data_key(config) != _re_data_key(self.config):
             raise ValueError("rebind cannot change the data configuration")
         new = copy.copy(self)
         new.config = config
-        new._bind_solver()
+        if new._make_solver_key() != self._solver_key:
+            new._bind_solver()
         return new
 
     def _warm_start(self, bucket_index: int, init: RandomEffectModel) -> np.ndarray:
@@ -488,14 +532,15 @@ class RandomEffectCoordinate(Coordinate):
                 w0 = self._put_entity(np.zeros((b.num_lanes, solve_dim), self._dtype))
             # residual offsets gathered into the bucket layout
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
-            res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"])
+            res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
+                               self.config.reg)
             coeffs.append(res.w)
             results.append(res)
             if variances is not None:
                 # per-entity variances, vmapped over the bucket's lanes
                 # (reference computes them per SingleNodeOptimizationProblem)
                 variances.append(self._vvar(res.w, dev["x"], dev["y"],
-                                            off_b, dev["w"]))
+                                            off_b, dev["w"], self.config.reg))
 
         if self._proj is not None:
             coeffs = self._proj.back_project([np.asarray(c) for c in coeffs])
@@ -547,15 +592,17 @@ class RandomEffectCoordinate(Coordinate):
                     np.zeros((b.num_lanes, self.dim), self._dtype)))
         return tuple(lanes)
 
-    def trace_update(self, state: Tuple[Array, ...], offsets: Array
+    def trace_update(self, state: Tuple[Array, ...], offsets: Array,
+                     reg: Optional[Regularization] = None
                      ) -> Tuple[Tuple[Array, ...], Array]:
         from photon_ml_tpu.parallel.bucketing import score_samples
 
+        reg = self.config.reg if reg is None else reg
         offsets = offsets.astype(self._dtype)
         new_lanes = []
         for lanes, dev in zip(state, self._dev):
             off_b = jnp.where(dev["valid"], offsets[dev["rows"]], 0.0)
-            res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"])
+            res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"], reg)
             new_lanes.append(res.w)
         w_stack = self.trace_publish(tuple(new_lanes))
         score = score_samples(w_stack, self._sample_slots, self._x_full)[: self._n]

@@ -123,7 +123,9 @@ class GameEstimator:
             if fused_ok:
                 from photon_ml_tpu.game.fused import FusedSweep
 
-                key = (tuple((cid, id(coordinates[cid]))
+                # reg weights are traced sweep inputs, so a λ grid over
+                # data/solver-identical coordinates reuses ONE compiled sweep
+                key = (tuple((cid, coordinates[cid].sweep_key())
                              for cid in config.coordinates),
                        config.num_outer_iterations)
                 try:
@@ -138,7 +140,10 @@ class GameEstimator:
                     if self.fused is True:
                         raise
                 else:
-                    model, _scores = sweep.run(initial=warm)
+                    model, _scores = sweep.run(
+                        initial=warm,
+                        regs=[coordinates[cid].config.reg
+                              for cid in config.coordinates])
                     results.append(GameFitResult(model=model, config=config,
                                                  evaluation=None,
                                                  history=DescentHistory()))

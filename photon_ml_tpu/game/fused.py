@@ -63,20 +63,22 @@ class FusedSweep:
         base = jnp.asarray(np.asarray(first._base_offset_host(), self._dtype))
         order, coords = self.order, self.coordinates
 
-        def body(carry, _):
-            states, scores = list(carry[0]), list(carry[1])
-            total = scores[0]
-            for s in scores[1:]:
-                total = total + s
-            for i, cid in enumerate(order):
-                # residual trick (CoordinateDescent.scala:197-204)
-                partial = total - scores[i]
-                states[i], scores[i] = coords[cid].trace_update(
-                    states[i], base + partial)
-                total = partial + scores[i]
-            return (tuple(states), tuple(scores)), None
+        def program(states0, scores0, regs):
+            # regs: per-coordinate Regularization pytree, TRACED — a
+            # reg-weight grid re-enters this one compiled program
+            def body(carry, _):
+                states, scores = list(carry[0]), list(carry[1])
+                total = scores[0]
+                for s in scores[1:]:
+                    total = total + s
+                for i, cid in enumerate(order):
+                    # residual trick (CoordinateDescent.scala:197-204)
+                    partial = total - scores[i]
+                    states[i], scores[i] = coords[cid].trace_update(
+                        states[i], base + partial, reg=regs[i])
+                    total = partial + scores[i]
+                return (tuple(states), tuple(scores)), None
 
-        def program(states0, scores0):
             carry, _ = lax.scan(body, (states0, scores0), None,
                                 length=self.num_iterations)
             states, scores = carry
@@ -100,11 +102,18 @@ class FusedSweep:
                                                       self._dtype)))
         return tuple(states), tuple(scores)
 
-    def run(self, initial: Optional[GameModel] = None
+    def run(self, initial: Optional[GameModel] = None,
+            regs: Optional[Sequence] = None
             ) -> Tuple[GameModel, Dict[str, np.ndarray]]:
-        """One fused descent; returns (model, per-coordinate final scores)."""
+        """One fused descent; returns (model, per-coordinate final scores).
+
+        ``regs``: per-coordinate (order-aligned) Regularization overrides —
+        lets one compiled sweep serve a whole reg-weight grid (the caller
+        typically reads them off rebind-updated configs)."""
         carry = self._cold if initial is None else self._init_carry(initial)
-        published, scores = self._program(*carry)
+        if regs is None:
+            regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
+        published, scores = self._program(*carry, tuple(regs))
         models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
                   for i, cid in enumerate(self.order)}
         final_scores = {cid: np.asarray(scores[i])

@@ -65,6 +65,11 @@ class ShardMapObjective:
     def reg(self):
         return self.obj.reg
 
+    def with_reg(self, reg) -> "ShardMapObjective":
+        """Reg-overridden copy (see GLMObjective.with_reg); used inside a
+        trace, so plain construction is fine."""
+        return ShardMapObjective(self.obj.with_reg(reg), self.mesh, self.axis)
+
     def _specs(self, batch: Batch):
         row_sharded = lambda a: P(self.axis, *([None] * (a.ndim - 1)))
         return jax.tree.map(row_sharded, batch)
