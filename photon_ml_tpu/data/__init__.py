@@ -15,3 +15,4 @@ from photon_ml_tpu.data.synthetic import (  # noqa: F401
     generate_linear,
     generate_glmix,
 )
+from photon_ml_tpu.data.writer import write_game_data_avro  # noqa: F401

@@ -1,0 +1,96 @@
+"""GameData -> TrainingExampleAvro export.
+
+Reference: photon-client data/avro/AvroDataWriter.scala:159 (DataFrame ->
+TrainingExample-style Avro out, re-expanding shard vectors into (name, term,
+value) feature bags through the index maps).
+
+Round-trips with ``data.reader.read_game_data_avro``: features come back
+through the same index maps, id tags through the same entity indexes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from photon_ml_tpu.data import avro as avro_io
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+from photon_ml_tpu.game.data import GameData, SparseShard
+
+
+def write_game_data_avro(
+    data: GameData,
+    path: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    shard: Optional[str] = None,
+) -> int:
+    """Write ``data`` as TrainingExampleAvro records; returns record count.
+
+    ``shard``: which feature shard to expand into the record's feature bag
+    (defaults to the only shard; required when several are present — the
+    reference's writer likewise emits one flattened feature bag).
+    Intercept columns are skipped: readers re-add them from the index map.
+    """
+    entity_indexes = entity_indexes or {}
+    if shard is None:
+        if len(index_maps) != 1:
+            raise ValueError(
+                f"several feature shards {sorted(index_maps)}; pass shard=")
+        shard = next(iter(index_maps))
+    imap = index_maps[shard]
+    x = data.features[shard]
+    intercept = imap.intercept_index
+
+    def feature_bag(i: int) -> list:
+        feats = []
+        if isinstance(x, SparseShard):
+            idxs = np.asarray(x.indices[i])
+            vals = np.asarray(x.values[i])
+            cols = [(int(j), float(v)) for j, v in zip(idxs, vals) if v != 0.0]
+        else:
+            row = np.asarray(x[i])
+            cols = [(int(j), float(row[j])) for j in np.nonzero(row)[0]]
+        for j, v in cols:
+            if j == intercept:
+                continue
+            name_term = imap.get_feature_name(j)
+            if name_term is None:
+                continue
+            feats.append({"name": name_term[0], "term": name_term[1],
+                          "value": v})
+        return feats
+
+    tag_names = {tag: entity_indexes.get(tag) for tag in data.id_tags}
+
+    def records() -> Iterator[dict]:
+        for i in range(data.num_samples):
+            meta = {}
+            for tag, ids in data.id_tags.items():
+                eid = int(ids[i])
+                if eid < 0:
+                    continue
+                eidx = tag_names[tag]
+                name = eidx.name_of(eid) if eidx is not None else None
+                # None-check, not truthiness: "" is a legal entity name
+                meta[tag] = name if name is not None else str(eid)
+            uid = None if data.uids is None else data.uids[i]
+            if uid is not None and not isinstance(uid, (str, int)):
+                # numpy scalars match no Avro union branch
+                uid = int(uid) if np.issubdtype(type(uid), np.integer) else str(uid)
+            yield {
+                "uid": uid,
+                "response": float(data.y[i]),
+                "label": None,
+                "features": feature_bag(i),
+                "weight": float(data.weight[i]),
+                "offset": float(data.offset[i]),
+                "metadataMap": meta or None,
+            }
+
+    n = data.num_samples
+    avro_io.write_container(path, TRAINING_EXAMPLE, records())
+    return n

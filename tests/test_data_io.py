@@ -301,3 +301,69 @@ def test_checkpoint_incremental_and_best(tmp_path, monkeypatch):
     assert best_eval.primary == 0.9 and best_eval.primary_name == "auc"
     np.testing.assert_allclose(best_model["a"].coefficients.means, [1.0, 2.0])
 
+
+
+def test_write_game_data_roundtrip(tmp_path, rng):
+    """write_game_data_avro (reference AvroDataWriter.scala:159) round-trips
+    through read_game_data_avro: same labels/weights/offsets/features/tags."""
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.data.reader import EntityIndex, read_game_data_avro
+    from photon_ml_tpu.data.writer import write_game_data_avro
+    from photon_ml_tpu.game.data import GameData
+
+    n, d = 40, 5
+    imap = IndexMap.from_features([(f"f{j}", "t") for j in range(d)],
+                                  add_intercept=True)
+    x = np.zeros((n, imap.size))
+    x[:, imap.intercept_index] = 1.0
+    dense = rng.normal(size=(n, d)) * (rng.random((n, d)) > 0.4)
+    for j in range(d):
+        x[:, imap.get_index(f"f{j}", "t")] = dense[:, j]
+    eidx = EntityIndex()
+    uid_names = [f"user{k}" for k in range(4)]
+    tag = np.asarray([eidx.get_or_add(uid_names[i % 4]) for i in range(n)])
+    data = GameData(y=(rng.random(n) > 0.5).astype(float),
+                    features={"s": x},
+                    offset=rng.normal(size=n), weight=rng.random(n) + 0.5,
+                    id_tags={"userId": tag},
+                    uids=np.asarray([f"u{i}" for i in range(n)], object))
+
+    path = str(tmp_path / "out.avro")
+    assert write_game_data_avro(data, path, {"s": imap},
+                                {"userId": eidx}) == n
+
+    back, back_idx = read_game_data_avro([path], {"s": imap},
+                                         id_tag_names=["userId"],
+                                         entity_indexes={"userId": EntityIndex()},
+                                         dtype=np.float64)
+    np.testing.assert_allclose(back.y, data.y)
+    np.testing.assert_allclose(back.offset, data.offset, rtol=1e-12)
+    np.testing.assert_allclose(back.weight, data.weight, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(back.features["s"]), x, rtol=1e-12)
+    # entity ids survive by NAME through the fresh index
+    names_out = [back_idx["userId"].name_of(int(e))
+                 for e in back.id_tags["userId"]]
+    names_in = [eidx.name_of(int(e)) for e in tag]
+    assert names_out == names_in
+
+
+def test_write_game_data_numpy_uids_and_empty_names(tmp_path, rng):
+    """numpy-typed uids must encode; an entity literally named "" must not
+    collapse into its integer surrogate."""
+    from photon_ml_tpu.data import avro as avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.data.writer import write_game_data_avro
+    from photon_ml_tpu.game.data import GameData
+
+    imap = IndexMap.from_features([("a", "")], add_intercept=False)
+    eidx = EntityIndex()
+    assert eidx.get_or_add("") == 0  # empty-string entity name
+    data = GameData(y=np.ones(3), features={"s": np.ones((3, 1))},
+                    id_tags={"t": np.zeros(3, np.int64)},
+                    uids=np.arange(3))  # np.int64 uids
+    path = str(tmp_path / "w.avro")
+    write_game_data_avro(data, path, {"s": imap}, {"t": eidx})
+    recs = list(avro_io.read_container(path))
+    assert [r["uid"] for r in recs] == [0, 1, 2]
+    assert all(r["metadataMap"]["t"] == "" for r in recs)
