@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="L2 weight for the diagnostic re-trains")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--top-k", type=int, default=20)
+    p.add_argument("--input-columns", default="",
+                   help="remap reserved input columns (see train driver)")
     return p
 
 
@@ -104,7 +106,11 @@ def run(argv: List[str]) -> int:
     loss = loss_for_task(task)
 
     id_tags = sorted(entity_indexes)
+    from photon_ml_tpu.data.reader import parse_input_columns
+
+    input_columns = parse_input_columns(args.input_columns)
     data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
+                                  input_columns=input_columns,
                                   entity_indexes=entity_indexes)
     batch = _dense_batch(data, shard)
     logger.info("diagnosing coordinate %r on %d samples", cid, data.num_samples)
@@ -150,6 +156,7 @@ def run(argv: List[str]) -> int:
     fit_payload = None
     if args.holdout:
         holdout_data, _ = read_game_data_avro(args.holdout, index_maps,
+                                              input_columns=input_columns,
                                               id_tag_names=id_tags,
                                               entity_indexes=entity_indexes)
         fit = fitting_diagnostic(train_fn, {"mean_loss": point_metric}, batch,

@@ -45,6 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-days-range", default=None,
                    help="START-END days ago (reference DaysRange.scala:28-48)")
     p.add_argument("--error-on-missing-date", action="store_true")
+    p.add_argument("--input-columns", default="",
+                   help="remap reserved input columns (see train driver)")
     return p
 
 
@@ -74,8 +76,11 @@ def run(argv: List[str]) -> int:
     model, task = load_game_model(os.path.join(args.model_dir, "best"),
                                   index_maps, entity_indexes)
     id_tags = sorted(entity_indexes)
+    from photon_ml_tpu.data.reader import parse_input_columns
+
     data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
-                                  entity_indexes=entity_indexes)
+                                  entity_indexes=entity_indexes,
+                                  input_columns=parse_input_columns(args.input_columns))
     logger.info("scoring %d samples", data.num_samples)
 
     tf = GameTransformer(model, task)

@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--input-date-range")
     p.add_argument("--error-on-missing-date", action="store_true",
                    help="fail if any day in range has no data dir")
+    p.add_argument("--input-columns", default="",
+                   help="remap reserved input columns, e.g. "
+                        "'response=clicked,weight=sampleWeight' (reference "
+                        "InputColumnsNames: uid,response,offset,weight,"
+                        "metadataMap,features)")
     p.add_argument("--feature-shards", required=True,
                    help="comma-separated feature shard names")
     p.add_argument("--coordinate", action="append", required=True, dest="coordinates",
@@ -171,10 +176,16 @@ def _run(args, task, t_start, emitter) -> int:
                                               build_index_maps_from_records)
     from photon_ml_tpu.data.native_avro import schema_eligible
 
-    # native columnar path only when EVERY file qualifies — otherwise decode
-    # once through the Python codec and reuse the records for both steps
-    use_native = all(schema_eligible(f) for p in args.train_data
-                     for f in list_avro_files(p))
+    from photon_ml_tpu.data.reader import parse_input_columns
+
+    input_columns = parse_input_columns(args.input_columns)
+
+    # native columnar path only when EVERY file qualifies (and reads the
+    # default reserved column names) — otherwise decode once through the
+    # Python codec and reuse the records for both steps
+    use_native = not input_columns and all(
+        schema_eligible(f) for p in args.train_data
+        for f in list_avro_files(p))
     train_records = None
     if not use_native:
         from photon_ml_tpu.data.avro import read_directory
@@ -201,7 +212,8 @@ def _run(args, task, t_start, emitter) -> int:
     else:
         logger.info("building index maps from training data")
         index_maps = build_index_maps_from_records(
-            train_records, shards, add_intercept=not args.no_intercept)
+            train_records, shards, add_intercept=not args.no_intercept,
+            features_col=input_columns.get("features", "features"))
     for s in shards:
         logger.info("shard %s: %d features", s, index_maps[s].size)
 
@@ -223,7 +235,8 @@ def _run(args, task, t_start, emitter) -> int:
     data, entity_indexes = read_game_data_avro(args.train_data, index_maps,
                                                id_tag_names=id_tags,
                                                records=train_records,
-                                               sparse_shards=sparse_shards)
+                                               sparse_shards=sparse_shards,
+                                               input_columns=input_columns)
     del train_records
     logger.info("train: %d samples", data.num_samples)
     val_data = None
@@ -231,7 +244,8 @@ def _run(args, task, t_start, emitter) -> int:
         val_data, _ = read_game_data_avro(args.validation_data, index_maps,
                                           id_tag_names=id_tags,
                                           entity_indexes=entity_indexes,
-                                          sparse_shards=sparse_shards)
+                                          sparse_shards=sparse_shards,
+                                          input_columns=input_columns)
         logger.info("validation: %d samples", val_data.num_samples)
     from photon_ml_tpu.data.native_avro import clear_columnar_cache
 

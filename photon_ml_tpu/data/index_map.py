@@ -153,6 +153,7 @@ def build_index_maps_from_records(
     records: Iterable[dict],
     shards: Iterable[str],
     add_intercept: bool = True,
+    features_col: str = "features",
 ) -> Dict[str, IndexMap]:
     """Build per-shard IndexMaps from already-decoded TrainingExampleAvro
     records.  The single-bag Avro layout puts every feature in every shard,
@@ -161,7 +162,7 @@ def build_index_maps_from_records(
     reader."""
     seen: set = set()
     for rec in records:
-        for feat in rec.get("features", []):
+        for feat in rec.get(features_col, []):
             seen.add(feature_key(feat["name"], feat.get("term") or ""))
     shared = IndexMap.build(seen, add_intercept)
     return {shard: shared for shard in shards}

@@ -66,6 +66,36 @@ class EntityIndex:
             return cls(json.load(f))
 
 
+#: Logical -> physical record field names (reference InputColumnsNames.scala:
+#: the reserved columns {uid, response, offset, weight, metadataMap} may be
+#: remapped to arbitrary input field names).
+DEFAULT_INPUT_COLUMNS = {
+    "uid": "uid",
+    "response": "response",
+    "offset": "offset",
+    "weight": "weight",
+    "metadataMap": "metadataMap",
+    "features": "features",
+}
+
+
+def parse_input_columns(spec: str) -> Dict[str, str]:
+    """Parse a CLI remap spec 'response=clicked,features=feats' against the
+    reserved logical names; identity entries are dropped (so they don't
+    disable the native fast path)."""
+    out: Dict[str, str] = {}
+    for kv in (spec or "").split(","):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        if k not in DEFAULT_INPUT_COLUMNS or not v:
+            raise SystemExit(f"bad --input-columns entry: {kv!r} "
+                             f"(keys: {sorted(DEFAULT_INPUT_COLUMNS)})")
+        if v != DEFAULT_INPUT_COLUMNS[k]:
+            out[k] = v
+    return out
+
+
 def read_game_data_avro(
     paths: Iterable[str],
     index_maps: Dict[str, IndexMap],
@@ -74,6 +104,7 @@ def read_game_data_avro(
     dtype=np.float32,
     records: Optional[List[dict]] = None,
     sparse_shards: Optional[Iterable[str]] = None,
+    input_columns: Optional[Dict[str, str]] = None,
 ) -> Tuple[GameData, Dict[str, EntityIndex]]:
     """TrainingExampleAvro files -> GameData.
 
@@ -86,12 +117,15 @@ def read_game_data_avro(
     """
     from photon_ml_tpu.data.avro import read_directory
 
+    cols = {**DEFAULT_INPUT_COLUMNS, **(input_columns or {})}
+    default_cols = cols == DEFAULT_INPUT_COLUMNS
     sparse_shards = set(sparse_shards or ())
     if records is None:
-        fast = _read_game_data_columnar(paths, index_maps, id_tag_names,
-                                        entity_indexes, dtype, sparse_shards)
-        if fast is not None:
-            return fast
+        if default_cols:  # the native columnar loader reads reserved names
+            fast = _read_game_data_columnar(paths, index_maps, id_tag_names,
+                                            entity_indexes, dtype, sparse_shards)
+            if fast is not None:
+                return fast
         records = []
         for path in paths:
             records.extend(read_directory(path))
@@ -114,13 +148,13 @@ def read_game_data_avro(
     tags = {tag: np.full(n, -1, np.int64) for tag in id_tag_names}
 
     for i, rec in enumerate(records):
-        uids[i] = rec.get("uid")
-        y[i] = rec["response"]
-        if rec.get("offset") is not None:
-            offset[i] = rec["offset"]
-        if rec.get("weight") is not None:
-            weight[i] = rec["weight"]
-        meta = rec.get("metadataMap") or {}
+        uids[i] = rec.get(cols["uid"])
+        y[i] = rec[cols["response"]]
+        if rec.get(cols["offset"]) is not None:
+            offset[i] = rec[cols["offset"]]
+        if rec.get(cols["weight"]) is not None:
+            weight[i] = rec[cols["weight"]]
+        meta = rec.get(cols["metadataMap"]) or {}
         for tag in id_tag_names:
             if tag in meta:
                 tags[tag][i] = entity_indexes[tag].get_or_add(str(meta[tag]))
@@ -131,7 +165,7 @@ def read_game_data_avro(
             ii = m.intercept_index
             if ii is not None:
                 x[i, ii] = 1.0
-            for feat in rec.get("features", []):
+            for feat in rec.get(cols["features"], []):
                 j = m.get_index(feat["name"], feat.get("term") or "")
                 if j >= 0:
                     x[i, j] += feat["value"]
@@ -140,7 +174,7 @@ def read_game_data_avro(
     for gid, shards_of in groups.items():
         m = group_maps[gid]
         if group_sparse[gid]:
-            sparse = _sparse_from_records(records, m, dtype)
+            sparse = _sparse_from_records(records, m, dtype, cols["features"])
             for shard in shards_of:
                 mats[shard] = sparse
         else:
@@ -164,20 +198,20 @@ def _shard_groups(index_maps, sparse_shards):
     return groups, group_maps, group_sparse
 
 
-def _sparse_from_records(records, m, dtype):
+def _sparse_from_records(records, m, dtype, features_col="features"):
     """Row-padded COO from decoded records (fallback path)."""
     from photon_ml_tpu.game.data import SparseShard
 
     n = len(records)
     ii = m.intercept_index
     extra = 1 if ii is not None else 0
-    k = max((len(r.get("features") or ()) for r in records), default=0) + extra
+    k = max((len(r.get(features_col) or ()) for r in records), default=0) + extra
     k = max(k, 1)
     idx = np.zeros((n, k), np.int32)
     vals = np.zeros((n, k), dtype)
     for i, rec in enumerate(records):
         p = 0
-        for feat in rec.get("features", []):
+        for feat in rec.get(features_col, []):
             j = m.get_index(feat["name"], feat.get("term") or "")
             if j >= 0:
                 idx[i, p] = j

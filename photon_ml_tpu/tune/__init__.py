@@ -17,3 +17,4 @@ from photon_ml_tpu.tune.serialization import (  # noqa: F401
     game_prior_default,
     prior_from_json,
 )
+from photon_ml_tpu.tune.shrink import shrink_search_range  # noqa: F401

@@ -1,0 +1,57 @@
+"""Warm-start search-range shrinking.
+
+Reference: photon-client hyperparameter/ShrinkSearchRange.getBounds:40-100 —
+fit a GP (Matern52) on rescaled prior observations, draw a Sobol candidate
+pool, predict, take the best-predicted point, and return a [best - radius,
+best + radius] box in the unit cube mapped back to real ranges (clamped to
+the original domain).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_ml_tpu.tune.gp import GaussianProcess
+from photon_ml_tpu.tune.kernels import Matern52
+from photon_ml_tpu.tune.search import DomainDim, SearchDomain
+
+
+def shrink_search_range(
+    domain: SearchDomain,
+    prior_observations: Sequence[Tuple[np.ndarray, float]],
+    radius: float = 0.25,
+    minimize: bool = True,
+    candidate_pool_size: int = 1024,
+    seed: int = 0,
+) -> SearchDomain:
+    """New, narrower SearchDomain centered on the GP-predicted best point.
+
+    ``prior_observations``: (real-space params, value) pairs (e.g. from
+    tune/serialization.prior_from_json).  ``radius`` is in the rescaled
+    [0, 1] space, like the reference's.
+    """
+    if not prior_observations:
+        raise ValueError("shrink_search_range needs at least one prior observation")
+    params = np.stack([domain.to_unit(np.asarray(p, float))
+                       for p, _ in prior_observations])
+    values = np.asarray([v if minimize else -v for _, v in prior_observations])
+
+    gp = GaussianProcess(base_kernel=Matern52()).fit(params, values, seed=seed)
+    sobol = qmc.Sobol(domain.d, scramble=True, seed=seed)
+    candidates = sobol.random(candidate_pool_size)
+    mu, _ = gp.predict(candidates)
+    best = candidates[int(np.argmin(mu))]
+
+    lo_unit = np.clip(best - radius, 0.0, 1.0)
+    hi_unit = np.clip(best + radius, 0.0, 1.0)
+    lo = domain.to_real(lo_unit)
+    hi = domain.to_real(hi_unit)
+
+    dims: List[DomainDim] = []
+    for j, dim in enumerate(domain.dims):
+        dims.append(dataclasses.replace(dim, low=float(lo[j]), high=float(hi[j])))
+    return SearchDomain(dims)

@@ -448,3 +448,66 @@ def test_feature_summary_avro_output(tmp_path):
     assert len(recs) >= 4  # g0..g2, ux (+ intercept row if mapped)
     by_name = {r["name"]: r["metrics"] for r in recs}
     assert "g0" in by_name and set(by_name["g0"]) == {"mean", "variance", "absMax"}
+
+
+def test_input_columns_remap(tmp_path):
+    """--input-columns remaps the reserved record fields
+    (reference InputColumnsNames)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    # fixture with custom field names
+    rng = np.random.default_rng(4)
+    schema = {
+        "type": "record", "name": "Custom", "namespace": "x",
+        "fields": [
+            {"name": "clicked", "type": "double"},
+            {"name": "feats", "type": {"type": "array", "items": {
+                "type": "record", "name": "F", "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": "string"},
+                    {"name": "value", "type": "double"}]}}},
+            {"name": "meta", "type": {"type": "map", "values": "string"}},
+        ],
+    }
+    gw = np.asarray([1.0, -1.0])
+    records = []
+    for i in range(200):
+        x = rng.normal(size=2)
+        yv = float(rng.random() < 1 / (1 + np.exp(-x @ gw)))
+        records.append({"clicked": yv,
+                        "feats": [{"name": f"g{j}", "term": "", "value": float(x[j])}
+                                  for j in range(2)],
+                        "meta": {"userId": f"u{i % 3}"}})
+    path = str(tmp_path / "custom.avro")
+    avro_io.write_container(path, schema, records)
+
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", path, "--feature-shards", "all",
+        "--input-columns", "response=clicked,features=feats,metadataMap=meta",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--id-tags", "userId",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["train_samples"] == 200
+
+    # scoring the same remapped data works (cross-driver wiring)
+    from photon_ml_tpu.cli import score as score_cli
+
+    score_out = str(tmp_path / "scores")
+    rc = score_cli.run(["--data", path, "--model-dir", out,
+                        "--input-columns",
+                        "response=clicked,features=feats,metadataMap=meta",
+                        "--evaluators", "auc", "--output-dir", score_out])
+    assert rc == 0
+    metrics = json.load(open(os.path.join(score_out, "metrics.json")))
+    assert metrics["auc"] > 0.6
+
+    # bad key rejected
+    with pytest.raises(SystemExit):
+        train_cli.run(["--train-data", path, "--feature-shards", "all",
+                       "--input-columns", "nope=x",
+                       "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+                       "--output-dir", str(tmp_path / "bad")])

@@ -209,3 +209,38 @@ def test_tuning_with_json_config_and_priors(tmp_path, rng):
     for obs in search.observations[2:]:
         assert 1e-2 <= obs.params[0] <= 1e2  # respects the JSON domain
     assert best.evaluation.values["auc"] > 0.6
+
+
+def test_shrink_search_range():
+    """Reference ShrinkSearchRange.getBounds:40-100: GP on priors -> best
+    Sobol candidate -> [best-r, best+r] box clamped to the original domain."""
+    from photon_ml_tpu.tune.search import DomainDim, SearchDomain
+    from photon_ml_tpu.tune.shrink import shrink_search_range
+
+    dom = SearchDomain([DomainDim("l2", 1e-3, 1e3, log_scale=True),
+                        DomainDim("b", 0.0, 10.0)])
+    # quadratic bowl with minimum at l2=1.0 (unit 0.5), b=2.0 (unit 0.2)
+    priors = []
+    rng = np.random.default_rng(3)
+    for _ in range(25):
+        u = rng.random(2)
+        p = dom.to_real(u)
+        v = (np.log10(p[0])) ** 2 + 0.5 * (p[1] - 2.0) ** 2
+        priors.append((p, float(v)))
+
+    shrunk = shrink_search_range(dom, priors, radius=0.2, seed=0)
+    l2d, bd = shrunk.dims
+    assert l2d.log_scale
+    # the shrunk box contains the optimum and is genuinely narrower
+    assert l2d.low <= 1.0 <= l2d.high
+    assert bd.low <= 2.0 <= bd.high
+    assert np.log(l2d.high / l2d.low) < 0.5 * np.log(1e3 / 1e-3)
+    assert (bd.high - bd.low) < 0.5 * 10.0
+    # clamped inside the original domain
+    assert l2d.low >= 1e-3 - 1e-12 and l2d.high <= 1e3 + 1e-9
+    assert bd.low >= 0.0 and bd.high <= 10.0
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        shrink_search_range(dom, [], radius=0.2)
