@@ -108,7 +108,11 @@ def run(argv: List[str]) -> int:
     id_tags = sorted(entity_indexes)
     from photon_ml_tpu.data.reader import parse_input_columns
 
-    input_columns = parse_input_columns(args.input_columns)
+    try:
+        input_columns = parse_input_columns(args.input_columns)
+    except ValueError as e:
+        logger.error("%s", e)
+        return 1
     data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
                                   input_columns=input_columns,
                                   entity_indexes=entity_indexes)

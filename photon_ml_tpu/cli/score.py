@@ -78,9 +78,14 @@ def run(argv: List[str]) -> int:
     id_tags = sorted(entity_indexes)
     from photon_ml_tpu.data.reader import parse_input_columns
 
+    try:
+        input_columns = parse_input_columns(args.input_columns)
+    except ValueError as e:
+        logger.error("%s", e)
+        return 1
     data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
                                   entity_indexes=entity_indexes,
-                                  input_columns=parse_input_columns(args.input_columns))
+                                  input_columns=input_columns)
     logger.info("scoring %d samples", data.num_samples)
 
     tf = GameTransformer(model, task)

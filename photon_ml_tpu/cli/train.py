@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tuning-iterations", type=int, default=0,
                    help="GP hyperparameter tuning iterations (0 = off)")
     p.add_argument("--tuning-mode", default="bayesian", choices=["bayesian", "random"])
+    p.add_argument("--tuner", default="BUILTIN",
+                   help="DUMMY (no-op), BUILTIN, or module.path:ClassName "
+                        "loaded reflectively (reference "
+                        "HyperparameterTunerFactory.scala:20-48)")
     p.add_argument("--tuning-config", default=None,
                    help="JSON file in the reference HyperparameterSerialization "
                         "format ({tuning_mode, variables:{name:{transform,min,"
@@ -99,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JSON file of prior observations ({records:[{param:"
                         "value,...,evaluationValue:v}]}) seeded into the "
                         "search (reference priorFromJson)")
+    p.add_argument("--tuning-shrink-radius", type=float, default=None,
+                   help="with --tuning-priors: shrink the search domain to a "
+                        "box of this radius (in rescaled [0,1] space) around "
+                        "the GP-predicted best prior point (reference "
+                        "ShrinkSearchRange.getBounds:40-100)")
     p.add_argument("--model-output-mode", default="BEST",
                    choices=["NONE", "BEST", "EXPLICIT", "TUNED", "ALL"],
                    help="which trained models to save (reference "
@@ -178,7 +187,21 @@ def _run(args, task, t_start, emitter) -> int:
 
     from photon_ml_tpu.data.reader import parse_input_columns
 
-    input_columns = parse_input_columns(args.input_columns)
+    try:
+        input_columns = parse_input_columns(args.input_columns)
+    except ValueError as e:
+        logger.error("%s", e)
+        return 1
+    if args.tuning_iterations > 0:
+        # resolve the tuner NOW: a bad --tuner must fail before hours of
+        # grid fitting, not after
+        from photon_ml_tpu.tune.factory import tuner_factory
+
+        try:
+            tuner = tuner_factory(args.tuner)
+        except ValueError as e:
+            logger.error("%s", e)
+            return 1
 
     # native columnar path only when EVERY file qualifies (and reads the
     # default reserved column names) — otherwise decode once through the
@@ -425,8 +448,6 @@ def _run(args, task, t_start, emitter) -> int:
         if val_data is None or suite is None:
             logger.error("tuning requires --validation-data and --evaluators")
             return 1
-        from photon_ml_tpu.tune.game_tuning import tune_game_model
-
         tuning_mode, search_domain, prior_obs = args.tuning_mode, None, None
         unlocked = [c for c in best.config.coordinates if c not in (locked or ())]
         if args.tuning_config:
@@ -445,8 +466,25 @@ def _run(args, task, t_start, emitter) -> int:
             defaults.update({n: "0.0" for n in names})
             with open(args.tuning_priors) as f:
                 prior_obs = prior_from_json(f.read(), defaults, names)
+        if args.tuning_shrink_radius is not None:
+            if not prior_obs:
+                logger.error("--tuning-shrink-radius needs --tuning-priors")
+                return 1
+            from photon_ml_tpu.tune.shrink import shrink_search_range
 
-        _tuned, _search, tuned_results = tune_game_model(
+            if search_domain is None:
+                from photon_ml_tpu.tune.game_tuning import default_l2_domain
+
+                search_domain = default_l2_domain(unlocked)
+            minimize = not suite.primary.larger_is_better
+            search_domain = shrink_search_range(
+                search_domain, prior_obs, radius=args.tuning_shrink_radius,
+                minimize=minimize, seed=args.seed)
+            logger.info("shrunk tuning domain: %s",
+                        [(d.name, round(d.low, 6), round(d.high, 6))
+                         for d in search_domain.dims])
+
+        _tuned, _search, tuned_results = tuner.tune(
             est, best.config, data, val_data,
             n_iterations=args.tuning_iterations,
             mode=tuning_mode, seed=args.seed,
@@ -454,7 +492,8 @@ def _run(args, task, t_start, emitter) -> int:
             locked_coordinates=locked,
             search_domain=search_domain,
             prior_observations=prior_obs)
-        best = est.best(results + tuned_results)
+        if tuned_results:
+            best = est.best(results + tuned_results)
 
     if best.evaluation is not None:
         logger.info("best model validation: %s", best.evaluation.values)

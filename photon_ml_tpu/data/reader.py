@@ -80,19 +80,28 @@ DEFAULT_INPUT_COLUMNS = {
 
 
 def parse_input_columns(spec: str) -> Dict[str, str]:
-    """Parse a CLI remap spec 'response=clicked,features=feats' against the
+    """Parse a remap spec 'response=clicked,features=feats' against the
     reserved logical names; identity entries are dropped (so they don't
-    disable the native fast path)."""
+    disable the native fast path).  Raises ValueError on unknown keys or
+    physical-name collisions (two logical columns reading one field would
+    silently train on the wrong data)."""
     out: Dict[str, str] = {}
     for kv in (spec or "").split(","):
         if not kv:
             continue
         k, _, v = kv.partition("=")
         if k not in DEFAULT_INPUT_COLUMNS or not v:
-            raise SystemExit(f"bad --input-columns entry: {kv!r} "
+            raise ValueError(f"bad input-columns entry: {kv!r} "
                              f"(keys: {sorted(DEFAULT_INPUT_COLUMNS)})")
         if v != DEFAULT_INPUT_COLUMNS[k]:
             out[k] = v
+    merged = {**DEFAULT_INPUT_COLUMNS, **out}
+    seen: Dict[str, str] = {}
+    for k, v in merged.items():
+        if v in seen:
+            raise ValueError(
+                f"input columns {seen[v]!r} and {k!r} both read field {v!r}")
+        seen[v] = k
     return out
 
 

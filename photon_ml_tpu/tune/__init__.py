@@ -18,3 +18,4 @@ from photon_ml_tpu.tune.serialization import (  # noqa: F401
     prior_from_json,
 )
 from photon_ml_tpu.tune.shrink import shrink_search_range  # noqa: F401
+from photon_ml_tpu.tune.factory import BuiltinTuner, DummyTuner, tuner_factory  # noqa: F401

@@ -1,0 +1,64 @@
+"""Pluggable hyperparameter tuners.
+
+Reference: photon-api hyperparameter/tuner/HyperparameterTunerFactory.scala:20-48
+— the tuner implementation is resolved by NAME and loaded reflectively
+(DUMMY = no-op; ATLAS = LinkedIn-internal class not present in the repo).
+Here: DUMMY (no-op), BUILTIN (tune/game_tuning.tune_game_model), or any
+``module.path:ClassName`` whose instances implement ``tune(...)`` with the
+same signature as ``BuiltinTuner.tune``.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import List, Optional, Tuple
+
+DUMMY = "DUMMY"
+BUILTIN = "BUILTIN"
+
+
+class DummyTuner:
+    """No-op (reference DummyTuner.scala): returns no tuned results."""
+
+    def tune(self, estimator, base_config, data, validation_data, **kwargs
+             ) -> Tuple[Optional[object], Optional[object], List[object]]:
+        return None, None, []
+
+
+class BuiltinTuner:
+    """The in-tree Sobol/GP search (tune/game_tuning.tune_game_model)."""
+
+    def tune(self, estimator, base_config, data, validation_data, **kwargs
+             ) -> Tuple[object, object, List[object]]:
+        from photon_ml_tpu.tune.game_tuning import tune_game_model
+
+        return tune_game_model(estimator, base_config, data, validation_data,
+                               **kwargs)
+
+
+def tuner_factory(name: str):
+    """Tuner NAME -> tuner instance (HyperparameterTunerFactory.scala:31-44).
+
+    ``DUMMY`` | ``BUILTIN`` | ``module.path:ClassName`` (reflection-loaded,
+    like the reference's ATLAS hook).
+    """
+    key = (name or BUILTIN).strip()
+    if key.upper() == DUMMY:
+        return DummyTuner()
+    if key.upper() == BUILTIN:
+        return BuiltinTuner()
+    if ":" not in key:
+        raise ValueError(
+            f"unknown tuner {name!r}: use DUMMY, BUILTIN, or module:Class")
+    mod_name, _, cls_name = key.partition(":")
+    try:
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(f"couldn't load tuner {name!r}: {e}") from e
+    try:
+        tuner = cls()
+    except Exception as e:
+        raise ValueError(f"couldn't instantiate tuner {name!r}: {e}") from e
+    if not callable(getattr(tuner, "tune", None)):
+        raise ValueError(f"tuner {name!r} has no tune() method")
+    return tuner

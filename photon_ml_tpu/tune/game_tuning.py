@@ -74,6 +74,19 @@ class GameEstimatorEvaluationFunction:
         return np.asarray([config.coordinates[cid].reg.l2 for cid in self.coordinate_ids])
 
 
+DEFAULT_L2_RANGE = (1e-4, 1e4)
+
+
+def default_l2_domain(coordinate_ids, l2_range=DEFAULT_L2_RANGE) -> SearchDomain:
+    """The standard per-coordinate log-scale L2 search domain (shared by
+    tune_game_model and the driver's shrink branch)."""
+    return SearchDomain([
+        DomainDim(name=f"l2:{cid}", low=l2_range[0], high=l2_range[1],
+                  log_scale=True)
+        for cid in coordinate_ids
+    ])
+
+
 def tune_game_model(
     estimator: GameEstimator,
     base_config: GameConfig,
@@ -81,7 +94,7 @@ def tune_game_model(
     validation_data: GameData,
     n_iterations: int = 10,
     mode: str = "bayesian",  # reference HyperparameterTuningMode {RANDOM, BAYESIAN}
-    l2_range: Tuple[float, float] = (1e-4, 1e4),
+    l2_range: Tuple[float, float] = DEFAULT_L2_RANGE,
     seed: int = 0,
     initial_model=None,
     locked_coordinates=None,
@@ -111,11 +124,7 @@ def tune_game_model(
                 f"{len(fn.coordinate_ids)} tunable coordinates")
         domain = search_domain
     else:
-        domain = SearchDomain([
-            DomainDim(name=f"l2:{cid}", low=l2_range[0], high=l2_range[1],
-                      log_scale=True)
-            for cid in fn.coordinate_ids
-        ])
+        domain = default_l2_domain(fn.coordinate_ids, l2_range)
     minimize = not estimator.validation_suite.primary.larger_is_better
     cls = GaussianProcessSearch if mode == "bayesian" else RandomSearch
     search = cls(domain, minimize=minimize, seed=seed)

@@ -505,9 +505,72 @@ def test_input_columns_remap(tmp_path):
     metrics = json.load(open(os.path.join(score_out, "metrics.json")))
     assert metrics["auc"] > 0.6
 
-    # bad key rejected
-    with pytest.raises(SystemExit):
-        train_cli.run(["--train-data", path, "--feature-shards", "all",
-                       "--input-columns", "nope=x",
-                       "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
-                       "--output-dir", str(tmp_path / "bad")])
+    # bad key and physical-name collisions are rejected with exit code 1
+    base_bad = ["--train-data", path, "--feature-shards", "all",
+                "--coordinate", "name=fixed,feature.shard=all,reg.weights=1"]
+    assert train_cli.run(base_bad + ["--input-columns", "nope=x",
+                                     "--output-dir", str(tmp_path / "bad")]) == 1
+    assert train_cli.run(base_bad + ["--input-columns", "response=weight",
+                                     "--output-dir", str(tmp_path / "bad2")]) == 1
+
+
+def test_tuning_shrink_radius_cli(tmp_path):
+    """--tuning-shrink-radius narrows the search domain around the best
+    prior before tuning (reference ShrinkSearchRange)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    val_path = str(tmp_path / "val.avro")
+    _write_fixture(train_path, n=200, seed=5)
+    _write_fixture(val_path, n=100, seed=6)
+    priors = str(tmp_path / "priors.json")
+    with open(priors, "w") as f:
+        json.dump({"records": [
+            {"l2:fixed": "1.0", "evaluationValue": "0.75"},
+            {"l2:fixed": "100.0", "evaluationValue": "0.55"},
+            {"l2:fixed": "0.01", "evaluationValue": "0.6"},
+        ]}, f)
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--validation-data", val_path,
+        "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--evaluators", "auc",
+        "--tuning-iterations", "2", "--tuning-mode", "random",
+        "--tuning-priors", priors, "--tuning-shrink-radius", "0.15",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    assert os.path.isdir(os.path.join(out, "best"))
+
+    # shrink without priors is rejected
+    assert train_cli.run([
+        "--train-data", train_path, "--validation-data", val_path,
+        "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--evaluators", "auc", "--tuning-iterations", "2",
+        "--tuning-shrink-radius", "0.15",
+        "--output-dir", str(tmp_path / "bad"),
+    ]) == 1
+
+
+def test_dummy_tuner_cli(tmp_path):
+    """--tuner DUMMY: tuning requested but no-op (reference DummyTuner)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    val_path = str(tmp_path / "val.avro")
+    _write_fixture(train_path, n=150, seed=7)
+    _write_fixture(val_path, n=80, seed=8)
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--validation-data", val_path,
+        "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1|10",
+        "--evaluators", "auc", "--tuning-iterations", "5",
+        "--tuner", "DUMMY", "--model-output-mode", "ALL",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    # only the 2 grid models saved: the DUMMY tuner produced none
+    assert sorted(os.listdir(os.path.join(out, "models"))) == ["0", "1"]

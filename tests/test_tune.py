@@ -244,3 +244,41 @@ def test_shrink_search_range():
 
     with pytest.raises(ValueError):
         shrink_search_range(dom, [], radius=0.2)
+
+
+class _RecordingTuner:
+    """Custom tuner for the reflection-loading test."""
+
+    calls = []
+
+    def tune(self, estimator, base_config, data, validation_data, **kwargs):
+        _RecordingTuner.calls.append(kwargs)
+        return None, None, []
+
+
+def test_tuner_factory_dispatch():
+    """Reference HyperparameterTunerFactory.scala:20-48: tuner by name —
+    DUMMY no-op, BUILTIN in-tree, module:Class reflection-loaded."""
+    import pytest
+
+    from photon_ml_tpu.tune.factory import (BuiltinTuner, DummyTuner,
+                                            tuner_factory)
+
+    assert isinstance(tuner_factory("DUMMY"), DummyTuner)
+    assert isinstance(tuner_factory("dummy"), DummyTuner)
+    assert isinstance(tuner_factory("BUILTIN"), BuiltinTuner)
+    assert isinstance(tuner_factory(""), BuiltinTuner)
+
+    t = tuner_factory("test_tune:_RecordingTuner")
+    assert isinstance(t, _RecordingTuner)
+    assert t.tune(None, None, None, None, n_iterations=3) == (None, None, [])
+    assert _RecordingTuner.calls[-1]["n_iterations"] == 3
+
+    assert DummyTuner().tune(None, None, None, None) == (None, None, [])
+
+    with pytest.raises(ValueError):
+        tuner_factory("NOPE")
+    with pytest.raises(ValueError):
+        tuner_factory("no.such.module:Thing")
+    with pytest.raises(ValueError):
+        tuner_factory("collections:OrderedDict")  # loads but has no tune()
