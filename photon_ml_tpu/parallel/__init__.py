@@ -15,3 +15,11 @@ from photon_ml_tpu.parallel.bucketing import (  # noqa: F401
     fit_random_effects,
     score_random_effects,
 )
+from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    global_batch_from_local,
+    global_mesh,
+    initialize,
+    pad_local_rows,
+    padded_per_host_rows,
+    process_row_range,
+)

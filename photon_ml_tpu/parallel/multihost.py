@@ -1,0 +1,161 @@
+"""Multi-host (multi-process) SPMD: initialization, global meshes, and
+process-local data placement.
+
+Reference analog: the Spark driver/executor cluster — Netty RPC broadcast +
+treeAggregate over the cluster network (SURVEY.md §5 "Distributed
+communication backend").  TPU-native shape: every host runs THIS SAME
+program under ``jax.distributed``; collectives ride ICI within a slice and
+DCN across slices, inserted by XLA from the sharding annotations.  There is
+no driver process — the "driver loop" (coordinate descent) runs identically
+on every host, operating on globally-sharded arrays.
+
+Data loading is split by sample id BEFORE reading (each host reads only its
+row range — the reference's executor-partitioned Avro read), PADDED to the
+balanced per-host row count (padding rows carry weight 0, so they are inert
+in every objective/metric), then assembled into global arrays with
+``jax.make_array_from_process_local_data``.
+
+The recipe (each host runs the same code):
+
+    initialize(...)                      # no-op for a single process
+    mesh = global_mesh()
+    rows = padded_per_host_rows(n, mesh)
+    start, stop = process_row_range(n)
+    block = load_rows(start, stop)       # host-local read
+    block = pad_local_rows(block, rows)  # weight column padded with 0
+    g = global_batch_from_local(block, mesh)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS
+
+Array = jax.Array
+logger = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the jax.distributed runtime.
+
+    Explicit ``num_processes <= 1`` is a no-op.  With no arguments,
+    auto-detection is attempted (TPU pods infer everything from the
+    environment); if no cluster environment is found this degenerates to
+    single-process with a log line instead of raising — so the same program
+    runs unchanged on a laptop and on a pod.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (RuntimeError, ValueError) as e:
+        if kwargs:
+            raise  # explicit cluster config that fails must be loud
+        logger.info("no cluster environment detected (%s); running "
+                    "single-process", e)
+
+
+def global_mesh(n_entity: int = 1, n_feature: int = 1) -> Mesh:
+    """A (data, entity, feature) mesh over ALL processes' devices.
+
+    The data axis spans every chip in the job; XLA routes its collectives
+    over ICI within a slice and DCN across slices automatically.
+    """
+    devices = np.asarray(jax.devices())
+    n = len(devices)
+    if n % (n_entity * n_feature):
+        raise ValueError(
+            f"{n} global devices not divisible by entity*feature = "
+            f"{n_entity * n_feature}")
+    arr = devices.reshape(n // (n_entity * n_feature), n_entity, n_feature)
+    return Mesh(arr, (DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS))
+
+
+def process_row_range(n: int,
+                      process_id: Optional[int] = None,
+                      num_processes: Optional[int] = None) -> Tuple[int, int]:
+    """[start, stop) of the global sample rows THIS host should read.
+
+    Contiguous row split by process id; the last host's range is short when
+    ``n`` doesn't divide (pad with ``pad_local_rows`` before assembly).
+    """
+    pid = jax.process_index() if process_id is None else process_id
+    np_ = jax.process_count() if num_processes is None else num_processes
+    if not 0 <= pid < np_:
+        raise ValueError(f"process id {pid} out of range for {np_} processes")
+    per = -(-n // np_)  # ceil: every host but the last reads `per` rows
+    start = min(pid * per, n)
+    stop = min(start + per, n)
+    return start, stop
+
+
+def padded_per_host_rows(n: int, mesh: Mesh,
+                         num_processes: Optional[int] = None) -> int:
+    """Per-host row count every host must pad its block to: ceil(n / hosts)
+    rounded up so each host's rows divide its share of the data axis."""
+    np_ = jax.process_count() if num_processes is None else num_processes
+    per = -(-n // np_)
+    data_size = mesh.shape[DATA_AXIS]
+    if data_size % np_:
+        raise ValueError(
+            f"data axis ({data_size}) must be divisible by the process "
+            f"count ({np_}) — one host cannot own a fraction of a device row")
+    local_devices = data_size // np_
+    return -(-per // local_devices) * local_devices
+
+
+def pad_local_rows(block: Dict[str, np.ndarray], rows: int) -> Dict[str, np.ndarray]:
+    """Zero-pad every column's leading dim to ``rows`` (weight columns pad
+    with 0, making the extra rows inert everywhere)."""
+    out = {}
+    for name, a in block.items():
+        a = np.asarray(a)
+        pad = rows - a.shape[0]
+        if pad < 0:
+            raise ValueError(f"column {name!r} has {a.shape[0]} rows > {rows}")
+        if pad:
+            a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+        out[name] = a
+    return out
+
+
+def global_batch_from_local(
+    local: Dict[str, np.ndarray],
+    mesh: Mesh,
+    specs: Optional[Dict[str, PartitionSpec]] = None,
+) -> Dict[str, Array]:
+    """Host-local row blocks -> globally data-sharded device arrays.
+
+    Every host must pass the same keys with the SAME per-host row count
+    (use ``padded_per_host_rows`` + ``pad_local_rows``); rows concatenate
+    across hosts in process order.  ``specs`` overrides the default
+    row-sharded PartitionSpec per key (e.g. ``{"x": P(DATA_AXIS,
+    FEATURE_AXIS)}`` for a feature-sharded design matrix).
+    """
+    specs = specs or {}
+    n_proc = jax.process_count()
+    out: Dict[str, Array] = {}
+    for name, a in local.items():
+        a = np.asarray(a)
+        spec = specs.get(name,
+                         PartitionSpec(DATA_AXIS, *([None] * (a.ndim - 1))))
+        sharding = NamedSharding(mesh, spec)
+        global_shape = (a.shape[0] * n_proc,) + a.shape[1:]
+        out[name] = jax.make_array_from_process_local_data(
+            sharding, a, global_shape=global_shape)
+    return out

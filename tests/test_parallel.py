@@ -289,3 +289,66 @@ def test_variance_on_mesh_matches_single_device(devices, rng):
             assert model.coefficients.variances is not None
             got[label] = model.coefficients.variances
         np.testing.assert_allclose(got["one"], got["eight"], rtol=1e-3, atol=1e-6)
+
+
+def test_multihost_helpers_single_process(devices):
+    """Multi-host helpers in the 1-process degenerate case: row ranges
+    tile the dataset, the global mesh covers all devices, and
+    host-local -> global assembly yields correctly sharded arrays whose
+    psum matches the local computation."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from photon_ml_tpu.parallel.multihost import (global_batch_from_local,
+                                                  global_mesh,
+                                                  initialize,
+                                                  pad_local_rows,
+                                                  padded_per_host_rows,
+                                                  process_row_range)
+
+    initialize(num_processes=1)  # explicit single-process no-op
+    initialize()  # auto-detect falls back to single-process, never raises
+
+    # row split math for a hypothetical 3-host job (ceil split: 35/35/33)
+    n = 103
+    ranges = [process_row_range(n, pid, 3) for pid in range(3)]
+    assert ranges == [(0, 35), (35, 70), (70, 103)]
+    assert all(b - a <= 35 for a, b in ranges)
+    with pytest.raises(ValueError):
+        process_row_range(n, 5, 3)
+
+    mesh = global_mesh(n_feature=2)
+    assert mesh.shape["data"] * mesh.shape["feature"] == len(jax.devices())
+    with pytest.raises(ValueError):
+        global_mesh(n_entity=3)  # 8 not divisible
+
+    # this process owns ALL rows in a 1-process job
+    start, stop = process_row_range(n)
+    assert (start, stop) == (0, n)
+
+    # balanced padded rows: 103 rows over the 4-device data axis -> 104
+    rows = padded_per_host_rows(n, mesh)
+    assert rows == 104 and rows % mesh.shape["data"] == 0
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 6))
+    y = rng.normal(size=n)
+    w = np.ones(n)
+    block = pad_local_rows({"x": x, "y": y, "weight": w}, rows)
+    assert block["x"].shape == (rows, 6)
+    assert block["weight"][n:].sum() == 0  # padding rows are weight-0
+    with pytest.raises(ValueError):
+        pad_local_rows({"x": x}, n - 1)
+
+    g = global_batch_from_local(block, mesh,
+                                specs={"x": P("data", "feature")})
+    assert g["x"].shape == (rows, 6) and g["y"].shape == (rows,)
+    assert g["x"].sharding.spec == P("data", "feature")
+    assert g["y"].sharding.spec == P("data")
+    x, y = block["x"], block["y"]
+
+    # a jitted global reduction over the sharded arrays matches numpy
+    total = jax.jit(lambda xx, yy: (xx.sum(), (xx.T @ yy)))(g["x"], g["y"])
+    np.testing.assert_allclose(np.asarray(total[0]), x.sum(), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(total[1]), x.T @ y, rtol=1e-6)
