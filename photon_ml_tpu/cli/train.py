@@ -466,10 +466,11 @@ def _run(args, task, t_start, emitter) -> int:
             defaults.update({n: "0.0" for n in names})
             with open(args.tuning_priors) as f:
                 prior_obs = prior_from_json(f.read(), defaults, names)
-        from photon_ml_tpu.tune.factory import DummyTuner
-
-        if args.tuning_shrink_radius is not None and isinstance(tuner, DummyTuner):
-            logger.info("skipping search-range shrink: DUMMY tuner ignores it")
+        # tuners without a search domain (DUMMY and kin) skip the prep work
+        tuner_uses_domain = getattr(tuner, "uses_search_domain", True)
+        if args.tuning_shrink_radius is not None and not tuner_uses_domain:
+            logger.info("skipping search-range shrink: tuner ignores the "
+                        "search domain")
         elif args.tuning_shrink_radius is not None:
             if not prior_obs:
                 logger.error("--tuning-shrink-radius needs --tuning-priors")

@@ -301,6 +301,12 @@ class FixedEffectCoordinate(Coordinate):
         s = self._score(jnp.asarray(np.asarray(model.coefficients.means, self._dtype)))
         return np.asarray(s)[: self._n]
 
+    def tracker_summary(self, tracker) -> dict:
+        """Solver telemetry for the job log (FixedEffectOptimizationTracker)."""
+        from photon_ml_tpu.opt.types import summarize_solver_results
+
+        return summarize_solver_results(tracker)
+
     # --- traceable-step interface (game/fused.py) ---
     # State = transformed-space coefficient vector [d].
 
@@ -619,6 +625,15 @@ class RandomEffectCoordinate(Coordinate):
             w_stack=np.asarray(published), slot_of=dict(self._slot_of),
             random_effect_type=self.config.random_effect_type,
             feature_shard=self.config.feature_shard, task=self.task)
+
+    def tracker_summary(self, trackers) -> dict:
+        """Per-entity solve statistics, padded lanes excluded (reference
+        RandomEffectOptimizationTracker.scala:158 summary over thousands of
+        entity solves)."""
+        from photon_ml_tpu.opt.types import summarize_solver_results
+
+        masks = [np.asarray(b.entity_lanes) >= 0 for b in self.buckets.buckets]
+        return summarize_solver_results(list(trackers), valid_masks=masks)
 
 
 def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfig,

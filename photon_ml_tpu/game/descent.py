@@ -119,6 +119,14 @@ class CoordinateDescent:
                 offsets = coord._base_offset_host() + partial
                 model, _tracker = coord.update(offsets, seed=seed + it,
                                                init=models.get(cid))
+                if logger.isEnabledFor(logging.DEBUG):
+                    # reference logs tracker summaries at debug
+                    # (CoordinateDescent.scala:238-250)
+                    try:
+                        logger.debug("coord %s solvers: %s", cid,
+                                     coord.tracker_summary(_tracker))
+                    except Exception:  # telemetry must never kill training
+                        logger.debug("coord %s: tracker summary unavailable", cid)
                 new_score = np.asarray(coord.score(model))
                 models[cid] = model
                 scores[cid] = new_score

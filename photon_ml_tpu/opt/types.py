@@ -130,3 +130,50 @@ def convergence_check(value, prev_value, init_value, grad_norm, init_grad_norm,
         ),
     )
     return reason.astype(jnp.int32)
+
+
+def summarize_solver_results(results, valid_masks=None) -> dict:
+    """Aggregate statistics over many (possibly vmapped) solver results.
+
+    Reference: RandomEffectOptimizationTracker.scala:158 — thousands of
+    per-entity solves reduce to convergence-reason counts + iteration/loss
+    summary stats for the job log.  ``results``: SolverResult or list of
+    them (each scalar or batched over lanes); ``valid_masks``: per-result
+    boolean lane masks (padded bucket lanes are excluded).
+    """
+    import numpy as np
+
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    its, reasons, values = [], [], []
+    for k, res in enumerate(results):
+        it = np.atleast_1d(np.asarray(res.iterations))
+        rs = np.atleast_1d(np.asarray(res.reason))
+        va = np.atleast_1d(np.asarray(res.value))
+        mask = np.ones(it.shape, bool)
+        if valid_masks is not None and valid_masks[k] is not None:
+            mask = np.atleast_1d(np.asarray(valid_masks[k])).astype(bool)
+        its.append(it[mask])
+        reasons.append(rs[mask])
+        values.append(va[mask])
+    its = np.concatenate(its) if its else np.zeros(0, np.int32)
+    reasons = np.concatenate(reasons) if reasons else np.zeros(0, np.int32)
+    values = np.concatenate(values) if values else np.zeros(0)
+    if len(its) == 0:
+        return {"count": 0}
+    return {
+        "count": int(len(its)),
+        "convergence_reasons": {
+            ConvergenceReason(int(r)).name: int((reasons == r).sum())
+            for r in np.unique(reasons)
+        },
+        "iterations": {
+            "mean": float(its.mean()), "max": int(its.max()),
+            "p50": float(np.percentile(its, 50)),
+            "p90": float(np.percentile(its, 90)),
+        },
+        "final_value": {
+            "mean": float(values.mean()),
+            "max": float(values.max()), "min": float(values.min()),
+        },
+    }

@@ -68,6 +68,8 @@ def replicate(mesh: Mesh) -> NamedSharding:
 
 def _pad_rows(a: np.ndarray, target: int) -> np.ndarray:
     pad = target - a.shape[0]
+    if pad < 0:
+        raise ValueError(f"array has {a.shape[0]} rows > target {target}")
     if pad == 0:
         return a
     return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)

@@ -43,15 +43,17 @@ logger = logging.getLogger(__name__)
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               expected_processes: Optional[int] = None) -> None:
     """Bring up the jax.distributed runtime.
 
     Explicit ``num_processes <= 1`` is a no-op.  With no arguments,
     auto-detection is attempted (TPU pods infer everything from the
     environment); if no cluster environment is found this degenerates to
-    single-process with a log line instead of raising — so the same program
-    runs unchanged on a laptop and on a pod.
-    """
+    single-process — at WARNING level, because on a real pod that means N
+    independent jobs training divergent models.  Pass
+    ``expected_processes`` to turn a short job into a hard error (the
+    recommended pod setting)."""
     if num_processes is not None and num_processes <= 1:
         return
     kwargs = {}
@@ -66,24 +68,33 @@ def initialize(coordinator_address: Optional[str] = None,
     except (RuntimeError, ValueError) as e:
         if kwargs:
             raise  # explicit cluster config that fails must be loud
-        logger.info("no cluster environment detected (%s); running "
-                    "single-process", e)
+        logger.warning("no cluster environment detected (%s); running "
+                       "single-process", e)
+    got = jax.process_count()
+    want = expected_processes if expected_processes is not None else num_processes
+    if want is not None and got != want:
+        raise RuntimeError(
+            f"expected {want} processes but jax.process_count() == {got}: "
+            "the cluster did not form (check coordinator address / pod env)")
 
 
 def global_mesh(n_entity: int = 1, n_feature: int = 1) -> Mesh:
     """A (data, entity, feature) mesh over ALL processes' devices.
 
     The data axis spans every chip in the job; XLA routes its collectives
-    over ICI within a slice and DCN across slices automatically.
+    over ICI within a slice and DCN across slices automatically.  Thin
+    strict wrapper over ``parallel.mesh.make_mesh`` (which would silently
+    truncate a non-dividing remainder).
     """
-    devices = np.asarray(jax.devices())
-    n = len(devices)
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
     if n % (n_entity * n_feature):
         raise ValueError(
             f"{n} global devices not divisible by entity*feature = "
             f"{n_entity * n_feature}")
-    arr = devices.reshape(n // (n_entity * n_feature), n_entity, n_feature)
-    return Mesh(arr, (DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS))
+    return make_mesh(n_data=n // (n_entity * n_feature),
+                     n_entity=n_entity, n_feature=n_feature)
 
 
 def process_row_range(n: int,
@@ -122,16 +133,9 @@ def padded_per_host_rows(n: int, mesh: Mesh,
 def pad_local_rows(block: Dict[str, np.ndarray], rows: int) -> Dict[str, np.ndarray]:
     """Zero-pad every column's leading dim to ``rows`` (weight columns pad
     with 0, making the extra rows inert everywhere)."""
-    out = {}
-    for name, a in block.items():
-        a = np.asarray(a)
-        pad = rows - a.shape[0]
-        if pad < 0:
-            raise ValueError(f"column {name!r} has {a.shape[0]} rows > {rows}")
-        if pad:
-            a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-        out[name] = a
-    return out
+    from photon_ml_tpu.parallel.mesh import _pad_rows
+
+    return {name: _pad_rows(np.asarray(a), rows) for name, a in block.items()}
 
 
 def global_batch_from_local(

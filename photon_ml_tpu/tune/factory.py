@@ -20,6 +20,9 @@ BUILTIN = "BUILTIN"
 class DummyTuner:
     """No-op (reference DummyTuner.scala): returns no tuned results."""
 
+    #: capability flag the driver checks before doing search-domain prep work
+    uses_search_domain = False
+
     def tune(self, estimator, base_config, data, validation_data, **kwargs
              ) -> Tuple[Optional[object], Optional[object], List[object]]:
         return None, None, []
@@ -27,6 +30,8 @@ class DummyTuner:
 
 class BuiltinTuner:
     """The in-tree Sobol/GP search (tune/game_tuning.tune_game_model)."""
+
+    uses_search_domain = True
 
     def tune(self, estimator, base_config, data, validation_data, **kwargs
              ) -> Tuple[object, object, List[object]]:

@@ -262,3 +262,59 @@ def test_train_glm_reg_path_warm_start_model(rng):
     # both converge to the same optimum; warm start just changes the route
     np.testing.assert_allclose(path[0][1].coefficients.means,
                                path0[0][1].coefficients.means, atol=1e-4)
+
+
+def test_summarize_solver_results(rng):
+    """Reference RandomEffectOptimizationTracker summary: reason counts +
+    iteration/value stats over many (vmapped) solves, masked lanes excluded."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.opt.types import SolverResult, summarize_solver_results
+    from photon_ml_tpu.types import ConvergenceReason
+
+    batched = SolverResult(
+        w=jnp.zeros((4, 3)),
+        value=jnp.asarray([1.0, 2.0, 3.0, 99.0]),
+        grad_norm=jnp.zeros(4),
+        iterations=jnp.asarray([5, 7, 9, 100], jnp.int32),
+        reason=jnp.asarray([ConvergenceReason.GRADIENT_CONVERGED,
+                            ConvergenceReason.GRADIENT_CONVERGED,
+                            ConvergenceReason.MAX_ITERATIONS,
+                            ConvergenceReason.MAX_ITERATIONS], jnp.int32),
+    )
+    # last lane is padding -> excluded
+    s = summarize_solver_results([batched],
+                                 valid_masks=[np.asarray([1, 1, 1, 0], bool)])
+    assert s["count"] == 3
+    assert s["convergence_reasons"] == {"GRADIENT_CONVERGED": 2,
+                                        "MAX_ITERATIONS": 1}
+    assert s["iterations"]["max"] == 9
+    np.testing.assert_allclose(s["iterations"]["mean"], 7.0)
+    np.testing.assert_allclose(s["final_value"]["mean"], 2.0)
+
+    assert summarize_solver_results([])["count"] == 0
+
+
+def test_re_coordinate_tracker_summary(rng):
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import GameData, RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import TaskType
+
+    n_users, per = 7, 30
+    n = n_users * per
+    x = rng.normal(size=(n, 3))
+    y = (rng.random(n) < 0.5).astype(float)
+    uids = np.repeat(np.arange(n_users), per)
+    data = GameData(y=y, features={"u": x}, id_tags={"uid": uids})
+    coord = build_coordinate(
+        "re", data,
+        RandomEffectConfig(random_effect_type="uid", feature_shard="u",
+                           solver=SolverConfig(max_iters=50),
+                           reg=Regularization(l2=1.0)),
+        TaskType.LOGISTIC_REGRESSION)
+    _, trackers = coord.update(np.zeros(n))
+    s = coord.tracker_summary(trackers)
+    assert s["count"] == n_users  # padded lanes excluded
+    assert sum(s["convergence_reasons"].values()) == n_users
