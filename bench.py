@@ -9,14 +9,26 @@ numpy/scipy implementation of the same training loop run on this machine —
 the stand-in for the reference's Spark-CPU execution model (single-node
 local[*] is also how the reference's own regression baselines were captured,
 GameTrainingDriverIntegTest.scala:79-80).
+
+Two accelerator implementations of the identical training semantics:
+  fused — the whole coordinate-descent sweep as ONE jitted scan program
+          (game/fused.FusedSweep), no host round-trips; tried first, in a
+          watchdog subprocess so a pathological compile/backend hang falls
+          back instead of wedging the bench;
+  host  — the host-paced CoordinateDescent loop (one dispatch per phase).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+OUTER = 2
 
 
 def _synth(rng, n_users=512, per_user=256, d_global=128, d_user=16, dtype=np.float32):
@@ -32,13 +44,9 @@ def _synth(rng, n_users=512, per_user=256, d_global=128, d_user=16, dtype=np.flo
     return xg[perm], xu[perm], uids[perm], y[perm]
 
 
-def bench_tpu(xg, xu, uids, y, outer_iters=2):
-    """Steady-state training throughput: coordinates (device data layout +
-    jitted solvers) are built once; we time full coordinate-descent sweeps —
-    the analog of timing the reference's training loop after the RDDs are
-    materialized (not the Avro load)."""
+def _build_coordinates(xg, xu, uids, y):
     from photon_ml_tpu.core.regularization import Regularization
-    from photon_ml_tpu.game import CoordinateDescent, FixedEffectConfig, GameData, RandomEffectConfig
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, RandomEffectConfig
     from photon_ml_tpu.game.coordinate import build_coordinate
     from photon_ml_tpu.opt.types import SolverConfig
     from photon_ml_tpu.types import TaskType
@@ -46,7 +54,7 @@ def bench_tpu(xg, xu, uids, y, outer_iters=2):
     data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
     solver = SolverConfig(max_iters=30, tolerance=1e-7)
     task = TaskType.LOGISTIC_REGRESSION
-    coords = {
+    return {
         "fixed": build_coordinate(
             "fixed", data, FixedEffectConfig(feature_shard="g", solver=solver,
                                              reg=Regularization(l2=1.0)), task),
@@ -55,15 +63,31 @@ def bench_tpu(xg, xu, uids, y, outer_iters=2):
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
                                solver=solver, reg=Regularization(l2=1.0)), task),
     }
-    descent = CoordinateDescent(coords, num_iterations=outer_iters)
+
+
+def bench_accel(xg, xu, uids, y, impl: str):
+    """Steady-state training seconds for OUTER full coordinate-descent
+    sweeps (device layout + compiles excluded via one warm-up run) — the
+    analog of timing the reference's training loop after RDDs materialize."""
+    coords = _build_coordinates(xg, xu, uids, y)
+    if impl == "fused":
+        from photon_ml_tpu.game.fused import FusedSweep
+
+        sweep = FusedSweep(coords, num_iterations=OUTER)
+        sweep.run()  # warm-up: compiles the whole-descent program once
+        t0 = time.perf_counter()
+        sweep.run()
+        return time.perf_counter() - t0
+    from photon_ml_tpu.game import CoordinateDescent
+
+    descent = CoordinateDescent(coords, num_iterations=OUTER)
     descent.run()  # warm-up: compiles every solver once
     t0 = time.perf_counter()
-    model, _, _ = descent.run()
-    dt = time.perf_counter() - t0
-    return dt, model
+    descent.run()
+    return time.perf_counter() - t0
 
 
-def bench_cpu_reference(xg, xu, uids, y, outer_iters=2, l2=1.0):
+def bench_cpu_reference(xg, xu, uids, y, l2=1.0):
     """Spark-CPU stand-in: scipy L-BFGS fixed effect + per-user serial scipy
     solves, same residual coordinate-descent loop."""
     import scipy.optimize as sopt
@@ -87,7 +111,7 @@ def bench_cpu_reference(xg, xu, uids, y, outer_iters=2, l2=1.0):
     fixed_scores = np.zeros(n)
     rand_scores = np.zeros(n)
     t0 = time.perf_counter()
-    for _ in range(outer_iters):
+    for _ in range(OUTER):
         off = rand_scores
         r = sopt.minimize(nll, wg, jac=grad, args=(xg, y, off), method="L-BFGS-B",
                           options={"maxiter": 30})
@@ -103,17 +127,46 @@ def bench_cpu_reference(xg, xu, uids, y, outer_iters=2, l2=1.0):
     return time.perf_counter() - t0
 
 
+def _accel_seconds(data=None):
+    """(dt of the preferred accelerator impl, dataset) — fused first (in a
+    watchdog subprocess that synthesizes its own copy), host loop inline as
+    fallback.  ``data`` lets the caller pass pre-synthesized arrays for the
+    inline paths."""
+    impl = os.environ.get("PHOTON_BENCH_IMPL")
+    if impl in ("fused", "host"):
+        data = data if data is not None else _synth(np.random.default_rng(42))
+        return bench_accel(*data, impl), data
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--impl", "fused"],
+            capture_output=True, text=True, timeout=1500, cwd=os.path.dirname(
+                os.path.abspath(__file__)))
+        if out.returncode == 0:
+            dt = json.loads(out.stdout.strip().splitlines()[-1])["dt"]
+            return dt, data
+        sys.stderr.write(f"fused bench failed (rc {out.returncode}); "
+                         f"falling back to host loop\n{out.stderr[-2000:]}\n")
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError) as e:
+        sys.stderr.write(f"fused bench unusable ({e}); host-loop fallback\n")
+    data = data if data is not None else _synth(np.random.default_rng(42))
+    return bench_accel(*data, "host"), data
+
+
 def main():
-    rng = np.random.default_rng(42)
-    xg, xu, uids, y = _synth(rng)
+    if len(sys.argv) >= 3 and sys.argv[1] == "--impl":
+        dt = bench_accel(*_synth(np.random.default_rng(42)), sys.argv[2])
+        print(json.dumps({"dt": dt}))
+        return
+
+    dt_accel, data = _accel_seconds()
+    if data is None:  # subprocess path: only the CPU reference needs arrays
+        data = _synth(np.random.default_rng(42))
+    xg, xu, uids, y = data
     n = len(y)
-    outer = 2
+    examples_per_sec = n * OUTER / dt_accel
 
-    dt_tpu, _ = bench_tpu(xg, xu, uids, y, outer)
-    examples_per_sec = n * outer / dt_tpu
-
-    dt_cpu = bench_cpu_reference(xg, xu, uids, y, outer)
-    speedup = dt_cpu / dt_tpu
+    dt_cpu = bench_cpu_reference(xg, xu, uids, y)
+    speedup = dt_cpu / dt_accel
 
     print(json.dumps({
         "metric": "glmix_2coord_examples_per_sec_per_chip",

@@ -55,6 +55,10 @@ def initialize(coordinator_address: Optional[str] = None,
     ``expected_processes`` to turn a short job into a hard error (the
     recommended pod setting)."""
     if num_processes is not None and num_processes <= 1:
+        if expected_processes is not None and expected_processes != num_processes:
+            raise RuntimeError(
+                f"expected {expected_processes} processes but launched with "
+                f"num_processes={num_processes}")
         return
     kwargs = {}
     if coordinator_address is not None:
@@ -135,7 +139,13 @@ def pad_local_rows(block: Dict[str, np.ndarray], rows: int) -> Dict[str, np.ndar
     with 0, making the extra rows inert everywhere)."""
     from photon_ml_tpu.parallel.mesh import _pad_rows
 
-    return {name: _pad_rows(np.asarray(a), rows) for name, a in block.items()}
+    out = {}
+    for name, a in block.items():
+        try:
+            out[name] = _pad_rows(np.asarray(a), rows)
+        except ValueError as e:
+            raise ValueError(f"column {name!r}: {e}") from e
+    return out
 
 
 def global_batch_from_local(
