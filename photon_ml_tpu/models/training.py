@@ -115,3 +115,47 @@ def train_glm_reg_path(
             task=task)))
         trackers[lam] = res
     return path, trackers
+
+
+def select_best_glm(
+    path: List[Tuple[float, GLMModel]],
+    x_val: np.ndarray,
+    y_val: np.ndarray,
+    metric: Optional[str] = None,
+    offset: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+) -> Tuple[float, GLMModel]:
+    """Best (λ, model) on validation data — the legacy driver's model
+    selection (reference ModelSelection.scala:29-92: AUC for classifiers,
+    RMSE for linear regression, Poisson loss for Poisson models; the
+    task-default metric applies unless ``metric`` overrides it).
+    """
+    from photon_ml_tpu.evaluation.evaluator import make_evaluator
+
+    if not path:
+        raise ValueError("empty regularization path")
+    task = path[0][1].task
+    if metric is None:
+        if task == TaskType.NONE:
+            raise ValueError("task NONE has no default metric; pass metric=")
+        metric = {
+            TaskType.LOGISTIC_REGRESSION: "auc",
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "auc",
+            TaskType.LINEAR_REGRESSION: "rmse",
+            TaskType.POISSON_REGRESSION: "poisson_loss",
+        }[task]
+    evaluator = make_evaluator(metric)
+    x_val = np.asarray(x_val)
+    y_val = np.asarray(y_val)
+    n = len(y_val)
+    offset = np.zeros(n) if offset is None else np.asarray(offset)
+    weight = np.ones(n) if weight is None else np.asarray(weight)
+
+    best: Optional[Tuple[float, GLMModel, float]] = None
+    for lam, model in path:
+        scores = np.asarray(model.score(x_val)) + offset
+        value = float(np.asarray(
+            evaluator.evaluate(scores, y_val, weight)))
+        if best is None or evaluator.better_than(value, best[2]):
+            best = (lam, model, value)
+    return best[0], best[1]

@@ -318,3 +318,23 @@ def test_re_coordinate_tracker_summary(rng):
     s = coord.tracker_summary(trackers)
     assert s["count"] == n_users  # padded lanes excluded
     assert sum(s["convergence_reasons"].values()) == n_users
+
+
+def test_select_best_glm(rng):
+    """Reference ModelSelection.scala: best λ on validation by the
+    task-default metric (AUC for classifiers)."""
+    from photon_ml_tpu.models.training import select_best_glm, train_glm_reg_path
+    from photon_ml_tpu.types import TaskType
+
+    x = rng.normal(size=(600, 5))
+    w = rng.normal(size=5) * 2
+    y = (rng.random(600) < 1.0 / (1.0 + np.exp(-x @ w))).astype(float)
+    path, _ = train_glm_reg_path(x[:400], y[:400], TaskType.LOGISTIC_REGRESSION,
+                                 [0.01, 1.0, 1000.0], dtype=np.float64)
+    lam, model = select_best_glm(path, x[400:], y[400:])
+    assert lam != 1000.0  # the crushed model can't win on AUC
+    # metric override: logistic loss picks a (possibly different) minimum
+    lam2, _ = select_best_glm(path, x[400:], y[400:], metric="logistic_loss")
+    assert lam2 in (0.01, 1.0)
+    with pytest.raises(ValueError):
+        select_best_glm([], x, y)
