@@ -69,6 +69,9 @@ def bench_accel(xg, xu, uids, y, impl: str):
     """Steady-state training seconds for OUTER full coordinate-descent
     sweeps (device layout + compiles excluded via one warm-up run) — the
     analog of timing the reference's training loop after RDDs materialize."""
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     coords = _build_coordinates(xg, xu, uids, y)
     if impl == "fused":
         from photon_ml_tpu.game.fused import FusedSweep

@@ -87,6 +87,10 @@ def _dense_batch(data, shard: str) -> DenseBatch:
 def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     model, task, index_maps, entity_indexes = _load_dir(args.model_dir)
 
     from photon_ml_tpu.models.game import FixedEffectModel

@@ -47,12 +47,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--error-on-missing-date", action="store_true")
     p.add_argument("--input-columns", default="",
                    help="remap reserved input columns (see train driver)")
+    p.add_argument("--log-data-and-model-stats", action="store_true",
+                   help="log summaries of the model and scoring data "
+                        "(reference GameScoringDriver logDataAndModelStats)")
     return p
 
 
 def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from photon_ml_tpu.utils.dates import input_paths_within_date_range, resolve_range
 
@@ -87,6 +94,25 @@ def run(argv: List[str]) -> int:
                                   entity_indexes=entity_indexes,
                                   input_columns=input_columns)
     logger.info("scoring %d samples", data.num_samples)
+    if args.log_data_and_model_stats:
+        # reference logDataAndModelStats: toSummaryString dumps of the model
+        # and the prepared dataset
+        for cid, m in model.models.items():
+            if hasattr(m, "w_stack"):
+                logger.info("model %s: random effect %s, %d entities x %d "
+                            "features", cid, m.random_effect_type,
+                            m.w_stack.shape[0], m.w_stack.shape[1])
+            else:
+                logger.info("model %s: fixed effect, %d features", cid,
+                            len(m.coefficients.means))
+        y = np.asarray(data.y, float)
+        logger.info("data: %d samples, mean response %.6f, %d feature "
+                    "shard(s)", data.num_samples, float(y.mean()),
+                    len(data.features))
+        for tag, ids in data.id_tags.items():
+            known = int((np.asarray(ids) >= 0).sum())
+            logger.info("data: id tag %s covers %d/%d samples", tag, known,
+                        data.num_samples)
 
     tf = GameTransformer(model, task)
     # One scoring pass; the inverse-link mean is a pointwise function of the

@@ -138,6 +138,10 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     t_start = time.time()
     task = TaskType[args.task]
 

@@ -124,3 +124,17 @@ def test_input_paths_within_date_range(tmp_path):
     with pytest.raises(FileNotFoundError):  # no day at all in range
         input_paths_within_date_range([str(base)], DateRange.from_string(
             "20180101-20180102"))
+
+
+def test_compilation_cache_setup(tmp_path, monkeypatch):
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    d = str(tmp_path / "cache")
+    assert enable_compilation_cache(d) == d
+    import os
+
+    assert os.path.isdir(d)
+    monkeypatch.setenv("PHOTON_COMPILE_CACHE", "0")
+    assert enable_compilation_cache() is None
+    monkeypatch.setenv("PHOTON_COMPILE_CACHE", str(tmp_path / "env"))
+    assert enable_compilation_cache() == str(tmp_path / "env")
