@@ -37,6 +37,9 @@ class CoordinateSpec:
     reg_type: RegularizationType
     alpha: float
     template: CoordinateConfig  # reg filled per grid point
+    # path of a JSON file {entityName: l2Multiplier}; the train driver
+    # translates names -> ids once the entity index exists
+    per_entity_l2_file: "str | None" = None
 
     def with_weight(self, w: float) -> CoordinateConfig:
         reg = Regularization.from_context(self.reg_type, w, self.alpha)
@@ -93,10 +96,12 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
                              if "intercept.index" in kv else None),
             variance=variance,
         )
+        per_entity_file = kv.pop("per.entity.l2.multipliers", None)
         for consumed in ("active.data.upper.bound", "projected.dim",
                          "features.to.samples.ratio", "intercept.index"):
             kv.pop(consumed, None)
     else:
+        per_entity_file = None
         template = FixedEffectConfig(
             feature_shard=shard,
             optimizer=optimizer,
@@ -107,7 +112,8 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     if kv:
         raise ValueError(f"unknown coordinate spec keys for {name!r}: {sorted(kv)}")
     return CoordinateSpec(name=name, reg_weights=weights, reg_type=reg_type,
-                          alpha=alpha, template=template)
+                          alpha=alpha, template=template,
+                          per_entity_l2_file=per_entity_file)
 
 
 def expand_game_configs(specs: List[CoordinateSpec], task: TaskType,

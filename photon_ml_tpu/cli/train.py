@@ -180,6 +180,30 @@ def _run(args, task, t_start, emitter) -> int:
     id_tags = [s for s in args.id_tags.split(",") if s]
     specs = [parse_coordinate_spec(s) for s in args.coordinates]
 
+    # per-entity L2 multiplier files: validate and parse NOW — a bad path or
+    # value must fail before hours of data loading (same early-failure rule
+    # as the tuner resolution above)
+    mult_by_spec = {}
+    for i, spec in enumerate(specs):
+        if spec.per_entity_l2_file is None:
+            continue
+        try:
+            with open(spec.per_entity_l2_file) as f:
+                raw = json.load(f)
+            parsed = {}
+            for name, m in raw.items():
+                m = float(m)
+                if not (m >= 0.0) or not np.isfinite(m):
+                    raise ValueError(
+                        f"entity {name!r}: multiplier {m} must be finite "
+                        "and >= 0 (negative L2 is unbounded)")
+                parsed[str(name)] = m
+            mult_by_spec[i] = parsed
+        except (OSError, ValueError, TypeError, json.JSONDecodeError) as e:
+            logger.error("coordinate %s per-entity multipliers (%s): %s",
+                         spec.name, spec.per_entity_l2_file, e)
+            return 1
+
     # 1. index maps + training data.  Native loader (native/avro_loader.cpp):
     # columnar decode, no per-record Python objects — index maps and design
     # matrices both come from interned columnar buffers.  Python fallback:
@@ -331,6 +355,37 @@ def _run(args, task, t_start, emitter) -> int:
             }
         logger.info("normalization %s over %d shard(s)", kind.name, len(normalization))
 
+    # per-entity L2 multipliers: entity NAMES in the JSON file resolve
+    # through the entity index built from the data (beyond-reference
+    # feature; RandomEffectOptimizationProblem.scala:42 only envisioned
+    # per-entity lambdas)
+    import dataclasses as _dc
+
+    for i, spec in enumerate(specs):
+        if i not in mult_by_spec:
+            continue
+        re_type = spec.template.random_effect_type
+        eidx = entity_indexes.get(re_type)
+        if eidx is None:
+            logger.error("per-entity multipliers for %r need id tag %r in "
+                         "--id-tags", spec.name, re_type)
+            return 1
+        mult = {}
+        missing = 0
+        for name, m in mult_by_spec[i].items():
+            eid = eidx.get(name)
+            if eid < 0:
+                missing += 1
+                continue
+            mult[eid] = m
+        if missing:
+            logger.warning("coordinate %s: %d multiplier entities not in "
+                           "training data (ignored)", spec.name, missing)
+        specs[i] = _dc.replace(spec, template=_dc.replace(
+            spec.template, per_entity_l2_multipliers=mult))
+        logger.info("coordinate %s: per-entity L2 multipliers for %d "
+                    "entities", spec.name, len(mult))
+
     # 5. config grid (reference prepareGameOptConfigs) + fit
     configs = expand_game_configs(specs, task, args.coordinate_descent_iterations)
     if normalization:
@@ -399,6 +454,9 @@ def _run(args, task, t_start, emitter) -> int:
         # updates, best-metric comparisons across different primaries, or a
         # cursor applied to different data).
         fp_src = json.dumps({"coordinates": args.coordinates, "task": args.task,
+                             "per_entity_multipliers": {
+                                 str(i): sorted(d.items())
+                                 for i, d in mult_by_spec.items()},
                              "iterations": args.coordinate_descent_iterations,
                              "seed": args.seed,
                              "train_data": sorted(args.train_data),

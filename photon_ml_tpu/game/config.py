@@ -53,6 +53,23 @@ class RandomEffectConfig:
     features_to_samples_ratio: Optional[float] = None  # per-entity Pearson top-k cap
     intercept_index: Optional[int] = None  # column the Pearson filter must keep
     variance: VarianceComputationType = VarianceComputationType.NONE
+    # Per-entity regularization: multiplicative factors on this coordinate's
+    # L2 weight, keyed by entity id (the reference ENVISIONED per-entity λ —
+    # RandomEffectOptimizationProblem.scala:42 keeps one problem per entity
+    # for exactly this — but never implemented it).  Multiplicative so a
+    # tuned/grid L2 scales every entity while relative strengths persist.
+    # Accepts a dict; stored canonically as a sorted tuple of pairs.
+    per_entity_l2_multipliers: "Optional[tuple]" = None
+
+    def __post_init__(self):
+        m = self.per_entity_l2_multipliers
+        if isinstance(m, dict):
+            object.__setattr__(self, "per_entity_l2_multipliers",
+                               tuple(sorted((int(k), float(v))
+                                            for k, v in m.items())))
+        elif m is not None:
+            object.__setattr__(self, "per_entity_l2_multipliers",
+                               tuple(sorted((int(k), float(v)) for k, v in m)))
 
 
 CoordinateConfig = Union[FixedEffectConfig, RandomEffectConfig]

@@ -427,6 +427,7 @@ class RandomEffectCoordinate(Coordinate):
             solve_buckets = self._proj.buckets
 
         self._bind_solver()
+        self._refresh_lane_mult()
 
         # Device-resident bucket arrays, entity lane sharded over ALL mesh
         # devices (the reference's balanced entity partitioner,
@@ -451,13 +452,14 @@ class RandomEffectCoordinate(Coordinate):
         self._objective = objective
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
 
-        # reg traced (broadcast over lanes): λ sweeps reuse this compilation
+        # reg traced PER LANE (vmapped like the data): λ sweeps reuse this
+        # compilation, and per-entity regularization costs nothing extra
         def _vsolve(w0, x_b, y_b, off_b, wt_b, reg):
             return jax.vmap(
-                lambda w, xx, yy, oo, ww: solve(
+                lambda w, xx, yy, oo, ww, rr: solve(
                     w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
-                    objective=objective.with_reg(reg))
-            )(w0, x_b, y_b, off_b, wt_b)
+                    objective=objective.with_reg(rr))
+            )(w0, x_b, y_b, off_b, wt_b, reg)
 
         self._vsolve = jax.jit(_vsolve)
 
@@ -472,10 +474,10 @@ class RandomEffectCoordinate(Coordinate):
 
             def _vvar(w_b, x_b, y_b, off_b, wt_b, reg):
                 return jax.vmap(
-                    lambda w, xx, yy, oo, ww: compute_variances(
-                        objective.with_reg(reg), w,
+                    lambda w, xx, yy, oo, ww, rr: compute_variances(
+                        objective.with_reg(rr), w,
                         DenseBatch(x=xx, y=yy, offset=oo, weight=ww), kind)
-                )(w_b, x_b, y_b, off_b, wt_b)
+                )(w_b, x_b, y_b, off_b, wt_b, reg)
 
             self._vvar = jax.jit(_vvar)
         else:
@@ -485,6 +487,28 @@ class RandomEffectCoordinate(Coordinate):
     def _make_solver_key(self) -> tuple:
         c = self.config
         return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance)
+
+    def _refresh_lane_mult(self) -> None:
+        """Cache per-bucket (ones, multiplier) lane vectors — constant per
+        config, rebuilt only when the config changes (rebind)."""
+        mult = dict(self.config.per_entity_l2_multipliers or ())
+        self._lane_mult = []
+        for b in self.buckets.buckets:
+            ones = jnp.ones(b.num_lanes, self._dtype)
+            if mult:
+                m = jnp.asarray(np.asarray(
+                    [mult.get(int(e), 1.0) for e in b.entity_lanes],
+                    self._dtype))
+            else:
+                m = ones
+            self._lane_mult.append((ones, m))
+
+    def _lane_regs(self, reg: Regularization) -> List[Regularization]:
+        """Per-bucket per-lane Regularization pytrees: the scalar (possibly
+        traced) ``reg`` broadcast over lanes, L2 scaled by the per-entity
+        multipliers (default 1; padded lanes get 1, they're inert anyway)."""
+        return [Regularization(l1=reg.l1 * ones, l2=reg.l2 * m)
+                for ones, m in self._lane_mult]
 
     def data_key(self) -> tuple:
         return _re_data_key(self.config)
@@ -500,6 +524,8 @@ class RandomEffectCoordinate(Coordinate):
         new.config = config
         if new._make_solver_key() != self._solver_key:
             new._bind_solver()
+        if config.per_entity_l2_multipliers != self.config.per_entity_l2_multipliers:
+            new._refresh_lane_mult()
         return new
 
     def _warm_start(self, bucket_index: int, init: RandomEffectModel) -> np.ndarray:
@@ -530,6 +556,7 @@ class RandomEffectCoordinate(Coordinate):
         coeffs = []
         variances = [] if self._vvar is not None else None
         results = []
+        lane_regs = self._lane_regs(self.config.reg)
         for bi, (b, dev) in enumerate(zip(self.buckets.buckets, self._dev)):
             solve_dim = dev["x"].shape[2]
             if init is not None:
@@ -539,14 +566,14 @@ class RandomEffectCoordinate(Coordinate):
             # residual offsets gathered into the bucket layout
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
             res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
-                               self.config.reg)
+                               lane_regs[bi])
             coeffs.append(res.w)
             results.append(res)
             if variances is not None:
                 # per-entity variances, vmapped over the bucket's lanes
                 # (reference computes them per SingleNodeOptimizationProblem)
                 variances.append(self._vvar(res.w, dev["x"], dev["y"],
-                                            off_b, dev["w"], self.config.reg))
+                                            off_b, dev["w"], lane_regs[bi]))
 
         if self._proj is not None:
             coeffs = self._proj.back_project([np.asarray(c) for c in coeffs])
@@ -604,11 +631,13 @@ class RandomEffectCoordinate(Coordinate):
         from photon_ml_tpu.parallel.bucketing import score_samples
 
         reg = self.config.reg if reg is None else reg
+        lane_regs = self._lane_regs(reg)
         offsets = offsets.astype(self._dtype)
         new_lanes = []
-        for lanes, dev in zip(state, self._dev):
+        for bi, (lanes, dev) in enumerate(zip(state, self._dev)):
             off_b = jnp.where(dev["valid"], offsets[dev["rows"]], 0.0)
-            res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"], reg)
+            res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"],
+                               lane_regs[bi])
             new_lanes.append(res.w)
         w_stack = self.trace_publish(tuple(new_lanes))
         score = score_samples(w_stack, self._sample_slots, self._x_full)[: self._n]

@@ -592,3 +592,90 @@ def test_golden_coefficients_regression():
         0.7988396286964417, 0.15702131390571594, -0.6274759769439697])
     np.testing.assert_allclose(re_model.w_stack[re_model.slot_of[11]],
                                golden_user0, rtol=1e-4, atol=1e-5)
+
+
+def test_per_entity_l2_multipliers(rng):
+    """Per-entity regularization (beyond-reference: the reference only
+    envisioned per-entity lambda, RandomEffectOptimizationProblem.scala:42):
+    a heavily-multiplied entity's coefficients shrink, others are untouched;
+    the fused sweep agrees with the host loop."""
+    import dataclasses
+
+    from photon_ml_tpu.game.fused import FusedSweep
+
+    data, _, _, _ = _glmix_data(rng, n_users=8, per_user=50)
+    base = _configs(num_iters=1)
+    re_base = base.coordinates["per-user"]
+    eids = sorted(set(int(e) for e in data.id_tags["userId"]))
+    heavy = eids[2]
+
+    def fit(cfg):
+        coord = build_coordinate("u", data, cfg, base.task)
+        model, _ = coord.update(np.zeros(data.num_samples))
+        return coord, model
+
+    _, plain = fit(re_base)
+    cfg_mult = dataclasses.replace(
+        re_base, per_entity_l2_multipliers={heavy: 1000.0})
+    coord, mult = fit(cfg_mult)
+
+    slot = plain.slot_of[heavy]
+    assert (np.linalg.norm(mult.w_stack[slot])
+            < 0.05 * np.linalg.norm(plain.w_stack[slot]))
+    for e in eids:
+        if e == heavy:
+            continue
+        np.testing.assert_allclose(mult.w_stack[plain.slot_of[e]],
+                                   plain.w_stack[plain.slot_of[e]],
+                                   rtol=1e-4, atol=1e-5)
+
+    # config canonicalization: dict -> sorted tuple, hash/eq safe
+    assert cfg_mult.per_entity_l2_multipliers == ((heavy, 1000.0),)
+
+    # fused sweep applies the multipliers too (they're part of sweep_key)
+    coords = {"u": coord}
+    fused_model, _ = FusedSweep(coords, num_iterations=1).run()
+    np.testing.assert_allclose(fused_model["u"].w_stack, mult.w_stack,
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_per_entity_multipliers_cli(tmp_path):
+    import json as _json
+    import os
+
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.storage.model_io import load_game_model
+    from photon_ml_tpu.data.index_map import load_index
+    from photon_ml_tpu.data.reader import EntityIndex
+
+    import sys
+    sys.path.insert(0, "tests")
+    from test_cli import _write_fixture
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=300, seed=11)
+    mults = str(tmp_path / "mults.json")
+    with open(mults, "w") as f:
+        _json.dump({"user0": 500.0, "ghost_user": 2.0}, f)
+
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--coordinate", f"name=u,random.effect.type=userId,feature.shard=all,"
+                        f"reg.weights=1,per.entity.l2.multipliers={mults}",
+        "--id-tags", "userId",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    eidx = EntityIndex.load(os.path.join(out, "userId.entities.json"))
+    imap = load_index(os.path.join(out, "all.idx"))
+    model, _ = load_game_model(os.path.join(out, "best"), {"all": imap},
+                               {"userId": eidx})
+    re_model = model["u"]
+    heavy_slot = re_model.slot_of[eidx.get("user0")]
+    other = [s for e, s in re_model.slot_of.items()
+             if e != eidx.get("user0")]
+    heavy_norm = np.linalg.norm(re_model.w_stack[heavy_slot])
+    other_norms = [np.linalg.norm(re_model.w_stack[s]) for s in other]
+    assert heavy_norm < 0.3 * np.median(other_norms)
