@@ -190,6 +190,10 @@ def _run(args, task, t_start, emitter) -> int:
         try:
             with open(spec.per_entity_l2_file) as f:
                 raw = json.load(f)
+            if not isinstance(raw, dict):
+                raise ValueError(
+                    f"expected a JSON object of entity -> multiplier, got "
+                    f"{type(raw).__name__}")
             parsed = {}
             for name, m in raw.items():
                 m = float(m)
@@ -446,7 +450,9 @@ def _run(args, task, t_start, emitter) -> int:
     if args.checkpoint_dir:
         import hashlib
 
-        from photon_ml_tpu.storage.checkpoint import load_checkpoint, save_checkpoint
+        from photon_ml_tpu.storage.checkpoint import (has_checkpoint,
+                                                       load_checkpoint,
+                                                       save_checkpoint)
 
         # Fingerprint of everything the positional cursor and best-model
         # tracking depend on: a rerun with ANY of these changed must NOT
@@ -472,9 +478,19 @@ def _run(args, task, t_start, emitter) -> int:
                              "index_map_dir": args.index_map_dir}, sort_keys=True)
         fingerprint = hashlib.sha256(fp_src.encode()).hexdigest()[:16]
 
-        try:
-            initial_model, ck_task, resume_cursor, resume_best = load_checkpoint(
-                args.checkpoint_dir, index_maps, entity_indexes)
+        # Discriminator is the POINTER, not an exception type: a present
+        # pointer names an atomically-written version, so ANY load failure
+        # there (missing files included) is external damage and must refuse
+        # loudly rather than silently retrain from scratch.
+        if has_checkpoint(args.checkpoint_dir):
+            try:
+                initial_model, ck_task, resume_cursor, resume_best = load_checkpoint(
+                    args.checkpoint_dir, index_maps, entity_indexes)
+            except Exception as e:
+                logger.error(
+                    "checkpoint in %s is unreadable (%s); clear the dir to "
+                    "start fresh or restore it to resume", args.checkpoint_dir, e)
+                return 1
             if ck_task != task:
                 logger.error("checkpoint task %s != --task %s", ck_task, task)
                 return 1
@@ -488,8 +504,6 @@ def _run(args, task, t_start, emitter) -> int:
                 return 1
             logger.info("resuming from checkpoint %s at %s", args.checkpoint_dir,
                         resume_cursor)
-        except FileNotFoundError:
-            pass
 
         def checkpoint_hook(model, cursor, updated=None, best=None, best_changed=True):
             save_checkpoint(args.checkpoint_dir, model, index_maps, cursor,

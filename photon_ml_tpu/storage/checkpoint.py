@@ -162,6 +162,13 @@ def save_checkpoint(
             shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
+def has_checkpoint(ckpt_dir: str) -> bool:
+    """True when a checkpoint pointer exists (the only state meaning
+    'something was saved here' — saves are atomic, so a present pointer
+    names a fully-written version)."""
+    return _read_pointer(ckpt_dir) is not None
+
+
 def load_checkpoint(
     ckpt_dir: str,
     index_maps: Dict[str, IndexMap],

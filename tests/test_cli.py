@@ -574,3 +574,37 @@ def test_dummy_tuner_cli(tmp_path):
     assert rc == 0
     # only the 2 grid models saved: the DUMMY tuner produced none
     assert sorted(os.listdir(os.path.join(out, "models"))) == ["0", "1"]
+
+
+def test_corrupt_checkpoint_fails_cleanly(tmp_path):
+    """A damaged checkpoint dir (atomic saves make this external damage)
+    must produce a clear error, not a traceback or a silent fresh restart."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=150, seed=12)
+    ck = tmp_path / "ckpt"
+    out1 = str(tmp_path / "out1")
+    base = ["--train-data", train_path, "--feature-shards", "all",
+            "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+            "--checkpoint-dir", str(ck)]
+    assert train_cli.run(base + ["--output-dir", out1]) == 0
+
+    # corrupt the pointed-to version's cursor
+    import json as _json
+
+    ptr = (ck / "LATEST").read_text().strip()
+    (ck / ptr / "cursor.json").write_text("{not json")
+
+    rc = train_cli.run(base + ["--output-dir", str(tmp_path / "out2")])
+    assert rc == 1  # clean failure, not a crash
+
+    # deleted version dir with a live pointer: also a loud refusal, NOT a
+    # silent fresh restart (the pointer is the no-checkpoint discriminator)
+    import shutil
+
+    shutil.rmtree(ck / ptr)
+    rc = train_cli.run(base + ["--output-dir", str(tmp_path / "out3")])
+    assert rc == 1
+    assert not os.path.exists(os.path.join(str(tmp_path / "out3"),
+                                           "training-summary.json"))

@@ -649,7 +649,9 @@ def test_per_entity_multipliers_cli(tmp_path):
     from photon_ml_tpu.data.reader import EntityIndex
 
     import sys
-    sys.path.insert(0, "tests")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
     from test_cli import _write_fixture
 
     train_path = str(tmp_path / "train.avro")
