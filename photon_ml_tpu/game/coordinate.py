@@ -668,10 +668,23 @@ class RandomEffectCoordinate(Coordinate):
 def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfig,
                      task: TaskType, mesh: Optional[Mesh] = None,
                      norm: Optional[NormalizationContext] = None,
-                     seed: int = 0) -> Coordinate:
-    """Reference CoordinateFactory.build (CoordinateFactory.scala:34-113)."""
+                     seed: int = 0, dtype=np.float32) -> Coordinate:
+    """Reference CoordinateFactory.build (CoordinateFactory.scala:34-113).
+
+    ``dtype``: compute precision for this coordinate's device arrays; the
+    reference computes in JVM float64 throughout — pass ``np.float64`` for
+    reference-precision parity, keep the float32 default for TPU throughput.
+    """
+    if np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"dtype {np.dtype(dtype).name} requires jax_enable_x64: without it "
+            "jax silently truncates every array to 32 bits and the solve would "
+            'NOT run at the requested precision — jax.config.update('
+            '"jax_enable_x64", True) first (CPU; TPU hardware is 32-bit)')
     if isinstance(config, FixedEffectConfig):
-        return FixedEffectCoordinate(coordinate_id, data, config, task, mesh, norm)
+        return FixedEffectCoordinate(coordinate_id, data, config, task, mesh, norm,
+                                     dtype=dtype)
     if isinstance(config, RandomEffectConfig):
-        return RandomEffectCoordinate(coordinate_id, data, config, task, mesh, seed)
+        return RandomEffectCoordinate(coordinate_id, data, config, task, mesh, seed,
+                                      dtype=dtype)
     raise TypeError(f"unknown coordinate config {type(config)!r}")

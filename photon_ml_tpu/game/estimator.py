@@ -50,7 +50,7 @@ class GameEstimator:
     def __init__(self, mesh: Optional[Mesh] = None,
                  validation_suite: Optional[EvaluationSuite] = None,
                  normalization: Optional[Dict[str, "NormalizationContext"]] = None,
-                 fused: "bool | str" = "auto"):
+                 fused: "bool | str" = "auto", dtype=np.float32):
         """``normalization``: per-feature-shard NormalizationContext applied
         to fixed-effect coordinates (reference GameEstimator normalization
         wrappers, fit:430-436; models come out in original space).  Living on
@@ -66,6 +66,10 @@ class GameEstimator:
         self.validation_suite = validation_suite
         self.normalization = normalization or {}
         self.fused = fused
+        # Compute precision for coordinate device arrays: the reference runs
+        # on JVM doubles; np.float64 gives reference-precision solves (CPU),
+        # the float32 default is the TPU-throughput choice.
+        self.dtype = dtype
 
     def fit(
         self,
@@ -106,12 +110,12 @@ class GameEstimator:
                         coordinates[cid] = build_coordinate(
                             cid, data, ccfg, config.task, self.mesh,
                             norm=self.normalization.get(ccfg.feature_shard),
-                            seed=seed)
+                            seed=seed, dtype=self.dtype)
                 else:
                     coordinates[cid] = build_coordinate(
                         cid, data, ccfg, config.task, self.mesh,
                         norm=self.normalization.get(ccfg.feature_shard),
-                        seed=seed)
+                        seed=seed, dtype=self.dtype)
             prev = coordinates
             validation = None
             if validation_data is not None and self.validation_suite is not None:

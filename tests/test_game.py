@@ -681,3 +681,80 @@ def test_per_entity_multipliers_cli(tmp_path):
     heavy_norm = np.linalg.norm(re_model.w_stack[heavy_slot])
     other_norms = [np.linalg.norm(re_model.w_stack[s]) for s in other]
     assert heavy_norm < 0.3 * np.median(other_norms)
+
+
+# --- Reference-golden parity: the reference's own pinned scikit-learn values ---
+
+# The reference's "trivial" dataset (photon-api/src/test/.../GameTestUtils.scala:
+# trivialLabeledPoints, 68-79): 10 points, 2 features; an intercept column of
+# ones is appended LAST, exactly as GameEstimatorIntegTest.simpleHardcodedTest
+# does before training.
+_TRIVIAL_X = np.asarray([
+    [-0.7306653538519616, 0.0],
+    [0.6750417712898752, -0.4232874171873786],
+    [0.1863463229359709, -0.8163423997075965],
+    [-0.6719842051493347, 0.0],
+    [0.9699938346531928, 0.0],
+    [0.22759406190283604, 0.0],
+    [0.9688721028330911, 0.0],
+    [0.5993795346650845, 0.0],
+    [0.9219423508390701, -0.8972778242305388],
+    [0.7006904841584055, -0.5607635619919824],
+])
+_TRIVIAL_Y = np.asarray([0.0, 1.0, 1.0, 0.0, 1.0, 1.0, 1.0, 0.0, 0.0, 0.0])
+
+
+def _trivial_game_data():
+    x = np.concatenate([_TRIVIAL_X, np.ones((len(_TRIVIAL_Y), 1))], axis=1)
+    return GameData(y=_TRIVIAL_Y, features={"features": x}, id_tags={})
+
+
+def test_reference_golden_trivial_linear_l2():
+    """Cross-implementation golden parity: linear regression + L2(0.3) on the
+    reference's trivial dataset must reproduce the scikit-learn-derived
+    coefficients the reference pins at HIGH_PRECISION_TOLERANCE
+    (GameEstimatorIntegTest.scala:105-107; loss = 1/2 Σ(z-y)², reg = λ/2‖w‖²
+    including the intercept)."""
+    cfg = GameConfig(task=TaskType.LINEAR_REGRESSION, coordinates={
+        "global": FixedEffectConfig(
+            feature_shard="features",
+            solver=SolverConfig(max_iters=100, tolerance=1e-11),
+            reg=Regularization(l2=0.3), intercept_index=2)})
+    res = GameEstimator(dtype=np.float64).fit(_trivial_game_data(), [cfg])[0]
+    np.testing.assert_allclose(
+        res.model["global"].coefficients.means,
+        [0.3215554473500486, 0.17904355431985355, 0.4122241763914806],
+        rtol=0, atol=1e-9)
+
+
+@pytest.mark.parametrize("kind", ["none", "scale_with_max_magnitude",
+                                  "scale_with_standard_deviation",
+                                  "standardization"])
+def test_reference_golden_trivial_normalization(kind):
+    """GameEstimatorIntegTest.testNormalization parity: the UNregularized
+    solve is invariant under every normalization type because the published
+    model is mapped back to original space — all four must reproduce the
+    reference's pinned scikit-learn OLS coefficients at
+    LOW_PRECISION_TOLERANCE (1e-8)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import (build_normalization,
+                                                  compute_feature_stats)
+    from photon_ml_tpu.types import NormalizationType
+
+    data = _trivial_game_data()
+    x = data.features["features"]
+    stats = compute_feature_stats(jnp.asarray(x, jnp.float64),
+                                  intercept_index=2)
+    ctx = build_normalization(NormalizationType(kind), stats)
+    cfg = GameConfig(task=TaskType.LINEAR_REGRESSION, coordinates={
+        "global": FixedEffectConfig(
+            feature_shard="features",
+            solver=SolverConfig(max_iters=100, tolerance=1e-11),
+            reg=Regularization(), intercept_index=2)})
+    res = GameEstimator(normalization={"features": ctx},
+                        dtype=np.float64).fit(data, [cfg])[0]
+    np.testing.assert_allclose(
+        res.model["global"].coefficients.means,
+        [0.34945501725815586, 0.26339479490270173, 0.4366125400310442],
+        rtol=0, atol=1e-8)
