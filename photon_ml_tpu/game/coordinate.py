@@ -100,15 +100,33 @@ class Coordinate:
         raise NotImplementedError
 
     def trace_update(self, state, offsets: Array,
-                     reg: "Optional[Regularization]" = None) -> Tuple[object, Array]:
+                     reg: "Optional[Regularization]" = None,
+                     key=None) -> Tuple[object, Array]:
         """Traceable: one update against residual-folded ``offsets[n]``;
         returns (state', this coordinate's new score[n]).  ``reg`` (possibly
         traced) overrides the config's regularization weights so one compiled
-        sweep serves a whole reg grid."""
+        sweep serves a whole reg grid.  ``key``: per-(iteration, coordinate)
+        PRNG key the fused sweep folds for stochastic per-update work
+        (down-sampling); coordinates without such work ignore it."""
         raise NotImplementedError
 
     def trace_publish(self, state) -> Array:
         """Traceable: state -> the publishable coefficient array."""
+        raise NotImplementedError
+
+    def init_sweep_variances(self):
+        """Host: placeholder pytree the sweep carries for this coordinate's
+        variances (a zero-length array when variance=NONE)."""
+        return jnp.zeros(0)
+
+    def trace_variances(self, state, offsets: Array,
+                        reg: "Optional[Regularization]" = None, key=None):
+        """Traceable: variances at this update's iterate/offsets/reg; same
+        pytree structure as ``init_sweep_variances()``."""
+        raise NotImplementedError
+
+    def export_variances(self, v) -> np.ndarray:
+        """Host: program variance output -> array for the published model."""
         raise NotImplementedError
 
     def export_model(self, published: np.ndarray) -> DatumScoringModel:
@@ -245,18 +263,37 @@ class FixedEffectCoordinate(Coordinate):
         pad = self._padded_n - len(a)
         return a if pad == 0 else np.concatenate([a, np.zeros(pad, a.dtype)])
 
+    def _down_sample_mult(self, keep, y):
+        """Per-task sampling rule (reference DownSamplerHelper.scala:33-40):
+        binary tasks keep every positive and reweight sampled negatives by
+        1/rate (BinaryClassificationDownSampler.scala:32-55); regression
+        tasks sample uniformly with NO reweight (DefaultDownSampler)."""
+        rate = self.config.down_sampling_rate
+        xp = jnp if isinstance(keep, jax.Array) else np
+        if self.task in (TaskType.LOGISTIC_REGRESSION,
+                         TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+            mult = xp.where(keep, 1.0 / rate, 0.0)
+            return xp.where(y > 0.5, 1.0, mult).astype(self._dtype)
+        return keep.astype(self._dtype)
+
     def _down_sample_weights(self, seed: int) -> Array:
-        """Negative down-sampling with 1/rate weight compensation (reference
-        BinaryClassificationDownSampler.scala:32-55); resampled per update."""
+        """Host-paced resample-per-update path (reference
+        DistributedOptimizationProblem.runWithSampling:159-174)."""
         rate = self.config.down_sampling_rate
         if rate >= 1.0:
             return self._base_weight
         rng = np.random.default_rng(seed)
         keep = rng.random(self._padded_n) < rate
-        mult = np.where(keep, 1.0 / rate, 0.0).astype(self._dtype)
-        y = np.asarray(self._batch.y)
-        mult = np.where(y > 0.5, 1.0, mult)  # keep all positives
+        mult = self._down_sample_mult(keep, np.asarray(self._batch.y))
         return self._base_weight * jnp.asarray(mult)
+
+    def _traced_down_sample_weights(self, key) -> Array:
+        """Traced twin of ``_down_sample_weights`` for the fused sweep: same
+        per-task semantics, but the draw happens inside the compiled program
+        (a fresh fold of the sweep key each outer iteration, mirroring the
+        reference's new seed per update)."""
+        keep = jax.random.uniform(key, (self._padded_n,)) < self.config.down_sampling_rate
+        return self._base_weight * self._down_sample_mult(keep, self._batch.y)
 
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[FixedEffectModel] = None) -> Tuple[FixedEffectModel, SolverResult]:
@@ -311,26 +348,28 @@ class FixedEffectCoordinate(Coordinate):
     # State = transformed-space coefficient vector [d].
 
     def init_sweep_state(self, init: Optional[FixedEffectModel] = None) -> Array:
-        if self.config.down_sampling_rate < 1.0:
-            raise NotImplementedError(
-                f"coordinate {self.coordinate_id!r} resamples per update "
-                "(down_sampling_rate < 1) — use the host-paced CoordinateDescent")
-        if self.config.variance != VarianceComputationType.NONE:
-            raise NotImplementedError(
-                f"coordinate {self.coordinate_id!r} requests coefficient "
-                "variances, which the fused sweep does not produce — use the "
-                "host-paced CoordinateDescent")
         if init is not None:
             w = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
             return self._norm.model_to_transformed_space(
                 w, self.config.intercept_index)
         return jnp.zeros(self.dim, self._dtype)
 
-    def trace_update(self, state: Array, offsets: Array,
-                     reg: Optional[Regularization] = None) -> Tuple[Array, Array]:
+    def _sweep_batch_inputs(self, offsets: Array, key) -> Tuple[Array, Array]:
+        """(padded offsets, per-update weights) — the ONE definition of what a
+        sweep update sees; trace_update and trace_variances must agree on it
+        (down-sampled weights are re-drawn from the same key, so XLA CSEs the
+        duplicate draw and the variance weights match the update's exactly)."""
         pad = self._padded_n - self._n
-        offs = jnp.pad(offsets, (0, pad)) if pad else offsets
-        res = self._solve(state, offs.astype(self._dtype), self._base_weight,
+        offs = (jnp.pad(offsets, (0, pad)) if pad else offsets).astype(self._dtype)
+        if self.config.down_sampling_rate < 1.0 and key is not None:
+            return offs, self._traced_down_sample_weights(key)
+        return offs, self._base_weight
+
+    def trace_update(self, state: Array, offsets: Array,
+                     reg: Optional[Regularization] = None,
+                     key=None) -> Tuple[Array, Array]:
+        offs, weights = self._sweep_batch_inputs(offsets, key)
+        res = self._solve(state, offs, weights,
                           self.config.reg if reg is None else reg)
         return res.w, self._batch.margins(self.trace_publish(res.w))[: self._n]
 
@@ -342,6 +381,32 @@ class FixedEffectCoordinate(Coordinate):
         return FixedEffectModel(
             coefficients=Coefficients(means=np.asarray(published)),
             feature_shard=self.config.feature_shard, task=self.task)
+
+    def init_sweep_variances(self) -> Array:
+        if self.config.variance == VarianceComputationType.NONE:
+            return jnp.zeros(0, self._dtype)
+        return jnp.zeros(self.dim, self._dtype)
+
+    def trace_variances(self, state: Array, offsets: Array,
+                        reg: Optional[Regularization] = None,
+                        key=None) -> Array:
+        """Traced coefficient variances at this update's iterate against this
+        update's offsets, (down-sampled) weights AND traced ``reg`` — the
+        exact inputs trace_update solved with, so the last iteration's values
+        match what the host path publishes
+        (DistributedOptimizationProblem.scala:84-108: variances are computed
+        per update; only the final update's survive into the model)."""
+        from photon_ml_tpu.opt.solve import compute_variances
+
+        offs, weights = self._sweep_batch_inputs(offsets, key)
+        v = compute_variances(
+            self._objective.with_reg(self.config.reg if reg is None else reg),
+            state, self._batch.replace(offset=offs, weight=weights),
+            self.config.variance)
+        return self._norm.model_to_original_space(v, self.config.intercept_index)
+
+    def export_variances(self, v) -> np.ndarray:
+        return np.asarray(v)
 
 
 def _re_data_key(c: RandomEffectConfig) -> tuple:
@@ -611,11 +676,6 @@ class RandomEffectCoordinate(Coordinate):
             raise NotImplementedError(
                 f"coordinate {self.coordinate_id!r} solves in a projected "
                 "space — use the host-paced CoordinateDescent")
-        if self.config.variance != VarianceComputationType.NONE:
-            raise NotImplementedError(
-                f"coordinate {self.coordinate_id!r} requests coefficient "
-                "variances, which the fused sweep does not produce — use the "
-                "host-paced CoordinateDescent")
         lanes = []
         for bi, b in enumerate(self.buckets.buckets):
             if init is not None:
@@ -626,8 +686,10 @@ class RandomEffectCoordinate(Coordinate):
         return tuple(lanes)
 
     def trace_update(self, state: Tuple[Array, ...], offsets: Array,
-                     reg: Optional[Regularization] = None
-                     ) -> Tuple[Tuple[Array, ...], Array]:
+                     reg: Optional[Regularization] = None,
+                     key=None) -> Tuple[Tuple[Array, ...], Array]:
+        # ``key`` unused: random effects have no per-update stochastic work
+        # (down-sampling is a fixed-effect-only config, as in the reference).
         from photon_ml_tpu.parallel.bucketing import score_samples
 
         reg = self.config.reg if reg is None else reg
@@ -654,6 +716,32 @@ class RandomEffectCoordinate(Coordinate):
             w_stack=np.asarray(published), slot_of=dict(self._slot_of),
             random_effect_type=self.config.random_effect_type,
             feature_shard=self.config.feature_shard, task=self.task)
+
+    def init_sweep_variances(self) -> "Array | Tuple[Array, ...]":
+        if self.config.variance == VarianceComputationType.NONE:
+            return jnp.zeros(0, self._dtype)
+        return tuple(jnp.zeros((b.num_lanes, self.dim), self._dtype)
+                     for b in self.buckets.buckets)
+
+    def trace_variances(self, state: Tuple[Array, ...], offsets: Array,
+                        reg: Optional[Regularization] = None,
+                        key=None) -> Tuple[Array, ...]:
+        """Traced per-entity variances at this update's lane iterates and
+        traced ``reg``, vmapped per bucket exactly as the host path's
+        update() does."""
+        offs = offsets.astype(self._dtype)
+        lane_regs = self._lane_regs(self.config.reg if reg is None else reg)
+        out = []
+        for bi, (lanes, dev) in enumerate(zip(state, self._dev)):
+            off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0)
+            out.append(self._vvar(lanes, dev["x"], dev["y"], off_b,
+                                  dev["w"], lane_regs[bi]))
+        return tuple(out)
+
+    def export_variances(self, v) -> np.ndarray:
+        var_stack, _ = stacked_coefficients([np.asarray(b) for b in v],
+                                            self.buckets)
+        return np.asarray(var_stack)
 
     def tracker_summary(self, trackers) -> dict:
         """Per-entity solve statistics, padded lanes excluded (reference

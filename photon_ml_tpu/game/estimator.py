@@ -147,7 +147,8 @@ class GameEstimator:
                     model, _scores = sweep.run(
                         initial=warm,
                         regs=[coordinates[cid].config.reg
-                              for cid in config.coordinates])
+                              for cid in config.coordinates],
+                        seed=seed)
                     results.append(GameFitResult(model=model, config=config,
                                                  evaluation=None,
                                                  history=DescentHistory()))

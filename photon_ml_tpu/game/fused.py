@@ -15,9 +15,13 @@ This is the TPU-native answer to the reference's persist/broadcast
 choreography between coordinate updates (CoordinateDescent.scala:208-232):
 instead of caching RDD scores between Spark jobs, the scores never leave HBM.
 
-Eligibility is decided by each coordinate's ``init_sweep_state``: per-update
-down-sampling and projected random effects need the host-paced loop and raise
-NotImplementedError there (identical semantics either way).
+Eligibility is decided by each coordinate's ``init_sweep_state``: projected
+random effects need the host-paced loop and raise NotImplementedError there
+(identical semantics either way).  Per-update down-sampling IS fused (the
+draw happens inside the program from a per-(iteration, coordinate) fold of
+the sweep's PRNG key), and coefficient variances ARE fused (computed in the
+scan body on the final iteration only, at the exact offsets/weights/reg of
+that coordinate's last update — what the host loop publishes).
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ from jax import lax
 
 from photon_ml_tpu.game.coordinate import Coordinate
 from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.types import VarianceComputationType
 
 Array = jax.Array
 
@@ -63,33 +68,55 @@ class FusedSweep:
         base = jnp.asarray(np.asarray(first._base_offset_host(), self._dtype))
         order, coords = self.order, self.coordinates
 
-        def program(states0, scores0, regs):
+        needs_var = [coords[cid].config.variance != VarianceComputationType.NONE
+                     for cid in self.order]
+
+        def program(states0, scores0, vars0, regs, base_key):
             # regs: per-coordinate Regularization pytree, TRACED — a
-            # reg-weight grid re-enters this one compiled program
-            def body(carry, _):
-                states, scores = list(carry[0]), list(carry[1])
+            # reg-weight grid re-enters this one compiled program.
+            # base_key: sweep PRNG key, folded per (iteration, coordinate)
+            # for stochastic per-update work (down-sampling) — a new draw
+            # each outer iteration, like the reference's seed-per-update
+            # (DistributedOptimizationProblem.runWithSampling).
+            def body(carry, it):
+                states, scores, vars_ = (list(c) for c in carry)
+                it_key = jax.random.fold_in(base_key, it)
                 total = scores[0]
                 for s in scores[1:]:
                     total = total + s
                 for i, cid in enumerate(order):
                     # residual trick (CoordinateDescent.scala:197-204)
                     partial = total - scores[i]
+                    key = jax.random.fold_in(it_key, i)
                     states[i], scores[i] = coords[cid].trace_update(
-                        states[i], base + partial, reg=regs[i])
+                        states[i], base + partial, reg=regs[i], key=key)
+                    if needs_var[i]:
+                        # Only the LAST update's variances survive into the
+                        # published model (host-path semantics), so skip the
+                        # curvature work on every earlier iteration — FULL
+                        # variance is a d×d Hessian + Cholesky per lane.
+                        vars_[i] = lax.cond(
+                            it == self.num_iterations - 1,
+                            lambda s, o, r, k: coords[cid].trace_variances(
+                                s, o, reg=r, key=k),
+                            lambda s, o, r, k: vars_[i],
+                            states[i], base + partial, regs[i], key)
                     total = partial + scores[i]
-                return (tuple(states), tuple(scores)), None
+                return (tuple(states), tuple(scores), tuple(vars_)), None
 
-            carry, _ = lax.scan(body, (states0, scores0), None,
-                                length=self.num_iterations)
-            states, scores = carry
+            carry, _ = lax.scan(body, (states0, scores0, vars0),
+                                jnp.arange(self.num_iterations))
+            states, scores, vars_ = carry
             published = tuple(coords[cid].trace_publish(states[i])
                               for i, cid in enumerate(order))
-            return published, scores
+            return published, scores, vars_
 
         self._program = jax.jit(program)
         # Cold-start carry built eagerly: validates every coordinate's
         # fused-eligibility at construction time and is reused by run().
         self._cold = self._init_carry(None)
+        self._vars0 = tuple(coordinates[cid].init_sweep_variances()
+                            for cid in self.order)
 
     def _init_carry(self, initial: Optional[GameModel]):
         states, scores = [], []
@@ -103,19 +130,45 @@ class FusedSweep:
         return tuple(states), tuple(scores)
 
     def run(self, initial: Optional[GameModel] = None,
-            regs: Optional[Sequence] = None
+            regs: Optional[Sequence] = None, seed: int = 0
             ) -> Tuple[GameModel, Dict[str, np.ndarray]]:
         """One fused descent; returns (model, per-coordinate final scores).
 
         ``regs``: per-coordinate (order-aligned) Regularization overrides —
         lets one compiled sweep serve a whole reg-weight grid (the caller
-        typically reads them off rebind-updated configs)."""
+        typically reads them off rebind-updated configs).  ``seed``: PRNG
+        seed for in-program stochastic work (down-sampling); a traced input,
+        so varying it reuses the compiled program."""
         carry = self._cold if initial is None else self._init_carry(initial)
         if regs is None:
             regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
-        published, scores = self._program(*carry, tuple(regs))
+        published, scores, vars_ = self._program(
+            *carry, self._vars0, tuple(regs), jax.random.PRNGKey(seed))
         models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
                   for i, cid in enumerate(self.order)}
         final_scores = {cid: np.asarray(scores[i])
                         for i, cid in enumerate(self.order)}
+        models = self._attach_variances(models, vars_)
         return GameModel(models=models), final_scores
+
+    def _attach_variances(self, models, vars_):
+        """Attach the in-sweep-computed variances (the LAST update's, exactly
+        as the host loop publishes) to the exported models."""
+        import dataclasses
+
+        from photon_ml_tpu.models.game import FixedEffectModel
+        from photon_ml_tpu.models.glm import Coefficients
+
+        for i, cid in enumerate(self.order):
+            coord = self.coordinates[cid]
+            if coord.config.variance == VarianceComputationType.NONE:
+                continue
+            v = coord.export_variances(vars_[i])
+            m = models[cid]
+            if isinstance(m, FixedEffectModel):
+                models[cid] = dataclasses.replace(
+                    m, coefficients=Coefficients(
+                        means=m.coefficients.means, variances=v))
+            else:  # random effect: stacked per-entity variances
+                models[cid] = dataclasses.replace(m, variances=v)
+        return models

@@ -375,15 +375,21 @@ def test_fused_sweep_warm_start(rng):
                                h2["per-user"].w_stack, rtol=2e-3, atol=2e-3)
 
 
-def test_fused_sweep_rejects_downsampling(rng):
+def test_fused_sweep_rejects_projected_space(rng):
+    """Projected random effects still need the host-paced loop; eligibility
+    is surfaced at FusedSweep construction (down-sampling and variances are
+    now fused-eligible and no longer rejected)."""
     import dataclasses
 
     from photon_ml_tpu.game.fused import FusedSweep
+    from photon_ml_tpu.types import ProjectorType
 
     data, _, _, _ = _glmix_data(rng, n_users=4, per_user=30)
     cfg = _configs()
-    fixed_ds = dataclasses.replace(cfg.coordinates["fixed"], down_sampling_rate=0.5)
-    coords = {"fixed": build_coordinate("fixed", data, fixed_ds, cfg.task)}
+    re_proj = dataclasses.replace(cfg.coordinates["per-user"],
+                                  projector=ProjectorType.RANDOM,
+                                  projected_dim=2)
+    coords = {"per-user": build_coordinate("per-user", data, re_proj, cfg.task)}
     with pytest.raises(NotImplementedError):
         FusedSweep(coords)
 
@@ -486,11 +492,14 @@ def test_estimator_fused_auto_matches_host(rng):
         GameEstimator(validation_suite=suite, fused=True).fit(
             data, [cfg], validation_data=data)
 
-    # fused=True surfaces coordinate ineligibility (downsampling)
+    # fused=True surfaces coordinate ineligibility (projected solve space)
     import dataclasses
 
-    ds = dataclasses.replace(cfg.coordinates["fixed"], down_sampling_rate=0.5)
-    bad = GameConfig(task=cfg.task, coordinates={"fixed": ds})
+    from photon_ml_tpu.types import ProjectorType
+
+    proj = dataclasses.replace(cfg.coordinates["per-user"],
+                               projector=ProjectorType.RANDOM, projected_dim=2)
+    bad = GameConfig(task=cfg.task, coordinates={"per-user": proj})
     with pytest.raises(NotImplementedError):
         GameEstimator(fused=True).fit(data, [bad])
 
@@ -758,3 +767,129 @@ def test_reference_golden_trivial_normalization(kind):
         res.model["global"].coefficients.means,
         [0.34945501725815586, 0.26339479490270173, 0.4366125400310442],
         rtol=0, atol=1e-8)
+
+
+def test_down_sampling_default_sampler_regression_tasks(rng):
+    """Reference DownSamplerHelper.scala:33-40: regression tasks down-sample
+    with DefaultDownSampler — uniform sampling at rate, NO positive-keeping
+    and NO 1/rate reweighting."""
+    data, *_ = _glmix_data(rng, n_users=8, per_user=40)
+    cfg = FixedEffectConfig(feature_shard="global",
+                            solver=SolverConfig(max_iters=20),
+                            reg=Regularization(l2=1.0), down_sampling_rate=0.5)
+    coord = build_coordinate("fixed", data, cfg, TaskType.LINEAR_REGRESSION)
+    base = np.asarray(coord._base_weight)
+    w = np.asarray(coord._down_sample_weights(seed=7))
+    live = base > 0
+    kept = live & (w > 0)
+    # sampled rows keep their ORIGINAL weight (no compensation), others drop
+    np.testing.assert_allclose(w[kept], base[kept])
+    frac = kept.sum() / live.sum()
+    assert 0.35 < frac < 0.65  # ~rate of the live rows survive
+
+
+def test_fused_down_sampling_matches_host_statistically(rng):
+    """The fused sweep now runs per-update down-sampling inside the compiled
+    program (traced PRNG fold per iteration).  Draws differ from the host
+    path's numpy PRNG, so parity is statistical: both must land near the
+    no-sampling solution at rate→1⁻ semantics scale, and the fused solution
+    must track the host solution closely on a well-conditioned problem."""
+    import dataclasses
+
+    data, *_ = _glmix_data(rng, n_users=6, per_user=80)
+    base_cfg = _configs(num_iters=2)
+    fixed = dataclasses.replace(base_cfg.coordinates["fixed"],
+                                down_sampling_rate=0.8)
+    cfg = GameConfig(task=base_cfg.task, coordinates={
+        "fixed": fixed, "per-user": base_cfg.coordinates["per-user"]},
+        num_outer_iterations=2)
+
+    w_fused = GameEstimator(fused=True).fit(data, [cfg])[0] \
+        .model["fixed"].coefficients.means
+    w_host = GameEstimator(fused=False).fit(data, [cfg])[0] \
+        .model["fixed"].coefficients.means
+    # different PRNG streams -> not identical...
+    assert not np.allclose(w_fused, w_host, atol=1e-12)
+    # ...but the same estimator up to sampling noise
+    np.testing.assert_allclose(w_fused, w_host, rtol=0.35, atol=0.15)
+
+    # seed is a traced input: same seed reproduces, different seed varies
+    coords = {cid: build_coordinate(cid, data, c, cfg.task)
+              for cid, c in cfg.coordinates.items()}
+    from photon_ml_tpu.game.fused import FusedSweep
+    sweep = FusedSweep(coords, num_iterations=2)
+    m1, _ = sweep.run(seed=3)
+    m2, _ = sweep.run(seed=3)
+    m3, _ = sweep.run(seed=4)
+    np.testing.assert_array_equal(m1["fixed"].coefficients.means,
+                                  m2["fixed"].coefficients.means)
+    assert not np.array_equal(m1["fixed"].coefficients.means,
+                              m3["fixed"].coefficients.means)
+
+
+def test_fused_variances_match_host(rng):
+    """Fused sweep computes coefficient variances in the scan body on the
+    final iteration, at each coordinate's last-update offsets/weights/reg —
+    must equal the host-paced path's published variances on both coordinate
+    types (only the final update's variances survive there too)."""
+    import dataclasses
+
+    from photon_ml_tpu.types import VarianceComputationType
+
+    data, *_ = _glmix_data(rng, n_users=6, per_user=40)
+    base = _configs(num_iters=2)
+    cfg = GameConfig(task=base.task, coordinates={
+        "fixed": dataclasses.replace(base.coordinates["fixed"],
+                                     variance=VarianceComputationType.SIMPLE),
+        "per-user": dataclasses.replace(base.coordinates["per-user"],
+                                        variance=VarianceComputationType.FULL)},
+        num_outer_iterations=2)
+
+    fused = GameEstimator(fused=True).fit(data, [cfg])[0].model
+    host = GameEstimator(fused=False).fit(data, [cfg])[0].model
+
+    fv = fused["fixed"].coefficients.variances
+    hv = host["fixed"].coefficients.variances
+    assert fv is not None and hv is not None
+    np.testing.assert_allclose(fv, hv, rtol=1e-4, atol=1e-7)
+
+    fr, hr = fused["per-user"], host["per-user"]
+    assert fr.variances is not None and hr.variances is not None
+    assert fr.slot_of == hr.slot_of
+    np.testing.assert_allclose(fr.variances, hr.variances, rtol=1e-4, atol=1e-7)
+
+
+def test_fused_reg_grid_variances_use_each_lambda(rng):
+    """Regression: a fused λ grid reuses ONE compiled sweep whose reg enters
+    as a traced argument — the published variances must be computed with EACH
+    grid point's λ (not the first config's), matching the host path at every
+    grid point."""
+    import dataclasses
+
+    from photon_ml_tpu.types import VarianceComputationType
+
+    data, *_ = _glmix_data(rng, n_users=6, per_user=40)
+    base = _configs(num_iters=1)
+    fixed = dataclasses.replace(base.coordinates["fixed"],
+                                variance=VarianceComputationType.SIMPLE)
+    ruser = dataclasses.replace(base.coordinates["per-user"],
+                                variance=VarianceComputationType.SIMPLE)
+    grid = []
+    for l2 in (0.1, 10.0):
+        grid.append(GameConfig(task=base.task, coordinates={
+            "fixed": dataclasses.replace(fixed, reg=Regularization(l2=l2)),
+            "per-user": dataclasses.replace(ruser, reg=Regularization(l2=l2))}))
+
+    fused = GameEstimator(fused=True).fit(data, grid)
+    host = GameEstimator(fused=False).fit(data, grid)
+    for f, h in zip(fused, host):
+        np.testing.assert_allclose(f.model["fixed"].coefficients.variances,
+                                   h.model["fixed"].coefficients.variances,
+                                   rtol=1e-4, atol=1e-7)
+        np.testing.assert_allclose(f.model["per-user"].variances,
+                                   h.model["per-user"].variances,
+                                   rtol=1e-4, atol=1e-7)
+    # the two grid points' variances genuinely differ (λ enters the Hessian)
+    v0 = fused[0].model["fixed"].coefficients.variances
+    v1 = fused[1].model["fixed"].coefficients.variances
+    assert not np.allclose(v0, v1, rtol=1e-2)
