@@ -70,6 +70,8 @@ class FusedSweep:
 
         needs_var = [coords[cid].config.variance != VarianceComputationType.NONE
                      for cid in self.order]
+        needs_rand = [getattr(coords[cid].config, "down_sampling_rate", 1.0) < 1.0
+                      for cid in self.order]
 
         def program(states0, scores0, vars0, regs, base_key):
             # regs: per-coordinate Regularization pytree, TRACED — a
@@ -77,17 +79,21 @@ class FusedSweep:
             # base_key: sweep PRNG key, folded per (iteration, coordinate)
             # for stochastic per-update work (down-sampling) — a new draw
             # each outer iteration, like the reference's seed-per-update
-            # (DistributedOptimizationProblem.runWithSampling).
+            # (DistributedOptimizationProblem.runWithSampling).  Folds are
+            # emitted only for coordinates that down-sample, so the common
+            # no-sampling program carries no threefry code at all.
             def body(carry, it):
                 states, scores, vars_ = (list(c) for c in carry)
-                it_key = jax.random.fold_in(base_key, it)
+                it_key = (jax.random.fold_in(base_key, it)
+                          if any(needs_rand) else None)
                 total = scores[0]
                 for s in scores[1:]:
                     total = total + s
                 for i, cid in enumerate(order):
                     # residual trick (CoordinateDescent.scala:197-204)
                     partial = total - scores[i]
-                    key = jax.random.fold_in(it_key, i)
+                    key = (jax.random.fold_in(it_key, i)
+                           if needs_rand[i] else None)
                     states[i], scores[i] = coords[cid].trace_update(
                         states[i], base + partial, reg=regs[i], key=key)
                     if needs_var[i]:

@@ -1,0 +1,188 @@
+"""End-to-end runs on the REFERENCE'S OWN checked-in Avro fixtures.
+
+The reference's integration tests run its drivers over fixtures under
+photon-client/src/integTest/resources/DriverIntegTest/input (DriverTest.scala:
+HEART_EXPECTED_NUM_FEATURES=14, HEART_EXPECTED_NUM_TRAINING_DATA=250, stage
+flow, best-λ selection, malformed-weight failure cases).  These tests drive
+OUR production reader and CLI over the very same files — wire-format parity
+(pure-python Avro codec vs their Java-written containers) plus pipeline
+behavior on real data, not synthetic look-alikes.
+
+Skipped wholesale when the reference checkout is absent.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+_REF_INPUT = ("/root/reference/photon-client/src/integTest/resources/"
+              "DriverIntegTest/input")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_REF_INPUT), reason="reference fixtures not present")
+
+
+def _heart(*parts):
+    return os.path.join(_REF_INPUT, *parts)
+
+
+def test_production_reader_reads_reference_heart():
+    """Our reader on their heart.avro: 250 rows, 13 features + intercept = 14
+    (DriverTest.scala HEART_EXPECTED_* constants); schema uses 'label' (the
+    TrainingExample writer's name), remapped via input columns exactly as the
+    reference's InputColumnsNames machinery would."""
+    from photon_ml_tpu.data.index_map import build_index_maps_from_records
+    from photon_ml_tpu.data.avro import read_directory
+    from photon_ml_tpu.data.reader import read_game_data_avro
+
+    records = list(read_directory(_heart("heart.avro")))
+    assert len(records) == 250
+
+    index_maps = build_index_maps_from_records(records, {"all": None},
+                                               add_intercept=True)
+    data, _ = read_game_data_avro(
+        [_heart("heart.avro")], index_maps, records=records,
+        input_columns={"response": "label"})
+    assert data.num_samples == 250
+    assert data.features["all"].shape == (250, 14)
+    labels = set(np.unique(np.asarray(data.y)))
+    assert labels == {0.0, 1.0}
+    # intercept column is all ones
+    ii = index_maps["all"].intercept_index
+    np.testing.assert_array_equal(data.features["all"][:, ii], 1.0)
+
+
+def test_cli_e2e_on_reference_heart(tmp_path):
+    """Full train driver on their heart.avro + heart_validation.avro over a
+    λ grid with standard-deviation scaling — the reference's
+    testRunWithValidation scenario (DriverTest.scala:110-150): all grid
+    models trained, a best model selected by validation AUC, and the learned
+    classifier actually separates the data."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", _heart("heart.avro"),
+        "--validation-data", _heart("heart_validation.avro"),
+        "--input-columns", "response=label",
+        "--feature-shards", "all",
+        "--coordinate", "name=global,feature.shard=all,reg.weights=0.1|1|10|100",
+        "--evaluators", "auc,logistic_loss",
+        "--normalization", "SCALE_WITH_STANDARD_DEVIATION",
+        "--model-output-mode", "ALL",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["train_samples"] == 250
+    # Quality bar in the reference's captured-baseline style
+    # (GameTrainingDriverIntegTest RMSE<=1.2): an independent scipy L-BFGS
+    # solve of the same grid tops out at validation AUC 0.82292 (λ=100 on the
+    # 20-row validation set) — our grid-best must match that ceiling.
+    assert abs(summary["validation"]["auc"] - 0.8229167) < 2e-3, \
+        summary["validation"]
+    # all four grid models were trained and persisted (ModelOutputMode.ALL —
+    # the reference's DriverTest asserts one text model per λ the same way)
+    assert os.path.isdir(os.path.join(out, "best", "fixed-effect", "global"))
+    model_dirs = os.listdir(os.path.join(out, "models"))
+    assert len(model_dirs) == 4
+
+
+@pytest.mark.parametrize("fixture", ["zero-weights.avro", "negative-weights.avro"])
+def test_cli_rejects_reference_bad_weight_fixtures(tmp_path, fixture):
+    """The reference ships malformed-weight fixtures and expects the driver
+    to fail validation (SURVEY §4 'bad-input tests'); our VALIDATE_FULL path
+    must reject the same files."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    rc = train_cli.run([
+        "--train-data", _heart("bad-weights", fixture),
+        "--input-columns", "response=label",
+        "--feature-shards", "all",
+        "--coordinate", "name=global,feature.shard=all,reg.weights=1",
+        "--output-dir", str(tmp_path / "o"),
+    ])
+    assert rc == 1
+
+
+def test_cli_input_columns_on_reference_renamed_fixture(tmp_path):
+    """Their different-column-names fixture (the_label/metadata/w/intercept)
+    trains through --input-columns remapping (reference InputColumnsNames +
+    different-column-names DriverTest case)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", _heart("different-column-names", "diff-col-names.avro"),
+        "--input-columns",
+        "response=the_label,weight=w,offset=intercept,metadataMap=metadata",
+        "--feature-shards", "all",
+        "--coordinate", "name=global,feature.shard=all,reg.weights=10",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["train_samples"] == 250
+
+
+@pytest.mark.parametrize("stem,task,remap", [
+    ("linear_regression", "LINEAR_REGRESSION", True),
+    ("poisson", "POISSON_REGRESSION", False),  # poisson_test.avro already
+    # uses 'response' (and omits weight/offset/metadataMap entirely)
+])
+def test_cli_regression_tasks_on_reference_fixtures(tmp_path, stem, task, remap):
+    """Their linear/poisson fixtures train end-to-end under the matching task
+    (legacy Driver covers all task types on these files)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train = _heart(f"{stem}_train.avro")
+    val = _heart(f"{stem}_val.avro")
+    if not os.path.exists(train):
+        train = _heart(f"{stem}_test.avro")
+    args = [
+        "--train-data", train,
+        "--feature-shards", "all",
+        "--task", task,
+        "--coordinate", "name=global,feature.shard=all,reg.weights=1",
+        "--evaluators", "rmse",
+        "--output-dir", str(tmp_path / "out"),
+    ]
+    if remap:
+        args[2:2] = ["--input-columns", "response=label"]
+    if os.path.exists(val):
+        args[2:2] = ["--validation-data", val]
+    rc = train_cli.run(args)
+    assert rc == 0
+
+
+def test_a9a_quickstart_auc_parity():
+    """BASELINE.md config #1 correctness gate: the reference README
+    quick-start trains logistic regression on the bundled UCI adult (a9a)
+    libsvm data; canonical test AUC for L2 logistic on a9a is ~0.902.  Our
+    full estimator path must reach it (the perf bench rides this config)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.data.reader import read_libsvm
+    from photon_ml_tpu.evaluation.metrics import auc_roc
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, GameEstimator
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import TaskType
+
+    xtr, ytr, ii = read_libsvm(_heart("a9a"))
+    xte, yte, _ = read_libsvm(_heart("a9a.t"), num_features=xtr.shape[1] - 1)
+    assert xtr.shape == (32561, 124) and xte.shape == (16281, 124)
+
+    cfg = GameConfig(task=TaskType.LOGISTIC_REGRESSION, coordinates={
+        "g": FixedEffectConfig(feature_shard="all",
+                               solver=SolverConfig(max_iters=100, tolerance=1e-7),
+                               reg=Regularization(l2=1.0), intercept_index=ii)})
+    res = GameEstimator().fit(GameData(y=ytr, features={"all": xtr},
+                                       id_tags={}), [cfg])[0]
+    w = np.asarray(res.model["g"].coefficients.means)
+    auc = float(auc_roc(jnp.asarray(xte @ w), jnp.asarray(yte),
+                        jnp.ones(len(yte))))
+    assert auc > 0.895, auc
