@@ -71,6 +71,23 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     )
     reg_type = RegularizationType[kv.pop("reg.type", "L2").upper()]
     variance = VarianceComputationType[kv.pop("variance.type", "NONE").upper()]
+    storage_dtype = kv.pop("storage.dtype", None)  # e.g. bfloat16 (mixed precision)
+    if storage_dtype is not None:
+        # fail at parse time with the key name, like every other grammar key
+        import ml_dtypes  # registers bfloat16/float8 etc. with numpy  # noqa: F401
+        import numpy as _np
+
+        try:
+            itemsize = _np.dtype(storage_dtype).itemsize
+        except TypeError as e:
+            raise ValueError(
+                f"coordinate {name!r}: storage.dtype={storage_dtype!r} is not "
+                "a dtype (use e.g. bfloat16 or float16)") from e
+        if itemsize >= 4:
+            raise ValueError(
+                f"coordinate {name!r}: storage.dtype={storage_dtype!r} is not "
+                "narrower than the f32 compute dtype — mixed-precision "
+                "storage only makes sense at 16 bits or less")
     alpha = float(kv.pop("reg.alpha", 0.5))
     weights = [float(w) for w in kv.pop("reg.weights", "0").split("|")]
 
@@ -95,6 +112,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             intercept_index=(int(kv["intercept.index"])
                              if "intercept.index" in kv else None),
             variance=variance,
+            storage_dtype=storage_dtype,
         )
         per_entity_file = kv.pop("per.entity.l2.multipliers", None)
         for consumed in ("active.data.upper.bound", "projected.dim",
@@ -108,6 +126,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             solver=solver,
             down_sampling_rate=float(kv.pop("down.sampling.rate", 1.0)),
             variance=variance,
+            storage_dtype=storage_dtype,
         )
     if kv:
         raise ValueError(f"unknown coordinate spec keys for {name!r}: {sorted(kv)}")

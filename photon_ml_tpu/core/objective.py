@@ -39,12 +39,18 @@ Array = jax.Array
 
 
 def _xt_dot(batch: Batch, r: Array, dim: int) -> Array:
-    """X^T r against the raw design matrix (the gradient's scatter/reduce)."""
+    """X^T r against the raw design matrix (the gradient's scatter/reduce).
+
+    Mixed precision mirrors DenseBatch.margins: narrow-stored x with MXU
+    operands at storage width, accumulation/result at the residual's width."""
     if isinstance(batch, DenseBatch):
+        if batch.x.dtype != r.dtype:
+            return jnp.matmul(batch.x.T, r.astype(batch.x.dtype),
+                              preferred_element_type=r.dtype)
         return batch.x.T @ r
     # Row-padded COO: scatter-add each value*r into its feature slot.  Padded
     # slots have value 0 so they contribute nothing wherever they point.
-    contrib = batch.values * r[..., None]
+    contrib = batch.values.astype(r.dtype) * r[..., None]
     return jnp.zeros((dim,), contrib.dtype).at[batch.indices].add(contrib)
 
 
@@ -71,11 +77,16 @@ class GLMObjective:
         return self.replace(reg=reg)
 
     @staticmethod
-    def _fused_eligible(batch: Batch) -> bool:
+    def _fused_eligible(batch: Batch, w: Array = None) -> bool:
         """Trace-time gate for the pallas kernels; ineligible batches fall
-        through to the reference XLA path below (single home for that math)."""
+        through to the reference XLA path below (single home for that math).
+        Mixed-precision storage (x narrower than w) uses the XLA path — the
+        pallas kernels assume one uniform dtype."""
         from photon_ml_tpu.ops.fused_glm import eligible
 
+        if (w is not None and isinstance(batch, DenseBatch)
+                and batch.x.dtype != w.dtype):
+            return False
         return eligible(batch)
 
     # -- margins ----------------------------------------------------------------
@@ -131,7 +142,7 @@ class GLMObjective:
         normalization chain applied.  These are plain data-sums, so SPMD
         callers (parallel/fixed.ShardMapObjective) psum them across shards
         before finishing with ``finish_value_and_grad``."""
-        if self.fused and self._fused_eligible(batch):
+        if self.fused and self._fused_eligible(batch, w):
             from photon_ml_tpu.ops.fused_glm import fused_value_and_grad
 
             raw_val, g_raw, r_sum = fused_value_and_grad(
@@ -164,7 +175,7 @@ class GLMObjective:
 
     def raw_hvp(self, w: Array, batch: Batch, v: Array) -> Tuple[Array, Array]:
         """(X^T q, Σ q) raw sums — psum-able like raw_value_and_grad."""
-        if self.fused and self._fused_eligible(batch):
+        if self.fused and self._fused_eligible(batch, w):
             from photon_ml_tpu.ops.fused_glm import fused_hvp
 
             eff_v = self.norm.effective_coefficients(v)
@@ -200,7 +211,7 @@ class GLMObjective:
         d = w.shape[-1]
         if isinstance(batch, DenseBatch):
             x2 = _xt_dot(batch.replace(x=batch.x * batch.x), q, d)
-            x1 = batch.x.T @ q if self.norm.shifts is not None else None
+            x1 = _xt_dot(batch, q, d) if self.norm.shifts is not None else None
         else:
             b2 = batch.replace(values=batch.values * batch.values)
             x2 = _xt_dot(b2, q, d)

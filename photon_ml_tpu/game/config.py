@@ -32,6 +32,12 @@ class FixedEffectConfig:
     # DistributedOptimizationProblem.scala:84-108; stored in
     # BayesianLinearModelAvro.variances)
     variance: VarianceComputationType = VarianceComputationType.NONE
+    # Mixed precision (TPU-native; no reference analog — the JVM is f64):
+    # store the design matrix at this width ("bfloat16"/"float16") while the
+    # solver state, reductions, labels and weights stay at the compute dtype.
+    # Matmuls run with storage-width MXU operands and compute-width
+    # accumulation — halves objective-pass HBM traffic on large n.
+    storage_dtype: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +59,8 @@ class RandomEffectConfig:
     features_to_samples_ratio: Optional[float] = None  # per-entity Pearson top-k cap
     intercept_index: Optional[int] = None  # column the Pearson filter must keep
     variance: VarianceComputationType = VarianceComputationType.NONE
+    # Mixed-precision design-matrix storage (see FixedEffectConfig).
+    storage_dtype: Optional[str] = None
     # Per-entity regularization: multiplicative factors on this coordinate's
     # L2 weight, keyed by entity id (the reference ENVISIONED per-entity λ —
     # RandomEffectOptimizationProblem.scala:42 keeps one problem per entity

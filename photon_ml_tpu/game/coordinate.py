@@ -147,6 +147,16 @@ class Coordinate:
                 dataclasses.replace(self.config, reg=regime))
 
 
+def _storage_np_dtype(storage_dtype: Optional[str]):
+    """Resolve a config storage dtype string to a numpy dtype (ml_dtypes
+    registers bfloat16 etc. with numpy), or None when unset."""
+    if storage_dtype is None:
+        return None
+    import ml_dtypes  # noqa: F401  (registers the 16-bit dtypes with numpy)
+
+    return np.dtype(storage_dtype)
+
+
 class FixedEffectCoordinate(Coordinate):
     """Global GLM coordinate (reference FixedEffectCoordinate.scala:35-166)."""
 
@@ -166,20 +176,27 @@ class FixedEffectCoordinate(Coordinate):
         y = jnp.asarray(np.asarray(data.y, dtype))
         offs0 = jnp.asarray(np.asarray(data.offset, dtype))
         wt0 = jnp.asarray(np.asarray(data.weight, dtype))
+        # Storage narrowing happens ON HOST so the device transfer and the
+        # resident array are storage-width from the start (an on-device cast
+        # would transfer f32 and transiently hold both copies in HBM).
+        x_dtype = _storage_np_dtype(config.storage_dtype) or dtype
         if isinstance(shard_data, SparseShard):
             batch = SparseBatch(
                 indices=jnp.asarray(shard_data.indices),
-                values=jnp.asarray(np.asarray(shard_data.values, dtype)),
+                values=jnp.asarray(np.asarray(shard_data.values, x_dtype)),
                 y=y, offset=offs0, weight=wt0, dim=shard_data.dim)
         else:
-            batch = DenseBatch(x=jnp.asarray(np.asarray(shard_data, dtype)),
+            batch = DenseBatch(x=jnp.asarray(np.asarray(shard_data, x_dtype)),
                                y=y, offset=offs0, weight=wt0)
         # One-time row padding to the fused-kernel block granule so the
         # pallas path never re-pads (and re-copies X) per solver call.
+        # Mixed-storage batches never take the pallas path (uniform-dtype
+        # kernels), so they skip the block padding too.
         from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
 
+        fused_ok = config.storage_dtype is None and eligible(batch)
         if mesh is not None:
-            if eligible(batch):
+            if fused_ok:
                 # pad so each device's LOCAL shard is a block multiple
                 from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
@@ -188,7 +205,7 @@ class FixedEffectCoordinate(Coordinate):
                 bn = _pick_block_rows(local, batch.dim)
                 batch = _pad_rows(batch, (-(-local // bn) * bn) * n_dev)
             batch = shard_batch(batch, mesh)
-        elif eligible(batch):
+        elif fused_ok:
             batch = _pad_rows(batch, _pick_block_rows(*batch.x.shape))
         self._batch = batch
         self._padded_n = batch.num_examples
@@ -243,7 +260,7 @@ class FixedEffectCoordinate(Coordinate):
     def data_key(self) -> tuple:
         """Identity of the device data layout (reuse across optimization
         configs — reference GameEstimator prepares datasets once, fit:454-557)."""
-        return ("fixed", self.config.feature_shard)
+        return ("fixed", self.config.feature_shard, self.config.storage_dtype)
 
     def rebind(self, config: FixedEffectConfig) -> "FixedEffectCoordinate":
         """New optimization settings over the SAME device-resident data.
@@ -251,8 +268,10 @@ class FixedEffectCoordinate(Coordinate):
         argument of ``_solve``) — zero recompilation across a λ grid."""
         import copy
 
-        if config.feature_shard != self.config.feature_shard:
-            raise ValueError("rebind cannot change the feature shard")
+        if (config.feature_shard != self.config.feature_shard
+                or config.storage_dtype != self.config.storage_dtype):
+            raise ValueError("rebind cannot change the feature shard or its "
+                             "storage dtype")
         new = copy.copy(self)
         new.config = config
         if new._make_solver_key() != self._solver_key:
@@ -414,7 +433,7 @@ def _re_data_key(c: RandomEffectConfig) -> tuple:
     config differing only in optimization settings may reuse device arrays."""
     return ("random", c.random_effect_type, c.feature_shard, c.active_cap,
             c.min_active_samples, c.projector, c.projected_dim,
-            c.features_to_samples_ratio, c.intercept_index)
+            c.features_to_samples_ratio, c.intercept_index, c.storage_dtype)
 
 
 class RandomEffectCoordinate(Coordinate):
@@ -505,8 +524,11 @@ class RandomEffectCoordinate(Coordinate):
             return jax.device_put(a, NamedSharding(mesh, spec))
 
         self._put_entity = put
+        sd = _storage_np_dtype(self.config.storage_dtype)  # host-side cast:
+        # transfer + HBM residency are storage-width from the start
         self._dev = [
-            dict(x=put(b.x), y=put(b.y), w=put(b.weight),
+            dict(x=put(b.x if sd is None else np.asarray(b.x).astype(sd)),
+                 y=put(b.y), w=put(b.weight),
                  rows=put(np.where(b.rows < 0, 0, b.rows)),
                  valid=put(b.rows >= 0))
             for b in solve_buckets

@@ -202,6 +202,10 @@ def fused_value_and_grad(
     if not eligible(batch, interpret):
         raise ValueError("fused_value_and_grad called on an ineligible batch; "
                          "gate on ops.fused_glm.eligible()")
+    if batch.x.dtype != w_eff.dtype:
+        raise ValueError(
+            f"fused_value_and_grad needs one uniform dtype (x {batch.x.dtype} "
+            f"vs w {w_eff.dtype}); mixed-precision storage uses the XLA path")
 
     n, d = batch.x.shape
     bn = block_rows or _pick_block_rows(n, d)
@@ -254,6 +258,10 @@ def fused_hvp(
     if not eligible(batch, interpret):
         raise ValueError("fused_hvp called on an ineligible batch; "
                          "gate on ops.fused_glm.eligible()")
+    if batch.x.dtype != w_eff.dtype:
+        raise ValueError(
+            f"fused_hvp needs one uniform dtype (x {batch.x.dtype} "
+            f"vs w {w_eff.dtype}); mixed-precision storage uses the XLA path")
 
     n, d = batch.x.shape
     bn = block_rows or _pick_block_rows(n, d)

@@ -608,3 +608,22 @@ def test_corrupt_checkpoint_fails_cleanly(tmp_path):
     assert rc == 1
     assert not os.path.exists(os.path.join(str(tmp_path / "out3"),
                                            "training-summary.json"))
+
+
+def test_config_grammar_storage_dtype():
+    spec = parse_coordinate_spec(
+        "name=global,feature.shard=s,reg.weights=1,storage.dtype=bfloat16")
+    assert spec.template.storage_dtype == "bfloat16"
+    spec_re = parse_coordinate_spec(
+        "name=u,random.effect.type=userId,feature.shard=s,reg.weights=1,"
+        "storage.dtype=bfloat16")
+    assert spec_re.template.storage_dtype == "bfloat16"
+
+
+def test_config_grammar_storage_dtype_validation():
+    with pytest.raises(ValueError, match="storage.dtype"):
+        parse_coordinate_spec(
+            "name=g,feature.shard=s,reg.weights=1,storage.dtype=bf16")
+    with pytest.raises(ValueError, match="narrower"):
+        parse_coordinate_spec(
+            "name=g,feature.shard=s,reg.weights=1,storage.dtype=float64")

@@ -893,3 +893,33 @@ def test_fused_reg_grid_variances_use_each_lambda(rng):
     v0 = fused[0].model["fixed"].coefficients.variances
     v1 = fused[1].model["fixed"].coefficients.variances
     assert not np.allclose(v0, v1, rtol=1e-2)
+
+
+def test_storage_dtype_mixed_precision_fit(rng):
+    """storage_dtype="bfloat16": design matrices live at bf16 (half the HBM
+    bytes per objective pass) while solver state stays f32 — published
+    coefficients must track the all-f32 fit closely on both coordinate types,
+    and the fused path must accept the config."""
+    import dataclasses
+
+    data, *_ = _glmix_data(rng, n_users=6, per_user=60)
+    base = _configs(num_iters=2)
+    mixed = GameConfig(task=base.task, coordinates={
+        "fixed": dataclasses.replace(base.coordinates["fixed"],
+                                     storage_dtype="bfloat16"),
+        "per-user": dataclasses.replace(base.coordinates["per-user"],
+                                        storage_dtype="bfloat16")},
+        num_outer_iterations=2)
+
+    w32 = GameEstimator(fused=False).fit(data, [base])[0].model
+    wbf_host = GameEstimator(fused=False).fit(data, [mixed])[0].model
+    wbf_fused = GameEstimator(fused=True).fit(data, [mixed])[0].model
+
+    for m in (wbf_host, wbf_fused):
+        assert m["fixed"].coefficients.means.dtype == np.float32
+        np.testing.assert_allclose(m["fixed"].coefficients.means,
+                                   w32["fixed"].coefficients.means,
+                                   rtol=0.08, atol=0.08)
+        np.testing.assert_allclose(m["per-user"].w_stack,
+                                   w32["per-user"].w_stack,
+                                   rtol=0.15, atol=0.15)

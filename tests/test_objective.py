@@ -192,3 +192,51 @@ def test_standardization_requires_intercept(rng):
     stats = compute_feature_stats(jnp.asarray(rng.normal(size=(N, D))))
     with pytest.raises(ValueError, match="intercept"):
         bn(NormalizationType.STANDARDIZATION, stats)
+
+
+def test_mixed_precision_storage_matches_f32():
+    """bf16-stored design matrix with f32 solver state: margins/gradients/Hv
+    accumulate at f32 (preferred_element_type), so results track the all-f32
+    objective to bf16 input resolution — and outputs are f32, never bf16."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.core.batch import DenseBatch, SparseBatch
+    from photon_ml_tpu.core.losses import logistic_loss
+    from photon_ml_tpu.core.objective import GLMObjective
+    from photon_ml_tpu.core.regularization import Regularization
+
+    rng = np.random.default_rng(3)
+    n, d, k = 400, 24, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32) * 0.3
+    v = rng.normal(size=d).astype(np.float32)
+    obj = GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.5))
+
+    f32 = DenseBatch(x=jnp.asarray(x), y=jnp.asarray(y),
+                     offset=jnp.zeros(n, jnp.float32), weight=jnp.ones(n, jnp.float32))
+    bf16 = f32.replace(x=f32.x.astype(jnp.bfloat16))
+
+    for name, fn in [("value_and_grad", lambda b: obj.value_and_grad(jnp.asarray(w), b)),
+                     ("hvp", lambda b: (obj.hvp(jnp.asarray(w), b, jnp.asarray(v)),)),
+                     ("hessian_diag", lambda b: (obj.hessian_diag(jnp.asarray(w), b),))]:
+        for a, b in zip(fn(f32), fn(bf16)):
+            assert jnp.asarray(b).dtype == jnp.float32, name  # accumulation width
+            np.testing.assert_allclose(np.asarray(a, np.float64),
+                                       np.asarray(b, np.float64),
+                                       rtol=3e-2, atol=3e-2, err_msg=name)
+
+    # sparse storage narrowing follows the same contract (indices unique per
+    # row, per the SparseBatch contract)
+    idx = np.stack([rng.choice(d, size=k, replace=False) for _ in range(n)]) \
+        .astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    sp32 = SparseBatch(indices=jnp.asarray(idx), values=jnp.asarray(vals),
+                       y=jnp.asarray(y), offset=jnp.zeros(n, jnp.float32),
+                       weight=jnp.ones(n, jnp.float32), dim=d)
+    spb = sp32.replace(values=sp32.values.astype(jnp.bfloat16))
+    g32 = obj.value_and_grad(jnp.asarray(w), sp32)[1]
+    gbf = obj.value_and_grad(jnp.asarray(w), spb)[1]
+    assert gbf.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(g32), np.asarray(gbf), rtol=3e-2, atol=3e-2)
