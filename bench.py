@@ -31,7 +31,11 @@ import numpy as np
 OUTER = 2
 
 
-def _synth(rng, n_users=512, per_user=256, d_global=128, d_user=16, dtype=np.float32):
+def _synth(rng, n_users=2048, per_user=256, d_global=256, d_user=16, dtype=np.float32):
+    """Synthetic GLMix workload at production-representative scale: 524k
+    samples, 2048 entities — large enough that the accelerator's objective
+    passes are HBM/MXU-bound rather than dispatch-latency-bound (the
+    reference's target is LinkedIn-production CTR datasets, README.md:56)."""
     n = n_users * per_user
     xg = rng.normal(size=(n, d_global)).astype(dtype)
     xu = rng.normal(size=(n, d_user)).astype(dtype)
@@ -130,29 +134,44 @@ def bench_cpu_reference(xg, xu, uids, y, l2=1.0):
     return time.perf_counter() - t0
 
 
+def _impl_subprocess(impl: str, timeout: int):
+    """Run one accelerator impl in a watchdog subprocess; returns dt or None.
+    EVERY accelerator touch lives in a subprocess: a wedged device backend
+    (e.g. the tunnel after an abrupt client kill) then costs one timeout
+    instead of hanging the whole bench."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--impl", impl],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if out.returncode == 0:
+            return json.loads(out.stdout.strip().splitlines()[-1])["dt"]
+        sys.stderr.write(f"{impl} bench failed (rc {out.returncode})\n"
+                         f"{out.stderr[-2000:]}\n")
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError,
+            IndexError, TypeError) as e:
+        sys.stderr.write(f"{impl} bench unusable ({e})\n")
+    return None
+
+
 def _accel_seconds(data=None):
-    """(dt of the preferred accelerator impl, dataset) — fused first (in a
-    watchdog subprocess that synthesizes its own copy), host loop inline as
-    fallback.  ``data`` lets the caller pass pre-synthesized arrays for the
-    inline paths."""
+    """(dt of the preferred accelerator impl, dataset) — fused first, host
+    loop as fallback, both in watchdog subprocesses.  ``data`` lets the
+    caller pass pre-synthesized arrays for the inline paths."""
     impl = os.environ.get("PHOTON_BENCH_IMPL")
     if impl in ("fused", "host"):
         data = data if data is not None else _synth(np.random.default_rng(42))
         return bench_accel(*data, impl), data
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--impl", "fused"],
-            capture_output=True, text=True, timeout=1500, cwd=os.path.dirname(
-                os.path.abspath(__file__)))
-        if out.returncode == 0:
-            dt = json.loads(out.stdout.strip().splitlines()[-1])["dt"]
-            return dt, data
-        sys.stderr.write(f"fused bench failed (rc {out.returncode}); "
-                         f"falling back to host loop\n{out.stderr[-2000:]}\n")
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError) as e:
-        sys.stderr.write(f"fused bench unusable ({e}); host-loop fallback\n")
-    data = data if data is not None else _synth(np.random.default_rng(42))
-    return bench_accel(*data, "host"), data
+    fused_to = int(os.environ.get("PHOTON_BENCH_FUSED_TIMEOUT", 2400))
+    host_to = int(os.environ.get("PHOTON_BENCH_HOST_TIMEOUT", 1200))
+    dt = _impl_subprocess("fused", timeout=fused_to)
+    if dt is None:
+        sys.stderr.write("falling back to host loop\n")
+        dt = _impl_subprocess("host", timeout=host_to)
+    if dt is None:
+        raise SystemExit("accelerator unavailable: both fused and host bench "
+                         "subprocesses failed/timed out")
+    return dt, data
 
 
 def main():
