@@ -99,15 +99,26 @@ class Coordinate:
         """Host: initial device state (cold or warm-started from a model)."""
         raise NotImplementedError
 
+    def sweep_data(self):
+        """Host: pytree of device arrays the traceable steps read (the design
+        matrices).  The fused sweep passes it back through ``trace_*``'s
+        ``data=`` so the big arrays enter the compiled program as ARGUMENTS —
+        closed-over jax.Arrays lower to baked XLA constants and compile time
+        grows linearly with constant bytes."""
+        return None
+
     def trace_update(self, state, offsets: Array,
                      reg: "Optional[Regularization]" = None,
-                     key=None) -> Tuple[object, Array]:
+                     key=None, data=None) -> Tuple[object, Array]:
         """Traceable: one update against residual-folded ``offsets[n]``;
         returns (state', this coordinate's new score[n]).  ``reg`` (possibly
         traced) overrides the config's regularization weights so one compiled
         sweep serves a whole reg grid.  ``key``: per-(iteration, coordinate)
         PRNG key the fused sweep folds for stochastic per-update work
-        (down-sampling); coordinates without such work ignore it."""
+        (down-sampling); coordinates without such work ignore it.  ``data``:
+        this coordinate's ``sweep_data()`` passed back as traced arguments
+        (None = read the coordinate's own device arrays, the host-paced
+        path)."""
         raise NotImplementedError
 
     def trace_publish(self, state) -> Array:
@@ -120,7 +131,8 @@ class Coordinate:
         return jnp.zeros(0)
 
     def trace_variances(self, state, offsets: Array,
-                        reg: "Optional[Regularization]" = None, key=None):
+                        reg: "Optional[Regularization]" = None, key=None,
+                        data=None):
         """Traceable: variances at this update's iterate/offsets/reg; same
         pytree structure as ``init_sweep_variances()``."""
         raise NotImplementedError
@@ -218,8 +230,12 @@ class FixedEffectCoordinate(Coordinate):
             factors=None if norm.factors is None else jnp.asarray(norm.factors, dtype),
             shifts=None if norm.shifts is None else jnp.asarray(norm.shifts, dtype))
         self._bind_solver()
-        batch = self._batch
-        self._score = jax.jit(lambda w: batch.margins(w))
+        # The batch is an ARGUMENT of every jitted program, never a closure:
+        # closed-over jax.Arrays lower to baked XLA constants, and compile
+        # time grows linearly with constant bytes (~9s per GB-touch on CPU;
+        # far worse on the TPU backend) — X here is the biggest array in the
+        # system.
+        self._score = jax.jit(lambda w, batch: batch.margins(w))
 
     def _bind_solver(self) -> None:
         # Both paths use the pallas fused kernels (ops/fused_glm.py) where
@@ -236,15 +252,13 @@ class FixedEffectCoordinate(Coordinate):
             objective = ShardMapObjective(objective, self.mesh)
         self._objective = objective
         solve = make_solver(objective, self.config.optimizer, self.config.solver)
-        batch = self._batch
 
         # reg is a TRACED argument: a reg-weight grid re-enters this exact
         # compiled program (the optimizer/L1-regime dispatch inside
-        # make_solver stays keyed to the build-time reg — see _solver_key)
-        def _solve(w0: Array, offsets: Array, weights: Array,
-                   reg: Regularization) -> SolverResult:
-            return solve(w0, batch.replace(offset=offsets, weight=weights),
-                         objective=objective.with_reg(reg))
+        # make_solver stays keyed to the build-time reg — see _solver_key).
+        # The batch is an argument too (see __init__ compile-time note).
+        def _solve(w0: Array, batch, reg: Regularization) -> SolverResult:
+            return solve(w0, batch, objective=objective.with_reg(reg))
 
         out_shard = replicate(self.mesh) if self.mesh is not None else None
         self._solve = (jax.jit(_solve, out_shardings=out_shard)
@@ -306,13 +320,6 @@ class FixedEffectCoordinate(Coordinate):
         mult = self._down_sample_mult(keep, np.asarray(self._batch.y))
         return self._base_weight * jnp.asarray(mult)
 
-    def _traced_down_sample_weights(self, key) -> Array:
-        """Traced twin of ``_down_sample_weights`` for the fused sweep: same
-        per-task semantics, but the draw happens inside the compiled program
-        (a fresh fold of the sweep key each outer iteration, mirroring the
-        reference's new seed per update)."""
-        keep = jax.random.uniform(key, (self._padded_n,)) < self.config.down_sampling_rate
-        return self._base_weight * self._down_sample_mult(keep, self._batch.y)
 
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[FixedEffectModel] = None) -> Tuple[FixedEffectModel, SolverResult]:
@@ -329,7 +336,8 @@ class FixedEffectCoordinate(Coordinate):
             w0 = jnp.zeros(self.dim, self._dtype)
         offs = jnp.asarray(self._pad(np.asarray(total_offsets, self._dtype)))
         weights = self._down_sample_weights(seed)
-        res = self._solve(w0, offs, weights, self.config.reg)
+        res = self._solve(w0, self._batch.replace(offset=offs, weight=weights),
+                          self.config.reg)
         w_orig = self._norm.model_to_original_space(res.w, ii)
         variances = None
         if self.config.variance != VarianceComputationType.NONE:
@@ -354,7 +362,8 @@ class FixedEffectCoordinate(Coordinate):
         return model, res
 
     def score(self, model: FixedEffectModel) -> np.ndarray:
-        s = self._score(jnp.asarray(np.asarray(model.coefficients.means, self._dtype)))
+        s = self._score(jnp.asarray(np.asarray(model.coefficients.means, self._dtype)),
+                        self._batch)
         return np.asarray(s)[: self._n]
 
     def tracker_summary(self, tracker) -> dict:
@@ -373,7 +382,12 @@ class FixedEffectCoordinate(Coordinate):
                 w, self.config.intercept_index)
         return jnp.zeros(self.dim, self._dtype)
 
-    def _sweep_batch_inputs(self, offsets: Array, key) -> Tuple[Array, Array]:
+    def sweep_data(self):
+        """The batch enters the fused program as an ARGUMENT (compile-time
+        note in __init__)."""
+        return self._batch
+
+    def _sweep_batch_inputs(self, offsets: Array, key, batch) -> Tuple[Array, Array]:
         """(padded offsets, per-update weights) — the ONE definition of what a
         sweep update sees; trace_update and trace_variances must agree on it
         (down-sampled weights are re-drawn from the same key, so XLA CSEs the
@@ -381,16 +395,19 @@ class FixedEffectCoordinate(Coordinate):
         pad = self._padded_n - self._n
         offs = (jnp.pad(offsets, (0, pad)) if pad else offsets).astype(self._dtype)
         if self.config.down_sampling_rate < 1.0 and key is not None:
-            return offs, self._traced_down_sample_weights(key)
-        return offs, self._base_weight
+            keep = (jax.random.uniform(key, (self._padded_n,))
+                    < self.config.down_sampling_rate)
+            return offs, batch.weight * self._down_sample_mult(keep, batch.y)
+        return offs, batch.weight
 
     def trace_update(self, state: Array, offsets: Array,
                      reg: Optional[Regularization] = None,
-                     key=None) -> Tuple[Array, Array]:
-        offs, weights = self._sweep_batch_inputs(offsets, key)
-        res = self._solve(state, offs, weights,
+                     key=None, data=None) -> Tuple[Array, Array]:
+        batch = self._batch if data is None else data
+        offs, weights = self._sweep_batch_inputs(offsets, key, batch)
+        res = self._solve(state, batch.replace(offset=offs, weight=weights),
                           self.config.reg if reg is None else reg)
-        return res.w, self._batch.margins(self.trace_publish(res.w))[: self._n]
+        return res.w, batch.margins(self.trace_publish(res.w))[: self._n]
 
     def trace_publish(self, state: Array) -> Array:
         return self._norm.model_to_original_space(state,
@@ -408,7 +425,7 @@ class FixedEffectCoordinate(Coordinate):
 
     def trace_variances(self, state: Array, offsets: Array,
                         reg: Optional[Regularization] = None,
-                        key=None) -> Array:
+                        key=None, data=None) -> Array:
         """Traced coefficient variances at this update's iterate against this
         update's offsets, (down-sampled) weights AND traced ``reg`` — the
         exact inputs trace_update solved with, so the last iteration's values
@@ -417,10 +434,11 @@ class FixedEffectCoordinate(Coordinate):
         per update; only the final update's survive into the model)."""
         from photon_ml_tpu.opt.solve import compute_variances
 
-        offs, weights = self._sweep_batch_inputs(offsets, key)
+        batch = self._batch if data is None else data
+        offs, weights = self._sweep_batch_inputs(offsets, key, batch)
         v = compute_variances(
             self._objective.with_reg(self.config.reg if reg is None else reg),
-            state, self._batch.replace(offset=offs, weight=weights),
+            state, batch.replace(offset=offs, weight=weights),
             self.config.variance)
         return self._norm.model_to_original_space(v, self.config.intercept_index)
 
@@ -707,24 +725,31 @@ class RandomEffectCoordinate(Coordinate):
                     np.zeros((b.num_lanes, self.dim), self._dtype)))
         return tuple(lanes)
 
+    def sweep_data(self):
+        """Bucket design matrices + full-sample scoring arrays, passed into
+        the fused program as arguments (see Coordinate.sweep_data)."""
+        return dict(dev=self._dev, slots=self._sample_slots, x_full=self._x_full)
+
     def trace_update(self, state: Tuple[Array, ...], offsets: Array,
                      reg: Optional[Regularization] = None,
-                     key=None) -> Tuple[Tuple[Array, ...], Array]:
+                     key=None, data=None) -> Tuple[Tuple[Array, ...], Array]:
         # ``key`` unused: random effects have no per-update stochastic work
         # (down-sampling is a fixed-effect-only config, as in the reference).
         from photon_ml_tpu.parallel.bucketing import score_samples
 
+        if data is None:
+            data = self.sweep_data()
         reg = self.config.reg if reg is None else reg
         lane_regs = self._lane_regs(reg)
         offsets = offsets.astype(self._dtype)
         new_lanes = []
-        for bi, (lanes, dev) in enumerate(zip(state, self._dev)):
+        for bi, (lanes, dev) in enumerate(zip(state, data["dev"])):
             off_b = jnp.where(dev["valid"], offsets[dev["rows"]], 0.0)
             res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"],
                                lane_regs[bi])
             new_lanes.append(res.w)
         w_stack = self.trace_publish(tuple(new_lanes))
-        score = score_samples(w_stack, self._sample_slots, self._x_full)[: self._n]
+        score = score_samples(w_stack, data["slots"], data["x_full"])[: self._n]
         return tuple(new_lanes), score
 
     def trace_publish(self, state: Tuple[Array, ...]) -> Array:
@@ -747,14 +772,15 @@ class RandomEffectCoordinate(Coordinate):
 
     def trace_variances(self, state: Tuple[Array, ...], offsets: Array,
                         reg: Optional[Regularization] = None,
-                        key=None) -> Tuple[Array, ...]:
+                        key=None, data=None) -> Tuple[Array, ...]:
         """Traced per-entity variances at this update's lane iterates and
         traced ``reg``, vmapped per bucket exactly as the host path's
         update() does."""
+        dev_buckets = self._dev if data is None else data["dev"]
         offs = offsets.astype(self._dtype)
         lane_regs = self._lane_regs(self.config.reg if reg is None else reg)
         out = []
-        for bi, (lanes, dev) in enumerate(zip(state, self._dev)):
+        for bi, (lanes, dev) in enumerate(zip(state, dev_buckets)):
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0)
             out.append(self._vvar(lanes, dev["x"], dev["y"], off_b,
                                   dev["w"], lane_regs[bi]))

@@ -65,7 +65,6 @@ class FusedSweep:
         first = coordinates[self.order[0]]
         self._n = first.num_samples
         self._dtype = first.dtype
-        base = jnp.asarray(np.asarray(first._base_offset_host(), self._dtype))
         order, coords = self.order, self.coordinates
 
         needs_var = [coords[cid].config.variance != VarianceComputationType.NONE
@@ -73,7 +72,7 @@ class FusedSweep:
         needs_rand = [getattr(coords[cid].config, "down_sampling_rate", 1.0) < 1.0
                       for cid in self.order]
 
-        def program(states0, scores0, vars0, regs, base_key):
+        def program(states0, scores0, vars0, regs, base_key, base, datas):
             # regs: per-coordinate Regularization pytree, TRACED — a
             # reg-weight grid re-enters this one compiled program.
             # base_key: sweep PRNG key, folded per (iteration, coordinate)
@@ -82,6 +81,9 @@ class FusedSweep:
             # (DistributedOptimizationProblem.runWithSampling).  Folds are
             # emitted only for coordinates that down-sample, so the common
             # no-sampling program carries no threefry code at all.
+            # base/datas: base offsets + per-coordinate design-matrix pytrees
+            # as ARGUMENTS — closed-over device arrays would lower to baked
+            # XLA constants, with compile time linear in constant bytes.
             def body(carry, it):
                 states, scores, vars_ = (list(c) for c in carry)
                 it_key = (jax.random.fold_in(base_key, it)
@@ -95,7 +97,8 @@ class FusedSweep:
                     key = (jax.random.fold_in(it_key, i)
                            if needs_rand[i] else None)
                     states[i], scores[i] = coords[cid].trace_update(
-                        states[i], base + partial, reg=regs[i], key=key)
+                        states[i], base + partial, reg=regs[i], key=key,
+                        data=datas[i])
                     if needs_var[i]:
                         # Only the LAST update's variances survive into the
                         # published model (host-path semantics), so skip the
@@ -104,7 +107,7 @@ class FusedSweep:
                         vars_[i] = lax.cond(
                             it == self.num_iterations - 1,
                             lambda s, o, r, k: coords[cid].trace_variances(
-                                s, o, reg=r, key=k),
+                                s, o, reg=r, key=k, data=datas[i]),
                             lambda s, o, r, k: vars_[i],
                             states[i], base + partial, regs[i], key)
                     total = partial + scores[i]
@@ -118,6 +121,9 @@ class FusedSweep:
             return published, scores, vars_
 
         self._program = jax.jit(program)
+        self._base = jnp.asarray(np.asarray(first._base_offset_host(),
+                                            self._dtype))
+        self._datas = tuple(coords[cid].sweep_data() for cid in self.order)
         # Cold-start carry built eagerly: validates every coordinate's
         # fused-eligibility at construction time and is reused by run().
         self._cold = self._init_carry(None)
@@ -149,7 +155,8 @@ class FusedSweep:
         if regs is None:
             regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
         published, scores, vars_ = self._program(
-            *carry, self._vars0, tuple(regs), jax.random.PRNGKey(seed))
+            *carry, self._vars0, tuple(regs), jax.random.PRNGKey(seed),
+            self._base, self._datas)
         models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
                   for i, cid in enumerate(self.order)}
         final_scores = {cid: np.asarray(scores[i])
