@@ -82,7 +82,9 @@ def train_glm_reg_path(
     objective = GLMObjective(loss=loss_for_task(task), reg=reg0, norm=norm_ctx,
                              fused=True)
     solve = make_solver(objective, optimizer, solver, box=box)
-    fit = jax.jit(lambda obj, w0: solve(w0, batch, objective=obj))
+    # batch as an ARGUMENT (a closed-over array lowers to a baked XLA
+    # constant; compile time then grows with the dataset)
+    fit = jax.jit(lambda obj, w0, b: solve(w0, b, objective=obj))
 
     sorted_weights = sorted((float(w) for w in reg_weights), reverse=True)
     warm_start_models = warm_start_models or {}
@@ -103,7 +105,7 @@ def train_glm_reg_path(
 
         obj = objective.replace(
             reg=Regularization.from_context(reg_type, lam, elastic_net_alpha))
-        res = fit(obj, w0)
+        res = fit(obj, w0, batch)
         prev_w = res.w
 
         w_orig = norm_ctx.model_to_original_space(res.w, intercept_index)
