@@ -58,14 +58,19 @@ def _build_coordinates(xg, xu, uids, y):
     data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
     solver = SolverConfig(max_iters=30, tolerance=1e-7)
     task = TaskType.LOGISTIC_REGRESSION
+    # PHOTON_BENCH_STORAGE=bfloat16 flips on mixed-precision design-matrix
+    # storage (f32 solver state/accumulation — README "Mixed precision")
+    storage = os.environ.get("PHOTON_BENCH_STORAGE") or None
     return {
         "fixed": build_coordinate(
             "fixed", data, FixedEffectConfig(feature_shard="g", solver=solver,
-                                             reg=Regularization(l2=1.0)), task),
+                                             reg=Regularization(l2=1.0),
+                                             storage_dtype=storage), task),
         "per-user": build_coordinate(
             "per-user", data,
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
-                               solver=solver, reg=Regularization(l2=1.0)), task),
+                               solver=solver, reg=Regularization(l2=1.0),
+                               storage_dtype=storage), task),
     }
 
 

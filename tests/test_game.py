@@ -926,3 +926,27 @@ def test_storage_dtype_mixed_precision_fit(rng):
         np.testing.assert_allclose(m["per-user"].w_stack,
                                    w32["per-user"].w_stack,
                                    rtol=0.15, atol=0.15)
+
+
+def test_fused_sweep_tron_matches_host(rng):
+    """TRON (trust region + truncated CG) through the fused sweep: the
+    make_solver dispatch is optimizer-agnostic, so the whole-descent program
+    must reproduce the host-paced TRON descent on both coordinate types."""
+    import dataclasses
+
+    from photon_ml_tpu.types import OptimizerType
+
+    data, *_ = _glmix_data(rng, n_users=6, per_user=40)
+    base = _configs(num_iters=2)
+    cfg = dataclasses.replace(base, coordinates={
+        "fixed": dataclasses.replace(base.coordinates["fixed"],
+                                     optimizer=OptimizerType.TRON),
+        "per-user": dataclasses.replace(base.coordinates["per-user"],
+                                        optimizer=OptimizerType.TRON)})
+    f = GameEstimator(fused=True).fit(data, [cfg])[0].model
+    h = GameEstimator(fused=False).fit(data, [cfg])[0].model
+    np.testing.assert_allclose(f["fixed"].coefficients.means,
+                               h["fixed"].coefficients.means,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(f["per-user"].w_stack, h["per-user"].w_stack,
+                               rtol=2e-3, atol=2e-3)
