@@ -86,10 +86,11 @@ class Coordinate:
     # --- traceable-step interface (fully-jitted sweeps, game/fused.py) ---
     # The host-paced contract above crosses the device boundary per call; the
     # methods below keep the whole descent on device: ``state`` is a pytree of
-    # device arrays carried through lax.scan.  A coordinate whose
-    # configuration can't run inside one jitted program (per-update
-    # down-sampling, non-identity projection) raises NotImplementedError from
-    # init_sweep_state.
+    # device arrays carried through lax.scan.  Both built-in coordinate
+    # flavors implement every configuration (down-sampling, variances,
+    # projection all run in-program); a custom Coordinate subclass that only
+    # implements the host-paced contract inherits these raising defaults, and
+    # the estimator's fused="auto" then falls back to CoordinateDescent.
 
     @property
     def dtype(self):
@@ -527,6 +528,22 @@ class RandomEffectCoordinate(Coordinate):
                 seed=seed,
             )
             solve_buckets = self._proj.buckets
+            # Device twins of each bucket's back-projection (gather indices /
+            # shared Gaussian matrix) so trace_publish can back-project INSIDE
+            # the fused program (small arrays; closure-consts are fine here).
+            # The Gaussian matrix is SHARED across buckets — upload it once
+            # so it bakes into the program as one constant, not one per bucket.
+            from photon_ml_tpu.parallel.projection import BucketProjection
+
+            matrix_dev: Dict[int, Array] = {}
+            self._proj_dev = []
+            for p in self._proj.projections:
+                if isinstance(p, BucketProjection):
+                    self._proj_dev.append(("index", jnp.asarray(p.indices)))
+                else:
+                    dev = matrix_dev.setdefault(id(p.matrix),
+                                                jnp.asarray(p.matrix))
+                    self._proj_dev.append(("random", dev))
 
         self._bind_solver()
         self._refresh_lane_mult()
@@ -712,17 +729,15 @@ class RandomEffectCoordinate(Coordinate):
     # State = tuple of per-bucket lane coefficient arrays [(lanes, d), ...].
 
     def init_sweep_state(self, init: Optional[RandomEffectModel] = None) -> Tuple[Array, ...]:
-        if self._proj is not None:
-            raise NotImplementedError(
-                f"coordinate {self.coordinate_id!r} solves in a projected "
-                "space — use the host-paced CoordinateDescent")
         lanes = []
         for bi, b in enumerate(self.buckets.buckets):
             if init is not None:
                 lanes.append(self._put_entity(self._warm_start(bi, init)))
             else:
+                # cold lanes in the SOLVE space (projected dim per bucket)
+                solve_dim = self._dev[bi]["x"].shape[2]
                 lanes.append(self._put_entity(
-                    np.zeros((b.num_lanes, self.dim), self._dtype)))
+                    np.zeros((b.num_lanes, solve_dim), self._dtype)))
         return tuple(lanes)
 
     def sweep_data(self):
@@ -755,8 +770,25 @@ class RandomEffectCoordinate(Coordinate):
     def trace_publish(self, state: Tuple[Array, ...]) -> Array:
         from photon_ml_tpu.parallel.bucketing import stack_bucket_lanes
 
+        if self._proj is not None:
+            # traced twin of ProjectedBuckets.back_project (margin-exact):
+            # lanes return to full dim before stacking
+            state = tuple(self._traced_back_project(bi, lanes)
+                          for bi, lanes in enumerate(state))
         return stack_bucket_lanes(state, self._slot_idx_dev,
                                   len(self._sorted_ids))
+
+    def _traced_back_project(self, bi: int, lanes: Array) -> Array:
+        kind, arr = self._proj_dev[bi]
+        if kind == "random":
+            return lanes @ arr.T  # shared Gaussian (ProjectionMatrix.scala:127)
+        # index compaction: scatter each lane's projected slots into full dim;
+        # padded slots (idx<0) carry value 0, so colliding on column 0 is inert
+        e = lanes.shape[0]
+        safe = jnp.where(arr < 0, 0, arr)
+        vals = jnp.where(arr >= 0, lanes, 0.0)
+        out = jnp.zeros((e, self.dim), lanes.dtype)
+        return out.at[jnp.arange(e)[:, None], safe].add(vals)
 
     def export_model(self, published: np.ndarray) -> RandomEffectModel:
         return RandomEffectModel(

@@ -59,9 +59,11 @@ class GameEstimator:
         ``fused``: "auto" (default) runs each configuration as ONE jitted
         program (game/fused.FusedSweep — no host round-trips between
         coordinate updates) whenever the fit has no per-update host work
-        (no validation suite, checkpointing, locked coordinates, or resume)
-        and every coordinate is fused-eligible; True requires it (raising
-        when ineligible); False always uses the host-paced loop."""
+        (no validation suite, checkpointing, locked coordinates, or resume);
+        both built-in coordinate flavors support every configuration in the
+        fused program.  True requires the fused path (raising on per-update
+        host work, or on a custom Coordinate subclass without the
+        traceable-step interface); False always uses the host-paced loop."""
         self.mesh = mesh
         self.validation_suite = validation_suite
         self.normalization = normalization or {}
@@ -141,6 +143,9 @@ class GameEstimator:
                                            num_iterations=config.num_outer_iterations)
                         prev_sweep = (key, sweep)
                 except NotImplementedError:
+                    # a custom Coordinate subclass without the traceable-step
+                    # interface (base-class init_sweep_state raises); both
+                    # built-in flavors are always fused-eligible
                     if self.fused is True:
                         raise
                 else:

@@ -15,13 +15,14 @@ This is the TPU-native answer to the reference's persist/broadcast
 choreography between coordinate updates (CoordinateDescent.scala:208-232):
 instead of caching RDD scores between Spark jobs, the scores never leave HBM.
 
-Eligibility is decided by each coordinate's ``init_sweep_state``: projected
-random effects need the host-paced loop and raise NotImplementedError there
-(identical semantics either way).  Per-update down-sampling IS fused (the
-draw happens inside the program from a per-(iteration, coordinate) fold of
-the sweep's PRNG key), and coefficient variances ARE fused (computed in the
-scan body on the final iteration only, at the exact offsets/weights/reg of
-that coordinate's last update — what the host loop publishes).
+Every coordinate flavor is fused-eligible.  Per-update down-sampling runs
+inside the program (a per-(iteration, coordinate) fold of the sweep's PRNG
+key); coefficient variances are computed in the scan body on the final
+iteration only, at the exact offsets/weights/reg of that coordinate's last
+update (what the host loop publishes); projected random effects solve in
+their compact per-bucket spaces and back-project inside ``trace_publish``.
+Only per-fit HOST work (validation suites, checkpoint hooks, locked
+coordinates, resume) forces the host-paced CoordinateDescent.
 """
 
 from __future__ import annotations
@@ -124,8 +125,9 @@ class FusedSweep:
         self._base = jnp.asarray(np.asarray(first._base_offset_host(),
                                             self._dtype))
         self._datas = tuple(coords[cid].sweep_data() for cid in self.order)
-        # Cold-start carry built eagerly: validates every coordinate's
-        # fused-eligibility at construction time and is reused by run().
+        # Cold-start carry built eagerly: surfaces a coordinate without the
+        # traceable-step interface at construction time (base-class
+        # init_sweep_state raises) and is reused by run().
         self._cold = self._init_carry(None)
         self._vars0 = tuple(coordinates[cid].init_sweep_variances()
                             for cid in self.order)

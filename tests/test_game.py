@@ -375,23 +375,34 @@ def test_fused_sweep_warm_start(rng):
                                h2["per-user"].w_stack, rtol=2e-3, atol=2e-3)
 
 
-def test_fused_sweep_rejects_projected_space(rng):
-    """Projected random effects still need the host-paced loop; eligibility
-    is surfaced at FusedSweep construction (down-sampling and variances are
-    now fused-eligible and no longer rejected)."""
+@pytest.mark.parametrize("projector,extra", [
+    ("INDEX_MAP", {}),
+    ("RANDOM", {"projected_dim": 2}),
+])
+def test_fused_sweep_projected_space_matches_host(rng, projector, extra):
+    """Projected random effects run INSIDE the fused sweep: each bucket
+    solves in its compact space and trace_publish back-projects (traced twin
+    of ProjectedBuckets.back_project) — published models must match the
+    host-paced loop for both projector flavors."""
     import dataclasses
 
-    from photon_ml_tpu.game.fused import FusedSweep
     from photon_ml_tpu.types import ProjectorType
 
-    data, _, _, _ = _glmix_data(rng, n_users=4, per_user=30)
-    cfg = _configs()
-    re_proj = dataclasses.replace(cfg.coordinates["per-user"],
-                                  projector=ProjectorType.RANDOM,
-                                  projected_dim=2)
-    coords = {"per-user": build_coordinate("per-user", data, re_proj, cfg.task)}
-    with pytest.raises(NotImplementedError):
-        FusedSweep(coords)
+    data, _, _, _ = _glmix_data(rng, n_users=6, per_user=40)
+    base = _configs(num_iters=2)
+    cfg = dataclasses.replace(base, coordinates={
+        "fixed": base.coordinates["fixed"],
+        "per-user": dataclasses.replace(base.coordinates["per-user"],
+                                        projector=ProjectorType[projector],
+                                        **extra)})
+    f = GameEstimator(fused=True).fit(data, [cfg])[0].model
+    h = GameEstimator(fused=False).fit(data, [cfg])[0].model
+    assert f["per-user"].w_stack.shape == h["per-user"].w_stack.shape
+    np.testing.assert_allclose(f["fixed"].coefficients.means,
+                               h["fixed"].coefficients.means,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(f["per-user"].w_stack, h["per-user"].w_stack,
+                               rtol=2e-3, atol=2e-3)
 
 
 def test_variance_computation_game_path(rng, tmp_path):
@@ -492,16 +503,8 @@ def test_estimator_fused_auto_matches_host(rng):
         GameEstimator(validation_suite=suite, fused=True).fit(
             data, [cfg], validation_data=data)
 
-    # fused=True surfaces coordinate ineligibility (projected solve space)
-    import dataclasses
-
-    from photon_ml_tpu.types import ProjectorType
-
-    proj = dataclasses.replace(cfg.coordinates["per-user"],
-                               projector=ProjectorType.RANDOM, projected_dim=2)
-    bad = GameConfig(task=cfg.task, coordinates={"per-user": proj})
-    with pytest.raises(NotImplementedError):
-        GameEstimator(fused=True).fit(data, [bad])
+    # every coordinate flavor is now fused-eligible; ineligibility is only
+    # per-fit host work (validation/checkpoint/locks), asserted above
 
 
 def test_reg_grid_reuses_compiled_programs(rng):
