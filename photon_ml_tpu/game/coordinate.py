@@ -122,8 +122,9 @@ class Coordinate:
         path)."""
         raise NotImplementedError
 
-    def trace_publish(self, state) -> Array:
-        """Traceable: state -> the publishable coefficient array."""
+    def trace_publish(self, state, data=None) -> Array:
+        """Traceable: state -> the publishable coefficient array.  ``data``:
+        this coordinate's ``sweep_data()`` (same convention as trace_update)."""
         raise NotImplementedError
 
     def init_sweep_variances(self):
@@ -410,7 +411,7 @@ class FixedEffectCoordinate(Coordinate):
                           self.config.reg if reg is None else reg)
         return res.w, batch.margins(self.trace_publish(res.w))[: self._n]
 
-    def trace_publish(self, state: Array) -> Array:
+    def trace_publish(self, state: Array, data=None) -> Array:
         return self._norm.model_to_original_space(state,
                                                   self.config.intercept_index)
 
@@ -529,21 +530,25 @@ class RandomEffectCoordinate(Coordinate):
             )
             solve_buckets = self._proj.buckets
             # Device twins of each bucket's back-projection (gather indices /
-            # shared Gaussian matrix) so trace_publish can back-project INSIDE
-            # the fused program (small arrays; closure-consts are fine here).
-            # The Gaussian matrix is SHARED across buckets — upload it once
-            # so it bakes into the program as one constant, not one per bucket.
+            # shared Gaussian matrix); they travel through sweep_data() into
+            # the fused program as arguments.  The Gaussian matrix is SHARED
+            # across buckets — upload it once, not once per bucket.
             from photon_ml_tpu.parallel.projection import BucketProjection
 
+            # kinds are STATIC (python strings can't be jit-arg leaves);
+            # the arrays are the traced half
             matrix_dev: Dict[int, Array] = {}
+            self._proj_kinds = []
             self._proj_dev = []
             for p in self._proj.projections:
                 if isinstance(p, BucketProjection):
-                    self._proj_dev.append(("index", jnp.asarray(p.indices)))
+                    self._proj_kinds.append("index")
+                    self._proj_dev.append(jnp.asarray(p.indices))
                 else:
-                    dev = matrix_dev.setdefault(id(p.matrix),
-                                                jnp.asarray(p.matrix))
-                    self._proj_dev.append(("random", dev))
+                    self._proj_kinds.append("random")
+                    self._proj_dev.append(matrix_dev.setdefault(
+                        id(p.matrix), jnp.asarray(p.matrix)))
+            self._proj_dev = tuple(self._proj_dev)
 
         self._bind_solver()
         self._refresh_lane_mult()
@@ -741,9 +746,12 @@ class RandomEffectCoordinate(Coordinate):
         return tuple(lanes)
 
     def sweep_data(self):
-        """Bucket design matrices + full-sample scoring arrays, passed into
-        the fused program as arguments (see Coordinate.sweep_data)."""
-        return dict(dev=self._dev, slots=self._sample_slots, x_full=self._x_full)
+        """Bucket design matrices, full-sample scoring arrays and (when
+        projecting) back-projection arrays, passed into the fused program as
+        arguments (see Coordinate.sweep_data)."""
+        return dict(dev=self._dev, slots=self._sample_slots,
+                    x_full=self._x_full,
+                    proj=self._proj_dev if self._proj is not None else None)
 
     def trace_update(self, state: Tuple[Array, ...], offsets: Array,
                      reg: Optional[Regularization] = None,
@@ -763,23 +771,28 @@ class RandomEffectCoordinate(Coordinate):
             res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"],
                                lane_regs[bi])
             new_lanes.append(res.w)
-        w_stack = self.trace_publish(tuple(new_lanes))
+        w_stack = self.trace_publish(tuple(new_lanes), data=data)
         score = score_samples(w_stack, data["slots"], data["x_full"])[: self._n]
         return tuple(new_lanes), score
 
-    def trace_publish(self, state: Tuple[Array, ...]) -> Array:
+    def trace_publish(self, state: Tuple[Array, ...], data=None) -> Array:
         from photon_ml_tpu.parallel.bucketing import stack_bucket_lanes
 
         if self._proj is not None:
             # traced twin of ProjectedBuckets.back_project (margin-exact):
-            # lanes return to full dim before stacking
-            state = tuple(self._traced_back_project(bi, lanes)
+            # lanes return to full dim before stacking.  Projection arrays
+            # come through ``data`` so they enter the compiled program as
+            # arguments (sweep_data convention), not baked constants.
+            proj = (data or {}).get("proj")
+            if proj is None:
+                proj = self._proj_dev
+            state = tuple(self._traced_back_project(bi, proj[bi], lanes)
                           for bi, lanes in enumerate(state))
         return stack_bucket_lanes(state, self._slot_idx_dev,
                                   len(self._sorted_ids))
 
-    def _traced_back_project(self, bi: int, lanes: Array) -> Array:
-        kind, arr = self._proj_dev[bi]
+    def _traced_back_project(self, bi: int, arr: Array, lanes: Array) -> Array:
+        kind = self._proj_kinds[bi]
         if kind == "random":
             return lanes @ arr.T  # shared Gaussian (ProjectionMatrix.scala:127)
         # index compaction: scatter each lane's projected slots into full dim;

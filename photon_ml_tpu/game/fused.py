@@ -117,7 +117,8 @@ class FusedSweep:
             carry, _ = lax.scan(body, (states0, scores0, vars0),
                                 jnp.arange(self.num_iterations))
             states, scores, vars_ = carry
-            published = tuple(coords[cid].trace_publish(states[i])
+            published = tuple(coords[cid].trace_publish(states[i],
+                                                        data=datas[i])
                               for i, cid in enumerate(order))
             return published, scores, vars_
 

@@ -240,3 +240,27 @@ def test_a9a_sparse_shard_cli_e2e(tmp_path):
     assert dense["train_samples"] == n_tr
     assert sparse["validation"]["auc"] > 0.89
     assert abs(sparse["validation"]["auc"] - dense["validation"]["auc"]) < 2e-3
+
+
+def test_cli_smoothed_hinge_svm_on_reference_heart(tmp_path):
+    """SMOOTHED_HINGE_LOSS_LINEAR_SVM end-to-end on their heart data (the
+    reference's legacy Driver trains all four task types on these fixtures;
+    TaskType.scala:25).  The smoothed-hinge margin classifier must separate
+    heart comparably to the logistic run (same validation AUC ballpark)."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", _heart("heart.avro"),
+        "--validation-data", _heart("heart_validation.avro"),
+        "--input-columns", "response=label",
+        "--feature-shards", "all",
+        "--task", "SMOOTHED_HINGE_LOSS_LINEAR_SVM",
+        "--coordinate", "name=global,feature.shard=all,reg.weights=0.1|1|10",
+        "--evaluators", "auc",
+        "--normalization", "SCALE_WITH_STANDARD_DEVIATION",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["validation"]["auc"] > 0.75, summary["validation"]
