@@ -546,8 +546,10 @@ class RandomEffectCoordinate(Coordinate):
                     self._proj_dev.append(jnp.asarray(p.indices))
                 else:
                     self._proj_kinds.append("random")
-                    self._proj_dev.append(matrix_dev.setdefault(
-                        id(p.matrix), jnp.asarray(p.matrix)))
+                    key = id(p.matrix)
+                    if key not in matrix_dev:  # one upload for the shared matrix
+                        matrix_dev[key] = jnp.asarray(p.matrix)
+                    self._proj_dev.append(matrix_dev[key])
             self._proj_dev = tuple(self._proj_dev)
 
         self._bind_solver()
@@ -783,9 +785,9 @@ class RandomEffectCoordinate(Coordinate):
             # lanes return to full dim before stacking.  Projection arrays
             # come through ``data`` so they enter the compiled program as
             # arguments (sweep_data convention), not baked constants.
-            proj = (data or {}).get("proj")
-            if proj is None:
-                proj = self._proj_dev
+            if data is None:
+                data = self.sweep_data()
+            proj = data["proj"]
             state = tuple(self._traced_back_project(bi, proj[bi], lanes)
                           for bi, lanes in enumerate(state))
         return stack_bucket_lanes(state, self._slot_idx_dev,

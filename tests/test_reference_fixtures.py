@@ -264,3 +264,36 @@ def test_cli_smoothed_hinge_svm_on_reference_heart(tmp_path):
     assert rc == 0
     summary = json.load(open(os.path.join(out, "training-summary.json")))
     assert summary["validation"]["auc"] > 0.75, summary["validation"]
+
+
+def test_diagnose_driver_on_reference_heart(tmp_path):
+    """Diagnostics pipeline (bootstrap CIs, learning curve, Hosmer-Lemeshow
+    calibration, feature importance, HTML report) over their heart fixtures —
+    the legacy Driver's DIAGNOSED stage on the same data
+    (Driver.scala:431, DriverStage.scala:50)."""
+    from photon_ml_tpu.cli import diagnose as diag_cli
+    from photon_ml_tpu.cli import train as train_cli
+
+    model_out = str(tmp_path / "model")
+    assert train_cli.run([
+        "--train-data", _heart("heart.avro"),
+        "--input-columns", "response=label",
+        "--feature-shards", "all",
+        "--coordinate", "name=global,feature.shard=all,reg.weights=10",
+        "--output-dir", model_out]) == 0
+
+    diag_out = str(tmp_path / "diag")
+    rc = diag_cli.run([
+        "--data", _heart("heart.avro"),
+        "--holdout", _heart("heart_validation.avro"),
+        "--input-columns", "response=label",
+        "--model-dir", model_out,
+        "--output-dir", diag_out,
+        "--bootstrap-replicates", "8",
+    ])
+    assert rc == 0
+    report = os.path.join(diag_out, "report.html")
+    assert os.path.exists(report)
+    html = open(report).read()
+    for section in ("Bootstrap", "Hosmer"):
+        assert section.lower() in html.lower(), section
