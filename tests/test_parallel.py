@@ -352,3 +352,55 @@ def test_multihost_helpers_single_process(devices):
     total = jax.jit(lambda xx, yy: (xx.sum(), (xx.T @ yy)))(g["x"], g["y"])
     np.testing.assert_allclose(np.asarray(total[0]), x.sum(), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(total[1]), x.T @ y, rtol=1e-6)
+
+
+@pytest.mark.parametrize("variant", ["bf16_storage", "projected"])
+def test_fused_sweep_mesh_invariance_new_features(devices, rng, variant):
+    """Chip-count invariance extends to the newer fused features: bf16
+    design-matrix storage (mixed precision) and projected random effects —
+    1-device vs 8-device meshes must agree up to reduction-order noise."""
+    import dataclasses
+
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.fused import FusedSweep
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import ProjectorType, TaskType
+
+    n_users, per_user, dg, du = 16, 32, 6, 3
+    n = n_users * per_user
+    xg = rng.normal(size=(n, dg))
+    xu = rng.normal(size=(n, du))
+    uids = np.repeat(np.arange(n_users), per_user)
+    y = (rng.random(n) < 0.5).astype(float)
+    data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
+    solver = SolverConfig(max_iters=30, tolerance=1e-8)
+    task = TaskType.LOGISTIC_REGRESSION
+    fixed = FixedEffectConfig(feature_shard="g", solver=solver,
+                              reg=Regularization(l2=1.0))
+    user = RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                              solver=solver, reg=Regularization(l2=1.0))
+    if variant == "bf16_storage":
+        fixed = dataclasses.replace(fixed, storage_dtype="bfloat16")
+        user = dataclasses.replace(user, storage_dtype="bfloat16")
+        tol = dict(rtol=3e-2, atol=3e-2)  # bf16 input resolution
+    else:
+        user = dataclasses.replace(user, projector=ProjectorType.INDEX_MAP)
+        tol = dict(rtol=2e-3, atol=2e-4)
+    cfgs = {"fixed": fixed, "user": user}
+
+    models = {}
+    for label, mesh in (("one", make_mesh(n_data=1, devices=devices[:1])),
+                        ("eight", make_mesh(n_data=8, devices=devices))):
+        coords = {cid: build_coordinate(cid, data, c, task, mesh=mesh)
+                  for cid, c in cfgs.items()}
+        m, _ = FusedSweep(coords, num_iterations=2).run()
+        models[label] = m
+
+    np.testing.assert_allclose(models["one"]["fixed"].coefficients.means,
+                               models["eight"]["fixed"].coefficients.means,
+                               **tol)
+    assert models["one"]["user"].slot_of == models["eight"]["user"].slot_of
+    np.testing.assert_allclose(models["one"]["user"].w_stack,
+                               models["eight"]["user"].w_stack, **tol)
