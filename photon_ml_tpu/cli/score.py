@@ -37,6 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output-dir", required=True)
     p.add_argument("--evaluators", default="")
     p.add_argument("--model-id", default="", help="stamped into score metadata")
+    p.add_argument("--model-format", default="native",
+                   choices=["native", "reference"],
+                   help="'reference' imports a model saved by LinkedIn "
+                        "Photon ML itself (ModelProcessingUtils on-disk "
+                        "layout: model-metadata.json + fixed-effect/ + "
+                        "random-effect/) — the migration path; index maps "
+                        "are rebuilt from the stored feature names")
     p.add_argument("--predict-mean", action="store_true",
                    help="write inverse-link means instead of raw scores")
     p.add_argument("--input-date-range", default=None,
@@ -68,20 +75,32 @@ def run(argv: List[str]) -> int:
         args.data = input_paths_within_date_range(
             args.data, date_range, args.error_on_missing_date)
 
-    index_maps = {}
-    entity_indexes = {}
-    for name in os.listdir(args.model_dir):
-        if name.endswith(".idx") or name.endswith(".phidx"):
-            from photon_ml_tpu.data.index_map import load_index
+    if args.model_format == "reference":
+        from photon_ml_tpu.storage.model_io import import_reference_game_model
 
-            shard = name.rsplit(".", 1)[0]
-            index_maps[shard] = load_index(os.path.join(args.model_dir, name))
-        elif name.endswith(".entities.json"):
-            entity_indexes[name[: -len(".entities.json")]] = EntityIndex.load(
-                os.path.join(args.model_dir, name))
+        try:
+            model, task, index_maps, entity_indexes = \
+                import_reference_game_model(args.model_dir)
+        except (FileNotFoundError, KeyError) as e:
+            logger.error("--model-dir (reference format): %s", e)
+            return 1
+        logger.info("imported reference-format model: %d coordinate(s)",
+                    len(model.models))
+    else:
+        index_maps = {}
+        entity_indexes = {}
+        for name in os.listdir(args.model_dir):
+            if name.endswith(".idx") or name.endswith(".phidx"):
+                from photon_ml_tpu.data.index_map import load_index
 
-    model, task = load_game_model(os.path.join(args.model_dir, "best"),
-                                  index_maps, entity_indexes)
+                shard = name.rsplit(".", 1)[0]
+                index_maps[shard] = load_index(os.path.join(args.model_dir, name))
+            elif name.endswith(".entities.json"):
+                entity_indexes[name[: -len(".entities.json")]] = EntityIndex.load(
+                    os.path.join(args.model_dir, name))
+
+        model, task = load_game_model(os.path.join(args.model_dir, "best"),
+                                      index_maps, entity_indexes)
     id_tags = sorted(entity_indexes)
     from photon_ml_tpu.data.reader import parse_input_columns
 

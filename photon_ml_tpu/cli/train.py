@@ -122,6 +122,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-input-dir", default=None,
                    help="existing model dir for warm start "
                         "(reference GameTrainingDriver modelInputDirectory)")
+    p.add_argument("--model-input-format", default="native",
+                   choices=["native", "reference"],
+                   help="'reference' warm-starts from a model saved by "
+                        "LinkedIn Photon ML itself (ModelProcessingUtils "
+                        "layout; coordinate names must match this run's "
+                        "--coordinate names) — the migration path")
     p.add_argument("--lock-coordinates", default="",
                    help="comma-separated coordinate ids kept from the input "
                         "model and only re-scored (partial retraining, "
@@ -418,7 +424,44 @@ def _run(args, task, t_start, emitter) -> int:
             logger.error("--lock-coordinates %s not among configured coordinates %s",
                          sorted(bad), sorted(known))
             return 1
-    if args.model_input_dir:
+    if args.model_input_dir and args.model_input_format == "reference":
+        # Warm start / partial retraining FROM a model the reference itself
+        # saved (migration): stored (name, term) coefficients remap into THIS
+        # run's index maps; imported coordinate ids must match the training
+        # coordinate names for warm start to engage.
+        from photon_ml_tpu.storage.model_io import import_reference_game_model
+
+        shard_by_cid = {s.name: s.template.feature_shard for s in specs}
+        try:
+            initial_model, loaded_task, _, entity_indexes = \
+                import_reference_game_model(
+                    args.model_input_dir, entity_indexes=entity_indexes,
+                    index_maps=index_maps, shard_of=shard_by_cid)
+        except (KeyError, FileNotFoundError) as e:
+            logger.error("--model-input-dir (reference format): %s", e)
+            return 1
+        if loaded_task != task:
+            logger.error("input model task %s != --task %s", loaded_task, task)
+            return 1
+        # The imported per-entity coefficients are keyed by the model's
+        # randomEffectType; if a same-named training coordinate uses a
+        # DIFFERENT id tag, entity ids would silently misalign — refuse.
+        re_type_by_cid = {
+            s.name: s.template.random_effect_type for s in specs
+            if not isinstance(s.template, FixedEffectConfig)}
+        for cid, m in initial_model.models.items():
+            want = re_type_by_cid.get(cid)
+            got = getattr(m, "random_effect_type", None)
+            if want is not None and got is not None and want != got:
+                logger.error(
+                    "imported coordinate %r has randomEffectType %r but this "
+                    "run's coordinate uses random.effect.type=%r — entity "
+                    "ids would misalign", cid, got, want)
+                return 1
+        logger.info("imported reference-format warm-start model "
+                    "(%d coordinates%s)", len(initial_model.models),
+                    f", locked: {sorted(locked)}" if locked else "")
+    elif args.model_input_dir:
         from photon_ml_tpu.storage.model_io import load_game_model
 
         # accept either the training output dir (contains best/) or a model
@@ -470,6 +513,7 @@ def _run(args, task, t_start, emitter) -> int:
                              "evaluators": args.evaluators,
                              "lock": args.lock_coordinates,
                              "model_input": args.model_input_dir,
+                             "model_input_format": args.model_input_format,
                              "normalization": args.normalization,
                              "sparse_threshold": args.sparse_threshold,
                              "feature_shards": args.feature_shards,

@@ -157,22 +157,9 @@ def load_game_model(
         else:
             cdir = os.path.join(model_dir, "random-effect", cid)
             re_type = info["random_effect_type"]
-            eidx = entity_indexes.get(re_type)
             recs = list(avro_io.read_directory(cdir))
-            w = np.zeros((len(recs), imap.size), np.float64)
-            slot_of: Dict[int, int] = {}
-            any_var = any(r.get("variances") for r in recs)
-            variances = np.zeros((len(recs), imap.size), np.float64) if any_var else None
-            for slot, rec in enumerate(recs):
-                c = _record_to_coeff(rec, imap)
-                w[slot] = c.means
-                if variances is not None and c.variances is not None:
-                    variances[slot] = c.variances
-                if eidx is not None:
-                    eid = eidx.get_or_add(rec["modelId"])
-                else:
-                    eid = int(rec["modelId"])
-                slot_of[eid] = slot
+            w, slot_of, variances = _stack_random_effect(
+                recs, imap, entity_indexes.get(re_type))
             models[cid] = RandomEffectModel(
                 w_stack=w, slot_of=slot_of, random_effect_type=re_type,
                 feature_shard=shard, task=task, variances=variances)
@@ -188,3 +175,153 @@ def save_glm_text(model: FixedEffectModel, index_map: IndexMap, path: str) -> No
                 continue
             name, term = index_map.get_feature_name(int(j))
             f.write(f"{name}\t{term}\t{means[j]:.17g}\n")
+
+
+def _stack_random_effect(recs, imap: IndexMap,
+                         eidx: Optional[EntityIndex]):
+    """records -> (w_stack, slot_of, variances); shared by the native loader
+    and the reference-format importer."""
+    w = np.zeros((len(recs), imap.size), np.float64)
+    any_var = any(r.get("variances") for r in recs)
+    variances = np.zeros((len(recs), imap.size), np.float64) if any_var else None
+    slot_of: Dict[int, int] = {}
+    for slot, rec in enumerate(recs):
+        c = _record_to_coeff(rec, imap)
+        w[slot] = c.means
+        if variances is not None and c.variances is not None:
+            variances[slot] = c.variances
+        if eidx is not None:
+            eid = eidx.get_or_add(str(rec["modelId"]))
+        else:
+            eid = int(rec["modelId"])
+        slot_of[eid] = slot
+    return w, slot_of, variances
+
+
+def import_reference_game_model(
+    model_dir: str,
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    shard_of: Optional[Dict[str, str]] = None,
+) -> Tuple[GameModel, TaskType, Dict[str, IndexMap], Dict[str, EntityIndex]]:
+    """Import a GAME model saved by LinkedIn Photon ML ITSELF — the migration
+    path for existing users (reference on-disk layout,
+    ModelProcessingUtils.scala:77-141 save / 489-607 metadata):
+
+        <dir>/model-metadata.json
+        <dir>/fixed-effect/<coord>/id-info              ([featureShardId])
+        <dir>/fixed-effect/<coord>/coefficients/part-*.avro
+        <dir>/random-effect/<coord>/id-info             ([randomEffectType,
+                                                          featureShardId])
+        <dir>/random-effect/<coord>/**.avro             (one record/entity)
+
+    The authoritative randomEffectType / featureShardId come from each
+    coordinate's ``id-info`` file, exactly where the reference's own loader
+    reads them (ModelProcessingUtils.scala:99-101, 116-121); the directory
+    name is only the coordinate's name.  Feature index maps are REBUILT from
+    the stored (name, term) triples, keyed by featureShardId and UNIONED
+    across coordinates sharing a shard — the reference's models are
+    index-map-independent by design (coefficients stored by feature name), so
+    no PalDB store is needed to import.  Returns (model, task, index_maps
+    keyed by featureShardId, entity_indexes).
+
+    ``index_maps``/``shard_of``: remap the stored coefficients into EXISTING
+    feature index maps instead of rebuilding them — the warm-start path,
+    where the imported model must align with the training data's indexing.
+    ``shard_of`` overrides a coordinate's shard name (imported coordinate id
+    -> this run's feature-shard name).
+    """
+    import glob as _glob
+
+    from photon_ml_tpu.data.index_map import feature_key
+
+    with open(os.path.join(model_dir, "model-metadata.json")) as f:
+        meta = json.load(f)
+    task = TaskType[meta["modelType"]]
+    entity_indexes = dict(entity_indexes or {})
+    provided_maps = index_maps
+    shard_of = shard_of or {}
+
+    def _records_under(cdir: str):
+        paths = sorted(_glob.glob(os.path.join(cdir, "**", "*.avro"),
+                                  recursive=True))
+        for p in paths:
+            yield from avro_io.read_container(p)
+
+    def _id_info(cdir: str):
+        path = os.path.join(cdir, "id-info")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [line.strip() for line in f if line.strip()]
+
+    # Pass 1: scan coordinate directories, STREAMING records (only feature
+    # keys are collected — production reference models hold millions of
+    # per-entity records, which must never all live in memory at once)
+    scanned = []  # (kind, cid, cdir, re_type, shard)
+    per_shard: Dict[str, Dict[str, None]] = {}
+    for kind in ("fixed-effect", "random-effect"):
+        root = os.path.join(model_dir, kind)
+        if not os.path.isdir(root):
+            continue
+        for cid in sorted(os.listdir(root)):
+            cdir = os.path.join(root, cid)
+            if not os.path.isdir(cdir):
+                continue
+            info = _id_info(cdir)
+            if kind == "fixed-effect":
+                re_type = None
+                shard = info[0] if info else cid
+            else:
+                # dir-name '<type>-<shard>' fallback for hand-built layouts
+                re_type = info[0] if info else cid.split("-")[0]
+                shard = info[1] if len(info) > 1 else cid
+            shard = shard_of.get(cid, shard)
+            empty = True
+            keys = per_shard.setdefault(shard, {})
+            for rec in _records_under(cdir):
+                empty = False
+                if provided_maps is None:
+                    for ntv in rec["means"]:
+                        keys.setdefault(feature_key(ntv["name"],
+                                                    ntv.get("term") or ""),
+                                        None)
+            if not empty:
+                scanned.append((kind, cid, cdir, re_type, shard))
+
+    if not scanned:
+        raise FileNotFoundError(
+            f"no coordinate models found under {model_dir!r} "
+            "(expected fixed-effect/ and/or random-effect/ subdirectories)")
+
+    # Index maps per featureShardId — UNION of every sharing coordinate's
+    # features (one map per shard, like the reference)
+    if provided_maps is not None:
+        index_maps = dict(provided_maps)
+        for _, cid, _, _, shard in scanned:
+            if shard not in index_maps:
+                raise KeyError(
+                    f"imported coordinate {cid!r} needs index map for shard "
+                    f"{shard!r}; provide it (or a shard_of entry)")
+    else:
+        index_maps = {shard: IndexMap({k: i for i, k in enumerate(sorted(keys))})
+                      for shard, keys in per_shard.items()}
+
+    # Pass 2: models, re-streaming each coordinate's files one at a time
+    models: Dict[str, object] = {}
+    for kind, cid, cdir, re_type, shard in scanned:
+        imap = index_maps[shard]
+        if kind == "fixed-effect":
+            rec = next(iter(_records_under(cdir)))
+            models[cid] = FixedEffectModel(
+                coefficients=_record_to_coeff(rec, imap),
+                feature_shard=shard, task=task)
+        else:
+            eidx = entity_indexes.setdefault(re_type, EntityIndex())
+            recs = list(_records_under(cdir))
+            w, slot_of_, variances = _stack_random_effect(recs, imap, eidx)
+            models[cid] = RandomEffectModel(
+                w_stack=w, slot_of=slot_of_, random_effect_type=re_type,
+                feature_shard=shard, task=task, variances=variances)
+
+    return GameModel(models=models), task, index_maps, entity_indexes
