@@ -21,8 +21,15 @@ logger = logging.getLogger("photon_ml_tpu.index")
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-tpu-index",
                                 description="Build feature index maps from Avro data")
-    p.add_argument("--data", nargs="+", required=True)
+    p.add_argument("--data", nargs="*", default=[])
     p.add_argument("--feature-shards", required=True)
+    p.add_argument("--feature-lists", default="",
+                   help="shard=path[,shard=path...] of newline-delimited "
+                        "'name<TAB>term' feature lists (the reference "
+                        "NameAndTermFeatureBagsDriver's output format, "
+                        "consumed by its FeatureIndexingDriver) — builds "
+                        "each shard's map from the list instead of "
+                        "scanning --data")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--no-intercept", action="store_true")
     p.add_argument("--format", choices=["idx", "store"], default="idx",
@@ -36,8 +43,43 @@ def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
     shards = [s for s in args.feature_shards.split(",") if s]
-    maps = build_index_maps_from_avro(args.data, {s: [] for s in shards},
-                                      add_intercept=not args.no_intercept)
+    list_of = {}
+    for kv in (args.feature_lists or "").split(","):
+        if not kv:
+            continue
+        shard, _, path = kv.partition("=")
+        if not path:
+            logger.error("bad --feature-lists entry: %r", kv)
+            return 1
+        if shard not in shards:
+            logger.error("--feature-lists names unknown shard %r "
+                         "(--feature-shards has %s)", shard, shards)
+            return 1
+        list_of[shard] = path
+    scan_shards = [s for s in shards if s not in list_of]
+    if scan_shards and not args.data:
+        logger.error("shards %s have no --feature-lists entry and no --data "
+                     "to scan", scan_shards)
+        return 1
+    maps = {}
+    if scan_shards:
+        maps = build_index_maps_from_avro(args.data,
+                                          {s: [] for s in scan_shards},
+                                          add_intercept=not args.no_intercept)
+    for shard, path in list_of.items():
+        from photon_ml_tpu.data.index_map import IndexMap, feature_key
+
+        keys = {}
+        with open(path) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                name, _, term = line.partition("\t")
+                keys.setdefault(feature_key(name, term), None)
+        maps[shard] = IndexMap.build(keys, add_intercept=not args.no_intercept)
+        logger.info("shard %s: %d features from list %s", shard,
+                    maps[shard].size, path)
     os.makedirs(args.output_dir, exist_ok=True)
     for shard, m in maps.items():
         if args.format == "store":

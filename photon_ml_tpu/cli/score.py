@@ -81,7 +81,8 @@ def run(argv: List[str]) -> int:
         try:
             model, task, index_maps, entity_indexes = \
                 import_reference_game_model(args.model_dir)
-        except (FileNotFoundError, KeyError) as e:
+        except (FileNotFoundError, KeyError, ValueError) as e:
+            # ValueError covers json.JSONDecodeError (corrupt metadata)
             logger.error("--model-dir (reference format): %s", e)
             return 1
         logger.info("imported reference-format model: %d coordinate(s)",

@@ -433,11 +433,14 @@ def _run(args, task, t_start, emitter) -> int:
 
         shard_by_cid = {s.name: s.template.feature_shard for s in specs}
         try:
+            # subset migration: only coordinates named in this run's
+            # --coordinate specs import; others are skipped, not errors
             initial_model, loaded_task, _, entity_indexes = \
                 import_reference_game_model(
                     args.model_input_dir, entity_indexes=entity_indexes,
-                    index_maps=index_maps, shard_of=shard_by_cid)
-        except (KeyError, FileNotFoundError) as e:
+                    index_maps=index_maps, shard_of=shard_by_cid,
+                    only=set(shard_by_cid))
+        except (KeyError, FileNotFoundError, ValueError) as e:
             logger.error("--model-input-dir (reference format): %s", e)
             return 1
         if loaded_task != task:

@@ -203,6 +203,7 @@ def import_reference_game_model(
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
     index_maps: Optional[Dict[str, IndexMap]] = None,
     shard_of: Optional[Dict[str, str]] = None,
+    only: Optional[set] = None,
 ) -> Tuple[GameModel, TaskType, Dict[str, IndexMap], Dict[str, EntityIndex]]:
     """Import a GAME model saved by LinkedIn Photon ML ITSELF — the migration
     path for existing users (reference on-disk layout,
@@ -229,7 +230,9 @@ def import_reference_game_model(
     feature index maps instead of rebuilding them — the warm-start path,
     where the imported model must align with the training data's indexing.
     ``shard_of`` overrides a coordinate's shard name (imported coordinate id
-    -> this run's feature-shard name).
+    -> this run's feature-shard name).  ``only`` restricts the import to the
+    named coordinates (subset migration: other coordinate directories are
+    skipped entirely, never decoded).
     """
     import glob as _glob
 
@@ -268,6 +271,8 @@ def import_reference_game_model(
             cdir = os.path.join(root, cid)
             if not os.path.isdir(cdir):
                 continue
+            if only is not None and cid not in only:
+                continue
             info = _id_info(cdir)
             if kind == "fixed-effect":
                 re_type = None
@@ -277,11 +282,14 @@ def import_reference_game_model(
                 re_type = info[0] if info else cid.split("-")[0]
                 shard = info[1] if len(info) > 1 else cid
             shard = shard_of.get(cid, shard)
-            empty = True
-            keys = per_shard.setdefault(shard, {})
-            for rec in _records_under(cdir):
-                empty = False
-                if provided_maps is None:
+            if provided_maps is not None:
+                # maps supplied: only emptiness matters — decode ONE record
+                empty = next(iter(_records_under(cdir)), None) is None
+            else:
+                empty = True
+                keys = per_shard.setdefault(shard, {})
+                for rec in _records_under(cdir):
+                    empty = False
                     for ntv in rec["means"]:
                         keys.setdefault(feature_key(ntv["name"],
                                                     ntv.get("term") or ""),

@@ -461,3 +461,74 @@ def test_train_cli_warm_start_from_reference_model(tmp_path):
     ii = imap.get_index("(INTERCEPT)", "")
     np.testing.assert_allclose(model["globalShard"].coefficients.means[ii],
                                3.5525033712866567)
+
+
+def test_index_driver_from_reference_feature_lists(tmp_path):
+    """Index driver consuming the reference's own feature-list files
+    (NameAndTermFeatureBagsDriver output, 'name<TAB>term' lines —
+    GameIntegTest/input/feature-lists), exactly what its
+    FeatureIndexingDriver turns into PalDB stores."""
+    from photon_ml_tpu.cli import index as index_cli
+    from photon_ml_tpu.data.index_map import load_index
+
+    lists_dir = ("/root/reference/photon-client/src/integTest/resources/"
+                 "GameIntegTest/input/feature-lists")
+    out = str(tmp_path / "idx")
+    rc = index_cli.run([
+        "--feature-shards", "globalShard,userShard",
+        "--feature-lists",
+        f"globalShard={lists_dir}/features,userShard={lists_dir}/userFeatures",
+        "--output-dir", out,
+    ])
+    assert rc == 0
+    imap = load_index(os.path.join(out, "globalShard.idx"))
+    n_lines = len({line.rstrip("\n") for line in open(f"{lists_dir}/features")
+                   if line.strip("\n")})
+    assert imap.size == n_lines + 1  # + intercept
+    assert imap.intercept_index == 0
+    # a known feature from the list resolves
+    first = next(line for line in open(f"{lists_dir}/features")
+                 if line.strip("\n"))
+    name, _, term = first.rstrip("\n").partition("\t")
+    assert imap.get_index(name, term) >= 0
+
+
+def test_train_warm_start_subset_migration(tmp_path):
+    """Subset migration: warm-starting only SOME of the reference model's
+    coordinates — unconfigured coordinate dirs are skipped entirely (never
+    decoded), not errors."""
+    import shutil
+
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.data import avro as avro_io
+    from photon_ml_tpu.data.schemas import BAYESIAN_LINEAR_MODEL, TRAINING_EXAMPLE
+
+    src = ("/root/reference/photon-client/src/integTest/resources/"
+           "GameIntegTest/gameModel")
+    mdir = str(tmp_path / "m")
+    shutil.copytree(src, mdir)
+    # populate an RE coordinate the training run will NOT configure
+    avro_io.write_container(
+        os.path.join(mdir, "random-effect", "userId-userShard", "part-00000.avro"),
+        BAYESIAN_LINEAR_MODEL,
+        [{"modelId": "alice", "modelClass": "x", "lossFunction": "",
+          "means": [{"name": "u", "term": "1", "value": 0.5}],
+          "variances": None}])
+
+    rng = np.random.default_rng(2)
+    records = [{"uid": i, "response": float(rng.normal()), "label": None,
+                "features": [{"name": "u", "term": "1",
+                              "value": float(rng.normal())}],
+                "weight": None, "offset": None, "metadataMap": None}
+               for i in range(80)]
+    dp = str(tmp_path / "d.avro")
+    avro_io.write_container(dp, TRAINING_EXAMPLE, records)
+
+    rc = train_cli.run([
+        "--train-data", dp, "--feature-shards", "all",
+        "--task", "LINEAR_REGRESSION",
+        "--coordinate", "name=globalShard,feature.shard=all,reg.weights=1",
+        "--model-input-dir", mdir, "--model-input-format", "reference",
+        "--output-dir", str(tmp_path / "out"),
+    ])
+    assert rc == 0
