@@ -13,7 +13,8 @@ import os
 import sys
 from typing import List
 
-from photon_ml_tpu.data.index_map import build_index_maps_from_avro
+from photon_ml_tpu.data.index_map import (IndexMap, build_index_maps_from_avro,
+                                           feature_key)
 
 logger = logging.getLogger("photon_ml_tpu.index")
 
@@ -67,8 +68,6 @@ def run(argv: List[str]) -> int:
                                           {s: [] for s in scan_shards},
                                           add_intercept=not args.no_intercept)
     for shard, path in list_of.items():
-        from photon_ml_tpu.data.index_map import IndexMap, feature_key
-
         keys = {}
         with open(path) as f:
             for line in f:

@@ -132,6 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated coordinate ids kept from the input "
                         "model and only re-scored (partial retraining, "
                         "reference partialRetrainLockedCoordinates)")
+    p.add_argument("--export-reference-model", default=None,
+                   help="ALSO write the best model in the reference's "
+                        "ModelProcessingUtils on-disk layout to this dir so "
+                        "Spark-side Photon ML can load it (bidirectional "
+                        "migration)")
     p.add_argument("--event-listener", action="append", default=[], dest="event_listeners",
                    help="'module.path:ClassName' lifecycle EventListener (repeatable)")
     p.add_argument("--checkpoint-dir", default=None,
@@ -648,6 +653,15 @@ def _run(args, task, t_start, emitter) -> int:
                          "optimizer": c.optimizer.name}
         return spec
 
+    if args.export_reference_model:
+        # independent of --model-output-mode: an explicitly requested
+        # Spark-consumable artifact is written even under NONE
+        from photon_ml_tpu.storage.model_io import export_reference_game_model
+
+        export_reference_game_model(best.model, args.export_reference_model,
+                                    index_maps, entity_indexes, task)
+        logger.info("exported best model in reference layout -> %s",
+                    args.export_reference_model)
     if args.model_output_mode != "NONE":
         save_game_model(best.model, os.path.join(args.output_dir, "best"),
                         index_maps, entity_indexes, task)

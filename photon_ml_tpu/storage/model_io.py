@@ -66,6 +66,17 @@ def _record_to_coeff(rec: dict, index_map: IndexMap) -> Coefficients:
     return Coefficients(means=means, variances=variances)
 
 
+def _re_records(m: "RandomEffectModel", eidx: Optional[EntityIndex],
+                imap: IndexMap, loss_name: str):
+    """Per-entity BayesianLinearModelAvro records, sorted by entity id —
+    shared by the native writer and the reference-layout exporter."""
+    for eid, slot in sorted(m.slot_of.items()):
+        name = eidx.name_of(eid) if eidx is not None else None
+        var = m.variances[slot] if m.variances is not None else None
+        yield _coeff_to_record(name if name is not None else str(eid),
+                               m.w_stack[slot], var, imap, loss_name)
+
+
 def coordinate_rel_dir(cid: str, m) -> str:
     """Relative directory of one coordinate inside a model dir."""
     kind = "fixed-effect" if isinstance(m, FixedEffectModel) else "random-effect"
@@ -96,17 +107,9 @@ def save_coordinate(
     if isinstance(m, RandomEffectModel):
         imap = index_maps[m.feature_shard]
         eidx = entity_indexes.get(m.random_effect_type)
-
-        def records():
-            for eid, slot in sorted(m.slot_of.items()):
-                name = eidx.name_of(eid) if eidx is not None else None
-                var = m.variances[slot] if m.variances is not None else None
-                yield _coeff_to_record(
-                    name if name is not None else str(eid),
-                    m.w_stack[slot], var, imap, m.task.value)
-
         avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
-                                BAYESIAN_LINEAR_MODEL, records())
+                                BAYESIAN_LINEAR_MODEL,
+                                _re_records(m, eidx, imap, m.task.value))
         id_map = {str(eid): (eidx.name_of(eid) if eidx is not None else str(eid))
                   for eid in m.slot_of}
         with open(os.path.join(cdir, "id-index.json"), "w") as f:
@@ -262,6 +265,7 @@ def import_reference_game_model(
     # keys are collected — production reference models hold millions of
     # per-entity records, which must never all live in memory at once)
     scanned = []  # (kind, cid, cdir, re_type, shard)
+    skipped = []  # coordinate dirs excluded by ``only`` (for error messages)
     per_shard: Dict[str, Dict[str, None]] = {}
     for kind in ("fixed-effect", "random-effect"):
         root = os.path.join(model_dir, kind)
@@ -272,6 +276,7 @@ def import_reference_game_model(
             if not os.path.isdir(cdir):
                 continue
             if only is not None and cid not in only:
+                skipped.append(cid)
                 continue
             info = _id_info(cdir)
             if kind == "fixed-effect":
@@ -298,6 +303,10 @@ def import_reference_game_model(
                 scanned.append((kind, cid, cdir, re_type, shard))
 
     if not scanned:
+        if only is not None and skipped:
+            raise FileNotFoundError(
+                f"none of the requested coordinates {sorted(only)} exist "
+                f"under {model_dir!r}; the model contains {sorted(skipped)}")
         raise FileNotFoundError(
             f"no coordinate models found under {model_dir!r} "
             "(expected fixed-effect/ and/or random-effect/ subdirectories)")
@@ -333,3 +342,50 @@ def import_reference_game_model(
                 feature_shard=shard, task=task, variances=variances)
 
     return GameModel(models=models), task, index_maps, entity_indexes
+
+
+def export_reference_game_model(
+    model: GameModel,
+    out_dir: str,
+    index_maps: Dict[str, IndexMap],
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    task: TaskType = TaskType.LOGISTIC_REGRESSION,
+) -> None:
+    """Write a GAME model in the REFERENCE'S on-disk layout so Spark-side
+    Photon ML consumers can load it (the inverse of
+    ``import_reference_game_model``; ModelProcessingUtils.scala:77-141):
+
+        <dir>/model-metadata.json                       ({"modelType": ...})
+        <dir>/fixed-effect/<coord>/id-info              ([featureShardId])
+        <dir>/fixed-effect/<coord>/coefficients/part-00000.avro
+        <dir>/random-effect/<coord>/id-info             ([type, shardId])
+        <dir>/random-effect/<coord>/part-00000.avro
+    """
+    entity_indexes = entity_indexes or {}
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "model-metadata.json"), "w") as f:
+        json.dump({"modelType": task.name}, f, indent=2)
+
+    for cid, m in model.models.items():
+        imap = index_maps[m.feature_shard]
+        if isinstance(m, FixedEffectModel):
+            cdir = os.path.join(out_dir, "fixed-effect", cid)
+            os.makedirs(os.path.join(cdir, "coefficients"), exist_ok=True)
+            with open(os.path.join(cdir, "id-info"), "w") as f:
+                f.write(m.feature_shard + "\n")
+            rec = _coeff_to_record(cid, m.coefficients.means,
+                                   m.coefficients.variances, imap, task.value)
+            avro_io.write_container(
+                os.path.join(cdir, "coefficients", "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL, [rec])
+        elif isinstance(m, RandomEffectModel):
+            cdir = os.path.join(out_dir, "random-effect", cid)
+            os.makedirs(cdir, exist_ok=True)
+            with open(os.path.join(cdir, "id-info"), "w") as f:
+                f.write(m.random_effect_type + "\n" + m.feature_shard + "\n")
+            eidx = entity_indexes.get(m.random_effect_type)
+            avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
+                                    BAYESIAN_LINEAR_MODEL,
+                                    _re_records(m, eidx, imap, task.value))
+        else:
+            raise TypeError(f"cannot export model type {type(m)!r}")

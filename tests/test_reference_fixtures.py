@@ -532,3 +532,71 @@ def test_train_warm_start_subset_migration(tmp_path):
         "--output-dir", str(tmp_path / "out"),
     ])
     assert rc == 0
+
+
+def test_export_reference_layout_round_trip(tmp_path):
+    """Bidirectional migration: a model trained HERE exports to the
+    reference's on-disk layout and round-trips through the importer with
+    coefficients intact (the Spark side reads the same layout)."""
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.data import avro as avro_io
+    from photon_ml_tpu.data.index_map import load_index
+    from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+    from photon_ml_tpu.storage.model_io import (export_reference_game_model,
+                                                import_reference_game_model,
+                                                load_game_model)
+
+    rng = np.random.default_rng(4)
+    records = []
+    for i in range(240):
+        u = int(rng.integers(0, 5))
+        xg = rng.normal(size=2)
+        y = float(rng.random() < 1 / (1 + np.exp(-(xg[0] - xg[1]))))
+        records.append({"uid": i, "response": y, "label": None,
+                        "features": [
+                            {"name": "f0", "term": "", "value": float(xg[0])},
+                            {"name": "f1", "term": "a", "value": float(xg[1])}],
+                        "weight": None, "offset": None,
+                        "metadataMap": {"userId": f"user{u}"}})
+    dp = str(tmp_path / "d.avro")
+    avro_io.write_container(dp, TRAINING_EXAMPLE, records)
+    out = str(tmp_path / "out")
+    assert train_cli.run([
+        "--train-data", dp, "--feature-shards", "all",
+        "--coordinate", "name=g,feature.shard=all,reg.weights=1",
+        "--coordinate", "name=u,random.effect.type=userId,feature.shard=all,"
+                        "reg.weights=1",
+        "--id-tags", "userId",
+        "--output-dir", out]) == 0
+
+    imap = load_index(os.path.join(out, "all.idx"))
+    from photon_ml_tpu.data.reader import EntityIndex
+    eidx = EntityIndex.load(os.path.join(out, "userId.entities.json"))
+    model, task = load_game_model(os.path.join(out, "best"), {"all": imap},
+                                  {"userId": eidx})
+
+    ref_dir = str(tmp_path / "ref_layout")
+    export_reference_game_model(model, ref_dir, {"all": imap},
+                                {"userId": eidx}, task)
+    # round trip through the importer (fresh index maps from stored names)
+    back, task2, imaps2, eidx2 = import_reference_game_model(ref_dir)
+    assert task2 == task
+    # fixed coefficients identical feature-by-feature
+    re_imap = imaps2["all"]
+    for j in range(imap.size):
+        name, term = imap.get_feature_name(j)
+        j2 = re_imap.get_index(name, term)
+        orig = model["g"].coefficients.means[j]
+        if orig != 0.0:
+            np.testing.assert_allclose(back["g"].coefficients.means[j2], orig)
+    # per-entity: one entity's vector matches through the name remap
+    u0 = eidx.get("user0")
+    slot = model["u"].slot_of[u0]
+    u0b = eidx2["userId"].get("user0")
+    slot2 = back["u"].slot_of[u0b]
+    for j in range(imap.size):
+        name, term = imap.get_feature_name(j)
+        j2 = re_imap.get_index(name, term)
+        orig = model["u"].w_stack[slot, j]
+        if orig != 0.0:
+            np.testing.assert_allclose(back["u"].w_stack[slot2, j2], orig)
