@@ -1,0 +1,116 @@
+"""Composable GLM model validators over every task type.
+
+Reference analog (SURVEY §4): photon-api integTest supervised/* — train simple
+GLMs and assert SEMANTIC properties via composable validators
+(PredictionFiniteValidator, BinaryPredictionValidator,
+BinaryClassifierAUCValidator, NonNegativePredictionValidator,
+MaximumDifferenceValidator, CompositeModelValidator — BaseGLMIntegTest.scala
+runs the composition per task).  Here the validators are small functions
+composed per task, and the "distributed vs local" MaximumDifference check
+compares the 8-device-mesh solve against the single-device solve.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core import GLMObjective, Regularization, losses
+from photon_ml_tpu.core.batch import dense_batch
+from photon_ml_tpu.opt import SolverConfig, make_solver
+from photon_ml_tpu.parallel import fit_fixed_effect, make_mesh
+from photon_ml_tpu.types import TaskType
+
+D = 6
+
+
+# --- validators (each: (task, w, x, scores, means) -> None, raises on fail) --
+
+def prediction_finite(task, w, x, scores, means, **_):
+    """PredictionFiniteValidator: every prediction is finite."""
+    assert np.all(np.isfinite(means)), task
+
+
+def binary_prediction(task, w, x, scores, means, **_):
+    """BinaryPredictionValidator: thresholded means fall in {0, 1} and both
+    classes actually occur on a balanced problem."""
+    preds = (means > 0.5).astype(float)
+    assert set(np.unique(preds)) <= {0.0, 1.0}
+    assert 0.1 < preds.mean() < 0.9, task
+
+
+def classifier_auc(threshold):
+    def _check(task, w, x, scores, means, y=None, **_):
+        from photon_ml_tpu.evaluation.metrics import auc_roc
+
+        auc = float(auc_roc(jnp.asarray(scores), jnp.asarray(y),
+                            jnp.ones(len(y))))
+        assert auc > threshold, (task, auc)
+    return _check
+
+
+def non_negative_prediction(task, w, x, scores, means, **_):
+    """NonNegativePredictionValidator (Poisson: exp mean > 0)."""
+    assert np.all(means >= 0), task
+
+
+def max_difference(tol):
+    """MaximumDifferenceValidator: distributed (8-device mesh) vs local solve
+    coefficients agree within tol — the reference's distributed-vs-local
+    semantic bar."""
+    def _check(task, w, x, scores, means, w_local=None, **_):
+        assert np.max(np.abs(np.asarray(w) - np.asarray(w_local))) < tol, task
+    return _check
+
+
+_LOSS = {
+    TaskType.LOGISTIC_REGRESSION: losses.logistic_loss,
+    TaskType.LINEAR_REGRESSION: losses.squared_loss,
+    TaskType.POISSON_REGRESSION: losses.poisson_loss,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: losses.smoothed_hinge_loss,
+}
+
+_VALIDATORS = {  # CompositeModelValidator per task (BaseGLMIntegTest pattern)
+    TaskType.LOGISTIC_REGRESSION: [prediction_finite, binary_prediction,
+                                   classifier_auc(0.8), max_difference(5e-3)],
+    TaskType.LINEAR_REGRESSION: [prediction_finite, max_difference(5e-3)],
+    TaskType.POISSON_REGRESSION: [prediction_finite, non_negative_prediction,
+                                  max_difference(5e-3)],
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: [prediction_finite,
+                                              classifier_auc(0.8),
+                                              max_difference(5e-3)],
+}
+
+
+def _data_for(task, rng, n=800):
+    x = rng.normal(size=(n, D))
+    w_true = rng.normal(size=D) * 0.7
+    z = x @ w_true
+    if task == TaskType.LINEAR_REGRESSION:
+        y = z + rng.normal(size=n) * 0.3
+    elif task == TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(z * 0.5, -4, 3))).astype(float)
+    else:
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(float)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+@pytest.mark.parametrize("task", list(_VALIDATORS))
+def test_glm_semantic_validators(task, rng, devices):
+    x, y = _data_for(task, rng)
+    batch = dense_batch(x, y)
+    obj = GLMObjective(loss=_LOSS[task], reg=Regularization(l2=1.0))
+    cfg = SolverConfig(max_iters=60, tolerance=1e-8)
+
+    # local (single-device) and distributed (8-device mesh) solves
+    w_local = jax.jit(make_solver(obj, config=cfg))(jnp.zeros(D, jnp.float32),
+                                                    batch).w
+    w_dist = fit_fixed_effect(obj, batch, jnp.zeros(D, jnp.float32),
+                              make_mesh(n_data=8, devices=devices),
+                              config=cfg).w
+
+    scores = np.asarray(x @ np.asarray(w_dist))
+    means = np.asarray(_LOSS[task].mean(jnp.asarray(scores)))
+
+    for validator in _VALIDATORS[task]:
+        validator(task, w_dist, x, scores, means, y=y, w_local=w_local)
