@@ -627,3 +627,35 @@ def test_config_grammar_storage_dtype_validation():
     with pytest.raises(ValueError, match="narrower"):
         parse_coordinate_spec(
             "name=g,feature.shard=s,reg.weights=1,storage.dtype=float64")
+
+
+def test_score_predict_mean_and_grouped_evaluators(tmp_path):
+    """Score driver: --predict-mean writes inverse-link means (bounded (0,1)
+    for logistic) while evaluators run on RAW margins; grouped 'auc:userId'
+    spec evaluates per id-tag (reference MultiEvaluator per-tag semantics)."""
+    from photon_ml_tpu.cli import score as score_cli
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=400, seed=11)
+    out = str(tmp_path / "model")
+    assert train_cli.run([
+        "--train-data", train_path, "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--id-tags", "userId",
+        "--output-dir", out]) == 0
+
+    score_out = str(tmp_path / "scores")
+    rc = score_cli.run([
+        "--data", train_path, "--model-dir", out,
+        "--predict-mean",
+        "--evaluators", "auc,auc:userId",
+        "--output-dir", score_out,
+    ])
+    assert rc == 0
+    scores = list(avro_io.read_container(os.path.join(score_out, "scores.avro")))
+    vals = np.asarray([s["predictionScore"] for s in scores])
+    assert np.all((vals > 0) & (vals < 1))  # sigmoid means, not margins
+    metrics = json.load(open(os.path.join(score_out, "metrics.json")))
+    assert metrics["auc"] > 0.6          # raw-margin AUC unaffected by link
+    assert "auc:userId" in metrics       # grouped per-tag evaluator ran
