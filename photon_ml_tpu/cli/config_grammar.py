@@ -88,6 +88,17 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
                 f"coordinate {name!r}: storage.dtype={storage_dtype!r} is not "
                 "narrower than the f32 compute dtype — mixed-precision "
                 "storage only makes sense at 16 bits or less")
+        try:
+            # floating-ness probe that also covers ml_dtypes' custom types
+            # (this numpy registers bfloat16 with kind 'V', so issubdtype
+            # against np.floating would wrongly reject it)
+            ml_dtypes.finfo(_np.dtype(storage_dtype))
+        except ValueError:
+            # int8/uint8/bool sail past the itemsize check but silently
+            # truncate the design matrix when cast host-side
+            raise ValueError(
+                f"coordinate {name!r}: storage.dtype={storage_dtype!r} is not "
+                "a floating dtype (use bfloat16 or float16)") from None
     alpha = float(kv.pop("reg.alpha", 0.5))
     weights = [float(w) for w in kv.pop("reg.weights", "0").split("|")]
 

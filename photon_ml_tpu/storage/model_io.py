@@ -28,9 +28,24 @@ from photon_ml_tpu.types import TaskType
 
 FORMAT_VERSION = 1
 
+# JVM class the reference's loader instantiates via Class.forName(modelClass)
+# (AvroUtils.scala:382-413).  Exported models MUST carry one of these names or
+# Spark-side Photon ML throws IllegalArgumentException on load.
+REFERENCE_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+
 
 def _coeff_to_record(model_id: str, means: np.ndarray, variances: Optional[np.ndarray],
-                     index_map: IndexMap, loss_name: str) -> dict:
+                     index_map: IndexMap, loss_name: str,
+                     model_class: str = "photon_ml_tpu.GLMModel") -> dict:
     triples = []
     var_triples = []
     for j in range(len(means)):
@@ -43,7 +58,7 @@ def _coeff_to_record(model_id: str, means: np.ndarray, variances: Optional[np.nd
             var_triples.append({"name": name, "term": term, "value": float(variances[j])})
     return {
         "modelId": model_id,
-        "modelClass": "photon_ml_tpu.GLMModel",
+        "modelClass": model_class,
         "means": triples,
         "variances": var_triples if variances is not None else None,
         "lossFunction": loss_name,
@@ -67,14 +82,16 @@ def _record_to_coeff(rec: dict, index_map: IndexMap) -> Coefficients:
 
 
 def _re_records(m: "RandomEffectModel", eidx: Optional[EntityIndex],
-                imap: IndexMap, loss_name: str):
+                imap: IndexMap, loss_name: str,
+                model_class: str = "photon_ml_tpu.GLMModel"):
     """Per-entity BayesianLinearModelAvro records, sorted by entity id —
     shared by the native writer and the reference-layout exporter."""
     for eid, slot in sorted(m.slot_of.items()):
         name = eidx.name_of(eid) if eidx is not None else None
         var = m.variances[slot] if m.variances is not None else None
         yield _coeff_to_record(name if name is not None else str(eid),
-                               m.w_stack[slot], var, imap, loss_name)
+                               m.w_stack[slot], var, imap, loss_name,
+                               model_class=model_class)
 
 
 def coordinate_rel_dir(cid: str, m) -> str:
@@ -359,9 +376,16 @@ def export_reference_game_model(
         <dir>/fixed-effect/<coord>/id-info              ([featureShardId])
         <dir>/fixed-effect/<coord>/coefficients/part-00000.avro
         <dir>/random-effect/<coord>/id-info             ([type, shardId])
-        <dir>/random-effect/<coord>/part-00000.avro
+        <dir>/random-effect/<coord>/coefficients/part-00000.avro
+
+    Records carry the reference's own JVM modelClass names (loaded via
+    Class.forName, AvroUtils.scala:382-413) and random-effect records live
+    under coefficients/ exactly where the reference's loader globs them
+    (ModelProcessingUtils.scala:229 AvroConstants.COEFFICIENTS,
+    saveRandomEffectModelToHDFS:278).
     """
     entity_indexes = entity_indexes or {}
+    jvm_class = REFERENCE_MODEL_CLASS[task]
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "model-metadata.json"), "w") as f:
         json.dump({"modelType": task.name}, f, indent=2)
@@ -374,18 +398,20 @@ def export_reference_game_model(
             with open(os.path.join(cdir, "id-info"), "w") as f:
                 f.write(m.feature_shard + "\n")
             rec = _coeff_to_record(cid, m.coefficients.means,
-                                   m.coefficients.variances, imap, task.value)
+                                   m.coefficients.variances, imap, task.value,
+                                   model_class=jvm_class)
             avro_io.write_container(
                 os.path.join(cdir, "coefficients", "part-00000.avro"),
                 BAYESIAN_LINEAR_MODEL, [rec])
         elif isinstance(m, RandomEffectModel):
             cdir = os.path.join(out_dir, "random-effect", cid)
-            os.makedirs(cdir, exist_ok=True)
+            os.makedirs(os.path.join(cdir, "coefficients"), exist_ok=True)
             with open(os.path.join(cdir, "id-info"), "w") as f:
                 f.write(m.random_effect_type + "\n" + m.feature_shard + "\n")
             eidx = entity_indexes.get(m.random_effect_type)
-            avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
-                                    BAYESIAN_LINEAR_MODEL,
-                                    _re_records(m, eidx, imap, task.value))
+            avro_io.write_container(
+                os.path.join(cdir, "coefficients", "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL,
+                _re_records(m, eidx, imap, task.value, model_class=jvm_class))
         else:
             raise TypeError(f"cannot export model type {type(m)!r}")

@@ -627,6 +627,12 @@ def test_config_grammar_storage_dtype_validation():
     with pytest.raises(ValueError, match="narrower"):
         parse_coordinate_spec(
             "name=g,feature.shard=s,reg.weights=1,storage.dtype=float64")
+    # sub-4-byte but non-floating dtypes must be rejected too (they would
+    # silently truncate the design matrix at the host-side cast)
+    for bad in ("int8", "uint8", "bool"):
+        with pytest.raises(ValueError, match="floating"):
+            parse_coordinate_spec(
+                f"name=g,feature.shard=s,reg.weights=1,storage.dtype={bad}")
 
 
 def test_score_predict_mean_and_grouped_evaluators(tmp_path):

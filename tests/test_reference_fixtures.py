@@ -578,6 +578,26 @@ def test_export_reference_layout_round_trip(tmp_path):
     ref_dir = str(tmp_path / "ref_layout")
     export_reference_game_model(model, ref_dir, {"all": imap},
                                 {"userId": eidx}, task)
+
+    # The exported layout must match what the REFERENCE's loader requires —
+    # not merely what our own (glob-tolerant) importer accepts:
+    # random-effect records under coefficients/ (ModelProcessingUtils.scala:229
+    # AvroConstants.COEFFICIENTS) and a JVM modelClass that Class.forName can
+    # resolve (AvroUtils.scala:382-413).
+    fe_avro = os.path.join(ref_dir, "fixed-effect", "g", "coefficients",
+                           "part-00000.avro")
+    re_avro = os.path.join(ref_dir, "random-effect", "u", "coefficients",
+                           "part-00000.avro")
+    assert os.path.isfile(fe_avro)
+    assert os.path.isfile(re_avro)
+    fe_rec = next(iter(avro_io.read_container(fe_avro)))
+    assert fe_rec["modelClass"] == (
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel")
+    re_recs = list(avro_io.read_container(re_avro))
+    assert len(re_recs) == len(model["u"].slot_of)
+    assert all(r["modelClass"].startswith("com.linkedin.photon.ml.supervised.")
+               for r in re_recs)
+
     # round trip through the importer (fresh index maps from stored names)
     back, task2, imaps2, eidx2 = import_reference_game_model(ref_dir)
     assert task2 == task
