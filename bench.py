@@ -1,25 +1,52 @@
-"""Benchmark: GLMix 2-coordinate training throughput on the local accelerator.
+"""Benchmark: the five BASELINE.md configs on the local accelerator.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line whose required keys are the headline metric
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+(config #3, the GLMix 2-coordinate sweep — same metric as round 1) plus:
+    "backend":  the JAX platform actually measured ("axon"/"tpu" or "cpu"
+                when the accelerator tunnel is down — the bench ALWAYS emits
+                a valid line, it never hangs on a dead backend),
+    "scale":    dataset divisor applied on the cpu fallback,
+    "configs":  per-config results for all five BASELINE.md:24-28 configs:
+                a1a (LBFGS logistic), sparse1m (1M-feature Poisson TRON),
+                glmix2 (fixed+per-user), glmix3 (fixed+per-user+per-item),
+                gp_tune (Bayesian L2 auto-tune).  Each carries value/unit,
+                vs_baseline, a correctness gate (quality.pass), and a FLOP
+                estimate with MFU against the v5e bf16 peak.
 
-Config #3 of BASELINE.md (GLMix 2-coordinate: global fixed + per-user random
-effect, logistic).  The reference publishes no numbers (BASELINE.json
-published: {}), so vs_baseline is measured against a self-contained CPU
-numpy/scipy implementation of the same training loop run on this machine —
-the stand-in for the reference's Spark-CPU execution model (single-node
-local[*] is also how the reference's own regression baselines were captured,
-GameTrainingDriverIntegTest.scala:79-80).
+The reference publishes no numbers (BASELINE.json published: {}), so
+vs_baseline is measured against self-contained numpy/scipy implementations
+of the same training semantics run on this machine — the stand-in for the
+reference's Spark-CPU execution model (single-node local[*] is also how the
+reference's own regression baselines were captured,
+GameTrainingDriverIntegTest.scala:79-80).  For the single-coordinate configs
+the baseline is *time-to-target*: the wall time scipy needs to first reach
+the accelerator's final objective value.  CPU stand-in timings are cached in
+.bench_cpu_cache.json (keyed by config+sizes+target) so repeat runs don't
+re-pay scipy.
 
-Two accelerator implementations of the identical training semantics:
-  fused — the whole coordinate-descent sweep as ONE jitted scan program
-          (game/fused.FusedSweep), no host round-trips; tried first, in a
-          watchdog subprocess so a pathological compile/backend hang falls
-          back instead of wedging the bench;
-  host  — the host-paced CoordinateDescent loop (one dispatch per phase).
+Process layout: EVERY accelerator touch runs in a watchdog subprocess
+(`bench.py --config NAME --platform P`), so a wedged device backend (e.g.
+the tunnel after an abrupt client kill) costs one timeout instead of hanging
+the whole bench; scipy stand-ins run in the parent (no jax).  A fast
+`--probe` subprocess picks the platform up front.  NOTE: jax is pre-imported
+at interpreter startup in this image, so the JAX_PLATFORMS env var is
+ignored — subprocesses select the platform via jax.config.update before
+first device use.
+
+Env knobs:
+    PHOTON_BENCH_CONFIGS   comma list (default all five)
+    PHOTON_BENCH_IMPL      fused|host for the glmix sweeps (default: fused,
+                           host retry on failure)
+    PHOTON_BENCH_STORAGE   e.g. bfloat16 — mixed-precision design storage
+    PHOTON_BENCH_PROBE_TIMEOUT / PHOTON_BENCH_CONFIG_TIMEOUT (seconds)
+    PHOTON_BENCH_CPU_SCALE dataset divisor on the cpu fallback (default 8)
+    PHOTON_BENCH_CPU_REF   0 skips scipy stand-ins (vs_baseline null)
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -28,87 +55,431 @@ import time
 
 import numpy as np
 
-OUTER = 2
+OUTER = 2  # coordinate-descent sweeps timed in the glmix configs
+SOLVER_ITERS = 30  # inner solver iterations per coordinate update
+PEAK_BF16 = 197e12  # TPU v5e (v5 litepod) bf16 peak FLOP/s, for MFU
+ALL_CONFIGS = ("a1a", "sparse1m", "glmix2", "glmix3", "gp_tune")
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_CACHE = os.path.join(_REPO, ".bench_cpu_cache.json")
 
 
-def _synth(rng, n_users=2048, per_user=256, d_global=256, d_user=16, dtype=np.float32):
-    """Synthetic GLMix workload at production-representative scale: 524k
-    samples, 2048 entities — large enough that the accelerator's objective
-    passes are HBM/MXU-bound rather than dispatch-latency-bound (the
-    reference's target is LinkedIn-production CTR datasets, README.md:56)."""
+# --------------------------------------------------------------------------
+# host-side helpers (numpy/scipy only — safe with a dead accelerator)
+# --------------------------------------------------------------------------
+
+def _np_auc(y: np.ndarray, s: np.ndarray) -> float:
+    """Rank AUC (average ranks on ties), matching evaluation/metrics.py."""
+    import scipy.stats as st
+
+    y = np.asarray(y, bool)
+    r = st.rankdata(s)
+    n1 = int(y.sum())
+    n0 = len(y) - n1
+    if n1 == 0 or n0 == 0:
+        return float("nan")
+    return float((r[y].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+def _cache_get(key: str):
+    try:
+        with open(_CACHE) as f:
+            return json.load(f).get(key)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _cache_put(key: str, val) -> None:
+    try:
+        with open(_CACHE) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        d = {}
+    d[key] = val
+    with open(_CACHE, "w") as f:
+        json.dump(d, f, indent=1, sort_keys=True)
+
+
+class _TimeToTarget:
+    """Wraps a scipy objective; records (elapsed, f) per call so the caller
+    can read off the first time the trace reached a target value."""
+
+    def __init__(self, fun):
+        self.fun = fun
+        self.trace = []
+        self.t0 = time.perf_counter()
+
+    def __call__(self, w, *args):
+        f = self.fun(w, *args)
+        self.trace.append((time.perf_counter() - self.t0, float(f)))
+        return f
+
+    def time_to(self, target: float, rel: float = 1e-4):
+        bar = target + rel * abs(target)
+        for t, f in self.trace:
+            if f <= bar:
+                return t
+        return None
+
+
+# --------------------------------------------------------------------------
+# synthetic datasets — deterministic, regenerated identically in the
+# accelerator subprocess and the scipy parent
+# --------------------------------------------------------------------------
+
+def synth_a1a():
+    """a1a-shaped stand-in (the reference quick-start dataset,
+    README.md:240-297, is not redistributable in this image): 30,956 rows x
+    123 binary features + intercept, ~14 active features/row."""
+    rng = np.random.default_rng(11)
+    n, d, k = 30956, 124, 14
+    idx = np.empty((n, k), np.int32)
+    for i in range(n):  # unique per-row feature ids, like one-hot groups
+        idx[i] = rng.choice(d - 1, size=k, replace=False) + 1
+    idx[:, 0] = 0  # intercept slot
+    vals = np.ones((n, k), np.float32)
+    w_true = (rng.normal(size=d) * 0.7).astype(np.float64)
+    z = w_true[idx].sum(axis=1)
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+    return idx, vals, y, d
+
+
+def synth_sparse1m(scale: int):
+    """BASELINE #2: 1M-feature sparse Poisson. Feature ids power-law-ish so
+    hot columns exist (realistic collision pattern for the scatter-add)."""
+    rng = np.random.default_rng(12)
+    n, d, k = 131072 // scale, 1_000_000, 32
+    # half the slots draw from a hot 4096-id head, half from the 1M tail;
+    # each slot samples within its own disjoint id block, so per-row indices
+    # are unique by construction (SparseBatch contract) with no dedup pass
+    kh = k // 2
+    head_block = 4096 // kh
+    head = (np.arange(kh) * head_block)[None, :] + rng.integers(
+        0, head_block, size=(n, kh))
+    kt = k - kh
+    tail_block = (d - 4096) // kt
+    tail = 4096 + (np.arange(kt) * tail_block)[None, :] + rng.integers(
+        0, tail_block, size=(n, kt))
+    idx = np.concatenate([head, tail], axis=1).astype(np.int32)
+    vals = rng.exponential(0.5, size=(n, k)).astype(np.float32)
+    w_true = rng.normal(size=d) * 0.05
+    z = np.clip((vals * w_true[idx]).sum(axis=1), -4, 4)
+    y = rng.poisson(np.exp(z)).astype(np.float32)
+    return idx, vals, y, d
+
+
+def synth_glmix(scale: int, three: bool):
+    """BASELINE #3/#4 GLMix data: 2048 users (+1024 items for #4)."""
+    rng = np.random.default_rng(42)
+    n_users, d_g, d_u = 2048, (128 if three else 256), 16
+    per_user = (128 if three else 256) // scale
     n = n_users * per_user
-    xg = rng.normal(size=(n, d_global)).astype(dtype)
-    xu = rng.normal(size=(n, d_user)).astype(dtype)
+    xg = rng.normal(size=(n, d_g)).astype(np.float32)
+    xu = rng.normal(size=(n, d_u)).astype(np.float32)
     uids = np.repeat(np.arange(n_users), per_user)
-    wg = (rng.normal(size=d_global) * 0.5).astype(dtype)
-    wu = (rng.normal(size=(n_users, d_user)) * 1.0).astype(dtype)
+    wg = (rng.normal(size=d_g) * 0.5).astype(np.float32)
+    wu = (rng.normal(size=(n_users, d_u))).astype(np.float32)
     logits = xg @ wg + np.einsum("nd,nd->n", xu, wu[uids])
-    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(dtype)
+    out = {"xg": xg, "xu": xu, "uids": uids}
+    if three:
+        n_items, d_i = 1024, 16
+        xi = rng.normal(size=(n, d_i)).astype(np.float32)
+        iids = rng.integers(0, n_items, size=n)
+        wi = (rng.normal(size=(n_items, d_i))).astype(np.float32)
+        logits = logits + np.einsum("nd,nd->n", xi, wi[iids])
+        out.update(xi=xi, iids=iids)
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    out["y"] = y
+    perm = rng.permutation(n)
+    return {k: v[perm] for k, v in out.items()}
+
+
+def synth_tune(scale: int):
+    rng = np.random.default_rng(7)
+    n_users, per_user, d_g, d_u = 256, 256 // scale, 64, 8
+    n = n_users * per_user
+    xg = rng.normal(size=(n, d_g)).astype(np.float32)
+    xu = rng.normal(size=(n, d_u)).astype(np.float32)
+    uids = np.repeat(np.arange(n_users), per_user)
+    wg = (rng.normal(size=d_g) * 0.5).astype(np.float32)
+    wu = (rng.normal(size=(n_users, d_u))).astype(np.float32)
+    logits = xg @ wg + np.einsum("nd,nd->n", xu, wu[uids])
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
     perm = rng.permutation(n)
     return xg[perm], xu[perm], uids[perm], y[perm]
 
 
-def _build_coordinates(xg, xu, uids, y):
+# --------------------------------------------------------------------------
+# accelerator-side config runners (subprocess only)
+# --------------------------------------------------------------------------
+
+def _select_platform(platform: str | None):
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    return jax.devices()[0].platform
+
+
+def _solve_single(idx, vals, y, d, *, loss, optimizer, solver_cfg, l2):
+    """jit one make_solver fit over a SparseBatch; returns (dt, result)."""
+    import jax
+
+    from photon_ml_tpu.core.batch import sparse_batch
+    from photon_ml_tpu.core.losses import logistic_loss, poisson_loss
+    from photon_ml_tpu.core.objective import GLMObjective
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.opt.solve import make_solver
+
+    batch = sparse_batch(idx, vals, y, dim=d)
+    obj = GLMObjective(loss={"logistic": logistic_loss,
+                             "poisson": poisson_loss}[loss],
+                       reg=Regularization(l2=l2))
+    solve = jax.jit(make_solver(obj, optimizer, solver_cfg))
+    w0 = np.zeros(d, np.float32)
+    res = solve(w0, batch)
+    jax.block_until_ready(res.w)  # warm-up: compile
+    t0 = time.perf_counter()
+    res = solve(w0, batch)
+    jax.block_until_ready(res.w)
+    return time.perf_counter() - t0, res, batch
+
+
+def run_a1a(platform, scale):
+    """BASELINE #1: fixed-effect logistic LBFGS+L2 on a1a-shaped data."""
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import OptimizerType
+
+    backend = _select_platform(platform)
+    idx, vals, y, d = synth_a1a()
+    dt, res, batch = _solve_single(
+        idx, vals, y, d, loss="logistic", optimizer=OptimizerType.LBFGS,
+        solver_cfg=SolverConfig(max_iters=100, tolerance=1e-7), l2=1.0)
+    import jax.numpy as jnp
+
+    margins = np.asarray(batch.margins(jnp.asarray(res.w)))
+    iters = int(res.iterations)
+    n = len(y)
+    return {
+        "backend": backend, "dt": dt,
+        "units": n * iters, "unit": "example_iters/sec",
+        # one value+grad pass over a sparse design ~ 4 flops/nnz; LBFGS
+        # does ~1 such eval per iteration (line-search extras uncounted)
+        "flops_est": iters * 4 * n * idx.shape[1],
+        "stats": {"final_value": float(res.value), "iters": iters,
+                  "auc": _np_auc(y, margins)},
+    }
+
+
+def run_sparse1m(platform, scale):
+    """BASELINE #2: 1M-feature sparse Poisson, TRON."""
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import OptimizerType
+
+    backend = _select_platform(platform)
+    idx, vals, y, d = synth_sparse1m(scale)
+    cfg = SolverConfig.tron_default()
+    dt, res, _ = _solve_single(
+        idx, vals, y, d, loss="poisson", optimizer=OptimizerType.TRON,
+        solver_cfg=cfg, l2=1.0)
+    iters = int(res.iterations)
+    n = len(y)
+    return {
+        "backend": backend, "dt": dt,
+        "units": n * iters, "unit": "example_iters/sec",
+        # per TRON iteration: 1 value+grad + <=max_cg Hv passes, each
+        # ~4 flops/nnz (upper-bound estimate: CG often stops early)
+        "flops_est": iters * (1 + cfg.max_cg) * 4 * n * idx.shape[1],
+        "stats": {"final_value": float(res.value), "iters": iters,
+                  "mean_nll": float(res.value) / n},
+    }
+
+
+def _glmix_coords(data, three: bool):
     from photon_ml_tpu.core.regularization import Regularization
     from photon_ml_tpu.game import FixedEffectConfig, GameData, RandomEffectConfig
     from photon_ml_tpu.game.coordinate import build_coordinate
     from photon_ml_tpu.opt.types import SolverConfig
     from photon_ml_tpu.types import TaskType
 
-    data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
-    solver = SolverConfig(max_iters=30, tolerance=1e-7)
+    feats = {"g": data["xg"], "u": data["xu"]}
+    tags = {"userId": data["uids"]}
+    if three:
+        feats["i"] = data["xi"]
+        tags["itemId"] = data["iids"]
+    gd = GameData(y=data["y"], features=feats, id_tags=tags)
+    solver = SolverConfig(max_iters=SOLVER_ITERS, tolerance=1e-7)
     task = TaskType.LOGISTIC_REGRESSION
-    # PHOTON_BENCH_STORAGE=bfloat16 flips on mixed-precision design-matrix
-    # storage (f32 solver state/accumulation — README "Mixed precision")
     storage = os.environ.get("PHOTON_BENCH_STORAGE") or None
-    return {
+    coords = {
         "fixed": build_coordinate(
-            "fixed", data, FixedEffectConfig(feature_shard="g", solver=solver,
-                                             reg=Regularization(l2=1.0),
-                                             storage_dtype=storage), task),
+            "fixed", gd, FixedEffectConfig(feature_shard="g", solver=solver,
+                                           reg=Regularization(l2=1.0),
+                                           storage_dtype=storage), task),
         "per-user": build_coordinate(
-            "per-user", data,
+            "per-user", gd,
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
                                solver=solver, reg=Regularization(l2=1.0),
                                storage_dtype=storage), task),
     }
+    if three:
+        coords["per-item"] = build_coordinate(
+            "per-item", gd,
+            RandomEffectConfig(random_effect_type="itemId", feature_shard="i",
+                               solver=solver, reg=Regularization(l2=1.0),
+                               storage_dtype=storage), task)
+    return coords
 
 
-def bench_accel(xg, xu, uids, y, impl: str):
-    """Steady-state training seconds for OUTER full coordinate-descent
-    sweeps (device layout + compiles excluded via one warm-up run) — the
-    analog of timing the reference's training loop after RDDs materialize."""
-    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+def run_glmix(platform, scale, three: bool):
+    """BASELINE #3/#4: GLMix coordinate-descent sweep throughput."""
+    import jax
 
-    enable_compilation_cache()
-    coords = _build_coordinates(xg, xu, uids, y)
+    backend = _select_platform(platform)
+    data = synth_glmix(scale, three)
+    coords = _glmix_coords(data, three)
+    impl = os.environ.get("PHOTON_BENCH_IMPL", "fused")
     if impl == "fused":
         from photon_ml_tpu.game.fused import FusedSweep
 
         sweep = FusedSweep(coords, num_iterations=OUTER)
-        sweep.run()  # warm-up: compiles the whole-descent program once
+        model, scores = sweep.run()  # warm-up: compiles the whole program
         t0 = time.perf_counter()
-        sweep.run()
-        return time.perf_counter() - t0
-    from photon_ml_tpu.game import CoordinateDescent
+        model, scores = sweep.run()
+        dt = time.perf_counter() - t0
+        total = np.sum([np.asarray(s) for s in scores.values()], axis=0)
+    else:
+        from photon_ml_tpu.game import CoordinateDescent
 
-    descent = CoordinateDescent(coords, num_iterations=OUTER)
-    descent.run()  # warm-up: compiles every solver once
+        descent = CoordinateDescent(coords, num_iterations=OUTER)
+        descent.run()
+        t0 = time.perf_counter()
+        model, _, _ = descent.run()
+        dt = time.perf_counter() - t0
+        from photon_ml_tpu.game import GameData
+        feats = {"g": data["xg"], "u": data["xu"]}
+        tags = {"userId": data["uids"]}
+        if three:
+            feats["i"] = data["xi"]
+            tags["itemId"] = data["iids"]
+        total = model.score(GameData(y=data["y"], features=feats, id_tags=tags))
+    n = len(data["y"])
+    d_sum = data["xg"].shape[1] + data["xu"].shape[1] + (
+        data["xi"].shape[1] if three else 0)
+    return {
+        "backend": backend, "dt": dt, "impl": impl,
+        "units": n * OUTER, "unit": "examples/sec/chip",
+        # per sweep each coordinate runs <=SOLVER_ITERS solver iterations,
+        # each ~1 value+grad pass (4 flops per design-matrix entry)
+        "flops_est": OUTER * SOLVER_ITERS * 4 * n * d_sum,
+        "stats": {"auc": _np_auc(data["y"], np.asarray(total))},
+    }
+
+
+def run_gp_tune(platform, scale):
+    """BASELINE #5: Bayesian (GP) auto-tune of per-coordinate L2 weights."""
+    backend = _select_platform(platform)
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import (FixedEffectConfig, GameData,
+                                    GameEstimator, RandomEffectConfig)
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.tune import tune_game_model
+    from photon_ml_tpu.types import TaskType
+
+    xg, xu, uids, y = synth_tune(scale)
+    n = len(y)
+    cut = int(n * 0.8)
+    tr = GameData(y=y[:cut], features={"g": xg[:cut], "u": xu[:cut]},
+                  id_tags={"userId": uids[:cut]})
+    va = GameData(y=y[cut:], features={"g": xg[cut:], "u": xu[cut:]},
+                  id_tags={"userId": uids[cut:]})
+    solver = SolverConfig(max_iters=SOLVER_ITERS, tolerance=1e-7)
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        num_outer_iterations=OUTER,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "per-user": RandomEffectConfig(random_effect_type="userId",
+                                           feature_shard="u", solver=solver,
+                                           reg=Regularization(l2=1.0)),
+        })
+    est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
+    n_iter = 6
     t0 = time.perf_counter()
-    descent.run()
-    return time.perf_counter() - t0
+    best, search, tuned = tune_game_model(est, config, tr, va,
+                                          n_iterations=n_iter,
+                                          mode="bayesian", seed=0)
+    dt = time.perf_counter() - t0
+    aucs = [r.evaluation.values["auc"] for r in tuned]
+    return {
+        "backend": backend, "dt": dt,
+        "units": len(tuned), "unit": "tuning_fits/sec",
+        "flops_est": None,  # dominated by many small fits + GP host math
+        "stats": {"best_auc": float(best.evaluation.values["auc"]),
+                  "prior_auc": float(aucs[0]), "fits": len(tuned)},
+    }
 
 
-def bench_cpu_reference(xg, xu, uids, y, l2=1.0):
-    """Spark-CPU stand-in: scipy L-BFGS fixed effect + per-user serial scipy
-    solves, same residual coordinate-descent loop."""
+# --------------------------------------------------------------------------
+# scipy CPU stand-ins (parent process, cached)
+# --------------------------------------------------------------------------
+
+def _scipy_single(idx, vals, y, d, *, loss, l2, target, maxiter=300):
+    """scipy L-BFGS time-to-target on a sparse design."""
+    import scipy.optimize as sopt
+    import scipy.sparse as ssp
+    import scipy.special as sp
+
+    n, k = vals.shape
+    X = ssp.csr_matrix(
+        (vals.ravel().astype(np.float64),
+         (np.repeat(np.arange(n), k), idx.ravel())), shape=(n, d))
+    yy = y.astype(np.float64)
+
+    if loss == "logistic":
+        def raw(w):
+            z = X @ w
+            return float(np.sum(np.logaddexp(0, z) - yy * z) + 0.5 * l2 * w @ w)
+
+        def grad(w):
+            z = X @ w
+            return X.T @ (sp.expit(z) - yy) + l2 * w
+    else:  # poisson: l = exp(z) - y*z
+        def raw(w):
+            z = X @ w
+            return float(np.sum(np.exp(z) - yy * z) + 0.5 * l2 * w @ w)
+
+        def grad(w):
+            z = X @ w
+            return X.T @ (np.exp(z) - yy) + l2 * w
+
+    fun = _TimeToTarget(raw)
+    t0 = time.perf_counter()
+    sopt.minimize(fun, np.zeros(d), jac=grad, method="L-BFGS-B",
+                  options={"maxiter": maxiter, "ftol": 1e-12, "gtol": 1e-9})
+    total = time.perf_counter() - t0
+    tt = fun.time_to(target)
+    final = min(f for _, f in fun.trace)
+    return {"dt_cpu": tt if tt is not None else total,
+            "reached_target": tt is not None, "final_value": final}
+
+
+def _scipy_glmix(data, three: bool, l2=1.0):
+    """Same residual coordinate-descent loop as the accelerator sweep:
+    scipy L-BFGS fixed effect + per-entity serial scipy solves."""
     import scipy.optimize as sopt
     import scipy.special as sp
 
-    n, dg = xg.shape
-    du = xu.shape[1]
-    users = np.unique(uids)
-    rows_of = {u: np.nonzero(uids == u)[0] for u in users}
+    y = data["y"].astype(np.float64)
+    xg = data["xg"].astype(np.float64)
+    blocks = [("u", data["xu"].astype(np.float64), data["uids"])]
+    if three:
+        blocks.append(("i", data["xi"].astype(np.float64), data["iids"]))
 
     def nll(w, X, yy, off):
         z = X @ w + off
@@ -118,89 +489,246 @@ def bench_cpu_reference(xg, xu, uids, y, l2=1.0):
         z = X @ w + off
         return X.T @ (sp.expit(z) - yy) + l2 * w
 
-    wg = np.zeros(dg)
-    wu = np.zeros((len(users), du))
+    n = len(y)
+    wg = np.zeros(xg.shape[1])
+    state = {}
+    for name, X, ids in blocks:
+        ents = np.unique(ids)
+        state[name] = (np.zeros((len(ents), X.shape[1])), ents,
+                       {u: np.nonzero(ids == u)[0] for u in ents})
+    scores = {name: np.zeros(n) for name, _, _ in blocks}
     fixed_scores = np.zeros(n)
-    rand_scores = np.zeros(n)
     t0 = time.perf_counter()
     for _ in range(OUTER):
-        off = rand_scores
-        r = sopt.minimize(nll, wg, jac=grad, args=(xg, y, off), method="L-BFGS-B",
-                          options={"maxiter": 30})
+        off = np.sum(list(scores.values()), axis=0)
+        r = sopt.minimize(nll, wg, jac=grad, args=(xg, y, off),
+                          method="L-BFGS-B", options={"maxiter": SOLVER_ITERS})
         wg = r.x
         fixed_scores = xg @ wg
-        for ui, u in enumerate(users):
-            idx = rows_of[u]
-            r = sopt.minimize(nll, wu[ui], jac=grad,
-                              args=(xu[idx], y[idx], fixed_scores[idx]),
-                              method="L-BFGS-B", options={"maxiter": 30})
-            wu[ui] = r.x
-        rand_scores = np.einsum("nd,nd->n", xu, wu[np.searchsorted(users, uids)])
-    return time.perf_counter() - t0
+        for name, X, ids in blocks:
+            W, ents, rows_of = state[name]
+            other = fixed_scores + np.sum(
+                [scores[o] for o in scores if o != name], axis=0)
+            for ei, u in enumerate(ents):
+                ridx = rows_of[u]
+                r = sopt.minimize(nll, W[ei], jac=grad,
+                                  args=(X[ridx], y[ridx], other[ridx]),
+                                  method="L-BFGS-B",
+                                  options={"maxiter": SOLVER_ITERS})
+                W[ei] = r.x
+            sc = np.einsum("nd,nd->n", X,
+                           W[np.searchsorted(ents, ids)])
+            scores[name] = sc
+    dt = time.perf_counter() - t0
+    total = fixed_scores + np.sum(list(scores.values()), axis=0)
+    return {"dt_cpu": dt, "auc": _np_auc(data["y"], total)}
 
 
-def _impl_subprocess(impl: str, timeout: int):
-    """Run one accelerator impl in a watchdog subprocess; returns dt or None.
-    EVERY accelerator touch lives in a subprocess: a wedged device backend
-    (e.g. the tunnel after an abrupt client kill) then costs one timeout
-    instead of hanging the whole bench."""
+def cpu_ref(name: str, scale: int, accel_stats: dict):
+    """vs_baseline stand-in for one config; cached on disk."""
+    key = json.dumps([name, scale,
+                      round(accel_stats.get("final_value", 0), 2)])
+    hit = _cache_get(key)
+    if hit is not None:
+        return hit
+    if name == "a1a":
+        idx, vals, y, d = synth_a1a()
+        out = _scipy_single(idx, vals, y, d, loss="logistic", l2=1.0,
+                            target=accel_stats["final_value"])
+        out["auc"] = _scipy_auc_single(idx, vals, y, d, "logistic", 1.0)
+    elif name == "sparse1m":
+        idx, vals, y, d = synth_sparse1m(scale)
+        out = _scipy_single(idx, vals, y, d, loss="poisson", l2=1.0,
+                            target=accel_stats["final_value"])
+        out["mean_nll"] = out["final_value"] / len(y)
+    elif name in ("glmix2", "glmix3"):
+        data = synth_glmix(scale, three=(name == "glmix3"))
+        out = _scipy_glmix(data, three=(name == "glmix3"))
+    elif name == "gp_tune":
+        # one stand-in glmix fit, scaled by the number of tuning fits —
+        # every fit retrains the same model at a different L2
+        xg, xu, uids, y = synth_tune(scale)
+        data = {"xg": xg, "xu": xu, "uids": uids, "y": y}
+        one = _scipy_glmix(data, three=False)
+        out = {"dt_cpu": one["dt_cpu"] * accel_stats.get("fits", 7),
+               "per_fit": one["dt_cpu"]}
+    else:
+        raise KeyError(name)
+    _cache_put(key, out)
+    return out
+
+
+def _scipy_auc_single(idx, vals, y, d, loss, l2):
+    """Training AUC of a converged scipy solve (quality anchor for a1a)."""
+    import scipy.optimize as sopt
+    import scipy.sparse as ssp
+    import scipy.special as sp
+
+    n, k = vals.shape
+    X = ssp.csr_matrix(
+        (vals.ravel().astype(np.float64),
+         (np.repeat(np.arange(n), k), idx.ravel())), shape=(n, d))
+    yy = y.astype(np.float64)
+
+    def raw(w):
+        z = X @ w
+        return float(np.sum(np.logaddexp(0, z) - yy * z) + 0.5 * l2 * w @ w)
+
+    def grad(w):
+        z = X @ w
+        return X.T @ (sp.expit(z) - yy) + l2 * w
+
+    r = sopt.minimize(raw, np.zeros(d), jac=grad, method="L-BFGS-B",
+                      options={"maxiter": 300})
+    return _np_auc(y, X @ r.x)
+
+
+# --------------------------------------------------------------------------
+# quality gates
+# --------------------------------------------------------------------------
+
+def quality_gate(name: str, stats: dict, ref: dict | None):
+    """Correctness gate per config (BASELINE.md: matching validation
+    AUC/RMSE within the reference's own integration-test thresholds)."""
+    if name == "a1a":
+        if ref is None or ref.get("auc") is None:
+            return {"pass": None, "detail": "no cpu reference"}
+        d = abs(stats["auc"] - ref["auc"])
+        return {"pass": bool(d <= 0.005), "auc": stats["auc"],
+                "auc_ref": ref["auc"], "auc_diff": round(d, 5)}
+    if name == "sparse1m":
+        if ref is None:
+            return {"pass": None, "detail": "no cpu reference"}
+        rel = abs(stats["mean_nll"] - ref["mean_nll"]) / max(
+            abs(ref["mean_nll"]), 1e-12)
+        return {"pass": bool(rel <= 1e-2), "mean_nll": stats["mean_nll"],
+                "mean_nll_ref": ref["mean_nll"], "rel_diff": round(rel, 5)}
+    if name in ("glmix2", "glmix3"):
+        if ref is None:
+            return {"pass": None, "detail": "no cpu reference"}
+        d = abs(stats["auc"] - ref["auc"])
+        return {"pass": bool(d <= 0.005), "auc": stats["auc"],
+                "auc_ref": ref["auc"], "auc_diff": round(d, 5)}
+    if name == "gp_tune":
+        ok = stats["best_auc"] >= stats["prior_auc"] - 1e-9
+        return {"pass": bool(ok), "best_auc": stats["best_auc"],
+                "prior_auc": stats["prior_auc"]}
+    return {"pass": None}
+
+
+# --------------------------------------------------------------------------
+# orchestration
+# --------------------------------------------------------------------------
+
+def _subprocess_json(args, timeout, env=None):
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--impl", impl],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, timeout=timeout, cwd=_REPO,
+            env=env)
         if out.returncode == 0:
-            return json.loads(out.stdout.strip().splitlines()[-1])["dt"]
-        sys.stderr.write(f"{impl} bench failed (rc {out.returncode})\n"
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        sys.stderr.write(f"bench {args} failed (rc {out.returncode})\n"
                          f"{out.stderr[-2000:]}\n")
     except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError,
-            IndexError, TypeError) as e:
-        sys.stderr.write(f"{impl} bench unusable ({e})\n")
+            IndexError) as e:
+        sys.stderr.write(f"bench {args} unusable ({type(e).__name__}: {e})\n")
     return None
 
 
-def _accel_seconds(data=None):
-    """(dt of the preferred accelerator impl, dataset) — fused first, host
-    loop as fallback, both in watchdog subprocesses.  ``data`` lets the
-    caller pass pre-synthesized arrays for the inline paths."""
-    impl = os.environ.get("PHOTON_BENCH_IMPL")
-    if impl in ("fused", "host"):
-        data = data if data is not None else _synth(np.random.default_rng(42))
-        return bench_accel(*data, impl), data
-    fused_to = int(os.environ.get("PHOTON_BENCH_FUSED_TIMEOUT", 2400))
-    host_to = int(os.environ.get("PHOTON_BENCH_HOST_TIMEOUT", 1200))
-    dt = _impl_subprocess("fused", timeout=fused_to)
-    if dt is None:
-        sys.stderr.write("falling back to host loop\n")
-        dt = _impl_subprocess("host", timeout=host_to)
-    if dt is None:
-        raise SystemExit("accelerator unavailable: both fused and host bench "
-                         "subprocesses failed/timed out")
-    return dt, data
+def probe_platform() -> str:
+    """Fast backend probe in a subprocess; 'cpu' when the device is dead."""
+    to = int(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", 120))
+    got = _subprocess_json(["--probe"], timeout=to)
+    if got and got.get("platform") and got["platform"] != "cpu":
+        return got["platform"]
+    sys.stderr.write("accelerator unavailable; falling back to cpu backend\n")
+    return "cpu"
+
+
+RUNNERS = {
+    "a1a": lambda p, s: run_a1a(p, s),
+    "sparse1m": lambda p, s: run_sparse1m(p, s),
+    "glmix2": lambda p, s: run_glmix(p, s, three=False),
+    "glmix3": lambda p, s: run_glmix(p, s, three=True),
+    "gp_tune": lambda p, s: run_gp_tune(p, s),
+}
 
 
 def main():
-    if len(sys.argv) >= 3 and sys.argv[1] == "--impl":
-        dt = bench_accel(*_synth(np.random.default_rng(42)), sys.argv[2])
-        print(json.dumps({"dt": dt}))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", action="store_true")
+    ap.add_argument("--config", choices=list(RUNNERS))
+    ap.add_argument("--platform", default=None)
+    a = ap.parse_args()
+
+    if a.probe:
+        import jax
+
+        print(json.dumps({"platform": jax.devices()[0].platform}))
+        return
+    if a.config:
+        scale = 1
+        if (a.platform or "") == "cpu":
+            scale = int(os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
+        print(json.dumps(RUNNERS[a.config](a.platform, scale)))
         return
 
-    dt_accel, data = _accel_seconds()
-    if data is None:  # subprocess path: only the CPU reference needs arrays
-        data = _synth(np.random.default_rng(42))
-    xg, xu, uids, y = data
-    n = len(y)
-    examples_per_sec = n * OUTER / dt_accel
+    # ---- orchestrator ----
+    platform = probe_platform()
+    scale = 1 if platform != "cpu" else int(
+        os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
+    names = [c.strip() for c in os.environ.get(
+        "PHOTON_BENCH_CONFIGS", ",".join(ALL_CONFIGS)).split(",") if c.strip()]
+    to = int(os.environ.get("PHOTON_BENCH_CONFIG_TIMEOUT", 2400))
+    want_cpu_ref = os.environ.get("PHOTON_BENCH_CPU_REF", "1") != "0"
 
-    dt_cpu = bench_cpu_reference(xg, xu, uids, y)
-    speedup = dt_cpu / dt_accel
+    configs = {}
+    for name in names:
+        args = ["--config", name]
+        if platform == "cpu":
+            args += ["--platform", "cpu"]
+        got = _subprocess_json(args, timeout=to)
+        if got is None and name in ("glmix2", "glmix3") and \
+                os.environ.get("PHOTON_BENCH_IMPL", "fused") == "fused":
+            sys.stderr.write(f"{name}: fused failed; retrying host loop\n")
+            env = os.environ.copy()
+            env["PHOTON_BENCH_IMPL"] = "host"
+            got = _subprocess_json(args, timeout=to, env=env)
+        if got is None:
+            configs[name] = {"error": "failed or timed out"}
+            continue
+        ref = cpu_ref(name, scale, got["stats"]) if want_cpu_ref else None
+        dt = got["dt"]
+        entry = {
+            "value": round(got["units"] / dt, 1),
+            "unit": got["unit"],
+            "dt_sec": round(dt, 3),
+            "vs_baseline": (round(ref["dt_cpu"] / dt, 2) if ref else None),
+            "quality": quality_gate(name, got["stats"], ref),
+            "backend": got["backend"],
+        }
+        if got.get("impl"):
+            entry["impl"] = got["impl"]
+        if got.get("flops_est"):
+            entry["gflops_per_sec"] = round(got["flops_est"] / dt / 1e9, 1)
+            entry["mfu_bf16_peak"] = round(got["flops_est"] / dt / PEAK_BF16, 5)
+        configs[name] = entry
 
-    print(json.dumps({
+    # headline: config #3 (same metric as round 1), else first success
+    head = configs.get("glmix2")
+    if not head or "value" not in head:
+        head = next((c for c in configs.values() if "value" in c), None)
+    line = {
         "metric": "glmix_2coord_examples_per_sec_per_chip",
-        "value": round(examples_per_sec, 1),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(speedup, 2),
-    }))
+        "value": head["value"] if head else 0.0,
+        "unit": head["unit"] if head else "examples/sec/chip",
+        "vs_baseline": head.get("vs_baseline") if head else None,
+        "backend": platform,
+        "scale": scale,
+        "configs": configs,
+    }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
