@@ -40,6 +40,11 @@ class CoordinateSpec:
     # path of a JSON file {entityName: l2Multiplier}; the train driver
     # translates names -> ids once the entity index exists
     per_entity_l2_file: "str | None" = None
+    # path of a JSON constraint file (reference constraint-string grammar,
+    # GLMSuite.createConstraintFeatureMap:193-232): a JSON array of
+    # {"name": ..., "term": ..., "lowerBound": ..., "upperBound": ...};
+    # the train driver resolves names -> indices once index maps exist
+    constraints_file: "str | None" = None
 
     def with_weight(self, w: float) -> CoordinateConfig:
         reg = Regularization.from_context(self.reg_type, w, self.alpha)
@@ -138,12 +143,20 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             down_sampling_rate=float(kv.pop("down.sampling.rate", 1.0)),
             variance=variance,
             storage_dtype=storage_dtype,
+            # huge-vocabulary model parallelism; active only when the mesh
+            # has a feature axis > 1 (--mesh feature=N)
+            feature_sharded=(kv.pop("feature.sharded", "false").lower()
+                             in ("true", "1", "yes")),
         )
+    constraints_file = kv.pop("constraints", None)
+    if constraints_file and constraints_file.startswith("@"):
+        constraints_file = constraints_file[1:]
     if kv:
         raise ValueError(f"unknown coordinate spec keys for {name!r}: {sorted(kv)}")
     return CoordinateSpec(name=name, reg_weights=weights, reg_type=reg_type,
                           alpha=alpha, template=template,
-                          per_entity_l2_file=per_entity_file)
+                          per_entity_l2_file=per_entity_file,
+                          constraints_file=constraints_file)
 
 
 def expand_game_configs(specs: List[CoordinateSpec], task: TaskType,
@@ -159,3 +172,69 @@ def expand_game_configs(specs: List[CoordinateSpec], task: TaskType,
             num_outer_iterations=num_outer_iterations,
         ))
     return configs
+
+
+WILDCARD = "*"
+
+
+def resolve_constraints(entries, index_map) -> Tuple[Tuple[int, float, float], ...]:
+    """Resolve a reference-grammar constraint list against a feature index map.
+
+    Reference semantics (GLMSuite.createConstraintFeatureMap:193-260):
+    - every entry needs "name" and "term"; missing bounds default to ∓inf;
+    - lo < hi, not both infinite;
+    - name="*" requires term="*" and applies to ALL features except the
+      intercept; it may not be combined with any other constraint;
+    - term="*" applies to every term of that name;
+    - overlapping constraints (same feature twice) are an error.
+    """
+    out: Dict[int, Tuple[float, float]] = {}
+
+    def put(j: int, lo: float, hi: float) -> None:
+        if j in out:
+            name_term = index_map.get_feature_name(j)
+            raise ValueError(
+                f"overlapping constraints for feature {name_term} (index {j})")
+        out[j] = (lo, hi)
+
+    saw_all_wildcard = False
+    for e in entries:
+        if "name" not in e or "term" not in e:
+            raise ValueError(
+                f"constraint entry must carry both 'name' and 'term': {e!r}")
+        name, term = str(e["name"]), str(e["term"])
+        lo = float(e.get("lowerBound", float("-inf")))
+        hi = float(e.get("upperBound", float("inf")))
+        if name == WILDCARD:
+            if term != WILDCARD:
+                raise ValueError(
+                    "wildcard in feature name alone is not supported: if the "
+                    "name is a wildcard the term must be a wildcard too")
+            if out or saw_all_wildcard:
+                raise ValueError(
+                    "an all-feature wildcard constraint cannot be combined "
+                    "with any other constraint")
+            saw_all_wildcard = True
+            ii = index_map.intercept_index
+            for j in range(index_map.size):
+                if j != ii:
+                    put(j, lo, hi)
+        elif term == WILDCARD:
+            if saw_all_wildcard:
+                raise ValueError(
+                    "an all-feature wildcard constraint cannot be combined "
+                    "with any other constraint")
+            matched = [j for j in range(index_map.size)
+                       if (nt := index_map.get_feature_name(j)) is not None
+                       and nt[0] == name]
+            for j in matched:
+                put(j, lo, hi)
+        else:
+            if saw_all_wildcard:
+                raise ValueError(
+                    "an all-feature wildcard constraint cannot be combined "
+                    "with any other constraint")
+            j = index_map.get_index(name, term)
+            if j >= 0:
+                put(j, lo, hi)
+    return tuple((j, lo, hi) for j, (lo, hi) in sorted(out.items()))

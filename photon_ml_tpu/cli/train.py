@@ -137,6 +137,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "ModelProcessingUtils on-disk layout to this dir so "
                         "Spark-side Photon ML can load it (bidirectional "
                         "migration)")
+    p.add_argument("--mesh", default=None,
+                   help="device mesh spec 'data=4,entity=2,feature=1' — axes "
+                        "default to 1, 'data' defaults to the remaining "
+                        "devices; omit for single-device training")
     p.add_argument("--event-listener", action="append", default=[], dest="event_listeners",
                    help="'module.path:ClassName' lifecycle EventListener (repeatable)")
     p.add_argument("--checkpoint-dir", default=None,
@@ -217,6 +221,24 @@ def _run(args, task, t_start, emitter) -> int:
         except (OSError, ValueError, TypeError, json.JSONDecodeError) as e:
             logger.error("coordinate %s per-entity multipliers (%s): %s",
                          spec.name, spec.per_entity_l2_file, e)
+            return 1
+
+    # constraint files (reference constraint-string grammar): parse + shape-
+    # check NOW; name->index resolution waits for the index maps
+    constraint_entries_by_spec = {}
+    for i, spec in enumerate(specs):
+        if spec.constraints_file is None:
+            continue
+        try:
+            with open(spec.constraints_file) as f:
+                raw = json.load(f)
+            if not isinstance(raw, list) or not all(
+                    isinstance(e, dict) for e in raw):
+                raise ValueError("expected a JSON array of constraint objects")
+            constraint_entries_by_spec[i] = raw
+        except (OSError, ValueError, TypeError, json.JSONDecodeError) as e:
+            logger.error("coordinate %s constraints (%s): %s",
+                         spec.name, spec.constraints_file, e)
             return 1
 
     # 1. index maps + training data.  Native loader (native/avro_loader.cpp):
@@ -401,6 +423,25 @@ def _run(args, task, t_start, emitter) -> int:
         logger.info("coordinate %s: per-entity L2 multipliers for %d "
                     "entities", spec.name, len(mult))
 
+    # constraint resolution: reference grammar names/terms -> this run's
+    # feature indices (GLMSuite.createConstraintFeatureMap semantics)
+    for i, entries in constraint_entries_by_spec.items():
+        spec = specs[i]
+        from photon_ml_tpu.cli.config_grammar import resolve_constraints
+
+        try:
+            resolved = resolve_constraints(
+                entries, index_maps[spec.template.feature_shard])
+            # bound validation (lo < hi, not both infinite) fires in the
+            # config's __post_init__ — keep it inside the CLI error contract
+            specs[i] = _dc.replace(spec, template=_dc.replace(
+                spec.template, constraints=resolved))
+        except ValueError as e:
+            logger.error("coordinate %s constraints: %s", spec.name, e)
+            return 1
+        logger.info("coordinate %s: box constraints on %d feature(s)",
+                    spec.name, len(resolved))
+
     # 5. config grid (reference prepareGameOptConfigs) + fit
     configs = expand_game_configs(specs, task, args.coordinate_descent_iterations)
     if normalization:
@@ -416,7 +457,23 @@ def _run(args, task, t_start, emitter) -> int:
     logger.info("fitting %d configuration(s)", len(configs))
     suite = (EvaluationSuite.from_specs(args.evaluators.split(","))
              if args.evaluators else None)
-    est = GameEstimator(validation_suite=suite, normalization=normalization)
+    mesh = None
+    if args.mesh:
+        from photon_ml_tpu.parallel.mesh import make_mesh
+
+        axes = {}
+        for part in args.mesh.split(","):
+            k, _, v = part.partition("=")
+            if k.strip() not in ("data", "entity", "feature") or not v:
+                raise SystemExit(f"bad --mesh fragment {part!r} "
+                                 "(expected data=N,entity=N,feature=N)")
+            axes[k.strip()] = int(v)
+        mesh = make_mesh(n_data=axes.get("data"),
+                         n_entity=axes.get("entity", 1),
+                         n_feature=axes.get("feature", 1))
+        logger.info("device mesh: %s", dict(mesh.shape))
+    est = GameEstimator(mesh=mesh, validation_suite=suite,
+                        normalization=normalization)
 
     # Warm start / partial retraining (reference GameTrainingDriver.scala:370-379
     # -> GameEstimator initialModel + partial retraining :106-112).

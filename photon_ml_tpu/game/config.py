@@ -9,7 +9,14 @@ regularization + downSamplingRate per coordinate).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Union
+from typing import Optional, Tuple, Union
+
+# Per-feature-index box constraints (reference constraintMap:
+# Map[Int, (lowerBound, upperBound)], OptimizerConfig.scala:47, applied via
+# OptimizationUtils.projectCoefficientsToSubspace): ((index, lo, hi), ...).
+# Index-keyed like the reference's; name/term resolution against the feature
+# index map happens in the CLI layer (cli/config_grammar.resolve_constraints).
+ConstraintMap = Tuple[Tuple[int, float, float], ...]
 
 from photon_ml_tpu.core.regularization import Regularization
 from photon_ml_tpu.opt.types import SolverConfig
@@ -38,6 +45,18 @@ class FixedEffectConfig:
     # Matmuls run with storage-width MXU operands and compute-width
     # accumulation — halves objective-pass HBM traffic on large n.
     storage_dtype: Optional[str] = None
+    # Shard w (and dense design columns) over the mesh's ``feature`` axis —
+    # the huge-vocabulary scale path (reference: sparse vectors over PalDB
+    # 1e8-feature index maps, PalDBIndexMap.scala:16-60).  No-op unless the
+    # estimator mesh has a feature axis > 1.  See parallel/fixed.py.
+    feature_sharded: bool = False
+    # Box constraints on coefficients (see ConstraintMap above); LBFGS only
+    # (projected-gradient path, opt/lbfgs.py) — reference parity: TRON/OWLQN
+    # reject constraints too.
+    constraints: Optional[ConstraintMap] = None
+
+    def __post_init__(self):
+        _canonicalize_constraints(self)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +87,10 @@ class RandomEffectConfig:
     # tuned/grid L2 scales every entity while relative strengths persist.
     # Accepts a dict; stored canonically as a sorted tuple of pairs.
     per_entity_l2_multipliers: "Optional[tuple]" = None
+    # Box constraints on coefficients, applied to EVERY entity's solve
+    # (see ConstraintMap above); IDENTITY projector + LBFGS only — bounds
+    # have no meaning in a projected solve space.
+    constraints: Optional[ConstraintMap] = None
 
     def __post_init__(self):
         m = self.per_entity_l2_multipliers
@@ -78,6 +101,31 @@ class RandomEffectConfig:
         elif m is not None:
             object.__setattr__(self, "per_entity_l2_multipliers",
                                tuple(sorted((int(k), float(v)) for k, v in m)))
+        _canonicalize_constraints(self)
+
+
+def _canonicalize_constraints(cfg) -> None:
+    """Accept a dict {index: (lo, hi)} or iterable of (index, lo, hi);
+    store a sorted tuple (hashable — configs are frozen/compared) and
+    validate bounds (reference GLMSuite.createConstraintFeatureMap:193-232:
+    lo < hi, not both infinite)."""
+    c = cfg.constraints
+    if c is None:
+        return
+    if isinstance(c, dict):
+        c = tuple((int(j), *map(float, bounds)) for j, bounds in c.items())
+    else:
+        c = tuple((int(j), float(lo), float(hi)) for j, lo, hi in c)
+    for j, lo, hi in c:
+        if not lo < hi:
+            raise ValueError(
+                f"constraint on feature {j}: lower bound {lo} must be < "
+                f"upper bound {hi}")
+        if lo == float("-inf") and hi == float("inf"):
+            raise ValueError(
+                f"constraint on feature {j}: both bounds infinite "
+                "(not a constraint)")
+    object.__setattr__(cfg, "constraints", tuple(sorted(c)))
 
 
 CoordinateConfig = Union[FixedEffectConfig, RandomEffectConfig]

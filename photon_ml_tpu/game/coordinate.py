@@ -207,8 +207,16 @@ class FixedEffectCoordinate(Coordinate):
         # Mixed-storage batches never take the pallas path (uniform-dtype
         # kernels), so they skip the block padding too.
         from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
+        from photon_ml_tpu.parallel.mesh import FEATURE_AXIS, padded_dim
 
-        fused_ok = config.storage_dtype is None and eligible(batch)
+        # Feature-axis (model-parallel) sharding: active only when the mesh
+        # actually has a feature axis > 1, so the same config is valid on any
+        # mesh (mesh-agnostic property, SURVEY §4).
+        self._fs = bool(getattr(config, "feature_sharded", False)) \
+            and mesh is not None and mesh.shape[FEATURE_AXIS] > 1
+        self._d_pad = padded_dim(self.dim, mesh) if self._fs else self.dim
+        fused_ok = (config.storage_dtype is None and eligible(batch)
+                    and not self._fs)  # pallas kernels assume full-width w
         if mesh is not None:
             if fused_ok:
                 # pad so each device's LOCAL shard is a block multiple
@@ -218,7 +226,10 @@ class FixedEffectCoordinate(Coordinate):
                 local = -(-batch.num_examples // n_dev)
                 bn = _pick_block_rows(local, batch.dim)
                 batch = _pad_rows(batch, (-(-local // bn) * bn) * n_dev)
-            batch = shard_batch(batch, mesh)
+            batch = shard_batch(
+                batch, mesh,
+                feature_axis=FEATURE_AXIS
+                if (self._fs and isinstance(batch, DenseBatch)) else None)
         elif fused_ok:
             batch = _pad_rows(batch, _pick_block_rows(*batch.x.shape))
         self._batch = batch
@@ -231,6 +242,15 @@ class FixedEffectCoordinate(Coordinate):
         self._norm = norm.replace(
             factors=None if norm.factors is None else jnp.asarray(norm.factors, dtype),
             shifts=None if norm.shifts is None else jnp.asarray(norm.shifts, dtype))
+        if self._fs and self._d_pad != self.dim:
+            # padded coefficient slots: identity scale, no shift — they see
+            # only zero feature columns so they stay pinned at 0
+            pad = self._d_pad - self.dim
+            self._norm = self._norm.replace(
+                factors=None if self._norm.factors is None
+                else jnp.pad(self._norm.factors, (0, pad), constant_values=1.0),
+                shifts=None if self._norm.shifts is None
+                else jnp.pad(self._norm.shifts, (0, pad)))
         self._bind_solver()
         # The batch is an ARGUMENT of every jitted program, never a closure:
         # closed-over jax.Arrays lower to baked XLA constants, and compile
@@ -247,13 +267,28 @@ class FixedEffectCoordinate(Coordinate):
         # cannot auto-partition a pallas custom call, shard_map runs it
         # per-device on local rows.
         objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
-                                 norm=self._norm, fused=True)
-        if self.mesh is not None:
+                                 norm=self._norm, fused=not self._fs)
+        if self._fs and isinstance(self._batch, SparseBatch):
+            from photon_ml_tpu.parallel.fixed import ShardSparseObjective
+            from photon_ml_tpu.parallel.mesh import FEATURE_AXIS
+
+            objective = ShardSparseObjective(
+                objective, self.mesh,
+                self._d_pad // self.mesh.shape[FEATURE_AXIS])
+        elif self._fs:
+            # dense + feature-sharded: plain objective; GSPMD partitions the
+            # margin/gradient contractions from the (data, feature) shardings
+            pass
+        elif self.mesh is not None:
             from photon_ml_tpu.parallel.fixed import ShardMapObjective
 
             objective = ShardMapObjective(objective, self.mesh)
         self._objective = objective
-        solve = make_solver(objective, self.config.optimizer, self.config.solver)
+        box = _box_from_constraints(
+            self.config.constraints, self.dim, self._dtype, self._norm,
+            d_pad=self._d_pad if self._fs else None)
+        solve = make_solver(objective, self.config.optimizer,
+                            self.config.solver, box=box)
 
         # reg is a TRACED argument: a reg-weight grid re-enters this exact
         # compiled program (the optimizer/L1-regime dispatch inside
@@ -262,21 +297,25 @@ class FixedEffectCoordinate(Coordinate):
         def _solve(w0: Array, batch, reg: Regularization) -> SolverResult:
             return solve(w0, batch, objective=objective.with_reg(reg))
 
-        out_shard = replicate(self.mesh) if self.mesh is not None else None
+        # Feature-sharded solves keep w P("feature") end-to-end (propagated
+        # from w0) — replicating the output would defeat the sharding.
+        out_shard = (replicate(self.mesh)
+                     if self.mesh is not None and not self._fs else None)
         self._solve = (jax.jit(_solve, out_shardings=out_shard)
-                       if self.mesh is not None else jax.jit(_solve))
+                       if out_shard is not None else jax.jit(_solve))
         self._solver_key = self._make_solver_key()
 
     def _make_solver_key(self) -> tuple:
         """Everything (besides reg VALUES) that shapes the compiled solver."""
         c = self.config
         return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance,
-                c.intercept_index)
+                c.intercept_index, c.constraints)
 
     def data_key(self) -> tuple:
         """Identity of the device data layout (reuse across optimization
         configs — reference GameEstimator prepares datasets once, fit:454-557)."""
-        return ("fixed", self.config.feature_shard, self.config.storage_dtype)
+        return ("fixed", self.config.feature_shard, self.config.storage_dtype,
+                self._fs)
 
     def rebind(self, config: FixedEffectConfig) -> "FixedEffectCoordinate":
         """New optimization settings over the SAME device-resident data.
@@ -285,9 +324,10 @@ class FixedEffectCoordinate(Coordinate):
         import copy
 
         if (config.feature_shard != self.config.feature_shard
-                or config.storage_dtype != self.config.storage_dtype):
-            raise ValueError("rebind cannot change the feature shard or its "
-                             "storage dtype")
+                or config.storage_dtype != self.config.storage_dtype
+                or config.feature_sharded != self.config.feature_sharded):
+            raise ValueError("rebind cannot change the feature shard, its "
+                             "storage dtype, or feature sharding")
         new = copy.copy(self)
         new.config = config
         if new._make_solver_key() != self._solver_key:
@@ -332,10 +372,16 @@ class FixedEffectCoordinate(Coordinate):
         original-space, so warm starts convert back in."""
         ii = self.config.intercept_index
         if init is not None:
-            w0 = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
-            w0 = self._norm.model_to_transformed_space(w0, ii)
+            means = np.asarray(init.coefficients.means, self._dtype)
+            if self._fs and len(means) < self._d_pad:
+                means = np.pad(means, (0, self._d_pad - len(means)))
+            w0 = self._norm.model_to_transformed_space(jnp.asarray(means), ii)
         else:
-            w0 = jnp.zeros(self.dim, self._dtype)
+            w0 = jnp.zeros(self._d_pad, self._dtype)
+        if self._fs:
+            from photon_ml_tpu.parallel.mesh import shard_coefficients
+
+            w0 = shard_coefficients(w0, self.mesh)
         offs = jnp.asarray(self._pad(np.asarray(total_offsets, self._dtype)))
         weights = self._down_sample_weights(seed)
         res = self._solve(w0, self._batch.replace(offset=offs, weight=weights),
@@ -355,8 +401,9 @@ class FixedEffectCoordinate(Coordinate):
                 self._batch.replace(offset=offs, weight=weights),
                 self.config.variance)
             variances = np.asarray(self._norm.model_to_original_space(v, ii))
+            variances = variances[: self.dim]
         model = FixedEffectModel(
-            coefficients=Coefficients(means=np.asarray(w_orig),
+            coefficients=Coefficients(means=np.asarray(w_orig)[: self.dim],
                                       variances=variances),
             feature_shard=self.config.feature_shard,
             task=self.task,
@@ -364,8 +411,10 @@ class FixedEffectCoordinate(Coordinate):
         return model, res
 
     def score(self, model: FixedEffectModel) -> np.ndarray:
-        s = self._score(jnp.asarray(np.asarray(model.coefficients.means, self._dtype)),
-                        self._batch)
+        means = np.asarray(model.coefficients.means, self._dtype)
+        if self._fs and len(means) < self._d_pad:
+            means = np.pad(means, (0, self._d_pad - len(means)))
+        s = self._score(jnp.asarray(means), self._batch)
         return np.asarray(s)[: self._n]
 
     def tracker_summary(self, tracker) -> dict:
@@ -378,6 +427,12 @@ class FixedEffectCoordinate(Coordinate):
     # State = transformed-space coefficient vector [d].
 
     def init_sweep_state(self, init: Optional[FixedEffectModel] = None) -> Array:
+        if self._fs:
+            # the fused whole-descent program assumes full-width replicated
+            # coordinate states; feature-sharded coordinates run host-paced
+            # (estimator fused="auto" falls back on this signal)
+            raise NotImplementedError(
+                "feature-sharded coordinates use the host-paced descent loop")
         if init is not None:
             w = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
             return self._norm.model_to_transformed_space(
@@ -448,6 +503,44 @@ class FixedEffectCoordinate(Coordinate):
         return np.asarray(v)
 
 
+def _box_from_constraints(constraints, dim: int, dtype, norm=None,
+                          d_pad: Optional[int] = None):
+    """(lower, upper) solver box arrays in the SOLVE (transformed) space.
+
+    Reference: OptimizerConfig.constraintMap (OptimizerConfig.scala:47)
+    applied by OptimizationUtils.projectCoefficientsToSubspace per iteration
+    — here the bounds become the LBFGS projected-gradient box
+    (opt/lbfgs.py:97 via make_solver(box=...)).  Bounds are ORIGINAL-space;
+    with scaling normalization w_orig = factors * w_t (factors > 0), so the
+    transformed-space box is [lo/f, hi/f].  Shift normalization folds a
+    -<w, shifts> term into the intercept, making per-feature original-space
+    bounds non-separable — refused loudly.
+    """
+    if not constraints:
+        return None
+    total = d_pad or dim
+    lo = np.full(total, -np.inf, dtype)
+    hi = np.full(total, np.inf, dtype)
+    if total != dim:
+        lo[dim:] = 0.0  # padded coefficient slots stay pinned at 0
+        hi[dim:] = 0.0
+    for j, l, h in constraints:
+        if not 0 <= j < dim:
+            raise ValueError(
+                f"constraint feature index {j} out of range [0, {dim})")
+        lo[j], hi[j] = l, h
+    if norm is not None:
+        if norm.shifts is not None:
+            raise ValueError(
+                "box constraints with shift normalization are not supported "
+                "(original-space bounds are non-separable under shifts); use "
+                "a scaling-only normalization type")
+        if norm.factors is not None:
+            f = np.asarray(norm.factors)
+            lo, hi = lo / f, hi / f
+    return jnp.asarray(lo), jnp.asarray(hi)
+
+
 def _re_data_key(c: RandomEffectConfig) -> tuple:
     """Every field that affects the DATA layout (buckets + projection); a
     config differing only in optimization settings may reuse device arrays."""
@@ -467,7 +560,7 @@ class RandomEffectCoordinate(Coordinate):
 
     def __init__(self, coordinate_id: str, data: GameData, config: RandomEffectConfig,
                  task: TaskType, mesh: Optional[Mesh] = None, seed: int = 0,
-                 dtype=np.float32):
+                 dtype=np.float32, norm: Optional[NormalizationContext] = None):
         self.coordinate_id = coordinate_id
         self.config = config
         self.task = task
@@ -475,6 +568,37 @@ class RandomEffectCoordinate(Coordinate):
         self._n = data.num_samples
         self._dtype = dtype
         self.dim = data.shard_dim(config.feature_shard)
+        # Per-entity normalization (reference: one NormalizationContext per
+        # REId — NormalizationContextRDD, RandomEffectOptimizationProblem
+        # .scala:154-178, built by GameEstimator.prepareNormalizationContext
+        # Wrappers:646-680).  Three cases, exactly the reference's:
+        #   IDENTITY projector  -> ONE shared context for every entity
+        #                          (NormalizationContextBroadcast);
+        #   INDEX_MAP projector -> the coordinate context PROJECTED into each
+        #                          entity's compact space (the RDD case) —
+        #                          here: per-lane gathered factor arrays that
+        #                          ride the vmapped solve as traced leaves;
+        #   RANDOM projector    -> unsupported (the reference pushes the
+        #                          context through the Gaussian matrix; the
+        #                          factor algebra does not survive it here).
+        if norm is not None and config.projector == ProjectorType.RANDOM:
+            raise NotImplementedError(
+                f"coordinate {coordinate_id!r}: normalization under a RANDOM "
+                "projection is not supported (no exact per-entity context)")
+        if (norm is not None and norm.shifts is not None
+                and config.projector != ProjectorType.IDENTITY):
+            raise NotImplementedError(
+                f"coordinate {coordinate_id!r}: shift normalization needs a "
+                "stable intercept column — only the IDENTITY projector "
+                "keeps one")
+        self._norm = None
+        if norm is not None and (norm.factors is not None
+                                 or norm.shifts is not None):
+            self._norm = norm.replace(
+                factors=None if norm.factors is None
+                else jnp.asarray(norm.factors, dtype),
+                shifts=None if norm.shifts is None
+                else jnp.asarray(norm.shifts, dtype))
         self._base_offset = np.asarray(data.offset, np.float64)
 
         shard_data = data.features[config.feature_shard]
@@ -575,20 +699,68 @@ class RandomEffectCoordinate(Coordinate):
                  valid=put(b.rows >= 0))
             for b in solve_buckets
         ]
+        # INDEX_MAP + normalization: project the coordinate context into each
+        # entity's compact space (the reference's per-REId contexts) — gather
+        # the factor vector through every lane's column map; padded slots get
+        # the identity factor 1.
+        self._norm_fac_dev = None
+        if self._norm is not None and self._proj is not None:
+            from photon_ml_tpu.parallel.projection import BucketProjection
+
+            fac = np.asarray(self._norm.factors, self._dtype)
+            lanes_fac = []
+            for p in self._proj.projections:
+                assert isinstance(p, BucketProjection)  # RANDOM rejected above
+                safe = np.where(p.indices < 0, 0, p.indices)
+                lanes_fac.append(np.where(p.indices >= 0, fac[safe],
+                                          1.0).astype(self._dtype))
+            self._norm_fac_np = lanes_fac  # host twin for warm starts
+            self._norm_fac_dev = [put(f) for f in lanes_fac]
 
     def _bind_solver(self) -> None:
-        objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg)
+        # shared-context normalization (IDENTITY projector) bakes into the
+        # objective; per-lane contexts (INDEX_MAP) enter the vmapped solve as
+        # traced factor arrays instead (see _vsolve below)
+        shared_norm = (self._norm if self._norm is not None
+                       and self.config.projector == ProjectorType.IDENTITY
+                       else None)
+        objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
+                                 norm=shared_norm or no_normalization())
         self._objective = objective
-        solve = make_solver(objective, self.config.optimizer, self.config.solver)
+        self._norm_per_lane = (self._norm is not None and shared_norm is None)
+        box = None
+        if self.config.constraints:
+            if self.config.projector != ProjectorType.IDENTITY:
+                raise ValueError(
+                    f"coordinate {self.coordinate_id!r}: box constraints have "
+                    "no meaning in a projected solve space; use "
+                    "ProjectorType.IDENTITY")
+            if self._norm is not None:
+                box = _box_from_constraints(self.config.constraints, self.dim,
+                                            self._dtype, self._norm)
+            else:
+                box = _box_from_constraints(self.config.constraints, self.dim,
+                                            self._dtype)
+        solve = make_solver(objective, self.config.optimizer,
+                            self.config.solver, box=box)
 
         # reg traced PER LANE (vmapped like the data): λ sweeps reuse this
         # compilation, and per-entity regularization costs nothing extra
-        def _vsolve(w0, x_b, y_b, off_b, wt_b, reg):
-            return jax.vmap(
-                lambda w, xx, yy, oo, ww, rr: solve(
-                    w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
-                    objective=objective.with_reg(rr))
-            )(w0, x_b, y_b, off_b, wt_b, reg)
+        if self._norm_per_lane:
+            def _vsolve(w0, x_b, y_b, off_b, wt_b, reg, fac_b):
+                return jax.vmap(
+                    lambda w, xx, yy, oo, ww, rr, fa: solve(
+                        w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
+                        objective=objective.with_reg(rr).replace(
+                            norm=NormalizationContext(factors=fa, shifts=None)))
+                )(w0, x_b, y_b, off_b, wt_b, reg, fac_b)
+        else:
+            def _vsolve(w0, x_b, y_b, off_b, wt_b, reg, fac_b=None):
+                return jax.vmap(
+                    lambda w, xx, yy, oo, ww, rr: solve(
+                        w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
+                        objective=objective.with_reg(rr))
+                )(w0, x_b, y_b, off_b, wt_b, reg)
 
         self._vsolve = jax.jit(_vsolve)
 
@@ -615,7 +787,8 @@ class RandomEffectCoordinate(Coordinate):
 
     def _make_solver_key(self) -> tuple:
         c = self.config
-        return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance)
+        return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance,
+                c.constraints)
 
     def _refresh_lane_mult(self) -> None:
         """Cache per-bucket (ones, multiplier) lane vectors — constant per
@@ -676,7 +849,34 @@ class RandomEffectCoordinate(Coordinate):
             else:
                 # Gaussian projection has no exact inverse; restart cold.
                 w0 = np.zeros((b.num_lanes, proj.d_proj), self._dtype)
+        if self._norm is not None:
+            # published models are ORIGINAL-space; solves run transformed
+            # (same convention as the fixed effect's update())
+            if self._norm_per_lane:
+                w0 = w0 / self._norm_fac_np[bucket_index]
+            else:
+                n = self._norm
+                if n.shifts is not None:
+                    ii = self.config.intercept_index
+                    w0[:, ii] += w0 @ np.asarray(n.shifts)
+                if n.factors is not None:
+                    w0 = w0 / np.asarray(n.factors)
         return w0.astype(self._dtype)
+
+    def _lanes_to_original(self, lanes: Array, bucket_index: int,
+                           norm_fac=None) -> Array:
+        """Map a bucket's transformed-space lane vectors to original space
+        (the reference applies modelToOriginalSpace per entity problem —
+        GeneralizedLinearOptimizationProblem.createModel)."""
+        if self._norm is None:
+            return lanes
+        if self._norm_per_lane:
+            fac = (norm_fac if norm_fac is not None
+                   else self._norm_fac_dev)[bucket_index]
+            return lanes * fac
+        ii = self.config.intercept_index
+        return jax.vmap(
+            lambda w: self._norm.model_to_original_space(w, ii))(lanes)
 
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[RandomEffectModel] = None
@@ -694,15 +894,19 @@ class RandomEffectCoordinate(Coordinate):
                 w0 = self._put_entity(np.zeros((b.num_lanes, solve_dim), self._dtype))
             # residual offsets gathered into the bucket layout
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
+            fac_args = ((self._norm_fac_dev[bi],) if self._norm_per_lane else ())
             res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
-                               lane_regs[bi])
-            coeffs.append(res.w)
+                               lane_regs[bi], *fac_args)
+            coeffs.append(self._lanes_to_original(res.w, bi))
             results.append(res)
             if variances is not None:
                 # per-entity variances, vmapped over the bucket's lanes
-                # (reference computes them per SingleNodeOptimizationProblem)
-                variances.append(self._vvar(res.w, dev["x"], dev["y"],
-                                            off_b, dev["w"], lane_regs[bi]))
+                # (reference computes them per SingleNodeOptimizationProblem),
+                # at the TRANSFORMED-space iterates, then mapped through the
+                # same coefficient transform as the means (createModel:89-95)
+                v = self._vvar(res.w, dev["x"], dev["y"],
+                               off_b, dev["w"], lane_regs[bi])
+                variances.append(self._lanes_to_original(v, bi))
 
         if self._proj is not None:
             coeffs = self._proj.back_project([np.asarray(c) for c in coeffs])
@@ -753,7 +957,8 @@ class RandomEffectCoordinate(Coordinate):
         arguments (see Coordinate.sweep_data)."""
         return dict(dev=self._dev, slots=self._sample_slots,
                     x_full=self._x_full,
-                    proj=self._proj_dev if self._proj is not None else None)
+                    proj=self._proj_dev if self._proj is not None else None,
+                    norm_fac=self._norm_fac_dev)
 
     def trace_update(self, state: Tuple[Array, ...], offsets: Array,
                      reg: Optional[Regularization] = None,
@@ -770,8 +975,9 @@ class RandomEffectCoordinate(Coordinate):
         new_lanes = []
         for bi, (lanes, dev) in enumerate(zip(state, data["dev"])):
             off_b = jnp.where(dev["valid"], offsets[dev["rows"]], 0.0)
+            fac_args = ((data["norm_fac"][bi],) if self._norm_per_lane else ())
             res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"],
-                               lane_regs[bi])
+                               lane_regs[bi], *fac_args)
             new_lanes.append(res.w)
         w_stack = self.trace_publish(tuple(new_lanes), data=data)
         score = score_samples(w_stack, data["slots"], data["x_full"])[: self._n]
@@ -780,6 +986,14 @@ class RandomEffectCoordinate(Coordinate):
     def trace_publish(self, state: Tuple[Array, ...], data=None) -> Array:
         from photon_ml_tpu.parallel.bucketing import stack_bucket_lanes
 
+        if self._norm is not None:
+            # original-space lanes BEFORE back-projection/stacking (per-lane
+            # factor maps live in the compact solve space)
+            if data is None:
+                data = self.sweep_data()
+            state = tuple(
+                self._lanes_to_original(lanes, bi, norm_fac=data.get("norm_fac"))
+                for bi, lanes in enumerate(state))
         if self._proj is not None:
             # traced twin of ProjectedBuckets.back_project (margin-exact):
             # lanes return to full dim before stacking.  Projection arrays
@@ -829,8 +1043,9 @@ class RandomEffectCoordinate(Coordinate):
         out = []
         for bi, (lanes, dev) in enumerate(zip(state, dev_buckets)):
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0)
-            out.append(self._vvar(lanes, dev["x"], dev["y"], off_b,
-                                  dev["w"], lane_regs[bi]))
+            v = self._vvar(lanes, dev["x"], dev["y"], off_b,
+                           dev["w"], lane_regs[bi])
+            out.append(self._lanes_to_original(v, bi))
         return tuple(out)
 
     def export_variances(self, v) -> np.ndarray:
@@ -869,5 +1084,5 @@ def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfi
                                      dtype=dtype)
     if isinstance(config, RandomEffectConfig):
         return RandomEffectCoordinate(coordinate_id, data, config, task, mesh, seed,
-                                      dtype=dtype)
+                                      dtype=dtype, norm=norm)
     raise TypeError(f"unknown coordinate config {type(config)!r}")

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from photon_ml_tpu.core.batch import Batch, DenseBatch
+from photon_ml_tpu.core.batch import Batch, DenseBatch, SparseBatch
 from photon_ml_tpu.core.objective import GLMObjective
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverConfig, SolverResult
@@ -128,6 +128,137 @@ class ShardMapObjective:
         return h + obj.reg.l2 * jnp.eye(d, dtype=h.dtype)
 
 
+class ShardSparseObjective:
+    """Sparse GLM objective with w sharded over the ``feature`` mesh axis.
+
+    The huge-vocabulary path (reference scale story: sparse vectors over
+    PalDB 1e8-feature index maps, PalDBIndexMap.scala:16-60): no device holds
+    the full coefficient vector.  Each device owns a contiguous block of
+    ``shard_d`` coefficients and the batch rows of its ``data`` shard
+    (indices stay GLOBAL — the data layout is identical to the replicated-w
+    case, so the data path needs no shard-local reindexing pass):
+
+      margins   masked gather from the LOCAL w block (out-of-block slots
+                contribute 0) -> one psum over ``feature`` assembles the full
+                margin for the shard's rows;
+      gradient  per-block masked scatter-add -> one psum over ``data``; the
+                result STAYS feature-sharded (P('feature')) — the per-feature
+                partial-sum layout the reference gets from treeAggregate
+                segments, mapped onto ICI.
+
+    Communication per value+grad evaluation: exactly one feature-axis
+    all-reduce of an [n_local] vector + one data-axis all-reduce of the
+    [shard_d] block (vs the replicated-w path's single [d] all-reduce — for
+    d >> n/D this is the cheaper direction, which is the point).
+
+    All normalization/regularization algebra runs OUTSIDE the shard_map at
+    GSPMD level on sharded (d_pad,) vectors (elementwise ops keep the
+    sharding; dots psum over ICI).  Scaling-only normalization is supported;
+    shifts would densify sparse margins, so they raise — same reason the
+    reference recommends scaling-only normalization for sparse data
+    (NormalizationType SCALE_WITH_*).
+    """
+
+    def __init__(self, objective: GLMObjective, mesh: Mesh, shard_d: int,
+                 data_axis: str = DATA_AXIS, feature_axis: str = FEATURE_AXIS):
+        if objective.norm.shifts is not None:
+            raise ValueError(
+                "feature-sharded sparse fitting supports scaling-only "
+                "normalization (shifts densify sparse margins)")
+        self.obj = objective
+        self.mesh = mesh
+        self.shard_d = shard_d
+        self.data_axis = data_axis
+        self.feature_axis = feature_axis
+
+    @property
+    def reg(self):
+        return self.obj.reg
+
+    def with_reg(self, reg) -> "ShardSparseObjective":
+        return ShardSparseObjective(self.obj.with_reg(reg), self.mesh,
+                                    self.shard_d, self.data_axis,
+                                    self.feature_axis)
+
+    def _specs(self, batch: SparseBatch):
+        row_sharded = lambda a: P(self.data_axis, *([None] * (a.ndim - 1)))
+        return jax.tree.map(row_sharded, batch)
+
+    def _local_parts(self, blk: Array, b: SparseBatch):
+        """(full margins for local rows, masked values, local ids)."""
+        lo = jax.lax.axis_index(self.feature_axis) * self.shard_d
+        lid = b.indices - lo
+        ok = (lid >= 0) & (lid < self.shard_d)
+        vals = jnp.where(ok, b.values.astype(blk.dtype), 0)
+        lid = jnp.clip(lid, 0, self.shard_d - 1)
+        z = jax.lax.psum(jnp.sum(vals * blk[lid], axis=-1), self.feature_axis)
+        return z + b.offset, vals, lid
+
+    def _scatter(self, vals: Array, lid: Array, r: Array) -> Array:
+        """Local block of X^T r (masked vals make clamped ids contribute 0)."""
+        contrib = vals * r[..., None]
+        return jnp.zeros((self.shard_d,), contrib.dtype).at[lid].add(contrib)
+
+    def value_and_grad(self, w: Array, batch: SparseBatch) -> Tuple[Array, Array]:
+        obj, data, feat = self.obj, self.data_axis, self.feature_axis
+        eff = obj.norm.effective_coefficients(w)  # elementwise: stays sharded
+
+        def local(eff_blk, b):
+            z, vals, lid = self._local_parts(eff_blk, b)
+            z = jnp.where(b.weight > 0, z, 0.0)  # core masking contract
+            l, d1 = obj.loss.loss_and_d1(z, b.y)
+            r = b.weight * d1
+            return (jax.lax.psum(jnp.sum(b.weight * l), data),
+                    jax.lax.psum(self._scatter(vals, lid, r), data),
+                    jax.lax.psum(jnp.sum(r), data))
+
+        rv, gr, rs = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P(feat), self._specs(batch)),
+            out_specs=(P(), P(feat), P()))(eff, batch)
+        return obj.finish_value_and_grad(w, rv, gr, rs)
+
+    def hvp(self, w: Array, batch: SparseBatch, v: Array) -> Array:
+        obj, data, feat = self.obj, self.data_axis, self.feature_axis
+        eff_w = obj.norm.effective_coefficients(w)
+        eff_v = obj.norm.effective_coefficients(v)
+
+        def local(ew_blk, ev_blk, b):
+            z, vals, lid = self._local_parts(ew_blk, b)
+            z = jnp.where(b.weight > 0, z, 0.0)
+            mv = jax.lax.psum(jnp.sum(vals * ev_blk[lid], axis=-1), feat)
+            q = b.weight * obj.loss.d2(z, b.y) * mv
+            return (jax.lax.psum(self._scatter(vals, lid, q), data),
+                    jax.lax.psum(jnp.sum(q), data))
+
+        hv, qs = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(feat), P(feat), self._specs(batch)),
+            out_specs=(P(feat), P()))(eff_w, eff_v, batch)
+        return obj.finish_hvp(v, hv, qs)
+
+    def hessian_diag(self, w: Array, batch: SparseBatch) -> Array:
+        obj, data, feat = self.obj, self.data_axis, self.feature_axis
+        eff = obj.norm.effective_coefficients(w)
+
+        def local(eff_blk, b):
+            z, vals, lid = self._local_parts(eff_blk, b)
+            z = jnp.where(b.weight > 0, z, 0.0)
+            q = b.weight * obj.loss.d2(z, b.y)
+            return jax.lax.psum(self._scatter(vals * vals, lid, q), data)
+
+        diag = jax.shard_map(
+            local, mesh=self.mesh, in_specs=(P(feat), self._specs(batch)),
+            out_specs=P(feat))(eff, batch)
+        if obj.norm.factors is not None:
+            diag = diag * obj.norm.factors * obj.norm.factors
+        return diag + obj.reg.l2
+
+    def hessian(self, w: Array, batch: SparseBatch) -> Array:
+        raise NotImplementedError(
+            "FULL variance needs the dense d x d Hessian — not meaningful at "
+            "feature-sharded scale; use SIMPLE (diagonal) variances")
+
+
 def fit_fixed_effect(
     objective: GLMObjective,
     batch: Batch,
@@ -153,21 +284,13 @@ def fit_fixed_effect(
     The returned w is sliced back to the caller's d (padding is trimmed).
     """
     d = int(w0.shape[0])
-    if feature_sharded and not isinstance(batch, DenseBatch):
-        # Sparse batches address w by global index; a feature-sharded w would
-        # force an all-gather per lookup.  Shard-local-id sparse layouts are
-        # the data layer's job — refuse loudly rather than silently
-        # replicating a vector the caller asked to keep sharded.
-        raise ValueError(
-            "feature_sharded=True requires a DenseBatch; sparse batches use "
-            "global feature ids (project/densify first, or keep w replicated)")
     if not batch_presharded:
         batch = shard_batch(batch, mesh,
                             feature_axis=FEATURE_AXIS if feature_sharded else None)
     rep = replicate(mesh)
     if feature_sharded:
         d_pad = padded_dim(d, mesh)
-        if batch.x.shape[-1] != d_pad:
+        if isinstance(batch, DenseBatch) and batch.x.shape[-1] != d_pad:
             raise ValueError(
                 f"feature-sharded batch has {batch.x.shape[-1]} feature "
                 f"columns but w pads to {d_pad}; preshard with "
@@ -191,10 +314,19 @@ def fit_fixed_effect(
     else:
         w0 = jax.device_put(w0, rep)
     if feature_sharded:
-        # w stays P("feature") throughout; sharding propagates from inputs
-        # and GSPMD inserts the feature-axis contractions.
-        solve = make_solver(objective, optimizer, config, box=box)
-        fitted = jax.jit(solve)
+        if isinstance(batch, SparseBatch):
+            # Global-id sparse rows + blocked w: explicit shard_map objective
+            # (masked gather/scatter per block — see ShardSparseObjective).
+            # Solver state stays P("feature") via propagation from w0.
+            sm = ShardSparseObjective(objective, mesh,
+                                      d_pad // mesh.shape[FEATURE_AXIS])
+            solve = make_solver(sm, optimizer, config, box=box)
+            fitted = jax.jit(solve)
+        else:
+            # w stays P("feature") throughout; sharding propagates from
+            # inputs and GSPMD inserts the feature-axis contractions.
+            solve = make_solver(objective, optimizer, config, box=box)
+            fitted = jax.jit(solve)
     else:
         # Explicit SPMD (one psum per evaluation); the caller's fused flag is
         # honored as-is — under shard_map the pallas kernels run per-device

@@ -978,3 +978,214 @@ def test_fused_program_has_no_large_baked_constants(rng):
     # n=400 samples: a single leaked score vector would be 3.2KB (f64) and a
     # leaked design matrix 9.6KB+ — anything over 1KB means a leak
     assert const_bytes <= 1024, f"{const_bytes} bytes of baked constants"
+
+
+# --- box constraints through GAME configs (reference OptimizerConfig.scala:47,
+# --- applied via OptimizationUtils.projectCoefficientsToSubspace) ---
+
+def test_fixed_effect_constraints(rng):
+    """A constrained GAME fit keeps coefficients inside bounds and matches
+    scipy L-BFGS-B under the same box."""
+    import scipy.optimize as sopt
+    import scipy.special as sp
+
+    n, d = 600, 6
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d) * 2.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-x @ w_true))).astype(float)
+    data = GameData(y=y, features={"g": x})
+    l2 = 0.5
+    lo, hi = -0.25, 0.25
+    cfg = GameConfig(task=TaskType.LOGISTIC_REGRESSION, coordinates={
+        "fixed": FixedEffectConfig(
+            feature_shard="g", reg=Regularization(l2=l2),
+            solver=SolverConfig(max_iters=200, tolerance=1e-9),
+            constraints=tuple((j, lo, hi) for j in range(d)))})
+    res = GameEstimator(dtype=np.float64).fit(data, [cfg])[0]
+    w = np.asarray(res.model["fixed"].coefficients.means)
+    assert np.all(w >= lo - 1e-9) and np.all(w <= hi + 1e-9)
+    # some bounds must actually bind (w_true is far outside the box)
+    assert np.any(np.isclose(np.abs(w), 0.25, atol=1e-6))
+
+    def nll(wv):
+        z = x @ wv
+        return np.sum(np.logaddexp(0, z) - y * z) + 0.5 * l2 * wv @ wv
+
+    def grad(wv):
+        z = x @ wv
+        return x.T @ (sp.expit(z) - y) + l2 * wv
+
+    ref = sopt.minimize(nll, np.zeros(d), jac=grad, method="L-BFGS-B",
+                        bounds=[(lo, hi)] * d)
+    np.testing.assert_allclose(w, ref.x, atol=5e-5)
+
+
+def test_random_effect_constraints(rng):
+    """Constraints apply to EVERY entity's solve in the vmapped buckets."""
+    n_users, per_user, d = 8, 40, 3
+    n = n_users * per_user
+    x = rng.normal(size=(n, d))
+    uids = np.repeat(np.arange(n_users), per_user)
+    wu = rng.normal(size=(n_users, d)) * 3.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.einsum(
+        "nd,nd->n", x, wu[uids])))).astype(float)
+    data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    cfg = GameConfig(task=TaskType.LOGISTIC_REGRESSION, coordinates={
+        "per-user": RandomEffectConfig(
+            random_effect_type="userId", feature_shard="u",
+            reg=Regularization(l2=0.1),
+            constraints=((0, -0.5, 0.5), (2, 0.0, 1.0)))})
+    res = GameEstimator().fit(data, [cfg])[0]
+    m = res.model["per-user"]
+    assert np.all(m.w_stack[:, 0] >= -0.5 - 1e-6)
+    assert np.all(m.w_stack[:, 0] <= 0.5 + 1e-6)
+    assert np.all(m.w_stack[:, 2] >= -1e-6)
+    assert np.all(m.w_stack[:, 2] <= 1.0 + 1e-6)
+    # feature 1 unconstrained: at least one entity escapes the [-0.5, 0.5] box
+    assert np.any(np.abs(m.w_stack[:, 1]) > 0.5)
+
+
+def test_constraint_validation():
+    with pytest.raises(ValueError, match="lower bound"):
+        FixedEffectConfig(feature_shard="g", constraints=((0, 1.0, -1.0),))
+    with pytest.raises(ValueError, match="infinite"):
+        FixedEffectConfig(
+            feature_shard="g",
+            constraints=((0, float("-inf"), float("inf")),))
+    # dict form canonicalizes to sorted tuples
+    c = FixedEffectConfig(feature_shard="g",
+                          constraints={3: (0.0, 1.0), 1: (-1.0, 1.0)})
+    assert c.constraints == ((1, -1.0, 1.0), (3, 0.0, 1.0))
+    # TRON + constraints must refuse loudly at solver bind
+    from photon_ml_tpu.types import OptimizerType
+
+    data = GameData(y=np.ones(8), features={"g": np.ones((8, 2))})
+    with pytest.raises(ValueError, match="box"):
+        build_coordinate(
+            "fixed", data,
+            FixedEffectConfig(feature_shard="g", optimizer=OptimizerType.TRON,
+                              constraints=((0, -1.0, 1.0),)),
+            TaskType.LOGISTIC_REGRESSION)
+
+
+# --- per-entity normalization for random effects (reference
+# --- NormalizationContextRDD, RandomEffectOptimizationProblem.scala:154-178) ---
+
+def _re_norm_data(rng, n_users=6, per_user=50, d=4):
+    """Per-user logistic data with an intercept column and deliberately
+    badly-scaled features (what normalization is for)."""
+    n = n_users * per_user
+    scales = np.resize(np.asarray([1.0, 0.03, 12.0, 1.0]), d)
+    x = rng.normal(size=(n, d)) * scales
+    x[:, 0] = 1.0  # intercept
+    uids = np.repeat(np.arange(n_users), per_user)
+    wu = rng.normal(size=(n_users, d))
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.einsum(
+        "nd,nd->n", x, wu[uids])))).astype(float)
+    return x, uids, y
+
+
+def test_random_effect_shared_normalization_parity(rng):
+    """IDENTITY projector: ONE standardization context for every entity
+    (reference NormalizationContextBroadcast).  Each entity's published
+    coefficients must match a direct per-entity normalized host solve."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.losses import logistic_loss
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.core.objective import GLMObjective
+    from photon_ml_tpu.core.batch import dense_batch
+    from photon_ml_tpu.opt.solve import make_solver
+
+    x, uids, y = _re_norm_data(rng)
+    factors = 1.0 / (np.std(x, axis=0) + 1e-12)
+    shifts = np.mean(x, axis=0).copy()
+    factors[0], shifts[0] = 1.0, 0.0  # intercept untouched
+    norm = NormalizationContext(factors=jnp.asarray(factors, jnp.float32),
+                                shifts=jnp.asarray(shifts, jnp.float32))
+
+    data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    cfg = RandomEffectConfig(
+        random_effect_type="userId", feature_shard="u",
+        reg=Regularization(l2=0.3), intercept_index=0,
+        solver=SolverConfig(max_iters=100, tolerance=1e-9))
+    coord = build_coordinate("u", data, cfg, TaskType.LOGISTIC_REGRESSION,
+                             norm=norm)
+    model, _ = coord.update(np.zeros(len(y)))
+
+    obj = GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.3), norm=norm)
+    solve = jax.jit(make_solver(obj))
+    for u in range(6):
+        rows = uids == u
+        res = solve(jnp.zeros(x.shape[1], jnp.float32),
+                    dense_batch(x[rows].astype(np.float32),
+                                y[rows].astype(np.float32)))
+        w_ref = norm.model_to_original_space(res.w, 0)
+        slot = model.slot_of[u]
+        # f32 solves stop at slightly different iterates (vmapped vs single
+        # reduction order); parity is semantic, not bitwise
+        np.testing.assert_allclose(model.w_stack[slot], np.asarray(w_ref),
+                                   rtol=1e-2, atol=1e-3)
+
+
+def test_random_effect_projected_normalization_parity(rng):
+    """INDEX_MAP projector: the context projected into each entity's compact
+    space (reference NormalizationContextRDD case).  Compaction is exact, so
+    the published model must match the IDENTITY fit with the same context."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.types import ProjectorType
+
+    x, uids, y = _re_norm_data(rng, d=5)
+    # entity-disjoint sparsity so INDEX_MAP actually compacts
+    for u in range(6):
+        x[uids == u, 1 + (u % 3)] = 0.0
+    factors = 1.0 / (np.std(x, axis=0) + 1e-12)
+    factors[0] = 1.0
+    norm = NormalizationContext(factors=jnp.asarray(factors, jnp.float32),
+                                shifts=None)
+    data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+
+    def fit(projector):
+        cfg = RandomEffectConfig(
+            random_effect_type="userId", feature_shard="u",
+            reg=Regularization(l2=0.3), projector=projector,
+            solver=SolverConfig(max_iters=100, tolerance=1e-9))
+        coord = build_coordinate("u", data, cfg, TaskType.LOGISTIC_REGRESSION,
+                                 norm=norm)
+        model, _ = coord.update(np.zeros(len(y)))
+        return model
+
+    ident = fit(ProjectorType.IDENTITY)
+    comp = fit(ProjectorType.INDEX_MAP)
+    for u in range(6):
+        np.testing.assert_allclose(comp.w_stack[comp.slot_of[u]],
+                                   ident.w_stack[ident.slot_of[u]],
+                                   rtol=1e-2, atol=1e-3)
+
+
+def test_random_effect_normalization_rejections(rng):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.types import ProjectorType
+
+    x, uids, y = _re_norm_data(rng)
+    data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    norm_shift = NormalizationContext(factors=None,
+                                      shifts=jnp.asarray(np.full(4, 0.5)))
+    with pytest.raises(NotImplementedError, match="intercept"):
+        build_coordinate(
+            "u", data,
+            RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                               projector=ProjectorType.INDEX_MAP),
+            TaskType.LOGISTIC_REGRESSION, norm=norm_shift)
+    with pytest.raises(NotImplementedError, match="RANDOM"):
+        build_coordinate(
+            "u", data,
+            RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                               projector=ProjectorType.RANDOM, projected_dim=2),
+            TaskType.LOGISTIC_REGRESSION,
+            norm=NormalizationContext(factors=jnp.ones(4), shifts=None))

@@ -90,13 +90,85 @@ def test_fixed_effect_feature_sharded_box_and_norm(rng, devices):
     np.testing.assert_allclose(r.w, plain.w, rtol=1e-5, atol=1e-8)
 
 
-def test_fixed_effect_feature_sharded_sparse_raises(rng, devices):
-    idx = np.stack([rng.choice(D, size=2, replace=False) for _ in range(20)])
-    sb = sparse_batch(idx, rng.normal(size=(20, 2)), np.ones(20), dim=D)
-    obj = GLMObjective(loss=losses.logistic_loss)
+def _sparse_problem(rng, n=120, d=11, k=3, l2=0.1):
+    idx = np.stack([rng.choice(d, size=k, replace=False) for _ in range(n)])
+    val = rng.normal(size=(n, k))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.einsum(
+        "nk,nk->n", val, w[idx])))).astype(float)
+    sb = sparse_batch(idx, val, y, dim=d)
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=l2))
+    return sb, obj, d
+
+
+@pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+def test_fixed_effect_feature_sharded_sparse(rng, devices, opt):
+    """Sparse + feature-axis sharding (the 1M-feature scale path): global-id
+    rows against blocked w must match the replicated-w solve, including the
+    d=11-over-4-shards padding trim, for both LBFGS and TRON (hvp path)."""
+    sb, obj, d = _sparse_problem(rng)
     mesh = make_mesh(n_data=2, n_feature=4, devices=devices)
-    with pytest.raises(ValueError, match="DenseBatch"):
-        fit_fixed_effect(obj, sb, jnp.zeros(D), mesh, feature_sharded=True)
+    r = fit_fixed_effect(obj, sb, jnp.zeros(d), mesh, optimizer=opt,
+                         feature_sharded=True)
+    assert r.w.shape == (d,)
+    plain = jax.jit(make_solver(obj, opt))(jnp.zeros(d), sb)
+    np.testing.assert_allclose(r.value, plain.value, rtol=1e-8)
+    np.testing.assert_allclose(r.w, plain.w, rtol=1e-5, atol=1e-8)
+
+
+def test_fixed_effect_sparse_sharded_chip_count_invariance(rng, devices):
+    """Same optimum for sparse x (data, feature) meshes of any shape."""
+    sb, obj, d = _sparse_problem(rng, n=96)
+    r1 = fit_fixed_effect(obj, sb, jnp.zeros(d),
+                          make_mesh(n_data=1, devices=devices[:1]),
+                          feature_sharded=True)
+    for n_data, n_feature in [(1, 8), (4, 2), (2, 2)]:
+        mesh = make_mesh(n_data=n_data, n_feature=n_feature,
+                         devices=devices[: n_data * n_feature])
+        r = fit_fixed_effect(obj, sb, jnp.zeros(d), mesh, feature_sharded=True)
+        np.testing.assert_allclose(r.value, r1.value, rtol=1e-9)
+        np.testing.assert_allclose(r.w, r1.w, rtol=1e-6, atol=1e-9)
+
+
+def test_fixed_effect_feature_sharded_sparse_norm_and_variance(rng, devices):
+    """Scaling-only normalization flows through the blocked objective
+    (effective coefficients + chain rule at GSPMD level) and SIMPLE
+    variances (hessian_diag) match the unsharded computation; shift
+    normalization must refuse (it would densify sparse margins)."""
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.opt.solve import compute_variances
+    from photon_ml_tpu.parallel.fixed import ShardSparseObjective
+    from photon_ml_tpu.parallel.mesh import padded_dim, shard_batch, shard_coefficients
+    from photon_ml_tpu.types import VarianceComputationType
+
+    sb, _, d = _sparse_problem(rng)
+    factors = jnp.asarray(rng.random(d) + 0.5)
+    obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=0.2),
+                       norm=NormalizationContext(factors=factors, shifts=None))
+    mesh = make_mesh(n_data=2, n_feature=4, devices=devices)
+    r = fit_fixed_effect(obj, sb, jnp.zeros(d), mesh, feature_sharded=True)
+    plain = jax.jit(make_solver(obj, OptimizerType.LBFGS))(jnp.zeros(d), sb)
+    np.testing.assert_allclose(r.value, plain.value, rtol=1e-8)
+    np.testing.assert_allclose(r.w, plain.w, rtol=1e-5, atol=1e-8)
+
+    # SIMPLE variances through the blocked hessian_diag
+    d_pad = padded_dim(d, mesh)
+    padded_obj = obj.replace(norm=obj.norm.replace(
+        factors=jnp.pad(factors, (0, d_pad - d), constant_values=1.0)))
+    sm = ShardSparseObjective(padded_obj, mesh, d_pad // mesh.shape["feature"])
+    w_sh = shard_coefficients(jnp.asarray(plain.w), mesh)
+    b_sh = shard_batch(sb, mesh)
+    var = jax.jit(lambda w, b: compute_variances(
+        sm, w, b, VarianceComputationType.SIMPLE))(w_sh, b_sh)
+    var_plain = compute_variances(obj, plain.w, sb, VarianceComputationType.SIMPLE)
+    np.testing.assert_allclose(np.asarray(var)[:d], var_plain, rtol=1e-6)
+
+    # shift normalization refuses loudly
+    shifted = GLMObjective(
+        loss=losses.logistic_loss,
+        norm=NormalizationContext(factors=None, shifts=jnp.zeros(d) + 0.1))
+    with pytest.raises(ValueError, match="scaling-only"):
+        fit_fixed_effect(shifted, sb, jnp.zeros(d), mesh, feature_sharded=True)
 
 
 def test_fixed_effect_sparse_sharded(rng, devices):
