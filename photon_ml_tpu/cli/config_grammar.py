@@ -180,6 +180,12 @@ WILDCARD = "*"
 def resolve_constraints(entries, index_map) -> Tuple[Tuple[int, float, float], ...]:
     """Resolve a reference-grammar constraint list against a feature index map.
 
+    Scale note: wildcard entries materialize one (index, lo, hi) triple per
+    matched feature in Python — fine through ~1e5-feature vocabularies, but
+    at the 1e7+ store-backed scale an all-feature wildcard means 1e7 python
+    tuples and per-index name lookups; use explicit per-feature entries (or
+    no constraints) there.
+
     Reference semantics (GLMSuite.createConstraintFeatureMap:193-260):
     - every entry needs "name" and "term"; missing bounds default to ∓inf;
     - lo < hi, not both infinite;

@@ -464,10 +464,14 @@ def _run(args, task, t_start, emitter) -> int:
         axes = {}
         for part in args.mesh.split(","):
             k, _, v = part.partition("=")
-            if k.strip() not in ("data", "entity", "feature") or not v:
+            try:
+                size = int(v)
+            except ValueError:
+                size = 0
+            if k.strip() not in ("data", "entity", "feature") or size < 1:
                 raise SystemExit(f"bad --mesh fragment {part!r} "
-                                 "(expected data=N,entity=N,feature=N)")
-            axes[k.strip()] = int(v)
+                                 "(expected data=N,entity=N,feature=N, N >= 1)")
+            axes[k.strip()] = size
         mesh = make_mesh(n_data=axes.get("data"),
                          n_entity=axes.get("entity", 1),
                          n_feature=axes.get("feature", 1))
@@ -623,10 +627,18 @@ def _run(args, task, t_start, emitter) -> int:
     # Always fit the explicit reg-weight grid; tuning then explores FROM the
     # best grid point (reference: grid first, tuner after, :643-674).
     emitter.emit("fit_start", configs=len(configs))
-    results = est.fit(data, configs, validation_data=val_data, seed=args.seed,
-                      initial_model=initial_model, locked_coordinates=locked,
-                      checkpoint_hook=checkpoint_hook, resume_cursor=resume_cursor,
-                      resume_best=resume_best)
+    try:
+        results = est.fit(data, configs, validation_data=val_data, seed=args.seed,
+                          initial_model=initial_model, locked_coordinates=locked,
+                          checkpoint_hook=checkpoint_hook, resume_cursor=resume_cursor,
+                          resume_best=resume_best)
+    except (ValueError, NotImplementedError) as e:
+        # config-shaped refusals raised at coordinate build/bind time (e.g.
+        # box constraints under shift normalization, normalization under a
+        # RANDOM projector) get the same error contract as every other
+        # config validation failure — with the traceback preserved in the log
+        logger.exception("configuration rejected during fit: %s", e)
+        return 1
     best = est.best(results)
     tuned_results = []
     if args.tuning_iterations > 0:

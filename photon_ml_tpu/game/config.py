@@ -116,7 +116,13 @@ def _canonicalize_constraints(cfg) -> None:
         c = tuple((int(j), *map(float, bounds)) for j, bounds in c.items())
     else:
         c = tuple((int(j), float(lo), float(hi)) for j, lo, hi in c)
+    seen = set()
     for j, lo, hi in c:
+        if j in seen:
+            raise ValueError(
+                f"duplicate constraint for feature index {j} (later entries "
+                "would silently overwrite earlier bounds)")
+        seen.add(j)
         if not lo < hi:
             raise ValueError(
                 f"constraint on feature {j}: lower bound {lo} must be < "

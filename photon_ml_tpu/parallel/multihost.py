@@ -83,22 +83,37 @@ def initialize(coordinator_address: Optional[str] = None,
 
 
 def global_mesh(n_entity: int = 1, n_feature: int = 1) -> Mesh:
-    """A (data, entity, feature) mesh over ALL processes' devices.
+    """A (data, entity, feature) mesh over ALL processes' devices, laid out
+    so collectives ride the right interconnect tier.
 
-    The data axis spans every chip in the job; XLA routes its collectives
-    over ICI within a slice and DCN across slices automatically.  Thin
-    strict wrapper over ``parallel.mesh.make_mesh`` (which would silently
-    truncate a non-dividing remainder).
+    ICI/DCN mapping (the multi-slice story, SURVEY §5): the ``entity`` and
+    ``feature`` axes are placed INNERMOST WITHIN each process's (slice's)
+    devices, so their collectives — the per-evaluation feature-axis margin
+    psum of the sharded sparse objective, the entity-lane layouts — always
+    ride ICI.  Only the ``data`` axis strides ACROSS processes, so the one
+    gradient all-reduce per objective evaluation is the only collective that
+    ever touches DCN — exactly the reference's cluster-network role
+    (treeAggregate over Spark executors), and DP gradient all-reduce is the
+    one collective that amortizes DCN latency well.
+
+    Within a single process this degenerates to ``make_mesh``'s layout.
     """
-    from photon_ml_tpu.parallel.mesh import make_mesh
-
-    n = len(jax.devices())
-    if n % (n_entity * n_feature):
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    n_proc = jax.process_count()
+    local = n // n_proc
+    cell = n_entity * n_feature
+    if n % cell:
         raise ValueError(
-            f"{n} global devices not divisible by entity*feature = "
-            f"{n_entity * n_feature}")
-    return make_mesh(n_data=n // (n_entity * n_feature),
-                     n_entity=n_entity, n_feature=n_feature)
+            f"{n} global devices not divisible by entity*feature = {cell}")
+    if n_proc > 1 and local % cell:
+        raise ValueError(
+            f"entity*feature = {cell} does not fit within one process's "
+            f"{local} devices — entity/feature collectives must stay on ICI "
+            "(within a slice); shrink those axes or grow the slice")
+    arr = (np.asarray(devices)
+           .reshape(n // cell, n_entity, n_feature))
+    return Mesh(arr, (DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS))
 
 
 def process_row_range(n: int,
