@@ -274,7 +274,12 @@ def run_a1a(platform, scale):
 
 
 def run_sparse1m(platform, scale):
-    """BASELINE #2: 1M-feature sparse Poisson, TRON."""
+    """BASELINE #2: 1M-feature sparse Poisson, TRON.
+
+    L2-only: the reference itself rejects TRON with L1/elastic-net
+    (OptimizerFactory.scala:71-72), so BASELINE.md's "TRON + elastic-net"
+    wording is unattainable in the reference too — this config matches what
+    the reference can actually run."""
     from photon_ml_tpu.opt.types import SolverConfig
     from photon_ml_tpu.types import OptimizerType
 
