@@ -73,6 +73,7 @@ def save_checkpoint(
     best: Optional[Tuple[GameModel, EvaluationResults]] = None,
     best_changed: bool = True,
     fingerprint: Optional[str] = None,
+    fmt: str = "avro",
 ) -> None:
     """``cursor``: {"iteration": i, "coordinate": k} — the NEXT update to run.
 
@@ -80,6 +81,10 @@ def save_checkpoint(
     that coordinate is re-serialized; the rest hard-link to the previous
     version.  ``best``: best-so-far (model, evaluation) retained across
     resume; re-serialized only when ``best_changed``.
+
+    ``fmt``: model serialization format (see model_io.save_coordinate) —
+    "columnar" makes per-update checkpoints O(1)-Python at huge d.  A format
+    change invalidates prev-version coordinate reuse (no cross-format links).
     """
     os.makedirs(ckpt_dir, exist_ok=True)
     prev = _read_pointer(ckpt_dir)
@@ -98,8 +103,14 @@ def save_checkpoint(
         prev_meta = None
         if prev_dir is not None:
             with open(os.path.join(prev_dir, "metadata.json")) as f:
-                prev_meta = json.load(f)["coordinates"]
+                prev_doc = json.load(f)
+            if prev_doc.get("format", "avro") == fmt:
+                prev_meta = prev_doc["coordinates"]
+            else:
+                prev_dir = None  # format changed: never link old-format files
         meta = {"version": FORMAT_VERSION, "task": task.value, "coordinates": {}}
+        if fmt == "columnar":
+            meta["format"] = "columnar"
         for cid, m in model.models.items():
             rel = coordinate_rel_dir(cid, m)
             src = os.path.join(prev_dir, rel) if prev_dir is not None else None
@@ -109,7 +120,7 @@ def save_checkpoint(
                 meta["coordinates"][cid] = prev_meta[cid]
             else:
                 meta["coordinates"][cid] = save_coordinate(
-                    cid, m, tmp, index_maps, entity_indexes)
+                    cid, m, tmp, index_maps, entity_indexes, fmt=fmt)
         with open(os.path.join(tmp, "metadata.json"), "w") as f:
             json.dump(meta, f, indent=2)
 
@@ -135,7 +146,8 @@ def save_checkpoint(
                 shutil.copyfile(os.path.join(tmp, "metadata.json"),
                                 os.path.join(bdir, "metadata.json"))
             else:
-                save_game_model(best_model, bdir, index_maps, entity_indexes, task)
+                save_game_model(best_model, bdir, index_maps, entity_indexes,
+                                task, fmt=fmt)
         cursor_doc = dict(cursor)
         if fingerprint is not None:
             cursor_doc["fingerprint"] = fingerprint
