@@ -177,7 +177,14 @@ def eligible(batch, interpret: bool = False) -> bool:
     """True when the pallas kernel path can run: TPU present, lane-aligned
     dim, and dim small enough that the (_NACC, d) accumulators + X tile fit
     VMEM.  Callers (GLMObjective) use their plain-XLA path otherwise — the
-    kernels raise rather than silently duplicating that math here."""
+    kernels raise rather than silently duplicating that math here.
+
+    PHOTON_GLM_DISABLE_PALLAS=1 forces the plain-XLA path everywhere —
+    the bench's pallas-vs-XLA A/B knob (and an escape hatch)."""
+    import os
+
+    if os.environ.get("PHOTON_GLM_DISABLE_PALLAS") == "1":
+        return False
     if not isinstance(batch, DenseBatch):
         return False
     if interpret:

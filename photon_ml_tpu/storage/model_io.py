@@ -94,6 +94,54 @@ def _re_records(m: "RandomEffectModel", eidx: Optional[EntityIndex],
                                model_class=model_class)
 
 
+def _index_map_fingerprint(imap) -> dict:
+    """FULL-content fingerprint of an index map: {"scheme": ..., "value": ...}.
+
+    Columnar models are POSITION-bound to their index maps; a same-size map
+    with different contents would silently misassign every coefficient, so
+    the loader verifies this fingerprint — complete coverage, not a sample.
+    Two schemes (tagged, so loaders only compare like with like, and future
+    scheme changes degrade to skipping the check rather than refusing valid
+    models):
+
+    - store maps hash their mmap file bytes (C speed, ~0.4s per GB);
+    - dict maps hash every (key, id) pair in ITERATION order (deterministic
+      for maps built by the same code path; a logically-equal map built in a
+      different order refuses — the safe direction).
+
+    Cached on the instance: save+load in one process pays the pass once.
+    """
+    import hashlib
+    import itertools
+
+    cached = getattr(imap, "_content_fp", None)
+    if cached is not None:
+        return cached
+    from photon_ml_tpu.data.native_index import StoreIndexMap
+
+    h = hashlib.sha1()
+    if isinstance(imap, StoreIndexMap):
+        scheme = "phfp1-store"
+        with open(imap._path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 22), b""):
+                h.update(chunk)
+    else:
+        scheme = "phfp1-items"
+        h.update(f"{imap.size}:{imap.intercept_index}".encode())
+        pairs = (f"{k}={i}" for k, i in imap.items())
+        while True:
+            block = "\x1f".join(itertools.islice(pairs, 65536))
+            if not block:
+                break
+            h.update(block.encode())
+    fp = {"scheme": scheme, "value": h.hexdigest()[:16]}
+    try:
+        imap._content_fp = fp
+    except AttributeError:
+        pass  # slotted/foreign map types just recompute
+    return fp
+
+
 def coordinate_rel_dir(cid: str, m) -> str:
     """Relative directory of one coordinate inside a model dir."""
     kind = "fixed-effect" if isinstance(m, FixedEffectModel) else "random-effect"
@@ -124,6 +172,8 @@ def save_coordinate(
     entity_indexes = entity_indexes or {}
     cdir = os.path.join(out_dir, coordinate_rel_dir(cid, m))
     os.makedirs(cdir, exist_ok=True)
+    fp = (_index_map_fingerprint(index_maps[m.feature_shard])
+          if fmt == "columnar" and m.feature_shard in index_maps else None)
     if isinstance(m, FixedEffectModel):
         if fmt == "columnar":
             arrays = {"means": np.asarray(m.coefficients.means)}
@@ -136,7 +186,10 @@ def save_coordinate(
                                    m.coefficients.variances, imap, m.task.value)
             avro_io.write_container(os.path.join(cdir, "coefficients.avro"),
                                     BAYESIAN_LINEAR_MODEL, [rec])
-        return {"type": "fixed", "feature_shard": m.feature_shard}
+        out = {"type": "fixed", "feature_shard": m.feature_shard}
+        if fp is not None:
+            out["index_fingerprint"] = fp
+        return out
     if isinstance(m, RandomEffectModel):
         eidx = entity_indexes.get(m.random_effect_type)
         if fmt == "columnar":
@@ -156,11 +209,14 @@ def save_coordinate(
                   for eid in m.slot_of}
         with open(os.path.join(cdir, "id-index.json"), "w") as f:
             json.dump(id_map, f)
-        return {
+        out = {
             "type": "random",
             "feature_shard": m.feature_shard,
             "random_effect_type": m.random_effect_type,
         }
+        if fp is not None:
+            out["index_fingerprint"] = fp
+        return out
     raise TypeError(f"cannot save model type {type(m)!r}")
 
 
@@ -201,25 +257,44 @@ def load_game_model(
     models: Dict[str, object] = {}
 
     if meta.get("format") == "columnar":
-        def _check_binding(cid, shard, d_saved):
+        def _check_binding(cid, info, d_saved):
             # columnar coefficients are POSITION-bound to the saving run's
-            # index map — a size mismatch means the features moved; fail
-            # loudly instead of silently misassigning every coefficient
-            imap = index_maps.get(shard)
-            if imap is not None and d_saved != imap.size:
+            # index map — a size mismatch OR content churn (same size,
+            # shuffled positions: checked via the saved fingerprint) means
+            # the features moved; fail loudly instead of silently
+            # misassigning every coefficient
+            imap = index_maps.get(info["feature_shard"])
+            if imap is None:
+                return
+            bound = (f"columnar models bind to the saving run's index maps "
+                     f"(load with those maps, or re-save as the portable "
+                     f"avro format)")
+            if d_saved != imap.size:
                 raise ValueError(
                     f"columnar model coordinate {cid!r} has {d_saved} "
-                    f"coefficients but index map for shard {shard!r} has "
-                    f"{imap.size} features — columnar models bind to the "
-                    "saving run's index maps (load with those maps, or "
-                    "re-save as the portable avro format)")
+                    f"coefficients but index map for shard "
+                    f"{info['feature_shard']!r} has {imap.size} features — "
+                    + bound)
+            saved_fp = info.get("index_fingerprint")
+            if isinstance(saved_fp, dict):
+                ours = _index_map_fingerprint(imap)
+                # compare only like schemes: an unknown/different scheme
+                # (older model, different map kind) skips the check instead
+                # of refusing a valid model
+                if (saved_fp.get("scheme") == ours["scheme"]
+                        and saved_fp.get("value") != ours["value"]):
+                    raise ValueError(
+                        f"columnar model coordinate {cid!r}: index map for "
+                        f"shard {info['feature_shard']!r} has the same size "
+                        f"but different contents than the saving run's — "
+                        + bound)
 
         for cid, info in meta["coordinates"].items():
             shard = info["feature_shard"]
             if info["type"] == "fixed":
                 z = np.load(os.path.join(model_dir, "fixed-effect", cid,
                                          "coefficients.npz"))
-                _check_binding(cid, shard, z["means"].shape[-1])
+                _check_binding(cid, info, z["means"].shape[-1])
                 models[cid] = FixedEffectModel(
                     coefficients=Coefficients(
                         means=z["means"],
@@ -228,7 +303,7 @@ def load_game_model(
             else:
                 cdir = os.path.join(model_dir, "random-effect", cid)
                 z = np.load(os.path.join(cdir, "coefficients.npz"))
-                _check_binding(cid, shard, z["w_stack"].shape[-1])
+                _check_binding(cid, info, z["w_stack"].shape[-1])
                 re_type = info["random_effect_type"]
                 # entity ids remap BY NAME through id-index.json (same
                 # contract as the avro path's _stack_random_effect): the

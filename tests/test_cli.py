@@ -826,3 +826,44 @@ def test_columnar_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(outs["avro"]["g"].coefficients.means,
                                outs["columnar"]["g"].coefficients.means,
                                rtol=1e-6, atol=1e-8)
+
+
+def test_columnar_cross_run_entity_remap_and_fingerprint(tmp_path):
+    """The two columnar-binding safety contracts: (1) entity ids remap BY
+    NAME through id-index.json when the loading run numbers entities
+    differently; (2) a same-size-but-different index map is refused via the
+    content fingerprint."""
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import GameModel, RandomEffectModel
+    from photon_ml_tpu.storage.model_io import load_game_model, save_game_model
+
+    d = str(tmp_path / "m")
+    imap = IndexMap({feature_key(f"f{j}", ""): j for j in range(3)})
+    eidx_a = EntityIndex()
+    a_alice, a_bob = eidx_a.get_or_add("alice"), eidx_a.get_or_add("bob")
+    w = np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    m = RandomEffectModel(w_stack=w, slot_of={a_alice: 0, a_bob: 1},
+                          random_effect_type="userId", feature_shard="s",
+                          task=TaskType.LOGISTIC_REGRESSION)
+    save_game_model(GameModel(models={"u": m}), d, {"s": imap},
+                    {"userId": eidx_a}, TaskType.LOGISTIC_REGRESSION,
+                    fmt="columnar")
+
+    # loading run sees bob FIRST -> different ids; names must still win
+    eidx_b = EntityIndex()
+    b_bob, b_alice = eidx_b.get_or_add("bob"), eidx_b.get_or_add("alice")
+    model, _ = load_game_model(d, {"s": imap}, {"userId": eidx_b})
+    np.testing.assert_array_equal(
+        model["u"].w_stack[model["u"].slot_of[b_alice]], [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(
+        model["u"].w_stack[model["u"].slot_of[b_bob]], [4.0, 5.0, 6.0])
+
+    # same-size, different-content index map -> loud fingerprint refusal
+    imap_shuffled = IndexMap({feature_key(f"g{j}", ""): j for j in range(3)})
+    with pytest.raises(ValueError, match="different contents"):
+        load_game_model(d, {"s": imap_shuffled}, {"userId": eidx_b})
+    # different size -> loud size refusal
+    imap_small = IndexMap({feature_key("f0", ""): 0})
+    with pytest.raises(ValueError, match="coefficients"):
+        load_game_model(d, {"s": imap_small}, {"userId": eidx_b})
