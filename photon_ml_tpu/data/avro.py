@@ -279,30 +279,12 @@ _META_SCHEMA: Schema = {"type": "map", "values": "bytes"}
 
 def read_container(path: str) -> Iterator[dict]:
     """Iterate records of an Avro object container file (null/deflate codecs)."""
-    with open(path, "rb") as f:
-        data = f.read()
-    r = _Reader(data)
-    if r.raw(4) != MAGIC:
-        raise ValueError(f"{path}: not an Avro container file")
+    schema, blocks = read_container_raw(path)
     named: Dict[str, dict] = {}
-    meta = decode(_META_SCHEMA, r, named)  # str keys, bytes values
-    schema = json.loads(meta["avro.schema"])
-    codec = meta.get("avro.codec", b"null").decode()
-    sync = r.raw(16)
-    named = {}
-    while r.pos < len(data):
-        count = r.long()
-        size = r.long()
-        block = r.raw(size)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec != "null":
-            raise ValueError(f"unsupported avro codec {codec!r}")
+    for count, block in blocks:
         br = _Reader(block)
         for _ in range(count):
             yield decode(schema, br, named)
-        if r.raw(16) != sync:
-            raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
 
 
 def read_schema(path: str) -> dict:
@@ -317,6 +299,18 @@ def read_schema(path: str) -> dict:
     return json.loads(raw if isinstance(raw, (str, bytes)) else bytes(raw))
 
 
+def _write_header(f, schema: Schema, codec: str, sync: bytes, named) -> None:
+    """Container header framing — the ONE home shared by write_container
+    and write_container_raw."""
+    f.write(MAGIC)
+    header = bytearray()
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    encode(_META_SCHEMA, meta, header, named)
+    f.write(bytes(header))
+    f.write(sync)
+
+
 def write_container(path: str, schema: Schema, records: Iterable[dict],
                     codec: str = "deflate", sync: bytes = b"photon-ml-tpu-sm",
                     block_records: int = 4096) -> int:
@@ -325,13 +319,7 @@ def write_container(path: str, schema: Schema, records: Iterable[dict],
     named: Dict[str, dict] = {}
     n_total = 0
     with open(path, "wb") as f:
-        f.write(MAGIC)
-        header = bytearray()
-        meta = {"avro.schema": json.dumps(schema).encode(),
-                "avro.codec": codec.encode()}
-        encode(_META_SCHEMA, meta, header, named)
-        f.write(bytes(header))
-        f.write(sync)
+        _write_header(f, schema, codec, sync, named)
 
         block = bytearray()
         n_block = 0
@@ -376,3 +364,60 @@ def read_directory(path: str) -> Iterator[dict]:
     part-files from an HDFS dir, AvroUtils.readAvroFiles)."""
     for f in list_avro_files(path):
         yield from read_container(f)
+
+
+def write_container_raw(path: str, schema: Schema, encoded_records,
+                        codec: str = "deflate",
+                        sync: bytes = b"photon-ml-tpu-sm") -> int:
+    """Write PRE-ENCODED record bodies (bytes each) into a container file —
+    the native-codec fast path's framing half (the generic ``write_container``
+    encodes python dicts; this skips straight to block assembly)."""
+    assert len(sync) == 16
+    named: Dict[str, dict] = {}
+    n_total = 0
+    with open(path, "wb") as f:
+        _write_header(f, schema, codec, sync, named)
+        for body in encoded_records:
+            payload = bytes(body)
+            if codec == "deflate":
+                comp = zlib.compressobj(wbits=-15)
+                payload = comp.compress(payload) + comp.flush()
+            head = bytearray()
+            _encode_long(1, head)
+            _encode_long(len(payload), head)
+            f.write(bytes(head))
+            f.write(payload)
+            f.write(sync)
+            n_total += 1
+    return n_total
+
+
+def read_container_raw(path: str):
+    """Yield decompressed (record_count, raw_block_bytes) pairs plus the
+    writer schema: returns (schema, iterator) — the native-codec fast
+    path's read half.  Callers must decode records out of each block
+    themselves (records are concatenated with no framing)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    if r.raw(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta = decode(_META_SCHEMA, r, {})
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = r.raw(16)
+
+    def blocks():
+        while r.pos < len(data):
+            count = r.long()
+            size = r.long()
+            block = r.raw(size)
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            elif codec != "null":
+                raise ValueError(f"unsupported avro codec {codec!r}")
+            if r.raw(16) != sync:
+                raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+            yield count, block
+
+    return schema, blocks()

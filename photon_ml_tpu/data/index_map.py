@@ -79,6 +79,24 @@ class IndexMap:
     def items(self) -> Iterator[Tuple[str, int]]:
         return iter(self._fwd.items())
 
+    def key_blob(self):
+        """(utf-8 key blob, offsets[n+1] int64) ordered by index — the bulk
+        boundary format shared with the native store/codec (one flat buffer
+        instead of n python strings); cached per instance."""
+        import numpy as np
+
+        cached = getattr(self, "_key_blob", None)
+        if cached is not None:
+            return cached
+        rev = [b""] * len(self._fwd)
+        for k, i in self._fwd.items():
+            rev[i] = k.encode("utf-8")
+        offs = np.zeros(len(rev) + 1, np.int64)
+        np.cumsum([len(b) for b in rev], out=offs[1:])
+        blob = np.frombuffer(b"".join(rev), np.uint8)
+        self._key_blob = (blob, offs)
+        return self._key_blob
+
     # -- builders -----------------------------------------------------------
 
     @classmethod

@@ -193,6 +193,58 @@ class StoreIndexMap:
             return out
         return np.asarray([self._py_probe(k) for k in enc], np.int64)
 
+    def key_blob(self):
+        """(utf-8 key blob, offsets[n+1] int64) ordered by index, read
+        straight out of the store's mmap — zero copies of the 1e7+ keys
+        (the arrays view the mapping; numpy keeps it alive)."""
+        mm = self._mm
+        if mm is None:
+            # native-handle instances never built the python-side view;
+            # map the (already phidx_open-validated) file lazily once
+            f = open(self._path, "rb")
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            f.close()
+            if mm[:8] != MAGIC2:
+                raise ValueError(f"{self._path}: not a PHIDX002 store")
+            n, table_size = struct.unpack_from("<qq", mm, 8)
+            if n != self._n:
+                raise ValueError(f"{self._path}: store changed on disk "
+                                 f"({n} keys now, opened with {self._n})")
+            slots_off = 24
+            offsets_off = slots_off + 8 * table_size
+            blob_off = offsets_off + 8 * (n + 1)
+            if (n < 0 or table_size < 8 or table_size & (table_size - 1)
+                    or n > table_size or blob_off > len(mm)):
+                raise ValueError(f"{self._path}: corrupt PHIDX002 store header")
+            (blob_len,) = struct.unpack_from("<q", mm, offsets_off + 8 * n)
+            if blob_len < 0 or blob_off + blob_len > len(mm):
+                raise ValueError(f"{self._path}: truncated PHIDX002 store")
+            self._mm = mm
+            self._table_size = table_size
+            self._slots_off = slots_off
+            self._offsets_off = offsets_off
+            self._blob_off = blob_off
+        offsets = np.frombuffer(mm, np.int64, self._n + 1,
+                                offset=self._offsets_off)
+        blob = np.frombuffer(mm, np.uint8, int(offsets[-1]),
+                             offset=self._blob_off)
+        return blob, offsets
+
+    def get_indices_blob(self, blob: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+        """Batch lookup over an already-packed key blob (the native codec's
+        output format) — no python strings at any point."""
+        n = len(offsets) - 1
+        if self._handle is not None:
+            out = np.empty(n, np.int64)
+            _native_lib().phidx_get_batch(
+                self._handle, blob.ctypes.data, offsets.ctypes.data, n,
+                out.ctypes.data)
+            return out
+        raw = blob.tobytes()
+        return np.asarray(
+            [self._py_probe(raw[offsets[i]:offsets[i + 1]]) for i in range(n)],
+            np.int64)
+
     def get_feature_name(self, idx: int) -> Optional[Tuple[str, str]]:
         if not 0 <= idx < self._n:
             return None

@@ -1,0 +1,272 @@
+// Native Avro codec for BayesianLinearModelAvro record BODIES — the
+// huge-d fixed-effect model files (reference BayesianLinearModelAvro,
+// photon-avro-schemas; written by ModelProcessingUtils.scala:77-141).
+//
+// Why native: the portable model format stores one (name, term, value)
+// triple per nonzero coefficient.  At 1e7 features the pure-python codec
+// spends minutes building/parsing 1e7 python dicts; this codec moves the
+// whole triple array across the boundary as three flat buffers (packed
+// key blob + offsets + f64 values), so python-side work is O(1) in d.
+// The container framing (magic, schema header, deflate blocks, sync
+// markers) stays in data/avro.py — zlib is already C-speed there.
+//
+// Key blob convention matches native_index._pack_keys / index_store.cpp:
+// concatenated utf-8 feature keys (name + '\x1f' + term), offsets[n+1].
+//
+// C ABI + ctypes (no pybind11 in this image); two-pass decode protocol
+// (scan for sizes, then fill caller-allocated buffers).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr char SEP = '\x1f';  // data/index_map.py SEP
+
+// ---- zigzag varints (Avro spec) -------------------------------------------
+
+inline int put_varint(int64_t v, uint8_t* out) {
+    uint64_t z = (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+    int n = 0;
+    while (z >= 0x80) {
+        out[n++] = static_cast<uint8_t>(z | 0x80);
+        z >>= 7;
+    }
+    out[n++] = static_cast<uint8_t>(z);
+    return n;
+}
+
+inline bool get_varint(const uint8_t* buf, int64_t len, int64_t* pos, int64_t* out) {
+    uint64_t z = 0;
+    int shift = 0;
+    while (*pos < len && shift <= 63) {
+        uint8_t b = buf[(*pos)++];
+        z |= static_cast<uint64_t>(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = static_cast<int64_t>(z >> 1) ^ -static_cast<int64_t>(z & 1);
+            return true;
+        }
+        shift += 7;
+    }
+    return false;
+}
+
+inline bool skip_string(const uint8_t* buf, int64_t len, int64_t* pos) {
+    int64_t n;
+    if (!get_varint(buf, len, pos, &n) || n < 0 || *pos + n > len) return false;
+    *pos += n;
+    return true;
+}
+
+// one NTV item: name string, term string, value double
+inline bool scan_ntv(const uint8_t* buf, int64_t len, int64_t* pos,
+                     int64_t* key_bytes) {
+    int64_t n;
+    if (!get_varint(buf, len, pos, &n) || n < 0 || *pos + n > len) return false;
+    *key_bytes += n + 1;  // + SEP
+    *pos += n;
+    if (!get_varint(buf, len, pos, &n) || n < 0 || *pos + n > len) return false;
+    *key_bytes += n;
+    *pos += n + 0;
+    if (*pos + 8 > len) return false;
+    *pos += 8;
+    return true;
+}
+
+// Avro array decode driver: f(item) for each item across all blocks.
+// Handles negative block counts (count<0 => followed by byte size).
+template <typename F>
+inline bool walk_array(const uint8_t* buf, int64_t len, int64_t* pos, F&& f) {
+    for (;;) {
+        int64_t count;
+        if (!get_varint(buf, len, pos, &count)) return false;
+        if (count == 0) return true;
+        if (count < 0) {
+            int64_t nbytes;
+            if (!get_varint(buf, len, pos, &nbytes)) return false;
+            count = -count;
+        }
+        for (int64_t i = 0; i < count; ++i)
+            if (!f()) return false;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// ENCODE one record body.
+//
+// keys_blob/key_off[n+1]: packed feature keys (name SEP term) indexed by
+// coefficient position j (the index map's key blob order).  values[d],
+// variances[d] or null.  Zero means are skipped (sparse NTV storage, like
+// the reference); a variance is emitted iff its mean is emitted.
+// Returns bytes written, or -(bytes needed) when cap is too small (call
+// again with a bigger buffer), or 0 on malformed input.
+// ---------------------------------------------------------------------------
+int64_t plmc_encode(const char* model_id, int64_t model_id_len,
+                    const char* model_class, int64_t model_class_len,  // <0: null branch
+                    const char* loss, int64_t loss_len,                // <0: null branch
+                    const char* keys_blob, const int64_t* key_off,
+                    const double* values, const double* variances,
+                    int64_t d, char* out, int64_t cap) {
+    if (d < 0) return 0;
+    // conservative size bound: per item 2 varints(<=5B ea for typical keys)
+    // + key bytes + 8B double; strings + unions + block headers
+    int64_t nnz = 0, key_bytes = 0;
+    for (int64_t j = 0; j < d; ++j) {
+        if (values[j] == 0.0) continue;
+        ++nnz;
+        key_bytes += key_off[j + 1] - key_off[j];
+    }
+    int64_t bound = 64 + model_id_len + model_class_len + loss_len
+        + 2 * (key_bytes + nnz * 28) + 64;
+    if (cap < bound) return -bound;
+
+    uint8_t* o = reinterpret_cast<uint8_t*>(out);
+    int64_t p = 0;
+    auto put_str = [&](const char* s, int64_t n) {
+        p += put_varint(n, o + p);
+        std::memcpy(o + p, s, n);
+        p += n;
+    };
+    auto put_double = [&](double v) {
+        std::memcpy(o + p, &v, 8);  // IEEE754 little-endian (x86/ARM LE)
+        p += 8;
+    };
+    auto put_items = [&](const double* arr) {
+        // one positive-count block then the 0 terminator (legal Avro;
+        // both our python decoder and Java Avro read it).  An EMPTY array
+        // is just the terminator — emitting count=0 twice would shift
+        // every following field by one byte.
+        if (nnz > 0) p += put_varint(nnz, o + p);
+        if (nnz > 0) {
+            for (int64_t j = 0; j < d; ++j) {
+                if (values[j] == 0.0) continue;
+                const char* key = keys_blob + key_off[j];
+                int64_t klen = key_off[j + 1] - key_off[j];
+                const char* sep = static_cast<const char*>(
+                    std::memchr(key, SEP, static_cast<size_t>(klen)));
+                int64_t name_len = sep ? (sep - key) : klen;
+                const char* term = sep ? sep + 1 : key + klen;
+                int64_t term_len = sep ? (key + klen - term) : 0;
+                put_str(key, name_len);
+                put_str(term, term_len);
+                put_double(arr[j]);
+            }
+        }
+        p += put_varint(0, o + p);
+    };
+
+    put_str(model_id, model_id_len);                     // modelId
+    if (model_class_len < 0) p += put_varint(0, o + p);  // modelClass union
+    else { p += put_varint(1, o + p); put_str(model_class, model_class_len); }
+    put_items(values);                                   // means
+    if (variances == nullptr) p += put_varint(0, o + p); // variances union
+    else { p += put_varint(1, o + p); put_items(variances); }
+    if (loss_len < 0) p += put_varint(0, o + p);         // lossFunction union
+    else { p += put_varint(1, o + p); put_str(loss, loss_len); }
+    return p;
+}
+
+// ---------------------------------------------------------------------------
+// DECODE pass 1: scan one record body for sizes.
+// Outputs: consumed bytes, n_means, means_key_bytes (packed keys incl. SEP),
+// n_vars (-1 when the variances branch is null), vars_key_bytes,
+// model_id/class/loss lengths (class/loss -1 when null).
+// Returns 1 on success, 0 on malformed input.
+// ---------------------------------------------------------------------------
+int64_t plmc_scan(const char* buf_, int64_t len, int64_t* consumed,
+                  int64_t* n_means, int64_t* means_key_bytes,
+                  int64_t* n_vars, int64_t* vars_key_bytes,
+                  int64_t* id_len, int64_t* class_len, int64_t* loss_len) {
+    const uint8_t* buf = reinterpret_cast<const uint8_t*>(buf_);
+    int64_t pos = 0, n;
+    if (!get_varint(buf, len, &pos, &n) || n < 0 || pos + n > len) return 0;
+    *id_len = n; pos += n;                               // modelId
+    if (!get_varint(buf, len, &pos, &n)) return 0;       // modelClass union
+    if (n == 1) {
+        int64_t s = pos;
+        if (!skip_string(buf, len, &pos)) return 0;
+        int64_t hdr; get_varint(buf, len, &s, &hdr); *class_len = hdr;
+    } else if (n == 0) *class_len = -1; else return 0;
+    *n_means = 0; *means_key_bytes = 0;
+    if (!walk_array(buf, len, &pos, [&] {                // means
+            ++*n_means;
+            return scan_ntv(buf, len, &pos, means_key_bytes);
+        }))
+        return 0;
+    if (!get_varint(buf, len, &pos, &n)) return 0;       // variances union
+    if (n == 1) {
+        *n_vars = 0; *vars_key_bytes = 0;
+        if (!walk_array(buf, len, &pos, [&] {
+                ++*n_vars;
+                return scan_ntv(buf, len, &pos, vars_key_bytes);
+            }))
+            return 0;
+    } else if (n == 0) { *n_vars = -1; *vars_key_bytes = 0; } else return 0;
+    if (!get_varint(buf, len, &pos, &n)) return 0;       // lossFunction union
+    if (n == 1) {
+        int64_t s = pos;
+        if (!skip_string(buf, len, &pos)) return 0;
+        int64_t hdr; get_varint(buf, len, &s, &hdr); *loss_len = hdr;
+    } else if (n == 0) *loss_len = -1; else return 0;
+    *consumed = pos;
+    return 1;
+}
+
+// ---------------------------------------------------------------------------
+// DECODE pass 2: fill caller-allocated buffers sized from plmc_scan.
+// Key blobs are packed (name SEP term) with offsets[n+1] — feed them
+// straight to phidx_get_batch (store maps) or split python-side.
+// ---------------------------------------------------------------------------
+int64_t plmc_fill(const char* buf_, int64_t len,
+                  char* model_id, char* model_class, char* loss,
+                  char* means_keys, int64_t* means_off, double* means_vals,
+                  char* vars_keys, int64_t* vars_off, double* vars_vals) {
+    const uint8_t* buf = reinterpret_cast<const uint8_t*>(buf_);
+    int64_t pos = 0, n;
+
+    auto copy_str = [&](char* dst) -> bool {
+        int64_t sl;
+        if (!get_varint(buf, len, &pos, &sl) || sl < 0 || pos + sl > len)
+            return false;
+        if (dst) std::memcpy(dst, buf + pos, sl);
+        pos += sl;
+        return true;
+    };
+    auto fill_items = [&](char* keys, int64_t* off, double* vals) -> bool {
+        int64_t i = 0, kp = 0;
+        off[0] = 0;
+        return walk_array(buf, len, &pos, [&] {
+            int64_t sl;
+            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || pos + sl > len)
+                return false;
+            std::memcpy(keys + kp, buf + pos, sl);
+            kp += sl; pos += sl;
+            keys[kp++] = SEP;
+            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || pos + sl > len)
+                return false;
+            std::memcpy(keys + kp, buf + pos, sl);
+            kp += sl; pos += sl;
+            if (pos + 8 > len) return false;
+            std::memcpy(&vals[i], buf + pos, 8);
+            pos += 8;
+            off[++i] = kp;
+            return true;
+        });
+    };
+
+    if (!copy_str(model_id)) return 0;
+    if (!get_varint(buf, len, &pos, &n)) return 0;
+    if (n == 1 && !copy_str(model_class)) return 0;
+    if (!fill_items(means_keys, means_off, means_vals)) return 0;
+    if (!get_varint(buf, len, &pos, &n)) return 0;
+    if (n == 1 && !fill_items(vars_keys, vars_off, vars_vals)) return 0;
+    if (!get_varint(buf, len, &pos, &n)) return 0;
+    if (n == 1 && !copy_str(loss)) return 0;
+    return pos;
+}
+
+}  // extern "C"

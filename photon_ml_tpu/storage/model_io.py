@@ -142,6 +142,65 @@ def _index_map_fingerprint(imap) -> dict:
     return fp
 
 
+def _write_fixed_avro(path: str, model_id: str, means, variances,
+                      imap: IndexMap, loss_name: str,
+                      model_class: str = "photon_ml_tpu.GLMModel") -> None:
+    """ONE home for fixed-effect NTV writes: the native codec fast path
+    (native/model_codec.cpp — index-ordered key blob + f64 arrays in, one
+    avro record body out, O(1) python in d) with the generic pure-python
+    codec as fallback.  Identical wire format either way."""
+    from photon_ml_tpu.storage import native_model_codec as nmc
+
+    if nmc.available() and hasattr(imap, "key_blob"):
+        blob, off = imap.key_blob()
+        if len(off) - 1 == len(means):
+            body = nmc.encode_record(
+                model_id, model_class, loss_name, blob, off,
+                np.asarray(means, np.float64),
+                None if variances is None
+                else np.asarray(variances, np.float64))
+            if body is not None:
+                avro_io.write_container_raw(path, BAYESIAN_LINEAR_MODEL, [body])
+                return
+    rec = _coeff_to_record(model_id, means, variances, imap, loss_name,
+                           model_class=model_class)
+    avro_io.write_container(path, BAYESIAN_LINEAR_MODEL, [rec])
+
+
+def _read_fixed_avro_fast(path: str, imap: IndexMap) -> Optional[Coefficients]:
+    """Native-codec read half: only for single-record files whose writer
+    schema is EXACTLY ours (the dispatch guard); None -> generic path."""
+    from photon_ml_tpu.storage import native_model_codec as nmc
+
+    if not nmc.available():
+        return None
+    try:
+        schema, blocks = avro_io.read_container_raw(path)
+    except (OSError, ValueError):
+        return None
+    if schema != BAYESIAN_LINEAR_MODEL:
+        return None
+    count, block = next(iter(blocks), (0, b""))
+    if count != 1:
+        return None
+    dec = nmc.decode_record(block)
+    if dec is None:
+        return None
+    means = np.zeros(imap.size, np.float64)
+    idx = nmc.lookup_blob(imap, dec["means_keys"], dec["means_off"])
+    ok = idx >= 0
+    means[idx[ok]] = dec["means_vals"][ok]
+    variances = None
+    # an EMPTY variances array reads as None, exactly like the generic
+    # path's falsy rec.get("variances") — loaders must agree
+    if dec["vars_vals"] is not None and len(dec["vars_vals"]):
+        variances = np.zeros(imap.size, np.float64)
+        vi = nmc.lookup_blob(imap, dec["vars_keys"], dec["vars_off"])
+        ok = vi >= 0
+        variances[vi[ok]] = dec["vars_vals"][ok]
+    return Coefficients(means=means, variances=variances)
+
+
 def coordinate_rel_dir(cid: str, m) -> str:
     """Relative directory of one coordinate inside a model dir."""
     kind = "fixed-effect" if isinstance(m, FixedEffectModel) else "random-effect"
@@ -181,11 +240,9 @@ def save_coordinate(
                 arrays["variances"] = np.asarray(m.coefficients.variances)
             np.savez(os.path.join(cdir, "coefficients.npz"), **arrays)
         else:
-            imap = index_maps[m.feature_shard]
-            rec = _coeff_to_record(cid, m.coefficients.means,
-                                   m.coefficients.variances, imap, m.task.value)
-            avro_io.write_container(os.path.join(cdir, "coefficients.avro"),
-                                    BAYESIAN_LINEAR_MODEL, [rec])
+            _write_fixed_avro(os.path.join(cdir, "coefficients.avro"), cid,
+                              m.coefficients.means, m.coefficients.variances,
+                              index_maps[m.feature_shard], m.task.value)
         out = {"type": "fixed", "feature_shard": m.feature_shard}
         if fp is not None:
             out["index_fingerprint"] = fp
@@ -330,9 +387,12 @@ def load_game_model(
         imap = index_maps[shard]
         if info["type"] == "fixed":
             path = os.path.join(model_dir, "fixed-effect", cid, "coefficients.avro")
-            rec = next(iter(avro_io.read_container(path)))
+            coeff = _read_fixed_avro_fast(path, imap)
+            if coeff is None:
+                rec = next(iter(avro_io.read_container(path)))
+                coeff = _record_to_coeff(rec, imap)
             models[cid] = FixedEffectModel(
-                coefficients=_record_to_coeff(rec, imap), feature_shard=shard, task=task)
+                coefficients=coeff, feature_shard=shard, task=task)
         else:
             cdir = os.path.join(model_dir, "random-effect", cid)
             re_type = info["random_effect_type"]
@@ -556,12 +616,10 @@ def export_reference_game_model(
             os.makedirs(os.path.join(cdir, "coefficients"), exist_ok=True)
             with open(os.path.join(cdir, "id-info"), "w") as f:
                 f.write(m.feature_shard + "\n")
-            rec = _coeff_to_record(cid, m.coefficients.means,
-                                   m.coefficients.variances, imap, task.value,
-                                   model_class=jvm_class)
-            avro_io.write_container(
-                os.path.join(cdir, "coefficients", "part-00000.avro"),
-                BAYESIAN_LINEAR_MODEL, [rec])
+            _write_fixed_avro(
+                os.path.join(cdir, "coefficients", "part-00000.avro"), cid,
+                m.coefficients.means, m.coefficients.variances, imap,
+                task.value, model_class=jvm_class)
         elif isinstance(m, RandomEffectModel):
             cdir = os.path.join(out_dir, "random-effect", cid)
             os.makedirs(os.path.join(cdir, "coefficients"), exist_ok=True)

@@ -1,0 +1,147 @@
+"""ctypes glue for native/model_codec.cpp — bulk BayesianLinearModelAvro
+record bodies as flat buffers (packed key blob + offsets + f64 values).
+
+The huge-d fixed-effect fast path for the PORTABLE model format: python-side
+work is O(1) in d on both save and load (storage/model_io.py falls back to
+the generic pure-python codec when the native library is unavailable or the
+writer schema isn't ours).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.native.build import compile_library
+
+_lib = None
+_tried = False
+
+
+def _native():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    path = compile_library("model_codec")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    i64, p = ctypes.c_int64, ctypes.c_void_p
+    lib.plmc_encode.restype = i64
+    lib.plmc_encode.argtypes = [p, i64, p, i64, p, i64, p, p, p, p, i64, p, i64]
+    lib.plmc_scan.restype = i64
+    lib.plmc_scan.argtypes = [p, i64] + [p] * 8
+    lib.plmc_fill.restype = i64
+    lib.plmc_fill.argtypes = [p, i64] + [p] * 9
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _native() is not None
+
+
+def encode_record(model_id: str, model_class: Optional[str],
+                  loss: Optional[str], keys_blob: np.ndarray,
+                  key_offsets: np.ndarray, values: np.ndarray,
+                  variances: Optional[np.ndarray]) -> Optional[bytes]:
+    """One record body (avro binary) from index-ordered flat buffers;
+    zero means are skipped (sparse NTV storage).  None when unavailable."""
+    lib = _native()
+    if lib is None:
+        return None
+    mid = model_id.encode()
+    mcls = model_class.encode() if model_class is not None else b""
+    lo = loss.encode() if loss is not None else b""
+    values = np.ascontiguousarray(values, np.float64)
+    var = (np.ascontiguousarray(variances, np.float64)
+           if variances is not None else None)
+    key_offsets = np.ascontiguousarray(key_offsets, np.int64)
+    keys_blob = np.ascontiguousarray(keys_blob, np.uint8)
+    d = len(values)
+    cap = 256  # first call returns the needed size
+    for _ in range(2):
+        out = ctypes.create_string_buffer(cap)
+        n = lib.plmc_encode(
+            mid, len(mid), mcls, len(mcls) if model_class is not None else -1,
+            lo, len(lo) if loss is not None else -1,
+            keys_blob.ctypes.data, key_offsets.ctypes.data,
+            values.ctypes.data,
+            var.ctypes.data if var is not None else None,
+            d, out, cap)
+        if n > 0:
+            return out.raw[:n]
+        if n == 0:
+            return None
+        cap = -n
+    return None
+
+
+def decode_record(buf: bytes, offset: int = 0):
+    """Decode ONE record body starting at ``offset``.
+
+    Returns None when unavailable/malformed, else a dict:
+      model_id/model_class/loss: str | None
+      means_keys (uint8 blob), means_off (int64[n+1]), means_vals (f64[n])
+      vars_keys/vars_off/vars_vals: same or None
+      consumed: bytes read (for walking multi-record blocks)
+    """
+    lib = _native()
+    if lib is None:
+        return None
+    if not isinstance(buf, bytes):
+        buf = bytes(buf)
+    # pointer into the bytes object's buffer (no copy); `buf` stays
+    # referenced for the duration of both native calls below
+    keep = ctypes.c_char_p(buf)
+    ptr = ctypes.cast(keep, ctypes.c_void_p).value + offset
+    blen = len(buf) - offset
+
+    c = [ctypes.c_int64() for _ in range(8)]
+    ok = lib.plmc_scan(ptr, blen, *[ctypes.byref(x) for x in c])
+    if not ok:
+        return None
+    consumed, n_means, mk_bytes, n_vars, vk_bytes, id_len, cls_len, loss_len = (
+        int(x.value) for x in c)
+
+    mid = ctypes.create_string_buffer(max(id_len, 1))
+    mcls = ctypes.create_string_buffer(max(cls_len, 1))
+    lo = ctypes.create_string_buffer(max(loss_len, 1))
+    mk = np.empty(max(mk_bytes, 1), np.uint8)
+    moff = np.empty(n_means + 1, np.int64)
+    mvals = np.empty(n_means, np.float64)
+    has_vars = n_vars >= 0
+    vk = np.empty(max(vk_bytes, 1), np.uint8)
+    voff = np.empty((n_vars + 1) if has_vars else 1, np.int64)
+    vvals = np.empty(max(n_vars, 0) if has_vars else 0, np.float64)
+
+    got = lib.plmc_fill(
+        ptr, blen, mid, mcls, lo,
+        mk.ctypes.data, moff.ctypes.data, mvals.ctypes.data,
+        vk.ctypes.data, voff.ctypes.data, vvals.ctypes.data)
+    if got != consumed:
+        return None
+    return {
+        "model_id": mid.raw[:id_len].decode(),
+        "model_class": mcls.raw[:cls_len].decode() if cls_len >= 0 else None,
+        "loss": lo.raw[:loss_len].decode() if loss_len >= 0 else None,
+        "means_keys": mk[:mk_bytes], "means_off": moff, "means_vals": mvals,
+        "vars_keys": vk[:vk_bytes] if has_vars else None,
+        "vars_off": voff if has_vars else None,
+        "vars_vals": vvals if has_vars else None,
+        "consumed": consumed,
+    }
+
+
+def lookup_blob(imap, blob: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """Feature indices for a packed key blob against any index-map kind."""
+    getter = getattr(imap, "get_indices_blob", None)
+    if getter is not None:
+        return getter(blob, offsets)
+    raw = blob.tobytes()
+    keys = [raw[offsets[i]:offsets[i + 1]].decode("utf-8")
+            for i in range(len(offsets) - 1)]
+    return imap.get_indices(keys)
