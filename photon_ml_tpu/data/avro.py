@@ -368,27 +368,45 @@ def read_directory(path: str) -> Iterator[dict]:
 
 def write_container_raw(path: str, schema: Schema, encoded_records,
                         codec: str = "deflate",
-                        sync: bytes = b"photon-ml-tpu-sm") -> int:
+                        sync: bytes = b"photon-ml-tpu-sm",
+                        block_records: int = 4096) -> int:
     """Write PRE-ENCODED record bodies (bytes each) into a container file —
     the native-codec fast path's framing half (the generic ``write_container``
-    encodes python dicts; this skips straight to block assembly)."""
+    encodes python dicts; this skips straight to block assembly).  Bodies
+    batch into blocks of ``block_records`` like the generic writer (one
+    deflate stream + sync marker per block, not per record)."""
     assert len(sync) == 16
     named: Dict[str, dict] = {}
     n_total = 0
     with open(path, "wb") as f:
         _write_header(f, schema, codec, sync, named)
-        for body in encoded_records:
-            payload = bytes(body)
+        block = bytearray()
+        n_block = 0
+
+        def flush():
+            nonlocal block, n_block
+            if n_block == 0:
+                return
+            payload = bytes(block)
             if codec == "deflate":
                 comp = zlib.compressobj(wbits=-15)
                 payload = comp.compress(payload) + comp.flush()
             head = bytearray()
-            _encode_long(1, head)
+            _encode_long(n_block, head)
             _encode_long(len(payload), head)
             f.write(bytes(head))
             f.write(payload)
             f.write(sync)
+            block = bytearray()
+            n_block = 0
+
+        for body in encoded_records:
+            block += body
+            n_block += 1
             n_total += 1
+            if n_block >= block_records:
+                flush()
+        flush()
     return n_total
 
 

@@ -53,7 +53,7 @@ inline bool get_varint(const uint8_t* buf, int64_t len, int64_t* pos, int64_t* o
 
 inline bool skip_string(const uint8_t* buf, int64_t len, int64_t* pos) {
     int64_t n;
-    if (!get_varint(buf, len, pos, &n) || n < 0 || *pos + n > len) return false;
+    if (!get_varint(buf, len, pos, &n) || n < 0 || n > len - *pos) return false;
     *pos += n;
     return true;
 }
@@ -62,13 +62,13 @@ inline bool skip_string(const uint8_t* buf, int64_t len, int64_t* pos) {
 inline bool scan_ntv(const uint8_t* buf, int64_t len, int64_t* pos,
                      int64_t* key_bytes) {
     int64_t n;
-    if (!get_varint(buf, len, pos, &n) || n < 0 || *pos + n > len) return false;
+    if (!get_varint(buf, len, pos, &n) || n < 0 || n > len - *pos) return false;
     *key_bytes += n + 1;  // + SEP
     *pos += n;
-    if (!get_varint(buf, len, pos, &n) || n < 0 || *pos + n > len) return false;
+    if (!get_varint(buf, len, pos, &n) || n < 0 || n > len - *pos) return false;
     *key_bytes += n;
     *pos += n + 0;
-    if (*pos + 8 > len) return false;
+    if (8 > len - *pos) return false;
     *pos += 8;
     return true;
 }
@@ -183,7 +183,7 @@ int64_t plmc_scan(const char* buf_, int64_t len, int64_t* consumed,
                   int64_t* id_len, int64_t* class_len, int64_t* loss_len) {
     const uint8_t* buf = reinterpret_cast<const uint8_t*>(buf_);
     int64_t pos = 0, n;
-    if (!get_varint(buf, len, &pos, &n) || n < 0 || pos + n > len) return 0;
+    if (!get_varint(buf, len, &pos, &n) || n < 0 || n > len - pos) return 0;
     *id_len = n; pos += n;                               // modelId
     if (!get_varint(buf, len, &pos, &n)) return 0;       // modelClass union
     if (n == 1) {
@@ -230,7 +230,7 @@ int64_t plmc_fill(const char* buf_, int64_t len,
 
     auto copy_str = [&](char* dst) -> bool {
         int64_t sl;
-        if (!get_varint(buf, len, &pos, &sl) || sl < 0 || pos + sl > len)
+        if (!get_varint(buf, len, &pos, &sl) || sl < 0 || sl > len - pos)
             return false;
         if (dst) std::memcpy(dst, buf + pos, sl);
         pos += sl;
@@ -241,16 +241,16 @@ int64_t plmc_fill(const char* buf_, int64_t len,
         off[0] = 0;
         return walk_array(buf, len, &pos, [&] {
             int64_t sl;
-            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || pos + sl > len)
+            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || sl > len - pos)
                 return false;
             std::memcpy(keys + kp, buf + pos, sl);
             kp += sl; pos += sl;
             keys[kp++] = SEP;
-            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || pos + sl > len)
+            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || sl > len - pos)
                 return false;
             std::memcpy(keys + kp, buf + pos, sl);
             kp += sl; pos += sl;
-            if (pos + 8 > len) return false;
+            if (8 > len - pos) return false;
             std::memcpy(&vals[i], buf + pos, 8);
             pos += 8;
             off[++i] = kp;
@@ -266,6 +266,113 @@ int64_t plmc_fill(const char* buf_, int64_t len,
     if (n == 1 && !fill_items(vars_keys, vars_off, vars_vals)) return 0;
     if (!get_varint(buf, len, &pos, &n)) return 0;
     if (n == 1 && !copy_str(loss)) return 0;
+    return pos;
+}
+
+// ---------------------------------------------------------------------------
+// BLOCK decode: N records in TWO calls (the per-entity random-effect path —
+// millions of small records where per-record boundary crossings dominate).
+//
+// scan: totals for buffer sizing.  fill: concatenated outputs —
+//   ids blob + id_off[n+1]
+//   means keys blob + mkey_off[total_means+1] + vals[total_means]
+//     + mrec_off[n+1] (record boundaries into the means arrays)
+//   vars: same shape; absent variance arrays contribute 0-length spans.
+// model_class/lossFunction strings are skipped (per-entity loaders don't
+// use them).
+// ---------------------------------------------------------------------------
+extern "C" int64_t plmc_scan_block(const char* buf_, int64_t len, int64_t n_records,
+                                   int64_t* total_means, int64_t* means_key_bytes,
+                                   int64_t* total_vars, int64_t* vars_key_bytes,
+                                   int64_t* id_bytes) {
+    const uint8_t* buf = reinterpret_cast<const uint8_t*>(buf_);
+    int64_t pos = 0;
+    *total_means = 0; *means_key_bytes = 0;
+    *total_vars = 0; *vars_key_bytes = 0; *id_bytes = 0;
+    for (int64_t r = 0; r < n_records; ++r) {
+        int64_t n;
+        if (!get_varint(buf, len, &pos, &n) || n < 0 || n > len - pos) return 0;
+        *id_bytes += n; pos += n;                        // modelId
+        if (!get_varint(buf, len, &pos, &n)) return 0;   // modelClass union
+        if (n == 1) { if (!skip_string(buf, len, &pos)) return 0; }
+        else if (n != 0) return 0;
+        if (!walk_array(buf, len, &pos, [&] {            // means
+                ++*total_means;
+                return scan_ntv(buf, len, &pos, means_key_bytes);
+            }))
+            return 0;
+        if (!get_varint(buf, len, &pos, &n)) return 0;   // variances union
+        if (n == 1) {
+            if (!walk_array(buf, len, &pos, [&] {
+                    ++*total_vars;
+                    return scan_ntv(buf, len, &pos, vars_key_bytes);
+                }))
+                return 0;
+        } else if (n != 0) return 0;
+        if (!get_varint(buf, len, &pos, &n)) return 0;   // lossFunction union
+        if (n == 1) { if (!skip_string(buf, len, &pos)) return 0; }
+        else if (n != 0) return 0;
+    }
+    return pos;
+}
+
+extern "C" int64_t plmc_fill_block(const char* buf_, int64_t len, int64_t n_records,
+                                   char* ids, int64_t* id_off,
+                                   char* mkeys, int64_t* mkey_off, double* mvals,
+                                   int64_t* mrec_off,
+                                   char* vkeys, int64_t* vkey_off, double* vvals,
+                                   int64_t* vrec_off) {
+    const uint8_t* buf = reinterpret_cast<const uint8_t*>(buf_);
+    int64_t pos = 0;
+    int64_t ip = 0, mi = 0, mkp = 0, vi = 0, vkp = 0;
+    id_off[0] = 0; mkey_off[0] = 0; mrec_off[0] = 0;
+    vkey_off[0] = 0; vrec_off[0] = 0;
+
+    auto fill_one = [&](char* keys, int64_t* koff, double* vals,
+                        int64_t* i, int64_t* kp) -> bool {
+        return walk_array(buf, len, &pos, [&] {
+            int64_t sl;
+            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || sl > len - pos)
+                return false;
+            std::memcpy(keys + *kp, buf + pos, sl);
+            *kp += sl; pos += sl;
+            keys[(*kp)++] = SEP;
+            if (!get_varint(buf, len, &pos, &sl) || sl < 0 || sl > len - pos)
+                return false;
+            std::memcpy(keys + *kp, buf + pos, sl);
+            *kp += sl; pos += sl;
+            if (8 > len - pos) return false;
+            std::memcpy(&vals[*i], buf + pos, 8);
+            pos += 8;
+            koff[++*i] = *kp;
+            return true;
+        });
+    };
+    auto skip_union_string = [&]() -> bool {
+        int64_t n;
+        if (!get_varint(buf, len, &pos, &n)) return false;
+        if (n == 1) return skip_string(buf, len, &pos);
+        return n == 0;
+    };
+
+    for (int64_t r = 0; r < n_records; ++r) {
+        int64_t sl;
+        if (!get_varint(buf, len, &pos, &sl) || sl < 0 || sl > len - pos)
+            return 0;
+        std::memcpy(ids + ip, buf + pos, sl);
+        ip += sl; pos += sl;
+        id_off[r + 1] = ip;
+        if (!skip_union_string()) return 0;              // modelClass
+        if (!fill_one(mkeys, mkey_off, mvals, &mi, &mkp)) return 0;
+        mrec_off[r + 1] = mi;
+        int64_t n;
+        if (!get_varint(buf, len, &pos, &n)) return 0;   // variances union
+        if (n == 1) {
+            if (!fill_one(vkeys, vkey_off, vvals, &vi, &vkp)) return 0;
+        } else if (n != 0) return 0;
+        vrec_off[r + 1] = vi;
+        if (!skip_union_string()) return 0;              // lossFunction
+    }
     return pos;
 }
 

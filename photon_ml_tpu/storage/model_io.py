@@ -81,16 +81,23 @@ def _record_to_coeff(rec: dict, index_map: IndexMap) -> Coefficients:
     return Coefficients(means=means, variances=variances)
 
 
-def _re_records(m: "RandomEffectModel", eidx: Optional[EntityIndex],
-                imap: IndexMap, loss_name: str,
-                model_class: str = "photon_ml_tpu.GLMModel"):
-    """Per-entity BayesianLinearModelAvro records, sorted by entity id —
-    shared by the native writer and the reference-layout exporter."""
+def _re_entity_rows(m: "RandomEffectModel", eidx: Optional[EntityIndex]):
+    """(model_id, means, variances) per entity, sorted by entity id — the
+    ONE definition of per-entity record identity/order, shared by the
+    generic and native writers (their outputs must stay byte-semantics
+    identical)."""
     for eid, slot in sorted(m.slot_of.items()):
         name = eidx.name_of(eid) if eidx is not None else None
         var = m.variances[slot] if m.variances is not None else None
-        yield _coeff_to_record(name if name is not None else str(eid),
-                               m.w_stack[slot], var, imap, loss_name,
+        yield (name if name is not None else str(eid), m.w_stack[slot], var)
+
+
+def _re_records(m: "RandomEffectModel", eidx: Optional[EntityIndex],
+                imap: IndexMap, loss_name: str,
+                model_class: str = "photon_ml_tpu.GLMModel"):
+    """Per-entity BayesianLinearModelAvro records (generic-codec form)."""
+    for model_id, means, var in _re_entity_rows(m, eidx):
+        yield _coeff_to_record(model_id, means, var, imap, loss_name,
                                model_class=model_class)
 
 
@@ -201,6 +208,88 @@ def _read_fixed_avro_fast(path: str, imap: IndexMap) -> Optional[Coefficients]:
     return Coefficients(means=means, variances=variances)
 
 
+def _write_re_avro_fast(path: str, m: "RandomEffectModel",
+                        eidx: Optional[EntityIndex], imap: IndexMap,
+                        loss_name: str,
+                        model_class: str = "photon_ml_tpu.GLMModel") -> bool:
+    """Per-entity NTV writes through the native codec — the entity-COUNT
+    scale path (the reference's production random effects hold millions of
+    per-member models).  Same record semantics as _re_records."""
+    from photon_ml_tpu.storage import native_model_codec as nmc
+
+    if not nmc.available() or not hasattr(imap, "key_blob"):
+        return False
+    blob, off = imap.key_blob()
+    if len(off) - 1 != m.w_stack.shape[1]:
+        return False
+
+    def bodies():
+        for model_id, means, var in _re_entity_rows(m, eidx):
+            body = nmc.encode_record(
+                model_id, model_class, loss_name, blob, off,
+                np.asarray(means, np.float64),
+                None if var is None else np.asarray(var, np.float64))
+            if body is None:
+                raise RuntimeError("native encode failed mid-stream")
+            yield body
+
+    avro_io.write_container_raw(path, BAYESIAN_LINEAR_MODEL, bodies())
+    return True
+
+
+def _read_re_avro_fast(cdir: str, imap: IndexMap,
+                       eidx: Optional[EntityIndex]):
+    """Native read of a random-effect coordinate directory; returns
+    (w_stack, slot_of, variances) or None for the generic path.  Walks
+    records inside each block via the decoder's consumed counts."""
+    from photon_ml_tpu.storage import native_model_codec as nmc
+
+    if not nmc.available():
+        return None
+    decoded = []  # (n_records, block-decode dict)
+    for p in avro_io.list_avro_files(cdir):
+        try:
+            schema, blocks = avro_io.read_container_raw(p)
+        except (OSError, ValueError):
+            return None
+        if schema != BAYESIAN_LINEAR_MODEL:
+            return None
+        for count, block in blocks:
+            dec = nmc.decode_block(block, count)
+            if dec is None:
+                return None
+            decoded.append((count, dec))
+    n = sum(c for c, _ in decoded)
+    if n == 0:
+        return None
+    w = np.zeros((n, imap.size), np.float64)
+    any_var = any(len(d["vars_vals"]) for _, d in decoded)
+    variances = np.zeros_like(w) if any_var else None
+    slot_of: Dict[int, int] = {}
+    base = 0
+    for count, dec in decoded:
+        # ONE batch key lookup for the whole block, then a vectorized
+        # scatter: row ids from the per-record span lengths
+        idx = nmc.lookup_blob(imap, dec["means_keys"], dec["means_key_off"])
+        rows = base + np.repeat(np.arange(count), np.diff(dec["means_rec_off"]))
+        ok = idx >= 0
+        w[rows[ok], idx[ok]] = dec["means_vals"][ok]
+        if variances is not None and len(dec["vars_vals"]):
+            vi = nmc.lookup_blob(imap, dec["vars_keys"], dec["vars_key_off"])
+            vrows = base + np.repeat(np.arange(count),
+                                     np.diff(dec["vars_rec_off"]))
+            vok = vi >= 0
+            variances[vrows[vok], vi[vok]] = dec["vars_vals"][vok]
+        ids_raw = dec["ids"].tobytes()
+        io_ = dec["id_off"]
+        for r in range(count):
+            mid = ids_raw[io_[r]:io_[r + 1]].decode("utf-8")
+            eid = eidx.get_or_add(mid) if eidx is not None else int(mid)
+            slot_of[eid] = base + r
+        base += count
+    return w, slot_of, variances
+
+
 def coordinate_rel_dir(cid: str, m) -> str:
     """Relative directory of one coordinate inside a model dir."""
     kind = "fixed-effect" if isinstance(m, FixedEffectModel) else "random-effect"
@@ -259,9 +348,10 @@ def save_coordinate(
             np.savez(os.path.join(cdir, "coefficients.npz"), **arrays)
         else:
             imap = index_maps[m.feature_shard]
-            avro_io.write_container(os.path.join(cdir, "part-00000.avro"),
-                                    BAYESIAN_LINEAR_MODEL,
-                                    _re_records(m, eidx, imap, m.task.value))
+            rpath = os.path.join(cdir, "part-00000.avro")
+            if not _write_re_avro_fast(rpath, m, eidx, imap, m.task.value):
+                avro_io.write_container(rpath, BAYESIAN_LINEAR_MODEL,
+                                        _re_records(m, eidx, imap, m.task.value))
         id_map = {str(eid): (eidx.name_of(eid) if eidx is not None else str(eid))
                   for eid in m.slot_of}
         with open(os.path.join(cdir, "id-index.json"), "w") as f:
@@ -396,9 +486,13 @@ def load_game_model(
         else:
             cdir = os.path.join(model_dir, "random-effect", cid)
             re_type = info["random_effect_type"]
-            recs = list(avro_io.read_directory(cdir))
-            w, slot_of, variances = _stack_random_effect(
-                recs, imap, entity_indexes.get(re_type))
+            fast = _read_re_avro_fast(cdir, imap, entity_indexes.get(re_type))
+            if fast is not None:
+                w, slot_of, variances = fast
+            else:
+                recs = list(avro_io.read_directory(cdir))
+                w, slot_of, variances = _stack_random_effect(
+                    recs, imap, entity_indexes.get(re_type))
             models[cid] = RandomEffectModel(
                 w_stack=w, slot_of=slot_of, random_effect_type=re_type,
                 feature_shard=shard, task=task, variances=variances)
@@ -626,9 +720,12 @@ def export_reference_game_model(
             with open(os.path.join(cdir, "id-info"), "w") as f:
                 f.write(m.random_effect_type + "\n" + m.feature_shard + "\n")
             eidx = entity_indexes.get(m.random_effect_type)
-            avro_io.write_container(
-                os.path.join(cdir, "coefficients", "part-00000.avro"),
-                BAYESIAN_LINEAR_MODEL,
-                _re_records(m, eidx, imap, task.value, model_class=jvm_class))
+            rpath = os.path.join(cdir, "coefficients", "part-00000.avro")
+            if not _write_re_avro_fast(rpath, m, eidx, imap, task.value,
+                                       model_class=jvm_class):
+                avro_io.write_container(
+                    rpath, BAYESIAN_LINEAR_MODEL,
+                    _re_records(m, eidx, imap, task.value,
+                                model_class=jvm_class))
         else:
             raise TypeError(f"cannot export model type {type(m)!r}")

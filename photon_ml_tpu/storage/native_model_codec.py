@@ -36,6 +36,10 @@ def _native():
     lib.plmc_scan.argtypes = [p, i64] + [p] * 8
     lib.plmc_fill.restype = i64
     lib.plmc_fill.argtypes = [p, i64] + [p] * 9
+    lib.plmc_scan_block.restype = i64
+    lib.plmc_scan_block.argtypes = [p, i64, i64] + [p] * 5
+    lib.plmc_fill_block.restype = i64
+    lib.plmc_fill_block.argtypes = [p, i64, i64] + [p] * 10
     _lib = lib
     return _lib
 
@@ -133,6 +137,61 @@ def decode_record(buf: bytes, offset: int = 0):
         "vars_off": voff if has_vars else None,
         "vars_vals": vvals if has_vars else None,
         "consumed": consumed,
+    }
+
+
+def decode_block(block: bytes, n_records: int):
+    """Decode ALL records of a container block in two native calls — the
+    entity-count scale path (per-record boundary crossings dominate when
+    records are small and numerous).
+
+    Returns None on unavailability/malformed input, else a dict of
+    concatenated arrays:
+      ids (uint8 blob), id_off[n+1]
+      means_keys/means_key_off[total+1]/means_vals[total],
+      means_rec_off[n+1] (record boundaries into the means arrays)
+      vars_*: same shape (absent arrays contribute 0-length spans)
+    """
+    lib = _native()
+    if lib is None:
+        return None
+    if not isinstance(block, bytes):
+        block = bytes(block)
+    keep = ctypes.c_char_p(block)
+    ptr = ctypes.cast(keep, ctypes.c_void_p).value
+    c = [ctypes.c_int64() for _ in range(5)]
+    ok = lib.plmc_scan_block(ptr, len(block), n_records,
+                             *[ctypes.byref(x) for x in c])
+    if not ok:
+        return None
+    t_means, mk_bytes, t_vars, vk_bytes, id_bytes = (int(x.value) for x in c)
+
+    ids = np.empty(max(id_bytes, 1), np.uint8)
+    id_off = np.empty(n_records + 1, np.int64)
+    mkeys = np.empty(max(mk_bytes, 1), np.uint8)
+    mkey_off = np.empty(t_means + 1, np.int64)
+    mvals = np.empty(t_means, np.float64)
+    mrec_off = np.empty(n_records + 1, np.int64)
+    vkeys = np.empty(max(vk_bytes, 1), np.uint8)
+    vkey_off = np.empty(t_vars + 1, np.int64)
+    vvals = np.empty(max(t_vars, 0), np.float64)
+    vrec_off = np.empty(n_records + 1, np.int64)
+
+    got = lib.plmc_fill_block(
+        ptr, len(block), n_records,
+        ids.ctypes.data, id_off.ctypes.data,
+        mkeys.ctypes.data, mkey_off.ctypes.data, mvals.ctypes.data,
+        mrec_off.ctypes.data,
+        vkeys.ctypes.data, vkey_off.ctypes.data, vvals.ctypes.data,
+        vrec_off.ctypes.data)
+    if got != ok:
+        return None
+    return {
+        "ids": ids[:id_bytes], "id_off": id_off,
+        "means_keys": mkeys[:mk_bytes], "means_key_off": mkey_off,
+        "means_vals": mvals, "means_rec_off": mrec_off,
+        "vars_keys": vkeys[:vk_bytes], "vars_key_off": vkey_off,
+        "vars_vals": vvals, "vars_rec_off": vrec_off,
     }
 
 
