@@ -18,7 +18,9 @@ from photon_ml_tpu.core.regularization import Regularization
 from photon_ml_tpu.evaluation.evaluator import EvaluationSuite
 from photon_ml_tpu.game.config import FixedEffectConfig, GameConfig, RandomEffectConfig
 from photon_ml_tpu.game.data import GameData
-from photon_ml_tpu.game.estimator import GameEstimator, GameFitResult
+from photon_ml_tpu.game.descent import DescentHistory
+from photon_ml_tpu.game.estimator import (GameEstimator, GameFitResult,
+                                          GameTransformer)
 from photon_ml_tpu.tune.search import DomainDim, GaussianProcessSearch, RandomSearch, SearchDomain
 
 
@@ -51,6 +53,7 @@ class GameEstimatorEvaluationFunction:
         if not self.coordinate_ids:
             raise ValueError("all coordinates are locked; nothing to tune")
         self.results: List[GameFitResult] = []
+        self._sweep = None  # None = not built; False = un-fusable
 
     def config_for(self, params: np.ndarray) -> GameConfig:
         # keep every coordinate (locked ones must stay in the config so the
@@ -60,8 +63,57 @@ class GameEstimatorEvaluationFunction:
             coords[cid] = _with_l2(coords[cid], float(params[i]))
         return dataclasses.replace(self.base_config, coordinates=coords)
 
+    def _fused_sweep(self):
+        """ONE FusedSweep shared by every tuning fit — reg weights are
+        traced sweep inputs, so the whole tuning loop compiles exactly one
+        descent program (the estimator's own sweep cache is local to each
+        fit() call and would re-trace per tuning iteration)."""
+        if self._sweep is False:
+            return None
+        if self._sweep is None:
+            from photon_ml_tpu.game.coordinate import build_coordinate
+            from photon_ml_tpu.game.fused import FusedSweep
+
+            est = self.estimator
+            try:
+                coords = {
+                    cid: build_coordinate(
+                        cid, self.data, ccfg, self.base_config.task, est.mesh,
+                        norm=est.normalization.get(ccfg.feature_shard),
+                        seed=self.seed, dtype=est.dtype)
+                    for cid, ccfg in self.base_config.coordinates.items()}
+                self._sweep = (FusedSweep(
+                    coords, order=list(self.base_config.coordinates),
+                    num_iterations=self.base_config.num_outer_iterations),
+                    coords)
+            except NotImplementedError:
+                self._sweep = False  # un-fusable coordinate: host path
+                return None
+        return self._sweep
+
     def __call__(self, params: np.ndarray) -> float:
         config = self.config_for(params)
+        # Fused fast path: train WITHOUT per-update validation (the whole
+        # retrain is one jitted sweep, reused across every tuning fit) and
+        # evaluate the FINAL model.  Only when a single outer iteration
+        # makes final == best-across-iterations — with more iterations the
+        # host loop's best-model retention (reference CoordinateDescent
+        # .scala:163-314) is load-bearing and must be kept.
+        fused_ok = (not self.locked and self.estimator.fused is not False
+                    and config.num_outer_iterations == 1)
+        sweep = self._fused_sweep() if fused_ok else None
+        if sweep is not None:
+            sweep_obj, coords = sweep
+            model, _scores = sweep_obj.run(
+                initial=self.initial_model,
+                regs=[config.coordinates[cid].reg for cid in config.coordinates],
+                seed=self.seed)
+            ev = GameTransformer(model, config.task).evaluate(
+                self.validation_data, self.estimator.validation_suite)
+            res = GameFitResult(model=model, config=config, evaluation=ev,
+                                history=DescentHistory())
+            self.results.append(res)
+            return ev.primary
         res = self.estimator.fit(self.data, [config],
                                  validation_data=self.validation_data, seed=self.seed,
                                  initial_model=self.initial_model,
