@@ -345,7 +345,12 @@ def run_glmix(platform, scale, three: bool):
     backend = _select_platform(platform)
     data = synth_glmix(scale, three)
     coords = _glmix_coords(data, three)
-    impl = os.environ.get("PHOTON_BENCH_IMPL", "fused")
+    # measured default per backend: the fused whole-descent program wins on
+    # accelerators (no host round-trips between updates); on the CPU
+    # fallback XLA's scan scheduling loses to the host-paced loop (~2x at
+    # the fallback scale), so measure the better one honestly
+    impl = os.environ.get("PHOTON_BENCH_IMPL",
+                          "host" if backend == "cpu" else "fused")
     if impl == "fused":
         from photon_ml_tpu.game.fused import FusedSweep
 

@@ -102,6 +102,10 @@ class CoordinateDescent:
         if resume_best is not None:
             best_model, best_eval = resume_best
         last_eval: Optional[EvaluationResults] = None
+        # the update that completes each sweep (locked coordinates never
+        # update, so "full model" means after the last UNLOCKED one)
+        active = [k for k, c in enumerate(self.order) if c not in self.locked]
+        last_active = active[-1] if active else -1
 
         for it in range(self.num_iterations):
             for k, cid in enumerate(self.order):
@@ -143,7 +147,14 @@ class CoordinateDescent:
                         val_scores, val_data.y, val_data.weight, group_ids=val_data.id_tags
                     )
                     last_eval = val_res
-                    if suite.better_than(val_res, best_eval):
+                    # best-model retention compares FULL models only — after
+                    # a complete update sequence, never inside the coordinate
+                    # loop, where a "best" snapshot could have untrained
+                    # coordinates (reference CoordinateDescent.scala:163-167,
+                    # explicit NOTE).  Mid-sweep evaluations are still
+                    # computed and logged, like the reference's.
+                    if k == last_active and suite.better_than(
+                            val_res, best_eval):
                         best_eval = val_res
                         best_model = current
                         best_changed = True

@@ -73,6 +73,14 @@ class GameEstimator:
         # the float32 default is the TPU-throughput choice.
         self.dtype = dtype
 
+    def build_one_coordinate(self, cid, data, ccfg, task, seed: int = 0):
+        """The ONE construction call for a coordinate under this estimator's
+        settings (mesh / normalization / dtype) — shared by fit() and the
+        tuning fast path so they can never drift apart."""
+        return build_coordinate(cid, data, ccfg, task, self.mesh,
+                                norm=self.normalization.get(ccfg.feature_shard),
+                                seed=seed, dtype=self.dtype)
+
     def fit(
         self,
         data: GameData,
@@ -109,15 +117,11 @@ class GameEstimator:
                     try:
                         coordinates[cid] = old.rebind(ccfg)  # same data, new opt settings
                     except ValueError:
-                        coordinates[cid] = build_coordinate(
-                            cid, data, ccfg, config.task, self.mesh,
-                            norm=self.normalization.get(ccfg.feature_shard),
-                            seed=seed, dtype=self.dtype)
+                        coordinates[cid] = self.build_one_coordinate(
+                            cid, data, ccfg, config.task, seed)
                 else:
-                    coordinates[cid] = build_coordinate(
-                        cid, data, ccfg, config.task, self.mesh,
-                        norm=self.normalization.get(ccfg.feature_shard),
-                        seed=seed, dtype=self.dtype)
+                    coordinates[cid] = self.build_one_coordinate(
+                        cid, data, ccfg, config.task, seed)
             prev = coordinates
             validation = None
             if validation_data is not None and self.validation_suite is not None:

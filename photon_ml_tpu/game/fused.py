@@ -144,17 +144,25 @@ class FusedSweep:
                                                       self._dtype)))
         return tuple(states), tuple(scores)
 
+    def init_carry(self, initial: Optional[GameModel]):
+        """Public warm-start carry builder: callers re-running one sweep many
+        times from the SAME initial model (tuning) compute this once and pass
+        it via ``run(carry0=...)`` instead of re-scoring the initial model
+        per call."""
+        return self._cold if initial is None else self._init_carry(initial)
+
     def run(self, initial: Optional[GameModel] = None,
-            regs: Optional[Sequence] = None, seed: int = 0
-            ) -> Tuple[GameModel, Dict[str, np.ndarray]]:
+            regs: Optional[Sequence] = None, seed: int = 0,
+            carry0=None) -> Tuple[GameModel, Dict[str, np.ndarray]]:
         """One fused descent; returns (model, per-coordinate final scores).
 
         ``regs``: per-coordinate (order-aligned) Regularization overrides —
         lets one compiled sweep serve a whole reg-weight grid (the caller
         typically reads them off rebind-updated configs).  ``seed``: PRNG
         seed for in-program stochastic work (down-sampling); a traced input,
-        so varying it reuses the compiled program."""
-        carry = self._cold if initial is None else self._init_carry(initial)
+        so varying it reuses the compiled program.  ``carry0``: precomputed
+        ``init_carry`` result (overrides ``initial``)."""
+        carry = carry0 if carry0 is not None else self.init_carry(initial)
         if regs is None:
             regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
         published, scores, vars_ = self._program(

@@ -71,21 +71,22 @@ class GameEstimatorEvaluationFunction:
         if self._sweep is False:
             return None
         if self._sweep is None:
-            from photon_ml_tpu.game.coordinate import build_coordinate
             from photon_ml_tpu.game.fused import FusedSweep
 
-            est = self.estimator
             try:
                 coords = {
-                    cid: build_coordinate(
-                        cid, self.data, ccfg, self.base_config.task, est.mesh,
-                        norm=est.normalization.get(ccfg.feature_shard),
-                        seed=self.seed, dtype=est.dtype)
+                    cid: self.estimator.build_one_coordinate(
+                        cid, self.data, ccfg, self.base_config.task, self.seed)
                     for cid, ccfg in self.base_config.coordinates.items()}
-                self._sweep = (FusedSweep(
+                sweep = FusedSweep(
                     coords, order=list(self.base_config.coordinates),
-                    num_iterations=self.base_config.num_outer_iterations),
-                    coords)
+                    num_iterations=self.base_config.num_outer_iterations)
+                # the warm-start carry is constant for the life of this
+                # evaluation function — score the initial model ONCE, not
+                # once per tuning iteration
+                carry0 = (sweep.init_carry(self.initial_model)
+                          if self.initial_model is not None else None)
+                self._sweep = (sweep, carry0)
             except NotImplementedError:
                 self._sweep = False  # un-fusable coordinate: host path
                 return None
@@ -103,9 +104,9 @@ class GameEstimatorEvaluationFunction:
                     and config.num_outer_iterations == 1)
         sweep = self._fused_sweep() if fused_ok else None
         if sweep is not None:
-            sweep_obj, coords = sweep
+            sweep_obj, carry0 = sweep
             model, _scores = sweep_obj.run(
-                initial=self.initial_model,
+                carry0=carry0,
                 regs=[config.coordinates[cid].reg for cid in config.coordinates],
                 seed=self.seed)
             ev = GameTransformer(model, config.task).evaluate(
