@@ -223,6 +223,25 @@ def _select_platform(platform: str | None):
     return jax.devices()[0].platform
 
 
+def _measure(thunk, min_repeats=5, max_total=120.0):
+    """Median-of-repeats timing for an already-warm thunk.
+
+    A single sub-second window is dispatch-jitter noise (a 19ms a1a run
+    headlined round 2 — VERDICT r2 weak #5), so every config repeats its
+    timed section >=min_repeats times (or until max_total seconds for slow
+    full-scale configs, where each repeat is seconds long anyway) and
+    reports the MEDIAN plus the spread."""
+    dts = []
+    total = 0.0
+    while len(dts) < min_repeats and total < max_total:
+        dt = thunk()
+        dts.append(dt)
+        total += dt
+    med = float(np.median(dts))
+    return med, {"n_repeats": len(dts), "dt_median": round(med, 4),
+                 "dt_min": round(min(dts), 4), "dt_max": round(max(dts), 4)}
+
+
 def _solve_single(idx, vals, y, d, *, loss, optimizer, solver_cfg, l2):
     """jit one make_solver fit over a SparseBatch; returns (dt, result)."""
     import jax
@@ -241,10 +260,15 @@ def _solve_single(idx, vals, y, d, *, loss, optimizer, solver_cfg, l2):
     w0 = np.zeros(d, np.float32)
     res = solve(w0, batch)
     jax.block_until_ready(res.w)  # warm-up: compile
-    t0 = time.perf_counter()
-    res = solve(w0, batch)
-    jax.block_until_ready(res.w)
-    return time.perf_counter() - t0, res, batch
+
+    def thunk():
+        t0 = time.perf_counter()
+        r = solve(w0, batch)
+        jax.block_until_ready(r.w)
+        return time.perf_counter() - t0
+
+    dt, timing = _measure(thunk)
+    return dt, timing, res, batch
 
 
 def run_a1a(platform, scale):
@@ -254,7 +278,7 @@ def run_a1a(platform, scale):
 
     backend = _select_platform(platform)
     idx, vals, y, d = synth_a1a()
-    dt, res, batch = _solve_single(
+    dt, timing, res, batch = _solve_single(
         idx, vals, y, d, loss="logistic", optimizer=OptimizerType.LBFGS,
         solver_cfg=SolverConfig(max_iters=100, tolerance=1e-7), l2=1.0)
     import jax.numpy as jnp
@@ -263,7 +287,7 @@ def run_a1a(platform, scale):
     iters = int(res.iterations)
     n = len(y)
     return {
-        "backend": backend, "dt": dt,
+        "backend": backend, "dt": dt, "timing": timing,
         "units": n * iters, "unit": "example_iters/sec",
         # one value+grad pass over a sparse design ~ 4 flops/nnz; LBFGS
         # does ~1 such eval per iteration (line-search extras uncounted)
@@ -286,13 +310,13 @@ def run_sparse1m(platform, scale):
     backend = _select_platform(platform)
     idx, vals, y, d = synth_sparse1m(scale)
     cfg = SolverConfig.tron_default()
-    dt, res, _ = _solve_single(
+    dt, timing, res, _ = _solve_single(
         idx, vals, y, d, loss="poisson", optimizer=OptimizerType.TRON,
         solver_cfg=cfg, l2=1.0)
     iters = int(res.iterations)
     n = len(y)
     return {
-        "backend": backend, "dt": dt,
+        "backend": backend, "dt": dt, "timing": timing,
         "units": n * iters, "unit": "example_iters/sec",
         # per TRON iteration: 1 value+grad + <=max_cg Hv passes, each
         # ~4 flops/nnz (upper-bound estimate: CG often stops early)
@@ -355,19 +379,30 @@ def run_glmix(platform, scale, three: bool):
         from photon_ml_tpu.game.fused import FusedSweep
 
         sweep = FusedSweep(coords, num_iterations=OUTER)
-        model, scores = sweep.run()  # warm-up: compiles the whole program
-        t0 = time.perf_counter()
-        model, scores = sweep.run()
-        dt = time.perf_counter() - t0
-        total = np.sum([np.asarray(s) for s in scores.values()], axis=0)
+        sweep.run()  # warm-up: compiles the whole program
+        out = {}
+
+        def thunk():
+            t0 = time.perf_counter()
+            out["model"], out["scores"] = sweep.run()
+            return time.perf_counter() - t0
+
+        dt, timing = _measure(thunk)
+        total = np.sum([np.asarray(s) for s in out["scores"].values()], axis=0)
     else:
         from photon_ml_tpu.game import CoordinateDescent
 
         descent = CoordinateDescent(coords, num_iterations=OUTER)
         descent.run()
-        t0 = time.perf_counter()
-        model, _, _ = descent.run()
-        dt = time.perf_counter() - t0
+        out = {}
+
+        def thunk():
+            t0 = time.perf_counter()
+            out["model"], _, _ = descent.run()
+            return time.perf_counter() - t0
+
+        dt, timing = _measure(thunk)
+        model = out["model"]
         from photon_ml_tpu.game import GameData
         feats = {"g": data["xg"], "u": data["xu"]}
         tags = {"userId": data["uids"]}
@@ -379,7 +414,7 @@ def run_glmix(platform, scale, three: bool):
     d_sum = data["xg"].shape[1] + data["xu"].shape[1] + (
         data["xi"].shape[1] if three else 0)
     return {
-        "backend": backend, "dt": dt, "impl": impl,
+        "backend": backend, "dt": dt, "timing": timing, "impl": impl,
         "units": n * OUTER, "unit": "examples/sec/chip",
         # per sweep each coordinate runs <=SOLVER_ITERS solver iterations,
         # each ~1 value+grad pass (4 flops per design-matrix entry)
@@ -408,26 +443,41 @@ def run_gp_tune(platform, scale):
     va = GameData(y=y[cut:], features={"g": xg[cut:], "u": xu[cut:]},
                   id_tags={"userId": uids[cut:]})
     solver = SolverConfig(max_iters=SOLVER_ITERS, tolerance=1e-7)
+    # the prior (base-config) L2s are DELIBERATELY bad — the per-user weight
+    # over-shrinks the strong random effects — so the quality gate can demand
+    # the tuner actually finds a better config (best_auc > prior_auc), not
+    # merely never regresses from an already-optimal prior
     config = GameConfig(
         task=TaskType.LOGISTIC_REGRESSION,
         num_outer_iterations=OUTER,
         coordinates={
             "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
-                                       reg=Regularization(l2=1.0)),
+                                       reg=Regularization(l2=10.0)),
             "per-user": RandomEffectConfig(random_effect_type="userId",
                                            feature_shard="u", solver=solver,
-                                           reg=Regularization(l2=1.0)),
+                                           reg=Regularization(l2=500.0)),
         })
     est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
     n_iter = 6
-    t0 = time.perf_counter()
-    best, search, tuned = tune_game_model(est, config, tr, va,
-                                          n_iterations=n_iter,
-                                          mode="bayesian", seed=0)
-    dt = time.perf_counter() - t0
+    from photon_ml_tpu.tune.game_tuning import GameEstimatorEvaluationFunction
+
+    fn = GameEstimatorEvaluationFunction(est, config, tr, va, seed=0)
+    fn.warmup()  # compile the shared fused tuning program outside the window
+    out = {}
+
+    def thunk():
+        fn.results.clear()  # each repeat is a fresh tuning run
+        t0 = time.perf_counter()
+        out["best"], _, out["tuned"] = tune_game_model(
+            est, config, tr, va, n_iterations=n_iter, mode="bayesian",
+            seed=0, evaluation_function=fn)
+        return time.perf_counter() - t0
+
+    dt, timing = _measure(thunk)
+    best, tuned = out["best"], out["tuned"]
     aucs = [r.evaluation.values["auc"] for r in tuned]
     return {
-        "backend": backend, "dt": dt,
+        "backend": backend, "dt": dt, "timing": timing,
         "units": len(tuned), "unit": "tuning_fits/sec",
         "flops_est": None,  # dominated by many small fits + GP host math
         "stats": {"best_auc": float(best.evaluation.values["auc"]),
@@ -625,9 +675,12 @@ def quality_gate(name: str, stats: dict, ref: dict | None):
         return {"pass": bool(d <= 0.005), "auc": stats["auc"],
                 "auc_ref": ref["auc"], "auc_diff": round(d, 5)}
     if name == "gp_tune":
-        ok = stats["best_auc"] >= stats["prior_auc"] - 1e-9
+        # the prior config is deliberately mis-regularized (run_gp_tune), so
+        # a working tuner MUST beat it — equality fails this gate
+        ok = stats["best_auc"] > stats["prior_auc"] + 1e-4
         return {"pass": bool(ok), "best_auc": stats["best_auc"],
-                "prior_auc": stats["prior_auc"]}
+                "prior_auc": stats["prior_auc"],
+                "improvement": round(stats["best_auc"] - stats["prior_auc"], 5)}
     return {"pass": None}
 
 
@@ -664,6 +717,8 @@ def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
         "quality": quality_gate(name, got["stats"], ref),
         "backend": got["backend"],
     }
+    if got.get("timing"):
+        entry["timing"] = got["timing"]
     if got.get("impl"):
         entry["impl"] = got["impl"]
     if got.get("flops_est"):

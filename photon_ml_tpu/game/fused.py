@@ -72,6 +72,9 @@ class FusedSweep:
                      for cid in self.order]
         needs_rand = [getattr(coords[cid].config, "down_sampling_rate", 1.0) < 1.0
                       for cid in self.order]
+        self._needs_var = needs_var
+        self._needs_rand = needs_rand
+        self._snap_program = None  # built lazily by run_snapshots
 
         def program(states0, scores0, vars0, regs, base_key, base, datas):
             # regs: per-coordinate Regularization pytree, TRACED — a
@@ -86,20 +89,13 @@ class FusedSweep:
             # as ARGUMENTS — closed-over device arrays would lower to baked
             # XLA constants, with compile time linear in constant bytes.
             def body(carry, it):
-                states, scores, vars_ = (list(c) for c in carry)
+                states, scores, vars_ = carry
+                vars_ = list(vars_)
                 it_key = (jax.random.fold_in(base_key, it)
                           if any(needs_rand) else None)
-                total = scores[0]
-                for s in scores[1:]:
-                    total = total + s
+                states, scores, partials, keys = self._sweep_iteration(
+                    states, scores, regs, it_key, base, datas)
                 for i, cid in enumerate(order):
-                    # residual trick (CoordinateDescent.scala:197-204)
-                    partial = total - scores[i]
-                    key = (jax.random.fold_in(it_key, i)
-                           if needs_rand[i] else None)
-                    states[i], scores[i] = coords[cid].trace_update(
-                        states[i], base + partial, reg=regs[i], key=key,
-                        data=datas[i])
                     if needs_var[i]:
                         # Only the LAST update's variances survive into the
                         # published model (host-path semantics), so skip the
@@ -110,8 +106,7 @@ class FusedSweep:
                             lambda s, o, r, k: coords[cid].trace_variances(
                                 s, o, reg=r, key=k, data=datas[i]),
                             lambda s, o, r, k: vars_[i],
-                            states[i], base + partial, regs[i], key)
-                    total = partial + scores[i]
+                            states[i], base + partials[i], regs[i], keys[i])
                 return (tuple(states), tuple(scores), tuple(vars_)), None
 
             carry, _ = lax.scan(body, (states0, scores0, vars0),
@@ -132,6 +127,34 @@ class FusedSweep:
         self._cold = self._init_carry(None)
         self._vars0 = tuple(coordinates[cid].init_sweep_variances()
                             for cid in self.order)
+
+    def _sweep_iteration(self, states, scores, regs, it_key, base, datas):
+        """Traceable: ONE outer iteration's coordinate loop — the single
+        source of the descent math (residual fold + per-coordinate update,
+        CoordinateDescent.scala:197-204) shared by the main program and the
+        snapshot program.  Returns (states', scores', partials, keys):
+        partials[i] is the residual offset coordinate i was solved against
+        and keys[i] the PRNG key its update used — variance computation must
+        see the SAME offsets and down-sampling mask as the published
+        coefficients, so it re-uses both rather than re-deriving them."""
+        order, coords = self.order, self.coordinates
+        needs_rand = self._needs_rand
+        states, scores = list(states), list(scores)
+        partials, keys = [], []
+        total = scores[0]
+        for s in scores[1:]:
+            total = total + s
+        for i, cid in enumerate(order):
+            # residual trick (CoordinateDescent.scala:197-204)
+            partial = total - scores[i]
+            key = (jax.random.fold_in(it_key, i) if needs_rand[i] else None)
+            states[i], scores[i] = coords[cid].trace_update(
+                states[i], base + partial, reg=regs[i], key=key,
+                data=datas[i])
+            partials.append(partial)
+            keys.append(key)
+            total = partial + scores[i]
+        return states, scores, partials, keys
 
     def _init_carry(self, initial: Optional[GameModel]):
         states, scores = [], []
@@ -174,6 +197,65 @@ class FusedSweep:
                         for i, cid in enumerate(self.order)}
         models = self._attach_variances(models, vars_)
         return GameModel(models=models), final_scores
+
+    def run_snapshots(self, initial: Optional[GameModel] = None,
+                      regs: Optional[Sequence] = None, seed: int = 0,
+                      carry0=None) -> Sequence[GameModel]:
+        """One fused descent, returning the FULL model after EVERY outer
+        iteration (still one compiled program — the scan emits each
+        iteration's published coefficients as its per-step output).
+
+        This is what host-paced best-model retention needs from a fused
+        sweep: the host loop compares full models at sweep boundaries only
+        (descent.py, reference CoordinateDescent.scala:163-167), so a caller
+        holding these snapshots can evaluate each on validation data and keep
+        the best — without per-update host round-trips.  Used by the tuning
+        fast path (tune/game_tuning.py) for multi-iteration configs.
+
+        Variance computation is not supported here (the host loop publishes
+        each update's own variances; per-snapshot variances would multiply
+        the curvature work T-fold) — callers fall back to the host descent.
+        """
+        if any(self._needs_var):
+            raise NotImplementedError(
+                "run_snapshots does not compute coefficient variances; use "
+                "run() (final model only) or the host CoordinateDescent")
+        order, coords = self.order, self.coordinates
+        needs_rand = self._needs_rand
+        if self._snap_program is None:
+            def program(states0, scores0, regs, base_key, base, datas):
+                # same _sweep_iteration core as the main program (no
+                # variances), but each iteration ALSO publishes — scan
+                # stacks the published coefficients along a leading T axis
+                def body(carry, it):
+                    states, scores = carry
+                    it_key = (jax.random.fold_in(base_key, it)
+                              if any(needs_rand) else None)
+                    states, scores, _, _ = self._sweep_iteration(
+                        states, scores, regs, it_key, base, datas)
+                    published = tuple(
+                        coords[cid].trace_publish(states[i], data=datas[i])
+                        for i, cid in enumerate(order))
+                    return (tuple(states), tuple(scores)), published
+
+                (_, scores), pubs = lax.scan(
+                    body, (states0, scores0), jnp.arange(self.num_iterations))
+                return pubs, scores
+
+            self._snap_program = jax.jit(program)
+        carry = carry0 if carry0 is not None else self.init_carry(initial)
+        if regs is None:
+            regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
+        pubs, _scores = self._snap_program(
+            *carry, tuple(regs), jax.random.PRNGKey(seed),
+            self._base, self._datas)
+        pubs = [np.asarray(p) for p in pubs]
+        return [
+            GameModel(models={
+                cid: self.coordinates[cid].export_model(pubs[i][t])
+                for i, cid in enumerate(order)})
+            for t in range(self.num_iterations)
+        ]
 
     def _attach_variances(self, models, vars_):
         """Attach the in-sweep-computed variances (the LAST update's, exactly

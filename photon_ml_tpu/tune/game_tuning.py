@@ -72,7 +72,16 @@ class GameEstimatorEvaluationFunction:
             return None
         if self._sweep is None:
             from photon_ml_tpu.game.fused import FusedSweep
+            from photon_ml_tpu.types import VarianceComputationType
 
+            if self.base_config.num_outer_iterations > 1 and any(
+                    c.variance != VarianceComputationType.NONE
+                    for c in self.base_config.coordinates.values()):
+                # multi-iteration fused tuning runs via per-iteration
+                # snapshots, which don't carry variances (FusedSweep
+                # .run_snapshots) — host path keeps exact semantics
+                self._sweep = False
+                return None
             try:
                 coords = {
                     cid: self.estimator.build_one_coordinate(
@@ -95,26 +104,36 @@ class GameEstimatorEvaluationFunction:
     def __call__(self, params: np.ndarray) -> float:
         config = self.config_for(params)
         # Fused fast path: train WITHOUT per-update validation (the whole
-        # retrain is one jitted sweep, reused across every tuning fit) and
-        # evaluate the FINAL model.  Only when a single outer iteration
-        # makes final == best-across-iterations — with more iterations the
-        # host loop's best-model retention (reference CoordinateDescent
-        # .scala:163-314) is load-bearing and must be kept.
-        fused_ok = (not self.locked and self.estimator.fused is not False
-                    and config.num_outer_iterations == 1)
+        # retrain is one jitted sweep, reused across every tuning fit).
+        # Best-model retention (reference CoordinateDescent.scala:163-314)
+        # compares FULL models at sweep boundaries only, so per-iteration
+        # snapshots from the fused program (FusedSweep.run_snapshots) carry
+        # exactly the candidates the host loop would compare — each is
+        # evaluated on validation here and the best kept.  One outer
+        # iteration degenerates to evaluating the final model via run().
+        fused_ok = (not self.locked and self.estimator.fused is not False)
         sweep = self._fused_sweep() if fused_ok else None
         if sweep is not None:
             sweep_obj, carry0 = sweep
-            model, _scores = sweep_obj.run(
-                carry0=carry0,
-                regs=[config.coordinates[cid].reg for cid in config.coordinates],
-                seed=self.seed)
-            ev = GameTransformer(model, config.task).evaluate(
-                self.validation_data, self.estimator.validation_suite)
-            res = GameFitResult(model=model, config=config, evaluation=ev,
-                                history=DescentHistory())
+            regs = [config.coordinates[cid].reg for cid in config.coordinates]
+            suite = self.estimator.validation_suite
+            if config.num_outer_iterations == 1:
+                model, _scores = sweep_obj.run(carry0=carry0, regs=regs,
+                                               seed=self.seed)
+                snapshots = [model]
+            else:
+                snapshots = sweep_obj.run_snapshots(carry0=carry0, regs=regs,
+                                                    seed=self.seed)
+            best_model, best_ev = None, None
+            for m in snapshots:
+                ev = GameTransformer(m, config.task).evaluate(
+                    self.validation_data, suite)
+                if best_ev is None or suite.better_than(ev, best_ev):
+                    best_model, best_ev = m, ev
+            res = GameFitResult(model=best_model, config=config,
+                                evaluation=best_ev, history=DescentHistory())
             self.results.append(res)
-            return ev.primary
+            return best_ev.primary
         res = self.estimator.fit(self.data, [config],
                                  validation_data=self.validation_data, seed=self.seed,
                                  initial_model=self.initial_model,
@@ -125,6 +144,15 @@ class GameEstimatorEvaluationFunction:
     def vectorize(self, config: GameConfig) -> np.ndarray:
         """Config -> params vector (reference configurationToVector)."""
         return np.asarray([config.coordinates[cid].reg.l2 for cid in self.coordinate_ids])
+
+    def warmup(self) -> None:
+        """Compile the shared fused tuning program (one throwaway fit at the
+        base config's weights, not recorded).  Benchmarks call this so the
+        timed window measures tuning-fit throughput, not XLA compilation —
+        the same convention as the sweep benches' warm-up run."""
+        n = len(self.results)
+        self(self.vectorize(self.base_config))
+        del self.results[n:]
 
 
 DEFAULT_L2_RANGE = (1e-4, 1e4)
@@ -153,6 +181,7 @@ def tune_game_model(
     locked_coordinates=None,
     search_domain: Optional[SearchDomain] = None,
     prior_observations: Optional[List[Tuple[np.ndarray, float]]] = None,
+    evaluation_function: Optional[GameEstimatorEvaluationFunction] = None,
 ) -> Tuple[GameFitResult, "RandomSearch", List[GameFitResult]]:
     """Search per-coordinate L2 weights; returns (best fit, search object,
     all tuned fits in evaluation order — the driver's TUNED/ALL output modes
@@ -167,9 +196,31 @@ def tune_game_model(
     must match the unlocked-coordinate order.  ``prior_observations``:
     (params, value) pairs seeded into the search
     (HyperparameterSerialization.priorFromJson)."""
-    fn = GameEstimatorEvaluationFunction(estimator, base_config, data, validation_data,
-                                         seed, initial_model=initial_model,
-                                         locked_coordinates=locked_coordinates)
+    if evaluation_function is not None:
+        # caller pre-built (and possibly warmup()-compiled) the evaluation
+        # function — it must wrap the SAME estimator/config, and the
+        # per-fit knobs must not be double-specified (they live on fn)
+        fn = evaluation_function
+        if fn.estimator is not estimator or fn.base_config is not base_config:
+            raise ValueError(
+                "evaluation_function was built for a different estimator or "
+                "base_config than the ones passed to tune_game_model")
+        if fn.data is not data or fn.validation_data is not validation_data:
+            raise ValueError(
+                "evaluation_function was built for different data or "
+                "validation_data than the ones passed to tune_game_model")
+        if initial_model is not None or locked_coordinates is not None:
+            raise ValueError(
+                "pass initial_model/locked_coordinates to the "
+                "GameEstimatorEvaluationFunction constructor, not to "
+                "tune_game_model, when supplying evaluation_function")
+        if seed != fn.seed:
+            raise ValueError(
+                f"seed {seed} != evaluation_function's seed {fn.seed}")
+    else:
+        fn = GameEstimatorEvaluationFunction(estimator, base_config, data, validation_data,
+                                             seed, initial_model=initial_model,
+                                             locked_coordinates=locked_coordinates)
     if search_domain is not None:
         if search_domain.d != len(fn.coordinate_ids):
             raise ValueError(
@@ -181,6 +232,9 @@ def tune_game_model(
     minimize = not estimator.validation_suite.primary.larger_is_better
     cls = GaussianProcessSearch if mode == "bayesian" else RandomSearch
     search = cls(domain, minimize=minimize, seed=seed)
+    # a reused evaluation_function may carry fits from a previous search —
+    # this run's results are everything appended from here on
+    start = len(fn.results)
     # prior: supplied observations (values already in the primary metric's
     # raw orientation), then the base config's own weights, evaluated first
     # (warm prior, reference ShrinkSearchRange / prior JSON defaults)
@@ -190,5 +244,6 @@ def tune_game_model(
         priors.append((prior_params, fn(prior_params)))
     search.find(fn, n=n_iterations, priors=priors or None)
 
-    best = estimator.best(fn.results)
-    return best, search, list(fn.results)
+    results = list(fn.results[start:])
+    best = estimator.best(results)
+    return best, search, results
