@@ -315,12 +315,27 @@ def _run(args, task, t_start, emitter) -> int:
     if args.sparse_threshold > 0:
         sparse_shards = {s for s in shards
                          if index_maps[s].size >= args.sparse_threshold}
-        re_shards = {spec.template.feature_shard for spec in specs
-                     if not isinstance(spec.template, FixedEffectConfig)}
-        forced_dense = sparse_shards & re_shards
+        # random-effect coordinates train from sparse shards directly
+        # (compact observed-column buckets, bucket_by_entity_sparse) EXCEPT
+        # the combinations the sparse path refuses loudly — those shards
+        # stay dense so the run keeps the round-1 behavior
+        from photon_ml_tpu.types import ProjectorType, VarianceComputationType
+
+        needs_dense = {
+            spec.template.feature_shard for spec in specs
+            if not isinstance(spec.template, FixedEffectConfig)
+            and (spec.template.projector == ProjectorType.RANDOM
+                 or spec.template.variance != VarianceComputationType.NONE
+                 # constraints are still the UNRESOLVED @file here (they
+                 # resolve later, against the index maps) — the spec field
+                 # is the truth at this point, not template.constraints
+                 or spec.constraints_file is not None)}
+        forced_dense = sparse_shards & needs_dense
         if forced_dense:
-            logger.warning("shards %s stay dense: random-effect coordinates "
-                           "need dense shards", sorted(forced_dense))
+            logger.warning("shards %s stay dense: RANDOM-projected, "
+                           "variance-computing or box-constrained "
+                           "random-effect coordinates need dense shards",
+                           sorted(forced_dense))
             sparse_shards -= forced_dense
         if sparse_shards:
             logger.info("sparse shards: %s", sorted(sparse_shards))

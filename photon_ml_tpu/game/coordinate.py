@@ -602,24 +602,69 @@ class RandomEffectCoordinate(Coordinate):
         self._base_offset = np.asarray(data.offset, np.float64)
 
         shard_data = data.features[config.feature_shard]
-        if isinstance(shard_data, SparseShard):
-            raise NotImplementedError(
-                f"random-effect coordinate {coordinate_id!r} needs a dense "
-                f"feature shard; {config.feature_shard!r} is sparse — use a "
-                "separate (projected/smaller) dense shard for random effects, "
-                "as the reference does via per-entity projection (SURVEY §2.7)")
-        x = np.asarray(shard_data, dtype)
         entity_ids = data.id_tags[config.random_effect_type]
         lane_multiple = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
-        self.buckets = bucket_by_entity(
-            entity_ids, x, np.asarray(data.y, dtype),
-            offset=np.asarray(data.offset, dtype),
-            weight=np.asarray(data.weight, dtype),
-            active_cap=config.active_cap,
-            min_active_samples=config.min_active_samples,
-            lane_multiple=lane_multiple,
-            seed=seed, dtype=dtype,
-        )
+        self._sparse = isinstance(shard_data, SparseShard)
+        if self._sparse:
+            # Row-sparse RE feature bag (the reference's per-entity sparse
+            # LocalDataset, data/LocalDataset.scala:35-247): each entity
+            # solves in the compact space of its observed columns, built
+            # DIRECTLY from the sparse rows — the full-vocabulary [E, S, d]
+            # bucket tensors never exist (bucket_by_entity_sparse).
+            if config.projector == ProjectorType.RANDOM:
+                raise NotImplementedError(
+                    f"coordinate {coordinate_id!r}: RANDOM projection of a "
+                    "sparse shard is not supported — use INDEX_MAP (or "
+                    "IDENTITY, which compacts to observed columns anyway)")
+            if config.projected_dim is not None:
+                raise ValueError(
+                    "projected_dim applies only to RANDOM projection; sparse "
+                    "shards derive per-entity dimensions from observed columns")
+            if config.constraints:
+                raise ValueError(
+                    f"coordinate {coordinate_id!r}: box constraints are not "
+                    "supported with a sparse feature shard (the compact solve "
+                    "space has no stable full-dim column alignment)")
+            if config.variance != VarianceComputationType.NONE:
+                raise NotImplementedError(
+                    f"coordinate {coordinate_id!r}: per-entity variances need "
+                    "a dense feature shard (an unobserved feature's variance "
+                    "is prior-only and the compact space drops it)")
+            if norm is not None and norm.shifts is not None:
+                raise NotImplementedError(
+                    f"coordinate {coordinate_id!r}: shift normalization needs "
+                    "a stable intercept column, which per-entity compaction "
+                    "does not keep — factor-only normalization is supported")
+            from photon_ml_tpu.parallel.bucketing import bucket_by_entity_sparse
+            from photon_ml_tpu.parallel.projection import ProjectedBuckets
+
+            ratio = (config.features_to_samples_ratio
+                     if config.projector == ProjectorType.INDEX_MAP else None)
+            self.buckets, projections = bucket_by_entity_sparse(
+                entity_ids, shard_data.indices, shard_data.values, self.dim,
+                np.asarray(data.y, dtype),
+                offset=np.asarray(data.offset, dtype),
+                weight=np.asarray(data.weight, dtype),
+                active_cap=config.active_cap,
+                min_active_samples=config.min_active_samples,
+                lane_multiple=lane_multiple, seed=seed, dtype=dtype,
+                features_to_samples_ratio=ratio,
+                intercept_index=config.intercept_index,
+            )
+            self._proj = ProjectedBuckets(base=self.buckets,
+                                          buckets=self.buckets.buckets,
+                                          projections=projections)
+        else:
+            x = np.asarray(shard_data, dtype)
+            self.buckets = bucket_by_entity(
+                entity_ids, x, np.asarray(data.y, dtype),
+                offset=np.asarray(data.offset, dtype),
+                weight=np.asarray(data.weight, dtype),
+                active_cap=config.active_cap,
+                min_active_samples=config.min_active_samples,
+                lane_multiple=lane_multiple,
+                seed=seed, dtype=dtype,
+            )
         # slot order for the stacked model = sorted entity id (stacked_coefficients)
         self._sorted_ids = sorted(self.buckets.lane_of)
         self._slot_of = {eid: i for i, eid in enumerate(self._sorted_ids)}
@@ -635,24 +680,34 @@ class RandomEffectCoordinate(Coordinate):
         ]
         self._entity_ids = np.asarray(entity_ids, np.int64)
         self._sample_slots = jnp.asarray(_slots_from(self._slot_of, self._entity_ids))
-        self._x_full = jnp.asarray(x)
+        if self._sparse:
+            # full-sample scoring stays sparse: [n, k] gather arrays, never
+            # an [n, d_full] densified design (score_samples_sparse)
+            self._x_idx_dev = jnp.asarray(np.asarray(shard_data.indices, np.int32))
+            self._x_val_dev = jnp.asarray(np.asarray(shard_data.values, dtype))
+        else:
+            self._x_full = jnp.asarray(x)
 
         # Optional per-entity feature projection (reference
         # RandomEffectCoordinateInProjectedSpace.scala:149): solve each bucket
         # in a compact feature space, back-project coefficients to full dim.
-        self._proj = None
-        solve_buckets = self.buckets.buckets
-        if config.projector != ProjectorType.IDENTITY:
-            from photon_ml_tpu.parallel.projection import project_buckets
+        # (A sparse shard arrives here with self._proj already built — its
+        # buckets ARE the compact space.)
+        if not self._sparse:
+            self._proj = None
+            if config.projector != ProjectorType.IDENTITY:
+                from photon_ml_tpu.parallel.projection import project_buckets
 
-            self._proj = project_buckets(
-                self.buckets, config.projector,
-                projected_dim=config.projected_dim,
-                features_to_samples_ratio=config.features_to_samples_ratio,
-                intercept_index=config.intercept_index,
-                seed=seed,
-            )
-            solve_buckets = self._proj.buckets
+                self._proj = project_buckets(
+                    self.buckets, config.projector,
+                    projected_dim=config.projected_dim,
+                    features_to_samples_ratio=config.features_to_samples_ratio,
+                    intercept_index=config.intercept_index,
+                    seed=seed,
+                )
+        solve_buckets = (self._proj.buckets if self._proj is not None
+                         else self.buckets.buckets)
+        if self._proj is not None:
             # Device twins of each bucket's back-projection (gather indices /
             # shared Gaussian matrix); they travel through sweep_data() into
             # the fused program as arguments.  The Gaussian matrix is SHARED
@@ -719,10 +774,12 @@ class RandomEffectCoordinate(Coordinate):
 
     def _bind_solver(self) -> None:
         # shared-context normalization (IDENTITY projector) bakes into the
-        # objective; per-lane contexts (INDEX_MAP) enter the vmapped solve as
+        # objective; per-lane contexts (INDEX_MAP, and any sparse shard —
+        # whose solve space is always compact) enter the vmapped solve as
         # traced factor arrays instead (see _vsolve below)
         shared_norm = (self._norm if self._norm is not None
                        and self.config.projector == ProjectorType.IDENTITY
+                       and not self._sparse
                        else None)
         objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
                                  norm=shared_norm or no_normalization())
@@ -766,11 +823,11 @@ class RandomEffectCoordinate(Coordinate):
 
         kind = self.config.variance
         if kind != VarianceComputationType.NONE:
-            if self.config.projector != ProjectorType.IDENTITY:
+            if self.config.projector != ProjectorType.IDENTITY or self._sparse:
                 raise ValueError(
                     "per-entity variances are not defined in a projected "
-                    "solve space; use ProjectorType.IDENTITY "
-                    f"(coordinate {self.coordinate_id!r})")
+                    "solve space; use ProjectorType.IDENTITY with a dense "
+                    f"shard (coordinate {self.coordinate_id!r})")
             from photon_ml_tpu.opt.solve import compute_variances
 
             def _vvar(w_b, x_b, y_b, off_b, wt_b, reg):
@@ -924,7 +981,8 @@ class RandomEffectCoordinate(Coordinate):
         return model, results
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
-        from photon_ml_tpu.parallel.bucketing import score_samples
+        from photon_ml_tpu.parallel.bucketing import (score_samples,
+                                                      score_samples_sparse)
 
         w = jnp.asarray(np.asarray(model.w_stack, self._dtype))
         if model.slot_of == self._slot_of:
@@ -934,6 +992,9 @@ class RandomEffectCoordinate(Coordinate):
             # slot map (an entity may be absent from our training buckets yet
             # present in the model)
             slots = jnp.asarray(_slots_from(model.slot_of, self._entity_ids))
+        if self._sparse:
+            return np.asarray(score_samples_sparse(
+                w, slots, self._x_idx_dev, self._x_val_dev))[: self._n]
         return np.asarray(score_samples(w, slots, self._x_full))[: self._n]
 
     # --- traceable-step interface (game/fused.py) ---
@@ -955,17 +1016,22 @@ class RandomEffectCoordinate(Coordinate):
         """Bucket design matrices, full-sample scoring arrays and (when
         projecting) back-projection arrays, passed into the fused program as
         arguments (see Coordinate.sweep_data)."""
-        return dict(dev=self._dev, slots=self._sample_slots,
-                    x_full=self._x_full,
-                    proj=self._proj_dev if self._proj is not None else None,
-                    norm_fac=self._norm_fac_dev)
+        d = dict(dev=self._dev, slots=self._sample_slots,
+                 proj=self._proj_dev if self._proj is not None else None,
+                 norm_fac=self._norm_fac_dev)
+        if self._sparse:
+            d.update(x_idx=self._x_idx_dev, x_val=self._x_val_dev)
+        else:
+            d["x_full"] = self._x_full
+        return d
 
     def trace_update(self, state: Tuple[Array, ...], offsets: Array,
                      reg: Optional[Regularization] = None,
                      key=None, data=None) -> Tuple[Tuple[Array, ...], Array]:
         # ``key`` unused: random effects have no per-update stochastic work
         # (down-sampling is a fixed-effect-only config, as in the reference).
-        from photon_ml_tpu.parallel.bucketing import score_samples
+        from photon_ml_tpu.parallel.bucketing import (score_samples,
+                                                      score_samples_sparse)
 
         if data is None:
             data = self.sweep_data()
@@ -980,7 +1046,12 @@ class RandomEffectCoordinate(Coordinate):
                                lane_regs[bi], *fac_args)
             new_lanes.append(res.w)
         w_stack = self.trace_publish(tuple(new_lanes), data=data)
-        score = score_samples(w_stack, data["slots"], data["x_full"])[: self._n]
+        if self._sparse:
+            score = score_samples_sparse(
+                w_stack, data["slots"], data["x_idx"], data["x_val"])[: self._n]
+        else:
+            score = score_samples(w_stack, data["slots"],
+                                  data["x_full"])[: self._n]
         return tuple(new_lanes), score
 
     def trace_publish(self, state: Tuple[Array, ...], data=None) -> Array:

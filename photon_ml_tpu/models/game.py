@@ -91,11 +91,15 @@ class RandomEffectModel(DatumScoringModel):
 
     def score(self, data: GameData) -> Array:
         shard = data.features[self.feature_shard]
-        if hasattr(shard, "indices"):
-            raise NotImplementedError(
-                "random-effect models score dense shards only "
-                f"({self.feature_shard!r} is sparse)")
         slots = jnp.asarray(self.slots_for(data))
+        if hasattr(shard, "indices"):
+            # row-sparse shard: O(n*k) two-level gather, never [n, d_full]
+            from photon_ml_tpu.parallel.bucketing import score_samples_sparse
+
+            return score_samples_sparse(
+                jnp.asarray(self.w_stack), slots,
+                jnp.asarray(np.asarray(shard.indices)),
+                jnp.asarray(np.asarray(shard.values, self.w_stack.dtype)))
         x = jnp.asarray(shard)
         return score_samples(jnp.asarray(self.w_stack), slots, x)
 

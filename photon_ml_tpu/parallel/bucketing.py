@@ -98,6 +98,65 @@ class EntityBuckets:
         return np.asarray(sorted(self.lane_of), np.int64)
 
 
+def _group_rows(
+    entity_ids: np.ndarray,
+    active_cap: Optional[int],
+    min_active_samples: int,
+    seed: int,
+) -> Tuple[List[np.ndarray], List[int], List[float]]:
+    """Group sample rows by entity with the deterministic reservoir cap +
+    weight rescale count/cap (reference RandomEffectDataset.scala:358-420)
+    and the min-active lower bound (:319-341).  Shared by the dense and
+    row-sparse bucketers."""
+    uniq, inverse, counts = np.unique(entity_ids, return_inverse=True,
+                                      return_counts=True)
+    order = np.argsort(inverse, kind="stable")  # rows grouped by entity
+    starts = np.concatenate([[0], np.cumsum(counts)])
+
+    kept_rows: List[np.ndarray] = []
+    kept_entities: List[int] = []
+    rescale: List[float] = []
+    for e in range(len(uniq)):
+        rows = order[starts[e]: starts[e + 1]]
+        if len(rows) < min_active_samples:
+            continue
+        scale = 1.0
+        if active_cap is not None and len(rows) > active_cap:
+            keys = _splitmix64(rows.astype(np.uint64) ^ np.uint64(seed))
+            rows = rows[np.argsort(keys, kind="stable")[:active_cap]]
+            scale = len(keys) / active_cap  # weight rescale count/cap
+        kept_rows.append(np.sort(rows))
+        kept_entities.append(int(uniq[e]))
+        rescale.append(scale)
+    return kept_rows, kept_entities, rescale
+
+
+def _pack_lane_meta(n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
+                    y, offset, weight, dtype, lane_of, bucket_index):
+    """Fill one capacity class's NON-design lane arrays (labels, offsets,
+    rescaled weights, row map, counts, entity directory) — identical between
+    the dense and row-sparse bucketers, factored so their padding/rescale
+    semantics cannot diverge.  Returns (by, boff, bw, brows, bcounts,
+    blanes); ``lane_of`` is updated in place."""
+    by = np.zeros((n_lanes, cap), dtype)
+    boff = np.zeros((n_lanes, cap), dtype)
+    bw = np.zeros((n_lanes, cap), dtype)
+    brows = np.full((n_lanes, cap), -1, np.int32)
+    bcounts = np.zeros((n_lanes,), np.int32)
+    blanes = np.full((n_lanes,), -1, np.int64)
+    for lane, ei in enumerate(idxs):
+        rows = kept_rows[ei]
+        k = len(rows)
+        by[lane, :k] = y[rows]
+        boff[lane, :k] = offset[rows]
+        bw[lane, :k] = weight[rows] * rescale[ei]
+        brows[lane, :k] = rows
+        bcounts[lane] = k
+        blanes[lane] = kept_entities[ei]
+        lane_of[kept_entities[ei]] = (bucket_index, lane)
+    return by, boff, bw, brows, bcounts, blanes
+
+
 def bucket_by_entity(
     entity_ids: np.ndarray,
     x: np.ndarray,
@@ -129,26 +188,8 @@ def bucket_by_entity(
     weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
     d = x.shape[1]
 
-    uniq, inverse, counts = np.unique(entity_ids, return_inverse=True, return_counts=True)
-    order = np.argsort(inverse, kind="stable")  # rows grouped by entity
-    starts = np.concatenate([[0], np.cumsum(counts)])
-
-    # Per-entity row lists (+ deterministic reservoir cap).
-    kept_rows: List[np.ndarray] = []
-    kept_entities: List[int] = []
-    rescale: List[float] = []
-    for e in range(len(uniq)):
-        rows = order[starts[e]: starts[e + 1]]
-        if len(rows) < min_active_samples:
-            continue
-        scale = 1.0
-        if active_cap is not None and len(rows) > active_cap:
-            keys = _splitmix64(rows.astype(np.uint64) ^ np.uint64(seed))
-            rows = rows[np.argsort(keys, kind="stable")[:active_cap]]
-            scale = len(keys) / active_cap  # weight rescale count/cap
-        kept_rows.append(np.sort(rows))
-        kept_entities.append(int(uniq[e]))
-        rescale.append(scale)
+    kept_rows, kept_entities, rescale = _group_rows(
+        entity_ids, active_cap, min_active_samples, seed)
 
     # Capacity classes: next power of two of the active count.
     caps = np.asarray([max(1, 1 << (len(r) - 1).bit_length()) for r in kept_rows])
@@ -157,29 +198,119 @@ def bucket_by_entity(
     for cap in sorted(set(caps.tolist())):
         idxs = np.nonzero(caps == cap)[0]
         n_lanes = ((len(idxs) + lane_multiple - 1) // lane_multiple) * lane_multiple
+        by, boff, bw, brows, bcounts, blanes = _pack_lane_meta(
+            n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
+            y, offset, weight, dtype, lane_of, len(buckets))
         bx = np.zeros((n_lanes, cap, d), dtype)
-        by = np.zeros((n_lanes, cap), dtype)
-        boff = np.zeros((n_lanes, cap), dtype)
-        bw = np.zeros((n_lanes, cap), dtype)
-        brows = np.full((n_lanes, cap), -1, np.int32)
-        bcounts = np.zeros((n_lanes,), np.int32)
-        blanes = np.full((n_lanes,), -1, np.int64)
         for lane, ei in enumerate(idxs):
             rows = kept_rows[ei]
-            k = len(rows)
-            bx[lane, :k] = x[rows]
-            by[lane, :k] = y[rows]
-            boff[lane, :k] = offset[rows]
-            bw[lane, :k] = weight[rows] * rescale[ei]
-            brows[lane, :k] = rows
-            bcounts[lane] = k
-            blanes[lane] = kept_entities[ei]
-            lane_of[kept_entities[ei]] = (len(buckets), lane)
+            bx[lane, :len(rows)] = x[rows]
         buckets.append(Bucket(x=bx, y=by, offset=boff, weight=bw, rows=brows,
                               counts=bcounts, entity_lanes=blanes))
 
     return EntityBuckets(buckets=buckets, lane_of=lane_of, dim=d,
                          num_entities=len(kept_entities), num_samples=n)
+
+
+def bucket_by_entity_sparse(
+    entity_ids: np.ndarray,
+    indices: np.ndarray,
+    values: np.ndarray,
+    dim: int,
+    y: np.ndarray,
+    offset: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+    active_cap: Optional[int] = None,
+    min_active_samples: int = 1,
+    lane_multiple: int = 1,
+    seed: int = 0,
+    dtype=np.float32,
+    features_to_samples_ratio: Optional[float] = None,
+    intercept_index: Optional[int] = None,
+):
+    """Compact per-entity buckets built DIRECTLY from row-sparse features.
+
+    The reference keeps per-entity SPARSE Breeze vectors
+    (data/LocalDataset.scala:35-247), so wide sparse random-effect feature
+    bags never densify to the full vocabulary.  The TPU equivalent: each
+    entity solves in the compact space of its OBSERVED columns (the
+    IndexMapProjectorRDD.scala:222-261 set, built here straight from the
+    row-sparse (indices, values) pairs), so the bucket design blocks are
+    [E, S, d_obs] — never [E, S, d_full] — and HBM scales with observed
+    features per entity, not vocabulary size.  Margin-exact: an unobserved
+    feature has zero data gradient and stays at exactly 0 under L2/L1 from a
+    zero init (same fact the reference's projection relies on).
+
+    ``indices``/``values``: the SparseShard row-padded COO arrays [n, k]
+    (padded slots carry value 0 and are ignored; duplicate indices within a
+    row ACCUMULATE, matching core/batch.SparseBatch margins).
+    ``features_to_samples_ratio``/``intercept_index``: per-entity top-k
+    |Pearson| feature filter exactly as build_observed_indices applies it to
+    dense buckets (LocalDataset.scala:185-247).
+
+    Returns ``(EntityBuckets, projections)`` — compact buckets plus one
+    BucketProjection per bucket mapping compact columns back to the full
+    vocabulary (``EntityBuckets.dim`` stays the FULL dimension).
+    """
+    from photon_ml_tpu.parallel.projection import (BucketProjection,
+                                                   pearson_top_k)
+
+    n = len(entity_ids)
+    entity_ids = np.asarray(entity_ids, np.int64)
+    indices = np.asarray(indices, np.int64)
+    values = np.asarray(values, dtype)
+    y = np.asarray(y, dtype)
+    offset = np.zeros(n, dtype) if offset is None else np.asarray(offset, dtype)
+    weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
+
+    kept_rows, kept_entities, rescale = _group_rows(
+        entity_ids, active_cap, min_active_samples, seed)
+
+    def _compact_lane(rows: np.ndarray):
+        """(observed columns, compact dense block [len(rows), n_obs])."""
+        iv, vv = indices[rows], values[rows]
+        nz_r, nz_c = np.nonzero(vv != 0)
+        obs = np.unique(iv[nz_r, nz_c]) if nz_r.size else np.empty(0, np.int64)
+        x = np.zeros((len(rows), len(obs)), dtype)
+        if nz_r.size:
+            pos = np.searchsorted(obs, iv[nz_r, nz_c])
+            np.add.at(x, (nz_r, pos), vv[nz_r, nz_c])  # duplicates accumulate
+        if features_to_samples_ratio is not None and obs.size:
+            keep_n = max(1, int(np.ceil(features_to_samples_ratio * len(rows))))
+            if obs.size > keep_n:
+                top = pearson_top_k(x, y[rows], weight[rows], obs, keep_n,
+                                    intercept_index)
+                obs, x = obs[top], x[:, top]
+        return obs.astype(np.int32), x
+
+    caps = np.asarray([max(1, 1 << (len(r) - 1).bit_length()) for r in kept_rows])
+    buckets: List[Bucket] = []
+    projections: List[object] = []
+    lane_of: Dict[int, Tuple[int, int]] = {}
+    for cap in sorted(set(caps.tolist())):
+        idxs = np.nonzero(caps == cap)[0]
+        compacted = [_compact_lane(kept_rows[ei]) for ei in idxs]
+        d_proj = max(1, 1 << (max((len(o) for o, _ in compacted), default=1) - 1)
+                     .bit_length())
+        d_proj = min(d_proj, dim)
+        n_lanes = ((len(idxs) + lane_multiple - 1) // lane_multiple) * lane_multiple
+        by, boff, bw, brows, bcounts, blanes = _pack_lane_meta(
+            n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
+            y, offset, weight, dtype, lane_of, len(buckets))
+        bx = np.zeros((n_lanes, cap, d_proj), dtype)
+        bidx = np.full((n_lanes, d_proj), -1, np.int32)
+        for lane, ei in enumerate(idxs):
+            k = len(kept_rows[ei])
+            obs, x = compacted[lane]
+            bx[lane, :k, :len(obs)] = x
+            bidx[lane, :len(obs)] = obs
+        buckets.append(Bucket(x=bx, y=by, offset=boff, weight=bw, rows=brows,
+                              counts=bcounts, entity_lanes=blanes))
+        projections.append(BucketProjection(indices=bidx, d_full=dim))
+
+    ents = EntityBuckets(buckets=buckets, lane_of=lane_of, dim=dim,
+                         num_entities=len(kept_entities), num_samples=n)
+    return ents, projections
 
 
 def _entity_sharding(mesh: Optional[Mesh]):
@@ -297,6 +428,22 @@ def score_samples(w_stack: Array, slots: Array, x: Array) -> Array:
     """
     safe = jnp.where(slots >= 0, slots, 0)
     margins = jnp.einsum("nd,nd->n", x, w_stack[safe])
+    return jnp.where(slots >= 0, margins, 0.0)
+
+
+def score_samples_sparse(w_stack: Array, slots: Array, indices: Array,
+                         values: Array) -> Array:
+    """Raw per-sample scores for ROW-SPARSE features:
+    sum_k w_stack[slot_i, indices[i,k]] * values[i,k].
+
+    The sparse twin of ``score_samples`` — no [n, d_full] densification, an
+    O(n*k) two-level gather instead.  Padded COO slots carry value 0
+    (SparseShard contract), so whatever coefficient they gather is inert;
+    samples with slot -1 (entity without a model) score 0.
+    """
+    safe = jnp.where(slots >= 0, slots, 0)
+    gathered = w_stack[safe[:, None], indices]  # [n, k]
+    margins = jnp.sum(gathered * values, axis=-1)
     return jnp.where(slots >= 0, margins, 0.0)
 
 

@@ -76,6 +76,23 @@ def pearson_scores(x: np.ndarray, y: np.ndarray, weight: np.ndarray) -> np.ndarr
     return out
 
 
+def pearson_top_k(x: np.ndarray, y: np.ndarray, w: np.ndarray,
+                  obs: np.ndarray, keep_n: int,
+                  intercept_index: Optional[int] = None) -> np.ndarray:
+    """Sorted positions (into ``obs``) of the top-``keep_n`` |Pearson|
+    columns of ``x`` (LocalDataset.scala:185-247) — THE per-entity feature
+    filter, shared by the dense bucket path (build_observed_indices) and the
+    row-sparse one (bucket_by_entity_sparse) so tie-breaking and the
+    intercept pin cannot diverge.  The intercept column (located via its
+    full-dim id in ``obs``) always survives."""
+    scores = pearson_scores(x, y, w)
+    if intercept_index is not None:
+        at = np.nonzero(obs == intercept_index)[0]
+        if at.size:
+            scores[at[0]] = np.inf  # intercept always survives
+    return np.sort(np.argsort(-scores, kind="stable")[:keep_n])
+
+
 @dataclasses.dataclass
 class BucketProjection:
     """INDEX_MAP projection of one bucket: per-lane gather indices."""
@@ -160,14 +177,10 @@ def build_observed_indices(
         if features_to_samples_ratio is not None and observed.size > 0:
             keep_n = max(1, int(np.ceil(features_to_samples_ratio * k)))
             if observed.size > keep_n:
-                scores = pearson_scores(x[:, observed], bucket.y[lane, :k],
-                                        bucket.weight[lane, :k])
-                if intercept_index is not None:
-                    at = np.nonzero(observed == intercept_index)[0]
-                    if at.size:
-                        scores[at[0]] = np.inf  # intercept always survives
-                top = np.argsort(-scores, kind="stable")[:keep_n]
-                observed = np.sort(observed[top])
+                top = pearson_top_k(x[:, observed], bucket.y[lane, :k],
+                                    bucket.weight[lane, :k], observed,
+                                    keep_n, intercept_index)
+                observed = observed[top]
         per_lane.append(observed.astype(np.int32))
 
     d_proj = _pow2_at_least(max((len(o) for o in per_lane), default=1))

@@ -195,3 +195,174 @@ def test_sparse_feature_sharded_cli(tmp_path):
     assert rc == 0
     summary = json.load(open(os.path.join(out, "training-summary.json")))
     assert summary["validation"]["auc"] > 0.6
+
+
+# ---------------------------------------------------------------------------
+# Sparse per-entity random-effect shards (reference LocalDataset holds sparse
+# Breeze vectors per entity, data/LocalDataset.scala:35-247 — wide sparse RE
+# feature bags must train WITHOUT densifying to the vocabulary)
+# ---------------------------------------------------------------------------
+
+def _sparse_re_data(seed=5, n=1024, d=2048, k=8, n_users=32):
+    """Row-sparse per-user bag + its densified twin (same samples)."""
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.2] = 0.0  # padded COO slots (value 0)
+    uids = np.repeat(np.arange(n_users), n // n_users)
+    rng.shuffle(uids)
+    w_true = (rng.normal(size=(n_users, d)) * 0.3).astype(np.float32)
+    margins = np.array([vals[i] @ w_true[uids[i], idx[i]] for i in range(n)])
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (np.repeat(np.arange(n), k), idx.ravel()), vals.ravel())
+    return idx, vals, dense, uids, y, d
+
+
+def _re_coordinate(features, uids, y, d, **cfg_kw):
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.game.config import RandomEffectConfig
+
+    cfg = RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                             solver=SolverConfig(max_iters=25),
+                             reg=Regularization(l2=1.0), **cfg_kw)
+    gd = GameData(y=y, features={"u": features}, id_tags={"userId": uids})
+    return build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION), gd
+
+
+def test_sparse_re_parity_vs_densified_and_hbm():
+    """Sparse-shard RE fit == densified-shard RE fit (coefficients, scores),
+    with the compact bucket design blocks a small fraction of the densified
+    ones (the HBM claim: observed-columns width, not vocabulary width)."""
+    idx, vals, dense, uids, y, d = _sparse_re_data()
+    cs, _ = _re_coordinate(SparseShard(indices=idx, values=vals, dim=d),
+                           uids, y, d)
+    cd, _ = _re_coordinate(dense, uids, y, d)
+    off = np.zeros(len(y), np.float32)
+    ms, _ = cs.update(off)
+    md, _ = cd.update(off)
+    assert ms.w_stack.shape == md.w_stack.shape == (32, d)
+    np.testing.assert_allclose(ms.w_stack, md.w_stack, atol=5e-4)
+    np.testing.assert_allclose(cs.score(ms), cd.score(md), atol=5e-3)
+    sparse_bytes = sum(b.x.nbytes for b in cs.buckets.buckets)
+    dense_bytes = sum(b.x.nbytes for b in cd.buckets.buckets)
+    # 1024 rows * 8 nnz / 32 users -> <=256 observed columns vs d=2048:
+    # compact blocks must be at least 4x smaller here (8x at these shapes)
+    assert dense_bytes >= 4 * sparse_bytes, (sparse_bytes, dense_bytes)
+
+
+def test_sparse_re_fused_sweep_matches_host():
+    """A GAME descent (fixed + sparse RE) agrees between the fused program
+    and the host loop, and validation scoring consumes the sparse shard."""
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import CoordinateDescent
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.game.estimator import GameEstimator, GameTransformer
+    from photon_ml_tpu.game.fused import FusedSweep
+    from photon_ml_tpu.opt.types import SolverConfig
+
+    idx, vals, dense, uids, y, d = _sparse_re_data(n=1024, d=1024, n_users=32)
+    rng = np.random.default_rng(0)
+    xg = rng.normal(size=(len(y), 8)).astype(np.float32)
+    cut = 768
+    def gd(sl):
+        return GameData(y=y[sl], features={
+            "g": xg[sl],
+            "u": SparseShard(indices=idx[sl], values=vals[sl], dim=d)},
+            id_tags={"userId": uids[sl]})
+    tr, va = gd(slice(None, cut)), gd(slice(cut, None))
+    solver = SolverConfig(max_iters=25)
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION, num_outer_iterations=2,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "per-user": __import__("photon_ml_tpu.game.config", fromlist=["RandomEffectConfig"]).RandomEffectConfig(
+                random_effect_type="userId", feature_shard="u", solver=solver,
+                reg=Regularization(l2=1.0))})
+    est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
+    coords = {cid: est.build_one_coordinate(cid, tr, c, config.task, 0)
+              for cid, c in config.coordinates.items()}
+    model_f, _ = FusedSweep(coords, num_iterations=2).run()
+    model_h, _, _ = CoordinateDescent(coords, num_iterations=2).run()
+    suite = est.validation_suite
+    auc_f = GameTransformer(model_f, config.task).evaluate(va, suite).values["auc"]
+    auc_h = GameTransformer(model_h, config.task).evaluate(va, suite).values["auc"]
+    assert abs(auc_f - auc_h) < 2e-3
+    np.testing.assert_allclose(model_f["per-user"].w_stack,
+                               model_h["per-user"].w_stack, atol=5e-4)
+
+
+def test_sparse_re_pearson_ratio_and_normalization():
+    """INDEX_MAP + features_to_samples_ratio prunes each entity to its top-k
+    |Pearson| observed columns (intercept pinned); factor normalization rides
+    the per-lane compact space and round-trips to original-space models."""
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.config import RandomEffectConfig
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import ProjectorType
+
+    # small vocabulary so every observed column has MANY nonzero rows per
+    # entity — near-tied Pearson scores (e.g. single-observation columns)
+    # break differently under float32 between the two paths, which is
+    # tie-order ambiguity, not a correctness signal
+    idx, vals, dense, uids, y, d = _sparse_re_data(n=512, d=64, n_users=8)
+    # intercept column d-1 on every row
+    idx[:, -1] = d - 1
+    vals[:, -1] = 1.0
+    dense[:, :] = 0.0
+    np.add.at(dense, (np.repeat(np.arange(len(y)), idx.shape[1]),
+                      idx.ravel()), vals.ravel())
+    cfg = dict(random_effect_type="userId", feature_shard="u",
+               solver=SolverConfig(max_iters=25), reg=Regularization(l2=1.0),
+               projector=ProjectorType.INDEX_MAP,
+               features_to_samples_ratio=0.25, intercept_index=d - 1)
+    gd_s = GameData(y=y, features={"u": SparseShard(indices=idx, values=vals,
+                                                    dim=d)},
+                    id_tags={"userId": uids})
+    gd_d = GameData(y=y, features={"u": dense}, id_tags={"userId": uids})
+    cs = build_coordinate("u", gd_s, RandomEffectConfig(**cfg),
+                          TaskType.LOGISTIC_REGRESSION)
+    cd = build_coordinate("u", gd_d, RandomEffectConfig(**cfg),
+                          TaskType.LOGISTIC_REGRESSION)
+    # identical per-entity observed-column selections (sparse builds them
+    # straight from COO rows; dense scans the densified block)
+    for ps, pd in zip(cs._proj.projections, cd._proj.projections):
+        sel_s = [set(r[r >= 0].tolist()) for r in ps.indices]
+        sel_d = [set(r[r >= 0].tolist()) for r in pd.indices]
+        assert sel_s == sel_d
+        assert all(d - 1 in s for s, lanes in zip(sel_s, ps.indices)
+                   if lanes[0] >= 0)  # intercept survives on real lanes
+    off = np.zeros(len(y), np.float32)
+    ms, _ = cs.update(off)
+    md, _ = cd.update(off)
+    np.testing.assert_allclose(ms.w_stack, md.w_stack, atol=5e-4)
+
+    # factor-only normalization: sparse matches the densified INDEX_MAP path
+    fac = np.linspace(0.5, 2.0, d).astype(np.float32)
+    norm = NormalizationContext(factors=fac, shifts=None)
+    cs_n = build_coordinate("u", gd_s, RandomEffectConfig(**cfg),
+                            TaskType.LOGISTIC_REGRESSION, norm=norm)
+    cd_n = build_coordinate("u", gd_d, RandomEffectConfig(**cfg),
+                            TaskType.LOGISTIC_REGRESSION, norm=norm)
+    ms_n, _ = cs_n.update(off)
+    md_n, _ = cd_n.update(off)
+    np.testing.assert_allclose(ms_n.w_stack, md_n.w_stack, atol=5e-4)
+
+
+def test_sparse_re_unsupported_configs_raise():
+    from photon_ml_tpu.game.config import RandomEffectConfig
+    from photon_ml_tpu.types import ProjectorType, VarianceComputationType
+
+    idx, vals, dense, uids, y, d = _sparse_re_data(n=256, d=256, n_users=8)
+    shard = SparseShard(indices=idx, values=vals, dim=d)
+    with pytest.raises(NotImplementedError, match="RANDOM"):
+        _re_coordinate(shard, uids, y, d, projector=ProjectorType.RANDOM,
+                       projected_dim=16)
+    with pytest.raises((NotImplementedError, ValueError), match="variance"):
+        _re_coordinate(shard, uids, y, d,
+                       variance=VarianceComputationType.SIMPLE)
