@@ -326,6 +326,10 @@ def _run(args, task, t_start, emitter) -> int:
             if not isinstance(spec.template, FixedEffectConfig)
             and (spec.template.projector == ProjectorType.RANDOM
                  or spec.template.variance != VarianceComputationType.NONE
+                 # projected.dim on a non-RANDOM projector was silently
+                 # ignored on the dense path; the sparse path rejects it —
+                 # keep such configs dense rather than break them
+                 or spec.template.projected_dim is not None
                  # constraints are still the UNRESOLVED @file here (they
                  # resolve later, against the index maps) — the spec field
                  # is the truth at this point, not template.constraints

@@ -578,19 +578,26 @@ class RandomEffectCoordinate(Coordinate):
         #                          entity's compact space (the RDD case) —
         #                          here: per-lane gathered factor arrays that
         #                          ride the vmapped solve as traced leaves;
-        #   RANDOM projector    -> unsupported (the reference pushes the
-        #                          context through the Gaussian matrix; the
-        #                          factor algebra does not survive it here).
-        if norm is not None and config.projector == ProjectorType.RANDOM:
-            raise NotImplementedError(
-                f"coordinate {coordinate_id!r}: normalization under a RANDOM "
-                "projection is not supported (no exact per-entity context)")
+        #   RANDOM projector    -> the context pushed through the Gaussian
+        #                          matrix, shared by every entity (reference
+        #                          ProjectionMatrixBroadcast
+        #                          .projectNormalizationContext:102-112);
+        #                          shifts need the intercept pass-through
+        #                          slot (intercept_index set).
         if (norm is not None and norm.shifts is not None
-                and config.projector != ProjectorType.IDENTITY):
+                and config.projector == ProjectorType.RANDOM
+                and config.intercept_index is None):
+            raise ValueError(
+                f"coordinate {coordinate_id!r}: shift normalization under a "
+                "RANDOM projection needs intercept_index — the Gaussian "
+                "matrix then carries the reference's intercept pass-through "
+                "slot (ProjectionMatrix.scala:112-120)")
+        if (norm is not None and norm.shifts is not None
+                and config.projector == ProjectorType.INDEX_MAP):
             raise NotImplementedError(
                 f"coordinate {coordinate_id!r}: shift normalization needs a "
-                "stable intercept column — only the IDENTITY projector "
-                "keeps one")
+                "stable intercept column, which per-entity INDEX_MAP "
+                "compaction does not keep — use IDENTITY or RANDOM")
         self._norm = None
         if norm is not None and (norm.factors is not None
                                  or norm.shifts is not None):
@@ -754,18 +761,19 @@ class RandomEffectCoordinate(Coordinate):
                  valid=put(b.rows >= 0))
             for b in solve_buckets
         ]
-        # INDEX_MAP + normalization: project the coordinate context into each
-        # entity's compact space (the reference's per-REId contexts) — gather
-        # the factor vector through every lane's column map; padded slots get
-        # the identity factor 1.
+        # INDEX_MAP/sparse + normalization: project the coordinate context
+        # into each entity's compact space (the reference's per-REId
+        # contexts) — gather the factor vector through every lane's column
+        # map; padded slots get the identity factor 1.  (RANDOM instead
+        # shares ONE projected context, baked by _bind_solver.)
         self._norm_fac_dev = None
-        if self._norm is not None and self._proj is not None:
+        if self._norm_per_lane:
             from photon_ml_tpu.parallel.projection import BucketProjection
 
             fac = np.asarray(self._norm.factors, self._dtype)
             lanes_fac = []
             for p in self._proj.projections:
-                assert isinstance(p, BucketProjection)  # RANDOM rejected above
+                assert isinstance(p, BucketProjection)
                 safe = np.where(p.indices < 0, 0, p.indices)
                 lanes_fac.append(np.where(p.indices >= 0, fac[safe],
                                           1.0).astype(self._dtype))
@@ -776,11 +784,27 @@ class RandomEffectCoordinate(Coordinate):
         # shared-context normalization (IDENTITY projector) bakes into the
         # objective; per-lane contexts (INDEX_MAP, and any sparse shard —
         # whose solve space is always compact) enter the vmapped solve as
-        # traced factor arrays instead (see _vsolve below)
+        # traced factor arrays instead (see _vsolve below); a RANDOM
+        # projection shares ONE context pushed through the Gaussian matrix
+        # (reference ProjectionMatrixBroadcast
+        # .projectNormalizationContext:102-112), baked like IDENTITY's
         shared_norm = (self._norm if self._norm is not None
                        and self.config.projector == ProjectorType.IDENTITY
                        and not self._sparse
                        else None)
+        self._norm_proj = None
+        self._norm_proj_intercept = None
+        if (self._norm is not None
+                and self.config.projector == ProjectorType.RANDOM):
+            rp = self._proj.projections[0]  # shared across buckets
+            ctx, p_ii = rp.project_normalization(self._norm)
+            self._norm_proj = NormalizationContext(
+                factors=None if ctx.factors is None
+                else jnp.asarray(ctx.factors, self._dtype),
+                shifts=None if ctx.shifts is None
+                else jnp.asarray(ctx.shifts, self._dtype))
+            self._norm_proj_intercept = p_ii
+            shared_norm = self._norm_proj
         objective = GLMObjective(loss=loss_for_task(self.task), reg=self.config.reg,
                                  norm=shared_norm or no_normalization())
         self._objective = objective
@@ -904,8 +928,10 @@ class RandomEffectCoordinate(Coordinate):
                 w0 = np.where(proj.indices >= 0,
                               np.take_along_axis(w0, safe, axis=1), 0.0)
             else:
-                # Gaussian projection has no exact inverse; restart cold.
-                w0 = np.zeros((b.num_lanes, proj.d_proj), self._dtype)
+                # Gaussian projection has no exact inverse; restart cold
+                # (zeros are zeros under any normalization of the projected
+                # space, so no transformed-space mapping applies either)
+                return np.zeros((b.num_lanes, proj.d_proj), self._dtype)
         if self._norm is not None:
             # published models are ORIGINAL-space; solves run transformed
             # (same convention as the fixed effect's update())
@@ -931,6 +957,16 @@ class RandomEffectCoordinate(Coordinate):
             fac = (norm_fac if norm_fac is not None
                    else self._norm_fac_dev)[bucket_index]
             return lanes * fac
+        if self._norm_proj is not None:
+            # RANDOM projection: the model leaves the solver in the
+            # TRANSFORMED PROJECTED space; the projected context (with its
+            # pass-through intercept slot) maps it to the original projected
+            # space, and back-projection to full dim happens afterwards —
+            # the reference order (createModel in projected space, then
+            # projectCoefficientsRDD)
+            ii = self._norm_proj_intercept
+            return jax.vmap(
+                lambda w: self._norm_proj.model_to_original_space(w, ii))(lanes)
         ii = self.config.intercept_index
         return jax.vmap(
             lambda w: self._norm.model_to_original_space(w, ii))(lanes)

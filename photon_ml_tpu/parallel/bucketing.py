@@ -131,6 +131,13 @@ def _group_rows(
     return kept_rows, kept_entities, rescale
 
 
+def _capacity_classes(kept_rows: List[np.ndarray]) -> np.ndarray:
+    """Per-entity bucket capacity: next power of two of the active count —
+    ONE rounding rule for the dense and sparse bucketers."""
+    return np.asarray([max(1, 1 << (len(r) - 1).bit_length())
+                       for r in kept_rows])
+
+
 def _pack_lane_meta(n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
                     y, offset, weight, dtype, lane_of, bucket_index):
     """Fill one capacity class's NON-design lane arrays (labels, offsets,
@@ -192,7 +199,7 @@ def bucket_by_entity(
         entity_ids, active_cap, min_active_samples, seed)
 
     # Capacity classes: next power of two of the active count.
-    caps = np.asarray([max(1, 1 << (len(r) - 1).bit_length()) for r in kept_rows])
+    caps = _capacity_classes(kept_rows)
     buckets: List[Bucket] = []
     lane_of: Dict[int, Tuple[int, int]] = {}
     for cap in sorted(set(caps.tolist())):
@@ -253,6 +260,7 @@ def bucket_by_entity_sparse(
     vocabulary (``EntityBuckets.dim`` stays the FULL dimension).
     """
     from photon_ml_tpu.parallel.projection import (BucketProjection,
+                                                   _pow2_at_least,
                                                    pearson_top_k)
 
     n = len(entity_ids)
@@ -283,15 +291,15 @@ def bucket_by_entity_sparse(
                 obs, x = obs[top], x[:, top]
         return obs.astype(np.int32), x
 
-    caps = np.asarray([max(1, 1 << (len(r) - 1).bit_length()) for r in kept_rows])
+    caps = _capacity_classes(kept_rows)
     buckets: List[Bucket] = []
     projections: List[object] = []
     lane_of: Dict[int, Tuple[int, int]] = {}
     for cap in sorted(set(caps.tolist())):
         idxs = np.nonzero(caps == cap)[0]
         compacted = [_compact_lane(kept_rows[ei]) for ei in idxs]
-        d_proj = max(1, 1 << (max((len(o) for o, _ in compacted), default=1) - 1)
-                     .bit_length())
+        d_proj = _pow2_at_least(max((len(o) for o, _ in compacted),
+                                    default=1))
         d_proj = min(d_proj, dim)
         n_lanes = ((len(idxs) + lane_multiple - 1) // lane_multiple) * lane_multiple
         by, boff, bw, brows, bcounts, blanes = _pack_lane_meta(

@@ -124,9 +124,18 @@ class BucketProjection:
 
 @dataclasses.dataclass
 class RandomProjection:
-    """Shared Gaussian projection (reference ProjectionMatrix.scala:127)."""
+    """Shared Gaussian projection (reference ProjectionMatrix.scala:127).
+
+    ``intercept_index``: original-space intercept column, when the matrix
+    carries the reference's intercept pass-through (an extra projected slot
+    that copies the intercept exactly — the "dummy row" of
+    ProjectionMatrix.buildGaussianRandomProjectionMatrix:112-120, a column
+    here under the transposed [d_full, d_proj] convention).  The projected
+    intercept is then the LAST projected coordinate
+    (ProjectionMatrix.scala:43 projectedInterceptId)."""
 
     matrix: np.ndarray  # [d_full, d_proj]
+    intercept_index: Optional[int] = None
 
     @property
     def d_full(self) -> int:
@@ -136,18 +145,55 @@ class RandomProjection:
     def d_proj(self) -> int:
         return self.matrix.shape[1]
 
+    @property
+    def projected_intercept(self) -> Optional[int]:
+        return None if self.intercept_index is None else self.d_proj - 1
+
     def project_x(self, x: np.ndarray) -> np.ndarray:
         return (x @ self.matrix).astype(x.dtype)
 
     def back_project(self, w_proj: np.ndarray) -> np.ndarray:
         return (np.asarray(w_proj) @ self.matrix.T).astype(w_proj.dtype)
 
+    def project_normalization(self, norm) -> tuple:
+        """Reference ProjectionMatrixBroadcast.projectNormalizationContext
+        (:102-112): push factors AND shifts through projectFeatures; the
+        projected intercept id is the pass-through slot.  Returns
+        ``(projected NormalizationContext, projected intercept index)``."""
+        from photon_ml_tpu.core.normalization import NormalizationContext
+
+        fac = (None if norm.factors is None
+               else (np.asarray(norm.factors) @ self.matrix).astype(
+                   self.matrix.dtype))
+        shifts = (None if norm.shifts is None
+                  else (np.asarray(norm.shifts) @ self.matrix).astype(
+                      self.matrix.dtype))
+        return (NormalizationContext(factors=fac, shifts=shifts),
+                self.projected_intercept)
+
 
 def build_random_projection(d_full: int, d_proj: int, seed: int = 0,
-                            dtype=np.float32) -> RandomProjection:
+                            dtype=np.float32,
+                            intercept_index: Optional[int] = None
+                            ) -> RandomProjection:
+    """``intercept_index``: append the intercept pass-through slot (the
+    reference builds every random-effect projection with
+    isKeepingInterceptTerm=true, RandomEffectProjector.scala:80) — the
+    projected design gets d_proj+1 columns, the last being the original
+    intercept column copied exactly."""
     rng = np.random.default_rng(seed)
     m = rng.normal(scale=1.0 / np.sqrt(d_proj), size=(d_full, d_proj))
-    return RandomProjection(matrix=m.astype(dtype))
+    m = m.astype(dtype)
+    if intercept_index is not None:
+        e = np.zeros((d_full, 1), dtype)
+        e[intercept_index, 0] = 1.0
+        # zero the Gaussian mass on the intercept column so the pass-through
+        # slot is the ONLY place its signal lands (the reference's dummy row
+        # coexists with Gaussian rows that also see the intercept; zeroing
+        # keeps the projected intercept exact AND non-duplicated)
+        m[intercept_index, :] = 0.0
+        m = np.concatenate([m, e], axis=1)
+    return RandomProjection(matrix=m, intercept_index=intercept_index)
 
 
 def build_observed_indices(
@@ -220,11 +266,10 @@ def project_buckets(
     """Apply a ProjectorType to every bucket (host-side, one-time layout)."""
     if kind == ProjectorType.IDENTITY:
         raise ValueError("IDENTITY projection needs no ProjectedBuckets")
-    if kind == ProjectorType.RANDOM and (features_to_samples_ratio is not None
-                                         or intercept_index is not None):
+    if kind == ProjectorType.RANDOM and features_to_samples_ratio is not None:
         raise ValueError(
-            "features_to_samples_ratio / intercept_index apply only to "
-            "INDEX_MAP projection; RANDOM would silently ignore them")
+            "features_to_samples_ratio applies only to INDEX_MAP projection; "
+            "RANDOM would silently ignore it")
     if kind == ProjectorType.INDEX_MAP and projected_dim is not None:
         raise ValueError(
             "projected_dim applies only to RANDOM projection; INDEX_MAP "
@@ -241,7 +286,8 @@ def project_buckets(
                 raise ValueError("RANDOM projection requires projected_dim")
             if shared is None:
                 shared = build_random_projection(buckets.dim, projected_dim, seed,
-                                                 dtype=b.x.dtype)
+                                                 dtype=b.x.dtype,
+                                                 intercept_index=intercept_index)
             proj = shared
         else:
             raise ValueError(f"unknown projector {kind!r}")

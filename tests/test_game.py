@@ -1186,10 +1186,21 @@ def test_random_effect_normalization_rejections(rng):
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
                                projector=ProjectorType.INDEX_MAP),
             TaskType.LOGISTIC_REGRESSION, norm=norm_shift)
-    with pytest.raises(NotImplementedError, match="RANDOM"):
+    # factor normalization under RANDOM projection is SUPPORTED (round 3):
+    # the context is pushed through the Gaussian matrix and shared
+    # (ProjectionMatrixBroadcast.projectNormalizationContext; full parity
+    # coverage in tests/test_projection.py) — only shift normalization
+    # WITHOUT an intercept_index still refuses
+    coord = build_coordinate(
+        "u", data,
+        RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                           projector=ProjectorType.RANDOM, projected_dim=2),
+        TaskType.LOGISTIC_REGRESSION,
+        norm=NormalizationContext(factors=jnp.ones(4) * 2.0, shifts=None))
+    assert coord._norm_proj is not None
+    with pytest.raises(ValueError, match="intercept_index"):
         build_coordinate(
             "u", data,
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
                                projector=ProjectorType.RANDOM, projected_dim=2),
-            TaskType.LOGISTIC_REGRESSION,
-            norm=NormalizationContext(factors=jnp.ones(4), shifts=None))
+            TaskType.LOGISTIC_REGRESSION, norm=norm_shift)

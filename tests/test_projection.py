@@ -146,3 +146,105 @@ def test_project_buckets_requires_dim_for_random(rng):
     with pytest.raises(ValueError, match="INDEX_MAP"):
         project_buckets(buckets, ProjectorType.RANDOM, projected_dim=4,
                         features_to_samples_ratio=0.5)
+
+
+def test_random_projection_normalization_parity():
+    """Normalization under RANDOM projection: the coordinate context is
+    pushed through the Gaussian matrix and shared by every entity
+    (reference ProjectionMatrixBroadcast.projectNormalizationContext:102-112,
+    intercept pass-through ProjectionMatrix.scala:112-120).  Must equal the
+    reference-order manual computation: project design + context by hand,
+    solve per-entity in the projected space (IDENTITY path), back-project."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import GameData
+    from photon_ml_tpu.game.config import RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.parallel.projection import build_random_projection
+    from photon_ml_tpu.types import ProjectorType, TaskType
+
+    rng = np.random.default_rng(9)
+    n, d, n_users, d_proj = 512, 48, 8, 12
+    x = rng.normal(size=(n, d)).astype(np.float32) * np.linspace(
+        0.5, 3.0, d).astype(np.float32)
+    x[:, -1] = 1.0  # intercept column
+    uids = np.repeat(np.arange(n_users), n // n_users)
+    rng.shuffle(uids)
+    wu = (rng.normal(size=(n_users, d)) * 0.4).astype(np.float32)
+    margins = np.einsum("nd,nd->n", x, wu[uids])
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+
+    fac = (1.0 / np.maximum(x.std(axis=0), 1e-6)).astype(np.float32)
+    fac[-1] = 1.0
+    shifts = x.mean(axis=0).astype(np.float32)
+    shifts[-1] = 0.0
+    norm = NormalizationContext(factors=fac, shifts=shifts)
+
+    solver = SolverConfig(max_iters=40, tolerance=1e-8)
+    cfg = RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                             solver=solver, reg=Regularization(l2=1.0),
+                             projector=ProjectorType.RANDOM,
+                             projected_dim=d_proj, intercept_index=d - 1)
+    gd = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    c = build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION,
+                         norm=norm, seed=3)
+    m, _ = c.update(np.zeros(n, np.float32))
+
+    rp = build_random_projection(d, d_proj, seed=3, dtype=np.float32,
+                                 intercept_index=d - 1)
+    ctx, p_ii = rp.project_normalization(norm)
+    x_p = rp.project_x(x)
+    np.testing.assert_allclose(x_p[:, -1], x[:, -1])  # intercept exact
+    cfg_id = RandomEffectConfig(random_effect_type="userId",
+                                feature_shard="u", solver=solver,
+                                reg=Regularization(l2=1.0),
+                                intercept_index=p_ii)
+    gd_p = GameData(y=y, features={"u": x_p}, id_tags={"userId": uids})
+    c2 = build_coordinate("u", gd_p, cfg_id, TaskType.LOGISTIC_REGRESSION,
+                          norm=NormalizationContext(factors=ctx.factors,
+                                                    shifts=ctx.shifts),
+                          seed=3)
+    m2, _ = c2.update(np.zeros(n, np.float32))
+    w_manual = rp.back_project(m2.w_stack)
+    np.testing.assert_allclose(m.w_stack, w_manual, atol=1e-4)
+
+    # the context is load-bearing: dropping it changes the solution
+    c_raw = build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION,
+                             seed=3)
+    m_raw, _ = c_raw.update(np.zeros(n, np.float32))
+    assert np.max(np.abs(m_raw.w_stack - m.w_stack)) > 1e-3
+
+    # fused sweep path publishes the same model (trace_publish order:
+    # transformed->original projected space, then back-projection)
+    state = c.init_sweep_state()
+    state, _score = c.trace_update(state, jnp.zeros(n, jnp.float32))
+    w_fused = np.asarray(c.trace_publish(state))
+    np.testing.assert_allclose(w_fused, m.w_stack, atol=1e-4)
+
+
+def test_random_projection_shift_requires_intercept():
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import GameData
+    from photon_ml_tpu.game.config import RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import ProjectorType, TaskType
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    uids = np.repeat(np.arange(4), 16)
+    y = (rng.random(64) < 0.5).astype(np.float32)
+    norm = NormalizationContext(factors=None,
+                                shifts=x.mean(axis=0).astype(np.float32))
+    cfg = RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                             solver=SolverConfig(max_iters=5),
+                             reg=Regularization(l2=1.0),
+                             projector=ProjectorType.RANDOM, projected_dim=4)
+    gd = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    with pytest.raises(ValueError, match="intercept_index"):
+        build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION,
+                         norm=norm)
