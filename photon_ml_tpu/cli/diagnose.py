@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.core.batch import DenseBatch, dense_batch
+from photon_ml_tpu.core.batch import dense_batch
 from photon_ml_tpu.core.losses import loss_for_task
 from photon_ml_tpu.core.objective import GLMObjective
 from photon_ml_tpu.core.regularization import Regularization
@@ -36,7 +36,8 @@ from photon_ml_tpu.diagnostics import (bootstrap_training, expected_magnitude_im
                                        fitting_diagnostic, hosmer_lemeshow,
                                        kendall_tau_analysis, render_html, render_text,
                                        variance_importance)
-from photon_ml_tpu.diagnostics.reporting import Chapter, Document, Plot, Table, Text
+from photon_ml_tpu.diagnostics.reporting import (Bars, Bullets, Document,
+                                                 Plot, Scatter, Table, Text)
 from photon_ml_tpu.models.glm import Coefficients, GLMModel
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.storage.model_io import load_game_model
@@ -79,11 +80,6 @@ def _load_dir(model_dir):
     return model, task, index_maps, entity_indexes
 
 
-def _dense_batch(data, shard: str) -> DenseBatch:
-    return dense_batch(data.features[shard], data.y, data.offset, data.weight,
-                       dtype=np.float64)
-
-
 def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
@@ -93,20 +89,25 @@ def run(argv: List[str]) -> int:
     enable_compilation_cache()
     model, task, index_maps, entity_indexes = _load_dir(args.model_dir)
 
-    from photon_ml_tpu.models.game import FixedEffectModel
+    from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
 
     fixed = {cid: m for cid, m in model.models.items()
              if isinstance(m, FixedEffectModel)}
+    random_effects = {cid: m for cid, m in model.models.items()
+                      if isinstance(m, RandomEffectModel)}
     if not fixed:
         logger.error("no fixed-effect coordinate in the model")
         return 1
-    cid = args.coordinate or next(iter(fixed))
-    if cid not in fixed:
-        logger.error("coordinate %r not found (have: %s)", cid, sorted(fixed))
-        return 1
-    fe = fixed[cid]
-    shard = fe.feature_shard
-    imap = index_maps[shard]
+    if args.coordinate:
+        if args.coordinate not in model.models:
+            logger.error("coordinate %r not found (have: %s)",
+                         args.coordinate, sorted(model.models))
+            return 1
+        # restrict the per-coordinate chapters to the selection (full-model
+        # calibration/residual chapters still cover the whole model)
+        fixed = {k: v for k, v in fixed.items() if k == args.coordinate}
+        random_effects = {k: v for k, v in random_effects.items()
+                          if k == args.coordinate}
     loss = loss_for_task(task)
 
     id_tags = sorted(entity_indexes)
@@ -120,8 +121,14 @@ def run(argv: List[str]) -> int:
     data, _ = read_game_data_avro(args.data, index_maps, id_tag_names=id_tags,
                                   input_columns=input_columns,
                                   entity_indexes=entity_indexes)
-    batch = _dense_batch(data, shard)
-    logger.info("diagnosing coordinate %r on %d samples", cid, data.num_samples)
+    holdout_data = None
+    if args.holdout:
+        holdout_data, _ = read_game_data_avro(args.holdout, index_maps,
+                                              input_columns=input_columns,
+                                              id_tag_names=id_tags,
+                                              entity_indexes=entity_indexes)
+    logger.info("diagnosing %d fixed + %d random coordinate(s) on %d samples",
+                len(fixed), len(random_effects), data.num_samples)
 
     obj = GLMObjective(loss=loss, reg=Regularization(l2=args.l2))
     solve = jax.jit(make_solver(obj))
@@ -136,59 +143,152 @@ def run(argv: List[str]) -> int:
         l = np.asarray(loss.loss(jnp.asarray(z), b.y))
         return float((w * l).sum() / max(w.sum(), 1e-12))
 
-    doc = Document(f"Diagnostics: coordinate {cid!r} ({task.value})")
+    doc = Document(f"Model diagnostics ({task.value})")
+    summary: dict = {"task": task.value, "coordinates": {}}
 
-    def _label(j: int) -> str:
-        nm = imap.get_feature_name(int(j))
-        return f"{nm[0]}:{nm[1]}" if nm else str(j)
+    # per-coordinate raw scores on the training data — each coordinate is
+    # diagnosed against the RESIDUAL of the others (the descent's partial
+    # score, CoordinateDescent.scala:197-204), and calibration/residual
+    # chapters use the FULL model
+    coord_scores = {cid: np.asarray(m.score(data), np.float64)
+                    for cid, m in model.models.items()}
+    total_score = np.sum(list(coord_scores.values()), axis=0)
+    base_offset = np.asarray(data.offset, np.float64)
+    holdout_scores = ({cid: np.asarray(m.score(holdout_data), np.float64)
+                       for cid, m in model.models.items()}
+                      if holdout_data is not None else None)
 
-    names = [_label(j) for j in range(batch.dim)]
+    # ---- chapter: model summary (index + inventory) ----
+    ch = doc.chapter("Model summary")
+    inventory = []
+    for mcid, m in model.models.items():
+        if isinstance(m, FixedEffectModel):
+            inventory.append(
+                f"{mcid}: fixed effect on shard {m.feature_shard!r}, "
+                f"{len(m.coefficients.means)} coefficients")
+        else:
+            inventory.append(
+                f"{mcid}: random effect per {m.random_effect_type!r} on shard "
+                f"{m.feature_shard!r}, {m.num_entities} entities x "
+                f"{m.w_stack.shape[1]} coefficients")
+    ch.section("Coordinates").add(Bullets(inventory))
+    ch.section("Data").add(Bullets([
+        f"training samples: {data.num_samples}",
+        f"holdout samples: {holdout_data.num_samples if holdout_data else 0}",
+        f"diagnostic re-train L2: {args.l2}",
+    ]))
 
-    # 1. bootstrap confidence intervals (BootstrapTraining.scala:29-181)
-    report = bootstrap_training(train_fn, batch, num_replicates=args.bootstrap_replicates,
-                                metrics={"mean_loss": lambda m: point_metric(m, batch)},
-                                seed=args.seed)
-    ch = doc.chapter("Bootstrap")
-    sec = ch.section(f"Coefficient {95.0:.0f}% intervals ({args.bootstrap_replicates} replicates)")
-    rows = []
-    order = np.argsort(-np.abs(report.coefficient_means))[: args.top_k]
-    for j in order:
-        lo, hi = report.coefficient_intervals[j]
-        rows.append([names[j], f"{report.coefficient_means[j]:.5g}",
-                     f"{lo:.5g}", f"{hi:.5g}"])
-    sec.add(Table(["feature", "mean", "lo", "hi"], rows))
-    mean, std = report.metric_summary()["mean_loss"]
-    sec.add(Text(f"bootstrap mean loss: {mean:.6g} ± {std:.3g}"))
+    # ---- per-fixed-coordinate chapters ----
+    for cid, fe in fixed.items():
+        shard = fe.feature_shard
+        imap = index_maps[shard]
+        residual = total_score - coord_scores[cid]
+        batch = dense_batch(data.features[shard], data.y,
+                            base_offset + residual, data.weight,
+                            dtype=np.float64)
 
-    # 2. learning curve (FittingDiagnostic.scala:33-131)
-    fit_payload = None
-    if args.holdout:
-        holdout_data, _ = read_game_data_avro(args.holdout, index_maps,
-                                              input_columns=input_columns,
-                                              id_tag_names=id_tags,
-                                              entity_indexes=entity_indexes)
-        fit = fitting_diagnostic(train_fn, {"mean_loss": point_metric}, batch,
-                                 _dense_batch(holdout_data, shard), seed=args.seed)
-        sec = doc.chapter("Fitting").section("Learning curve (train vs holdout)")
-        sec.add(Plot("mean loss vs training fraction", list(fit.fractions),
+        def _label(j: int) -> str:
+            nm = imap.get_feature_name(int(j))
+            return f"{nm[0]}:{nm[1]}" if nm else str(j)
+
+        names = [_label(j) for j in range(batch.dim)]
+        ch = doc.chapter(f"Coordinate {cid!r} (fixed effect)")
+        cs: dict = {}
+
+        # 1. bootstrap confidence intervals (BootstrapTraining.scala:29-181)
+        report = bootstrap_training(
+            train_fn, batch, num_replicates=args.bootstrap_replicates,
+            metrics={"mean_loss": lambda m: point_metric(m, batch)},
+            seed=args.seed)
+        sec = ch.section(f"Bootstrap 95% coefficient intervals "
+                         f"({args.bootstrap_replicates} replicates)")
+        order = np.argsort(-np.abs(report.coefficient_means))[: args.top_k]
+        sec.add(Table(["feature", "mean", "lo", "hi"],
+                      [[names[j], f"{report.coefficient_means[j]:.5g}",
+                        f"{report.coefficient_intervals[j][0]:.5g}",
+                        f"{report.coefficient_intervals[j][1]:.5g}"]
+                       for j in order]))
+        sec.add(Plot("coefficient mean and 95% interval by |mean| rank",
+                     list(range(len(order))),
+                     {"mean": [float(report.coefficient_means[j]) for j in order],
+                      "lo": [float(report.coefficient_intervals[j][0]) for j in order],
+                      "hi": [float(report.coefficient_intervals[j][1]) for j in order]},
+                     x_label="rank"))
+        mean, std = report.metric_summary()["mean_loss"]
+        sec.add(Text(f"bootstrap mean loss: {mean:.6g} ± {std:.3g}"))
+        cs["bootstrap"] = {"replicates": report.num_replicates,
+                           "mean_loss": [mean, std]}
+
+        # 2. learning curve (FittingDiagnostic.scala:33-131)
+        if holdout_data is not None:
+            h_residual = np.sum([s for ocid, s in holdout_scores.items()
+                                 if ocid != cid], axis=0) \
+                if len(holdout_scores) > 1 else \
+                np.zeros(holdout_data.num_samples, np.float64)
+            hbatch = dense_batch(holdout_data.features[shard], holdout_data.y,
+                                 np.asarray(holdout_data.offset, np.float64)
+                                 + h_residual,
+                                 holdout_data.weight, dtype=np.float64)
+            fit = fitting_diagnostic(train_fn, {"mean_loss": point_metric},
+                                     batch, hbatch, seed=args.seed)
+            ch.section("Learning curve (train vs holdout)").add(
+                Plot("mean loss vs training fraction", list(fit.fractions),
                      {"train": list(fit.train_metrics["mean_loss"]),
                       "holdout": list(fit.holdout_metrics["mean_loss"])},
                      x_label="fraction"))
-        fit_payload = {"fractions": fit.fractions.tolist(),
-                       "train": fit.train_metrics["mean_loss"].tolist(),
-                       "holdout": fit.holdout_metrics["mean_loss"].tolist()}
+            cs["fitting"] = {"fractions": fit.fractions.tolist(),
+                             "train": fit.train_metrics["mean_loss"].tolist(),
+                             "holdout": fit.holdout_metrics["mean_loss"].tolist()}
 
-    # predictions of the ACTUAL trained model for calibration/independence
-    margins = np.asarray(fe.coefficients.score(batch.x)) + np.asarray(batch.offset)
+        # 3. feature importance (featureimportance/*)
+        x_np = np.asarray(batch.x)
+        em = expected_magnitude_importance(np.asarray(fe.coefficients.means),
+                                           np.abs(x_np).mean(0), names, args.top_k)
+        vi = variance_importance(np.asarray(fe.coefficients.means),
+                                 x_np.var(0), names, args.top_k)
+        sec = ch.section("Feature importance")
+        sec.add(Bars("expected magnitude |w|*E|x|",
+                     [n for n, _ in em.ranked], [v for _, v in em.ranked]))
+        sec.add(Table(["feature", "importance"],
+                      [[n, f"{v:.5g}"] for n, v in em.ranked]))
+        sec.add(Bars("variance w^2*Var[x]",
+                     [n for n, _ in vi.ranked], [v for _, v in vi.ranked]))
+        sec.add(Table(["feature", "importance"],
+                      [[n, f"{v:.5g}"] for n, v in vi.ranked]))
+        summary["coordinates"][cid] = cs
+
+    # ---- per-random-coordinate chapters ----
+    for cid, re_model in random_effects.items():
+        ch = doc.chapter(f"Coordinate {cid!r} (random effect)")
+        norms = np.linalg.norm(np.asarray(re_model.w_stack, np.float64), axis=1)
+        qs = np.quantile(norms, [0.0, 0.25, 0.5, 0.75, 1.0]) if len(norms) else [0] * 5
+        ch.section("Per-entity coefficient norms").add(Table(
+            ["entities", "min", "p25", "median", "p75", "max"],
+            [[str(len(norms))] + [f"{q:.5g}" for q in qs]]))
+        hist, edges = np.histogram(norms, bins=min(16, max(4, len(norms) // 4 or 4)))
+        ch.sections[-1].add(Bars(
+            "entity count by ||w|| bin",
+            [f"[{edges[i]:.3g},{edges[i+1]:.3g})" for i in range(len(hist))],
+            hist.tolist()))
+        top = np.argsort(-norms)[: args.top_k]
+        inv = {v: k for k, v in re_model.slot_of.items()}
+        ch.section("Largest entities by ||w||").add(Table(
+            ["entity", "||w||"],
+            [[str(inv.get(int(j), int(j))), f"{norms[j]:.5g}"] for j in top]))
+        summary["coordinates"][cid] = {
+            "entities": int(len(norms)),
+            "norm_quantiles": [float(q) for q in qs],
+        }
+
+    # ---- full-model chapters: calibration + residual independence ----
+    margins = total_score + base_offset
     preds = np.asarray(loss.mean(jnp.asarray(margins)))
-    y = np.asarray(batch.y)
+    y = np.asarray(data.y, np.float64)
 
-    # 3. calibration (logistic only; HosmerLemeshowDiagnostic)
-    hl_payload = None
     if task == TaskType.LOGISTIC_REGRESSION:
         try:
-            hl = hosmer_lemeshow(preds, y, np.asarray(batch.weight))
-            sec = doc.chapter("Calibration").section("Hosmer-Lemeshow")
+            hl = hosmer_lemeshow(preds, y, np.asarray(data.weight))
+            sec = doc.chapter("Calibration (full model)").section("Hosmer-Lemeshow")
             sec.add(Text(f"chi2={hl.chi_square:.4f} df={hl.degrees_of_freedom} "
                          f"p={hl.p_value:.4g}"))
             sec.add(Table(["bin_lo", "bin_hi", "total", "obs+", "exp+"],
@@ -196,41 +296,34 @@ def run(argv: List[str]) -> int:
                             f"{hl.totals[i]:.1f}", f"{hl.observed_pos[i]:.1f}",
                             f"{hl.expected_pos[i]:.1f}"]
                            for i in range(len(hl.totals))]))
-            hl_payload = {"chi_square": hl.chi_square, "df": hl.degrees_of_freedom,
-                          "p_value": hl.p_value}
+            centers = [(hl.bin_edges[i] + hl.bin_edges[i + 1]) / 2
+                       for i in range(len(hl.totals))]
+            safe_tot = np.maximum(np.asarray(hl.totals), 1e-12)
+            sec.add(Plot("observed vs expected positive rate per bin", centers,
+                         {"observed": (np.asarray(hl.observed_pos) / safe_tot).tolist(),
+                          "expected": (np.asarray(hl.expected_pos) / safe_tot).tolist()},
+                         x_label="predicted probability bin"))
+            summary["hosmer_lemeshow"] = {"chi_square": hl.chi_square,
+                                          "df": hl.degrees_of_freedom,
+                                          "p_value": hl.p_value}
         except ValueError as e:
             logger.warning("Hosmer-Lemeshow skipped: %s", e)
 
-    # 4. feature importance (featureimportance/*)
-    x_np = np.asarray(batch.x)
-    em = expected_magnitude_importance(np.asarray(fe.coefficients.means),
-                                       np.abs(x_np).mean(0), names, args.top_k)
-    vi = variance_importance(np.asarray(fe.coefficients.means),
-                             x_np.var(0), names, args.top_k)
-    ch = doc.chapter("Feature importance")
-    ch.section("Expected magnitude |w|*E|x|").add(
-        Table(["feature", "importance"], [[n, f"{v:.5g}"] for n, v in em.ranked]))
-    ch.section("Variance w^2*Var[x]").add(
-        Table(["feature", "importance"], [[n, f"{v:.5g}"] for n, v in vi.ranked]))
-
-    # 5. residual independence (KendallTauAnalysis.scala)
     kt = kendall_tau_analysis(preds, y, seed=args.seed)
-    doc.chapter("Residuals").section("Kendall tau (prediction vs error)").add(
-        Text(kt.summary()))
+    sec = doc.chapter("Residuals (full model)").section(
+        "Kendall tau (prediction vs error)")
+    sec.add(Text(kt.summary()))
+    sub = np.random.default_rng(args.seed).permutation(len(preds))[:2000]
+    sec.add(Scatter("prediction vs residual", preds[sub].tolist(),
+                    (y - preds)[sub].tolist(),
+                    x_label="prediction", y_label="residual"))
+    summary["kendall_tau"] = {"tau": kt.tau, "p_value": kt.p_value}
 
     os.makedirs(args.output_dir, exist_ok=True)
     with open(os.path.join(args.output_dir, "report.html"), "w") as f:
         f.write(render_html(doc))
     with open(os.path.join(args.output_dir, "report.txt"), "w") as f:
         f.write(render_text(doc))
-    summary = {
-        "coordinate": cid,
-        "bootstrap": {"replicates": report.num_replicates,
-                      "mean_loss": [mean, std]},
-        "fitting": fit_payload,
-        "hosmer_lemeshow": hl_payload,
-        "kendall_tau": {"tau": kt.tau, "p_value": kt.p_value},
-    }
     with open(os.path.join(args.output_dir, "diagnostics.json"), "w") as f:
         json.dump(summary, f, indent=2)
     logger.info("report -> %s", os.path.join(args.output_dir, "report.html"))

@@ -36,7 +36,37 @@ class Plot:
     y_label: str = ""
 
 
-Item = Union[Text, Table, Plot]
+@dataclasses.dataclass
+class Bars:
+    """A horizontal bar chart: (label, value) pairs, e.g. feature importance
+    rankings (the reference renders these as JFreeChart bar plots —
+    reporting/PlotToHTMLRenderer; here inline SVG rects)."""
+
+    title: str
+    labels: List[str]
+    values: Sequence[float]
+    x_label: str = ""
+
+
+@dataclasses.dataclass
+class Scatter:
+    """A scatter plot, e.g. prediction-vs-residual clouds."""
+
+    title: str
+    x: Sequence[float]
+    y: Sequence[float]
+    x_label: str = ""
+    y_label: str = ""
+
+
+@dataclasses.dataclass
+class Bullets:
+    """A bulleted list (reference reporting/BulletedListPhysicalReport)."""
+
+    items: List[str]
+
+
+Item = Union[Text, Table, Plot, Bars, Scatter, Bullets]
 
 
 @dataclasses.dataclass
@@ -107,6 +137,61 @@ def _svg_plot(plot: Plot) -> str:
     return "".join(parts)
 
 
+def _svg_bars(item: Bars) -> str:
+    vals = [float(v) for v in item.values]
+    if not vals:
+        return "<svg/>"
+    n = len(vals)
+    row_h, label_w = 18, 180
+    w = 520
+    h = 40 + n * row_h
+    vmax = max(abs(v) for v in vals) or 1.0
+    bar_w = w - label_w - 60
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" height="{h}">',
+             f'<text x="{w//2}" y="16" text-anchor="middle" font-size="13">'
+             f"{html.escape(item.title)}</text>"]
+    for i, (label, v) in enumerate(zip(item.labels, vals)):
+        y = 28 + i * row_h
+        bw = abs(v) / vmax * bar_w
+        color = _COLORS[0] if v >= 0 else _COLORS[1]
+        parts.append(f'<text x="{label_w-6}" y="{y+12}" text-anchor="end" '
+                     f'font-size="10">{html.escape(str(label)[:28])}</text>')
+        parts.append(f'<rect x="{label_w}" y="{y+2}" width="{bw:.1f}" '
+                     f'height="{row_h-6}" fill="{color}"/>')
+        parts.append(f'<text x="{label_w+bw+4:.1f}" y="{y+12}" font-size="10">'
+                     f"{v:.4g}</text>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _svg_scatter(item: Scatter) -> str:
+    xs = [float(v) for v in item.x]
+    ys = [float(v) for v in item.y]
+    if not xs or not ys:
+        return "<svg/>"
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    xr = (x1 - x0) or 1.0
+    yr = (y1 - y0) or 1.0
+
+    def sx(v): return _PAD + (v - x0) / xr * (_SVG_W - 2 * _PAD)
+    def sy(v): return _SVG_H - _PAD - (v - y0) / yr * (_SVG_H - 2 * _PAD)
+
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{_SVG_W}" height="{_SVG_H}">',
+             f'<text x="{_SVG_W//2}" y="16" text-anchor="middle" font-size="13">'
+             f"{html.escape(item.title)}</text>",
+             f'<rect x="{_PAD}" y="{_PAD}" width="{_SVG_W-2*_PAD}" '
+             f'height="{_SVG_H-2*_PAD}" fill="none" stroke="#999"/>']
+    parts.extend(f'<circle cx="{sx(x):.1f}" cy="{sy(y):.1f}" r="1.6" '
+                 f'fill="{_COLORS[0]}" fill-opacity="0.5"/>'
+                 for x, y in zip(xs, ys))
+    parts.append(f'<text x="{_PAD}" y="{_SVG_H-8}" font-size="10">'
+                 f"[{x0:.3g}, {x1:.3g}] {html.escape(item.x_label)}"
+                 f" vs [{y0:.3g}, {y1:.3g}] {html.escape(item.y_label)}</text>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
 def _html_item(item: Item) -> str:
     if isinstance(item, Text):
         return f"<p>{html.escape(item.body)}</p>"
@@ -117,17 +202,38 @@ def _html_item(item: Item) -> str:
         return f"<table border='1' cellspacing='0' cellpadding='3'><tr>{head}</tr>{rows}</table>"
     if isinstance(item, Plot):
         return _svg_plot(item)
+    if isinstance(item, Bars):
+        return _svg_bars(item)
+    if isinstance(item, Scatter):
+        return _svg_scatter(item)
+    if isinstance(item, Bullets):
+        lis = "".join(f"<li>{html.escape(b)}</li>" for b in item.items)
+        return f"<ul>{lis}</ul>"
     raise TypeError(f"unknown report item {type(item)!r}")
 
 
 def render_html(doc: Document) -> str:
+    """Self-contained HTML: an index (table of contents with anchor links —
+    the reference's DocumentToHTMLRenderer navigation) followed by numbered
+    chapters/sections."""
     out = [f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
            f"<title>{html.escape(doc.title)}</title></head><body>"
            f"<h1>{html.escape(doc.title)}</h1>"]
+    # index page: chapter/section ToC with anchors
+    out.append("<h2>Index</h2><ul>")
     for ci, chapter in enumerate(doc.chapters, 1):
-        out.append(f"<h2>{ci}. {html.escape(chapter.title)}</h2>")
+        out.append(f'<li><a href="#ch{ci}">{ci}. '
+                   f"{html.escape(chapter.title)}</a><ul>")
         for si, section in enumerate(chapter.sections, 1):
-            out.append(f"<h3>{ci}.{si}. {html.escape(section.title)}</h3>")
+            out.append(f'<li><a href="#ch{ci}s{si}">{ci}.{si}. '
+                       f"{html.escape(section.title)}</a></li>")
+        out.append("</ul></li>")
+    out.append("</ul>")
+    for ci, chapter in enumerate(doc.chapters, 1):
+        out.append(f'<h2 id="ch{ci}">{ci}. {html.escape(chapter.title)}</h2>')
+        for si, section in enumerate(chapter.sections, 1):
+            out.append(f'<h3 id="ch{ci}s{si}">{ci}.{si}. '
+                       f"{html.escape(section.title)}</h3>")
             out.extend(_html_item(item) for item in section.items)
     out.append("</body></html>")
     return "".join(out)
@@ -145,6 +251,16 @@ def _text_item(item: Item) -> str:
         for name, ys in item.series.items():
             lines.append(f"  {name}: " + ", ".join(f"{float(y):.4g}" for y in ys))
         return "\n".join(lines)
+    if isinstance(item, Bars):
+        lines = [f"[bars] {item.title}"]
+        lines += [f"  {l}: {float(v):.4g}"
+                  for l, v in zip(item.labels, item.values)]
+        return "\n".join(lines)
+    if isinstance(item, Scatter):
+        return (f"[scatter] {item.title}: {len(list(item.x))} points "
+                f"({item.x_label} vs {item.y_label})")
+    if isinstance(item, Bullets):
+        return "\n".join(f"  * {b}" for b in item.items)
     raise TypeError(f"unknown report item {type(item)!r}")
 
 

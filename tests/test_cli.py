@@ -310,6 +310,9 @@ def test_diagnose_driver(tmp_path):
     assert train_cli.run([
         "--train-data", train_path, "--feature-shards", "all",
         "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,reg.weights=1",
+        "--id-tags", "userId",
         "--output-dir", out]) == 0
 
     diag_out = str(tmp_path / "diag")
@@ -318,11 +321,21 @@ def test_diagnose_driver(tmp_path):
                        "--bootstrap-replicates", "4"])
     assert rc == 0
     html = open(os.path.join(diag_out, "report.html")).read()
+    # per-coordinate chapters + model summary + full-model chapters,
+    # reachable from the index page
+    assert "Model summary" in html
+    assert "Coordinate &#x27;fixed&#x27; (fixed effect)" in html
+    assert "Coordinate &#x27;user&#x27; (random effect)" in html
+    assert "Calibration (full model)" in html
+    assert "Residuals (full model)" in html
+    assert '<a href="#ch1">' in html  # index page
     assert "Bootstrap" in html and "Feature importance" in html
-    assert "<svg" in html  # learning-curve plot rendered
+    assert "<svg" in html and "<polyline" in html  # line plots
+    assert "<rect" in html and "<circle" in html  # bar charts + scatter
     summary = json.load(open(os.path.join(diag_out, "diagnostics.json")))
-    assert summary["coordinate"] == "fixed"
-    assert summary["fitting"] is not None
+    assert set(summary["coordinates"]) == {"fixed", "user"}
+    assert summary["coordinates"]["fixed"]["fitting"] is not None
+    assert summary["coordinates"]["user"]["entities"] == 6
     assert summary["hosmer_lemeshow"] is not None
     assert abs(summary["kendall_tau"]["tau"]) <= 1.0
 

@@ -156,7 +156,9 @@ class TestReporting:
         sec.add(Plot("rmse", [0.1, 0.5, 1.0],
                      {"train": [1.0, 0.8, 0.7], "holdout": [1.2, 1.0, 0.9]}))
         html_out = render_html(doc)
-        assert "<h2>1. Fit quality</h2>" in html_out
+        assert '<h2 id="ch1">1. Fit quality</h2>' in html_out
+        # index page links to every chapter/section anchor
+        assert '<a href="#ch1">' in html_out and '<a href="#ch1s1">' in html_out
         assert "<svg" in html_out and "polyline" in html_out
         text_out = render_text(doc)
         assert "1.1. Learning curve" in text_out and "[plot] rmse" in text_out

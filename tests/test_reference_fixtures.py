@@ -295,7 +295,11 @@ def test_diagnose_driver_on_reference_heart(tmp_path):
     report = os.path.join(diag_out, "report.html")
     assert os.path.exists(report)
     html = open(report).read()
-    for section in ("Bootstrap", "Hosmer"):
+    # every diagnostics module shows up in the report tree: bootstrap,
+    # fitting (learning curve), calibration, importance, residuals — plus
+    # the index page and the model-summary chapter
+    for section in ("Model summary", "Bootstrap", "Learning curve", "Hosmer",
+                    "Feature importance", "Kendall tau", 'href="#ch'):
         assert section.lower() in html.lower(), section
 
 
