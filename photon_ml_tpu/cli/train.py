@@ -382,32 +382,51 @@ def _run(args, task, t_start, emitter) -> int:
 
         from photon_ml_tpu.core.normalization import (build_normalization,
                                                       compute_feature_stats)
-        from photon_ml_tpu.types import NormalizationType
+        from photon_ml_tpu.types import NormalizationType, ProjectorType
 
         kind = NormalizationType[args.normalization]
-        # normalization applies to FIXED-effect solves only (the reference's
-        # per-entity NormalizationContextRDD for random effects is not
-        # implemented); compute stats just for shards fixed effects use
-        fixed_shards = {spec.template.feature_shard for spec in specs
-                        if isinstance(spec.template, FixedEffectConfig)}
-        re_shards = {spec.template.feature_shard for spec in specs
-                     if not isinstance(spec.template, FixedEffectConfig)}
-        if re_shards:
-            logger.warning(
-                "--normalization applies to fixed-effect coordinates only; "
-                "random-effect coordinates (shards %s) train unnormalized",
-                sorted(re_shards))
-        skipped = fixed_shards & sparse_shards
-        if skipped:
-            logger.warning("normalization skipped for sparse shards %s "
-                           "(needs dense stats)", sorted(skipped))
-            fixed_shards -= skipped
+        # normalization applies to EVERY coordinate on the shard, random
+        # effects included (reference NormalizationContextRDD via
+        # GameEstimator.prepareNormalizationContextWrappers:646-680); sparse
+        # shards compute their stats straight from the COO arrays.  The one
+        # refused combination: shift normalization (STANDARDIZATION) with a
+        # random-effect solve space that has no stable intercept column
+        # (INDEX_MAP compaction, or any sparse shard) — fail loudly up front
+        # rather than mid-fit.
+        norm_shards = {spec.template.feature_shard for spec in specs}
+        if kind == NormalizationType.STANDARDIZATION:
+            for spec in specs:
+                t = spec.template
+                if isinstance(t, FixedEffectConfig):
+                    continue
+                s = t.feature_shard
+                bad = ("a sparse shard" if s in sparse_shards else
+                       "INDEX_MAP compaction"
+                       if t.projector == ProjectorType.INDEX_MAP else None)
+                if bad:
+                    logger.error(
+                        "coordinate %s: STANDARDIZATION shifts need a stable "
+                        "intercept column, which %s does not keep — use a "
+                        "factor-only normalization "
+                        "(SCALE_WITH_STANDARD_DEVIATION / "
+                        "SCALE_WITH_MAX_MAGNITUDE) or the IDENTITY/RANDOM "
+                        "projector on a dense shard", spec.name, bad)
+                    return 1
         normalization = {}
-        for s in sorted(fixed_shards):
+        for s in sorted(norm_shards):
             ii = index_maps[s].intercept_index
-            stats = compute_feature_stats(jnp.asarray(data.features[s]),
-                                          jnp.asarray(data.weight),
-                                          intercept_index=ii)
+            shard_data = data.features[s]
+            if s in sparse_shards:
+                from photon_ml_tpu.core.normalization import \
+                    compute_feature_stats_sparse
+
+                stats = compute_feature_stats_sparse(
+                    shard_data.indices, shard_data.values, shard_data.dim,
+                    weight=data.weight, intercept_index=ii)
+            else:
+                stats = compute_feature_stats(jnp.asarray(shard_data),
+                                              jnp.asarray(data.weight),
+                                              intercept_index=ii)
             normalization[s] = build_normalization(kind, stats)
             feature_stats[s] = {
                 "mean": np.asarray(stats.mean).tolist(),
@@ -471,11 +490,13 @@ def _run(args, task, t_start, emitter) -> int:
     configs = expand_game_configs(specs, task, args.coordinate_descent_iterations)
     if normalization:
         # shift-normalized solves need the intercept column id (conversion
-        # between model and transformed space, NormalizationContext.scala)
+        # between model and transformed space, NormalizationContext.scala);
+        # random effects also need it for the RANDOM projector's intercept
+        # pass-through — fill from the index map unless the user set it
         configs = [
             _dc.replace(cfg, coordinates={
                 cid: (_dc.replace(c, intercept_index=index_maps[c.feature_shard].intercept_index)
-                      if isinstance(c, FixedEffectConfig) else c)
+                      if c.intercept_index is None else c)
                 for cid, c in cfg.coordinates.items()})
             for cfg in configs
         ]

@@ -72,6 +72,53 @@ def compute_feature_stats(x: Array, weight: Optional[Array] = None,
     )
 
 
+def compute_feature_stats_sparse(indices, values, dim: int,
+                                 weight=None,
+                                 intercept_index: Optional[int] = None
+                                 ) -> FeatureStats:
+    """Feature stats straight from row-padded COO arrays [n, k] — the
+    huge-vocabulary twin of compute_feature_stats, so sparse shards can be
+    normalized without densifying (reference BasicStatisticalSummary over
+    sparse vectors).  Implicit zeros count toward every moment; padded slots
+    (value 0) are inert.  Duplicate indices within a row make the
+    second-moment stats approximate (Σv_i² vs (Σv_i)²) — the same tolerance
+    the SparseShard contract grants SIMPLE-variance Hessian diagonals
+    (game/data.py)."""
+    import numpy as np
+
+    idx = np.asarray(indices)
+    val = np.asarray(values, np.float64)
+    n, _ = idx.shape
+    w = (np.ones(n, np.float64) if weight is None
+         else np.asarray(weight, np.float64))
+    wsum = float(w.sum())
+    wv = w[:, None] * val
+    s1 = np.zeros(dim, np.float64)   # Σ w x
+    s2 = np.zeros(dim, np.float64)   # Σ w x²
+    nnz = np.zeros(dim, np.float64)
+    amax = np.zeros(dim, np.float64)
+    vmin = np.zeros(dim, np.float64)  # zeros are implicit in every column
+    vmax = np.zeros(dim, np.float64)
+    np.add.at(s1, idx.ravel(), wv.ravel())
+    np.add.at(s2, idx.ravel(), (wv * val).ravel())
+    np.add.at(nnz, idx.ravel(), (val != 0).ravel())
+    np.maximum.at(amax, idx.ravel(), np.abs(val).ravel())
+    np.minimum.at(vmin, idx.ravel(), val.ravel())
+    np.maximum.at(vmax, idx.ravel(), val.ravel())
+    mean = s1 / max(wsum, 1e-300)
+    # weighted sample variance about the mean, implicit zeros included:
+    # Σ w (x-m)² = Σ w x² - 2 m Σ w x + m² Σ w
+    ss = s2 - 2.0 * mean * s1 + mean * mean * wsum
+    var = np.maximum(ss, 0.0) / max(wsum - 1.0, 1.0)
+    return FeatureStats(
+        mean=jnp.asarray(mean), variance=jnp.asarray(var),
+        min=jnp.asarray(vmin), max=jnp.asarray(vmax),
+        abs_max=jnp.asarray(amax),
+        num_nonzeros=jnp.asarray(nnz), count=jnp.asarray(wsum),
+        intercept_index=intercept_index,
+    )
+
+
 @struct.dataclass
 class NormalizationContext:
     """Affine feature normalization; ``factors``/``shifts`` may be None (identity).

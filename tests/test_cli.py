@@ -880,3 +880,95 @@ def test_columnar_cross_run_entity_remap_and_fingerprint(tmp_path):
     imap_small = IndexMap({feature_key("f0", ""): 0})
     with pytest.raises(ValueError, match="coefficients"):
         load_game_model(d, {"s": imap_small}, {"userId": eidx_b})
+
+
+def test_normalization_applies_to_random_effects(tmp_path):
+    """--normalization now normalizes random-effect coordinates too
+    (reference NormalizationContextRDD): a GLMix run with STANDARDIZATION
+    trains e2e, and the refused combination (STANDARDIZATION + INDEX_MAP
+    compaction, which keeps no stable intercept) fails loudly up front."""
+    from photon_ml_tpu.cli import train as train_cli
+
+    train_path = str(tmp_path / "train.avro")
+    _write_fixture(train_path, n=400, seed=13)
+    out = str(tmp_path / "out")
+    base = [
+        "--train-data", train_path, "--validation-data", train_path,
+        "--feature-shards", "all", "--evaluators", "auc",
+        "--id-tags", "userId",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+    ]
+    rc = train_cli.run(base + [
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,reg.weights=1",
+        "--normalization", "STANDARDIZATION",
+        "--output-dir", out])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["validation"]["auc"] > 0.6
+
+    # INDEX_MAP + shifts: loud usage error, not a mid-fit traceback
+    rc = train_cli.run(base + [
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,"
+        "projector=INDEX_MAP,reg.weights=1",
+        "--normalization", "STANDARDIZATION",
+        "--output-dir", str(tmp_path / "out2")])
+    assert rc == 1
+    # ... but factor-only normalization with INDEX_MAP is fine
+    rc = train_cli.run(base + [
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,"
+        "projector=INDEX_MAP,reg.weights=1",
+        "--normalization", "SCALE_WITH_STANDARD_DEVIATION",
+        "--output-dir", str(tmp_path / "out3")])
+    assert rc == 0
+
+
+def test_sparse_shard_factor_normalization(tmp_path):
+    """Sparse shards compute feature stats straight from the COO arrays
+    (compute_feature_stats_sparse) — a sparse-threshold run with
+    SCALE_WITH_STANDARD_DEVIATION normalizes both coordinates."""
+    import numpy as np
+
+    from photon_ml_tpu.cli import train as train_cli
+
+    rng = np.random.default_rng(3)
+    n_users, vocab, k = 8, 120, 6
+    uw = {u: rng.normal(size=vocab) * 1.2 for u in range(n_users)}
+    scale_col = np.exp(rng.normal(size=vocab))  # wildly varied column scales
+
+    def write(path, n, seed):
+        r = np.random.default_rng(seed)
+        recs = []
+        for i in range(n):
+            u = int(r.integers(0, n_users))
+            js = r.choice(vocab, size=k, replace=False)
+            vs = r.normal(size=k) * scale_col[js]
+            logit = vs @ (uw[u][js] / scale_col[js])
+            yv = float(r.random() < 1 / (1 + np.exp(-logit)))
+            feats = [{"name": f"u{j}", "term": "", "value": float(v)}
+                     for j, v in zip(js, vs)]
+            recs.append({"uid": i, "response": yv, "label": None,
+                         "features": feats, "weight": None, "offset": None,
+                         "metadataMap": {"userId": f"user{u}"}})
+        avro_io.write_container(path, TRAINING_EXAMPLE, recs)
+
+    train_path = str(tmp_path / "train.avro")
+    write(train_path, 1600, 1)
+    out = str(tmp_path / "out")
+    rc = train_cli.run([
+        "--train-data", train_path, "--validation-data", train_path,
+        "--feature-shards", "all", "--evaluators", "auc",
+        "--id-tags", "userId",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,reg.weights=1",
+        "--normalization", "SCALE_WITH_STANDARD_DEVIATION",
+        "--sparse-threshold", "100",
+        "--output-dir", out])
+    assert rc == 0
+    stats = json.load(open(os.path.join(out, "feature-stats.json")))
+    assert "all" in stats  # sparse stats were computed and recorded
+    summary = json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["validation"]["auc"] > 0.6

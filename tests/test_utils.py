@@ -138,3 +138,29 @@ def test_compilation_cache_setup(tmp_path, monkeypatch):
     assert enable_compilation_cache() is None
     monkeypatch.setenv("PHOTON_COMPILE_CACHE", str(tmp_path / "env"))
     assert enable_compilation_cache() == str(tmp_path / "env")
+
+
+def test_sparse_feature_stats_match_dense():
+    """compute_feature_stats_sparse == compute_feature_stats on the densified
+    twin (unique indices per row — duplicates are documented-approximate)."""
+    import numpy as np
+
+    from photon_ml_tpu.core.normalization import (compute_feature_stats,
+                                                  compute_feature_stats_sparse)
+
+    rng = np.random.default_rng(0)
+    n, d, k = 500, 40, 6
+    idx = np.stack([rng.choice(d, size=k, replace=False)
+                    for _ in range(n)]).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.2] = 0.0  # padded slots
+    w = rng.random(n).astype(np.float32) + 0.5
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (np.repeat(np.arange(n), k), idx.ravel()), vals.ravel())
+    sd = compute_feature_stats(np.asarray(dense), np.asarray(w), intercept_index=3)
+    ss = compute_feature_stats_sparse(idx, vals, d, weight=w, intercept_index=3)
+    for f in ("mean", "variance", "abs_max", "num_nonzeros", "min", "max",
+              "count"):
+        np.testing.assert_allclose(np.asarray(getattr(sd, f)),
+                                   np.asarray(getattr(ss, f)),
+                                   atol=1e-4, rtol=1e-3, err_msg=f)
