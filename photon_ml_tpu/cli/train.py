@@ -428,12 +428,28 @@ def _run(args, task, t_start, emitter) -> int:
                                               jnp.asarray(data.weight),
                                               intercept_index=ii)
             normalization[s] = build_normalization(kind, stats)
-            feature_stats[s] = {
-                "mean": np.asarray(stats.mean).tolist(),
-                "variance": np.asarray(stats.variance).tolist(),
-                "abs_max": np.asarray(stats.abs_max).tolist(),
-                "intercept_index": ii,
-            }
+            if s in sparse_shards:
+                # a huge-vocabulary shard must not dump dim-length JSON
+                # lists (or loop the avro summary over millions of columns)
+                # — record OBSERVED columns only, with their ids
+                nnz = np.asarray(stats.num_nonzeros)
+                keep = np.nonzero(nnz > 0)[0]
+                if ii is not None and ii not in keep:
+                    keep = np.sort(np.append(keep, ii))
+                feature_stats[s] = {
+                    "indices": keep.tolist(),
+                    "mean": np.asarray(stats.mean)[keep].tolist(),
+                    "variance": np.asarray(stats.variance)[keep].tolist(),
+                    "abs_max": np.asarray(stats.abs_max)[keep].tolist(),
+                    "intercept_index": ii,
+                }
+            else:
+                feature_stats[s] = {
+                    "mean": np.asarray(stats.mean).tolist(),
+                    "variance": np.asarray(stats.variance).tolist(),
+                    "abs_max": np.asarray(stats.abs_max).tolist(),
+                    "intercept_index": ii,
+                }
         logger.info("normalization %s over %d shard(s)", kind.name, len(normalization))
 
     # per-entity L2 multipliers: entity NAMES in the JSON file resolve
@@ -813,15 +829,18 @@ def _run(args, task, t_start, emitter) -> int:
             imap = index_maps[s]
 
             def records(st=st, imap=imap):
-                for j in range(len(st["mean"])):
-                    name_term = imap.get_feature_name(j)
+                # sparse shards carry an explicit observed-column id list;
+                # dense shards are positionally indexed
+                cols = st.get("indices") or range(len(st["mean"]))
+                for pos, j in enumerate(cols):
+                    name_term = imap.get_feature_name(int(j))
                     if name_term is None:
                         continue
                     name, term = name_term
                     yield {"name": name, "term": term, "metrics": {
-                        "mean": st["mean"][j],
-                        "variance": st["variance"][j],
-                        "absMax": st["abs_max"][j],
+                        "mean": st["mean"][pos],
+                        "variance": st["variance"][pos],
+                        "absMax": st["abs_max"][pos],
                     }}
 
             avro_io.write_container(

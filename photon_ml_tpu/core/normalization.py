@@ -97,14 +97,27 @@ def compute_feature_stats_sparse(indices, values, dim: int,
     s2 = np.zeros(dim, np.float64)   # Σ w x²
     nnz = np.zeros(dim, np.float64)
     amax = np.zeros(dim, np.float64)
-    vmin = np.zeros(dim, np.float64)  # zeros are implicit in every column
-    vmax = np.zeros(dim, np.float64)
     np.add.at(s1, idx.ravel(), wv.ravel())
     np.add.at(s2, idx.ravel(), (wv * val).ravel())
     np.add.at(nnz, idx.ravel(), (val != 0).ravel())
     np.maximum.at(amax, idx.ravel(), np.abs(val).ravel())
-    np.minimum.at(vmin, idx.ravel(), val.ravel())
-    np.maximum.at(vmax, idx.ravel(), val.ravel())
+    # min/max over nonzero observations, then blend in the implicit zero for
+    # any column NOT observed (nonzero) in every row — a column present in
+    # all n rows must report its true extremes, not 0
+    vmin = np.full(dim, np.inf)
+    vmax = np.full(dim, -np.inf)
+    nz = val != 0
+    np.minimum.at(vmin, idx[nz], val[nz])
+    np.maximum.at(vmax, idx[nz], val[nz])
+    rows_with = np.zeros(dim, np.int64)
+    if nz.any():
+        r, c = np.nonzero(nz)
+        pairs = np.unique(np.stack([r.astype(np.int64),
+                                    idx[nz].astype(np.int64)]), axis=1)
+        np.add.at(rows_with, pairs[1], 1)
+    has_zero = rows_with < n
+    vmin = np.where(has_zero, np.minimum(vmin, 0.0), vmin)
+    vmax = np.where(has_zero, np.maximum(vmax, 0.0), vmax)
     mean = s1 / max(wsum, 1e-300)
     # weighted sample variance about the mean, implicit zeros included:
     # Σ w (x-m)² = Σ w x² - 2 m Σ w x + m² Σ w

@@ -150,10 +150,14 @@ def test_sparse_feature_stats_match_dense():
 
     rng = np.random.default_rng(0)
     n, d, k = 500, 40, 6
-    idx = np.stack([rng.choice(d, size=k, replace=False)
+    idx = np.stack([rng.choice(d - 1, size=k, replace=False)
                     for _ in range(n)]).astype(np.int32)
     vals = rng.normal(size=(n, k)).astype(np.float32)
     vals[rng.random((n, k)) < 0.2] = 0.0  # padded slots
+    # column d-1 observed (nonzero, strictly positive) in EVERY row: its
+    # min/max must be the true extremes, not the implicit-zero default
+    idx[:, -1] = d - 1
+    vals[:, -1] = rng.random(n).astype(np.float32) + 0.5
     w = rng.random(n).astype(np.float32) + 0.5
     dense = np.zeros((n, d), np.float32)
     np.add.at(dense, (np.repeat(np.arange(n), k), idx.ravel()), vals.ravel())
