@@ -111,10 +111,11 @@ def compute_feature_stats_sparse(indices, values, dim: int,
     np.maximum.at(vmax, idx[nz], val[nz])
     rows_with = np.zeros(dim, np.int64)
     if nz.any():
-        r, c = np.nonzero(nz)
-        pairs = np.unique(np.stack([r.astype(np.int64),
-                                    idx[nz].astype(np.int64)]), axis=1)
-        np.add.at(rows_with, pairs[1], 1)
+        r = np.nonzero(nz)[0].astype(np.int64)
+        # unique (row, col) pairs via one combined key — np.unique(axis=1)
+        # would void-view sort, much slower at huge-vocabulary scale
+        keys = np.unique(r * np.int64(dim) + idx[nz].astype(np.int64))
+        np.add.at(rows_with, keys % np.int64(dim), 1)
     has_zero = rows_with < n
     vmin = np.where(has_zero, np.minimum(vmin, 0.0), vmin)
     vmax = np.where(has_zero, np.maximum(vmax, 0.0), vmax)

@@ -1,0 +1,183 @@
+"""One-command TPU capture: everything the perf story needs from a real chip.
+
+The axon tunnel has been unavailable for whole build rounds at a time
+(VERDICT r2 "Missing #1"), so the moment it answers, ONE command must bank
+all accelerator evidence before anything can wedge it again:
+
+  python tools/tpu_checklist.py            # probe -> pallas parity -> bench
+
+Stages (each skipped gracefully when no TPU answers):
+  1. probe      — subprocess jax.devices() with a hard timeout (a wedged
+                  tunnel costs the timeout, never a hang; the client process
+                  always exits cleanly — killing a mid-op TPU process is
+                  what wedges the tunnel in the first place).
+  2. pallas     — NON-interpret parity of ops/fused_glm's kernels vs the XLA
+                  objective math on the real chip (the tests force CPU +
+                  interpret mode; this is the only place the kernels run for
+                  real).  Run in a subprocess for the same wedge-isolation.
+  3. bench      — python bench.py (all five BASELINE configs; on a non-cpu
+                  backend it auto-runs the pallas-off and bf16-storage A/B
+                  variants and the fused-vs-host A/B).
+
+Everything lands in TPU_CHECKLIST.json (stage results + the bench line),
+refreshed atomically after every stage so a later wedge can't destroy
+earlier evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OUT = os.path.join(_REPO, "TPU_CHECKLIST.json")
+
+_PROBE_SRC = """
+import jax
+print(jax.devices()[0].platform)
+"""
+
+# Runs on the REAL backend (no platform override): builds LANE-aligned f32
+# batches, compares the pallas kernels (interpret=False) against the plain
+# XLA objective math, prints one JSON line.
+_PALLAS_SRC = """
+import json
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.core.batch import dense_batch
+from photon_ml_tpu.core.losses import logistic_loss, poisson_loss, squared_loss
+from photon_ml_tpu.ops.fused_glm import (eligible, fused_hvp,
+                                         fused_value_and_grad)
+
+platform = jax.devices()[0].platform
+out = {"platform": platform, "cases": []}
+rng = np.random.default_rng(0)
+for name, loss in (("logistic", logistic_loss), ("squared", squared_loss),
+                   ("poisson", poisson_loss)):
+    n, d = 4096, 256  # LANE-aligned on both axes
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    if name == "poisson":
+        y = rng.poisson(1.5, size=n).astype(np.float32)
+    b = dense_batch(x, y, offset=rng.normal(size=n).astype(np.float32) * 0.1,
+                    weight=(rng.random(n).astype(np.float32) + 0.5))
+    w = (rng.normal(size=d) * 0.05).astype(np.float32)
+    v = rng.normal(size=d).astype(np.float32)
+    assert eligible(b), "checklist batch must be pallas-eligible"
+
+    # pallas, REAL kernels
+    val_p, g_p, r_p = fused_value_and_grad(loss, jnp.asarray(w), b)
+    hv_p, q_p = fused_hvp(loss, jnp.asarray(w), jnp.asarray(v), b)
+
+    # plain XLA twin
+    z = b.x @ w + b.offset
+    l, dl, d2l = loss.loss(z, b.y), loss.d1(z, b.y), loss.d2(z, b.y)
+    wt = b.weight
+    val_x = jnp.sum(wt * l)
+    r = wt * dl
+    g_x, rsum_x = b.x.T @ r, jnp.sum(r)
+    q = wt * d2l * (b.x @ v)
+    hv_x, qsum_x = b.x.T @ q, jnp.sum(q)
+
+    def rel(a, bb):
+        a, bb = np.asarray(a, np.float64), np.asarray(bb, np.float64)
+        return float(np.max(np.abs(a - bb)) / max(1e-12, np.max(np.abs(bb))))
+
+    case = {"loss": name,
+            "value_rel": rel(val_p, val_x), "grad_rel": rel(g_p, g_x),
+            "rsum_rel": rel(r_p, rsum_x), "hv_rel": rel(hv_p, hv_x),
+            "qsum_rel": rel(q_p, qsum_x)}
+    case["pass"] = all(case[k] < 2e-4 for k in
+                       ("value_rel", "grad_rel", "rsum_rel", "hv_rel",
+                        "qsum_rel"))
+    out["cases"].append(case)
+out["pass"] = all(c["pass"] for c in out["cases"])
+print(json.dumps(out))
+"""
+
+
+def _save(results: dict) -> None:
+    tmp = _OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=2)
+    os.replace(tmp, _OUT)
+
+
+def _run_py(src: str, timeout: int):
+    """Run a python snippet in a subprocess; (last stdout line, error)."""
+    try:
+        p = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                           text=True, timeout=timeout, cwd=_REPO)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    if p.returncode != 0:
+        # stderr may be empty (signal kill, OOM) — the error must still be
+        # truthy, or a failed probe would read as success
+        return None, p.stderr[-2000:] or f"exit code {p.returncode}"
+    lines = [l for l in p.stdout.strip().splitlines() if l]
+    if not lines:
+        return None, "no output"
+    return lines[-1], None
+
+
+def main() -> int:
+    results = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+    # 1. probe
+    probe_to = int(os.environ.get("PHOTON_TPU_PROBE_TIMEOUT", 120))
+    line, err = _run_py(_PROBE_SRC, probe_to)
+    results["probe"] = {"platform": line, "error": err}
+    _save(results)
+    if err or line == "cpu":
+        print(f"no accelerator ({err or 'cpu backend'}); checklist aborted "
+              f"— results in {_OUT}")
+        return 1
+    print(f"backend: {line}")
+
+    # 2. pallas non-interpret parity
+    line, err = _run_py(_PALLAS_SRC, int(os.environ.get(
+        "PHOTON_TPU_PALLAS_TIMEOUT", 600)))
+    if err:
+        results["pallas_parity"] = {"error": err}
+    else:
+        try:
+            results["pallas_parity"] = json.loads(line)
+        except ValueError:
+            # TPU runtimes routinely append non-JSON stdout noise; keep the
+            # raw line instead of crashing away the remaining stages
+            results["pallas_parity"] = {"error": "non-JSON output",
+                                        "raw": line[-2000:]}
+    _save(results)
+    print("pallas parity:", json.dumps(results["pallas_parity"]))
+
+    # 3. full bench (includes pallas-off / bf16 / fused-vs-host A/Bs on a
+    # real accelerator)
+    try:
+        p = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py")],
+                           capture_output=True, text=True, cwd=_REPO,
+                           timeout=int(os.environ.get(
+                               "PHOTON_TPU_BENCH_TIMEOUT", 14400)))
+        bench_line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
+        if p.returncode == 0 and bench_line:
+            try:
+                results["bench"] = json.loads(bench_line)
+            except ValueError:
+                results["bench"] = {"error": "non-JSON output",
+                                    "raw": bench_line[-2000:]}
+        else:
+            results["bench"] = {"error": p.stderr[-2000:] or "no output"}
+    except subprocess.TimeoutExpired:
+        results["bench"] = {"error": "bench timeout"}
+    _save(results)
+    print("bench:", json.dumps(results.get("bench", {}))[:400])
+    print(f"checklist complete -> {_OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
