@@ -369,23 +369,17 @@ def run_glmix(platform, scale, three: bool):
     backend = _select_platform(platform)
     data = synth_glmix(scale, three)
     coords = _glmix_coords(data, three)
-    # measured default per backend: the fused whole-descent program wins on
-    # accelerators (no host round-trips between updates).  On the CPU
-    # fallback round 2 measured the host loop ~2x ahead; round 3's clean
-    # re-measurement (no concurrent load) shows parity at the fallback scale
-    # (median 2.10s fused vs 2.11s host, n_repeats=5) and ~1.3x at full
-    # scale (54s vs 40s for the 2-sweep glmix2).  Per-phase isolation of the
-    # full-scale gap: sweep 1 is AT PARITY (fused 12.3s vs host 12.0s; a
-    # jitted trace_update alone, the same inside lax.scan(1), and the host
-    # update() all cost 11.7s, so the scan machinery itself adds nothing);
-    # the entire difference sits in sweep 2's fixed-effect re-solve against
-    # residual-folded offsets (warm start near a shifted optimum -> more
-    # Wolfe line-search evaluations, each a full [n x d] pass), where the
-    # one-XLA-program version schedules ~30% slower than the host-paced
-    # dispatches on the CPU backend.  Host stays the cpu default; the
-    # orchestrator records BOTH impls (glmix2_{fused,host}) every run.
-    impl = os.environ.get("PHOTON_BENCH_IMPL",
-                          "host" if backend == "cpu" else "fused")
+    # measured default: the fused whole-descent program wins EVERYWHERE now.
+    # Round 2's "host ~2x ahead on CPU" and round 3's early "~1.3x at full
+    # scale" readings were both artifacts of the same root cause: the
+    # gradient's X^T r ran as a transposed matvec, which XLA CPU executes as
+    # a cache-hostile column-major walk (20x slower than the equivalent
+    # r @ X at [512k, 256] — core/objective._xt_dot).  With the contraction
+    # rewritten, clean medians (n_repeats=5, no concurrent load): fallback
+    # scale fused 0.48s vs host 0.52s; full scale fused 5.2s vs host 5.6s
+    # (down from 54s/40s).  The orchestrator still records BOTH impls
+    # (glmix2_{fused,host}) every run so the claim stays measured.
+    impl = os.environ.get("PHOTON_BENCH_IMPL", "fused")
     if impl == "fused":
         from photon_ml_tpu.game.fused import FusedSweep
 
@@ -793,8 +787,7 @@ def main():
             args += ["--platform", "cpu"]
         got = _subprocess_json(args, timeout=to)
         if got is None and name in ("glmix2", "glmix3") and \
-                os.environ.get("PHOTON_BENCH_IMPL", "fused") == "fused" and \
-                platform != "cpu":
+                os.environ.get("PHOTON_BENCH_IMPL", "fused") == "fused":
             sys.stderr.write(f"{name}: fused failed; retrying host loop\n")
             fused_failed.add(name)
             env = os.environ.copy()

@@ -41,13 +41,20 @@ Array = jax.Array
 def _xt_dot(batch: Batch, r: Array, dim: int) -> Array:
     """X^T r against the raw design matrix (the gradient's scatter/reduce).
 
+    Written as the LEFT product r @ X — the same contraction, but without an
+    explicit transpose: XLA TPU folds either form into dot_general dimension
+    numbers, while XLA *CPU* executes ``x.T @ r`` as a cache-hostile
+    column-major walk (measured 20x slower than ``r @ x`` at [512k, 256] —
+    the whole-solver fallback cost, since this runs once per L-BFGS/TRON
+    function evaluation).
+
     Mixed precision mirrors DenseBatch.margins: narrow-stored x with MXU
     operands at storage width, accumulation/result at the residual's width."""
     if isinstance(batch, DenseBatch):
         if batch.x.dtype != r.dtype:
-            return jnp.matmul(batch.x.T, r.astype(batch.x.dtype),
+            return jnp.matmul(r.astype(batch.x.dtype), batch.x,
                               preferred_element_type=r.dtype)
-        return batch.x.T @ r
+        return r @ batch.x
     # Row-padded COO: scatter-add each value*r into its feature slot.  Padded
     # slots have value 0 so they contribute nothing wherever they point.
     contrib = batch.values.astype(r.dtype) * r[..., None]

@@ -108,21 +108,45 @@ def _save(results: dict) -> None:
     os.replace(tmp, _OUT)
 
 
-def _run_py(src: str, timeout: int):
-    """Run a python snippet in a subprocess; (last stdout line, error)."""
+# SIGALRM self-timeout prepended to every snippet: the KERNEL delivers the
+# signal even while the interpreter is blocked inside a hung C call, and the
+# default SIGALRM action terminates the process — a normal signal death, not
+# the parent-side SIGKILL that wedges the tunnel (axon has no
+# abrupt-disconnect recovery).  The parent's subprocess timeout stays as a
+# LAST-RESORT backstop, 30s behind the child's own alarm.
+_ALARM_PREAMBLE = "import signal as _sig; _sig.alarm({timeout})\n"
+
+
+def _run_py(argv_or_src, timeout: int):
+    """Run a python snippet (str) or argv (list) in a self-timing-out
+    subprocess; returns (last stdout line, error)."""
+    if isinstance(argv_or_src, str):
+        argv = [sys.executable, "-c",
+                _ALARM_PREAMBLE.format(timeout=timeout) + argv_or_src]
+    else:
+        argv = [sys.executable] + list(argv_or_src)
     try:
-        p = subprocess.run([sys.executable, "-c", src], capture_output=True,
-                           text=True, timeout=timeout, cwd=_REPO)
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout + 30, cwd=_REPO)
     except subprocess.TimeoutExpired:
         return None, f"timeout after {timeout}s"
     if p.returncode != 0:
-        # stderr may be empty (signal kill, OOM) — the error must still be
-        # truthy, or a failed probe would read as success
-        return None, p.stderr[-2000:] or f"exit code {p.returncode}"
+        # always truthy, always carries the exit code (SIGALRM self-timeout
+        # shows as -14 even when stderr holds only startup warnings)
+        return None, f"exit {p.returncode}: {p.stderr[-2000:]}".strip()
     lines = [l for l in p.stdout.strip().splitlines() if l]
     if not lines:
         return None, "no output"
     return lines[-1], None
+
+
+def _parse_json(line: str, label: str) -> dict:
+    """json.loads that records non-JSON trailing stdout instead of crashing
+    away the remaining stages (TPU runtimes routinely print noise)."""
+    try:
+        return json.loads(line)
+    except ValueError:
+        return {"error": f"non-JSON {label} output", "raw": line[-2000:]}
 
 
 def main() -> int:
@@ -139,40 +163,24 @@ def main() -> int:
         return 1
     print(f"backend: {line}")
 
-    # 2. pallas non-interpret parity
-    line, err = _run_py(_PALLAS_SRC, int(os.environ.get(
-        "PHOTON_TPU_PALLAS_TIMEOUT", 600)))
-    if err:
-        results["pallas_parity"] = {"error": err}
+    # 2. pallas non-interpret parity — TPU only (the kernels hard-require
+    # TPU; on any other accelerator record a skip, not a traceback)
+    if line == "tpu":
+        line2, err = _run_py(_PALLAS_SRC, int(os.environ.get(
+            "PHOTON_TPU_PALLAS_TIMEOUT", 600)))
+        results["pallas_parity"] = ({"error": err} if err
+                                    else _parse_json(line2, "pallas"))
     else:
-        try:
-            results["pallas_parity"] = json.loads(line)
-        except ValueError:
-            # TPU runtimes routinely append non-JSON stdout noise; keep the
-            # raw line instead of crashing away the remaining stages
-            results["pallas_parity"] = {"error": "non-JSON output",
-                                        "raw": line[-2000:]}
+        results["pallas_parity"] = {"skipped": f"backend {line!r} is not tpu"}
     _save(results)
     print("pallas parity:", json.dumps(results["pallas_parity"]))
 
     # 3. full bench (includes pallas-off / bf16 / fused-vs-host A/Bs on a
-    # real accelerator)
-    try:
-        p = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py")],
-                           capture_output=True, text=True, cwd=_REPO,
-                           timeout=int(os.environ.get(
-                               "PHOTON_TPU_BENCH_TIMEOUT", 14400)))
-        bench_line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
-        if p.returncode == 0 and bench_line:
-            try:
-                results["bench"] = json.loads(bench_line)
-            except ValueError:
-                results["bench"] = {"error": "non-JSON output",
-                                    "raw": bench_line[-2000:]}
-        else:
-            results["bench"] = {"error": p.stderr[-2000:] or "no output"}
-    except subprocess.TimeoutExpired:
-        results["bench"] = {"error": "bench timeout"}
+    # real accelerator).  bench.py runs its own watchdog subprocesses, so no
+    # alarm preamble — just the argv path through the same runner.
+    line3, err = _run_py([os.path.join(_REPO, "bench.py")],
+                         int(os.environ.get("PHOTON_TPU_BENCH_TIMEOUT", 14400)))
+    results["bench"] = {"error": err} if err else _parse_json(line3, "bench")
     _save(results)
     print("bench:", json.dumps(results.get("bench", {}))[:400])
     print(f"checklist complete -> {_OUT}")
