@@ -695,6 +695,12 @@ def quality_gate(name: str, stats: dict, ref: dict | None):
 
 def _subprocess_json(args, timeout, env=None):
     try:
+        env = dict(env if env is not None else os.environ)
+        # child self-timeouts BEFORE the parent's SIGKILL, always: margin of
+        # 30s for roomy timeouts, 5s for tight ones; assigned (not
+        # setdefault) so a stale value from a manual child run can't leak in
+        env["PHOTON_BENCH_SELF_TIMEOUT"] = str(
+            max(1, timeout - (30 if timeout > 60 else 5)))
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)] + args,
             capture_output=True, text=True, timeout=timeout, cwd=_REPO,
@@ -757,6 +763,16 @@ def main():
     ap.add_argument("--config", choices=list(RUNNERS))
     ap.add_argument("--platform", default=None)
     a = ap.parse_args()
+
+    # Child modes self-timeout via SIGALRM: kernel-delivered even while
+    # blocked inside a hung device call, and a normal signal death — the
+    # parent's subprocess timeout (SIGKILL, which wedges the axon tunnel
+    # mid-op) stays a last resort it should never reach.
+    self_to = int(os.environ.get("PHOTON_BENCH_SELF_TIMEOUT", 0))
+    if self_to > 0 and (a.probe or a.config):
+        import signal
+
+        signal.alarm(self_to)
 
     if a.probe:
         import jax
