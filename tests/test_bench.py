@@ -1,0 +1,90 @@
+"""Unit tests for the bench harness itself (bench.py is the driver's entry
+artifact — its measurement and gating logic deserve the same regression
+protection as the library)."""
+
+import os
+import sys
+
+import pytest
+
+# bench.py lives at the repo root, one level above tests/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+class TestMeasure:
+    def test_five_repeats_and_median(self):
+        calls = []
+
+        def thunk():
+            calls.append(1)
+            return [0.3, 0.1, 0.2, 0.5, 0.4][len(calls) - 1]
+
+        med, timing = bench._measure(thunk)
+        assert len(calls) == 5
+        assert med == pytest.approx(0.3)
+        assert timing == {"n_repeats": 5, "dt_median": 0.3,
+                          "dt_min": 0.1, "dt_max": 0.5}
+
+    def test_slow_config_stops_at_budget(self):
+        """Full-scale configs with multi-minute repeats stop at max_total —
+        every repeat is seconds long, satisfying the dt>=2s criterion."""
+        med, timing = bench._measure(lambda: 50.0, max_total=120.0)
+        assert timing["n_repeats"] == 3  # 50+50 < 120 <= 50+50+50
+        assert med == 50.0
+
+
+class TestQualityGates:
+    def test_gp_tune_gate_can_fail(self):
+        """VERDICT r2 weak #2: the old gate passed on equality (a tuner that
+        finds nothing).  The gate must now DEMAND improvement."""
+        stats_flat = {"best_auc": 0.90477, "prior_auc": 0.90477, "fits": 7}
+        assert bench.quality_gate("gp_tune", stats_flat, None)["pass"] is False
+        stats_worse = {"best_auc": 0.80, "prior_auc": 0.85, "fits": 7}
+        assert bench.quality_gate("gp_tune", stats_worse, None)["pass"] is False
+        stats_better = {"best_auc": 0.88, "prior_auc": 0.84, "fits": 7}
+        gate = bench.quality_gate("gp_tune", stats_better, None)
+        assert gate["pass"] is True
+        assert gate["improvement"] == pytest.approx(0.04)
+
+    def test_auc_gates_use_reference(self):
+        ref = {"auc": 0.9}
+        assert bench.quality_gate("a1a", {"auc": 0.9001}, ref)["pass"] is True
+        assert bench.quality_gate("a1a", {"auc": 0.88}, ref)["pass"] is False
+        assert bench.quality_gate("glmix2", {"auc": 0.904},
+                                  {"auc": 0.9})["pass"] is True
+        # no reference -> explicitly unknown, never silently green
+        assert bench.quality_gate("a1a", {"auc": 0.9}, None)["pass"] is None
+
+    def test_sparse1m_gate_relative(self):
+        ref = {"mean_nll": 0.5}
+        assert bench.quality_gate("sparse1m", {"mean_nll": 0.5001},
+                                  ref)["pass"] is True
+        assert bench.quality_gate("sparse1m", {"mean_nll": 0.52},
+                                  ref)["pass"] is False
+
+
+class TestEntry:
+    def test_entry_from_carries_timing_and_ratio(self):
+        got = {"dt": 2.0, "units": 100, "unit": "examples/sec",
+               "backend": "cpu", "stats": {"best_auc": 0.9, "prior_auc": 0.8,
+                                           "fits": 7},
+               "timing": {"n_repeats": 5, "dt_median": 2.0,
+                          "dt_min": 1.9, "dt_max": 2.2},
+               "impl": "fused"}
+        entry = bench._entry_from("gp_tune", got, scale=8, want_cpu_ref=False)
+        assert entry["value"] == 50.0
+        assert entry["vs_baseline"] is None  # no stand-in requested
+        assert entry["timing"]["n_repeats"] == 5
+        assert entry["impl"] == "fused"
+        assert entry["quality"]["pass"] is True
+
+
+class TestSynth:
+    def test_synth_shapes_scale(self):
+        xg, xu, uids, y = bench.synth_tune(8)
+        assert len(y) == len(uids) == xg.shape[0] == xu.shape[0] == 8192
+        xg1, *_ = bench.synth_tune(1)
+        assert xg1.shape[0] == 65536
+        data = bench.synth_glmix(8, False)
+        assert data["xg"].shape == (2048 * 32, 256)
