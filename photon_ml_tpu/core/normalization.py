@@ -49,7 +49,14 @@ class FeatureStats:
 
 def compute_feature_stats(x: Array, weight: Optional[Array] = None,
                           intercept_index: Optional[int] = None) -> FeatureStats:
-    """Dense-batch feature stats; the sharded variant psums the moments."""
+    """Dense-batch feature stats.
+
+    Multihost/sharded: call jitted on a globally data-sharded array with the
+    padded rows carrying weight 0 — the moment reductions become GSPMD
+    cross-host collectives and every host sees identical global stats
+    (tests/test_parallel.py::test_global_feature_stats_on_sharded_rows; the
+    multihost recipe in parallel/multihost.py).  ALWAYS pass ``weight`` in
+    that setting: the unweighted branch divides by the padded row count."""
     n = x.shape[0]
     if weight is None:
         mean = jnp.mean(x, axis=0)

@@ -585,3 +585,30 @@ with open(os.path.join(out, f"w{{pid}}.json"), "w") as f:
     res = jax.jit(make_solver(obj, config=SolverConfig(max_iters=50)))(
         jnp.zeros(d), dense_batch(x.astype(np.float64), y.astype(np.float64)))
     np.testing.assert_allclose(w0, np.asarray(res.w), rtol=2e-3, atol=2e-4)
+
+
+def test_global_feature_stats_on_sharded_rows(devices, rng):
+    """The multihost normalization recipe: each host pads its local rows
+    (weight 0) and assembles a globally data-sharded array; a jitted
+    compute_feature_stats over it equals the stats of the raw unpadded rows
+    on every host — GSPMD inserts the cross-host moment reductions (the
+    'sharded variant psums the moments' contract in core/normalization)."""
+    from photon_ml_tpu.core.normalization import compute_feature_stats
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.parallel.multihost import (global_batch_from_local,
+                                                  pad_local_rows)
+
+    n, d = 100, 6  # deliberately NOT divisible by the mesh
+    x = rng.normal(size=(n, d)).astype(np.float64) * np.linspace(0.5, 3, d)
+    w = rng.random(n).astype(np.float64) + 0.5
+    mesh = make_mesh(n_data=8, devices=devices[:8])
+    rows = -(-n // 8) * 8
+    local = pad_local_rows({"x": x, "weight": w}, rows)
+    g = global_batch_from_local(local, mesh)
+
+    stats_sharded = jax.jit(compute_feature_stats)(g["x"], g["weight"])
+    stats_host = compute_feature_stats(jnp.asarray(x), jnp.asarray(w))
+    for f in ("mean", "variance", "abs_max"):
+        np.testing.assert_allclose(np.asarray(getattr(stats_sharded, f)),
+                                   np.asarray(getattr(stats_host, f)),
+                                   rtol=1e-10, err_msg=f)
