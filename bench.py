@@ -797,10 +797,10 @@ def main():
 
     configs = {}
     fused_failed = set()
+    # every child of a cpu-fallback run gets the same platform override
+    plat_args = ["--platform", "cpu"] if platform == "cpu" else []
     for name in names:
-        args = ["--config", name]
-        if platform == "cpu":
-            args += ["--platform", "cpu"]
+        args = ["--config", name] + plat_args
         got = _subprocess_json(args, timeout=to)
         if got is None and name in ("glmix2", "glmix3") and \
                 os.environ.get("PHOTON_BENCH_IMPL", "fused") == "fused":
@@ -828,21 +828,22 @@ def main():
         else:
             env = os.environ.copy()
             env["PHOTON_BENCH_IMPL"] = alt
-            args = ["--config", "glmix2"]
-            if platform == "cpu":
-                args += ["--platform", "cpu"]
-            got = _subprocess_json(args, timeout=to, env=env)
+            got = _subprocess_json(["--config", "glmix2"] + plat_args,
+                                   timeout=to, env=env)
             configs[f"glmix2_{alt}"] = (
                 _entry_from("glmix2", got, scale, want_cpu_ref) if got
                 else {"error": "failed or timed out"})
 
-    # A/B variants on a real accelerator (skipped on the cpu fallback to keep
-    # it fast): pallas-fused vs plain-XLA objective, and bf16 design storage.
-    # Both reuse glmix2's data/loop/baseline so the deltas are pure.
-    if platform != "cpu" and "value" in configs.get("glmix2", {}):
+    # A/B variants: pallas-fused vs plain-XLA objective (accelerator only —
+    # there is no pallas path on cpu) and bf16 design storage (EVERY
+    # backend: on cpu it documents the mixed-precision path's quality gate
+    # and honest cost — software-emulated bf16 matmuls lose ~2.5x there,
+    # while TPU MXUs take bf16 operands natively).  All variants reuse
+    # glmix2's data/loop/baseline so the deltas are pure.
+    if "value" in configs.get("glmix2", {}):
         head_impl = configs["glmix2"].get("impl", "fused")
         variants = [("glmix2_bf16", {"PHOTON_BENCH_STORAGE": "bfloat16"})]
-        if head_impl == "fused":
+        if head_impl == "fused" and platform != "cpu":
             # pallas-vs-XLA only makes sense on the impl that actually ran;
             # under the host-loop fallback the A/B would re-fail fused twice
             variants.insert(0, ("glmix2_xla", {"PHOTON_GLM_DISABLE_PALLAS": "1"}))
@@ -850,18 +851,25 @@ def main():
             env = os.environ.copy()
             env["PHOTON_BENCH_IMPL"] = head_impl
             env.update(extra_env)
-            got = _subprocess_json(["--config", "glmix2"], timeout=to, env=env)
+            got = _subprocess_json(["--config", "glmix2"] + plat_args,
+                                   timeout=to, env=env)
             if got is None:
                 configs[vname] = {"error": "failed or timed out"}
             else:
                 configs[vname] = _entry_from("glmix2", got, scale, want_cpu_ref)
                 if vname == "glmix2_bf16":
                     # mixed-storage batches always take the plain-XLA path
-                    # (uniform-dtype pallas kernels), so the bf16 delta is
-                    # clean against glmix2_xla, NOT against the headline
-                    configs[vname]["note"] = ("plain-XLA objective (mixed-"
-                                              "storage skips pallas); compare "
-                                              "vs glmix2_xla")
+                    # (uniform-dtype pallas kernels), so the clean comparator
+                    # is glmix2_xla when it ran (fused accelerator headline),
+                    # otherwise the headline itself (cpu, or host fallback —
+                    # both already plain-XLA)
+                    configs[vname]["note"] = (
+                        "plain-XLA objective (mixed-storage skips pallas); "
+                        "compare vs glmix2_xla"
+                        if "glmix2_xla" in configs else
+                        ("software bf16 on cpu; compare vs glmix2 — TPU MXUs "
+                         "take bf16 natively" if platform == "cpu" else
+                         "compare vs the (plain-XLA host) glmix2 headline"))
 
     # headline: config #3 (same metric as round 1), else first success
     head = configs.get("glmix2")

@@ -53,10 +53,13 @@ def compute_feature_stats(x: Array, weight: Optional[Array] = None,
 
     Multihost/sharded: call jitted on a globally data-sharded array with the
     padded rows carrying weight 0 — the moment reductions become GSPMD
-    cross-host collectives and every host sees identical global stats
-    (tests/test_parallel.py::test_global_feature_stats_on_sharded_rows; the
-    multihost recipe in parallel/multihost.py).  ALWAYS pass ``weight`` in
-    that setting: the unweighted branch divides by the padded row count."""
+    cross-host collectives and every host sees identical global
+    mean/variance/abs_max (what normalization consumes;
+    tests/test_parallel.py::test_global_feature_stats_on_sharded_rows and
+    the multihost recipe in parallel/multihost.py).  ALWAYS pass ``weight``
+    in that setting: the unweighted branch divides by the padded row count.
+    CAVEAT: ``min``/``max`` are order statistics weight cannot mask, so on
+    padded data they include the pad rows' zeros."""
     n = x.shape[0]
     if weight is None:
         mean = jnp.mean(x, axis=0)
