@@ -147,6 +147,25 @@ class Coordinate:
         """Host: the array from trace_publish -> this coordinate's model."""
         raise NotImplementedError
 
+    def merge_carry_through(self, model: DatumScoringModel,
+                            init: Optional[DatumScoringModel]
+                            ) -> DatumScoringModel:
+        """Host: fold warm-start state this update could NOT retrain into the
+        published model (reference RandomEffectCoordinate.updateModel's
+        leftOuterJoin :114-127: a prior per-entity model with no active data
+        passes through unchanged).  Default: nothing to carry."""
+        return model
+
+    def carry_through_scores(self, init: Optional[DatumScoringModel]
+                             ) -> "Optional[np.ndarray]":
+        """Host: per-sample scores [n] of the warm-start state that
+        merge_carry_through would pass through (the carried entities'
+        contribution).  The fused sweep folds this CONSTANT into its base
+        offsets so every in-program residual matches the host loop, whose
+        re-scoring of the merged model includes it.  None = nothing
+        carried."""
+        return None
+
     def sweep_key(self) -> tuple:
         """Identity of this coordinate's compiled sweep contribution: the
         device data layout + every config field EXCEPT the regularization
@@ -560,7 +579,8 @@ class RandomEffectCoordinate(Coordinate):
 
     def __init__(self, coordinate_id: str, data: GameData, config: RandomEffectConfig,
                  task: TaskType, mesh: Optional[Mesh] = None, seed: int = 0,
-                 dtype=np.float32, norm: Optional[NormalizationContext] = None):
+                 dtype=np.float32, norm: Optional[NormalizationContext] = None,
+                 existing_model_keys: Optional[frozenset] = None):
         self.coordinate_id = coordinate_id
         self.config = config
         self.task = task
@@ -657,6 +677,7 @@ class RandomEffectCoordinate(Coordinate):
                 lane_multiple=lane_multiple, seed=seed, dtype=dtype,
                 features_to_samples_ratio=ratio,
                 intercept_index=config.intercept_index,
+                existing_model_keys=existing_model_keys,
             )
             self._proj = ProjectedBuckets(base=self.buckets,
                                           buckets=self.buckets.buckets,
@@ -671,6 +692,7 @@ class RandomEffectCoordinate(Coordinate):
                 min_active_samples=config.min_active_samples,
                 lane_multiple=lane_multiple,
                 seed=seed, dtype=dtype,
+                existing_model_keys=existing_model_keys,
             )
         # slot order for the stacked model = sorted entity id (stacked_coefficients)
         self._sorted_ids = sorted(self.buckets.lane_of)
@@ -1014,7 +1036,72 @@ class RandomEffectCoordinate(Coordinate):
             feature_shard=self.config.feature_shard, task=self.task,
             variances=var_stack,
         )
-        return model, results
+        return self.merge_carry_through(model, init), results
+
+    def merge_carry_through(self, model: RandomEffectModel,
+                            init: Optional[RandomEffectModel]
+                            ) -> RandomEffectModel:
+        """Prior-model entities this update did not retrain (no active data —
+        e.g. dropped by the existing-model-aware lower bound, or simply
+        absent from this dataset) keep their old coefficients in the
+        published model: the reference's leftOuterJoin passthrough
+        (RandomEffectCoordinate.scala:114-127)."""
+        if init is None:
+            return model
+        carried = sorted(eid for eid in init.slot_of
+                         if eid not in model.slot_of)
+        if not carried:
+            return model
+        import dataclasses
+
+        # the pipeline's dtype stays authoritative: a float64 avro prior
+        # must not upcast a float32 model just because an entity carried
+        out_dtype = np.asarray(model.w_stack).dtype
+        rows = np.stack([init.w_stack[init.slot_of[eid]]
+                         for eid in carried]).astype(out_dtype)
+        slot_of = dict(model.slot_of)
+        base = len(slot_of)
+        for i, eid in enumerate(carried):
+            slot_of[eid] = base + i
+        w_stack = np.concatenate([np.asarray(model.w_stack), rows])
+        var_stack = model.variances
+        if var_stack is not None:
+            # carried rows keep the prior model's variances when it has
+            # them; a variance-less prior contributes zeros (its uncertainty
+            # was never computed — 0 is the explicit "not estimated" marker
+            # model_io uses for absent variances)
+            if init.variances is not None:
+                vrows = np.stack([init.variances[init.slot_of[eid]]
+                                  for eid in carried]).astype(out_dtype)
+            else:
+                vrows = np.zeros_like(rows)
+            var_stack = np.concatenate(
+                [np.asarray(var_stack, vrows.dtype), vrows])
+        return dataclasses.replace(model, w_stack=w_stack, slot_of=slot_of,
+                                   variances=var_stack)
+
+    def carry_through_scores(self, init: Optional[RandomEffectModel]
+                             ) -> Optional[np.ndarray]:
+        from photon_ml_tpu.parallel.bucketing import (score_samples,
+                                                      score_samples_sparse)
+
+        if init is None:
+            return None
+        carried = np.fromiter(
+            (eid for eid in init.slot_of if eid not in self._slot_of),
+            np.int64)
+        if carried.size == 0:
+            return None
+        slots = _slots_from(init.slot_of, self._entity_ids)
+        slots = np.where(np.isin(self._entity_ids, carried),
+                         slots, -1).astype(np.int32)
+        w = jnp.asarray(np.asarray(init.w_stack, self._dtype))
+        if self._sparse:
+            s = score_samples_sparse(w, jnp.asarray(slots),
+                                     self._x_idx_dev, self._x_val_dev)
+        else:
+            s = score_samples(w, jnp.asarray(slots), self._x_full)
+        return np.asarray(s)[: self._n]
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
         from photon_ml_tpu.parallel.bucketing import (score_samples,
@@ -1173,12 +1260,15 @@ class RandomEffectCoordinate(Coordinate):
 def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfig,
                      task: TaskType, mesh: Optional[Mesh] = None,
                      norm: Optional[NormalizationContext] = None,
-                     seed: int = 0, dtype=np.float32) -> Coordinate:
+                     seed: int = 0, dtype=np.float32,
+                     existing_model_keys: Optional[frozenset] = None) -> Coordinate:
     """Reference CoordinateFactory.build (CoordinateFactory.scala:34-113).
 
     ``dtype``: compute precision for this coordinate's device arrays; the
     reference computes in JVM float64 throughout — pass ``np.float64`` for
     reference-precision parity, keep the float32 default for TPU throughput.
+    ``existing_model_keys``: warm-start entity ids for the random-effect
+    lower bound's existing-model semantics (see bucketing._group_rows).
     """
     if np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
         raise ValueError(
@@ -1191,5 +1281,6 @@ def build_coordinate(coordinate_id: str, data: GameData, config: CoordinateConfi
                                      dtype=dtype)
     if isinstance(config, RandomEffectConfig):
         return RandomEffectCoordinate(coordinate_id, data, config, task, mesh, seed,
-                                      dtype=dtype, norm=norm)
+                                      dtype=dtype, norm=norm,
+                                      existing_model_keys=existing_model_keys)
     raise TypeError(f"unknown coordinate config {type(config)!r}")

@@ -73,13 +73,22 @@ class GameEstimator:
         # the float32 default is the TPU-throughput choice.
         self.dtype = dtype
 
-    def build_one_coordinate(self, cid, data, ccfg, task, seed: int = 0):
+    def build_one_coordinate(self, cid, data, ccfg, task, seed: int = 0,
+                             initial_model=None):
         """The ONE construction call for a coordinate under this estimator's
         settings (mesh / normalization / dtype) — shared by fit() and the
-        tuning fast path so they can never drift apart."""
+        tuning fast path so they can never drift apart.  ``initial_model``:
+        its entity keys feed the random-effect lower bound's existing-model
+        semantics (RandomEffectDataset.scala:322-333)."""
+        keys = None
+        if initial_model is not None and cid in initial_model:
+            m = initial_model[cid]
+            if hasattr(m, "slot_of"):
+                keys = frozenset(m.slot_of)
         return build_coordinate(cid, data, ccfg, task, self.mesh,
                                 norm=self.normalization.get(ccfg.feature_shard),
-                                seed=seed, dtype=self.dtype)
+                                seed=seed, dtype=self.dtype,
+                                existing_model_keys=keys)
 
     def fit(
         self,
@@ -101,6 +110,12 @@ class GameEstimator:
         later grid points."""
         results: List[GameFitResult] = []
         warm = initial_model
+        # existing-model lower-bound semantics apply to a user-supplied WARM
+        # START only: on checkpoint resume, initial_model is the mid-job
+        # checkpoint — treating its entities as "existing" would freeze
+        # under-bound entities the uninterrupted run kept retraining,
+        # breaking resume equivalence
+        prior_for_bounds = initial_model if resume_cursor is None else None
         prev: Dict[str, object] = {}
         prev_sweep = None  # (key, FusedSweep) — reuse the compiled program
         # when every coordinate object survived config-to-config (same `prev`
@@ -118,10 +133,12 @@ class GameEstimator:
                         coordinates[cid] = old.rebind(ccfg)  # same data, new opt settings
                     except ValueError:
                         coordinates[cid] = self.build_one_coordinate(
-                            cid, data, ccfg, config.task, seed)
+                            cid, data, ccfg, config.task, seed,
+                            initial_model=prior_for_bounds)
                 else:
                     coordinates[cid] = self.build_one_coordinate(
-                        cid, data, ccfg, config.task, seed)
+                        cid, data, ccfg, config.task, seed,
+                        initial_model=prior_for_bounds)
             prev = coordinates
             validation = None
             if validation_data is not None and self.validation_suite is not None:

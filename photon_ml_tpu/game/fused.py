@@ -162,9 +162,18 @@ class FusedSweep:
             coord = self.coordinates[cid]
             init = initial[cid] if initial is not None and cid in initial else None
             states.append(coord.init_sweep_state(init))
-            scores.append(jnp.zeros(self._n, self._dtype) if init is None
-                          else jnp.asarray(np.asarray(coord.score(init),
-                                                      self._dtype)))
+            if init is None:
+                scores.append(jnp.zeros(self._n, self._dtype))
+                continue
+            s = np.asarray(coord.score(init), self._dtype)
+            c = coord.carry_through_scores(init)
+            if c is not None:
+                # the carried (never-retrained) contribution rides the BASE
+                # offsets for the whole program (_base_with_carry_through);
+                # keeping it out of the per-coordinate carry score prevents
+                # double-counting it in the first update's residual
+                s = s - np.asarray(c, self._dtype)
+            scores.append(jnp.asarray(s))
         return tuple(states), tuple(scores)
 
     def init_carry(self, initial: Optional[GameModel]):
@@ -188,15 +197,50 @@ class FusedSweep:
         carry = carry0 if carry0 is not None else self.init_carry(initial)
         if regs is None:
             regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
+        base, carried = self._base_with_carry_through(initial)
         published, scores, vars_ = self._program(
             *carry, self._vars0, tuple(regs), jax.random.PRNGKey(seed),
-            self._base, self._datas)
+            base, self._datas)
         models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
                   for i, cid in enumerate(self.order)}
         final_scores = {cid: np.asarray(scores[i])
                         for i, cid in enumerate(self.order)}
+        for cid, c in carried.items():
+            # published scores include the carried contribution, exactly as
+            # the host loop's re-scoring of the merged model does
+            final_scores[cid] = final_scores[cid] + c
         models = self._attach_variances(models, vars_)
+        models = self._merge_carry_through(models, initial)
         return GameModel(models=models), final_scores
+
+    def _base_with_carry_through(self, initial: Optional[GameModel]):
+        """(base offsets + carried-entity scores, per-coordinate carried
+        scores).  Carried entities never retrain, so their contribution is a
+        CONSTANT the program must see in its offsets — otherwise every
+        residual after a coordinate's first in-program update would drop it,
+        diverging from the host loop (which re-scores the merged model each
+        update)."""
+        carried = {}
+        base = self._base
+        if initial is not None:
+            for cid in self.order:
+                c = self.coordinates[cid].carry_through_scores(
+                    initial[cid] if cid in initial else None)
+                if c is not None:
+                    carried[cid] = c
+                    base = base + jnp.asarray(np.asarray(c, self._dtype))
+        return base, carried
+
+    def _merge_carry_through(self, models, initial: Optional[GameModel]):
+        """Warm-start state the program could not retrain (prior-model
+        entities with no active data) passes through on host — the same
+        leftOuterJoin semantics the host path applies
+        (Coordinate.merge_carry_through)."""
+        if initial is None:
+            return models
+        return {cid: self.coordinates[cid].merge_carry_through(
+                    m, initial[cid] if cid in initial else None)
+                for cid, m in models.items()}
 
     def run_snapshots(self, initial: Optional[GameModel] = None,
                       regs: Optional[Sequence] = None, seed: int = 0,
@@ -246,14 +290,15 @@ class FusedSweep:
         carry = carry0 if carry0 is not None else self.init_carry(initial)
         if regs is None:
             regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
+        base, _carried = self._base_with_carry_through(initial)
         pubs, _scores = self._snap_program(
             *carry, tuple(regs), jax.random.PRNGKey(seed),
-            self._base, self._datas)
+            base, self._datas)
         pubs = [np.asarray(p) for p in pubs]
         return [
-            GameModel(models={
-                cid: self.coordinates[cid].export_model(pubs[i][t])
-                for i, cid in enumerate(order)})
+            GameModel(models=self._merge_carry_through(
+                {cid: self.coordinates[cid].export_model(pubs[i][t])
+                 for i, cid in enumerate(order)}, initial))
             for t in range(self.num_iterations)
         ]
 

@@ -103,11 +103,19 @@ def _group_rows(
     active_cap: Optional[int],
     min_active_samples: int,
     seed: int,
+    existing_model_keys: Optional[frozenset] = None,
 ) -> Tuple[List[np.ndarray], List[int], List[float]]:
     """Group sample rows by entity with the deterministic reservoir cap +
     weight rescale count/cap (reference RandomEffectDataset.scala:358-420)
     and the min-active lower bound (:319-341).  Shared by the dense and
-    row-sparse bucketers."""
+    row-sparse bucketers.
+
+    ``existing_model_keys`` (warm start): the reference's lower-bound filter
+    drops an under-bound entity only when a prior model already covers it
+    (that model then passes through unchanged — RandomEffectCoordinate
+    .updateModel's leftOuterJoin :114-127); an under-bound NEW entity still
+    trains, else it would never get a model at all
+    (RandomEffectDataset.scala:322-333)."""
     uniq, inverse, counts = np.unique(entity_ids, return_inverse=True,
                                       return_counts=True)
     order = np.argsort(inverse, kind="stable")  # rows grouped by entity
@@ -118,7 +126,9 @@ def _group_rows(
     rescale: List[float] = []
     for e in range(len(uniq)):
         rows = order[starts[e]: starts[e + 1]]
-        if len(rows) < min_active_samples:
+        if len(rows) < min_active_samples and (
+                existing_model_keys is None
+                or int(uniq[e]) in existing_model_keys):
             continue
         scale = 1.0
         if active_cap is not None and len(rows) > active_cap:
@@ -175,6 +185,7 @@ def bucket_by_entity(
     lane_multiple: int = 1,
     seed: int = 0,
     dtype=np.float32,
+    existing_model_keys: Optional[frozenset] = None,
 ) -> EntityBuckets:
     """Group samples by entity into power-of-two-capacity buckets.
 
@@ -196,7 +207,8 @@ def bucket_by_entity(
     d = x.shape[1]
 
     kept_rows, kept_entities, rescale = _group_rows(
-        entity_ids, active_cap, min_active_samples, seed)
+        entity_ids, active_cap, min_active_samples, seed,
+        existing_model_keys=existing_model_keys)
 
     # Capacity classes: next power of two of the active count.
     caps = _capacity_classes(kept_rows)
@@ -234,6 +246,7 @@ def bucket_by_entity_sparse(
     dtype=np.float32,
     features_to_samples_ratio: Optional[float] = None,
     intercept_index: Optional[int] = None,
+    existing_model_keys: Optional[frozenset] = None,
 ):
     """Compact per-entity buckets built DIRECTLY from row-sparse features.
 
@@ -272,7 +285,8 @@ def bucket_by_entity_sparse(
     weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
 
     kept_rows, kept_entities, rescale = _group_rows(
-        entity_ids, active_cap, min_active_samples, seed)
+        entity_ids, active_cap, min_active_samples, seed,
+        existing_model_keys=existing_model_keys)
 
     def _compact_lane(rows: np.ndarray):
         """(observed columns, compact dense block [len(rows), n_obs])."""

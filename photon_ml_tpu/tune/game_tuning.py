@@ -85,7 +85,8 @@ class GameEstimatorEvaluationFunction:
             try:
                 coords = {
                     cid: self.estimator.build_one_coordinate(
-                        cid, self.data, ccfg, self.base_config.task, self.seed)
+                        cid, self.data, ccfg, self.base_config.task, self.seed,
+                        initial_model=self.initial_model)
                     for cid, ccfg in self.base_config.coordinates.items()}
                 sweep = FusedSweep(
                     coords, order=list(self.base_config.coordinates),
@@ -118,12 +119,14 @@ class GameEstimatorEvaluationFunction:
             regs = [config.coordinates[cid].reg for cid in config.coordinates]
             suite = self.estimator.validation_suite
             if config.num_outer_iterations == 1:
-                model, _scores = sweep_obj.run(carry0=carry0, regs=regs,
+                model, _scores = sweep_obj.run(initial=self.initial_model,
+                                               carry0=carry0, regs=regs,
                                                seed=self.seed)
                 snapshots = [model]
             else:
-                snapshots = sweep_obj.run_snapshots(carry0=carry0, regs=regs,
-                                                    seed=self.seed)
+                snapshots = sweep_obj.run_snapshots(
+                    initial=self.initial_model, carry0=carry0, regs=regs,
+                    seed=self.seed)
             best_model, best_ev = None, None
             for m in snapshots:
                 ev = GameTransformer(m, config.task).evaluate(

@@ -1204,3 +1204,114 @@ def test_random_effect_normalization_rejections(rng):
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
                                projector=ProjectorType.RANDOM, projected_dim=2),
             TaskType.LOGISTIC_REGRESSION, norm=norm_shift)
+
+
+def test_lower_bound_existing_model_semantics(rng):
+    """Reference RandomEffectDataset.scala:322-333 + RandomEffectCoordinate
+    .updateModel:114-127: with a warm-start model, an under-bound entity
+    ALREADY covered by it is not retrained (its model passes through
+    unchanged), while an under-bound NEW entity still trains; without a
+    warm start, under-bound entities are dropped outright."""
+    from photon_ml_tpu.models.game import RandomEffectModel
+
+    d = 4
+    # entity 0: 16 samples; entity 1: 2 samples (under bound), IN the prior;
+    # entity 2: 2 samples (under bound), NOT in the prior
+    uids = np.concatenate([np.zeros(16), np.ones(2), np.full(2, 2)]).astype(np.int64)
+    n = len(uids)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    cfg = RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                             solver=SolverConfig(max_iters=20),
+                             reg=Regularization(l2=1.0),
+                             min_active_samples=4)
+    prior_w = np.asarray([[1.0, 2.0, 3.0, 4.0], [5.0, 6.0, 7.0, 8.0]],
+                         np.float32)
+    prior = RandomEffectModel(w_stack=prior_w, slot_of={0: 0, 1: 1},
+                              random_effect_type="userId", feature_shard="u",
+                              task=TaskType.LOGISTIC_REGRESSION)
+
+    # no warm start: under-bound entities dropped outright
+    cold = build_coordinate("u", data, cfg, TaskType.LOGISTIC_REGRESSION)
+    m_cold, _ = cold.update(np.zeros(n, np.float32))
+    assert set(m_cold.slot_of) == {0}
+
+    # warm start: entity 1 (under-bound, prior) NOT retrained — its prior
+    # coefficients pass through; entity 2 (under-bound, new) IS trained
+    warm = build_coordinate("u", data, cfg, TaskType.LOGISTIC_REGRESSION,
+                            existing_model_keys=frozenset(prior.slot_of))
+    assert set(warm.buckets.lane_of) == {0, 2}
+    m_warm, _ = warm.update(np.zeros(n, np.float32), init=prior)
+    assert set(m_warm.slot_of) == {0, 1, 2}
+    np.testing.assert_array_equal(
+        m_warm.w_stack[m_warm.slot_of[1]], prior_w[1])
+    # retrained entities moved off the prior
+    assert np.max(np.abs(m_warm.w_stack[m_warm.slot_of[0]] - prior_w[0])) > 1e-3
+    # the carried entity's samples score with its carried model
+    sc = warm.score(m_warm)
+    expected = x[16:18] @ prior_w[1]
+    np.testing.assert_allclose(sc[16:18], expected, rtol=1e-5)
+
+    # estimator path (fused): same semantics end-to-end
+    est = GameEstimator()
+    config = GameConfig(task=TaskType.LOGISTIC_REGRESSION,
+                        coordinates={"user": cfg})
+    res = est.fit(data, [config],
+                  initial_model=GameModel(models={"user": prior}), seed=0)[0]
+    m_fused = res.model["user"]
+    assert set(m_fused.slot_of) == {0, 1, 2}
+    np.testing.assert_array_equal(
+        m_fused.w_stack[m_fused.slot_of[1]], prior_w[1])
+
+
+def test_warm_start_carry_through_fused_matches_host(rng):
+    """Carried entities' samples contribute a CONSTANT score to every
+    residual; the fused program folds it into the base offsets, so a
+    2-coordinate warm-started fused fit must match the host loop (which
+    re-scores the merged model each update) — and both must differ from a
+    fit that ignores the carried prior."""
+    d_g, d_u = 5, 3
+    uids = np.concatenate([np.zeros(24), np.ones(2), np.full(24, 2)]).astype(np.int64)
+    n = len(uids)
+    xg = rng.normal(size=(n, d_g)).astype(np.float32)
+    xu = rng.normal(size=(n, d_u)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    data = GameData(y=y, features={"g": xg, "u": xu}, id_tags={"userId": uids})
+    solver = SolverConfig(max_iters=30, tolerance=1e-8)
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION, num_outer_iterations=2,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "user": RandomEffectConfig(random_effect_type="userId",
+                                       feature_shard="u", solver=solver,
+                                       reg=Regularization(l2=1.0),
+                                       min_active_samples=4)})
+    from photon_ml_tpu.models.game import RandomEffectModel
+
+    prior_w = (rng.normal(size=(1, d_u)) * 2.0).astype(np.float32)
+    prior = GameModel(models={"user": RandomEffectModel(
+        w_stack=prior_w, slot_of={1: 0}, random_effect_type="userId",
+        feature_shard="u", task=TaskType.LOGISTIC_REGRESSION)})
+
+    m_fused = GameEstimator().fit(data, [config], initial_model=prior,
+                                  seed=0)[0].model
+    m_host = GameEstimator(fused=False).fit(data, [config],
+                                            initial_model=prior,
+                                            seed=0)[0].model
+    # entity 1 (under-bound, in prior): carried identically by both paths
+    for m in (m_fused, m_host):
+        np.testing.assert_array_equal(
+            m["user"].w_stack[m["user"].slot_of[1]], prior_w[0])
+    # the FIXED coordinate saw the carried residual identically
+    np.testing.assert_allclose(m_fused["fixed"].coefficients.means,
+                               m_host["fixed"].coefficients.means, atol=2e-4)
+    np.testing.assert_allclose(
+        m_fused["user"].w_stack[m_fused["user"].slot_of[0]],
+        m_host["user"].w_stack[m_host["user"].slot_of[0]], atol=2e-4)
+    # and the carried prior is load-bearing: without it the fixed effect
+    # trains against a different residual
+    m_cold = GameEstimator().fit(data, [config], seed=0)[0].model
+    assert np.max(np.abs(m_cold["fixed"].coefficients.means
+                         - m_fused["fixed"].coefficients.means)) > 1e-3
