@@ -343,3 +343,58 @@ def test_tuner_factory_dispatch():
         tuner_factory("no.such.module:Thing")
     with pytest.raises(ValueError):
         tuner_factory("collections:OrderedDict")  # loads but has no tune()
+
+
+def test_tuning_warm_start_carries_prior_entities(rng):
+    """Bayesian tuning with a warm-start model whose random effect covers an
+    entity absent from (or under-bound in) the tuning data: every tuned fit
+    — through the shared fused program — publishes that entity unchanged,
+    and the carried contribution rides each fit's offsets."""
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import (FixedEffectConfig, GameData,
+                                    GameEstimator, RandomEffectConfig)
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.models.game import GameModel, RandomEffectModel
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.tune import tune_game_model
+    from photon_ml_tpu.types import TaskType
+
+    d_g, d_u = 4, 3
+    # entity 7's TWO training rows are under the bound (4) and the prior
+    # covers it -> the existing-model filter drops it from training and the
+    # prior carries; entities 0/1 train normally (rows placed BEFORE the
+    # validation cut so the lower-bound path actually fires)
+    uids = np.concatenate([np.zeros(24), np.full(2, 7), np.ones(24)]).astype(np.int64)
+    n = len(uids)
+    xg = rng.normal(size=(n, d_g)).astype(np.float32)
+    xu = rng.normal(size=(n, d_u)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    cut = n - 10
+    tr = GameData(y=y[:cut], features={"g": xg[:cut], "u": xu[:cut]},
+                  id_tags={"userId": uids[:cut]})
+    va = GameData(y=y[cut:], features={"g": xg[cut:], "u": xu[cut:]},
+                  id_tags={"userId": uids[cut:]})
+    solver = SolverConfig(max_iters=15)
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION, num_outer_iterations=2,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "user": RandomEffectConfig(random_effect_type="userId",
+                                       feature_shard="u", solver=solver,
+                                       reg=Regularization(l2=1.0),
+                                       min_active_samples=4)})
+    prior_w = (rng.normal(size=(1, d_u)) * 1.5).astype(np.float32)
+    prior = GameModel(models={"user": RandomEffectModel(
+        w_stack=prior_w, slot_of={7: 0}, random_effect_type="userId",
+        feature_shard="u", task=TaskType.LOGISTIC_REGRESSION)})
+    est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
+    best, _search, tuned = tune_game_model(
+        est, config, tr, va, n_iterations=3, mode="bayesian", seed=0,
+        initial_model=prior)
+    assert len(tuned) == 4 and best in tuned
+    for r in tuned:
+        m = r.model["user"]
+        assert 7 in m.slot_of
+        np.testing.assert_array_equal(m.w_stack[m.slot_of[7]], prior_w[0])
