@@ -325,7 +325,12 @@ def _run(args, task, t_start, emitter) -> int:
             spec.template.feature_shard for spec in specs
             if not isinstance(spec.template, FixedEffectConfig)
             and (spec.template.projector == ProjectorType.RANDOM
-                 or spec.template.variance != VarianceComputationType.NONE
+                 # SIMPLE variances are exact under sparse compaction;
+                 # FULL needs the full Hessian, and variance + per-entity
+                 # normalization contexts are refused together
+                 or spec.template.variance == VarianceComputationType.FULL
+                 or (spec.template.variance != VarianceComputationType.NONE
+                     and args.normalization != "NONE")
                  # projected.dim on a non-RANDOM projector was silently
                  # ignored on the dense path; the sparse path rejects it —
                  # keep such configs dense rather than break them

@@ -652,11 +652,12 @@ class RandomEffectCoordinate(Coordinate):
                     f"coordinate {coordinate_id!r}: box constraints are not "
                     "supported with a sparse feature shard (the compact solve "
                     "space has no stable full-dim column alignment)")
-            if config.variance != VarianceComputationType.NONE:
+            if config.variance == VarianceComputationType.FULL:
                 raise NotImplementedError(
-                    f"coordinate {coordinate_id!r}: per-entity variances need "
-                    "a dense feature shard (an unobserved feature's variance "
-                    "is prior-only and the compact space drops it)")
+                    f"coordinate {coordinate_id!r}: FULL variances need the "
+                    "full-dimension Hessian — use a dense shard, or SIMPLE "
+                    "(exact under compaction: observed features from the "
+                    "compact diag, unobserved prior-only 1/λ2)")
             if norm is not None and norm.shifts is not None:
                 raise NotImplementedError(
                     f"coordinate {coordinate_id!r}: shift normalization needs "
@@ -868,12 +869,33 @@ class RandomEffectCoordinate(Coordinate):
         self._vsolve = jax.jit(_vsolve)
 
         kind = self.config.variance
+        # SIMPLE variances are EXACT under observed-column compaction
+        # (sparse shards / INDEX_MAP): diag(H)_jj = Σ w·l''·x_j² + λ2 is
+        # per-feature, margins are compaction-invariant, and an unobserved
+        # feature's curvature is prior-only (λ2).  FULL needs the true d×d
+        # Hessian; RANDOM mixes features so neither is exact there.
+        self._compact_variances = (kind == VarianceComputationType.SIMPLE
+                                   and (self._sparse or self.config.projector
+                                        == ProjectorType.INDEX_MAP))
         if kind != VarianceComputationType.NONE:
-            if self.config.projector != ProjectorType.IDENTITY or self._sparse:
+            if self.config.projector == ProjectorType.RANDOM:
                 raise ValueError(
-                    "per-entity variances are not defined in a projected "
-                    "solve space; use ProjectorType.IDENTITY with a dense "
-                    f"shard (coordinate {self.coordinate_id!r})")
+                    "per-entity variances are not defined under a RANDOM "
+                    "projection (the Gaussian matrix mixes features); use "
+                    "IDENTITY or INDEX_MAP "
+                    f"(coordinate {self.coordinate_id!r})")
+            if (kind == VarianceComputationType.FULL
+                    and (self._sparse
+                         or self.config.projector != ProjectorType.IDENTITY)):
+                raise ValueError(
+                    "FULL variances need the full-dimension Hessian; use "
+                    "ProjectorType.IDENTITY with a dense shard, or SIMPLE "
+                    f"(coordinate {self.coordinate_id!r})")
+            if self._compact_variances and self._norm is not None:
+                raise NotImplementedError(
+                    "SIMPLE variances under compaction do not support "
+                    "per-entity normalization contexts "
+                    f"(coordinate {self.coordinate_id!r})")
             from photon_ml_tpu.opt.solve import compute_variances
 
             def _vvar(w_b, x_b, y_b, off_b, wt_b, reg):
@@ -887,6 +909,30 @@ class RandomEffectCoordinate(Coordinate):
         else:
             self._vvar = None
         self._solver_key = self._make_solver_key()
+
+    def _expand_compact_variances(self, v_compact: Array, bucket_index: int,
+                                  lane_reg: Regularization) -> Array:
+        """[lanes, d_compact] SIMPLE variances -> [lanes, d_full]: observed
+        features carry their computed variance (margin-exact diag), every
+        other feature has prior-only curvature diag(H)_jj = λ2 ⇒ variance
+        1/λ2 (the per-lane effective λ2, so per-entity multipliers are
+        honored).  NOTE: the NTV model format stores nonzero-MEAN features
+        only (reference sparse storage), so prior-only variances live in the
+        in-memory/columnar model but do not survive an NTV save — absent
+        features reload as variance 0, the format's "not estimated" marker.
+        Padded compact slots route OUT of range and drop — a
+        'set' scatter with a duplicate target is order-nondeterministic, so
+        letting them collide with a genuinely observed column 0 could
+        clobber its variance."""
+        idxs = self._proj_dev[bucket_index]  # [lanes, d_compact], -1 padding
+        lanes = v_compact.shape[0]
+        fill = 1.0 / jnp.maximum(
+            jnp.broadcast_to(jnp.asarray(lane_reg.l2, v_compact.dtype),
+                             (lanes,)), 1e-30)
+        out = jnp.broadcast_to(fill[:, None], (lanes, self.dim))
+        safe = jnp.where(idxs < 0, self.dim, idxs)  # out-of-range -> dropped
+        return out.at[jnp.arange(lanes)[:, None], safe].set(
+            v_compact, mode="drop")
 
     def _make_solver_key(self) -> tuple:
         c = self.config
@@ -1021,6 +1067,8 @@ class RandomEffectCoordinate(Coordinate):
                 # same coefficient transform as the means (createModel:89-95)
                 v = self._vvar(res.w, dev["x"], dev["y"],
                                off_b, dev["w"], lane_regs[bi])
+                if self._compact_variances:
+                    v = self._expand_compact_variances(v, bi, lane_regs[bi])
                 variances.append(self._lanes_to_original(v, bi))
 
         if self._proj is not None:
@@ -1239,6 +1287,8 @@ class RandomEffectCoordinate(Coordinate):
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0)
             v = self._vvar(lanes, dev["x"], dev["y"], off_b,
                            dev["w"], lane_regs[bi])
+            if self._compact_variances:
+                v = self._expand_compact_variances(v, bi, lane_regs[bi])
             out.append(self._lanes_to_original(v, bi))
         return tuple(out)
 

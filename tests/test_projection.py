@@ -248,3 +248,47 @@ def test_random_projection_shift_requires_intercept():
     with pytest.raises(ValueError, match="intercept_index"):
         build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION,
                          norm=norm)
+
+
+def test_index_map_simple_variances_match_identity():
+    """SIMPLE variances under INDEX_MAP compaction equal the IDENTITY
+    computation: diag(H) is per-feature and margin-invariant; unobserved
+    features carry prior-only 1/λ2."""
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import GameData
+    from photon_ml_tpu.game.config import RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import (ProjectorType, TaskType,
+                                     VarianceComputationType)
+
+    rng = np.random.default_rng(4)
+    n, d, n_users = 256, 24, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    # per-entity sparsity so INDEX_MAP actually compacts: zero half the
+    # columns per user
+    uids = np.repeat(np.arange(n_users), n // n_users)
+    mask = np.ones((n, d), bool)
+    for u in range(n_users):
+        cols = rng.choice(d, size=d // 2, replace=False)
+        mask[np.ix_(uids == u, cols)] = False
+    x = np.where(mask, x, 0.0).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    gd = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+    l2 = 3.0
+
+    def fit(projector):
+        cfg = RandomEffectConfig(random_effect_type="userId",
+                                 feature_shard="u",
+                                 solver=SolverConfig(max_iters=25),
+                                 reg=Regularization(l2=l2),
+                                 projector=projector,
+                                 variance=VarianceComputationType.SIMPLE)
+        c = build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION)
+        m, _ = c.update(np.zeros(n, np.float32))
+        return m
+
+    m_id = fit(ProjectorType.IDENTITY)
+    m_im = fit(ProjectorType.INDEX_MAP)
+    np.testing.assert_allclose(m_im.w_stack, m_id.w_stack, atol=5e-4)
+    np.testing.assert_allclose(m_im.variances, m_id.variances, rtol=2e-3)

@@ -367,6 +367,61 @@ def test_sparse_re_unsupported_configs_raise():
     with pytest.raises(NotImplementedError, match="RANDOM"):
         _re_coordinate(shard, uids, y, d, projector=ProjectorType.RANDOM,
                        projected_dim=16)
-    with pytest.raises((NotImplementedError, ValueError), match="variance"):
+    # SIMPLE variances are exact under compaction and BUILD; FULL needs the
+    # full-dimension Hessian and refuses
+    c, _ = _re_coordinate(shard, uids, y, d,
+                          variance=VarianceComputationType.SIMPLE)
+    assert c._compact_variances
+    with pytest.raises(NotImplementedError, match="FULL"):
         _re_coordinate(shard, uids, y, d,
-                       variance=VarianceComputationType.SIMPLE)
+                       variance=VarianceComputationType.FULL)
+
+
+def test_sparse_re_simple_variances_exact():
+    """SIMPLE variances under compaction are EXACT: diag(H) is per-feature
+    and margin-invariant, so observed features match the densified IDENTITY
+    computation and unobserved features carry the prior-only curvature
+    1/λ2 — on the host path AND through the fused program."""
+    from photon_ml_tpu.types import VarianceComputationType
+
+    idx, vals, dense, uids, y, d = _sparse_re_data(n=512, d=256, n_users=16)
+    l2 = 2.5
+    def coord(features):
+        from photon_ml_tpu.game.config import RandomEffectConfig
+        from photon_ml_tpu.game.coordinate import build_coordinate
+        from photon_ml_tpu.game.data import GameData
+        from photon_ml_tpu.opt.types import SolverConfig
+
+        cfg = RandomEffectConfig(random_effect_type="userId",
+                                 feature_shard="u",
+                                 solver=SolverConfig(max_iters=25),
+                                 reg=Regularization(l2=l2),
+                                 variance=VarianceComputationType.SIMPLE)
+        gd = GameData(y=y, features={"u": features}, id_tags={"userId": uids})
+        return build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION)
+
+    cs = coord(SparseShard(indices=idx, values=vals, dim=d))
+    cd = coord(dense)
+    off = np.zeros(len(y), np.float32)
+    ms, _ = cs.update(off)
+    md, _ = cd.update(off)
+    np.testing.assert_allclose(ms.w_stack, md.w_stack, atol=5e-4)
+    assert ms.variances is not None and ms.variances.shape == md.variances.shape
+    np.testing.assert_allclose(ms.variances, md.variances, rtol=2e-3)
+    # unobserved features really are prior-only
+    eid0 = sorted(cs.buckets.lane_of)[0]
+    bi, lane = cs.buckets.lane_of[eid0]
+    obs = set(cs._proj.projections[bi].indices[lane].tolist()) - {-1}
+    unobs = [j for j in range(d) if j not in obs][:5]
+    slot = ms.slot_of[eid0]
+    np.testing.assert_allclose(ms.variances[slot][unobs], 1.0 / l2, rtol=1e-5)
+
+    # fused program publishes the same variances
+    import jax.numpy as jnp
+    state = cs.init_sweep_state()
+    sdata = cs.sweep_data()
+    state, _ = cs.trace_update(state, jnp.zeros(len(y), jnp.float32),
+                               data=sdata)
+    v = cs.trace_variances(state, jnp.zeros(len(y), jnp.float32), data=sdata)
+    v_stack = cs.export_variances(v)
+    np.testing.assert_allclose(v_stack, ms.variances, rtol=2e-3)
