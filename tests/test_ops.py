@@ -128,3 +128,38 @@ def test_objective_fused_flag_cpu_fallback(rng):
     np.testing.assert_allclose(g1, g2, rtol=1e-12)
     np.testing.assert_allclose(plain.hvp(w, batch, g1), fused.hvp(w, batch, g2),
                                rtol=1e-12)
+
+
+def test_tpu_checklist_pallas_snippet_interpret():
+    """The one-command TPU capture (tools/tpu_checklist.py) embeds a
+    non-interpret pallas parity snippet that only ever runs on real
+    hardware — keep its MATH pinned green here by executing it in
+    interpret mode (same kernels, interpreter backend)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import tools.tpu_checklist as tc
+
+    def patch(src, old, new):
+        # a reworded snippet must fail HERE (stale patch), not as a
+        # confusing eligibility/kernel error after a silent no-op replace
+        out = src.replace(old, new)
+        assert out != src, f"snippet no longer contains: {old!r}"
+        return out
+
+    src = tc._PALLAS_SRC
+    src = patch(src, "fused_value_and_grad(loss, jnp.asarray(w), b)",
+                "fused_value_and_grad(loss, jnp.asarray(w), b, interpret=True)")
+    src = patch(src, "fused_hvp(loss, jnp.asarray(w), jnp.asarray(v), b)",
+                "fused_hvp(loss, jnp.asarray(w), jnp.asarray(v), b, interpret=True)")
+    src = patch(src, "assert eligible(b)",
+                "assert eligible(b, interpret=True)")
+    captured = {}
+    src = patch(src, "print(json.dumps(out))", "captured['out'] = out")
+    g = {"captured": captured}
+    exec(src, g)
+    out = captured["out"]
+    assert out["pass"], out
+    assert {c["loss"] for c in out["cases"]} == {"logistic", "squared",
+                                                "poisson"}
