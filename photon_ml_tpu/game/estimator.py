@@ -52,9 +52,10 @@ class GameEstimator:
                  normalization: Optional[Dict[str, "NormalizationContext"]] = None,
                  fused: "bool | str" = "auto", dtype=np.float32):
         """``normalization``: per-feature-shard NormalizationContext applied
-        to fixed-effect coordinates (reference GameEstimator normalization
-        wrappers, fit:430-436; models come out in original space).  Living on
-        the estimator (not fit()) so tuning retrains inherit it.
+        to EVERY coordinate on that shard — fixed effects and random effects
+        alike (reference GameEstimator normalization wrappers fit:430-436 +
+        NormalizationContextRDD; models come out in original space).  Living
+        on the estimator (not fit()) so tuning retrains inherit it.
 
         ``fused``: "auto" (default) runs each configuration as ONE jitted
         program (game/fused.FusedSweep — no host round-trips between
