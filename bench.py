@@ -42,6 +42,11 @@ Env knobs:
     PHOTON_BENCH_PROBE_TIMEOUT / PHOTON_BENCH_CONFIG_TIMEOUT (seconds)
     PHOTON_BENCH_CPU_SCALE dataset divisor on the cpu fallback (default 8)
     PHOTON_BENCH_CPU_REF   0 skips scipy stand-ins (vs_baseline null)
+    PHOTON_BENCH_SELF_TIMEOUT  seconds before a child --probe/--config
+                           process SIGALRMs itself; set automatically by the
+                           orchestrator (inside the parent's subprocess
+                           timeout) so a hung device call dies by clean
+                           signal, never by a tunnel-wedging parent SIGKILL
 """
 
 from __future__ import annotations
