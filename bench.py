@@ -228,17 +228,23 @@ def _select_platform(platform: str | None):
     return jax.devices()[0].platform
 
 
-def _measure(thunk, min_repeats=5, max_total=120.0):
+def _measure(thunk, min_repeats=5, max_total=120.0, min_window=0.5):
     """Median-of-repeats timing for an already-warm thunk.
 
     A single sub-second window is dispatch-jitter noise (a 19ms a1a run
     headlined round 2 — VERDICT r2 weak #5), so every config repeats its
-    timed section >=min_repeats times (or until max_total seconds for slow
-    full-scale configs, where each repeat is seconds long anyway) and
-    reports the MEDIAN plus the spread."""
+    timed section >=min_repeats times, and — for thunks so fast that five
+    repeats still measure mostly dispatch (TPU a1a's whole solve is ~0.1ms)
+    — keeps repeating until min_window seconds of samples exist (capped at
+    200 repeats).  Slow full-scale configs stop at max_total seconds; each
+    of their repeats is seconds long anyway.  Reports the MEDIAN + spread."""
     dts = []
     total = 0.0
-    while len(dts) < min_repeats and total < max_total:
+    # 5000-repeat cap: at TPU-a1a's ~0.1ms/solve that still accumulates the
+    # full 0.5s window (a 200 cap would stop at ~20ms of samples and leave
+    # the median dispatch-jitter-bound — the exact failure mode this guards)
+    while (len(dts) < min_repeats or total < min_window) and \
+            total < max_total and len(dts) < 5000:
         dt = thunk()
         dts.append(dt)
         total += dt
@@ -698,6 +704,19 @@ def quality_gate(name: str, stats: dict, ref: dict | None):
 # orchestration
 # --------------------------------------------------------------------------
 
+def _log_child_failure(msg: str) -> None:
+    """Child failures must survive the run: the orchestrator's own stderr is
+    routinely captured-and-discarded by outer harnesses (tpu_checklist), so
+    a silent fused-impl crash would be undiagnosable after the fact."""
+    sys.stderr.write(msg)
+    try:
+        with open(os.path.join(_REPO, ".bench_errors.log"), "a") as f:
+            f.write(f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] "
+                    f"{msg}\n")
+    except OSError:
+        pass
+
+
 def _subprocess_json(args, timeout, env=None):
     try:
         env = dict(env if env is not None else os.environ)
@@ -712,11 +731,11 @@ def _subprocess_json(args, timeout, env=None):
             env=env)
         if out.returncode == 0:
             return json.loads(out.stdout.strip().splitlines()[-1])
-        sys.stderr.write(f"bench {args} failed (rc {out.returncode})\n"
-                         f"{out.stderr[-2000:]}\n")
+        _log_child_failure(f"bench {args} failed (rc {out.returncode})\n"
+                           f"{out.stderr[-2000:]}\n")
     except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError,
             IndexError) as e:
-        sys.stderr.write(f"bench {args} unusable ({type(e).__name__}: {e})\n")
+        _log_child_failure(f"bench {args} unusable ({type(e).__name__}: {e})\n")
     return None
 
 
@@ -785,6 +804,17 @@ def main():
         print(json.dumps({"platform": jax.devices()[0].platform}))
         return
     if a.config:
+        # Emergency brake for a live run: children execute THIS file fresh,
+        # so touching this marker makes the (late-running, full-re-upload)
+        # accelerator storage A/B yield its slot instead of pushing the
+        # whole bench past an outer harness deadline that would destroy
+        # every collected result.  Remove the marker to restore the A/B.
+        if os.environ.get("PHOTON_BENCH_STORAGE") and \
+                (a.platform or "") != "cpu" and \
+                os.path.exists(os.path.join(_REPO,
+                                            ".bench_skip_accel_storage_ab")):
+            sys.stderr.write("storage A/B skipped: marker file present\n")
+            sys.exit(7)
         scale = 1
         if (a.platform or "") == "cpu":
             scale = int(os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
@@ -797,7 +827,12 @@ def main():
         os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
     names = [c.strip() for c in os.environ.get(
         "PHOTON_BENCH_CONFIGS", ",".join(ALL_CONFIGS)).split(",") if c.strip()]
-    to = int(os.environ.get("PHOTON_BENCH_CONFIG_TIMEOUT", 2400))
+    # Accelerator children pay a large, variable upload toll first (the axon
+    # tunnel has been measured in the hundreds-of-KB/s; glmix2's full-scale
+    # design is ~550MB ≈ 30min of transfer), so their timeout default is
+    # roomier than the cpu fallback's.
+    to = int(os.environ.get("PHOTON_BENCH_CONFIG_TIMEOUT",
+                            2400 if platform == "cpu" else 4500))
     want_cpu_ref = os.environ.get("PHOTON_BENCH_CPU_REF", "1") != "0"
 
     configs = {}
@@ -819,10 +854,15 @@ def main():
             continue
         configs[name] = _entry_from(name, got, scale, want_cpu_ref)
 
+    # PHOTON_BENCH_AB=0 skips every A/B block: a recovery window on a flaky
+    # accelerator should bank the missing headline configs first, not spend
+    # the window re-uploading glmix2's dataset three more times.
+    want_ab = os.environ.get("PHOTON_BENCH_AB", "1") != "0"
+
     # fused-vs-host A/B (EVERY backend, cpu included): the headline glmix2
     # measures the better impl per backend; the other one is recorded too so
     # the gap itself is data, not an unvalidated claim (VERDICT r2 weak #4).
-    if "value" in configs.get("glmix2", {}) and \
+    if want_ab and "value" in configs.get("glmix2", {}) and \
             not os.environ.get("PHOTON_BENCH_IMPL"):
         head_impl = configs["glmix2"].get("impl", "fused")
         alt = "host" if head_impl == "fused" else "fused"
@@ -845,7 +885,7 @@ def main():
     # and honest cost — software-emulated bf16 matmuls lose ~2.5x there,
     # while TPU MXUs take bf16 operands natively).  All variants reuse
     # glmix2's data/loop/baseline so the deltas are pure.
-    if "value" in configs.get("glmix2", {}):
+    if want_ab and "value" in configs.get("glmix2", {}):
         head_impl = configs["glmix2"].get("impl", "fused")
         variants = [("glmix2_bf16", {"PHOTON_BENCH_STORAGE": "bfloat16"})]
         if head_impl == "fused" and platform != "cpu":
@@ -853,6 +893,13 @@ def main():
             # under the host-loop fallback the A/B would re-fail fused twice
             variants.insert(0, ("glmix2_xla", {"PHOTON_GLM_DISABLE_PALLAS": "1"}))
         for vname, extra_env in variants:
+            if "PHOTON_BENCH_STORAGE" in extra_env and platform != "cpu" and \
+                    os.path.exists(os.path.join(
+                        _REPO, ".bench_skip_accel_storage_ab")):
+                # record the deliberate skip as a skip, not as a child crash
+                configs[vname] = {
+                    "skipped": "storage A/B brake marker present"}
+                continue
             env = os.environ.copy()
             env["PHOTON_BENCH_IMPL"] = head_impl
             env.update(extra_env)
@@ -876,12 +923,19 @@ def main():
                          "take bf16 natively" if platform == "cpu" else
                          "compare vs the (plain-XLA host) glmix2 headline"))
 
-    # headline: config #3 (same metric as round 1), else first success
+    # headline: config #3 (same metric as round 1), else first success —
+    # with the metric RE-LABELED to the substituted config so a fallback
+    # never presents another config's number as the GLMix headline
     head = configs.get("glmix2")
+    head_name = "glmix2"
     if not head or "value" not in head:
-        head = next((c for c in configs.values() if "value" in c), None)
+        head_name, head = next(
+            ((n, c) for n, c in configs.items() if "value" in c),
+            ("glmix2", None))
     line = {
-        "metric": "glmix_2coord_examples_per_sec_per_chip",
+        "metric": ("glmix_2coord_examples_per_sec_per_chip"
+                   if head_name == "glmix2" else
+                   f"{head_name}_throughput (glmix2 headline unavailable)"),
         "value": head["value"] if head else 0.0,
         "unit": head["unit"] if head else "examples/sec/chip",
         "vs_baseline": head.get("vs_baseline") if head else None,

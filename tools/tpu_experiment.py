@@ -1,0 +1,214 @@
+"""Single-process TPU experiment bank for slow-tunnel iteration.
+
+The axon tunnel moves bytes at roughly hundreds of KB/s (measured by the
+bandwidth stage below), so every fresh process pays minutes-to-tens-of-minutes
+of upload before the first useful result.  bench.py's one-subprocess-per-config
+layout is right for the official artifact (wedge isolation) but hopeless for
+iterating: this script instead runs MANY experiments in ONE process so each
+dataset uploads once, and appends every stage's result to TPU_EXPERIMENTS.json
+as it lands (a later hang can't destroy earlier evidence).
+
+  python tools/tpu_experiment.py              # all stages, scale 8
+  PHOTON_EXP_SCALE=4 python tools/tpu_experiment.py bw glmix
+  PHOTON_EXP_STAGES=bw,glmix,a1a python tools/tpu_experiment.py
+
+Stages:
+  bw     — device_put bandwidth (8MB + 64MB) and tiny-matmul dispatch latency
+  a1a    — BASELINE #1 solve, timed (small upload; quick signal the chip works)
+  glmix  — glmix2 at 1/PHOTON_EXP_SCALE dataset scale: upload timed, then
+           fused vs host vs fused-without-pallas vs bf16-storage, each
+           compile-timed and run-timed separately, with full tracebacks on
+           failure (the checklist's full-scale fused crash left no message)
+
+A global SIGALRM (PHOTON_EXP_TIMEOUT, default 5400s) uses the DEFAULT signal
+action — process death, kernel-delivered even inside a hung device RPC — so a
+wedged tunnel costs the timeout, never a hang, and never a parent-side SIGKILL
+(which is what wedges the tunnel for everyone else).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+import traceback
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+_OUT = os.path.join(_REPO, "TPU_EXPERIMENTS.json")
+
+signal.alarm(int(os.environ.get("PHOTON_EXP_TIMEOUT", 5400)))
+
+_RESULTS: dict = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                  "scale": int(os.environ.get("PHOTON_EXP_SCALE", 8)),
+                  "stages": {}}
+
+
+def _save() -> None:
+    tmp = _OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_RESULTS, f, indent=1)
+    os.replace(tmp, _OUT)
+
+
+def _stage(name):
+    """Decorator: run stage, record result-or-traceback, persist, continue."""
+    def wrap(fn):
+        def run():
+            t0 = time.perf_counter()
+            print(f"== {name} ==", flush=True)
+            try:
+                out = fn()
+                out = out if isinstance(out, dict) else {"ok": True}
+            except Exception:
+                out = {"error": traceback.format_exc()[-4000:]}
+            out["stage_sec"] = round(time.perf_counter() - t0, 2)
+            _RESULTS["stages"][name] = out
+            _save()
+            print(json.dumps({name: out})[:600], flush=True)
+        return run
+    return wrap
+
+
+def _timed(fn, *args):
+    import jax
+
+    t0 = time.perf_counter()
+    r = fn(*args)
+    jax.block_until_ready(r)
+    return r, time.perf_counter() - t0
+
+
+def _median_time(fn, repeats=3):
+    import numpy as np
+
+    ts = []
+    for _ in range(repeats):
+        _, dt = _timed(fn)
+        ts.append(dt)
+    return float(np.median(ts)), [round(t, 4) for t in ts]
+
+
+@_stage("bw")
+def stage_bw():
+    import jax
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    out = {"platform": platform}
+    for mb in (8, 64):
+        a = np.random.default_rng(0).random((mb * 1024 * 1024 // 4,),
+                                            dtype=np.float32)
+        _, dt = _timed(jax.device_put, a)
+        out[f"upload_{mb}mb_sec"] = round(dt, 2)
+        out[f"upload_{mb}mb_mbps"] = round(mb / dt, 2)
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: a @ a)
+    _, compile_dt = _timed(f, x)
+    med, _ = _median_time(lambda: f(x), repeats=10)
+    out["tiny_matmul_compile_sec"] = round(compile_dt, 2)
+    out["tiny_matmul_dispatch_sec"] = round(med, 4)
+    return out
+
+
+@_stage("a1a")
+def stage_a1a():
+    sys.path.insert(0, _REPO)
+    import bench
+
+    return bench.run_a1a(None, 1)
+
+
+def _glmix_data_coords(scale: int):
+    import bench
+
+    data = bench.synth_glmix(scale, three=False)
+    return data, bench._glmix_coords(data, three=False)
+
+
+@_stage("glmix")
+def stage_glmix():
+    import jax
+    import numpy as np
+
+    import bench
+
+    scale = _RESULTS["scale"]
+    out = {"scale": scale}
+
+    t0 = time.perf_counter()
+    data = bench.synth_glmix(scale, three=False)
+    out["synth_sec"] = round(time.perf_counter() - t0, 2)
+    n = len(data["y"])
+    mb = sum(v.nbytes for v in data.values()) / 1e6
+    out["n"] = n
+    out["data_mb"] = round(mb, 1)
+
+    t0 = time.perf_counter()
+    dev = {k: jax.device_put(v) for k, v in data.items()}
+    jax.block_until_ready(dev)
+    dt = time.perf_counter() - t0
+    out["upload_sec"] = round(dt, 2)
+    out["upload_mbps"] = round(mb / dt, 2)
+    data = {k: np.asarray(v) for k, v in data.items()}  # coords re-stage
+
+    variants = [("fused", {}), ("fused_xla", {"PHOTON_GLM_DISABLE_PALLAS": "1"}),
+                ("host", {}), ("bf16", {"PHOTON_BENCH_STORAGE": "bfloat16"})]
+    for vname, env in variants:
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            coords = bench._glmix_coords(data, three=False)
+            v = {}
+            if vname == "host":
+                from photon_ml_tpu.game import CoordinateDescent
+
+                driver = CoordinateDescent(coords, num_iterations=bench.OUTER)
+                t0 = time.perf_counter()
+                driver.run()
+                v["warmup_sec"] = round(time.perf_counter() - t0, 2)
+                med, ts = _median_time(lambda: driver.run(), repeats=3)
+            else:
+                from photon_ml_tpu.game.fused import FusedSweep
+
+                sweep = FusedSweep(coords, num_iterations=bench.OUTER)
+                t0 = time.perf_counter()
+                sweep.run()
+                v["warmup_sec"] = round(time.perf_counter() - t0, 2)
+                med, ts = _median_time(lambda: sweep.run(), repeats=3)
+            v["median_sec"] = round(med, 4)
+            v["times"] = ts
+            v["examples_per_sec"] = round(n * bench.OUTER / med, 1)
+            out[vname] = v
+        except Exception:
+            out[vname] = {"error": traceback.format_exc()[-4000:]}
+        finally:
+            for k, val in old.items():
+                if val is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = val
+        _RESULTS["stages"]["glmix"] = out
+        _save()
+        print(json.dumps({vname: out.get(vname)})[:400], flush=True)
+    return out
+
+
+STAGES = {"bw": stage_bw, "a1a": stage_a1a, "glmix": stage_glmix}
+
+
+def main() -> int:
+    names = sys.argv[1:] or [s.strip() for s in os.environ.get(
+        "PHOTON_EXP_STAGES", "bw,a1a,glmix").split(",") if s.strip()]
+    for name in names:
+        STAGES[name]()
+    print(f"done -> {_OUT}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
