@@ -213,13 +213,16 @@ class FixedEffectCoordinate(Coordinate):
         # resident array are storage-width from the start (an on-device cast
         # would transfer f32 and transiently hold both copies in HBM).
         x_dtype = _storage_np_dtype(config.storage_dtype) or dtype
+        # The design matrix is the one giant host->device transfer in a fit;
+        # chunked_device_put bounds each RPC on fragile transports (axon).
+        from photon_ml_tpu.utils.transfer import chunked_device_put
         if isinstance(shard_data, SparseShard):
             batch = SparseBatch(
-                indices=jnp.asarray(shard_data.indices),
-                values=jnp.asarray(np.asarray(shard_data.values, x_dtype)),
+                indices=chunked_device_put(shard_data.indices),
+                values=chunked_device_put(shard_data.values, x_dtype),
                 y=y, offset=offs0, weight=wt0, dim=shard_data.dim)
         else:
-            batch = DenseBatch(x=jnp.asarray(np.asarray(shard_data, x_dtype)),
+            batch = DenseBatch(x=chunked_device_put(shard_data, x_dtype),
                                y=y, offset=offs0, weight=wt0)
         # One-time row padding to the fused-kernel block granule so the
         # pallas path never re-pads (and re-copies X) per solver call.
@@ -710,13 +713,16 @@ class RandomEffectCoordinate(Coordinate):
         ]
         self._entity_ids = np.asarray(entity_ids, np.int64)
         self._sample_slots = jnp.asarray(_slots_from(self._slot_of, self._entity_ids))
+        # full-sample arrays are the random-effect coordinate's giant
+        # host->device transfer — bounded-RPC chunked like the fixed effect's
+        from photon_ml_tpu.utils.transfer import chunked_device_put
         if self._sparse:
             # full-sample scoring stays sparse: [n, k] gather arrays, never
             # an [n, d_full] densified design (score_samples_sparse)
-            self._x_idx_dev = jnp.asarray(np.asarray(shard_data.indices, np.int32))
-            self._x_val_dev = jnp.asarray(np.asarray(shard_data.values, dtype))
+            self._x_idx_dev = chunked_device_put(shard_data.indices, np.int32)
+            self._x_val_dev = chunked_device_put(shard_data.values, dtype)
         else:
-            self._x_full = jnp.asarray(x)
+            self._x_full = chunked_device_put(x)
 
         # Optional per-entity feature projection (reference
         # RandomEffectCoordinateInProjectedSpace.scala:149): solve each bucket
@@ -768,9 +774,11 @@ class RandomEffectCoordinate(Coordinate):
         # devices (the reference's balanced entity partitioner,
         # RandomEffectDatasetPartitioner.scala:30-171).
         def put(a):
-            a = jnp.asarray(a)
             if mesh is None:
-                return a
+                # single-device: bucket design tensors can be large — use the
+                # bounded-RPC chunked transfer (utils/transfer.py)
+                return chunked_device_put(np.asarray(a))
+            a = jnp.asarray(a)
             spec = PartitionSpec(tuple(mesh.axis_names), *([None] * (a.ndim - 1)))
             return jax.device_put(a, NamedSharding(mesh, spec))
 

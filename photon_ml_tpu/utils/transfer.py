@@ -1,0 +1,81 @@
+"""Chunked host->device transfer for slow / fragile transports.
+
+The axon tunnel moves bytes at hundreds of KB/s, and its first observed
+degradation followed the bench's first ~512MB single-shot design-matrix
+upload (BASELINE.md round-3 notes).  A monolithic ``jnp.asarray(big)``
+gives the transport one giant buffer to swallow with no observability; this
+helper slices the leading axis into ~chunk_bytes pieces, blocks after each,
+and assembles on device — same bytes, but each RPC is bounded, progress is
+loggable, and a mid-transfer failure surfaces at the failing chunk instead
+of an opaque hang.
+
+Assembly uses a DONATED ``lax.dynamic_update_slice`` per chunk, so the
+device-memory peak is output + one chunk — NOT output + all chunks as a
+``jnp.concatenate`` would give (the design matrix must never be
+double-resident in HBM; see the storage-narrowing note at its call site in
+game/coordinate.py).
+
+Used for arrays above PHOTON_CHUNKED_PUT_MIN_MB (default 64; 0 disables
+chunking).  Covers every design-matrix upload: fixed-effect dense/sparse
+shards AND random-effect full-sample arrays route through here.  The
+reference has no analog — Spark ships partitions to executors; here the
+full design matrix rides HBM (SURVEY.md §2.7 broadcast -> SPMD
+replication) and these are the places those bytes cross the wire.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_LOG = logging.getLogger("photon_ml_tpu.transfer")
+
+
+def _min_bytes() -> int:
+    return int(float(os.environ.get("PHOTON_CHUNKED_PUT_MIN_MB", "64"))
+               * 1024 * 1024)
+
+
+def _update_rows(out: jax.Array, part: jax.Array, lo: int) -> jax.Array:
+    """Donated row-slice write: reuses ``out``'s buffer, so assembling N
+    chunks never holds more than output + one chunk on device."""
+    start = (lo,) + (0,) * (out.ndim - 1)
+    return jax.jit(lax.dynamic_update_slice,
+                   donate_argnums=0)(out, part, start)
+
+
+def chunked_device_put(arr: np.ndarray, dtype=None,
+                       chunk_bytes: int = 32 * 1024 * 1024) -> jax.Array:
+    """``jnp.asarray(np.asarray(arr, dtype))`` with bounded transfer RPCs.
+
+    Small arrays (below PHOTON_CHUNKED_PUT_MIN_MB) and rank-0 arrays take
+    the direct path; large ones upload in leading-axis slices of about
+    ``chunk_bytes`` each (always >=1 row), written into a preallocated
+    device buffer via donation.
+    """
+    arr = np.asarray(arr, dtype)
+    min_bytes = _min_bytes()
+    if min_bytes <= 0 or arr.nbytes <= min_bytes or arr.ndim == 0 or \
+            arr.shape[0] <= 1:
+        return jnp.asarray(arr)
+    row_bytes = max(1, arr.nbytes // arr.shape[0])
+    rows = max(1, chunk_bytes // row_bytes)
+    t0 = time.perf_counter()
+    out = jnp.zeros(arr.shape, arr.dtype)
+    n_chunks = 0
+    for lo in range(0, arr.shape[0], rows):
+        part = jnp.asarray(arr[lo:lo + rows])
+        part.block_until_ready()
+        out = _update_rows(out, part, lo)
+        n_chunks += 1
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    _LOG.info("chunked_device_put: %.1fMB in %d chunks, %.1fs (%.2fMB/s)",
+              arr.nbytes / 1e6, n_chunks, dt, arr.nbytes / 1e6 / max(dt, 1e-9))
+    return out
