@@ -375,11 +375,8 @@ def _glmix_coords(data, three: bool):
 
 def run_glmix(platform, scale, three: bool):
     """BASELINE #3/#4: GLMix coordinate-descent sweep throughput."""
-    import jax
-
     backend = _select_platform(platform)
     data = synth_glmix(scale, three)
-    coords = _glmix_coords(data, three)
     # measured default: the fused whole-descent program wins EVERYWHERE now.
     # Round 2's "host ~2x ahead on CPU" and round 3's early "~1.3x at full
     # scale" readings were both artifacts of the same root cause: the
@@ -391,6 +388,16 @@ def run_glmix(platform, scale, three: bool):
     # (down from 54s/40s).  The orchestrator still records BOTH impls
     # (glmix2_{fused,host}) every run so the claim stays measured.
     impl = os.environ.get("PHOTON_BENCH_IMPL", "fused")
+    return _glmix_measure(backend, data, three, impl)
+
+
+def _glmix_measure(backend, data, three: bool, impl: str):
+    """Measure one glmix impl over (possibly device-resident) data.
+
+    Split from run_glmix so the --ab-chain child can measure several
+    variants over ONE design-matrix upload (the axon tunnel's data plane
+    makes per-variant re-uploads the dominant cost)."""
+    coords = _glmix_coords(data, three)
     if impl == "fused":
         from photon_ml_tpu.game.fused import FusedSweep
 
@@ -437,6 +444,46 @@ def run_glmix(platform, scale, three: bool):
         "flops_est": OUTER * SOLVER_ITERS * 4 * n * d_sum,
         "stats": {"auc": _np_auc(data["y"], np.asarray(total))},
     }
+
+
+def run_glmix2_ab_chain(platform, scale):
+    """One child, ONE design upload, three measurements: glmix2 fused (the
+    headline), host-loop, and fused-without-pallas.  Prints one JSON line
+    per variant AS IT LANDS (flushed), so a mid-chain device wedge loses
+    only the variants after it — the parent parses every line it got.
+
+    Exists for slow transports: the per-variant child layout re-uploads the
+    ~550MB full-scale design once per variant (~30min each on the axon
+    tunnel); here the fixed-effect shard is chunk-uploaded once and shared
+    via the device-array passthrough in utils/transfer.chunked_device_put.
+    bf16 storage stays a separate child — its upload is different bytes
+    (host-narrowed)."""
+    import traceback
+
+    backend = _select_platform(platform)
+    data = dict(synth_glmix(scale, three=False))
+    from photon_ml_tpu.utils.transfer import chunked_device_put
+
+    data["xg"] = chunked_device_put(data["xg"])  # the giant shard, once
+    variants = (("glmix2", "fused", {}),
+                ("glmix2_host", "host", {}),
+                ("glmix2_xla", "fused", {"PHOTON_GLM_DISABLE_PALLAS": "1"}))
+    for name, impl, extra in variants:
+        old = {k: os.environ.get(k) for k in extra}
+        os.environ.update(extra)
+        try:
+            got = _glmix_measure(backend, data, False, impl)
+            print(json.dumps({"variant": name, **got}), flush=True)
+        except Exception:
+            print(json.dumps({"variant": name,
+                              "error": traceback.format_exc()[-2000:]}),
+                  flush=True)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
 
 def run_gp_tune(platform, scale):
@@ -739,6 +786,38 @@ def _subprocess_json(args, timeout, env=None):
     return None
 
 
+def _subprocess_json_lines(args, timeout, env=None):
+    """Run a child that emits one JSON object per stdout line; parse EVERY
+    parseable line, even when the child died mid-stream (a wedged device
+    call should cost the un-emitted variants, not the finished ones)."""
+    env = dict(env if env is not None else os.environ)
+    env["PHOTON_BENCH_SELF_TIMEOUT"] = str(
+        max(1, timeout - (30 if timeout > 60 else 5)))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, timeout=timeout, cwd=_REPO,
+            env=env)
+        stdout, rc = out.stdout, out.returncode
+        if rc != 0:
+            _log_child_failure(f"bench {args} died (rc {rc}) after emitting "
+                               f"{stdout.count(chr(10))} lines\n"
+                               f"{out.stderr[-2000:]}\n")
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or b"").decode() if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        _log_child_failure(f"bench {args} hard-timeout after {timeout}s\n")
+    lines = []
+    for ln in stdout.splitlines():
+        try:
+            parsed = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            lines.append(parsed)
+    return lines
+
+
 def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
     """Per-config result entry: throughput, baseline ratio, quality gate,
     FLOP/MFU estimates."""
@@ -786,6 +865,9 @@ def main():
     ap.add_argument("--probe", action="store_true")
     ap.add_argument("--config", choices=list(RUNNERS))
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--ab-chain", action="store_true",
+                    help="with --config glmix2: measure fused/host/xla over "
+                         "one design upload, one JSON line per variant")
     a = ap.parse_args()
 
     # Child modes self-timeout via SIGALRM: kernel-delivered even while
@@ -818,6 +900,9 @@ def main():
         scale = 1
         if (a.platform or "") == "cpu":
             scale = int(os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
+        if a.ab_chain:
+            run_glmix2_ab_chain(a.platform, scale)  # prints its own lines
+            return
         print(json.dumps(RUNNERS[a.config](a.platform, scale)))
         return
 
@@ -835,11 +920,68 @@ def main():
                             2400 if platform == "cpu" else 4500))
     want_cpu_ref = os.environ.get("PHOTON_BENCH_CPU_REF", "1") != "0"
 
+    # PHOTON_BENCH_AB=0 skips every A/B block: a recovery window on a flaky
+    # accelerator should bank the missing headline configs first, not spend
+    # the window re-uploading glmix2's dataset three more times.
+    want_ab = os.environ.get("PHOTON_BENCH_AB", "1") != "0"
+
     configs = {}
     fused_failed = set()
+    chain_done = False
     # every child of a cpu-fallback run gets the same platform override
     plat_args = ["--platform", "cpu"] if platform == "cpu" else []
     for name in names:
+        if name == "glmix2" and want_ab and platform != "cpu" and \
+                not os.environ.get("PHOTON_BENCH_IMPL"):
+            # Accelerator A/Bs ride ONE child / ONE design upload
+            # (run_glmix2_ab_chain): per-variant children would re-upload
+            # the ~550MB design once each over the slow tunnel.  Every
+            # variant line the child managed to emit is kept, so a wedge
+            # costs only the un-run variants.
+            lines = _subprocess_json_lines(
+                ["--config", "glmix2", "--ab-chain"], timeout=to + 1800)
+            by = {ln.pop("variant"): ln for ln in lines if "variant" in ln}
+            fused = by.get("glmix2")
+            host = by.get("glmix2_host")
+            if fused and "error" not in fused:
+                configs["glmix2"] = _entry_from("glmix2", fused, scale,
+                                                want_cpu_ref)
+                # fused-vs-host stays recorded data even when host failed
+                configs["glmix2_host"] = (
+                    _entry_from("glmix2", host, scale, want_cpu_ref)
+                    if host and "error" not in host else
+                    {"error": (host or {}).get(
+                        "error", "not emitted (chain died earlier)")[-500:]})
+            elif host and "error" not in host:
+                sys.stderr.write("glmix2: fused failed in chain; host "
+                                 "impl is the headline\n")
+                fused_failed.add("glmix2")
+                configs["glmix2"] = _entry_from("glmix2", host, scale,
+                                                want_cpu_ref)
+                configs["glmix2_fused"] = {
+                    "error": (fused or {}).get("error", "not emitted")[-500:]}
+            else:
+                # chain yielded no usable measurement (e.g. child wedged and
+                # died before any line): keep the old recovery property —
+                # one fresh host-impl child gets a clean shot at a headline
+                sys.stderr.write("glmix2: chain yielded nothing; retrying "
+                                 "host loop in a fresh child\n")
+                fused_failed.add("glmix2")
+                env = os.environ.copy()
+                env["PHOTON_BENCH_IMPL"] = "host"
+                got = _subprocess_json(["--config", "glmix2"] + plat_args,
+                                       timeout=to, env=env)
+                configs["glmix2"] = (
+                    _entry_from("glmix2", got, scale, want_cpu_ref)
+                    if got else {"error": "failed or timed out"})
+            xla = by.get("glmix2_xla")
+            if xla is not None:
+                configs["glmix2_xla"] = (
+                    _entry_from("glmix2", xla, scale, want_cpu_ref)
+                    if "error" not in xla else
+                    {"error": xla["error"][-500:]})
+            chain_done = True
+            continue
         args = ["--config", name] + plat_args
         got = _subprocess_json(args, timeout=to)
         if got is None and name in ("glmix2", "glmix3") and \
@@ -854,15 +996,11 @@ def main():
             continue
         configs[name] = _entry_from(name, got, scale, want_cpu_ref)
 
-    # PHOTON_BENCH_AB=0 skips every A/B block: a recovery window on a flaky
-    # accelerator should bank the missing headline configs first, not spend
-    # the window re-uploading glmix2's dataset three more times.
-    want_ab = os.environ.get("PHOTON_BENCH_AB", "1") != "0"
-
     # fused-vs-host A/B (EVERY backend, cpu included): the headline glmix2
     # measures the better impl per backend; the other one is recorded too so
     # the gap itself is data, not an unvalidated claim (VERDICT r2 weak #4).
-    if want_ab and "value" in configs.get("glmix2", {}) and \
+    # (The accelerator chain above already banked host+xla in one child.)
+    if want_ab and not chain_done and "value" in configs.get("glmix2", {}) and \
             not os.environ.get("PHOTON_BENCH_IMPL"):
         head_impl = configs["glmix2"].get("impl", "fused")
         alt = "host" if head_impl == "fused" else "fused"
@@ -888,9 +1026,10 @@ def main():
     if want_ab and "value" in configs.get("glmix2", {}):
         head_impl = configs["glmix2"].get("impl", "fused")
         variants = [("glmix2_bf16", {"PHOTON_BENCH_STORAGE": "bfloat16"})]
-        if head_impl == "fused" and platform != "cpu":
+        if head_impl == "fused" and platform != "cpu" and not chain_done:
             # pallas-vs-XLA only makes sense on the impl that actually ran;
             # under the host-loop fallback the A/B would re-fail fused twice
+            # (the accelerator chain banks this variant itself)
             variants.insert(0, ("glmix2_xla", {"PHOTON_GLM_DISABLE_PALLAS": "1"}))
         for vname, extra_env in variants:
             if "PHOTON_BENCH_STORAGE" in extra_env and platform != "cpu" and \

@@ -776,8 +776,9 @@ class RandomEffectCoordinate(Coordinate):
         def put(a):
             if mesh is None:
                 # single-device: bucket design tensors can be large — use the
-                # bounded-RPC chunked transfer (utils/transfer.py)
-                return chunked_device_put(np.asarray(a))
+                # bounded-RPC chunked transfer (utils/transfer.py), which
+                # passes already-device-resident arrays straight through
+                return chunked_device_put(a)
             a = jnp.asarray(a)
             spec = PartitionSpec(tuple(mesh.axis_names), *([None] * (a.ndim - 1)))
             return jax.device_put(a, NamedSharding(mesh, spec))

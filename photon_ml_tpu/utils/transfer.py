@@ -42,12 +42,19 @@ def _min_bytes() -> int:
                * 1024 * 1024)
 
 
+# Module-level jit: one wrapper, one compile per (shape-pair) — a fresh
+# jax.jit per chunk would retrace/recompile identical programs chunk after
+# chunk, each an extra control-plane RPC on the transport this exists to
+# relieve.  The start index is traced, so successive offsets reuse the
+# compiled program; only the ragged last chunk adds a second compile.
+_UPDATE = jax.jit(lax.dynamic_update_slice, donate_argnums=0)
+
+
 def _update_rows(out: jax.Array, part: jax.Array, lo: int) -> jax.Array:
     """Donated row-slice write: reuses ``out``'s buffer, so assembling N
     chunks never holds more than output + one chunk on device."""
     start = (lo,) + (0,) * (out.ndim - 1)
-    return jax.jit(lax.dynamic_update_slice,
-                   donate_argnums=0)(out, part, start)
+    return _UPDATE(out, part, start)
 
 
 def chunked_device_put(arr: np.ndarray, dtype=None,
@@ -59,6 +66,13 @@ def chunked_device_put(arr: np.ndarray, dtype=None,
     ``chunk_bytes`` each (always >=1 row), written into a preallocated
     device buffer via donation.
     """
+    if isinstance(arr, jax.Array):
+        # Already device-resident (e.g. one upload shared across bench A/B
+        # variants): never round-trip through host. Dtype mismatch casts
+        # on device — transiently double-resident, so callers that care
+        # about storage narrowing should upload narrowed host bytes instead.
+        want = jnp.dtype(dtype) if dtype is not None else arr.dtype
+        return arr if arr.dtype == want else arr.astype(want)
     arr = np.asarray(arr, dtype)
     min_bytes = _min_bytes()
     if min_bytes <= 0 or arr.nbytes <= min_bytes or arr.ndim == 0 or \

@@ -103,3 +103,44 @@ class TestSynth:
         assert xg1.shape[0] == 65536
         data = bench.synth_glmix(8, False)
         assert data["xg"].shape == (2048 * 32, 256)
+
+
+class TestAbChain:
+    def test_chain_lines_parse_and_cover_variants(self):
+        """The accelerator A/B chain child emits one JSON line per variant
+        over ONE design upload; the parent must recover every emitted line
+        (even from a partially-dead child, which this parse path tolerates
+        by skipping unparseable tails)."""
+        import os
+
+        env = dict(os.environ, PHOTON_BENCH_CPU_SCALE="64", PYTHONPATH="")
+        lines = bench._subprocess_json_lines(
+            ["--config", "glmix2", "--ab-chain", "--platform", "cpu"],
+            timeout=520, env=env)
+        by = {ln["variant"]: ln for ln in lines if "variant" in ln}
+        assert set(by) == {"glmix2", "glmix2_host", "glmix2_xla"}
+        for v in by.values():
+            assert "error" not in v, v["error"]
+            assert v["units"] > 0 and v["dt"] > 0
+
+    def test_json_lines_keeps_lines_from_dead_child(self, tmp_path,
+                                                    monkeypatch):
+        """A child that emits valid lines then dies nonzero must still
+        yield its emitted lines (wedge costs the un-run variants only)."""
+        import subprocess
+
+        real_run = subprocess.run
+
+        def fake_run(argv, **kw):
+            class R:
+                returncode = 1
+                stdout = ('noise\n{"variant": "a", "x": 1}\n'
+                          'WARN xyz\n{"variant": "b"}\n')
+                stderr = "boom"
+            return R()
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        monkeypatch.setattr(bench, "_REPO", str(tmp_path))  # error log target
+        lines = bench._subprocess_json_lines(["--config", "x"], timeout=5)
+        assert [d["variant"] for d in lines] == ["a", "b"]
+        assert "boom" in (tmp_path / ".bench_errors.log").read_text()
