@@ -387,8 +387,38 @@ def run_glmix(platform, scale, three: bool):
     # scale fused 0.48s vs host 0.52s; full scale fused 5.2s vs host 5.6s
     # (down from 54s/40s).  The orchestrator still records BOTH impls
     # (glmix2_{fused,host}) every run so the claim stays measured.
-    impl = os.environ.get("PHOTON_BENCH_IMPL", "fused")
-    return _glmix_measure(backend, data, three, impl)
+    # Upload the fixed-effect shard (the giant one) ONCE up front; the
+    # random-effect shards stay host-side for bucketing.  Both the fused
+    # attempt and the in-process host fallback below then share it via the
+    # device-array passthrough in chunked_device_put.
+    from photon_ml_tpu.utils.transfer import chunked_device_put
+
+    if not os.environ.get("PHOTON_BENCH_STORAGE"):
+        # Narrowed-storage (bf16) runs upload host-narrowed bytes inside
+        # coords construction — half the wire traffic; pre-uploading f32
+        # here would double it AND leave f32+narrow copies in HBM.
+        data = dict(data)
+        data["xg"] = chunked_device_put(data["xg"])
+    impl = os.environ.get("PHOTON_BENCH_IMPL")
+    if impl:
+        return _glmix_measure(backend, data, three, impl)
+    try:
+        return _glmix_measure(backend, data, three, "fused")
+    except Exception:
+        # In-process fallback keeps the already-uploaded design (a fresh
+        # child would re-pay the tunnel's upload toll); the parent's
+        # fresh-child host retry remains for child-death failures.  The
+        # result carries fused_error so the PARENT can log it and skip the
+        # pointless fused re-run in the A/B block (child stderr is
+        # discarded on rc 0).
+        import traceback
+
+        tb = traceback.format_exc()
+        sys.stderr.write("glmix fused impl failed in-process; host fallback\n"
+                         + tb[-2000:] + "\n")
+        got = _glmix_measure(backend, data, three, "host")
+        got["fused_error"] = tb[-500:]
+        return got
 
 
 def _glmix_measure(backend, data, three: bool, impl: str):
@@ -835,6 +865,8 @@ def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
         entry["timing"] = got["timing"]
     if got.get("impl"):
         entry["impl"] = got["impl"]
+    if got.get("fused_error"):
+        entry["fused_error"] = got["fused_error"]
     if got.get("flops_est"):
         entry["gflops_per_sec"] = round(got["flops_est"] / dt / 1e9, 1)
         entry["mfu_bf16_peak"] = round(got["flops_est"] / dt / PEAK_BF16, 5)
@@ -901,6 +933,8 @@ def main():
         if (a.platform or "") == "cpu":
             scale = int(os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
         if a.ab_chain:
+            if a.config != "glmix2":
+                ap.error("--ab-chain only supports --config glmix2")
             run_glmix2_ab_chain(a.platform, scale)  # prints its own lines
             return
         print(json.dumps(RUNNERS[a.config](a.platform, scale)))
@@ -994,6 +1028,14 @@ def main():
         if got is None:
             configs[name] = {"error": "failed or timed out"}
             continue
+        if got.get("fused_error"):
+            # the child fell back to host IN-PROCESS: surface the fused
+            # crash (child stderr is discarded on rc 0) and stop the A/B
+            # block from burning a timeout re-confirming the failure
+            fused_failed.add(name)
+            _log_child_failure(
+                f"{name}: fused impl failed in-process (host fallback "
+                f"measured): {got['fused_error']}\n")
         configs[name] = _entry_from(name, got, scale, want_cpu_ref)
 
     # fused-vs-host A/B (EVERY backend, cpu included): the headline glmix2

@@ -113,7 +113,9 @@ class TestAbChain:
         by skipping unparseable tails)."""
         import os
 
-        env = dict(os.environ, PHOTON_BENCH_CPU_SCALE="64", PYTHONPATH="")
+        # scale 128 -> n=4096: keeps the three compiles the dominant cost
+        # (~15s total) so the 520s alarm has huge headroom under CI load
+        env = dict(os.environ, PHOTON_BENCH_CPU_SCALE="128", PYTHONPATH="")
         lines = bench._subprocess_json_lines(
             ["--config", "glmix2", "--ab-chain", "--platform", "cpu"],
             timeout=520, env=env)
