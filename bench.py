@@ -414,11 +414,15 @@ def run_glmix(platform, scale, three: bool):
         import traceback
 
         tb = traceback.format_exc()
-        sys.stderr.write("glmix fused impl failed in-process; host fallback\n"
-                         + tb[-2000:] + "\n")
-        got = _glmix_measure(backend, data, three, "host")
-        got["fused_error"] = tb[-500:]
-        return got
+    # The host measurement runs OUTSIDE the except block: a live exception
+    # pins the failed attempt's frames — and with them the fused coords' and
+    # sweep's device buffers — for the whole fallback; after a device OOM
+    # that would re-OOM the fallback too.
+    sys.stderr.write("glmix fused impl failed in-process; host fallback\n"
+                     + tb[-2000:] + "\n")
+    got = _glmix_measure(backend, data, three, "host")
+    got["fused_error"] = tb[-500:]
+    return got
 
 
 def _glmix_measure(backend, data, three: bool, impl: str):
@@ -901,6 +905,10 @@ def main():
                     help="with --config glmix2: measure fused/host/xla over "
                          "one design upload, one JSON line per variant")
     a = ap.parse_args()
+    if a.ab_chain and a.config != "glmix2":
+        # outside the `if a.config:` branch: a bare --ab-chain must error,
+        # not silently fall through to the full orchestrator
+        ap.error("--ab-chain requires --config glmix2")
 
     # Child modes self-timeout via SIGALRM: kernel-delivered even while
     # blocked inside a hung device call, and a normal signal death — the
@@ -933,8 +941,6 @@ def main():
         if (a.platform or "") == "cpu":
             scale = int(os.environ.get("PHOTON_BENCH_CPU_SCALE", 8))
         if a.ab_chain:
-            if a.config != "glmix2":
-                ap.error("--ab-chain only supports --config glmix2")
             run_glmix2_ab_chain(a.platform, scale)  # prints its own lines
             return
         print(json.dumps(RUNNERS[a.config](a.platform, scale)))
@@ -1091,18 +1097,16 @@ def main():
             else:
                 configs[vname] = _entry_from("glmix2", got, scale, want_cpu_ref)
                 if vname == "glmix2_bf16":
-                    # mixed-storage batches always take the plain-XLA path
-                    # (uniform-dtype pallas kernels), so the clean comparator
-                    # is glmix2_xla when it ran (fused accelerator headline),
-                    # otherwise the headline itself (cpu, or host fallback —
-                    # both already plain-XLA)
+                    # bf16 storage keeps the pallas path on TPU (kernels
+                    # take storage-width MXU operands, f32 accumulation) —
+                    # compare against the f32 pallas headline for the pure
+                    # storage-width delta.  On cpu there is no pallas path
+                    # and bf16 matmuls are software-emulated.
                     configs[vname]["note"] = (
-                        "plain-XLA objective (mixed-storage skips pallas); "
-                        "compare vs glmix2_xla"
-                        if "glmix2_xla" in configs else
-                        ("software bf16 on cpu; compare vs glmix2 — TPU MXUs "
-                         "take bf16 natively" if platform == "cpu" else
-                         "compare vs the (plain-XLA host) glmix2 headline"))
+                        "software bf16 on cpu; compare vs glmix2 — TPU MXUs "
+                        "take bf16 natively" if platform == "cpu" else
+                        "bf16-storage pallas kernels; compare vs the f32 "
+                        "glmix2 headline (same impl)")
 
     # headline: config #3 (same metric as round 1), else first success —
     # with the metric RE-LABELED to the substituted config so a fallback

@@ -87,12 +87,17 @@ class GLMObjective:
     def _fused_eligible(batch: Batch, w: Array = None) -> bool:
         """Trace-time gate for the pallas kernels; ineligible batches fall
         through to the reference XLA path below (single home for that math).
-        Mixed-precision storage (x narrower than w) uses the XLA path — the
-        pallas kernels assume one uniform dtype."""
-        from photon_ml_tpu.ops.fused_glm import eligible
+
+        Narrow float storage (bf16/f16 x against an f32 solver state) IS
+        eligible: the callers cast the effective coefficients down to
+        storage width, exactly mirroring DenseBatch.margins' mixed-precision
+        contract (both MXU operands at storage width, f32 accumulation), so
+        the kernel keeps the single-HBM-pass advantage at half the bytes.
+        Any other dtype mix (e.g. f64 x / f32 w) stays on the XLA path."""
+        from photon_ml_tpu.ops.fused_glm import eligible, storage_narrowing_ok
 
         if (w is not None and isinstance(batch, DenseBatch)
-                and batch.x.dtype != w.dtype):
+                and not storage_narrowing_ok(batch.x.dtype, w.dtype)):
             return False
         return eligible(batch)
 
@@ -152,8 +157,12 @@ class GLMObjective:
         if self.fused and self._fused_eligible(batch, w):
             from photon_ml_tpu.ops.fused_glm import fused_value_and_grad
 
+            # storage-width effective coefficients: for narrow-stored x this
+            # is DenseBatch.margins' mixed contract (bf16 MXU operands, f32
+            # accumulation inside the kernel); a no-op for uniform dtypes
+            eff = self.norm.effective_coefficients(w).astype(batch.x.dtype)
             raw_val, g_raw, r_sum = fused_value_and_grad(
-                self.loss, self.norm.effective_coefficients(w), batch,
+                self.loss, eff, batch,
                 margin_shift=self.norm.margin_shift(w))
             return (raw_val.astype(w.dtype), g_raw.astype(w.dtype),
                     r_sum.astype(w.dtype))
@@ -185,9 +194,11 @@ class GLMObjective:
         if self.fused and self._fused_eligible(batch, w):
             from photon_ml_tpu.ops.fused_glm import fused_hvp
 
-            eff_v = self.norm.effective_coefficients(v)
+            # storage-width operands (see raw_value_and_grad)
+            eff = self.norm.effective_coefficients(w).astype(batch.x.dtype)
+            eff_v = self.norm.effective_coefficients(v).astype(batch.x.dtype)
             hv_raw, q_sum = fused_hvp(
-                self.loss, self.norm.effective_coefficients(w), eff_v, batch,
+                self.loss, eff, eff_v, batch,
                 margin_shift=self.norm.margin_shift(w),
                 v_shift=self.norm.margin_shift(v))
             return hv_raw.astype(w.dtype), q_sum.astype(w.dtype)

@@ -226,9 +226,14 @@ class FixedEffectCoordinate(Coordinate):
                                y=y, offset=offs0, weight=wt0)
         # One-time row padding to the fused-kernel block granule so the
         # pallas path never re-pads (and re-copies X) per solver call.
-        # Mixed-storage batches never take the pallas path (uniform-dtype
-        # kernels), so they skip the block padding too.
-        from photon_ml_tpu.ops.fused_glm import _pick_block_rows, _pad_rows, eligible
+        # Narrow float storage (bf16/f16) keeps the pallas path — the
+        # kernels take storage-width MXU operands with f32 accumulation
+        # (GLMObjective._fused_eligible), so the single-HBM-pass advantage
+        # compounds with the halved bytes.  Wider-than-solver storage (f64)
+        # falls back to XLA.
+        from photon_ml_tpu.ops.fused_glm import (_pick_block_rows, _pad_rows,
+                                                 eligible,
+                                                 storage_narrowing_ok)
         from photon_ml_tpu.parallel.mesh import FEATURE_AXIS, padded_dim
 
         # Feature-axis (model-parallel) sharding: active only when the mesh
@@ -237,7 +242,9 @@ class FixedEffectCoordinate(Coordinate):
         self._fs = bool(getattr(config, "feature_sharded", False)) \
             and mesh is not None and mesh.shape[FEATURE_AXIS] > 1
         self._d_pad = padded_dim(self.dim, mesh) if self._fs else self.dim
-        fused_ok = (config.storage_dtype is None and eligible(batch)
+        # same predicate GLMObjective._fused_eligible consults at solve time
+        # — the pre-pad must never disagree with the per-call gate
+        fused_ok = (storage_narrowing_ok(x_dtype, dtype) and eligible(batch)
                     and not self._fs)  # pallas kernels assume full-width w
         if mesh is not None:
             if fused_ok:
@@ -246,14 +253,16 @@ class FixedEffectCoordinate(Coordinate):
 
                 n_dev = mesh.shape[DATA_AXIS]
                 local = -(-batch.num_examples // n_dev)
-                bn = _pick_block_rows(local, batch.dim)
+                bn = _pick_block_rows(
+                    local, batch.dim, np.dtype(batch.x.dtype).itemsize)
                 batch = _pad_rows(batch, (-(-local // bn) * bn) * n_dev)
             batch = shard_batch(
                 batch, mesh,
                 feature_axis=FEATURE_AXIS
                 if (self._fs and isinstance(batch, DenseBatch)) else None)
         elif fused_ok:
-            batch = _pad_rows(batch, _pick_block_rows(*batch.x.shape))
+            batch = _pad_rows(batch, _pick_block_rows(
+                *batch.x.shape, np.dtype(batch.x.dtype).itemsize))
         self._batch = batch
         self._padded_n = batch.num_examples
         self._base_weight = batch.weight

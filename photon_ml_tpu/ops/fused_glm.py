@@ -47,16 +47,20 @@ _MAX_DIM = 8192  # VMEM cap: the whole-array (_NACC, d) accumulator block plus
 # the double-buffered (block_rows, d) X tile must fit ~16MB/core.
 
 
-def _pick_block_rows(n: int, d: int, vmem_budget_bytes: int = 1 << 20) -> int:
+def _pick_block_rows(n: int, d: int, itemsize: int = 4,
+                     vmem_budget_bytes: int = 1 << 20) -> int:
     """Multiple of 128: block_rows is the LANE dim of the (3, bn) yow block
     (and the sublane dim of the X block), so 128 is the only always-legal
     granule.  Budget counts only the X tile; double-buffering + accumulators
     bring actual VMEM use to ~3-4x this, against the ~16MB/core limit.
+    ``itemsize`` is X's storage width — bf16 tiles carry twice the rows in
+    the same VMEM, halving grid steps.
 
     IDEMPOTENT under its own padding: pick(pad(n, pick(n))) == pick(n), so a
     caller that pre-pads once (FixedEffectCoordinate) never re-pads per call.
     """
-    budget_rows = max(_LANE, (vmem_budget_bytes // max(4 * d, 1) // _LANE) * _LANE)
+    budget_rows = max(_LANE, (vmem_budget_bytes // max(itemsize * d, 1)
+                              // _LANE) * _LANE)
     if n <= budget_rows:
         return int(-(-max(n, 1) // _LANE) * _LANE)  # one block: ceil to 128
     return int(budget_rows)
@@ -173,6 +177,24 @@ def _hvp_kernel(loss: PointwiseLoss, shift_ref, vshift_ref, wv_ref, x_ref,
 # -- public entry points -------------------------------------------------------
 
 
+def storage_narrowing_ok(x_dtype, w_dtype) -> bool:
+    """ONE definition of the mixed-precision storage contract, shared by
+    GLMObjective._fused_eligible and FixedEffectCoordinate's pre-padding
+    decision (two separate copies drifted once — a gate mismatch wastes a
+    permanent padded X copy on a path that then never runs fused).
+
+    x may equal the solver dtype, or be a STRICTLY narrower float that
+    promotes back to it (bf16/f16 against f32): kernels then take
+    storage-width MXU operands with solver-width accumulation, mirroring
+    DenseBatch.margins.  Widening storage (f64 x / f32 w) is out — promotion
+    would change solver numerics."""
+    xd, wd = jnp.dtype(x_dtype), jnp.dtype(w_dtype)
+    if xd == wd:
+        return True
+    return bool(jnp.issubdtype(xd, jnp.floating) and xd.itemsize < wd.itemsize
+                and jnp.promote_types(xd, wd) == wd)
+
+
 def eligible(batch, interpret: bool = False) -> bool:
     """True when the pallas kernel path can run: TPU present, lane-aligned
     dim, and dim small enough that the (_NACC, d) accumulators + X tile fit
@@ -215,7 +237,8 @@ def fused_value_and_grad(
             f"vs w {w_eff.dtype}); mixed-precision storage uses the XLA path")
 
     n, d = batch.x.shape
-    bn = block_rows or _pick_block_rows(n, d)
+    bn = block_rows or _pick_block_rows(
+        n, d, np.dtype(batch.x.dtype).itemsize)
     batch = _pad_rows(batch, bn)
     n_pad = batch.num_examples
     acc = _acc_dtype(batch.x.dtype)
@@ -271,7 +294,8 @@ def fused_hvp(
             f"vs w {w_eff.dtype}); mixed-precision storage uses the XLA path")
 
     n, d = batch.x.shape
-    bn = block_rows or _pick_block_rows(n, d)
+    bn = block_rows or _pick_block_rows(
+        n, d, np.dtype(batch.x.dtype).itemsize)
     batch = _pad_rows(batch, bn)
     n_pad = batch.num_examples
     acc = _acc_dtype(batch.x.dtype)

@@ -155,6 +155,8 @@ def test_tpu_checklist_pallas_snippet_interpret():
                 "fused_hvp(loss, jnp.asarray(w), jnp.asarray(v), b, interpret=True)")
     src = patch(src, "assert eligible(b)",
                 "assert eligible(b, interpret=True)")
+    src = patch(src, "fused_value_and_grad(logistic_loss, w16, b)",
+                "fused_value_and_grad(logistic_loss, w16, b, interpret=True)")
     captured = {}
     src = patch(src, "print(json.dumps(out))", "captured['out'] = out")
     g = {"captured": captured}
@@ -162,4 +164,117 @@ def test_tpu_checklist_pallas_snippet_interpret():
     out = captured["out"]
     assert out["pass"], out
     assert {c["loss"] for c in out["cases"]} == {"logistic", "squared",
-                                                "poisson"}
+                                                "poisson", "logistic_bf16"}
+
+
+@pytest.mark.parametrize("loss", [logistic_loss, poisson_loss], ids=lambda l: l.name)
+def test_bf16_storage_parity_normalized(rng, loss):
+    """bf16 storage through the FULL objective fused path WITH a non-trivial
+    NormalizationContext — the narrowing cast applies to the norm-scaled
+    effective coefficients and the f32 margin_shift rides beside bf16
+    operands, exactly where storage width and normalization interact.
+    Parity vs the XLA mixed path (fused=False) on identical inputs."""
+    n, d = 96, 16
+    x32 = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+    y = ((rng.random(n) < 0.5).astype(np.float32) if loss is logistic_loss
+         else rng.poisson(2.0, size=n).astype(np.float32))
+    weight = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    weight[: n // 10] = 0.0
+    batch = DenseBatch(x=jnp.asarray(x32).astype(jnp.bfloat16),
+                       y=jnp.asarray(y),
+                       offset=jnp.asarray((rng.normal(size=n) * 0.1)
+                                          .astype(np.float32)),
+                       weight=jnp.asarray(weight))
+    norm = NormalizationContext(
+        factors=jnp.asarray(rng.uniform(0.5, 2.0, size=d).astype(np.float32)),
+        shifts=jnp.asarray((rng.normal(size=d) * 0.2).astype(np.float32)))
+    w = jnp.asarray((rng.normal(size=d) * 0.2).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+    plain = GLMObjective(loss=loss, reg=Regularization(l2=0.05), norm=norm)
+    eff = norm.effective_coefficients(w).astype(jnp.bfloat16)
+    val, g_raw, r_sum = fused_value_and_grad(
+        loss, eff, batch, margin_shift=norm.margin_shift(w),
+        block_rows=32, interpret=True)
+    got_val = val + plain.l2_term(w)
+    got_grad = plain._chain(g_raw, r_sum) + 0.05 * w
+    ref_val, ref_grad = plain.value_and_grad(w, batch)
+    np.testing.assert_allclose(np.asarray(got_val), np.asarray(ref_val),
+                               rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(got_grad), np.asarray(ref_grad),
+                               rtol=6e-2, atol=6e-2)
+
+    eff_v = norm.effective_coefficients(v).astype(jnp.bfloat16)
+    hv_raw, q_sum = fused_hvp(loss, eff, eff_v, batch,
+                              margin_shift=norm.margin_shift(w),
+                              v_shift=norm.margin_shift(v),
+                              block_rows=32, interpret=True)
+    got_hvp = plain._chain(hv_raw, q_sum) + 0.05 * v
+    np.testing.assert_allclose(np.asarray(got_hvp),
+                               np.asarray(plain.hvp(w, batch, v)),
+                               rtol=6e-2, atol=6e-2)
+
+
+@pytest.mark.parametrize("loss", [logistic_loss, poisson_loss], ids=lambda l: l.name)
+def test_bf16_storage_parity_with_xla_mixed_path(rng, loss):
+    """Narrow (bf16) storage now keeps the pallas path: kernels take
+    storage-width MXU operands with f32 accumulation — the same contract as
+    DenseBatch.margins / _xt_dot on the XLA mixed path.  Parity here is
+    against that XLA mixed path (fused=False), tolerances at bf16 scale."""
+    n, d = 96, 16
+    x32 = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+    y = ((rng.random(n) < 0.5).astype(np.float32) if loss is logistic_loss
+         else rng.poisson(2.0, size=n).astype(np.float32))
+    weight = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    weight[: n // 10] = 0.0
+    offset = (rng.normal(size=n) * 0.1).astype(np.float32)
+    batch = DenseBatch(x=jnp.asarray(x32).astype(jnp.bfloat16),
+                       y=jnp.asarray(y), offset=jnp.asarray(offset),
+                       weight=jnp.asarray(weight))
+    w = jnp.asarray((rng.normal(size=d) * 0.2).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+    plain = GLMObjective(loss=loss, reg=Regularization(l2=0.05))
+
+    ref_val, ref_grad = plain.value_and_grad(w, batch)
+    val, g_raw, r_sum = fused_value_and_grad(
+        loss, w.astype(jnp.bfloat16), batch, block_rows=32, interpret=True)
+    got_val = val + plain.l2_term(w)
+    got_grad = g_raw + 0.05 * w
+    np.testing.assert_allclose(np.asarray(got_val), np.asarray(ref_val),
+                               rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(got_grad), np.asarray(ref_grad),
+                               rtol=5e-2, atol=5e-2)
+
+    ref_hvp = plain.hvp(w, batch, v)
+    hv_raw, q_sum = fused_hvp(loss, w.astype(jnp.bfloat16),
+                              v.astype(jnp.bfloat16), batch,
+                              block_rows=32, interpret=True)
+    got_hvp = hv_raw + 0.05 * v
+    np.testing.assert_allclose(np.asarray(got_hvp), np.asarray(ref_hvp),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_bf16_block_rows_doubled():
+    """bf16 X tiles carry 2x the rows in the same VMEM budget."""
+    assert _pick_block_rows(1 << 20, 256, 2) == 2 * _pick_block_rows(
+        1 << 20, 256, 4)
+
+
+def test_fused_eligible_dtype_gate(rng, monkeypatch):
+    """Isolate the dtype guard: with kernel eligibility stubbed true,
+    narrow float storage (bf16 x / f32 w) passes, widening mixes (f64 x /
+    f32 w) stay on the XLA path (promotion would change solver numerics)."""
+    from photon_ml_tpu.ops import fused_glm
+
+    monkeypatch.setattr(fused_glm, "eligible", lambda b, interpret=False: True)
+    batch64 = _batch(rng, squared_loss)  # f64 x on the f64 test mesh
+    w32 = jnp.asarray(rng.normal(size=batch64.dim).astype(np.float32))
+    assert not GLMObjective._fused_eligible(batch64, w32)
+    batch16 = DenseBatch(x=batch64.x.astype(jnp.bfloat16),
+                         y=batch64.y.astype(jnp.float32),
+                         offset=batch64.offset.astype(jnp.float32),
+                         weight=batch64.weight.astype(jnp.float32))
+    assert GLMObjective._fused_eligible(batch16, w32)
+    # uniform dtypes always pass the guard
+    assert GLMObjective._fused_eligible(batch64, batch64.x[0])

@@ -96,6 +96,35 @@ for name, loss in (("logistic", logistic_loss), ("squared", squared_loss),
                        ("value_rel", "grad_rel", "rsum_rel", "hv_rel",
                         "qsum_rel"))
     out["cases"].append(case)
+
+# bf16 storage: kernels take storage-width MXU operands with f32
+# accumulation; parity vs the XLA mixed twin (same operand widths),
+# tolerances at bf16 scale.
+n, d = 4096, 256
+x = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.random(n) > 0.5).astype(np.float32)
+b = dense_batch(x, y, weight=(rng.random(n).astype(np.float32) + 0.5))
+b = b.replace(x=b.x.astype(jnp.bfloat16))
+w = (rng.normal(size=d) * 0.05).astype(np.float32)
+w16 = jnp.asarray(w).astype(jnp.bfloat16)
+val_p, g_p, r_p = fused_value_and_grad(logistic_loss, w16, b)
+z = jnp.matmul(b.x, w16, preferred_element_type=jnp.float32) + b.offset
+dl = logistic_loss.d1(z, b.y)
+r = b.weight * dl
+g_x = jnp.matmul(r.astype(jnp.bfloat16), b.x, preferred_element_type=jnp.float32)
+val_x = jnp.sum(b.weight * logistic_loss.loss(z, b.y))
+
+
+def rel16(a, bb):
+    a, bb = np.asarray(a, np.float64), np.asarray(bb, np.float64)
+    return float(np.max(np.abs(a - bb)) / max(1e-12, np.max(np.abs(bb))))
+
+
+case = {"loss": "logistic_bf16", "value_rel": rel16(val_p, val_x),
+        "grad_rel": rel16(g_p, g_x), "rsum_rel": rel16(r_p, jnp.sum(r))}
+case["pass"] = all(case[k] < 2e-2 for k in
+                   ("value_rel", "grad_rel", "rsum_rel"))
+out["cases"].append(case)
 out["pass"] = all(c["pass"] for c in out["cases"])
 print(json.dumps(out))
 """
