@@ -28,9 +28,11 @@ EOF
     rc=$?
     echo "[$(date -u +%H:%M:%S)] lost-config bench rc=$rc -> TPU_BENCH_RETRY.json" >> "$LOG"
     if [ "$rc" = "0" ]; then
-      echo "[$(date -u +%H:%M:%S)] full bench with A/Bs" >> "$LOG"
-      python bench.py > TPU_BENCH_FULL.json 2>> "$LOG"
-      echo "[$(date -u +%H:%M:%S)] full bench rc=$? -> TPU_BENCH_FULL.json" >> "$LOG"
+      # full checklist: pallas non-interpret parity (now incl. the bf16
+      # storage case) + the full bench with A/B chain -> TPU_CHECKLIST.json
+      echo "[$(date -u +%H:%M:%S)] full checklist (pallas + bench A/Bs)" >> "$LOG"
+      python tools/tpu_checklist.py >> "$LOG" 2>&1
+      echo "[$(date -u +%H:%M:%S)] checklist rc=$? -> TPU_CHECKLIST.json" >> "$LOG"
     fi
     exit 0
   fi
