@@ -146,3 +146,39 @@ class TestAbChain:
         lines = bench._subprocess_json_lines(["--config", "x"], timeout=5)
         assert [d["variant"] for d in lines] == ["a", "b"]
         assert "boom" in (tmp_path / ".bench_errors.log").read_text()
+
+
+class TestInProcessFallback:
+    def test_fused_crash_falls_back_to_host_with_error_tag(self, monkeypatch):
+        """run_glmix: a fused-impl exception must yield a HOST measurement
+        carrying fused_error (the parent logs it and skips the fused A/B),
+        without a second child / re-upload."""
+        calls = []
+
+        def fake_measure(backend, data, three, impl):
+            calls.append(impl)
+            if impl == "fused":
+                raise RuntimeError("synthetic fused crash")
+            return {"backend": backend, "dt": 1.0, "impl": impl,
+                    "units": 10, "unit": "x/sec", "stats": {}}
+
+        monkeypatch.setattr(bench, "_glmix_measure", fake_measure)
+        monkeypatch.setattr(bench, "_select_platform", lambda p: "cpu")
+        monkeypatch.delenv("PHOTON_BENCH_IMPL", raising=False)
+        got = bench.run_glmix("cpu", 128, three=False)
+        assert calls == ["fused", "host"]
+        assert got["impl"] == "host"
+        assert "synthetic fused crash" in got["fused_error"]
+        entry = bench._entry_from("glmix2", got, 128, want_cpu_ref=False)
+        assert entry["fused_error"] == got["fused_error"]
+
+    def test_explicit_impl_env_disables_fallback(self, monkeypatch):
+        def fake_measure(backend, data, three, impl):
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(bench, "_glmix_measure", fake_measure)
+        monkeypatch.setattr(bench, "_select_platform", lambda p: "cpu")
+        monkeypatch.setenv("PHOTON_BENCH_IMPL", "fused")
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="boom"):
+            bench.run_glmix("cpu", 128, three=False)
