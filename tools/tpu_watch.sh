@@ -7,9 +7,17 @@
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${PHOTON_WATCH_INTERVAL:-900}
+# Hard stop (epoch seconds): the round driver runs its own bench at round
+# end, and two concurrent axon clients hang each other — the watcher must
+# be out of the way well before then.  No deadline when unset.
+DEADLINE=${PHOTON_WATCH_DEADLINE:-0}
 LOG=.tpu_watch.log
-echo "[$(date -u +%H:%M:%S)] watcher start" >> "$LOG"
+echo "[$(date -u +%H:%M:%S)] watcher start (deadline=$DEADLINE)" >> "$LOG"
 while true; do
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date -u +%s)" -ge "$DEADLINE" ]; then
+    echo "[$(date -u +%H:%M:%S)] deadline reached; watcher exits" >> "$LOG"
+    exit 0
+  fi
   out=$(python - <<'EOF' 2>/dev/null
 import signal
 signal.alarm(120)
