@@ -27,6 +27,13 @@ MAGIC = b"PHIDX001"
 
 
 def feature_key(name: str, term: str = "") -> str:
+    # The separator inside a NAME would make the key ambiguous under
+    # split_key/partition (term may legitimately be anything after the first
+    # SEP).  Reject loudly — found by hypothesis, not a theoretical case.
+    if SEP in name:
+        raise ValueError(
+            f"feature name {name!r} contains the reserved key separator "
+            f"U+001F (index_map.SEP); rename the feature")
     return f"{name}{SEP}{term}"
 
 

@@ -110,11 +110,27 @@ def test_long_zigzag_roundtrip(n):
     assert val == n and pos == len(out)
 
 
+def test_feature_key_rejects_separator_in_name():
+    """U+001F is the reserved name/term separator in interned keys; a name
+    containing it would decode ambiguously (hypothesis found this via the
+    cross-decoder test below).  The ingest paths (reader, index driver) all
+    key through feature_key, so the loud raise guards them all."""
+    from photon_ml_tpu.data.index_map import feature_key, split_key
+
+    with pytest.raises(ValueError, match="reserved key separator"):
+        feature_key("bad\x1fname")
+    assert split_key(feature_key("n", "t\x1fstill_fine")) == ("n", "t\x1fstill_fine")
+
+
+# names exclude the reserved U+001F separator (contract tested above)
+_NAMES = st.text(max_size=6).filter(lambda s: "\x1f" not in s)
+
+
 @settings(max_examples=20, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
 @given(rows=st.lists(
     st.tuples(st.floats(allow_nan=False, width=32),          # label
-              st.lists(st.tuples(st.text(max_size=6),        # feature name
+              st.lists(st.tuples(_NAMES,                     # feature name
                                  st.floats(allow_nan=False, width=32)),
                        max_size=4)),
     min_size=1, max_size=6))
