@@ -1,0 +1,101 @@
+"""Property tests for the normalization algebra.
+
+Every solve runs in transformed space and publishes in original space
+(reference NormalizationContext.scala:73-124), so the two maps being exact
+inverses — and margins being invariant under the transform — is load-bearing
+for every normalized fit.  Hypothesis drives random factors/shifts/models.
+"""
+
+import os
+import sys
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402  (conftest forces cpu + x64)
+
+from photon_ml_tpu.core.normalization import NormalizationContext  # noqa: E402
+
+_D = 6
+_II = 0  # intercept index
+
+_finite = st.floats(min_value=-10, max_value=10,
+                    allow_nan=False, allow_infinity=False)
+_factor = st.floats(min_value=0.05, max_value=20.0)
+
+
+def _vec(elems, d=_D):
+    return st.lists(elems, min_size=d, max_size=d).map(
+        lambda v: np.asarray(v, np.float64))
+
+
+@st.composite
+def _contexts(draw):
+    factors = draw(st.none() | _vec(_factor))
+    shifts = draw(st.none() | _vec(_finite))
+    if factors is not None:
+        factors[_II] = 1.0
+    if shifts is not None:
+        shifts[_II] = 0.0
+    return NormalizationContext(
+        factors=None if factors is None else jnp.asarray(factors),
+        shifts=None if shifts is None else jnp.asarray(shifts))
+
+
+@settings(max_examples=80, deadline=None)
+@given(ctx=_contexts(), w=_vec(_finite))
+def test_space_maps_are_inverses(ctx, w):
+    w = jnp.asarray(w)
+    there = ctx.model_to_original_space(w, _II)
+    back = ctx.model_to_transformed_space(there, _II)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(w),
+                               rtol=1e-9, atol=1e-9)
+    # and the other direction
+    again = ctx.model_to_original_space(back, _II)
+    np.testing.assert_allclose(np.asarray(again), np.asarray(there),
+                               rtol=1e-9, atol=1e-9)
+
+
+@settings(max_examples=80, deadline=None)
+@given(ctx=_contexts(), w=_vec(_finite), x=_vec(_finite))
+def test_margins_invariant_under_transform(ctx, w, x):
+    """margin(original-space model, raw x) == margin(transformed model,
+    normalized x): dot(w_orig, x) == dot(w, (x - shift) * factor) + intercept
+    handling — the identity the effective-coefficients + margin-shift
+    optimization (GLMObjective.margins) relies on.  The intercept column of
+    raw x is the constant 1."""
+    x = np.asarray(x)
+    x[_II] = 1.0  # intercept column
+    w = jnp.asarray(w)
+    w_orig = ctx.model_to_original_space(w, _II)
+    lhs = float(jnp.vdot(w_orig, jnp.asarray(x)))
+
+    xn = x.copy()
+    if ctx.shifts is not None:
+        xn = xn - np.asarray(ctx.shifts)
+    if ctx.factors is not None:
+        xn = xn * np.asarray(ctx.factors)
+    xn[_II] = 1.0  # intercept stays the constant column
+    rhs = float(jnp.vdot(w, jnp.asarray(xn)))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-8, atol=1e-8)
+
+
+@settings(max_examples=80, deadline=None)
+@given(ctx=_contexts(), w=_vec(_finite), x=_vec(_finite))
+def test_effective_coefficients_margin_shift_identity(ctx, w, x):
+    """dot(eff(w), x) + margin_shift(w) == dot(w, (x - shift) * factor):
+    the in-solver margins shortcut equals the explicit transform."""
+    w = jnp.asarray(w)
+    x_j = jnp.asarray(x)
+    lhs = float(jnp.vdot(ctx.effective_coefficients(w), x_j)
+                + ctx.margin_shift(w))
+    xn = np.asarray(x, np.float64)
+    if ctx.shifts is not None:
+        xn = xn - np.asarray(ctx.shifts)
+    if ctx.factors is not None:
+        xn = xn * np.asarray(ctx.factors)
+    rhs = float(jnp.vdot(w, jnp.asarray(xn)))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-8, atol=1e-8)
