@@ -1,0 +1,89 @@
+"""Property tests for evaluation metrics vs brute-force references.
+
+auc_roc backs every bench quality gate and every validation-driven model
+selection, so it is checked here against the O(n^2) pairwise definition
+(P[score_pos > score_neg] + 0.5 P[tie], weighted) on random score/label/
+weight draws, including heavy ties.  rmse against the closed form.
+"""
+
+import os
+import sys
+
+import numpy as np
+from hypothesis import assume, given, settings
+from hypothesis import strategies as st
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+from photon_ml_tpu.evaluation import metrics  # noqa: E402
+
+# small score alphabet -> dense ties, the hard case for rank-based AUC
+_scores = st.lists(st.sampled_from([-1.0, -0.5, 0.0, 0.25, 0.5, 1.0]),
+                   min_size=2, max_size=40)
+
+
+def _pairwise_auc(s, y, w):
+    """O(n^2) weighted pairwise AUC: sum over (pos, neg) pairs of
+    w_p*w_n * (1[s_p > s_n] + 0.5*1[s_p == s_n]) / total pair weight."""
+    num = den = 0.0
+    for i in range(len(s)):
+        if y[i] != 1:
+            continue
+        for j in range(len(s)):
+            if y[j] != 0:
+                continue
+            pw = w[i] * w[j]
+            den += pw
+            if s[i] > s[j]:
+                num += pw
+            elif s[i] == s[j]:
+                num += 0.5 * pw
+    return num / den if den else float("nan")
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(), scores=_scores)
+def test_auc_matches_pairwise_definition(data, scores):
+    n = len(scores)
+    labels = data.draw(st.lists(st.sampled_from([0.0, 1.0]),
+                                min_size=n, max_size=n))
+    assume(0.0 in labels and 1.0 in labels)
+    weights = data.draw(st.lists(st.sampled_from([0.5, 1.0, 2.0]),
+                                 min_size=n, max_size=n))
+    got = float(metrics.auc_roc(jnp.asarray(scores), jnp.asarray(labels),
+                                jnp.asarray(weights)))
+    want = _pairwise_auc(scores, labels, weights)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(), scores=_scores)
+def test_rmse_closed_form(data, scores):
+    n = len(scores)
+    labels = data.draw(st.lists(st.floats(-2, 2), min_size=n, max_size=n))
+    weights = data.draw(st.lists(st.sampled_from([0.5, 1.0, 2.0]),
+                                 min_size=n, max_size=n))
+    got = float(metrics.rmse(jnp.asarray(scores), jnp.asarray(labels),
+                             jnp.asarray(weights)))
+    s, y, w = map(np.asarray, (scores, labels, weights))
+    want = float(np.sqrt(np.sum(w * (s - y) ** 2) / np.sum(w)))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+@settings(max_examples=40, deadline=None)
+@given(data=st.data(), scores=_scores)
+def test_auc_invariant_under_monotone_transform(data, scores):
+    """AUC is a rank statistic: any strictly increasing transform of the
+    scores leaves it unchanged (the reference's evaluators share this
+    contract — model selection must not depend on score calibration)."""
+    n = len(scores)
+    labels = data.draw(st.lists(st.sampled_from([0.0, 1.0]),
+                                min_size=n, max_size=n))
+    assume(0.0 in labels and 1.0 in labels)
+    w = jnp.ones(n)
+    s = jnp.asarray(scores)
+    a1 = float(metrics.auc_roc(s, jnp.asarray(labels), w))
+    a2 = float(metrics.auc_roc(jnp.tanh(s) * 3 + 7, jnp.asarray(labels), w))
+    np.testing.assert_allclose(a1, a2, rtol=1e-9, atol=1e-9)
