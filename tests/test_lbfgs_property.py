@@ -83,3 +83,23 @@ def test_box_constrained_satisfies_kkt(m, b, w0, jitter):
     g = A @ w - b
     proj_g = w - np.clip(w - g, lo, hi)
     np.testing.assert_allclose(proj_g, 0.0, atol=5e-5)
+
+
+@jax.jit
+def _solve_quad_tron(A, b, w0):
+    from photon_ml_tpu.opt.tron import minimize_tron
+
+    return minimize_tron(_quad_vg(A, b), lambda w, v: A @ v, w0,
+                         SolverConfig(max_iters=30, tolerance=1e-12))
+
+
+@settings(max_examples=40, deadline=None)
+@given(m=_mat, b=_vec, w0=_vec, jitter=st.floats(0.1, 5.0))
+def test_tron_reaches_analytic_optimum(m, b, w0, jitter):
+    """TRON (trust region + truncated CG) on the same random quadratics:
+    with an exact quadratic model the solver must land on the closed-form
+    optimum — any trust-region/CG bookkeeping slip shows up immediately."""
+    A = _spd(m, jitter)
+    res = _solve_quad_tron(jnp.asarray(A), jnp.asarray(b), jnp.asarray(w0))
+    want = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(res.w), want, rtol=1e-5, atol=1e-5)
