@@ -236,7 +236,7 @@ def _measure(thunk, min_repeats=5, max_total=120.0, min_window=0.5):
     timed section >=min_repeats times, and — for thunks so fast that five
     repeats still measure mostly dispatch (TPU a1a's whole solve is ~0.1ms)
     — keeps repeating until min_window seconds of samples exist (capped at
-    200 repeats).  Slow full-scale configs stop at max_total seconds; each
+    5000 repeats).  Slow full-scale configs stop at max_total seconds; each
     of their repeats is seconds long anyway.  Reports the MEDIAN + spread."""
     dts = []
     total = 0.0
@@ -840,7 +840,10 @@ def _subprocess_json_lines(args, timeout, env=None):
     except subprocess.TimeoutExpired as e:
         stdout = (e.stdout or b"").decode() if isinstance(
             e.stdout, bytes) else (e.stdout or "")
-        _log_child_failure(f"bench {args} hard-timeout after {timeout}s\n")
+        stderr = (e.stderr or b"").decode() if isinstance(
+            e.stderr, bytes) else (e.stderr or "")
+        _log_child_failure(f"bench {args} hard-timeout after {timeout}s\n"
+                           f"{stderr[-2000:]}\n")
     lines = []
     for ln in stdout.splitlines():
         try:

@@ -37,6 +37,18 @@ def feature_key(name: str, term: str = "") -> str:
     return f"{name}{SEP}{term}"
 
 
+def try_feature_key(name: str, term: str = "") -> Optional[str]:
+    """feature_key, or None when the name is un-keyable (reserved separator).
+
+    Lookup paths use this: a name that cannot be keyed can never be IN a map,
+    so it is absent (-1) under the reference's IndexMap.NULL_KEY contract —
+    only map-construction/keying paths keep feature_key's loud rejection."""
+    try:
+        return feature_key(name, term)
+    except ValueError:
+        return None
+
+
 def split_key(key: str) -> Tuple[str, str]:
     name, _, term = key.partition(SEP)
     return name, term
@@ -55,7 +67,8 @@ class IndexMap:
 
     def get_index(self, name: str, term: str = "") -> int:
         """-1 if absent (reference IndexMap.NULL_KEY semantics)."""
-        return self._fwd.get(feature_key(name, term), -1)
+        key = try_feature_key(name, term)
+        return -1 if key is None else self._fwd.get(key, -1)
 
     def get_feature_name(self, idx: int) -> Optional[Tuple[str, str]]:
         if self._rev is None:

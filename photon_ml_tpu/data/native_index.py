@@ -22,7 +22,8 @@ from typing import Iterable, List, Optional, Tuple, Union
 
 import numpy as np
 
-from photon_ml_tpu.data.index_map import IndexMap, feature_key, split_key
+from photon_ml_tpu.data.index_map import (IndexMap, feature_key, split_key,
+                                          try_feature_key)
 from photon_ml_tpu.data.schemas import INTERCEPT_NAME, INTERCEPT_TERM
 from photon_ml_tpu.native.build import compile_library
 
@@ -172,7 +173,8 @@ class StoreIndexMap:
         return self._n
 
     def get_index(self, name: str, term: str = "") -> int:
-        return self.get_key(feature_key(name, term))
+        key = try_feature_key(name, term)
+        return -1 if key is None else self.get_key(key)
 
     def get_key(self, key: str) -> int:
         kb = key.encode("utf-8")

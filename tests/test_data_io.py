@@ -89,6 +89,16 @@ def test_index_map_build_save_load(tmp_path):
     assert dict(m2.items()) == dict(m.items())
 
 
+def test_index_map_lookup_reserved_separator_is_absent_not_crash():
+    # feature_key rejects U+001F in names at keying time, but a data-derived
+    # LOOKUP of such a name must follow the reference's absent-key contract
+    # (IndexMap.scala:54 NULL_KEY -> -1), not raise mid-scoring.
+    m = IndexMap.from_features([("a", "")])
+    assert m.get_index("bad\x1fname") == -1
+    with pytest.raises(ValueError):
+        feature_key("bad\x1fname")
+
+
 def test_read_game_data_avro(tmp_path):
     path = str(tmp_path / "train.avro")
     records = [

@@ -154,6 +154,7 @@ def stage_glmix():
     dt = time.perf_counter() - t0
     out["upload_sec"] = round(dt, 2)
     out["upload_mbps"] = round(mb / dt, 2)
+    del dev  # timing-only upload: free the HBM copy before the variants stage
     data = {k: np.asarray(v) for k, v in data.items()}  # coords re-stage
 
     variants = [("fused", {}), ("fused_xla", {"PHOTON_GLM_DISABLE_PALLAS": "1"}),
