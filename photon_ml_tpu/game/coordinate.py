@@ -394,6 +394,26 @@ class FixedEffectCoordinate(Coordinate):
         return self._base_weight * jnp.asarray(mult)
 
 
+    def _initial_state(self, init: Optional[FixedEffectModel]) -> Array:
+        """Initial transformed-space solver state (cold, or an ORIGINAL-space
+        warm-start model mapped in), padded + P("feature")-sharded when the
+        coordinate is feature-sharded.  The ONE definition shared by the
+        host-paced update() and the fused sweep's init_sweep_state — the
+        fused==host parity tests rely on them never drifting."""
+        if init is not None:
+            means = np.asarray(init.coefficients.means, self._dtype)
+            if self._fs and len(means) < self._d_pad:
+                means = np.pad(means, (0, self._d_pad - len(means)))
+            w = self._norm.model_to_transformed_space(
+                jnp.asarray(means), self.config.intercept_index)
+        else:
+            w = jnp.zeros(self._d_pad, self._dtype)  # _d_pad == dim unless _fs
+        if self._fs:
+            from photon_ml_tpu.parallel.mesh import shard_coefficients
+
+            w = shard_coefficients(w, self.mesh)
+        return w
+
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[FixedEffectModel] = None) -> Tuple[FixedEffectModel, SolverResult]:
         """Solve in TRANSFORMED space, publish the model in ORIGINAL space
@@ -402,17 +422,7 @@ class FixedEffectCoordinate(Coordinate):
         NormalizationContext.scala:73-124).  Models/scores everywhere else are
         original-space, so warm starts convert back in."""
         ii = self.config.intercept_index
-        if init is not None:
-            means = np.asarray(init.coefficients.means, self._dtype)
-            if self._fs and len(means) < self._d_pad:
-                means = np.pad(means, (0, self._d_pad - len(means)))
-            w0 = self._norm.model_to_transformed_space(jnp.asarray(means), ii)
-        else:
-            w0 = jnp.zeros(self._d_pad, self._dtype)
-        if self._fs:
-            from photon_ml_tpu.parallel.mesh import shard_coefficients
-
-            w0 = shard_coefficients(w0, self.mesh)
+        w0 = self._initial_state(init)
         offs = jnp.asarray(self._pad(np.asarray(total_offsets, self._dtype)))
         weights = self._down_sample_weights(seed)
         res = self._solve(w0, self._batch.replace(offset=offs, weight=weights),
@@ -458,17 +468,13 @@ class FixedEffectCoordinate(Coordinate):
     # State = transformed-space coefficient vector [d].
 
     def init_sweep_state(self, init: Optional[FixedEffectModel] = None) -> Array:
-        if self._fs:
-            # the fused whole-descent program assumes full-width replicated
-            # coordinate states; feature-sharded coordinates run host-paced
-            # (estimator fused="auto" falls back on this signal)
-            raise NotImplementedError(
-                "feature-sharded coordinates use the host-paced descent loop")
-        if init is not None:
-            w = jnp.asarray(np.asarray(init.coefficients.means, self._dtype))
-            return self._norm.model_to_transformed_space(
-                w, self.config.intercept_index)
-        return jnp.zeros(self.dim, self._dtype)
+        """Sweep state = transformed-space coefficients.  Feature-sharded
+        coordinates carry a P("feature")-sharded [d_pad] state through the
+        scanned program — the residual fold only ever consumes the [n]-vector
+        scores (already feature-axis-reduced by trace_update), so the fused
+        descent runs one program for every model size, like the reference's
+        single CoordinateDescent path (CoordinateDescent.scala:93-107)."""
+        return self._initial_state(init)
 
     def sweep_data(self):
         """The batch enters the fused program as an ARGUMENT (compile-time
@@ -495,7 +501,12 @@ class FixedEffectCoordinate(Coordinate):
         offs, weights = self._sweep_batch_inputs(offsets, key, batch)
         res = self._solve(state, batch.replace(offset=offs, weight=weights),
                           self.config.reg if reg is None else reg)
-        return res.w, batch.margins(self.trace_publish(res.w))[: self._n]
+        w_pub = self.trace_publish(res.w)
+        if self._fs and isinstance(batch, SparseBatch):
+            # pinned communication: one [n_local] feature-axis psum instead
+            # of GSPMD all-gathering the full sharded coefficient vector
+            return res.w, self._objective.margins(w_pub, batch)[: self._n]
+        return res.w, batch.margins(w_pub)[: self._n]
 
     def trace_publish(self, state: Array, data=None) -> Array:
         return self._norm.model_to_original_space(state,
@@ -503,13 +514,19 @@ class FixedEffectCoordinate(Coordinate):
 
     def export_model(self, published: np.ndarray) -> FixedEffectModel:
         return FixedEffectModel(
-            coefficients=Coefficients(means=np.asarray(published)),
+            coefficients=Coefficients(
+                means=np.asarray(published)[: self.dim]),
             feature_shard=self.config.feature_shard, task=self.task)
 
     def init_sweep_variances(self) -> Array:
         if self.config.variance == VarianceComputationType.NONE:
             return jnp.zeros(0, self._dtype)
-        return jnp.zeros(self.dim, self._dtype)
+        v = jnp.zeros(self._d_pad if self._fs else self.dim, self._dtype)
+        if self._fs:
+            from photon_ml_tpu.parallel.mesh import shard_coefficients
+
+            v = shard_coefficients(v, self.mesh)
+        return v
 
     def trace_variances(self, state: Array, offsets: Array,
                         reg: Optional[Regularization] = None,
@@ -531,7 +548,7 @@ class FixedEffectCoordinate(Coordinate):
         return self._norm.model_to_original_space(v, self.config.intercept_index)
 
     def export_variances(self, v) -> np.ndarray:
-        return np.asarray(v)
+        return np.asarray(v)[: self.dim]
 
 
 def _box_from_constraints(constraints, dim: int, dtype, norm=None,

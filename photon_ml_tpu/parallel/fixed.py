@@ -184,14 +184,21 @@ class ShardSparseObjective:
         row_sharded = lambda a: P(self.data_axis, *([None] * (a.ndim - 1)))
         return jax.tree.map(row_sharded, batch)
 
-    def _local_parts(self, blk: Array, b: SparseBatch):
-        """(full margins for local rows, masked values, local ids)."""
+    def _local_margins(self, blk: Array, b: SparseBatch):
+        """(raw margins x·w for local rows — psum over feature, no offset —,
+        masked values, local ids).  The ONE definition of the shard-local
+        gather/mask rule, shared by every objective pass and by margins()."""
         lo = jax.lax.axis_index(self.feature_axis) * self.shard_d
         lid = b.indices - lo
         ok = (lid >= 0) & (lid < self.shard_d)
         vals = jnp.where(ok, b.values.astype(blk.dtype), 0)
         lid = jnp.clip(lid, 0, self.shard_d - 1)
         z = jax.lax.psum(jnp.sum(vals * blk[lid], axis=-1), self.feature_axis)
+        return z, vals, lid
+
+    def _local_parts(self, blk: Array, b: SparseBatch):
+        """(full margins incl. offset for local rows, masked values, local ids)."""
+        z, vals, lid = self._local_margins(blk, b)
         return z + b.offset, vals, lid
 
     def _scatter(self, vals: Array, lid: Array, r: Array) -> Array:
@@ -235,6 +242,21 @@ class ShardSparseObjective:
             in_specs=(P(feat), P(feat), self._specs(batch)),
             out_specs=(P(feat), P()))(eff_w, eff_v, batch)
         return obj.finish_hvp(v, hv, qs)
+
+    def margins(self, w: Array, batch: SparseBatch) -> Array:
+        """Raw margins x·w of a feature-sharded w (same contract as
+        Batch.margins: no offset, no normalization shift).  One [n_local]
+        psum over the feature axis — the pinned-communication alternative to
+        letting GSPMD all-gather the full [d_pad] coefficient vector for the
+        gather in SparseBatch.margins.  Used by the fused sweep's re-scoring
+        of a feature-sharded coordinate (game/coordinate.trace_update)."""
+        def local(blk, b):
+            return self._local_margins(blk, b)[0]
+
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(self.feature_axis), self._specs(batch)),
+            out_specs=P(self.data_axis))(w, batch)
 
     def hessian_diag(self, w: Array, batch: SparseBatch) -> Array:
         obj, data, feat = self.obj, self.data_axis, self.feature_axis

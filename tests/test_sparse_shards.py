@@ -197,6 +197,86 @@ def test_sparse_feature_sharded_cli(tmp_path):
     assert summary["validation"]["auc"] > 0.6
 
 
+def test_sparse_feature_sharded_fused_sweep_matches_host():
+    """A fused sweep CONTAINING a feature.sharded=true coordinate: the
+    coordinate's state stays P("feature")-sharded [d_pad] inside the scanned
+    program and the residual fold consumes its feature-axis-reduced [n]
+    scores.  Must match the host-paced loop on the same coordinates, be
+    invariant to the mesh factorization (chip-count invariance), and agree
+    with the replicated-w fused sweep — one descent path for every model
+    size, like the reference (CoordinateDescent.scala:93-107)."""
+    import jax
+
+    from photon_ml_tpu.game import CoordinateDescent
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.config import RandomEffectConfig
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.game.fused import FusedSweep
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(7)
+    # vocab 97 is NOT a multiple of any feature-axis size used below, so the
+    # padded-slot trim on publish is exercised
+    n, d, k, n_users = 768, 97, 6, 16
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    xu = rng.normal(size=(n, 3)).astype(np.float32)
+    uids = np.repeat(np.arange(n_users), n // n_users)
+    w = rng.normal(size=d) * 0.5
+    wu = rng.normal(size=(n_users, 3)) * 0.8
+    logit = (np.einsum("nk,nk->n", vals, w[idx])
+             + np.einsum("nd,nd->n", xu, wu[uids]))
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    data = GameData(y=y,
+                    features={"g": SparseShard(indices=idx, values=vals, dim=d),
+                              "u": xu},
+                    id_tags={"userId": uids})
+    solver = SolverConfig(max_iters=30)
+
+    def coords(mesh):
+        cfgs = {
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0),
+                                       feature_sharded=mesh is not None),
+            "per-user": RandomEffectConfig(random_effect_type="userId",
+                                           feature_shard="u", solver=solver,
+                                           reg=Regularization(l2=1.0)),
+        }
+        return {cid: build_coordinate(cid, data, c,
+                                      TaskType.LOGISTIC_REGRESSION, mesh)
+                for cid, c in cfgs.items()}
+
+    mesh = make_mesh(n_data=2, n_feature=4, devices=jax.devices())
+    cs = coords(mesh)
+    fused_model, fused_scores = FusedSweep(cs, num_iterations=2).run()
+    host_model, _, _ = CoordinateDescent(cs, num_iterations=2).run()
+
+    wf = np.asarray(fused_model["fixed"].coefficients.means)
+    assert wf.shape == (d,)  # padded slots trimmed on publish
+    np.testing.assert_allclose(wf, host_model["fixed"].coefficients.means,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(fused_model["per-user"].w_stack,
+                               host_model["per-user"].w_stack,
+                               rtol=2e-3, atol=2e-3)
+    # the in-program sharded re-scoring equals the model's own re-scoring
+    np.testing.assert_allclose(
+        fused_scores["fixed"],
+        np.asarray(cs["fixed"].score(fused_model["fixed"])),
+        rtol=1e-5, atol=1e-5)
+
+    # chip-count invariance: a different mesh factorization, same optimum
+    mesh2 = make_mesh(n_data=4, n_feature=2, devices=jax.devices())
+    alt_model, _ = FusedSweep(coords(mesh2), num_iterations=2).run()
+    np.testing.assert_allclose(alt_model["fixed"].coefficients.means, wf,
+                               atol=2e-3)
+
+    # replicated-w fused sweep (no mesh) reaches the same optimum
+    rep_model, _ = FusedSweep(coords(None), num_iterations=2).run()
+    np.testing.assert_allclose(rep_model["fixed"].coefficients.means, wf,
+                               atol=2e-3)
+
+
 # ---------------------------------------------------------------------------
 # Sparse per-entity random-effect shards (reference LocalDataset holds sparse
 # Breeze vectors per entity, data/LocalDataset.scala:35-247 — wide sparse RE
