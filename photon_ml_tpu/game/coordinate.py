@@ -207,8 +207,16 @@ class FixedEffectCoordinate(Coordinate):
 
         shard_data = data.features[config.feature_shard]
         y = jnp.asarray(np.asarray(data.y, dtype))
-        offs0 = jnp.asarray(np.asarray(data.offset, dtype))
-        wt0 = jnp.asarray(np.asarray(data.weight, dtype))
+        # Default offsets (all-zero) / weights (all-one) are created ON
+        # DEVICE: an [n]-sized constant needn't cross the wire (at chip
+        # scale over a slow transport those two uploads cost more than the
+        # labels themselves).
+        offs_np = np.asarray(data.offset, dtype)
+        offs0 = (jnp.zeros(self._n, dtype) if not offs_np.any()
+                 else jnp.asarray(offs_np))
+        wt_np = np.asarray(data.weight, dtype)
+        wt0 = (jnp.ones(self._n, dtype) if np.all(wt_np == 1.0)
+               else jnp.asarray(wt_np))
         # Storage narrowing happens ON HOST so the device transfer and the
         # resident array are storage-width from the start (an on-device cast
         # would transfer f32 and transiently hold both copies in HBM).
@@ -641,12 +649,6 @@ class RandomEffectCoordinate(Coordinate):
                 "RANDOM projection needs intercept_index — the Gaussian "
                 "matrix then carries the reference's intercept pass-through "
                 "slot (ProjectionMatrix.scala:112-120)")
-        if (norm is not None and norm.shifts is not None
-                and config.projector == ProjectorType.INDEX_MAP):
-            raise NotImplementedError(
-                f"coordinate {coordinate_id!r}: shift normalization needs a "
-                "stable intercept column, which per-entity INDEX_MAP "
-                "compaction does not keep — use IDENTITY or RANDOM")
         self._norm = None
         if norm is not None and (norm.factors is not None
                                  or norm.shifts is not None):
@@ -661,37 +663,33 @@ class RandomEffectCoordinate(Coordinate):
         entity_ids = data.id_tags[config.random_effect_type]
         lane_multiple = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
         self._sparse = isinstance(shard_data, SparseShard)
+        if (self._norm is not None and self._norm.shifts is not None
+                and config.intercept_index is None
+                and (self._sparse
+                     or config.projector == ProjectorType.INDEX_MAP)):
+            # Shift normalization under observed-column compaction projects
+            # the context per entity, exactly like the reference's per-REId
+            # NormalizationContextRDD through its per-entity projectors
+            # (IndexMapProjectorRDD.scala:34-262): the intercept is observed
+            # in every active sample, so compaction keeps a per-entity
+            # intercept column whose per-lane position absorbs the margin
+            # shift — but the coordinate must know WHICH full-dim column
+            # that is.
+            raise ValueError(
+                f"coordinate {coordinate_id!r}: shift normalization under "
+                "per-entity compaction needs intercept_index (the per-lane "
+                "intercept column absorbs the projected margin shift)")
         if self._sparse:
             # Row-sparse RE feature bag (the reference's per-entity sparse
             # LocalDataset, data/LocalDataset.scala:35-247): each entity
             # solves in the compact space of its observed columns, built
             # DIRECTLY from the sparse rows — the full-vocabulary [E, S, d]
             # bucket tensors never exist (bucket_by_entity_sparse).
-            if config.projector == ProjectorType.RANDOM:
-                raise NotImplementedError(
-                    f"coordinate {coordinate_id!r}: RANDOM projection of a "
-                    "sparse shard is not supported — use INDEX_MAP (or "
-                    "IDENTITY, which compacts to observed columns anyway)")
-            if config.projected_dim is not None:
+            if (config.projected_dim is not None
+                    and config.projector != ProjectorType.RANDOM):
                 raise ValueError(
                     "projected_dim applies only to RANDOM projection; sparse "
                     "shards derive per-entity dimensions from observed columns")
-            if config.constraints:
-                raise ValueError(
-                    f"coordinate {coordinate_id!r}: box constraints are not "
-                    "supported with a sparse feature shard (the compact solve "
-                    "space has no stable full-dim column alignment)")
-            if config.variance == VarianceComputationType.FULL:
-                raise NotImplementedError(
-                    f"coordinate {coordinate_id!r}: FULL variances need the "
-                    "full-dimension Hessian — use a dense shard, or SIMPLE "
-                    "(exact under compaction: observed features from the "
-                    "compact diag, unobserved prior-only 1/λ2)")
-            if norm is not None and norm.shifts is not None:
-                raise NotImplementedError(
-                    f"coordinate {coordinate_id!r}: shift normalization needs "
-                    "a stable intercept column, which per-entity compaction "
-                    "does not keep — factor-only normalization is supported")
             from photon_ml_tpu.parallel.bucketing import bucket_by_entity_sparse
             from photon_ml_tpu.parallel.projection import ProjectedBuckets
 
@@ -712,6 +710,36 @@ class RandomEffectCoordinate(Coordinate):
             self._proj = ProjectedBuckets(base=self.buckets,
                                           buckets=self.buckets.buckets,
                                           projections=projections)
+            if config.projector == ProjectorType.RANDOM:
+                # RANDOM over a sparse shard: the shared Gaussian matrix's
+                # rows GATHERED through each lane's observed-column map
+                # project the compact design into d_proj — exactly what the
+                # densified x @ A computes, because unobserved columns
+                # contribute zero either way (reference builds the same
+                # shared matrix per coordinate, ProjectionMatrixBroadcast
+                # .scala:150; the full-vocabulary [E, S, d] tensors still
+                # never exist).
+                import dataclasses as _dc
+
+                from photon_ml_tpu.parallel.projection import (
+                    build_random_projection)
+
+                if config.projected_dim is None:
+                    raise ValueError("RANDOM projection requires projected_dim")
+                shared = build_random_projection(
+                    self.dim, config.projected_dim, seed, dtype=dtype,
+                    intercept_index=config.intercept_index)
+                proj_buckets = []
+                for b, p in zip(self.buckets.buckets, projections):
+                    safe = np.where(p.indices < 0, 0, p.indices)
+                    a_sub = shared.matrix[safe]  # [lanes, d_compact, d_proj]
+                    a_sub = np.where((p.indices >= 0)[:, :, None], a_sub, 0.0)
+                    x_proj = np.einsum("lsd,ldp->lsp", b.x,
+                                       a_sub).astype(dtype)
+                    proj_buckets.append(_dc.replace(b, x=x_proj))
+                self._proj = ProjectedBuckets(
+                    base=self.buckets, buckets=proj_buckets,
+                    projections=[shared] * len(proj_buckets))
         else:
             x = np.asarray(shard_data, dtype)
             self.buckets = bucket_by_entity(
@@ -821,22 +849,55 @@ class RandomEffectCoordinate(Coordinate):
         ]
         # INDEX_MAP/sparse + normalization: project the coordinate context
         # into each entity's compact space (the reference's per-REId
-        # contexts) — gather the factor vector through every lane's column
-        # map; padded slots get the identity factor 1.  (RANDOM instead
-        # shares ONE projected context, baked by _bind_solver.)
+        # contexts, NormalizationContextRDD through the per-entity
+        # projectors, IndexMapProjectorRDD.scala:34-262) — gather the factor
+        # AND shift vectors through every lane's column map; padded slots get
+        # the identity factor 1 / shift 0.  Shift normalization additionally
+        # tracks each lane's compact-space INTERCEPT position: the intercept
+        # is observed in every active sample, so compaction keeps it, and the
+        # per-lane coefficient-space maps fold the margin shift into it.
+        # (RANDOM instead shares ONE projected context, baked by
+        # _bind_solver.)
         self._norm_fac_dev = None
+        self._norm_shift_dev = None
+        self._norm_ii_dev = None
         if self._norm_per_lane:
             from photon_ml_tpu.parallel.projection import BucketProjection
 
-            fac = np.asarray(self._norm.factors, self._dtype)
-            lanes_fac = []
-            for p in self._proj.projections:
+            fac = (np.asarray(self._norm.factors, self._dtype)
+                   if self._norm.factors is not None
+                   else np.ones(self.dim, self._dtype))
+            sh = (np.asarray(self._norm.shifts, self._dtype)
+                  if self._norm.shifts is not None else None)
+            ii = self.config.intercept_index
+            lanes_fac, lanes_sh, lanes_ii = [], [], []
+            for p, b in zip(self._proj.projections, self.buckets.buckets):
                 assert isinstance(p, BucketProjection)
                 safe = np.where(p.indices < 0, 0, p.indices)
-                lanes_fac.append(np.where(p.indices >= 0, fac[safe],
+                obs = p.indices >= 0
+                lanes_fac.append(np.where(obs, fac[safe],
                                           1.0).astype(self._dtype))
-            self._norm_fac_np = lanes_fac  # host twin for warm starts
+                if sh is not None:
+                    lanes_sh.append(np.where(obs, sh[safe],
+                                             0.0).astype(self._dtype))
+                    has_ii = np.any(p.indices == ii, axis=1)
+                    valid = np.asarray(b.entity_lanes) >= 0
+                    if not np.all(has_ii[valid]):
+                        raise ValueError(
+                            f"coordinate {self.coordinate_id!r}: shift "
+                            "normalization under compaction requires the "
+                            "intercept column (feature "
+                            f"{ii}) observed in every entity's active "
+                            "samples, but some entity never observes it")
+                    lanes_ii.append(np.argmax(p.indices == ii,
+                                              axis=1).astype(np.int32))
+            self._norm_fac_np = lanes_fac  # host twins for warm starts
             self._norm_fac_dev = [put(f) for f in lanes_fac]
+            if sh is not None:
+                self._norm_shift_np = lanes_sh
+                self._norm_ii_np = lanes_ii
+                self._norm_shift_dev = [put(s) for s in lanes_sh]
+                self._norm_ii_dev = [put(i) for i in lanes_ii]
 
     def _bind_solver(self) -> None:
         # shared-context normalization (IDENTITY projector) bakes into the
@@ -868,48 +929,105 @@ class RandomEffectCoordinate(Coordinate):
         self._objective = objective
         self._norm_per_lane = (self._norm is not None and shared_norm is None)
         box = None
+        self._box_lanes = None  # per-bucket (lo, hi) [lanes, d_compact] pairs
+        self._box_fill = None   # [dim] publish value for unobserved features
         if self.config.constraints:
-            if self.config.projector != ProjectorType.IDENTITY:
+            compact = (self._sparse
+                       or self.config.projector == ProjectorType.INDEX_MAP)
+            if self.config.projector == ProjectorType.RANDOM:
                 raise ValueError(
                     f"coordinate {self.coordinate_id!r}: box constraints have "
-                    "no meaning in a projected solve space; use "
-                    "ProjectorType.IDENTITY")
-            if self._norm is not None:
+                    "no meaning in a RANDOM-projected solve space (the "
+                    "Gaussian matrix mixes features); use IDENTITY or "
+                    "INDEX_MAP")
+            if not compact:
                 box = _box_from_constraints(self.config.constraints, self.dim,
                                             self._dtype, self._norm)
             else:
-                box = _box_from_constraints(self.config.constraints, self.dim,
-                                            self._dtype)
+                # Compact solve spaces get PER-LANE bounds: the full-space
+                # original bounds gathered through each lane's observed-column
+                # map (the reference applies its constraintMap in full
+                # coefficient space regardless of storage,
+                # OptimizationUtils.projectCoefficientsToSubspace; the compact
+                # twin of that is bound-per-observed-column).  Padded slots
+                # pin to [0, 0].  Unobserved features publish clip(0, lo, hi)
+                # — the full-space box optimum of the L2 pull toward 0 —
+                # via the back-projection fill.
+                from photon_ml_tpu.opt.solve import check_box_support
+
+                check_box_support(self.config.optimizer,
+                                  self.config.reg.l1 > 0.0)
+                if self._norm is not None and self._norm.shifts is not None:
+                    raise ValueError(
+                        f"coordinate {self.coordinate_id!r}: box constraints "
+                        "with shift normalization are not supported "
+                        "(original-space bounds are non-separable under "
+                        "shifts)")
+                lo, hi = _box_from_constraints(self.config.constraints,
+                                               self.dim, self._dtype)
+                lo, hi = np.asarray(lo), np.asarray(hi)
+                self._box_fill = np.clip(0.0, lo, hi).astype(self._dtype)
+                lanes_box = []
+                for p in self._proj.projections:
+                    safe = np.where(p.indices < 0, 0, p.indices)
+                    lo_c = np.where(p.indices >= 0, lo[safe],
+                                    0.0).astype(self._dtype)
+                    hi_c = np.where(p.indices >= 0, hi[safe],
+                                    0.0).astype(self._dtype)
+                    lanes_box.append((jnp.asarray(lo_c), jnp.asarray(hi_c)))
+                self._box_lanes = lanes_box
         solve = make_solver(objective, self.config.optimizer,
                             self.config.solver, box=box)
 
         # reg traced PER LANE (vmapped like the data): λ sweeps reuse this
-        # compilation, and per-entity regularization costs nothing extra
-        if self._norm_per_lane:
-            def _vsolve(w0, x_b, y_b, off_b, wt_b, reg, fac_b):
-                return jax.vmap(
-                    lambda w, xx, yy, oo, ww, rr, fa: solve(
-                        w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
-                        objective=objective.with_reg(rr).replace(
-                            norm=NormalizationContext(factors=fa, shifts=None)))
-                )(w0, x_b, y_b, off_b, wt_b, reg, fac_b)
-        else:
-            def _vsolve(w0, x_b, y_b, off_b, wt_b, reg, fac_b=None):
-                return jax.vmap(
-                    lambda w, xx, yy, oo, ww, rr: solve(
-                        w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
-                        objective=objective.with_reg(rr))
-                )(w0, x_b, y_b, off_b, wt_b, reg)
+        # compilation, and per-entity regularization costs nothing extra.
+        # Optional per-lane extras ride the same vmap, in a fixed order:
+        # normalization factor rows (per-lane contexts), then box lo/hi rows
+        # (compact-space constrained solves) — _solve_extras builds the
+        # matching argument tuple.
+        per_lane_norm = self._norm_per_lane
+        per_lane_shift = (per_lane_norm and self._norm.shifts is not None)
+        per_lane_box = self._box_lanes is not None
+
+        def _one(w, xx, yy, oo, ww, rr, *ex):
+            i = 0
+            obj = objective.with_reg(rr)
+            fa = None
+            if per_lane_norm:
+                fa = ex[i]
+                i += 1
+                sh = None
+                if per_lane_shift:
+                    sh = ex[i]
+                    i += 1
+                obj = obj.replace(
+                    norm=NormalizationContext(factors=fa, shifts=sh))
+            kw = {}
+            if per_lane_box:
+                lo_r, hi_r = ex[i], ex[i + 1]
+                if fa is not None:  # original-space bounds -> solve space
+                    lo_r, hi_r = lo_r / fa, hi_r / fa
+                kw["box"] = (lo_r, hi_r)
+            return solve(w, DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
+                         objective=obj, **kw)
+
+        def _vsolve(w0, x_b, y_b, off_b, wt_b, reg, *extras_b):
+            return jax.vmap(_one)(w0, x_b, y_b, off_b, wt_b, reg, *extras_b)
 
         self._vsolve = jax.jit(_vsolve)
 
         kind = self.config.variance
-        # SIMPLE variances are EXACT under observed-column compaction
-        # (sparse shards / INDEX_MAP): diag(H)_jj = Σ w·l''·x_j² + λ2 is
-        # per-feature, margins are compaction-invariant, and an unobserved
-        # feature's curvature is prior-only (λ2).  FULL needs the true d×d
-        # Hessian; RANDOM mixes features so neither is exact there.
-        self._compact_variances = (kind == VarianceComputationType.SIMPLE
+        # BOTH variance kinds are EXACT under observed-column compaction
+        # (sparse shards / INDEX_MAP): an unobserved feature's column is
+        # identically zero in this entity's data, so the full-space Hessian
+        # H = Σ w·l''·x xᵀ + λ2 I is BLOCK-DIAGONAL — the observed block is
+        # the compact Hessian and the unobserved block is exactly λ2 I with
+        # no cross terms.  Hence SIMPLE (1/diag H) and FULL (diag H⁻¹) both
+        # decompose: observed features from the compact computation,
+        # unobserved features prior-only 1/λ2.  RANDOM mixes features, so
+        # neither is exact there (refused below, as in _bind_solver's
+        # RANDOM-variance guard).
+        self._compact_variances = (kind != VarianceComputationType.NONE
                                    and (self._sparse or self.config.projector
                                         == ProjectorType.INDEX_MAP))
         if kind != VarianceComputationType.NONE:
@@ -919,18 +1037,12 @@ class RandomEffectCoordinate(Coordinate):
                     "projection (the Gaussian matrix mixes features); use "
                     "IDENTITY or INDEX_MAP "
                     f"(coordinate {self.coordinate_id!r})")
-            if (kind == VarianceComputationType.FULL
-                    and (self._sparse
-                         or self.config.projector != ProjectorType.IDENTITY)):
-                raise ValueError(
-                    "FULL variances need the full-dimension Hessian; use "
-                    "ProjectorType.IDENTITY with a dense shard, or SIMPLE "
-                    f"(coordinate {self.coordinate_id!r})")
             if self._compact_variances and self._norm is not None:
                 raise NotImplementedError(
-                    "SIMPLE variances under compaction do not support "
-                    "per-entity normalization contexts "
-                    f"(coordinate {self.coordinate_id!r})")
+                    "coefficient variances under compaction do not support "
+                    "per-entity normalization contexts — drop the "
+                    "normalization or use an uncompacted (IDENTITY, dense) "
+                    f"layout (coordinate {self.coordinate_id!r})")
             from photon_ml_tpu.opt.solve import compute_variances
 
             def _vvar(w_b, x_b, y_b, off_b, wt_b, reg):
@@ -947,11 +1059,14 @@ class RandomEffectCoordinate(Coordinate):
 
     def _expand_compact_variances(self, v_compact: Array, bucket_index: int,
                                   lane_reg: Regularization) -> Array:
-        """[lanes, d_compact] SIMPLE variances -> [lanes, d_full]: observed
-        features carry their computed variance (margin-exact diag), every
-        other feature has prior-only curvature diag(H)_jj = λ2 ⇒ variance
+        """[lanes, d_compact] variances -> [lanes, d_full]: observed features
+        carry their computed variance, every other feature is prior-only
         1/λ2 (the per-lane effective λ2, so per-entity multipliers are
-        honored).  NOTE: the NTV model format stores nonzero-MEAN features
+        honored).  Exact for BOTH kinds: the full-space Hessian is
+        block-diagonal (unobserved columns are identically zero in this
+        entity's data), its unobserved block exactly λ2 I — so SIMPLE's
+        1/diag(H) and FULL's diag(H⁻¹) are each 1/λ2 there, and the observed
+        block's computation is untouched by the unobserved one.  NOTE: the NTV model format stores nonzero-MEAN features
         only (reference sparse storage), so prior-only variances live in the
         in-memory/columnar model but do not survive an NTV save — absent
         features reload as variance 0, the format's "not estimated" marker.
@@ -988,6 +1103,24 @@ class RandomEffectCoordinate(Coordinate):
             else:
                 m = ones
             self._lane_mult.append((ones, m))
+
+    def _solve_extras(self, bi: int, data=None) -> tuple:
+        """Per-bucket extra vmapped solver arguments, in ``_one``'s fixed
+        order: per-lane normalization factor rows, per-lane shift rows, then
+        per-lane box lo/hi rows.  ``data``: sweep_data() pytree when tracing
+        (fused program argument convention), None for the host-paced path."""
+        out = ()
+        if self._norm_per_lane:
+            out += ((data["norm_fac"] if data is not None
+                     else self._norm_fac_dev)[bi],)
+            if self._norm.shifts is not None:
+                out += ((data["norm_shift"] if data is not None
+                         else self._norm_shift_dev)[bi],)
+        if self._box_lanes is not None:
+            lo, hi = (data["box"] if data is not None
+                      else self._box_lanes)[bi]
+            out += (lo, hi)
+        return out
 
     def _lane_regs(self, reg: Regularization) -> List[Regularization]:
         """Per-bucket per-lane Regularization pytrees: the scalar (possibly
@@ -1039,6 +1172,13 @@ class RandomEffectCoordinate(Coordinate):
             # published models are ORIGINAL-space; solves run transformed
             # (same convention as the fixed effect's update())
             if self._norm_per_lane:
+                if self._norm.shifts is not None:
+                    # per-lane modelToTransformedSpace: the shift dot folds
+                    # into each lane's own compact intercept position
+                    sh = self._norm_shift_np[bucket_index]
+                    iis = self._norm_ii_np[bucket_index]
+                    dots = np.einsum("ld,ld->l", w0, sh)
+                    w0[np.arange(len(w0)), iis] += dots
                 w0 = w0 / self._norm_fac_np[bucket_index]
             else:
                 n = self._norm
@@ -1050,16 +1190,27 @@ class RandomEffectCoordinate(Coordinate):
         return w0.astype(self._dtype)
 
     def _lanes_to_original(self, lanes: Array, bucket_index: int,
-                           norm_fac=None) -> Array:
+                           data=None) -> Array:
         """Map a bucket's transformed-space lane vectors to original space
         (the reference applies modelToOriginalSpace per entity problem —
-        GeneralizedLinearOptimizationProblem.createModel)."""
+        GeneralizedLinearOptimizationProblem.createModel).  ``data``:
+        sweep_data() pytree when tracing, None for the host-paced path."""
         if self._norm is None:
             return lanes
         if self._norm_per_lane:
-            fac = (norm_fac if norm_fac is not None
+            fac = (data["norm_fac"] if data is not None
                    else self._norm_fac_dev)[bucket_index]
-            return lanes * fac
+            eff = lanes * fac
+            if self._norm.shifts is not None:
+                # fold -<eff, shifts> into each lane's OWN intercept column
+                # (NormalizationContext.scala:73-99, per projected context)
+                sh = (data["norm_shift"] if data is not None
+                      else self._norm_shift_dev)[bucket_index]
+                ii_l = (data["norm_ii"] if data is not None
+                        else self._norm_ii_dev)[bucket_index]
+                adj = -jnp.sum(eff * sh, axis=1)
+                eff = eff.at[jnp.arange(eff.shape[0]), ii_l].add(adj)
+            return eff
         if self._norm_proj is not None:
             # RANDOM projection: the model leaves the solver in the
             # TRANSFORMED PROJECTED space; the projected context (with its
@@ -1090,9 +1241,8 @@ class RandomEffectCoordinate(Coordinate):
                 w0 = self._put_entity(np.zeros((b.num_lanes, solve_dim), self._dtype))
             # residual offsets gathered into the bucket layout
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
-            fac_args = ((self._norm_fac_dev[bi],) if self._norm_per_lane else ())
             res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
-                               lane_regs[bi], *fac_args)
+                               lane_regs[bi], *self._solve_extras(bi))
             coeffs.append(self._lanes_to_original(res.w, bi))
             results.append(res)
             if variances is not None:
@@ -1107,7 +1257,8 @@ class RandomEffectCoordinate(Coordinate):
                 variances.append(self._lanes_to_original(v, bi))
 
         if self._proj is not None:
-            coeffs = self._proj.back_project([np.asarray(c) for c in coeffs])
+            coeffs = self._proj.back_project([np.asarray(c) for c in coeffs],
+                                             fill=self._box_fill)
         w_stack, slot_of = stacked_coefficients(coeffs, self.buckets)
         var_stack = None
         if variances is not None:
@@ -1224,7 +1375,11 @@ class RandomEffectCoordinate(Coordinate):
         arguments (see Coordinate.sweep_data)."""
         d = dict(dev=self._dev, slots=self._sample_slots,
                  proj=self._proj_dev if self._proj is not None else None,
-                 norm_fac=self._norm_fac_dev)
+                 norm_fac=self._norm_fac_dev,
+                 norm_shift=self._norm_shift_dev, norm_ii=self._norm_ii_dev,
+                 box=self._box_lanes,
+                 box_fill=None if self._box_fill is None
+                 else jnp.asarray(self._box_fill))
         if self._sparse:
             d.update(x_idx=self._x_idx_dev, x_val=self._x_val_dev)
         else:
@@ -1247,9 +1402,8 @@ class RandomEffectCoordinate(Coordinate):
         new_lanes = []
         for bi, (lanes, dev) in enumerate(zip(state, data["dev"])):
             off_b = jnp.where(dev["valid"], offsets[dev["rows"]], 0.0)
-            fac_args = ((data["norm_fac"][bi],) if self._norm_per_lane else ())
             res = self._vsolve(lanes, dev["x"], dev["y"], off_b, dev["w"],
-                               lane_regs[bi], *fac_args)
+                               lane_regs[bi], *self._solve_extras(bi, data))
             new_lanes.append(res.w)
         w_stack = self.trace_publish(tuple(new_lanes), data=data)
         if self._sparse:
@@ -1265,12 +1419,11 @@ class RandomEffectCoordinate(Coordinate):
 
         if self._norm is not None:
             # original-space lanes BEFORE back-projection/stacking (per-lane
-            # factor maps live in the compact solve space)
+            # context maps live in the compact solve space)
             if data is None:
                 data = self.sweep_data()
-            state = tuple(
-                self._lanes_to_original(lanes, bi, norm_fac=data.get("norm_fac"))
-                for bi, lanes in enumerate(state))
+            state = tuple(self._lanes_to_original(lanes, bi, data=data)
+                          for bi, lanes in enumerate(state))
         if self._proj is not None:
             # traced twin of ProjectedBuckets.back_project (margin-exact):
             # lanes return to full dim before stacking.  Projection arrays
@@ -1279,18 +1432,28 @@ class RandomEffectCoordinate(Coordinate):
             if data is None:
                 data = self.sweep_data()
             proj = data["proj"]
-            state = tuple(self._traced_back_project(bi, proj[bi], lanes)
+            state = tuple(self._traced_back_project(bi, proj[bi], lanes,
+                                                    fill=data.get("box_fill"))
                           for bi, lanes in enumerate(state))
         return stack_bucket_lanes(state, self._slot_idx_dev,
                                   len(self._sorted_ids))
 
-    def _traced_back_project(self, bi: int, arr: Array, lanes: Array) -> Array:
+    def _traced_back_project(self, bi: int, arr: Array, lanes: Array,
+                             fill: Optional[Array] = None) -> Array:
         kind = self._proj_kinds[bi]
         if kind == "random":
             return lanes @ arr.T  # shared Gaussian (ProjectionMatrix.scala:127)
+        e = lanes.shape[0]
+        if fill is not None:
+            # box-constrained compact solve: unobserved features publish
+            # clip(0, lo, hi) (BucketProjection.back_project's fill
+            # semantics); padded slots route out of range and drop so the
+            # 'set' scatter can never clobber a genuinely observed column
+            safe = jnp.where(arr < 0, self.dim, arr)
+            out = jnp.broadcast_to(fill.astype(lanes.dtype), (e, self.dim))
+            return out.at[jnp.arange(e)[:, None], safe].set(lanes, mode="drop")
         # index compaction: scatter each lane's projected slots into full dim;
         # padded slots (idx<0) carry value 0, so colliding on column 0 is inert
-        e = lanes.shape[0]
         safe = jnp.where(arr < 0, 0, arr)
         vals = jnp.where(arr >= 0, lanes, 0.0)
         out = jnp.zeros((e, self.dim), lanes.dtype)

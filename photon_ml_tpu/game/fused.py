@@ -183,6 +183,25 @@ class FusedSweep:
         per call."""
         return self._cold if initial is None else self._init_carry(initial)
 
+    def run_device(self, initial: Optional[GameModel] = None,
+                   regs: Optional[Sequence] = None, seed: int = 0,
+                   carry0=None):
+        """One fused descent, DEVICE outputs only: returns
+        ``(published, scores, vars_, carried)`` where the first three are
+        the program's output pytrees of device arrays — nothing is pulled
+        to host.  For benchmarking (time the sweep, not the [n]-vector
+        downloads — over slow transports those dominate) and for callers
+        that pipeline further device work; ``run()`` wraps this with the
+        host export."""
+        carry = carry0 if carry0 is not None else self.init_carry(initial)
+        if regs is None:
+            regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
+        base, carried = self._base_with_carry_through(initial)
+        published, scores, vars_ = self._program(
+            *carry, self._vars0, tuple(regs), jax.random.PRNGKey(seed),
+            base, self._datas)
+        return published, scores, vars_, carried
+
     def run(self, initial: Optional[GameModel] = None,
             regs: Optional[Sequence] = None, seed: int = 0,
             carry0=None) -> Tuple[GameModel, Dict[str, np.ndarray]]:
@@ -194,13 +213,8 @@ class FusedSweep:
         seed for in-program stochastic work (down-sampling); a traced input,
         so varying it reuses the compiled program.  ``carry0``: precomputed
         ``init_carry`` result (overrides ``initial``)."""
-        carry = carry0 if carry0 is not None else self.init_carry(initial)
-        if regs is None:
-            regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
-        base, carried = self._base_with_carry_through(initial)
-        published, scores, vars_ = self._program(
-            *carry, self._vars0, tuple(regs), jax.random.PRNGKey(seed),
-            base, self._datas)
+        published, scores, vars_, carried = self.run_device(
+            initial, regs, seed, carry0)
         models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
                   for i, cid in enumerate(self.order)}
         final_scores = {cid: np.asarray(scores[i])

@@ -31,6 +31,17 @@ from photon_ml_tpu.utils.linalg import cholesky_inverse
 Array = jax.Array
 
 
+def check_box_support(optimizer: OptimizerType, has_l1: bool) -> None:
+    """Box constraints are a projected-gradient L-BFGS feature (reference
+    OptimizationUtils.projectCoefficientsToSubspace applies them in LBFGSB
+    only); TRON and the L1/OWLQN regime refuse.  Shared by make_solver and
+    callers that pass per-call boxes to an unboxed-at-build solver."""
+    if optimizer == OptimizerType.TRON:
+        raise ValueError("TRON does not support box constraints")
+    if optimizer == OptimizerType.OWLQN or has_l1:
+        raise ValueError("OWLQN does not support box constraints")
+
+
 def make_solver(
     objective: GLMObjective,
     optimizer: OptimizerType = OptimizerType.LBFGS,
@@ -56,11 +67,9 @@ def make_solver(
 
     if optimizer == OptimizerType.TRON and has_l1:
         raise ValueError("TRON does not support L1 regularization (reference parity)")
-    if optimizer == OptimizerType.TRON and box is not None:
-        raise ValueError("TRON does not support box constraints")
+    if box is not None:
+        check_box_support(optimizer, has_l1)
     if optimizer == OptimizerType.OWLQN or (optimizer == OptimizerType.LBFGS and has_l1):
-        if box is not None:
-            raise ValueError("OWLQN does not support box constraints")
 
         def solve_owlqn(w0: Array, batch: Batch,
                         objective: GLMObjective = objective) -> SolverResult:
@@ -72,7 +81,11 @@ def make_solver(
     if optimizer == OptimizerType.LBFGS:
 
         def solve_lbfgs(w0: Array, batch: Batch,
-                        objective: GLMObjective = objective) -> SolverResult:
+                        objective: GLMObjective = objective,
+                        box: Optional[Tuple[Array, Array]] = box) -> SolverResult:
+            # ``box`` is per-call overridable like ``objective`` (same static
+            # presence rule): the random-effect coordinate passes per-lane
+            # bound arrays through vmap for compact-space constrained solves.
             vg = lambda w: objective.value_and_grad(w, batch)
             return minimize_lbfgs(vg, w0, config, box=box)
 

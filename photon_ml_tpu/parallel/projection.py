@@ -110,10 +110,22 @@ class BucketProjection:
         out = np.take_along_axis(x, safe[:, None, :], axis=2)
         return np.where((self.indices >= 0)[:, None, :], out, 0.0).astype(x.dtype)
 
-    def back_project(self, w_proj: np.ndarray) -> np.ndarray:
-        """[E, d_proj] -> [E, d_full] scatter (margin-exact)."""
+    def back_project(self, w_proj: np.ndarray,
+                     fill: Optional[np.ndarray] = None) -> np.ndarray:
+        """[E, d_proj] -> [E, d_full] scatter (margin-exact).
+
+        ``fill``: per-feature value [d_full] every UNOBSERVED slot takes
+        (default 0).  Box-constrained compact solves pass clip(0, lo, hi):
+        the reference solves in full space and projects every iterate into
+        the box (OptimizationUtils.projectCoefficientsToSubspace), so an
+        unobserved feature — whose full-space optimum is the box projection
+        of the L2 pull toward 0 — publishes clip(0, lo, hi), not 0."""
         e = w_proj.shape[0]
-        out = np.zeros((e, self.d_full), w_proj.dtype)
+        if fill is None:
+            out = np.zeros((e, self.d_full), w_proj.dtype)
+        else:
+            out = np.broadcast_to(np.asarray(fill, w_proj.dtype),
+                                  (e, self.d_full)).copy()
         lanes = np.repeat(np.arange(e), self.d_proj)
         idx = self.indices.reshape(-1)
         vals = np.asarray(w_proj).reshape(-1)
@@ -251,8 +263,11 @@ class ProjectedBuckets:
     buckets: List[Bucket]
     projections: List[object]  # BucketProjection | RandomProjection per bucket
 
-    def back_project(self, coeffs: List[np.ndarray]) -> List[np.ndarray]:
-        return [p.back_project(np.asarray(w)) for p, w in zip(self.projections, coeffs)]
+    def back_project(self, coeffs: List[np.ndarray],
+                     fill: Optional[np.ndarray] = None) -> List[np.ndarray]:
+        kw = {} if fill is None else {"fill": fill}
+        return [p.back_project(np.asarray(w), **kw)
+                for p, w in zip(self.projections, coeffs)]
 
 
 def project_buckets(

@@ -1170,6 +1170,116 @@ def test_random_effect_projected_normalization_parity(rng):
                                    rtol=1e-2, atol=1e-3)
 
 
+def test_random_effect_standardization_under_compaction(rng):
+    """STANDARDIZATION (factors + SHIFTS) under INDEX_MAP compaction: the
+    context is projected per entity — factor/shift rows gathered through each
+    lane's observed-column map, the margin shift folded into the lane's own
+    compact intercept position (reference NormalizationContextRDD through
+    IndexMapProjectorRDD.scala:34-262).  With every feature observed the
+    compact solve IS the full-space solve, so INDEX_MAP must match IDENTITY
+    exactly; warm-starting from the published optimum must be a fixed point
+    (round-trips the per-lane modelToTransformedSpace)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.types import ProjectorType
+
+    x, uids, y = _re_norm_data(rng, d=5)
+    factors = 1.0 / (np.std(x, axis=0) + 1e-12)
+    shifts = np.mean(x, axis=0).copy()
+    factors[0], shifts[0] = 1.0, 0.0  # intercept untouched
+    norm = NormalizationContext(factors=jnp.asarray(factors, jnp.float32),
+                                shifts=jnp.asarray(shifts, jnp.float32))
+    data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
+
+    def coord(projector):
+        cfg = RandomEffectConfig(
+            random_effect_type="userId", feature_shard="u",
+            reg=Regularization(l2=0.3), projector=projector,
+            intercept_index=0,
+            solver=SolverConfig(max_iters=100, tolerance=1e-9))
+        return build_coordinate("u", data, cfg, TaskType.LOGISTIC_REGRESSION,
+                                norm=norm)
+
+    ci = coord(ProjectorType.IDENTITY)
+    cc = coord(ProjectorType.INDEX_MAP)
+    assert cc._norm_per_lane and cc._norm_shift_dev is not None
+    mi, _ = ci.update(np.zeros(len(y)))
+    mc, _ = cc.update(np.zeros(len(y)))
+    for u in range(6):
+        np.testing.assert_allclose(mc.w_stack[mc.slot_of[u]],
+                                   mi.w_stack[mi.slot_of[u]],
+                                   rtol=1e-2, atol=1e-3)
+    # warm start from the optimum is a fixed point (inverse map round-trip)
+    mc2, _ = cc.update(np.zeros(len(y)), init=mc)
+    np.testing.assert_allclose(mc2.w_stack, mc.w_stack, rtol=1e-3, atol=1e-4)
+    # fused program publishes the same model
+    state = cc.init_sweep_state()
+    sdata = cc.sweep_data()
+    state, _ = cc.trace_update(state, jnp.zeros(len(y), jnp.float32),
+                               data=sdata)
+    w_stack = np.asarray(cc.trace_publish(state, data=sdata))
+    np.testing.assert_allclose(w_stack, mc.w_stack, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_re_standardization_matches_densified_compaction(rng):
+    """Shift normalization on a SPARSE random-effect shard (the round-3
+    refusal at the old game/coordinate.py:674): row-sparse compaction with a
+    per-row intercept slot must match the densified INDEX_MAP fit — the two
+    compact paths project the context identically."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.normalization import NormalizationContext
+    from photon_ml_tpu.game.data import SparseShard
+    from photon_ml_tpu.types import ProjectorType
+
+    n_users, per_user, d, k = 8, 48, 32, 5
+    n = n_users * per_user
+    uids = np.repeat(np.arange(n_users), per_user)
+    # k-sparse rows over features 1..d-1 plus an explicit intercept column 0
+    idx = np.concatenate(
+        [np.zeros((n, 1), np.int32),
+         rng.integers(1, d, size=(n, k)).astype(np.int32)], axis=1)
+    vals = np.concatenate(
+        [np.ones((n, 1), np.float32),
+         (rng.normal(size=(n, k)) * 3.0 + 1.0).astype(np.float32)], axis=1)
+    wu = rng.normal(size=(n_users, d)).astype(np.float32) * 0.5
+    margins = np.einsum("nk,nk->n", vals, np.take_along_axis(
+        wu[uids], idx, axis=1))
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (np.repeat(np.arange(n), k + 1), idx.ravel()),
+              vals.ravel())
+
+    factors = np.ones(d, np.float32)
+    factors[1:] = 0.4
+    shifts = np.zeros(d, np.float32)
+    shifts[1:] = 1.0  # nonzero shifts on every non-intercept feature
+    norm = NormalizationContext(factors=jnp.asarray(factors),
+                                shifts=jnp.asarray(shifts))
+
+    def coord(features, projector):
+        cfg = RandomEffectConfig(
+            random_effect_type="userId", feature_shard="u",
+            reg=Regularization(l2=0.5), projector=projector,
+            intercept_index=0,
+            solver=SolverConfig(max_iters=60, tolerance=1e-9))
+        gd = GameData(y=y, features={"u": features}, id_tags={"userId": uids})
+        return build_coordinate("u", gd, cfg, TaskType.LOGISTIC_REGRESSION,
+                                norm=norm)
+
+    cs = coord(SparseShard(indices=idx, values=vals, dim=d),
+               ProjectorType.IDENTITY)
+    cd = coord(dense, ProjectorType.INDEX_MAP)
+    ms, _ = cs.update(np.zeros(n))
+    md, _ = cd.update(np.zeros(n))
+    assert ms.w_stack.shape == md.w_stack.shape == (n_users, d)
+    for u in range(n_users):
+        np.testing.assert_allclose(ms.w_stack[ms.slot_of[u]],
+                                   md.w_stack[md.slot_of[u]],
+                                   rtol=1e-2, atol=1e-3)
+
+
 def test_random_effect_normalization_rejections(rng):
     import jax.numpy as jnp
 
@@ -1180,12 +1290,25 @@ def test_random_effect_normalization_rejections(rng):
     data = GameData(y=y, features={"u": x}, id_tags={"userId": uids})
     norm_shift = NormalizationContext(factors=None,
                                       shifts=jnp.asarray(np.full(4, 0.5)))
-    with pytest.raises(NotImplementedError, match="intercept"):
+    # INDEX_MAP + shifts is SUPPORTED (round 4: per-lane projected contexts)
+    # but needs intercept_index so each lane's compact intercept position can
+    # absorb the margin shift
+    with pytest.raises(ValueError, match="intercept_index"):
         build_coordinate(
             "u", data,
             RandomEffectConfig(random_effect_type="userId", feature_shard="u",
                                projector=ProjectorType.INDEX_MAP),
             TaskType.LOGISTIC_REGRESSION, norm=norm_shift)
+    shift0 = np.full(4, 0.5)
+    shift0[0] = 0.0  # the intercept column itself is never shifted
+    coord_im = build_coordinate(
+        "u", data,
+        RandomEffectConfig(random_effect_type="userId", feature_shard="u",
+                           projector=ProjectorType.INDEX_MAP,
+                           intercept_index=0),
+        TaskType.LOGISTIC_REGRESSION,
+        norm=NormalizationContext(factors=None, shifts=jnp.asarray(shift0)))
+    assert coord_im._norm_shift_dev is not None
     # factor normalization under RANDOM projection is SUPPORTED (round 3):
     # the context is pushed through the Gaussian matrix and shared
     # (ProjectionMatrixBroadcast.projectNormalizationContext; full parity
