@@ -84,12 +84,18 @@ def _rank_stats(scores: Array, labels: Array, weights: Array):
     return seg, is_end, end_ctp, end_cfp, prev_ctp, prev_cfp, ctp[-1], cfp[-1]
 
 
+@jax.jit
 def auc_roc(scores: Array, labels: Array, weights: Array) -> Array:
     """Exact weighted ROC AUC with tie handling (trapezoidal).
 
     Degenerate inputs (no positives or no negatives) return 0.5, the
     convention downstream model selection relies on.
-    """
+
+    jitted at definition: the ~15-op rank pipeline otherwise dispatches
+    eagerly per call (~20ms of op-launch overhead on 13k rows — it
+    dominated the gp_tune profile); under jit the same call is ~1ms and
+    repeated same-shape evaluations (every tuning fit) hit the cache.
+    Inside an outer jit the decorator is a no-op (inlined)."""
     seg, is_end, end_tp, end_fp, prev_tp, prev_fp, tot_p, tot_n = _rank_stats(
         scores, labels, weights
     )
@@ -100,9 +106,11 @@ def auc_roc(scores: Array, labels: Array, weights: Array) -> Array:
     return jnp.where((tot_p == 0) | (tot_n == 0), 0.5, auc)
 
 
+@jax.jit
 def auc_pr(scores: Array, labels: Array, weights: Array) -> Array:
     """Weighted area under the precision-recall curve (linear interpolation
-    in recall, like the reference's Spark BinaryClassificationMetrics)."""
+    in recall, like the reference's Spark BinaryClassificationMetrics).
+    jitted at definition for the same reason as auc_roc."""
     seg, is_end, end_tp, end_fp, prev_tp, prev_fp, tot_p, tot_n = _rank_stats(
         scores, labels, weights
     )

@@ -75,6 +75,8 @@ class FusedSweep:
         self._needs_var = needs_var
         self._needs_rand = needs_rand
         self._snap_program = None  # built lazily by run_snapshots
+        self._grid_program = None  # built lazily by run_grid
+        self._grid_snap_program = None  # built lazily by run_grid_snapshots
 
         def program(states0, scores0, vars0, regs, base_key, base, datas):
             # regs: per-coordinate Regularization pytree, TRACED — a
@@ -117,6 +119,7 @@ class FusedSweep:
                               for i, cid in enumerate(order))
             return published, scores, vars_
 
+        self._program_fn = program  # unjitted: the grid path vmaps it
         self._program = jax.jit(program)
         self._base = jnp.asarray(np.asarray(first._base_offset_host(),
                                             self._dtype))
@@ -278,29 +281,8 @@ class FusedSweep:
             raise NotImplementedError(
                 "run_snapshots does not compute coefficient variances; use "
                 "run() (final model only) or the host CoordinateDescent")
-        order, coords = self.order, self.coordinates
-        needs_rand = self._needs_rand
         if self._snap_program is None:
-            def program(states0, scores0, regs, base_key, base, datas):
-                # same _sweep_iteration core as the main program (no
-                # variances), but each iteration ALSO publishes — scan
-                # stacks the published coefficients along a leading T axis
-                def body(carry, it):
-                    states, scores = carry
-                    it_key = (jax.random.fold_in(base_key, it)
-                              if any(needs_rand) else None)
-                    states, scores, _, _ = self._sweep_iteration(
-                        states, scores, regs, it_key, base, datas)
-                    published = tuple(
-                        coords[cid].trace_publish(states[i], data=datas[i])
-                        for i, cid in enumerate(order))
-                    return (tuple(states), tuple(scores)), published
-
-                (_, scores), pubs = lax.scan(
-                    body, (states0, scores0), jnp.arange(self.num_iterations))
-                return pubs, scores
-
-            self._snap_program = jax.jit(program)
+            self._snap_program = jax.jit(self._snap_fn())
         carry = carry0 if carry0 is not None else self.init_carry(initial)
         if regs is None:
             regs = tuple(self.coordinates[cid].config.reg for cid in self.order)
@@ -312,8 +294,111 @@ class FusedSweep:
         return [
             GameModel(models=self._merge_carry_through(
                 {cid: self.coordinates[cid].export_model(pubs[i][t])
-                 for i, cid in enumerate(order)}, initial))
+                 for i, cid in enumerate(self.order)}, initial))
             for t in range(self.num_iterations)
+        ]
+
+    def _snap_fn(self):
+        """The snapshot program (shared by run_snapshots and the vmapped
+        grid twin): same _sweep_iteration core as the main program (no
+        variances), but each iteration ALSO publishes — scan stacks the
+        published coefficients along a leading T axis."""
+        order, coords = self.order, self.coordinates
+        needs_rand = self._needs_rand
+
+        def program(states0, scores0, regs, base_key, base, datas):
+            def body(carry, it):
+                states, scores = carry
+                it_key = (jax.random.fold_in(base_key, it)
+                          if any(needs_rand) else None)
+                states, scores, _, _ = self._sweep_iteration(
+                    states, scores, regs, it_key, base, datas)
+                published = tuple(
+                    coords[cid].trace_publish(states[i], data=datas[i])
+                    for i, cid in enumerate(order))
+                return (tuple(states), tuple(scores)), published
+
+            (_, scores), pubs = lax.scan(
+                body, (states0, scores0), jnp.arange(self.num_iterations))
+            return pubs, scores
+
+        return program
+
+    # --- regularization-grid batching -----------------------------------
+    # A λ grid's descents are INDEPENDENT programs over the SAME data, and
+    # these solves are bandwidth-bound: vmapping the sweep over the reg
+    # axis shares every design-matrix stream, so a B-point grid costs far
+    # less than B sequential sweeps.  The reference trains its grid
+    # sequentially (GameEstimator.fit over configurations;
+    # GameEstimatorEvaluationFunction.apply per tuning iteration) — this is
+    # the TPU-native replacement.  All grid lanes must share the L1 regime
+    # (same static constraint as run()'s reg overrides, see sweep_key).
+
+    def _stack_regs(self, regs_grid: Sequence[Sequence]) -> tuple:
+        return jax.tree.map(
+            lambda *leaves: jnp.stack(
+                [jnp.asarray(v, self._dtype) for v in leaves]),
+            *[tuple(regs) for regs in regs_grid])
+
+    def run_grid(self, regs_grid: Sequence[Sequence],
+                 initial: Optional[GameModel] = None, seed: int = 0,
+                 carry0=None) -> list:
+        """B fused descents over a regularization grid in ONE vmapped
+        program; returns a list of B (model, scores-dict) pairs, each
+        exactly what run() returns for that grid point."""
+        if self._grid_program is None:
+            self._grid_program = jax.jit(jax.vmap(
+                self._program_fn,
+                in_axes=(None, None, None, 0, None, None, None)))
+        carry = carry0 if carry0 is not None else self.init_carry(initial)
+        base, carried = self._base_with_carry_through(initial)
+        published, scores, vars_ = self._grid_program(
+            *carry, self._vars0, self._stack_regs(regs_grid),
+            jax.random.PRNGKey(seed), base, self._datas)
+        # one bulk device->host transfer per output array, host-indexed per
+        # grid point (B*C per-slice transfers would multiply round-trip
+        # latency on slow transports)
+        published = [np.asarray(jax.device_get(p)) for p in published]
+        scores = [np.asarray(s) for s in scores]
+        vars_ = tuple(np.asarray(v) for v in vars_)
+        out = []
+        for b in range(len(regs_grid)):
+            models = {cid: self.coordinates[cid].export_model(published[i][b])
+                      for i, cid in enumerate(self.order)}
+            final_scores = {cid: scores[i][b]
+                            for i, cid in enumerate(self.order)}
+            for cid, c in carried.items():
+                final_scores[cid] = final_scores[cid] + c
+            models = self._attach_variances(
+                models, tuple(v[b] for v in vars_))
+            models = self._merge_carry_through(models, initial)
+            out.append((GameModel(models=models), final_scores))
+        return out
+
+    def run_grid_snapshots(self, regs_grid: Sequence[Sequence],
+                           initial: Optional[GameModel] = None, seed: int = 0,
+                           carry0=None) -> list:
+        """Grid twin of run_snapshots: returns a list of B lists of
+        per-iteration GameModels (one list per grid point)."""
+        if any(self._needs_var):
+            raise NotImplementedError(
+                "run_grid_snapshots does not compute coefficient variances; "
+                "use run_grid() or the host CoordinateDescent")
+        if self._grid_snap_program is None:
+            self._grid_snap_program = jax.jit(jax.vmap(
+                self._snap_fn(), in_axes=(None, None, 0, None, None, None)))
+        carry = carry0 if carry0 is not None else self.init_carry(initial)
+        base, _carried = self._base_with_carry_through(initial)
+        pubs, _scores = self._grid_snap_program(
+            *carry, self._stack_regs(regs_grid), jax.random.PRNGKey(seed),
+            base, self._datas)
+        pubs = [np.asarray(p) for p in pubs]  # [coord][B, T, ...]
+        return [
+            [GameModel(models=self._merge_carry_through(
+                {cid: self.coordinates[cid].export_model(pubs[i][b][t])
+                 for i, cid in enumerate(self.order)}, initial))
+             for t in range(self.num_iterations)]
+            for b in range(len(regs_grid))
         ]
 
     def _attach_variances(self, models, vars_):

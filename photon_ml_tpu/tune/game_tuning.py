@@ -54,6 +54,9 @@ class GameEstimatorEvaluationFunction:
             raise ValueError("all coordinates are locked; nothing to tune")
         self.results: List[GameFitResult] = []
         self._sweep = None  # None = not built; False = un-fusable
+        # phase accounting (bench reports the breakdown; reset_phases())
+        self.fit_seconds = 0.0
+        self.eval_seconds = 0.0
 
     def config_for(self, params: np.ndarray) -> GameConfig:
         # keep every coordinate (locked ones must stay in the config so the
@@ -102,7 +105,28 @@ class GameEstimatorEvaluationFunction:
                 return None
         return self._sweep
 
+    def _select_and_record(self, config: GameConfig, snapshots) -> float:
+        """Evaluate each snapshot on validation, keep the best (host-loop
+        best-model retention semantics), record the fit."""
+        import time
+
+        suite = self.estimator.validation_suite
+        t0 = time.perf_counter()
+        best_model, best_ev = None, None
+        for m in snapshots:
+            ev = GameTransformer(m, config.task).evaluate(
+                self.validation_data, suite)
+            if best_ev is None or suite.better_than(ev, best_ev):
+                best_model, best_ev = m, ev
+        self.eval_seconds += time.perf_counter() - t0
+        self.results.append(GameFitResult(model=best_model, config=config,
+                                          evaluation=best_ev,
+                                          history=DescentHistory()))
+        return best_ev.primary
+
     def __call__(self, params: np.ndarray) -> float:
+        import time
+
         config = self.config_for(params)
         # Fused fast path: train WITHOUT per-update validation (the whole
         # retrain is one jitted sweep, reused across every tuning fit).
@@ -117,7 +141,7 @@ class GameEstimatorEvaluationFunction:
         if sweep is not None:
             sweep_obj, carry0 = sweep
             regs = [config.coordinates[cid].reg for cid in config.coordinates]
-            suite = self.estimator.validation_suite
+            t0 = time.perf_counter()
             if config.num_outer_iterations == 1:
                 model, _scores = sweep_obj.run(initial=self.initial_model,
                                                carry0=carry0, regs=regs,
@@ -127,22 +151,52 @@ class GameEstimatorEvaluationFunction:
                 snapshots = sweep_obj.run_snapshots(
                     initial=self.initial_model, carry0=carry0, regs=regs,
                     seed=self.seed)
-            best_model, best_ev = None, None
-            for m in snapshots:
-                ev = GameTransformer(m, config.task).evaluate(
-                    self.validation_data, suite)
-                if best_ev is None or suite.better_than(ev, best_ev):
-                    best_model, best_ev = m, ev
-            res = GameFitResult(model=best_model, config=config,
-                                evaluation=best_ev, history=DescentHistory())
-            self.results.append(res)
-            return best_ev.primary
+            self.fit_seconds += time.perf_counter() - t0
+            return self._select_and_record(config, snapshots)
+        t0 = time.perf_counter()
         res = self.estimator.fit(self.data, [config],
                                  validation_data=self.validation_data, seed=self.seed,
                                  initial_model=self.initial_model,
                                  locked_coordinates=self.locked or None)[0]
+        self.fit_seconds += time.perf_counter() - t0
         self.results.append(res)
         return res.evaluation.primary
+
+    def evaluate_batch(self, params_batch) -> List[float]:
+        """Evaluate several parameter vectors in ONE vmapped grid fit
+        (FusedSweep.run_grid/_snapshots): all grid lanes share the same
+        design-matrix streams, so q tuning fits cost far less than q
+        sequential retrains — the batched half of batch Bayesian
+        optimization (the search picks the q candidates).  Order of
+        ``results`` matches sequential evaluation.  Falls back to
+        sequential calls when the fused path is unavailable."""
+        import time
+
+        params_batch = [np.asarray(p, float) for p in params_batch]
+        fused_ok = (not self.locked and self.estimator.fused is not False)
+        sweep = self._fused_sweep() if fused_ok else None
+        if sweep is None or len(params_batch) == 1:
+            return [self(p) for p in params_batch]
+        sweep_obj, carry0 = sweep
+        configs = [self.config_for(p) for p in params_batch]
+        regs_grid = [[c.coordinates[cid].reg for cid in c.coordinates]
+                     for c in configs]
+        t0 = time.perf_counter()
+        if self.base_config.num_outer_iterations == 1:
+            snap_lists = [[m] for m, _scores in sweep_obj.run_grid(
+                regs_grid, initial=self.initial_model, carry0=carry0,
+                seed=self.seed)]
+        else:
+            snap_lists = sweep_obj.run_grid_snapshots(
+                regs_grid, initial=self.initial_model, carry0=carry0,
+                seed=self.seed)
+        self.fit_seconds += time.perf_counter() - t0
+        return [self._select_and_record(config, snaps)
+                for config, snaps in zip(configs, snap_lists)]
+
+    def reset_phases(self) -> None:
+        self.fit_seconds = 0.0
+        self.eval_seconds = 0.0
 
     def vectorize(self, config: GameConfig) -> np.ndarray:
         """Config -> params vector (reference configurationToVector)."""
@@ -185,6 +239,7 @@ def tune_game_model(
     search_domain: Optional[SearchDomain] = None,
     prior_observations: Optional[List[Tuple[np.ndarray, float]]] = None,
     evaluation_function: Optional[GameEstimatorEvaluationFunction] = None,
+    batch_size: int = 1,
 ) -> Tuple[GameFitResult, "RandomSearch", List[GameFitResult]]:
     """Search per-coordinate L2 weights; returns (best fit, search object,
     all tuned fits in evaluation order — the driver's TUNED/ALL output modes
@@ -234,7 +289,10 @@ def tune_game_model(
         domain = default_l2_domain(fn.coordinate_ids, l2_range)
     minimize = not estimator.validation_suite.primary.larger_is_better
     cls = GaussianProcessSearch if mode == "bayesian" else RandomSearch
-    search = cls(domain, minimize=minimize, seed=seed)
+    # batch_size > 1: each search round evaluates its candidates as ONE
+    # vmapped grid fit (fn.evaluate_batch -> FusedSweep.run_grid) — batch
+    # Bayesian optimization, total fit count unchanged
+    search = cls(domain, minimize=minimize, seed=seed, batch_size=batch_size)
     # a reused evaluation_function may carry fits from a previous search —
     # this run's results are everything appended from here on
     start = len(fn.results)
@@ -245,7 +303,8 @@ def tune_game_model(
     prior_params = fn.vectorize(base_config)
     if np.all(prior_params > 0):
         priors.append((prior_params, fn(prior_params)))
-    search.find(fn, n=n_iterations, priors=priors or None)
+    search.find(fn, n=n_iterations, priors=priors or None,
+                evaluate_batch=fn.evaluate_batch)
 
     results = list(fn.results[start:])
     best = estimator.best(results)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
-from scipy.linalg import cho_factor, cho_solve
+from scipy.linalg import solve_triangular
 
 
 def _scaled_sqdist(x1: np.ndarray, x2: np.ndarray, lengthscale: np.ndarray) -> np.ndarray:
@@ -45,16 +45,21 @@ class Kernel:
                                    lengthscale=np.asarray(lengthscale, float))
 
     def log_likelihood(self, x: np.ndarray, y: np.ndarray) -> float:
-        """GP marginal log-likelihood (reference StationaryKernel.logLikelihood)."""
+        """GP marginal log-likelihood (reference StationaryKernel.logLikelihood).
+
+        np.linalg.cholesky + triangular solves, NOT scipy cho_factor: the
+        slice sampler calls this hundreds of times per GP fit on tiny
+        (n_obs x n_obs) matrices, where scipy's check_finite/asarray
+        wrapping is most of the wall time (gp_tune profile)."""
         n = len(x)
         k = self(x, x) + self.noise * np.eye(n)
         try:
-            c, lower = cho_factor(k)
+            c = np.linalg.cholesky(k)
         except np.linalg.LinAlgError:
             return -np.inf
-        alpha = cho_solve((c, lower), y)
+        z = solve_triangular(c, y, lower=True, check_finite=False)
         logdet = 2.0 * np.sum(np.log(np.diagonal(c)))
-        return float(-0.5 * y @ alpha - 0.5 * logdet - 0.5 * n * np.log(2 * np.pi))
+        return float(-0.5 * z @ z - 0.5 * logdet - 0.5 * n * np.log(2 * np.pi))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -15,9 +15,17 @@ LogDensity = Callable[[np.ndarray], float]
 
 
 def _slice_1d(log_density: LogDensity, x: np.ndarray, dim: int, rng: np.random.Generator,
-              step: float = 1.0, max_steps: int = 32) -> np.ndarray:
+              step: float = 1.0, max_steps: int = 32,
+              f0: "float | None" = None):
+    """One coordinate-wise slice update; returns (x', f(x')).
+
+    ``f0``: the current point's (already-known) log density — the sweep
+    caller threads it through so the density is evaluated once per MOVE,
+    not once per call (each evaluation is a GP Cholesky; this is the
+    tuner's hot loop)."""
     x = x.copy()
-    f0 = log_density(x)
+    if f0 is None:
+        f0 = log_density(x)
     log_u = f0 + np.log(rng.random() + 1e-300)
 
     # stepping out
@@ -42,13 +50,14 @@ def _slice_1d(log_density: LogDensity, x: np.ndarray, dim: int, rng: np.random.G
     # shrinkage
     for _ in range(100):
         xt[dim] = left + rng.random() * (right - left)
-        if log_density(xt) > log_u:
-            return xt
+        ft = log_density(xt)
+        if ft > log_u:
+            return xt, ft
         if xt[dim] < x[dim]:
             left = xt[dim]
         else:
             right = xt[dim]
-    return x  # shrunk to nothing: keep the current point
+    return x, f0  # shrunk to nothing: keep the current point
 
 
 def slice_sample(log_density: LogDensity, x0: np.ndarray, n_samples: int,
@@ -58,9 +67,10 @@ def slice_sample(log_density: LogDensity, x0: np.ndarray, n_samples: int,
     x = np.asarray(x0, float).copy()
     out = np.empty((n_samples, len(x)))
     total = burn_in + n_samples
+    fx = None  # threaded through so each move costs one density evaluation
     for i in range(total):
         for dim in range(len(x)):
-            x = _slice_1d(log_density, x, dim, rng, step=step)
+            x, fx = _slice_1d(log_density, x, dim, rng, step=step, f0=fx)
         if i >= burn_in:
             out[i - burn_in] = x
     return out

@@ -398,3 +398,81 @@ def test_tuning_warm_start_carries_prior_entities(rng):
         m = r.model["user"]
         assert 7 in m.slot_of
         np.testing.assert_array_equal(m.w_stack[m.slot_of[7]], prior_w[0])
+
+
+def test_batched_grid_tuning_matches_sequential(rng):
+    """evaluate_batch (ONE vmapped FusedSweep.run_grid[_snapshots] over a
+    reg grid) must reproduce sequential evaluation: same metrics, same
+    recorded models, same order — for both the multi-iteration snapshot
+    path and the single-iteration run_grid path; and tune_game_model with
+    batch_size>1 keeps the total fit count."""
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import (FixedEffectConfig, GameData,
+                                    GameEstimator, RandomEffectConfig)
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.tune import tune_game_model
+    from photon_ml_tpu.tune.game_tuning import GameEstimatorEvaluationFunction
+    from photon_ml_tpu.types import TaskType
+
+    n, d_g, d_u, n_users = 512, 6, 3, 16
+    xg = rng.normal(size=(n, d_g)).astype(np.float32)
+    xu = rng.normal(size=(n, d_u)).astype(np.float32)
+    uids = np.repeat(np.arange(n_users), n // n_users)
+    wu = rng.normal(size=(n_users, d_u))
+    logits = xg @ rng.normal(size=d_g) + np.einsum("nd,nd->n", xu, wu[uids])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    perm = rng.permutation(n)
+    xg, xu, uids, y = xg[perm], xu[perm], uids[perm], y[perm]
+    cut = 384
+    tr = GameData(y=y[:cut], features={"g": xg[:cut], "u": xu[:cut]},
+                  id_tags={"userId": uids[:cut]})
+    va = GameData(y=y[cut:], features={"g": xg[cut:], "u": xu[cut:]},
+                  id_tags={"userId": uids[cut:]})
+    solver = SolverConfig(max_iters=25, tolerance=1e-7)
+    suite = EvaluationSuite.from_specs(["auc"])
+    grid = [np.asarray([1.0, 1.0]), np.asarray([10.0, 0.1]),
+            np.asarray([0.2, 5.0])]
+
+    for outer in (2, 1):  # snapshots path and run_grid path
+        config = GameConfig(
+            task=TaskType.LOGISTIC_REGRESSION, num_outer_iterations=outer,
+            coordinates={
+                "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                           reg=Regularization(l2=1.0)),
+                "per-user": RandomEffectConfig(
+                    random_effect_type="userId", feature_shard="u",
+                    solver=solver, reg=Regularization(l2=1.0))})
+        fn_seq = GameEstimatorEvaluationFunction(
+            GameEstimator(validation_suite=suite), config, tr, va, seed=0)
+        fn_bat = GameEstimatorEvaluationFunction(
+            GameEstimator(validation_suite=suite), config, tr, va, seed=0)
+        seq = [fn_seq(p) for p in grid]
+        bat = fn_bat.evaluate_batch(grid)
+        np.testing.assert_allclose(bat, seq, atol=2e-3)
+        assert len(fn_bat.results) == len(grid)
+        for rs, rb in zip(fn_seq.results, fn_bat.results):
+            np.testing.assert_allclose(
+                np.asarray(rb.model["fixed"].coefficients.means),
+                np.asarray(rs.model["fixed"].coefficients.means), atol=2e-3)
+            np.testing.assert_allclose(
+                np.asarray(rb.model["per-user"].w_stack),
+                np.asarray(rs.model["per-user"].w_stack), atol=2e-3)
+        assert fn_bat.fit_seconds > 0 and fn_bat.eval_seconds > 0
+
+    # end-to-end batched tuning: same fit count, search records gp time
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION, num_outer_iterations=2,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "per-user": RandomEffectConfig(
+                random_effect_type="userId", feature_shard="u",
+                solver=solver, reg=Regularization(l2=1.0))})
+    est = GameEstimator(validation_suite=suite)
+    best, search, tuned = tune_game_model(est, config, tr, va,
+                                          n_iterations=6, mode="bayesian",
+                                          seed=0, batch_size=3)
+    assert len(tuned) == 7  # prior + 6 tuning fits, batched 3 per round
+    assert best in tuned
