@@ -100,11 +100,27 @@ def shard_coefficients(w, mesh: Mesh, axis: str = FEATURE_AXIS):
     """
     import jax.numpy as jnp
 
+    sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() > 1 and getattr(w, "is_fully_addressable", True):
+        # multihost: any PROCESS-LOCAL input (host numpy or a
+        # fully-addressable jax.Array — e.g. the coordinate's jnp.zeros
+        # cold start) becomes a GLOBAL sharded array via the per-shard
+        # callback (device_put of process-local data to a multi-process
+        # sharding is not portable).  Every host passes the same w, and the
+        # feature axis lives within each process (multihost.global_mesh),
+        # so each callback index is addressable.  An already-global array
+        # (is_fully_addressable False) takes the reshard path below.
+        w_np = np.asarray(w)
+        pad = padded_dim(w_np.shape[0], mesh, axis) - w_np.shape[0]
+        if pad:
+            w_np = np.concatenate([w_np, np.zeros(pad, w_np.dtype)])
+        return jax.make_array_from_callback(
+            w_np.shape, sharding, lambda idx: w_np[idx])
     w = jnp.asarray(w)
     pad = padded_dim(w.shape[0], mesh, axis) - w.shape[0]
     if pad:
         w = jnp.pad(w, (0, pad))
-    return jax.device_put(w, NamedSharding(mesh, P(axis)))
+    return jax.device_put(w, sharding)
 
 
 def shard_batch(batch: Batch, mesh: Mesh, axis: str = DATA_AXIS,
