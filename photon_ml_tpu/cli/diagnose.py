@@ -37,7 +37,9 @@ from photon_ml_tpu.diagnostics import (bootstrap_training, expected_magnitude_im
                                        kendall_tau_analysis, render_html, render_text,
                                        variance_importance)
 from photon_ml_tpu.diagnostics.reporting import (Bars, Bullets, Document,
-                                                 Plot, Scatter, Table, Text)
+                                                 NumberedList, Plot,
+                                                 Reference, Scatter, Table,
+                                                 Text)
 from photon_ml_tpu.models.glm import Coefficients, GLMModel
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.storage.model_io import load_game_model
@@ -60,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bootstrap-replicates", type=int, default=16)
     p.add_argument("--l2", type=float, default=1.0,
                    help="L2 weight for the diagnostic re-trains")
+    p.add_argument("--compare-l2", default="",
+                   help="comma list of L2 weights: adds a regularization-"
+                        "path comparison chapter (one nested subsection per "
+                        "weight, like the legacy driver's per-lambda report "
+                        "chapters)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--top-k", type=int, default=20)
     p.add_argument("--input-columns", default="",
@@ -130,6 +137,16 @@ def run(argv: List[str]) -> int:
     logger.info("diagnosing %d fixed + %d random coordinate(s) on %d samples",
                 len(fixed), len(random_effects), data.num_samples)
 
+    try:
+        compare_weights = [float(v) for v in args.compare_l2.split(",") if v]
+    except ValueError as e:
+        logger.error("--compare-l2: %s", e)
+        return 1
+    if any(w <= 0 for w in compare_weights):
+        logger.error("--compare-l2 weights must be positive (the comparison "
+                     "plot is on a log axis); got %s", args.compare_l2)
+        return 1
+
     obj = GLMObjective(loss=loss, reg=Regularization(l2=args.l2))
     solve = jax.jit(make_solver(obj))
 
@@ -179,6 +196,7 @@ def run(argv: List[str]) -> int:
     ]))
 
     # ---- per-fixed-coordinate chapters ----
+    fixed_batches: dict = {}
     for cid, fe in fixed.items():
         shard = fe.feature_shard
         imap = index_maps[shard]
@@ -192,7 +210,10 @@ def run(argv: List[str]) -> int:
             return f"{nm[0]}:{nm[1]}" if nm else str(j)
 
         names = [_label(j) for j in range(batch.dim)]
-        ch = doc.chapter(f"Coordinate {cid!r} (fixed effect)")
+        if compare_weights:  # retained only when the comparison chapter runs
+            fixed_batches[cid] = (batch, names)
+        ch = doc.chapter(f"Coordinate {cid!r} (fixed effect)",
+                         label=f"coord:{cid}")
         cs: dict = {}
 
         # 1. bootstrap confidence intervals (BootstrapTraining.scala:29-181)
@@ -256,6 +277,43 @@ def run(argv: List[str]) -> int:
         sec.add(Table(["feature", "importance"],
                       [[n, f"{v:.5g}"] for n, v in vi.ranked]))
         summary["coordinates"][cid] = cs
+
+    # ---- regularization-path comparison chapter (legacy Driver trains a
+    # per-lambda path and its diagnostic report carries per-lambda chapters;
+    # photon-diagnostics reporting/** nests them as sections) ----
+    if compare_weights:
+        ch = doc.chapter("Regularization path comparison", label="regpath")
+        ch.section("Weights compared").add(NumberedList(
+            [f"l2 = {w:g}" for w in compare_weights]))
+        for cid, (batch, names) in fixed_batches.items():
+            fe = fixed[cid]
+            sec = ch.section(f"Coordinate {cid!r}")
+            sec.add(Reference(f"coord:{cid}",
+                              "full diagnostics for this coordinate"))
+            published = np.asarray(fe.coefficients.means, np.float64)
+            tr_losses = []
+            for w in compare_weights:
+                res = solve(jnp.zeros(batch.dim, batch.x.dtype), batch,
+                            objective=obj.with_reg(Regularization(l2=w)))
+                m = GLMModel(coefficients=Coefficients(
+                    means=np.asarray(res.w)), task=task)
+                tr_losses.append(point_metric(m, batch))
+                ss = sec.subsection(f"l2 = {w:g}")
+                wv = np.asarray(res.w, np.float64)
+                move = np.abs(wv - published[: len(wv)])
+                order = np.argsort(-move)[: min(args.top_k, len(move))]
+                ss.add(Table(
+                    ["feature", "w(l2)", "published", "|shift|"],
+                    [[names[j], f"{wv[j]:.5g}", f"{published[j]:.5g}",
+                      f"{move[j]:.5g}"] for j in order]))
+                ss.add(Text(f"train mean loss: {tr_losses[-1]:.6g}; "
+                            f"coefficient norm: {np.linalg.norm(wv):.5g}"))
+            xs = [float(np.log10(w)) for w in compare_weights]
+            sec.add(Plot("mean loss vs log10(l2)", xs, {"train": tr_losses},
+                         x_label="log10(l2)", y_label="mean loss"))
+        summary["regularization_path"] = {
+            "weights": compare_weights,
+        }
 
     # ---- per-random-coordinate chapters ----
     for cid, re_model in random_effects.items():

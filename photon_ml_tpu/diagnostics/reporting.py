@@ -66,26 +66,59 @@ class Bullets:
     items: List[str]
 
 
-Item = Union[Text, Table, Plot, Bars, Scatter, Bullets]
+@dataclasses.dataclass
+class NumberedList:
+    """A numbered list (reference reporting/NumberedListPhysicalReport)."""
+
+    items: List[str]
+
+
+@dataclasses.dataclass
+class Reference:
+    """A cross-reference to a labeled chapter/section (reference
+    reporting/ReferencePhysicalReport): renders as an anchor link in HTML
+    and as "see §x.y (title)" in text.  ``label`` names the target
+    (Chapter/Section label=); unresolved labels render as plain text so a
+    dangling reference degrades loudly-but-safely."""
+
+    label: str
+    text: str = ""
+
+
+Item = Union[Text, Table, Plot, Bars, Scatter, Bullets, NumberedList,
+             Reference]
 
 
 @dataclasses.dataclass
 class Section:
+    """A section that may NEST (reference SectionPhysicalReport holds
+    arbitrary child physical reports, including sections): numbering walks
+    the tree depth-first — chapter.section.subsection → x.y.z — exactly the
+    reference's NumberingContext."""
+
     title: str
     items: List[Item] = dataclasses.field(default_factory=list)
+    subsections: List["Section"] = dataclasses.field(default_factory=list)
+    label: str = ""
 
     def add(self, item: Item) -> "Section":
         self.items.append(item)
         return self
+
+    def subsection(self, title: str, label: str = "") -> "Section":
+        s = Section(title, label=label)
+        self.subsections.append(s)
+        return s
 
 
 @dataclasses.dataclass
 class Chapter:
     title: str
     sections: List[Section] = dataclasses.field(default_factory=list)
+    label: str = ""
 
-    def section(self, title: str) -> Section:
-        s = Section(title)
+    def section(self, title: str, label: str = "") -> Section:
+        s = Section(title, label=label)
         self.sections.append(s)
         return s
 
@@ -95,10 +128,39 @@ class Document:
     title: str
     chapters: List[Chapter] = dataclasses.field(default_factory=list)
 
-    def chapter(self, title: str) -> Chapter:
-        c = Chapter(title)
+    def chapter(self, title: str, label: str = "") -> Chapter:
+        c = Chapter(title, label=label)
         self.chapters.append(c)
         return c
+
+
+def _walk_sections(sections, prefix):
+    """Depth-first (numbers, section) pairs; numbers like (1, 2, 3)."""
+    for i, s in enumerate(sections, 1):
+        nums = prefix + (i,)
+        yield nums, s
+        yield from _walk_sections(s.subsections, nums)
+
+
+def _number_map(doc: Document) -> Dict[str, tuple]:
+    """label -> ((numbers...), title) for every labeled chapter/section —
+    the resolution pass References need (reference NumberingContext)."""
+    out: Dict[str, tuple] = {}
+    for ci, chapter in enumerate(doc.chapters, 1):
+        if chapter.label:
+            out[chapter.label] = ((ci,), chapter.title)
+        for nums, s in _walk_sections(chapter.sections, (ci,)):
+            if s.label:
+                out[s.label] = (nums, s.title)
+    return out
+
+
+def _anchor(nums: tuple) -> str:
+    return "s" + "-".join(str(n) for n in nums)
+
+
+def _dotted(nums: tuple) -> str:
+    return ".".join(str(n) for n in nums)
 
 
 # -- renderers -----------------------------------------------------------------
@@ -192,7 +254,19 @@ def _svg_scatter(item: Scatter) -> str:
     return "".join(parts)
 
 
-def _html_item(item: Item) -> str:
+def _html_item(item: Item, labels: Dict[str, tuple] = {}) -> str:
+    if isinstance(item, Reference):
+        tgt = labels.get(item.label)
+        if tgt is None:
+            return (f"<p>[unresolved reference {html.escape(item.label)!s}"
+                    f"{': ' + html.escape(item.text) if item.text else ''}]</p>")
+        nums, title = tgt
+        disp = item.text or f"§{_dotted(nums)} {title}"
+        return (f'<p><a href="#{_anchor(nums)}">'
+                f"{html.escape(disp)}</a></p>")
+    if isinstance(item, NumberedList):
+        lis = "".join(f"<li>{html.escape(b)}</li>" for b in item.items)
+        return f"<ol>{lis}</ol>"
     if isinstance(item, Text):
         return f"<p>{html.escape(item.body)}</p>"
     if isinstance(item, Table):
@@ -214,32 +288,60 @@ def _html_item(item: Item) -> str:
 
 def render_html(doc: Document) -> str:
     """Self-contained HTML: an index (table of contents with anchor links —
-    the reference's DocumentToHTMLRenderer navigation) followed by numbered
-    chapters/sections."""
+    the reference's DocumentToHTMLRenderer navigation) followed by
+    recursively numbered chapters/sections/subsections."""
+    labels = _number_map(doc)
     out = [f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
            f"<title>{html.escape(doc.title)}</title></head><body>"
            f"<h1>{html.escape(doc.title)}</h1>"]
-    # index page: chapter/section ToC with anchors
+
+    def toc_sections(sections, prefix):
+        if not sections:
+            return
+        out.append("<ul>")
+        for i, s in enumerate(sections, 1):
+            nums = prefix + (i,)
+            out.append(f'<li><a href="#{_anchor(nums)}">{_dotted(nums)}. '
+                       f"{html.escape(s.title)}</a>")
+            toc_sections(s.subsections, nums)
+            out.append("</li>")
+        out.append("</ul>")
+
     out.append("<h2>Index</h2><ul>")
     for ci, chapter in enumerate(doc.chapters, 1):
-        out.append(f'<li><a href="#ch{ci}">{ci}. '
-                   f"{html.escape(chapter.title)}</a><ul>")
-        for si, section in enumerate(chapter.sections, 1):
-            out.append(f'<li><a href="#ch{ci}s{si}">{ci}.{si}. '
-                       f"{html.escape(section.title)}</a></li>")
-        out.append("</ul></li>")
+        out.append(f'<li><a href="#{_anchor((ci,))}">{ci}. '
+                   f"{html.escape(chapter.title)}</a>")
+        toc_sections(chapter.sections, (ci,))
+        out.append("</li>")
     out.append("</ul>")
+
+    def body_sections(sections, prefix):
+        for i, s in enumerate(sections, 1):
+            nums = prefix + (i,)
+            level = min(1 + len(nums), 6)  # h3 for x.y, h4 for x.y.z, ...
+            out.append(f'<h{level} id="{_anchor(nums)}">{_dotted(nums)}. '
+                       f"{html.escape(s.title)}</h{level}>")
+            out.extend(_html_item(item, labels) for item in s.items)
+            body_sections(s.subsections, nums)
+
     for ci, chapter in enumerate(doc.chapters, 1):
-        out.append(f'<h2 id="ch{ci}">{ci}. {html.escape(chapter.title)}</h2>')
-        for si, section in enumerate(chapter.sections, 1):
-            out.append(f'<h3 id="ch{ci}s{si}">{ci}.{si}. '
-                       f"{html.escape(section.title)}</h3>")
-            out.extend(_html_item(item) for item in section.items)
+        out.append(f'<h2 id="{_anchor((ci,))}">{ci}. '
+                   f"{html.escape(chapter.title)}</h2>")
+        body_sections(chapter.sections, (ci,))
     out.append("</body></html>")
     return "".join(out)
 
 
-def _text_item(item: Item) -> str:
+def _text_item(item: Item, labels: Dict[str, tuple] = {}) -> str:
+    if isinstance(item, Reference):
+        tgt = labels.get(item.label)
+        if tgt is None:
+            return f"[unresolved reference {item.label}]"
+        nums, title = tgt
+        disp = f" ({item.text})" if item.text else ""
+        return f"see §{_dotted(nums)} {title}{disp}"
+    if isinstance(item, NumberedList):
+        return "\n".join(f"  {i}. {b}" for i, b in enumerate(item.items, 1))
     if isinstance(item, Text):
         return item.body
     if isinstance(item, Table):
@@ -265,10 +367,13 @@ def _text_item(item: Item) -> str:
 
 
 def render_text(doc: Document) -> str:
+    """The reference's ToString render strategy: same recursively numbered
+    tree, plain text."""
+    labels = _number_map(doc)
     out = [doc.title, "=" * len(doc.title)]
     for ci, chapter in enumerate(doc.chapters, 1):
         out.append(f"\n{ci}. {chapter.title}")
-        for si, section in enumerate(chapter.sections, 1):
-            out.append(f"\n{ci}.{si}. {section.title}")
-            out.extend(_text_item(item) for item in section.items)
+        for nums, section in _walk_sections(chapter.sections, (ci,)):
+            out.append(f"\n{_dotted(nums)}. {section.title}")
+            out.extend(_text_item(item, labels) for item in section.items)
     return "\n".join(out)

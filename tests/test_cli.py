@@ -318,7 +318,8 @@ def test_diagnose_driver(tmp_path):
     diag_out = str(tmp_path / "diag")
     rc = diag_cli.run(["--data", train_path, "--holdout", val_path,
                        "--model-dir", out, "--output-dir", diag_out,
-                       "--bootstrap-replicates", "4"])
+                       "--bootstrap-replicates", "4",
+                       "--compare-l2", "0.1,1,10"])
     assert rc == 0
     html = open(os.path.join(diag_out, "report.html")).read()
     # per-coordinate chapters + model summary + full-model chapters,
@@ -328,8 +329,19 @@ def test_diagnose_driver(tmp_path):
     assert "Coordinate &#x27;user&#x27; (random effect)" in html
     assert "Calibration (full model)" in html
     assert "Residuals (full model)" in html
-    assert '<a href="#ch1">' in html  # index page
+    assert '<a href="#s1">' in html  # index page
     assert "Bootstrap" in html and "Feature importance" in html
+    # regularization-path comparison chapter: one NESTED subsection per
+    # weight, a numbered weight list, and a resolved cross-reference back
+    # to the coordinate's own chapter
+    assert "Regularization path comparison" in html
+    for w in ("0.1", "1", "10"):
+        assert f"l2 = {w}</h4>" in html
+    assert "<ol><li>l2 = 0.1</li>" in html
+    assert "full diagnostics for this coordinate" in html
+    assert "[unresolved reference" not in html
+    text = open(os.path.join(diag_out, "report.txt")).read()
+    assert "l2 = 10" in text and "see §" in text
     assert "<svg" in html and "<polyline" in html  # line plots
     assert "<rect" in html and "<circle" in html  # bar charts + scatter
     summary = json.load(open(os.path.join(diag_out, "diagnostics.json")))

@@ -156,9 +156,45 @@ class TestReporting:
         sec.add(Plot("rmse", [0.1, 0.5, 1.0],
                      {"train": [1.0, 0.8, 0.7], "holdout": [1.2, 1.0, 0.9]}))
         html_out = render_html(doc)
-        assert '<h2 id="ch1">1. Fit quality</h2>' in html_out
+        assert '<h2 id="s1">1. Fit quality</h2>' in html_out
         # index page links to every chapter/section anchor
-        assert '<a href="#ch1">' in html_out and '<a href="#ch1s1">' in html_out
+        assert '<a href="#s1">' in html_out and '<a href="#s1-1">' in html_out
         assert "<svg" in html_out and "polyline" in html_out
         text_out = render_text(doc)
         assert "1.1. Learning curve" in text_out and "[plot] rmse" in text_out
+
+    def test_nested_sections_numbered_lists_and_references(self):
+        """Reference reporting parity: sections NEST with recursive x.y.z
+        numbering (NumberingContext), NumberedList renders ordered, and
+        Reference items resolve labels to anchors in HTML / section numbers
+        in text (ReferencePhysicalReport)."""
+        from photon_ml_tpu.diagnostics.reporting import NumberedList, Reference
+
+        doc = Document("Nested")
+        ch = doc.chapter("Comparison", label="cmp")
+        sec = ch.section("Per coordinate")
+        sub = sec.subsection("l2 = 0.1", label="w01")
+        sub.add(Text("small lambda"))
+        subsub = sub.subsection("details")
+        subsub.add(NumberedList(["first", "second"]))
+        other = doc.chapter("Appendix")
+        other.section("Links").add(Reference("w01", "the small-lambda fit"))
+        other.sections[0].add(Reference("missing-label"))
+
+        html_out = render_html(doc)
+        # nested numbering + anchors: chapter 1, section 1.1, sub 1.1.1,
+        # subsub 1.1.1.1 — all present in body AND in the index
+        assert '<h3 id="s1-1">1.1. Per coordinate</h3>' in html_out
+        assert '<h4 id="s1-1-1">1.1.1. l2 = 0.1</h4>' in html_out
+        assert '<h5 id="s1-1-1-1">1.1.1.1. details</h5>' in html_out
+        assert html_out.count('href="#s1-1-1"') == 2  # index + reference
+        assert "<ol><li>first</li><li>second</li></ol>" in html_out
+        assert "the small-lambda fit" in html_out
+        assert "[unresolved reference missing-label]" in html_out
+
+        text_out = render_text(doc)
+        assert "1.1.1. l2 = 0.1" in text_out
+        assert "1.1.1.1. details" in text_out
+        assert "  1. first" in text_out and "  2. second" in text_out
+        assert "see §1.1.1 l2 = 0.1 (the small-lambda fit)" in text_out
+        assert "[unresolved reference missing-label]" in text_out
