@@ -91,6 +91,20 @@ def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
 
+    # parse --compare-l2 BEFORE any model/data load (the repo's
+    # early-failure rule: a bad flag value must not cost the whole read);
+    # weights must be positive finite — the comparison plot is log-axis
+    try:
+        compare_weights = [float(v) for v in args.compare_l2.split(",") if v]
+    except ValueError as e:
+        logger.error("--compare-l2: %s", e)
+        return 1
+    if any(not (w > 0 and np.isfinite(w)) for w in compare_weights):
+        logger.error("--compare-l2 weights must be positive finite (the "
+                     "comparison plot is on a log axis); got %s",
+                     args.compare_l2)
+        return 1
+
     from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 
     enable_compilation_cache()
@@ -136,16 +150,6 @@ def run(argv: List[str]) -> int:
                                               entity_indexes=entity_indexes)
     logger.info("diagnosing %d fixed + %d random coordinate(s) on %d samples",
                 len(fixed), len(random_effects), data.num_samples)
-
-    try:
-        compare_weights = [float(v) for v in args.compare_l2.split(",") if v]
-    except ValueError as e:
-        logger.error("--compare-l2: %s", e)
-        return 1
-    if any(w <= 0 for w in compare_weights):
-        logger.error("--compare-l2 weights must be positive (the comparison "
-                     "plot is on a log axis); got %s", args.compare_l2)
-        return 1
 
     obj = GLMObjective(loss=loss, reg=Regularization(l2=args.l2))
     solve = jax.jit(make_solver(obj))
@@ -196,7 +200,7 @@ def run(argv: List[str]) -> int:
     ]))
 
     # ---- per-fixed-coordinate chapters ----
-    fixed_batches: dict = {}
+    compare_results: dict = {}
     for cid, fe in fixed.items():
         shard = fe.feature_shard
         imap = index_maps[shard]
@@ -210,8 +214,29 @@ def run(argv: List[str]) -> int:
             return f"{nm[0]}:{nm[1]}" if nm else str(j)
 
         names = [_label(j) for j in range(batch.dim)]
-        if compare_weights:  # retained only when the comparison chapter runs
-            fixed_batches[cid] = (batch, names)
+        if compare_weights:
+            # per-weight solves run HERE so the dense float64 batch stays
+            # transient (one coordinate's at a time); only the small tables
+            # and losses are buffered for the comparison chapter below
+            published = np.asarray(fe.coefficients.means, np.float64)
+            per_weight = []
+            for w in compare_weights:
+                res = solve(jnp.zeros(batch.dim, batch.x.dtype), batch,
+                            objective=obj.with_reg(Regularization(l2=w)))
+                m = GLMModel(coefficients=Coefficients(
+                    means=np.asarray(res.w)), task=task)
+                wv = np.asarray(res.w, np.float64)
+                move = np.abs(wv - published[: len(wv)])
+                order = np.argsort(-move)[: min(args.top_k, len(move))]
+                per_weight.append({
+                    "w": w,
+                    "rows": [[names[j], f"{wv[j]:.5g}",
+                              f"{published[j]:.5g}", f"{move[j]:.5g}"]
+                             for j in order],
+                    "train_loss": point_metric(m, batch),
+                    "norm": float(np.linalg.norm(wv)),
+                })
+            compare_results[cid] = per_weight
         ch = doc.chapter(f"Coordinate {cid!r} (fixed effect)",
                          label=f"coord:{cid}")
         cs: dict = {}
@@ -285,31 +310,19 @@ def run(argv: List[str]) -> int:
         ch = doc.chapter("Regularization path comparison", label="regpath")
         ch.section("Weights compared").add(NumberedList(
             [f"l2 = {w:g}" for w in compare_weights]))
-        for cid, (batch, names) in fixed_batches.items():
-            fe = fixed[cid]
+        for cid, per_weight in compare_results.items():
             sec = ch.section(f"Coordinate {cid!r}")
             sec.add(Reference(f"coord:{cid}",
                               "full diagnostics for this coordinate"))
-            published = np.asarray(fe.coefficients.means, np.float64)
-            tr_losses = []
-            for w in compare_weights:
-                res = solve(jnp.zeros(batch.dim, batch.x.dtype), batch,
-                            objective=obj.with_reg(Regularization(l2=w)))
-                m = GLMModel(coefficients=Coefficients(
-                    means=np.asarray(res.w)), task=task)
-                tr_losses.append(point_metric(m, batch))
-                ss = sec.subsection(f"l2 = {w:g}")
-                wv = np.asarray(res.w, np.float64)
-                move = np.abs(wv - published[: len(wv)])
-                order = np.argsort(-move)[: min(args.top_k, len(move))]
-                ss.add(Table(
-                    ["feature", "w(l2)", "published", "|shift|"],
-                    [[names[j], f"{wv[j]:.5g}", f"{published[j]:.5g}",
-                      f"{move[j]:.5g}"] for j in order]))
-                ss.add(Text(f"train mean loss: {tr_losses[-1]:.6g}; "
-                            f"coefficient norm: {np.linalg.norm(wv):.5g}"))
+            for entry in per_weight:
+                ss = sec.subsection(f"l2 = {entry['w']:g}")
+                ss.add(Table(["feature", "w(l2)", "published", "|shift|"],
+                             entry["rows"]))
+                ss.add(Text(f"train mean loss: {entry['train_loss']:.6g}; "
+                            f"coefficient norm: {entry['norm']:.5g}"))
             xs = [float(np.log10(w)) for w in compare_weights]
-            sec.add(Plot("mean loss vs log10(l2)", xs, {"train": tr_losses},
+            sec.add(Plot("mean loss vs log10(l2)", xs,
+                         {"train": [e["train_loss"] for e in per_weight]},
                          x_label="log10(l2)", y_label="mean loss"))
         summary["regularization_path"] = {
             "weights": compare_weights,

@@ -199,7 +199,11 @@ def _run(args, task, t_start, emitter) -> int:
 
     shards = [s for s in args.feature_shards.split(",") if s]
     id_tags = [s for s in args.id_tags.split(",") if s]
-    specs = [parse_coordinate_spec(s) for s in args.coordinates]
+    try:
+        specs = [parse_coordinate_spec(s) for s in args.coordinates]
+    except ValueError as e:
+        logger.error("--coordinate: %s", e)
+        return 1
 
     # per-entity L2 multiplier files: validate and parse NOW — a bad path or
     # value must fail before hours of data loading (same early-failure rule
@@ -317,34 +321,24 @@ def _run(args, task, t_start, emitter) -> int:
                          if index_maps[s].size >= args.sparse_threshold}
         # random-effect coordinates train from sparse shards directly
         # (compact observed-column buckets, bucket_by_entity_sparse) EXCEPT
-        # the combinations the sparse path refuses loudly — those shards
-        # stay dense so the run keeps the round-1 behavior
-        from photon_ml_tpu.types import ProjectorType, VarianceComputationType
+        # the ONE combination the sparse path still refuses loudly — those
+        # shards stay dense so the run succeeds.  (Round 4 closed the other
+        # carve-outs: RANDOM projection, FULL variances, box constraints and
+        # shift normalization all run on sparse shards now.)
+        from photon_ml_tpu.types import VarianceComputationType
 
         needs_dense = {
             spec.template.feature_shard for spec in specs
             if not isinstance(spec.template, FixedEffectConfig)
-            and (spec.template.projector == ProjectorType.RANDOM
-                 # SIMPLE variances are exact under sparse compaction;
-                 # FULL needs the full Hessian, and variance + per-entity
-                 # normalization contexts are refused together
-                 or spec.template.variance == VarianceComputationType.FULL
-                 or (spec.template.variance != VarianceComputationType.NONE
-                     and args.normalization != "NONE")
-                 # projected.dim on a non-RANDOM projector was silently
-                 # ignored on the dense path; the sparse path rejects it —
-                 # keep such configs dense rather than break them
-                 or spec.template.projected_dim is not None
-                 # constraints are still the UNRESOLVED @file here (they
-                 # resolve later, against the index maps) — the spec field
-                 # is the truth at this point, not template.constraints
-                 or spec.constraints_file is not None)}
+            # variances under compaction + per-entity normalization
+            # contexts are refused together (game/coordinate._bind_solver)
+            and (spec.template.variance != VarianceComputationType.NONE
+                 and args.normalization != "NONE")}
         forced_dense = sparse_shards & needs_dense
         if forced_dense:
-            logger.warning("shards %s stay dense: RANDOM-projected, "
-                           "variance-computing or box-constrained "
-                           "random-effect coordinates need dense shards",
-                           sorted(forced_dense))
+            logger.warning("shards %s stay dense: variance-computing "
+                           "random-effect coordinates under normalization "
+                           "need dense shards", sorted(forced_dense))
             sparse_shards -= forced_dense
         if sparse_shards:
             logger.info("sparse shards: %s", sorted(sparse_shards))
@@ -387,35 +381,32 @@ def _run(args, task, t_start, emitter) -> int:
 
         from photon_ml_tpu.core.normalization import (build_normalization,
                                                       compute_feature_stats)
-        from photon_ml_tpu.types import NormalizationType, ProjectorType
+        from photon_ml_tpu.types import NormalizationType
 
         kind = NormalizationType[args.normalization]
         # normalization applies to EVERY coordinate on the shard, random
         # effects included (reference NormalizationContextRDD via
         # GameEstimator.prepareNormalizationContextWrappers:646-680); sparse
-        # shards compute their stats straight from the COO arrays.  The one
-        # refused combination: shift normalization (STANDARDIZATION) with a
-        # random-effect solve space that has no stable intercept column
-        # (INDEX_MAP compaction, or any sparse shard) — fail loudly up front
-        # rather than mid-fit.
+        # shards compute their stats straight from the COO arrays.  Shift
+        # normalization (STANDARDIZATION) under per-entity compaction is
+        # SUPPORTED since round 4 (the context is projected per entity and
+        # the per-lane intercept position absorbs the margin shift —
+        # game/coordinate.py); the intercept id is auto-filled from the
+        # index maps below.  The one remaining shift refusal: a
+        # feature-SHARDED sparse fixed effect (ShardSparseObjective is
+        # scaling-only — shifts would densify sparse margins).
         norm_shards = {spec.template.feature_shard for spec in specs}
         if kind == NormalizationType.STANDARDIZATION:
             for spec in specs:
                 t = spec.template
-                if isinstance(t, FixedEffectConfig):
-                    continue
-                s = t.feature_shard
-                bad = ("a sparse shard" if s in sparse_shards else
-                       "INDEX_MAP compaction"
-                       if t.projector == ProjectorType.INDEX_MAP else None)
-                if bad:
+                if (isinstance(t, FixedEffectConfig)
+                        and getattr(t, "feature_sharded", False)
+                        and t.feature_shard in sparse_shards):
                     logger.error(
-                        "coordinate %s: STANDARDIZATION shifts need a stable "
-                        "intercept column, which %s does not keep — use a "
-                        "factor-only normalization "
-                        "(SCALE_WITH_STANDARD_DEVIATION / "
-                        "SCALE_WITH_MAX_MAGNITUDE) or the IDENTITY/RANDOM "
-                        "projector on a dense shard", spec.name, bad)
+                        "coordinate %s: STANDARDIZATION shifts are not "
+                        "supported on a feature-sharded sparse fixed effect "
+                        "(shifts densify sparse margins) — use a factor-only "
+                        "normalization", spec.name)
                     return 1
         normalization = {}
         for s in sorted(norm_shards):

@@ -101,6 +101,14 @@ class RandomEffectConfig:
         elif m is not None:
             object.__setattr__(self, "per_entity_l2_multipliers",
                                tuple(sorted((int(k), float(v)) for k, v in m)))
+        if (self.projected_dim is not None
+                and self.projector != ProjectorType.RANDOM):
+            # validated at CONFIG time so every path agrees: the dense
+            # IDENTITY path used to silently ignore projected_dim and the
+            # sparse path raised mid-build — one loud, early answer instead
+            raise ValueError(
+                "projected_dim applies only to ProjectorType.RANDOM "
+                f"(got projector={self.projector.name})")
         _canonicalize_constraints(self)
 
 

@@ -685,11 +685,8 @@ class RandomEffectCoordinate(Coordinate):
             # solves in the compact space of its observed columns, built
             # DIRECTLY from the sparse rows — the full-vocabulary [E, S, d]
             # bucket tensors never exist (bucket_by_entity_sparse).
-            if (config.projected_dim is not None
-                    and config.projector != ProjectorType.RANDOM):
-                raise ValueError(
-                    "projected_dim applies only to RANDOM projection; sparse "
-                    "shards derive per-entity dimensions from observed columns")
+            # (projected_dim without RANDOM is rejected at CONFIG time —
+            # RandomEffectConfig.__post_init__ — so no guard here)
             from photon_ml_tpu.parallel.bucketing import bucket_by_entity_sparse
             from photon_ml_tpu.parallel.projection import ProjectedBuckets
 

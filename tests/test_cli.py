@@ -897,8 +897,9 @@ def test_columnar_cross_run_entity_remap_and_fingerprint(tmp_path):
 def test_normalization_applies_to_random_effects(tmp_path):
     """--normalization now normalizes random-effect coordinates too
     (reference NormalizationContextRDD): a GLMix run with STANDARDIZATION
-    trains e2e, and the refused combination (STANDARDIZATION + INDEX_MAP
-    compaction, which keeps no stable intercept) fails loudly up front."""
+    trains e2e — including under INDEX_MAP compaction since round 4 (the
+    context is projected per entity; the per-lane intercept position
+    absorbs the margin shift)."""
     from photon_ml_tpu.cli import train as train_cli
 
     train_path = str(tmp_path / "train.avro")
@@ -919,15 +920,18 @@ def test_normalization_applies_to_random_effects(tmp_path):
     summary = json.load(open(os.path.join(out, "training-summary.json")))
     assert summary["validation"]["auc"] > 0.6
 
-    # INDEX_MAP + shifts: loud usage error, not a mid-fit traceback
+    # INDEX_MAP + shifts: SUPPORTED since round 4 (the old loud refusal)
     rc = train_cli.run(base + [
         "--coordinate",
         "name=user,random.effect.type=userId,feature.shard=all,"
         "projector=INDEX_MAP,reg.weights=1",
         "--normalization", "STANDARDIZATION",
         "--output-dir", str(tmp_path / "out2")])
-    assert rc == 1
-    # ... but factor-only normalization with INDEX_MAP is fine
+    assert rc == 0
+    summary2 = json.load(open(os.path.join(tmp_path / "out2",
+                                           "training-summary.json")))
+    assert summary2["validation"]["auc"] > 0.6
+    # factor-only normalization with INDEX_MAP stays fine
     rc = train_cli.run(base + [
         "--coordinate",
         "name=user,random.effect.type=userId,feature.shard=all,"

@@ -299,7 +299,7 @@ def test_diagnose_driver_on_reference_heart(tmp_path):
     # fitting (learning curve), calibration, importance, residuals — plus
     # the index page and the model-summary chapter
     for section in ("Model summary", "Bootstrap", "Learning curve", "Hosmer",
-                    "Feature importance", "Kendall tau", 'href="#ch'):
+                    "Feature importance", "Kendall tau", 'href="#s'):
         assert section.lower() in html.lower(), section
 
 

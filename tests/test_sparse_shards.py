@@ -197,6 +197,72 @@ def test_sparse_feature_sharded_cli(tmp_path):
     assert summary["validation"]["auc"] > 0.6
 
 
+def test_sparse_re_round4_combos_cli(tmp_path):
+    """The round-4 carve-outs are CLI-REACHABLE on sparse shards: the train
+    driver no longer forces dense for FULL variances, RANDOM projection, or
+    STANDARDIZATION on random-effect coordinates (game/coordinate supports
+    them under compaction since round 4)."""
+    import json as _json
+
+    from photon_ml_tpu.cli import train as train_cli
+
+    rng = np.random.default_rng(9)
+    path = str(tmp_path / "train.avro")
+    n, vocab, k, n_users = 400, 60, 5, 10
+    w = rng.normal(size=vocab) * 0.7
+    records = []
+    for i in range(n):
+        js = rng.choice(vocab, size=k, replace=False)
+        vs = rng.normal(size=k)
+        yv = float(rng.random() < 1 / (1 + np.exp(-float(vs @ w[js]))))
+        records.append({"uid": i, "response": yv, "label": None,
+                        "features": [{"name": f"f{j}", "term": "",
+                                      "value": float(v)}
+                                     for j, v in zip(js, vs)],
+                        "weight": None, "offset": None,
+                        "metadataMap": {"userId": str(i % n_users)}})
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+
+    cases = {
+        "full_var": "name=user,random.effect.type=userId,feature.shard=all,"
+                    "reg.weights=1,variance.type=FULL",
+        "random_proj": "name=user,random.effect.type=userId,"
+                       "feature.shard=all,reg.weights=1,projector=RANDOM,"
+                       "projected.dim=4",
+    }
+    for label, coord in cases.items():
+        out = str(tmp_path / label)
+        rc = train_cli.run([
+            "--train-data", path, "--validation-data", path,
+            "--feature-shards", "all", "--evaluators", "auc",
+            "--id-tags", "userId",
+            "--coordinate", coord,
+            "--sparse-threshold", "10",  # vocab 60 > 10 -> sparse
+            "--output-dir", out])
+        assert rc == 0, label
+        summary = _json.load(open(os.path.join(out, "training-summary.json")))
+        assert summary["validation"]["auc"] > 0.5, label
+
+    # STANDARDIZATION over a sparse RE shard (per-lane projected contexts;
+    # the intercept id auto-fills from the index map)
+    out = str(tmp_path / "standardized")
+    rc = train_cli.run([
+        "--train-data", path, "--validation-data", path,
+        "--feature-shards", "all", "--evaluators", "auc",
+        "--id-tags", "userId",
+        "--normalization", "STANDARDIZATION",
+        "--coordinate",
+        "name=fixed,feature.shard=all,reg.weights=0.1",
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,"
+        "reg.weights=1",
+        "--sparse-threshold", "10",
+        "--output-dir", out])
+    assert rc == 0
+    summary = _json.load(open(os.path.join(out, "training-summary.json")))
+    assert summary["validation"]["auc"] > 0.6
+
+
 def test_sparse_feature_sharded_fused_sweep_matches_host():
     """A fused sweep CONTAINING a feature.sharded=true coordinate: the
     coordinate's state stays P("feature")-sharded [d_pad] inside the scanned
