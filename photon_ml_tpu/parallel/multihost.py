@@ -24,7 +24,19 @@ The recipe (each host runs the same code):
     block = load_rows(start, stop)       # host-local read
     block = pad_local_rows(block, rows)  # weight column padded with 0
     g = global_batch_from_local(block, mesh)
-"""
+
+Cross-process scope (tested in tests/test_parallel.py
+::test_multihost_two_processes): the fixed-effect solve runs multihost
+both data-parallel (ShardMapObjective — the one DCN all-reduce) and
+FEATURE-SHARDED (ShardSparseObjective, w blocked over the within-process
+feature axis).  RANDOM-EFFECT coordinates are currently single-process:
+their bucketing groups rows by entity GLOBALLY, so a row-split read
+cannot feed them — a multihost RE run must give every host the full
+dataset for those shards and keep the entity axis within one process
+(the reference instead shuffles per-entity across the cluster,
+RandomEffectDatasetPartitioner.scala:30-171; the TPU-native equivalent —
+entity-lane arrays assembled per process from a host-sharded entity
+range — is future work)."""
 
 from __future__ import annotations
 

@@ -1,0 +1,141 @@
+"""Property tests for the round-4 compact-space carve-outs.
+
+The random-effect coordinate solves each entity in the compact space of its
+observed columns; round 4 composed that with shift normalization, box
+constraints and FULL variances (game/coordinate.py).  These properties pin
+the math that makes each composition exact:
+
+- FULL/SIMPLE variances: the full-space Hessian is block-diagonal (an
+  unobserved column is identically zero), so compact computation + 1/λ2
+  fill equals the full-space answer.
+- box constraints: an unobserved constrained feature's full-space optimum
+  is clip(0, lo, hi) — the back-projection fill's value.
+- per-lane projected contexts: a published original-space model maps back
+  to the transformed-space iterate (the maps are inverses per lane).
+
+Shapes are FIXED (hypothesis varies data only) so every example reuses one
+compiled solve.
+"""
+
+import os
+import sys
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402  (conftest forces cpu + x64)
+
+from photon_ml_tpu.core.batch import dense_batch  # noqa: E402
+from photon_ml_tpu.core.losses import logistic_loss  # noqa: E402
+from photon_ml_tpu.core.objective import GLMObjective  # noqa: E402
+from photon_ml_tpu.core.regularization import Regularization  # noqa: E402
+from photon_ml_tpu.types import VarianceComputationType  # noqa: E402
+from photon_ml_tpu.utils.linalg import cholesky_inverse  # noqa: E402
+
+_N, _D, _OBS = 24, 8, 4  # samples, full dim, observed columns
+
+
+@st.composite
+def _entity_problem(draw):
+    """One entity's data over _OBS observed columns of a _D-wide space."""
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    obs = np.sort(rng.choice(_D, size=_OBS, replace=False))
+    x_c = rng.normal(size=(_N, _OBS))
+    y = (rng.random(_N) < 0.5).astype(float)
+    w_c = rng.normal(size=_OBS) * 0.5
+    l2 = float(draw(st.floats(min_value=0.1, max_value=5.0)))
+    return obs, x_c, y, w_c, l2
+
+
+@settings(max_examples=15, deadline=None)
+@given(_entity_problem())
+def test_full_variances_block_diagonal_exact(prob):
+    """diag(H_full⁻¹) == [diag(H_compact⁻¹) on observed, 1/λ2 elsewhere] —
+    the fact _expand_compact_variances relies on for FULL variances under
+    compaction (game/coordinate.py)."""
+    obs, x_c, y, w_c, l2 = prob
+    x_full = np.zeros((_N, _D))
+    x_full[:, obs] = x_c
+    w_full = np.zeros(_D)
+    w_full[obs] = w_c
+
+    obj = GLMObjective(loss=logistic_loss, reg=Regularization(l2=l2))
+    h_full = np.asarray(obj.hessian(jnp.asarray(w_full),
+                                    dense_batch(x_full, y)))
+    v_full = np.diagonal(np.asarray(cholesky_inverse(jnp.asarray(h_full))))
+
+    h_c = np.asarray(obj.hessian(jnp.asarray(w_c), dense_batch(x_c, y)))
+    v_c = np.diagonal(np.asarray(cholesky_inverse(jnp.asarray(h_c))))
+    v_expand = np.full(_D, 1.0 / l2)
+    v_expand[obs] = v_c
+    np.testing.assert_allclose(v_full, v_expand, rtol=1e-8, atol=1e-12)
+
+
+@settings(max_examples=15, deadline=None)
+@given(_entity_problem(),
+       st.floats(min_value=0.01, max_value=0.3),
+       st.floats(min_value=0.4, max_value=1.0))
+def test_unobserved_box_optimum_is_clipped_zero(prob, lo, hi):
+    """A constrained feature the entity never observes reaches exactly
+    clip(0, lo, hi) in the FULL-space box solve — the value the compact
+    path's back-projection fill publishes (BucketProjection.back_project)."""
+    from photon_ml_tpu.opt.solve import make_solver
+    from photon_ml_tpu.opt.types import SolverConfig
+
+    obs, x_c, y, w_c, l2 = prob
+    x_full = np.zeros((_N, _D))
+    x_full[:, obs] = x_c
+    unobs = [j for j in range(_D) if j not in set(obs.tolist())]
+
+    lo_v = np.full(_D, -np.inf)
+    hi_v = np.full(_D, np.inf)
+    for j in unobs:
+        lo_v[j], hi_v[j] = lo, hi  # a positive box away from 0
+    obj = GLMObjective(loss=logistic_loss, reg=Regularization(l2=l2))
+    solve = make_solver(obj, config=SolverConfig(max_iters=60),
+                        box=(jnp.asarray(lo_v), jnp.asarray(hi_v)))
+    import jax
+
+    res = jax.jit(solve)(jnp.zeros(_D), dense_batch(x_full, y))
+    w = np.asarray(res.w)
+    expected = float(np.clip(0.0, lo, hi))  # == lo here (lo > 0)
+    np.testing.assert_allclose(w[unobs], expected, atol=1e-6)
+
+
+@settings(max_examples=10, deadline=None)
+@given(_entity_problem(),
+       st.lists(st.floats(min_value=0.2, max_value=5.0),
+                min_size=_OBS, max_size=_OBS),
+       st.lists(st.floats(min_value=-2.0, max_value=2.0),
+                min_size=_OBS, max_size=_OBS))
+def test_per_lane_context_maps_are_inverses(prob, facs, shifts):
+    """The per-lane projected context's coefficient-space maps round-trip:
+    model_to_original_space ∘ model_to_transformed_space == id at the lane's
+    own intercept position — what warm starts + publishing rely on under
+    shift normalization with compaction (game/coordinate._warm_start /
+    _lanes_to_original)."""
+    from photon_ml_tpu.core.normalization import NormalizationContext
+
+    obs, x_c, y, w_c, l2 = prob
+    ii = 0  # compact intercept position within the lane
+    x_c = x_c.copy()
+    x_c[:, ii] = 1.0  # margin invariance NEEDS a real intercept column:
+    # the shift folds into its coefficient (why the compact path requires
+    # the intercept observed in every sample)
+    fac = np.asarray(facs)
+    sh = np.asarray(shifts)
+    fac[ii], sh[ii] = 1.0, 0.0  # the intercept column is never transformed
+    ctx = NormalizationContext(factors=jnp.asarray(fac),
+                               shifts=jnp.asarray(sh))
+    w_t = ctx.model_to_transformed_space(jnp.asarray(w_c), ii)
+    w_rt = ctx.model_to_original_space(w_t, ii)
+    np.testing.assert_allclose(np.asarray(w_rt), w_c, rtol=1e-9, atol=1e-10)
+    # and margins are invariant: eff(w_t)·x + margin_shift == w_orig·x
+    z_t = (np.asarray(ctx.effective_coefficients(w_t)) @ x_c.T
+           + float(ctx.margin_shift(w_t)))
+    z_o = w_c @ x_c.T
+    np.testing.assert_allclose(z_t, z_o, rtol=1e-8, atol=1e-9)

@@ -36,8 +36,11 @@ EOF
     rc=$?
     echo "[$(date -u +%H:%M:%S)] lost-config bench rc=$rc -> TPU_BENCH_RETRY.json" >> "$LOG"
     if [ "$rc" = "0" ]; then
-      # full checklist: pallas non-interpret parity (now incl. the bf16
-      # storage case) + the full bench with A/B chain -> TPU_CHECKLIST.json
+      # full checklist: pallas non-interpret parity (incl. the bf16 storage
+      # case) + the full bench with A/B chain AND the chip-scale glmix_chip
+      # (its ~200MB upload belongs here, not in the minimal lost-config
+      # pass above — a window closing mid-upload must not cost the three
+      # headline configs) -> TPU_CHECKLIST.json
       echo "[$(date -u +%H:%M:%S)] full checklist (pallas + bench A/Bs)" >> "$LOG"
       python tools/tpu_checklist.py >> "$LOG" 2>&1
       echo "[$(date -u +%H:%M:%S)] checklist rc=$? -> TPU_CHECKLIST.json" >> "$LOG"
