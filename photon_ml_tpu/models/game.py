@@ -71,7 +71,16 @@ class RandomEffectModel(DatumScoringModel):
     ``w_stack[slot_of[entity_id]]`` is that entity's coefficient vector;
     samples whose entity has no model score 0 (reference convention).
     ``variances`` optional, aligned with w_stack rows.
-    """
+
+    Scale note: the stack is DENSE [num_entities, d] — the right layout for
+    device gather-scoring and the modest per-entity bags the reference's
+    GLMix deployments use, but it couples the entity axis to the vocabulary
+    width (1M entities x 1M-feature bags would need a compact per-entity
+    storage like the reference's sparse per-REId vectors; the training path
+    already never densifies — bucket_by_entity_sparse — so the gap is this
+    published container + its scoring gather, recorded here as future
+    work).  On-disk NTV storage is already sparse (nonzero means only,
+    storage/model_io.py)."""
 
     w_stack: np.ndarray  # [num_entities, d]
     slot_of: Dict[int, int]

@@ -21,6 +21,16 @@ from flax import struct
 
 from photon_ml_tpu.types import ConvergenceReason
 
+# Working-precision plateau width, in ulps of the objective value.  Shared
+# INVARIANT with opt/linesearch.py's approximate-Wolfe slack: the line
+# search may accept a step whose objective is up to PLATEAU_ULPS ulps worse
+# than phi0, and convergence_check's function tolerance is floored at the
+# same width — so any slack-accepted step is immediately recognized as
+# converged and the solver can never creep uphill across iterations.
+# Raising the slack without raising the floor would reintroduce the
+# plateau-thrashing pathology both exist to prevent.
+PLATEAU_ULPS = 4.0
+
 Array = jax.Array
 
 
@@ -115,7 +125,18 @@ def convergence_check(value, prev_value, init_value, grad_norm, init_grad_norm,
     reference's check order: function values, gradient, max-iterations.
     """
     eps = jnp.asarray(jnp.finfo(value.dtype).tiny, value.dtype)
-    f_tol = tolerance * jnp.maximum(jnp.abs(init_value), eps)
+    # Working-precision floor: |f_k - f_{k-1}| cannot be resolved below a
+    # few ulps of f, so a relative tolerance tighter than that (easy at f32
+    # with large n: tol*|f0| ~ 1 ulp of f) makes convergence ulp-LUCK — the
+    # unlucky path burns a full max_linesearch of objective passes in a
+    # doomed final line search before exiting via OBJECTIVE_NOT_IMPROVING
+    # (measured 5x on full-scale glmix2).  The reference runs f64 where
+    # tol*|f0| is always far above this floor, so clamping preserves its
+    # semantics while making f32 exit deterministically at the plateau.
+    ulp = jnp.asarray(jnp.finfo(value.dtype).eps, value.dtype) * jnp.maximum(
+        jnp.abs(value), jnp.abs(prev_value))
+    f_tol = jnp.maximum(tolerance * jnp.maximum(jnp.abs(init_value), eps),
+                        PLATEAU_ULPS * ulp)
     g_tol = tolerance * jnp.maximum(init_grad_norm, eps)
     func_conv = jnp.abs(value - prev_value) <= f_tol
     grad_conv = grad_norm <= g_tol

