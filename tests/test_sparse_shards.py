@@ -266,6 +266,47 @@ def test_sparse_re_round4_combos_cli(tmp_path):
     assert summary["validation"]["auc"] > 0.6
 
 
+def test_sparse_feature_sharded_bf16_storage():
+    """feature.sharded x sparse x bf16 storage compose: the blocked-w
+    sharded objective reads storage-width values and widens in-register
+    (ShardSparseObjective._local_margins vals.astype(blk.dtype)), so the
+    solve tracks the f32 twin to bf16 input resolution."""
+    import jax
+
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(5)
+    n, d, k = 512, 97, 6
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    w = rng.normal(size=d) * 0.5
+    y = (rng.random(n) < 1 / (1 + np.exp(
+        -np.einsum("nk,nk->n", vals, w[idx])))).astype(np.float32)
+    from photon_ml_tpu.game.data import GameData
+
+    gd = GameData(y=y, features={"g": SparseShard(indices=idx, values=vals,
+                                                  dim=d)})
+    mesh = make_mesh(n_data=2, n_feature=4, devices=jax.devices())
+    out = {}
+    from photon_ml_tpu.game.coordinate import build_coordinate
+
+    for sd in (None, "bfloat16"):
+        cfg = FixedEffectConfig(feature_shard="g",
+                                solver=__import__("photon_ml_tpu.opt.types",
+                                                  fromlist=["SolverConfig"]
+                                                  ).SolverConfig(max_iters=40),
+                                reg=Regularization(l2=0.5),
+                                feature_sharded=True, storage_dtype=sd)
+        c = build_coordinate("fixed", gd, cfg, TaskType.LOGISTIC_REGRESSION,
+                             mesh)
+        m, _ = c.update(np.zeros(n, np.float32))
+        out[sd or "f32"] = np.asarray(m.coefficients.means)
+        assert out[sd or "f32"].shape == (d,)
+        assert np.all(np.isfinite(out[sd or "f32"]))
+    np.testing.assert_allclose(out["bfloat16"], out["f32"], atol=1.5e-2)
+
+
 def test_sparse_feature_sharded_fused_sweep_matches_host():
     """A fused sweep CONTAINING a feature.sharded=true coordinate: the
     coordinate's state stays P("feature")-sharded [d_pad] inside the scanned
