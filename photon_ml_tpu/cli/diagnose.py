@@ -110,12 +110,15 @@ def run(argv: List[str]) -> int:
     enable_compilation_cache()
     model, task, index_maps, entity_indexes = _load_dir(args.model_dir)
 
-    from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+    from photon_ml_tpu.models.game import (CompactRandomEffectModel,
+                                           FixedEffectModel,
+                                           RandomEffectModel)
 
     fixed = {cid: m for cid, m in model.models.items()
              if isinstance(m, FixedEffectModel)}
     random_effects = {cid: m for cid, m in model.models.items()
-                      if isinstance(m, RandomEffectModel)}
+                      if isinstance(m, (RandomEffectModel,
+                                        CompactRandomEffectModel))}
     if not fixed:
         logger.error("no fixed-effect coordinate in the model")
         return 1
@@ -188,10 +191,11 @@ def run(argv: List[str]) -> int:
                 f"{mcid}: fixed effect on shard {m.feature_shard!r}, "
                 f"{len(m.coefficients.means)} coefficients")
         else:
+            width = (m.w_stack.shape[1] if hasattr(m, "w_stack") else m.dim)
             inventory.append(
                 f"{mcid}: random effect per {m.random_effect_type!r} on shard "
                 f"{m.feature_shard!r}, {m.num_entities} entities x "
-                f"{m.w_stack.shape[1]} coefficients")
+                f"{width} coefficients")
     ch.section("Coordinates").add(Bullets(inventory))
     ch.section("Data").add(Bullets([
         f"training samples: {data.num_samples}",
@@ -331,7 +335,11 @@ def run(argv: List[str]) -> int:
     # ---- per-random-coordinate chapters ----
     for cid, re_model in random_effects.items():
         ch = doc.chapter(f"Coordinate {cid!r} (random effect)")
-        norms = np.linalg.norm(np.asarray(re_model.w_stack, np.float64), axis=1)
+        # either container: the compact model's value rows are 0-padded, so
+        # their norms equal the dense rows'
+        stack = (re_model.w_stack if hasattr(re_model, "w_stack")
+                 else re_model.values)
+        norms = np.linalg.norm(np.asarray(stack, np.float64), axis=1)
         qs = np.quantile(norms, [0.0, 0.25, 0.5, 0.75, 1.0]) if len(norms) else [0] * 5
         ch.section("Per-entity coefficient norms").add(Table(
             ["entities", "min", "p25", "median", "p75", "max"],

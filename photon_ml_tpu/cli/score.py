@@ -118,10 +118,12 @@ def run(argv: List[str]) -> int:
         # reference logDataAndModelStats: toSummaryString dumps of the model
         # and the prepared dataset
         for cid, m in model.models.items():
-            if hasattr(m, "w_stack"):
+            if hasattr(m, "slot_of"):  # either random-effect container
+                width = (m.w_stack.shape[1] if hasattr(m, "w_stack")
+                         else m.dim)
                 logger.info("model %s: random effect %s, %d entities x %d "
                             "features", cid, m.random_effect_type,
-                            m.w_stack.shape[0], m.w_stack.shape[1])
+                            m.num_entities, width)
             else:
                 logger.info("model %s: fixed effect, %d features", cid,
                             len(m.coefficients.means))

@@ -1144,6 +1144,23 @@ class RandomEffectCoordinate(Coordinate):
             new._refresh_lane_mult()
         return new
 
+    @staticmethod
+    def _dense_init(init):
+        """Warm-start models arrive in either random-effect container; the
+        warm-start gathers below need the dense stack, so a compact model
+        densifies HERE (once per update, logged — at true wide-vocabulary
+        scale the caller should warm-start selectively instead)."""
+        from photon_ml_tpu.models.game import CompactRandomEffectModel
+
+        if isinstance(init, CompactRandomEffectModel):
+            import logging
+
+            logging.getLogger("photon_ml_tpu.coordinate").info(
+                "densifying a CompactRandomEffectModel warm start "
+                "(%d entities x %d features)", init.num_entities, init.dim)
+            return init.to_dense()
+        return init
+
     def _warm_start(self, bucket_index: int, init: RandomEffectModel) -> np.ndarray:
         """Full-dim warm-start lanes, projected into the solve space if needed."""
         b = self.buckets.buckets[bucket_index]
@@ -1225,6 +1242,7 @@ class RandomEffectCoordinate(Coordinate):
     def update(self, total_offsets: np.ndarray, seed: int = 0,
                init: Optional[RandomEffectModel] = None
                ) -> Tuple[RandomEffectModel, List[SolverResult]]:
+        init = self._dense_init(init)
         offs = jnp.asarray(np.asarray(total_offsets, self._dtype))
         coeffs = []
         variances = [] if self._vvar is not None else None
@@ -1279,6 +1297,7 @@ class RandomEffectCoordinate(Coordinate):
         (RandomEffectCoordinate.scala:114-127)."""
         if init is None:
             return model
+        init = self._dense_init(init)
         carried = sorted(eid for eid in init.slot_of
                          if eid not in model.slot_of)
         if not carried:
@@ -1318,6 +1337,7 @@ class RandomEffectCoordinate(Coordinate):
 
         if init is None:
             return None
+        init = self._dense_init(init)
         carried = np.fromiter(
             (eid for eid in init.slot_of if eid not in self._slot_of),
             np.int64)
@@ -1355,6 +1375,7 @@ class RandomEffectCoordinate(Coordinate):
     # State = tuple of per-bucket lane coefficient arrays [(lanes, d), ...].
 
     def init_sweep_state(self, init: Optional[RandomEffectModel] = None) -> Tuple[Array, ...]:
+        init = self._dense_init(init)
         lanes = []
         for bi, b in enumerate(self.buckets.buckets):
             if init is not None:

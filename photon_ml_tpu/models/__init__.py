@@ -1,5 +1,6 @@
 from photon_ml_tpu.models.glm import Coefficients, GLMModel  # noqa: F401
 from photon_ml_tpu.models.game import (  # noqa: F401
+    CompactRandomEffectModel,
     DatumScoringModel,
     FixedEffectModel,
     RandomEffectModel,

@@ -74,13 +74,13 @@ class RandomEffectModel(DatumScoringModel):
 
     Scale note: the stack is DENSE [num_entities, d] — the right layout for
     device gather-scoring and the modest per-entity bags the reference's
-    GLMix deployments use, but it couples the entity axis to the vocabulary
-    width (1M entities x 1M-feature bags would need a compact per-entity
-    storage like the reference's sparse per-REId vectors; the training path
-    already never densifies — bucket_by_entity_sparse — so the gap is this
-    published container + its scoring gather, recorded here as future
-    work).  On-disk NTV storage is already sparse (nonzero means only,
-    storage/model_io.py)."""
+    GLMix deployments use.  For wide vocabularies, ``to_compact()`` yields
+    the sparse twin (CompactRandomEffectModel below: memory ∝ observed
+    columns, like the reference's per-REId sparse vectors), matching the
+    training path, which never densifies (bucket_by_entity_sparse).
+    On-disk NTV storage is already sparse (nonzero means only,
+    storage/model_io.py); the compact container also saves natively sparse
+    in the columnar format."""
 
     w_stack: np.ndarray  # [num_entities, d]
     slot_of: Dict[int, int]
@@ -94,9 +94,7 @@ class RandomEffectModel(DatumScoringModel):
         return self.w_stack.shape[0]
 
     def slots_for(self, data: GameData) -> np.ndarray:
-        from photon_ml_tpu.game.coordinate import _slots_from
-
-        return _slots_from(self.slot_of, data.id_tags[self.random_effect_type])
+        return _entity_slots(self, data)
 
     def score(self, data: GameData) -> Array:
         shard = data.features[self.feature_shard]
@@ -118,6 +116,147 @@ class RandomEffectModel(DatumScoringModel):
             return None
         var = self.variances[slot] if self.variances is not None else None
         return Coefficients(means=self.w_stack[slot], variances=var)
+
+    def to_compact(self, k: Optional[int] = None) -> "CompactRandomEffectModel":
+        """Sparse per-entity container: O(entities x observed columns)
+        instead of O(entities x vocabulary) — the published-model twin of
+        the training path's bucket_by_entity_sparse (see the scale note
+        above).  ``k``: per-entity coefficient capacity (default: the max
+        nonzero count across entities; an explicit k BELOW that is an error
+        — truncation would silently change scores — while a roomier k just
+        pads).  Models carrying coefficient VARIANCES refuse: the variance
+        rows are dense on a different support (prior-only fill lives at
+        zero-coefficient columns), so compacting on the coefficient pattern
+        would silently drop them."""
+        if self.variances is not None:
+            raise ValueError(
+                "to_compact would silently drop coefficient variances "
+                "(their support differs from the coefficients' — prior-only "
+                "variances live at zero-coefficient columns); keep the "
+                "dense model, or compact a variance-free copy deliberately")
+        w = np.asarray(self.w_stack)
+        e, d = w.shape
+        # O(nnz) build: np.nonzero walks row-major, so cols arrive grouped
+        # by row in ascending column order (searchsorted-ready) — no
+        # full-width [e, d] argsort/int64 transient (which would dwarf the
+        # stack itself at the wide-vocabulary scale this container targets)
+        rows, cols = np.nonzero(w)
+        counts = np.bincount(rows, minlength=e)
+        k_need = int(counts.max()) if e else 0
+        if k is None:
+            k = max(1, k_need)
+        elif k < k_need:
+            raise ValueError(
+                f"capacity k={k} < densest entity's {k_need} nonzero "
+                "coefficients — truncation would silently change scores")
+        offsets = np.zeros(e + 1, np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        pos = np.arange(len(rows)) - offsets[rows]  # position within row
+        idx = np.full((e, k), d, np.int32)
+        val = np.zeros((e, k), w.dtype)
+        idx[rows, pos] = cols
+        val[rows, pos] = w[rows, cols]
+        return CompactRandomEffectModel(
+            indices=idx, values=val, dim=d, slot_of=dict(self.slot_of),
+            random_effect_type=self.random_effect_type,
+            feature_shard=self.feature_shard, task=self.task)
+
+
+def _entity_slots(model, data: "GameData") -> np.ndarray:
+    from photon_ml_tpu.game.coordinate import _slots_from
+
+    return _slots_from(model.slot_of, data.id_tags[model.random_effect_type])
+
+
+@jax.jit
+def _score_dense_compact(w_idx: Array, w_val: Array, slots: Array,
+                         x: Array) -> Array:
+    """Σ_t values[e,t] * x[i, indices[e,t]] — gather the DENSE design at
+    each entity's observed columns (never materializing [E, d])."""
+    e = jnp.where(slots >= 0, slots, 0)
+    idx = w_idx[e]  # [n, k]
+    xv = jnp.take_along_axis(x, jnp.clip(idx, 0, x.shape[1] - 1), axis=1)
+    s = jnp.sum(w_val[e] * jnp.where(idx < x.shape[1], xv, 0.0), axis=1)
+    return jnp.where(slots >= 0, s, 0.0)
+
+
+@jax.jit
+def _score_sparse_compact(w_idx: Array, w_val: Array, slots: Array,
+                          f_idx: Array, f_val: Array) -> Array:
+    """Sparse-features x sparse-model margins: binary-search each sample
+    feature id into its entity's sorted coefficient columns (miss -> 0)."""
+    e = jnp.where(slots >= 0, slots, 0)
+    rows_idx = w_idx[e]  # [n, k_model] sorted, padded with dim
+    rows_val = w_val[e]
+    pos = jax.vmap(jnp.searchsorted)(rows_idx, f_idx)  # [n, k_feat]
+    pos_c = jnp.clip(pos, 0, rows_idx.shape[1] - 1)
+    hit = jnp.take_along_axis(rows_idx, pos_c, axis=1) == f_idx
+    wv = jnp.where(hit, jnp.take_along_axis(rows_val, pos_c, axis=1), 0.0)
+    s = jnp.sum(f_val * wv, axis=1)
+    return jnp.where(slots >= 0, s, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactRandomEffectModel(DatumScoringModel):
+    """Per-entity GLMs as SPARSE coefficient rows — the wide-vocabulary
+    published container (reference RandomEffectModel.scala:304 holds
+    per-REId GLMs whose coefficient vectors are sparse Breeze vectors; the
+    dense ``RandomEffectModel`` is the right layout for modest bags, this
+    one decouples entity count from vocabulary width).
+
+    ``indices[slot]`` are that entity's observed column ids, ascending,
+    padded with ``dim`` (out of range — inert everywhere); ``values`` align,
+    padded with 0.  Scoring never builds an [E, d] stack: dense shards
+    gather x at the entity's observed columns, sparse shards binary-search
+    each sample feature into the entity's sorted columns.  Missing entities
+    score 0 (reference convention)."""
+
+    indices: np.ndarray  # [num_entities, k] int32, sorted, dim-padded
+    values: np.ndarray   # [num_entities, k]
+    dim: int
+    slot_of: Dict[int, int]
+    random_effect_type: str
+    feature_shard: str
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    @property
+    def num_entities(self) -> int:
+        return self.indices.shape[0]
+
+    def slots_for(self, data: GameData) -> np.ndarray:
+        return _entity_slots(self, data)
+
+    def score(self, data: GameData) -> Array:
+        shard = data.features[self.feature_shard]
+        if shard.shape[1] != self.dim:
+            # loud, like the dense twin's einsum shape error — the padding
+            # mask in the scoring kernels would otherwise silently zero
+            # real coefficients on a mis-bound shard
+            raise ValueError(
+                f"shard {self.feature_shard!r} has {shard.shape[1]} "
+                f"features but this model was trained on {self.dim}")
+        slots = jnp.asarray(self.slots_for(data))
+        w_idx = jnp.asarray(self.indices)
+        w_val = jnp.asarray(self.values)
+        if hasattr(shard, "indices"):
+            return _score_sparse_compact(
+                w_idx, w_val, slots,
+                jnp.asarray(np.asarray(shard.indices, np.int32)),
+                jnp.asarray(np.asarray(shard.values, self.values.dtype)))
+        return _score_dense_compact(w_idx, w_val, slots,
+                                    jnp.asarray(shard, self.values.dtype))
+
+    def to_dense(self) -> RandomEffectModel:
+        e, k = self.indices.shape
+        w = np.zeros((e, self.dim), self.values.dtype)
+        rows = np.repeat(np.arange(e), k)
+        idx = self.indices.reshape(-1)
+        keep = idx < self.dim
+        w[rows[keep], idx[keep]] = self.values.reshape(-1)[keep]
+        return RandomEffectModel(
+            w_stack=w, slot_of=dict(self.slot_of),
+            random_effect_type=self.random_effect_type,
+            feature_shard=self.feature_shard, task=self.task)
 
 
 @dataclasses.dataclass

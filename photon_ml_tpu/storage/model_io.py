@@ -336,13 +336,49 @@ def save_coordinate(
         if fp is not None:
             out["index_fingerprint"] = fp
         return out
+    def _write_entity_directory(cdir, m, eidx):
+        """(entity_ids, slots) arrays + the id-index.json name remap — the
+        ONE id-map contract both random-effect containers' columnar saves
+        share (the loader resolves names through it either way)."""
+        eids = np.asarray(sorted(m.slot_of), np.int64)
+        slots = np.asarray([m.slot_of[int(e)] for e in eids], np.int64)
+        id_map = {str(eid): (eidx.name_of(eid) if eidx is not None
+                             else str(eid))
+                  for eid in m.slot_of}
+        with open(os.path.join(cdir, "id-index.json"), "w") as f:
+            json.dump(id_map, f)
+        return eids, slots
+
+    from photon_ml_tpu.models.game import CompactRandomEffectModel
+
+    if isinstance(m, CompactRandomEffectModel):
+        # the wide-vocabulary container saves NATIVELY sparse in the
+        # columnar format (its whole point is never materializing [E, d]);
+        # the reference-format avro writers walk dense rows, so that export
+        # asks for an explicit to_dense()
+        if fmt != "columnar":
+            raise ValueError(
+                f"coordinate {cid!r}: CompactRandomEffectModel saves in the "
+                "columnar format only — pass fmt='columnar', or convert "
+                "with .to_dense() for the reference avro format")
+        eidx = entity_indexes.get(m.random_effect_type)
+        eids, slots = _write_entity_directory(cdir, m, eidx)
+        np.savez(os.path.join(cdir, "coefficients.npz"),
+                 re_indices=np.asarray(m.indices),
+                 re_values=np.asarray(m.values),
+                 re_dim=np.asarray(m.dim, np.int64),
+                 entity_ids=eids, slots=slots)
+        out = {"type": "random", "feature_shard": m.feature_shard,
+               "random_effect_type": m.random_effect_type}
+        if fp is not None:
+            out["index_fingerprint"] = fp
+        return out
     if isinstance(m, RandomEffectModel):
         eidx = entity_indexes.get(m.random_effect_type)
+        eids, slots = _write_entity_directory(cdir, m, eidx)
         if fmt == "columnar":
-            eids = np.asarray(sorted(m.slot_of), np.int64)
             arrays = {"w_stack": np.asarray(m.w_stack), "entity_ids": eids,
-                      "slots": np.asarray([m.slot_of[int(e)] for e in eids],
-                                          np.int64)}
+                      "slots": slots}
             if m.variances is not None:
                 arrays["variances"] = np.asarray(m.variances)
             np.savez(os.path.join(cdir, "coefficients.npz"), **arrays)
@@ -352,10 +388,6 @@ def save_coordinate(
             if not _write_re_avro_fast(rpath, m, eidx, imap, m.task.value):
                 avro_io.write_container(rpath, BAYESIAN_LINEAR_MODEL,
                                         _re_records(m, eidx, imap, m.task.value))
-        id_map = {str(eid): (eidx.name_of(eid) if eidx is not None else str(eid))
-                  for eid in m.slot_of}
-        with open(os.path.join(cdir, "id-index.json"), "w") as f:
-            json.dump(id_map, f)
         out = {
             "type": "random",
             "feature_shard": m.feature_shard,
@@ -450,7 +482,10 @@ def load_game_model(
             else:
                 cdir = os.path.join(model_dir, "random-effect", cid)
                 z = np.load(os.path.join(cdir, "coefficients.npz"))
-                _check_binding(cid, info, z["w_stack"].shape[-1])
+                compact = "re_indices" in z
+                _check_binding(cid, info,
+                               int(z["re_dim"]) if compact
+                               else z["w_stack"].shape[-1])
                 re_type = info["random_effect_type"]
                 # entity ids remap BY NAME through id-index.json (same
                 # contract as the avro path's _stack_random_effect): the
@@ -465,11 +500,22 @@ def load_game_model(
                            if eidx is not None and name is not None
                            else int(e))
                     slot_of[eid] = int(s)
-                models[cid] = RandomEffectModel(
-                    w_stack=z["w_stack"], slot_of=slot_of,
-                    random_effect_type=re_type,
-                    feature_shard=shard, task=task,
-                    variances=z["variances"] if "variances" in z else None)
+                if compact:
+                    from photon_ml_tpu.models.game import \
+                        CompactRandomEffectModel
+
+                    models[cid] = CompactRandomEffectModel(
+                        indices=z["re_indices"], values=z["re_values"],
+                        dim=int(z["re_dim"]), slot_of=slot_of,
+                        random_effect_type=re_type,
+                        feature_shard=shard, task=task)
+                else:
+                    models[cid] = RandomEffectModel(
+                        w_stack=z["w_stack"], slot_of=slot_of,
+                        random_effect_type=re_type,
+                        feature_shard=shard, task=task,
+                        variances=z["variances"] if "variances" in z
+                        else None)
         return GameModel(models=models), task
 
     for cid, info in meta["coordinates"].items():

@@ -377,3 +377,48 @@ def test_write_game_data_numpy_uids_and_empty_names(tmp_path, rng):
     recs = list(avro_io.read_container(path))
     assert [r["uid"] for r in recs] == [0, 1, 2]
     assert all(r["metadataMap"]["t"] == "" for r in recs)
+
+
+def test_compact_random_effect_columnar_roundtrip(tmp_path):
+    """CompactRandomEffectModel saves NATIVELY sparse in the columnar
+    format (never materializing [E, d]) and loads back as itself, scoring
+    identically; the dense-walking avro format refuses with remediation."""
+    from photon_ml_tpu.models.game import (CompactRandomEffectModel,
+                                           RandomEffectModel)
+
+    rng = np.random.default_rng(4)
+    e, d = 6, 40
+    w = np.zeros((e, d), np.float64)
+    for i in range(e):
+        w[i, rng.choice(d, size=3, replace=False)] = rng.normal(size=3)
+    imap = IndexMap.from_features([(f"f{j}", "") for j in range(d - 1)])
+    eidx = EntityIndex()
+    for i in range(e):
+        eidx.get_or_add(f"u{i}")
+    dense = RandomEffectModel(w_stack=w, slot_of={i: i for i in range(e)},
+                              random_effect_type="userId", feature_shard="s",
+                              task=TaskType.LOGISTIC_REGRESSION)
+    compact = dense.to_compact()
+    model = GameModel(models={"per-user": compact})
+    out = str(tmp_path / "model")
+    save_game_model(model, out, {"s": imap}, {"userId": eidx},
+                    fmt="columnar")
+    eidx2 = EntityIndex()
+    for i in range(e):
+        eidx2.get_or_add(f"u{i}")
+    loaded, _ = load_game_model(out, {"s": imap}, {"userId": eidx2})
+    lre = loaded["per-user"]
+    assert isinstance(lre, CompactRandomEffectModel)
+    np.testing.assert_array_equal(lre.to_dense().w_stack, w)
+
+    gd = GameData(y=np.zeros(8),
+                  features={"s": np.asarray(
+                      np.random.default_rng(1).normal(size=(8, d)))},
+                  id_tags={"userId": np.asarray([0, 1, 2, 3, 4, 5, 0, 77])})
+    np.testing.assert_allclose(np.asarray(lre.score(gd)),
+                               np.asarray(dense.score(gd)),
+                               rtol=1e-9, atol=1e-12)
+
+    with pytest.raises(ValueError, match="columnar"):
+        save_game_model(model, str(tmp_path / "m2"), {"s": imap},
+                        {"userId": eidx})

@@ -143,6 +143,17 @@ def re_demo(args):
     assert len(model.slot_of) == e
     assert np.all(np.isfinite(model.w_stack))
 
+    # compact published container (models/game.CompactRandomEffectModel):
+    # the wide-vocabulary twin — memory ∝ observed columns per entity
+    t0 = time.perf_counter()
+    compact = model.to_compact()
+    records.append(stage(
+        "re_compact_model", t0,
+        compact_mb=round((compact.indices.nbytes
+                          + compact.values.nbytes) / 2**20, 1),
+        dense_mb=round(model.w_stack.nbytes / 2**20, 1),
+        per_entity_capacity=int(compact.indices.shape[1])))
+
     # 4. total scoring (active + passive union)
     t0 = time.perf_counter()
     scores = coord.score(model)
