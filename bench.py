@@ -1138,16 +1138,19 @@ def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
         entry["impl"] = got["impl"]
     if got.get("fused_error"):
         entry["fused_error"] = got["fused_error"]
+    on_chip = got["backend"] != "cpu"  # "tpu" or "axon" (the tunnel's name)
     if got.get("flops_est"):
         entry["gflops_per_sec"] = round(got["flops_est"] / dt / 1e9, 1)
-        entry["mfu_bf16_peak"] = round(got["flops_est"] / dt / PEAK_BF16, 5)
+        if on_chip:
+            entry["mfu_bf16_peak"] = round(got["flops_est"] / dt / PEAK_BF16, 5)
     if got.get("bytes_est"):
         # useful-traffic lower bound (design-matrix streams + per-example
-        # vectors per objective pass); the v5e utilization number is the
-        # roofline lens — meaningful when backend is the chip, context
-        # otherwise
+        # vectors per objective pass); the v5e roofline ratio is only
+        # emitted when the measurement actually ran on the chip — a CPU
+        # wall-clock divided by v5e peak invites misquoting
         entry["gbytes_per_sec"] = round(got["bytes_est"] / dt / 1e9, 1)
-        entry["hbm_bw_util_v5e"] = round(got["bytes_est"] / dt / PEAK_HBM, 4)
+        if on_chip:
+            entry["hbm_bw_util_v5e"] = round(got["bytes_est"] / dt / PEAK_HBM, 4)
     return entry
 
 

@@ -1188,7 +1188,17 @@ class RandomEffectCoordinate(Coordinate):
             if self._norm_per_lane:
                 if self._norm.shifts is not None:
                     # per-lane modelToTransformedSpace: the shift dot folds
-                    # into each lane's own compact intercept position
+                    # into each lane's own compact intercept position.
+                    # DELIBERATELY the COMPACT dot (observed columns only):
+                    # the compact objective computes margins as
+                    # <eff, x_c> - <eff, sh_c> (objective.py margin_shift),
+                    # i.e. the unobserved-column data term -w_j*shift_j that
+                    # would cancel a full-dim fold was deleted by compaction
+                    # — folding the full <w, shifts> here would shift every
+                    # margin by sum_unobserved(w_j*shift_j).  Warm-start
+                    # mass at unobserved columns is margin-inert on this
+                    # entity's data (raw x_j == 0 there), so truncating it
+                    # is exact, not lossy (advisor r4, resolved r5).
                     sh = self._norm_shift_np[bucket_index]
                     iis = self._norm_ii_np[bucket_index]
                     dots = np.einsum("ld,ld->l", w0, sh)
@@ -1469,7 +1479,11 @@ class RandomEffectCoordinate(Coordinate):
             # 'set' scatter can never clobber a genuinely observed column
             safe = jnp.where(arr < 0, self.dim, arr)
             out = jnp.broadcast_to(fill.astype(lanes.dtype), (e, self.dim))
-            return out.at[jnp.arange(e)[:, None], safe].set(lanes, mode="drop")
+            out = out.at[jnp.arange(e)[:, None], safe].set(lanes, mode="drop")
+            # padding lanes (index row entirely -1) stay zero, matching
+            # BucketProjection.back_project — no fill rows for nonexistent
+            # entities (today both stacking paths drop them anyway)
+            return jnp.where((arr >= 0).any(axis=1)[:, None], out, 0.0)
         # index compaction: scatter each lane's projected slots into full dim;
         # padded slots (idx<0) carry value 0, so colliding on column 0 is inert
         safe = jnp.where(arr < 0, 0, arr)

@@ -104,6 +104,7 @@ def _group_rows(
     min_active_samples: int,
     seed: int,
     existing_model_keys: Optional[frozenset] = None,
+    row_ids: Optional[np.ndarray] = None,
 ) -> Tuple[List[np.ndarray], List[int], List[float]]:
     """Group sample rows by entity with the deterministic reservoir cap +
     weight rescale count/cap (reference RandomEffectDataset.scala:358-420)
@@ -115,7 +116,13 @@ def _group_rows(
     (that model then passes through unchanged — RandomEffectCoordinate
     .updateModel's leftOuterJoin :114-127); an under-bound NEW entity still
     trains, else it would never get a model at all
-    (RandomEffectDataset.scala:322-333)."""
+    (RandomEffectDataset.scala:322-333).
+
+    ``row_ids``: GLOBAL sample-row id per local row (multihost entity-sharded
+    reads, parallel/multihost.py).  Reservoir keys mix the global id, so an
+    entity keeps the SAME samples no matter how many hosts the data is split
+    over — the recompute-stable property the reference gets from hashing
+    uniqueId (RandomEffectDataset.scala:394-401), extended across topology."""
     uniq, inverse, counts = np.unique(entity_ids, return_inverse=True,
                                       return_counts=True)
     order = np.argsort(inverse, kind="stable")  # rows grouped by entity
@@ -132,7 +139,8 @@ def _group_rows(
             continue
         scale = 1.0
         if active_cap is not None and len(rows) > active_cap:
-            keys = _splitmix64(rows.astype(np.uint64) ^ np.uint64(seed))
+            gids = rows if row_ids is None else row_ids[rows]
+            keys = _splitmix64(gids.astype(np.uint64) ^ np.uint64(seed))
             rows = rows[np.argsort(keys, kind="stable")[:active_cap]]
             scale = len(keys) / active_cap  # weight rescale count/cap
         kept_rows.append(np.sort(rows))
@@ -149,12 +157,14 @@ def _capacity_classes(kept_rows: List[np.ndarray]) -> np.ndarray:
 
 
 def _pack_lane_meta(n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
-                    y, offset, weight, dtype, lane_of, bucket_index):
+                    y, offset, weight, dtype, lane_of, bucket_index,
+                    row_ids=None):
     """Fill one capacity class's NON-design lane arrays (labels, offsets,
     rescaled weights, row map, counts, entity directory) — identical between
     the dense and row-sparse bucketers, factored so their padding/rescale
     semantics cannot diverge.  Returns (by, boff, bw, brows, bcounts,
-    blanes); ``lane_of`` is updated in place."""
+    blanes); ``lane_of`` is updated in place.  ``row_ids`` maps local row
+    positions to the GLOBAL sample-row ids stored in ``brows`` (multihost)."""
     by = np.zeros((n_lanes, cap), dtype)
     boff = np.zeros((n_lanes, cap), dtype)
     bw = np.zeros((n_lanes, cap), dtype)
@@ -167,7 +177,7 @@ def _pack_lane_meta(n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
         by[lane, :k] = y[rows]
         boff[lane, :k] = offset[rows]
         bw[lane, :k] = weight[rows] * rescale[ei]
-        brows[lane, :k] = rows
+        brows[lane, :k] = rows if row_ids is None else row_ids[rows]
         bcounts[lane] = k
         blanes[lane] = kept_entities[ei]
         lane_of[kept_entities[ei]] = (bucket_index, lane)

@@ -131,6 +131,13 @@ class BucketProjection:
         vals = np.asarray(w_proj).reshape(-1)
         keep = idx >= 0
         out[lanes[keep], idx[keep]] = vals[keep]
+        # padding lanes (bucket slots past the real entity count carry an
+        # all -1 index row) must stay zero — a fill row there would publish
+        # clip(0, lo, hi) coefficients for entities that don't exist
+        if fill is not None:
+            invalid = ~(self.indices >= 0).any(axis=1)
+            if invalid.any():
+                out[invalid] = 0.0
         return out
 
 

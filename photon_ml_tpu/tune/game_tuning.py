@@ -173,6 +173,8 @@ class GameEstimatorEvaluationFunction:
         import time
 
         params_batch = [np.asarray(p, float) for p in params_batch]
+        if not params_batch:
+            return []
         fused_ok = (not self.locked and self.estimator.fused is not False)
         sweep = self._fused_sweep() if fused_ok else None
         if sweep is None or len(params_batch) == 1:
@@ -182,7 +184,13 @@ class GameEstimatorEvaluationFunction:
         regs_grid = [[c.coordinates[cid].reg for cid in c.coordinates]
                      for c in configs]
         t0 = time.perf_counter()
-        if self.base_config.num_outer_iterations == 1:
+        # key off the per-candidate configs like __call__ does (advisor r4);
+        # a batched fused grid shares ONE program, so candidates cannot
+        # disagree on iteration count — fail loudly if config_for ever does
+        iters = {c.num_outer_iterations for c in configs}
+        if len(iters) > 1:
+            return [self(p) for p in params_batch]  # sequential: exact per-candidate semantics
+        if configs[0].num_outer_iterations == 1:
             snap_lists = [[m] for m, _scores in sweep_obj.run_grid(
                 regs_grid, initial=self.initial_model, carry0=carry0,
                 seed=self.seed)]
