@@ -16,10 +16,16 @@ from photon_ml_tpu.parallel.bucketing import (  # noqa: F401
     score_random_effects,
 )
 from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    build_re_scoring,
+    export_local_random_effects,
     global_batch_from_local,
+    global_entity_buckets,
     global_mesh,
     initialize,
+    local_entity_rows,
+    multihost_glmix_sweep,
     pad_local_rows,
     padded_per_host_rows,
+    process_entity_assignment,
     process_row_range,
 )

@@ -196,6 +196,8 @@ def bucket_by_entity(
     seed: int = 0,
     dtype=np.float32,
     existing_model_keys: Optional[frozenset] = None,
+    row_ids: Optional[np.ndarray] = None,
+    num_samples: Optional[int] = None,
 ) -> EntityBuckets:
     """Group samples by entity into power-of-two-capacity buckets.
 
@@ -207,6 +209,10 @@ def bucket_by_entity(
       (reference lower-bound filter, RandomEffectDataset.scala:319-341).
     - ``lane_multiple``: pad each bucket's entity count to a multiple (set to
       the mesh device count so the entity axis shards evenly).
+    - ``row_ids`` / ``num_samples``: multihost entity-sharded reads — the
+      local rows' GLOBAL sample ids (stored in ``Bucket.rows`` and mixed
+      into reservoir keys so decisions are topology-invariant) and the
+      GLOBAL score-vector length (parallel/multihost.py).
     """
     n = len(entity_ids)
     entity_ids = np.asarray(entity_ids, np.int64)
@@ -215,10 +221,12 @@ def bucket_by_entity(
     offset = np.zeros(n, dtype) if offset is None else np.asarray(offset, dtype)
     weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
     d = x.shape[1]
+    if row_ids is not None:
+        row_ids = np.asarray(row_ids, np.int64)
 
     kept_rows, kept_entities, rescale = _group_rows(
         entity_ids, active_cap, min_active_samples, seed,
-        existing_model_keys=existing_model_keys)
+        existing_model_keys=existing_model_keys, row_ids=row_ids)
 
     # Capacity classes: next power of two of the active count.
     caps = _capacity_classes(kept_rows)
@@ -229,7 +237,7 @@ def bucket_by_entity(
         n_lanes = ((len(idxs) + lane_multiple - 1) // lane_multiple) * lane_multiple
         by, boff, bw, brows, bcounts, blanes = _pack_lane_meta(
             n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
-            y, offset, weight, dtype, lane_of, len(buckets))
+            y, offset, weight, dtype, lane_of, len(buckets), row_ids=row_ids)
         bx = np.zeros((n_lanes, cap, d), dtype)
         for lane, ei in enumerate(idxs):
             rows = kept_rows[ei]
@@ -238,7 +246,8 @@ def bucket_by_entity(
                               counts=bcounts, entity_lanes=blanes))
 
     return EntityBuckets(buckets=buckets, lane_of=lane_of, dim=d,
-                         num_entities=len(kept_entities), num_samples=n)
+                         num_entities=len(kept_entities),
+                         num_samples=n if num_samples is None else num_samples)
 
 
 def bucket_by_entity_sparse(
@@ -257,6 +266,8 @@ def bucket_by_entity_sparse(
     features_to_samples_ratio: Optional[float] = None,
     intercept_index: Optional[int] = None,
     existing_model_keys: Optional[frozenset] = None,
+    row_ids: Optional[np.ndarray] = None,
+    num_samples: Optional[int] = None,
 ):
     """Compact per-entity buckets built DIRECTLY from row-sparse features.
 
@@ -294,9 +305,11 @@ def bucket_by_entity_sparse(
     offset = np.zeros(n, dtype) if offset is None else np.asarray(offset, dtype)
     weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
 
+    if row_ids is not None:
+        row_ids = np.asarray(row_ids, np.int64)
     kept_rows, kept_entities, rescale = _group_rows(
         entity_ids, active_cap, min_active_samples, seed,
-        existing_model_keys=existing_model_keys)
+        existing_model_keys=existing_model_keys, row_ids=row_ids)
 
     def _compact_lane(rows: np.ndarray):
         """(observed columns, compact dense block [len(rows), n_obs])."""
@@ -328,7 +341,7 @@ def bucket_by_entity_sparse(
         n_lanes = ((len(idxs) + lane_multiple - 1) // lane_multiple) * lane_multiple
         by, boff, bw, brows, bcounts, blanes = _pack_lane_meta(
             n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
-            y, offset, weight, dtype, lane_of, len(buckets))
+            y, offset, weight, dtype, lane_of, len(buckets), row_ids=row_ids)
         bx = np.zeros((n_lanes, cap, d_proj), dtype)
         bidx = np.full((n_lanes, d_proj), -1, np.int32)
         for lane, ei in enumerate(idxs):
@@ -341,7 +354,8 @@ def bucket_by_entity_sparse(
         projections.append(BucketProjection(indices=bidx, d_full=dim))
 
     ents = EntityBuckets(buckets=buckets, lane_of=lane_of, dim=dim,
-                         num_entities=len(kept_entities), num_samples=n)
+                         num_entities=len(kept_entities),
+                         num_samples=n if num_samples is None else num_samples)
     return ents, projections
 
 

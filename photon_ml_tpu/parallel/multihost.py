@@ -26,17 +26,21 @@ The recipe (each host runs the same code):
     g = global_batch_from_local(block, mesh)
 
 Cross-process scope (tested in tests/test_parallel.py
-::test_multihost_two_processes): the fixed-effect solve runs multihost
-both data-parallel (ShardMapObjective — the one DCN all-reduce) and
-FEATURE-SHARDED (ShardSparseObjective, w blocked over the within-process
-feature axis).  RANDOM-EFFECT coordinates are currently single-process:
-their bucketing groups rows by entity GLOBALLY, so a row-split read
-cannot feed them — a multihost RE run must give every host the full
-dataset for those shards and keep the entity axis within one process
-(the reference instead shuffles per-entity across the cluster,
-RandomEffectDatasetPartitioner.scala:30-171; the TPU-native equivalent —
-entity-lane arrays assembled per process from a host-sharded entity
-range — is future work)."""
+::test_multihost_two_processes and ::test_multihost_glmix_four_processes):
+the fixed-effect solve runs multihost both data-parallel
+(ShardMapObjective — the one DCN all-reduce) and FEATURE-SHARDED
+(ShardSparseObjective, w blocked over the within-process feature axis).
+RANDOM-EFFECT coordinates run multihost via ENTITY-sharded reads: every
+entity's samples are owned by exactly one host
+(``process_entity_assignment`` — the deterministic-hash analog of the
+reference's shuffle into balanced entity partitions,
+RandomEffectDatasetPartitioner.scala:30-171), each host buckets its own
+entities locally (``parallel/bucketing.py`` with global ``row_ids``), the
+hosts agree on global bucket shapes with one tiny metadata all-gather
+(``global_entity_buckets``), and the entity-lane arrays assemble into
+globally-sharded buckets with ``jax.make_array_from_process_local_data``.
+``multihost_glmix_sweep`` then runs residual coordinate descent (fixed +
+random effects) with every score vector a global device array."""
 
 from __future__ import annotations
 
@@ -44,6 +48,7 @@ import logging
 from typing import Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -199,4 +204,349 @@ def global_batch_from_local(
         global_shape = (a.shape[0] * n_proc,) + a.shape[1:]
         out[name] = jax.make_array_from_process_local_data(
             sharding, a, global_shape=global_shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Random effects across hosts: entity-sharded reads -> host-local bucketing
+# -> globally-sharded entity lanes.  Reference analog: the shuffle of
+# per-entity data into balanced partitions (RandomEffectDataset.scala:302-341,
+# RandomEffectDatasetPartitioner.scala:30-171).  TPU-native shape: there is
+# no shuffle fabric — ownership is decided BEFORE the read by a deterministic
+# hash of the entity id, every host keeps only its entities' rows (carrying
+# their GLOBAL sample ids), and the per-host buckets concatenate into global
+# [E, S, d] lane arrays whose entity axis is sharded over the whole mesh.
+# ---------------------------------------------------------------------------
+
+
+def process_entity_assignment(entity_ids: np.ndarray,
+                              num_processes: Optional[int] = None,
+                              seed: int = 0) -> np.ndarray:
+    """Owning process id per sample, by deterministic hash of the entity id.
+
+    Every host computes the same assignment with no global view — the
+    shuffle-free analog of the reference's entity partitioner; with many
+    entities the load balances statistically (the reference balances by
+    exact counts because a Spark shuffle is already paying for the global
+    pass, RandomEffectDatasetPartitioner.scala:68-117)."""
+    from photon_ml_tpu.parallel.bucketing import _splitmix64
+
+    np_ = jax.process_count() if num_processes is None else num_processes
+    ids = np.asarray(entity_ids, np.int64).astype(np.uint64)
+    return (_splitmix64(ids ^ np.uint64(seed)) % np.uint64(np_)).astype(np.int64)
+
+
+def local_entity_rows(entity_ids: np.ndarray,
+                      process_id: Optional[int] = None,
+                      num_processes: Optional[int] = None,
+                      seed: int = 0) -> np.ndarray:
+    """GLOBAL row ids of the samples THIS host owns for a random-effect
+    coordinate (its entities' rows).  Feed the filtered columns plus these
+    ids into ``bucket_by_entity(..., row_ids=..., num_samples=n_global)``."""
+    pid = jax.process_index() if process_id is None else process_id
+    owner = process_entity_assignment(entity_ids, num_processes, seed)
+    return np.nonzero(owner == pid)[0].astype(np.int64)
+
+
+def global_entity_buckets(local, mesh: Mesh):
+    """Host-local EntityBuckets -> globally-sharded EntityBuckets.
+
+    Every host calls this with ITS entities' buckets (built with global
+    ``row_ids``/``num_samples``).  One metadata all-gather agrees on the
+    union of capacity classes and the per-host lane count of each, then
+    every field assembles via ``make_array_from_process_local_data`` with
+    the entity lane sharded over ALL mesh devices (the layout
+    ``fit_random_effects`` solves under).  The returned ``lane_of`` maps
+    THIS host's entities to (bucket, GLOBAL lane); ``num_entities`` is the
+    global total.  Hosts missing a capacity class contribute all-padding
+    lanes (weight 0, entity -1) — inert by the core masking contract."""
+    from jax.experimental import multihost_utils
+
+    from photon_ml_tpu.parallel.bucketing import Bucket, EntityBuckets
+
+    n_proc = jax.process_count()
+    pid = jax.process_index()
+    n_dev = mesh.size
+    if n_dev % n_proc:
+        raise ValueError(f"{n_dev} devices not divisible by {n_proc} processes")
+    ldc = n_dev // n_proc  # per-host device share of the entity lane
+
+    # 1. agree on capacity classes + per-host lane counts (tiny all-gather:
+    #    lanes-per-log2-capacity, one int vector per host)
+    MAXLOG = 33
+    vec = np.zeros((MAXLOG,), np.int64)
+    by_cap = {}
+    for local_bi, b in enumerate(local.buckets):
+        c = int(b.capacity)
+        log = c.bit_length() - 1
+        if (1 << log) != c:
+            raise ValueError(f"bucket capacity {c} is not a power of two")
+        vec[log] = b.num_lanes
+        by_cap[c] = (local_bi, b)
+    all_vec = np.asarray(multihost_utils.process_allgather(vec))  # [nproc, MAXLOG]
+    ent_counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([local.num_entities], np.int64)))
+    num_entities_global = int(ent_counts.sum())
+
+    shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+    buckets = []
+    lane_of: Dict[int, Tuple[int, int]] = {}
+    dtype = (local.buckets[0].x.dtype if local.buckets else np.float32)
+    for log in range(MAXLOG):
+        host_lanes = all_vec[:, log]
+        if not host_lanes.any():
+            continue
+        cap = 1 << log
+        per_host = int(-(-host_lanes.max() // ldc) * ldc)
+        local_bi, b = by_cap.get(cap, (None, None))
+        if b is not None and b.x.shape[2] != local.dim:
+            raise ValueError(
+                "global_entity_buckets takes FULL-dimension buckets "
+                "(bucket_by_entity); per-host compact dims "
+                f"({b.x.shape[2]} != {local.dim}) cannot concatenate across "
+                "hosts — compact/projected multihost random effects would "
+                "need a per-host d_proj agreement pass")
+        d = local.dim
+
+        def _pad(a, fill, shape_tail, dt):
+            out = np.full((per_host,) + shape_tail, fill, dt)
+            if a is not None:
+                out[: a.shape[0]] = a
+            return out
+
+        fields = dict(
+            x=_pad(b.x if b else None, 0, (cap, d), dtype),
+            y=_pad(b.y if b else None, 0, (cap,), dtype),
+            offset=_pad(b.offset if b else None, 0, (cap,), dtype),
+            weight=_pad(b.weight if b else None, 0, (cap,), dtype),
+            rows=_pad(b.rows if b else None, -1, (cap,), np.int32),
+            counts=_pad(b.counts if b else None, 0, (), np.int32),
+            entity_lanes=_pad(b.entity_lanes if b else None, -1, (), np.int64),
+        )
+        g = {
+            k: jax.make_array_from_process_local_data(
+                shard, a, global_shape=(per_host * n_proc,) + a.shape[1:])
+            for k, a in fields.items()
+        }
+        bi = len(buckets)
+        if b is not None:
+            for eid, (lbi, lane) in local.lane_of.items():
+                if lbi == local_bi:
+                    lane_of[eid] = (bi, pid * per_host + lane)
+        buckets.append(Bucket(**g))
+    return EntityBuckets(buckets=buckets, lane_of=lane_of, dim=local.dim,
+                         num_entities=num_entities_global,
+                         num_samples=local.num_samples)
+
+
+def build_re_scoring(global_train, local_scoring, mesh: Mesh):
+    """Multihost analog of the reference's PASSIVE data path: samples capped
+    out of an entity's training reservoir still get scored with the entity's
+    model (RandomEffectDataset passiveData; RandomEffectCoordinate.scala:
+    210-231).  ``local_scoring``: THIS host's UNCAPPED buckets (same entity
+    filter, ``active_cap=None``, global ``row_ids``).  Returns
+    ``(global_scoring_buckets, coeff_idx)`` where ``coeff_idx[bi]`` maps each
+    scoring lane to its entity's row in the CONCATENATED training lane
+    arrays (-1 for padding lanes) — the cross-bucket coefficient gather
+    ``multihost_glmix_sweep`` scores with."""
+    bases = np.cumsum([0] + [b.num_lanes for b in global_train.buckets])
+    flat_of = {eid: int(bases[bi] + lane)
+               for eid, (bi, lane) in global_train.lane_of.items()}
+    gs = global_entity_buckets(local_scoring, mesh)
+    n_proc = jax.process_count()
+    shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+    coeff_idx = []
+    for b in gs.buckets:
+        per_host = b.num_lanes // n_proc
+        local_lanes = np.full((per_host,), -1, np.int32)
+        for eid, (bi2, glane) in gs.lane_of.items():
+            if gs.buckets[bi2] is b:
+                local_lanes[glane - jax.process_index() * per_host] = \
+                    flat_of.get(eid, -1)
+        coeff_idx.append(jax.make_array_from_process_local_data(
+            shard, local_lanes, global_shape=(b.num_lanes,)))
+    return gs, coeff_idx
+
+
+def multihost_glmix_sweep(
+    mesh: Mesh,
+    fixed_batch,
+    re_buckets,
+    fixed_objective,
+    re_objective,
+    num_iterations: int = 2,
+    optimizer=None,
+    config=None,
+    re_scoring=None,
+    num_samples: Optional[int] = None,
+):
+    """Residual coordinate descent (one fixed + one random-effect
+    coordinate) where EVERY score vector is a global device array — the
+    multihost GLMix training loop (reference CoordinateDescent.scala:197-204
+    run on a cluster; here the same program runs on every host and XLA's
+    collectives replace the shuffle/broadcast).
+
+    ``fixed_batch``: globally row-sharded DenseBatch (``global_batch_from_
+    local``); its ``offset`` is the base offset.  ``re_buckets``: globally
+    entity-sharded EntityBuckets (``global_entity_buckets``) whose
+    ``Bucket.rows`` carry GLOBAL sample ids into the fixed batch's row
+    space.  Update order per iteration: fixed (offsets += RE scores), then
+    random effects (offsets += fixed margins) — the 2-coordinate residual
+    schedule of game/descent.py.
+
+    ``re_scoring``: optional ``build_re_scoring`` result — under a
+    reservoir cap, RE scores come from the UNCAPPED scoring buckets (the
+    reference's passive-data path), not just the training rows; without it
+    the training buckets score (exact when no cap drops rows).
+
+    ``num_samples``: the TRUE global sample count ``n``.  ``Bucket.rows``
+    carry ORIGINAL global row ids (so reservoir decisions stay
+    topology-invariant), but the fixed batch lives in the PADDED per-host
+    layout — whenever ceil(n/nproc) is not a multiple of the per-host
+    data-device count the two row spaces differ, and every gather/scatter
+    here translates original -> padded ids.  Required; the two tests'
+    sizes aligning by accident is exactly the trap.
+
+    Normalization is not folded here (both objectives must be
+    identity-normalized); the single-process coordinate path owns the
+    model-space maps.  Returns ``(w_fixed, re_coeffs, re_scores)`` —
+    replicated fixed coefficients, per-bucket GLOBAL [E, d] lane
+    coefficients, and the final replicated RE score vector."""
+    import functools
+
+    from photon_ml_tpu.opt.solve import make_solver
+    from photon_ml_tpu.parallel.fixed import ShardMapObjective
+    from photon_ml_tpu.types import OptimizerType
+
+    if fixed_objective.norm.factors is not None or \
+            fixed_objective.norm.shifts is not None or \
+            re_objective.norm.factors is not None or \
+            re_objective.norm.shifts is not None:
+        raise ValueError(
+            "multihost_glmix_sweep runs identity-normalized objectives; "
+            "fold normalization before the multihost path")
+    optimizer = OptimizerType.LBFGS if optimizer is None else optimizer
+    n_pad = int(fixed_batch.y.shape[0])
+    d_fixed = int(fixed_batch.x.shape[1])
+    dtype = fixed_batch.y.dtype
+    rep = NamedSharding(mesh, PartitionSpec())
+    row_sharded = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+    if num_samples is None:
+        raise ValueError(
+            "multihost_glmix_sweep needs num_samples (the true global n) to "
+            "translate original row ids into the padded fixed-batch layout")
+    n_proc = jax.process_count()
+    per = -(-num_samples // n_proc)       # process_row_range's host stride
+    rows_per = n_pad // n_proc            # padded_per_host_rows's stride
+    if per == rows_per:
+        to_padded = lambda rows: rows
+    else:
+        # original global id r lives in host r // per at padded position
+        # (r // per) * rows_per + r % per; -1 padding slots pass through
+        to_padded = lambda rows: jnp.where(
+            rows >= 0, (rows // per) * rows_per + rows % per, rows)
+
+    zeros_n = jax.jit(lambda: jnp.zeros((n_pad,), dtype), out_shardings=rep)
+    re_scores = zeros_n()
+
+    add_offsets = jax.jit(lambda base, s: base + s, out_shardings=row_sharded)
+    fixed_margin = jax.jit(lambda w, b: b.margins(w), out_shardings=rep)
+
+    @jax.jit
+    def bucket_offset(off0, rows, margins):
+        rows = to_padded(rows)
+        safe = jnp.where(rows >= 0, rows, 0)
+        return off0 + jnp.where(rows >= 0, margins[safe], 0.0)
+
+    @functools.partial(jax.jit, out_shardings=rep)
+    def re_score(ws, xs, rows_list):
+        total = jnp.zeros((n_pad,), dtype)
+        for w, x, rows in zip(ws, xs, rows_list):
+            rows = to_padded(rows)
+            margins = jnp.einsum("esd,ed->es", x, w)
+            valid = rows >= 0
+            safe = jnp.where(valid, rows, 0)
+            total = total.at[safe.ravel()].add(
+                jnp.where(valid, margins, 0.0).ravel())
+        return total
+
+    @functools.partial(jax.jit, out_shardings=rep)
+    def re_score_passive(ws, xs, rows_list, idx_list):
+        # cross-bucket coefficient gather: scoring lanes look their entity's
+        # trained row up in the concatenated training lane arrays
+        flat = jnp.concatenate(ws, axis=0)
+        total = jnp.zeros((n_pad,), dtype)
+        for x, rows, idx in zip(xs, rows_list, idx_list):
+            rows = to_padded(rows)
+            wl = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]
+            wl = jnp.where((idx >= 0)[:, None], wl, 0.0)
+            margins = jnp.einsum("esd,ed->es", x, wl)
+            valid = rows >= 0
+            safe = jnp.where(valid, rows, 0)
+            total = total.at[safe.ravel()].add(
+                jnp.where(valid, margins, 0.0).ravel())
+        return total
+
+    solve_re = make_solver(re_objective, optimizer, config)
+    vsolve_re = jax.jit(jax.vmap(solve_re))
+    # ONE compile for the fixed solve (the same explicit-SPMD path
+    # fit_fixed_effect takes), reused across descent iterations
+    solve_fixed = jax.jit(
+        make_solver(ShardMapObjective(fixed_objective, mesh), optimizer,
+                    config), out_shardings=rep)
+    entity_shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+
+    import dataclasses as _dc
+
+    from photon_ml_tpu.core.batch import DenseBatch
+
+    w_fixed = jax.jit(lambda: jnp.zeros((d_fixed,), dtype), out_shardings=rep)()
+    re_coeffs = [
+        jax.jit(functools.partial(jnp.zeros, (b.num_lanes, re_buckets.dim),
+                                  dtype), out_shardings=entity_shard)()
+        for b in re_buckets.buckets
+    ]
+    base_offset = fixed_batch.offset
+    for _ in range(num_iterations):
+        batch_f = _dc.replace(fixed_batch,
+                              offset=add_offsets(base_offset, re_scores))
+        w_fixed = solve_fixed(w_fixed, batch_f).w
+        margins = fixed_margin(w_fixed, fixed_batch)
+        new_coeffs = []
+        for b, w0 in zip(re_buckets.buckets, re_coeffs):
+            off = bucket_offset(b.offset, b.rows, margins)
+            rb = DenseBatch(x=b.x, y=b.y, offset=off, weight=b.weight)
+            new_coeffs.append(vsolve_re(w0, rb).w)
+        re_coeffs = new_coeffs
+        if re_scoring is not None:
+            gs, coeff_idx = re_scoring
+            re_scores = re_score_passive(
+                tuple(re_coeffs), tuple(b.x for b in gs.buckets),
+                tuple(b.rows for b in gs.buckets), tuple(coeff_idx))
+        else:
+            re_scores = re_score(tuple(re_coeffs),
+                                 tuple(b.x for b in re_buckets.buckets),
+                                 tuple(b.rows for b in re_buckets.buckets))
+    return w_fixed, re_coeffs, re_scores
+
+
+def export_local_random_effects(re_coeffs, re_buckets,
+                                mesh: Mesh) -> Dict[int, np.ndarray]:
+    """THIS host's entities' coefficient vectors from globally-sharded lane
+    arrays — each host publishes its own entity range (the reference writes
+    the RandomEffectModel RDD partition-wise the same way)."""
+    n_proc = jax.process_count()
+    pid = jax.process_index()
+    out: Dict[int, np.ndarray] = {}
+    host_blocks = {}
+    for bi, arr in enumerate(re_coeffs):
+        shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
+        host_blocks[bi] = (np.concatenate([np.asarray(s.data) for s in shards])
+                          if shards else np.zeros((0, arr.shape[1])))
+        per_host = arr.shape[0] // n_proc
+        base = pid * per_host
+        for eid, (ebi, lane) in re_buckets.lane_of.items():
+            if ebi == bi:
+                out[eid] = host_blocks[bi][lane - base]
     return out

@@ -185,11 +185,12 @@ class GameEstimatorEvaluationFunction:
                      for c in configs]
         t0 = time.perf_counter()
         # key off the per-candidate configs like __call__ does (advisor r4);
-        # a batched fused grid shares ONE program, so candidates cannot
-        # disagree on iteration count — fail loudly if config_for ever does
+        # a batched fused grid shares ONE program, so candidates that
+        # disagree on iteration count cannot ride it — fall back to
+        # sequential evaluation, which honors each candidate's own count
         iters = {c.num_outer_iterations for c in configs}
         if len(iters) > 1:
-            return [self(p) for p in params_batch]  # sequential: exact per-candidate semantics
+            return [self(p) for p in params_batch]
         if configs[0].num_outer_iterations == 1:
             snap_lists = [[m] for m, _scores in sweep_obj.run_grid(
                 regs_grid, initial=self.initial_model, carry0=carry0,
