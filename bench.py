@@ -238,6 +238,12 @@ def synth_tune(scale: int):
 D_SIG, D_CHIP_G, D_CHIP_U = 16, 512, 4  # glmix_chip feature widths
 CHIP_CAP = 32        # per-entity active-sample cap (reference activeDataUpperBound)
 _CHIP_P = 8191       # prime phase period of the counter-based signal columns
+# shared by run_glmix_chip (device generation) and _chip_design_host (host
+# reconstruction): the fold_in chunk boundaries and seed must agree BITWISE
+# or the floor-scale parity gate fails for a reason that looks like a
+# solver bug
+CHIP_CHUNK = 1 << 19
+CHIP_SEED = 99
 
 
 def _chip_sizes(scale: int):
@@ -666,8 +672,8 @@ def run_glmix_chip(platform, scale):
     storage = "bfloat16" if backend != "cpu" else None
     xdt = jnp.bfloat16 if storage else jnp.float32
 
-    ch = min(n, 1 << 19)
-    key = jax.random.PRNGKey(99)
+    ch = min(n, CHIP_CHUNK)
+    key = jax.random.PRNGKey(CHIP_SEED)
 
     def _chunk(key, start, rows: int):
         i = start + jnp.arange(rows, dtype=jnp.int32)
@@ -735,7 +741,11 @@ def run_glmix_chip(platform, scale):
                   "signal_mean_abs": float(np.abs(wg[:D_SIG]).mean()),
                   "noise_mean_abs": float(np.abs(wg[D_SIG:]).mean()),
                   "n": n, "entities": host["users"],
-                  "chip_scale": scale},
+                  "chip_scale": scale,
+                  # coefficient vector for the floor-scale parity gate —
+                  # only where the scipy stand-in can run on the same data
+                  **({"wg": [round(float(v), 6) for v in wg]}
+                     if backend == "cpu" else {})},
     }
 
 
@@ -915,6 +925,106 @@ def _scipy_glmix(data, three: bool, l2=1.0):
             "wg": [round(float(v), 6) for v in wg]}
 
 
+def _chip_design_host(scale: int) -> np.ndarray:
+    """Reconstruct run_glmix_chip's device-generated design on host.
+
+    jax's threefry PRNG is bitwise platform-deterministic, and the chunk/
+    fold structure here mirrors run_glmix_chip's exactly, so the host f32
+    array equals what the accel child trained on (signal columns agree to
+    f32 rounding — _chip_signal_cols docstring).  Only reached on the CPU
+    fallback path, where no axon child is attached (one-client rule)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    users, per_user = _chip_sizes(scale)
+    n = users * per_user
+    ch = min(n, CHIP_CHUNK)
+    key = jax.random.PRNGKey(CHIP_SEED)
+    xg = np.empty((n, D_CHIP_G), np.float32)
+    for c, lo in enumerate(range(0, n, ch)):
+        rows = min(ch, n - lo)
+        i = np.arange(lo, lo + rows, dtype=np.int64)
+        xg[lo:lo + rows, :D_SIG] = _chip_signal_cols(i, np)
+        xg[lo:lo + rows, D_SIG:] = np.asarray(jax.random.normal(
+            jax.random.fold_in(key, c), (rows, D_CHIP_G - D_SIG), jnp.float32))
+    return xg
+
+
+def _scipy_glmix_chip(scale: int):
+    """Independent scipy stand-in for glmix_chip at a CPU-feasible scale
+    (VERDICT r4 missing #3: the chip config's gate was self-referential).
+
+    The same alternating residual loop as _scipy_glmix, adapted to the chip
+    config's shape: the fixed objective streams the [n, 512] f32 design in
+    chunks with f64 accumulation (no f64 copy of the whole design), and the
+    per-entity solves slice contiguous uid blocks (synth_glmix_chip repeats
+    uids, so no per-entity nonzero scans).  At the floor scales per_user
+    (16) never exceeds CHIP_CAP (32), so no reservoir logic applies."""
+    import scipy.optimize as sopt
+    import scipy.special as sp
+
+    host = synth_glmix_chip(scale)
+    xg = _chip_design_host(scale)
+    y = host["y"].astype(np.float64)
+    xu = host["xu"].astype(np.float64)
+    users, per_user = host["users"], host["per_user"]
+    n = host["n"]
+    l2 = 1.0
+    ch = CHIP_CHUNK
+
+    def fixed_nll_grad(w, off):
+        val = 0.5 * l2 * float(w @ w)
+        g = l2 * w
+        for lo in range(0, n, ch):
+            hi = min(lo + ch, n)
+            z = xg[lo:hi] @ w.astype(np.float32) + off[lo:hi]
+            z = z.astype(np.float64)
+            val += float(np.sum(np.logaddexp(0, z) - y[lo:hi] * z))
+            # f32 vec-mat against the design as stored — no transposed f64
+            # chunk copies (2.1GB each at the cpu floor); the ~1e-6 relative
+            # error is absorbed by the 5% parity band
+            r32 = (sp.expit(z) - y[lo:hi]).astype(np.float32)
+            g = g + (r32 @ xg[lo:hi]).astype(np.float64)
+        return val, g
+
+    def re_nll(w, X, yy, off):
+        z = X @ w + off
+        return np.sum(np.logaddexp(0, z) - yy * z) + 0.5 * l2 * w @ w
+
+    def re_grad(w, X, yy, off):
+        z = X @ w + off
+        return X.T @ (sp.expit(z) - yy) + l2 * w
+
+    wg = np.zeros(D_CHIP_G)
+    W = np.zeros((users, D_CHIP_U))
+    re_scores = np.zeros(n)
+    fixed_scores = np.zeros(n)
+    t0 = time.perf_counter()
+    for _ in range(OUTER):
+        r = sopt.minimize(fixed_nll_grad, wg, jac=True, args=(re_scores,),
+                          method="L-BFGS-B",
+                          options={"maxiter": SOLVER_ITERS})
+        wg = r.x
+        for lo in range(0, n, ch):
+            hi = min(lo + ch, n)
+            fixed_scores[lo:hi] = (xg[lo:hi] @ wg.astype(np.float32)
+                                   ).astype(np.float64)
+        for u in range(users):
+            sl = slice(u * per_user, (u + 1) * per_user)
+            r = sopt.minimize(re_nll, W[u], jac=re_grad,
+                              args=(xu[sl], y[sl], fixed_scores[sl]),
+                              method="L-BFGS-B",
+                              options={"maxiter": SOLVER_ITERS})
+            W[u] = r.x
+            re_scores[sl] = xu[sl] @ W[u]
+    dt = time.perf_counter() - t0
+    total = fixed_scores + re_scores
+    return {"dt_cpu": dt, "auc": _np_auc(host["y"], total),
+            "wg": [round(float(v), 6) for v in wg]}
+
+
 def cpu_ref(name: str, scale: int, accel_stats: dict):
     """vs_baseline stand-in for one config; cached on disk.
 
@@ -929,7 +1039,8 @@ def cpu_ref(name: str, scale: int, accel_stats: dict):
     # the glmix keys so the untouched a1a/sparse1m/gp_tune cache entries
     # (old 3-element key format) stay valid
     key = (json.dumps([name, scale, tgt, _SYNTH_V])
-           if name in ("glmix2", "glmix3") else json.dumps([name, scale, tgt]))
+           if name in ("glmix2", "glmix3", "glmix_chip")
+           else json.dumps([name, scale, tgt]))
     hit = _cache_get(key)
     if hit is not None:
         return hit
@@ -954,6 +1065,12 @@ def cpu_ref(name: str, scale: int, accel_stats: dict):
         one = _scipy_glmix(data, three=False)
         out = {"dt_cpu": one["dt_cpu"] * accel_stats.get("fits", 7),
                "per_fit": one["dt_cpu"]}
+    elif name == "glmix_chip":
+        # only reachable at a CPU-feasible scale (run_glmix_chip's cpu
+        # floor, or the test-tier scales) — the chip-scale run keeps
+        # vs_baseline null and inherits the floor-scale coefficient parity
+        # as its falsifiable gate
+        out = _scipy_glmix_chip(scale)
     else:
         raise KeyError(name)
     _cache_put(key, out)
@@ -1038,9 +1155,24 @@ def quality_gate(name: str, stats: dict, ref: dict | None):
         # noise columns
         ok = (0.70 <= stats["auc"] <= 0.92
               and stats["signal_mean_abs"] > 5 * stats["noise_mean_abs"])
-        return {"pass": bool(ok), "auc": stats["auc"],
+        gate = {"pass": bool(ok), "auc": stats["auc"],
                 "signal_mean_abs": round(stats["signal_mean_abs"], 5),
                 "noise_mean_abs": round(stats["noise_mean_abs"], 5)}
+        if ref is not None and stats.get("wg") is not None \
+                and ref.get("wg") is not None:
+            # floor-scale anchor (VERDICT r4 missing #3): an independent
+            # scipy fit of the SAME data — coefficient parity makes the
+            # chip config's gate falsifiable like glmix2's
+            d = abs(stats["auc"] - ref["auc"])
+            wa = np.asarray(stats["wg"], np.float64)
+            wr = np.asarray(ref["wg"], np.float64)
+            rel = float(np.linalg.norm(wa - wr)
+                        / max(np.linalg.norm(wr), 1e-12))
+            gate["auc_ref"] = ref["auc"]
+            gate["auc_diff"] = round(d, 5)
+            gate["coef_rel_err"] = round(rel, 5)
+            gate["pass"] = bool(gate["pass"] and d <= 0.005 and rel <= 0.05)
+        return gate
     return {"pass": None}
 
 
@@ -1121,8 +1253,15 @@ def _subprocess_json_lines(args, timeout, env=None):
 def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
     """Per-config result entry: throughput, baseline ratio, quality gate,
     FLOP/MFU estimates."""
-    ref = (cpu_ref(name, scale, got["stats"])
-           if want_cpu_ref and name in CPU_REF_CONFIGS else None)
+    ref = None
+    if want_cpu_ref and name in CPU_REF_CONFIGS:
+        ref = cpu_ref(name, scale, got["stats"])
+    elif want_cpu_ref and name == "glmix_chip" \
+            and got["stats"].get("wg") is not None:
+        # cpu-floor run: the scipy stand-in trains on the same (host-
+        # reconstructible) data, pinning coefficient parity at the floor;
+        # chip-scale runs carry no ref (vs_baseline stays null)
+        ref = cpu_ref(name, got["stats"]["chip_scale"], got["stats"])
     dt = got["dt"]
     entry = {
         "value": round(got["units"] / dt, 1),
@@ -1173,9 +1312,11 @@ RUNNERS = {
     "glmix_chip": lambda p, s: run_glmix_chip(p, s),
 }
 
-# configs with a scipy stand-in for vs_baseline; glmix_chip has none (its
-# role is the roofline number — no host ever holds its design matrix, so
-# there is nothing comparable for scipy to run at chip scale)
+# configs with an unconditional scipy stand-in for vs_baseline.  glmix_chip
+# is special-cased in _entry_from: at chip scale no host holds its design
+# matrix (vs_baseline stays null), but CPU-floor runs reconstruct the
+# device-generated design on host and pin coefficient parity vs scipy
+# (quality_gate's floor-scale anchor)
 CPU_REF_CONFIGS = ("a1a", "sparse1m", "glmix2", "glmix3", "gp_tune")
 
 

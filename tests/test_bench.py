@@ -243,3 +243,58 @@ class TestGateFalsifiability:
         assert gate["pass"] is False
         assert gate["auc_diff"] <= 0.005          # AUC alone would pass...
         assert gate["coef_rel_err"] > 0.05        # ...the coef gate fails it
+
+
+class TestChipGateFalsifiability:
+    """VERDICT r4 missing #3: glmix_chip's gate was self-referential (AUC +
+    signal/noise columns from the same generative formula).  At CPU-feasible
+    scales the device-generated design is host-reconstructible (threefry is
+    platform-deterministic), so an INDEPENDENT scipy fit of the same data
+    pins coefficient parity — the chip-scale run keeps vs_baseline null but
+    inherits this floor-scale anchor as its falsifiable gate."""
+
+    @pytest.fixture(scope="class")
+    def chip1024(self):
+        # direct stand-in call (like TestGateFalsifiability's _scipy_glmix):
+        # going through cpu_ref would read/write the shared repo-level
+        # .bench_cpu_cache.json and let a stale entry stand in for the code
+        # under test
+        got = bench.run_glmix_chip("cpu", 1024)
+        ref = bench._scipy_glmix_chip(1024)
+        return got, ref
+
+    def test_healthy_run_passes(self, chip1024):
+        got, ref = chip1024
+        gate = bench.quality_gate("glmix_chip", got["stats"], ref)
+        assert gate["pass"] is True
+        assert gate["coef_rel_err"] <= 0.01  # healthy margin is ~5e-4
+        assert gate["auc_diff"] <= 0.005
+
+    def test_mis_set_reg_weight_fails(self, chip1024, monkeypatch):
+        import dataclasses
+
+        import photon_ml_tpu.game.coordinate as gc
+        from photon_ml_tpu.core.regularization import Regularization
+
+        _, ref = chip1024
+        orig = gc.build_coordinate
+
+        def sabotaged(cid, data, cfg, task, **kw):
+            cfg = dataclasses.replace(
+                cfg, reg=Regularization(l2=cfg.reg.l2 * 100.0))
+            return orig(cid, data, cfg, task, **kw)
+
+        monkeypatch.setattr(gc, "build_coordinate", sabotaged)
+        got = bench.run_glmix_chip("cpu", 1024)
+        gate = bench.quality_gate("glmix_chip", got["stats"], ref)
+        assert gate["pass"] is False
+        assert gate["coef_rel_err"] > 0.05
+
+    def test_chip_scale_run_keeps_null_baseline(self, chip1024):
+        """A chip-backend run carries no wg (and no scipy ref is reachable):
+        the gate must stay the self-band, with no parity fields."""
+        got, ref = chip1024
+        stats = {k: v for k, v in got["stats"].items() if k != "wg"}
+        gate = bench.quality_gate("glmix_chip", stats, ref)
+        assert "coef_rel_err" not in gate
+        assert gate["pass"] is True
