@@ -106,6 +106,9 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
                 "a floating dtype (use bfloat16 or float16)") from None
     alpha = float(kv.pop("reg.alpha", 0.5))
     weights = [float(w) for w in kv.pop("reg.weights", "0").split("|")]
+    # constraint.space=transformed: reference-compat raw bounds on the
+    # transformed-space iterate (TRON.scala:228) — see MIGRATION.md
+    constraint_space = kv.pop("constraint.space", "original")
 
     re_type = kv.pop("random.effect.type", None)
     if re_type is not None:
@@ -129,6 +132,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
                              if "intercept.index" in kv else None),
             variance=variance,
             storage_dtype=storage_dtype,
+            constraint_space=constraint_space,
         )
         per_entity_file = kv.pop("per.entity.l2.multipliers", None)
         for consumed in ("active.data.upper.bound", "projected.dim",
@@ -147,6 +151,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             # has a feature axis > 1 (--mesh feature=N)
             feature_sharded=(kv.pop("feature.sharded", "false").lower()
                              in ("true", "1", "yes")),
+            constraint_space=constraint_space,
         )
     constraints_file = kv.pop("constraints", None)
     if constraints_file and constraints_file.startswith("@"):

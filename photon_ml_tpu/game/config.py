@@ -54,6 +54,9 @@ class FixedEffectConfig:
     # (projected-gradient path, opt/lbfgs.py) — reference parity: TRON/OWLQN
     # reject constraints too.
     constraints: Optional[ConstraintMap] = None
+    # Which coefficient space the bounds constrain (see
+    # _canonicalize_constraints for the semantics of each value).
+    constraint_space: str = "original"
 
     def __post_init__(self):
         _canonicalize_constraints(self)
@@ -91,6 +94,8 @@ class RandomEffectConfig:
     # (see ConstraintMap above); IDENTITY projector + LBFGS only — bounds
     # have no meaning in a projected solve space.
     constraints: Optional[ConstraintMap] = None
+    # See FixedEffectConfig.constraint_space.
+    constraint_space: str = "original"
 
     def __post_init__(self):
         m = self.per_entity_l2_multipliers
@@ -116,7 +121,27 @@ def _canonicalize_constraints(cfg) -> None:
     """Accept a dict {index: (lo, hi)} or iterable of (index, lo, hi);
     store a sorted tuple (hashable — configs are frozen/compared) and
     validate bounds (reference GLMSuite.createConstraintFeatureMap:193-232:
-    lo < hi, not both infinite)."""
+    lo < hi, not both infinite).
+
+    ``constraint_space`` semantics:
+
+    - "original" (default): bounds constrain the PUBLISHED original-space
+      coefficients.  Mathematically consistent; under scaling normalization
+      the solver-space box becomes [lo/f, hi/f], and shift normalization is
+      refused (per-feature original-space bounds are non-separable under
+      the intercept shift fold).
+    - "transformed": reference-compat — bounds applied RAW to the
+      TRANSFORMED (solver-space) coefficients every iteration, exactly what
+      the reference does (TRON.scala:228 projects constraintMap bounds onto
+      the scaled+shifted iterate, OptimizationUtils.scala:56-58), i.e. the
+      published original-space coefficients may VIOLATE the written bounds
+      whenever normalization rescales.  Faithful but questionable; exists
+      so reference jobs migrate bit-for-bit.  See MIGRATION.md.
+    """
+    if cfg.constraint_space not in ("original", "transformed"):
+        raise ValueError(
+            f"constraint_space must be 'original' or 'transformed' "
+            f"(got {cfg.constraint_space!r})")
     c = cfg.constraints
     if c is None:
         return

@@ -325,7 +325,8 @@ class FixedEffectCoordinate(Coordinate):
         self._objective = objective
         box = _box_from_constraints(
             self.config.constraints, self.dim, self._dtype, self._norm,
-            d_pad=self._d_pad if self._fs else None)
+            d_pad=self._d_pad if self._fs else None,
+            space=self.config.constraint_space)
         solve = make_solver(objective, self.config.optimizer,
                             self.config.solver, box=box)
 
@@ -348,7 +349,7 @@ class FixedEffectCoordinate(Coordinate):
         """Everything (besides reg VALUES) that shapes the compiled solver."""
         c = self.config
         return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance,
-                c.intercept_index, c.constraints)
+                c.intercept_index, c.constraints, c.constraint_space)
 
     def data_key(self) -> tuple:
         """Identity of the device data layout (reuse across optimization
@@ -560,20 +561,33 @@ class FixedEffectCoordinate(Coordinate):
 
 
 def _box_from_constraints(constraints, dim: int, dtype, norm=None,
-                          d_pad: Optional[int] = None):
+                          d_pad: Optional[int] = None,
+                          space: str = "original"):
     """(lower, upper) solver box arrays in the SOLVE (transformed) space.
 
     Reference: OptimizerConfig.constraintMap (OptimizerConfig.scala:47)
     applied by OptimizationUtils.projectCoefficientsToSubspace per iteration
     — here the bounds become the LBFGS projected-gradient box
-    (opt/lbfgs.py:97 via make_solver(box=...)).  Bounds are ORIGINAL-space;
-    with scaling normalization w_orig = factors * w_t (factors > 0), so the
-    transformed-space box is [lo/f, hi/f].  Shift normalization folds a
-    -<w, shifts> term into the intercept, making per-feature original-space
-    bounds non-separable — refused loudly.
+    (opt/lbfgs.py:97 via make_solver(box=...)).
+
+    ``space="original"`` (default): bounds constrain the PUBLISHED
+    original-space coefficients; with scaling normalization
+    w_orig = factors * w_t (factors > 0) the transformed-space box is
+    [lo/f, hi/f], and shift normalization is refused loudly (the
+    -<w, shifts> intercept fold makes per-feature original bounds
+    non-separable).
+
+    ``space="transformed"``: reference-compat — raw bounds applied to the
+    transformed-space iterate regardless of normalization, reproducing
+    TRON.scala:228 / OptimizationUtils.scala:56-58 (which silently apply
+    original-space constraintMap bounds in the scaled+shifted space); the
+    published original-space coefficients can then violate the written
+    bounds.  See game/config._canonicalize_constraints and MIGRATION.md.
     """
     if not constraints:
         return None
+    if space == "transformed":
+        norm = None  # raw bounds in solver space: the reference's behavior
     total = d_pad or dim
     lo = np.full(total, -np.inf, dtype)
     hi = np.full(total, np.inf, dtype)
@@ -590,7 +604,9 @@ def _box_from_constraints(constraints, dim: int, dtype, norm=None,
             raise ValueError(
                 "box constraints with shift normalization are not supported "
                 "(original-space bounds are non-separable under shifts); use "
-                "a scaling-only normalization type")
+                "a scaling-only normalization type, or "
+                "constraint_space='transformed' for reference-compat raw "
+                "bounds on the transformed iterate (MIGRATION.md)")
         if norm.factors is not None:
             f = np.asarray(norm.factors)
             lo, hi = lo / f, hi / f
@@ -939,7 +955,8 @@ class RandomEffectCoordinate(Coordinate):
                     "INDEX_MAP")
             if not compact:
                 box = _box_from_constraints(self.config.constraints, self.dim,
-                                            self._dtype, self._norm)
+                                            self._dtype, self._norm,
+                                            space=self.config.constraint_space)
             else:
                 # Compact solve spaces get PER-LANE bounds: the full-space
                 # original bounds gathered through each lane's observed-column
@@ -955,11 +972,32 @@ class RandomEffectCoordinate(Coordinate):
                 check_box_support(self.config.optimizer,
                                   self.config.reg.l1 > 0.0)
                 if self._norm is not None and self._norm.shifts is not None:
+                    # constraint_space="transformed" does NOT lift this:
+                    # a compact solve publishes through per-lane original-
+                    # space maps whose intercept fold would have to include
+                    # the unobserved-column fill values to match the
+                    # reference's full-space semantics — refusing is the
+                    # honest call on both settings (MIGRATION.md)
                     raise ValueError(
                         f"coordinate {self.coordinate_id!r}: box constraints "
-                        "with shift normalization are not supported "
-                        "(original-space bounds are non-separable under "
-                        "shifts)")
+                        "with shift normalization are not supported under "
+                        "compaction (original-space bounds are non-separable "
+                        "under shifts; the constraint_space='transformed' "
+                        "compat flag covers non-compact coordinates only)")
+                if (self.config.constraint_space == "transformed"
+                        and self._norm is not None):
+                    # scaling-only compact: the per-lane solve applies
+                    # bounds with ORIGINAL semantics (lane-factor division
+                    # + original-space publish fill) — silently accepting
+                    # the flag here would produce exactly the divergence it
+                    # exists to prevent
+                    raise ValueError(
+                        f"coordinate {self.coordinate_id!r}: "
+                        "constraint_space='transformed' is not supported "
+                        "for compact (sparse/INDEX_MAP) solves under "
+                        "normalization — use the IDENTITY projector for "
+                        "reference-compat constrained coordinates "
+                        "(MIGRATION.md)")
                 lo, hi = _box_from_constraints(self.config.constraints,
                                                self.dim, self._dtype)
                 lo, hi = np.asarray(lo), np.asarray(hi)
@@ -1084,7 +1122,7 @@ class RandomEffectCoordinate(Coordinate):
     def _make_solver_key(self) -> tuple:
         c = self.config
         return (c.optimizer, c.solver, c.reg.l1 > 0.0, c.variance,
-                c.constraints)
+                c.constraints, c.constraint_space)
 
     def _refresh_lane_mult(self) -> None:
         """Cache per-bucket (ones, multiplier) lane vectors — constant per
