@@ -86,6 +86,11 @@ class EntityBuckets:
     """All buckets for one random-effect coordinate + the entity directory.
 
     ``lane_of``: entity id -> (bucket index, lane) for model lookup/update.
+    ``compact``: design blocks are per-lane OBSERVED-column bases (the
+    sparse bucketer), not the shared full-vocabulary basis — an explicit
+    marker because the padded compact width can EQUAL ``dim`` while lane
+    column j still means "the lane's j-th observed feature", so width
+    comparison cannot detect compactness.
     """
 
     buckets: List[Bucket]
@@ -93,6 +98,7 @@ class EntityBuckets:
     dim: int
     num_entities: int
     num_samples: int  # original sample-row count (scores vector length)
+    compact: bool = False
 
     def entity_ids(self) -> np.ndarray:
         return np.asarray(sorted(self.lane_of), np.int64)
@@ -355,7 +361,8 @@ def bucket_by_entity_sparse(
 
     ents = EntityBuckets(buckets=buckets, lane_of=lane_of, dim=dim,
                          num_entities=len(kept_entities),
-                         num_samples=n if num_samples is None else num_samples)
+                         num_samples=n if num_samples is None else num_samples,
+                         compact=True)
     return ents, projections
 
 

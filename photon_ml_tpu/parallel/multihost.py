@@ -248,18 +248,28 @@ def local_entity_rows(entity_ids: np.ndarray,
     return np.nonzero(owner == pid)[0].astype(np.int64)
 
 
-def global_entity_buckets(local, mesh: Mesh):
+def global_entity_buckets(local, mesh: Mesh, projections=None):
     """Host-local EntityBuckets -> globally-sharded EntityBuckets.
 
     Every host calls this with ITS entities' buckets (built with global
     ``row_ids``/``num_samples``).  One metadata all-gather agrees on the
-    union of capacity classes and the per-host lane count of each, then
-    every field assembles via ``make_array_from_process_local_data`` with
-    the entity lane sharded over ALL mesh devices (the layout
-    ``fit_random_effects`` solves under).  The returned ``lane_of`` maps
-    THIS host's entities to (bucket, GLOBAL lane); ``num_entities`` is the
-    global total.  Hosts missing a capacity class contribute all-padding
-    lanes (weight 0, entity -1) — inert by the core masking contract."""
+    union of capacity classes, the per-host lane count of each, and — for
+    COMPACT buckets — the class's compact width; then every field assembles
+    via ``make_array_from_process_local_data`` with the entity lane sharded
+    over ALL mesh devices (the layout ``fit_random_effects`` solves under).
+    The returned ``lane_of`` maps THIS host's entities to (bucket, GLOBAL
+    lane); ``num_entities`` is the global total.  Hosts missing a capacity
+    class contribute all-padding lanes (weight 0, entity -1) — inert by the
+    core masking contract.
+
+    ``projections``: the per-bucket BucketProjection list from
+    ``bucket_by_entity_sparse`` (wide-vocabulary compact buckets: design
+    blocks are [E, S, d_obs], never [E, S, vocab]).  Per-host compact
+    widths differ, so the agreement pass takes the max per class and each
+    host zero-pads its blocks (padded columns carry index -1 / value 0 —
+    margin-inert).  Returns ``(global_buckets, padded_projections)`` in
+    this mode; projections stay HOST-LOCAL (publish back-projects each
+    host's own lanes — ``export_local_random_effects``)."""
     from jax.experimental import multihost_utils
 
     from photon_ml_tpu.parallel.bucketing import Bucket, EntityBuckets
@@ -271,57 +281,69 @@ def global_entity_buckets(local, mesh: Mesh):
         raise ValueError(f"{n_dev} devices not divisible by {n_proc} processes")
     ldc = n_dev // n_proc  # per-host device share of the entity lane
 
-    # 1. agree on capacity classes + per-host lane counts (tiny all-gather:
-    #    lanes-per-log2-capacity, one int vector per host)
+    # 1. agree on capacity classes + per-host lane counts + compact widths
+    #    (tiny all-gather: two ints per log2-capacity per host)
     MAXLOG = 33
-    vec = np.zeros((MAXLOG,), np.int64)
+    vec = np.zeros((MAXLOG, 2), np.int64)
     by_cap = {}
     for local_bi, b in enumerate(local.buckets):
         c = int(b.capacity)
         log = c.bit_length() - 1
         if (1 << log) != c:
             raise ValueError(f"bucket capacity {c} is not a power of two")
-        vec[log] = b.num_lanes
+        vec[log, 0] = b.num_lanes
+        vec[log, 1] = b.x.shape[2]
         by_cap[c] = (local_bi, b)
-    all_vec = np.asarray(multihost_utils.process_allgather(vec))  # [nproc, MAXLOG]
+    if local.compact and projections is None:
+        # the explicit marker, NOT width comparison: a padded compact width
+        # can equal dim while lane column j still means "j-th observed
+        # feature" (EntityBuckets.compact docstring)
+        raise ValueError(
+            "compact buckets need their projections: pass "
+            "bucket_by_entity_sparse's BucketProjection list so the "
+            "agreement pass can align per-host compact widths and export "
+            "can back-project to the full vocabulary")
+    all_vec = np.asarray(multihost_utils.process_allgather(vec))
     ent_counts = np.asarray(multihost_utils.process_allgather(
         np.asarray([local.num_entities], np.int64)))
     num_entities_global = int(ent_counts.sum())
 
     shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
     buckets = []
+    padded_projections = []
     lane_of: Dict[int, Tuple[int, int]] = {}
     dtype = (local.buckets[0].x.dtype if local.buckets else np.float32)
     for log in range(MAXLOG):
-        host_lanes = all_vec[:, log]
+        host_lanes = all_vec[:, log, 0]
         if not host_lanes.any():
             continue
         cap = 1 << log
         per_host = int(-(-host_lanes.max() // ldc) * ldc)
         local_bi, b = by_cap.get(cap, (None, None))
-        if b is not None and b.x.shape[2] != local.dim:
-            raise ValueError(
-                "global_entity_buckets takes FULL-dimension buckets "
-                "(bucket_by_entity); per-host compact dims "
-                f"({b.x.shape[2]} != {local.dim}) cannot concatenate across "
-                "hosts — compact/projected multihost random effects would "
-                "need a per-host d_proj agreement pass")
-        d = local.dim
+        d = (int(all_vec[:, log, 1].max()) if projections is not None
+             else local.dim)
 
-        def _pad(a, fill, shape_tail, dt):
+        def _pad2(a, fill, shape_tail, dt):
+            """Pad lanes AND (for 3-d design blocks) trailing compact dim."""
             out = np.full((per_host,) + shape_tail, fill, dt)
             if a is not None:
-                out[: a.shape[0]] = a
+                if a.ndim == 3:
+                    out[: a.shape[0], :, : a.shape[2]] = a
+                elif a.ndim == 2:
+                    out[: a.shape[0], : a.shape[1]] = a
+                else:
+                    out[: a.shape[0]] = a
             return out
 
         fields = dict(
-            x=_pad(b.x if b else None, 0, (cap, d), dtype),
-            y=_pad(b.y if b else None, 0, (cap,), dtype),
-            offset=_pad(b.offset if b else None, 0, (cap,), dtype),
-            weight=_pad(b.weight if b else None, 0, (cap,), dtype),
-            rows=_pad(b.rows if b else None, -1, (cap,), np.int32),
-            counts=_pad(b.counts if b else None, 0, (), np.int32),
-            entity_lanes=_pad(b.entity_lanes if b else None, -1, (), np.int64),
+            x=_pad2(b.x if b else None, 0, (cap, d), dtype),
+            y=_pad2(b.y if b else None, 0, (cap,), dtype),
+            offset=_pad2(b.offset if b else None, 0, (cap,), dtype),
+            weight=_pad2(b.weight if b else None, 0, (cap,), dtype),
+            rows=_pad2(b.rows if b else None, -1, (cap,), np.int32),
+            counts=_pad2(b.counts if b else None, 0, (), np.int32),
+            entity_lanes=_pad2(b.entity_lanes if b else None, -1, (),
+                               np.int64),
         )
         g = {
             k: jax.make_array_from_process_local_data(
@@ -334,9 +356,21 @@ def global_entity_buckets(local, mesh: Mesh):
                 if lbi == local_bi:
                     lane_of[eid] = (bi, pid * per_host + lane)
         buckets.append(Bucket(**g))
-    return EntityBuckets(buckets=buckets, lane_of=lane_of, dim=local.dim,
-                         num_entities=num_entities_global,
-                         num_samples=local.num_samples)
+        if projections is not None:
+            from photon_ml_tpu.parallel.projection import BucketProjection
+
+            p = projections[local_bi] if b is not None else None
+            idx = _pad2(p.indices if p is not None else None, -1, (d,),
+                        np.int32)
+            padded_projections.append(
+                BucketProjection(indices=idx, d_full=local.dim))
+    out = EntityBuckets(buckets=buckets, lane_of=lane_of, dim=local.dim,
+                        num_entities=num_entities_global,
+                        num_samples=local.num_samples,
+                        compact=local.compact)
+    if projections is not None:
+        return out, padded_projections
+    return out
 
 
 def build_re_scoring(global_train, local_scoring, mesh: Mesh):
@@ -349,6 +383,12 @@ def build_re_scoring(global_train, local_scoring, mesh: Mesh):
     scoring lane to its entity's row in the CONCATENATED training lane
     arrays (-1 for padding lanes) — the cross-bucket coefficient gather
     ``multihost_glmix_sweep`` scores with."""
+    if global_train.compact:
+        raise ValueError(
+            "passive scoring does not compose with COMPACT training buckets "
+            "(each lane's coefficients live in its own observed-column "
+            "basis); omit the reservoir cap for compact multihost "
+            "coordinates, so the training buckets score every sample")
     bases = np.cumsum([0] + [b.num_lanes for b in global_train.buckets])
     flat_of = {eid: int(bases[bi] + lane)
                for eid, (bi, lane) in global_train.lane_of.items()}
@@ -502,8 +542,11 @@ def multihost_glmix_sweep(
     from photon_ml_tpu.core.batch import DenseBatch
 
     w_fixed = jax.jit(lambda: jnp.zeros((d_fixed,), dtype), out_shardings=rep)()
+    # per-bucket solve width = the bucket's design width (compact buckets
+    # solve in their observed-column space, not the full vocabulary)
     re_coeffs = [
-        jax.jit(functools.partial(jnp.zeros, (b.num_lanes, re_buckets.dim),
+        jax.jit(functools.partial(jnp.zeros,
+                                  (b.num_lanes, int(b.x.shape[2])),
                                   dtype), out_shardings=entity_shard)()
         for b in re_buckets.buckets
     ]
@@ -531,19 +574,27 @@ def multihost_glmix_sweep(
     return w_fixed, re_coeffs, re_scores
 
 
-def export_local_random_effects(re_coeffs, re_buckets,
-                                mesh: Mesh) -> Dict[int, np.ndarray]:
+def export_local_random_effects(re_coeffs, re_buckets, mesh: Mesh,
+                                projections=None) -> Dict[int, np.ndarray]:
     """THIS host's entities' coefficient vectors from globally-sharded lane
     arrays — each host publishes its own entity range (the reference writes
-    the RandomEffectModel RDD partition-wise the same way)."""
+    the RandomEffectModel RDD partition-wise the same way).
+
+    ``projections``: the padded host-local BucketProjection list from
+    ``global_entity_buckets(..., projections=...)`` — compact lanes
+    back-project through THIS host's observed-column maps to full
+    vocabulary width before export."""
     n_proc = jax.process_count()
     pid = jax.process_index()
     out: Dict[int, np.ndarray] = {}
     host_blocks = {}
     for bi, arr in enumerate(re_coeffs):
         shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
-        host_blocks[bi] = (np.concatenate([np.asarray(s.data) for s in shards])
-                          if shards else np.zeros((0, arr.shape[1])))
+        block = (np.concatenate([np.asarray(s.data) for s in shards])
+                 if shards else np.zeros((0, arr.shape[1])))
+        if projections is not None:
+            block = projections[bi].back_project(block)
+        host_blocks[bi] = block
         per_host = arr.shape[0] // n_proc
         base = pid * per_host
         for eid, (ebi, lane) in re_buckets.lane_of.items():

@@ -855,3 +855,139 @@ def test_multihost_glmix_padded_row_space(tmp_path):
         "ceil(n/nproc) is not a multiple of the per-host data-device count")
     assert sum(o["n_owned_rows"] for o in outs) == 57
     _check_glmix_outputs(outs, 2, n=57)
+
+
+def test_multihost_glmix_sparse_compact_two_processes(tmp_path):
+    """Wide-vocabulary multihost random effects: sparse (compact,
+    observed-column) buckets built per host, compact widths aligned by the
+    metadata all-gather, solved in the global sweep, back-projected
+    host-locally on export — the multihost twin of the single-process
+    sparse coordinate.  Parity vs the single-process framework solve."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = tmp_path / "glmix_sparse_worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {os.getcwd()!r})
+import os, json
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); out = sys.argv[3]
+from photon_ml_tpu.parallel import multihost as mh
+from photon_ml_tpu.parallel.bucketing import bucket_by_entity_sparse
+mh.initialize(coordinator_address="127.0.0.1:{port}", num_processes=nproc,
+              process_id=pid, expected_processes=nproc)
+mesh = mh.global_mesh()
+
+rng = np.random.default_rng(77)
+n, n_users, dg, du, ku = 480, 12, 4, 64, 3
+uids = rng.integers(0, n_users, size=n)
+xg = rng.normal(size=(n, dg)).astype(np.float32)
+idx_u = rng.integers(0, du, size=(n, ku)).astype(np.int32)
+vals_u = rng.normal(size=(n, ku)).astype(np.float32)
+uw = rng.normal(size=(n_users, du)).astype(np.float32)
+gw = rng.normal(size=dg).astype(np.float32)
+z = xg @ gw + np.einsum("nk,nk->n", vals_u, uw[uids[:, None], idx_u])
+y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+
+from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.losses import logistic_loss
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.opt.types import SolverConfig
+
+start, stop = mh.process_row_range(n)
+rows_per = mh.padded_per_host_rows(n, mesh)
+blk = mh.pad_local_rows(dict(x=xg[start:stop], y=y[start:stop],
+                             offset=np.zeros(stop - start, np.float32),
+                             weight=np.ones(stop - start, np.float32)),
+                        rows_per)
+g = mh.global_batch_from_local(blk, mesh)
+fixed_batch = DenseBatch(x=g["x"], y=g["y"], offset=g["offset"],
+                         weight=g["weight"])
+
+rid = mh.local_entity_rows(uids)
+assert len(rid) > 0
+local, projs = bucket_by_entity_sparse(
+    uids[rid], idx_u[rid], vals_u[rid], du, y[rid],
+    weight=np.ones(len(rid), np.float32), seed=5,
+    row_ids=rid, num_samples=rows_per * nproc)
+gb, pp = mh.global_entity_buckets(local, mesh, projections=projs)
+
+cfg = SolverConfig(max_iters=60, tolerance=1e-9)
+wf, rec, _ = mh.multihost_glmix_sweep(
+    mesh, fixed_batch, gb,
+    GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.1)),
+    GLMObjective(loss=logistic_loss, reg=Regularization(l2=1.0)),
+    num_iterations=2, config=cfg, num_samples=n)
+exported = mh.export_local_random_effects(rec, gb, mesh, projections=pp)
+with open(os.path.join(out, f"sp{{pid}}.json"), "w") as f:
+    json.dump({{"wf": [float(v) for v in np.asarray(wf)],
+               "re": {{str(k): [float(v) for v in w]
+                      for k, w in exported.items()}}}}, f)
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), "2", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(2)]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (_, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+    res = [json.load(open(tmp_path / f"sp{pid}.json")) for pid in range(2)]
+    np.testing.assert_allclose(res[0]["wf"], res[1]["wf"], rtol=0, atol=0)
+    merged = {int(k): np.asarray(v) for o in res for k, v in o["re"].items()}
+
+    # single-process framework reference (sparse shard -> compact coordinate)
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.data import SparseShard
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(77)
+    n, n_users, dg, du, ku = 480, 12, 4, 64, 3
+    uids = rng.integers(0, n_users, size=n)
+    xg = rng.normal(size=(n, dg)).astype(np.float32)
+    idx_u = rng.integers(0, du, size=(n, ku)).astype(np.int32)
+    vals_u = rng.normal(size=(n, ku)).astype(np.float32)
+    uw = rng.normal(size=(n_users, du)).astype(np.float32)
+    gw = rng.normal(size=dg).astype(np.float32)
+    z = xg @ gw + np.einsum("nk,nk->n", vals_u, uw[uids[:, None], idx_u])
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+    data = GameData(y=y, features={
+        "g": xg, "u": SparseShard(indices=idx_u, values=vals_u, dim=du)},
+        id_tags={"userId": uids})
+    cfg = SolverConfig(max_iters=60, tolerance=1e-9)
+    coords = {
+        "fixed": build_coordinate("fixed", data, FixedEffectConfig(
+            feature_shard="g", solver=cfg, reg=Regularization(l2=0.1)),
+            TaskType.LOGISTIC_REGRESSION, seed=5),
+        "user": build_coordinate("user", data, RandomEffectConfig(
+            random_effect_type="userId", feature_shard="u",
+            solver=cfg, reg=Regularization(l2=1.0)),
+            TaskType.LOGISTIC_REGRESSION, seed=5),
+    }
+    model, _, _ = CoordinateDescent(coords, order=["fixed", "user"],
+                                    num_iterations=2).run(seed=5)
+    np.testing.assert_allclose(
+        res[0]["wf"], np.asarray(model["fixed"].coefficients.means),
+        atol=5e-4, rtol=1e-3)
+    re_ref = model["user"]
+    assert set(merged) == set(re_ref.slot_of)
+    for eid, w in merged.items():
+        np.testing.assert_allclose(
+            w, np.asarray(re_ref.w_stack[re_ref.slot_of[eid]]),
+            atol=5e-4, rtol=1e-3)
