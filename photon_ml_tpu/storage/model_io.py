@@ -382,21 +382,44 @@ def save_coordinate(
             if m.variances is not None:
                 arrays["variances"] = np.asarray(m.variances)
             np.savez(os.path.join(cdir, "coefficients.npz"), **arrays)
+        if fmt != "columnar":
+            # single-process save = the multihost writer's part=0 case: ONE
+            # definition of the record write (save_random_effect_part)
+            out = save_random_effect_part(cid, m, out_dir,
+                                          index_maps[m.feature_shard], eidx,
+                                          part=0)
         else:
-            imap = index_maps[m.feature_shard]
-            rpath = os.path.join(cdir, "part-00000.avro")
-            if not _write_re_avro_fast(rpath, m, eidx, imap, m.task.value):
-                avro_io.write_container(rpath, BAYESIAN_LINEAR_MODEL,
-                                        _re_records(m, eidx, imap, m.task.value))
-        out = {
-            "type": "random",
-            "feature_shard": m.feature_shard,
-            "random_effect_type": m.random_effect_type,
-        }
+            out = {
+                "type": "random",
+                "feature_shard": m.feature_shard,
+                "random_effect_type": m.random_effect_type,
+            }
         if fp is not None:
             out["index_fingerprint"] = fp
         return out
     raise TypeError(f"cannot save model type {type(m)!r}")
+
+
+def save_random_effect_part(coordinate_id: str, model, out_dir: str,
+                            index_map, entity_index=None,
+                            part: int = 0) -> dict:
+    """Write ONE host's random-effect entities as a part file into the
+    shared model directory (reference: executors write part-NNNNN avro
+    files per partition; the loader here already merges the whole
+    directory — ``avro_io.read_directory`` in ``load_game_model``).
+    Used by the multihost train driver: every process calls this with its
+    own entities and ``part=process_index``; returns the coordinate's
+    metadata dict (identical on every host)."""
+    cdir = os.path.join(out_dir, "random-effect", coordinate_id)
+    os.makedirs(cdir, exist_ok=True)
+    rpath = os.path.join(cdir, f"part-{part:05d}.avro")
+    if not _write_re_avro_fast(rpath, model, entity_index, index_map,
+                               model.task.value):
+        avro_io.write_container(
+            rpath, BAYESIAN_LINEAR_MODEL,
+            _re_records(model, entity_index, index_map, model.task.value))
+    return {"type": "random", "feature_shard": model.feature_shard,
+            "random_effect_type": model.random_effect_type}
 
 
 def save_game_model(
