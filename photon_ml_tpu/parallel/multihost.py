@@ -447,24 +447,52 @@ def multihost_glmix_sweep(
     here translates original -> padded ids.  Required; the two tests'
     sizes aligning by accident is exactly the trap.
 
-    Normalization is not folded here (both objectives must be
+    MULTIPLE random-effect coordinates (the reference's per-user +
+    per-item GLMix shape): pass ``re_buckets`` as an ORDERED dict
+    {cid: EntityBuckets} — ``re_objective`` then takes a matching dict (or
+    one shared objective) and ``re_scoring`` a dict of ``build_re_scoring``
+    results; the update schedule becomes fixed, then each RE coordinate in
+    dict order, every one training against the residual of ALL others
+    (CoordinateDescent.scala:197-204).  Returns dicts in this mode.
+
+    Normalization is not folded here (every objective must be
     identity-normalized); the single-process coordinate path owns the
     model-space maps.  Returns ``(w_fixed, re_coeffs, re_scores)`` —
     replicated fixed coefficients, per-bucket GLOBAL [E, d] lane
-    coefficients, and the final replicated RE score vector."""
+    coefficients, and the final replicated RE score vector(s)."""
     import functools
 
     from photon_ml_tpu.opt.solve import make_solver
     from photon_ml_tpu.parallel.fixed import ShardMapObjective
     from photon_ml_tpu.types import OptimizerType
 
-    if fixed_objective.norm.factors is not None or \
-            fixed_objective.norm.shifts is not None or \
-            re_objective.norm.factors is not None or \
-            re_objective.norm.shifts is not None:
-        raise ValueError(
-            "multihost_glmix_sweep runs identity-normalized objectives; "
-            "fold normalization before the multihost path")
+    single = not isinstance(re_buckets, dict)
+    re_b = {"__re__": re_buckets} if single else dict(re_buckets)
+    if isinstance(re_objective, dict):
+        if set(re_objective) != set(re_b):
+            raise ValueError("re_objective keys must match re_buckets keys")
+        re_obj = dict(re_objective)
+    else:
+        re_obj = {cid: re_objective for cid in re_b}
+    if re_scoring is None:
+        re_sc = {}
+    elif single:
+        re_sc = {"__re__": re_scoring}
+    else:
+        re_sc = dict(re_scoring)
+        unknown = set(re_sc) - set(re_b)
+        if unknown:
+            # a misspelled key would silently fall back to scoring with the
+            # CAPPED training buckets — the exact failure mode the passive
+            # path exists to prevent
+            raise ValueError(f"re_scoring keys {sorted(unknown)} not in "
+                             f"re_buckets {sorted(re_b)}")
+
+    for o in [fixed_objective, *re_obj.values()]:
+        if o.norm.factors is not None or o.norm.shifts is not None:
+            raise ValueError(
+                "multihost_glmix_sweep runs identity-normalized objectives; "
+                "fold normalization before the multihost path")
     optimizer = OptimizerType.LBFGS if optimizer is None else optimizer
     n_pad = int(fixed_batch.y.shape[0])
     d_fixed = int(fixed_batch.x.shape[1])
@@ -488,10 +516,13 @@ def multihost_glmix_sweep(
             rows >= 0, (rows // per) * rows_per + rows % per, rows)
 
     zeros_n = jax.jit(lambda: jnp.zeros((n_pad,), dtype), out_shardings=rep)
-    re_scores = zeros_n()
 
     add_offsets = jax.jit(lambda base, s: base + s, out_shardings=row_sharded)
     fixed_margin = jax.jit(lambda w, b: b.margins(w), out_shardings=rep)
+    # residual bookkeeping on replicated [n_pad] vectors (the descent loop's
+    # numpy adds in game/descent.py, kept on device)
+    rep_other = jax.jit(lambda m, t, s: m + t - s, out_shardings=rep)
+    rep_swap = jax.jit(lambda t, old, new: t - old + new, out_shardings=rep)
 
     @jax.jit
     def bucket_offset(off0, rows, margins):
@@ -528,8 +559,9 @@ def multihost_glmix_sweep(
                 jnp.where(valid, margins, 0.0).ravel())
         return total
 
-    solve_re = make_solver(re_objective, optimizer, config)
-    vsolve_re = jax.jit(jax.vmap(solve_re))
+    vsolves = {cid: jax.jit(jax.vmap(make_solver(re_obj[cid], optimizer,
+                                                 config)))
+               for cid in re_b}
     # ONE compile for the fixed solve (the same explicit-SPMD path
     # fit_fixed_effect takes), reused across descent iterations
     solve_fixed = jax.jit(
@@ -544,33 +576,45 @@ def multihost_glmix_sweep(
     w_fixed = jax.jit(lambda: jnp.zeros((d_fixed,), dtype), out_shardings=rep)()
     # per-bucket solve width = the bucket's design width (compact buckets
     # solve in their observed-column space, not the full vocabulary)
-    re_coeffs = [
-        jax.jit(functools.partial(jnp.zeros,
-                                  (b.num_lanes, int(b.x.shape[2])),
-                                  dtype), out_shardings=entity_shard)()
-        for b in re_buckets.buckets
-    ]
+    re_coeffs = {
+        cid: [jax.jit(functools.partial(jnp.zeros,
+                                        (b.num_lanes, int(b.x.shape[2])),
+                                        dtype), out_shardings=entity_shard)()
+              for b in rb.buckets]
+        for cid, rb in re_b.items()
+    }
+    re_scores = {cid: zeros_n() for cid in re_b}
+    total_re = zeros_n()
     base_offset = fixed_batch.offset
     for _ in range(num_iterations):
         batch_f = _dc.replace(fixed_batch,
-                              offset=add_offsets(base_offset, re_scores))
+                              offset=add_offsets(base_offset, total_re))
         w_fixed = solve_fixed(w_fixed, batch_f).w
         margins = fixed_margin(w_fixed, fixed_batch)
-        new_coeffs = []
-        for b, w0 in zip(re_buckets.buckets, re_coeffs):
-            off = bucket_offset(b.offset, b.rows, margins)
-            rb = DenseBatch(x=b.x, y=b.y, offset=off, weight=b.weight)
-            new_coeffs.append(vsolve_re(w0, rb).w)
-        re_coeffs = new_coeffs
-        if re_scoring is not None:
-            gs, coeff_idx = re_scoring
-            re_scores = re_score_passive(
-                tuple(re_coeffs), tuple(b.x for b in gs.buckets),
-                tuple(b.rows for b in gs.buckets), tuple(coeff_idx))
-        else:
-            re_scores = re_score(tuple(re_coeffs),
-                                 tuple(b.x for b in re_buckets.buckets),
-                                 tuple(b.rows for b in re_buckets.buckets))
+        for cid, rb in re_b.items():
+            # everything the OTHER coordinates explain becomes this one's
+            # offset (fresh scores from coordinates already updated this
+            # iteration — the game/descent.py schedule)
+            other = rep_other(margins, total_re, re_scores[cid])
+            new_coeffs = []
+            for b, w0 in zip(rb.buckets, re_coeffs[cid]):
+                off = bucket_offset(b.offset, b.rows, other)
+                dbatch = DenseBatch(x=b.x, y=b.y, offset=off, weight=b.weight)
+                new_coeffs.append(vsolves[cid](w0, dbatch).w)
+            re_coeffs[cid] = new_coeffs
+            if cid in re_sc and re_sc[cid] is not None:
+                gs, coeff_idx = re_sc[cid]
+                new_score = re_score_passive(
+                    tuple(new_coeffs), tuple(b.x for b in gs.buckets),
+                    tuple(b.rows for b in gs.buckets), tuple(coeff_idx))
+            else:
+                new_score = re_score(tuple(new_coeffs),
+                                     tuple(b.x for b in rb.buckets),
+                                     tuple(b.rows for b in rb.buckets))
+            total_re = rep_swap(total_re, re_scores[cid], new_score)
+            re_scores[cid] = new_score
+    if single:
+        return w_fixed, re_coeffs["__re__"], re_scores["__re__"]
     return w_fixed, re_coeffs, re_scores
 
 

@@ -487,14 +487,8 @@ def test_multihost_two_processes(tmp_path):
     execution model, SURVEY §5, with no driver process)."""
     import json
     import os
-    import socket
-    import subprocess
-    import sys
 
-    with socket.socket() as s:  # free port for the coordinator
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
+    port = _free_port()
     worker = tmp_path / "worker.py"
     worker.write_text(f"""
 import sys
@@ -583,17 +577,7 @@ with open(os.path.join(out, f"w{{pid}}.json"), "w") as f:
                "w2": [float(v) for v in w2]}}, f)
 """)
 
-    env = dict(os.environ,
-               JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    env.pop("PYTEST_CURRENT_TEST", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(pid), "2", str(tmp_path)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        for pid in range(2)]
-    outs = [p.communicate(timeout=240) for p in procs]
-    for p, (so, se) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+    _launch_workers(worker, 2, tmp_path, timeout=240)
 
     out0 = json.load(open(tmp_path / "w0.json"))
     out1 = json.load(open(tmp_path / "w1.json"))
@@ -657,6 +641,34 @@ def test_global_feature_stats_on_sharded_rows(devices, rng):
         np.testing.assert_allclose(np.asarray(getattr(stats_sharded, f)),
                                    np.asarray(getattr(stats_host, f)),
                                    rtol=1e-10, err_msg=f)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_workers(worker, nproc, tmp_path, local_devices=2, timeout=420):
+    """Run ``worker`` as nproc jax.distributed processes (argv: pid nproc
+    tmp_path) and assert they all exit 0 — the ONE definition of the
+    multi-process launch contract (env, device count, failure reporting)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={local_devices}")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), str(nproc), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(nproc)]
+    outs = [p.communicate(timeout=timeout) for p in procs]
+    for p, (_, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
 
 
 # --- multihost GLMix (fixed + random effects across processes) -------------
@@ -773,27 +785,12 @@ def _glmix_reference(n=503, active_cap=16):
 def _run_glmix_workers(tmp_path, nproc, local_devices, n_entity, n=503):
     import json
     import os
-    import socket
-    import subprocess
-    import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = tmp_path / "glmix_worker.py"
     worker.write_text(_GLMIX_WORKER.format(
-        repo=os.getcwd(), port=port, n_entity=n_entity,
+        repo=os.getcwd(), port=_free_port(), n_entity=n_entity,
         datagen=_GLMIX_DATAGEN.format(n=n)))
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS=f"--xla_force_host_platform_device_count={local_devices}")
-    env.pop("PYTEST_CURRENT_TEST", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(pid), str(nproc), str(tmp_path)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        for pid in range(nproc)]
-    outs = [p.communicate(timeout=420) for p in procs]
-    for p, (_, se) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+    _launch_workers(worker, nproc, tmp_path, local_devices=local_devices)
     return [json.load(open(tmp_path / f"glmix{pid}.json"))
             for pid in range(nproc)]
 
@@ -865,13 +862,8 @@ def test_multihost_glmix_sparse_compact_two_processes(tmp_path):
     sparse coordinate.  Parity vs the single-process framework solve."""
     import json
     import os
-    import socket
-    import subprocess
-    import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = _free_port()
     worker = tmp_path / "glmix_sparse_worker.py"
     worker.write_text(f"""
 import sys
@@ -935,16 +927,7 @@ with open(os.path.join(out, f"sp{{pid}}.json"), "w") as f:
                "re": {{str(k): [float(v) for v in w]
                       for k, w in exported.items()}}}}, f)
 """)
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    env.pop("PYTEST_CURRENT_TEST", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(pid), "2", str(tmp_path)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        for pid in range(2)]
-    outs = [p.communicate(timeout=420) for p in procs]
-    for p, (_, se) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+    _launch_workers(worker, 2, tmp_path)
     res = [json.load(open(tmp_path / f"sp{pid}.json")) for pid in range(2)]
     np.testing.assert_allclose(res[0]["wf"], res[1]["wf"], rtol=0, atol=0)
     merged = {int(k): np.asarray(v) for o in res for k, v in o["re"].items()}
@@ -991,3 +974,139 @@ with open(os.path.join(out, f"sp{{pid}}.json"), "w") as f:
         np.testing.assert_allclose(
             w, np.asarray(re_ref.w_stack[re_ref.slot_of[eid]]),
             atol=5e-4, rtol=1e-3)
+
+
+def test_multihost_glmix3_two_processes(tmp_path):
+    """Three-coordinate multihost GLMix (fixed + per-user + per-item — the
+    reference's flagship shape): each RE coordinate has its OWN entity-hash
+    ownership and buckets; the residual schedule runs fixed then each RE
+    against the residual of all others.  Parity vs the single-process
+    3-coordinate CoordinateDescent solve."""
+    import json
+    import os
+
+    port = _free_port()
+    worker = tmp_path / "glmix3_worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {os.getcwd()!r})
+import os, json
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); out = sys.argv[3]
+from photon_ml_tpu.parallel import multihost as mh
+from photon_ml_tpu.parallel.bucketing import bucket_by_entity
+mh.initialize(coordinator_address="127.0.0.1:{port}", num_processes=nproc,
+              process_id=pid, expected_processes=nproc)
+mesh = mh.global_mesh()
+
+rng = np.random.default_rng(91)
+n, n_users, n_items, dg, du, di = 600, 12, 9, 4, 2, 2
+uids = rng.integers(0, n_users, size=n)
+iids = rng.integers(0, n_items, size=n)
+xg = rng.normal(size=(n, dg)).astype(np.float32)
+xu = rng.normal(size=(n, du)).astype(np.float32)
+xi = rng.normal(size=(n, di)).astype(np.float32)
+uw = rng.normal(size=(n_users, du)).astype(np.float32)
+iw = rng.normal(size=(n_items, di)).astype(np.float32)
+gw = rng.normal(size=dg).astype(np.float32)
+z = (xg @ gw + np.einsum("nd,nd->n", xu, uw[uids])
+     + np.einsum("nd,nd->n", xi, iw[iids]))
+y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+
+from photon_ml_tpu.core.batch import DenseBatch
+from photon_ml_tpu.core.losses import logistic_loss
+from photon_ml_tpu.core.objective import GLMObjective
+from photon_ml_tpu.core.regularization import Regularization
+from photon_ml_tpu.opt.types import SolverConfig
+
+start, stop = mh.process_row_range(n)
+rows_per = mh.padded_per_host_rows(n, mesh)
+blk = mh.pad_local_rows(dict(x=xg[start:stop], y=y[start:stop],
+                             offset=np.zeros(stop - start, np.float32),
+                             weight=np.ones(stop - start, np.float32)),
+                        rows_per)
+g = mh.global_batch_from_local(blk, mesh)
+fb = DenseBatch(x=g["x"], y=g["y"], offset=g["offset"], weight=g["weight"])
+n_glob = rows_per * nproc
+
+def make_buckets(ids, x):
+    rid = mh.local_entity_rows(ids)
+    local = bucket_by_entity(ids[rid], x[rid], y[rid],
+                             weight=np.ones(len(rid), np.float32),
+                             seed=5, row_ids=rid, num_samples=n_glob)
+    return mh.global_entity_buckets(local, mesh)
+
+gb = {{"user": make_buckets(uids, xu), "item": make_buckets(iids, xi)}}
+cfg = SolverConfig(max_iters=60, tolerance=1e-9)
+objs = {{"user": GLMObjective(loss=logistic_loss, reg=Regularization(l2=1.0)),
+        "item": GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.7))}}
+wf, rec, _ = mh.multihost_glmix_sweep(
+    mesh, fb, gb,
+    GLMObjective(loss=logistic_loss, reg=Regularization(l2=0.1)),
+    objs, num_iterations=2, config=cfg, num_samples=n)
+ex = {{cid: mh.export_local_random_effects(rec[cid], gb[cid], mesh)
+      for cid in gb}}
+with open(os.path.join(out, f"g3_{{pid}}.json"), "w") as f:
+    json.dump({{"wf": [float(v) for v in np.asarray(wf)],
+               "re": {{cid: {{str(k): [float(v) for v in w]
+                            for k, w in d.items()}}
+                      for cid, d in ex.items()}}}}, f)
+""")
+    _launch_workers(worker, 2, tmp_path)
+    res = [json.load(open(tmp_path / f"g3_{pid}.json")) for pid in range(2)]
+    np.testing.assert_allclose(res[0]["wf"], res[1]["wf"], rtol=0, atol=0)
+    merged = {cid: {int(k): np.asarray(v)
+                    for o in res for k, v in o["re"][cid].items()}
+              for cid in ("user", "item")}
+
+    # single-process 3-coordinate reference
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.game import FixedEffectConfig, GameData, RandomEffectConfig
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(91)
+    n, n_users, n_items, dg, du, di = 600, 12, 9, 4, 2, 2
+    uids = rng.integers(0, n_users, size=n)
+    iids = rng.integers(0, n_items, size=n)
+    xg = rng.normal(size=(n, dg)).astype(np.float32)
+    xu = rng.normal(size=(n, du)).astype(np.float32)
+    xi = rng.normal(size=(n, di)).astype(np.float32)
+    uw = rng.normal(size=(n_users, du)).astype(np.float32)
+    iw = rng.normal(size=(n_items, di)).astype(np.float32)
+    gw = rng.normal(size=dg).astype(np.float32)
+    z = (xg @ gw + np.einsum("nd,nd->n", xu, uw[uids])
+         + np.einsum("nd,nd->n", xi, iw[iids]))
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+    data = GameData(y=y, features={"g": xg, "u": xu, "i": xi},
+                    id_tags={"userId": uids, "itemId": iids})
+    cfg = SolverConfig(max_iters=60, tolerance=1e-9)
+    coords = {
+        "fixed": build_coordinate("fixed", data, FixedEffectConfig(
+            feature_shard="g", solver=cfg, reg=Regularization(l2=0.1)),
+            TaskType.LOGISTIC_REGRESSION, seed=5),
+        "user": build_coordinate("user", data, RandomEffectConfig(
+            random_effect_type="userId", feature_shard="u", solver=cfg,
+            reg=Regularization(l2=1.0)), TaskType.LOGISTIC_REGRESSION,
+            seed=5),
+        "item": build_coordinate("item", data, RandomEffectConfig(
+            random_effect_type="itemId", feature_shard="i", solver=cfg,
+            reg=Regularization(l2=0.7)), TaskType.LOGISTIC_REGRESSION,
+            seed=5),
+    }
+    model, _, _ = CoordinateDescent(coords, order=["fixed", "user", "item"],
+                                    num_iterations=2).run(seed=5)
+    np.testing.assert_allclose(
+        res[0]["wf"], np.asarray(model["fixed"].coefficients.means),
+        atol=5e-4, rtol=1e-3)
+    for cid in ("user", "item"):
+        ref = model[cid]
+        assert set(merged[cid]) == set(ref.slot_of)
+        for e, w in merged[cid].items():
+            np.testing.assert_allclose(
+                w, np.asarray(ref.w_stack[ref.slot_of[e]]),
+                atol=5e-4, rtol=1e-3)
