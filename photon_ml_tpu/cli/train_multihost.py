@@ -85,8 +85,17 @@ def run(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--index-map-dir", default=None)
     ap.add_argument("--no-intercept", action="store_true")
     ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="per-host npz checkpoint after every iteration; "
+                         "rerunning the same command resumes at the cursor "
+                         "(requires the same process count and inputs)")
+    ap.add_argument("--stop-after-iteration", type=int, default=None,
+                    help="exit cleanly right after checkpointing this "
+                         "iteration (preemption drills / tests)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.stop_after_iteration is not None and not args.checkpoint_dir:
+        raise SystemExit("--stop-after-iteration needs --checkpoint-dir")
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -227,9 +236,87 @@ def run(argv: Optional[List[str]] = None) -> int:
             seed=args.seed, row_ids=rid, num_samples=n_glob)
         scoring = mh.build_re_scoring(gb, ls, mesh)
 
-    # 5. the sweep
+    # 5. the sweep (+ per-iteration checkpointing: every host writes ITS
+    # lane blocks, process 0 advances the cursor AFTER a barrier — a rerun
+    # of the same command resumes at the cursor with recomputed scores)
+    import json
+    import os
+
+    from jax.experimental import multihost_utils
+
     from photon_ml_tpu.core.losses import loss_for_task
     from photon_ml_tpu.core.objective import GLMObjective
+
+    initial, start_it = None, 0
+    ck = args.checkpoint_dir
+    if ck:
+        os.makedirs(ck, exist_ok=True)
+        cursor_p = os.path.join(ck, "cursor.json")
+        host_p = os.path.join(ck, f"host-{pid:05d}.npz")
+        if os.path.exists(cursor_p):
+            with open(cursor_p) as f:
+                cur = json.load(f)
+            if cur["num_processes"] != nproc:
+                raise SystemExit(
+                    f"checkpoint was written by {cur['num_processes']} "
+                    f"processes; this run has {nproc} (lane blocks are "
+                    "per-host — resume with the same topology)")
+            if not os.path.exists(host_p):
+                raise SystemExit(
+                    f"checkpoint cursor exists but {host_p} is missing — "
+                    "every host's npz must be present (lane blocks are "
+                    "per-host; copy the whole checkpoint dir)")
+            z = np.load(host_p)
+            start_it = int(cur["next_iteration"])
+            if int(z["iteration"]) != start_it - 1:
+                # a preemption between the block write and the cursor
+                # commit leaves blocks/cursor from different iterations —
+                # resuming would warm-start a state on NO point of the
+                # uninterrupted trajectory
+                raise SystemExit(
+                    f"checkpoint inconsistent: {host_p} holds iteration "
+                    f"{int(z['iteration'])} but cursor expects "
+                    f"{start_it - 1} — restart from scratch or restore a "
+                    "consistent checkpoint dir")
+            initial = (z["w_fixed"],
+                       [z[f"b{i}"] for i in range(int(z["n_buckets"]))])
+            logger.info("resuming at iteration %d from %s", start_it, ck)
+        # every host must enter the sweep with the SAME trip count — a
+        # stale cursor view (NFS attribute caching, partial mounts) would
+        # otherwise deadlock the first collective
+        from jax.experimental import multihost_utils as _mhu
+
+        views = np.asarray(_mhu.process_allgather(
+            np.asarray([start_it], np.int64)))
+        if len(set(views.ravel().tolist())) != 1:
+            raise SystemExit(
+                f"hosts disagree on the resume iteration ({views.ravel()}) "
+                "— the checkpoint dir is not uniformly visible")
+
+    def on_iteration(it, wf, coeffs):
+        if not ck:
+            return
+        blocks = mh.host_lane_blocks(coeffs)
+        arrays = {f"b{i}": b for i, b in enumerate(blocks)}
+        arrays["w_fixed"] = np.asarray(wf)
+        arrays["n_buckets"] = np.asarray(len(blocks))
+        arrays["iteration"] = np.asarray(it)
+        tmp = host_p + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, host_p)
+        multihost_utils.sync_global_devices(f"ckpt blocks {it}")
+        if pid == 0:
+            tmp = cursor_p + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"next_iteration": it + 1,
+                           "num_processes": nproc}, f)
+            os.replace(tmp, cursor_p)
+        multihost_utils.sync_global_devices(f"ckpt cursor {it}")
+        if args.stop_after_iteration is not None \
+                and it >= args.stop_after_iteration:
+            logger.info("stopping after iteration %d (checkpointed)", it)
+            raise SystemExit(0)
 
     obj_f = GLMObjective(loss=loss_for_task(task), reg=fixed_cfg.reg)
     obj_re = GLMObjective(loss=loss_for_task(task), reg=re_cfg.reg)
@@ -237,7 +324,9 @@ def run(argv: Optional[List[str]] = None) -> int:
         mesh, fixed_batch, gb, obj_f, obj_re,
         num_iterations=args.iterations,
         optimizer=fixed_cfg.optimizer, config=fixed_cfg.solver,
-        re_scoring=scoring, num_samples=n)
+        re_scoring=scoring, num_samples=n,
+        on_iteration=on_iteration, initial=initial,
+        start_iteration=start_it)
     exported = mh.export_local_random_effects(rec, gb, mesh,
                                               projections=padded_projs)
     logger.info("trained: fixed[%d], %d local entities",
@@ -245,9 +334,6 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     # 6. executor-partitioned model write (shared --output-dir): every host
     # writes its entities as part-{pid}; process 0 adds fixed + metadata
-    import json
-    import os
-
     from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
     from photon_ml_tpu.models.glm import Coefficients
     from photon_ml_tpu.storage.model_io import (FORMAT_VERSION,

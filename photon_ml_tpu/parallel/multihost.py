@@ -419,6 +419,9 @@ def multihost_glmix_sweep(
     config=None,
     re_scoring=None,
     num_samples: Optional[int] = None,
+    on_iteration=None,
+    initial=None,
+    start_iteration: int = 0,
 ):
     """Residual coordinate descent (one fixed + one random-effect
     coordinate) where EVERY score vector is a global device array — the
@@ -454,6 +457,15 @@ def multihost_glmix_sweep(
     results; the update schedule becomes fixed, then each RE coordinate in
     dict order, every one training against the residual of ALL others
     (CoordinateDescent.scala:197-204).  Returns dicts in this mode.
+
+    Checkpoint/resume (the multihost twin of storage/checkpoint.py's
+    mid-job resume): ``on_iteration(it, w_fixed, re_coeffs)`` fires after
+    every completed iteration with the live device values — the CLI driver
+    writes per-host npz checkpoints from it.  To resume, pass
+    ``initial=(w_fixed_host, {cid: [host-local lane blocks per bucket]})``
+    (each host ITS OWN addressable blocks, as saved) plus
+    ``start_iteration``; RE scores are recomputed from the loaded
+    coefficients, so the resumed trajectory equals the uninterrupted one.
 
     Normalization is not folded here (every objective must be
     identity-normalized); the single-process coordinate path owns the
@@ -573,20 +585,51 @@ def multihost_glmix_sweep(
 
     from photon_ml_tpu.core.batch import DenseBatch
 
-    w_fixed = jax.jit(lambda: jnp.zeros((d_fixed,), dtype), out_shardings=rep)()
-    # per-bucket solve width = the bucket's design width (compact buckets
-    # solve in their observed-column space, not the full vocabulary)
-    re_coeffs = {
-        cid: [jax.jit(functools.partial(jnp.zeros,
-                                        (b.num_lanes, int(b.x.shape[2])),
-                                        dtype), out_shardings=entity_shard)()
-              for b in rb.buckets]
-        for cid, rb in re_b.items()
-    }
-    re_scores = {cid: zeros_n() for cid in re_b}
+    def _score_of(cid, coeffs):
+        if cid in re_sc and re_sc[cid] is not None:
+            gs, coeff_idx = re_sc[cid]
+            return re_score_passive(
+                tuple(coeffs), tuple(b.x for b in gs.buckets),
+                tuple(b.rows for b in gs.buckets), tuple(coeff_idx))
+        rb = re_b[cid]
+        return re_score(tuple(coeffs), tuple(b.x for b in rb.buckets),
+                        tuple(b.rows for b in rb.buckets))
+
+    if initial is not None:
+        w0_host, re_blocks = initial
+        w_fixed = jax.make_array_from_process_local_data(
+            rep, np.asarray(w0_host, dtype), global_shape=(d_fixed,))
+        if single and not isinstance(re_blocks, dict):
+            re_blocks = {"__re__": re_blocks}
+        re_coeffs = {
+            cid: [jax.make_array_from_process_local_data(
+                      entity_shard, np.asarray(blk),
+                      global_shape=(b.num_lanes,) + np.asarray(blk).shape[1:])
+                  for b, blk in zip(re_b[cid].buckets, re_blocks[cid])]
+            for cid in re_b
+        }
+        # scores recomputed from the loaded coefficients — the resumed
+        # trajectory equals the uninterrupted one
+        re_scores = {cid: _score_of(cid, re_coeffs[cid]) for cid in re_b}
+    else:
+        w_fixed = jax.jit(lambda: jnp.zeros((d_fixed,), dtype),
+                          out_shardings=rep)()
+        # per-bucket solve width = the bucket's design width (compact
+        # buckets solve in their observed-column space, not the vocabulary)
+        re_coeffs = {
+            cid: [jax.jit(functools.partial(jnp.zeros,
+                                            (b.num_lanes, int(b.x.shape[2])),
+                                            dtype),
+                          out_shardings=entity_shard)()
+                  for b in rb.buckets]
+            for cid, rb in re_b.items()
+        }
+        re_scores = {cid: zeros_n() for cid in re_b}
     total_re = zeros_n()
+    for s in re_scores.values():
+        total_re = rep_swap(total_re, zeros_n(), s)
     base_offset = fixed_batch.offset
-    for _ in range(num_iterations):
+    for it in range(start_iteration, num_iterations):
         batch_f = _dc.replace(fixed_batch,
                               offset=add_offsets(base_offset, total_re))
         w_fixed = solve_fixed(w_fixed, batch_f).w
@@ -602,20 +645,26 @@ def multihost_glmix_sweep(
                 dbatch = DenseBatch(x=b.x, y=b.y, offset=off, weight=b.weight)
                 new_coeffs.append(vsolves[cid](w0, dbatch).w)
             re_coeffs[cid] = new_coeffs
-            if cid in re_sc and re_sc[cid] is not None:
-                gs, coeff_idx = re_sc[cid]
-                new_score = re_score_passive(
-                    tuple(new_coeffs), tuple(b.x for b in gs.buckets),
-                    tuple(b.rows for b in gs.buckets), tuple(coeff_idx))
-            else:
-                new_score = re_score(tuple(new_coeffs),
-                                     tuple(b.x for b in rb.buckets),
-                                     tuple(b.rows for b in rb.buckets))
+            new_score = _score_of(cid, new_coeffs)
             total_re = rep_swap(total_re, re_scores[cid], new_score)
             re_scores[cid] = new_score
+        if on_iteration is not None:
+            on_iteration(it, w_fixed,
+                         re_coeffs["__re__"] if single else re_coeffs)
     if single:
         return w_fixed, re_coeffs["__re__"], re_scores["__re__"]
     return w_fixed, re_coeffs, re_scores
+
+
+def host_lane_blocks(re_coeffs) -> "list[np.ndarray]":
+    """THIS host's addressable [per_host, d] block of each global entity-lane
+    array — the unit the CLI checkpoints and ``initial=`` resumes from."""
+    out = []
+    for arr in re_coeffs:
+        shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
+        out.append(np.concatenate([np.asarray(s.data) for s in shards])
+                   if shards else np.zeros((0, arr.shape[1])))
+    return out
 
 
 def export_local_random_effects(re_coeffs, re_buckets, mesh: Mesh,
@@ -631,17 +680,13 @@ def export_local_random_effects(re_coeffs, re_buckets, mesh: Mesh,
     n_proc = jax.process_count()
     pid = jax.process_index()
     out: Dict[int, np.ndarray] = {}
-    host_blocks = {}
-    for bi, arr in enumerate(re_coeffs):
-        shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
-        block = (np.concatenate([np.asarray(s.data) for s in shards])
-                 if shards else np.zeros((0, arr.shape[1])))
+    blocks = host_lane_blocks(re_coeffs)
+    for bi, (arr, block) in enumerate(zip(re_coeffs, blocks)):
         if projections is not None:
             block = projections[bi].back_project(block)
-        host_blocks[bi] = block
         per_host = arr.shape[0] // n_proc
         base = pid * per_host
         for eid, (ebi, lane) in re_buckets.lane_of.items():
             if ebi == bi:
-                out[eid] = host_blocks[bi][lane - base]
+                out[eid] = block[lane - base]
     return out

@@ -1110,3 +1110,67 @@ with open(os.path.join(out, f"g3_{{pid}}.json"), "w") as f:
             np.testing.assert_allclose(
                 w, np.asarray(ref.w_stack[ref.slot_of[e]]),
                 atol=5e-4, rtol=1e-3)
+
+
+class TestMultihostGuards:
+    """The loud-failure contracts of the multihost path (single-process
+    degenerates — the errors fire before any cross-process work)."""
+
+    def _mesh(self, devices):
+        from photon_ml_tpu.parallel.multihost import global_mesh
+
+        return global_mesh()
+
+    def test_compact_buckets_require_projections(self, devices, rng):
+        from photon_ml_tpu.parallel.bucketing import bucket_by_entity_sparse
+        from photon_ml_tpu.parallel.multihost import global_entity_buckets
+
+        n, d, k = 40, 16, 3
+        uids = np.repeat(np.arange(8), 5)
+        local, _projs = bucket_by_entity_sparse(
+            uids, rng.integers(0, d, size=(n, k)).astype(np.int32),
+            rng.normal(size=(n, k)).astype(np.float32), d,
+            (rng.random(n) < 0.5).astype(np.float32))
+        assert local.compact
+        with pytest.raises(ValueError, match="projections"):
+            global_entity_buckets(local, self._mesh(devices))
+
+    def test_sweep_requires_num_samples(self, devices, rng):
+        from photon_ml_tpu.core import GLMObjective, Regularization, losses
+        from photon_ml_tpu.core.batch import dense_batch
+        from photon_ml_tpu.parallel.bucketing import bucket_by_entity
+        from photon_ml_tpu.parallel.multihost import (global_entity_buckets,
+                                                      multihost_glmix_sweep)
+
+        mesh = self._mesh(devices)
+        n = 16
+        uids = np.repeat(np.arange(4), 4)
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        gb = global_entity_buckets(
+            bucket_by_entity(uids, x, y, row_ids=np.arange(n),
+                             num_samples=n), mesh)
+        obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=1))
+        with pytest.raises(ValueError, match="num_samples"):
+            multihost_glmix_sweep(mesh, dense_batch(x, y), gb, obj, obj)
+
+    def test_unknown_re_scoring_key_fails(self, devices, rng):
+        from photon_ml_tpu.core import GLMObjective, Regularization, losses
+        from photon_ml_tpu.core.batch import dense_batch
+        from photon_ml_tpu.parallel.bucketing import bucket_by_entity
+        from photon_ml_tpu.parallel.multihost import (global_entity_buckets,
+                                                      multihost_glmix_sweep)
+
+        mesh = self._mesh(devices)
+        n = 16
+        uids = np.repeat(np.arange(4), 4)
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        gb = global_entity_buckets(
+            bucket_by_entity(uids, x, y, row_ids=np.arange(n),
+                             num_samples=n), mesh)
+        obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=1))
+        with pytest.raises(ValueError, match="re_scoring keys"):
+            multihost_glmix_sweep(mesh, dense_batch(x, y), {"user": gb},
+                                  obj, obj, num_samples=n,
+                                  re_scoring={"users": None})
