@@ -17,6 +17,37 @@ from typing import Optional
 
 logger = logging.getLogger(__name__)
 
+def _host_tag() -> str:
+    """Short stable id of THIS machine's CPU capabilities.
+
+    XLA:CPU AOT executables bake target-machine features; loading a cache
+    written on a different host warns "+prefer-no-scatter ... not supported
+    on the host machine ... could lead to execution errors such as SIGILL"
+    (observed when the build environment migrated between rounds).  Keying
+    the cache dir by a hash of the cpuinfo flags gives each machine type its
+    own cache instead of sharing stale foreign binaries."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                # x86 writes "flags", aarch64 writes "Features"
+                if ln.startswith(("flags", "Features")):
+                    return hashlib.sha256(
+                        " ".join(sorted(ln.split()[2:])).encode()
+                    ).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+
+    # machine() is never empty ("x86_64"/"arm64"); processor() often is —
+    # hash both so hosts without a parseable cpuinfo at least split by
+    # architecture instead of silently sharing one tag
+    return hashlib.sha256(
+        f"{platform.machine()}|{platform.processor()}".encode()
+    ).hexdigest()[:10]
+
+
 def _default_dir() -> str:
     # Source checkout: repo-root .xla_cache (the package's grandparent holds
     # the repo's own files).  Installed package: user cache dir — the
@@ -26,9 +57,9 @@ def _default_dir() -> str:
     if os.path.exists(os.path.join(root, "photon_ml_tpu", "__init__.py")) \
             and not os.path.basename(root).endswith("-packages") \
             and os.access(root, os.W_OK):
-        return os.path.join(root, ".xla_cache")
+        return os.path.join(root, ".xla_cache", _host_tag())
     return os.path.join(os.path.expanduser("~"), ".cache", "photon_ml_tpu",
-                        "xla")
+                        "xla", _host_tag())
 
 
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:

@@ -207,9 +207,22 @@ def main() -> int:
     # 3. full bench (includes pallas-off / bf16 / fused-vs-host A/Bs on a
     # real accelerator).  bench.py runs its own watchdog subprocesses, so no
     # alarm preamble — just the argv path through the same runner.
+    # PHOTON_BENCH_PROFILE_DIR: the glmix_chip child additionally captures
+    # ONE untimed sweep under jax.profiler (device-side single-HBM-pass
+    # evidence), AFTER flushing its result line.  A RUN-SPECIFIC subdir so
+    # a stale trace from an earlier checklist can never masquerade as this
+    # run's evidence.
+    prof_dir = os.environ.setdefault(
+        "PHOTON_BENCH_PROFILE_DIR",
+        os.path.join(_REPO, "TPU_PROFILE",
+                     time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())))
     line3, err = _run_py([os.path.join(_REPO, "bench.py")],
                          int(os.environ.get("PHOTON_TPU_BENCH_TIMEOUT", 14400)))
     results["bench"] = {"error": err} if err else _parse_json(line3, "bench")
+    results["profile_trace"] = {
+        "dir": prof_dir,
+        "files": (sum(len(fs) for _, _, fs in os.walk(prof_dir))
+                  if os.path.isdir(prof_dir) else 0)}
     _save(results)
     print("bench:", json.dumps(results.get("bench", {}))[:400])
     print(f"checklist complete -> {_OUT}")
