@@ -1227,16 +1227,29 @@ def _subprocess_json(args, timeout, env=None):
         if out.returncode != 0:
             _log_child_failure(f"bench {args} failed (rc {out.returncode})\n"
                                f"{out.stderr[-2000:]}\n")
-        # parse the last JSON line even on a nonzero exit: a child that
-        # flushed its full result then died in POST-result work (profiler
-        # capture, teardown) should count, with the failure logged above
-        for ln in reversed(out.stdout.strip().splitlines()):
-            try:
-                return json.loads(ln)
-            except ValueError:
-                continue
+        return _last_json_dict(out.stdout)
     except subprocess.TimeoutExpired as e:
-        _log_child_failure(f"bench {args} unusable (TimeoutExpired: {e})\n")
+        # the parent timeout also salvages: a child that flushed its full
+        # result then WEDGED in post-result work (profiler capture) should
+        # count, with the failure logged
+        _log_child_failure(f"bench {args} parent-timeout (TimeoutExpired)\n")
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        return _last_json_dict(stdout)
+    return None
+
+
+def _last_json_dict(stdout: str):
+    """Last stdout line that parses to a DICT (runner results are dicts;
+    a stray library print that happens to be JSON must not reach the
+    orchestrator's .get() calls)."""
+    for ln in reversed((stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
     return None
 
 

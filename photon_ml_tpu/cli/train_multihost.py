@@ -23,11 +23,15 @@ model directory (process 0 adds the fixed effect + metadata); the standard
 loader merges the directory.
 
 Multihost v1 contract (see ``multihost_glmix_sweep``): ONE fixed + ONE
-random-effect coordinate, identity normalization, dense fixed shard;
-the random-effect shard may be dense or sparse (compact observed-column
-buckets).  Each host currently scans the full input and keeps its share —
-a per-host pre-partitioned read (the reference's partitioned-HDFS layout)
-drops in through the same ``row_ids`` contract.
+random-effect coordinate, dense fixed shard; the random-effect shard may
+be dense or sparse (compact observed-column buckets).  Shared-context
+normalization (``--normalization``) is supported on dense shards: solves
+run transformed, the published models are original-space — the same
+semantics as the single-process driver; compact buckets would need
+per-lane projected contexts and stay identity-normalized.  Each host
+currently scans the full input and keeps its share — a per-host
+pre-partitioned read (the reference's partitioned-HDFS layout) drops in
+through the same ``row_ids`` contract.
 """
 
 from __future__ import annotations
@@ -82,6 +86,13 @@ def run(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--sparse-threshold", type=int, default=100_000,
                     help="random-effect shards at least this wide read as "
                          "row-sparse and train in compact buckets")
+    ap.add_argument("--normalization", default="NONE",
+                    choices=["NONE", "SCALE_WITH_MAX_MAGNITUDE",
+                             "SCALE_WITH_STANDARD_DEVIATION",
+                             "STANDARDIZATION"],
+                    help="shared per-shard contexts from training stats; "
+                         "solves run transformed, published models are "
+                         "original-space (dense shards only)")
     ap.add_argument("--index-map-dir", default=None)
     ap.add_argument("--no-intercept", action="store_true")
     ap.add_argument("--output-dir", required=True)
@@ -180,6 +191,35 @@ def run(argv: Optional[List[str]] = None) -> int:
             "the data are SHARED by every shard (one vocabulary), so a "
             "sparse random-effect shard with a dense fixed shard needs "
             "distinct per-shard maps via --index-map-dir")
+
+    # shared normalization contexts from training stats (every host scans
+    # the same data -> identical contexts; same semantics as the
+    # single-process driver's prepareNormalizationContext analog)
+    from photon_ml_tpu.core.normalization import (NormalizationType,
+                                                  build_normalization,
+                                                  compute_feature_stats,
+                                                  no_normalization)
+
+    norm_kind = NormalizationType[args.normalization]
+    norms = {}
+    if norm_kind != NormalizationType.NONE:
+        if isinstance(data.features[re_cfg.feature_shard], SparseShard):
+            raise SystemExit(
+                "multihost --normalization needs DENSE shards (compact "
+                "buckets would need per-lane projected contexts — the "
+                "single-process driver's domain)")
+        import jax.numpy as jnp
+
+        for s in {fixed_cfg.feature_shard, re_cfg.feature_shard}:
+            stats = compute_feature_stats(
+                jnp.asarray(np.asarray(data.features[s])),
+                jnp.asarray(data.weight),
+                intercept_index=index_maps[s].intercept_index)
+            norms[s] = build_normalization(norm_kind, stats)
+    fixed_norm = norms.get(fixed_cfg.feature_shard, no_normalization())
+    re_norm = norms.get(re_cfg.feature_shard, no_normalization())
+    fixed_ii = index_maps[fixed_cfg.feature_shard].intercept_index
+    re_ii = index_maps[re_cfg.feature_shard].intercept_index
 
     # 3. fixed side: this host's row range, padded, assembled globally
     from photon_ml_tpu.core.batch import DenseBatch
@@ -318,8 +358,10 @@ def run(argv: Optional[List[str]] = None) -> int:
             logger.info("stopping after iteration %d (checkpointed)", it)
             raise SystemExit(0)
 
-    obj_f = GLMObjective(loss=loss_for_task(task), reg=fixed_cfg.reg)
-    obj_re = GLMObjective(loss=loss_for_task(task), reg=re_cfg.reg)
+    obj_f = GLMObjective(loss=loss_for_task(task), reg=fixed_cfg.reg,
+                         norm=fixed_norm)
+    obj_re = GLMObjective(loss=loss_for_task(task), reg=re_cfg.reg,
+                          norm=re_norm)
     wf, rec, _ = mh.multihost_glmix_sweep(
         mesh, fixed_batch, gb, obj_f, obj_re,
         num_iterations=args.iterations,
@@ -327,8 +369,10 @@ def run(argv: Optional[List[str]] = None) -> int:
         re_scoring=scoring, num_samples=n,
         on_iteration=on_iteration, initial=initial,
         start_iteration=start_it)
-    exported = mh.export_local_random_effects(rec, gb, mesh,
-                                              projections=padded_projs)
+    exported = mh.export_local_random_effects(
+        rec, gb, mesh, projections=padded_projs,
+        norm=None if re_norm.is_identity else re_norm,
+        intercept_index=re_ii)
     logger.info("trained: fixed[%d], %d local entities",
                 len(np.asarray(wf)), len(exported))
 
@@ -357,7 +401,9 @@ def run(argv: Optional[List[str]] = None) -> int:
     multihost_utils.sync_global_devices("model parts written")
     if pid == 0:
         fixed_model = FixedEffectModel(
-            coefficients=Coefficients(means=np.asarray(wf)),
+            coefficients=Coefficients(means=np.asarray(
+                wf if fixed_norm.is_identity
+                else fixed_norm.model_to_original_space(wf, fixed_ii))),
             feature_shard=fixed_cfg.feature_shard, task=task)
         fixed_info = save_coordinate(fixed_spec.name, fixed_model,
                                      args.output_dir, index_maps)

@@ -467,11 +467,18 @@ def multihost_glmix_sweep(
     ``start_iteration``; RE scores are recomputed from the loaded
     coefficients, so the resumed trajectory equals the uninterrupted one.
 
-    Normalization is not folded here (every objective must be
-    identity-normalized); the single-process coordinate path owns the
-    model-space maps.  Returns ``(w_fixed, re_coeffs, re_scores)`` —
-    replicated fixed coefficients, per-bucket GLOBAL [E, d] lane
-    coefficients, and the final replicated RE score vector(s)."""
+    Normalization rides the objectives (shared contexts, the reference's
+    NormalizationContextBroadcast semantics): solves run transformed,
+    every exchanged score carries eff(w) + the margin shift (margins are
+    invariant), and the returned coefficients stay in SOLVER space — the
+    caller publishes original-space via
+    ``norm.model_to_original_space`` / ``export_local_random_effects(
+    norm=...)``.  Compact buckets refuse non-identity normalization
+    (per-lane projected contexts are the single-process path's domain).
+
+    Returns ``(w_fixed, re_coeffs, re_scores)`` — replicated fixed
+    coefficients, per-bucket GLOBAL [E, d] lane coefficients, and the
+    final replicated RE score vector(s)."""
     import functools
 
     from photon_ml_tpu.opt.solve import make_solver
@@ -500,11 +507,21 @@ def multihost_glmix_sweep(
             raise ValueError(f"re_scoring keys {sorted(unknown)} not in "
                              f"re_buckets {sorted(re_b)}")
 
-    for o in [fixed_objective, *re_obj.values()]:
-        if o.norm.factors is not None or o.norm.shifts is not None:
+    # Normalization rides the objectives (the single-process shared-context
+    # semantics: solve transformed, margins invariant): the fixed margins
+    # and RE scores below carry eff(w) + the margin shift, and the CALLER
+    # publishes original-space coefficients (export_local_random_effects
+    # norm=/model_to_original_space).  Compact buckets would need PER-LANE
+    # projected contexts — refused, like the sparse feature-sharded fixed
+    # objective refuses shifts.
+    for cid, rb in re_b.items():
+        o = re_obj[cid]
+        if rb.compact and (o.norm.factors is not None
+                           or o.norm.shifts is not None):
             raise ValueError(
-                "multihost_glmix_sweep runs identity-normalized objectives; "
-                "fold normalization before the multihost path")
+                f"multihost coordinate {cid!r}: normalization with COMPACT "
+                "(observed-column) buckets needs per-lane projected "
+                "contexts — use dense buckets or identity normalization")
     optimizer = OptimizerType.LBFGS if optimizer is None else optimizer
     n_pad = int(fixed_batch.y.shape[0])
     d_fixed = int(fixed_batch.x.shape[1])
@@ -530,7 +547,19 @@ def multihost_glmix_sweep(
     zeros_n = jax.jit(lambda: jnp.zeros((n_pad,), dtype), out_shardings=rep)
 
     add_offsets = jax.jit(lambda base, s: base + s, out_shardings=row_sharded)
-    fixed_margin = jax.jit(lambda w, b: b.margins(w), out_shardings=rep)
+    fnorm = fixed_objective.norm
+    fixed_margin = jax.jit(
+        lambda w, b: b.margins(fnorm.effective_coefficients(w))
+        + fnorm.margin_shift(w), out_shardings=rep)
+
+    def _lane_margins(norm, w, x):
+        """[E, S] margins of lane models under the coordinate's shared
+        context: x·eff(w) plus each lane's own margin shift."""
+        eff = norm.effective_coefficients(w)
+        m = jnp.einsum("esd,ed->es", x, eff)
+        if norm.shifts is not None:
+            m = m - (eff @ norm.shifts)[:, None]
+        return m
     # residual bookkeeping on replicated [n_pad] vectors (the descent loop's
     # numpy adds in game/descent.py, kept on device)
     rep_other = jax.jit(lambda m, t, s: m + t - s, out_shardings=rep)
@@ -542,34 +571,42 @@ def multihost_glmix_sweep(
         safe = jnp.where(rows >= 0, rows, 0)
         return off0 + jnp.where(rows >= 0, margins[safe], 0.0)
 
-    @functools.partial(jax.jit, out_shardings=rep)
-    def re_score(ws, xs, rows_list):
-        total = jnp.zeros((n_pad,), dtype)
-        for w, x, rows in zip(ws, xs, rows_list):
-            rows = to_padded(rows)
-            margins = jnp.einsum("esd,ed->es", x, w)
-            valid = rows >= 0
-            safe = jnp.where(valid, rows, 0)
-            total = total.at[safe.ravel()].add(
-                jnp.where(valid, margins, 0.0).ravel())
-        return total
+    def _make_scorer(norm):
+        @functools.partial(jax.jit, out_shardings=rep)
+        def re_score(ws, xs, rows_list):
+            total = jnp.zeros((n_pad,), dtype)
+            for w, x, rows in zip(ws, xs, rows_list):
+                rows = to_padded(rows)
+                margins = _lane_margins(norm, w, x)
+                valid = rows >= 0
+                safe = jnp.where(valid, rows, 0)
+                total = total.at[safe.ravel()].add(
+                    jnp.where(valid, margins, 0.0).ravel())
+            return total
+        return re_score
 
-    @functools.partial(jax.jit, out_shardings=rep)
-    def re_score_passive(ws, xs, rows_list, idx_list):
-        # cross-bucket coefficient gather: scoring lanes look their entity's
-        # trained row up in the concatenated training lane arrays
-        flat = jnp.concatenate(ws, axis=0)
-        total = jnp.zeros((n_pad,), dtype)
-        for x, rows, idx in zip(xs, rows_list, idx_list):
-            rows = to_padded(rows)
-            wl = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]
-            wl = jnp.where((idx >= 0)[:, None], wl, 0.0)
-            margins = jnp.einsum("esd,ed->es", x, wl)
-            valid = rows >= 0
-            safe = jnp.where(valid, rows, 0)
-            total = total.at[safe.ravel()].add(
-                jnp.where(valid, margins, 0.0).ravel())
-        return total
+    def _make_passive_scorer(norm):
+        @functools.partial(jax.jit, out_shardings=rep)
+        def re_score_passive(ws, xs, rows_list, idx_list):
+            # cross-bucket coefficient gather: scoring lanes look their
+            # entity's trained row up in the concatenated training arrays
+            flat = jnp.concatenate(ws, axis=0)
+            total = jnp.zeros((n_pad,), dtype)
+            for x, rows, idx in zip(xs, rows_list, idx_list):
+                rows = to_padded(rows)
+                wl = flat[jnp.clip(idx, 0, flat.shape[0] - 1)]
+                wl = jnp.where((idx >= 0)[:, None], wl, 0.0)
+                margins = _lane_margins(norm, wl, x)
+                valid = rows >= 0
+                safe = jnp.where(valid, rows, 0)
+                total = total.at[safe.ravel()].add(
+                    jnp.where(valid, margins, 0.0).ravel())
+            return total
+        return re_score_passive
+
+    scorers = {cid: _make_scorer(re_obj[cid].norm) for cid in re_b}
+    passive_scorers = {cid: _make_passive_scorer(re_obj[cid].norm)
+                       for cid in re_b}
 
     vsolves = {cid: jax.jit(jax.vmap(make_solver(re_obj[cid], optimizer,
                                                  config)))
@@ -588,12 +625,12 @@ def multihost_glmix_sweep(
     def _score_of(cid, coeffs):
         if cid in re_sc and re_sc[cid] is not None:
             gs, coeff_idx = re_sc[cid]
-            return re_score_passive(
+            return passive_scorers[cid](
                 tuple(coeffs), tuple(b.x for b in gs.buckets),
                 tuple(b.rows for b in gs.buckets), tuple(coeff_idx))
         rb = re_b[cid]
-        return re_score(tuple(coeffs), tuple(b.x for b in rb.buckets),
-                        tuple(b.rows for b in rb.buckets))
+        return scorers[cid](tuple(coeffs), tuple(b.x for b in rb.buckets),
+                            tuple(b.rows for b in rb.buckets))
 
     if initial is not None:
         w0_host, re_blocks = initial
@@ -668,7 +705,8 @@ def host_lane_blocks(re_coeffs) -> "list[np.ndarray]":
 
 
 def export_local_random_effects(re_coeffs, re_buckets, mesh: Mesh,
-                                projections=None) -> Dict[int, np.ndarray]:
+                                projections=None, norm=None,
+                                intercept_index=None) -> Dict[int, np.ndarray]:
     """THIS host's entities' coefficient vectors from globally-sharded lane
     arrays — each host publishes its own entity range (the reference writes
     the RandomEffectModel RDD partition-wise the same way).
@@ -676,12 +714,26 @@ def export_local_random_effects(re_coeffs, re_buckets, mesh: Mesh,
     ``projections``: the padded host-local BucketProjection list from
     ``global_entity_buckets(..., projections=...)`` — compact lanes
     back-project through THIS host's observed-column maps to full
-    vocabulary width before export."""
+    vocabulary width before export.
+
+    ``norm``/``intercept_index``: the coordinate's shared
+    NormalizationContext — solver-space lanes map to ORIGINAL-space
+    coefficients per lane (NormalizationContext.scala:73-99), like the
+    single-process publish path."""
     n_proc = jax.process_count()
     pid = jax.process_index()
     out: Dict[int, np.ndarray] = {}
     blocks = host_lane_blocks(re_coeffs)
     for bi, (arr, block) in enumerate(zip(re_coeffs, blocks)):
+        if norm is not None and not norm.is_identity:
+            if norm.shifts is not None and intercept_index is None:
+                raise ValueError("shift normalization needs "
+                                 "intercept_index to publish")
+            # the ONE definition of the coefficient-space map
+            # (NormalizationContext.scala:73-99), vmapped over lanes
+            block = np.asarray(jax.vmap(
+                lambda r: norm.model_to_original_space(r, intercept_index)
+            )(jnp.asarray(block))).astype(block.dtype)
         if projections is not None:
             block = projections[bi].back_project(block)
         per_host = arr.shape[0] // n_proc

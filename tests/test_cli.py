@@ -1154,3 +1154,84 @@ def test_train_multihost_checkpoint_resume(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(ra.w_stack[s]),
             np.asarray(rb.w_stack[rb.slot_of[e]]))
+
+
+def test_train_multihost_normalization(tmp_path):
+    """--normalization STANDARDIZATION on the multihost driver: shared
+    contexts from training stats, transformed solves, original-space
+    publish — matches the single-process normalized train driver."""
+    import socket
+    import subprocess
+    import sys
+
+    import photon_ml_tpu
+
+    data_path = str(tmp_path / "train.avro")
+    _write_fixture(data_path, n=500, seed=17)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out_mh = str(tmp_path / "out_mh")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    repo_root = os.path.dirname(os.path.dirname(photon_ml_tpu.__file__))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p)
+
+    def cmd(pid):
+        return [sys.executable, "-m", "photon_ml_tpu.cli.train_multihost",
+                "--train-data", data_path,
+                "--feature-shards", "global,user", "--id-tags", "userId",
+                "--normalization", "STANDARDIZATION",
+                "--fixed", "name=fixed,feature.shard=global,"
+                           "reg.weights=0.1,max.iter=80,tolerance=1e-9",
+                "--random", "name=user,random.effect.type=userId,"
+                            "feature.shard=user,reg.weights=1,"
+                            "max.iter=80,tolerance=1e-9",
+                "--coordinator-address", f"127.0.0.1:{port}",
+                "--num-processes", "2", "--process-id", str(pid),
+                "--expected-processes", "2", "--iterations", "2",
+                "--output-dir", out_mh, "--seed", "3"]
+
+    procs = [subprocess.Popen(cmd(pid), env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for pid in range(2)]
+    for p in procs:
+        _, se = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+
+    from photon_ml_tpu.cli import train as train_cli
+
+    out_sp = str(tmp_path / "out_sp")
+    rc = train_cli.run([
+        "--train-data", data_path, "--feature-shards", "global,user",
+        "--coordinate", "name=fixed,feature.shard=global,optimizer=LBFGS,"
+                        "max.iter=80,tolerance=1e-9,reg.weights=0.1",
+        "--coordinate", "name=user,random.effect.type=userId,"
+                        "feature.shard=user,max.iter=80,tolerance=1e-9,"
+                        "reg.weights=1",
+        "--id-tags", "userId", "--coordinate-descent-iterations", "2",
+        "--normalization", "STANDARDIZATION",
+        "--output-dir", out_sp, "--seed", "3"])
+    assert rc == 0
+
+    from photon_ml_tpu.data.index_map import load_index
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.storage.model_io import load_game_model
+
+    imaps = {"global": load_index(os.path.join(out_mh, "global.idx")),
+             "user": load_index(os.path.join(out_mh, "user.idx"))}
+    eidx = {"userId": EntityIndex.load(
+        os.path.join(out_mh, "userId.entities.json"))}
+    a, _ = load_game_model(out_mh, imaps, eidx)
+    b, _ = load_game_model(os.path.join(out_sp, "best"), imaps, eidx)
+    np.testing.assert_allclose(
+        np.asarray(a["fixed"].coefficients.means),
+        np.asarray(b["fixed"].coefficients.means), atol=2e-3, rtol=1e-2)
+    ra, rb = a["user"], b["user"]
+    assert set(ra.slot_of) == set(rb.slot_of)
+    for e, sl in ra.slot_of.items():
+        np.testing.assert_allclose(
+            np.asarray(ra.w_stack[sl]),
+            np.asarray(rb.w_stack[rb.slot_of[e]]), atol=2e-3, rtol=1e-2)

@@ -212,10 +212,14 @@ def main() -> int:
     # evidence), AFTER flushing its result line.  A RUN-SPECIFIC subdir so
     # a stale trace from an earlier checklist can never masquerade as this
     # run's evidence.
-    prof_dir = os.environ.setdefault(
-        "PHOTON_BENCH_PROFILE_DIR",
-        os.path.join(_REPO, "TPU_PROFILE",
-                     time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())))
+    # ALWAYS a run-specific timestamp subdir — even under an inherited
+    # PHOTON_BENCH_PROFILE_DIR — so stale traces can never be counted as
+    # this run's evidence
+    prof_base = os.environ.get("PHOTON_BENCH_PROFILE_DIR",
+                               os.path.join(_REPO, "TPU_PROFILE"))
+    prof_dir = os.path.join(prof_base,
+                            time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()))
+    os.environ["PHOTON_BENCH_PROFILE_DIR"] = prof_dir
     line3, err = _run_py([os.path.join(_REPO, "bench.py")],
                          int(os.environ.get("PHOTON_TPU_BENCH_TIMEOUT", 14400)))
     results["bench"] = {"error": err} if err else _parse_json(line3, "bench")
