@@ -1232,11 +1232,13 @@ def _subprocess_json(args, timeout, env=None):
         # the parent timeout also salvages: a child that flushed its full
         # result then WEDGED in post-result work (profiler capture) should
         # count, with the failure logged
-        _log_child_failure(f"bench {args} parent-timeout (TimeoutExpired)\n")
+        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+        _log_child_failure(f"bench {args} parent-timeout (TimeoutExpired)\n"
+                           f"{stderr[-2000:]}\n")
         stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
             else (e.stdout or "")
         return _last_json_dict(stdout)
-    return None
 
 
 def _last_json_dict(stdout: str):

@@ -92,6 +92,15 @@ _HIGHEST = jax.lax.Precision.HIGHEST  # default MXU f32 precision is a single
 # bf16 pass (~1e-3 rel err); HIGHEST uses the multi-pass f32 decomposition.
 
 
+def _mxu_precision(dtype):
+    """HIGHEST only makes sense for >=f32 operands (the multi-pass f32
+    decomposition).  Sub-f32 storage (bf16) is already the MXU's native input
+    width — a single DEFAULT pass is exact for those operands, and Mosaic
+    rejects an fp32-precision contract on bf16 vregs outright ("Bad lhs
+    type", seen on a real v5e, TPU_CHECKLIST round 5)."""
+    return _HIGHEST if jnp.dtype(dtype).itemsize >= 4 else jax.lax.Precision.DEFAULT
+
+
 def _row_margins(w, x, acc):
     """(D,C)^T @ (BN,D)^T -> (C, BN): margins as ROWS.
 
@@ -99,19 +108,22 @@ def _row_margins(w, x, acc):
     elementwise work uses all 128 VPU lanes (a (BN,1) column layout wastes
     127/128 of them) and the MXU emits a full-width row."""
     return jax.lax.dot_general(w, x, (((0,), (1,)), ((), ())),
-                               preferred_element_type=acc, precision=_HIGHEST)
+                               preferred_element_type=acc,
+                               precision=_mxu_precision(x.dtype))
 
 
 def _rowsum(row, ones, acc):
     """(1,BN)·(1,BN) -> (1,1) lane-contraction on the MXU."""
     return jax.lax.dot_general(row, ones, (((1,), (1,)), ((), ())),
-                               preferred_element_type=acc, precision=_HIGHEST)
+                               preferred_element_type=acc,
+                               precision=_mxu_precision(row.dtype))
 
 
 def _row_xt(row, x, acc):
     """(1,BN) @ (BN,D) -> (1,D) contraction on the MXU."""
     return jax.lax.dot_general(row, x, (((1,), (0,)), ((), ())),
-                               preferred_element_type=acc, precision=_HIGHEST)
+                               preferred_element_type=acc,
+                               precision=_mxu_precision(x.dtype))
 
 
 _NACC = 32  # accumulator rows: grid step i adds into row i % _NACC, cutting

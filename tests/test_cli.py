@@ -990,23 +990,14 @@ def test_sparse_shard_factor_normalization(tmp_path):
     assert summary["validation"]["auc"] > 0.6
 
 
-def test_train_multihost_cli(tmp_path):
-    """2-process `train_multihost` end-to-end: both processes train the
-    same GLMix under jax.distributed, write the executor-partitioned model
-    layout (part-{pid}.avro per host + fixed/metadata from process 0), and
-    the STANDARD loader merges the directory into a model matching the
-    single-process train driver to solver tolerance."""
-    import json
+def _run_multihost_train(data_path, output_dir, *, max_iter=80, extra=()):
+    """Shared 2-process `train_multihost` launch scaffolding: CPU env with a
+    2-device virtual mesh, fresh coordinator port, one worker process per
+    pid, and a zero-exit assertion carrying each worker's stderr tail."""
     import socket
     import subprocess
     import sys
 
-    data_path = str(tmp_path / "train.avro")
-    _write_fixture(data_path, n=500, seed=11)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    out_mh = str(tmp_path / "out_mh")
     import photon_ml_tpu
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
@@ -1015,27 +1006,43 @@ def test_train_multihost_cli(tmp_path):
     repo_root = os.path.dirname(os.path.dirname(photon_ml_tpu.__file__))
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo_root, env.get("PYTHONPATH")) if p)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
 
     def cmd(pid):
         return [sys.executable, "-m", "photon_ml_tpu.cli.train_multihost",
                 "--train-data", data_path,
                 "--feature-shards", "global,user", "--id-tags", "userId",
                 "--fixed", "name=fixed,feature.shard=global,"
-                           "reg.weights=0.1,max.iter=80,tolerance=1e-9",
+                           f"reg.weights=0.1,max.iter={max_iter},"
+                           "tolerance=1e-9",
                 "--random", "name=user,random.effect.type=userId,"
                             "feature.shard=user,reg.weights=1,"
-                            "max.iter=80,tolerance=1e-9",
+                            f"max.iter={max_iter},tolerance=1e-9",
                 "--coordinator-address", f"127.0.0.1:{port}",
                 "--num-processes", "2", "--process-id", str(pid),
                 "--expected-processes", "2", "--iterations", "2",
-                "--output-dir", out_mh, "--seed", "3"]
+                "--output-dir", output_dir, "--seed", "3"] + list(extra)
 
     procs = [subprocess.Popen(cmd(pid), env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True)
              for pid in range(2)]
-    outs = [p.communicate(timeout=420) for p in procs]
-    for p, (_, se) in zip(procs, outs):
+    for p in procs:
+        _, se = p.communicate(timeout=420)
         assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+
+
+def test_train_multihost_cli(tmp_path):
+    """2-process `train_multihost` end-to-end: both processes train the
+    same GLMix under jax.distributed, write the executor-partitioned model
+    layout (part-{pid}.avro per host + fixed/metadata from process 0), and
+    the STANDARD loader merges the directory into a model matching the
+    single-process train driver to solver tolerance."""
+    data_path = str(tmp_path / "train.avro")
+    _write_fixture(data_path, n=500, seed=11)
+    out_mh = str(tmp_path / "out_mh")
+    _run_multihost_train(data_path, out_mh)
     # the executor-partitioned layout: one part per process
     parts = sorted(os.listdir(os.path.join(out_mh, "random-effect", "user")))
     assert parts == ["part-00000.avro", "part-00001.avro"]
@@ -1081,50 +1088,12 @@ def test_train_multihost_checkpoint_resume(tmp_path):
     per-host checkpoint + cursor; rerunning the SAME command resumes at the
     cursor (scores recomputed from the loaded lane blocks) and the final
     model is BITWISE the uninterrupted run's."""
-    import socket
-    import subprocess
-    import sys
-
-    import photon_ml_tpu
-
     data_path = str(tmp_path / "train.avro")
     _write_fixture(data_path, n=400, seed=13)
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    env.pop("PYTEST_CURRENT_TEST", None)
-    repo_root = os.path.dirname(os.path.dirname(photon_ml_tpu.__file__))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p)
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     def run(outdir, extra):
-        port = free_port()
-
-        def cmd(pid):
-            return [sys.executable, "-m",
-                    "photon_ml_tpu.cli.train_multihost",
-                    "--train-data", data_path,
-                    "--feature-shards", "global,user", "--id-tags", "userId",
-                    "--fixed", "name=fixed,feature.shard=global,"
-                               "reg.weights=0.1,max.iter=60,tolerance=1e-9",
-                    "--random", "name=user,random.effect.type=userId,"
-                                "feature.shard=user,reg.weights=1,"
-                                "max.iter=60,tolerance=1e-9",
-                    "--coordinator-address", f"127.0.0.1:{port}",
-                    "--num-processes", "2", "--process-id", str(pid),
-                    "--expected-processes", "2", "--iterations", "2",
-                    "--output-dir", str(tmp_path / outdir), "--seed", "3",
-                    ] + extra
-        procs = [subprocess.Popen(cmd(pid), env=env, stdout=subprocess.PIPE,
-                                  stderr=subprocess.PIPE, text=True)
-                 for pid in range(2)]
-        for p in procs:
-            _, se = p.communicate(timeout=420)
-            assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+        _run_multihost_train(data_path, str(tmp_path / outdir),
+                             max_iter=60, extra=extra)
 
     ck = str(tmp_path / "ck")
     run("out_ck", ["--checkpoint-dir", ck, "--stop-after-iteration", "0"])
@@ -1160,46 +1129,11 @@ def test_train_multihost_normalization(tmp_path):
     """--normalization STANDARDIZATION on the multihost driver: shared
     contexts from training stats, transformed solves, original-space
     publish — matches the single-process normalized train driver."""
-    import socket
-    import subprocess
-    import sys
-
-    import photon_ml_tpu
-
     data_path = str(tmp_path / "train.avro")
     _write_fixture(data_path, n=500, seed=17)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     out_mh = str(tmp_path / "out_mh")
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    env.pop("PYTEST_CURRENT_TEST", None)
-    repo_root = os.path.dirname(os.path.dirname(photon_ml_tpu.__file__))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p)
-
-    def cmd(pid):
-        return [sys.executable, "-m", "photon_ml_tpu.cli.train_multihost",
-                "--train-data", data_path,
-                "--feature-shards", "global,user", "--id-tags", "userId",
-                "--normalization", "STANDARDIZATION",
-                "--fixed", "name=fixed,feature.shard=global,"
-                           "reg.weights=0.1,max.iter=80,tolerance=1e-9",
-                "--random", "name=user,random.effect.type=userId,"
-                            "feature.shard=user,reg.weights=1,"
-                            "max.iter=80,tolerance=1e-9",
-                "--coordinator-address", f"127.0.0.1:{port}",
-                "--num-processes", "2", "--process-id", str(pid),
-                "--expected-processes", "2", "--iterations", "2",
-                "--output-dir", out_mh, "--seed", "3"]
-
-    procs = [subprocess.Popen(cmd(pid), env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, text=True)
-             for pid in range(2)]
-    for p in procs:
-        _, se = p.communicate(timeout=420)
-        assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
+    _run_multihost_train(data_path, out_mh,
+                         extra=["--normalization", "STANDARDIZATION"])
 
     from photon_ml_tpu.cli import train as train_cli
 
