@@ -783,13 +783,21 @@ class RandomEffectCoordinate(Coordinate):
         # full-sample arrays are the random-effect coordinate's giant
         # host->device transfer — bounded-RPC chunked like the fixed effect's
         from photon_ml_tpu.utils.transfer import chunked_device_put
+        self._x_full_is_t = False
         if self._sparse:
             # full-sample scoring stays sparse: [n, k] gather arrays, never
             # an [n, d_full] densified design (score_samples_sparse)
             self._x_idx_dev = chunked_device_put(shard_data.indices, np.int32)
             self._x_val_dev = chunked_device_put(shard_data.values, dtype)
         else:
-            self._x_full = chunked_device_put(x)
+            # Narrow shards upload TRANSPOSED [d, n]: TPU tiling pads the
+            # minor axis to 128 lanes, so a [n, d<=32] array (and every
+            # scoring gather from it) occupies 128/d x its logical HBM bytes
+            # — 32x at glmix_chip's d=4, an OOM at 8.39M samples
+            # (score_samples_t in parallel/bucketing.py).
+            from photon_ml_tpu.parallel.bucketing import NARROW_SCORE_DIM_MAX
+            self._x_full_is_t = x.shape[1] <= NARROW_SCORE_DIM_MAX
+            self._x_full = chunked_device_put(x.T if self._x_full_is_t else x)
 
         # Optional per-entity feature projection (reference
         # RandomEffectCoordinateInProjectedSpace.scala:149): solve each bucket
@@ -1380,8 +1388,7 @@ class RandomEffectCoordinate(Coordinate):
 
     def carry_through_scores(self, init: Optional[RandomEffectModel]
                              ) -> Optional[np.ndarray]:
-        from photon_ml_tpu.parallel.bucketing import (score_samples,
-                                                      score_samples_sparse)
+        from photon_ml_tpu.parallel.bucketing import score_samples_sparse
 
         if init is None:
             return None
@@ -1399,12 +1406,11 @@ class RandomEffectCoordinate(Coordinate):
             s = score_samples_sparse(w, jnp.asarray(slots),
                                      self._x_idx_dev, self._x_val_dev)
         else:
-            s = score_samples(w, jnp.asarray(slots), self._x_full)
+            s = self._score_dense_full(w, jnp.asarray(slots))
         return np.asarray(s)[: self._n]
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
-        from photon_ml_tpu.parallel.bucketing import (score_samples,
-                                                      score_samples_sparse)
+        from photon_ml_tpu.parallel.bucketing import score_samples_sparse
 
         w = jnp.asarray(np.asarray(model.w_stack, self._dtype))
         if model.slot_of == self._slot_of:
@@ -1417,7 +1423,19 @@ class RandomEffectCoordinate(Coordinate):
         if self._sparse:
             return np.asarray(score_samples_sparse(
                 w, slots, self._x_idx_dev, self._x_val_dev))[: self._n]
-        return np.asarray(score_samples(w, slots, self._x_full))[: self._n]
+        return np.asarray(self._score_dense_full(w, slots))[: self._n]
+
+    def _score_dense_full(self, w_stack: Array, slots: Array,
+                          x_full: Optional[Array] = None) -> Array:
+        """Full-sample dense scoring in whichever layout ``_x_full`` uses:
+        [n, d], or [d, n] for narrow shards (bucketing.score_samples_t)."""
+        from photon_ml_tpu.parallel.bucketing import (score_samples,
+                                                      score_samples_t)
+
+        x = self._x_full if x_full is None else x_full
+        if self._x_full_is_t:
+            return score_samples_t(w_stack, slots, x)
+        return score_samples(w_stack, slots, x)
 
     # --- traceable-step interface (game/fused.py) ---
     # State = tuple of per-bucket lane coefficient arrays [(lanes, d), ...].
@@ -1457,8 +1475,7 @@ class RandomEffectCoordinate(Coordinate):
                      key=None, data=None) -> Tuple[Tuple[Array, ...], Array]:
         # ``key`` unused: random effects have no per-update stochastic work
         # (down-sampling is a fixed-effect-only config, as in the reference).
-        from photon_ml_tpu.parallel.bucketing import (score_samples,
-                                                      score_samples_sparse)
+        from photon_ml_tpu.parallel.bucketing import score_samples_sparse
 
         if data is None:
             data = self.sweep_data()
@@ -1476,8 +1493,8 @@ class RandomEffectCoordinate(Coordinate):
             score = score_samples_sparse(
                 w_stack, data["slots"], data["x_idx"], data["x_val"])[: self._n]
         else:
-            score = score_samples(w_stack, data["slots"],
-                                  data["x_full"])[: self._n]
+            score = self._score_dense_full(w_stack, data["slots"],
+                                           data["x_full"])[: self._n]
         return tuple(new_lanes), score
 
     def trace_publish(self, state: Tuple[Array, ...], data=None) -> Array:

@@ -484,6 +484,29 @@ def score_samples(w_stack: Array, slots: Array, x: Array) -> Array:
     return jnp.where(slots >= 0, margins, 0.0)
 
 
+NARROW_SCORE_DIM_MAX = 32  # below this, [d, n] layout beats the lane pad
+
+
+def score_samples_t(w_stack: Array, slots: Array, x_t: Array) -> Array:
+    """``score_samples`` for a TRANSPOSED [d, n] full-sample array.
+
+    TPU tiling pads an array's minor axis to 128 lanes, so a narrow [n, d]
+    design (random-effect shards are typically d<=16 wide) occupies 128/d x
+    its logical bytes in HBM and so does every [n, d] gather from it — 32x
+    at d=4, which turned glmix_chip's 8.39M-sample scoring into 2 x 4GB of
+    HLO temp and OOMed a 16GB v5e (bench round 5).  Samples-on-lanes layout
+    keeps every large intermediate 1-D over n: d static gathers of [E]
+    coefficient columns, and no padded [n, d] array ever exists.
+    """
+    safe = jnp.where(slots >= 0, slots, 0)
+    w_t = w_stack.T  # [d, E]: entities on lanes, tiny either way
+    acc = jnp.zeros(x_t.shape[1],
+                    jnp.promote_types(x_t.dtype, w_stack.dtype))
+    for j in range(x_t.shape[0]):  # d is static and small by contract
+        acc = acc + x_t[j] * w_t[j][safe]
+    return jnp.where(slots >= 0, acc, 0.0)
+
+
 def score_samples_sparse(w_stack: Array, slots: Array, indices: Array,
                          values: Array) -> Array:
     """Raw per-sample scores for ROW-SPARSE features:

@@ -50,10 +50,11 @@ def _min_bytes() -> int:
 _UPDATE = jax.jit(lax.dynamic_update_slice, donate_argnums=0)
 
 
-def _update_rows(out: jax.Array, part: jax.Array, lo: int) -> jax.Array:
-    """Donated row-slice write: reuses ``out``'s buffer, so assembling N
-    chunks never holds more than output + one chunk on device."""
-    start = (lo,) + (0,) * (out.ndim - 1)
+def _update_at(out: jax.Array, part: jax.Array, lo: int,
+               axis: int) -> jax.Array:
+    """Donated slice write along ``axis``: reuses ``out``'s buffer, so
+    assembling N chunks never holds more than output + one chunk on device."""
+    start = tuple(lo if a == axis else 0 for a in range(out.ndim))
     return _UPDATE(out, part, start)
 
 
@@ -75,18 +76,25 @@ def chunked_device_put(arr: np.ndarray, dtype=None,
         return arr if arr.dtype == want else arr.astype(want)
     arr = np.asarray(arr, dtype)
     min_bytes = _min_bytes()
+    # chunk along the LARGEST axis: a transposed narrow array ([d, n] —
+    # score_samples_t's samples-on-lanes layout) has a tiny leading axis,
+    # and leading-axis-only chunking would silently fall back to the one
+    # giant RPC this helper exists to prevent
+    axis = int(np.argmax(arr.shape)) if arr.ndim else 0
     if min_bytes <= 0 or arr.nbytes <= min_bytes or arr.ndim == 0 or \
-            arr.shape[0] <= 1:
+            arr.shape[axis] <= 1:
         return jnp.asarray(arr)
-    row_bytes = max(1, arr.nbytes // arr.shape[0])
+    row_bytes = max(1, arr.nbytes // arr.shape[axis])
     rows = max(1, chunk_bytes // row_bytes)
     t0 = time.perf_counter()
     out = jnp.zeros(arr.shape, arr.dtype)
     n_chunks = 0
-    for lo in range(0, arr.shape[0], rows):
-        part = jnp.asarray(arr[lo:lo + rows])
+    for lo in range(0, arr.shape[axis], rows):
+        sel = tuple(slice(lo, lo + rows) if a == axis else slice(None)
+                    for a in range(arr.ndim))
+        part = jnp.asarray(arr[sel])
         part.block_until_ready()
-        out = _update_rows(out, part, lo)
+        out = _update_at(out, part, lo, axis)
         n_chunks += 1
     out.block_until_ready()
     dt = time.perf_counter() - t0

@@ -1028,8 +1028,18 @@ def _run_multihost_train(data_path, output_dir, *, max_iter=80, extra=()):
     procs = [subprocess.Popen(cmd(pid), env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True)
              for pid in range(2)]
-    for p in procs:
-        _, se = p.communicate(timeout=420)
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=420))
+    finally:
+        # drain BOTH before asserting: a worker-0 failure must not leave
+        # worker-1 blocked on the dead coordinator as a leaked subprocess
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for p, (_, se) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{se[-3000:]}"
 
 

@@ -189,6 +189,32 @@ class TestChunkedDevicePut:
         out16 = chunked_device_put(a, "bfloat16", chunk_bytes=4096)
         assert str(out16.dtype) == "bfloat16"
 
+    def test_chunks_along_largest_axis(self, monkeypatch):
+        """A transposed narrow array ([d, n] — score_samples_t layout) has a
+        tiny leading axis; chunking must slice the LARGEST axis or the
+        upload degenerates to the one giant RPC the helper exists to
+        prevent."""
+        import numpy as np
+
+        from photon_ml_tpu.utils import transfer
+
+        monkeypatch.setenv("PHOTON_CHUNKED_PUT_MIN_MB", str(1 / 1024))
+        calls = []
+        real = transfer.jnp.asarray
+
+        def counting(a, *args, **kw):
+            calls.append(np.shape(a))
+            return real(a, *args, **kw)
+
+        monkeypatch.setattr(transfer, "jnp",
+                            type("J", (), {"asarray": staticmethod(counting),
+                                           "zeros": transfer.jnp.zeros}))
+        a = np.arange(2 * 5000, dtype=np.float32).reshape(2, 5000)
+        out = np.asarray(transfer.chunked_device_put(a.T.copy().T,
+                                                     chunk_bytes=4096))
+        np.testing.assert_array_equal(out, a)
+        assert len(calls) > 1 and all(s[0] == 2 for s in calls)
+
     def test_small_and_disabled_take_direct_path(self, monkeypatch):
         """Byte-identity can't distinguish the paths, so count the transfer
         calls: the direct path is exactly ONE jnp.asarray of the whole
