@@ -1334,7 +1334,13 @@ def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
 
 
 def probe_platform() -> str:
-    """Fast backend probe in a subprocess; 'cpu' when the device is dead."""
+    """Fast backend probe in a subprocess; 'cpu' when the device is dead.
+
+    PHOTON_BENCH_FORCE_PLATFORM skips the probe entirely — e.g. a CPU-floor
+    gate run on a host whose accelerator is alive (the probe would win)."""
+    forced = os.environ.get("PHOTON_BENCH_FORCE_PLATFORM")
+    if forced:
+        return forced
     to = int(os.environ.get("PHOTON_BENCH_PROBE_TIMEOUT", 120))
     got = _subprocess_json(["--probe"], timeout=to)
     if got and got.get("platform") and got["platform"] != "cpu":

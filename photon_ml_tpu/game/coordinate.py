@@ -1059,6 +1059,53 @@ class RandomEffectCoordinate(Coordinate):
 
         self._vsolve = jax.jit(_vsolve)
 
+        # Narrow dense lanes swap in the structure-of-arrays Newton solver:
+        # the vmapped path's [lanes, d] / [lanes, m, d] solver state pads
+        # its trailing axis to 128 TPU lanes (32x HBM at d=4 — profiled as
+        # 63% of the glmix_chip sweep), while the [d, lanes] Newton state
+        # pads at most 2x and converges in a fraction of the iterations.
+        # Same strictly convex objective, same convergence contract, same
+        # optimum to solver tolerance (opt/newton_soa.py; parity-tested).
+        # The bucket device arrays keep their [lanes, ...] layout — the
+        # transpose below reads them once per solve call, not per solver
+        # iteration — so the variance path and bucket plumbing are
+        # untouched.
+        from photon_ml_tpu.opt.newton_soa import (soa_eligible,
+                                                  solve_newton_soa)
+
+        # The swap wins where the vmapped path's 128-lane padding waste
+        # dominates (tiny d, modest caps, many lanes); at larger d/cap the
+        # Hessian assembly (d^2/2 weighted column products over the cap)
+        # outweighs it.  Measured on a real v5e (BENCH artifacts, round 5):
+        # glmix_chip (d=4, cap 32, 131k lanes) 2.7x FASTER; glmix2 (d=16,
+        # cap 256, 2k lanes) 1.5x SLOWER.  cap*d^2/2 <= 1280 keeps the
+        # winning regime: per-iteration Hessian traffic at or below the
+        # vmapped path's padded-state traffic (128 lanes x m=10 history).
+        max_cap = max((b.x.shape[1] for b in self.buckets.buckets),
+                      default=0)
+        self._use_soa = (
+            soa_eligible(self.dim, objective.loss.name)
+            and max_cap * self.dim * self.dim <= 2 * 1280
+            and not self._sparse and self._proj is None
+            and self._norm is None
+            and box is None and self._box_lanes is None
+            and not self.config.constraints
+            and self.config.reg.l1 == 0.0
+            and self.config.optimizer in (OptimizerType.LBFGS,
+                                          OptimizerType.TRON))
+        if self._use_soa:
+            solver_cfg = self.config.solver
+
+            def _vsolve_soa(w0, x_b, y_b, off_b, wt_b, reg):
+                res = solve_newton_soa(
+                    objective.loss, jnp.transpose(w0),
+                    jnp.transpose(x_b, (1, 2, 0)), jnp.transpose(y_b),
+                    jnp.transpose(off_b), jnp.transpose(wt_b), reg.l2,
+                    solver_cfg)
+                return res.replace(w=jnp.transpose(res.w))
+
+            self._vsolve = jax.jit(_vsolve_soa)
+
         kind = self.config.variance
         # BOTH variance kinds are EXACT under observed-column compaction
         # (sparse shards / INDEX_MAP): an unobserved feature's column is

@@ -375,3 +375,128 @@ def test_f32_plateau_exits_without_thrashing():
     # NOTE deliberately no optimum assertion: at this offset the WHOLE
     # remaining descent (<= 2.0) sits below one ulp of f (8.0) — the
     # objective cannot resolve it, and stopping promptly is the point
+
+
+class TestNewtonSoa:
+    """The narrow-lane structure-of-arrays Newton solver (opt/newton_soa.py)
+    must reach the SAME optimum as the vmapped generic path — it replaces
+    it on the flagship GLMix random-effect shapes (dense, d<=16, smooth
+    l2), so parity here is what licenses the swap."""
+
+    def _bucket(self, rng, L=7, cap=12, d=5, loss_name="logistic"):
+        import numpy as np
+
+        x = rng.normal(size=(L, cap, d)).astype(np.float64)
+        off = (rng.normal(size=(L, cap)) * 0.2).astype(np.float64)
+        wt = (rng.random(size=(L, cap)) + 0.5).astype(np.float64)
+        wt[:, cap - 3:] = 0.0          # padded rows
+        x[:, cap - 3:, :] = 0.0
+        off[:, cap - 3:] = 0.0
+        wt[L - 1] = 0.0                # an entirely-padded lane
+        x[L - 1] = 0.0
+        logits = np.einsum("lcd,d->lc", x, rng.normal(size=d))
+        if loss_name == "poisson":
+            y = rng.poisson(np.exp(np.clip(logits * 0.3, -3, 3)))
+        elif loss_name == "squared":
+            y = logits + rng.normal(size=logits.shape) * 0.1
+        else:
+            y = (rng.random(size=logits.shape) < 1 / (1 + np.exp(-logits)))
+        y = np.where(wt > 0, y, 0.0).astype(np.float64)
+        l2 = np.where(np.arange(L) % 2 == 0, 0.5, 2.0).astype(np.float64)
+        return x, y, off, wt, l2
+
+    @pytest.mark.parametrize("loss_name", ["logistic", "squared", "poisson"])
+    def test_matches_vmapped_lbfgs(self, rng, loss_name):
+        import numpy as np
+
+        from photon_ml_tpu.core.batch import DenseBatch
+        from photon_ml_tpu.core.losses import loss_by_name
+        from photon_ml_tpu.core.objective import GLMObjective
+        from photon_ml_tpu.core.regularization import Regularization
+        from photon_ml_tpu.opt.newton_soa import solve_newton_soa
+        from photon_ml_tpu.opt.solve import make_solver
+
+        x, y, off, wt, l2 = self._bucket(rng, loss_name=loss_name)
+        L, cap, d = x.shape
+        loss = loss_by_name(loss_name)
+        cfg = SolverConfig(max_iters=200, tolerance=1e-10)
+
+        solve = make_solver(GLMObjective(loss=loss), config=cfg)
+
+        def one(lam, xx, yy, oo, ww):
+            return solve(jnp.zeros(d, jnp.float64),
+                         DenseBatch(x=xx, y=yy, offset=oo, weight=ww),
+                         objective=GLMObjective(
+                             loss=loss, reg=Regularization(l2=lam)))
+
+        res_v = jax.vmap(one)(jnp.asarray(l2), jnp.asarray(x),
+                              jnp.asarray(y), jnp.asarray(off),
+                              jnp.asarray(wt))
+
+        res_s = solve_newton_soa(
+            loss, jnp.zeros((d, L), jnp.float64),
+            jnp.asarray(x.transpose(1, 2, 0)), jnp.asarray(y.T),
+            jnp.asarray(off.T), jnp.asarray(wt.T), jnp.asarray(l2), cfg)
+
+        # same optimum to SOLVER tolerance: the SoA side lands at machine-
+        # precision gradients (verified vs scipy in development); the vmapped
+        # L-BFGS side may exit a few ulps earlier via its value-plateau
+        # check, so the band is solver-scale, not machine-scale
+        np.testing.assert_allclose(np.asarray(res_s.w.T),
+                                   np.asarray(res_v.w),
+                                   rtol=1e-3, atol=2e-4)
+        # the weightless lane's optimum is exactly 0 under pure l2
+        np.testing.assert_allclose(np.asarray(res_s.w.T)[L - 1], 0.0,
+                                   atol=1e-12)
+        assert int(jnp.max(res_s.iterations)) <= 25  # Newton, not LBFGS
+
+    def test_cholesky_solve_matches_numpy(self, rng):
+        import numpy as np
+
+        from photon_ml_tpu.opt.newton_soa import _cholesky_solve_soa
+
+        L, d = 11, 6
+        a = rng.normal(size=(L, d, d))
+        H = np.einsum("lij,lkj->lik", a, a) + np.eye(d) * 0.1
+        g = rng.normal(size=(L, d))
+        hh = [[jnp.asarray(H[:, i, j]) for j in range(d)] for i in range(d)]
+        x = _cholesky_solve_soa(hh, jnp.asarray(g.T),
+                                jnp.asarray(1e-300))
+        ref = np.stack([np.linalg.solve(H[i], g[i]) for i in range(L)])
+        np.testing.assert_allclose(np.asarray(x.T), ref, rtol=1e-8,
+                                   atol=1e-10)
+
+    def test_line_search_failure_keeps_iterate(self):
+        """A non-finite Newton step (Hessian overflow -> NaN Cholesky) must
+        not poison the lane: the fully rejected line search KEEPS the
+        iterate (the pre-fix code computed w - 0*NaN = NaN) and reports
+        OBJECTIVE_NOT_IMPROVING like the generic solvers, while healthy
+        lanes in the same bucket still solve."""
+        import numpy as np
+
+        from photon_ml_tpu.core.losses import loss_by_name
+        from photon_ml_tpu.opt.newton_soa import solve_newton_soa
+        from photon_ml_tpu.types import ConvergenceReason
+
+        L, cap, d = 2, 4, 3
+        x = np.zeros((cap, d, L))
+        x[:, :, 0] = 1e160          # H entries overflow -> inf/inf = NaN
+        rng = np.random.default_rng(3)
+        x[:, :, 1] = rng.normal(size=(cap, d))
+        y = np.zeros((cap, L))
+        off = np.zeros((cap, L))
+        wt = np.ones((cap, L))
+        l2 = np.full(L, 0.5)
+        res = solve_newton_soa(
+            loss_by_name("poisson"), jnp.zeros((d, L), jnp.float64),
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
+            jnp.asarray(wt), jnp.asarray(l2),
+            SolverConfig(max_iters=50, tolerance=1e-9))
+        w = np.asarray(res.w)
+        assert np.isfinite(w).all(), w
+        np.testing.assert_array_equal(w[:, 0], 0.0)   # iterate preserved
+        assert int(res.reason[0]) == int(
+            ConvergenceReason.OBJECTIVE_NOT_IMPROVING)
+        assert int(res.reason[1]) != int(
+            ConvergenceReason.OBJECTIVE_NOT_IMPROVING)
+        assert np.abs(w[:, 1]).max() > 0               # healthy lane solved
