@@ -1081,12 +1081,20 @@ class RandomEffectCoordinate(Coordinate):
         # cap 256, 2k lanes) 1.5x SLOWER.  cap*d^2/2 <= 1280 keeps the
         # winning regime: per-iteration Hessian traffic at or below the
         # vmapped path's padded-state traffic (128 lanes x m=10 history).
-        max_cap = max((b.x.shape[1] for b in self.buckets.buckets),
-                      default=0)
+        # The SOLVE-space shapes decide: compact sparse buckets and
+        # projected (INDEX_MAP / RANDOM) buckets solve at their compact /
+        # projected width, which is exactly where narrow dims live — the
+        # back-projection and publish plumbing run on res.w and are
+        # solver-agnostic.
+        solve_shapes = [
+            (b.x.shape[1], b.x.shape[2])
+            for b in (self._proj.buckets if self._proj is not None
+                      else self.buckets.buckets)]
+        worst = max((cap * dd * dd for cap, dd in solve_shapes), default=0)
+        max_solve_dim = max((dd for _, dd in solve_shapes), default=0)
         self._use_soa = (
-            soa_eligible(self.dim, objective.loss.name)
-            and max_cap * self.dim * self.dim <= 2 * 1280
-            and not self._sparse and self._proj is None
+            soa_eligible(max_solve_dim, objective.loss.name)
+            and worst <= 2 * 1280
             and self._norm is None
             and box is None and self._box_lanes is None
             and not self.config.constraints

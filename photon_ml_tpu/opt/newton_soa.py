@@ -30,10 +30,13 @@ iterations, rel->abs tolerances).  The reference never specializes for
 narrow entities; this module is the TPU-native answer to its per-entity
 solve loop.
 
-Gating (game/coordinate.py::_bind_solver): dense non-compacted buckets,
-no per-lane normalization/box extras, l1 == 0, d <= _MAX_SOA_DIM, smooth
-loss.  Everything else keeps the general vmapped path.  Escape hatch:
-PHOTON_DISABLE_SOA_NEWTON=1.
+Gating (game/coordinate.py::_bind_solver): decided on SOLVE-space shapes
+— plain dense buckets, compact sparse buckets, and INDEX_MAP/RANDOM-
+projected buckets (their compact/projected width is exactly where narrow
+dims live) all qualify when there are no per-lane normalization/box
+extras, l1 == 0, solve dim <= _MAX_SOA_DIM, cap*d^2/2 is small enough,
+and the loss is smooth.  Everything else keeps the general vmapped path.
+Escape hatch: PHOTON_DISABLE_SOA_NEWTON=1.
 """
 
 from __future__ import annotations

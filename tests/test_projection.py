@@ -292,3 +292,29 @@ def test_index_map_simple_variances_match_identity():
     m_im = fit(ProjectorType.INDEX_MAP)
     np.testing.assert_allclose(m_im.w_stack, m_id.w_stack, atol=5e-4)
     np.testing.assert_allclose(m_im.variances, m_id.variances, rtol=2e-3)
+
+
+def test_index_map_soa_newton_matches_vmapped(rng, monkeypatch):
+    """Narrow INDEX_MAP-projected buckets gate onto the SoA Newton solver
+    (the gate keys on projected solve-space shapes); the published
+    full-dim model matches the generic vmapped path."""
+    eids, x, y = _sparse_entity_data(rng)
+    data = GameData(y=y, features={"s": x}, id_tags={"e": eids})
+    kw = dict(random_effect_type="e", feature_shard="s",
+              solver=SolverConfig(max_iters=60, tolerance=1e-9),
+              reg=Regularization(l2=0.5), projector=ProjectorType.INDEX_MAP)
+    cs = RandomEffectCoordinate("re", data, RandomEffectConfig(**kw),
+                                TaskType.LOGISTIC_REGRESSION)
+    if not cs._use_soa:
+        pytest.skip("fixture shapes exceed the SoA gate: "
+                    + str([b.x.shape for b in cs._proj.buckets]))
+    offs = np.zeros(len(y), np.float32)
+    ms, _ = cs.update(offs)
+
+    monkeypatch.setenv("PHOTON_DISABLE_SOA_NEWTON", "1")
+    cv = RandomEffectCoordinate("re", data, RandomEffectConfig(**kw),
+                                TaskType.LOGISTIC_REGRESSION)
+    assert not cv._use_soa
+    mv, _ = cv.update(offs)
+    np.testing.assert_allclose(np.asarray(ms.w_stack),
+                               np.asarray(mv.w_stack), rtol=1e-3, atol=2e-3)

@@ -720,3 +720,25 @@ def test_sparse_re_variances_exact_under_compaction(kind):
     v = cs.trace_variances(state, jnp.zeros(len(y), jnp.float32), data=sdata)
     v_stack = cs.export_variances(v)
     np.testing.assert_allclose(v_stack, ms.variances, rtol=2e-3)
+
+
+def test_sparse_re_soa_newton_matches_vmapped(monkeypatch):
+    """Narrow COMPACT sparse buckets ride the SoA Newton solver (the gate
+    keys on SOLVE-space shapes, not the full vocabulary width) and must
+    match the generic vmapped path bit-for-tolerance."""
+    idx, vals, dense, uids, y, d = _sparse_re_data(
+        seed=9, n=128, d=512, k=2, n_users=16)
+    sh = SparseShard(indices=idx, values=vals, dim=d)
+    cs, _ = _re_coordinate(sh, uids, y, d)
+    assert cs._use_soa, (
+        "narrow compact sparse buckets should gate onto SoA: shapes "
+        + str([b.x.shape for b in cs._proj.buckets]))
+    off = np.zeros(len(y), np.float32)
+    ms, _ = cs.update(off)
+
+    monkeypatch.setenv("PHOTON_DISABLE_SOA_NEWTON", "1")
+    cv, _ = _re_coordinate(sh, uids, y, d)
+    assert not cv._use_soa
+    mv, _ = cv.update(off)
+    np.testing.assert_allclose(ms.w_stack, mv.w_stack, atol=5e-4)
+    np.testing.assert_allclose(cs.score(ms), cv.score(mv), atol=5e-3)
