@@ -55,7 +55,12 @@ from photon_ml_tpu.types import ConvergenceReason
 Array = jax.Array
 
 _MAX_SOA_DIM = 16   # Cholesky unroll is O(d^3) fused ops; 16 covers every
-# GLMix random-effect shard in the bench suite (d_user=16, d_item=16, d=4)
+# GLMix random-effect shard in the bench suite (d_user=16, d_item=16, d=4).
+# d=32 was tried and reverted: the unroll compiles ~35s (measured, XLA
+# CPU) and under the cap*d^2/2 traffic guard only cap<=2 buckets would
+# ever qualify at that width — compile cost without a measurable win
+# (the 1M-entity cap4xd32 demo shape sits just past the guard, and an
+# end-to-end A/B there showed no speedup worth the compile).
 
 
 def soa_eligible(dim: int, loss_name: str) -> bool:
