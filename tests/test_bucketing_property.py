@@ -100,3 +100,31 @@ def test_dense_and_sparse_bucketers_agree(ids, cap, seed):
                                    np.asarray(sb.weight)[sl], rtol=1e-6)
         np.testing.assert_allclose(np.asarray(db.y)[dl],
                                    np.asarray(sb.y)[sl], rtol=1e-6)
+
+
+@settings(max_examples=40, deadline=None)
+@given(n=st.integers(1, 300), ne=st.integers(1, 40),
+       d=st.integers(1, 24), seed=st.integers(0, 2**31 - 1),
+       bf16=st.booleans())
+def test_score_samples_t_property(n, ne, d, seed, bf16):
+    """[d, n] samples-on-lanes scoring == [n, d] gather scoring for ANY
+    shape/slot pattern/storage dtype (the narrow-shard layout swap must be
+    a pure layout change — including d=1, all-(-1) slots, and bf16
+    storage against f32 coefficients)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(ne, d)).astype(np.float32)
+    slots = rng.integers(-1, ne, size=n).astype(np.int32)
+    xa = jnp.asarray(x)
+    tol = dict(rtol=1e-5, atol=1e-6)
+    if bf16:
+        xa = xa.astype(jnp.bfloat16)
+        tol = dict(rtol=2e-2, atol=2e-2)
+    a = np.asarray(bucketing.score_samples(
+        jnp.asarray(w), jnp.asarray(slots), xa), np.float64)
+    b = np.asarray(bucketing.score_samples_t(
+        jnp.asarray(w), jnp.asarray(slots), xa.T), np.float64)
+    np.testing.assert_allclose(a, b, **tol)
+    assert (b[slots < 0] == 0).all()
