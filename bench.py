@@ -1333,6 +1333,32 @@ def _entry_from(name: str, got: dict, scale: int, want_cpu_ref: bool) -> dict:
     return entry
 
 
+def _tpu_evidence_pointer(repo: str | None = None):
+    """Pointer at banked accelerator evidence for cpu-fallback lines, or
+    None.  Strictly best-effort: malformed/non-dict checklist content must
+    never cost the run's own output (tested)."""
+    if repo is None:
+        repo = _REPO  # resolved at CALL time: tests monkeypatch bench._REPO
+    try:
+        with open(os.path.join(repo, "TPU_CHECKLIST.json")) as f:
+            banked = json.load(f)
+        bench_banked = (banked.get("bench")
+                        if isinstance(banked, dict) else None)
+        if isinstance(bench_banked, dict) \
+                and bench_banked.get("backend") == "tpu":
+            return {
+                "file": "TPU_CHECKLIST.json",
+                "captured": banked.get("started"),
+                "note": "accelerator measurements banked by an earlier "
+                        "healthy tunnel window (provenance: BASELINE.md "
+                        "measured-status sections"
+                        + (", window_note in the checklist file"
+                           if banked.get("window_note") else "") + ")"}
+    except (OSError, ValueError):
+        return None
+    return None
+
+
 def probe_platform() -> str:
     """Fast backend probe in a subprocess; 'cpu' when the device is dead.
 
@@ -1610,24 +1636,9 @@ def main():
         # a CPU fallback is a statement about the TUNNEL, not the framework:
         # point the reader at the banked accelerator evidence so one sick
         # window at round end cannot hide a healthy window's measurements
-        ck = os.path.join(_REPO, "TPU_CHECKLIST.json")
-        try:
-            with open(ck) as f:
-                banked = json.load(f)
-            bench_banked = (banked.get("bench")
-                            if isinstance(banked, dict) else None)
-            if isinstance(bench_banked, dict) \
-                    and bench_banked.get("backend") == "tpu":
-                line["tpu_evidence"] = {
-                    "file": "TPU_CHECKLIST.json",
-                    "captured": banked.get("started"),
-                    "note": "accelerator measurements banked by an earlier "
-                            "healthy tunnel window (provenance: BASELINE.md "
-                            "measured-status sections"
-                            + (", window_note in the checklist file"
-                               if banked.get("window_note") else "") + ")"}
-        except (OSError, ValueError):
-            pass
+        evidence = _tpu_evidence_pointer()
+        if evidence:
+            line["tpu_evidence"] = evidence
     print(json.dumps(line))
 
 

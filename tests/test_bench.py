@@ -298,3 +298,37 @@ class TestChipGateFalsifiability:
         gate = bench.quality_gate("glmix_chip", stats, ref)
         assert "coef_rel_err" not in gate
         assert gate["pass"] is True
+
+
+class TestTpuEvidencePointer:
+    """The cpu-fallback line's pointer at banked accelerator evidence is
+    driver-facing output: it must appear exactly when TPU_CHECKLIST.json
+    holds a tpu-backend bench, and NEVER raise on malformed content."""
+
+    def _write(self, tmp_path, content):
+        import json
+
+        (tmp_path / "TPU_CHECKLIST.json").write_text(json.dumps(content))
+        return str(tmp_path)
+
+    def test_present_for_banked_tpu_bench(self, tmp_path):
+        repo = self._write(tmp_path, {
+            "started": "2026-08-01T08:04:35Z",
+            "window_note": "x",
+            "bench": {"backend": "tpu", "configs": {}}})
+        ev = bench._tpu_evidence_pointer(repo)
+        assert ev["file"] == "TPU_CHECKLIST.json"
+        assert ev["captured"] == "2026-08-01T08:04:35Z"
+        assert "window_note" in ev["note"]
+
+    def test_absent_for_cpu_bench_or_missing(self, tmp_path):
+        assert bench._tpu_evidence_pointer(str(tmp_path)) is None
+        repo = self._write(tmp_path, {"bench": {"backend": "cpu"}})
+        assert bench._tpu_evidence_pointer(repo) is None
+
+    def test_malformed_content_never_raises(self, tmp_path):
+        for content in ([1, 2], {"bench": [1, 2]}, {"bench": "tpu"}, 7):
+            repo = self._write(tmp_path, content)
+            assert bench._tpu_evidence_pointer(repo) is None
+        (tmp_path / "TPU_CHECKLIST.json").write_text("not json{")
+        assert bench._tpu_evidence_pointer(str(tmp_path)) is None
