@@ -790,13 +790,16 @@ class RandomEffectCoordinate(Coordinate):
             self._x_idx_dev = chunked_device_put(shard_data.indices, np.int32)
             self._x_val_dev = chunked_device_put(shard_data.values, dtype)
         else:
-            # Narrow shards upload TRANSPOSED [d, n]: TPU tiling pads the
-            # minor axis to 128 lanes, so a [n, d<=32] array (and every
-            # scoring gather from it) occupies 128/d x its logical HBM bytes
-            # — 32x at glmix_chip's d=4, an OOM at 8.39M samples
-            # (score_samples_t in parallel/bucketing.py).
-            from photon_ml_tpu.parallel.bucketing import NARROW_SCORE_DIM_MAX
-            self._x_full_is_t = x.shape[1] <= NARROW_SCORE_DIM_MAX
+            # Narrow shards whose padded [n, d] footprint threatens HBM
+            # upload TRANSPOSED [d, n]: TPU tiling pads the minor axis to
+            # 128 lanes, so a [n, d<=32] array (and every scoring gather
+            # from it) occupies 128/d x its logical HBM bytes — 32x at
+            # glmix_chip's d=4, an OOM at 8.39M samples.  Small shards keep
+            # the row layout: the chip-measured crossover lives with
+            # score_samples_t in parallel/bucketing.py.
+            from photon_ml_tpu.parallel.bucketing import use_transposed_scoring
+            self._x_full_is_t = use_transposed_scoring(
+                x.shape[0], x.shape[1], np.dtype(dtype).itemsize)
             self._x_full = chunked_device_put(x.T if self._x_full_is_t else x)
 
         # Optional per-entity feature projection (reference

@@ -484,7 +484,26 @@ def score_samples(w_stack: Array, slots: Array, x: Array) -> Array:
     return jnp.where(slots >= 0, margins, 0.0)
 
 
-NARROW_SCORE_DIM_MAX = 32  # below this, [d, n] layout beats the lane pad
+NARROW_SCORE_DIM_MAX = 32  # [d, n] layout only ever helps below this width
+# Measured crossover for the transposed layout (v5e, round-5 shipped-code
+# checklist vs the run-1 pre-swap numbers, TPU_CHECKLIST.json):
+#   - glmix2  [524288, 16] f32  -> padded [n, d] is 268 MB; the einsum row
+#     layout is 1.56x FASTER (0.47s vs 0.73s per sweep) — the pad fits HBM
+#     and XLA fuses the single gather+einsum better than d serial passes.
+#   - glmix_chip [8.39M, 4] bf16 -> padded [n, d] is 2.1 GB and the scoring
+#     HLO materializes two of them: OOM on a 16 GB chip. Transposed layout
+#     is the only way this config EXISTS on the v5e.
+# So the gate is the padded-HBM footprint (n x 128 lanes x itemsize), not
+# the width alone: transpose only when the pad is an actual memory threat.
+NARROW_SCORE_PAD_BYTES_MIN = 1 << 30
+
+
+def use_transposed_scoring(n: int, d: int, itemsize: int) -> bool:
+    """True when full-sample dense scoring should use the [d, n]
+    samples-on-lanes layout (``score_samples_t``) instead of row-major
+    [n, d] (``score_samples``).  See the crossover note above."""
+    return (d <= NARROW_SCORE_DIM_MAX
+            and n * 128 * itemsize >= NARROW_SCORE_PAD_BYTES_MIN)
 
 
 def score_samples_t(w_stack: Array, slots: Array, x_t: Array) -> Array:

@@ -297,6 +297,23 @@ def test_score_samples_t_matches_row_layout(rng):
     np.testing.assert_allclose(a16, b16, rtol=1e-3, atol=1e-3)
 
 
+def test_transposed_scoring_gate_is_padded_bytes():
+    """The [d, n] layout gate keys on the padded-HBM footprint
+    (n x 128 lanes x itemsize), not width alone: glmix2-shaped shards
+    (524k x 16 f32, 268 MB padded) measured 1.56x FASTER row-major on the
+    v5e while glmix_chip's (8.39M x 4 bf16, 2.1 GB padded) OOMs without
+    the transpose (TPU_CHECKLIST.json r5b vs run 1)."""
+    from photon_ml_tpu.parallel.bucketing import (
+        NARROW_SCORE_PAD_BYTES_MIN, use_transposed_scoring)
+
+    assert not use_transposed_scoring(524_288, 16, 4)   # glmix2: row-major
+    assert use_transposed_scoring(8_388_608, 4, 2)      # glmix_chip: [d, n]
+    assert not use_transposed_scoring(8_388_608, 64, 2)  # wide: never
+    n_edge = NARROW_SCORE_PAD_BYTES_MIN // (128 * 4)
+    assert use_transposed_scoring(n_edge, 4, 4)
+    assert not use_transposed_scoring(n_edge - 1, 4, 4)
+
+
 def test_scoring_unknown_entity_is_zero(rng):
     eids, x, y = _entity_data(rng, n_entities=3)
     obj = GLMObjective(loss=losses.logistic_loss)
