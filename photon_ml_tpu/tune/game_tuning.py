@@ -10,7 +10,7 @@ estimator.fit) and GameTrainingDriver.runHyperparameterTuning:643-674
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -211,14 +211,30 @@ class GameEstimatorEvaluationFunction:
         """Config -> params vector (reference configurationToVector)."""
         return np.asarray([config.coordinates[cid].reg.l2 for cid in self.coordinate_ids])
 
-    def warmup(self) -> None:
+    def warmup(self, grid_sizes: Sequence[int] = ()) -> None:
         """Compile the shared fused tuning program (one throwaway fit at the
         base config's weights, not recorded).  Benchmarks call this so the
         timed window measures tuning-fit throughput, not XLA compilation —
-        the same convention as the sweep benches' warm-up run."""
+        the same convention as the sweep benches' warm-up run.
+
+        ``grid_sizes``: additionally pre-compile the batched grid program
+        for each q (run_grid traces one program per distinct grid size) —
+        callers that tune with ``batch_size=q`` warm q here so no compile
+        lands inside their measured window."""
         n = len(self.results)
-        self(self.vectorize(self.base_config))
+        fit_s, eval_s = self.fit_seconds, self.eval_seconds
+        base = self.vectorize(self.base_config)
+        self(base)
+        fused_ok = (not self.locked and self.estimator.fused is not False
+                    and self._fused_sweep() is not None)
+        if fused_ok:  # without a fused sweep there is no grid program to
+            for q in grid_sizes:  # compile — evaluate_batch would just run
+                if q > 1:  # q discarded sequential retrains
+                    self.evaluate_batch([base] * q)
         del self.results[n:]
+        # warmup contributes nothing to phase accounting, but a reused
+        # evaluation function keeps the history it accumulated before
+        self.fit_seconds, self.eval_seconds = fit_s, eval_s
 
 
 DEFAULT_L2_RANGE = (1e-4, 1e4)

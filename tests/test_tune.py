@@ -476,3 +476,51 @@ def test_batched_grid_tuning_matches_sequential(rng):
                                           seed=0, batch_size=3)
     assert len(tuned) == 7  # prior + 6 tuning fits, batched 3 per round
     assert best in tuned
+
+
+def test_warmup_precompiles_grid_sizes(rng):
+    """warmup(grid_sizes=(q,)) must leave no recorded fits and zeroed phase
+    counters while having exercised both the single-fit and q-grid fused
+    programs (the bench's batched gp_tune relies on this so no XLA compile
+    lands inside its measured window)."""
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.evaluation import EvaluationSuite
+    from photon_ml_tpu.game import (FixedEffectConfig, GameData,
+                                    GameEstimator, RandomEffectConfig)
+    from photon_ml_tpu.game.config import GameConfig
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.tune import tune_game_model
+    from photon_ml_tpu.tune.game_tuning import GameEstimatorEvaluationFunction
+    from photon_ml_tpu.types import TaskType
+
+    n, d_g, d_u, n_users = 256, 4, 2, 8
+    xg = rng.normal(size=(n, d_g)).astype(np.float32)
+    xu = rng.normal(size=(n, d_u)).astype(np.float32)
+    uids = np.repeat(np.arange(n_users), n // n_users)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    cut = 192
+    tr = GameData(y=y[:cut], features={"g": xg[:cut], "u": xu[:cut]},
+                  id_tags={"userId": uids[:cut]})
+    va = GameData(y=y[cut:], features={"g": xg[cut:], "u": xu[cut:]},
+                  id_tags={"userId": uids[cut:]})
+    solver = SolverConfig(max_iters=10, tolerance=1e-6)
+    config = GameConfig(
+        task=TaskType.LOGISTIC_REGRESSION, num_outer_iterations=2,
+        coordinates={
+            "fixed": FixedEffectConfig(feature_shard="g", solver=solver,
+                                       reg=Regularization(l2=1.0)),
+            "per-user": RandomEffectConfig(
+                random_effect_type="userId", feature_shard="u",
+                solver=solver, reg=Regularization(l2=1.0))})
+    est = GameEstimator(validation_suite=EvaluationSuite.from_specs(["auc"]))
+    fn = GameEstimatorEvaluationFunction(est, config, tr, va, seed=0)
+    fn.warmup(grid_sizes=(2,))
+    assert fn.results == []
+    assert fn.fit_seconds == 0.0 and fn.eval_seconds == 0.0
+    # the warmed function then drives a batched search normally
+    best, search, tuned = tune_game_model(est, config, tr, va,
+                                          n_iterations=4, mode="bayesian",
+                                          seed=0, evaluation_function=fn,
+                                          batch_size=2)
+    assert len(tuned) == 5  # prior + 4 tuning fits
+    assert best in tuned
