@@ -332,3 +332,90 @@ class TestTpuEvidencePointer:
             assert bench._tpu_evidence_pointer(repo) is None
         (tmp_path / "TPU_CHECKLIST.json").write_text("not json{")
         assert bench._tpu_evidence_pointer(str(tmp_path)) is None
+
+
+class TestChecklistPromotion:
+    """tools/tpu_checklist.py must never clobber the canonical banked
+    artifact with a lesser run: in-progress state goes to the .partial
+    file, and promotion requires an accelerator-backend bench AND a
+    healthy pallas stage (learned 2026-08-02, when a degraded-window
+    rerun overwrote the banked pass at start)."""
+
+    def _run_main(self, tmp_path, monkeypatch, stage_lines):
+        import tools.tpu_checklist as tc
+
+        out = tmp_path / "TPU_CHECKLIST.json"
+        partial = tmp_path / "TPU_CHECKLIST.partial.json"
+        monkeypatch.setattr(tc, "_OUT", str(out))
+        monkeypatch.setattr(tc, "_PARTIAL", str(partial))
+        monkeypatch.setenv("PHOTON_BENCH_PROFILE_DIR", str(tmp_path / "prof"))
+        calls = iter(stage_lines)
+
+        def fake_run(argv_or_src, timeout):
+            return next(calls)
+
+        monkeypatch.setattr(tc, "_run_py", fake_run)
+        # _save(..., _OUT) uses the default-arg binding captured at import;
+        # patch _save to honor the monkeypatched module globals
+        real_save = tc._save.__wrapped__ if hasattr(tc._save, "__wrapped__") \
+            else tc._save
+
+        def save(results, path=None):
+            real_save(results, path or tc._PARTIAL)
+
+        monkeypatch.setattr(tc, "_save", save)
+        return tc.main(), out, partial
+
+    def test_healthy_tpu_run_promotes(self, tmp_path, monkeypatch):
+        import json
+
+        rc, out, partial = self._run_main(tmp_path, monkeypatch, [
+            ("tpu", None),
+            (json.dumps({"pass": True, "cases": []}), None),
+            (json.dumps({"backend": "tpu", "metric": "m", "configs": {}}),
+             None),
+        ])
+        assert rc == 0 and out.exists()
+        assert json.loads(out.read_text())["bench"]["backend"] == "tpu"
+
+    def test_cpu_fallback_bench_not_promoted(self, tmp_path, monkeypatch):
+        import json
+
+        banked = {"bench": {"backend": "tpu"}, "pallas_parity": {"pass": True}}
+        (tmp_path / "TPU_CHECKLIST.json").write_text(json.dumps(banked))
+        rc, out, partial = self._run_main(tmp_path, monkeypatch, [
+            ("tpu", None),
+            (json.dumps({"pass": True, "cases": []}), None),
+            # tunnel died mid-run: bench.py itself fell back to cpu
+            (json.dumps({"backend": "cpu", "metric": "m"}), None),
+        ])
+        assert rc == 1
+        assert json.loads(out.read_text()) == banked  # canonical untouched
+        assert json.loads(partial.read_text())["bench"]["backend"] == "cpu"
+
+    def test_pallas_failure_not_promoted(self, tmp_path, monkeypatch):
+        import json
+
+        banked = {"bench": {"backend": "tpu"}, "pallas_parity": {"pass": True}}
+        (tmp_path / "TPU_CHECKLIST.json").write_text(json.dumps(banked))
+        rc, out, partial = self._run_main(tmp_path, monkeypatch, [
+            ("tpu", None),
+            (None, "timeout after 600s"),  # pallas stage died
+            (json.dumps({"backend": "tpu", "metric": "m"}), None),
+        ])
+        assert rc == 1
+        assert json.loads(out.read_text()) == banked
+
+    def test_probe_failure_touches_nothing_canonical(self, tmp_path,
+                                                     monkeypatch):
+        import json
+
+        banked = {"bench": {"backend": "tpu"}}
+        (tmp_path / "TPU_CHECKLIST.json").write_text(json.dumps(banked))
+        rc, out, partial = self._run_main(tmp_path, monkeypatch, [
+            (None, "timeout after 120s"),
+        ])
+        assert rc == 1
+        assert json.loads(out.read_text()) == banked
+        assert "error" in json.loads(partial.read_text())["probe"]["error"] \
+            or json.loads(partial.read_text())["probe"]["error"]

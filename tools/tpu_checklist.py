@@ -19,9 +19,14 @@ Stages (each skipped gracefully when no TPU answers):
                   backend it auto-runs the pallas-off and bf16-storage A/B
                   variants and the fused-vs-host A/B).
 
-Everything lands in TPU_CHECKLIST.json (stage results + the bench line),
-refreshed atomically after every stage so a later wedge can't destroy
-earlier evidence.
+In-progress state lands in TPU_CHECKLIST.partial.json (refreshed
+atomically after every stage so a later wedge can't destroy THIS run's
+earlier stages); the canonical TPU_CHECKLIST.json is only replaced at the
+end of a COMPLETE run whose bench stage parsed without error.  A run that
+dies mid-bench — or finds no accelerator at all — therefore never clobbers
+the last banked full-evidence artifact (learned 2026-08-02: a
+degraded-window rerun overwrote the banked pass at start and had to be
+restored from git).
 """
 
 from __future__ import annotations
@@ -34,6 +39,7 @@ import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _OUT = os.path.join(_REPO, "TPU_CHECKLIST.json")
+_PARTIAL = _OUT.replace(".json", ".partial.json")
 
 _PROBE_SRC = """
 import jax
@@ -130,11 +136,11 @@ print(json.dumps(out))
 """
 
 
-def _save(results: dict) -> None:
-    tmp = _OUT + ".tmp"
+def _save(results: dict, path: str = _PARTIAL) -> None:
+    tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(results, f, indent=2)
-    os.replace(tmp, _OUT)
+    os.replace(tmp, path)
 
 
 # SIGALRM self-timeout prepended to every snippet: the KERNEL delivers the
@@ -188,7 +194,7 @@ def main() -> int:
     _save(results)
     if err or line == "cpu":
         print(f"no accelerator ({err or 'cpu backend'}); checklist aborted "
-              f"— results in {_OUT}")
+              f"— results in {_PARTIAL} (canonical {_OUT} untouched)")
         return 1
     print(f"backend: {line}")
 
@@ -229,6 +235,24 @@ def main() -> int:
                   if os.path.isdir(prof_dir) else 0)}
     _save(results)
     print("bench:", json.dumps(results.get("bench", {}))[:400])
+    # Promote only a run that KEEPS the canonical file's evidence value:
+    # bench completed on an accelerator (a mid-run tunnel death makes
+    # bench.py itself fall back to backend "cpu" — valid JSON, no error,
+    # but promoting it would clobber banked TPU evidence and kill
+    # bench.py's _tpu_evidence_pointer), and the pallas stage neither
+    # errored nor failed (the canonical artifact's pallas_parity block is
+    # cited as evidence by BASELINE.md/PARITY.md).
+    pallas = results.get("pallas_parity") or {}
+    pallas_ok = "error" not in pallas and pallas.get("pass") is not False
+    if err or "error" in results["bench"] \
+            or results["bench"].get("backend") in (None, "cpu") \
+            or not pallas_ok:
+        # the partial file + log hold this run's record; the canonical
+        # artifact keeps the last complete banked run
+        print(f"run not promotable (bench/pallas incomplete, errored, or "
+              f"cpu-fallback); record in {_PARTIAL}")
+        return 1
+    _save(results, _OUT)
     print(f"checklist complete -> {_OUT}")
     return 0
 
