@@ -823,8 +823,14 @@ def run_gp_tune(platform, scale):
     # streams).  The scipy stand-in stays 7 sequential fits: retraining q
     # candidates at once for the cost of ~one fit is precisely the
     # hardware-parallelism advantage this config exists to measure.
-    batch = 2
-    # compile the shared single-fit AND q=2 grid programs outside the window
+    # Unconditional even on the cpu fallback — same-host A/B: batched is
+    # 10% faster at bench scale (halved gp_sec outweighs the lock-step
+    # grid) and a wash at full scale (0.916s vs 0.922s, equal best_auc),
+    # so one code path serves both backends.  PHOTON_BENCH_GP_BATCH=1
+    # reproduces sequential mode for A/Bs.
+    batch = int(os.environ.get("PHOTON_BENCH_GP_BATCH", "2"))
+    # compile the shared single-fit AND batched grid programs outside the
+    # window (grid warmup no-ops at batch=1)
     fn.warmup(grid_sizes=(batch,))
     out = {}
 
