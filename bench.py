@@ -1403,6 +1403,125 @@ RUNNERS = {
     "glmix_chip": lambda p, s: run_glmix_chip(p, s),
 }
 
+def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
+                      device_capacity=None, seed=0, out_path=None):
+    """`bench.py --serving`: online-scoring micro-bench (serving/ subsystem).
+
+    Self-contained: builds a synthetic 2-coordinate GLMix model IN MEMORY
+    (no training, no disk) at the given entity count, stands up the
+    AOT-warmed ScoringEngine, then measures
+      - single-request latency (bucket 1): p50 / p99 / mean over a timed
+        loop — the user-facing number for the online path;
+      - batched throughput: a random-size request stream (the realistic
+        arrival pattern), reporting QPS and the padding-waste ratio the
+        bucket ladder actually paid;
+      - warm cost: executables compiled for the ladder (the number a hot
+        swap must pre-pay off the request path).
+    Emits one JSON dict (also written to BENCH_SERVING_<backend>.json).
+    """
+    import jax
+
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
+    from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                         StoreConfig)
+    from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    names = [f"f{j}" for j in range(d)]
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
+    eidx = EntityIndex()
+    for i in range(n_entities):
+        eidx.get_or_add(f"user{i}")
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=d)),
+            feature_shard="all", task=task),
+        "per_user": RandomEffectModel(
+            w_stack=rng.normal(size=(n_entities, d)) * 0.1,
+            slot_of={i: i for i in range(n_entities)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    })
+    metrics = ServingMetrics()
+    store = CoefficientStore.from_model(
+        model, task, {"userId": eidx}, {"all": imap},
+        config=StoreConfig(device_capacity=device_capacity),
+        version="synthetic", metrics=metrics)
+    engine = ScoringEngine(store, BucketedBatcher(max_batch), metrics=metrics)
+
+    t0 = time.perf_counter()
+    n_compiled = engine.warm()
+    warm_s = time.perf_counter() - t0
+
+    def mk_request(i):
+        feats = [{"name": n, "term": "", "value": float(v)}
+                 for n, v in zip(names, rng.normal(size=d))]
+        return Request(uid=i, features=feats,
+                       ids={"userId": f"user{int(rng.integers(0, int(n_entities * 1.05)))}"})
+
+    # single-request latency (bucket 1)
+    single = [mk_request(i) for i in range(min(500, n_requests))]
+    engine.score_requests(single[:1])  # touch every path once
+    lat = []
+    for r in single:
+        t = time.perf_counter()
+        engine.score_requests([r])
+        lat.append(time.perf_counter() - t)
+    lat = np.asarray(lat)
+
+    # batched throughput over a random-size arrival stream
+    stream = [mk_request(i) for i in range(n_requests)]
+    sizes = []
+    i = 0
+    while i < n_requests:
+        k = int(rng.integers(1, max_batch + 1))
+        sizes.append(min(k, n_requests - i))
+        i += sizes[-1]
+    waste_before = metrics.snapshot()["padded_rows_launched"]
+    t0 = time.perf_counter()
+    i = 0
+    for k in sizes:
+        engine.score_requests(stream[i:i + k])
+        i += k
+    stream_s = time.perf_counter() - t0
+    snap = metrics.snapshot()
+
+    out = {
+        "metric": "serving_p99_latency", "unit": "s",
+        "value": round(float(np.percentile(lat, 99)), 6),
+        "backend": jax.default_backend(),
+        "n_entities": n_entities, "d": d,
+        "device_capacity": device_capacity,
+        "single_request": {
+            "n": len(lat),
+            "p50_s": round(float(np.percentile(lat, 50)), 6),
+            "p99_s": round(float(np.percentile(lat, 99)), 6),
+            "mean_s": round(float(lat.mean()), 6),
+        },
+        "stream": {
+            "n_requests": n_requests, "n_batches": len(sizes),
+            "seconds": round(stream_s, 4),
+            "qps": round(n_requests / stream_s, 1),
+            "padding_waste_ratio": round(snap["padding_waste_ratio"], 4),
+        },
+        "warm": {"executables": n_compiled, "seconds": round(warm_s, 4)},
+        "counters": snap["counters"],
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            _REPO, f"BENCH_SERVING_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 # configs with an unconditional scipy stand-in for vs_baseline.  glmix_chip
 # is special-cased in _entry_from: at chip scale no host holds its design
 # matrix (vs_baseline stays null), but CPU-floor runs reconstruct the
@@ -1419,7 +1538,23 @@ def main():
     ap.add_argument("--ab-chain", action="store_true",
                     help="with --config glmix2: measure fused/host/xla over "
                          "one design upload, one JSON line per variant")
+    ap.add_argument("--serving", action="store_true",
+                    help="online-scoring micro-bench (p50/p99 per-request "
+                         "latency, QPS, padding waste) -> "
+                         "BENCH_SERVING_<backend>.json")
+    ap.add_argument("--serving-entities", type=int, default=20000)
+    ap.add_argument("--serving-requests", type=int, default=2000)
+    ap.add_argument("--serving-device-capacity", type=int, default=0,
+                    help="hot entity rows on device (0 = all)")
+    ap.add_argument("--out", default=None,
+                    help="with --serving: output JSON path override")
     a = ap.parse_args()
+    if a.serving:
+        print(json.dumps(run_serving_bench(
+            n_entities=a.serving_entities, n_requests=a.serving_requests,
+            device_capacity=a.serving_device_capacity or None,
+            out_path=a.out)))
+        return
     if a.ab_chain and a.config != "glmix2":
         # outside the `if a.config:` branch: a bare --ab-chain must error,
         # not silently fall through to the full orchestrator

@@ -17,12 +17,10 @@ from typing import List
 import numpy as np
 
 from photon_ml_tpu.data import avro as avro_io
-from photon_ml_tpu.data.index_map import IndexMap
-from photon_ml_tpu.data.reader import EntityIndex, read_game_data_avro
+from photon_ml_tpu.data.reader import read_game_data_avro
 from photon_ml_tpu.data.schemas import SCORING_RESULT
 from photon_ml_tpu.evaluation.evaluator import EvaluationSuite
-from photon_ml_tpu.game.estimator import GameTransformer
-from photon_ml_tpu.storage.model_io import load_game_model
+from photon_ml_tpu.storage.model_io import load_model_bundle
 
 logger = logging.getLogger("photon_ml_tpu.score")
 
@@ -88,20 +86,15 @@ def run(argv: List[str]) -> int:
         logger.info("imported reference-format model: %d coordinate(s)",
                     len(model.models))
     else:
-        index_maps = {}
-        entity_indexes = {}
-        for name in os.listdir(args.model_dir):
-            if name.endswith(".idx") or name.endswith(".phidx"):
-                from photon_ml_tpu.data.index_map import load_index
+        from photon_ml_tpu.storage.model_io import ModelLoadError
 
-                shard = name.rsplit(".", 1)[0]
-                index_maps[shard] = load_index(os.path.join(args.model_dir, name))
-            elif name.endswith(".entities.json"):
-                entity_indexes[name[: -len(".entities.json")]] = EntityIndex.load(
-                    os.path.join(args.model_dir, name))
-
-        model, task = load_game_model(os.path.join(args.model_dir, "best"),
-                                      index_maps, entity_indexes)
+        try:
+            bundle = load_model_bundle(args.model_dir)
+        except ModelLoadError as e:
+            logger.error("--model-dir: %s", e)
+            return 1
+        model, task = bundle.model, bundle.task
+        index_maps, entity_indexes = bundle.index_maps, bundle.entity_indexes
     id_tags = sorted(entity_indexes)
     from photon_ml_tpu.data.reader import parse_input_columns
 
@@ -136,15 +129,13 @@ def run(argv: List[str]) -> int:
             logger.info("data: id tag %s covers %d/%d samples", tag, known,
                         data.num_samples)
 
-    tf = GameTransformer(model, task)
+    from photon_ml_tpu.game.scoring import output_scores, raw_scores
+
     # One scoring pass; the inverse-link mean is a pointwise function of the
-    # raw margin (models/game.py:110-114), so --predict-mean never re-scores.
-    raw_scores = tf.score(data) + np.asarray(data.offset)
-    if args.predict_mean:
-        from photon_ml_tpu.core.losses import loss_for_task
-        scores = np.asarray(loss_for_task(task).mean(raw_scores))
-    else:
-        scores = raw_scores
+    # raw margin, so --predict-mean never re-scores (game/scoring.py — the
+    # same composition the serving engine and GameTransformer use).
+    raw = raw_scores(model, data)
+    scores = output_scores(raw, task, predict_mean=args.predict_mean)
 
     os.makedirs(args.output_dir, exist_ok=True)
     out_path = os.path.join(args.output_dir, "scores.avro")
@@ -162,7 +153,7 @@ def run(argv: List[str]) -> int:
     if args.evaluators:
         # evaluators expect RAW margins regardless of the output format flag
         suite = EvaluationSuite.from_specs(args.evaluators.split(","))
-        res = suite.evaluate(raw_scores, data.y, data.weight, group_ids=data.id_tags)
+        res = suite.evaluate(raw, data.y, data.weight, group_ids=data.id_tags)
         logger.info("metrics: %s", res.values)
         with open(os.path.join(args.output_dir, "metrics.json"), "w") as f:
             json.dump(res.values, f, indent=2)

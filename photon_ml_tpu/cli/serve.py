@@ -1,0 +1,200 @@
+"""GAME online scoring driver — JSON-lines in, JSON-lines out.
+
+Photon ML reference counterpart: there is none in the batch repo — the
+reference's GameScoringDriver scores offline datasets; online traffic is
+served by LinkedIn infrastructure reading the published PalDB stores.  This
+driver IS that online layer for the TPU-native stack: it loads a training
+output directory into a device-resident ``serving.CoefficientStore``,
+AOT-warms the ``serving.ScoringEngine`` bucket ladder, then scores a
+stream of JSON-lines requests with micro-batching and supports atomic hot
+model swap mid-stream.
+
+Wire protocol (one JSON object per line on stdin / ``--requests`` file):
+
+  request   {"uid": 7, "features": [{"name": "g0", "term": "", "value": 0.3},
+             ...], "ids": {"userId": "user3"}, "offset": 0.0}
+            (features also accept compact [name, value] / [name, term,
+             value] lists)
+  flush     a blank line — score the buffered requests now (otherwise the
+            batcher flushes whenever ``--max-batch`` requests are buffered,
+            and at EOF)
+  swap      {"cmd": "swap", "model_dir": "/path/to/new/output"}
+            -> {"swap": "ok"|"rejected", ...}; a rejected swap (corrupt or
+            incomplete model dir) leaves the current version serving
+  metrics   {"cmd": "metrics"} -> one metrics JSON line
+
+Responses are ``{"uid": ..., "score": ...}`` lines on stdout, in request
+order.  Programmatic use: ``build_server`` returns the (engine, swapper)
+pair without touching stdio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import IO, List, Optional, Sequence, Tuple
+
+from photon_ml_tpu.serving.batcher import BucketedBatcher, request_from_json
+from photon_ml_tpu.serving.coefficient_store import CoefficientStore, StoreConfig
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.swap import HotSwapper
+from photon_ml_tpu.storage.model_io import ModelLoadError, load_model_bundle
+
+logger = logging.getLogger("photon_ml_tpu.serve")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-tpu-serve",
+                                description="Online scoring with a trained "
+                                            "GAME model (JSON-lines)")
+    p.add_argument("--model-dir", required=True,
+                   help="training output dir (best/, *.idx, *.entities.json) "
+                        "or a model dir with metadata.json")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="micro-batch flush threshold and top bucket size")
+    p.add_argument("--buckets", default="",
+                   help="comma list of bucket sizes (default: powers of two "
+                        "up to --max-batch)")
+    p.add_argument("--device-entity-capacity", type=int, default=0,
+                   help="max entity rows device-resident per coordinate "
+                        "(0 = all; colder entities serve from the host LRU "
+                        "fallback)")
+    p.add_argument("--lru-capacity", type=int, default=4096,
+                   help="host LRU entries per coordinate for cold entities")
+    p.add_argument("--predict-mean", action="store_true",
+                   help="emit inverse-link means instead of raw margins")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip AOT pre-compilation of the bucket ladder "
+                        "(first request per bucket then pays the compile)")
+    p.add_argument("--requests", default="-",
+                   help="JSON-lines request file ('-' = stdin)")
+    p.add_argument("--metrics-json", default="",
+                   help="write the final metrics snapshot here at exit")
+    return p
+
+
+def build_server(model_dir: str,
+                 max_batch: int = 64,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 device_entity_capacity: Optional[int] = None,
+                 lru_capacity: int = 4096,
+                 metrics: Optional[ServingMetrics] = None,
+                 warm: bool = True) -> Tuple[ScoringEngine, HotSwapper]:
+    """Programmatic entry point: load -> store -> engine (+ warmed ladder)
+    -> swapper.  Raises storage.model_io.ModelLoadError on a broken dir."""
+    metrics = metrics or ServingMetrics()
+    bundle = load_model_bundle(model_dir)
+    config = StoreConfig(device_capacity=device_entity_capacity,
+                         lru_capacity=lru_capacity)
+    store = CoefficientStore.from_bundle(bundle, config=config,
+                                         version=model_dir, metrics=metrics)
+    engine = ScoringEngine(store, BucketedBatcher(max_batch, bucket_sizes),
+                           metrics=metrics)
+    if warm:
+        n = engine.warm()
+        logger.info("warmed %d executable(s) over buckets %s", n,
+                    engine.batcher.bucket_sizes)
+    return engine, HotSwapper(engine)
+
+
+def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
+                  out: IO, predict_mean: bool) -> int:
+    buffered: List = []
+
+    def flush() -> None:
+        if not buffered:
+            return
+        scores = engine.score_requests(buffered, predict_mean=predict_mean)
+        for req, s in zip(buffered, scores):
+            out.write(json.dumps({"uid": req.uid, "score": float(s)}) + "\n")
+        out.flush()
+        buffered.clear()
+
+    for line in lines:
+        line = line.strip()
+        if not line:
+            flush()
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            logger.error("bad request line: %s", e)
+            out.write(json.dumps({"error": str(e)}) + "\n")
+            continue
+        cmd = obj.get("cmd") if isinstance(obj, dict) else None
+        if cmd == "swap":
+            flush()  # everything buffered scores on the pre-swap version
+            ok = swapper.swap(obj["model_dir"])
+            out.write(json.dumps({
+                "swap": "ok" if ok else "rejected",
+                "generation": engine.store.generation,
+                "version": engine.store.version}) + "\n")
+            out.flush()
+        elif cmd == "metrics":
+            flush()
+            out.write(engine.metrics.to_json() + "\n")
+            out.flush()
+        elif cmd is not None:
+            out.write(json.dumps({"error": f"unknown cmd {cmd!r}"}) + "\n")
+        else:
+            try:
+                buffered.append(request_from_json(obj))
+            except (ValueError, TypeError) as e:
+                logger.error("bad request: %s", e)
+                out.write(json.dumps({"error": str(e)}) + "\n")
+                continue
+            if len(buffered) >= engine.batcher.max_batch:
+                flush()
+    flush()
+    return 0
+
+
+def run(argv: List[str]) -> int:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+
+    from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    buckets = None
+    if args.buckets:
+        buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    try:
+        engine, swapper = build_server(
+            args.model_dir,
+            max_batch=args.max_batch,
+            bucket_sizes=buckets,
+            device_entity_capacity=(args.device_entity_capacity or None),
+            lru_capacity=args.lru_capacity,
+            warm=not args.no_warm)
+    except (ModelLoadError, ValueError) as e:
+        logger.error("--model-dir: %s", e)
+        return 1
+    logger.info("serving generation %d (version %r), task %s",
+                engine.store.generation, engine.store.version,
+                engine.store.task.value)
+
+    lines = sys.stdin if args.requests == "-" else open(args.requests)
+    try:
+        rc = _serve_stream(engine, swapper, lines, sys.stdout,
+                           args.predict_mean)
+    finally:
+        if lines is not sys.stdin:
+            lines.close()
+        if args.metrics_json:
+            engine.metrics.export(args.metrics_json)
+            logger.info("metrics -> %s", args.metrics_json)
+    return rc
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

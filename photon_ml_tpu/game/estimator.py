@@ -250,5 +250,7 @@ class GameTransformer:
         return np.asarray(self.model.predict(data, self.task))
 
     def evaluate(self, data: GameData, suite: EvaluationSuite) -> EvaluationResults:
-        scores = self.score(data) + np.asarray(data.offset)
-        return suite.evaluate(scores, data.y, data.weight, group_ids=data.id_tags)
+        from photon_ml_tpu.game.scoring import raw_scores
+
+        return suite.evaluate(raw_scores(self.model, data), data.y,
+                              data.weight, group_ids=data.id_tags)

@@ -1,0 +1,61 @@
+"""Shared score composition — the ONE definition of GAME additive scoring.
+
+Reference: photon-lib .../model/GameModel.scala:99-110 (score = sum of
+coordinate raw scores) and photon-api transformers/GameTransformer.scala:
+263 (scoreGameDataset: raw totals, offset applied by the caller) plus the
+scoring driver's mean transform (GameScoringDriver.scala: predicted mean is
+the inverse link of margin + offset).
+
+Every consumer of "add up the coordinate scores, then apply offset and the
+task's inverse link" goes through here: the batch paths (models/game
+.GameModel.score, game/estimator.GameTransformer, cli/score.py) and the
+online serving engine (serving/engine.py), whose compiled per-bucket kernels
+call ``additive_total`` on per-coordinate margins exactly like the batch
+path does — one code path, so batch and online scores cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # annotation-only: avoid import cycles
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.models.game import GameModel
+    from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+def additive_total(num_samples: int, margins: Iterable[Array]) -> Array:
+    """Sum per-coordinate raw margins into the total score vector.
+
+    The accumulation order and the zero-init are part of the scoring
+    contract (GameModel.score:99-110): serving reuses this function inside
+    its jitted kernels so padded-bucket totals are bitwise the batch totals.
+    """
+    total = jnp.zeros((num_samples,))
+    for m in margins:
+        total = total + m
+    return total
+
+
+def raw_scores(model: "GameModel", data: "GameData") -> np.ndarray:
+    """Raw margin + offset per sample (reference scoreGameDataset:263 plus
+    the driver-side offset add) — the input both evaluators and the mean
+    transform expect."""
+    return np.asarray(model.score(data)) + np.asarray(data.offset)
+
+
+def output_scores(raw: np.ndarray, task: "TaskType",
+                  predict_mean: bool = False) -> np.ndarray:
+    """Final output transform: raw margins, or the task's inverse-link mean
+    (a pointwise function of the raw margin — never re-scores)."""
+    if not predict_mean:
+        return raw
+    from photon_ml_tpu.core.losses import loss_for_task
+
+    return np.asarray(loss_for_task(task).mean(raw))

@@ -267,11 +267,13 @@ class GameModel:
     models: Dict[str, DatumScoringModel]
 
     def score(self, data: GameData) -> Array:
-        """Sum of coordinate raw scores (GameModel.score:99-110)."""
-        total = jnp.zeros((data.num_samples,))
-        for model in self.models.values():
-            total = total + model.score(data)
-        return total
+        """Sum of coordinate raw scores (GameModel.score:99-110) via the
+        shared composition (game/scoring.additive_total — the same function
+        the online serving kernels use, so batch and serving cannot drift)."""
+        from photon_ml_tpu.game.scoring import additive_total
+
+        return additive_total(data.num_samples,
+                              (m.score(data) for m in self.models.values()))
 
     def predict(self, data: GameData, task: TaskType) -> Array:
         from photon_ml_tpu.core.losses import loss_for_task

@@ -1,0 +1,28 @@
+"""Online GLMix scoring — the serving half of the Photon ML design.
+
+The reference trains GAME models in Spark and publishes them to PalDB
+stores + broadcast coefficients for LinkedIn's online serving stack; this
+package is that serving layer, TPU-native:
+
+  - ``coefficient_store``: device-resident versioned coefficient tables
+    (the PalDB analog) with an LRU host fallback for cold entities;
+  - ``batcher``: request micro-batching padded to a fixed bucket ladder so
+    every shape hits an already-compiled executable;
+  - ``engine``: AOT-lowered per-(signature, bucket) scoring kernels sharing
+    the batch path's score composition (game/scoring.py);
+  - ``swap``: atomic hot model reload (load -> warm -> flip);
+  - ``metrics``: one thread-safe registry (latency histograms, QPS,
+    padding waste, entity misses, swap counters) exported as JSON.
+
+``cli/serve.py`` wires these into a stdin/JSON-lines driver and a
+programmatic ``build_server`` entry point.
+"""
+
+from photon_ml_tpu.serving.batcher import (BucketedBatcher, Request,  # noqa: F401
+                                           pow2_bucket_ladder,
+                                           request_from_json)
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,  # noqa: F401
+                                                     StoreConfig)
+from photon_ml_tpu.serving.engine import ScoringEngine  # noqa: F401
+from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from photon_ml_tpu.serving.swap import HotSwapper  # noqa: F401

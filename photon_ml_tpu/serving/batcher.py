@@ -1,0 +1,164 @@
+"""Request micro-batcher with padding to a fixed bucket ladder.
+
+Photon ML reference counterpart: the Spark GameTransformer scores whatever
+partition sizes the RDD hands it — shape polymorphism is free on CPU.  On an
+accelerator every new batch shape is a fresh XLA compile, so the online path
+pads each micro-batch up to a SMALL FIXED LADDER of bucket sizes (the same
+power-of-two idiom ``parallel/bucketing.py`` uses for per-entity sample
+capacities) and every request shape lands on an already-compiled executable
+(serving/engine.py).  Padded rows carry zero features and slot -1, so they
+are inert through the scoring contraction and are sliced off before results
+leave the engine.
+
+Also home to the request schema: ``Request`` (parsed, array-ready) and
+``request_from_json`` — the JSON-lines wire format of ``cli/serve.py``,
+whose feature triples flow through the SAME (name, term) -> column mapping
+``data/reader.read_game_data_avro`` applies to training records, so online
+features land in exactly the training columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+
+
+def pow2_bucket_ladder(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:
+    """1, 2, 4, ... up to (and including) the next power of two >= max_batch
+    — the same rounding rule as ``parallel/bucketing._capacity_classes``."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    top = 1 << (max_batch - 1).bit_length()
+    ladder = []
+    b = max(1, min_bucket)
+    while b < top:
+        ladder.append(b)
+        b <<= 1
+    ladder.append(top)
+    return tuple(ladder)
+
+
+@dataclasses.dataclass
+class Request:
+    """One scoring request, array-ready.
+
+    ``features``: ONE name/term/value triple list shared by every feature
+    shard (exactly like a TrainingExampleAvro record — each shard's index
+    map picks out the columns it knows).  ``ids``: id-tag -> entity string
+    (reference GameDatum idTagToValueMap).  ``offset``: added to the raw
+    margin, never part of the model score.
+    """
+
+    uid: object = None
+    features: Sequence[dict] = ()
+    ids: Dict[str, str] = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+
+
+def request_from_json(obj: dict) -> Request:
+    """Wire JSON -> Request.  Accepts features as NTV triple dicts
+    ([{"name": ..., "term": ..., "value": ...}, ...]) or compact
+    [name, value] / [name, term, value] lists."""
+    feats = []
+    for f in obj.get("features") or ():
+        if isinstance(f, dict):
+            feats.append(f)
+        elif isinstance(f, (list, tuple)) and len(f) == 2:
+            feats.append({"name": f[0], "term": "", "value": f[1]})
+        elif isinstance(f, (list, tuple)) and len(f) == 3:
+            feats.append({"name": f[0], "term": f[1], "value": f[2]})
+        else:
+            raise ValueError(f"unparseable feature entry {f!r}")
+    ids = {str(k): str(v) for k, v in (obj.get("ids") or {}).items()}
+    return Request(uid=obj.get("uid"), features=feats, ids=ids,
+                   offset=float(obj.get("offset") or 0.0))
+
+
+def densify_features(requests: Sequence[Request], index_maps: Dict[str, IndexMap],
+                     n_rows: int, dtype=np.float32) -> Dict[str, np.ndarray]:
+    """Requests -> one padded dense [n_rows, d_shard] matrix per shard.
+
+    Mirrors data/reader.read_game_data_avro's record loop exactly: intercept
+    column filled with 1, features accumulated through
+    ``IndexMap.get_index(name, term)``, unknown features dropped.  Rows
+    beyond ``len(requests)`` stay all-zero (padding; inert through every
+    scoring contraction).  Shards sharing one IndexMap object share ONE
+    matrix (the reader's aliasing trick).
+    """
+    mats: Dict[str, np.ndarray] = {}
+    by_map: Dict[int, np.ndarray] = {}
+    for shard, m in index_maps.items():
+        x = by_map.get(id(m))
+        if x is None:
+            x = np.zeros((n_rows, m.size), dtype)
+            ii = m.intercept_index
+            if ii is not None:
+                x[: len(requests), ii] = 1.0
+            for i, req in enumerate(requests):
+                for feat in req.features:
+                    j = m.get_index(feat["name"], feat.get("term") or "")
+                    if j >= 0:
+                        x[i, j] += feat["value"]
+            by_map[id(m)] = x
+        mats[shard] = x
+    return mats
+
+
+@dataclasses.dataclass(frozen=True)
+class MicroBatch:
+    """One planned launch: requests[start:stop] padded to ``bucket`` rows."""
+
+    start: int
+    stop: int
+    bucket: int
+
+    @property
+    def real_rows(self) -> int:
+        return self.stop - self.start
+
+
+class BucketedBatcher:
+    """Split a request stream into bucket-padded micro-batches.
+
+    ``bucket_sizes``: the compiled-shape ladder (default: powers of two up
+    to ``max_batch``).  A chunk of n requests pads to the smallest bucket
+    >= n; streams longer than the top bucket split into top-bucket chunks
+    first (full buckets have zero padding waste, so the tail is the only
+    waste source — the padding-waste metric tracks it).
+    """
+
+    def __init__(self, max_batch: int = 64,
+                 bucket_sizes: Optional[Sequence[int]] = None):
+        if bucket_sizes is None:
+            bucket_sizes = pow2_bucket_ladder(max_batch)
+        sizes = sorted(set(int(b) for b in bucket_sizes))
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"invalid bucket sizes {bucket_sizes!r}")
+        self.bucket_sizes: Tuple[int, ...] = tuple(sizes)
+        self.max_batch = self.bucket_sizes[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n must not exceed the top bucket)."""
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds top bucket {self.max_batch}")
+
+    def plan(self, n_requests: int) -> List[MicroBatch]:
+        """Cut n requests into launches: full top-bucket chunks, then one
+        padded tail chunk."""
+        plan: List[MicroBatch] = []
+        start = 0
+        while start < n_requests:
+            chunk = min(self.max_batch, n_requests - start)
+            plan.append(MicroBatch(start=start, stop=start + chunk,
+                                   bucket=self.bucket_for(chunk)))
+            start += chunk
+        return plan
+
+    def padding_rows(self, plan: Sequence[MicroBatch]) -> int:
+        return sum(mb.bucket - mb.real_rows for mb in plan)

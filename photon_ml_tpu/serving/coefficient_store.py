@@ -1,0 +1,305 @@
+"""Device-resident, versioned entity coefficient store.
+
+Photon ML reference counterpart: the PalDB off-heap key-value stores LinkedIn
+publishes GLMix models into for online serving (one store per random-effect
+coordinate, entity id -> sparse coefficient vector; see PAPER.md §"model
+deployment"), plus the broadcast fixed-effect coefficients.  TPU-native
+shape: the per-coordinate "KV store" is a dense ``jnp`` table
+``[hot_entities, d]`` resident in device memory, indexed by slot through the
+same entity-id machinery training uses (``data/reader.EntityIndex`` for
+string id -> int, ``game/coordinate._slots_from`` semantics for id -> row),
+so scoring a micro-batch is one gather instead of per-request KV lookups.
+
+Entities beyond the device budget ("cold" — the long tail of a
+millions-of-entities random effect) stay host-side and are resolved through
+an LRU-fronted fallback: their coefficient rows are gathered per batch into
+a tiny overflow buffer that the engine scores with the same contraction the
+device table uses, so hot and cold entities produce bitwise-identical
+scores.  Unknown entities score 0, exactly like the batch path
+(RandomEffectModel.score missing-entity convention).
+
+Stores are immutable and versioned: hot swap (serving/swap.py) builds a new
+store from a new model directory and flips the engine's generation pointer;
+in-flight requests keep scoring against the store they started with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+_generation = itertools.count(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreConfig:
+    """Build-time knobs, carried on the store so hot swap rebuilds the next
+    version with identical policy (serving/swap.py).
+
+    ``device_capacity``: max entity rows resident on device per coordinate
+    (None = all — the small-model default).  Hot entities are the FIRST rows
+    of the training-order stack; a frequency-ranked hot set is a follow-on
+    (ROADMAP).  ``lru_capacity``: host-side LRU entries per coordinate for
+    cold rows.  ``x_dtype``: request feature dtype (float32, matching
+    data/reader's default design dtype — part of the bitwise-parity
+    contract with batch scoring)."""
+
+    device_capacity: Optional[int] = None
+    lru_capacity: int = 4096
+    x_dtype: np.dtype = np.float32
+
+
+class ColdEntityCache:
+    """LRU front for cold-entity coefficient rows.
+
+    ``fetch_row`` abstracts the backing archive (here: the host copy of the
+    model's coefficient stack; in a production deployment: mmap/disk — the
+    PalDB page-cache analog).  The LRU makes repeat lookups of a recently
+    seen cold entity O(1) without re-touching the archive."""
+
+    def __init__(self, fetch_row: Callable[[int], Optional[np.ndarray]],
+                 capacity: int, metrics: Optional[ServingMetrics] = None):
+        self._fetch = fetch_row
+        self._capacity = max(1, capacity)
+        self._lru: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._metrics = metrics
+
+    def get(self, entity_id: int) -> Optional[np.ndarray]:
+        row = self._lru.get(entity_id)
+        if row is not None:
+            self._lru.move_to_end(entity_id)
+            if self._metrics is not None:
+                self._metrics.inc("lru_hits")
+            return row
+        row = self._fetch(entity_id)
+        if row is None:
+            return None
+        if self._metrics is not None:
+            self._metrics.inc("cold_fetches")
+        self._lru[entity_id] = row
+        if len(self._lru) > self._capacity:
+            self._lru.popitem(last=False)
+            if self._metrics is not None:
+                self._metrics.inc("lru_evictions")
+        return row
+
+
+@dataclasses.dataclass
+class FixedCoordinate:
+    """Broadcast fixed-effect weights (reference FixedEffectModel broadcast)."""
+
+    cid: str
+    feature_shard: str
+    weights: Array  # [d], device-resident
+
+
+@dataclasses.dataclass
+class RandomCoordinate:
+    """One random-effect coordinate's device table + host fallback."""
+
+    cid: str
+    feature_shard: str
+    random_effect_type: str
+    table: Array              # [hot, d] device-resident hot rows
+    dim: int
+    hot_slot_of: Dict[int, int]   # entity id -> device row (slot < hot)
+    cold: ColdEntityCache         # entity id -> host row for slot >= hot
+    num_entities: int             # hot + cold
+
+    @property
+    def hot_entities(self) -> int:
+        return self.table.shape[0]
+
+
+class CoefficientStore:
+    """One immutable model version, device-ready (see module docstring)."""
+
+    def __init__(self, task: TaskType,
+                 coordinates: Dict[str, Union[FixedCoordinate,
+                                              RandomCoordinate]],
+                 entity_indexes: Dict[str, EntityIndex],
+                 index_maps: Dict[str, "IndexMap"],
+                 shard_dims: Dict[str, int],
+                 config: StoreConfig,
+                 version: str = ""):
+        self.task = task
+        self.coordinates = coordinates
+        self.order: List[str] = list(coordinates)  # additive-score order
+        self.entity_indexes = entity_indexes
+        self.index_maps = index_maps
+        self.shard_dims = shard_dims
+        self.config = config
+        self.version = version
+        self.generation = next(_generation)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_bundle(cls, bundle, config: Optional[StoreConfig] = None,
+                    version: str = "",
+                    metrics: Optional[ServingMetrics] = None,
+                    ) -> "CoefficientStore":
+        """Build from a storage/model_io.ModelBundle (the load_model_bundle
+        result) — the path both cold start (cli/serve.py) and hot swap
+        (serving/swap.py) share."""
+        return cls.from_model(bundle.model, bundle.task,
+                              bundle.entity_indexes, bundle.index_maps,
+                              config=config,
+                              version=version or bundle.model_dir,
+                              metrics=metrics)
+
+    @classmethod
+    def from_model(cls, model: GameModel, task: TaskType,
+                   entity_indexes: Dict[str, EntityIndex],
+                   index_maps: Dict[str, "IndexMap"],
+                   config: Optional[StoreConfig] = None,
+                   version: str = "",
+                   metrics: Optional[ServingMetrics] = None,
+                   ) -> "CoefficientStore":
+        config = config or StoreConfig()
+        coordinates: Dict[str, Union[FixedCoordinate, RandomCoordinate]] = {}
+        shard_dims: Dict[str, int] = {}
+
+        def _shard_dim(shard: str, d: int, cid: str) -> None:
+            have = shard_dims.setdefault(shard, d)
+            if have != d:
+                raise ValueError(
+                    f"coordinate {cid!r}: shard {shard!r} width {d} "
+                    f"conflicts with another coordinate's {have}")
+
+        for cid, m in model.models.items():
+            if isinstance(m, FixedEffectModel):
+                w = np.asarray(m.coefficients.means)
+                _shard_dim(m.feature_shard, w.shape[-1], cid)
+                coordinates[cid] = FixedCoordinate(
+                    cid=cid, feature_shard=m.feature_shard,
+                    weights=jnp.asarray(w))
+            elif isinstance(m, RandomEffectModel):
+                w_stack = np.asarray(m.w_stack)
+                n_ent, d = w_stack.shape
+                _shard_dim(m.feature_shard, d, cid)
+                hot = n_ent if config.device_capacity is None else min(
+                    config.device_capacity, n_ent)
+                # device table = the first `hot` stack rows; colder rows stay
+                # host-side behind the LRU (full stack kept as the archive —
+                # host RAM is the PalDB store, device HBM holds the hot set).
+                # The table keeps at least one row: score_samples clamps
+                # missing slots to row 0, which must exist to gather from
+                # (an all-cold or entity-less coordinate serves a zero row).
+                if hot < 1:
+                    hot = 0
+                    table = jnp.zeros((1, d), w_stack.dtype)
+                else:
+                    table = jnp.asarray(w_stack[:hot] if hot < n_ent
+                                        else w_stack)
+                hot_slot_of = {eid: s for eid, s in m.slot_of.items()
+                               if s < hot}
+                cold_slot_of = {eid: s for eid, s in m.slot_of.items()
+                                if s >= hot}
+
+                def _fetch(eid: int, _stack=w_stack, _cold=cold_slot_of
+                           ) -> Optional[np.ndarray]:
+                    slot = _cold.get(eid)
+                    return None if slot is None else _stack[slot]
+
+                coordinates[cid] = RandomCoordinate(
+                    cid=cid, feature_shard=m.feature_shard,
+                    random_effect_type=m.random_effect_type,
+                    table=table, dim=d, hot_slot_of=hot_slot_of,
+                    cold=ColdEntityCache(_fetch, config.lru_capacity,
+                                         metrics),
+                    num_entities=n_ent)
+            else:
+                raise ValueError(
+                    f"coordinate {cid!r}: serving supports FixedEffectModel "
+                    f"and dense RandomEffectModel (got {type(m).__name__}); "
+                    "convert compact models with .to_dense(), or see "
+                    "ROADMAP's sparse-serving follow-on")
+        for shard, d in shard_dims.items():
+            imap = index_maps.get(shard)
+            if imap is None:
+                raise ValueError(
+                    f"feature shard {shard!r} has no index map — requests "
+                    "cannot be densified without it")
+            if imap.size != d:
+                raise ValueError(
+                    f"feature shard {shard!r}: index map has {imap.size} "
+                    f"features but the model expects {d} — wrong index map "
+                    "for this model version")
+        return cls(task=task, coordinates=coordinates,
+                   entity_indexes=entity_indexes, index_maps=index_maps,
+                   shard_dims=shard_dims, config=config, version=version)
+
+    # -- shape signature (compiled-executable cache key) -------------------
+    def signature(self) -> Tuple:
+        """Everything that determines compiled-kernel shapes/dtypes.  Two
+        model versions with an equal signature share AOT executables, which
+        is what makes same-shape hot swaps recompile-free."""
+        parts = []
+        for cid in self.order:
+            c = self.coordinates[cid]
+            if isinstance(c, FixedCoordinate):
+                parts.append(("fixed", cid, c.feature_shard,
+                              c.weights.shape, str(c.weights.dtype)))
+            else:
+                parts.append(("random", cid, c.feature_shard,
+                              c.table.shape, str(c.table.dtype)))
+        return (tuple(parts), tuple(sorted(self.shard_dims.items())),
+                str(np.dtype(self.config.x_dtype)))
+
+    # -- lookups -----------------------------------------------------------
+    def entity_id(self, re_type: str, name: Optional[str]) -> int:
+        """Entity string -> trained int id; -1 when unknown.  READ-ONLY:
+        serving must never grow the training-time index."""
+        if name is None:
+            return -1
+        eidx = self.entity_indexes.get(re_type)
+        return -1 if eidx is None else eidx.get(str(name))
+
+    def resolve(self, cid: str, entity_names: Sequence[Optional[str]],
+                metrics: Optional[ServingMetrics] = None
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-sample (device slots, cold overflow rows) for one coordinate.
+
+        ``slots[i]``: device-table row of sample i's entity, or -1 (cold or
+        unknown — the device kernel scores those 0, the reference's missing-
+        entity convention).  ``overflow[i]``: the cold entity's host
+        coefficient row (zeros for hot/unknown samples); the engine adds
+        ``einsum('nd,nd->n', x, overflow)`` so a cold entity scores exactly
+        as if its row were in the device table."""
+        c = self.coordinates[cid]
+        n = len(entity_names)
+        slots = np.full(n, -1, np.int32)
+        overflow = np.zeros((n, c.dim), c.table.dtype)
+        misses = 0
+        for i, name in enumerate(entity_names):
+            eid = self.entity_id(c.random_effect_type, name)
+            if eid < 0:
+                misses += 1
+                continue
+            slot = c.hot_slot_of.get(eid)
+            if slot is not None:
+                slots[i] = slot
+                continue
+            row = c.cold.get(eid)
+            if row is None:
+                misses += 1
+            else:
+                overflow[i] = row
+        if metrics is not None and misses:
+            metrics.inc("entity_misses", misses)
+        return slots, overflow

@@ -1,0 +1,208 @@
+"""AOT-compiled online scoring engine.
+
+Photon ML reference counterpart: transformers/GameTransformer.scala — score
+a prepared dataset with a GameModel by summing per-coordinate scores.  The
+online twin differs in three accelerator-driven ways:
+
+  1. **AOT compilation.**  Every (model-shape-signature, bucket-size) pair
+     is lowered and compiled ONCE up front (``jax.jit(...).lower(...)
+     .compile()``); requests only ever call finished executables, so the
+     tail latency of a first-compile (tens of seconds on TPU) can never
+     land on a user request.  Per-request input buffers are donated to the
+     executable on accelerator backends (the coefficient tables are NOT —
+     they are reused across every request of a model generation).
+  2. **Bucketed shapes.**  The batcher pads each micro-batch to a fixed
+     ladder of bucket sizes, so the executable cache stays small and the
+     second-and-later request at any bucket size triggers zero recompiles
+     (``compile_count`` exposes this for tests/monitoring).
+  3. **Composition parity.**  The kernel composes per-coordinate margins
+     with the SAME ``game/scoring.additive_total`` and the same contraction
+     primitives (``parallel/bucketing.score_samples``, ``x @ w``) the batch
+     path uses, so serving scores are bitwise the ``GameTransformer`` batch
+     scores — the property test in tests/test_serving.py holds this line.
+
+Hot swap: ``activate`` flips the generation pointer atomically; requests
+already scoring keep the store they snapshotted (serving/swap.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.scoring import additive_total, output_scores
+from photon_ml_tpu.parallel.bucketing import score_samples
+from photon_ml_tpu.serving.batcher import (BucketedBatcher, Request,
+                                           densify_features)
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     FixedCoordinate)
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.utils.logging import Timed
+
+Array = jax.Array
+
+
+def _cold_margin(x: Array, overflow: Array) -> Array:
+    """Cold-entity contribution: the same per-row contraction
+    ``score_samples`` applies to device-table rows, on host-gathered rows
+    (zeros for hot/unknown samples -> adds exactly 0.0)."""
+    return jnp.einsum("nd,nd->n", x, overflow)
+
+
+class ScoringEngine:
+    """Low-latency scorer over a CoefficientStore (see module docstring)."""
+
+    def __init__(self, store: CoefficientStore,
+                 batcher: Optional[BucketedBatcher] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self._store = store
+        self.batcher = batcher or BucketedBatcher()
+        self.metrics = metrics or ServingMetrics()
+        self._lock = threading.Lock()
+        self._executables: Dict[Tuple, object] = {}
+        self.compile_count = 0
+
+    # -- generation management (hot swap) ----------------------------------
+    @property
+    def store(self) -> CoefficientStore:
+        return self._store
+
+    def activate(self, store: CoefficientStore) -> CoefficientStore:
+        """Atomically flip the serving generation; returns the old store.
+        In-flight requests snapshotted the old store and finish on it."""
+        with self._lock:
+            old, self._store = self._store, store
+            # executables for generations other than (old, new) can never be
+            # reached again — drop them so repeated swaps stay bounded
+            keep = {old.signature(), store.signature()}
+            self._executables = {k: v for k, v in self._executables.items()
+                                 if k[0] in keep}
+        self.metrics.inc("activations")
+        return old
+
+    # -- compilation -------------------------------------------------------
+    def warm(self, buckets: Optional[Sequence[int]] = None,
+             store: Optional[CoefficientStore] = None) -> int:
+        """Compile executables for ``buckets`` (default: the batcher's whole
+        ladder) against ``store`` (default: active).  Returns how many were
+        newly compiled.  Hot swap warms the NEW store here before flipping
+        the pointer, so no request ever waits on a compile."""
+        store = store or self._store
+        buckets = tuple(buckets) if buckets is not None \
+            else self.batcher.bucket_sizes
+        before = self.compile_count
+        with Timed(f"serving.warm gen{store.generation}",
+                   sink=self.metrics.phase):
+            for b in buckets:
+                self._executable(store, b)
+        return self.compile_count - before
+
+    def _abstract_args(self, store: CoefficientStore, bucket: int):
+        """ShapeDtypeStructs matching _concrete_args."""
+        s = jax.ShapeDtypeStruct
+        x_dt = np.dtype(store.config.x_dtype)
+        xs = {shard: s((bucket, d), x_dt)
+              for shard, d in store.shard_dims.items()}
+        fixed_ws, tables, slots, overflows = [], [], [], []
+        for cid in store.order:
+            c = store.coordinates[cid]
+            if isinstance(c, FixedCoordinate):
+                fixed_ws.append(s(c.weights.shape, c.weights.dtype))
+            else:
+                tables.append(s(c.table.shape, c.table.dtype))
+                slots.append(s((bucket,), np.dtype(np.int32)))
+                overflows.append(s((bucket, c.dim), c.table.dtype))
+        return xs, fixed_ws, tables, slots, overflows
+
+    def _build_fn(self, store: CoefficientStore, bucket: int):
+        order = list(store.order)
+        kinds = [(cid, isinstance(store.coordinates[cid], FixedCoordinate),
+                  store.coordinates[cid].feature_shard) for cid in order]
+
+        def fn(xs, fixed_ws, tables, slots, overflows):
+            margins = []
+            fi = ri = 0
+            for cid, is_fixed, shard in kinds:
+                x = xs[shard]
+                if is_fixed:
+                    # == models/glm.Coefficients.score (x @ means)
+                    margins.append(x @ fixed_ws[fi])
+                    fi += 1
+                else:
+                    m = score_samples(tables[ri], slots[ri], x)
+                    margins.append(m + _cold_margin(x, overflows[ri]))
+                    ri += 1
+            # the ONE additive composition (game/scoring.py) — shared with
+            # GameModel.score so batch and serving totals cannot drift
+            return additive_total(bucket, margins)
+
+        return fn
+
+    def _executable(self, store: CoefficientStore, bucket: int):
+        key = (store.signature(), bucket)
+        exe = self._executables.get(key)
+        if exe is not None:
+            return exe
+        fn = self._build_fn(store, bucket)
+        # donate the per-request buffers (features, slots, overflow) — they
+        # are rebuilt every request, so the executable may reuse their
+        # device memory for outputs; coefficient tables (argnums 1, 2) live
+        # across requests and must NOT be donated.  CPU has no donation
+        # support (it would only warn), so gate on backend.
+        donate = (0, 3, 4) if jax.default_backend() != "cpu" else ()
+        jitted = jax.jit(fn, donate_argnums=donate)
+        lowered = jitted.lower(*self._abstract_args(store, bucket))
+        exe = lowered.compile()
+        with self._lock:
+            self._executables[key] = exe
+        self.compile_count += 1
+        self.metrics.inc("compiles")
+        return exe
+
+    # -- scoring -----------------------------------------------------------
+    def score_requests(self, requests: Sequence[Request],
+                       predict_mean: bool = False) -> np.ndarray:
+        """Score a request list; returns one score per request (raw margin +
+        offset, or the task's inverse-link mean with ``predict_mean`` — the
+        same output contract as cli/score.py)."""
+        store = self._store  # snapshot: finish on one generation
+        n = len(requests)
+        self.metrics.inc("requests", n)
+        if n == 0:
+            return np.zeros(0)
+        out: Optional[np.ndarray] = None
+        for mb in self.batcher.plan(n):
+            t0 = time.perf_counter()
+            chunk = requests[mb.start:mb.stop]
+            scores = self._score_chunk(store, chunk, mb.bucket)
+            if out is None:
+                out = np.empty(n, scores.dtype)
+            out[mb.start:mb.stop] = scores[: mb.real_rows]
+            self.metrics.observe_batch(mb.bucket, mb.real_rows,
+                                       time.perf_counter() - t0)
+        raw = out + np.asarray([r.offset for r in requests], out.dtype)
+        return output_scores(raw, store.task, predict_mean=predict_mean)
+
+    def _score_chunk(self, store: CoefficientStore,
+                     chunk: Sequence[Request], bucket: int) -> np.ndarray:
+        exe = self._executable(store, bucket)
+        xs = densify_features(chunk, store.index_maps, bucket,
+                              dtype=store.config.x_dtype)
+        fixed_ws, tables, slots, overflows = [], [], [], []
+        for cid in store.order:
+            c = store.coordinates[cid]
+            if isinstance(c, FixedCoordinate):
+                fixed_ws.append(c.weights)
+            else:
+                names = [r.ids.get(c.random_effect_type) for r in chunk]
+                names += [None] * (bucket - len(chunk))  # padding: slot -1
+                sl, ov = store.resolve(cid, names, metrics=self.metrics)
+                tables.append(c.table)
+                slots.append(sl)
+                overflows.append(ov)
+        return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))

@@ -1,0 +1,173 @@
+"""Serving metrics registry: latency histograms, QPS, padding waste, swaps.
+
+Photon ML reference counterpart: the Spark batch scorer has no online
+metrics surface; the closest analogs are the reference's Timed{} phase logs
+(util/Timed.scala) and the PalDB store's hit accounting that LinkedIn's
+serving stack layers on top of the published GLMix artifacts.  Here the
+registry is first-class: every serving component (coefficient store,
+batcher, engine, hot swap) reports into ONE thread-safe object exported as
+JSON, and phase timings flow in through ``utils/logging.Timed``'s ``sink``
+hook so the serving path and the offline drivers share one timing idiom.
+
+Metric families:
+  - counters: requests, batches, scored samples, entity misses (unknown
+    entity -> score 0), cold fetches / LRU hits (host fallback), compiles,
+    swaps / swap failures;
+  - per-bucket latency histograms (log-spaced bins, p50/p99/max) keyed by
+    padded bucket size, plus padded-row accounting for the padding-waste
+    ratio (padded rows / total padded capacity);
+  - phase durations (warm, swap) via the Timed sink.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+# Log-spaced latency bin upper bounds: 1us .. ~67s, factor 2 per bin.  Fixed
+# bins (not reservoirs) so concurrent recording is O(1), allocation-free,
+# and snapshots are mergeable across processes.
+_BOUNDS = tuple(1e-6 * (2.0 ** i) for i in range(27))
+
+
+class LatencyHistogram:
+    """Fixed-bin latency histogram with percentile estimates.
+
+    Percentiles interpolate inside the containing bin (log-linear would be
+    marginally better; linear keeps the math obvious and the error is
+    bounded by one 2x bin).
+    """
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(_BOUNDS) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        lo, hi = 0, len(_BOUNDS)
+        while lo < hi:  # first bin whose bound >= seconds
+            mid = (lo + hi) // 2
+            if _BOUNDS[mid] < seconds:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.counts[lo] += 1
+        self.count += 1
+        self.total += seconds
+        self.min = min(self.min, seconds)
+        self.max = max(self.max, seconds)
+
+    def percentile(self, p: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = p * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= target and c > 0:
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else self.max
+                lo = _BOUNDS[i - 1] if i > 0 else 0.0
+                frac = (target - seen) / c
+                return min(lo + frac * (hi - lo), self.max)
+            seen += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": self.total / self.count if self.count else 0.0,
+            "p50_s": self.percentile(0.50),
+            "p99_s": self.percentile(0.99),
+            "min_s": self.min if self.count else 0.0,
+            "max_s": self.max,
+        }
+
+
+class ServingMetrics:
+    """Thread-safe registry shared by every serving component.
+
+    All mutators take the one lock — serving requests, the background swap
+    thread, and metrics exports may interleave freely.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._latency: Dict[str, LatencyHistogram] = {}
+        self._phases: Dict[str, float] = {}
+        self._padded_capacity = 0  # sum of bucket sizes actually launched
+        self._real_rows = 0        # real (unpadded) rows inside them
+        self._started = time.time()
+
+    # -- mutators ----------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe_latency(self, key: str, seconds: float) -> None:
+        with self._lock:
+            h = self._latency.get(key)
+            if h is None:
+                h = self._latency[key] = LatencyHistogram()
+            h.record(seconds)
+
+    def observe_batch(self, bucket: int, real_rows: int, seconds: float) -> None:
+        """One launched micro-batch: ``bucket`` padded rows, ``real_rows``
+        live ones, per-request latency credited to every live row."""
+        with self._lock:
+            self._counters["batches"] = self._counters.get("batches", 0) + 1
+            self._counters["scored_samples"] = (
+                self._counters.get("scored_samples", 0) + real_rows)
+            self._padded_capacity += bucket
+            self._real_rows += real_rows
+            key = f"bucket_{bucket}"
+            h = self._latency.get(key)
+            if h is None:
+                h = self._latency[key] = LatencyHistogram()
+            h.record(seconds)
+
+    def phase(self, label: str, seconds: float) -> None:
+        """``utils/logging.Timed`` sink: cumulative wall time per phase."""
+        with self._lock:
+            self._phases[label] = self._phases.get(label, 0.0) + seconds
+
+    # -- views -------------------------------------------------------------
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    @property
+    def padding_waste_ratio(self) -> float:
+        """Fraction of launched device rows that were padding."""
+        with self._lock:
+            if self._padded_capacity == 0:
+                return 0.0
+            return 1.0 - self._real_rows / self._padded_capacity
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            uptime = max(time.time() - self._started, 1e-9)
+            requests = self._counters.get("requests", 0)
+            waste = (1.0 - self._real_rows / self._padded_capacity
+                     if self._padded_capacity else 0.0)
+            return {
+                "counters": dict(self._counters),
+                "qps": requests / uptime,
+                "uptime_s": uptime,
+                "padding_waste_ratio": waste,
+                "padded_rows_launched": self._padded_capacity,
+                "real_rows_launched": self._real_rows,
+                "latency": {k: h.snapshot()
+                            for k, h in sorted(self._latency.items())},
+                "phases_s": dict(self._phases),
+            }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent)
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json(indent=2))

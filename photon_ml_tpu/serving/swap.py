@@ -1,0 +1,87 @@
+"""Atomic hot model reload.
+
+Photon ML reference counterpart: LinkedIn's GLMix serving rotates newly
+trained model artifacts into the online stores (new PalDB store files built
+offline, then the serving layer cuts over) — the batch repo itself has no
+in-process swap, so this module is the piece the paper describes but the
+reference leaves to infrastructure.
+
+Protocol (all failure paths leave the OLD version serving):
+
+  1. load the new model directory through
+     ``storage/model_io.load_model_bundle`` — every structural problem
+     (missing metadata.json / ``*.idx`` / ``*.entities.json``, corrupt
+     files) surfaces as the typed ``ModelLoadError``, never a raw
+     ``KeyError``;
+  2. build a fresh ``CoefficientStore`` under the SAME StoreConfig policy
+     as the active generation;
+  3. **warm** the new store: compile executables for the bucket ladder so
+     no post-swap request pays a compile (same-shape versions reuse the old
+     executables outright — the signature cache key makes that free);
+  4. flip the engine's generation pointer atomically
+     (``ScoringEngine.activate``).  In-flight requests snapshotted the old
+     store and finish on it.
+
+``swap`` is synchronous; ``swap_async`` runs the same protocol on a
+background thread (the load/warm work happens off the request path either
+way — only the pointer flip touches the engine).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+from photon_ml_tpu.serving.coefficient_store import CoefficientStore
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.storage.model_io import ModelLoadError, load_model_bundle
+from photon_ml_tpu.utils.logging import Timed
+
+logger = logging.getLogger("photon_ml_tpu.serving.swap")
+
+
+class HotSwapper:
+    """Load-warm-flip model rotation for one ScoringEngine."""
+
+    def __init__(self, engine: ScoringEngine,
+                 warm_buckets: Optional[Sequence[int]] = None):
+        self.engine = engine
+        self.warm_buckets = warm_buckets  # None -> the batcher's ladder
+        self._swap_lock = threading.Lock()  # one swap in flight at a time
+
+    def swap(self, model_dir: str, version: str = "") -> bool:
+        """Returns True when the new version is serving; False when the new
+        directory was rejected (the old version keeps serving untouched)."""
+        metrics = self.engine.metrics
+        with self._swap_lock:
+            old = self.engine.store
+            try:
+                with Timed(f"serving.swap.load {model_dir}", logger,
+                           sink=metrics.phase):
+                    bundle = load_model_bundle(model_dir)
+                    new = CoefficientStore.from_bundle(
+                        bundle, config=old.config,
+                        version=version or model_dir, metrics=metrics)
+                self.engine.warm(self.warm_buckets, store=new)
+            except (ModelLoadError, ValueError) as e:
+                metrics.inc("swap_failures")
+                logger.error("hot swap rejected %s (still serving gen %d, "
+                             "version %r): %s", model_dir, old.generation,
+                             old.version, e)
+                return False
+            self.engine.activate(new)
+            metrics.inc("swaps")
+            logger.info("hot swap: gen %d (version %r) -> gen %d (version "
+                        "%r)", old.generation, old.version, new.generation,
+                        new.version)
+            return True
+
+    def swap_async(self, model_dir: str, version: str = "") -> threading.Thread:
+        """Run ``swap`` on a daemon thread; returns the thread (join it to
+        observe completion).  Requests keep flowing on the old generation
+        until the flip."""
+        t = threading.Thread(target=self.swap, args=(model_dir, version),
+                             daemon=True, name="photon-serving-swap")
+        t.start()
+        return t

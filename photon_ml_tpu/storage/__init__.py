@@ -1,6 +1,9 @@
 from photon_ml_tpu.storage.model_io import (  # noqa: F401
+    ModelBundle,
+    ModelLoadError,
     save_game_model,
     load_game_model,
+    load_model_bundle,
     save_glm_text,
 )
 from photon_ml_tpu.storage.checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

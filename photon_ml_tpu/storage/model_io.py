@@ -12,6 +12,7 @@ the reference maintains (feature-index remapping, save:77-141 / load:143-265).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Dict, Optional, Tuple
@@ -27,6 +28,17 @@ from photon_ml_tpu.models.glm import Coefficients
 from photon_ml_tpu.types import TaskType
 
 FORMAT_VERSION = 1
+
+
+class ModelLoadError(RuntimeError):
+    """A model directory is missing or structurally broken (no metadata.json,
+    no ``<shard>.idx``/``.phidx`` index maps, no ``<tag>.entities.json``
+    entity indexes, or a coordinate referencing an absent shard map).
+
+    One typed error so callers that must fail CLEANLY — above all the
+    serving hot-swap path (serving/swap.py), which keeps the old model
+    serving when the new directory is corrupt — can catch model-loading
+    problems without fishing for raw ``KeyError``/``FileNotFoundError``."""
 
 # JVM class the reference's loader instantiates via Class.forName(modelClass)
 # (AvroUtils.scala:382-413).  Exported models MUST carry one of these names or
@@ -452,9 +464,20 @@ def load_game_model(
     index_maps: Dict[str, IndexMap],
     entity_indexes: Optional[Dict[str, EntityIndex]] = None,
 ) -> Tuple[GameModel, TaskType]:
-    with open(os.path.join(model_dir, "metadata.json")) as f:
-        meta = json.load(f)
-    task = TaskType(meta["task"])
+    meta_path = os.path.join(model_dir, "metadata.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise ModelLoadError(
+            f"{model_dir!r} is not a model directory: missing metadata.json "
+            "(expected a dir written by save_game_model, e.g. <output>/best)")
+    except ValueError as e:  # json.JSONDecodeError
+        raise ModelLoadError(f"{meta_path!r} is corrupt: {e}")
+    try:
+        task = TaskType(meta["task"])
+    except (KeyError, ValueError) as e:
+        raise ModelLoadError(f"{meta_path!r} has no valid task entry: {e}")
     entity_indexes = entity_indexes or {}
     models: Dict[str, object] = {}
 
@@ -543,7 +566,12 @@ def load_game_model(
 
     for cid, info in meta["coordinates"].items():
         shard = info["feature_shard"]
-        imap = index_maps[shard]
+        imap = index_maps.get(shard)
+        if imap is None:
+            raise ModelLoadError(
+                f"coordinate {cid!r} needs the index map for feature shard "
+                f"{shard!r} — the model directory (or its parent) is missing "
+                f"{shard}.idx/{shard}.phidx")
         if info["type"] == "fixed":
             path = os.path.join(model_dir, "fixed-effect", cid, "coefficients.avro")
             coeff = _read_fixed_avro_fast(path, imap)
@@ -566,6 +594,100 @@ def load_game_model(
                 w_stack=w, slot_of=slot_of, random_effect_type=re_type,
                 feature_shard=shard, task=task, variances=variances)
     return GameModel(models=models), task
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    """Everything needed to score with a trained model: the model itself,
+    its task, and the feature/entity indexes saved alongside it."""
+
+    model: GameModel
+    task: TaskType
+    index_maps: Dict[str, IndexMap]
+    entity_indexes: Dict[str, EntityIndex]
+    model_dir: str  # the resolved dir holding metadata.json
+
+
+def load_model_bundle(model_dir: str) -> ModelBundle:
+    """Load a training-output directory as one scoring-ready bundle.
+
+    Accepts either the training output dir (``<dir>/best/metadata.json`` +
+    ``<dir>/<shard>.idx`` + ``<dir>/<tag>.entities.json``) or a model dir
+    itself (``<dir>/metadata.json``, artifacts alongside).  This is the ONE
+    resolution path shared by the batch scorer (cli/score.py), the online
+    scorer (cli/serve.py), and hot model swap (serving/swap.py) — every
+    failure mode raises :class:`ModelLoadError` with an actionable message,
+    never a raw ``KeyError``/``FileNotFoundError``, because the swap path
+    must distinguish "new model dir is broken, keep serving the old one"
+    from a programming error.
+    """
+    if not os.path.isdir(model_dir):
+        raise ModelLoadError(f"model dir {model_dir!r} does not exist")
+    sub = os.path.join(model_dir, "best")
+    if os.path.exists(os.path.join(model_dir, "metadata.json")):
+        mdir = model_dir
+        # artifacts may sit beside metadata.json (direct dir) or one level
+        # up (the training layout's <out>/best); scan both, best-dir first
+        scan_dirs = [model_dir, os.path.dirname(os.path.abspath(model_dir))]
+    elif os.path.exists(os.path.join(sub, "metadata.json")):
+        mdir = sub
+        scan_dirs = [sub, model_dir]
+    else:
+        raise ModelLoadError(
+            f"{model_dir!r} holds no model: neither metadata.json nor "
+            "best/metadata.json exists (expected a training --output-dir or "
+            "a save_game_model directory)")
+
+    from photon_ml_tpu.data.index_map import load_index
+
+    index_maps: Dict[str, IndexMap] = {}
+    entity_indexes: Dict[str, EntityIndex] = {}
+    for d in scan_dirs:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        for name in sorted(names):
+            path = os.path.join(d, name)
+            if name.endswith((".idx", ".phidx")):
+                shard = name.rsplit(".", 1)[0]
+                if shard not in index_maps:
+                    try:
+                        index_maps[shard] = load_index(path)
+                    except (OSError, ValueError) as e:
+                        raise ModelLoadError(
+                            f"index map {path!r} is unreadable: {e}")
+            elif name.endswith(".entities.json"):
+                tag = name[: -len(".entities.json")]
+                if tag not in entity_indexes:
+                    try:
+                        entity_indexes[tag] = EntityIndex.load(path)
+                    except (OSError, ValueError) as e:
+                        raise ModelLoadError(
+                            f"entity index {path!r} is unreadable: {e}")
+
+    # Pre-flight BEFORE decoding coefficients: a random-effect coordinate
+    # without its <tag>.entities.json would otherwise fail deep inside the
+    # loader (or worse, load with unresolvable entity names)
+    meta_path = os.path.join(mdir, "metadata.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ModelLoadError(f"{meta_path!r} is unreadable: {e}")
+    for cid, info in (meta.get("coordinates") or {}).items():
+        re_type = info.get("random_effect_type")
+        if info.get("type") == "random" and re_type not in (None, ""):
+            if re_type not in entity_indexes:
+                raise ModelLoadError(
+                    f"coordinate {cid!r} is a random effect over {re_type!r} "
+                    f"but {re_type}.entities.json was not found next to the "
+                    f"model (searched {scan_dirs}) — entity names cannot be "
+                    "resolved")
+
+    model, task = load_game_model(mdir, index_maps, entity_indexes)
+    return ModelBundle(model=model, task=task, index_maps=index_maps,
+                       entity_indexes=entity_indexes, model_dir=mdir)
 
 
 def save_glm_text(model: FixedEffectModel, index_map: IndexMap, path: str) -> None:
