@@ -1,0 +1,29 @@
+"""photonlint: JAX/TPU-aware static analysis for this codebase.
+
+Entry points:
+  - ``python -m tools.photonlint photon_ml_tpu/`` — the CLI gate;
+  - ``tests/test_photonlint.py`` — the tier-1 wiring (fails on any
+    non-baselined violation);
+  - :func:`run_analysis` / :func:`analyze_source` — the library API.
+
+See analysis/rules/__init__.py for the rule catalog and README "Static
+analysis" for the suppression/baseline workflow.
+"""
+
+from photon_ml_tpu.analysis.framework import (AnalysisResult, ModuleContext,
+                                              Rule, Violation, analyze_source,
+                                              build_rules, register,
+                                              registered_rules, run_analysis)
+from photon_ml_tpu.analysis.baseline import (BaselineError, empty_baseline,
+                                             load_baseline, make_baseline,
+                                             partition, save_baseline)
+from photon_ml_tpu.analysis.reporters import render_json, render_text
+
+__all__ = [
+    "AnalysisResult", "ModuleContext", "Rule", "Violation",
+    "analyze_source", "build_rules", "register", "registered_rules",
+    "run_analysis",
+    "BaselineError", "empty_baseline", "load_baseline", "make_baseline",
+    "partition", "save_baseline",
+    "render_json", "render_text",
+]

@@ -1,0 +1,90 @@
+"""Baseline file: accepted pre-existing debt, committed to the repo.
+
+The gate (tools/photonlint.py, tests/test_photonlint.py) fails only on
+violations whose fingerprint is NOT in the baseline — so landing the linter
+does not require fixing every historical finding at once, while any NEW
+violation fails tier-1 immediately.  Entries carry the human-readable
+finding alongside the fingerprint so reviewers can audit the debt; stale
+entries (fingerprints no longer produced) are reported so the baseline
+shrinks monotonically instead of accreting.
+
+Fingerprints (framework.Violation.fingerprint) hash rule, path, message and
+the stripped source line — not the line number — so pure renumbering edits
+don't invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from photon_ml_tpu.analysis.framework import Violation
+
+FORMAT_VERSION = 1
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, wrong version/shape)."""
+
+
+def empty_baseline() -> dict:
+    return {"version": FORMAT_VERSION, "entries": {}}
+
+
+def make_baseline(violations: Iterable[Violation]) -> dict:
+    entries = {}
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.col)):
+        entries[v.fingerprint()] = {
+            "rule": v.rule, "code": v.code, "path": v.path,
+            "message": v.message, "snippet": v.snippet.strip(),
+            "occurrence": v.occurrence,
+        }
+    return {"version": FORMAT_VERSION, "entries": entries}
+
+
+def save_baseline(baseline: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> dict:
+    if not os.path.exists(path):
+        return empty_baseline()
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"baseline {path}: invalid JSON: {e}") from e
+    if not isinstance(data, dict) or "entries" not in data:
+        raise BaselineError(f"baseline {path}: expected "
+                            "{{'version': ..., 'entries': {{...}}}}")
+    if data.get("version") != FORMAT_VERSION:
+        raise BaselineError(f"baseline {path}: unsupported version "
+                            f"{data.get('version')!r} (want {FORMAT_VERSION})")
+    if not isinstance(data["entries"], dict):
+        raise BaselineError(f"baseline {path}: 'entries' must be an object")
+    return data
+
+
+def partition(violations: Sequence[Violation], baseline: dict
+              ) -> Tuple[List[Violation], List[Violation], List[str]]:
+    """Split findings against a baseline.
+
+    Returns ``(new, baselined, stale_fingerprints)`` — ``new`` fails the
+    gate, ``baselined`` is accepted debt, ``stale_fingerprints`` are
+    baseline entries nothing matched (fixed debt; prune them)."""
+    entries: Dict[str, dict] = baseline.get("entries", {})
+    new: List[Violation] = []
+    matched: List[Violation] = []
+    seen = set()
+    for v in violations:
+        fp = v.fingerprint()
+        if fp in entries:
+            matched.append(v)
+            seen.add(fp)
+        else:
+            new.append(v)
+    stale = sorted(fp for fp in entries if fp not in seen)
+    return new, matched, stale

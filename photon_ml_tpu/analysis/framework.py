@@ -1,0 +1,287 @@
+"""photonlint core: violations, rules, suppressions, and the analysis driver.
+
+Photon ML reference counterpart: none directly — the JVM reference gets this
+class of checking from scalac + Spark's static DAG.  A JAX port trades both
+away (Python, dynamic tracing), so the repo's correctness/performance
+invariants (no host syncs in hot paths, no recompile hazards, no float64 on
+TPU paths, lock-protected mutation of shared serving state) are re-imposed
+here as an AST pass over our own source, run by tier-1
+(tests/test_photonlint.py) and ``python -m tools.photonlint``.
+
+Design:
+  - a ``Rule`` inspects one ``ModuleContext`` (source + AST + lazily built
+    ``JitIndex``) and yields ``Violation``s;
+  - ``# photonlint: disable=rule[,rule2] -- reason`` on the flagged line (or
+    a standalone comment line directly above it) suppresses; ``disable=all``
+    suppresses every rule; ``# photonlint: disable-file=rule`` anywhere in
+    the first 10 lines suppresses for the whole file;
+  - violations fingerprint on (rule, path, message, source-line text,
+    same-line occurrence) — NOT the line number — so baselined debt stays
+    matched while unrelated edits shift lines (analysis/baseline.py);
+  - parse failures surface as ``parse-error`` violations instead of
+    crashing the run, so a broken file fails the lint gate loudly.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple, Type
+
+SEVERITIES = ("error", "warning")
+
+# `# photonlint: disable=a,b` / `disable-file=a` with an optional
+# `-- why this is intentional` trailer (the reason is required by review
+# convention, not by the parser).
+_SUPPRESS_RE = re.compile(
+    r"#\s*photonlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\- ]+?)\s*(?:--.*)?$")
+_FILE_SCOPE_SCAN_LINES = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding.  ``snippet`` is the stripped source line — part of the
+    baseline fingerprint so renumbering-only edits don't invalidate debt."""
+
+    rule: str
+    code: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    snippet: str = ""
+    occurrence: int = 0  # disambiguates identical findings on identical lines
+
+    def fingerprint(self) -> str:
+        key = "|".join((self.rule, self.path, self.message,
+                        self.snippet.strip(), str(self.occurrence)))
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.code}[{self.rule}] {self.severity}: {self.message}")
+
+
+class ModuleContext:
+    """Everything a rule may inspect about one source file."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:  # surfaced as a parse-error violation
+            self.parse_error = e
+        self._jit_index = None
+
+    @property
+    def jit_index(self):
+        """Lazily built once per module, shared by every rule."""
+        if self._jit_index is None:
+            from photon_ml_tpu.analysis.jit_index import JitIndex
+            self._jit_index = JitIndex(self.tree) if self.tree else JitIndex(None)
+        return self._jit_index
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def violation(self, rule: "Rule", node: ast.AST, message: str,
+                  severity: Optional[str] = None) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Violation(rule=rule.name, code=rule.code, path=self.relpath,
+                         line=line, col=col, message=message,
+                         severity=severity or rule.severity,
+                         snippet=self.line_text(line).strip())
+
+
+class Rule:
+    """Base class: subclasses set metadata and implement ``check``."""
+
+    name: str = ""
+    code: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        raise NotImplementedError
+
+
+class _ParseErrorRule(Rule):
+    """Pseudo-rule used for files that fail to parse (never registered —
+    a broken file must not be silently skipped by rule selection)."""
+
+    name = "parse-error"
+    code = "PL000"
+    severity = "error"
+    description = "file could not be parsed as Python"
+
+
+_PARSE_RULE = _ParseErrorRule()
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: add a rule to the global registry (keyed by name)."""
+    if not cls.name or not cls.code:
+        raise ValueError(f"rule {cls.__name__} must define name and code")
+    if cls.name in _REGISTRY and _REGISTRY[cls.name] is not cls:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def registered_rules() -> Dict[str, Type[Rule]]:
+    # import for the registration side effect; cheap after the first call
+    import photon_ml_tpu.analysis.rules  # noqa: F401
+    return dict(_REGISTRY)
+
+
+def build_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    registry = registered_rules()
+    if names is None:
+        names = sorted(registry, key=lambda n: registry[n].code)
+    missing = [n for n in names if n not in registry]
+    if missing:
+        known = ", ".join(sorted(registry))
+        raise KeyError(f"unknown rule(s) {missing} (known: {known})")
+    return [registry[n]() for n in names]
+
+
+# -- suppressions -----------------------------------------------------------
+
+def _parse_suppressions(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]],
+                                                       Set[str]]:
+    """Returns (per-line rule sets, file-wide rule set).  A suppression on a
+    standalone comment line covers the next non-comment line too."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("scope"):
+            if i <= _FILE_SCOPE_SCAN_LINES:
+                file_wide |= rules
+            continue
+        per_line.setdefault(i, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            # standalone comment: covers the rest of its comment block (a
+            # multi-line reason) and the first code line below it
+            j = i + 1
+            while j <= len(lines) and lines[j - 1].lstrip().startswith("#"):
+                per_line.setdefault(j, set()).update(rules)
+                j += 1
+            per_line.setdefault(j, set()).update(rules)
+    return per_line, file_wide
+
+
+def _is_suppressed(v: Violation, per_line: Dict[int, Set[str]],
+                   file_wide: Set[str]) -> bool:
+    if "all" in file_wide or v.rule in file_wide:
+        return True
+    rules = per_line.get(v.line, ())
+    return "all" in rules or v.rule in rules
+
+
+# -- driver -----------------------------------------------------------------
+
+@dataclasses.dataclass
+class AnalysisResult:
+    violations: List[Violation]
+    suppressed: List[Violation]
+    files_scanned: int
+
+    def by_rule(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for v in self.violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
+        return counts
+
+
+def _iter_py_files(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _dedupe_occurrences(violations: List[Violation]) -> List[Violation]:
+    """Number repeat findings that share a fingerprint key (same rule, path,
+    message, and line text) so each gets a distinct baseline entry."""
+    seen: Dict[Tuple, int] = {}
+    out = []
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.col, v.code)):
+        key = (v.rule, v.path, v.message, v.snippet.strip())
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        out.append(dataclasses.replace(v, occurrence=n) if n else v)
+    return out
+
+
+def analyze_source(relpath: str, source: str,
+                   rules: Sequence[Rule]) -> Tuple[List[Violation],
+                                                   List[Violation]]:
+    """Lint one in-memory module; returns (kept, suppressed)."""
+    ctx = ModuleContext(relpath, source)
+    found: List[Violation] = []
+    if ctx.parse_error is not None:
+        e = ctx.parse_error
+        found.append(Violation(
+            rule=_PARSE_RULE.name, code=_PARSE_RULE.code, path=ctx.relpath,
+            line=e.lineno or 1, col=(e.offset or 1) - 1,
+            message=f"syntax error: {e.msg}", severity="error",
+            snippet=ctx.line_text(e.lineno or 1).strip()))
+    else:
+        for rule in rules:
+            found.extend(rule.check(ctx))
+    per_line, file_wide = _parse_suppressions(ctx.lines)
+    kept = [v for v in found if not _is_suppressed(v, per_line, file_wide)]
+    suppressed = [v for v in found if _is_suppressed(v, per_line, file_wide)]
+    return _dedupe_occurrences(kept), suppressed
+
+
+def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+                 root: Optional[str] = None) -> AnalysisResult:
+    """Lint every ``.py`` under ``paths``.  ``root`` anchors the
+    repo-relative paths used in reports and baseline fingerprints (default:
+    the current working directory)."""
+    rules = list(rules) if rules is not None else build_rules()
+    root = os.path.abspath(root or os.getcwd())
+    violations: List[Violation] = []
+    suppressed: List[Violation] = []
+    n_files = 0
+    for path in paths:
+        for fpath in _iter_py_files(path):
+            n_files += 1
+            rel = os.path.relpath(os.path.abspath(fpath), root)
+            with open(fpath, "r", encoding="utf-8") as f:
+                source = f.read()
+            kept, supp = analyze_source(rel, source, rules)
+            violations.extend(kept)
+            suppressed.extend(supp)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.code))
+    return AnalysisResult(violations=violations, suppressed=suppressed,
+                          files_scanned=n_files)

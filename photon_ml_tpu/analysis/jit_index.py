@@ -1,0 +1,259 @@
+"""Which functions in a module execute under ``jax.jit``?
+
+The rules that police trace-time behaviour (host-sync, tracer-safety,
+dtype-discipline) only apply inside code that actually runs under a jit
+trace.  This index resolves, per module, the idioms this codebase uses:
+
+  - ``@jax.jit`` / ``@jit`` / ``@pjit`` decorators;
+  - ``@functools.partial(jax.jit, static_argnames=...)`` decorators;
+  - ``jax.jit(fn)`` / ``jax.jit(fn).lower(...)`` where ``fn`` is a function
+    defined in the same module (any scope) — the AOT idiom of
+    serving/engine.py and the solver-wrapping idiom of game/coordinate.py;
+  - ``jax.jit(jax.vmap(fn))`` and other transform sandwiches — the wrapper
+    chain (vmap/grad/value_and_grad/remat/partial) is unwrapped to the
+    innermost function reference;
+  - ``jax.jit(lambda ...: ...)`` — the lambda body is jit code.
+
+Cross-module flows (a function passed to a jit defined elsewhere) are out of
+scope — per-module analysis keeps the pass dependency-free and O(file).
+``static_argnames``/``static_argnums`` are honoured when given as literals:
+static parameters are concrete Python values at trace time, not tracers, so
+param-sensitive checks must skip them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# dotted names that mean "this call/decorator jits its argument/target"
+JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+# transforms whose first argument is (eventually) the traced function
+WRAPPER_NAMES = {
+    "jax.vmap", "vmap", "jax.grad", "grad", "jax.value_and_grad",
+    "value_and_grad", "jax.remat", "jax.checkpoint", "remat",
+    "functools.partial", "partial",
+}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``ast.Attribute``/``ast.Name`` chain -> "a.b.c" (else None)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_call(node: ast.Call) -> bool:
+    """True for ``jax.jit(...)`` / ``jit(...)`` style calls."""
+    name = dotted_name(node.func)
+    return name in JIT_NAMES
+
+
+def is_partial_jit(node: ast.Call) -> bool:
+    """True for ``functools.partial(jax.jit, ...)``."""
+    name = dotted_name(node.func)
+    if name not in PARTIAL_NAMES or not node.args:
+        return False
+    return dotted_name(node.args[0]) in JIT_NAMES
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    """Literal ``static_argnames`` from a jit call/decorator (best effort)."""
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            names.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.add(elt.value)
+    return names
+
+
+def _static_nums_from_call(call: ast.Call) -> Set[int]:
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            nums.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    nums.add(elt.value)
+    return nums
+
+
+def _unwrap_transform(node: ast.AST) -> Optional[ast.AST]:
+    """Peel vmap/grad/partial sandwiches down to the function reference."""
+    while isinstance(node, ast.Call) and dotted_name(node.func) in WRAPPER_NAMES:
+        if not node.args:
+            return None
+        node = node.args[0]
+    return node
+
+
+def param_names(fn: FunctionNode, static_names: Set[str],
+                static_nums: Set[int]) -> Set[str]:
+    """Parameter names that are TRACERS under jit (statics excluded)."""
+    a = fn.args
+    ordered = list(a.posonlyargs) + list(a.args)
+    names: Set[str] = set()
+    for i, arg in enumerate(ordered):
+        if i in static_nums or arg.arg in static_names:
+            continue
+        names.add(arg.arg)
+    for arg in a.kwonlyargs:
+        if arg.arg not in static_names:
+            names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    # **kwargs of a jitted fn is at best unusual; treat values as tracers
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+class JitIndex:
+    """Per-module map of jit-executed functions.
+
+    ``roots``: list of (function node, tracer-param name set).  A root is a
+    jitted function NOT nested inside another jitted function (rules walk a
+    root's whole body, so nested defs are covered by their outermost root —
+    their params are re-resolved during the walk).
+    """
+
+    def __init__(self, tree: Optional[ast.Module]):
+        self.roots: List[Tuple[FunctionNode, Set[str]]] = []
+        self._jitted: Dict[int, Tuple[FunctionNode, Set[str], Set[int]]] = {}
+        if tree is None:
+            return
+        self._defs_by_name: Dict[str, List[FunctionNode]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+        self._collect_decorated(tree)
+        self._collect_call_sites(tree)
+        self._resolve_roots(tree)
+
+    # -- collection --------------------------------------------------------
+    def _mark(self, fn: Optional[ast.AST], statics: Set[str],
+              nums: Set[int]) -> None:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            self._jitted[id(fn)] = (fn, statics, nums)
+
+    def _collect_decorated(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if dotted_name(dec) in JIT_NAMES:
+                    self._mark(node, set(), set())
+                elif isinstance(dec, ast.Call) and (is_jit_call(dec) or
+                                                    is_partial_jit(dec)):
+                    self._mark(node, _static_names_from_call(dec),
+                               _static_nums_from_call(dec))
+
+    def _collect_call_sites(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and is_jit_call(node)):
+                continue
+            if not node.args:
+                continue
+            statics = _static_names_from_call(node)
+            nums = _static_nums_from_call(node)
+            target = _unwrap_transform(node.args[0])
+            if isinstance(target, ast.Lambda):
+                self._mark(target, statics, nums)
+            elif isinstance(target, ast.Name):
+                for fn in self._defs_by_name.get(target.id, ()):
+                    self._mark(fn, statics, nums)
+            elif isinstance(target, ast.Attribute):
+                # self._method / module.fn: resolve by terminal attribute
+                for fn in self._defs_by_name.get(target.attr, ()):
+                    self._mark(fn, statics, nums)
+
+    def _resolve_roots(self, tree: ast.Module) -> None:
+        # a jitted def nested inside another jitted def is covered by the
+        # outer root's walk; report each region once
+        inner: Set[int] = set()
+        for fn, _, _ in self._jitted.values():
+            for sub in ast.walk(fn):
+                if sub is fn:
+                    continue
+                if id(sub) in self._jitted:
+                    inner.add(id(sub))
+        for key, (fn, statics, nums) in self._jitted.items():
+            if key in inner:
+                continue
+            self.roots.append((fn, param_names(fn, statics, nums)))
+        self.roots.sort(key=lambda r: r[0].lineno)
+
+    # -- queries -----------------------------------------------------------
+    def is_jitted(self, fn: ast.AST) -> bool:
+        return id(fn) in self._jitted
+
+
+def walk_jit_code(index: JitIndex):
+    """Yield (node, tracer_param_names) for every node that executes under a
+    jit trace.  Entering a nested function swaps in that function's params
+    (its arguments are traced values when called from traced code)."""
+    for root, params in index.roots:
+        yield from _walk_scope(root, params)
+
+
+def _walk_scope(fn: FunctionNode, params: Set[str]):
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack: List[Tuple[ast.AST, Set[str]]] = [(n, params) for n in body]
+    while stack:
+        node, cur = stack.pop()
+        yield node, cur
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            sub_params = cur | param_names(node, set(), set())
+            sub_body = node.body if isinstance(node.body, list) else [node.body]
+            stack.extend((n, sub_params) for n in sub_body)
+        else:
+            stack.extend((child, cur) for child in ast.iter_child_nodes(node))
+
+
+def expr_references(node: ast.AST, names: Set[str],
+                    prune_static: bool = True) -> bool:
+    """Does ``node`` reference any name in ``names`` as a (possibly derived)
+    traced VALUE?  With ``prune_static``, sub-expressions that are concrete
+    at trace time are skipped: ``x is None`` / ``x is not None`` tests,
+    ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` attribute reads, and
+    ``len(x)`` / ``isinstance(x, ...)`` calls."""
+    STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+    STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr"}
+
+    def visit(n: ast.AST) -> bool:
+        if prune_static:
+            if isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                return False
+            if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+                return False
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in STATIC_CALLS):
+                return False
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+        return any(visit(c) for c in ast.iter_child_nodes(n))
+
+    return visit(node)

@@ -1,0 +1,61 @@
+"""Render photonlint results as text (human/CI logs) or JSON (tooling).
+
+Both reporters consume the same inputs: the violations split against the
+baseline (analysis/baseline.py) plus scan counts, so the CLI and the tier-1
+test print identical findings.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from photon_ml_tpu.analysis.framework import AnalysisResult, Violation
+
+
+def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
+                stale: Sequence[str], result: AnalysisResult,
+                verbose: bool = False) -> str:
+    out: List[str] = []
+    for v in new:
+        out.append(v.render())
+        if v.snippet:
+            out.append(f"    {v.snippet}")
+    if verbose and baselined:
+        out.append("")
+        out.append(f"baselined (accepted debt, {len(baselined)}):")
+        out.extend(f"  {v.render()}" for v in baselined)
+    if stale:
+        out.append("")
+        out.append(f"stale baseline entries ({len(stale)}) — debt fixed; "
+                   "prune with --write-baseline:")
+        out.extend(f"  {fp}" for fp in stale)
+    out.append("")
+    by_rule = {}
+    for v in new:
+        by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+    detail = (" (" + ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+              + ")") if by_rule else ""
+    out.append(
+        f"photonlint: {result.files_scanned} files scanned, "
+        f"{len(new)} new violation(s){detail}, {len(baselined)} baselined, "
+        f"{len(result.suppressed)} suppressed")
+    return "\n".join(out)
+
+
+def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
+                stale: Sequence[str], result: AnalysisResult) -> str:
+    payload = {
+        "files_scanned": result.files_scanned,
+        "new": [v.to_dict() for v in new],
+        "baselined": [v.to_dict() for v in baselined],
+        "suppressed": [v.to_dict() for v in result.suppressed],
+        "stale_baseline_fingerprints": list(stale),
+        "summary": {
+            "new": len(new),
+            "baselined": len(baselined),
+            "suppressed": len(result.suppressed),
+            "stale": len(stale),
+        },
+    }
+    return json.dumps(payload, indent=2)

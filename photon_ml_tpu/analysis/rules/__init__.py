@@ -1,0 +1,26 @@
+"""photonlint rule catalog — importing this package registers every rule.
+
+| code  | rule              | guards                                         |
+|-------|-------------------|------------------------------------------------|
+| PL001 | host-sync         | device→host syncs inside jit-traced code       |
+| PL002 | recompile-hazard  | per-call / per-iteration jit construction      |
+| PL003 | tracer-safety     | Python control flow on traced values           |
+| PL004 | dtype-discipline  | float64 / numpy promotion on TPU hot paths     |
+| PL005 | lock-discipline   | unlocked mutation of lock-protected state      |
+
+Planned (ROADMAP): donation-after-use, sharding-annotation checks.
+"""
+
+from photon_ml_tpu.analysis.rules.host_sync import HostSyncRule
+from photon_ml_tpu.analysis.rules.recompile import RecompileHazardRule
+from photon_ml_tpu.analysis.rules.tracer import TracerSafetyRule
+from photon_ml_tpu.analysis.rules.dtype import DtypeDisciplineRule
+from photon_ml_tpu.analysis.rules.locks import LockDisciplineRule
+
+__all__ = [
+    "HostSyncRule",
+    "RecompileHazardRule",
+    "TracerSafetyRule",
+    "DtypeDisciplineRule",
+    "LockDisciplineRule",
+]

@@ -1,0 +1,139 @@
+"""PL004 dtype-discipline: no float64 / host-numpy promotion on TPU paths.
+
+Why it matters here: TPUs have no native float64 — a f64 op either errors
+or falls back to a slow software path, and with ``jax_enable_x64`` set (the
+test harness does, for scipy parity) an accidental ``jnp.float64`` silently
+doubles memory traffic and halves MXU throughput on CPU/GPU runs too.
+Library code is dtype-agnostic by convention (conftest.py): kernels follow
+their INPUT dtypes, and f64 belongs only to host-side numpy (storage codecs,
+diagnostics, normalization statistics).  Host ``np.float64`` OUTSIDE traced
+code is therefore fine and not flagged.
+
+Flags, only in files under the configured hot-path dirs (core/, ops/,
+opt/, game/, parallel/, serving/, models/, evaluation/):
+  - ``jnp.float64`` anywhere — a device f64 request;
+  - ``dtype=np.float64`` / ``dtype=jnp.float64`` / ``dtype="float64"``
+    (keyword or 2nd positional) in any ``jnp.*`` call — ditto;
+  - ``np.float64`` referenced inside a jit-traced region — under x64 it
+    promotes the whole expression to f64 on device;
+  - promotion-prone host-numpy math (``np.exp``/``np.dot``/``np.sum``/...)
+    applied to a traced parameter inside a jit region — numpy computes on
+    host in f64 and breaks the trace (use ``jnp.*``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import (dotted_name, expr_references,
+                                              walk_jit_code)
+
+HOT_PATH_DIRS: Tuple[str, ...] = (
+    "core", "ops", "opt", "game", "parallel", "serving", "models",
+    "evaluation",
+)
+
+_JNP_ALIASES = {"jnp", "jax.numpy"}
+_NP_ALIASES = {"np", "numpy", "onp"}
+_NP_MATH = {
+    "exp", "log", "log1p", "expm1", "sqrt", "square", "abs", "sum", "mean",
+    "dot", "matmul", "einsum", "tanh", "sigmoid", "clip", "where",
+    "maximum", "minimum", "power", "outer", "cumsum", "prod",
+}
+
+
+def _in_hot_path(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    if "photon_ml_tpu" in parts:
+        parts = parts[parts.index("photon_ml_tpu") + 1:]
+    return bool(parts) and parts[0] in HOT_PATH_DIRS
+
+
+def _split_alias(name: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    if name is None or "." not in name:
+        return None, None
+    alias, _, attr = name.rpartition(".")
+    return alias, attr
+
+
+def _is_f64_expr(node: ast.AST) -> Optional[str]:
+    """Returns a description when ``node`` denotes float64."""
+    name = dotted_name(node)
+    if name is not None:
+        alias, attr = _split_alias(name)
+        if attr == "float64" and (alias in _JNP_ALIASES
+                                  or alias in _NP_ALIASES):
+            return name
+        if name == "float64":
+            return name
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return '"float64"'
+    return None
+
+
+@register
+class DtypeDisciplineRule(Rule):
+    name = "dtype-discipline"
+    code = "PL004"
+    severity = "error"
+    description = ("no float64 dtypes or host-numpy math on TPU hot paths "
+                   "(core/, ops/, opt/, game/, parallel/, serving/)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None or not _in_hot_path(ctx.relpath):
+            return
+        # module-wide: jnp.float64 and float64 dtype args in jnp calls
+        for node in ast.walk(ctx.tree):
+            name = dotted_name(node)
+            if name is not None:
+                alias, attr = _split_alias(name)
+                if alias in _JNP_ALIASES and attr == "float64":
+                    yield ctx.violation(
+                        self, node,
+                        "jnp.float64 requests a device f64 — TPUs have no "
+                        "native f64; follow the input dtype instead")
+                    continue
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                alias, _ = _split_alias(fname)
+                if alias not in _JNP_ALIASES:
+                    continue
+                dtype_arg = None
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype_arg = kw.value
+                if dtype_arg is None and len(node.args) >= 2:
+                    dtype_arg = node.args[1]
+                if dtype_arg is not None:
+                    desc = _is_f64_expr(dtype_arg)
+                    if desc:
+                        yield ctx.violation(
+                            self, node,
+                            f"{fname} called with dtype {desc} — f64 on a "
+                            "TPU path; library code is dtype-agnostic "
+                            "(follow the input dtype, keep f64 host-side)")
+        # trace-scoped: np.float64 and host-numpy math on traced values
+        for node, params in walk_jit_code(ctx.jit_index):
+            name = dotted_name(node)
+            alias, attr = _split_alias(name)
+            if alias in _NP_ALIASES and attr == "float64":
+                yield ctx.violation(
+                    self, node,
+                    "np.float64 inside a jit-traced region promotes to f64 "
+                    "under x64 (and is meaningless on TPU); use the traced "
+                    "operand's dtype")
+                continue
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                falias, fattr = _split_alias(fname)
+                if (falias in _NP_ALIASES and fattr in _NP_MATH
+                        and any(expr_references(a, params)
+                                for a in node.args)):
+                    yield ctx.violation(
+                        self, node,
+                        f"{fname} on a traced value computes on host (f64 "
+                        "promotion + trace break); use jnp."
+                        f"{fattr}")

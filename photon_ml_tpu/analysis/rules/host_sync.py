@@ -1,0 +1,83 @@
+"""PL001 host-sync: device→host synchronization inside jit-traced code.
+
+Why it matters here: the serving engine's AOT executables and the training
+solvers' fused programs (serving/engine.py, game/fused.py, opt/) are built
+on the premise that a traced function stays on-device end to end.  A
+``.item()`` / ``float()`` / ``np.asarray`` on a traced value either raises
+``ConcretizationTypeError`` at trace time or — worse, via callbacks or
+pre-jit refactors that later get jitted — silently inserts a blocking
+device→host transfer exactly where the paper's port lost its wins
+(PAPERS.md: Flare, arXiv:1703.08219).
+
+Flags, inside any jit-traced region (analysis/jit_index.py):
+  - ``x.item()`` / ``x.tolist()`` — explicit sync;
+  - ``np.asarray(...)`` / ``np.array(...)`` — host materialization (use
+    ``jnp.asarray``);
+  - ``float(p)`` / ``int(p)`` / ``bool(p)`` / ``complex(p)`` where ``p`` is
+    a (non-static) parameter of the traced function — concretization;
+  - ``print(...)`` referencing a traced parameter — executes at trace time,
+    not per call (use ``jax.debug.print``); warning severity.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import (dotted_name, expr_references,
+                                              walk_jit_code)
+
+_NP_ALIASES = {"np", "numpy", "onp"}
+_NP_HOST_FNS = {"asarray", "array"}
+_CASTS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    code = "PL001"
+    severity = "error"
+    description = ("no host syncs (.item/.tolist/float()/np.asarray/print) "
+                   "inside jit-traced code")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        for node, params in walk_jit_code(ctx.jit_index):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _SYNC_METHODS and not node.args):
+                yield ctx.violation(
+                    self, node,
+                    f".{func.attr}() forces a device->host sync inside a "
+                    "jit-traced function; keep the value on device (or move "
+                    "the readback outside the traced region)")
+                continue
+            name = dotted_name(func)
+            if name is not None and "." in name:
+                alias, _, attr = name.rpartition(".")
+                if alias in _NP_ALIASES and attr in _NP_HOST_FNS:
+                    yield ctx.violation(
+                        self, node,
+                        f"{name}(...) materializes on host inside a "
+                        "jit-traced function; use jnp.asarray (host numpy "
+                        "breaks tracing and blocks the device stream)")
+                    continue
+            if isinstance(func, ast.Name):
+                if (func.id in _CASTS and len(node.args) == 1
+                        and expr_references(node.args[0], params)):
+                    yield ctx.violation(
+                        self, node,
+                        f"{func.id}() concretizes a traced value (host sync "
+                        "/ ConcretizationTypeError); use jnp casts or keep "
+                        "it symbolic")
+                elif func.id == "print" and any(
+                        expr_references(a, params) for a in node.args):
+                    yield ctx.violation(
+                        self, node,
+                        "print() of a traced value runs at trace time, not "
+                        "per call; use jax.debug.print",
+                        severity="warning")

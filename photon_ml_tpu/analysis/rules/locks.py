@@ -1,0 +1,214 @@
+"""PL005 lock-discipline: unlocked mutation of lock-protected shared state.
+
+Why it matters here: the serving stack is the one place this codebase is
+genuinely multi-threaded — scoring requests, the background hot-swap thread
+(serving/swap.py), and metrics exports interleave on shared objects
+(serving/engine.py executable cache, serving/metrics.py registries,
+serving/coefficient_store.py LRU).  The convention is a per-object
+``self._lock`` and ``with self._lock:`` around every mutation; a single
+forgotten site is a data race no test reliably catches.
+
+Per class that owns a lock (an attribute assigned from ``threading.Lock()``
+/ ``RLock()`` / ``Condition()``, or any ``self.*lock*`` used as a context
+manager), flags:
+  - a mutation of ``self.X`` outside any ``with self.<lock>:`` when the
+    SAME attribute is also mutated under the lock elsewhere in the class —
+    the canonical forgotten-lock race;
+  - a mutation of ``self.X`` outside the ``with`` block in a method that
+    takes the lock elsewhere — partially-locked methods (mutating after
+    releasing is almost always an ordering bug).
+
+``__init__``/``__new__`` are exempt (no aliasing before construction
+returns).  Mutations counted: assignment/augmented assignment to
+``self.X``, item assignment/deletion ``self.X[k]``, and calls of mutating
+container methods (``append``/``update``/``pop``/``popitem``/
+``move_to_end``/...) on ``self.X``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import dotted_name
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "move_to_end", "appendleft",
+    "popleft", "sort", "reverse",
+}
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> "X" (else None)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_names(cls: ast.ClassDef) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            value_fn = (dotted_name(node.value.func)
+                        if isinstance(node.value, ast.Call) else None)
+            factory = (value_fn or "").rpartition(".")[2]
+            if factory in _LOCK_FACTORIES:
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        names.add(attr)
+        elif isinstance(node, ast.With):
+            # with self._lock: — treat any self.*lock* context manager as a
+            # lock even when constructed elsewhere
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and "lock" in attr.lower():
+                    names.add(attr)
+    return names
+
+
+@dataclasses.dataclass
+class _Site:
+    attr: str
+    method: str
+    locked: bool
+    node: ast.AST
+    kind: str  # "assign" | "item" | "call"
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Collect self-attribute mutation sites in one method, tracking
+    ``with self.<lock>`` nesting.  Nested function defs are skipped (their
+    execution context is unknowable here)."""
+
+    def __init__(self, method_name: str, locks: Set[str]):
+        self.method = method_name
+        self.locks = locks
+        self.depth = 0
+        self.took_lock = False
+        self.sites: List[_Site] = []
+
+    def _add(self, attr: Optional[str], node: ast.AST, kind: str) -> None:
+        if attr is None or attr in self.locks:
+            return
+        self.sites.append(_Site(attr=attr, method=self.method,
+                                locked=self.depth > 0, node=node, kind=kind))
+
+    # -- lock scope --------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        is_lock = any(_self_attr(i.context_expr) in self.locks
+                      for i in node.items)
+        if is_lock:
+            self.took_lock = True
+            self.depth += 1
+        self.generic_visit(node)
+        if is_lock:
+            self.depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # closures: out of scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- mutations ---------------------------------------------------------
+    def _target(self, tgt: ast.AST) -> None:
+        attr = _self_attr(tgt)
+        if attr is not None:
+            self._add(attr, tgt, "assign")
+        elif isinstance(tgt, ast.Subscript):
+            self._add(_self_attr(tgt.value), tgt, "item")
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._target(elt)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._target(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                self._add(_self_attr(tgt.value), tgt, "item")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            self._add(_self_attr(f.value), node, "call")
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    code = "PL005"
+    severity = "error"
+    description = ("attributes mutated under a class's lock must never be "
+                   "mutated outside it")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx: ModuleContext,
+                     cls: ast.ClassDef) -> Iterator[Violation]:
+        locks = _lock_names(cls)
+        if not locks:
+            return
+        sites: List[_Site] = []
+        partial_methods: Dict[str, List[_Site]] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name in _EXEMPT_METHODS:
+                continue
+            scanner = _MethodScanner(item.name, locks)
+            # generic_visit: enter the method body without tripping the
+            # nested-def skip on the method node itself
+            scanner.generic_visit(item)
+            sites.extend(scanner.sites)
+            if scanner.took_lock:
+                partial_methods[item.name] = scanner.sites
+        locked_attrs = {s.attr for s in sites if s.locked}
+        flagged: Set[int] = set()
+        for s in sites:
+            if s.locked or s.attr not in locked_attrs:
+                continue
+            flagged.add(id(s.node))
+            yield ctx.violation(
+                self, s.node,
+                f"{cls.name}.{s.attr} is mutated here without the lock but "
+                f"mutated under `with self.{sorted(locks)[0]}` elsewhere in "
+                "the class — a data race; take the lock around this "
+                "mutation")
+        for method, msites in partial_methods.items():
+            for s in msites:
+                if s.locked or id(s.node) in flagged:
+                    continue
+                yield ctx.violation(
+                    self, s.node,
+                    f"{cls.name}.{method} takes the class lock but mutates "
+                    f"self.{s.attr} outside it — mutation after release is "
+                    "an ordering race; move it inside the `with` block")

@@ -1,0 +1,104 @@
+"""PL002 recompile-hazard: jit construction patterns that defeat the cache.
+
+Why it matters here: serving/engine.py guarantees zero recompiles after
+``warm()`` — every (signature, bucket) executable is AOT-compiled once and a
+recompile on the request path is a multi-second tail-latency cliff on TPU.
+Training's regularization-path sweeps (opt/solve.py) make the same bet.
+``jax.jit``'s cache is keyed by function identity: construct the wrapper
+anew each iteration/call and every invocation retraces and recompiles.
+
+Flags, anywhere in a module:
+  - ``jax.jit(...)`` (or ``functools.partial(jax.jit, ...)``) constructed
+    inside a ``for``/``while`` body — a fresh wrapper (and compile) per
+    iteration; hoist the jit out of the loop;
+  - immediately-invoked construction ``jax.jit(f)(args)`` inside a function
+    body — a fresh wrapper per enclosing call (when ``f`` is a local
+    closure, a guaranteed recompile per call); bind the jitted callable
+    once (module level, ``__init__``, or an executable cache like
+    serving/engine._executable);
+  - ``jax.jit(...)`` where ``static_argnums``/``static_argnames`` is not a
+    literal int/str/tuple/list — dynamic static-arg specs are how array
+    values end up marked static (unhashable → TypeError, or worse a
+    compile per distinct value).
+
+Comprehensions are deliberately NOT treated as loops: the build-once
+``{cid: jax.jit(...) for cid in ...}`` setup idiom (parallel/multihost.py)
+constructs each wrapper exactly once and caches it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import is_jit_call, is_partial_jit
+
+_LITERAL_STATIC = (ast.Constant, ast.Tuple, ast.List)
+
+
+def _static_spec_dynamic(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant):
+            continue
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) for e in v.elts):
+            continue
+        return True
+    return False
+
+
+@register
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    code = "PL002"
+    severity = "error"
+    description = ("no per-iteration/per-call jax.jit construction or "
+                   "non-literal static-arg specs")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        yield from self._walk(ctx, ctx.tree, loop_depth=0, fn_depth=0)
+
+    def _walk(self, ctx: ModuleContext, node: ast.AST, loop_depth: int,
+              fn_depth: int) -> Iterator[Violation]:
+        for child in ast.iter_child_nodes(node):
+            in_loop = loop_depth
+            in_fn = fn_depth
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop += 1
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                # a nested def's body only loops if IT is called in a loop —
+                # reset; but it does run per call of the enclosing scope
+                in_loop = 0
+                in_fn += 1
+            if isinstance(child, ast.Call):
+                jit_like = is_jit_call(child) or is_partial_jit(child)
+                if jit_like and loop_depth > 0:
+                    yield ctx.violation(
+                        self, child,
+                        "jax.jit constructed inside a loop — a fresh wrapper "
+                        "(and XLA compile) every iteration; hoist the jit "
+                        "out of the loop and reuse it")
+                elif jit_like and _static_spec_dynamic(child):
+                    yield ctx.violation(
+                        self, child,
+                        "static_argnums/static_argnames is not a literal — "
+                        "dynamic static-arg specs invite unhashable/array "
+                        "statics (TypeError, or a compile per value); spell "
+                        "the spec as a literal")
+                elif (isinstance(child.func, ast.Call)
+                        and is_jit_call(child.func) and fn_depth > 0):
+                    yield ctx.violation(
+                        self, child,
+                        "jax.jit(f)(...) constructs and invokes a fresh "
+                        "wrapper on every call of the enclosing function — "
+                        "retrace + recompile each time; bind the jitted "
+                        "callable once and reuse it")
+            yield from self._walk(ctx, child, in_loop, in_fn)

@@ -1,0 +1,75 @@
+"""PL003 tracer-safety: Python control flow on traced values.
+
+Why it matters here: inside ``jax.jit``, a Python ``if``/``while`` on a
+traced value raises ``TracerBoolConversionError`` at trace time — or, when
+the branch condition is accidentally concrete (a captured host scalar),
+silently bakes ONE branch into the compiled program, which is the bug class
+hardest to see in review.  Iterating a traced array unrolls the loop into
+the XLA graph (compile-time blowup) or raises.  The solvers already use
+``lax.while_loop``/``lax.cond`` (opt/newton_soa.py, opt/linesearch.py);
+this rule keeps new trace-path code on that discipline.
+
+Flags, inside jit-traced regions, against the function's NON-STATIC
+parameters (``static_argnames``/``static_argnums`` are concrete — exempt):
+  - ``if p ...:`` / ``while p ...:`` where the test references a traced
+    parameter as a value (``is None`` tests and ``.shape``/``.ndim``/
+    ``.dtype``/``.size``/``len()`` reads are trace-time-concrete — exempt);
+  - ``for x in p:`` — loop unrolling over a traced array;
+  - ternary ``a if p else b`` on a traced parameter (same bake-one-branch
+    hazard as ``if``);
+  - ``assert p`` on a traced parameter — trace-time no-op that reads like a
+    runtime check (use ``checkify``); warning severity.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import expr_references, walk_jit_code
+
+
+@register
+class TracerSafetyRule(Rule):
+    name = "tracer-safety"
+    code = "PL003"
+    severity = "error"
+    description = ("no Python if/while/for/ternary/assert on traced values "
+                   "inside jit (use lax.cond/while_loop/select)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        for node, params in walk_jit_code(ctx.jit_index):
+            if isinstance(node, (ast.If, ast.While)):
+                if expr_references(node.test, params):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    fix = ("lax.cond/jnp.where" if kind == "if"
+                           else "lax.while_loop")
+                    yield ctx.violation(
+                        self, node,
+                        f"Python `{kind}` on a traced value — "
+                        "TracerBoolConversionError at trace time, or a "
+                        f"silently baked-in branch; use {fix}")
+            elif isinstance(node, ast.IfExp):
+                if expr_references(node.test, params):
+                    yield ctx.violation(
+                        self, node,
+                        "ternary on a traced value — use jnp.where or "
+                        "lax.select (Python chooses one branch at trace "
+                        "time)")
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if expr_references(node.iter, params):
+                    yield ctx.violation(
+                        self, node,
+                        "iterating a traced array unrolls the loop into the "
+                        "compiled program (or raises); use lax.scan / "
+                        "lax.fori_loop")
+            elif isinstance(node, ast.Assert):
+                if expr_references(node.test, params):
+                    yield ctx.violation(
+                        self, node,
+                        "assert on a traced value is a trace-time no-op "
+                        "that looks like a runtime check; use "
+                        "checkify.check for a real guard",
+                        severity="warning")

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -78,24 +79,30 @@ class ColdEntityCache:
         self._capacity = max(1, capacity)
         self._lru: "OrderedDict[int, np.ndarray]" = OrderedDict()
         self._metrics = metrics
+        # resolve() runs on whatever thread scores the batch; concurrent
+        # scorers share this cache, and OrderedDict corrupts under
+        # unsynchronized move_to_end/popitem
+        self._lock = threading.Lock()
 
     def get(self, entity_id: int) -> Optional[np.ndarray]:
-        row = self._lru.get(entity_id)
-        if row is not None:
-            self._lru.move_to_end(entity_id)
-            if self._metrics is not None:
-                self._metrics.inc("lru_hits")
-            return row
+        with self._lock:
+            row = self._lru.get(entity_id)
+            if row is not None:
+                self._lru.move_to_end(entity_id)
+                if self._metrics is not None:
+                    self._metrics.inc("lru_hits")
+                return row
         row = self._fetch(entity_id)
         if row is None:
             return None
         if self._metrics is not None:
             self._metrics.inc("cold_fetches")
-        self._lru[entity_id] = row
-        if len(self._lru) > self._capacity:
-            self._lru.popitem(last=False)
-            if self._metrics is not None:
-                self._metrics.inc("lru_evictions")
+        with self._lock:
+            self._lru[entity_id] = row
+            if len(self._lru) > self._capacity:
+                self._lru.popitem(last=False)
+                if self._metrics is not None:
+                    self._metrics.inc("lru_evictions")
         return row
 
 

@@ -160,7 +160,7 @@ class ScoringEngine:
         exe = lowered.compile()
         with self._lock:
             self._executables[key] = exe
-        self.compile_count += 1
+            self.compile_count += 1
         self.metrics.inc("compiles")
         return exe
 

@@ -16,6 +16,7 @@ import sys
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # not in the image; skip, don't error at collection
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

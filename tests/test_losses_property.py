@@ -13,6 +13,7 @@ import sys
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # not in the image; skip, don't error at collection
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

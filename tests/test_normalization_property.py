@@ -10,6 +10,9 @@ import os
 import sys
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # not in the image; skip, don't error at collection
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

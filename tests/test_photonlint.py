@@ -1,0 +1,566 @@
+"""photonlint test suite (tier-1).
+
+Three layers:
+  1. per-rule positive/negative fixtures — each rule must flag its hazard
+     and stay quiet on the idiomatic-correct twin;
+  2. framework behaviour — suppression comments, baseline round-trip,
+     parse-error surfacing, jit-index idiom resolution;
+  3. the GATE: the full rule suite over ``photon_ml_tpu/`` must produce
+     zero non-baselined violations (this is what makes every future PR
+     lint-clean by construction), plus a CLI smoke test so
+     ``python -m tools.photonlint`` and this test cannot drift apart.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.analysis import (analyze_source, build_rules,  # noqa: E402
+                                    load_baseline, make_baseline, partition,
+                                    registered_rules, run_analysis,
+                                    save_baseline)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "photon_ml_tpu")
+BASELINE_PATH = os.path.join(REPO_ROOT, "photonlint_baseline.json")
+HOT = "photon_ml_tpu/core/fixture.py"  # relpath inside dtype rule's scope
+
+
+def lint(src, rule=None, path=HOT):
+    rules = build_rules([rule]) if rule else build_rules()
+    kept, _ = analyze_source(path, textwrap.dedent(src), rules)
+    return kept
+
+
+def suppressed(src, rule=None, path=HOT):
+    rules = build_rules([rule]) if rule else build_rules()
+    _, supp = analyze_source(path, textwrap.dedent(src), rules)
+    return supp
+
+
+# -- PL001 host-sync ---------------------------------------------------------
+
+class TestHostSync:
+    def test_positive_item_and_np_asarray_inside_jit(self):
+        vs = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                y = x.item()
+                return np.asarray(y)
+        """, "host-sync")
+        assert len(vs) == 2
+        assert all(v.rule == "host-sync" for v in vs)
+
+    def test_positive_float_cast_of_param(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """, "host-sync")
+        assert len(vs) == 1 and "concretizes" in vs[0].message
+
+    def test_positive_tolist_in_jit_wrapped_by_name(self):
+        vs = lint("""
+            import jax
+
+            def solve(w):
+                return w.tolist()
+
+            fit = jax.jit(solve)
+        """, "host-sync")
+        assert len(vs) == 1 and ".tolist()" in vs[0].message
+
+    def test_positive_print_of_param_is_warning(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """, "host-sync")
+        assert len(vs) == 1 and vs[0].severity == "warning"
+
+    def test_negative_outside_jit(self):
+        assert lint("""
+            import numpy as np
+
+            def host_stats(x):
+                return float(np.asarray(x).sum()), x.item()
+        """, "host-sync") == []
+
+    def test_negative_jnp_asarray_and_static_float(self):
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                n = x.shape[0]
+                return jnp.asarray(x) * float(n)
+        """, "host-sync") == []
+
+
+# -- PL002 recompile-hazard --------------------------------------------------
+
+class TestRecompileHazard:
+    def test_positive_jit_in_loop(self):
+        vs = lint("""
+            import jax
+
+            def sweep(fns, x):
+                outs = []
+                for fn in fns:
+                    outs.append(jax.jit(fn))
+                return outs
+        """, "recompile-hazard")
+        assert len(vs) == 1 and "inside a loop" in vs[0].message
+
+    def test_positive_immediately_invoked_jit(self):
+        vs = lint("""
+            import jax
+
+            def score(f, x):
+                return jax.jit(f)(x)
+        """, "recompile-hazard")
+        assert len(vs) == 1 and "fresh" in vs[0].message
+
+    def test_positive_dynamic_static_spec(self):
+        vs = lint("""
+            import jax
+
+            def build(f, nums):
+                return jax.jit(f, static_argnums=nums)
+        """, "recompile-hazard")
+        assert len(vs) == 1 and "static_argnums" in vs[0].message
+
+    def test_negative_module_level_and_comprehension(self):
+        # the build-once setup idioms of parallel/multihost.py
+        assert lint("""
+            import jax
+
+            def f(x):
+                return x
+
+            g = jax.jit(f)
+            table = {k: jax.jit(f, static_argnames=("n",)) for k in range(3)}
+        """, "recompile-hazard") == []
+
+    def test_negative_aot_bind_then_compile(self):
+        # serving/engine.py: construct once per cache miss, then cache
+        assert lint("""
+            import jax
+
+            def build(fn, args):
+                jitted = jax.jit(fn)
+                return jitted.lower(*args).compile()
+        """, "recompile-hazard") == []
+
+
+# -- PL003 tracer-safety -----------------------------------------------------
+
+class TestTracerSafety:
+    def test_positive_if_on_param(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """, "tracer-safety")
+        assert len(vs) == 1 and "lax.cond" in vs[0].message
+
+    def test_positive_while_and_iteration(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                while x > 0:
+                    x = x - 1
+                for row in x:
+                    pass
+                return x
+        """, "tracer-safety")
+        assert {v.message.split()[0] for v in vs} == {"Python", "iterating"}
+
+    def test_positive_ternary_and_assert(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x, y):
+                assert y > 0
+                return x if y > 0 else -x
+        """, "tracer-safety")
+        sev = sorted(v.severity for v in vs)
+        assert sev == ["error", "warning"]
+
+    def test_negative_static_tests(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x, w=None):
+                if w is None:
+                    w = x
+                if x.shape[0] > 2 and len(x) > 2:
+                    w = w + 1
+                return w
+        """, "tracer-safety") == []
+
+    def test_negative_static_argnames_param_exempt(self):
+        assert lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                if n > 2:
+                    return x * n
+                return x
+        """, "tracer-safety") == []
+
+
+# -- PL004 dtype-discipline --------------------------------------------------
+
+class TestDtypeDiscipline:
+    def test_positive_f64_dtype_kwarg_and_attr(self):
+        vs = lint("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def init(n):
+                a = jnp.zeros(n, dtype=np.float64)
+                b = jnp.asarray([1.0], "float64")
+                return a.astype(jnp.float64) + b
+        """, "dtype-discipline")
+        assert len(vs) == 3
+
+    def test_positive_np_math_on_tracer(self):
+        vs = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.exp(x)
+        """, "dtype-discipline")
+        assert len(vs) == 1 and "jnp.exp" in vs[0].message
+
+    def test_negative_host_numpy_f64_outside_jit(self):
+        # normalization-statistics idiom: f64 accumulation is host-side
+        assert lint("""
+            import numpy as np
+
+            def stats(values):
+                return np.asarray(values, np.float64).sum()
+        """, "dtype-discipline") == []
+
+    def test_negative_out_of_scope_path(self):
+        # storage codecs are host-side: f64 is the on-disk precision there
+        assert lint("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            x = jnp.zeros(3, dtype=np.float64)
+        """, "dtype-discipline",
+                    path="photon_ml_tpu/storage/fixture.py") == []
+
+    def test_negative_dtype_following(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def f(x):
+                return jnp.zeros(x.shape, x.dtype)
+        """, "dtype-discipline") == []
+
+
+# -- PL005 lock-discipline ---------------------------------------------------
+
+class TestLockDiscipline:
+    def test_positive_unlocked_mutation_of_locked_attr(self):
+        vs = lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def safe(self):
+                    with self._lock:
+                        self.n += 1
+
+                def racy(self):
+                    self.n += 1
+        """, "lock-discipline")
+        assert len(vs) == 1 and "data race" in vs[0].message
+        assert vs[0].line == 14  # the mutation in racy()
+
+    def test_positive_mutation_after_release(self):
+        vs = lint("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.entries = {}
+                    self.count = 0
+
+                def put(self, k, v):
+                    with self._lock:
+                        self.entries[k] = v
+                    self.count += 1
+        """, "lock-discipline")
+        assert len(vs) == 1 and "outside it" in vs[0].message
+
+    def test_negative_all_mutations_locked(self):
+        assert lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self.items = []
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+                        self.items.append(self.n)
+        """, "lock-discipline") == []
+
+    def test_negative_class_without_lock(self):
+        # single-threaded classes are out of scope by design
+        assert lint("""
+            class Accum:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+        """, "lock-discipline") == []
+
+    def test_negative_init_exempt(self):
+        assert lint("""
+            import threading
+
+            class C:
+                def __init__(self, n):
+                    self._lock = threading.Lock()
+                    self.n = n
+
+                def set(self, n):
+                    with self._lock:
+                        self.n = n
+        """, "lock-discipline") == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+SUPPRESSIBLE = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x > 0:  {inline}
+            return x
+        return -x
+"""
+
+
+class TestSuppressions:
+    def test_same_line_disable(self):
+        src = SUPPRESSIBLE.format(
+            inline="# photonlint: disable=tracer-safety -- fixture")
+        assert lint(src, "tracer-safety") == []
+        assert len(suppressed(src, "tracer-safety")) == 1
+
+    def test_comment_above_disable(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                # photonlint: disable=tracer-safety -- reason spanning
+                # a second comment line before the statement
+                if x > 0:
+                    return x
+                return -x
+        """
+        assert lint(src, "tracer-safety") == []
+
+    def test_disable_all(self):
+        src = SUPPRESSIBLE.format(inline="# photonlint: disable=all")
+        assert lint(src, "tracer-safety") == []
+
+    def test_unrelated_rule_does_not_suppress(self):
+        src = SUPPRESSIBLE.format(inline="# photonlint: disable=host-sync")
+        assert len(lint(src, "tracer-safety")) == 1
+
+    def test_disable_file(self):
+        src = ("# photonlint: disable-file=tracer-safety\n"
+               + textwrap.dedent(SUPPRESSIBLE.format(inline="")))
+        assert lint(src, "tracer-safety") == []
+
+
+# -- baseline ----------------------------------------------------------------
+
+RACY = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            self.m = 0
+
+        def safe(self):
+            with self._lock:
+                self.n += 1
+                self.m += 1
+
+        def racy_n(self):
+            self.n += 1
+"""
+
+RACY_EXTRA = """
+        def racy_m(self):
+            self.m += 2
+"""
+
+
+class TestBaseline:
+    def _violations(self, extra=""):
+        return lint(textwrap.dedent(RACY + extra), "lock-discipline")
+
+    def test_round_trip_baselined_passes_new_fails(self, tmp_path):
+        vs = self._violations()
+        assert len(vs) == 1
+        path = str(tmp_path / "baseline.json")
+        save_baseline(make_baseline(vs), path)
+        loaded = load_baseline(path)
+        new, matched, stale = partition(vs, loaded)
+        assert new == [] and len(matched) == 1 and stale == []
+        # a NEW violation (different attribute) is not absorbed
+        vs2 = self._violations(extra=RACY_EXTRA)
+        assert len(vs2) == 2
+        new2, matched2, _ = partition(vs2, loaded)
+        assert len(new2) == 1 and len(matched2) == 1
+        assert "m" in new2[0].snippet
+
+    def test_stale_entries_reported(self, tmp_path):
+        vs = self._violations()
+        baseline = make_baseline(vs)
+        baseline["entries"]["deadbeefdeadbeef"] = {"rule": "host-sync"}
+        path = str(tmp_path / "baseline.json")
+        save_baseline(baseline, path)
+        new, matched, stale = partition(vs, load_baseline(path))
+        assert new == [] and stale == ["deadbeefdeadbeef"]
+
+    def test_fingerprint_survives_line_shift(self):
+        vs1 = self._violations()
+        shifted = ("# a new leading comment\n\n"
+                   + textwrap.dedent(RACY))
+        vs2 = lint(shifted, "lock-discipline")
+        assert len(vs2) == 1
+        assert vs1[0].fingerprint() == vs2[0].fingerprint()
+        assert vs1[0].line != vs2[0].line
+
+
+# -- framework odds and ends -------------------------------------------------
+
+class TestFramework:
+    def test_parse_error_is_a_violation(self):
+        vs = lint("def broken(:\n")
+        assert len(vs) == 1 and vs[0].rule == "parse-error"
+
+    def test_five_rules_registered(self):
+        registry = registered_rules()
+        assert set(registry) >= {"host-sync", "recompile-hazard",
+                                 "tracer-safety", "dtype-discipline",
+                                 "lock-discipline"}
+        assert len(registry) >= 5
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(KeyError):
+            build_rules(["no-such-rule"])
+
+    def test_jit_index_resolves_vmap_sandwich(self):
+        vs = lint("""
+            import jax
+
+            def kernel(w):
+                return float(w)
+
+            vk = jax.jit(jax.vmap(kernel))
+        """, "host-sync")
+        assert len(vs) == 1
+
+    def test_jit_index_resolves_lambda(self):
+        vs = lint("""
+            import jax
+            import numpy as np
+
+            score = jax.jit(lambda w: np.asarray(w))
+        """, "host-sync")
+        assert len(vs) == 1
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+class TestPackageGate:
+    def test_package_has_no_new_violations(self):
+        """THE gate: every future PR must keep photon_ml_tpu/ lint-clean
+        (or explicitly baseline/suppress with a reason)."""
+        result = run_analysis([PKG_DIR], root=REPO_ROOT)
+        baseline = load_baseline(BASELINE_PATH)
+        new, _, _ = partition(result.violations, baseline)
+        assert not new, (
+            "new photonlint violations (fix, suppress with a reason, or "
+            "baseline):\n" + "\n".join(v.render() for v in new))
+
+    def test_gate_scans_the_whole_package(self):
+        result = run_analysis([PKG_DIR], root=REPO_ROOT)
+        assert result.files_scanned >= 100  # the package, not a subset
+
+    def test_cli_exit_zero_on_package(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.photonlint",
+             os.path.join(REPO_ROOT, "photon_ml_tpu")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_json_and_nonzero_on_violation(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """))
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.photonlint", str(bad),
+             "--no-baseline", "--format", "json"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload["summary"]["new"] == 1
+        assert payload["new"][0]["rule"] == "tracer-safety"

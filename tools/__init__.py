@@ -1,0 +1,2 @@
+# Makes tools/ importable so `python -m tools.photonlint` works from the
+# repo root (the scripts themselves are still directly runnable).

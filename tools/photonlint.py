@@ -1,0 +1,118 @@
+"""photonlint CLI — the repo's JAX/TPU static-analysis gate.
+
+Usage:
+    python -m tools.photonlint [paths ...]
+    python -m tools.photonlint photon_ml_tpu/ --format json
+    python -m tools.photonlint --list-rules
+    python -m tools.photonlint photon_ml_tpu/ --write-baseline
+
+Exit codes: 0 = clean (every finding baselined or suppressed);
+1 = new violations (or stale baseline entries under --strict-baseline);
+2 = usage / configuration error.
+
+The default baseline is ``photonlint_baseline.json`` at the repo root; see
+README "Static analysis" for the suppression (`# photonlint: disable=rule
+-- reason`) and baseline workflow.  tests/test_photonlint.py runs the same
+analysis in-process, so tier-1 and this CLI cannot disagree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # direct `python tools/photonlint.py` runs
+    sys.path.insert(0, _REPO_ROOT)
+
+from photon_ml_tpu.analysis import (BaselineError, build_rules,  # noqa: E402
+                                    load_baseline, make_baseline, partition,
+                                    registered_rules, render_json,
+                                    render_text, run_analysis, save_baseline)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "photonlint_baseline.json")
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photonlint",
+        description="JAX/TPU-aware static analysis for photon-ml-tpu")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: photon_ml_tpu/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE",
+                   help="baseline file of accepted debt "
+                        "(default: %(default)s)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every violation as new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into --baseline "
+                        "(also prunes stale entries) and exit 0")
+    p.add_argument("--rules", default=None, metavar="R1,R2",
+                   help="comma-separated rule names (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="also fail when the baseline has stale entries")
+    p.add_argument("--verbose", action="store_true",
+                   help="text format: also print baselined findings")
+    p.add_argument("--root", default=_REPO_ROOT,
+                   help=argparse.SUPPRESS)  # tests anchor relpaths here
+    return p
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        registry = registered_rules()
+        for name in sorted(registry, key=lambda n: registry[n].code):
+            cls = registry[name]
+            print(f"{cls.code}  {name:<18} [{cls.severity}]  "
+                  f"{cls.description}")
+        return 0
+
+    paths = args.paths or [os.path.join(args.root, "photon_ml_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"photonlint: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        rules = build_rules(args.rules.split(",") if args.rules else None)
+    except KeyError as e:
+        print(f"photonlint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    result = run_analysis(paths, rules=rules, root=args.root)
+
+    if args.write_baseline:
+        save_baseline(make_baseline(result.violations), args.baseline)
+        print(f"photonlint: wrote {len(result.violations)} entr"
+              f"{'y' if len(result.violations) == 1 else 'ies'} to "
+              f"{args.baseline}")
+        return 0
+
+    try:
+        baseline = (load_baseline(args.baseline) if not args.no_baseline
+                    else {"version": 1, "entries": {}})
+    except BaselineError as e:
+        print(f"photonlint: {e}", file=sys.stderr)
+        return 2
+    new, baselined, stale = partition(result.violations, baseline)
+
+    if args.format == "json":
+        print(render_json(new, baselined, stale, result))
+    else:
+        print(render_text(new, baselined, stale, result,
+                          verbose=args.verbose))
+
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
