@@ -1522,6 +1522,47 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     return out
 
 
+def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
+    """Time the full whole-program photonlint pass over photon_ml_tpu/.
+
+    Static analysis sits on the tier-1 path (tests/test_photonlint.py) and
+    in the pre-commit loop (``tools/photonlint.py --paths``), so its cost is
+    tracked like any other hot path: BENCH_LINT.json records wall time per
+    run (best + mean), the ProgramIndex build share, and the finding counts
+    — a lint-time regression shows up in the same place a kernel regression
+    would.  Pure AST work: no jax import, runs identically on any backend.
+    """
+    import time as _time
+
+    from photon_ml_tpu.analysis import run_analysis
+
+    pkg = os.path.join(_REPO, "photon_ml_tpu")
+    times, idx_times, result = [], [], None
+    for _ in range(max(1, repeats)):
+        t0 = _time.perf_counter()
+        result = run_analysis([pkg], root=_REPO, whole_program=True)
+        times.append(_time.perf_counter() - t0)
+        idx_times.append(result.index_build_s)
+    out = {
+        "metric": "photonlint_full_package_wall_s",
+        "value": round(min(times), 4),
+        "unit": "s",
+        "wall_s_mean": round(sum(times) / len(times), 4),
+        "wall_s_all": [round(t, 4) for t in times],
+        "index_build_s": round(min(idx_times), 4),
+        "files_scanned": result.files_scanned,
+        "violations": len(result.violations),
+        "suppressed": len(result.suppressed),
+        "by_rule": result.by_rule(),
+        "repeats": max(1, repeats),
+    }
+    path = out_path or os.path.join(_REPO, "BENCH_LINT.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return out
+
+
 # configs with an unconditional scipy stand-in for vs_baseline.  glmix_chip
 # is special-cased in _entry_from: at chip scale no host holds its design
 # matrix (vs_baseline stays null), but CPU-floor runs reconstruct the
@@ -1546,9 +1587,17 @@ def main():
     ap.add_argument("--serving-requests", type=int, default=2000)
     ap.add_argument("--serving-device-capacity", type=int, default=0,
                     help="hot entity rows on device (0 = all)")
+    ap.add_argument("--lint", action="store_true",
+                    help="photonlint wall-time micro-bench (whole-program "
+                         "pass over photon_ml_tpu/) -> BENCH_LINT.json")
+    ap.add_argument("--lint-repeats", type=int, default=3)
     ap.add_argument("--out", default=None,
-                    help="with --serving: output JSON path override")
+                    help="with --serving/--lint: output JSON path override")
     a = ap.parse_args()
+    if a.lint:
+        print(json.dumps(run_lint_bench(repeats=a.lint_repeats,
+                                        out_path=a.out)))
+        return
     if a.serving:
         print(json.dumps(run_serving_bench(
             n_entities=a.serving_entities, n_requests=a.serving_requests,

@@ -73,27 +73,54 @@ class Violation:
 
 
 class ModuleContext:
-    """Everything a rule may inspect about one source file."""
+    """Everything a rule may inspect about one source file.
 
-    def __init__(self, relpath: str, source: str):
+    ``program``: the whole-program :class:`~photon_ml_tpu.analysis.
+    program_index.ProgramIndex` when linting in whole-program mode (None in
+    per-module mode / ``--no-program-index``).  When the program index holds
+    this module, its pre-parsed tree is reused so cross-module traced roots
+    share node identity with the tree the rules walk.
+    """
+
+    def __init__(self, relpath: str, source: str, program=None):
         self.relpath = relpath.replace(os.sep, "/")
         self.source = source
         self.lines = source.splitlines()
+        self.program = program
         self.tree: Optional[ast.Module] = None
         self.parse_error: Optional[SyntaxError] = None
-        try:
-            self.tree = ast.parse(source)
-        except SyntaxError as e:  # surfaced as a parse-error violation
-            self.parse_error = e
+        shared = program.tree_for(self.relpath) if program is not None else None
+        if shared is not None:
+            self.tree = shared
+        else:
+            try:
+                self.tree = ast.parse(source)
+            except SyntaxError as e:  # surfaced as a parse-error violation
+                self.parse_error = e
         self._jit_index = None
+        self._resolver = None
 
     @property
     def jit_index(self):
-        """Lazily built once per module, shared by every rule."""
+        """Lazily built once per module, shared by every rule.  In
+        whole-program mode the per-module index is augmented with the
+        cross-module traced roots the ProgramIndex resolved."""
         if self._jit_index is None:
             from photon_ml_tpu.analysis.jit_index import JitIndex
-            self._jit_index = JitIndex(self.tree) if self.tree else JitIndex(None)
+            idx = JitIndex(self.tree) if self.tree else JitIndex(None)
+            if self.program is not None and self.tree is not None:
+                for fn, params in self.program.extra_roots(self.relpath, idx):
+                    idx.add_root(fn, params)
+            self._jit_index = idx
         return self._jit_index
+
+    @property
+    def resolver(self):
+        """Shared best-effort literal resolver (analysis/resolve.py)."""
+        if self._resolver is None:
+            from photon_ml_tpu.analysis.resolve import Resolver
+            self._resolver = Resolver(self)
+        return self._resolver
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -208,11 +235,19 @@ class AnalysisResult:
     violations: List[Violation]
     suppressed: List[Violation]
     files_scanned: int
+    index_build_s: float = 0.0  # ProgramIndex build time (0 in per-module mode)
+    whole_program: bool = False
 
     def by_rule(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
         for v in self.violations:
             counts[v.rule] = counts.get(v.rule, 0) + 1
+        return counts
+
+    def by_severity(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for v in self.violations:
+            counts[v.severity] = counts.get(v.severity, 0) + 1
         return counts
 
 
@@ -241,11 +276,12 @@ def _dedupe_occurrences(violations: List[Violation]) -> List[Violation]:
     return out
 
 
-def analyze_source(relpath: str, source: str,
-                   rules: Sequence[Rule]) -> Tuple[List[Violation],
-                                                   List[Violation]]:
-    """Lint one in-memory module; returns (kept, suppressed)."""
-    ctx = ModuleContext(relpath, source)
+def analyze_source(relpath: str, source: str, rules: Sequence[Rule],
+                   program=None) -> Tuple[List[Violation],
+                                          List[Violation]]:
+    """Lint one in-memory module; returns (kept, suppressed).  ``program``:
+    optional ProgramIndex for whole-program (cross-module) resolution."""
+    ctx = ModuleContext(relpath, source, program=program)
     found: List[Violation] = []
     if ctx.parse_error is not None:
         e = ctx.parse_error
@@ -264,12 +300,31 @@ def analyze_source(relpath: str, source: str,
 
 
 def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
-                 root: Optional[str] = None) -> AnalysisResult:
+                 root: Optional[str] = None, whole_program: bool = True,
+                 index_paths: Optional[Sequence[str]] = None
+                 ) -> AnalysisResult:
     """Lint every ``.py`` under ``paths``.  ``root`` anchors the
     repo-relative paths used in reports and baseline fingerprints (default:
-    the current working directory)."""
+    the current working directory).
+
+    ``whole_program``: build a ProgramIndex so trace-scoped rules resolve
+    functions jitted across module boundaries and the sharding rules see
+    every mesh in the program (default; ``False`` restores pure per-module
+    analysis — the ``--no-program-index`` escape hatch).
+
+    ``index_paths``: build the ProgramIndex over THESE paths instead of the
+    lint paths — the incremental mode (``--paths``): lint a few files while
+    indexing the whole package so cross-module results match a full run.
+    """
     rules = list(rules) if rules is not None else build_rules()
     root = os.path.abspath(root or os.getcwd())
+    program = None
+    index_build_s = 0.0
+    if whole_program:
+        from photon_ml_tpu.analysis.program_index import ProgramIndex
+        program = ProgramIndex.from_paths(
+            list(index_paths) if index_paths else list(paths), root)
+        index_build_s = program.build_seconds
     violations: List[Violation] = []
     suppressed: List[Violation] = []
     n_files = 0
@@ -279,9 +334,10 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
             rel = os.path.relpath(os.path.abspath(fpath), root)
             with open(fpath, "r", encoding="utf-8") as f:
                 source = f.read()
-            kept, supp = analyze_source(rel, source, rules)
+            kept, supp = analyze_source(rel, source, rules, program=program)
             violations.extend(kept)
             suppressed.extend(supp)
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.code))
     return AnalysisResult(violations=violations, suppressed=suppressed,
-                          files_scanned=n_files)
+                          files_scanned=n_files, index_build_s=index_build_s,
+                          whole_program=whole_program)

@@ -15,7 +15,9 @@ trace.  This index resolves, per module, the idioms this codebase uses:
   - ``jax.jit(lambda ...: ...)`` — the lambda body is jit code.
 
 Cross-module flows (a function passed to a jit defined elsewhere) are out of
-scope — per-module analysis keeps the pass dependency-free and O(file).
+scope HERE — per-module analysis keeps the pass dependency-free and O(file);
+the whole-program layer (analysis/program_index.py) resolves them and
+splices the result back in through :meth:`JitIndex.add_root`.
 ``static_argnames``/``static_argnums`` are honoured when given as literals:
 static parameters are concrete Python values at trace time, not tracers, so
 param-sensitive checks must skip them.
@@ -207,6 +209,15 @@ class JitIndex:
     # -- queries -----------------------------------------------------------
     def is_jitted(self, fn: ast.AST) -> bool:
         return id(fn) in self._jitted
+
+    def add_root(self, fn: FunctionNode, params: Set[str]) -> None:
+        """Splice in an externally-resolved traced root (the whole-program
+        layer's cross-module jit targets and call-graph-reached helpers)."""
+        if id(fn) in self._jitted:
+            return
+        self._jitted[id(fn)] = (fn, set(), set())
+        self.roots.append((fn, params))
+        self.roots.sort(key=lambda r: r[0].lineno)
 
 
 def walk_jit_code(index: JitIndex):

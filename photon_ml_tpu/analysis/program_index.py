@@ -1,0 +1,360 @@
+"""Whole-program index: cross-module jit resolution for photonlint.
+
+The per-module ``JitIndex`` (analysis/jit_index.py) deliberately stops at
+module boundaries — a function defined in ``core/objective.py`` and jitted
+in ``parallel/fixed.py`` is invisible to the trace-scoped rules (PL001
+host-sync, PL003 tracer-safety, PL004 dtype-discipline).  This module adds
+the whole-program layer:
+
+  1. parse every module of the package ONCE;
+  2. build a module/symbol table — ``import a.b as c``, ``from a import b``
+     (absolute and relative), module-level function defs, module-level
+     string/tuple constants;
+  3. seed a call graph at every jit entry point: the per-module JitIndex
+     roots plus ``jax.jit(target)`` call sites whose target resolves through
+     the import table to a function in ANOTHER module;
+  4. propagate "traced" reachability over the call graph: a call inside
+     traced code to a resolvable function (local ``Name``, imported symbol,
+     ``alias.fn`` through a module alias, or ``self.method`` by name within
+     the module) marks the callee traced, to a fixpoint.
+
+``extra_roots(relpath, base_index)`` then returns, per module, the traced
+functions the per-module index did NOT already cover; ``ModuleContext``
+splices them into its ``JitIndex`` so every existing trace-scoped rule sees
+cross-module flows with no rule changes.
+
+The index also collects the program's **mesh-axis universe** — the axis
+names of every ``jax.sharding.Mesh(...)`` constructed anywhere in the
+package, with name constants (``DATA_AXIS`` et al.) resolved through the
+import table — which PL007 (mesh-axis) and PL008 (sharding-annotation)
+validate collective axis names and ``PartitionSpec`` strings against.
+
+Resolution is best-effort and conservative: anything unresolvable simply
+contributes nothing (no finding), so whole-program mode can only ADD
+findings relative to per-module mode, never invent phantom context.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from photon_ml_tpu.analysis.jit_index import (FunctionNode, JitIndex,
+                                              _static_names_from_call,
+                                              _static_nums_from_call,
+                                              _unwrap_transform, _walk_scope,
+                                              dotted_name, is_jit_call,
+                                              param_names)
+
+_MESH_TERMINALS = {"Mesh"}
+
+
+def module_name_for(relpath: str) -> str:
+    """``photon_ml_tpu/parallel/fixed.py`` -> ``photon_ml_tpu.parallel.fixed``."""
+    name = relpath.replace(os.sep, "/")
+    if name.endswith(".py"):
+        name = name[:-3]
+    name = name.strip("/").replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+class ModuleInfo:
+    """Symbol table of one parsed module."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.name = module_name_for(self.relpath)
+        self.source = source
+        self.tree: Optional[ast.Module] = None
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError:
+            # the framework re-parses and surfaces this as a PL000 finding;
+            # an unparseable module just contributes nothing to the index
+            pass
+        # local alias -> (module dotted path, symbol-in-module or None)
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        # module-level function defs (jit targets / call-graph callees)
+        self.defs: Dict[str, FunctionNode] = {}
+        # ALL function defs by name, any nesting (self.method resolution)
+        self.defs_by_name: Dict[str, List[FunctionNode]] = {}
+        # module-level simple constants: NAME = <expr>
+        self.constants: Dict[str, ast.expr] = {}
+        self.jit_index = JitIndex(self.tree)
+        if self.tree is None:
+            return
+        self._collect()
+
+    def _collect(self) -> None:
+        pkg = self.name.rpartition(".")[0]  # enclosing package for relatives
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    self.imports[bound] = (target, None)
+                    if alias.asname is None and "." in alias.name:
+                        # `import a.b.c` binds `a`, but the dotted chain
+                        # a.b.c.fn resolves through the FULL path; remember
+                        # it keyed by the head with the chain retained
+                        self.imports.setdefault(
+                            alias.name.split(".")[0],
+                            (alias.name.split(".")[0], None))
+            elif isinstance(stmt, ast.ImportFrom):
+                base = stmt.module or ""
+                if stmt.level:  # relative import
+                    parts = pkg.split(".") if pkg else []
+                    cut = stmt.level - 1
+                    parts = parts[: len(parts) - cut] if cut else parts
+                    base = ".".join(p for p in (".".join(parts), base) if p)
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    self.imports[bound] = (base, alias.name)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Name):
+                    self.constants[tgt.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    self.constants[stmt.target.id] = stmt.value
+
+
+class ProgramIndex:
+    """Cross-module symbol table + traced-reachability index (see module
+    docstring).  Build once per lint run; O(total AST nodes)."""
+
+    def __init__(self, sources: Dict[str, str]):
+        t0 = time.perf_counter()
+        self.modules: Dict[str, ModuleInfo] = {}      # by relpath
+        self.by_name: Dict[str, ModuleInfo] = {}      # by dotted module name
+        for relpath in sorted(sources):
+            info = ModuleInfo(relpath, sources[relpath])
+            self.modules[info.relpath] = info
+            self.by_name[info.name] = info
+        # id(fn) -> (ModuleInfo, fn, tracer-param names)
+        self._traced: Dict[int, Tuple[ModuleInfo, FunctionNode, Set[str]]] = {}
+        self._propagate()
+        self.axis_universe: Set[str] = self._collect_mesh_axes()
+        self.build_seconds = time.perf_counter() - t0
+
+    @classmethod
+    def from_paths(cls, paths: Sequence[str], root: str) -> "ProgramIndex":
+        from photon_ml_tpu.analysis.framework import _iter_py_files
+
+        root = os.path.abspath(root)
+        sources: Dict[str, str] = {}
+        for path in paths:
+            for fpath in _iter_py_files(path):
+                rel = os.path.relpath(os.path.abspath(fpath), root)
+                with open(fpath, "r", encoding="utf-8") as f:
+                    sources[rel.replace(os.sep, "/")] = f.read()
+        return cls(sources)
+
+    # -- lookups -------------------------------------------------------------
+    def tree_for(self, relpath: str) -> Optional[ast.Module]:
+        info = self.modules.get(relpath.replace(os.sep, "/"))
+        return info.tree if info else None
+
+    def _split_target(self, full: str) -> Optional[Tuple[ModuleInfo, str]]:
+        """Longest-prefix match of a dotted path against known modules;
+        the remainder must be a single symbol."""
+        parts = full.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.by_name.get(".".join(parts[:i]))
+            if mod is not None:
+                rest = parts[i:]
+                if len(rest) == 1:
+                    return mod, rest[0]
+                return None
+        return None
+
+    def resolve_symbol(self, info: ModuleInfo,
+                       dotted: str) -> Optional[Tuple[ModuleInfo, str]]:
+        """A dotted name as WRITTEN in ``info`` -> (defining module, symbol),
+        resolved through the import table.  None when it doesn't lead to a
+        module in this program."""
+        head, _, rest = dotted.partition(".")
+        imp = info.imports.get(head)
+        if imp is None:
+            return None
+        target_mod, target_sym = imp
+        if target_sym is None:
+            full = target_mod + ("." + rest if rest else "")
+        else:
+            full = target_mod + "." + target_sym + ("." + rest if rest else "")
+        # `from a import b` where b is a MODULE (subpackage import)
+        mod = self.by_name.get(full)
+        if mod is not None:
+            return None  # a bare module reference, not a symbol
+        return self._split_target(full)
+
+    def resolve_function(self, info: ModuleInfo,
+                         dotted: str) -> Optional[Tuple[ModuleInfo,
+                                                        FunctionNode]]:
+        got = self.resolve_symbol(info, dotted)
+        if got is None:
+            return None
+        mod, sym = got
+        fn = mod.defs.get(sym)
+        return (mod, fn) if fn is not None else None
+
+    def const_value(self, info: ModuleInfo, expr: ast.AST, depth: int = 0):
+        """Best-effort literal value of a module-level expression: constants,
+        name references (local or imported), tuples/lists.  None = unknown."""
+        if depth > 8 or expr is None:
+            return None
+        if isinstance(expr, ast.Constant):
+            return expr.value
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            vals = []
+            for e in expr.elts:
+                v = self.const_value(info, e, depth + 1)
+                if v is None:
+                    return None
+                vals.append(v)
+            return tuple(vals)
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        if "." not in name and name in info.constants:
+            return self.const_value(info, info.constants[name], depth + 1)
+        got = self.resolve_symbol(info, name)
+        if got is not None:
+            mod, sym = got
+            if sym in mod.constants:
+                return self.const_value(mod, mod.constants[sym], depth + 1)
+        return None
+
+    # -- mesh axes -----------------------------------------------------------
+    def _collect_mesh_axes(self) -> Set[str]:
+        axes: Set[str] = set()
+        for info in self.modules.values():
+            if info.tree is None:
+                continue
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if fname is None or fname.rpartition(".")[2] not in _MESH_TERMINALS:
+                    continue
+                axes_expr = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        axes_expr = kw.value
+                if axes_expr is None and len(node.args) >= 2:
+                    axes_expr = node.args[1]
+                val = self.const_value(info, axes_expr)
+                if isinstance(val, str):
+                    axes.add(val)
+                elif isinstance(val, tuple):
+                    axes.update(v for v in val if isinstance(v, str))
+        return axes
+
+    # -- traced propagation --------------------------------------------------
+    def _seed(self, info: ModuleInfo) -> Iterable[Tuple[ModuleInfo,
+                                                        FunctionNode,
+                                                        Set[str]]]:
+        # per-module roots (decorators, local jit call sites)
+        for fn, params in info.jit_index.roots:
+            yield info, fn, params
+        if info.tree is None:
+            return
+        # cross-module jit call sites: jax.jit(target) where target is an
+        # imported symbol or a module-alias attribute
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and is_jit_call(node)
+                    and node.args):
+                continue
+            target = _unwrap_transform(node.args[0])
+            dn = dotted_name(target) if target is not None else None
+            if dn is None:
+                continue
+            if "." not in dn and dn in info.defs_by_name:
+                continue  # local — per-module index already covers it
+            got = self.resolve_function(info, dn)
+            if got is None:
+                continue
+            mod, fn = got
+            statics = _static_names_from_call(node)
+            nums = _static_nums_from_call(node)
+            yield mod, fn, param_names(fn, statics, nums)
+
+    def _propagate(self) -> None:
+        stack: List[Tuple[ModuleInfo, FunctionNode, Set[str]]] = []
+        for info in self.modules.values():
+            for mod, fn, params in self._seed(info):
+                if id(fn) not in self._traced:
+                    self._traced[id(fn)] = (mod, fn, params)
+                    stack.append((mod, fn, params))
+        while stack:
+            info, fn, params = stack.pop()
+            for node, _ in _walk_scope(fn, params):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self._resolve_callee(info, node.func)
+                if callee is None:
+                    continue
+                mod, target = callee
+                if id(target) in self._traced:
+                    continue
+                # conservatively every parameter of a call-graph-reached
+                # function is a tracer (mirrors nested-def handling in
+                # jit_index._walk_scope)
+                tparams = param_names(target, set(), set())
+                self._traced[id(target)] = (mod, target, tparams)
+                stack.append((mod, target, tparams))
+
+    def _resolve_callee(self, info: ModuleInfo, func: ast.AST
+                        ) -> Optional[Tuple[ModuleInfo, FunctionNode]]:
+        if isinstance(func, ast.Name):
+            local = info.defs.get(func.id)
+            if local is not None:
+                return info, local
+            return self.resolve_function(info, func.id)
+        if isinstance(func, ast.Attribute):
+            # self.method: by-name within the module (the same terminal-attr
+            # convention the per-module JitIndex uses for jit targets)
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                cands = info.defs_by_name.get(func.attr)
+                if cands and len(cands) == 1:
+                    return info, cands[0]
+                return None
+            dn = dotted_name(func)
+            if dn is not None:
+                return self.resolve_function(info, dn)
+        return None
+
+    # -- rule-facing queries ---------------------------------------------------
+    def traced_in(self, relpath: str) -> List[Tuple[FunctionNode, Set[str]]]:
+        relpath = relpath.replace(os.sep, "/")
+        out = [(fn, params) for (mod, fn, params) in self._traced.values()
+               if mod.relpath == relpath]
+        out.sort(key=lambda t: t[0].lineno)
+        return out
+
+    def extra_roots(self, relpath: str, base: JitIndex
+                    ) -> List[Tuple[FunctionNode, Set[str]]]:
+        """Traced functions of ``relpath`` the per-module ``base`` index does
+        not already walk (not jitted there, not nested under a base root or
+        an earlier extra root)."""
+        covered: Set[int] = set()
+        for root, _ in base.roots:
+            covered.update(id(n) for n in ast.walk(root))
+        extras: List[Tuple[FunctionNode, Set[str]]] = []
+        for fn, params in self.traced_in(relpath):
+            if base.is_jitted(fn) or id(fn) in covered:
+                continue
+            extras.append((fn, params))
+            covered.update(id(n) for n in ast.walk(fn))
+        return extras

@@ -27,8 +27,8 @@ def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
         out.extend(f"  {v.render()}" for v in baselined)
     if stale:
         out.append("")
-        out.append(f"stale baseline entries ({len(stale)}) — debt fixed; "
-                   "prune with --write-baseline:")
+        out.append(f"stale baseline entries ({len(stale)}) — debt fixed but "
+                   "still baselined; remove with --prune-baseline:")
         out.extend(f"  {fp}" for fp in stale)
     out.append("")
     by_rule = {}
@@ -36,11 +36,21 @@ def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
         by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
     detail = (" (" + ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
               + ")") if by_rule else ""
+    mode = (f", index {result.index_build_s:.2f}s"
+            if result.whole_program else ", per-module mode")
     out.append(
         f"photonlint: {result.files_scanned} files scanned, "
         f"{len(new)} new violation(s){detail}, {len(baselined)} baselined, "
-        f"{len(result.suppressed)} suppressed")
+        f"{len(result.suppressed)} suppressed{mode}")
     return "\n".join(out)
+
+
+def _counts(violations: Sequence[Violation], key) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for v in violations:
+        k = key(v)
+        out[k] = out.get(k, 0) + 1
+    return dict(sorted(out.items()))
 
 
 def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
@@ -56,6 +66,11 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
             "baselined": len(baselined),
             "suppressed": len(result.suppressed),
             "stale": len(stale),
+            "files_scanned": result.files_scanned,
+            "whole_program": result.whole_program,
+            "index_build_s": round(result.index_build_s, 4),
+            "by_rule": _counts(new, lambda v: v.rule),
+            "by_severity": _counts(new, lambda v: v.severity),
         },
     }
     return json.dumps(payload, indent=2)

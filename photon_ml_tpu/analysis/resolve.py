@@ -1,0 +1,295 @@
+"""Best-effort literal resolution inside one module, for rule checks.
+
+PL006 (donation) needs ``donate_argnums=donate`` resolved to concrete
+positions; PL007/PL008 need axis names like ``axis`` / ``self.feature_axis``
+resolved to strings before validating them against the mesh universe.  The
+repo's idiom chains several hops deep::
+
+    class ShardSparseObjective:
+        def __init__(self, ..., feature_axis: str = FEATURE_AXIS):
+            self.feature_axis = feature_axis          # param default
+        def hvp(self, ...):
+            obj, data, feat = self.obj, self.data_axis, self.feature_axis
+            ... jax.lax.psum(..., feat)               # tuple unpack
+
+so the resolver follows: constants, Name bindings in enclosing function
+scopes (including tuple-unpack assignments), parameter DEFAULTS, ``self.X``
+attributes assigned in ``__init__``/other methods, module-level constants,
+and — when a :class:`~photon_ml_tpu.analysis.program_index.ProgramIndex`
+is attached — constants imported from other modules.
+
+``values(node)`` returns the LIST of possible literal values (an ``IfExp``
+contributes both branches; an empty list means "unknown").  Unknown always
+means "stay quiet" for the rules built on top — resolution failures must
+never invent findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+_MAX_DEPTH = 10
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef,
+           ast.Module)
+
+
+class Resolver:
+    def __init__(self, ctx):
+        """``ctx``: a framework.ModuleContext (tree + optional .program)."""
+        self.ctx = ctx
+        self.tree = ctx.tree
+        self.program = getattr(ctx, "program", None)
+        self._parents: Dict[int, ast.AST] = {}
+        self._constants: Dict[str, ast.expr] = {}
+        if self.tree is None:
+            return
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[id(child)] = node
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                self._constants[stmt.targets[0].id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                self._constants[stmt.target.id] = stmt.value
+
+    # -- scope walking -------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def enclosing_scopes(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-out chain of function/class/module scopes above node."""
+        out: List[ast.AST] = []
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, _SCOPES):
+                out.append(cur)
+            cur = self.parent(cur)
+        return out
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for scope in self.enclosing_scopes(node):
+            if isinstance(scope, ast.ClassDef):
+                return scope
+        return None
+
+    # -- resolution ----------------------------------------------------------
+    def values(self, node: ast.AST, at: Optional[ast.AST] = None,
+               depth: int = 0) -> List[object]:
+        """Possible literal values of ``node`` ([] = unknown).  ``at``
+        anchors Name lookups to the scope chain of that node (defaults to
+        ``node`` itself)."""
+        if depth > _MAX_DEPTH or node is None:
+            return []
+        at = at if at is not None else node
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, ast.IfExp):
+            return _dedupe(self.values(node.body, at, depth + 1)
+                           + self.values(node.orelse, at, depth + 1))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            elts = [self.values(e, at, depth + 1) for e in node.elts]
+            if any(not v for v in elts):
+                return []
+            # cap the cross product: one alternative per element beyond the
+            # first keeps this bounded and is plenty for donate/axis specs
+            out = [tuple(v[0] for v in elts)]
+            for i, alts in enumerate(elts):
+                for alt in alts[1:3]:
+                    combo = list(out[0])
+                    combo[i] = alt
+                    out.append(tuple(combo))
+            return _dedupe(out)
+        if isinstance(node, ast.Name):
+            return self._name_values(node.id, at, depth)
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return self._self_attr_values(node.attr, at, depth)
+            return self._imported_const(node, depth)
+        return []
+
+    def _name_values(self, name: str, at: ast.AST, depth: int) -> List[object]:
+        for scope in self.enclosing_scopes(at):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                got: List[object] = []
+                for expr in self._bindings_in(scope, name):
+                    got.extend(self.values(expr, expr, depth + 1))
+                default = self._param_default(scope, name)
+                if default is not None:
+                    # defaults evaluate in the scope ENCLOSING the function
+                    got.extend(self.values(default, scope, depth + 1))
+                if got or self._binds(scope, name):
+                    return _dedupe(got)
+            elif isinstance(scope, ast.ClassDef):
+                continue  # class bodies don't scope into methods
+        if name in self._constants:
+            return self.values(self._constants[name], self.tree, depth + 1)
+        return self._imported_name_const(name, depth)
+
+    def _self_attr_values(self, attr: str, at: ast.AST,
+                          depth: int) -> List[object]:
+        cls = self.enclosing_class(at)
+        if cls is None:
+            return []
+        got: List[object] = []
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(item):
+                for tgt, expr in _assign_pairs(stmt):
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self" and tgt.attr == attr):
+                        got.extend(self.values(expr, expr, depth + 1))
+        return _dedupe(got)
+
+    def _bindings_in(self, scope, name: str) -> List[ast.expr]:
+        """Expressions assigned to ``name`` anywhere in ``scope``'s own body
+        (nested defs excluded — their bindings are theirs)."""
+        out: List[ast.expr] = []
+        body = scope.body if isinstance(scope.body, list) else []
+        stack = list(body)
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            for tgt, expr in _assign_pairs(stmt):
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    out.append(expr)
+            stack.extend(ast.iter_child_nodes(stmt))
+        return out
+
+    def _binds(self, scope, name: str) -> bool:
+        """Is ``name`` a parameter of ``scope`` (shadowing outer scopes)?"""
+        a = scope.args
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return name in names
+
+    def _param_default(self, scope, name: str) -> Optional[ast.expr]:
+        a = scope.args
+        ordered = list(a.posonlyargs) + list(a.args)
+        defaults = list(a.defaults)
+        # defaults align to the TAIL of the positional params
+        for param, default in zip(ordered[len(ordered) - len(defaults):],
+                                  defaults):
+            if param.arg == name:
+                return default
+        for param, default in zip(a.kwonlyargs, a.kw_defaults):
+            if param.arg == name and default is not None:
+                return default
+        return None
+
+    def _imported_name_const(self, name: str, depth: int) -> List[object]:
+        if self.program is None:
+            return []
+        info = self.program.modules.get(self.ctx.relpath)
+        if info is None:
+            return []
+        val = self.program.const_value(info, ast.Name(id=name, ctx=ast.Load()),
+                                       depth)
+        return [val] if val is not None else []
+
+    def _imported_const(self, node: ast.Attribute, depth: int) -> List[object]:
+        if self.program is None:
+            return []
+        info = self.program.modules.get(self.ctx.relpath)
+        if info is None:
+            return []
+        val = self.program.const_value(info, node, depth)
+        return [val] if val is not None else []
+
+    # -- convenience ---------------------------------------------------------
+    def strings(self, node: ast.AST) -> List[str]:
+        """Flattened possible axis-name strings of node (strings and
+        tuples-of-strings both contribute their members)."""
+        out: List[str] = []
+        for v in self.values(node):
+            if isinstance(v, str):
+                out.append(v)
+            elif isinstance(v, tuple):
+                out.extend(x for x in v if isinstance(x, str))
+        return _dedupe(out)
+
+
+def _assign_pairs(stmt: ast.AST):
+    """(target, value-expr) pairs of an assignment statement, tuple-unpacks
+    expanded elementwise when both sides are tuples."""
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    and isinstance(stmt.value, (ast.Tuple, ast.List)) \
+                    and len(tgt.elts) == len(stmt.value.elts):
+                yield from zip(tgt.elts, stmt.value.elts)
+            else:
+                yield tgt, stmt.value
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        yield stmt.target, stmt.value
+
+
+def _dedupe(items: List) -> List:
+    out = []
+    for x in items:
+        if x not in out:
+            out.append(x)
+    return out
+
+
+def mesh_axes_in_module(resolver: Resolver) -> Set[str]:
+    """Axis names of every ``Mesh(...)`` constructed in THIS module (the
+    no-program-index fallback for PL007/PL008)."""
+    axes: Set[str] = set()
+    if resolver.tree is None:
+        return axes
+    for node in ast.walk(resolver.tree):
+        got = mesh_axes_of_call(resolver, node)
+        if got:
+            axes.update(got)
+    return axes
+
+
+def mesh_axes_of_call(resolver: Resolver, node: ast.AST) -> Set[str]:
+    """Axis names when ``node`` is a ``Mesh(...)`` construction (else {})."""
+    from photon_ml_tpu.analysis.jit_index import dotted_name
+
+    if not isinstance(node, ast.Call):
+        return set()
+    fname = dotted_name(node.func)
+    if fname is None or fname.rpartition(".")[2] != "Mesh":
+        return set()
+    axes_expr = None
+    for kw in node.keywords:
+        if kw.arg == "axis_names":
+            axes_expr = kw.value
+    if axes_expr is None and len(node.args) >= 2:
+        axes_expr = node.args[1]
+    if axes_expr is None:
+        return set()
+    return set(resolver.strings(axes_expr))
+
+
+def mesh_axes_of_expr(resolver: Resolver, expr: ast.AST) -> Set[str]:
+    """Resolve a mesh-valued EXPRESSION to its axis names when statically
+    visible: a direct ``Mesh(...)`` call, or a Name bound to one in an
+    enclosing scope.  {} = unknown."""
+    direct = mesh_axes_of_call(resolver, expr)
+    if direct:
+        return direct
+    if isinstance(expr, ast.Name):
+        for scope in resolver.enclosing_scopes(expr):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for bound in resolver._bindings_in(scope, expr.id):
+                    got = mesh_axes_of_call(resolver, bound)
+                    if got:
+                        return got
+        if expr.id in resolver._constants:
+            return mesh_axes_of_call(resolver, resolver._constants[expr.id])
+    return set()

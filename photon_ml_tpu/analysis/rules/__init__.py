@@ -1,14 +1,19 @@
 """photonlint rule catalog — importing this package registers every rule.
 
-| code  | rule              | guards                                         |
-|-------|-------------------|------------------------------------------------|
-| PL001 | host-sync         | device→host syncs inside jit-traced code       |
-| PL002 | recompile-hazard  | per-call / per-iteration jit construction      |
-| PL003 | tracer-safety     | Python control flow on traced values           |
-| PL004 | dtype-discipline  | float64 / numpy promotion on TPU hot paths     |
-| PL005 | lock-discipline   | unlocked mutation of lock-protected state      |
+| code  | rule                | guards                                       |
+|-------|---------------------|----------------------------------------------|
+| PL001 | host-sync           | device→host syncs inside jit-traced code     |
+| PL002 | recompile-hazard    | per-call / per-iteration jit construction    |
+| PL003 | tracer-safety       | Python control flow on traced values         |
+| PL004 | dtype-discipline    | float64 / numpy promotion on TPU hot paths   |
+| PL005 | lock-discipline     | unlocked mutation of lock-protected state    |
+| PL006 | donation-after-use  | reads of buffers already donated to jit      |
+| PL007 | mesh-axis           | collective axis names absent from the mesh   |
+| PL008 | sharding-annotation | unannotated mesh-path jits / bad spec axes   |
 
-Planned (ROADMAP): donation-after-use, sharding-annotation checks.
+PL001/PL003/PL004 are trace-scoped: in whole-program mode (the default) the
+ProgramIndex resolves functions jitted across module boundaries, so they
+fire on helpers defined in one file and jitted in another.
 """
 
 from photon_ml_tpu.analysis.rules.host_sync import HostSyncRule
@@ -16,6 +21,9 @@ from photon_ml_tpu.analysis.rules.recompile import RecompileHazardRule
 from photon_ml_tpu.analysis.rules.tracer import TracerSafetyRule
 from photon_ml_tpu.analysis.rules.dtype import DtypeDisciplineRule
 from photon_ml_tpu.analysis.rules.locks import LockDisciplineRule
+from photon_ml_tpu.analysis.rules.donation import DonationRule
+from photon_ml_tpu.analysis.rules.mesh_axis import MeshAxisRule
+from photon_ml_tpu.analysis.rules.sharding import ShardingAnnotationRule
 
 __all__ = [
     "HostSyncRule",
@@ -23,4 +31,7 @@ __all__ = [
     "TracerSafetyRule",
     "DtypeDisciplineRule",
     "LockDisciplineRule",
+    "DonationRule",
+    "MeshAxisRule",
+    "ShardingAnnotationRule",
 ]

@@ -1,0 +1,343 @@
+"""PL006 donation-after-use: reading a buffer after donating it to jit.
+
+Why it matters here: ``serving/engine.py`` donates the per-request buffers
+(features, slots, overflow) to its AOT executables so XLA can reuse their
+device memory for outputs, and ``utils/transfer.py`` assembles chunked
+uploads through a donated ``lax.dynamic_update_slice`` so the design matrix
+is never double-resident in HBM.  Donation invalidates the argument buffer:
+a later read of the SAME array is a use-after-free that CPU silently
+tolerates (no donation support — jax only warns) and that corrupts data or
+crashes only on TPU/GPU — the classic "passes every CPU test, fails on the
+pod" bug.
+
+Tracked, per scope (module body / each function body, statements in source
+order; loop bodies are scanned twice so a donation in iteration N is seen
+by the reads of iteration N+1):
+
+  - donating callables: ``f = jax.jit(fn, donate_argnums=...)`` (also
+    ``donate_argnames``), including AOT chains ``f.lower(...).compile()``
+    and methods of the same class that RETURN such an executable
+    (serving/engine.py's ``_executable``) — donate specs resolved through
+    analysis/resolve.py, so conditional specs like engine's
+    backend-gated ``(0, 3, 4) if ... else ()`` contribute both branches;
+  - derived donors: a plain function that forwards one of its OWN
+    parameters into a donated position (``transfer._update_at``) donates
+    that parameter position too;
+  - at each donating call, plain-Name arguments in donated positions become
+    tainted; a later Name READ of a tainted variable in the same scope is
+    the violation; any re-assignment of the name clears the taint (the
+    ``out = update(out, ...)`` rebind idiom is the sanctioned pattern).
+
+Additionally, passing one of the ENCLOSING function's parameters straight
+into a donated position is flagged at warning severity: the caller may
+still hold the buffer, and the donation contract has crossed a function
+boundary where this per-scope analysis cannot follow it — either document
+the consuming contract (suppress with a reason) or donate a locally-owned
+buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import dotted_name, is_jit_call
+
+_AOT_ATTRS = {"lower", "compile"}
+
+
+@dataclasses.dataclass(frozen=True)
+class DonateSpec:
+    argnums: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.argnums or self.argnames)
+
+
+def _as_ints(val) -> Tuple[int, ...]:
+    if isinstance(val, bool):
+        return ()
+    if isinstance(val, int):
+        return (val,)
+    if isinstance(val, tuple):
+        return tuple(v for v in val if isinstance(v, int)
+                     and not isinstance(v, bool))
+    return ()
+
+
+def _as_strs(val) -> Tuple[str, ...]:
+    if isinstance(val, str):
+        return (val,)
+    if isinstance(val, tuple):
+        return tuple(v for v in val if isinstance(v, str))
+    return ()
+
+
+def _jit_donate_spec(ctx: ModuleContext, call: ast.Call) -> DonateSpec:
+    """Donate spec of a ``jax.jit(...)`` call (union over every resolvable
+    alternative of the spec expressions)."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for v in ctx.resolver.values(kw.value):
+                nums.update(_as_ints(v))
+        elif kw.arg == "donate_argnames":
+            for v in ctx.resolver.values(kw.value):
+                names.update(_as_strs(v))
+    return DonateSpec(tuple(sorted(nums)), tuple(sorted(names)))
+
+
+class _ScopeScanner:
+    """Linear scan of one scope's statements tracking donating callables,
+    taints, and reads.  Loop bodies run twice (see module docstring)."""
+
+    def __init__(self, rule: "DonationRule", ctx: ModuleContext,
+                 donors: Dict[str, DonateSpec],
+                 self_donors: Dict[str, DonateSpec],
+                 fn_params: Sequence[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.donors = dict(donors)          # name -> spec (inherited + local)
+        self.self_donors = self_donors      # self.method() -> spec
+        self.fn_params = set(fn_params)
+        self.tainted: Dict[str, Tuple[int, str]] = {}  # var -> (line, donor)
+        self.violations: List[Violation] = []
+        self._param_warned: Set[str] = set()
+        self._flagged: Set[int] = set()  # node ids (loop bodies scan twice)
+
+    # -- spec discovery ------------------------------------------------------
+    def _spec_of_expr(self, expr: ast.AST, depth: int = 0
+                      ) -> Optional[DonateSpec]:
+        """Donate spec carried by an expression: a jit call with donate
+        kwargs, an AOT ``.lower(...).compile()`` chain over one, a known
+        donating Name, or ``self.method(...)`` returning one."""
+        if depth > 6:
+            return None
+        if isinstance(expr, ast.Name):
+            return self.donors.get(expr.id)
+        if isinstance(expr, ast.Call):
+            if is_jit_call(expr):
+                spec = _jit_donate_spec(self.ctx, expr)
+                return spec if spec else None
+            func = expr.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _AOT_ATTRS:
+                    return self._spec_of_expr(func.value, depth + 1)
+                if isinstance(func.value, ast.Name) \
+                        and func.value.id == "self":
+                    return self.self_donors.get(func.attr)
+        if isinstance(expr, ast.Attribute) and expr.attr in _AOT_ATTRS:
+            return self._spec_of_expr(expr.value, depth + 1)
+        return None
+
+    # -- statement processing ------------------------------------------------
+    def run(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are scanned separately
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # two passes: taints created in pass 1 are visible to pass 2,
+            # catching `for ...: donating(x)` buffer reuse across iterations
+            for _ in range(2):
+                for sub in stmt.body:
+                    self._stmt(sub)
+            for sub in stmt.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(stmt, (ast.If,)):
+            for sub in stmt.body + stmt.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            for sub in stmt.body:
+                self._stmt(sub)
+            return
+        if isinstance(stmt, ast.Try):
+            for sub in (stmt.body + stmt.orelse + stmt.finalbody
+                        + [s for h in stmt.handlers for s in h.body]):
+                self._stmt(sub)
+            return
+        # leaf statement: reads -> new taints -> stores (in that order, so
+        # `x = donating(x)` reads the old buffer legally then clears)
+        self._expr(stmt)
+        self._taint_calls(stmt)
+        self._clear_stores(stmt)
+        self._bind_donors(stmt)
+
+    def _expr(self, node: ast.AST) -> None:
+        """Flag loads of tainted names anywhere under node."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in self.tainted \
+                    and id(sub) not in self._flagged:
+                self._flagged.add(id(sub))
+                line, donor = self.tainted[sub.id]
+                self.violations.append(self.ctx.violation(
+                    self.rule, sub,
+                    f"`{sub.id}` was donated to `{donor}` (line {line}) and "
+                    "read again — donation invalidates the buffer; on "
+                    "TPU/GPU this is a use-after-free that CPU runs hide. "
+                    "Rebind the result or drop the donation"))
+
+    def _taint_calls(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            # covers donating Names, self.method() donors, AOT chains, and
+            # immediately-invoked `jax.jit(f, donate_argnums=...)(x)`
+            spec = self._spec_of_expr(node.func)
+            if not spec:
+                continue
+            donor = dotted_name(node.func) or "<donating executable>"
+            positions = list(spec.argnums)
+            for i, arg in enumerate(node.args):
+                donated = i in positions
+                if not donated:
+                    continue
+                if isinstance(arg, ast.Name):
+                    self._donate_name(arg, donor)
+            for kw in node.keywords:
+                if kw.arg in spec.argnames and isinstance(kw.value, ast.Name):
+                    self._donate_name(kw.value, donor)
+
+    def _donate_name(self, arg: ast.Name, donor: str) -> None:
+        self.tainted[arg.id] = (arg.lineno, donor)
+        if arg.id in self.fn_params and arg.id not in self._param_warned:
+            self._param_warned.add(arg.id)
+            self.violations.append(self.ctx.violation(
+                self.rule, arg,
+                f"parameter `{arg.id}` is donated to `{donor}` — the caller "
+                "may still hold this buffer and the donation contract "
+                "crosses the function boundary; donate a locally-owned "
+                "array, or suppress with the documented consuming contract",
+                severity="warning"))
+
+    def _clear_stores(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                self.tainted.pop(node.id, None)
+
+    def _bind_donors(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            spec = self._spec_of_expr(stmt.value)
+            name = stmt.targets[0].id
+            if spec:
+                self.donors[name] = spec
+            else:
+                self.donors.pop(name, None)
+
+
+def _derived_donor_spec(ctx: ModuleContext, fn, donors: Dict[str, DonateSpec],
+                        self_donors: Dict[str, DonateSpec]) -> DonateSpec:
+    """Does ``fn`` forward its own parameters into donated positions?  The
+    positions of those parameters become the function's own donate spec
+    (transfer.py's ``_update_at`` pattern)."""
+    a = fn.args
+    ordered = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    scanner = _ScopeScanner(None, ctx, donors, self_donors, ())  # type: ignore
+    nums: Set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        spec = scanner._spec_of_expr(node.func)
+        if not spec:
+            continue
+        for i, arg in enumerate(node.args):
+            if i in spec.argnums and isinstance(arg, ast.Name) \
+                    and arg.id in ordered:
+                nums.add(ordered.index(arg.id))
+        for kw in node.keywords:
+            if kw.arg in spec.argnames and isinstance(kw.value, ast.Name) \
+                    and kw.value.id in ordered:
+                nums.add(ordered.index(kw.value.id))
+    return DonateSpec(tuple(sorted(nums)))
+
+
+@register
+class DonationRule(Rule):
+    name = "donation-after-use"
+    code = "PL006"
+    severity = "error"
+    description = ("no reads of a buffer after passing it through a "
+                   "donate_argnums/donate_argnames position")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        # pass 1: module-level donating names + methods returning donors
+        module_donors: Dict[str, DonateSpec] = {}
+        probe = _ScopeScanner(self, ctx, {}, {}, ())
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                spec = probe._spec_of_expr(stmt.value)
+                if spec:
+                    module_donors[stmt.targets[0].id] = spec
+        self_donors = self._method_donors(ctx, module_donors)
+        # pass 2: derived donors — module functions forwarding their params
+        for name, fn in _module_functions(ctx.tree):
+            spec = _derived_donor_spec(ctx, fn, module_donors, self_donors)
+            if spec and name not in module_donors:
+                module_donors[name] = spec
+        # pass 3: scan every scope linearly
+        yield from self._scan_scope(ctx, ctx.tree.body, module_donors,
+                                    self_donors, ())
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                params = [p.arg for p in list(a.posonlyargs) + list(a.args)
+                          + list(a.kwonlyargs)]
+                yield from self._scan_scope(ctx, node.body, module_donors,
+                                            self_donors, params)
+
+    def _scan_scope(self, ctx, body, donors, self_donors, params
+                    ) -> Iterator[Violation]:
+        scanner = _ScopeScanner(self, ctx, donors, self_donors, params)
+        scanner.run(body)
+        yield from scanner.violations
+
+    def _method_donors(self, ctx: ModuleContext,
+                       module_donors: Dict[str, DonateSpec]
+                       ) -> Dict[str, DonateSpec]:
+        """Methods whose RETURN value is a donating executable — resolved
+        through the method's own local bindings (engine._executable's
+        ``jitted -> lowered -> exe`` chain)."""
+        out: Dict[str, DonateSpec] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                scanner = _ScopeScanner(self, ctx, module_donors, {}, ())
+                spec: Optional[DonateSpec] = None
+                for stmt in ast.walk(item):
+                    if isinstance(stmt, ast.Assign):
+                        scanner._bind_donors(stmt)
+                    elif isinstance(stmt, ast.Return) \
+                            and stmt.value is not None:
+                        got = scanner._spec_of_expr(stmt.value)
+                        if got:
+                            spec = got
+                if spec:
+                    out[item.name] = spec
+        return out
+
+
+def _module_functions(tree: ast.Module):
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt.name, stmt

@@ -20,9 +20,13 @@ manager), flags:
 
 ``__init__``/``__new__`` are exempt (no aliasing before construction
 returns).  Mutations counted: assignment/augmented assignment to
-``self.X``, item assignment/deletion ``self.X[k]``, and calls of mutating
-container methods (``append``/``update``/``pop``/``popitem``/
-``move_to_end``/...) on ``self.X``.
+``self.X`` (tuple-unpacking and starred targets included), item
+assignment/deletion ``self.X[k]``, calls of mutating container methods
+(``append``/``update``/``pop``/``popitem``/``move_to_end``/...) on
+``self.X``, and in-place ``operator`` module calls — ``operator.iadd(
+self.X, v)`` / ``op.setitem(self.X, k, v)`` through any import alias —
+which mutate exactly like ``+=`` / ``self.X[k] = v`` but previously slipped
+past the target extraction.
 """
 
 from __future__ import annotations
@@ -42,7 +46,30 @@ _MUTATORS = {
     "popitem", "remove", "discard", "clear", "move_to_end", "appendleft",
     "popleft", "sort", "reverse",
 }
+# operator-module functions that mutate their FIRST argument in place
+_OP_MUTATORS = {
+    "iadd", "isub", "imul", "imatmul", "itruediv", "ifloordiv", "imod",
+    "ipow", "ilshift", "irshift", "iand", "ixor", "ior", "iconcat",
+    "setitem", "delitem",
+}
 _EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _operator_aliases(tree: ast.Module) -> Tuple[Set[str], Dict[str, str]]:
+    """(names bound to the operator module, local name -> operator function
+    for ``from operator import iadd [as x]``)."""
+    modules: Set[str] = set()
+    funcs: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if alias.name == "operator":
+                    modules.add(alias.asname or alias.name)
+        elif isinstance(stmt, ast.ImportFrom) and stmt.module == "operator":
+            for alias in stmt.names:
+                if alias.name in _OP_MUTATORS:
+                    funcs[alias.asname or alias.name] = alias.name
+    return modules, funcs
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -89,9 +116,13 @@ class _MethodScanner(ast.NodeVisitor):
     ``with self.<lock>`` nesting.  Nested function defs are skipped (their
     execution context is unknowable here)."""
 
-    def __init__(self, method_name: str, locks: Set[str]):
+    def __init__(self, method_name: str, locks: Set[str],
+                 op_modules: Set[str] = frozenset(),
+                 op_funcs: Optional[Dict[str, str]] = None):
         self.method = method_name
         self.locks = locks
+        self.op_modules = op_modules
+        self.op_funcs = op_funcs or {}
         self.depth = 0
         self.took_lock = False
         self.sites: List[_Site] = []
@@ -129,6 +160,9 @@ class _MethodScanner(ast.NodeVisitor):
         elif isinstance(tgt, (ast.Tuple, ast.List)):
             for elt in tgt.elts:
                 self._target(elt)
+        elif isinstance(tgt, ast.Starred):
+            # `self.head, *self.rest = xs` — the starred slot rebinds too
+            self._target(tgt.value)
 
     def visit_Assign(self, node: ast.Assign) -> None:
         for tgt in node.targets:
@@ -154,7 +188,18 @@ class _MethodScanner(ast.NodeVisitor):
         f = node.func
         if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
             self._add(_self_attr(f.value), node, "call")
+        elif self._is_op_mutator(f) and node.args:
+            self._add(_self_attr(node.args[0]), node, "call")
         self.generic_visit(node)
+
+    def _is_op_mutator(self, f: ast.AST) -> bool:
+        """``operator.iadd`` / ``op.setitem`` / bare ``iadd`` imported from
+        operator — the ``+=``-through-an-alias forms."""
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return f.value.id in self.op_modules and f.attr in _OP_MUTATORS
+        if isinstance(f, ast.Name):
+            return f.id in self.op_funcs
+        return False
 
 
 @register
@@ -168,12 +213,14 @@ class LockDisciplineRule(Rule):
     def check(self, ctx: ModuleContext) -> Iterator[Violation]:
         if ctx.tree is None:
             return
+        op_modules, op_funcs = _operator_aliases(ctx.tree)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
-                yield from self._check_class(ctx, node)
+                yield from self._check_class(ctx, node, op_modules, op_funcs)
 
-    def _check_class(self, ctx: ModuleContext,
-                     cls: ast.ClassDef) -> Iterator[Violation]:
+    def _check_class(self, ctx: ModuleContext, cls: ast.ClassDef,
+                     op_modules: Set[str],
+                     op_funcs: Dict[str, str]) -> Iterator[Violation]:
         locks = _lock_names(cls)
         if not locks:
             return
@@ -184,7 +231,7 @@ class LockDisciplineRule(Rule):
                 continue
             if item.name in _EXEMPT_METHODS:
                 continue
-            scanner = _MethodScanner(item.name, locks)
+            scanner = _MethodScanner(item.name, locks, op_modules, op_funcs)
             # generic_visit: enter the method body without tripping the
             # nested-def skip on the method node itself
             scanner.generic_visit(item)

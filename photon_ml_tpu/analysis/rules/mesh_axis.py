@@ -1,0 +1,188 @@
+"""PL007 mesh-axis: collective axis names must exist on the mesh in scope.
+
+Why it matters here: the distributed objectives (parallel/fixed.py alone has
+~15 ``jax.lax.psum`` sites over two axes) are explicit SPMD — every
+collective names a mesh axis as a STRING, and nothing checks those strings
+until the program actually runs on a mesh that is missing the axis.  On a
+single-device CPU run the mesh often has every axis (or the collective is a
+no-op), so a typo'd or stale axis name is exactly the failure class that
+only reproduces on a pod slice (DrJAX, arxiv 2403.07128, calls mesh-axis
+mistakes the dominant silent-failure mode for shard_map-heavy code).
+
+Checked, for every collective call (``jax.lax.psum/pmean/pmax/pmin/
+all_gather/ppermute/psum_scatter/all_to_all/axis_index``):
+
+  - when the call sits lexically inside a function bound by a
+    ``shard_map``/``pjit`` site whose ``mesh=...`` expression resolves to a
+    ``Mesh(...)`` construction, the axis must be one of THAT mesh's axes;
+  - otherwise the axis must appear in the program's mesh-axis universe —
+    the union of every ``Mesh(axis_names=...)`` in the package, collected
+    by the ProgramIndex (or, in ``--no-program-index`` mode, this module).
+
+Axis names are resolved through analysis/resolve.py (parameter defaults,
+``self.X`` attributes, tuple unpacks, imported constants like
+``parallel/mesh.DATA_AXIS``); an unresolvable axis or an empty universe
+stays quiet — resolution failures must never invent findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import _unwrap_transform, dotted_name
+from photon_ml_tpu.analysis.resolve import (mesh_axes_in_module,
+                                            mesh_axes_of_expr)
+
+# collective terminal name -> positional index of its axis-name argument
+_COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "ppermute": 1, "psum_scatter": 1, "all_to_all": 1, "pshuffle": 1,
+    "axis_index": 0,
+}
+_AXIS_KW = "axis_name"
+_SHARD_MAP_TERMINALS = {"shard_map"}
+
+
+def axis_universe(ctx: ModuleContext) -> Set[str]:
+    """Every mesh axis name visible to this lint: program-wide when the
+    ProgramIndex is attached, else the current module's own meshes."""
+    if ctx.program is not None:
+        return set(ctx.program.axis_universe)
+    return mesh_axes_in_module(ctx.resolver)
+
+
+def _bare_lax_collectives(tree: ast.Module) -> Dict[str, str]:
+    """Names bound by ``from jax.lax import psum [as p]`` -> collective."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ImportFrom) and stmt.module == "jax.lax":
+            for alias in stmt.names:
+                if alias.name in _COLLECTIVES:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def collective_axis_expr(node: ast.Call,
+                         bare: Dict[str, str]) -> Optional[ast.expr]:
+    """The axis-name argument expression when ``node`` is a collective call
+    (else None).  Accepts ``jax.lax.psum`` / ``lax.psum`` dotted forms and
+    names imported from ``jax.lax`` directly."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    prefix, _, term = name.rpartition(".")
+    if prefix:
+        if not (prefix == "lax" or prefix.endswith(".lax")):
+            return None
+        coll = term if term in _COLLECTIVES else None
+    else:
+        coll = bare.get(name)
+    if coll is None:
+        return None
+    for kw in node.keywords:
+        if kw.arg == _AXIS_KW:
+            return kw.value
+    pos = _COLLECTIVES[coll]
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _def_in_scope_chain(ctx: ModuleContext, at: ast.AST,
+                        name: str) -> Optional[ast.AST]:
+    """Resolve a Name to the function def of that name in the nearest
+    enclosing scope of ``at`` (module level last) — scope-aware, so six
+    methods each defining a ``local`` closure don't cross-wire."""
+    scopes = ctx.resolver.enclosing_scopes(at)
+    if ctx.tree is not None:
+        scopes = scopes + [ctx.tree]
+    for scope in scopes:
+        body = scope.body if isinstance(scope.body, list) else []
+        stack = list(body)
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name == name:
+                    return stmt
+                continue  # don't descend into other functions' bodies
+            stack.extend(s for s in ast.iter_child_nodes(stmt)
+                         if isinstance(s, ast.stmt))
+    return None
+
+
+def _shard_map_bindings(ctx: ModuleContext) -> Dict[int, Set[str]]:
+    """id(node) -> axes of the mesh bound at the shard_map site wrapping the
+    node, for every node lexically inside a shard_map target whose mesh
+    expression resolves."""
+    out: Dict[int, Set[str]] = {}
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        fname = dotted_name(call.func)
+        if fname is None \
+                or fname.rpartition(".")[2] not in _SHARD_MAP_TERMINALS:
+            continue
+        mesh_expr = None
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+        if mesh_expr is None and len(call.args) >= 2:
+            mesh_expr = call.args[1]
+        if mesh_expr is None:
+            continue
+        axes = mesh_axes_of_expr(ctx.resolver, mesh_expr)
+        if not axes:
+            continue
+        target = _unwrap_transform(call.args[0]) if call.args else None
+        if isinstance(target, ast.Name):
+            target = _def_in_scope_chain(ctx, call, target.id)
+        if not isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+            continue
+        for sub in ast.walk(target):
+            out[id(sub)] = axes
+    return out
+
+
+@register
+class MeshAxisRule(Rule):
+    name = "mesh-axis"
+    code = "PL007"
+    severity = "error"
+    description = ("collective axis names must name an axis of the mesh in "
+                   "scope (typos only fail on a pod slice)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        universe = axis_universe(ctx)
+        bound = _shard_map_bindings(ctx)
+        bare = _bare_lax_collectives(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            axis_expr = collective_axis_expr(node, bare)
+            if axis_expr is None:
+                continue
+            coll = dotted_name(node.func)
+            site_axes = bound.get(id(node))
+            for axis in ctx.resolver.strings(axis_expr):
+                if site_axes is not None:
+                    if axis not in site_axes:
+                        yield ctx.violation(
+                            self, node,
+                            f"{coll} over axis '{axis}' inside a shard_map "
+                            f"whose mesh has axes {sorted(site_axes)} — the "
+                            "collective will fail (or silently no-op) when "
+                            "this program runs on the mesh it was written "
+                            "for")
+                elif universe and axis not in universe:
+                    yield ctx.violation(
+                        self, node,
+                        f"{coll} over axis '{axis}', which no Mesh in the "
+                        f"program defines (known axes: {sorted(universe)}) — "
+                        "a stale or typo'd axis name that only fails on a "
+                        "pod slice")

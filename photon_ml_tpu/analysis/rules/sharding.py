@@ -1,0 +1,172 @@
+"""PL008 sharding-annotation: mesh-path jits annotate output layouts, and
+PartitionSpec axis strings must exist on the mesh they're paired with.
+
+Why it matters here: on the ``parallel/`` mesh paths the output layout IS
+the contract — ``fit_fixed_effect`` hands solver state between sweeps, and
+multihost's score/residual kernels feed each other device-resident arrays.
+A ``jax.jit`` without ``out_shardings`` leaves that layout to GSPMD
+inference, which is free to change across jax versions or upstream edits
+and silently inserts resharding collectives between stages (the TPU
+distributed linear-algebra work, arxiv 2112.09017, pins every block layout
+for the same reason).  And a ``NamedSharding``/``PartitionSpec`` naming an
+axis the paired mesh does not have fails only when the mesh actually has
+multiple axes — i.e. on the pod, not in the single-device CPU tests.
+
+Flags:
+  - (warning, ``parallel/`` modules only) a ``jax.jit(...)`` call,
+    ``@jax.jit`` decorator, or ``functools.partial(jax.jit, ...)`` without
+    an ``out_shardings`` annotation — annotate the layout, or suppress with
+    the propagation rationale (sharding flowing from the inputs is a valid
+    design, but it must be a DOCUMENTED one);
+  - (error, anywhere) a string axis in a ``PartitionSpec(...)`` / ``P(...)``
+    that is not an axis of the mesh it's paired with via
+    ``NamedSharding(mesh, spec)`` (when the mesh expression resolves to a
+    ``Mesh(...)`` construction), falling back to the program's mesh-axis
+    universe from the ProgramIndex — unresolvable specs and an empty
+    universe stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import (JIT_NAMES, dotted_name,
+                                              is_jit_call, is_partial_jit)
+from photon_ml_tpu.analysis.resolve import mesh_axes_of_expr
+from photon_ml_tpu.analysis.rules.mesh_axis import axis_universe
+
+_MESH_PATH_DIRS: Tuple[str, ...] = ("parallel",)
+
+
+def _on_mesh_path(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    if "photon_ml_tpu" in parts:
+        parts = parts[parts.index("photon_ml_tpu") + 1:]
+    return bool(parts) and parts[0] in _MESH_PATH_DIRS
+
+
+def _pspec_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to ``jax.sharding.PartitionSpec`` (``P`` et al.)."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ImportFrom) \
+                and (stmt.module or "").endswith("sharding"):
+            for alias in stmt.names:
+                if alias.name == "PartitionSpec":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _is_pspec_call(node: ast.AST, aliases: Set[str]) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    return name in aliases or name.rpartition(".")[2] == "PartitionSpec"
+
+
+def _spec_axis_strings(ctx: ModuleContext,
+                       spec: ast.Call) -> List[Tuple[str, ast.expr]]:
+    """(axis-name, arg-expr) pairs for every resolvable string in the spec's
+    arguments (a single argument may be a tuple of axes)."""
+    out: List[Tuple[str, ast.expr]] = []
+    for arg in spec.args:
+        if isinstance(arg, ast.Starred):
+            continue  # `*([None] * k)` padding idiom — nothing to check
+        for s in ctx.resolver.strings(arg):
+            out.append((s, arg))
+    return out
+
+
+def _has_out_shardings(call: ast.Call) -> bool:
+    return any(kw.arg == "out_shardings" for kw in call.keywords)
+
+
+@register
+class ShardingAnnotationRule(Rule):
+    name = "sharding-annotation"
+    code = "PL008"
+    severity = "error"
+    description = ("parallel/ jits annotate out_shardings; PartitionSpec "
+                   "axes must exist on their mesh")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        aliases = _pspec_aliases(ctx.tree)
+        universe = axis_universe(ctx)
+        paired: Set[int] = set()  # P(...) nodes validated against their mesh
+        # -- NamedSharding(mesh, spec): validate spec against THAT mesh ------
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None \
+                    or fname.rpartition(".")[2] != "NamedSharding":
+                continue
+            mesh_expr = node.args[0] if node.args else None
+            spec_expr = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "mesh":
+                    mesh_expr = kw.value
+                elif kw.arg == "spec":
+                    spec_expr = kw.value
+            if not (mesh_expr is not None
+                    and _is_pspec_call(spec_expr, aliases)):
+                continue
+            axes = mesh_axes_of_expr(ctx.resolver, mesh_expr)
+            if not axes:
+                continue
+            paired.add(id(spec_expr))
+            for axis, arg in _spec_axis_strings(ctx, spec_expr):
+                if axis not in axes:
+                    yield ctx.violation(
+                        self, arg,
+                        f"PartitionSpec axis '{axis}' is not an axis of the "
+                        f"mesh it is paired with (axes: {sorted(axes)}) — "
+                        "this NamedSharding fails on any real mesh")
+        # -- every other PartitionSpec: validate against the universe --------
+        if universe:
+            for node in ast.walk(ctx.tree):
+                if not _is_pspec_call(node, aliases) or id(node) in paired:
+                    continue
+                for axis, arg in _spec_axis_strings(ctx, node):
+                    if axis not in universe:
+                        yield ctx.violation(
+                            self, arg,
+                            f"PartitionSpec axis '{axis}', which no Mesh in "
+                            "the program defines (known axes: "
+                            f"{sorted(universe)}) — a stale or typo'd axis "
+                            "that only fails on a multi-axis mesh")
+        # -- parallel/ jits must annotate out_shardings ----------------------
+        if not _on_mesh_path(ctx.relpath):
+            return
+        flagged: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if dotted_name(dec) in JIT_NAMES:
+                        flagged.add(id(dec))
+                        yield self._unannotated(ctx, dec)
+                    elif isinstance(dec, ast.Call) \
+                            and (is_jit_call(dec) or is_partial_jit(dec)) \
+                            and not _has_out_shardings(dec):
+                        flagged.add(id(dec))
+                        yield self._unannotated(ctx, dec)
+            elif isinstance(node, ast.Call) and id(node) not in flagged \
+                    and (is_jit_call(node) or is_partial_jit(node)) \
+                    and not _has_out_shardings(node):
+                yield self._unannotated(ctx, node)
+
+    def _unannotated(self, ctx: ModuleContext, node: ast.AST) -> Violation:
+        return ctx.violation(
+            self, node,
+            "jax.jit on a mesh path without out_shardings — the output "
+            "layout is left to GSPMD inference, which may reshard between "
+            "pipeline stages; annotate it (or suppress with the "
+            "sharding-propagation rationale)",
+            severity="warning")

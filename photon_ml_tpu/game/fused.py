@@ -145,6 +145,9 @@ class FusedSweep:
         states, scores = list(states), list(scores)
         partials, keys = [], []
         total = scores[0]
+        # photonlint: disable=tracer-safety -- scores is a Python list with
+        # one entry per coordinate (static length at trace time); the loop
+        # unrolls over coordinates, not over a traced array's elements
         for s in scores[1:]:
             total = total + s
         for i, cid in enumerate(order):

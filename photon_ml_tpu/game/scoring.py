@@ -38,6 +38,10 @@ def additive_total(num_samples: int, margins: Iterable[Array]) -> Array:
     its jitted kernels so padded-bucket totals are bitwise the batch totals.
     """
     total = jnp.zeros((num_samples,))
+    # photonlint: disable=tracer-safety -- margins is a Python iterable with
+    # one [n] array per coordinate (static structure); inside serving's
+    # jitted kernels this unrolls over coordinates by design, keeping the
+    # accumulation order identical to the batch path
     for m in margins:
         total = total + m
     return total

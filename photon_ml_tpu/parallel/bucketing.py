@@ -389,6 +389,10 @@ def fit_random_effects(
     coordinate-descent iteration).
     """
     solve = make_solver(objective, optimizer, config)
+    # photonlint: disable=sharding-annotation -- mesh is Optional here: the
+    # same jit serves the mesh-less single-device path, and when a mesh IS
+    # given the [E, ...] lane layout propagates from the device_put of
+    # w0/batch below (one broadcast spec would also pin scalar leaves)
     vsolve = jax.jit(jax.vmap(lambda w0, batch: solve(w0, batch)))
     shard = _entity_sharding(mesh)
 

@@ -343,11 +343,17 @@ def fit_fixed_effect(
             sm = ShardSparseObjective(objective, mesh,
                                       d_pad // mesh.shape[FEATURE_AXIS])
             solve = make_solver(sm, optimizer, config, box=box)
+            # photonlint: disable=sharding-annotation -- solver state stays
+            # P("feature") via propagation from the sharded w0; the result
+            # pytree mixes [d_pad] lanes with scalar diagnostics, so one
+            # broadcast out_shardings spec cannot express the layout
             fitted = jax.jit(solve)
         else:
             # w stays P("feature") throughout; sharding propagates from
             # inputs and GSPMD inserts the feature-axis contractions.
             solve = make_solver(objective, optimizer, config, box=box)
+            # photonlint: disable=sharding-annotation -- same propagation
+            # contract as the sparse branch above: w0 pins P("feature")
             fitted = jax.jit(solve)
     else:
         # Explicit SPMD (one psum per evaluation); the caller's fused flag is

@@ -528,6 +528,9 @@ def multihost_glmix_sweep(
     dtype = fixed_batch.y.dtype
     rep = NamedSharding(mesh, PartitionSpec())
     row_sharded = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    # per-entity lanes over ALL devices — the exact placement
+    # global_entity_buckets gave every bucket array
+    entity_shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
 
     if num_samples is None:
         raise ValueError(
@@ -565,7 +568,7 @@ def multihost_glmix_sweep(
     rep_other = jax.jit(lambda m, t, s: m + t - s, out_shardings=rep)
     rep_swap = jax.jit(lambda t, old, new: t - old + new, out_shardings=rep)
 
-    @jax.jit
+    @functools.partial(jax.jit, out_shardings=entity_shard)
     def bucket_offset(off0, rows, margins):
         rows = to_padded(rows)
         safe = jnp.where(rows >= 0, rows, 0)
@@ -613,6 +616,11 @@ def multihost_glmix_sweep(
     passive_scorers = {cid: _make_passive_scorer(re_obj[cid].norm)
                        for cid in re_b}
 
+    # photonlint: disable=sharding-annotation -- SolverResult is a pytree of
+    # [E, ...] entity lanes whose layout follows w0/batch (both placed
+    # entity-sharded by global_entity_buckets); one broadcast spec would
+    # also pin the result's scalar diagnostics, so propagation IS the
+    # annotation here
     vsolves = {cid: jax.jit(jax.vmap(make_solver(re_obj[cid], optimizer,
                                                  config)))
                for cid in re_b}
@@ -621,7 +629,6 @@ def multihost_glmix_sweep(
     solve_fixed = jax.jit(
         make_solver(ShardMapObjective(fixed_objective, mesh), optimizer,
                     config), out_shardings=rep)
-    entity_shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
 
     import dataclasses as _dc
 

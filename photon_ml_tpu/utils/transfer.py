@@ -55,6 +55,10 @@ def _update_at(out: jax.Array, part: jax.Array, lo: int,
     """Donated slice write along ``axis``: reuses ``out``'s buffer, so
     assembling N chunks never holds more than output + one chunk on device."""
     start = tuple(lo if a == axis else 0 for a in range(out.ndim))
+    # photonlint: disable=donation-after-use -- documented consuming
+    # contract: chunked_device_put owns ``out`` and immediately rebinds it
+    # (out = _update_at(out, ...)); donating the caller's buffer is the
+    # point — the device peak stays output + one chunk
     return _UPDATE(out, part, start)
 
 

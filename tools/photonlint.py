@@ -2,13 +2,25 @@
 
 Usage:
     python -m tools.photonlint [paths ...]
+    python -m tools.photonlint --paths photon_ml_tpu/serving/engine.py
     python -m tools.photonlint photon_ml_tpu/ --format json
     python -m tools.photonlint --list-rules
     python -m tools.photonlint photon_ml_tpu/ --write-baseline
 
-Exit codes: 0 = clean (every finding baselined or suppressed);
-1 = new violations (or stale baseline entries under --strict-baseline);
-2 = usage / configuration error.
+Whole-program mode is the DEFAULT: a ProgramIndex over the lint paths lets
+the trace-scoped rules (PL001/PL003/PL004) see functions jitted across
+module boundaries and gives PL007/PL008 the program's mesh-axis universe.
+``--no-program-index`` restores pure per-module analysis.
+
+``--paths f1.py f2.py`` is the incremental (pre-commit) mode: lint ONLY the
+named files, but still build the ProgramIndex over the whole package so
+cross-module results match a full run — a violation in f1.py caused by a
+jit site elsewhere is found without scanning everything.
+
+Exit codes: 0 = clean (every finding baselined or suppressed, no stale
+baseline entries); 1 = new violations OR stale baseline entries (paid-down
+debt must be pruned — rerun with --prune-baseline to remove it); 2 = usage
+/ configuration error.
 
 The default baseline is ``photonlint_baseline.json`` at the repo root; see
 README "Static analysis" for the suppression (`# photonlint: disable=rule
@@ -40,6 +52,10 @@ def _parser() -> argparse.ArgumentParser:
         description="JAX/TPU-aware static analysis for photon-ml-tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to lint (default: photon_ml_tpu/)")
+    p.add_argument("--paths", dest="only_paths", nargs="+", metavar="FILE",
+                   help="incremental mode: lint ONLY these files but index "
+                        "the whole package, so cross-module findings match "
+                        "a full run (fast pre-commit loop)")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE",
                    help="baseline file of accepted debt "
@@ -49,12 +65,22 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="accept all current findings into --baseline "
                         "(also prunes stale entries) and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="remove stale baseline entries (fingerprints no "
+                        "current finding matches) instead of failing on "
+                        "them")
+    wp = p.add_mutually_exclusive_group()
+    wp.add_argument("--whole-program", action="store_true", default=True,
+                    help="build the cross-module ProgramIndex (default)")
+    wp.add_argument("--no-program-index", dest="whole_program",
+                    action="store_false",
+                    help="escape hatch: per-module analysis only (no "
+                         "cross-module jit resolution, module-local mesh "
+                         "axes only)")
     p.add_argument("--rules", default=None, metavar="R1,R2",
                    help="comma-separated rule names (default: all)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
-    p.add_argument("--strict-baseline", action="store_true",
-                   help="also fail when the baseline has stale entries")
     p.add_argument("--verbose", action="store_true",
                    help="text format: also print baselined findings")
     p.add_argument("--root", default=_REPO_ROOT,
@@ -69,11 +95,24 @@ def main(argv=None) -> int:
         registry = registered_rules()
         for name in sorted(registry, key=lambda n: registry[n].code):
             cls = registry[name]
-            print(f"{cls.code}  {name:<18} [{cls.severity}]  "
+            print(f"{cls.code}  {name:<20} [{cls.severity}]  "
                   f"{cls.description}")
         return 0
 
-    paths = args.paths or [os.path.join(args.root, "photon_ml_tpu")]
+    pkg_default = os.path.join(args.root, "photon_ml_tpu")
+    if args.only_paths:
+        if args.paths:
+            print("photonlint: positional paths and --paths are mutually "
+                  "exclusive (--paths lints only the named files)",
+                  file=sys.stderr)
+            return 2
+        paths = list(args.only_paths)
+        # the incremental contract: index the whole package regardless of
+        # which few files are being linted
+        index_paths = [pkg_default]
+    else:
+        paths = args.paths or [pkg_default]
+        index_paths = None
     for p in paths:
         if not os.path.exists(p):
             print(f"photonlint: no such path: {p}", file=sys.stderr)
@@ -84,7 +123,9 @@ def main(argv=None) -> int:
         print(f"photonlint: {e.args[0]}", file=sys.stderr)
         return 2
 
-    result = run_analysis(paths, rules=rules, root=args.root)
+    result = run_analysis(paths, rules=rules, root=args.root,
+                          whole_program=args.whole_program,
+                          index_paths=index_paths)
 
     if args.write_baseline:
         save_baseline(make_baseline(result.violations), args.baseline)
@@ -101,6 +142,29 @@ def main(argv=None) -> int:
         return 2
     new, baselined, stale = partition(result.violations, baseline)
 
+    # staleness is only decidable for entries this run could have matched:
+    # an incremental run can't vouch for files it didn't lint, a --rules
+    # subset can't vouch for other rules' entries
+    entries = baseline.get("entries", {})
+    if args.only_paths:
+        linted = {os.path.relpath(os.path.abspath(p), args.root)
+                  .replace(os.sep, "/") for p in paths}
+        stale = [fp for fp in stale
+                 if entries.get(fp, {}).get("path") in linted]
+    if args.rules:
+        selected = set(args.rules.split(","))
+        stale = [fp for fp in stale
+                 if entries.get(fp, {}).get("rule") in selected]
+
+    if stale and args.prune_baseline and not args.no_baseline:
+        for fp in stale:
+            baseline["entries"].pop(fp, None)
+        save_baseline(baseline, args.baseline)
+        print(f"photonlint: pruned {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} from {args.baseline}",
+              file=sys.stderr)
+        stale = []
+
     if args.format == "json":
         print(render_json(new, baselined, stale, result))
     else:
@@ -109,7 +173,9 @@ def main(argv=None) -> int:
 
     if new:
         return 1
-    if stale and args.strict_baseline:
+    if stale:
+        # paid-down debt must not linger: a fingerprint nothing matches any
+        # more means the baseline misstates the repo — prune it
         return 1
     return 0
 
