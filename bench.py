@@ -1404,20 +1404,35 @@ RUNNERS = {
 }
 
 def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
-                      device_capacity=None, seed=0, out_path=None):
-    """`bench.py --serving`: online-scoring micro-bench (serving/ subsystem).
+                      device_capacity=None, seed=0, out_path=None,
+                      zipf=0.0, deadline_us=200.0, rebalance_every=500):
+    """`bench.py --serving [--zipf A]`: online-scoring micro-bench.
 
     Self-contained: builds a synthetic 2-coordinate GLMix model IN MEMORY
     (no training, no disk) at the given entity count, stands up the
     AOT-warmed ScoringEngine, then measures
       - single-request latency (bucket 1): p50 / p99 / mean over a timed
         loop — the user-facing number for the online path;
-      - batched throughput: a random-size request stream (the realistic
-        arrival pattern), reporting QPS and the padding-waste ratio the
-        bucket ladder actually paid;
+      - async-batched throughput: requests submitted ONE AT A TIME to the
+        deadline AsyncBatcher (the production arrival shape), so occupancy
+        comes from coalescing, not caller-side batch formation; a trickle
+        sub-phase (arrival gaps > deadline) exercises deadline flushes;
+      - hot-set adaptation (``zipf`` > 0): entity ids drawn from a zipf
+        rank distribution whose ranks are SHUFFLED across training slots
+        (so the initial first-K-slots residency starts ~random), a
+        frequency rebalance pass every ``rebalance_every`` requests during
+        an adaptation epoch, then a measured epoch — recording
+        ``entity_miss_rate``, hot-set hit rate, and the padding-waste
+        ratio the ladder actually paid, diffed over the measured epoch
+        only;
       - warm cost: executables compiled for the ladder (the number a hot
-        swap must pre-pay off the request path).
-    Emits one JSON dict (also written to BENCH_SERVING_<backend>.json).
+        swap must pre-pay off the request path), plus the zero-recompile
+        check (``compiles`` must not grow after warm).
+    In zipf mode an unset device_capacity defaults to n_entities/10 —
+    all-hot residency would make the miss/hit numbers trivial.
+    Emits one JSON dict (also written to BENCH_SERVING_<backend>.json);
+    ``padding_waste_ratio``, ``entity_miss_rate``, and ``p99_s`` are
+    top-level so trajectories stay comparable across PRs.
     """
     import jax
 
@@ -1432,6 +1447,9 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     from photon_ml_tpu.serving.engine import ScoringEngine
     from photon_ml_tpu.serving.metrics import ServingMetrics
     from photon_ml_tpu.types import TaskType
+
+    if zipf and device_capacity is None:
+        device_capacity = max(64, n_entities // 10)
 
     rng = np.random.default_rng(seed)
     names = [f"f{j}" for j in range(d)]
@@ -1460,14 +1478,28 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     n_compiled = engine.warm()
     warm_s = time.perf_counter() - t0
 
-    def mk_request(i):
+    # -- entity id streams: ~5% unknown either way; zipf ranks shuffled over
+    # training slots so the initial first-K residency starts uncorrelated
+    # with the traffic head (the adaptation the hot set must earn)
+    slot_of_rank = rng.permutation(n_entities)
+
+    def draw_users(n):
+        unknown = rng.random(n) < 0.05
+        if zipf:
+            w = (np.arange(n_entities) + 1.0) ** -zipf
+            ids = slot_of_rank[rng.choice(n_entities, size=n, p=w / w.sum())]
+        else:
+            ids = rng.integers(0, n_entities, size=n)
+        return np.where(unknown, n_entities + rng.integers(0, n, size=n), ids)
+
+    def mk_request(i, user):
         feats = [{"name": n, "term": "", "value": float(v)}
                  for n, v in zip(names, rng.normal(size=d))]
-        return Request(uid=i, features=feats,
-                       ids={"userId": f"user{int(rng.integers(0, int(n_entities * 1.05)))}"})
+        return Request(uid=i, features=feats, ids={"userId": f"user{user}"})
 
     # single-request latency (bucket 1)
-    single = [mk_request(i) for i in range(min(500, n_requests))]
+    single_users = draw_users(min(500, n_requests))
+    single = [mk_request(i, u) for i, u in enumerate(single_users)]
     engine.score_requests(single[:1])  # touch every path once
     lat = []
     for r in single:
@@ -1476,22 +1508,54 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
         lat.append(time.perf_counter() - t)
     lat = np.asarray(lat)
 
-    # batched throughput over a random-size arrival stream
-    stream = [mk_request(i) for i in range(n_requests)]
-    sizes = []
-    i = 0
-    while i < n_requests:
-        k = int(rng.integers(1, max_batch + 1))
-        sizes.append(min(k, n_requests - i))
-        i += sizes[-1]
-    waste_before = metrics.snapshot()["padded_rows_launched"]
-    t0 = time.perf_counter()
-    i = 0
-    for k in sizes:
-        engine.score_requests(stream[i:i + k])
-        i += k
-    stream_s = time.perf_counter() - t0
+    # -- async stream: one submit per request, deadline batcher coalesces
+    stream = [mk_request(i, u) for i, u in enumerate(draw_users(n_requests))]
+    batcher = engine.async_batcher(deadline_s=deadline_us * 1e-6)
+    try:
+        # adaptation epoch: same trace shape feeds the EWMA counters, with a
+        # rebalance pass on the configured cadence
+        for start in range(0, n_requests, rebalance_every):
+            chunk = stream[start:start + rebalance_every]
+            for f in [batcher.submit(r) for r in chunk]:
+                f.result(timeout=300)
+            store.rebalance()
+
+        # trickle sub-phase: arrival gaps > deadline force deadline flushes
+        trickle = [mk_request(i, u) for i, u in enumerate(draw_users(16))]
+        trickle_futs = []
+        for r in trickle:
+            trickle_futs.append(batcher.submit(r))
+            time.sleep(2.0 * deadline_us * 1e-6)
+        for f in trickle_futs:
+            f.result(timeout=300)
+
+        # measured epoch: a FRESH draw from the same arrival distribution
+        # (not the adaptation trace — that would grade the hot set on its
+        # own training data); counters diffed across it so adaptation and
+        # trickle traffic don't blur the steady-state numbers
+        measured = [mk_request(i, u)
+                    for i, u in enumerate(draw_users(n_requests))]
+        before = metrics.snapshot()
+        t0 = time.perf_counter()
+        futs = [batcher.submit(r) for r in measured]
+        for f in futs:
+            f.result(timeout=300)
+        stream_s = time.perf_counter() - t0
+    finally:
+        batcher.shutdown(drain=True)
     snap = metrics.snapshot()
+
+    def cdiff(name):
+        return (snap["counters"].get(name, 0)
+                - before["counters"].get(name, 0))
+
+    padded = snap["padded_rows_launched"] - before["padded_rows_launched"]
+    real = snap["real_rows_launched"] - before["real_rows_launched"]
+    waste = 1.0 - real / padded if padded else 0.0
+    miss_rate = cdiff("entity_misses") / n_requests
+    lookups = sum(cdiff(k) for k in ("hot_hits", "lru_hits", "cold_fetches",
+                                     "entity_misses"))
+    hot_rate = cdiff("hot_hits") / lookups if lookups else 0.0
 
     out = {
         "metric": "serving_p99_latency", "unit": "s",
@@ -1499,6 +1563,12 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
         "backend": jax.default_backend(),
         "n_entities": n_entities, "d": d,
         "device_capacity": device_capacity,
+        "zipf": zipf,
+        "deadline_us": deadline_us,
+        # the three cross-PR trajectory numbers (acceptance gate)
+        "p99_s": round(float(np.percentile(lat, 99)), 6),
+        "padding_waste_ratio": round(waste, 4),
+        "entity_miss_rate": round(miss_rate, 4),
         "single_request": {
             "n": len(lat),
             "p50_s": round(float(np.percentile(lat, 50)), 6),
@@ -1506,12 +1576,26 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
             "mean_s": round(float(lat.mean()), 6),
         },
         "stream": {
-            "n_requests": n_requests, "n_batches": len(sizes),
+            "n_requests": n_requests,
+            "n_batches": cdiff("batches"),
             "seconds": round(stream_s, 4),
             "qps": round(n_requests / stream_s, 1),
-            "padding_waste_ratio": round(snap["padding_waste_ratio"], 4),
+            "padding_waste_ratio": round(waste, 4),
+            "entity_miss_rate": round(miss_rate, 4),
+        },
+        "hot_set": {
+            "hit_rate": round(hot_rate, 4),
+            "promotions": snap["counters"].get("hot_promotions", 0),
+            "demotions": snap["counters"].get("hot_demotions", 0),
+            "rebalances": snap["counters"].get("rebalances", 0),
+        },
+        "flushes": {
+            "full": snap["counters"].get("flushes_full", 0),
+            "deadline": snap["counters"].get("flushes_deadline", 0),
+            "forced": snap["counters"].get("flushes_forced", 0),
         },
         "warm": {"executables": n_compiled, "seconds": round(warm_s, 4)},
+        "compiles_after_warm": engine.compile_count - n_compiled,
         "counters": snap["counters"],
     }
     if out_path is None:
@@ -1586,7 +1670,14 @@ def main():
     ap.add_argument("--serving-entities", type=int, default=20000)
     ap.add_argument("--serving-requests", type=int, default=2000)
     ap.add_argument("--serving-device-capacity", type=int, default=0,
-                    help="hot entity rows on device (0 = all)")
+                    help="hot entity rows on device (0 = all, or "
+                         "n_entities/10 in --zipf mode)")
+    ap.add_argument("--zipf", type=float, default=0.0,
+                    help="with --serving: skew entity traffic by a zipf(a) "
+                         "rank distribution (0 = uniform) — exercises the "
+                         "frequency-ranked hot set")
+    ap.add_argument("--serving-deadline-us", type=float, default=200.0,
+                    help="with --serving: async batcher deadline")
     ap.add_argument("--lint", action="store_true",
                     help="photonlint wall-time micro-bench (whole-program "
                          "pass over photon_ml_tpu/) -> BENCH_LINT.json")
@@ -1602,6 +1693,7 @@ def main():
         print(json.dumps(run_serving_bench(
             n_entities=a.serving_entities, n_requests=a.serving_requests,
             device_capacity=a.serving_device_capacity or None,
+            zipf=a.zipf, deadline_us=a.serving_deadline_us,
             out_path=a.out)))
         return
     if a.ab_chain and a.config != "glmix2":

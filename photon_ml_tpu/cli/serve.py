@@ -6,8 +6,11 @@ served by LinkedIn infrastructure reading the published PalDB stores.  This
 driver IS that online layer for the TPU-native stack: it loads a training
 output directory into a device-resident ``serving.CoefficientStore``,
 AOT-warms the ``serving.ScoringEngine`` bucket ladder, then scores a
-stream of JSON-lines requests with micro-batching and supports atomic hot
-model swap mid-stream.
+stream of JSON-lines requests through the ASYNC deadline batcher
+(``serving.batcher.AsyncBatcher``: each request is submitted individually
+and coalesces with its neighbors until a bucket fills or ``--deadline-us``
+expires) and supports atomic hot model swap and streaming coefficient
+deltas mid-stream.
 
 Wire protocol (one JSON object per line on stdin / ``--requests`` file):
 
@@ -15,29 +18,43 @@ Wire protocol (one JSON object per line on stdin / ``--requests`` file):
              ...], "ids": {"userId": "user3"}, "offset": 0.0}
             (features also accept compact [name, value] / [name, term,
              value] lists)
-  flush     a blank line — score the buffered requests now (otherwise the
-            batcher flushes whenever ``--max-batch`` requests are buffered,
-            and at EOF)
+  flush     a blank line — force-flush the batcher and drain every pending
+            score (otherwise the batcher flushes whenever a top bucket
+            fills or the deadline expires, and at EOF)
   swap      {"cmd": "swap", "model_dir": "/path/to/new/output"}
             -> {"swap": "ok"|"rejected", ...}; a rejected swap (corrupt or
             incomplete model dir) leaves the current version serving
+  delta     {"cmd": "delta", "coordinate": "user", "entity": "user3",
+             "row": [0.1, ...]}
+            -> {"delta": "ok"|"rejected", "delta_version": n}; scatters one
+            online-learned coefficient row into the live generation (device
+            table when hot, host archive + LRU invalidation always) — no
+            generation flip, no recompile
+  rebalance {"cmd": "rebalance"} -> {"rebalance": {cid: [promoted,
+            demoted]}}; one synchronous frequency-ranked hot-set pass (the
+            background cadence is ``--hot-set-interval``)
   metrics   {"cmd": "metrics"} -> one metrics JSON line
 
 Responses are ``{"uid": ..., "score": ...}`` lines on stdout, in request
-order.  Programmatic use: ``build_server`` returns the (engine, swapper)
-pair without touching stdio.
+order.  Every command drains pending requests first, so everything
+submitted before a swap/delta line scores on the pre-swap/pre-delta
+coefficients.  Programmatic use: ``build_server`` returns the (engine,
+swapper) pair without touching stdio.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import logging
 import sys
 from typing import IO, List, Optional, Sequence, Tuple
 
 from photon_ml_tpu.serving.batcher import BucketedBatcher, request_from_json
-from photon_ml_tpu.serving.coefficient_store import CoefficientStore, StoreConfig
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     HotSetManager,
+                                                     StoreConfig)
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.swap import HotSwapper
@@ -58,12 +75,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--buckets", default="",
                    help="comma list of bucket sizes (default: powers of two "
                         "up to --max-batch)")
+    p.add_argument("--deadline-us", type=float, default=500.0,
+                   help="async batcher deadline: a pending request waits at "
+                        "most this long for a bucket to fill before its "
+                        "batch flushes anyway")
+    p.add_argument("--sync-batcher", action="store_true",
+                   help="legacy synchronous batching: buffer requests and "
+                        "flush at --max-batch / blank line / EOF instead of "
+                        "the async deadline accumulator")
     p.add_argument("--device-entity-capacity", type=int, default=0,
                    help="max entity rows device-resident per coordinate "
                         "(0 = all; colder entities serve from the host LRU "
-                        "fallback)")
+                        "fallback and rebalancing promotes the hottest)")
     p.add_argument("--lru-capacity", type=int, default=4096,
                    help="host LRU entries per coordinate for cold entities")
+    p.add_argument("--hot-set-interval", type=float, default=0.0,
+                   help="seconds between background frequency-ranked "
+                        "promotion/demotion passes (0 = only on "
+                        "{\"cmd\": \"rebalance\"})")
+    p.add_argument("--hot-decay", type=float, default=0.5,
+                   help="EWMA decay applied to entity hit counters at each "
+                        "rebalance pass")
     p.add_argument("--predict-mean", action="store_true",
                    help="emit inverse-link means instead of raw margins")
     p.add_argument("--no-warm", action="store_true",
@@ -81,6 +113,7 @@ def build_server(model_dir: str,
                  bucket_sizes: Optional[Sequence[int]] = None,
                  device_entity_capacity: Optional[int] = None,
                  lru_capacity: int = 4096,
+                 hot_decay: float = 0.5,
                  metrics: Optional[ServingMetrics] = None,
                  warm: bool = True) -> Tuple[ScoringEngine, HotSwapper]:
     """Programmatic entry point: load -> store -> engine (+ warmed ladder)
@@ -88,7 +121,7 @@ def build_server(model_dir: str,
     metrics = metrics or ServingMetrics()
     bundle = load_model_bundle(model_dir)
     config = StoreConfig(device_capacity=device_entity_capacity,
-                         lru_capacity=lru_capacity)
+                         lru_capacity=lru_capacity, hot_decay=hot_decay)
     store = CoefficientStore.from_bundle(bundle, config=config,
                                          version=model_dir, metrics=metrics)
     engine = ScoringEngine(store, BucketedBatcher(max_batch, bucket_sizes),
@@ -101,54 +134,111 @@ def build_server(model_dir: str,
 
 
 def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
-                  out: IO, predict_mean: bool) -> int:
-    buffered: List = []
+                  out: IO, predict_mean: bool,
+                  deadline_s: float = 500e-6,
+                  sync: bool = False) -> int:
+    """Drive the engine from a JSON-lines stream.
+
+    Async (default): each request is submitted to an AsyncBatcher and its
+    (uid, future) queued; completed scores are written opportunistically in
+    submission order, and every command / blank line / EOF force-flushes
+    and drains.  ``sync=True`` keeps the legacy buffer-then-score path.
+    """
+    pending: "collections.deque" = collections.deque()  # (uid, future)
+    buffered: List = []  # sync mode only
+    batcher = None if sync else engine.async_batcher(
+        deadline_s=deadline_s, predict_mean=predict_mean)
+
+    def emit(uid, fut) -> None:
+        try:
+            out.write(json.dumps({"uid": uid, "score": fut.result()}) + "\n")
+        except Exception as e:  # scoring error: the request's own line
+            out.write(json.dumps({"uid": uid, "error": str(e)}) + "\n")
+
+    def drain(block: bool) -> None:
+        wrote = False
+        while pending and (block or pending[0][1].done()):
+            emit(*pending.popleft())
+            wrote = True
+        if wrote:
+            out.flush()
 
     def flush() -> None:
-        if not buffered:
-            return
-        scores = engine.score_requests(buffered, predict_mean=predict_mean)
-        for req, s in zip(buffered, scores):
-            out.write(json.dumps({"uid": req.uid, "score": float(s)}) + "\n")
-        out.flush()
-        buffered.clear()
-
-    for line in lines:
-        line = line.strip()
-        if not line:
-            flush()
-            continue
-        try:
-            obj = json.loads(line)
-        except ValueError as e:
-            logger.error("bad request line: %s", e)
-            out.write(json.dumps({"error": str(e)}) + "\n")
-            continue
-        cmd = obj.get("cmd") if isinstance(obj, dict) else None
-        if cmd == "swap":
-            flush()  # everything buffered scores on the pre-swap version
-            ok = swapper.swap(obj["model_dir"])
-            out.write(json.dumps({
-                "swap": "ok" if ok else "rejected",
-                "generation": engine.store.generation,
-                "version": engine.store.version}) + "\n")
+        if sync:
+            if not buffered:
+                return
+            scores = engine.score_requests(buffered,
+                                           predict_mean=predict_mean)
+            for req, s in zip(buffered, scores):
+                out.write(json.dumps({"uid": req.uid,
+                                      "score": float(s)}) + "\n")
             out.flush()
-        elif cmd == "metrics":
-            flush()
-            out.write(engine.metrics.to_json() + "\n")
-            out.flush()
-        elif cmd is not None:
-            out.write(json.dumps({"error": f"unknown cmd {cmd!r}"}) + "\n")
+            buffered.clear()
         else:
+            batcher.flush()
+            drain(block=True)
+
+    try:
+        for line in lines:
+            line = line.strip()
+            if not line:
+                flush()
+                continue
             try:
-                buffered.append(request_from_json(obj))
-            except (ValueError, TypeError) as e:
-                logger.error("bad request: %s", e)
+                obj = json.loads(line)
+            except ValueError as e:
+                logger.error("bad request line: %s", e)
                 out.write(json.dumps({"error": str(e)}) + "\n")
                 continue
-            if len(buffered) >= engine.batcher.max_batch:
+            cmd = obj.get("cmd") if isinstance(obj, dict) else None
+            if cmd == "swap":
+                flush()  # everything buffered scores on the pre-swap version
+                ok = swapper.swap(obj["model_dir"])
+                out.write(json.dumps({
+                    "swap": "ok" if ok else "rejected",
+                    "generation": engine.store.generation,
+                    "version": engine.store.version,
+                    "delta_version": swapper.delta_version}) + "\n")
+                out.flush()
+            elif cmd == "delta":
+                flush()  # pending requests score pre-delta coefficients
+                ok = swapper.apply_delta(obj.get("coordinate"),
+                                         obj.get("entity"),
+                                         obj.get("row") or ())
+                out.write(json.dumps({
+                    "delta": "ok" if ok else "rejected",
+                    "delta_version": swapper.delta_version}) + "\n")
+                out.flush()
+            elif cmd == "rebalance":
+                moves = engine.store.rebalance()
+                out.write(json.dumps({"rebalance": {
+                    cid: list(m) for cid, m in moves.items()}}) + "\n")
+                out.flush()
+            elif cmd == "metrics":
                 flush()
-    flush()
+                out.write(engine.metrics.to_json() + "\n")
+                out.flush()
+            elif cmd is not None:
+                out.write(json.dumps({"error": f"unknown cmd {cmd!r}"}) + "\n")
+            else:
+                try:
+                    req = request_from_json(obj)
+                except (ValueError, TypeError) as e:
+                    logger.error("bad request: %s", e)
+                    out.write(json.dumps({"error": str(e)}) + "\n")
+                    continue
+                if sync:
+                    buffered.append(req)
+                    if len(buffered) >= engine.batcher.max_batch:
+                        flush()
+                else:
+                    pending.append((req.uid, batcher.submit(req)))
+                    drain(block=False)
+        flush()
+    finally:
+        if batcher is not None:
+            batcher.shutdown(drain=True)
+            drain(block=True)
     return 0
 
 
@@ -171,6 +261,7 @@ def run(argv: List[str]) -> int:
             bucket_sizes=buckets,
             device_entity_capacity=(args.device_entity_capacity or None),
             lru_capacity=args.lru_capacity,
+            hot_decay=args.hot_decay,
             warm=not args.no_warm)
     except (ModelLoadError, ValueError) as e:
         logger.error("--model-dir: %s", e)
@@ -179,11 +270,21 @@ def run(argv: List[str]) -> int:
                 engine.store.generation, engine.store.version,
                 engine.store.task.value)
 
+    hotset = None
+    if args.hot_set_interval > 0:
+        hotset = HotSetManager(lambda: engine.store,
+                               interval_s=args.hot_set_interval).start()
+        logger.info("hot-set rebalancing every %.3fs", args.hot_set_interval)
+
     lines = sys.stdin if args.requests == "-" else open(args.requests)
     try:
         rc = _serve_stream(engine, swapper, lines, sys.stdout,
-                           args.predict_mean)
+                           args.predict_mean,
+                           deadline_s=args.deadline_us * 1e-6,
+                           sync=args.sync_batcher)
     finally:
+        if hotset is not None:
+            hotset.stop()
         if lines is not sys.stdin:
             lines.close()
         if args.metrics_json:

@@ -5,23 +5,30 @@ stores + broadcast coefficients for LinkedIn's online serving stack; this
 package is that serving layer, TPU-native:
 
   - ``coefficient_store``: device-resident versioned coefficient tables
-    (the PalDB analog) with an LRU host fallback for cold entities;
+    (the PalDB analog) with a frequency-ranked hot set (EWMA hit counters
+    + promotion/demotion rebalancing), an LRU host fallback for cold
+    entities, and streaming per-entity delta updates;
   - ``batcher``: request micro-batching padded to a fixed bucket ladder so
-    every shape hits an already-compiled executable;
+    every shape hits an already-compiled executable, plus the async
+    deadline accumulator (``AsyncBatcher``: submit one request, get a
+    future; flushes on a full bucket or a ~500µs deadline);
   - ``engine``: AOT-lowered per-(signature, bucket) scoring kernels sharing
     the batch path's score composition (game/scoring.py);
-  - ``swap``: atomic hot model reload (load -> warm -> flip);
+  - ``swap``: atomic hot model reload (load -> warm -> flip) and the
+    streaming-delta entry point (``(generation, delta_version)`` identity);
   - ``metrics``: one thread-safe registry (latency histograms, QPS,
-    padding waste, entity misses, swap counters) exported as JSON.
+    padding waste + per-bucket occupancy, hot-set hit rate, entity misses,
+    flush mix, swap counters) exported as JSON.
 
 ``cli/serve.py`` wires these into a stdin/JSON-lines driver and a
 programmatic ``build_server`` entry point.
 """
 
-from photon_ml_tpu.serving.batcher import (BucketedBatcher, Request,  # noqa: F401
-                                           pow2_bucket_ladder,
+from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,  # noqa: F401
+                                           Request, pow2_bucket_ladder,
                                            request_from_json)
 from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,  # noqa: F401
+                                                     HotSetManager,
                                                      StoreConfig)
 from photon_ml_tpu.serving.engine import ScoringEngine  # noqa: F401
 from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401

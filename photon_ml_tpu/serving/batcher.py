@@ -20,7 +20,10 @@ features land in exactly the training columns.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+import threading
+import time
+from concurrent.futures import Future
+from typing import (Callable, Dict, List, Optional, Sequence, Tuple)
 
 import numpy as np
 
@@ -33,6 +36,12 @@ def pow2_bucket_ladder(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:
     if max_batch < 1:
         raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     top = 1 << (max_batch - 1).bit_length()
+    if min_bucket > top:
+        # a ladder whose only rung is below min_bucket can't hold any batch
+        # the caller promised to send — fail loudly instead of under-bucketing
+        raise ValueError(
+            f"min_bucket {min_bucket} exceeds the top bucket {top} implied "
+            f"by max_batch {max_batch}")
     ladder = []
     b = max(1, min_bucket)
     while b < top:
@@ -162,3 +171,142 @@ class BucketedBatcher:
 
     def padding_rows(self, plan: Sequence[MicroBatch]) -> int:
         return sum(mb.bucket - mb.real_rows for mb in plan)
+
+
+class AsyncBatcher:
+    """Thread-safe deadline-or-full micro-batch accumulator.
+
+    The synchronous ``BucketedBatcher`` API makes the CALLER responsible for
+    batch formation — at low QPS every caller hands over a near-singleton
+    list and pays the pow2 ladder's padding tax (the 19.5% padding-waste
+    ratio in BENCH_SERVING_cpu.json).  This accumulator inverts that:
+    callers ``submit`` ONE request at a time and get a
+    ``concurrent.futures.Future`` back; a worker thread flushes the pending
+    set whenever it reaches ``flush_threshold`` (the engine's top bucket —
+    a zero-padding launch) OR the OLDEST pending request has waited
+    ``deadline_s`` (default 500µs), whichever comes first.  Concurrent
+    low-QPS streams therefore coalesce into high-occupancy buckets, and no
+    request waits longer than one deadline for company.
+
+    ``score_fn`` receives the drained request list and returns one score
+    per request (``ScoringEngine.score_requests`` — which still splits
+    oversized drains along the bucket ladder); each future resolves to its
+    request's float score, or to the scoring exception.
+
+    Flush accounting (per-flush, into ``metrics`` when given):
+    ``flushes_full`` (threshold reached), ``flushes_deadline`` (deadline
+    expired first), ``flushes_forced`` (explicit ``flush()`` / shutdown
+    drain) — the occupancy story of a deployment in one ratio.
+    """
+
+    def __init__(self, score_fn: Callable[[Sequence[Request]], np.ndarray],
+                 flush_threshold: int,
+                 deadline_s: float = 500e-6,
+                 metrics=None,
+                 name: str = "photon-serving-batcher"):
+        if flush_threshold < 1:
+            raise ValueError(
+                f"flush_threshold must be >= 1, got {flush_threshold}")
+        if deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        self._score = score_fn
+        self.flush_threshold = int(flush_threshold)
+        self.deadline_s = float(deadline_s)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[Tuple[Request, Future]] = []
+        self._first_ts: Optional[float] = None  # arrival of oldest pending
+        self._force = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+    def submit(self, request: Request) -> "Future[float]":
+        """Enqueue one request; returns the future its score resolves on."""
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncBatcher is shut down")
+            self._pending.append((request, fut))
+            if self._first_ts is None:
+                self._first_ts = time.perf_counter()
+            self._cond.notify()
+        return fut
+
+    def flush(self) -> List[Future]:
+        """Force an immediate flush of whatever is pending; returns the
+        pending futures (callers wait on those, not on this call)."""
+        with self._cond:
+            futs = [f for _, f in self._pending]
+            if self._pending:
+                self._force = True
+                self._cond.notify()
+        return futs
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the worker.  ``drain=True`` scores everything still pending
+        first (every outstanding future resolves); ``drain=False`` cancels
+        pending futures.  Idempotent; ``submit`` raises afterwards."""
+        with self._cond:
+            if not self._closed:
+                self._closed = True
+                if not drain:
+                    for _, f in self._pending:
+                        f.cancel()
+                    self._pending = []
+                    self._first_ts = None
+                self._cond.notify_all()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "AsyncBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    # -- worker side -------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending:
+                    return  # closed and drained
+                deadline = self._first_ts + self.deadline_s
+                while (not self._force and not self._closed
+                       and len(self._pending) < self.flush_threshold):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                batch = self._pending
+                self._pending = []
+                self._first_ts = None
+                forced, self._force = self._force, False
+                closed = self._closed
+            self._flush_batch(batch, forced=forced or closed)
+
+    def _flush_batch(self, batch: List[Tuple[Request, Future]],
+                     forced: bool) -> None:
+        if not batch:
+            return
+        if self._metrics is not None:
+            full = len(batch) >= self.flush_threshold
+            self._metrics.inc("flushes_full" if full else
+                              "flushes_forced" if forced else
+                              "flushes_deadline")
+        live = [(r, f) for r, f in batch if f.set_running_or_notify_cancel()]
+        if not live:
+            return
+        try:
+            scores = self._score([r for r, _ in live])
+        except Exception as e:  # resolve every waiter, never kill the worker
+            for _, f in live:
+                f.set_exception(e)
+            return
+        for (_, f), s in zip(live, scores):
+            f.set_result(float(s))

@@ -18,9 +18,25 @@ device table uses, so hot and cold entities produce bitwise-identical
 scores.  Unknown entities score 0, exactly like the batch path
 (RandomEffectModel.score missing-entity convention).
 
-Stores are immutable and versioned: hot swap (serving/swap.py) builds a new
-store from a new model directory and flips the engine's generation pointer;
-in-flight requests keep scoring against the store they started with.
+Device residency is **frequency-ranked**: every resolve records per-entity
+hits, and a promotion/demotion pass (``CoefficientStore.rebalance``, driven
+periodically by ``HotSetManager``) scatters the hottest rows from the host
+archive into the device table and evicts the coldest — so under skewed
+(zipf) traffic the device table tracks actual load instead of
+training-slot order.  Hit counters decay exponentially per pass (EWMA), so
+yesterday's hot entities age out.
+
+Stores are versioned: hot swap (serving/swap.py) builds a new store from a
+new model directory and flips the engine's generation pointer; in-flight
+requests keep scoring against the store they started with.  Within one
+generation exactly two things mutate, both under per-coordinate locks with
+the (table, slot map) pair swapped as ONE immutable snapshot so readers
+never see a torn hot set: the rebalance pass above, and **streaming
+deltas** (``apply_delta`` — scatter one online-learned coefficient row into
+the live table without a generation flip; serving/swap.py counts them into
+``delta_version``).  Neither ever changes a table's SHAPE, so every AOT
+executable compiled against the generation stays valid — the engine's
+zero-recompile guarantee survives both.
 """
 
 from __future__ import annotations
@@ -28,8 +44,9 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple, Union)
 from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +63,10 @@ Array = jax.Array
 
 _generation = itertools.count(1)
 
+# frequencies below this after decay are dropped from the counter map — the
+# long tail of one-hit entities must not grow the map without bound
+_FREQ_FLOOR = 1e-3
+
 
 @dataclasses.dataclass(frozen=True)
 class StoreConfig:
@@ -53,15 +74,21 @@ class StoreConfig:
     version with identical policy (serving/swap.py).
 
     ``device_capacity``: max entity rows resident on device per coordinate
-    (None = all — the small-model default).  Hot entities are the FIRST rows
-    of the training-order stack; a frequency-ranked hot set is a follow-on
-    (ROADMAP).  ``lru_capacity``: host-side LRU entries per coordinate for
-    cold rows.  ``x_dtype``: request feature dtype (float32, matching
-    data/reader's default design dtype — part of the bitwise-parity
-    contract with batch scoring)."""
+    (None = all — the small-model default).  The initial hot set is the
+    first ``device_capacity`` training slots; ``rebalance()`` then re-ranks
+    residency by observed request frequency.  ``lru_capacity``: host-side
+    LRU entries per coordinate for cold rows.  ``hot_decay``: multiplier
+    applied to every entity hit counter at each rebalance pass (EWMA — 0.5
+    halves an idle entity's rank per pass).  ``hot_max_moves``: cap on
+    promotions per coordinate per pass (None = unlimited) so one pass never
+    stalls the scoring threads behind a giant scatter.  ``x_dtype``:
+    request feature dtype (float32, matching data/reader's default design
+    dtype — part of the bitwise-parity contract with batch scoring)."""
 
     device_capacity: Optional[int] = None
     lru_capacity: int = 4096
+    hot_decay: float = 0.5
+    hot_max_moves: Optional[int] = None
     x_dtype: np.dtype = np.float32
 
 
@@ -105,6 +132,12 @@ class ColdEntityCache:
                     self._metrics.inc("lru_evictions")
         return row
 
+    def invalidate(self, entity_id: int) -> None:
+        """Drop one entry (stale after a streaming delta rewrote its row, or
+        redundant after the entity was promoted onto the device)."""
+        with self._lock:
+            self._lru.pop(entity_id, None)
+
 
 @dataclasses.dataclass
 class FixedCoordinate:
@@ -115,26 +148,174 @@ class FixedCoordinate:
     weights: Array  # [d], device-resident
 
 
-@dataclasses.dataclass
-class RandomCoordinate:
-    """One random-effect coordinate's device table + host fallback."""
+class HotSet(NamedTuple):
+    """One consistent device-residency snapshot: gather table + the entity
+    id -> device-row map that indexes it.  Replaced atomically as a pair —
+    a resolve that grabbed this snapshot can never pair stale slots with a
+    rebalanced table."""
 
-    cid: str
-    feature_shard: str
-    random_effect_type: str
-    table: Array              # [hot, d] device-resident hot rows
-    dim: int
-    hot_slot_of: Dict[int, int]   # entity id -> device row (slot < hot)
-    cold: ColdEntityCache         # entity id -> host row for slot >= hot
-    num_entities: int             # hot + cold
+    table: Array            # [max(capacity, 1), d] device-resident rows
+    slot_of: Dict[int, int]  # entity id -> device row
+
+
+class RandomCoordinate:
+    """One random-effect coordinate: device hot set, host archive, LRU.
+
+    ``archive`` is the full host-side coefficient stack (the PalDB store
+    analog); the device table holds the ``hot_capacity`` rows serving
+    residency currently favors.  Residency starts as the first
+    ``hot_capacity`` training slots and is re-ranked by ``rebalance()``
+    from the EWMA hit counters ``record_hits`` accumulates.  All mutation
+    — counters, promotion/demotion, streaming deltas — happens under
+    ``self._lock``; readers take the ``hot`` snapshot once and are
+    consistent without locking.
+    """
+
+    def __init__(self, cid: str, feature_shard: str, random_effect_type: str,
+                 archive: np.ndarray, archive_slot_of: Dict[int, int],
+                 hot_capacity: int, lru_capacity: int,
+                 metrics: Optional[ServingMetrics] = None,
+                 decay: float = 0.5,
+                 max_moves: Optional[int] = None):
+        self.cid = cid
+        self.feature_shard = feature_shard
+        self.random_effect_type = random_effect_type
+        self._archive = archive              # [n_ent, d] host rows
+        self.archive_slot_of = archive_slot_of  # entity id -> archive row
+        self.num_entities, self.dim = archive.shape
+        self.hot_capacity = int(hot_capacity)
+        self.decay = float(decay)
+        self.max_moves = max_moves
+        self._lock = threading.Lock()
+        self._freq: Dict[int, float] = {}
+        if self.hot_capacity < 1:
+            # score_samples clamps missing slots to row 0, which must exist
+            # to gather from — an all-cold coordinate serves a zero row
+            table = jnp.zeros((1, self.dim), archive.dtype)
+            slot_of: Dict[int, int] = {}
+        else:
+            table = jnp.asarray(archive[: self.hot_capacity])
+            slot_of = {eid: s for eid, s in archive_slot_of.items()
+                       if s < self.hot_capacity}
+        self._hot = HotSet(table, slot_of)
+        self.cold = ColdEntityCache(self._fetch_cold, lru_capacity, metrics)
+
+    def _fetch_cold(self, eid: int) -> Optional[np.ndarray]:
+        slot = self.archive_slot_of.get(eid)
+        return None if slot is None else self._archive[slot]
+
+    # -- reader surface ----------------------------------------------------
+    @property
+    def hot(self) -> HotSet:
+        """The current residency snapshot (read once per resolve)."""
+        return self._hot
 
     @property
-    def hot_entities(self) -> int:
-        return self.table.shape[0]
+    def table(self) -> Array:
+        return self._hot.table
+
+    @property
+    def hot_slot_of(self) -> Dict[int, int]:
+        return self._hot.slot_of
+
+    # -- frequency tracking ------------------------------------------------
+    def record_hits(self, counts: Dict[int, int]) -> None:
+        """Fold one batch's per-entity hit counts into the EWMA counters."""
+        if not counts:
+            return
+        with self._lock:
+            for eid, k in counts.items():
+                self._freq[eid] = self._freq.get(eid, 0.0) + k
+
+    def frequency(self, eid: int) -> float:
+        with self._lock:
+            return self._freq.get(eid, 0.0)
+
+    # -- promotion / demotion ----------------------------------------------
+    def rebalance(self) -> Tuple[int, int]:
+        """One frequency-ranked promotion/demotion pass.
+
+        Decays every hit counter by ``decay`` (EWMA), ranks all entities
+        with recorded traffic plus the incumbents by frequency (incumbents
+        win ties — hysteresis against churn; archive slot breaks the rest,
+        so a fixed request trace yields a reproducible hot set), then
+        scatters the promoted rows into the device rows the demoted ones
+        vacate — ONE ``.at[rows].set`` launch, table shape unchanged.
+        Returns (promotions, demotions); they are always equal.
+        """
+        if self.hot_capacity < 1 or self.hot_capacity >= self.num_entities:
+            with self._lock:  # keep counters EWMA even when residency is fixed
+                self._freq = {e: f * self.decay
+                              for e, f in self._freq.items()
+                              if f * self.decay > _FREQ_FLOOR}
+            return 0, 0
+        with self._lock:
+            self._freq = {e: f * self.decay for e, f in self._freq.items()
+                          if f * self.decay > _FREQ_FLOOR}
+            freq = self._freq
+            current = self._hot.slot_of
+            ranked = sorted(
+                set(freq) | set(current),
+                key=lambda e: (-freq.get(e, 0.0),
+                               0 if e in current else 1,
+                               self.archive_slot_of[e]))
+            desired = set(ranked[: self.hot_capacity])
+            promote = [e for e in ranked[: self.hot_capacity]
+                       if e not in current]
+            if not promote:
+                return 0, 0
+            # coldest incumbents vacate first; deterministic tiebreak again
+            demote = sorted((e for e in current if e not in desired),
+                            key=lambda e: (freq.get(e, 0.0),
+                                           -self.archive_slot_of[e]))
+            if self.max_moves is not None:
+                promote = promote[: self.max_moves]
+                demote = demote[: len(promote)]
+            rows = [current[e] for e in demote]
+            new_rows = np.stack([self._archive[self.archive_slot_of[e]]
+                                 for e in promote])
+            table = self._hot.table.at[jnp.asarray(rows, jnp.int32)].set(
+                jnp.asarray(new_rows))
+            slot_of = dict(current)
+            for e in demote:
+                del slot_of[e]
+            for e, r in zip(promote, rows):
+                slot_of[e] = r
+            self._hot = HotSet(table, slot_of)
+        for e in promote:  # device copy supersedes any LRU copy
+            self.cold.invalidate(e)
+        return len(promote), len(demote)
+
+    # -- streaming deltas --------------------------------------------------
+    def apply_delta(self, eid: int, row: np.ndarray) -> bool:
+        """Replace one entity's coefficient row in place (online learning).
+
+        Updates the host archive, scatters into the device table when the
+        entity is resident, and invalidates its LRU entry — the next
+        resolve serves the new row whichever tier it lands on.  Returns
+        False for an entity this coordinate never trained (serving never
+        grows the training-time index)."""
+        row = np.asarray(row, dtype=self._archive.dtype)
+        if row.shape != (self.dim,):
+            raise ValueError(
+                f"coordinate {self.cid!r}: delta row has shape {row.shape}, "
+                f"expected ({self.dim},)")
+        with self._lock:
+            slot = self.archive_slot_of.get(eid)
+            if slot is None:
+                return False
+            self._archive[slot] = row
+            dev = self._hot.slot_of.get(eid)
+            if dev is not None:
+                self._hot = HotSet(
+                    self._hot.table.at[dev].set(jnp.asarray(row)),
+                    self._hot.slot_of)
+        self.cold.invalidate(eid)
+        return True
 
 
 class CoefficientStore:
-    """One immutable model version, device-ready (see module docstring)."""
+    """One model version, device-ready (see module docstring)."""
 
     def __init__(self, task: TaskType,
                  coordinates: Dict[str, Union[FixedCoordinate,
@@ -143,7 +324,8 @@ class CoefficientStore:
                  index_maps: Dict[str, "IndexMap"],
                  shard_dims: Dict[str, int],
                  config: StoreConfig,
-                 version: str = ""):
+                 version: str = "",
+                 metrics: Optional[ServingMetrics] = None):
         self.task = task
         self.coordinates = coordinates
         self.order: List[str] = list(coordinates)  # additive-score order
@@ -152,6 +334,7 @@ class CoefficientStore:
         self.shard_dims = shard_dims
         self.config = config
         self.version = version
+        self.metrics = metrics
         self.generation = next(_generation)
 
     # -- construction ------------------------------------------------------
@@ -201,35 +384,16 @@ class CoefficientStore:
                 _shard_dim(m.feature_shard, d, cid)
                 hot = n_ent if config.device_capacity is None else min(
                     config.device_capacity, n_ent)
-                # device table = the first `hot` stack rows; colder rows stay
-                # host-side behind the LRU (full stack kept as the archive —
-                # host RAM is the PalDB store, device HBM holds the hot set).
-                # The table keeps at least one row: score_samples clamps
-                # missing slots to row 0, which must exist to gather from
-                # (an all-cold or entity-less coordinate serves a zero row).
-                if hot < 1:
-                    hot = 0
-                    table = jnp.zeros((1, d), w_stack.dtype)
-                else:
-                    table = jnp.asarray(w_stack[:hot] if hot < n_ent
-                                        else w_stack)
-                hot_slot_of = {eid: s for eid, s in m.slot_of.items()
-                               if s < hot}
-                cold_slot_of = {eid: s for eid, s in m.slot_of.items()
-                                if s >= hot}
-
-                def _fetch(eid: int, _stack=w_stack, _cold=cold_slot_of
-                           ) -> Optional[np.ndarray]:
-                    slot = _cold.get(eid)
-                    return None if slot is None else _stack[slot]
-
                 coordinates[cid] = RandomCoordinate(
                     cid=cid, feature_shard=m.feature_shard,
                     random_effect_type=m.random_effect_type,
-                    table=table, dim=d, hot_slot_of=hot_slot_of,
-                    cold=ColdEntityCache(_fetch, config.lru_capacity,
-                                         metrics),
-                    num_entities=n_ent)
+                    archive=np.array(w_stack),  # own it: deltas mutate rows
+                    archive_slot_of=dict(m.slot_of),
+                    hot_capacity=hot,
+                    lru_capacity=config.lru_capacity,
+                    metrics=metrics,
+                    decay=config.hot_decay,
+                    max_moves=config.hot_max_moves)
             else:
                 raise ValueError(
                     f"coordinate {cid!r}: serving supports FixedEffectModel "
@@ -249,13 +413,16 @@ class CoefficientStore:
                     "for this model version")
         return cls(task=task, coordinates=coordinates,
                    entity_indexes=entity_indexes, index_maps=index_maps,
-                   shard_dims=shard_dims, config=config, version=version)
+                   shard_dims=shard_dims, config=config, version=version,
+                   metrics=metrics)
 
     # -- shape signature (compiled-executable cache key) -------------------
     def signature(self) -> Tuple:
         """Everything that determines compiled-kernel shapes/dtypes.  Two
         model versions with an equal signature share AOT executables, which
-        is what makes same-shape hot swaps recompile-free."""
+        is what makes same-shape hot swaps recompile-free.  Rebalance and
+        streaming deltas never change a shape, so a generation's signature
+        is stable for its whole life."""
         parts = []
         for cid in self.order:
             c = self.coordinates[cid]
@@ -278,35 +445,133 @@ class CoefficientStore:
         return -1 if eidx is None else eidx.get(str(name))
 
     def resolve(self, cid: str, entity_names: Sequence[Optional[str]],
+                n_rows: Optional[int] = None,
                 metrics: Optional[ServingMetrics] = None
-                ) -> Tuple[np.ndarray, np.ndarray]:
-        """Per-sample (device slots, cold overflow rows) for one coordinate.
+                ) -> Tuple[Array, np.ndarray, np.ndarray]:
+        """Per-sample (table, device slots, cold overflow rows) for one
+        coordinate, padded to ``n_rows`` (default: no padding).
 
-        ``slots[i]``: device-table row of sample i's entity, or -1 (cold or
-        unknown — the device kernel scores those 0, the reference's missing-
-        entity convention).  ``overflow[i]``: the cold entity's host
+        ``table`` is the residency snapshot the slots index — callers MUST
+        score against the returned table, not a later read of
+        ``coordinate.table``, or a concurrent rebalance could tear them
+        apart.  ``slots[i]``: device-table row of sample i's entity, or -1
+        (cold or unknown — the device kernel scores those 0, the
+        reference's missing-entity convention).  Rows past
+        ``len(entity_names)`` are padding: slot -1, zero overflow, and NOT
+        counted as entity misses.  ``overflow[i]``: the cold entity's host
         coefficient row (zeros for hot/unknown samples); the engine adds
         ``einsum('nd,nd->n', x, overflow)`` so a cold entity scores exactly
-        as if its row were in the device table."""
+        as if its row were in the device table.  Every real lookup feeds
+        the coordinate's EWMA hit counters (the rebalance signal)."""
         c = self.coordinates[cid]
-        n = len(entity_names)
-        slots = np.full(n, -1, np.int32)
-        overflow = np.zeros((n, c.dim), c.table.dtype)
-        misses = 0
+        n_real = len(entity_names)
+        n_rows = n_real if n_rows is None else n_rows
+        hs = c.hot
+        slots = np.full(n_rows, -1, np.int32)
+        overflow = np.zeros((n_rows, c.dim), hs.table.dtype)
+        misses = hot_hits = 0
+        hits: Dict[int, int] = {}
         for i, name in enumerate(entity_names):
             eid = self.entity_id(c.random_effect_type, name)
             if eid < 0:
                 misses += 1
                 continue
-            slot = c.hot_slot_of.get(eid)
+            hits[eid] = hits.get(eid, 0) + 1
+            slot = hs.slot_of.get(eid)
             if slot is not None:
                 slots[i] = slot
+                hot_hits += 1
                 continue
             row = c.cold.get(eid)
             if row is None:
                 misses += 1
             else:
                 overflow[i] = row
-        if metrics is not None and misses:
-            metrics.inc("entity_misses", misses)
-        return slots, overflow
+        c.record_hits(hits)
+        if metrics is not None:
+            if misses:
+                metrics.inc("entity_misses", misses)
+            if hot_hits:
+                metrics.inc("hot_hits", hot_hits)
+        return hs.table, slots, overflow
+
+    # -- residency management ----------------------------------------------
+    def rebalance(self) -> Dict[str, Tuple[int, int]]:
+        """Run one promotion/demotion pass on every random coordinate;
+        returns cid -> (promotions, demotions)."""
+        moves: Dict[str, Tuple[int, int]] = {}
+        for cid in self.order:
+            c = self.coordinates[cid]
+            if isinstance(c, RandomCoordinate):
+                moves[cid] = c.rebalance()
+        if self.metrics is not None:
+            self.metrics.inc("rebalances")
+            promoted = sum(p for p, _ in moves.values())
+            demoted = sum(d for _, d in moves.values())
+            if promoted:
+                self.metrics.inc("hot_promotions", promoted)
+            if demoted:
+                self.metrics.inc("hot_demotions", demoted)
+        return moves
+
+    def apply_delta(self, cid: str, entity: Optional[str],
+                    row: np.ndarray) -> bool:
+        """Streaming coefficient update: replace ``entity``'s row on
+        coordinate ``cid`` in the LIVE store (see RandomCoordinate
+        .apply_delta).  Returns False for an entity outside the training
+        index; raises ValueError for an unknown/fixed coordinate or a row
+        of the wrong width."""
+        c = self.coordinates.get(cid)
+        if c is None:
+            raise ValueError(
+                f"unknown coordinate {cid!r} (have {self.order})")
+        if isinstance(c, FixedCoordinate):
+            raise ValueError(
+                f"coordinate {cid!r} is a fixed effect — streaming deltas "
+                "target per-entity random-effect rows; rotate fixed effects "
+                "through a hot swap")
+        eid = self.entity_id(c.random_effect_type, entity)
+        if eid < 0:
+            return False
+        ok = c.apply_delta(eid, row)
+        if ok and self.metrics is not None:
+            self.metrics.inc("delta_updates")
+        return ok
+
+
+class HotSetManager:
+    """Background promotion/demotion driver.
+
+    Calls ``store_getter().rebalance()`` every ``interval_s`` on a daemon
+    thread — ``store_getter`` (usually ``lambda: engine.store``) re-reads
+    the ACTIVE generation each tick, so the manager survives hot swaps
+    without re-wiring.  ``run_once`` is the synchronous form benches and
+    tests use for deterministic cadence."""
+
+    def __init__(self, store_getter: Callable[[], CoefficientStore],
+                 interval_s: float = 1.0):
+        self._get = store_getter
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run_once(self) -> Dict[str, Tuple[int, int]]:
+        return self._get().rebalance()
+
+    def start(self) -> "HotSetManager":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="photon-serving-hotset")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.run_once()

@@ -37,8 +37,8 @@ import numpy as np
 
 from photon_ml_tpu.game.scoring import additive_total, output_scores
 from photon_ml_tpu.parallel.bucketing import score_samples
-from photon_ml_tpu.serving.batcher import (BucketedBatcher, Request,
-                                           densify_features)
+from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,
+                                           Request, densify_features)
 from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
                                                      FixedCoordinate)
 from photon_ml_tpu.serving.metrics import ServingMetrics
@@ -200,9 +200,29 @@ class ScoringEngine:
                 fixed_ws.append(c.weights)
             else:
                 names = [r.ids.get(c.random_effect_type) for r in chunk]
-                names += [None] * (bucket - len(chunk))  # padding: slot -1
-                sl, ov = store.resolve(cid, names, metrics=self.metrics)
-                tables.append(c.table)
+                # resolve pads rows beyond len(chunk) itself (slot -1, zero
+                # overflow, not counted as misses) and returns the residency
+                # snapshot the slots index — a concurrent rebalance can
+                # never pair these slots with a different table
+                tbl, sl, ov = store.resolve(cid, names, n_rows=bucket,
+                                            metrics=self.metrics)
+                tables.append(tbl)
                 slots.append(sl)
                 overflows.append(ov)
         return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))
+
+    # -- async front -------------------------------------------------------
+    def async_batcher(self, deadline_s: float = 500e-6,
+                      predict_mean: bool = False,
+                      flush_threshold: Optional[int] = None) -> AsyncBatcher:
+        """An AsyncBatcher feeding this engine: submit requests one at a
+        time, get score futures back; flushes on a full top bucket or the
+        deadline, whichever first (see serving/batcher.AsyncBatcher)."""
+
+        def score(reqs: Sequence[Request]) -> np.ndarray:
+            return self.score_requests(reqs, predict_mean=predict_mean)
+
+        return AsyncBatcher(
+            score,
+            flush_threshold=flush_threshold or self.batcher.max_batch,
+            deadline_s=deadline_s, metrics=self.metrics)

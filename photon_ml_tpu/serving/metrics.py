@@ -11,11 +11,18 @@ hook so the serving path and the offline drivers share one timing idiom.
 
 Metric families:
   - counters: requests, batches, scored samples, entity misses (unknown
-    entity -> score 0), cold fetches / LRU hits (host fallback), compiles,
-    swaps / swap failures;
+    entity -> score 0), hot-set hits / cold fetches / LRU hits (residency
+    tiers), hot promotions/demotions + rebalances, streaming delta updates,
+    compiles, swaps / swap failures, and the async batcher's flush mix
+    (flushes_full / flushes_deadline / flushes_forced);
   - per-bucket latency histograms (log-spaced bins, p50/p99/max) keyed by
     padded bucket size, plus padded-row accounting for the padding-waste
-    ratio (padded rows / total padded capacity);
+    ratio (padded rows / total padded capacity) and per-bucket occupancy
+    (real rows / launched capacity at that bucket size);
+  - derived gauges in the snapshot: ``hot_set_hit_rate`` (device-resident
+    lookups / all known-entity lookups) and ``entity_miss_rate`` (unknown
+    entities / all lookups) — the two numbers the frequency-ranked hot set
+    exists to move;
   - phase durations (warm, swap) via the Timed sink.
 """
 
@@ -100,6 +107,8 @@ class ServingMetrics:
         self._phases: Dict[str, float] = {}
         self._padded_capacity = 0  # sum of bucket sizes actually launched
         self._real_rows = 0        # real (unpadded) rows inside them
+        # per-bucket occupancy accounting: bucket size -> [real, capacity]
+        self._bucket_rows: Dict[int, list] = {}
         self._started = time.time()
 
     # -- mutators ----------------------------------------------------------
@@ -123,6 +132,11 @@ class ServingMetrics:
                 self._counters.get("scored_samples", 0) + real_rows)
             self._padded_capacity += bucket
             self._real_rows += real_rows
+            occ = self._bucket_rows.get(bucket)
+            if occ is None:
+                occ = self._bucket_rows[bucket] = [0, 0]
+            occ[0] += real_rows
+            occ[1] += bucket
             key = f"bucket_{bucket}"
             h = self._latency.get(key)
             if h is None:
@@ -153,6 +167,12 @@ class ServingMetrics:
             requests = self._counters.get("requests", 0)
             waste = (1.0 - self._real_rows / self._padded_capacity
                      if self._padded_capacity else 0.0)
+            # residency gauges: lookups = every real (non-padding) entity
+            # lookup; hot = served straight from the device table
+            hot = self._counters.get("hot_hits", 0)
+            lookups = (hot + self._counters.get("lru_hits", 0)
+                       + self._counters.get("cold_fetches", 0)
+                       + self._counters.get("entity_misses", 0))
             return {
                 "counters": dict(self._counters),
                 "qps": requests / uptime,
@@ -160,6 +180,13 @@ class ServingMetrics:
                 "padding_waste_ratio": waste,
                 "padded_rows_launched": self._padded_capacity,
                 "real_rows_launched": self._real_rows,
+                "bucket_occupancy": {
+                    f"bucket_{b}": (rows[0] / rows[1] if rows[1] else 0.0)
+                    for b, rows in sorted(self._bucket_rows.items())},
+                "hot_set_hit_rate": hot / lookups if lookups else 0.0,
+                "entity_miss_rate": (
+                    self._counters.get("entity_misses", 0) / lookups
+                    if lookups else 0.0),
                 "latency": {k: h.snapshot()
                             for k, h in sorted(self._latency.items())},
                 "phases_s": dict(self._phases),

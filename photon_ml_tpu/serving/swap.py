@@ -25,6 +25,14 @@ Protocol (all failure paths leave the OLD version serving):
 ``swap`` is synchronous; ``swap_async`` runs the same protocol on a
 background thread (the load/warm work happens off the request path either
 way — only the pointer flip touches the engine).
+
+Between full swaps, **streaming deltas** (``apply_delta``) scatter single
+online-learned coefficient rows into the live store
+(``CoefficientStore.apply_delta``: archive write + device scatter + LRU
+invalidation) without a generation flip.  The swapper is where they enter
+so the coefficient state has ONE version identity:
+``(generation, delta_version)`` — ``delta_version`` counts deltas applied
+to the current generation and resets to 0 at every successful swap.
 """
 
 from __future__ import annotations
@@ -48,7 +56,11 @@ class HotSwapper:
                  warm_buckets: Optional[Sequence[int]] = None):
         self.engine = engine
         self.warm_buckets = warm_buckets  # None -> the batcher's ladder
-        self._swap_lock = threading.Lock()  # one swap in flight at a time
+        # one swap OR delta in flight at a time — deltas must not land on a
+        # store that is mid-flip, and delta_version must pair with exactly
+        # one generation
+        self._swap_lock = threading.Lock()
+        self.delta_version = 0  # deltas applied to the CURRENT generation
 
     def swap(self, model_dir: str, version: str = "") -> bool:
         """Returns True when the new version is serving; False when the new
@@ -71,11 +83,33 @@ class HotSwapper:
                              old.version, e)
                 return False
             self.engine.activate(new)
+            self.delta_version = 0  # fresh generation: no deltas yet
             metrics.inc("swaps")
             logger.info("hot swap: gen %d (version %r) -> gen %d (version "
                         "%r)", old.generation, old.version, new.generation,
                         new.version)
             return True
+
+    def apply_delta(self, cid: str, entity: str, row) -> bool:
+        """Scatter one updated coefficient row into the LIVE generation
+        (online-learned random effects — no generation flip, no recompile).
+        Returns True when applied; False when rejected (unknown entity,
+        unknown/fixed coordinate, wrong row width) — a rejected delta
+        leaves every coefficient untouched."""
+        metrics = self.engine.metrics
+        with self._swap_lock:
+            store = self.engine.store
+            try:
+                ok = store.apply_delta(cid, entity, row)
+            except ValueError as e:
+                logger.error("delta rejected (gen %d): %s",
+                             store.generation, e)
+                ok = False
+            if ok:
+                self.delta_version += 1
+            else:
+                metrics.inc("delta_rejects")
+            return ok
 
     def swap_async(self, model_dir: str, version: str = "") -> threading.Thread:
         """Run ``swap`` on a daemon thread; returns the thread (join it to

@@ -116,6 +116,14 @@ class TestBatcher:
         with pytest.raises(ValueError):
             pow2_bucket_ladder(0)
 
+    def test_pow2_ladder_min_bucket(self):
+        assert pow2_bucket_ladder(64, min_bucket=8) == (8, 16, 32, 64)
+        assert pow2_bucket_ladder(16, min_bucket=16) == (16,)
+        # min_bucket above the top bucket would yield a ladder that cannot
+        # hold the promised max_batch — must raise, not silently shrink
+        with pytest.raises(ValueError, match="min_bucket"):
+            pow2_bucket_ladder(5, min_bucket=32)
+
     def test_plan_pads_and_splits(self):
         b = BucketedBatcher(max_batch=8)
         assert [(mb.bucket, mb.real_rows) for mb in b.plan(3)] == [(4, 3)]
@@ -168,6 +176,27 @@ class TestMetrics:
         assert snap["counters"]["batches"] == 2
         assert "bucket_8" in snap["latency"]
         json.loads(m.to_json())  # serializable
+
+    def test_bucket_occupancy_gauges(self):
+        m = ServingMetrics()
+        m.observe_batch(bucket=8, real_rows=5, seconds=0.001)
+        m.observe_batch(bucket=8, real_rows=8, seconds=0.001)
+        m.observe_batch(bucket=2, real_rows=1, seconds=0.001)
+        occ = m.snapshot()["bucket_occupancy"]
+        assert occ["bucket_8"] == pytest.approx(13 / 16)
+        assert occ["bucket_2"] == pytest.approx(0.5)
+
+    def test_hot_set_and_miss_rate_gauges(self):
+        m = ServingMetrics()
+        snap = m.snapshot()
+        assert snap["hot_set_hit_rate"] == 0.0  # no lookups yet
+        m.inc("hot_hits", 6)
+        m.inc("lru_hits", 1)
+        m.inc("cold_fetches", 1)
+        m.inc("entity_misses", 2)
+        snap = m.snapshot()
+        assert snap["hot_set_hit_rate"] == pytest.approx(0.6)
+        assert snap["entity_miss_rate"] == pytest.approx(0.2)
 
 
 # ---------------------------------------------------------------------------
@@ -407,8 +436,10 @@ class TestServeCli:
         assert [o["uid"] for o in scores] == [0, 1, 2, 3, 4, 99]
         assert all(np.isfinite(o["score"]) for o in scores)
         swaps = [o for o in out if "swap" in o]
-        assert swaps == [{"swap": "ok", "generation": swaps[0]["generation"],
-                          "version": dir2}]
+        assert len(swaps) == 1
+        assert swaps[0]["swap"] == "ok"
+        assert swaps[0]["version"] == dir2
+        assert swaps[0]["delta_version"] == 0  # fresh generation
         metrics_lines = [o for o in out if "counters" in o]
         assert len(metrics_lines) == 1
         exported = json.load(open(metrics_file))
@@ -437,5 +468,26 @@ def test_bench_serving_smoke(tmp_path):
     assert out["stream"]["qps"] > 0
     assert 0 <= out["stream"]["padding_waste_ratio"] < 1
     assert out["warm"]["executables"] == 4
+    assert out["compiles_after_warm"] == 0  # acceptance: flat after warm
     on_disk = json.load(open(tmp_path / "b.json"))
     assert on_disk["value"] == out["value"]
+
+
+def test_bench_serving_zipf_smoke(tmp_path):
+    import bench
+
+    out = bench.run_serving_bench(n_entities=60, d=4, n_requests=48,
+                                  max_batch=8, device_capacity=12,
+                                  zipf=1.2, deadline_us=100.0,
+                                  rebalance_every=16,
+                                  out_path=str(tmp_path / "z.json"))
+    assert out["zipf"] == 1.2
+    # the three cross-PR trajectory numbers are recorded top-level
+    assert 0 <= out["padding_waste_ratio"] < 1
+    assert 0 <= out["entity_miss_rate"] < 1
+    assert out["p99_s"] > 0
+    assert out["hot_set"]["rebalances"] >= 3
+    assert out["hot_set"]["promotions"] >= 1  # skew moved residency
+    assert out["compiles_after_warm"] == 0
+    flushes = out["flushes"]
+    assert flushes["full"] + flushes["deadline"] + flushes["forced"] >= 1
