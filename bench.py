@@ -1647,6 +1647,75 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
     return out
 
 
+def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
+                  out_path: str = None) -> dict:
+    """`bench.py --obs`: photonscope overhead micro-bench.
+
+    The tracer sits on every serving hot path (submit, flush, resolve,
+    execute) and the descent loop, so its DISABLED cost is a hot-path tax
+    every request pays — this bench measures the per-call-site overhead of
+    the module-level ``span()`` guard with tracing off vs on (ring-buffer
+    record + attrs dict), plus ``instant()`` and a labeled registry ``inc``,
+    and ASSERTS the disabled-path guard stays under ``budget_ns``
+    (default 1µs — the acceptance budget; PHOTON_BENCH_OBS_BUDGET_NS
+    overrides).  Emits BENCH_OBS.json.  Pure host work: no jax import.
+    """
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.obs.registry import MetricsRegistry
+    from photon_ml_tpu.obs.trace import Tracer, span
+
+    budget_ns = float(os.environ.get("PHOTON_BENCH_OBS_BUDGET_NS", budget_ns))
+
+    def per_call_ns(thunk, n):
+        # best of 5 windows: the guard is ns-scale, so one long loop per
+        # window amortizes the timer and the min rejects scheduler noise
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                thunk()
+            best = min(best, (time.perf_counter_ns() - t0) / n)
+        return best
+
+    prev = obs.set_tracer(Tracer(capacity=4096, enabled=False))
+    try:
+        def disabled_span():
+            with span("bench.op", bucket=64):
+                pass
+
+        disabled_ns = per_call_ns(disabled_span, n_calls)
+        obs.get_tracer().enable()
+        enabled_ns = per_call_ns(disabled_span, min(n_calls, 50_000))
+        instant_ns = per_call_ns(
+            lambda: obs.instant("bench.tick", k=1), min(n_calls, 50_000))
+    finally:
+        obs.set_tracer(prev)
+    reg = MetricsRegistry()
+    inc_ns = per_call_ns(lambda: reg.inc("bench_total", bucket="64"),
+                         min(n_calls, 50_000))
+
+    out = {
+        "metric": "obs_disabled_span_overhead", "unit": "ns",
+        "value": round(disabled_ns, 1),
+        "disabled_span_ns": round(disabled_ns, 1),
+        "enabled_span_ns": round(enabled_ns, 1),
+        "instant_ns": round(instant_ns, 1),
+        "registry_inc_labeled_ns": round(inc_ns, 1),
+        "budget_ns": budget_ns,
+        "within_budget": disabled_ns < budget_ns,
+        "n_calls": n_calls,
+    }
+    path = out_path or os.path.join(_REPO, "BENCH_OBS.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    assert disabled_ns < budget_ns, (
+        f"disabled-tracer span guard costs {disabled_ns:.0f}ns/call — over "
+        f"the {budget_ns:.0f}ns budget; the hot paths pay this on EVERY "
+        "request")
+    return out
+
+
 # configs with an unconditional scipy stand-in for vs_baseline.  glmix_chip
 # is special-cased in _entry_from: at chip scale no host holds its design
 # matrix (vs_baseline stays null), but CPU-floor runs reconstruct the
@@ -1682,9 +1751,17 @@ def main():
                     help="photonlint wall-time micro-bench (whole-program "
                          "pass over photon_ml_tpu/) -> BENCH_LINT.json")
     ap.add_argument("--lint-repeats", type=int, default=3)
+    ap.add_argument("--obs", action="store_true",
+                    help="photonscope overhead micro-bench (disabled-path "
+                         "span guard ns/call vs enabled; asserts the "
+                         "disabled guard under budget) -> BENCH_OBS.json")
     ap.add_argument("--out", default=None,
-                    help="with --serving/--lint: output JSON path override")
+                    help="with --serving/--lint/--obs: output JSON path "
+                         "override")
     a = ap.parse_args()
+    if a.obs:
+        print(json.dumps(run_obs_bench(out_path=a.out)))
+        return
     if a.lint:
         print(json.dumps(run_lint_bench(repeats=a.lint_repeats,
                                         out_path=a.out)))

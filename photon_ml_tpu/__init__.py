@@ -20,6 +20,9 @@ by block coordinate descent. This package re-designs those capabilities TPU-firs
 - ``data``      Avro/libsvm readers, feature index maps, synthetic generators
                 (reference: photon-client .../data, .../index)
 - ``utils``     logging, timing, linalg helpers (reference: .../util)
+- ``obs``       photonscope: span tracer (Chrome trace export), unified
+                metrics registry (Prometheus/JSON), JAX runtime probe
+                (reference: .../util PhotonLogger/Timed + event/* — unified)
 
 Everything device-side is functional JAX: static shapes, ``lax``-control flow,
 collectives via ``shard_map`` over a ``jax.sharding.Mesh``.

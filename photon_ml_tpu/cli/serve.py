@@ -33,7 +33,13 @@ Wire protocol (one JSON object per line on stdin / ``--requests`` file):
   rebalance {"cmd": "rebalance"} -> {"rebalance": {cid: [promoted,
             demoted]}}; one synchronous frequency-ranked hot-set pass (the
             background cadence is ``--hot-set-interval``)
-  metrics   {"cmd": "metrics"} -> one metrics JSON line
+  metrics   {"cmd": "metrics"} -> one metrics JSON line;
+            {"cmd": "metrics", "format": "prometheus"} ->
+            {"prometheus": "<text exposition>"} (the full labeled registry)
+  trace     {"cmd": "trace"} -> one Chrome ``trace_event`` JSON line
+            (load in Perfetto) covering the tracer ring buffer: submit ->
+            batch flush -> resolve -> AOT execute spans; needs ``--trace``
+            (otherwise -> {"error": ...})
 
 Responses are ``{"uid": ..., "score": ...}`` lines on stdout, in request
 order.  Every command drains pending requests first, so everything
@@ -51,6 +57,7 @@ import logging
 import sys
 from typing import IO, List, Optional, Sequence, Tuple
 
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.batcher import BucketedBatcher, request_from_json
 from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
                                                      HotSetManager,
@@ -105,6 +112,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JSON-lines request file ('-' = stdin)")
     p.add_argument("--metrics-json", default="",
                    help="write the final metrics snapshot here at exit")
+    p.add_argument("--trace", action="store_true",
+                   help="enable the photonscope tracer (spans across "
+                        "submit/flush/resolve/execute; {\"cmd\": \"trace\"} "
+                        "dumps the ring buffer as Chrome trace JSON)")
+    p.add_argument("--trace-buffer", type=int, default=8192,
+                   help="tracer ring-buffer capacity (newest spans win)")
+    p.add_argument("--trace-out", default="",
+                   help="write the Chrome trace JSON here at exit "
+                        "(implies --trace)")
     return p
 
 
@@ -150,10 +166,12 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
         deadline_s=deadline_s, predict_mean=predict_mean)
 
     def emit(uid, fut) -> None:
-        try:
-            out.write(json.dumps({"uid": uid, "score": fut.result()}) + "\n")
-        except Exception as e:  # scoring error: the request's own line
-            out.write(json.dumps({"uid": uid, "error": str(e)}) + "\n")
+        with obs_span("serve.respond", uid=uid):
+            try:
+                out.write(json.dumps({"uid": uid,
+                                      "score": fut.result()}) + "\n")
+            except Exception as e:  # scoring error: the request's own line
+                out.write(json.dumps({"uid": uid, "error": str(e)}) + "\n")
 
     def drain(block: bool) -> None:
         wrote = False
@@ -216,7 +234,23 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                 out.flush()
             elif cmd == "metrics":
                 flush()
-                out.write(engine.metrics.to_json() + "\n")
+                if obj.get("format") == "prometheus":
+                    out.write(json.dumps(
+                        {"prometheus": engine.metrics.to_prometheus()}) + "\n")
+                else:
+                    out.write(engine.metrics.to_json() + "\n")
+                out.flush()
+            elif cmd == "trace":
+                flush()  # pending spans (flush/execute) land in the ring
+                from photon_ml_tpu import obs
+
+                tracer = obs.get_tracer()
+                if not tracer.enabled:
+                    out.write(json.dumps(
+                        {"error": "tracing disabled; rerun with --trace"})
+                        + "\n")
+                else:
+                    out.write(json.dumps(tracer.chrome_trace()) + "\n")
                 out.flush()
             elif cmd is not None:
                 out.write(json.dumps({"error": f"unknown cmd {cmd!r}"}) + "\n")
@@ -250,6 +284,12 @@ def run(argv: List[str]) -> int:
     from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 
     enable_compilation_cache()
+
+    if args.trace or args.trace_out:
+        from photon_ml_tpu import obs
+
+        obs.enable_tracing(capacity=args.trace_buffer)
+        logger.info("tracing enabled (ring capacity %d)", args.trace_buffer)
 
     buckets = None
     if args.buckets:
@@ -290,6 +330,11 @@ def run(argv: List[str]) -> int:
         if args.metrics_json:
             engine.metrics.export(args.metrics_json)
             logger.info("metrics -> %s", args.metrics_json)
+        if args.trace_out:
+            from photon_ml_tpu import obs
+
+            obs.get_tracer().export_chrome_trace(args.trace_out)
+            logger.info("trace -> %s", args.trace_out)
     return rc
 
 

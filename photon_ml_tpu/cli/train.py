@@ -153,6 +153,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flush descent state after every coordinate update and "
                         "auto-resume from it if present (preemption recovery; "
                         "mid-job checkpointing the reference lacks, SURVEY §5)")
+    p.add_argument("--trace-out", default=None,
+                   help="enable the photonscope tracer and write the Chrome "
+                        "trace JSON (Perfetto-loadable; per-(iteration, "
+                        "coordinate) descent spans with nested solve/score/"
+                        "validate children) here at exit")
+    p.add_argument("--trace-buffer", type=int, default=16384,
+                   help="with --trace-out: tracer ring-buffer capacity "
+                        "(newest spans win)")
+    p.add_argument("--metrics-out", default=None,
+                   help="write the unified metrics registry snapshot "
+                        "(descent update counters/timings, compile + "
+                        "transfer accounting) as JSON here at exit")
     return p
 
 
@@ -163,6 +175,11 @@ def run(argv: List[str]) -> int:
     from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
 
     enable_compilation_cache()
+    if args.trace_out:
+        from photon_ml_tpu import obs
+
+        obs.enable_tracing(capacity=args.trace_buffer)
+        logger.info("tracing enabled (ring capacity %d)", args.trace_buffer)
     t_start = time.time()
     task = TaskType[args.task]
 
@@ -184,6 +201,16 @@ def run(argv: List[str]) -> int:
     finally:
         emitter.close_listeners()
         job_log.close()
+        if args.trace_out:
+            from photon_ml_tpu import obs
+
+            obs.get_tracer().export_chrome_trace(args.trace_out)
+            logger.info("trace -> %s", args.trace_out)
+        if args.metrics_out:
+            from photon_ml_tpu import obs
+
+            obs.get_registry().export(args.metrics_out)
+            logger.info("metrics -> %s", args.metrics_out)
 
 
 def _run(args, task, t_start, emitter) -> int:

@@ -27,20 +27,36 @@ from photon_ml_tpu.evaluation.evaluator import EvaluationResults, EvaluationSuit
 from photon_ml_tpu.game.coordinate import Coordinate
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.models.game import DatumScoringModel, GameModel
+from photon_ml_tpu.obs import get_registry
+from photon_ml_tpu.obs.registry import MetricsRegistry
+from photon_ml_tpu.obs.trace import span as obs_span
 
 logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
 class DescentHistory:
-    """Per-update telemetry (reference per-iteration logging + trackers)."""
+    """Per-update telemetry (reference per-iteration logging + trackers).
+
+    Bookkeeping lives in the unified metrics registry — every ``add``
+    increments ``descent_updates_total{coordinate=...}`` and observes
+    ``descent_update_seconds{coordinate=...}``, so training timings land in
+    the same export surface (JSON / Prometheus) serving uses.  ``steps``
+    remains the in-order record API consumers iterate (estimator results,
+    tuning); ``registry=None`` binds to the process default."""
 
     steps: List[dict] = dataclasses.field(default_factory=list)
+    registry: Optional[MetricsRegistry] = None
 
     def add(self, iteration: int, coordinate_id: str, seconds: float,
             validation: Optional[EvaluationResults]) -> None:
         self.steps.append(dict(iteration=iteration, coordinate=coordinate_id,
                                seconds=seconds, validation=validation))
+        reg = self.registry or get_registry()
+        reg.inc("descent_updates_total", coordinate=coordinate_id)
+        reg.observe("descent_update_seconds", seconds,
+                    coordinate=coordinate_id)
+        reg.set_gauge("descent_iteration", iteration)
 
 
 class CoordinateDescent:
@@ -55,7 +71,8 @@ class CoordinateDescent:
     def __init__(self, coordinates: Dict[str, Coordinate], order: Optional[Sequence[str]] = None,
                  num_iterations: int = 1,
                  validation: Optional[Tuple[GameData, EvaluationSuite]] = None,
-                 locked: Optional[Set[str]] = None):
+                 locked: Optional[Set[str]] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.coordinates = coordinates
         self.order = list(order) if order is not None else list(coordinates)
         if set(self.order) != set(coordinates):
@@ -63,6 +80,7 @@ class CoordinateDescent:
         self.num_iterations = num_iterations
         self.validation = validation
         self.locked = locked or set()
+        self.registry = registry  # None -> process-default obs registry
         missing = self.locked - set(coordinates)
         if missing:
             raise ValueError(f"locked coordinates not present: {missing}")
@@ -81,7 +99,7 @@ class CoordinateDescent:
         best-by-primary-metric retention survives preemption."""
         coords = self.coordinates
         n = next(iter(coords.values()))._n if coords else 0
-        history = DescentHistory()
+        history = DescentHistory(registry=self.registry)
 
         # Initial scores: warm-start models (and locked coordinates) contribute
         # their score from the start (CoordinateDescent warm-start path).
@@ -117,24 +135,31 @@ class CoordinateDescent:
                                    resume_cursor.get("coordinate", 0))):
                     continue  # already done before the checkpoint
                 t0 = time.perf_counter()
-                # Residual trick (CoordinateDescent.scala:197-204): everything
-                # the OTHER coordinates explain becomes an offset.
-                partial = total - scores[cid]
-                offsets = coord._base_offset_host() + partial
-                model, _tracker = coord.update(offsets, seed=seed + it,
-                                               init=models.get(cid))
-                if logger.isEnabledFor(logging.DEBUG):
-                    # reference logs tracker summaries at debug
-                    # (CoordinateDescent.scala:238-250)
-                    try:
-                        logger.debug("coord %s solvers: %s", cid,
-                                     coord.tracker_summary(_tracker))
-                    except Exception:  # telemetry must never kill training
-                        logger.debug("coord %s: tracker summary unavailable", cid)
-                new_score = np.asarray(coord.score(model))
-                models[cid] = model
-                scores[cid] = new_score
-                total = partial + new_score
+                # one span per (iteration, coordinate) update — the unit the
+                # reference logs and the unit a Perfetto timeline nests the
+                # solve/score children under
+                with obs_span("descent.update", iteration=it, coordinate=cid):
+                    # Residual trick (CoordinateDescent.scala:197-204):
+                    # everything the OTHER coordinates explain becomes an
+                    # offset.
+                    partial = total - scores[cid]
+                    offsets = coord._base_offset_host() + partial
+                    with obs_span("descent.solve", coordinate=cid):
+                        model, _tracker = coord.update(offsets, seed=seed + it,
+                                                       init=models.get(cid))
+                    if logger.isEnabledFor(logging.DEBUG):
+                        # reference logs tracker summaries at debug
+                        # (CoordinateDescent.scala:238-250)
+                        try:
+                            logger.debug("coord %s solvers: %s", cid,
+                                         coord.tracker_summary(_tracker))
+                        except Exception:  # telemetry must never kill training
+                            logger.debug("coord %s: tracker summary unavailable", cid)
+                    with obs_span("descent.score", coordinate=cid):
+                        new_score = np.asarray(coord.score(model))
+                    models[cid] = model
+                    scores[cid] = new_score
+                    total = partial + new_score
                 dt = time.perf_counter() - t0
 
                 val_res = None
@@ -142,10 +167,13 @@ class CoordinateDescent:
                 if self.validation is not None:
                     val_data, suite = self.validation
                     current = GameModel(models=dict(models))
-                    val_scores = np.asarray(current.score(val_data)) + np.asarray(val_data.offset)
-                    val_res = suite.evaluate(
-                        val_scores, val_data.y, val_data.weight, group_ids=val_data.id_tags
-                    )
+                    with obs_span("descent.validate", iteration=it,
+                                  coordinate=cid):
+                        val_scores = np.asarray(current.score(val_data)) \
+                            + np.asarray(val_data.offset)
+                        val_res = suite.evaluate(
+                            val_scores, val_data.y, val_data.weight,
+                            group_ids=val_data.id_tags)
                     last_eval = val_res
                     # best-model retention compares FULL models only — after
                     # a complete update sequence, never inside the coordinate

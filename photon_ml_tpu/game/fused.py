@@ -36,6 +36,7 @@ from jax import lax
 
 from photon_ml_tpu.game.coordinate import Coordinate
 from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.types import VarianceComputationType
 
 Array = jax.Array
@@ -219,8 +220,14 @@ class FusedSweep:
         seed for in-program stochastic work (down-sampling); a traced input,
         so varying it reuses the compiled program.  ``carry0``: precomputed
         ``init_carry`` result (overrides ``initial``)."""
-        published, scores, vars_, carried = self.run_device(
-            initial, regs, seed, carry0)
+        # the whole sweep is ONE device program — per-coordinate host spans
+        # can't exist here; device_sync brackets actual execution, so the
+        # fused span is comparable with the host loop's descent.update sum
+        with obs_span("descent.fused_sweep", device_sync=True,
+                      coordinates=len(self.order),
+                      iterations=self.num_iterations):
+            published, scores, vars_, carried = self.run_device(
+                initial, regs, seed, carry0)
         models = {cid: self.coordinates[cid].export_model(np.asarray(published[i]))
                   for i, cid in enumerate(self.order)}
         final_scores = {cid: np.asarray(scores[i])

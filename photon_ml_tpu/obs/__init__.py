@@ -1,0 +1,43 @@
+"""photonscope: unified tracing, metrics, and XLA runtime accounting.
+
+Photon ML reference counterpart: the util/{PhotonLogger,Timed}.scala +
+event/Event.scala trio — text logs, wall-clock phase blocks, and lifecycle
+events, each its own silo.  Here the three become one observability layer
+shared by training AND serving:
+
+  - ``trace``: nestable spans in a fixed-size ring buffer with a Chrome
+    ``trace_event`` exporter (Perfetto-loadable), instant events bridged
+    from ``utils/events``, and opt-in per-span device fences
+    (``device_sync=True``) for device-accurate timings;
+  - ``registry``: one thread-safe ``MetricsRegistry`` — counters, gauges,
+    fixed-bin latency histograms, label support — with Prometheus text
+    exposition and JSON snapshots (``serving.ServingMetrics`` is a facade
+    over it);
+  - ``probe``: ``JaxRuntimeProbe`` counting XLA compiles per call site and
+    host<->device transfer bytes at the chunked-upload path.
+
+Tracing is disabled by default; the module-level ``span()``/``instant()``
+fast paths cost one boolean check when off (``bench.py --obs`` holds the
+guard under 1µs/call).  Enable with ``photon_ml_tpu.obs.enable_tracing()``,
+``cli/serve.py --trace``, or ``cli/train.py --trace-out``.
+"""
+
+from photon_ml_tpu.obs.probe import JaxRuntimeProbe, get_probe  # noqa: F401
+from photon_ml_tpu.obs.registry import (LatencyHistogram,  # noqa: F401
+                                        MetricsRegistry, get_registry,
+                                        series_name, set_registry)
+from photon_ml_tpu.obs.trace import (Tracer, enabled, get_tracer,  # noqa: F401
+                                     instant, set_tracer, span)
+
+
+def enable_tracing(capacity: int = None) -> Tracer:
+    """Turn the default tracer on (optionally resized); returns it."""
+    t = get_tracer()
+    if capacity is not None and capacity != t.capacity:
+        t = Tracer(capacity=capacity, enabled=True)
+        set_tracer(t)
+    return t.enable()
+
+
+def disable_tracing() -> Tracer:
+    return get_tracer().disable()

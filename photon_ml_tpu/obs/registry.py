@@ -1,0 +1,288 @@
+"""Unified metrics registry: counters, gauges, fixed-bin histograms.
+
+Photon ML reference counterpart: none — the reference logs Timed{} phase
+durations as text.  Here every component (training descent, the serving
+stack, the JAX runtime probe) reports into ONE thread-safe registry with
+label support (``requests_total{bucket="64"}``), exported two ways: a JSON
+snapshot (benches, the ``{"cmd": "metrics"}`` wire command) and Prometheus
+text exposition (scrapers).  ``serving.metrics.ServingMetrics`` is a thin
+facade over this registry that preserves its PR-4 ``snapshot()`` wire
+format, so bench history stays comparable.
+
+Series identity: ``(name, sorted(labels.items()))`` — label keyword order
+at the call site never splits a series (``inc("x", a="1", b="2")`` and
+``inc("x", b="2", a="1")`` are the same counter).
+
+Locking: one registry lock around every read-modify-write; histogram
+recording mutates the histogram under the same lock (fixed bins, O(1), no
+allocation), so concurrent scorers, the swap thread, and exporters
+interleave safely.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+Series = Tuple[str, LabelKey]
+
+# Log-spaced histogram bin upper bounds: 1us .. ~67s, factor 2 per bin.
+# Fixed bins (not reservoirs) so concurrent recording is O(1),
+# allocation-free, and snapshots are mergeable across processes.
+_BOUNDS = tuple(1e-6 * (2.0 ** i) for i in range(27))
+
+
+class LatencyHistogram:
+    """Fixed-bin latency histogram with percentile estimates.
+
+    Percentiles interpolate inside the containing bin (log-linear would be
+    marginally better; linear keeps the math obvious and the error is
+    bounded by one 2x bin).
+    """
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(_BOUNDS) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        lo, hi = 0, len(_BOUNDS)
+        while lo < hi:  # first bin whose bound >= seconds
+            mid = (lo + hi) // 2
+            if _BOUNDS[mid] < seconds:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.counts[lo] += 1
+        self.count += 1
+        self.total += seconds
+        self.min = min(self.min, seconds)
+        self.max = max(self.max, seconds)
+
+    def percentile(self, p: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = p * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= target and c > 0:
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else self.max
+                lo = _BOUNDS[i - 1] if i > 0 else 0.0
+                frac = (target - seen) / c
+                return min(lo + frac * (hi - lo), self.max)
+            seen += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": self.total / self.count if self.count else 0.0,
+            "p50_s": self.percentile(0.50),
+            "p99_s": self.percentile(0.99),
+            "min_s": self.min if self.count else 0.0,
+            "max_s": self.max,
+        }
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def series_name(name: str, labels: LabelKey) -> str:
+    """Canonical display form: ``name{a="1",b="2"}`` (sorted labels)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names allow ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    out = [c if (c.isalnum() or c in "_:") else "_" for c in name]
+    if out and out[0].isdigit():
+        out.insert(0, "_")
+    return "".join(out)
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: LabelKey, extra: Tuple[Tuple[str, str], ...] = ()
+                 ) -> str:
+    pairs = tuple(labels) + tuple(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{_prom_name(k)}="{_prom_escape(v)}"'
+                     for k, v in pairs)
+    return f"{{{inner}}}"
+
+
+def _fmt(v: float) -> str:
+    """Exposition value: integers without a trailing .0, floats as repr."""
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge/histogram registry (see module docstring)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Series, float] = {}
+        self._gauges: Dict[Series, float] = {}
+        self._histograms: Dict[Series, LatencyHistogram] = {}
+
+    # -- mutators ----------------------------------------------------------
+    def inc(self, name: str, n: float = 1, **labels) -> None:
+        """Monotonic counter add (ints stay ints for JSON fidelity)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[key] = value
+
+    def add_gauge(self, name: str, delta: float, **labels) -> None:
+        """Accumulating gauge (cumulative phase seconds and kin)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[key] = self._gauges.get(key, 0.0) + delta
+
+    def observe(self, name: str, seconds: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = LatencyHistogram()
+            h.record(seconds)
+
+    # -- reads -------------------------------------------------------------
+    def counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0)
+
+    def gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)))
+
+    def histogram_snapshot(self, name: str, **labels) -> Optional[dict]:
+        with self._lock:
+            h = self._histograms.get((name, _label_key(labels)))
+            return None if h is None else h.snapshot()
+
+    def snapshot_raw_counters(self) -> List[Tuple[Series, float]]:
+        """Every counter series as ``((name, labels), value)`` — the
+        structured form facades (serving.ServingMetrics) rebuild their wire
+        views from."""
+        with self._lock:
+            return list(self._counters.items())
+
+    def counter_series(self, name: str) -> Dict[LabelKey, float]:
+        """Every label combination recorded under one counter family."""
+        with self._lock:
+            return {lk: v for (n, lk), v in self._counters.items()
+                    if n == name}
+
+    def gauge_series(self, name: str) -> Dict[LabelKey, float]:
+        with self._lock:
+            return {lk: v for (n, lk), v in self._gauges.items() if n == name}
+
+    def histogram_series(self, name: str) -> Dict[LabelKey, dict]:
+        with self._lock:  # snapshot inside the lock: no torn count/total
+            return {lk: h.snapshot()
+                    for (n, lk), h in self._histograms.items() if n == name}
+
+    # -- exports -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-ready view: ``{"counters": {series: v}, "gauges": ...,
+        "histograms": {series: {count, mean_s, p50_s, ...}}}`` with series
+        rendered as ``name{label="v"}`` strings, sorted."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = [(k, h.snapshot()) for k, h in self._histograms.items()]
+        return {
+            "counters": {series_name(n, lk): v
+                         for (n, lk), v in sorted(counters.items())},
+            "gauges": {series_name(n, lk): v
+                       for (n, lk), v in sorted(gauges.items())},
+            "histograms": {series_name(n, lk): snap
+                           for (n, lk), snap in sorted(hists,
+                                                       key=lambda e: e[0])},
+        }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4).
+
+        Counters/gauges one sample per series; histograms expose the
+        cumulative ``_bucket{le=...}`` ladder over the fixed bins plus
+        ``_sum``/``_count``, which is exactly what the fixed-bin layout was
+        chosen for (mergeable, O(1) record)."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(((k, list(h.counts), h.total, h.count)
+                            for k, h in self._histograms.items()),
+                           key=lambda e: e[0])
+        lines: List[str] = []
+
+        def _family(items: Iterable, kind: str) -> None:
+            seen = None
+            for (name, labels), value in items:
+                pname = _prom_name(name)
+                if pname != seen:
+                    lines.append(f"# TYPE {pname} {kind}")
+                    seen = pname
+                lines.append(f"{pname}{_prom_labels(labels)} {_fmt(value)}")
+
+        _family(counters, "counter")
+        _family(gauges, "gauge")
+        seen = None
+        for (name, labels), counts, total, count in hists:
+            pname = _prom_name(name)
+            if pname != seen:
+                lines.append(f"# TYPE {pname} histogram")
+                seen = pname
+            cum = 0
+            for bound, c in zip(_BOUNDS, counts):
+                cum += c
+                lines.append(f"{pname}_bucket"
+                             f"{_prom_labels(labels, (('le', repr(bound)),))}"
+                             f" {cum}")
+            lines.append(f"{pname}_bucket"
+                         f"{_prom_labels(labels, (('le', '+Inf'),))} {count}")
+            lines.append(f"{pname}_sum{_prom_labels(labels)} {_fmt(total)}")
+            lines.append(f"{pname}_count{_prom_labels(labels)} {count}")
+        return "\n".join(lines) + "\n"
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json(indent=2))
+
+
+# ---------------------------------------------------------------------------
+# process-default registry
+# ---------------------------------------------------------------------------
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-default registry; returns the previous one."""
+    global _default
+    prev, _default = _default, registry
+    return prev

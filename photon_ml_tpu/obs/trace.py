@@ -1,0 +1,296 @@
+"""Structured tracer: nestable spans into a fixed-size ring buffer.
+
+Photon ML reference counterpart: util/Timed.scala wraps pipeline phases and
+logs wall-clock durations — a flat, text-only timeline.  Production serving
+needs the question Timed cannot answer: *where inside this request's 2ms did
+the time go*, across threads (the async batcher worker, the hot-swap thread,
+the scoring caller) and across layers (submit -> flush -> resolve -> AOT
+execute).  This tracer records **complete spans** (name, start, duration,
+thread, parent span) plus **instant events** (the ``utils/events`` lifecycle
+bridge) into a preallocated ring buffer and exports the Chrome
+``trace_event`` JSON format, so one Perfetto load shows training sweeps and
+serving requests on the same nested timeline.
+
+Concurrency model ("lock-free-ish"): every record claims a slot by bumping
+a cursor under a single lock — the lock protects ONLY the increment — and
+then fills the preallocated slot outside the lock.  Two writers can never
+share a slot; a reader (the exporter) skips slots whose sequence stamp says
+they are mid-write.  Slots are preallocated fixed-arity lists, so steady-
+state tracing allocates nothing but the per-span attrs dict.
+
+Disabled cost: call sites go through the module-level ``span()`` /
+``instant()`` helpers, which check one boolean and return a shared no-op
+context manager — a few ns guard (``bench.py --obs`` holds this under
+1µs/call).  Tracing is OFF by default; ``enable()`` / ``cli`` flags turn it
+on.
+
+Device-accurate timings: wall-clocking a host block around async device
+work measures dispatch, not execution (the gap ``utils/logging.py``
+documents).  ``span(..., device_sync=True)`` runs a device fence at entry
+and exit when tracing is enabled — enqueue a trivial op and block on it, so
+on an in-order accelerator stream the span brackets the actual device work.
+The fence costs a device round-trip, which is why it is per-span opt-in and
+completely absent when tracing is disabled.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# slot layout (preallocated lists; indices, not attributes, for write speed)
+_SEQ = 0      # claim sequence; -1 while the writer is mid-fill
+_NAME = 1
+_PHASE = 2    # "X" complete span | "i" instant
+_TS = 3       # perf_counter_ns at start
+_DUR = 4      # ns
+_TID = 5
+_SPAN = 6     # span id
+_PARENT = 7   # parent span id (0 = root)
+_ATTRS = 8
+_WIDTH = 9
+
+
+def _default_device_fence() -> None:
+    """Enqueue a trivial device op and block on it: on an in-order
+    accelerator stream this drains previously enqueued work, giving span
+    boundaries that bracket device execution instead of dispatch.  Never
+    raises — a host without jax initialized just gets wall clock."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """Active span handle; records the slot on exit."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_sync", "_t0", "_id",
+                 "_parent")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 attrs: Optional[Dict[str, Any]], sync: bool):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+        self._sync = sync
+
+    def __enter__(self) -> "_Span":
+        t = self._tracer
+        stack = t._stack()
+        self._parent = stack[-1] if stack else 0
+        self._id = next(t._ids)
+        stack.append(self._id)
+        if self._sync:
+            t.device_fence()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t = self._tracer
+        if self._sync:
+            t.device_fence()
+        dur = time.perf_counter_ns() - self._t0
+        stack = t._stack()
+        if stack and stack[-1] == self._id:
+            stack.pop()
+        t._record("X", self._name, self._t0, dur, self._id, self._parent,
+                  self._attrs)
+        return False
+
+
+class Tracer:
+    """Fixed-capacity span recorder (see module docstring).
+
+    ``capacity``: ring slots — the newest ``capacity`` records win; older
+    ones are silently overwritten (bounded memory is the contract, not
+    completeness).  ``enabled`` gates every record; flipping it never
+    invalidates outstanding ``_Span`` handles (they record into the ring,
+    which is harmless either way).
+    """
+
+    def __init__(self, capacity: int = 8192, enabled: bool = False):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._slots: List[list] = [[0] * _WIDTH for _ in range(self.capacity)]
+        self._cursor = 0
+        self._cursor_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._fence: Callable[[], None] = _default_device_fence
+
+    # -- per-thread span stack ---------------------------------------------
+    def _stack(self) -> List[int]:
+        s = getattr(self._local, "stack", None)
+        if s is None:
+            s = self._local.stack = []
+        return s
+
+    # -- recording ---------------------------------------------------------
+    def span(self, name: str, device_sync: bool = False, **attrs):
+        """Nestable timed span; a context manager.  ``device_sync=True``
+        fences the device at both edges (see module docstring)."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name, attrs or None, device_sync)
+
+    def instant(self, name: str, **attrs) -> None:
+        """One point-in-time event (Chrome phase "i") at the current
+        nesting level — the ``utils/events`` lifecycle bridge."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        parent = stack[-1] if stack else 0
+        self._record("i", name, time.perf_counter_ns(), 0, next(self._ids),
+                     parent, attrs or None)
+
+    def _record(self, phase: str, name: str, ts: int, dur: int,
+                span_id: int, parent: int,
+                attrs: Optional[Dict[str, Any]]) -> None:
+        if not self.enabled:
+            return
+        with self._cursor_lock:  # held ONLY to claim the slot
+            seq = self._cursor
+            self._cursor = seq + 1
+        slot = self._slots[seq % self.capacity]
+        slot[_SEQ] = -1  # mid-write marker: exporter skips torn slots
+        slot[_NAME] = name
+        slot[_PHASE] = phase
+        slot[_TS] = ts
+        slot[_DUR] = dur
+        slot[_TID] = threading.get_ident()
+        slot[_SPAN] = span_id
+        slot[_PARENT] = parent
+        slot[_ATTRS] = attrs
+        slot[_SEQ] = seq + 1  # valid: seq stamps are 1-based, 0 = empty
+
+    def device_fence(self) -> None:
+        self._fence()
+
+    def set_device_fence(self, fence: Callable[[], None]) -> None:
+        """Override the ``device_sync=True`` fence (tests, exotic
+        backends)."""
+        self._fence = fence
+
+    # -- control -----------------------------------------------------------
+    def enable(self) -> "Tracer":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "Tracer":
+        self.enabled = False
+        return self
+
+    def clear(self) -> None:
+        with self._cursor_lock:
+            self._cursor = 0
+        for slot in self._slots:
+            slot[_SEQ] = 0
+
+    # -- export ------------------------------------------------------------
+    def records(self) -> List[dict]:
+        """Valid ring records, oldest first.  Skips empty and mid-write
+        slots; the window is the last ``capacity`` claims."""
+        with self._cursor_lock:
+            cursor = self._cursor
+        lo = max(0, cursor - self.capacity)
+        out = []
+        for seq in range(lo, cursor):
+            slot = self._slots[seq % self.capacity]
+            snap = list(slot)  # one read; a racing overwrite changes _SEQ
+            if snap[_SEQ] != seq + 1:
+                continue  # empty, torn, or already lapped
+            out.append({
+                "name": snap[_NAME], "ph": snap[_PHASE],
+                "ts_ns": snap[_TS], "dur_ns": snap[_DUR],
+                "tid": snap[_TID], "id": snap[_SPAN],
+                "parent": snap[_PARENT], "attrs": snap[_ATTRS] or {},
+            })
+        return out
+
+    def chrome_trace(self) -> dict:
+        """Chrome ``trace_event`` JSON (load in Perfetto / chrome://tracing).
+
+        Complete spans use phase "X" with microsecond ``ts``/``dur``;
+        instants use phase "i" with thread scope.  Span/parent ids ride in
+        ``args`` so nesting survives tools that re-sort events."""
+        pid = os.getpid()
+        events = []
+        for r in sorted(self.records(), key=lambda r: (r["ts_ns"], r["id"])):
+            ev = {
+                "name": r["name"], "ph": r["ph"], "pid": pid,
+                "tid": r["tid"], "ts": r["ts_ns"] / 1e3,
+                "args": dict(r["attrs"], span_id=r["id"],
+                             parent_id=r["parent"]),
+            }
+            if r["ph"] == "X":
+                ev["dur"] = r["dur_ns"] / 1e3
+            else:
+                ev["s"] = "t"  # thread-scoped instant
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ns"}
+
+    def export_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+
+# ---------------------------------------------------------------------------
+# module-level default tracer: the hot-path entry points
+# ---------------------------------------------------------------------------
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-default tracer; returns the previous one (tests
+    restore it)."""
+    global _default
+    prev, _default = _default, tracer
+    return prev
+
+
+def span(name: str, device_sync: bool = False, **attrs):
+    """``with span("solve", coordinate=cid):`` against the default tracer.
+    Disabled: one boolean check + a shared no-op context manager."""
+    t = _default
+    if not t.enabled:
+        return _NOOP
+    return _Span(t, name, attrs or None, device_sync)
+
+
+def instant(name: str, **attrs) -> None:
+    t = _default
+    if t.enabled:
+        t.instant(name, **attrs)
+
+
+def enabled() -> bool:
+    return _default.enabled

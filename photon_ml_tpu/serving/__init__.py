@@ -16,9 +16,12 @@ package is that serving layer, TPU-native:
     the batch path's score composition (game/scoring.py);
   - ``swap``: atomic hot model reload (load -> warm -> flip) and the
     streaming-delta entry point (``(generation, delta_version)`` identity);
-  - ``metrics``: one thread-safe registry (latency histograms, QPS,
+  - ``metrics``: the serving metrics facade (latency histograms, QPS,
     padding waste + per-bucket occupancy, hot-set hit rate, entity misses,
-    flush mix, swap counters) exported as JSON.
+    flush mix, swap counters) over the unified ``obs.MetricsRegistry`` —
+    JSON snapshot wire format preserved, Prometheus exposition added; the
+    hot paths also emit ``obs`` tracer spans (submit → flush → resolve →
+    execute) when tracing is on.
 
 ``cli/serve.py`` wires these into a stdin/JSON-lines driver and a
 programmatic ``build_server`` entry point.

@@ -28,6 +28,8 @@ from typing import (Callable, Dict, List, Optional, Sequence, Tuple)
 import numpy as np
 
 from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.obs.trace import instant as obs_instant
+from photon_ml_tpu.obs.trace import span as obs_span
 
 
 def pow2_bucket_ladder(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:
@@ -226,6 +228,7 @@ class AsyncBatcher:
     # -- producer side -----------------------------------------------------
     def submit(self, request: Request) -> "Future[float]":
         """Enqueue one request; returns the future its score resolves on."""
+        obs_instant("serve.submit", uid=request.uid)
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -294,19 +297,22 @@ class AsyncBatcher:
                      forced: bool) -> None:
         if not batch:
             return
+        full = len(batch) >= self.flush_threshold
         if self._metrics is not None:
-            full = len(batch) >= self.flush_threshold
             self._metrics.inc("flushes_full" if full else
                               "flushes_forced" if forced else
                               "flushes_deadline")
         live = [(r, f) for r, f in batch if f.set_running_or_notify_cancel()]
         if not live:
             return
-        try:
-            scores = self._score([r for r, _ in live])
-        except Exception as e:  # resolve every waiter, never kill the worker
-            for _, f in live:
-                f.set_exception(e)
-            return
-        for (_, f), s in zip(live, scores):
-            f.set_result(float(s))
+        with obs_span("serve.flush", n=len(live),
+                      reason=("full" if full else
+                              "forced" if forced else "deadline")):
+            try:
+                scores = self._score([r for r, _ in live])
+            except Exception as e:  # resolve waiters, never kill the worker
+                for _, f in live:
+                    f.set_exception(e)
+                return
+            for (_, f), s in zip(live, scores):
+                f.set_result(float(s))

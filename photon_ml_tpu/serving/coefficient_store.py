@@ -56,6 +56,7 @@ from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.data.reader import EntityIndex
 from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
                                        RandomEffectModel)
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.types import TaskType
 
@@ -63,8 +64,8 @@ Array = jax.Array
 
 _generation = itertools.count(1)
 
-# frequencies below this after decay are dropped from the counter map — the
-# long tail of one-hit entities must not grow the map without bound
+# frequencies at or below this after decay are zeroed in the counter table —
+# the long tail of one-hit entities must not keep rows in the ranked set
 _FREQ_FLOOR = 1e-3
 
 
@@ -81,14 +82,20 @@ class StoreConfig:
     applied to every entity hit counter at each rebalance pass (EWMA — 0.5
     halves an idle entity's rank per pass).  ``hot_max_moves``: cap on
     promotions per coordinate per pass (None = unlimited) so one pass never
-    stalls the scoring threads behind a giant scatter.  ``x_dtype``:
-    request feature dtype (float32, matching data/reader's default design
-    dtype — part of the bitwise-parity contract with batch scoring)."""
+    stalls the scoring threads behind a giant scatter.
+    ``hot_tracked_max``: cap on entities carrying a nonzero hit counter
+    between passes (None = unlimited) — at each rebalance the counter table
+    is pruned to the top ``hot_tracked_max`` by an ``argpartition`` pass,
+    bounding the ranked candidate set at millions of entities.
+    ``x_dtype``: request feature dtype (float32, matching data/reader's
+    default design dtype — part of the bitwise-parity contract with batch
+    scoring)."""
 
     device_capacity: Optional[int] = None
     lru_capacity: int = 4096
     hot_decay: float = 0.5
     hot_max_moves: Optional[int] = None
+    hot_tracked_max: Optional[int] = None
     x_dtype: np.dtype = np.float32
 
 
@@ -165,10 +172,14 @@ class RandomCoordinate:
     analog); the device table holds the ``hot_capacity`` rows serving
     residency currently favors.  Residency starts as the first
     ``hot_capacity`` training slots and is re-ranked by ``rebalance()``
-    from the EWMA hit counters ``record_hits`` accumulates.  All mutation
-    — counters, promotion/demotion, streaming deltas — happens under
-    ``self._lock``; readers take the ``hot`` snapshot once and are
-    consistent without locking.
+    from the EWMA hit counters ``record_hits`` accumulates.  The counters
+    live in an ARRAY-BACKED table (``_freq[eid]``), so the hot-path fold is
+    one vectorized scatter-add and the ranking pass is numpy
+    (``lexsort``/``argpartition``) instead of a Python ``sorted`` over a
+    dict — the GIL-bound pass the ROADMAP flagged at millions of tracked
+    entities.  All mutation — counters, promotion/demotion, streaming
+    deltas — happens under ``self._lock``; readers take the ``hot``
+    snapshot once and are consistent without locking.
     """
 
     def __init__(self, cid: str, feature_shard: str, random_effect_type: str,
@@ -176,7 +187,8 @@ class RandomCoordinate:
                  hot_capacity: int, lru_capacity: int,
                  metrics: Optional[ServingMetrics] = None,
                  decay: float = 0.5,
-                 max_moves: Optional[int] = None):
+                 max_moves: Optional[int] = None,
+                 tracked_max: Optional[int] = None):
         self.cid = cid
         self.feature_shard = feature_shard
         self.random_effect_type = random_effect_type
@@ -186,8 +198,16 @@ class RandomCoordinate:
         self.hot_capacity = int(hot_capacity)
         self.decay = float(decay)
         self.max_moves = max_moves
+        self.tracked_max = tracked_max
         self._lock = threading.Lock()
-        self._freq: Dict[int, float] = {}
+        # array-backed frequency table + eid -> archive row as an array
+        # (-1 = not this coordinate's entity); indexed by the dense entity
+        # ids the EntityIndex hands out
+        n_ids = (max(archive_slot_of) + 1) if archive_slot_of else 0
+        self._slot_arr = np.full(n_ids, -1, np.int64)
+        for eid, slot in archive_slot_of.items():
+            self._slot_arr[eid] = slot
+        self._freq = np.zeros(n_ids, np.float64)
         if self.hot_capacity < 1:
             # score_samples clamps missing slots to row 0, which must exist
             # to gather from — an all-cold coordinate serves a zero row
@@ -220,20 +240,49 @@ class RandomCoordinate:
 
     # -- frequency tracking ------------------------------------------------
     def record_hits(self, counts: Dict[int, int]) -> None:
-        """Fold one batch's per-entity hit counts into the EWMA counters."""
+        """Fold one batch's per-entity hit counts into the EWMA counters —
+        one vectorized scatter-add into the counter table.  Ids without an
+        archive row (known to the entity index but never trained on this
+        coordinate) are dropped: they can never be promoted."""
         if not counts:
             return
+        eids = np.fromiter(counts.keys(), np.int64, len(counts))
+        vals = np.fromiter(counts.values(), np.float64, len(counts))
+        ok = (eids >= 0) & (eids < self._slot_arr.shape[0])
+        eids, vals = eids[ok], vals[ok]
+        ok = self._slot_arr[eids] >= 0
+        eids, vals = eids[ok], vals[ok]
+        if eids.size == 0:
+            return
         with self._lock:
-            for eid, k in counts.items():
-                self._freq[eid] = self._freq.get(eid, 0.0) + k
+            self._freq[eids] += vals  # dict keys are unique: no add.at needed
 
     def frequency(self, eid: int) -> float:
         with self._lock:
-            return self._freq.get(eid, 0.0)
+            if 0 <= eid < self._freq.shape[0]:
+                return float(self._freq[eid])
+            return 0.0
+
+    def _decay_and_prune(self) -> None:
+        """EWMA decay + tracked-set bound; caller holds ``self._lock``.
+
+        Counters at/below the floor zero out (the one-hit long tail);
+        ``tracked_max`` prunes the survivors to the top-k by one
+        ``argpartition`` pass, so the between-pass state and the next
+        ranking are both bounded regardless of how many entities traffic
+        touched."""
+        f = self._freq
+        f *= self.decay
+        f[f <= _FREQ_FLOOR] = 0.0
+        if self.tracked_max is not None:
+            nnz = int(np.count_nonzero(f))
+            if nnz > self.tracked_max:
+                drop = np.argpartition(-f, self.tracked_max)[self.tracked_max:]
+                f[drop] = 0.0
 
     # -- promotion / demotion ----------------------------------------------
     def rebalance(self) -> Tuple[int, int]:
-        """One frequency-ranked promotion/demotion pass.
+        """One frequency-ranked promotion/demotion pass, ranked in numpy.
 
         Decays every hit counter by ``decay`` (EWMA), ranks all entities
         with recorded traffic plus the incumbents by frequency (incumbents
@@ -241,39 +290,43 @@ class RandomCoordinate:
         so a fixed request trace yields a reproducible hot set), then
         scatters the promoted rows into the device rows the demoted ones
         vacate — ONE ``.at[rows].set`` launch, table shape unchanged.
-        Returns (promotions, demotions); they are always equal.
+        The ranking is a ``lexsort`` over the candidate arrays (traffic ∪
+        incumbents — bounded by ``tracked_max`` + capacity), not a Python
+        sort over every tracked entity.  Returns (promotions, demotions);
+        they are always equal.
         """
         if self.hot_capacity < 1 or self.hot_capacity >= self.num_entities:
             with self._lock:  # keep counters EWMA even when residency is fixed
-                self._freq = {e: f * self.decay
-                              for e, f in self._freq.items()
-                              if f * self.decay > _FREQ_FLOOR}
+                self._decay_and_prune()
             return 0, 0
-        with self._lock:
-            self._freq = {e: f * self.decay for e, f in self._freq.items()
-                          if f * self.decay > _FREQ_FLOOR}
+        with obs_span("store.rebalance", coordinate=self.cid), self._lock:
+            self._decay_and_prune()
             freq = self._freq
             current = self._hot.slot_of
-            ranked = sorted(
-                set(freq) | set(current),
-                key=lambda e: (-freq.get(e, 0.0),
-                               0 if e in current else 1,
-                               self.archive_slot_of[e]))
-            desired = set(ranked[: self.hot_capacity])
-            promote = [e for e in ranked[: self.hot_capacity]
-                       if e not in current]
-            if not promote:
+            cur = np.fromiter(current.keys(), np.int64, len(current))
+            cand = np.union1d(np.nonzero(freq)[0].astype(np.int64), cur)
+            f = freq[cand]
+            incumbent = np.isin(cand, cur, assume_unique=True)
+            slots = self._slot_arr[cand]
+            # lexsort: last key is primary — (-freq, incumbent-first, slot),
+            # the SAME composite key the dict-era sorted() used, so hot sets
+            # stay reproducible for a fixed trace
+            ranked = cand[np.lexsort((slots, np.where(incumbent, 0, 1), -f))]
+            desired = ranked[: self.hot_capacity]
+            promote = desired[~np.isin(desired, cur, assume_unique=True)]
+            if promote.size == 0:
                 return 0, 0
             # coldest incumbents vacate first; deterministic tiebreak again
-            demote = sorted((e for e in current if e not in desired),
-                            key=lambda e: (freq.get(e, 0.0),
-                                           -self.archive_slot_of[e]))
+            # (freq ascending, then archive slot DEscending)
+            dem = cur[~np.isin(cur, desired, assume_unique=True)]
+            demote = dem[np.lexsort((-self._slot_arr[dem], freq[dem]))]
             if self.max_moves is not None:
                 promote = promote[: self.max_moves]
-                demote = demote[: len(promote)]
+                demote = demote[: promote.size]
+            promote = [int(e) for e in promote]
+            demote = [int(e) for e in demote]
             rows = [current[e] for e in demote]
-            new_rows = np.stack([self._archive[self.archive_slot_of[e]]
-                                 for e in promote])
+            new_rows = self._archive[self._slot_arr[promote]]
             table = self._hot.table.at[jnp.asarray(rows, jnp.int32)].set(
                 jnp.asarray(new_rows))
             slot_of = dict(current)
@@ -393,7 +446,8 @@ class CoefficientStore:
                     lru_capacity=config.lru_capacity,
                     metrics=metrics,
                     decay=config.hot_decay,
-                    max_moves=config.hot_max_moves)
+                    max_moves=config.hot_max_moves,
+                    tracked_max=config.hot_tracked_max)
             else:
                 raise ValueError(
                     f"coordinate {cid!r}: serving supports FixedEffectModel "
@@ -466,34 +520,35 @@ class CoefficientStore:
         c = self.coordinates[cid]
         n_real = len(entity_names)
         n_rows = n_real if n_rows is None else n_rows
-        hs = c.hot
-        slots = np.full(n_rows, -1, np.int32)
-        overflow = np.zeros((n_rows, c.dim), hs.table.dtype)
-        misses = hot_hits = 0
-        hits: Dict[int, int] = {}
-        for i, name in enumerate(entity_names):
-            eid = self.entity_id(c.random_effect_type, name)
-            if eid < 0:
-                misses += 1
-                continue
-            hits[eid] = hits.get(eid, 0) + 1
-            slot = hs.slot_of.get(eid)
-            if slot is not None:
-                slots[i] = slot
-                hot_hits += 1
-                continue
-            row = c.cold.get(eid)
-            if row is None:
-                misses += 1
-            else:
-                overflow[i] = row
-        c.record_hits(hits)
-        if metrics is not None:
-            if misses:
-                metrics.inc("entity_misses", misses)
-            if hot_hits:
-                metrics.inc("hot_hits", hot_hits)
-        return hs.table, slots, overflow
+        with obs_span("store.resolve", coordinate=cid, rows=n_real):
+            hs = c.hot
+            slots = np.full(n_rows, -1, np.int32)
+            overflow = np.zeros((n_rows, c.dim), hs.table.dtype)
+            misses = hot_hits = 0
+            hits: Dict[int, int] = {}
+            for i, name in enumerate(entity_names):
+                eid = self.entity_id(c.random_effect_type, name)
+                if eid < 0:
+                    misses += 1
+                    continue
+                hits[eid] = hits.get(eid, 0) + 1
+                slot = hs.slot_of.get(eid)
+                if slot is not None:
+                    slots[i] = slot
+                    hot_hits += 1
+                    continue
+                row = c.cold.get(eid)
+                if row is None:
+                    misses += 1
+                else:
+                    overflow[i] = row
+            c.record_hits(hits)
+            if metrics is not None:
+                if misses:
+                    metrics.inc("entity_misses", misses)
+                if hot_hits:
+                    metrics.inc("hot_hits", hot_hits)
+            return hs.table, slots, overflow
 
     # -- residency management ----------------------------------------------
     def rebalance(self) -> Dict[str, Tuple[int, int]]:
@@ -533,7 +588,8 @@ class CoefficientStore:
         eid = self.entity_id(c.random_effect_type, entity)
         if eid < 0:
             return False
-        ok = c.apply_delta(eid, row)
+        with obs_span("store.apply_delta", coordinate=cid):
+            ok = c.apply_delta(eid, row)
         if ok and self.metrics is not None:
             self.metrics.inc("delta_updates")
         return ok

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.game.scoring import additive_total, output_scores
+from photon_ml_tpu.obs import get_probe
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.parallel.bucketing import score_samples
 from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,
                                            Request, densify_features)
@@ -75,14 +77,15 @@ class ScoringEngine:
     def activate(self, store: CoefficientStore) -> CoefficientStore:
         """Atomically flip the serving generation; returns the old store.
         In-flight requests snapshotted the old store and finish on it."""
-        with self._lock:
-            old, self._store = self._store, store
-            # executables for generations other than (old, new) can never be
-            # reached again — drop them so repeated swaps stay bounded
-            keep = {old.signature(), store.signature()}
-            self._executables = {k: v for k, v in self._executables.items()
-                                 if k[0] in keep}
-        self.metrics.inc("activations")
+        with obs_span("serve.activate", generation=store.generation):
+            with self._lock:
+                old, self._store = self._store, store
+                # executables for generations other than (old, new) can never
+                # be reached again — drop them so repeated swaps stay bounded
+                keep = {old.signature(), store.signature()}
+                self._executables = {k: v for k, v in self._executables.items()
+                                     if k[0] in keep}
+            self.metrics.inc("activations")
         return old
 
     # -- compilation -------------------------------------------------------
@@ -155,9 +158,13 @@ class ScoringEngine:
         # across requests and must NOT be donated.  CPU has no donation
         # support (it would only warn), so gate on backend.
         donate = (0, 3, 4) if jax.default_backend() != "cpu" else ()
-        jitted = jax.jit(fn, donate_argnums=donate)
-        lowered = jitted.lower(*self._abstract_args(store, bucket))
-        exe = lowered.compile()
+        # probe accounting: every AOT compile is counted + timed under the
+        # "serving.engine" site, so "did serving recompile after warm" is a
+        # registry query that must agree with compile_count
+        with get_probe().compile_span("serving.engine", bucket=bucket):
+            jitted = jax.jit(fn, donate_argnums=donate)
+            lowered = jitted.lower(*self._abstract_args(store, bucket))
+            exe = lowered.compile()
         with self._lock:
             self._executables[key] = exe
             self.compile_count += 1
@@ -179,7 +186,9 @@ class ScoringEngine:
         for mb in self.batcher.plan(n):
             t0 = time.perf_counter()
             chunk = requests[mb.start:mb.stop]
-            scores = self._score_chunk(store, chunk, mb.bucket)
+            with obs_span("serve.execute", bucket=mb.bucket,
+                          rows=mb.real_rows):
+                scores = self._score_chunk(store, chunk, mb.bucket)
             if out is None:
                 out = np.empty(n, scores.dtype)
             out[mb.start:mb.stop] = scores[: mb.real_rows]

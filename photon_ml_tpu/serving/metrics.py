@@ -1,199 +1,157 @@
-"""Serving metrics registry: latency histograms, QPS, padding waste, swaps.
+"""Serving metrics: a thin facade over the unified ``obs.MetricsRegistry``.
 
 Photon ML reference counterpart: the Spark batch scorer has no online
 metrics surface; the closest analogs are the reference's Timed{} phase logs
 (util/Timed.scala) and the PalDB store's hit accounting that LinkedIn's
-serving stack layers on top of the published GLMix artifacts.  Here the
-registry is first-class: every serving component (coefficient store,
-batcher, engine, hot swap) reports into ONE thread-safe object exported as
-JSON, and phase timings flow in through ``utils/logging.Timed``'s ``sink``
-hook so the serving path and the offline drivers share one timing idiom.
+serving stack layers on top of the published GLMix artifacts.
 
-Metric families:
-  - counters: requests, batches, scored samples, entity misses (unknown
-    entity -> score 0), hot-set hits / cold fetches / LRU hits (residency
-    tiers), hot promotions/demotions + rebalances, streaming delta updates,
-    compiles, swaps / swap failures, and the async batcher's flush mix
-    (flushes_full / flushes_deadline / flushes_forced);
-  - per-bucket latency histograms (log-spaced bins, p50/p99/max) keyed by
-    padded bucket size, plus padded-row accounting for the padding-waste
-    ratio (padded rows / total padded capacity) and per-bucket occupancy
-    (real rows / launched capacity at that bucket size);
-  - derived gauges in the snapshot: ``hot_set_hit_rate`` (device-resident
-    lookups / all known-entity lookups) and ``entity_miss_rate`` (unknown
-    entities / all lookups) — the two numbers the frequency-ranked hot set
-    exists to move;
-  - phase durations (warm, swap) via the Timed sink.
+Since the photonscope PR, storage lives in ONE ``obs.MetricsRegistry``
+(label-aware counters/gauges/histograms with Prometheus + JSON exporters)
+shared by every serving component — this class only maps the serving
+domain onto registry families and REPRODUCES the PR-4 ``snapshot()`` wire
+format byte-for-byte (key set and semantics), so BENCH_SERVING history
+stays comparable across PRs.  ``LatencyHistogram`` is re-exported from
+``obs.registry`` for the same compatibility reason.
+
+Registry mapping:
+  - plain counters (``requests``, ``hot_hits``, ``swaps``, the flush mix,
+    ...) keep their names as unlabeled registry counters;
+  - per-bucket latency -> histogram family ``serving_latency_s`` labeled
+    ``key="bucket_<n>"`` (plus free-form ``observe_latency`` keys);
+  - padding/occupancy accounting -> reserved ``serving_*`` counters
+    (``serving_padded_rows``/``serving_real_rows`` unlabeled;
+    ``serving_bucket_rows_{real,capacity}`` labeled by bucket) excluded
+    from the snapshot's ``counters`` view;
+  - per-batch bucket-size counters -> ``serving_batches_total{bucket=..}``
+    (the ``requests_total{bucket="64"}``-style series scrapers want);
+  - ``Timed`` phase sinks -> accumulating gauge
+    ``serving_phase_seconds{phase=...}``.
 """
 
 from __future__ import annotations
 
 import json
-import threading
 import time
-from typing import Dict, Optional
+from typing import Optional
 
-# Log-spaced latency bin upper bounds: 1us .. ~67s, factor 2 per bin.  Fixed
-# bins (not reservoirs) so concurrent recording is O(1), allocation-free,
-# and snapshots are mergeable across processes.
-_BOUNDS = tuple(1e-6 * (2.0 ** i) for i in range(27))
+from photon_ml_tpu.obs.registry import (LatencyHistogram,  # noqa: F401
+                                        MetricsRegistry)
 
-
-class LatencyHistogram:
-    """Fixed-bin latency histogram with percentile estimates.
-
-    Percentiles interpolate inside the containing bin (log-linear would be
-    marginally better; linear keeps the math obvious and the error is
-    bounded by one 2x bin).
-    """
-
-    def __init__(self) -> None:
-        self.counts = [0] * (len(_BOUNDS) + 1)
-        self.count = 0
-        self.total = 0.0
-        self.min = float("inf")
-        self.max = 0.0
-
-    def record(self, seconds: float) -> None:
-        lo, hi = 0, len(_BOUNDS)
-        while lo < hi:  # first bin whose bound >= seconds
-            mid = (lo + hi) // 2
-            if _BOUNDS[mid] < seconds:
-                lo = mid + 1
-            else:
-                hi = mid
-        self.counts[lo] += 1
-        self.count += 1
-        self.total += seconds
-        self.min = min(self.min, seconds)
-        self.max = max(self.max, seconds)
-
-    def percentile(self, p: float) -> float:
-        if self.count == 0:
-            return 0.0
-        target = p * self.count
-        seen = 0
-        for i, c in enumerate(self.counts):
-            if seen + c >= target and c > 0:
-                hi = _BOUNDS[i] if i < len(_BOUNDS) else self.max
-                lo = _BOUNDS[i - 1] if i > 0 else 0.0
-                frac = (target - seen) / c
-                return min(lo + frac * (hi - lo), self.max)
-            seen += c
-        return self.max
-
-    def snapshot(self) -> dict:
-        return {
-            "count": self.count,
-            "mean_s": self.total / self.count if self.count else 0.0,
-            "p50_s": self.percentile(0.50),
-            "p99_s": self.percentile(0.99),
-            "min_s": self.min if self.count else 0.0,
-            "max_s": self.max,
-        }
+# registry families owned by the facade's padding/occupancy bookkeeping —
+# internal storage, not part of the snapshot's "counters" wire view
+_PADDED = "serving_padded_rows"
+_REAL = "serving_real_rows"
+_BUCKET_REAL = "serving_bucket_rows_real"
+_BUCKET_CAP = "serving_bucket_rows_capacity"
+_BATCHES_BY_BUCKET = "serving_batches_total"
+_LATENCY = "serving_latency_s"
+_PHASE = "serving_phase_seconds"
+_RESERVED = {_PADDED, _REAL}
 
 
 class ServingMetrics:
-    """Thread-safe registry shared by every serving component.
+    """Thread-safe serving metrics registry (facade; see module docstring).
 
-    All mutators take the one lock — serving requests, the background swap
-    thread, and metrics exports may interleave freely.
+    All mutation delegates to the one registry lock — serving requests, the
+    background swap thread, and metrics exports may interleave freely.
     """
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._latency: Dict[str, LatencyHistogram] = {}
-        self._phases: Dict[str, float] = {}
-        self._padded_capacity = 0  # sum of bucket sizes actually launched
-        self._real_rows = 0        # real (unpadded) rows inside them
-        # per-bucket occupancy accounting: bucket size -> [real, capacity]
-        self._bucket_rows: Dict[int, list] = {}
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
         self._started = time.time()
 
     # -- mutators ----------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+        self.registry.inc(name, n)
 
     def observe_latency(self, key: str, seconds: float) -> None:
-        with self._lock:
-            h = self._latency.get(key)
-            if h is None:
-                h = self._latency[key] = LatencyHistogram()
-            h.record(seconds)
+        self.registry.observe(_LATENCY, seconds, key=key)
 
     def observe_batch(self, bucket: int, real_rows: int, seconds: float) -> None:
         """One launched micro-batch: ``bucket`` padded rows, ``real_rows``
         live ones, per-request latency credited to every live row."""
-        with self._lock:
-            self._counters["batches"] = self._counters.get("batches", 0) + 1
-            self._counters["scored_samples"] = (
-                self._counters.get("scored_samples", 0) + real_rows)
-            self._padded_capacity += bucket
-            self._real_rows += real_rows
-            occ = self._bucket_rows.get(bucket)
-            if occ is None:
-                occ = self._bucket_rows[bucket] = [0, 0]
-            occ[0] += real_rows
-            occ[1] += bucket
-            key = f"bucket_{bucket}"
-            h = self._latency.get(key)
-            if h is None:
-                h = self._latency[key] = LatencyHistogram()
-            h.record(seconds)
+        r = self.registry
+        r.inc("batches")
+        r.inc("scored_samples", real_rows)
+        r.inc(_PADDED, bucket)
+        r.inc(_REAL, real_rows)
+        r.inc(_BUCKET_REAL, real_rows, bucket=bucket)
+        r.inc(_BUCKET_CAP, bucket, bucket=bucket)
+        r.inc(_BATCHES_BY_BUCKET, 1, bucket=bucket)
+        r.observe(_LATENCY, seconds, key=f"bucket_{bucket}")
 
     def phase(self, label: str, seconds: float) -> None:
         """``utils/logging.Timed`` sink: cumulative wall time per phase."""
-        with self._lock:
-            self._phases[label] = self._phases.get(label, 0.0) + seconds
+        self.registry.add_gauge(_PHASE, seconds, phase=label)
 
     # -- views -------------------------------------------------------------
     def counter(self, name: str) -> int:
-        with self._lock:
-            return self._counters.get(name, 0)
+        return int(self.registry.counter(name))
+
+    def _plain_counters(self) -> dict:
+        """The PR-4 ``counters`` view: every unlabeled, non-reserved
+        counter (exactly what ``inc``/``observe_batch`` wrote)."""
+        out = {}
+        for (name, labels), v in self.registry.snapshot_raw_counters():
+            if not labels and name not in _RESERVED:
+                out[name] = v
+        return out
 
     @property
     def padding_waste_ratio(self) -> float:
         """Fraction of launched device rows that were padding."""
-        with self._lock:
-            if self._padded_capacity == 0:
-                return 0.0
-            return 1.0 - self._real_rows / self._padded_capacity
+        padded = self.registry.counter(_PADDED)
+        if padded == 0:
+            return 0.0
+        return 1.0 - self.registry.counter(_REAL) / padded
 
     def snapshot(self) -> dict:
-        with self._lock:
-            uptime = max(time.time() - self._started, 1e-9)
-            requests = self._counters.get("requests", 0)
-            waste = (1.0 - self._real_rows / self._padded_capacity
-                     if self._padded_capacity else 0.0)
-            # residency gauges: lookups = every real (non-padding) entity
-            # lookup; hot = served straight from the device table
-            hot = self._counters.get("hot_hits", 0)
-            lookups = (hot + self._counters.get("lru_hits", 0)
-                       + self._counters.get("cold_fetches", 0)
-                       + self._counters.get("entity_misses", 0))
-            return {
-                "counters": dict(self._counters),
-                "qps": requests / uptime,
-                "uptime_s": uptime,
-                "padding_waste_ratio": waste,
-                "padded_rows_launched": self._padded_capacity,
-                "real_rows_launched": self._real_rows,
-                "bucket_occupancy": {
-                    f"bucket_{b}": (rows[0] / rows[1] if rows[1] else 0.0)
-                    for b, rows in sorted(self._bucket_rows.items())},
-                "hot_set_hit_rate": hot / lookups if lookups else 0.0,
-                "entity_miss_rate": (
-                    self._counters.get("entity_misses", 0) / lookups
-                    if lookups else 0.0),
-                "latency": {k: h.snapshot()
-                            for k, h in sorted(self._latency.items())},
-                "phases_s": dict(self._phases),
-            }
+        r = self.registry
+        uptime = max(time.time() - self._started, 1e-9)
+        counters = self._plain_counters()
+        requests = counters.get("requests", 0)
+        padded = r.counter(_PADDED)
+        real = r.counter(_REAL)
+        waste = 1.0 - real / padded if padded else 0.0
+        # residency gauges: lookups = every real (non-padding) entity
+        # lookup; hot = served straight from the device table
+        hot = counters.get("hot_hits", 0)
+        lookups = (hot + counters.get("lru_hits", 0)
+                   + counters.get("cold_fetches", 0)
+                   + counters.get("entity_misses", 0))
+        occupancy = {}
+        caps = r.counter_series(_BUCKET_CAP)
+        reals = r.counter_series(_BUCKET_REAL)
+        for lk, cap in sorted(caps.items(),
+                              key=lambda e: int(dict(e[0])["bucket"])):
+            b = dict(lk)["bucket"]
+            occupancy[f"bucket_{b}"] = reals.get(lk, 0) / cap if cap else 0.0
+        latency = {dict(lk).get("key", ""): snap
+                   for lk, snap in r.histogram_series(_LATENCY).items()}
+        phases = {dict(lk).get("phase", ""): v
+                  for lk, v in r.gauge_series(_PHASE).items()}
+        return {
+            "counters": counters,
+            "qps": requests / uptime,
+            "uptime_s": uptime,
+            "padding_waste_ratio": waste,
+            "padded_rows_launched": padded,
+            "real_rows_launched": real,
+            "bucket_occupancy": occupancy,
+            "hot_set_hit_rate": hot / lookups if lookups else 0.0,
+            "entity_miss_rate": (counters.get("entity_misses", 0) / lookups
+                                 if lookups else 0.0),
+            "latency": {k: latency[k] for k in sorted(latency)},
+            "phases_s": phases,
+        }
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the backing registry (every
+        serving family, labels included)."""
+        return self.registry.to_prometheus()
 
     def export(self, path: str) -> None:
         with open(path, "w") as f:

@@ -41,6 +41,7 @@ import logging
 import threading
 from typing import Optional, Sequence
 
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.coefficient_store import CoefficientStore
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.storage.model_io import ModelLoadError, load_model_bundle
@@ -66,7 +67,7 @@ class HotSwapper:
         """Returns True when the new version is serving; False when the new
         directory was rejected (the old version keeps serving untouched)."""
         metrics = self.engine.metrics
-        with self._swap_lock:
+        with obs_span("serve.swap", model_dir=model_dir), self._swap_lock:
             old = self.engine.store
             try:
                 with Timed(f"serving.swap.load {model_dir}", logger,

@@ -64,9 +64,17 @@ def _default_dir() -> str:
 
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     """Turn on jax's persistent compilation cache; returns the dir (or None
-    when disabled).  Safe to call multiple times / after jax is initialized."""
+    when disabled).  Safe to call multiple times / after jax is initialized.
+
+    Cache residency reports into the observability registry (gauge
+    ``xla_compile_cache_enabled`` + a trace instant): a silently-disabled
+    cache means every process pays full first-compiles, which must be
+    visible next to the ``jax_compiles_total`` counters it inflates."""
+    from photon_ml_tpu.obs import get_probe
+
     env = os.environ.get("PHOTON_COMPILE_CACHE")
     if env == "0":
+        get_probe().record_compile_cache(False)
         return None
     cache_dir = cache_dir or env or _default_dir()
     try:
@@ -76,7 +84,9 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache everything that took noticeable compile time
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        get_probe().record_compile_cache(True, cache_dir)
         return cache_dir
     except Exception as e:  # never let cache setup break a run
         logger.warning("compilation cache unavailable: %s", e)
+        get_probe().record_compile_cache(False)
         return None

@@ -6,6 +6,11 @@ phase transitions, metric reports) to listeners registered by class name via
 reflection.  Here listeners register as callables or ``EventListener``
 subclasses; name-based registration resolves ``module:Class`` strings so CLI
 flags can wire listeners the way the reference's reflection path did.
+
+Tracer bridge: emitted events also land on the shared observability
+timeline as instant events (``obs.instant``), so lifecycle listeners and
+the trace see ONE sequence of ticks — disabled tracing costs one boolean
+check per emit; ``EventEmitter(trace=False)`` opts a noisy emitter out.
 """
 
 from __future__ import annotations
@@ -14,6 +19,8 @@ import dataclasses
 import importlib
 import time
 from typing import Any, Callable, Dict, List, Union
+
+from photon_ml_tpu.obs import trace as _trace
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,8 +58,9 @@ class EventEmitter:
     classes by reflected name, Driver.scala:95-104).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, trace: bool = True) -> None:
         self._listeners: List[EventListener] = []
+        self._trace = trace
 
     def register(self, listener: Union[EventListener, Callable[[Event], None], str]) -> EventListener:
         if isinstance(listener, str):
@@ -69,6 +77,10 @@ class EventEmitter:
 
     def emit(self, name: str, **payload: Any) -> Event:
         event = Event(name=name, payload=payload)
+        if self._trace:
+            # lifecycle ticks share the span timeline (instant events);
+            # payloads ride as args, stringified only at export time
+            _trace.instant(name, **payload)
         for listener in self._listeners:
             listener.on_event(event)
         return event

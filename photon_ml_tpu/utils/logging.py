@@ -4,10 +4,12 @@ Reference: util/PhotonLogger.scala (slf4j logger writing a job log file
 alongside the job outputs, with level control) and util/Timed.scala:25-77
 (``Timed { ... }`` blocks wrapping every pipeline phase, logging durations).
 
-TPU-native notes: timings around device work call ``block_until_ready`` on
-nothing — callers that want device-accurate timings must pass already-realized
-outputs; ``Timed`` measures wall clock of the enclosed host block, which is
-what the reference measures too.
+TPU-native notes: ``Timed`` measures wall clock of the enclosed host block,
+which is what the reference measures too; device-accurate timings come from
+the tracer's opt-in per-span fences (``obs.span(..., device_sync=True)``).
+Every ``Timed`` block also runs as a tracer span (one timing path, two
+sinks: the log line and the shared timeline) — when tracing is disabled the
+hook is a single boolean check.
 """
 
 from __future__ import annotations
@@ -18,6 +20,8 @@ import logging
 import os
 import time
 from typing import Callable, Iterator, Optional
+
+from photon_ml_tpu.obs import trace as _trace
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
 
@@ -84,16 +88,21 @@ class PhotonLogger:
 @contextlib.contextmanager
 def Timed(label: str, logger: Optional[logging.Logger] = None,
           sink: Optional[Callable[[str, float], None]] = None) -> Iterator[None]:
-    """``with Timed("phase"):`` — log the phase duration (Timed.scala:25-77)."""
+    """``with Timed("phase"):`` — log the phase duration (Timed.scala:25-77).
+
+    The block is also a tracer span, so ``Timed`` phases land on the same
+    nested timeline as the serving/descent spans instead of keeping a
+    parallel timing path."""
     log = logger or logging.getLogger("photon_ml_tpu.timed")
     start = time.perf_counter()
-    try:
-        yield
-    finally:
-        seconds = time.perf_counter() - start
-        log.info("%s: %.3fs", label, seconds)
-        if sink is not None:
-            sink(label, seconds)
+    with _trace.span(label):
+        try:
+            yield
+        finally:
+            seconds = time.perf_counter() - start
+            log.info("%s: %.3fs", label, seconds)
+            if sink is not None:
+                sink(label, seconds)
 
 
 def timed(label: Optional[str] = None, logger: Optional[logging.Logger] = None):

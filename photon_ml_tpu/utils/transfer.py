@@ -34,6 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from photon_ml_tpu.obs import get_probe
+from photon_ml_tpu.obs import trace as _trace
+
 _LOG = logging.getLogger("photon_ml_tpu.transfer")
 
 
@@ -85,22 +88,28 @@ def chunked_device_put(arr: np.ndarray, dtype=None,
     # and leading-axis-only chunking would silently fall back to the one
     # giant RPC this helper exists to prevent
     axis = int(np.argmax(arr.shape)) if arr.ndim else 0
+    probe = get_probe()
     if min_bytes <= 0 or arr.nbytes <= min_bytes or arr.ndim == 0 or \
             arr.shape[axis] <= 1:
+        probe.record_transfer(arr.nbytes, "h2d", site="direct_put")
         return jnp.asarray(arr)
     row_bytes = max(1, arr.nbytes // arr.shape[axis])
     rows = max(1, chunk_bytes // row_bytes)
     t0 = time.perf_counter()
-    out = jnp.zeros(arr.shape, arr.dtype)
-    n_chunks = 0
-    for lo in range(0, arr.shape[axis], rows):
-        sel = tuple(slice(lo, lo + rows) if a == axis else slice(None)
-                    for a in range(arr.ndim))
-        part = jnp.asarray(arr[sel])
-        part.block_until_ready()
-        out = _update_at(out, part, lo, axis)
-        n_chunks += 1
-    out.block_until_ready()
+    with _trace.span("transfer.chunked_put", bytes=int(arr.nbytes)):
+        out = jnp.zeros(arr.shape, arr.dtype)
+        n_chunks = 0
+        for lo in range(0, arr.shape[axis], rows):
+            sel = tuple(slice(lo, lo + rows) if a == axis else slice(None)
+                        for a in range(arr.ndim))
+            part = jnp.asarray(arr[sel])
+            part.block_until_ready()
+            # per-chunk accounting: a mid-transfer stall shows up as byte
+            # counters that stopped growing, not an opaque hang
+            probe.record_transfer(part.nbytes, "h2d", site="chunked_put")
+            out = _update_at(out, part, lo, axis)
+            n_chunks += 1
+        out.block_until_ready()
     dt = time.perf_counter() - t0
     _LOG.info("chunked_device_put: %.1fMB in %d chunks, %.1fs (%.2fMB/s)",
               arr.nbytes / 1e6, n_chunks, dt, arr.nbytes / 1e6 / max(dt, 1e-9))
