@@ -1606,6 +1606,264 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     return out
 
 
+def run_solve_bench(out_path=None, seed=0, n_users=96, per_user=96,
+                    d_user=4, n_iterations=4) -> dict:
+    """`bench.py --solve`: per-entity solve-path micro-bench ->
+    BENCH_SOLVE_<backend>.json — the solve path's first tracked perf
+    trajectory.  Three sections:
+
+      - ``soa_newton``: lanes/sec of the batched SoA Newton bucket solve
+        (opt/newton_soa.py) at the glmix_chip-like shape (d=4, cap=32),
+        measured per pallas A/B variant: ``auto`` (the pallas Newton-step
+        kernel where eligible — TPU) and ``xla`` (PHOTON_SOA_DISABLE_PALLAS
+        path).  On cpu both variants run the XLA path; the recorded
+        ``pallas_eligible`` flag says which backend the A/B is real on.
+      - ``sweeps``: wall time of one VALIDATED multi-iteration GLMix fit,
+        three ways over the same coordinates — host-paced validated
+        ``CoordinateDescent`` (one dispatch per solve/score/validate
+        phase), ``FusedSweep.run`` (training only, the no-validation floor)
+        and ``FusedSweep.run_validated`` (held-out scoring + per-update
+        losses fused into ONE program).  Each variant is warmed once and
+        measured on re-entry; ``compiles_after_warm`` counts jit cache
+        growth across the measured window (must be 0).
+      - ``compact_scoring``: sparse-compact scoring throughput
+        (models/game.score_compact_sparse) per pallas A/B variant —
+        ``auto`` (match-dot kernel where eligible) vs ``xla``
+        (PHOTON_COMPACT_DISABLE_PALLAS searchsorted chain).
+
+    ``speedup_fused_validated`` (host / fused-validated wall) is the
+    acceptance trajectory number.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.losses import logistic_loss
+    from photon_ml_tpu.data.synthetic import generate_glmix
+    from photon_ml_tpu.evaluation.evaluator import EvaluationSuite
+    from photon_ml_tpu.game.config import (FixedEffectConfig,
+                                           RandomEffectConfig)
+    from photon_ml_tpu.game.coordinate import build_coordinate
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.game.fused import FusedSweep
+    from photon_ml_tpu.core.regularization import Regularization
+    from photon_ml_tpu.opt.newton_soa import solve_newton_soa
+    from photon_ml_tpu.opt.types import SolverConfig
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    backend = jax.default_backend()
+    out = {"metric": "solve_path", "backend": backend, "seed": seed}
+
+    # -- 1. SoA Newton bucket solve: lanes/sec, pallas A/B ----------------
+    d, cap, lanes = 4, 32, 4096
+    x_t = jnp.asarray(rng.normal(size=(cap, d, lanes)), jnp.float32)
+    y_t = jnp.asarray((rng.random((cap, lanes)) < 0.5), jnp.float32)
+    off_t = jnp.zeros((cap, lanes), jnp.float32)
+    wt_t = jnp.asarray(rng.uniform(0.5, 2.0, size=(cap, lanes)), jnp.float32)
+    l2 = jnp.full((lanes,), 1.0, jnp.float32)
+    w0 = jnp.zeros((d, lanes), jnp.float32)
+    cfg = SolverConfig(max_iters=SOLVER_ITERS, tolerance=1e-7)
+
+    from photon_ml_tpu.ops import soa_newton as _soa
+
+    soa = {"d": d, "cap": cap, "lanes": lanes,
+           "pallas_eligible": _soa.eligible(d, lanes)}
+    for variant, env in (("auto", None), ("xla", "1")):
+        prev = os.environ.get("PHOTON_SOA_DISABLE_PALLAS")
+        if env is None:
+            os.environ.pop("PHOTON_SOA_DISABLE_PALLAS", None)
+        else:
+            os.environ["PHOTON_SOA_DISABLE_PALLAS"] = env
+        try:
+            # fresh jit per variant: the gate reads the env at TRACE time
+            solve = jax.jit(lambda w, l: solve_newton_soa(
+                logistic_loss, w, x_t, y_t, off_t, wt_t, l, cfg))
+            jax.block_until_ready(solve(w0, l2).w)  # warm
+            t, reps = time.perf_counter(), 0
+            while time.perf_counter() - t < 1.0:
+                jax.block_until_ready(solve(w0, l2).w)
+                reps += 1
+            dt = (time.perf_counter() - t) / reps
+            soa[variant] = {"seconds": round(dt, 6),
+                            "lanes_per_sec": round(lanes / dt, 1)}
+        finally:
+            if prev is None:
+                os.environ.pop("PHOTON_SOA_DISABLE_PALLAS", None)
+            else:
+                os.environ["PHOTON_SOA_DISABLE_PALLAS"] = prev
+    out["soa_newton"] = soa
+
+    # -- 2. validated sweep: host loop vs fused vs fused-validated --------
+    data, _ = generate_glmix(n_users=n_users, per_user=per_user,
+                             d_global=16, d_user=d_user, seed=seed)
+    val, _ = generate_glmix(n_users=n_users, per_user=max(8, per_user // 4),
+                            d_global=16, d_user=d_user, seed=seed + 1)
+    solver = SolverConfig(max_iters=SOLVER_ITERS, tolerance=1e-7)
+    cfgs = {
+        "fixed": FixedEffectConfig(feature_shard="global", solver=solver,
+                                   reg=Regularization(l2=1.0)),
+        "per_user": RandomEffectConfig(random_effect_type="userId",
+                                       feature_shard="per_user",
+                                       solver=solver,
+                                       reg=Regularization(l2=1.0)),
+    }
+    task = TaskType.LOGISTIC_REGRESSION
+    coords = {cid: build_coordinate(cid, data, c, task)
+              for cid, c in cfgs.items()}
+    suite = EvaluationSuite.from_specs(["auc", "logistic_loss"])
+
+    def _timed(thunk, warm=1, min_window=1.0):
+        for _ in range(warm):
+            thunk()
+        t, reps = time.perf_counter(), 0
+        while time.perf_counter() - t < min_window:
+            thunk()
+            reps += 1
+        return (time.perf_counter() - t) / reps
+
+    host = CoordinateDescent(coords, num_iterations=n_iterations,
+                             validation=(val, suite))
+    host_s = _timed(lambda: host.run())
+
+    sweep = FusedSweep(coords, num_iterations=n_iterations)
+    fused_s = _timed(lambda: sweep.run())
+    plan = sweep.validation_plan(val, suite)
+    fv_s = _timed(lambda: sweep.run_validated(plan))
+
+    def _cache_sizes():
+        progs = [sweep._program, sweep._val_program]
+        progs += [c._vsolve for c in coords.values() if hasattr(c, "_vsolve")]
+        progs += [c._solve for c in coords.values() if hasattr(c, "_solve")]
+        return sum(p._cache_size() for p in progs if p is not None)
+
+    before = _cache_sizes()
+    sweep.run_validated(plan)
+    sweep.run()
+    compiles_after_warm = _cache_sizes() - before
+
+    out["sweeps"] = {
+        "n_samples": int(data.num_samples),
+        "n_val": int(val.num_samples),
+        "coordinates": len(coords),
+        "iterations": n_iterations,
+        "host_validated_s": round(host_s, 4),
+        "fused_s": round(fused_s, 4),
+        "fused_validated_s": round(fv_s, 4),
+        "speedup_fused_validated": round(host_s / fv_s, 2),
+        "validation_overhead_vs_fused": round(fv_s / fused_s, 2),
+        "compiles_after_warm": int(compiles_after_warm),
+    }
+    out["value"] = out["sweeps"]["speedup_fused_validated"]
+    out["unit"] = "x (host validated / fused validated)"
+
+    # -- 3. sparse-compact scoring throughput, pallas A/B -----------------
+    from photon_ml_tpu.models.game import score_compact_sparse
+    from photon_ml_tpu.ops import compact_score as _cs
+
+    E, dim, k_m, k_f, n = 20000, 50000, 16, 24, 32768
+    w_idx = np.sort(rng.choice(dim, size=(E, k_m), replace=True), axis=1)
+    w_idx = w_idx.astype(np.int32)
+    w_val = rng.normal(size=(E, k_m)).astype(np.float32)
+    slots = rng.integers(-1, E, size=n).astype(np.int32)
+    f_idx = rng.integers(0, dim, size=(n, k_f)).astype(np.int32)
+    f_val = rng.normal(size=(n, k_f)).astype(np.float32)
+    args = tuple(jnp.asarray(a) for a in (w_idx, w_val, slots, f_idx, f_val))
+    comp = {"entities": E, "dim": dim, "k_model": k_m, "k_feat": k_f,
+            "n_samples": n, "pallas_eligible": _cs.eligible(k_m, k_f)}
+    for variant, env in (("auto", None), ("xla", "1")):
+        prev = os.environ.get("PHOTON_COMPACT_DISABLE_PALLAS")
+        if env is None:
+            os.environ.pop("PHOTON_COMPACT_DISABLE_PALLAS", None)
+        else:
+            os.environ["PHOTON_COMPACT_DISABLE_PALLAS"] = env
+        try:
+            score = jax.jit(score_compact_sparse)
+            jax.block_until_ready(score(*args))  # warm (fresh jit per env)
+            t, reps = time.perf_counter(), 0
+            while time.perf_counter() - t < 1.0:
+                jax.block_until_ready(score(*args))
+                reps += 1
+            dt = (time.perf_counter() - t) / reps
+            comp[variant] = {"seconds": round(dt, 6),
+                             "samples_per_sec": round(n / dt, 1)}
+        finally:
+            if prev is None:
+                os.environ.pop("PHOTON_COMPACT_DISABLE_PALLAS", None)
+            else:
+                os.environ["PHOTON_COMPACT_DISABLE_PALLAS"] = prev
+    out["compact_scoring"] = comp
+
+    # -- 4. compact SERVING: the engine end-to-end on a compact store -----
+    # (resolve -> AOT execute over device-resident (indices, values) hot
+    # rows + compact cold overflow; no .to_dense() anywhere)
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
+    from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                         StoreConfig)
+    from photon_ml_tpu.serving.engine import ScoringEngine
+
+    sd, sE, sn = 32, 5000, 1000
+    names = [f"f{j}" for j in range(sd)]
+    imap = IndexMap({feature_key(nm): j for j, nm in enumerate(names)})
+    eidx = EntityIndex()
+    for i in range(sE):
+        eidx.get_or_add(f"user{i}")
+    w = (rng.normal(size=(sE, sd))
+         * (rng.random((sE, sd)) < 0.25)).astype(np.float32)
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(
+                means=rng.normal(size=sd).astype(np.float32)),
+            feature_shard="all", task=TaskType.LOGISTIC_REGRESSION),
+        "per_user": RandomEffectModel(
+            w_stack=w, slot_of={i: i for i in range(sE)},
+            random_effect_type="userId", feature_shard="all",
+            task=TaskType.LOGISTIC_REGRESSION).to_compact(),
+    })
+    store = CoefficientStore.from_model(
+        model, TaskType.LOGISTIC_REGRESSION, {"userId": eidx},
+        {"all": imap}, config=StoreConfig(device_capacity=sE // 10))
+    engine = ScoringEngine(store, BucketedBatcher(64))
+    n_exec = engine.warm()
+    reqs = [Request(uid=i, features=[
+        {"name": nm, "term": "", "value": float(v)}
+        for nm, v in zip(names, rng.normal(size=sd))],
+        ids={"userId": f"user{int(rng.integers(0, sE + 50))}"})
+        for i in range(sn)]
+    engine.score_requests(reqs[:1])
+    lat = []
+    for r in reqs[:300]:
+        t = time.perf_counter()
+        engine.score_requests([r])
+        lat.append(time.perf_counter() - t)
+    t0 = time.perf_counter()
+    engine.score_requests(reqs)
+    stream_s = time.perf_counter() - t0
+    store.rebalance()
+    engine.score_requests(reqs[:64])
+    lat = np.asarray(lat)
+    out["compact_serving"] = {
+        "entities": sE, "d": sd,
+        "k": int(model.models["per_user"].indices.shape[1]),
+        "device_capacity": sE // 10,
+        "single_p50_s": round(float(np.percentile(lat, 50)), 6),
+        "single_p99_s": round(float(np.percentile(lat, 99)), 6),
+        "stream_qps": round(sn / stream_s, 1),
+        "warm_executables": n_exec,
+        "compiles_after_warm": engine.compile_count - n_exec,
+    }
+
+    if out_path is None:
+        out_path = os.path.join(_REPO, f"BENCH_SOLVE_{backend}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
     """Time the full whole-program photonlint pass over photon_ml_tpu/.
 
@@ -1747,6 +2005,11 @@ def main():
                          "frequency-ranked hot set")
     ap.add_argument("--serving-deadline-us", type=float, default=200.0,
                     help="with --serving: async batcher deadline")
+    ap.add_argument("--solve", action="store_true",
+                    help="per-entity solve-path micro-bench (SoA Newton "
+                         "lanes/sec, host vs fused vs fused-validated sweep "
+                         "wall, sparse-compact scoring throughput, pallas "
+                         "A/B) -> BENCH_SOLVE_<backend>.json")
     ap.add_argument("--lint", action="store_true",
                     help="photonlint wall-time micro-bench (whole-program "
                          "pass over photon_ml_tpu/) -> BENCH_LINT.json")
@@ -1765,6 +2028,9 @@ def main():
     if a.lint:
         print(json.dumps(run_lint_bench(repeats=a.lint_repeats,
                                         out_path=a.out)))
+        return
+    if a.solve:
+        print(json.dumps(run_solve_bench(out_path=a.out)))
         return
     if a.serving:
         print(json.dumps(run_serving_bench(

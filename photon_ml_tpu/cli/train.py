@@ -143,6 +143,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "ModelProcessingUtils on-disk layout to this dir so "
                         "Spark-side Photon ML can load it (bidirectional "
                         "migration)")
+    p.add_argument("--fused", default="auto", choices=["auto", "on", "off"],
+                   help="descent engine: 'auto' (default) runs each fit as "
+                        "ONE jitted program — validated fits included "
+                        "(held-out scoring + per-update losses fused into "
+                        "the scanned program, FusedSweep.run_validated) — "
+                        "whenever no per-update host work (checkpoints, "
+                        "locked coordinates, resume) is configured; 'off' "
+                        "forces the host-paced CoordinateDescent (per-update "
+                        "spans + history); 'on' requires the fused path and "
+                        "errors where it cannot run")
     p.add_argument("--mesh", default=None,
                    help="device mesh spec 'data=4,entity=2,feature=1' — axes "
                         "default to 1, 'data' defaults to the remaining "
@@ -562,7 +572,9 @@ def _run(args, task, t_start, emitter) -> int:
                          n_feature=axes.get("feature", 1))
         logger.info("device mesh: %s", dict(mesh.shape))
     est = GameEstimator(mesh=mesh, validation_suite=suite,
-                        normalization=normalization)
+                        normalization=normalization,
+                        fused={"auto": "auto", "on": True,
+                               "off": False}[args.fused])
 
     # Warm start / partial retraining (reference GameTrainingDriver.scala:370-379
     # -> GameEstimator initialModel + partial retraining :106-112).

@@ -20,6 +20,7 @@ TPU-native shape:
 
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Optional, Tuple, Union
 
 import jax
@@ -36,6 +37,8 @@ from photon_ml_tpu.game.config import CoordinateConfig, FixedEffectConfig, Rando
 from photon_ml_tpu.game.data import GameData, SparseShard
 from photon_ml_tpu.models.game import DatumScoringModel, FixedEffectModel, RandomEffectModel
 from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.obs import get_registry, set_family_bounds
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverResult
 from photon_ml_tpu.parallel.bucketing import bucket_by_entity, stacked_coefficients
@@ -44,6 +47,12 @@ from photon_ml_tpu.types import (OptimizerType, ProjectorType, TaskType,
                                  VarianceComputationType)
 
 Array = jax.Array
+
+# Per-entity bucket solves live in ms..minutes, not the default 1µs..67s
+# span ladder — register sane bins once at import (obs follow-on: per-family
+# histogram bound overrides).  100µs .. ~7min, factor 2.
+set_family_bounds("solve_bucket_seconds",
+                  [1e-4 * (2.0 ** i) for i in range(23)])
 
 
 def _slots_from(slot_of: Dict[int, int], entity_ids: np.ndarray) -> np.ndarray:
@@ -164,6 +173,35 @@ class Coordinate:
         offsets so every in-program residual matches the host loop, whose
         re-scoring of the merged model includes it.  None = nothing
         carried."""
+        return None
+
+    # --- external (validation) scoring for fused validated sweeps --------
+    # The fused validated program (game/fused.FusedSweep.run_validated)
+    # scores a HELD-OUT sample set with each coordinate's published
+    # coefficients inside the scanned program; these two methods are that
+    # contract.  Subclasses without them inherit raising defaults and the
+    # estimator falls back to the host-paced CoordinateDescent.
+
+    def external_data(self, data: "GameData"):
+        """Host: pytree of device arrays for scoring ``data`` with this
+        coordinate's published coefficients inside a traced program
+        (the validated sweep passes it back through ``trace_score_external``
+        as ARGUMENTS — the same baked-constant-avoidance convention as
+        ``sweep_data``)."""
+        raise NotImplementedError
+
+    def trace_score_external(self, published: Array, vdata) -> Array:
+        """Traceable: published coefficient array + ``external_data``
+        pytree -> this coordinate's raw score for every external sample
+        (the traced twin of ``model.score(data)`` on the exported model)."""
+        raise NotImplementedError
+
+    def carry_through_scores_on(self, init: "Optional[DatumScoringModel]",
+                                data: "GameData") -> "Optional[np.ndarray]":
+        """Host: per-sample scores on ``data`` of the warm-start state this
+        coordinate cannot retrain (``carry_through_scores``' semantics on an
+        EXTERNAL sample set) — the validated sweep folds this constant into
+        its held-out score base.  None = nothing carried."""
         return None
 
     def sweep_key(self) -> tuple:
@@ -558,6 +596,28 @@ class FixedEffectCoordinate(Coordinate):
 
     def export_variances(self, v) -> np.ndarray:
         return np.asarray(v)[: self.dim]
+
+    # --- external (validation) scoring (fused validated sweeps) ---------
+
+    def external_data(self, data: GameData):
+        """Held-out design for this shard, device-resident once (dense
+        [n, d] or the SparseShard COO pair) — the same layout
+        FixedEffectModel.score consumes."""
+        from photon_ml_tpu.utils.transfer import chunked_device_put
+
+        shard = data.features[self.config.feature_shard]
+        if isinstance(shard, SparseShard):
+            return {"x_idx": chunked_device_put(shard.indices, np.int32),
+                    "x_val": chunked_device_put(shard.values, self._dtype)}
+        return {"x": chunked_device_put(np.asarray(shard), self._dtype)}
+
+    def trace_score_external(self, published: Array, vdata) -> Array:
+        """== FixedEffectModel.score: x @ w (dense) or the gather-einsum
+        (sparse), on the ORIGINAL-space published coefficients."""
+        w = published[: self.dim]
+        if "x" in vdata:
+            return vdata["x"] @ w
+        return jnp.einsum("nk,nk->n", vdata["x_val"], w[vdata["x_idx"]])
 
 
 def _box_from_constraints(constraints, dim: int, dtype, norm=None,
@@ -1370,8 +1430,19 @@ class RandomEffectCoordinate(Coordinate):
                 w0 = self._put_entity(np.zeros((b.num_lanes, solve_dim), self._dtype))
             # residual offsets gathered into the bucket layout
             off_b = jnp.where(dev["valid"], offs[dev["rows"]], 0.0).astype(self._dtype)
-            res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
-                               lane_regs[bi], *self._solve_extras(bi))
+            # one span + histogram sample per bucket solve, device-accurate
+            # (block inside the span — the host-paced loop is per-phase
+            # dispatch anyway; the fused sweep is where pipelining lives)
+            with obs_span("solve.bucket", coordinate=self.coordinate_id,
+                          bucket=bi, lanes=b.num_lanes, soa=self._use_soa):
+                t0 = _time.perf_counter()
+                res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
+                                   lane_regs[bi], *self._solve_extras(bi))
+                jax.block_until_ready(res.w)
+                get_registry().observe(
+                    "solve_bucket_seconds", _time.perf_counter() - t0,
+                    coordinate=self.coordinate_id,
+                    soa=str(self._use_soa).lower())
             coeffs.append(self._lanes_to_original(res.w, bi))
             results.append(res)
             if variances is not None:
@@ -1639,6 +1710,70 @@ class RandomEffectCoordinate(Coordinate):
         var_stack, _ = stacked_coefficients([np.asarray(b) for b in v],
                                             self.buckets)
         return np.asarray(var_stack)
+
+    # --- external (validation) scoring (fused validated sweeps) ---------
+
+    def external_data(self, data: GameData):
+        """Held-out slots + design for this coordinate, device-resident
+        once.  Slots map the external entity ids through THIS RUN's trained
+        slot order (the stacked layout ``trace_publish`` emits); entities
+        this run never trained get -1 and score 0 — carried warm-start
+        entities are a host-side CONSTANT (``carry_through_scores_on``)."""
+        from photon_ml_tpu.utils.transfer import chunked_device_put
+
+        shard = data.features[self.config.feature_shard]
+        ids = np.asarray(data.id_tags[self.config.random_effect_type],
+                         np.int64)
+        out = {"slots": jnp.asarray(_slots_from(self._slot_of, ids))}
+        if isinstance(shard, SparseShard):
+            out["x_idx"] = chunked_device_put(shard.indices, np.int32)
+            out["x_val"] = chunked_device_put(shard.values, self._dtype)
+        else:
+            out["x"] = chunked_device_put(np.asarray(shard), self._dtype)
+        return out
+
+    def trace_score_external(self, published: Array, vdata) -> Array:
+        """== RandomEffectModel.score on the published stack: gather + row
+        dot (dense) or the two-level sparse gather."""
+        from photon_ml_tpu.parallel.bucketing import (score_samples,
+                                                      score_samples_sparse)
+
+        if "x" in vdata:
+            return score_samples(published, vdata["slots"], vdata["x"])
+        return score_samples_sparse(published, vdata["slots"],
+                                    vdata["x_idx"], vdata["x_val"])
+
+    def carry_through_scores_on(self, init: Optional[RandomEffectModel],
+                                data: GameData) -> Optional[np.ndarray]:
+        """Carried (never-retrained) entities' contribution on an EXTERNAL
+        sample set — ``carry_through_scores``' exact semantics evaluated on
+        ``data`` instead of the training samples."""
+        from photon_ml_tpu.parallel.bucketing import (score_samples,
+                                                      score_samples_sparse)
+
+        if init is None:
+            return None
+        init = self._dense_init(init)
+        carried = np.fromiter(
+            (eid for eid in init.slot_of if eid not in self._slot_of),
+            np.int64)
+        if carried.size == 0:
+            return None
+        ids = np.asarray(data.id_tags[self.config.random_effect_type],
+                         np.int64)
+        slots = _slots_from(init.slot_of, ids)
+        slots = np.where(np.isin(ids, carried), slots, -1).astype(np.int32)
+        w = jnp.asarray(np.asarray(init.w_stack, self._dtype))
+        shard = data.features[self.config.feature_shard]
+        if isinstance(shard, SparseShard):
+            s = score_samples_sparse(
+                w, jnp.asarray(slots),
+                jnp.asarray(np.asarray(shard.indices, np.int32)),
+                jnp.asarray(np.asarray(shard.values, self._dtype)))
+        else:
+            s = score_samples(w, jnp.asarray(slots),
+                              jnp.asarray(np.asarray(shard, self._dtype)))
+        return np.asarray(s)
 
     def tracker_summary(self, trackers) -> dict:
         """Per-entity solve statistics, padded lanes excluded (reference

@@ -121,6 +121,8 @@ class GameEstimator:
         prev_sweep = None  # (key, FusedSweep) — reuse the compiled program
         # when every coordinate object survived config-to-config (same `prev`
         # reuse that keeps solver jits alive)
+        prev_plan = None  # (sweep, val_data, ValidationPlan) — held-out
+        # designs upload once per sweep, not once per grid point
         for ci, config in enumerate(configs):
             if resume_cursor is not None and ci < resume_cursor.get("config", 0):
                 continue
@@ -145,9 +147,18 @@ class GameEstimator:
             if validation_data is not None and self.validation_suite is not None:
                 validation = (validation_data, self.validation_suite)
 
-            fused_ok = (self.fused is not False and validation is None
-                        and checkpoint_hook is None and not locked_coordinates
-                        and resume_cursor is None)
+            # Per-update HOST work (checkpoint hooks, locked coordinates,
+            # resume) forces the host-paced loop; a validation suite no
+            # longer does — the validated program (FusedSweep.run_validated)
+            # scores the held-out set and tracks per-update losses inside
+            # the scanned program, and the host evaluates the metric suite
+            # per sweep boundary with the host loop's exact best-model
+            # retention.  The two validated carve-outs that stay host-paced:
+            # coefficient variances (per-snapshot variances would multiply
+            # the curvature work T-fold) and a custom Coordinate without the
+            # external-scoring interface.
+            fused_ok = (self.fused is not False and checkpoint_hook is None
+                        and not locked_coordinates and resume_cursor is None)
             if fused_ok:
                 from photon_ml_tpu.game.fused import FusedSweep
 
@@ -156,6 +167,7 @@ class GameEstimator:
                 key = (tuple((cid, coordinates[cid].sweep_key())
                              for cid in config.coordinates),
                        config.num_outer_iterations)
+                fitted = None
                 try:
                     if prev_sweep is not None and prev_sweep[0] == key:
                         sweep = prev_sweep[1]
@@ -164,28 +176,40 @@ class GameEstimator:
                                            order=list(config.coordinates),
                                            num_iterations=config.num_outer_iterations)
                         prev_sweep = (key, sweep)
+                    regs = [coordinates[cid].config.reg
+                            for cid in config.coordinates]
+                    if validation is None:
+                        model, _scores = sweep.run(initial=warm, regs=regs,
+                                                   seed=seed)
+                        fitted = (model, None)
+                    else:
+                        if prev_plan is not None and prev_plan[0] is sweep \
+                                and prev_plan[1] is validation_data:
+                            plan = prev_plan[2]
+                        else:
+                            plan = sweep.validation_plan(
+                                validation_data, self.validation_suite)
+                            prev_plan = (sweep, validation_data, plan)
+                        model, _evals, best_ev, _losses = sweep.run_validated(
+                            plan, initial=warm, regs=regs, seed=seed)
+                        fitted = (model, best_ev)
                 except NotImplementedError:
                     # a custom Coordinate subclass without the traceable-step
-                    # interface (base-class init_sweep_state raises); both
-                    # built-in flavors are always fused-eligible
-                    if self.fused is True:
+                    # (or, for validated fits, external-scoring) interface,
+                    # or a variance-computing validated fit — host loop
+                    if self.fused is True and validation is None:
                         raise
-                else:
-                    model, _scores = sweep.run(
-                        initial=warm,
-                        regs=[coordinates[cid].config.reg
-                              for cid in config.coordinates],
-                        seed=seed)
+                if fitted is not None:
+                    model, ev = fitted
                     results.append(GameFitResult(model=model, config=config,
-                                                 evaluation=None,
+                                                 evaluation=ev,
                                                  history=DescentHistory()))
                     warm = model
                     continue
             elif self.fused is True:
                 raise ValueError(
                     "fused=True needs a fit with no per-update host work "
-                    "(no validation suite, checkpoint hook, locked "
-                    "coordinates, or resume)")
+                    "(no checkpoint hook, locked coordinates, or resume)")
             descent = CoordinateDescent(
                 coordinates,
                 order=list(config.coordinates),

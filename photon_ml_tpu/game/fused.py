@@ -78,6 +78,7 @@ class FusedSweep:
         self._snap_program = None  # built lazily by run_snapshots
         self._grid_program = None  # built lazily by run_grid
         self._grid_snap_program = None  # built lazily by run_grid_snapshots
+        self._val_program = None   # built lazily by run_validated
 
         def program(states0, scores0, vars0, regs, base_key, base, datas):
             # regs: per-coordinate Regularization pytree, TRACED — a
@@ -132,15 +133,19 @@ class FusedSweep:
         self._vars0 = tuple(coordinates[cid].init_sweep_variances()
                             for cid in self.order)
 
-    def _sweep_iteration(self, states, scores, regs, it_key, base, datas):
+    def _sweep_iteration(self, states, scores, regs, it_key, base, datas,
+                         on_update=None):
         """Traceable: ONE outer iteration's coordinate loop — the single
         source of the descent math (residual fold + per-coordinate update,
-        CoordinateDescent.scala:197-204) shared by the main program and the
-        snapshot program.  Returns (states', scores', partials, keys):
-        partials[i] is the residual offset coordinate i was solved against
-        and keys[i] the PRNG key its update used — variance computation must
-        see the SAME offsets and down-sampling mask as the published
-        coefficients, so it re-uses both rather than re-deriving them."""
+        CoordinateDescent.scala:197-204) shared by the main program, the
+        snapshot program and the validated program.  Returns (states',
+        scores', partials, keys): partials[i] is the residual offset
+        coordinate i was solved against and keys[i] the PRNG key its update
+        used — variance computation must see the SAME offsets and
+        down-sampling mask as the published coefficients, so it re-uses both
+        rather than re-deriving them.  ``on_update(i, cid, state_i)``:
+        traced hook after each coordinate's update (the validated program's
+        per-update held-out bookkeeping)."""
         order, coords = self.order, self.coordinates
         needs_rand = self._needs_rand
         states, scores = list(states), list(scores)
@@ -161,6 +166,8 @@ class FusedSweep:
             partials.append(partial)
             keys.append(key)
             total = partial + scores[i]
+            if on_update is not None:
+                on_update(i, cid, states[i])
         return states, scores, partials, keys
 
     def _init_carry(self, initial: Optional[GameModel]):
@@ -334,6 +341,140 @@ class FusedSweep:
 
         return program
 
+    # --- fused validated sweeps -----------------------------------------
+
+    def validation_plan(self, data, suite) -> "ValidationPlan":
+        """Build (once per held-out set) the device-resident inputs
+        ``run_validated`` scores against — per-coordinate designs/slots via
+        each coordinate's ``external_data``.  Raises NotImplementedError
+        for a coordinate without the external-scoring interface (the
+        estimator then falls back to the host-paced CoordinateDescent)."""
+        return ValidationPlan(self, data, suite)
+
+    def _validated_fn(self):
+        """The validated program: the same ``_sweep_iteration`` core as the
+        main program, with per-update held-out bookkeeping fused in —
+        after every coordinate update the scanned body re-scores THAT
+        coordinate's held-out margins from its published coefficients,
+        folds them into the running held-out total with the same
+        residual-style replace the training scores use, and records the
+        weighted held-out loss (the in-program twin of the host loop's
+        per-update ``descent.validate`` evaluation).  Each iteration also
+        emits its published coefficients and held-out totals, so the host
+        evaluates the full metric suite per sweep boundary from ONE
+        device->host pull — a validated multi-iteration fit is ONE XLA
+        program."""
+        order, coords = self.order, self.coordinates
+        needs_rand = self._needs_rand
+        loss = self._val_loss
+
+        def program(states0, scores0, vscores0, regs, base_key, base, datas,
+                    vdatas, val_base, val_y, val_wt):
+            wt_sum = jnp.maximum(val_wt.sum(), jnp.asarray(1e-30, self._dtype))
+
+            def body(carry, it):
+                states, scores, vscores = carry
+                vscores = list(vscores)
+                it_key = (jax.random.fold_in(base_key, it)
+                          if any(needs_rand) else None)
+                published = [None] * len(order)
+                losses = []
+                vtotal = vscores[0]
+                # photonlint: disable=tracer-safety -- static per-coordinate
+                # list, unrolled at trace time like _sweep_iteration's
+                for s in vscores[1:]:
+                    vtotal = vtotal + s
+
+                def on_update(i, cid, state_i):
+                    nonlocal vtotal
+                    w_pub = coords[cid].trace_publish(state_i, data=datas[i])
+                    vm = coords[cid].trace_score_external(
+                        w_pub, vdatas[i]).astype(self._dtype)
+                    vtotal = vtotal - vscores[i] + vm
+                    vscores[i] = vm
+                    published[i] = w_pub
+                    z = vtotal + val_base
+                    losses.append((val_wt * loss.loss(z, val_y)).sum()
+                                  / wt_sum)
+
+                states, scores, _, _ = self._sweep_iteration(
+                    states, scores, regs, it_key, base, datas,
+                    on_update=on_update)
+                return ((tuple(states), tuple(scores), tuple(vscores)),
+                        (tuple(published), vtotal, jnp.stack(losses)))
+
+            carry, (pubs, vtotals, losses) = lax.scan(
+                body, (states0, scores0, vscores0),
+                jnp.arange(self.num_iterations))
+            return pubs, vtotals, losses
+
+        return program
+
+    def run_validated(self, plan: "ValidationPlan",
+                      initial: Optional[GameModel] = None,
+                      regs: Optional[Sequence] = None, seed: int = 0,
+                      carry0=None):
+        """One fused descent WITH the validation suite: training updates,
+        held-out scoring and per-update held-out losses all run inside one
+        compiled program; the host evaluates ``plan.suite`` on each
+        iteration's held-out totals and keeps the best full model — the
+        exact best-model retention the host loop applies (full models at
+        sweep boundaries only, CoordinateDescent.scala:163-167 /
+        descent.py), without any per-update device round-trips.
+
+        Returns ``(best_model, evals, best_eval, losses)``: the retained
+        GameModel, one EvaluationResults per outer iteration (boundary
+        evaluations, in order), the best's results, and the in-program
+        per-(iteration, coordinate) held-out loss matrix [T, C].
+
+        Eligibility mirrors run_snapshots: no coefficient variances (the
+        host loop publishes each update's own variances; per-snapshot
+        variances would multiply the curvature work T-fold) — callers with
+        variance-computing coordinates fall back to the host descent.
+        Checkpoint hooks / locked coordinates / resume are host-loop work by
+        definition and never reach here (game/estimator.py gates)."""
+        if any(self._needs_var):
+            raise NotImplementedError(
+                "run_validated does not compute coefficient variances; use "
+                "the host CoordinateDescent for variance-computing validated "
+                "fits")
+        if self._val_program is None:
+            # the held-out loss fn is static program structure; it derives
+            # from the sweep's task, so every plan over this sweep agrees
+            self._val_loss = plan.loss
+            self._val_program = jax.jit(self._validated_fn())
+        carry = carry0 if carry0 is not None else self.init_carry(initial)
+        if regs is None:
+            regs = tuple(self.coordinates[cid].config.reg
+                         for cid in self.order)
+        base, _carried = self._base_with_carry_through(initial)
+        vscores0, val_base_np = plan.initial_state(initial)
+        with obs_span("descent.fused_validated", device_sync=True,
+                      coordinates=len(self.order),
+                      iterations=self.num_iterations):
+            pubs, vtotals, losses = self._val_program(
+                *carry, vscores0, tuple(regs), jax.random.PRNGKey(seed),
+                base, self._datas, plan.datas,
+                jnp.asarray(val_base_np), plan.y_dev, plan.wt_dev)
+            # one bulk pull per output (the only device->host transfers of
+            # the whole validated fit)
+            vtotals = np.asarray(vtotals)
+            losses = np.asarray(losses)
+            pubs = [np.asarray(jax.device_get(p)) for p in pubs]
+        evals, best_t, best_ev = [], 0, None
+        for t in range(self.num_iterations):
+            ev = plan.suite.evaluate(vtotals[t] + val_base_np, plan.y,
+                                     plan.weight, group_ids=plan.group_ids)
+            evals.append(ev)
+            # strict-improvement retention in iteration order — identical
+            # tie-breaking to the host loop's better_than chain
+            if plan.suite.better_than(ev, best_ev):
+                best_ev, best_t = ev, t
+        models = {cid: self.coordinates[cid].export_model(pubs[i][best_t])
+                  for i, cid in enumerate(self.order)}
+        model = GameModel(models=self._merge_carry_through(models, initial))
+        return model, evals, best_ev, losses
+
     # --- regularization-grid batching -----------------------------------
     # A λ grid's descents are INDEPENDENT programs over the SAME data, and
     # these solves are bandwidth-bound: vmapping the sweep over the reg
@@ -432,3 +573,66 @@ class FusedSweep:
             else:  # random effect: stacked per-entity variances
                 models[cid] = dataclasses.replace(m, variances=v)
         return models
+
+
+class ValidationPlan:
+    """Device-resident held-out inputs for ``FusedSweep.run_validated``.
+
+    Built ONCE per (sweep, held-out set, suite): per-coordinate scoring
+    pytrees (``Coordinate.external_data`` — designs + trained-slot maps,
+    uploaded once), the label/weight device twins the in-program loss
+    consumes, and the host-side arrays/suite the per-iteration metric
+    evaluation reads.  The per-fit constants (warm-start held-out margins,
+    carried-entity contributions) are computed by ``initial_state`` at run
+    time — they depend on the initial model, not the plan.
+    """
+
+    def __init__(self, sweep: FusedSweep, data, suite):
+        from photon_ml_tpu.core.losses import loss_for_task
+
+        self.sweep = sweep
+        self.data = data
+        self.suite = suite
+        self.n = data.num_samples
+        self.y = np.asarray(data.y)
+        self.weight = np.asarray(data.weight)
+        self.offset = np.asarray(data.offset)
+        self.group_ids = data.id_tags
+        # raises NotImplementedError for a coordinate without the
+        # external-scoring interface — callers fall back to the host loop
+        self.datas = tuple(
+            sweep.coordinates[cid].external_data(data)
+            for cid in sweep.order)
+        first = sweep.coordinates[sweep.order[0]]
+        self.loss = loss_for_task(first.task)
+        self.y_dev = jnp.asarray(np.asarray(self.y, sweep._dtype))
+        self.wt_dev = jnp.asarray(np.asarray(self.weight, sweep._dtype))
+
+    def initial_state(self, initial):
+        """(per-coordinate initial held-out margins as device arrays,
+        host ``val_base`` = offsets + carried-entity contributions) — the
+        held-out twin of ``FusedSweep._init_carry`` +
+        ``_base_with_carry_through``: warm-start models contribute their
+        held-out score from the start, carried (never-retrained) entities
+        ride the base as a constant so every in-program replace matches the
+        host loop's full-model re-scoring."""
+        sweep = self.sweep
+        dtype = sweep._dtype
+        val_base = np.asarray(self.offset, dtype).copy()
+        vscores = []
+        for i, cid in enumerate(sweep.order):
+            coord = sweep.coordinates[cid]
+            init = (initial[cid] if initial is not None and cid in initial
+                    else None)
+            if init is None:
+                vscores.append(jnp.zeros(self.n, dtype))
+                continue
+            s = np.asarray(init.score(self.data), dtype)
+            c = coord.carry_through_scores_on(init, self.data)
+            if c is not None:
+                # carried contribution rides val_base for the whole program
+                # (same no-double-count split as _init_carry's)
+                s = s - np.asarray(c, dtype)
+                val_base += np.asarray(c, dtype)
+            vscores.append(jnp.asarray(s))
+        return tuple(vscores), val_base

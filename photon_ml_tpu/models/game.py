@@ -99,16 +99,19 @@ class RandomEffectModel(DatumScoringModel):
     def score(self, data: GameData) -> Array:
         shard = data.features[self.feature_shard]
         slots = jnp.asarray(self.slots_for(data))
+        # the stack uploads ONCE per instance (repeat scoring of one model
+        # used to re-transfer the full [E, d] stack every call)
+        (w_dev,) = _cached_device_copies(self, self.w_stack)
         if hasattr(shard, "indices"):
             # row-sparse shard: O(n*k) two-level gather, never [n, d_full]
             from photon_ml_tpu.parallel.bucketing import score_samples_sparse
 
             return score_samples_sparse(
-                jnp.asarray(self.w_stack), slots,
+                w_dev, slots,
                 jnp.asarray(np.asarray(shard.indices)),
                 jnp.asarray(np.asarray(shard.values, self.w_stack.dtype)))
         x = jnp.asarray(shard)
-        return score_samples(jnp.asarray(self.w_stack), slots, x)
+        return score_samples(w_dev, slots, x)
 
     def coefficients_for(self, entity_id: int) -> Optional[Coefficients]:
         slot = self.slot_of.get(int(entity_id))
@@ -168,11 +171,13 @@ def _entity_slots(model, data: "GameData") -> np.ndarray:
     return _slots_from(model.slot_of, data.id_tags[model.random_effect_type])
 
 
-@jax.jit
-def _score_dense_compact(w_idx: Array, w_val: Array, slots: Array,
-                         x: Array) -> Array:
+def score_compact_dense(w_idx: Array, w_val: Array, slots: Array,
+                        x: Array) -> Array:
     """Σ_t values[e,t] * x[i, indices[e,t]] — gather the DENSE design at
-    each entity's observed columns (never materializing [E, d])."""
+    each entity's observed columns (never materializing [E, d]).  Plain
+    traceable math: the model wrapper below jits it, and the serving
+    engine's AOT kernels (serving/engine.py) inline it so batch and online
+    compact scoring share ONE definition."""
     e = jnp.where(slots >= 0, slots, 0)
     idx = w_idx[e]  # [n, k]
     xv = jnp.take_along_axis(x, jnp.clip(idx, 0, x.shape[1] - 1), axis=1)
@@ -180,11 +185,19 @@ def _score_dense_compact(w_idx: Array, w_val: Array, slots: Array,
     return jnp.where(slots >= 0, s, 0.0)
 
 
-@jax.jit
-def _score_sparse_compact(w_idx: Array, w_val: Array, slots: Array,
-                          f_idx: Array, f_val: Array) -> Array:
+def score_compact_sparse(w_idx: Array, w_val: Array, slots: Array,
+                         f_idx: Array, f_val: Array) -> Array:
     """Sparse-features x sparse-model margins: binary-search each sample
-    feature id into its entity's sorted coefficient columns (miss -> 0)."""
+    feature id into its entity's sorted coefficient columns (miss -> 0).
+    Plain traceable math (see score_compact_dense).  On TPU the
+    searchsorted/take_along_axis chain is replaced by the pallas match-dot
+    kernel (ops/compact_score.py — same math, one VMEM pass, parity-tested
+    in interpret mode; PHOTON_COMPACT_DISABLE_PALLAS=1 escape hatch)."""
+    from photon_ml_tpu.ops import compact_score
+
+    if compact_score.eligible(w_idx.shape[1], f_idx.shape[1]):
+        return compact_score.score_sparse_compact(w_idx, w_val, slots,
+                                                  f_idx, f_val)
     e = jnp.where(slots >= 0, slots, 0)
     rows_idx = w_idx[e]  # [n, k_model] sorted, padded with dim
     rows_val = w_val[e]
@@ -194,6 +207,29 @@ def _score_sparse_compact(w_idx: Array, w_val: Array, slots: Array,
     wv = jnp.where(hit, jnp.take_along_axis(rows_val, pos_c, axis=1), 0.0)
     s = jnp.sum(f_val * wv, axis=1)
     return jnp.where(slots >= 0, s, 0.0)
+
+
+_score_dense_compact = jax.jit(score_compact_dense)
+_score_sparse_compact = jax.jit(score_compact_sparse)
+
+
+def _cached_device_copies(model, *arrays) -> tuple:
+    """Per-instance device copies of host coefficient arrays, uploaded ONCE.
+
+    Scoring previously re-ran ``jnp.asarray`` on the full stacks every
+    call — a full host->device upload per batch on accelerator backends.
+    The cache is keyed by the host arrays' identities, so the functional
+    mutation idiom (``dataclasses.replace`` with new arrays — the only
+    mutation these frozen containers support) naturally invalidates it:
+    a replaced instance starts with no cache, and rebinding an array in
+    place (object.__setattr__) changes the identity key."""
+    cache = getattr(model, "_dev_cache", None)
+    if cache is not None and len(cache[0]) == len(arrays) and all(
+            c is a for c, a in zip(cache[0], arrays)):
+        return cache[1]
+    dev = tuple(jnp.asarray(a) for a in arrays)
+    object.__setattr__(model, "_dev_cache", (arrays, dev))
+    return dev
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,8 +272,9 @@ class CompactRandomEffectModel(DatumScoringModel):
                 f"shard {self.feature_shard!r} has {shard.shape[1]} "
                 f"features but this model was trained on {self.dim}")
         slots = jnp.asarray(self.slots_for(data))
-        w_idx = jnp.asarray(self.indices)
-        w_val = jnp.asarray(self.values)
+        # one upload per instance, not per call (the satellite fix: every
+        # score() used to re-run jnp.asarray on the full indices/values)
+        w_idx, w_val = _cached_device_copies(self, self.indices, self.values)
         if hasattr(shard, "indices"):
             return _score_sparse_compact(
                 w_idx, w_val, slots,

@@ -24,8 +24,9 @@ guard under 1µs/call).  Enable with ``photon_ml_tpu.obs.enable_tracing()``,
 
 from photon_ml_tpu.obs.probe import JaxRuntimeProbe, get_probe  # noqa: F401
 from photon_ml_tpu.obs.registry import (LatencyHistogram,  # noqa: F401
-                                        MetricsRegistry, get_registry,
-                                        series_name, set_registry)
+                                        MetricsRegistry, family_bounds,
+                                        get_registry, series_name,
+                                        set_family_bounds, set_registry)
 from photon_ml_tpu.obs.trace import (Tracer, enabled, get_tracer,  # noqa: F401
                                      instant, set_tracer, span)
 

@@ -33,27 +33,52 @@ Series = Tuple[str, LabelKey]
 # allocation-free, and snapshots are mergeable across processes.
 _BOUNDS = tuple(1e-6 * (2.0 ** i) for i in range(27))
 
+# Per-FAMILY bound overrides (metric name -> bin upper bounds), applied when
+# a histogram series of that family is first created.  Process-global, not
+# per-registry: a family's bin layout is a property of WHAT is measured
+# (solve latencies live in ms..minutes, span guards in ns..µs), and it must
+# survive the registry swaps tests/benches do.  Existing series keep the
+# bins they were created with — rebinning live counts would corrupt them.
+_family_bounds: Dict[str, Tuple[float, ...]] = {}
+
+
+def set_family_bounds(name: str, bounds: Iterable[float]) -> None:
+    """Override the fixed-bin upper bounds for every FUTURE histogram series
+    of family ``name`` (the carried-over photonscope follow-on): callers
+    whose latency distribution doesn't fit the default 1µs..67s ladder
+    register a sane one once at import time.  Bounds are sorted ascending;
+    values above the last bound land in the +Inf bucket as usual."""
+    _family_bounds[name] = tuple(sorted(float(b) for b in bounds))
+
+
+def family_bounds(name: str) -> Tuple[float, ...]:
+    """The bin bounds a new series of ``name`` would get."""
+    return _family_bounds.get(name, _BOUNDS)
+
 
 class LatencyHistogram:
     """Fixed-bin latency histogram with percentile estimates.
 
     Percentiles interpolate inside the containing bin (log-linear would be
     marginally better; linear keeps the math obvious and the error is
-    bounded by one 2x bin).
+    bounded by one 2x bin).  ``bounds`` default to the module ladder;
+    families registered via ``set_family_bounds`` get their own.
     """
 
-    def __init__(self) -> None:
-        self.counts = [0] * (len(_BOUNDS) + 1)
+    def __init__(self, bounds: Optional[Tuple[float, ...]] = None) -> None:
+        self.bounds = _BOUNDS if bounds is None else tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = 0.0
 
     def record(self, seconds: float) -> None:
-        lo, hi = 0, len(_BOUNDS)
+        bounds = self.bounds
+        lo, hi = 0, len(bounds)
         while lo < hi:  # first bin whose bound >= seconds
             mid = (lo + hi) // 2
-            if _BOUNDS[mid] < seconds:
+            if bounds[mid] < seconds:
                 lo = mid + 1
             else:
                 hi = mid
@@ -66,12 +91,13 @@ class LatencyHistogram:
     def percentile(self, p: float) -> float:
         if self.count == 0:
             return 0.0
+        bounds = self.bounds
         target = p * self.count
         seen = 0
         for i, c in enumerate(self.counts):
             if seen + c >= target and c > 0:
-                hi = _BOUNDS[i] if i < len(_BOUNDS) else self.max
-                lo = _BOUNDS[i - 1] if i > 0 else 0.0
+                hi = bounds[i] if i < len(bounds) else self.max
+                lo = bounds[i - 1] if i > 0 else 0.0
                 frac = (target - seen) / c
                 return min(lo + frac * (hi - lo), self.max)
             seen += c
@@ -161,7 +187,8 @@ class MetricsRegistry:
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
-                h = self._histograms[key] = LatencyHistogram()
+                h = self._histograms[key] = LatencyHistogram(
+                    _family_bounds.get(name))
             h.record(seconds)
 
     # -- reads -------------------------------------------------------------
@@ -232,7 +259,7 @@ class MetricsRegistry:
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
-            hists = sorted(((k, list(h.counts), h.total, h.count)
+            hists = sorted(((k, h.bounds, list(h.counts), h.total, h.count)
                             for k, h in self._histograms.items()),
                            key=lambda e: e[0])
         lines: List[str] = []
@@ -249,13 +276,13 @@ class MetricsRegistry:
         _family(counters, "counter")
         _family(gauges, "gauge")
         seen = None
-        for (name, labels), counts, total, count in hists:
+        for (name, labels), bounds, counts, total, count in hists:
             pname = _prom_name(name)
             if pname != seen:
                 lines.append(f"# TYPE {pname} histogram")
                 seen = pname
             cum = 0
-            for bound, c in zip(_BOUNDS, counts):
+            for bound, c in zip(bounds, counts):
                 cum += c
                 lines.append(f"{pname}_bucket"
                              f"{_prom_labels(labels, (('le', repr(bound)),))}"

@@ -12,3 +12,9 @@ usual bottleneck.
 
 from photon_ml_tpu.ops.fused_glm import (  # noqa: F401
     eligible, fused_hvp, fused_value_and_grad, has_tpu)
+
+# sibling kernel modules (imported lazily by their callers; listed here for
+# discoverability): ops.soa_newton — the SoA Newton step (Hessian assembly
+# + batched small-Cholesky solve in one VMEM pass, opt/newton_soa.py's hot
+# op); ops.compact_score — the sparse-compact match-dot scorer
+# (models/game.score_compact_sparse's hot op).

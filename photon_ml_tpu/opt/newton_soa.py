@@ -161,6 +161,14 @@ def solve_newton_soa(loss: PointwiseLoss, w0_t: Array, x_t: Array,
     dtype = w0_t.dtype
     c1 = jnp.asarray(config.c1, dtype)
     tol = jnp.asarray(config.tolerance, dtype)
+    # Pallas fast path for the step (TPU, lane-aligned buckets): margins ->
+    # curvature -> Hessian triangle -> Cholesky solve in ONE kernel, the
+    # design streamed through VMEM once per iteration and the [cap, d, L]
+    # xq intermediate never materialized (ops/soa_newton.py; same algorithm,
+    # parity-tested in interpret mode; PHOTON_SOA_DISABLE_PALLAS=1 escape).
+    from photon_ml_tpu.ops import soa_newton
+
+    use_pallas = soa_newton.eligible(d, num_l)
 
     def gnorm(g):
         # L2 norm, matching the vmapped L-BFGS/TRON convergence inputs
@@ -185,11 +193,15 @@ def solve_newton_soa(loss: PointwiseLoss, w0_t: Array, x_t: Array,
         # once too
         w, f, g, reason, iters, k = state
         active = reason == 0
-        hh = _hess(loss, w, x_t, y_t, off_t, wt_t, l2)
-        step = _cholesky_solve_soa(
-            hh, g, jitter * (jnp.abs(jnp.stack([hh[i][i]
-                                                for i in range(d)])).max(0)
-                             + jnp.asarray(1.0, dtype)))
+        if use_pallas:
+            step = soa_newton.newton_step(loss, w, g, x_t, y_t, off_t,
+                                          wt_t, l2)
+        else:
+            hh = _hess(loss, w, x_t, y_t, off_t, wt_t, l2)
+            step = _cholesky_solve_soa(
+                hh, g, jitter * (jnp.abs(jnp.stack([hh[i][i]
+                                                    for i in range(d)])).max(0)
+                                 + jnp.asarray(1.0, dtype)))
         gd = (g * step).sum(0)                     # descent rate, [L] >= 0
 
         def ls_cond(ls):

@@ -54,7 +54,8 @@ import numpy as np
 
 from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.data.reader import EntityIndex
-from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+from photon_ml_tpu.models.game import (CompactRandomEffectModel,
+                                       FixedEffectModel, GameModel,
                                        RandomEffectModel)
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.metrics import ServingMetrics
@@ -165,6 +166,17 @@ class HotSet(NamedTuple):
     slot_of: Dict[int, int]  # entity id -> device row
 
 
+class CompactHotSet(NamedTuple):
+    """The sparse twin: one consistent (indices, values, slot map) triple.
+    ``indices[row]`` are that entity's observed column ids (``dim``-padded,
+    ascending — CompactRandomEffectModel's row layout verbatim), ``values``
+    align.  Replaced atomically as ONE object, same contract as HotSet."""
+
+    indices: Array           # [max(capacity, 1), k] int32 device rows
+    values: Array            # [max(capacity, 1), k] device rows
+    slot_of: Dict[int, int]  # entity id -> device row
+
+
 class RandomCoordinate:
     """One random-effect coordinate: device hot set, host archive, LRU.
 
@@ -180,7 +192,16 @@ class RandomCoordinate:
     entities.  All mutation — counters, promotion/demotion, streaming
     deltas — happens under ``self._lock``; readers take the ``hot``
     snapshot once and are consistent without locking.
+
+    The row REPRESENTATION (dense [d] vectors here, compact (indices,
+    values) pairs in ``CompactRandomCoordinate``) is isolated behind five
+    small hooks — ``_initial_hot``, ``_archive_rows``, ``_scatter_rows``,
+    ``_delta_payload``, ``_write_archive_row`` — so the frequency ranking,
+    hysteresis, move caps and snapshot swap discipline are ONE
+    implementation for both layouts.
     """
+
+    kind = "dense"
 
     def __init__(self, cid: str, feature_shard: str, random_effect_type: str,
                  archive: np.ndarray, archive_slot_of: Dict[int, int],
@@ -192,9 +213,8 @@ class RandomCoordinate:
         self.cid = cid
         self.feature_shard = feature_shard
         self.random_effect_type = random_effect_type
-        self._archive = archive              # [n_ent, d] host rows
+        self._bind_archive(archive)
         self.archive_slot_of = archive_slot_of  # entity id -> archive row
-        self.num_entities, self.dim = archive.shape
         self.hot_capacity = int(hot_capacity)
         self.decay = float(decay)
         self.max_moves = max_moves
@@ -211,16 +231,52 @@ class RandomCoordinate:
         if self.hot_capacity < 1:
             # score_samples clamps missing slots to row 0, which must exist
             # to gather from — an all-cold coordinate serves a zero row
-            table = jnp.zeros((1, self.dim), archive.dtype)
             slot_of: Dict[int, int] = {}
         else:
-            table = jnp.asarray(archive[: self.hot_capacity])
             slot_of = {eid: s for eid, s in archive_slot_of.items()
                        if s < self.hot_capacity}
-        self._hot = HotSet(table, slot_of)
+        self._hot = self._initial_hot(slot_of)
         self.cold = ColdEntityCache(self._fetch_cold, lru_capacity, metrics)
 
-    def _fetch_cold(self, eid: int) -> Optional[np.ndarray]:
+    # -- row-representation hooks (overridden by CompactRandomCoordinate) --
+    def _bind_archive(self, archive: np.ndarray) -> None:
+        self._archive = archive              # [n_ent, d] host rows
+        self.num_entities, self.dim = archive.shape
+
+    def _initial_hot(self, slot_of: Dict[int, int]) -> HotSet:
+        if self.hot_capacity < 1:
+            return HotSet(jnp.zeros((1, self.dim), self._archive.dtype), {})
+        return HotSet(jnp.asarray(self._archive[: self.hot_capacity]),
+                      slot_of)
+
+    def _archive_rows(self, slots: np.ndarray):
+        """Archive rows (whatever the representation) for a slot vector."""
+        return self._archive[slots]
+
+    def _scatter_rows(self, hot, dev_rows: List[int], payload,
+                      slot_of: Dict[int, int]):
+        """New snapshot with ``payload`` scattered at ``dev_rows`` — ONE
+        ``.at[rows].set`` launch per device array, shape unchanged."""
+        rows = jnp.asarray(dev_rows, jnp.int32)
+        return HotSet(hot.table.at[rows].set(jnp.asarray(payload)), slot_of)
+
+    def _delta_payload(self, row: np.ndarray):
+        """Validate/convert one streaming-delta row into archive form."""
+        row = np.asarray(row, dtype=self._archive.dtype)
+        if row.shape != (self.dim,):
+            raise ValueError(
+                f"coordinate {self.cid!r}: delta row has shape {row.shape}, "
+                f"expected ({self.dim},)")
+        return row
+
+    def _write_archive_row(self, slot: int, payload) -> None:
+        self._archive[slot] = payload
+
+    def _stack_rows(self, payloads: list):
+        """Single rows -> the stacked form ``_scatter_rows`` consumes."""
+        return np.stack(payloads)
+
+    def _fetch_cold(self, eid: int):
         slot = self.archive_slot_of.get(eid)
         return None if slot is None else self._archive[slot]
 
@@ -326,15 +382,13 @@ class RandomCoordinate:
             promote = [int(e) for e in promote]
             demote = [int(e) for e in demote]
             rows = [current[e] for e in demote]
-            new_rows = self._archive[self._slot_arr[promote]]
-            table = self._hot.table.at[jnp.asarray(rows, jnp.int32)].set(
-                jnp.asarray(new_rows))
+            new_rows = self._archive_rows(self._slot_arr[promote])
             slot_of = dict(current)
             for e in demote:
                 del slot_of[e]
             for e, r in zip(promote, rows):
                 slot_of[e] = r
-            self._hot = HotSet(table, slot_of)
+            self._hot = self._scatter_rows(self._hot, rows, new_rows, slot_of)
         for e in promote:  # device copy supersedes any LRU copy
             self.cold.invalidate(e)
         return len(promote), len(demote)
@@ -343,28 +397,134 @@ class RandomCoordinate:
     def apply_delta(self, eid: int, row: np.ndarray) -> bool:
         """Replace one entity's coefficient row in place (online learning).
 
-        Updates the host archive, scatters into the device table when the
-        entity is resident, and invalidates its LRU entry — the next
-        resolve serves the new row whichever tier it lands on.  Returns
-        False for an entity this coordinate never trained (serving never
-        grows the training-time index)."""
-        row = np.asarray(row, dtype=self._archive.dtype)
-        if row.shape != (self.dim,):
-            raise ValueError(
-                f"coordinate {self.cid!r}: delta row has shape {row.shape}, "
-                f"expected ({self.dim},)")
+        ``row`` is always a DENSE [dim] vector on the wire (the trainer's
+        natural output); the representation hook converts it — the compact
+        coordinate compacts it to (indices, values) under its per-row
+        capacity.  Updates the host archive, scatters into the device table
+        when the entity is resident, and invalidates its LRU entry — the
+        next resolve serves the new row whichever tier it lands on.
+        Returns False for an entity this coordinate never trained (serving
+        never grows the training-time index)."""
+        payload = self._delta_payload(row)
         with self._lock:
             slot = self.archive_slot_of.get(eid)
             if slot is None:
                 return False
-            self._archive[slot] = row
+            self._write_archive_row(slot, payload)
             dev = self._hot.slot_of.get(eid)
             if dev is not None:
-                self._hot = HotSet(
-                    self._hot.table.at[dev].set(jnp.asarray(row)),
+                self._hot = self._scatter_rows(
+                    self._hot, [dev], self._stack_rows([payload]),
                     self._hot.slot_of)
         self.cold.invalidate(eid)
         return True
+
+
+class CompactRandomCoordinate(RandomCoordinate):
+    """Sparse/compact random-effect coordinate: wide-vocabulary entities
+    served NATIVELY from device-resident (indices, values) hot rows — no
+    ``.to_dense()`` [E, d_vocab] stack ever exists on host or device.
+
+    The archive is the CompactRandomEffectModel's columnar pair ([E, k]
+    int32 column ids padded with ``dim`` + aligned values, exactly the
+    container the trainer publishes); the hot set is the same pair's first
+    ``hot_capacity`` rows, swapped/rebalanced/delta-patched by the
+    inherited frequency machinery with both device arrays replaced as ONE
+    ``CompactHotSet`` snapshot.  The engine scores hot rows with the SAME
+    compact gather kernel batch scoring uses (models/game
+    .score_compact_dense) and cold/overflow rows with the identical math on
+    per-sample rows, so compact serving is bitwise the compact batch score.
+
+    Streaming deltas stay dense-[dim] on the wire; rows compact here and a
+    delta with more nonzeros than the model's per-row capacity ``k`` is
+    refused loudly (growing k would change every AOT executable's shapes —
+    the zero-recompile contract; retrain or hot-swap into a roomier k)."""
+
+    kind = "compact"
+
+    def __init__(self, cid: str, feature_shard: str, random_effect_type: str,
+                 archive_indices: np.ndarray, archive_values: np.ndarray,
+                 dim: int, archive_slot_of: Dict[int, int],
+                 hot_capacity: int, lru_capacity: int,
+                 metrics: Optional[ServingMetrics] = None,
+                 decay: float = 0.5,
+                 max_moves: Optional[int] = None,
+                 tracked_max: Optional[int] = None):
+        self._full_dim = int(dim)
+        super().__init__(cid, feature_shard, random_effect_type,
+                         (archive_indices, archive_values), archive_slot_of,
+                         hot_capacity, lru_capacity, metrics=metrics,
+                         decay=decay, max_moves=max_moves,
+                         tracked_max=tracked_max)
+
+    # -- row-representation hooks -----------------------------------------
+    def _bind_archive(self, archive) -> None:
+        idx, val = archive
+        if idx.shape != val.shape:
+            raise ValueError(
+                f"coordinate {self.cid!r}: indices {idx.shape} != values "
+                f"{val.shape}")
+        self._archive_idx = np.asarray(idx, np.int32)
+        self._archive_val = np.asarray(val)
+        self.num_entities, self.k = self._archive_idx.shape
+        self.dim = self._full_dim  # full vocabulary width (shard contract)
+
+    def _initial_hot(self, slot_of: Dict[int, int]) -> CompactHotSet:
+        if self.hot_capacity < 1:
+            # row 0 must exist to gather from; all-dim indices are inert
+            return CompactHotSet(
+                jnp.full((1, self.k), self.dim, jnp.int32),
+                jnp.zeros((1, self.k), self._archive_val.dtype), {})
+        return CompactHotSet(
+            jnp.asarray(self._archive_idx[: self.hot_capacity]),
+            jnp.asarray(self._archive_val[: self.hot_capacity]), slot_of)
+
+    def _archive_rows(self, slots: np.ndarray):
+        return self._archive_idx[slots], self._archive_val[slots]
+
+    def _scatter_rows(self, hot: CompactHotSet, dev_rows: List[int], payload,
+                      slot_of: Dict[int, int]) -> CompactHotSet:
+        idx, val = payload
+        rows = jnp.asarray(dev_rows, jnp.int32)
+        # two scatters, ONE snapshot swap — readers hold the triple and can
+        # never pair new values with old column ids
+        return CompactHotSet(hot.indices.at[rows].set(jnp.asarray(idx)),
+                             hot.values.at[rows].set(jnp.asarray(val)),
+                             slot_of)
+
+    def _delta_payload(self, row: np.ndarray):
+        row = np.asarray(row, dtype=self._archive_val.dtype)
+        if row.shape != (self.dim,):
+            raise ValueError(
+                f"coordinate {self.cid!r}: delta row has shape {row.shape}, "
+                f"expected ({self.dim},)")
+        cols = np.nonzero(row)[0]
+        if len(cols) > self.k:
+            raise ValueError(
+                f"coordinate {self.cid!r}: delta row has {len(cols)} nonzero "
+                f"coefficients but this compact store's per-row capacity is "
+                f"{self.k} — truncation would silently change scores (hot-"
+                "swap a model rebuilt with a larger capacity instead)")
+        idx = np.full(self.k, self.dim, np.int32)
+        val = np.zeros(self.k, self._archive_val.dtype)
+        idx[: len(cols)] = cols.astype(np.int32)
+        val[: len(cols)] = row[cols]
+        return idx, val
+
+    def _write_archive_row(self, slot: int, payload) -> None:
+        idx, val = payload
+        self._archive_idx[slot] = idx
+        self._archive_val[slot] = val
+
+    def _stack_rows(self, payloads: list):
+        return (np.stack([p[0] for p in payloads]),
+                np.stack([p[1] for p in payloads]))
+
+    def _fetch_cold(self, eid: int):
+        slot = self.archive_slot_of.get(eid)
+        if slot is None:
+            return None
+        return self._archive_idx[slot], self._archive_val[slot]
 
 
 class CoefficientStore:
@@ -448,12 +608,33 @@ class CoefficientStore:
                     decay=config.hot_decay,
                     max_moves=config.hot_max_moves,
                     tracked_max=config.hot_tracked_max)
+            elif isinstance(m, CompactRandomEffectModel):
+                # wide-vocabulary sparse rows serve NATIVELY: the columnar
+                # (indices, values) pair goes device-resident as-is — no
+                # [E, d_vocab] .to_dense() stack, on host or device
+                idx = np.asarray(m.indices)
+                n_ent = idx.shape[0]
+                _shard_dim(m.feature_shard, m.dim, cid)
+                hot = n_ent if config.device_capacity is None else min(
+                    config.device_capacity, n_ent)
+                coordinates[cid] = CompactRandomCoordinate(
+                    cid=cid, feature_shard=m.feature_shard,
+                    random_effect_type=m.random_effect_type,
+                    archive_indices=np.array(idx),   # own: deltas mutate
+                    archive_values=np.array(np.asarray(m.values)),
+                    dim=m.dim,
+                    archive_slot_of=dict(m.slot_of),
+                    hot_capacity=hot,
+                    lru_capacity=config.lru_capacity,
+                    metrics=metrics,
+                    decay=config.hot_decay,
+                    max_moves=config.hot_max_moves,
+                    tracked_max=config.hot_tracked_max)
             else:
                 raise ValueError(
-                    f"coordinate {cid!r}: serving supports FixedEffectModel "
-                    f"and dense RandomEffectModel (got {type(m).__name__}); "
-                    "convert compact models with .to_dense(), or see "
-                    "ROADMAP's sparse-serving follow-on")
+                    f"coordinate {cid!r}: serving supports FixedEffectModel, "
+                    f"dense RandomEffectModel and CompactRandomEffectModel "
+                    f"(got {type(m).__name__})")
         for shard, d in shard_dims.items():
             imap = index_maps.get(shard)
             if imap is None:
@@ -483,6 +664,10 @@ class CoefficientStore:
             if isinstance(c, FixedCoordinate):
                 parts.append(("fixed", cid, c.feature_shard,
                               c.weights.shape, str(c.weights.dtype)))
+            elif isinstance(c, CompactRandomCoordinate):
+                hs = c.hot
+                parts.append(("compact", cid, c.feature_shard, c.dim,
+                              hs.indices.shape, str(hs.values.dtype)))
             else:
                 parts.append(("random", cid, c.feature_shard,
                               c.table.shape, str(c.table.dtype)))
@@ -516,14 +701,27 @@ class CoefficientStore:
         coefficient row (zeros for hot/unknown samples); the engine adds
         ``einsum('nd,nd->n', x, overflow)`` so a cold entity scores exactly
         as if its row were in the device table.  Every real lookup feeds
-        the coordinate's EWMA hit counters (the rebalance signal)."""
+        the coordinate's EWMA hit counters (the rebalance signal).
+
+        COMPACT coordinates return ``(CompactHotSet, slots, (ov_idx,
+        ov_val))`` instead: the snapshot is the (indices, values) pair and
+        the overflow is per-sample compact rows ([n, k] ``dim``-padded ids
+        + values, inert for hot/unknown samples) that the engine scores
+        with the same compact gather the device rows use."""
         c = self.coordinates[cid]
         n_real = len(entity_names)
         n_rows = n_real if n_rows is None else n_rows
-        with obs_span("store.resolve", coordinate=cid, rows=n_real):
+        compact = isinstance(c, CompactRandomCoordinate)
+        with obs_span("store.resolve", coordinate=cid, rows=n_real,
+                      kind=c.kind if isinstance(c, RandomCoordinate)
+                      else "fixed"):
             hs = c.hot
             slots = np.full(n_rows, -1, np.int32)
-            overflow = np.zeros((n_rows, c.dim), hs.table.dtype)
+            if compact:
+                ov_idx = np.full((n_rows, c.k), c.dim, np.int32)
+                ov_val = np.zeros((n_rows, c.k), hs.values.dtype)
+            else:
+                overflow = np.zeros((n_rows, c.dim), hs.table.dtype)
             misses = hot_hits = 0
             hits: Dict[int, int] = {}
             for i, name in enumerate(entity_names):
@@ -540,6 +738,8 @@ class CoefficientStore:
                 row = c.cold.get(eid)
                 if row is None:
                     misses += 1
+                elif compact:
+                    ov_idx[i], ov_val[i] = row
                 else:
                     overflow[i] = row
             c.record_hits(hits)
@@ -548,6 +748,8 @@ class CoefficientStore:
                     metrics.inc("entity_misses", misses)
                 if hot_hits:
                     metrics.inc("hot_hits", hot_hits)
+            if compact:
+                return hs, slots, (ov_idx, ov_val)
             return hs.table, slots, overflow
 
     # -- residency management ----------------------------------------------

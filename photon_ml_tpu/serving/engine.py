@@ -42,6 +42,7 @@ from photon_ml_tpu.parallel.bucketing import score_samples
 from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,
                                            Request, densify_features)
 from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     CompactRandomCoordinate,
                                                      FixedCoordinate)
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.utils.logging import Timed
@@ -106,7 +107,10 @@ class ScoringEngine:
         return self.compile_count - before
 
     def _abstract_args(self, store: CoefficientStore, bucket: int):
-        """ShapeDtypeStructs matching _concrete_args."""
+        """ShapeDtypeStructs matching _concrete_args.  Compact coordinates
+        ride the SAME (tables, slots, overflows) argument slots with
+        (indices, values) PAIRS as the pytree leaves — one executable
+        signature for every coordinate mix."""
         s = jax.ShapeDtypeStruct
         x_dt = np.dtype(store.config.x_dtype)
         xs = {shard: s((bucket, d), x_dt)
@@ -116,6 +120,13 @@ class ScoringEngine:
             c = store.coordinates[cid]
             if isinstance(c, FixedCoordinate):
                 fixed_ws.append(s(c.weights.shape, c.weights.dtype))
+            elif isinstance(c, CompactRandomCoordinate):
+                hs = c.hot
+                tables.append((s(hs.indices.shape, hs.indices.dtype),
+                               s(hs.values.shape, hs.values.dtype)))
+                slots.append(s((bucket,), np.dtype(np.int32)))
+                overflows.append((s((bucket, c.k), np.dtype(np.int32)),
+                                  s((bucket, c.k), hs.values.dtype)))
             else:
                 tables.append(s(c.table.shape, c.table.dtype))
                 slots.append(s((bucket,), np.dtype(np.int32)))
@@ -124,18 +135,40 @@ class ScoringEngine:
 
     def _build_fn(self, store: CoefficientStore, bucket: int):
         order = list(store.order)
-        kinds = [(cid, isinstance(store.coordinates[cid], FixedCoordinate),
+
+        def _kind(c):
+            if isinstance(c, FixedCoordinate):
+                return "fixed"
+            return "compact" if isinstance(c, CompactRandomCoordinate) \
+                else "dense"
+
+        kinds = [(cid, _kind(store.coordinates[cid]),
                   store.coordinates[cid].feature_shard) for cid in order]
 
         def fn(xs, fixed_ws, tables, slots, overflows):
+            from photon_ml_tpu.models.game import score_compact_dense
+
             margins = []
             fi = ri = 0
-            for cid, is_fixed, shard in kinds:
+            for cid, kind, shard in kinds:
                 x = xs[shard]
-                if is_fixed:
+                if kind == "fixed":
                     # == models/glm.Coefficients.score (x @ means)
                     margins.append(x @ fixed_ws[fi])
                     fi += 1
+                elif kind == "compact":
+                    # the SAME compact gather kernel batch scoring uses
+                    # (models/game.score_compact_dense) for the hot rows,
+                    # and the identical math on per-sample overflow rows
+                    # (slots = iota: row i scores its own cold row; dim-
+                    # padded hot/unknown rows contribute exactly 0.0)
+                    t_idx, t_val = tables[ri]
+                    o_idx, o_val = overflows[ri]
+                    m = score_compact_dense(t_idx, t_val, slots[ri], x)
+                    cold = score_compact_dense(
+                        o_idx, o_val, jnp.arange(bucket, dtype=jnp.int32), x)
+                    margins.append(m + cold)
+                    ri += 1
                 else:
                     m = score_samples(tables[ri], slots[ri], x)
                     margins.append(m + _cold_margin(x, overflows[ri]))
@@ -215,7 +248,13 @@ class ScoringEngine:
                 # never pair these slots with a different table
                 tbl, sl, ov = store.resolve(cid, names, n_rows=bucket,
                                             metrics=self.metrics)
-                tables.append(tbl)
+                if isinstance(c, CompactRandomCoordinate):
+                    # compact snapshot -> the (indices, values) leaf pair
+                    # _build_fn's compact branch consumes; overflow is
+                    # already the ([n, k], [n, k]) pair
+                    tables.append((tbl.indices, tbl.values))
+                else:
+                    tables.append(tbl)
                 slots.append(sl)
                 overflows.append(ov)
         return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))

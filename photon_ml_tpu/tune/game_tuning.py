@@ -77,9 +77,9 @@ class GameEstimatorEvaluationFunction:
             from photon_ml_tpu.game.fused import FusedSweep
             from photon_ml_tpu.types import VarianceComputationType
 
-            if self.base_config.num_outer_iterations > 1 and any(
-                    c.variance != VarianceComputationType.NONE
-                    for c in self.base_config.coordinates.values()):
+            needs_var = any(c.variance != VarianceComputationType.NONE
+                            for c in self.base_config.coordinates.values())
+            if self.base_config.num_outer_iterations > 1 and needs_var:
                 # multi-iteration fused tuning runs via per-iteration
                 # snapshots, which don't carry variances (FusedSweep
                 # .run_snapshots) — host path keeps exact semantics
@@ -99,7 +99,13 @@ class GameEstimatorEvaluationFunction:
                 # once per tuning iteration
                 carry0 = (sweep.init_carry(self.initial_model)
                           if self.initial_model is not None else None)
-                self._sweep = (sweep, carry0)
+                # variance-free tuning runs FULLY fused: held-out scoring +
+                # best-iteration selection ride the validated program
+                # (run_validated); variance-computing single-iteration
+                # configs keep the run() + host-evaluate path (plan=None)
+                plan = (None if needs_var else sweep.validation_plan(
+                    self.validation_data, self.estimator.validation_suite))
+                self._sweep = (sweep, carry0, plan)
             except NotImplementedError:
                 self._sweep = False  # un-fusable coordinate: host path
                 return None
@@ -139,9 +145,21 @@ class GameEstimatorEvaluationFunction:
         fused_ok = (not self.locked and self.estimator.fused is not False)
         sweep = self._fused_sweep() if fused_ok else None
         if sweep is not None:
-            sweep_obj, carry0 = sweep
+            sweep_obj, carry0, plan = sweep
             regs = [config.coordinates[cid].reg for cid in config.coordinates]
             t0 = time.perf_counter()
+            if plan is not None:
+                # fully fused validated fit: training, held-out scoring and
+                # per-update losses in ONE compiled program; the suite runs
+                # per sweep boundary on the stacked in-program scores
+                model, _evals, best_ev, _losses = sweep_obj.run_validated(
+                    plan, initial=self.initial_model, carry0=carry0,
+                    regs=regs, seed=self.seed)
+                self.fit_seconds += time.perf_counter() - t0
+                self.results.append(GameFitResult(
+                    model=model, config=config, evaluation=best_ev,
+                    history=DescentHistory()))
+                return best_ev.primary
             if config.num_outer_iterations == 1:
                 model, _scores = sweep_obj.run(initial=self.initial_model,
                                                carry0=carry0, regs=regs,
@@ -179,7 +197,7 @@ class GameEstimatorEvaluationFunction:
         sweep = self._fused_sweep() if fused_ok else None
         if sweep is None or len(params_batch) == 1:
             return [self(p) for p in params_batch]
-        sweep_obj, carry0 = sweep
+        sweep_obj, carry0, _plan = sweep  # grid fits host-evaluate snapshots
         configs = [self.config_for(p) for p in params_batch]
         regs_grid = [[c.coordinates[cid].reg for cid in c.coordinates]
                      for c in configs]

@@ -109,10 +109,13 @@ def test_glmix_recovers_fixed_coefficients(rng):
 
 
 def test_descent_converges_training_loss(rng):
-    """Each outer iteration must not worsen the training objective."""
+    """Each outer iteration must not worsen the training objective.
+    fused=False: the per-update validation entries this asserts live in the
+    HOST loop's history (the fused validated program tracks per-update
+    losses in-program instead — tests/test_solve_path.py)."""
     data, *_ = _glmix_data(rng, n_users=8, per_user=40)
     suite = EvaluationSuite.from_specs(["logistic_loss"])
-    est = GameEstimator(validation_suite=suite)
+    est = GameEstimator(validation_suite=suite, fused=False)
     res = est.fit(data, [_configs(num_iters=3)], validation_data=data)[0]
     losses = [s["validation"].values["logistic_loss"] for s in res.history.steps]
     assert losses[-1] <= losses[0]
@@ -496,19 +499,24 @@ def test_estimator_fused_auto_matches_host(rng):
     np.testing.assert_allclose(m_auto["per-user"].w_stack,
                                m_host["per-user"].w_stack, rtol=2e-3, atol=2e-3)
 
-    # validation present -> auto falls back to the host loop (metrics needed)
+    # validation present -> the fused VALIDATED program (held-out scoring
+    # in-program, suite evaluated per sweep boundary) — evaluation attached
     suite = EvaluationSuite.from_specs(["auc"])
     r = GameEstimator(validation_suite=suite, fused="auto").fit(
         data, [cfg], validation_data=data)[0]
     assert r.evaluation is not None
+    r_true = GameEstimator(validation_suite=suite, fused=True).fit(
+        data, [cfg], validation_data=data)[0]
+    assert r_true.evaluation is not None
 
-    # fused=True raises when the fit needs per-update host work
+    # fused=True still raises on genuinely host-paced per-update work
     with pytest.raises(ValueError):
         GameEstimator(validation_suite=suite, fused=True).fit(
-            data, [cfg], validation_data=data)
+            data, [cfg], validation_data=data,
+            checkpoint_hook=lambda m, cur, **kw: None)
 
     # every coordinate flavor is now fused-eligible; ineligibility is only
-    # per-fit host work (validation/checkpoint/locks), asserted above
+    # per-fit host work (checkpoint/locks/resume), asserted above
 
 
 def test_reg_grid_reuses_compiled_programs(rng):

@@ -413,9 +413,11 @@ class TestDescentTrace:
         reg = MetricsRegistry()
         prev = obs.set_registry(reg)
         try:
-            # --validation-data routes through CoordinateDescent.run (the
-            # fused sweep is one device program with a single span)
+            # --fused off pins the host-paced CoordinateDescent.run — the
+            # per-update span nesting under test lives there (validated
+            # fits otherwise run as one fused program with a single span)
             rc = train_cli.run([
+                "--fused", "off",
                 "--train-data", data, "--validation-data", val,
                 "--evaluators", "auc", "--feature-shards", "all",
                 "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",

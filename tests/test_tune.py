@@ -178,7 +178,7 @@ def test_multi_iteration_fused_tuning_matches_host(rng):
         assert abs(v_fused - v_host) < 2e-3, params
     # the fused path really did share one sweep (not the host fallback)
     assert fn_fused._sweep not in (None, False)
-    sweep, _ = fn_fused._sweep
+    sweep, _, _plan = fn_fused._sweep
     snaps = sweep.run_snapshots()
     assert len(snaps) == 2  # one full model per outer iteration
     assert set(snaps[0].models) == {"fixed", "per-user"}
