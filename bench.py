@@ -1403,6 +1403,49 @@ RUNNERS = {
     "glmix_chip": lambda p, s: run_glmix_chip(p, s),
 }
 
+def _synthetic_serving_engine(rng, n_entities, d, max_batch,
+                              device_capacity=None):
+    """Build the serving benches' in-memory 2-coordinate GLMix engine
+    (fixed + per-user effects, no training, no disk).  Consumes from
+    ``rng`` in a fixed order, so callers seeding identically get identical
+    models.  Returns (engine, metrics, feature_names)."""
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.serving.batcher import BucketedBatcher
+    from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                         StoreConfig)
+    from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    from photon_ml_tpu.types import TaskType
+
+    names = [f"f{j}" for j in range(d)]
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
+    eidx = EntityIndex()
+    for i in range(n_entities):
+        eidx.get_or_add(f"user{i}")
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=d)),
+            feature_shard="all", task=task),
+        "per_user": RandomEffectModel(
+            w_stack=rng.normal(size=(n_entities, d)) * 0.1,
+            slot_of={i: i for i in range(n_entities)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    })
+    metrics = ServingMetrics()
+    store = CoefficientStore.from_model(
+        model, task, {"userId": eidx}, {"all": imap},
+        config=StoreConfig(device_capacity=device_capacity),
+        version="synthetic", metrics=metrics)
+    engine = ScoringEngine(store, BucketedBatcher(max_batch),
+                           metrics=metrics)
+    return engine, metrics, names
+
+
 def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
                       device_capacity=None, seed=0, out_path=None,
                       zipf=0.0, deadline_us=200.0, rebalance_every=500):
@@ -1436,43 +1479,15 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     """
     import jax
 
-    from photon_ml_tpu.data.index_map import IndexMap, feature_key
-    from photon_ml_tpu.data.reader import EntityIndex
-    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
-                                           RandomEffectModel)
-    from photon_ml_tpu.models.glm import Coefficients
-    from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
-    from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
-                                                         StoreConfig)
-    from photon_ml_tpu.serving.engine import ScoringEngine
-    from photon_ml_tpu.serving.metrics import ServingMetrics
-    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.serving.batcher import Request
 
     if zipf and device_capacity is None:
         device_capacity = max(64, n_entities // 10)
 
     rng = np.random.default_rng(seed)
-    names = [f"f{j}" for j in range(d)]
-    imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
-    eidx = EntityIndex()
-    for i in range(n_entities):
-        eidx.get_or_add(f"user{i}")
-    task = TaskType.LOGISTIC_REGRESSION
-    model = GameModel(models={
-        "fixed": FixedEffectModel(
-            coefficients=Coefficients(means=rng.normal(size=d)),
-            feature_shard="all", task=task),
-        "per_user": RandomEffectModel(
-            w_stack=rng.normal(size=(n_entities, d)) * 0.1,
-            slot_of={i: i for i in range(n_entities)},
-            random_effect_type="userId", feature_shard="all", task=task),
-    })
-    metrics = ServingMetrics()
-    store = CoefficientStore.from_model(
-        model, task, {"userId": eidx}, {"all": imap},
-        config=StoreConfig(device_capacity=device_capacity),
-        version="synthetic", metrics=metrics)
-    engine = ScoringEngine(store, BucketedBatcher(max_batch), metrics=metrics)
+    engine, metrics, names = _synthetic_serving_engine(
+        rng, n_entities, d, max_batch, device_capacity)
+    store = engine.store
 
     t0 = time.perf_counter()
     n_compiled = engine.warm()
@@ -1601,6 +1616,120 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     if out_path is None:
         out_path = os.path.join(
             _REPO, f"BENCH_SERVING_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
+def run_open_loop_bench(n_entities=5000, d=16, max_batch=64, seed=0,
+                        duration_s=2.5, rates=None, rate_multipliers=None,
+                        n_connections=4, budget_ms=25.0, deadline_us=200.0,
+                        max_requests_per_rate=20000, out_path=None):
+    """`bench.py --serving --open-loop`: latency-under-overload ->
+    BENCH_NET_<backend>.json.
+
+    The closed-loop serving bench (``run_serving_bench``) self-throttles:
+    when the engine slows down, the submit loop slows with it, so queueing
+    never builds and p99 looks flat through saturation (the Spark-perf
+    study's critique in PAPERS.md).  This bench drives the full network
+    edge — ``serving.frontend.FrontendServer`` on a localhost socket —
+    with a POISSON ARRIVAL PROCESS whose rate is fixed in advance
+    (``serving.frontend.loadgen``), sweeping rates below, near, and past
+    the engine's calibrated saturation point.  Per rate it records client-
+    observed p50/p99/p999 and the shed rate.  The acceptance shape: shed
+    ≈ 0 below saturation; past saturation the admission controller sheds
+    the excess and p99 stays bounded near the deadline budget instead of
+    growing with the (unbounded) backlog an open loop would otherwise
+    build.
+
+    ``rates``: explicit arrival rates in qps, or ``rate_multipliers``
+    (default 0.25/0.7/1.5) times the calibrated capacity.  Calibration:
+    the median wall time of a full top-bucket ``score_requests`` launch
+    gives the engine's peak qps; the edge saturates below that (wire +
+    JSON + event-loop overhead), which is why "near" sits at 0.7.
+    """
+    import asyncio
+
+    import jax
+
+    from photon_ml_tpu.serving.frontend import (AdmissionConfig,
+                                                FrontendConfig,
+                                                ThreadedFrontend,
+                                                run_open_loop)
+    from photon_ml_tpu.serving.frontend.loadgen import \
+        measure_closed_loop_capacity
+
+    rng = np.random.default_rng(seed)
+    engine, metrics, names = _synthetic_serving_engine(
+        rng, n_entities, d, max_batch, device_capacity=None)
+    t0 = time.perf_counter()
+    n_compiled = engine.warm()
+    warm_s = time.perf_counter() - t0
+
+    # request pool: assembled up front so the send path (which must hit
+    # the Poisson schedule) does no rng work per arrival
+    pool = [{"features": [[n, float(v)] for n, v in
+             zip(names, rng.normal(size=d))],
+             "ids": {"userId": f"user{rng.integers(n_entities)}"}}
+            for _ in range(256)]
+
+    def make_request(uid):
+        req = dict(pool[uid % len(pool)])
+        req["uid"] = uid
+        return req
+
+    front = ThreadedFrontend(engine, config=FrontendConfig(
+        admission=AdmissionConfig(budget_s=budget_ms * 1e-3),
+        batcher_deadline_s=deadline_us * 1e-6,
+        flush_threshold=max_batch)).start()
+    sweep = []
+    try:
+        # -- calibrate against the EDGE, closed-loop through the socket
+        # (json + wire + loop + batcher + engine); also warms the flush-
+        # cost EWMA so admission enters the sweep with real observations
+        capacity_qps = asyncio.run(measure_closed_loop_capacity(
+            "127.0.0.1", front.port, make_request, window=2 * max_batch))
+        print(json.dumps({"capacity_qps": round(capacity_qps, 1)}),
+              file=sys.stderr)
+
+        if rates is None:
+            mults = rate_multipliers or (0.25, 0.7, 1.5)
+            labels = {0: "below", 1: "near", 2: "past"}
+            rates = [(labels.get(i, f"x{m}"), m * capacity_qps)
+                     for i, m in enumerate(sorted(mults))]
+        else:
+            rates = [(f"r{int(r)}", float(r)) for r in rates]
+
+        for i, (label, rate) in enumerate(rates):
+            dur = min(duration_s, max_requests_per_rate / rate)
+            res = asyncio.run(run_open_loop(
+                "127.0.0.1", front.port, rate, dur, make_request,
+                n_connections=n_connections,
+                rng=np.random.default_rng(seed + 1000 + i)))
+            point = {"label": label, **res.to_json()}
+            sweep.append(point)
+            print(json.dumps(point), file=sys.stderr)
+    finally:
+        front.stop()
+
+    shed_series = metrics.registry.counter_series("requests_shed_total")
+    out = {
+        "metric": "open_loop_p99_past_saturation", "unit": "ms",
+        "value": sweep[-1]["latency_ms"]["p99"] if sweep else 0.0,
+        "backend": jax.default_backend(),
+        "n_entities": n_entities, "d": d, "max_batch": max_batch,
+        "budget_ms": budget_ms, "deadline_us": deadline_us,
+        "n_connections": n_connections,
+        "capacity_qps": round(capacity_qps, 1),
+        "warm": {"executables": n_compiled, "seconds": round(warm_s, 4)},
+        "sweep": sweep,
+        "shed_counters": {
+            ",".join(f"{k}={v}" for k, v in lk) or "total": n
+            for lk, n in shed_series.items()},
+    }
+    if out_path is None:
+        out_path = os.path.join(_REPO,
+                                f"BENCH_NET_{jax.default_backend()}.json")
     with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
     return out
@@ -2005,6 +2134,21 @@ def main():
                          "frequency-ranked hot set")
     ap.add_argument("--serving-deadline-us", type=float, default=200.0,
                     help="with --serving: async batcher deadline")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="with --serving: open-loop (Poisson arrival-rate "
+                         "driven) overload sweep against the network front "
+                         "end — p50/p99/p999 + shed rate per arrival rate "
+                         "-> BENCH_NET_<backend>.json")
+    ap.add_argument("--open-loop-rates", default="",
+                    help="comma list of arrival rates in qps (default: "
+                         "0.25/0.7/1.5 x the calibrated engine capacity "
+                         "= below/near/past saturation)")
+    ap.add_argument("--open-loop-duration", type=float, default=2.5,
+                    help="seconds of Poisson arrivals per rate point")
+    ap.add_argument("--open-loop-connections", type=int, default=4,
+                    help="client connections the arrivals spread across")
+    ap.add_argument("--open-loop-budget-ms", type=float, default=25.0,
+                    help="front-end admission deadline budget")
     ap.add_argument("--solve", action="store_true",
                     help="per-entity solve-path micro-bench (SoA Newton "
                          "lanes/sec, host vs fused vs fused-validated sweep "
@@ -2032,6 +2176,19 @@ def main():
     if a.solve:
         print(json.dumps(run_solve_bench(out_path=a.out)))
         return
+    if a.serving and a.open_loop:
+        rates = [float(r) for r in a.open_loop_rates.split(",")
+                 if r.strip()] or None
+        print(json.dumps(run_open_loop_bench(
+            n_entities=a.serving_entities,
+            rates=rates, duration_s=a.open_loop_duration,
+            n_connections=a.open_loop_connections,
+            budget_ms=a.open_loop_budget_ms,
+            deadline_us=a.serving_deadline_us,
+            out_path=a.out)))
+        return
+    if a.open_loop:
+        ap.error("--open-loop requires --serving")
     if a.serving:
         print(json.dumps(run_serving_bench(
             n_entities=a.serving_entities, n_requests=a.serving_requests,

@@ -46,19 +46,35 @@ order.  Every command drains pending requests first, so everything
 submitted before a swap/delta line scores on the pre-swap/pre-delta
 coefficients.  Programmatic use: ``build_server`` returns the (engine,
 swapper) pair without touching stdio.
+
+``--listen host:port`` serves the SAME wire protocol over TCP instead of
+stdio, through the ``serving.frontend`` edge: many concurrent clients,
+deadline-budget admission control (``{"error": "overloaded",
+"retry_after_ms": ...}`` when the predicted queue wait exceeds
+``--admission-budget-ms``), per-client round-robin fairness, and graceful
+drain on swap / ``{"cmd": "shutdown"}`` / SIGTERM.  ``--metrics-port``
+additionally exposes ``GET /metrics`` (Prometheus text exposition) on
+localhost in either mode.  Input lines in both modes are byte-bounded
+(``--max-line-bytes``): an oversized line gets an ``{"error": ...}`` reply
+and the stream keeps going.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import collections
 import json
 import logging
+import signal
 import sys
 from typing import IO, List, Optional, Sequence, Tuple
 
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.batcher import BucketedBatcher, request_from_json
+from photon_ml_tpu.serving.frontend.protocol import (DEFAULT_MAX_LINE_BYTES,
+                                                     LineTooLong,
+                                                     iter_bounded_lines)
 from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
                                                      HotSetManager,
                                                      StoreConfig)
@@ -110,6 +126,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "(first request per bucket then pays the compile)")
     p.add_argument("--requests", default="-",
                    help="JSON-lines request file ('-' = stdin)")
+    p.add_argument("--listen", default="",
+                   help="host:port — serve the wire protocol over TCP "
+                        "through the serving.frontend edge (admission "
+                        "control, per-client fairness, graceful drain) "
+                        "instead of stdio; port 0 picks an ephemeral port "
+                        "(logged at startup)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="expose GET /metrics (Prometheus text exposition) "
+                        "and /metrics.json on this localhost port "
+                        "(0 = off; in --listen mode it shares the event "
+                        "loop, in stdio mode it runs on a sidecar thread)")
+    p.add_argument("--max-line-bytes", type=int,
+                   default=DEFAULT_MAX_LINE_BYTES,
+                   help="hard per-line byte bound on every input stream; "
+                        "an oversized line is discarded with an "
+                        "{\"error\": ...} reply and the stream survives")
+    p.add_argument("--admission-budget-ms", type=float, default=50.0,
+                   help="--listen mode: per-request deadline budget; "
+                        "requests predicted to wait longer are shed with "
+                        "{\"error\": \"overloaded\", \"retry_after_ms\"...}")
+    p.add_argument("--resume-fraction", type=float, default=0.5,
+                   help="--listen mode: hysteresis low watermark as a "
+                        "fraction of the budget — shedding latches until "
+                        "the predicted wait drops below this")
+    p.add_argument("--dispatch-window", type=int, default=0,
+                   help="--listen mode: max requests resident in the "
+                        "batcher at once; the rest queue per-client where "
+                        "round-robin fairness applies (0 = 2 flush waves)")
     p.add_argument("--metrics-json", default="",
                    help="write the final metrics snapshot here at exit")
     p.add_argument("--trace", action="store_true",
@@ -152,7 +196,8 @@ def build_server(model_dir: str,
 def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                   out: IO, predict_mean: bool,
                   deadline_s: float = 500e-6,
-                  sync: bool = False) -> int:
+                  sync: bool = False,
+                  max_line_bytes: int = DEFAULT_MAX_LINE_BYTES) -> int:
     """Drive the engine from a JSON-lines stream.
 
     Async (default): each request is submitted to an AsyncBatcher and its
@@ -197,7 +242,14 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
             drain(block=True)
 
     try:
-        for line in lines:
+        for line in iter_bounded_lines(lines, max_line_bytes):
+            if isinstance(line, LineTooLong):
+                # oversized line: already discarded through its newline by
+                # the bounded reader — reply and keep serving
+                logger.error("dropped oversized line: %s", line)
+                out.write(json.dumps({"error": str(line)}) + "\n")
+                out.flush()
+                continue
             line = line.strip()
             if not line:
                 flush()
@@ -276,6 +328,60 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
     return 0
 
 
+def _parse_listen(listen: str) -> Tuple[str, int]:
+    host, sep, port = listen.rpartition(":")
+    if not sep:
+        raise ValueError(f"--listen wants host:port, got {listen!r}")
+    return host or "127.0.0.1", int(port)
+
+
+def _run_network(engine: ScoringEngine, swapper: HotSwapper,
+                 args: argparse.Namespace) -> int:
+    """--listen mode: the serving.frontend edge on an asyncio loop this
+    process owns, with an optional same-loop /metrics scrape endpoint and
+    SIGTERM/SIGINT wired to the graceful drain."""
+    from photon_ml_tpu.serving.frontend.admission import AdmissionConfig
+    from photon_ml_tpu.serving.frontend.metrics_http import MetricsEndpoint
+    from photon_ml_tpu.serving.frontend.server import (FrontendConfig,
+                                                       FrontendServer)
+
+    host, port = _parse_listen(args.listen)
+    config = FrontendConfig(
+        host=host, port=port,
+        max_line_bytes=args.max_line_bytes,
+        admission=AdmissionConfig(
+            budget_s=args.admission_budget_ms * 1e-3,
+            resume_fraction=args.resume_fraction),
+        batcher_deadline_s=args.deadline_us * 1e-6,
+        dispatch_window=(args.dispatch_window or None),
+        predict_mean=args.predict_mean)
+
+    async def _main() -> int:
+        front = FrontendServer(engine, swapper, config)
+        await front.start()
+        scrape = None
+        if args.metrics_port:
+            scrape = await MetricsEndpoint(
+                engine.metrics, port=args.metrics_port).start()
+            logger.info("metrics scrape on http://127.0.0.1:%d/metrics",
+                        scrape.port)
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(
+                    sig, lambda: asyncio.ensure_future(front.aclose()))
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass  # non-main thread / platform without signal support
+        try:
+            await front.wait_closed()
+        finally:
+            if scrape is not None:
+                await scrape.aclose()
+        return 0
+
+    return asyncio.run(_main())
+
+
 def run(argv: List[str]) -> int:
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(name)s %(message)s")
@@ -316,17 +422,35 @@ def run(argv: List[str]) -> int:
                                interval_s=args.hot_set_interval).start()
         logger.info("hot-set rebalancing every %.3fs", args.hot_set_interval)
 
-    lines = sys.stdin if args.requests == "-" else open(args.requests)
+    metrics_sidecar = None
     try:
-        rc = _serve_stream(engine, swapper, lines, sys.stdout,
-                           args.predict_mean,
-                           deadline_s=args.deadline_us * 1e-6,
-                           sync=args.sync_batcher)
+        if args.listen:
+            rc = _run_network(engine, swapper, args)
+        else:
+            if args.metrics_port:
+                from photon_ml_tpu.serving.frontend.metrics_http import \
+                    ThreadedMetricsEndpoint
+
+                metrics_sidecar = ThreadedMetricsEndpoint(
+                    engine.metrics, port=args.metrics_port).start()
+                logger.info("metrics scrape on http://127.0.0.1:%d/metrics",
+                            metrics_sidecar.port)
+            lines = sys.stdin if args.requests == "-" \
+                else open(args.requests)
+            try:
+                rc = _serve_stream(engine, swapper, lines, sys.stdout,
+                                   args.predict_mean,
+                                   deadline_s=args.deadline_us * 1e-6,
+                                   sync=args.sync_batcher,
+                                   max_line_bytes=args.max_line_bytes)
+            finally:
+                if lines is not sys.stdin:
+                    lines.close()
     finally:
+        if metrics_sidecar is not None:
+            metrics_sidecar.stop()
         if hotset is not None:
             hotset.stop()
-        if lines is not sys.stdin:
-            lines.close()
         if args.metrics_json:
             engine.metrics.export(args.metrics_json)
             logger.info("metrics -> %s", args.metrics_json)

@@ -21,10 +21,17 @@ package is that serving layer, TPU-native:
     flush mix, swap counters) over the unified ``obs.MetricsRegistry`` —
     JSON snapshot wire format preserved, Prometheus exposition added; the
     hot paths also emit ``obs`` tracer spans (submit → flush → resolve →
-    execute) when tracing is on.
+    execute) when tracing is on;
+  - ``frontend``: the network edge — an asyncio TCP server multiplexing
+    many clients into the AsyncBatcher with deadline-budget admission
+    control (load shedding + hysteresis), per-client round-robin fairness,
+    graceful drain on swap/SIGTERM, a ``/metrics`` scrape endpoint, and
+    the open-loop Poisson load generator behind
+    ``bench.py --serving --open-loop``.
 
-``cli/serve.py`` wires these into a stdin/JSON-lines driver and a
-programmatic ``build_server`` entry point.
+``cli/serve.py`` wires these into a stdin/JSON-lines driver (or, with
+``--listen``, the socket front end) and a programmatic ``build_server``
+entry point.
 """
 
 from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,  # noqa: F401

@@ -199,7 +199,17 @@ class AsyncBatcher:
     ``flushes_full`` (threshold reached), ``flushes_deadline`` (deadline
     expired first), ``flushes_forced`` (explicit ``flush()`` / shutdown
     drain) — the occupancy story of a deployment in one ratio.
+
+    Introspection for admission control (serving/frontend): the worker
+    keeps an EWMA of observed flush wall times and marks when a flush is in
+    progress, so ``queue_wait_estimate`` can predict how long a request
+    arriving NOW would wait — the in-flight flush's remainder, plus one
+    EWMA per queued flush wave, plus the residual deadline if the tail wave
+    would not fill.  All of it reads/writes under ``self._cond`` like every
+    other batcher attribute.
     """
+
+    _EWMA_ALPHA = 0.2  # flush-cost smoothing: ~5-flush memory
 
     def __init__(self, score_fn: Callable[[Sequence[Request]], np.ndarray],
                  flush_threshold: int,
@@ -221,6 +231,8 @@ class AsyncBatcher:
         self._first_ts: Optional[float] = None  # arrival of oldest pending
         self._force = False
         self._closed = False
+        self._flush_ewma_s: Optional[float] = None  # observed flush cost
+        self._inflight_since: Optional[float] = None  # flush in progress
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=name)
         self._thread.start()
@@ -248,6 +260,44 @@ class AsyncBatcher:
                 self._force = True
                 self._cond.notify()
         return futs
+
+    def pending_count(self) -> int:
+        """Requests accumulated and not yet handed to a flush."""
+        with self._cond:
+            return len(self._pending)
+
+    def flush_cost_estimate(self) -> float:
+        """EWMA of observed flush wall times (scoring one wave); the
+        deadline is the optimistic floor until the first flush lands."""
+        with self._cond:
+            return self._flush_cost_locked()
+
+    def _flush_cost_locked(self) -> float:
+        return (self._flush_ewma_s if self._flush_ewma_s is not None
+                else self.deadline_s)
+
+    def queue_wait_estimate(self, extra: int = 0) -> float:
+        """Predicted seconds until a request arriving NOW resolves, given
+        ``extra`` requests queued ahead of it outside the batcher (the
+        front end's fair queue).  The admission controller's input.
+
+        Components: the in-flight flush's unfinished remainder; one flush
+        cost per wave the backlog fills; the residual deadline wait when
+        the tail wave would flush non-full.
+        """
+        with self._cond:
+            now = time.perf_counter()
+            ewma = self._flush_cost_locked()
+            ahead = len(self._pending) + max(0, int(extra))
+            est = 0.0
+            if self._inflight_since is not None:
+                est += max(0.0, ewma - (now - self._inflight_since))
+            waves, tail = divmod(ahead + 1, self.flush_threshold)
+            if tail:
+                waves += 1
+                est += self.deadline_s  # non-full tail waits out the clock
+            est += waves * ewma
+            return est
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
@@ -291,7 +341,14 @@ class AsyncBatcher:
                 self._first_ts = None
                 forced, self._force = self._force, False
                 closed = self._closed
+                self._inflight_since = time.perf_counter()
             self._flush_batch(batch, forced=forced or closed)
+            with self._cond:
+                dt = time.perf_counter() - self._inflight_since
+                self._inflight_since = None
+                prev = self._flush_ewma_s
+                self._flush_ewma_s = dt if prev is None else (
+                    (1.0 - self._EWMA_ALPHA) * prev + self._EWMA_ALPHA * dt)
 
     def _flush_batch(self, batch: List[Tuple[Request, Future]],
                      forced: bool) -> None:

@@ -1,0 +1,131 @@
+"""Admission control: deadline-budget load shedding with hysteresis.
+
+Photon ML reference counterpart: none — overload behavior is the part of
+LinkedIn's serving stack the paper leaves to infrastructure.  The policy
+here is the classic one for a batching accelerator backend:
+
+  **Shed when the work already admitted cannot resolve a new request
+  within its deadline budget.**  The predictor is
+  ``AsyncBatcher.queue_wait_estimate`` — an EWMA of observed flush
+  latencies (the registry's ``serve.flush`` service times, observed where
+  they happen) times the number of flush waves queued ahead, plus the
+  residual deadline wait for a non-full tail bucket.  Under overload that
+  estimate grows linearly with queue depth, so the controller starts
+  refusing work while the queue is still ~one deadline deep — bounding
+  p99 at roughly the budget instead of letting the queue (and every
+  client's latency) grow without bound, which is exactly the cliff an
+  open-loop arrival process exposes (``bench.py --serving --open-loop``).
+
+  **Hysteresis makes shedding stable.**  A single threshold oscillates: one
+  shed reply drains the queue below the limit, the next request is
+  admitted, the queue refills, repeat — the shed/admit decision would
+  flap at the arrival rate.  Instead the controller latches into a
+  shedding state at the HIGH watermark (estimate > budget) and only
+  unlatches at the LOW watermark (estimate <= ``resume_fraction`` *
+  budget), so each transition requires the backlog to genuinely drain.
+
+Shed replies carry ``retry_after_ms`` — the predicted time until the
+backlog is back under the resume watermark, clamped to at least one
+deadline budget — so a well-behaved client backs off instead of hammering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.obs.registry import MetricsRegistry
+
+# requests_shed_total{reason=...} reasons
+SHED_OVERLOAD = "overload"
+SHED_DRAINING = "draining"
+SHED_SHUTDOWN = "shutdown"
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """Knobs for the deadline-budget controller.
+
+    ``budget_s``: per-request deadline — the latency the edge promises; a
+    request predicted to resolve later than this is refused up front.
+    ``resume_fraction``: the low watermark as a fraction of the budget
+    (must sit strictly below 1.0 for the hysteresis to exist).
+    ``retry_after_ms``: floor for the advisory backoff in shed replies.
+    """
+
+    budget_s: float = 0.050
+    resume_fraction: float = 0.5
+    retry_after_ms: float = 0.0  # 0 -> derive from the budget
+
+    def __post_init__(self):
+        if self.budget_s <= 0:
+            raise ValueError(f"budget_s must be > 0, got {self.budget_s}")
+        if not 0.0 < self.resume_fraction < 1.0:
+            raise ValueError("resume_fraction must be in (0, 1), got "
+                             f"{self.resume_fraction}")
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One admission decision: ``admitted`` or shed with advice."""
+
+    admitted: bool
+    predicted_wait_s: float
+    reason: Optional[str] = None  # SHED_* when not admitted
+    retry_after_ms: float = 0.0
+
+
+class AdmissionController:
+    """Two-watermark (hysteresis) deadline-budget admission (module doc).
+
+    Single-owner state: the front end calls ``decide`` from its event loop
+    only, so the latch needs no lock — documented rather than defended,
+    like the rest of the asyncio-side front-end state.
+    """
+
+    def __init__(self, config: Optional[AdmissionConfig] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.config = config or AdmissionConfig()
+        self._registry = registry
+        self._shedding = False
+
+    @property
+    def shedding(self) -> bool:
+        return self._shedding
+
+    def _set_shedding(self, value: bool) -> None:
+        if value != self._shedding:
+            self._shedding = value
+            if self._registry is not None:
+                self._registry.set_gauge("front_shedding", int(value))
+
+    def retry_after_ms(self, predicted_wait_s: float) -> float:
+        """Advisory backoff: predicted time until the backlog is under the
+        resume watermark, floored at one budget (a client that retries
+        sooner than the backlog can possibly drain just re-queues itself
+        for another shed reply)."""
+        c = self.config
+        drain_s = max(predicted_wait_s - c.resume_fraction * c.budget_s, 0.0)
+        return round(max(drain_s, c.budget_s, c.retry_after_ms * 1e-3) * 1e3,
+                     3)
+
+    def decide(self, predicted_wait_s: float) -> Verdict:
+        """One admission decision for a request arriving now, given the
+        backlog predictor's estimate of its time-to-resolution."""
+        c = self.config
+        if self._shedding:
+            if predicted_wait_s <= c.budget_s * c.resume_fraction:
+                self._set_shedding(False)  # backlog drained: unlatch
+            else:
+                return Verdict(False, predicted_wait_s, SHED_OVERLOAD,
+                               self.retry_after_ms(predicted_wait_s))
+        elif predicted_wait_s > c.budget_s:
+            self._set_shedding(True)  # latch: stays shedding until the
+            # estimate is back under the LOW watermark, not just under the
+            # budget — that gap is what keeps the decision from flapping
+            return Verdict(False, predicted_wait_s, SHED_OVERLOAD,
+                           self.retry_after_ms(predicted_wait_s))
+        if self._registry is not None:
+            self._registry.observe("front_predicted_wait_s",
+                                   predicted_wait_s)
+        return Verdict(True, predicted_wait_s)

@@ -1,0 +1,160 @@
+"""Localhost HTTP scrape endpoint for the serving metrics registry.
+
+Photon ML reference counterpart: none — observability is infrastructure
+the reference leaves outside the repo.  This closes the PR-5 follow-on:
+``MetricsRegistry.to_prometheus()`` already renders the text exposition
+format; what was missing is an HTTP listener a Prometheus scraper (or
+``curl``) can hit.  Kept deliberately tiny — a hand-rolled asyncio
+HTTP/1.0-style responder, not ``http.server`` — so it can ride on the SAME
+event loop as the socket front end (one thread, one loop, no handler-class
+plumbing), and so the stdio serve loop can host it on a sidecar thread via
+:class:`ThreadedMetricsEndpoint` without dragging in a blocking server.
+
+Routes:  ``GET /metrics`` -> Prometheus text exposition;
+``GET /metrics.json`` -> the structured JSON dump.  Anything else is 404.
+Connections are one-shot (``Connection: close``) — scrape traffic, not an
+API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from photon_ml_tpu.serving.metrics import ServingMetrics
+
+_MAX_REQUEST_BYTES = 8192  # a scrape request line + headers; hard bound
+
+
+class MetricsEndpoint:
+    """One-loop asyncio scrape listener (module docstring)."""
+
+    def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.metrics = metrics
+        self.host = host
+        self.config_port = port
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> "MetricsEndpoint":
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.config_port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=5.0)
+            except asyncio.IncompleteReadError as e:
+                head = e.partial  # curl -0 style or early close: best effort
+            except (asyncio.LimitOverrunError, asyncio.TimeoutError):
+                return
+            if len(head) > _MAX_REQUEST_BYTES:
+                writer.write(_response(431, b"request too large\n",
+                                       b"text/plain"))
+                return
+            request_line = head.split(b"\r\n", 1)[0].split(b"\n", 1)[0]
+            parts = request_line.split()
+            method = parts[0].decode("latin-1") if parts else ""
+            path = parts[1].decode("latin-1") if len(parts) > 1 else ""
+            if method not in ("GET", "HEAD"):
+                writer.write(_response(405, b"method not allowed\n",
+                                       b"text/plain"))
+                return
+            if path in ("/metrics", "/metrics/"):
+                body = self.metrics.to_prometheus().encode("utf-8")
+                ctype = b"text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = self.metrics.to_json().encode("utf-8")
+                ctype = b"application/json"
+            else:
+                writer.write(_response(
+                    404, b"try /metrics or /metrics.json\n", b"text/plain"))
+                return
+            writer.write(_response(200, b"" if method == "HEAD" else body,
+                                   ctype, content_length=len(body)))
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+_REASONS = {200: b"OK", 404: b"Not Found", 405: b"Method Not Allowed",
+            431: b"Request Header Fields Too Large"}
+
+
+def _response(status: int, body: bytes, ctype: bytes,
+              content_length: Optional[int] = None) -> bytes:
+    n = len(body) if content_length is None else content_length
+    return (b"HTTP/1.0 %d %s\r\n"
+            b"Content-Type: %s\r\n"
+            b"Content-Length: %d\r\n"
+            b"Connection: close\r\n\r\n"
+            % (status, _REASONS.get(status, b"?"), ctype, n)) + body
+
+
+class ThreadedMetricsEndpoint:
+    """Run a MetricsEndpoint on its own event-loop thread — the sidecar
+    the blocking stdio serve loop uses for ``--metrics-port``."""
+
+    def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.endpoint = MetricsEndpoint(metrics, host, port)
+        self._ready = threading.Event()
+        self._stop: Optional[asyncio.Event] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="photonfront-metrics")
+
+    @property
+    def port(self) -> int:
+        return self.endpoint.port
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:
+            self._error = e
+            self._ready.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        try:
+            await self.endpoint.start()
+        except BaseException as e:
+            self._error = e
+            self._ready.set()
+            raise
+        self._ready.set()
+        await self._stop.wait()
+        await self.endpoint.aclose()
+
+    def start(self, timeout: float = 10.0) -> "ThreadedMetricsEndpoint":
+        self._thread.start()
+        if not self._ready.wait(timeout):
+            raise RuntimeError("metrics endpoint did not start within "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise RuntimeError(
+                "metrics endpoint failed to start") from self._error
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._loop is not None and self._thread.is_alive():
+            self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout)

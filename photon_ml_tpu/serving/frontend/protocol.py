@@ -1,0 +1,145 @@
+"""Wire framing for the network front end: bounded newline-delimited JSON.
+
+Photon ML reference counterpart: none — the reference's online edge is
+LinkedIn infrastructure.  The TPU-native stack speaks the SAME wire format
+as ``cli/serve.py`` (one JSON object per line, ``{"cmd": ...}`` control
+lines, blank line = force flush), so every existing driver works unchanged
+over a socket.
+
+The one thing a multi-client edge must add to the stdio loop is a HARD
+per-line byte bound: an unbounded ``readline`` lets a single malformed (or
+malicious) client grow the server's receive buffer without limit — one
+firehose of garbage OOMs every other client's server.  Both framing paths
+here enforce ``max_line_bytes``:
+
+  - :class:`BoundedLineReader` — the asyncio side.  Buffers reads itself
+    (``asyncio.StreamReader.readline``'s over-limit behavior clears its
+    internal buffer mid-line, which would resynchronize on GARBAGE — the
+    tail of the oversized line would parse as a fresh request).  An
+    oversized line is discarded THROUGH its terminating newline and
+    surfaced as one :class:`LineTooLong`, after which the stream is
+    byte-exactly aligned on the next line — the connection survives.
+  - :func:`iter_bounded_lines` — the same contract for the blocking stdio
+    loop (``cli/serve.py`` without ``--listen``), yielding ``LineTooLong``
+    markers in-band so the driver replies ``{"error": ...}`` and keeps
+    reading.  (Text-mode ``readline(size)`` counts characters, not bytes;
+    for the ASCII-dominated JSON wire format the bound is byte-accurate,
+    and for exotic unicode it is conservative within the UTF-8 expansion
+    factor.)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Awaitable, Callable, Iterator, Optional, Union
+
+# 1 MiB: a scoring request is a few hundred bytes; the largest legitimate
+# line is a {"cmd": "delta"} row for a wide coordinate (8 bytes/coeff as
+# JSON text -> ~100k features fit with headroom)
+DEFAULT_MAX_LINE_BYTES = 1 << 20
+
+_READ_CHUNK = 1 << 16
+
+
+class LineTooLong(ValueError):
+    """One wire line exceeded the byte bound (the line was discarded and
+    the stream is aligned on the next one)."""
+
+    def __init__(self, nbytes: int, limit: int):
+        super().__init__(
+            f"line too long: {nbytes} bytes exceeds the "
+            f"{limit}-byte limit")
+        self.nbytes = nbytes
+        self.limit = limit
+
+
+def error_reply(message: str, **extra) -> dict:
+    out = {"error": message}
+    out.update(extra)
+    return out
+
+
+def encode(obj: dict) -> bytes:
+    """One reply line, wire-ready."""
+    return (json.dumps(obj) + "\n").encode("utf-8")
+
+
+class BoundedLineReader:
+    """Newline framing over an async ``read(n) -> bytes`` with a hard
+    per-line bound (see module docstring).
+
+    ``readline`` returns the next line (terminator stripped), ``None`` at
+    EOF, or raises :class:`LineTooLong` exactly once per oversized line —
+    the oversized bytes are consumed through their newline first, so the
+    caller may keep reading.
+    """
+
+    def __init__(self, read: Callable[[int], Awaitable[bytes]],
+                 max_line_bytes: int = DEFAULT_MAX_LINE_BYTES):
+        if max_line_bytes < 1:
+            raise ValueError(
+                f"max_line_bytes must be >= 1, got {max_line_bytes}")
+        self._read = read
+        self._buf = bytearray()
+        self._eof = False
+        self.max_line_bytes = int(max_line_bytes)
+
+    async def readline(self) -> Optional[bytes]:
+        discarded = 0  # bytes of an oversized line already thrown away
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl >= 0:
+                line = bytes(self._buf[: nl])
+                del self._buf[: nl + 1]
+                # nl > bound catches an oversized line whose newline arrived
+                # in the same chunk (it never hit the no-newline path below)
+                if discarded or nl > self.max_line_bytes:
+                    raise LineTooLong(discarded + nl + 1,
+                                      self.max_line_bytes)
+                return line
+            if len(self._buf) > self.max_line_bytes:
+                # no newline yet and already over budget: switch to discard
+                # mode — drop what we hold, keep consuming until the line
+                # ends so the NEXT line starts clean
+                discarded += len(self._buf)
+                self._buf.clear()
+            if self._eof:
+                if discarded:
+                    discarded += len(self._buf)
+                    self._buf.clear()
+                    raise LineTooLong(discarded, self.max_line_bytes)
+                if not self._buf:
+                    return None
+                line = bytes(self._buf)  # trailing line without newline
+                self._buf.clear()
+                return line
+            chunk = await self._read(_READ_CHUNK)
+            if not chunk:
+                self._eof = True
+            else:
+                self._buf.extend(chunk)
+
+
+def iter_bounded_lines(f, max_line_bytes: int = DEFAULT_MAX_LINE_BYTES
+                       ) -> Iterator[Union[str, LineTooLong]]:
+    """Bounded line iteration for a blocking text stream (the stdio serve
+    loop).  Yields each line (newline kept, like file iteration) or a
+    :class:`LineTooLong` marker for a discarded oversized line."""
+    if max_line_bytes < 1:
+        raise ValueError(f"max_line_bytes must be >= 1, got {max_line_bytes}")
+    while True:
+        line = f.readline(max_line_bytes + 1)
+        if not line:
+            return
+        if len(line) <= max_line_bytes or line.endswith("\n"):
+            # within budget, or the terminator landed exactly on the probe
+            # boundary (content is <= the bound either way)
+            yield line
+            continue
+        n = len(line)
+        while True:  # discard through the end of the oversized line
+            more = f.readline(max_line_bytes + 1)
+            n += len(more)
+            if not more or more.endswith("\n"):
+                break
+        yield LineTooLong(n, max_line_bytes)

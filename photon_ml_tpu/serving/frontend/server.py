@@ -1,0 +1,569 @@
+"""photonfront: asyncio socket front end over the AsyncBatcher.
+
+Photon ML reference counterpart: none — the reference publishes models and
+LinkedIn's serving infrastructure owns the edge.  This module IS that edge
+for the TPU-native stack: a stdlib-only asyncio TCP server that multiplexes
+many concurrent client connections into the existing
+``serving.batcher.AsyncBatcher`` / AOT ``ScoringEngine``, speaking the SAME
+newline-delimited JSON wire protocol as the stdio ``cli/serve.py`` loop
+(requests, blank-line flush, ``{"cmd": ...}`` control lines), so existing
+drivers work unchanged pointed at a socket.
+
+What makes it an edge rather than a socket wrapper:
+
+  - **Admission control / load shedding** (``admission.py``): every score
+    request is checked against a deadline budget BEFORE it joins the
+    queue, using the batcher's flush-latency EWMA times the queued flush
+    waves (``AsyncBatcher.queue_wait_estimate``).  Refusals are explicit —
+    ``{"error": "overloaded", "retry_after_ms": ...}`` — and hysteresis
+    (two watermarks) keeps the shed decision latched until the backlog
+    genuinely drains, so shedding is stable, not flappy.
+  - **Per-client fairness** (``fairness.py``): admitted requests queue per
+    connection and a round-robin dispatcher fills a bounded batcher window
+    (default 2 flush waves), so one firehose connection cannot park a
+    trickle client behind its backlog; any client's added wait is bounded
+    by (clients x window), not by another client's queue depth.
+  - **Graceful drain**: ``{"cmd": "swap"}``, ``{"cmd": "delta"}``,
+    ``{"cmd": "shutdown"}`` and SIGTERM (wired in cli/serve.py) stop
+    admitting (shed reason ``draining``), submit everything queued, flush
+    the batcher, and wait for every in-flight future to resolve before
+    flipping the generation / applying the delta / exiting — zero admitted
+    requests are ever dropped or errored by a rotation.
+  - **Bounded reads** (``protocol.py``): a malformed line gets an
+    ``{"error": ...}`` reply and the connection survives; an oversized
+    line is discarded through its newline under a hard byte bound, so one
+    client cannot OOM the server.
+
+Observability: photonscope spans/instants ``front.accept`` /
+``front.admit`` / ``front.shed`` / ``front.drain`` and registry series
+``front_connections`` (gauge), ``front_connections_total``,
+``front_requests_total``, ``front_queue_depth{client=...}``,
+``requests_shed_total{reason=...}``, ``front_protocol_errors_total{kind=
+...}``, ``front_shedding``, ``front_predicted_wait_s`` (histogram) — all
+in the engine's registry, scrapeable via ``metrics_http.py``.
+
+Concurrency model: ALL front-end state (fair queue, admission latch,
+in-flight accounting) is owned by the event loop; the only cross-thread
+edges are ``AsyncBatcher.submit`` (thread-safe by contract) and future
+completion callbacks, which re-enter the loop via
+``call_soon_threadsafe``.  Per-connection reply ORDER is the submission
+order: each connection has a reply queue of futures its writer task awaits
+in sequence, so fairness reorders work ACROSS clients, never within one.
+
+Wire protocol extension over stdio: ``{"cmd": "shutdown"}`` drains and
+stops the whole server (the socket analog of stdin EOF).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import threading
+from typing import Dict, Optional
+
+from photon_ml_tpu.obs.trace import instant as obs_instant
+from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.serving.batcher import request_from_json
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.serving.frontend.admission import (SHED_DRAINING,
+                                                      SHED_SHUTDOWN,
+                                                      AdmissionConfig,
+                                                      AdmissionController)
+from photon_ml_tpu.serving.frontend.fairness import FairQueue
+from photon_ml_tpu.serving.frontend.protocol import (DEFAULT_MAX_LINE_BYTES,
+                                                     BoundedLineReader,
+                                                     LineTooLong, encode,
+                                                     error_reply)
+from photon_ml_tpu.serving.swap import HotSwapper
+
+logger = logging.getLogger("photon_ml_tpu.serving.frontend")
+
+_CLOSE = object()  # writer-task sentinel: flush backlog, then close
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontendConfig:
+    """Front-end policy knobs (wire format itself is not configurable)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 -> ephemeral; FrontendServer.port holds the binding
+    max_line_bytes: int = DEFAULT_MAX_LINE_BYTES
+    admission: AdmissionConfig = dataclasses.field(
+        default_factory=AdmissionConfig)
+    batcher_deadline_s: float = 500e-6
+    flush_threshold: Optional[int] = None  # None -> engine's top bucket
+    # max requests resident in the batcher at once; the rest wait in the
+    # per-client fair queue where round-robin applies.  None -> 2 flush
+    # waves: one scoring, one forming — enough to never starve the engine,
+    # small enough that the backlog lives where fairness can see it.
+    dispatch_window: Optional[int] = None
+    drain_grace_s: float = 30.0
+    predict_mean: bool = False
+
+
+class _Conn:
+    """Per-connection state: identity, streams, and the ordered reply
+    queue its writer task drains."""
+
+    __slots__ = ("cid", "reader", "writer", "replies", "alive")
+
+    def __init__(self, cid: str, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.cid = cid
+        self.reader = reader
+        self.writer = writer
+        self.replies: asyncio.Queue = asyncio.Queue()
+        self.alive = True
+
+
+class _Pending:
+    """One admitted score request: reply future + settle-once accounting."""
+
+    __slots__ = ("conn", "req", "reply", "settled")
+
+    def __init__(self, conn: _Conn, req, reply: asyncio.Future):
+        self.conn = conn
+        self.req = req
+        self.reply = reply
+        self.settled = False
+
+
+class FrontendServer:
+    """Asyncio TCP front end for one ScoringEngine (module docstring)."""
+
+    def __init__(self, engine: ScoringEngine,
+                 swapper: Optional[HotSwapper] = None,
+                 config: Optional[FrontendConfig] = None,
+                 registry=None):
+        self.engine = engine
+        self.swapper = swapper or HotSwapper(engine)
+        self.config = config or FrontendConfig()
+        self._registry = registry if registry is not None \
+            else engine.metrics.registry
+        self._batcher = engine.async_batcher(
+            deadline_s=self.config.batcher_deadline_s,
+            predict_mean=self.config.predict_mean,
+            flush_threshold=self.config.flush_threshold)
+        self._window = self.config.dispatch_window or \
+            2 * self._batcher.flush_threshold
+        self._queue = FairQueue()
+        self._admission = AdmissionController(self.config.admission,
+                                              registry=self._registry)
+        self._conns: Dict[str, _Conn] = {}
+        self._conn_seq = 0
+        self._outstanding = 0  # resident in the batcher (dispatch window)
+        self._inflight = 0     # admitted, not yet settled (drain barrier)
+        self._draining = False
+        self._closing = False
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._state_lock: Optional[asyncio.Lock] = None  # swap/delta serial
+        self._idle: Optional[asyncio.Event] = None
+        self._closed: Optional[asyncio.Event] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> "FrontendServer":
+        self._loop = asyncio.get_running_loop()
+        self._state_lock = asyncio.Lock()
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self._closed = asyncio.Event()
+        self._server = await asyncio.start_server(
+            self._on_connect, self.config.host, self.config.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("photonfront listening on %s:%d (window %d, budget "
+                    "%.1fms)", self.config.host, self.port, self._window,
+                    self.config.admission.budget_s * 1e3)
+        return self
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()
+
+    async def aclose(self) -> None:
+        """Graceful shutdown: stop accepting, drain in-flight work to
+        completion, stop the batcher, close connections.  Idempotent."""
+        if self._closing:
+            await self._closed.wait()
+            return
+        self._closing = True
+        if self._server is not None:
+            self._server.close()
+        async with self._state_lock:
+            self._draining = True
+            await self._drain()
+        # batcher.shutdown joins its worker thread — off the loop
+        await self._loop.run_in_executor(
+            None, lambda: self._batcher.shutdown(drain=True))
+        for conn in list(self._conns.values()):
+            conn.replies.put_nowait(_CLOSE)
+        if self._server is not None:
+            await self._server.wait_closed()
+        self._closed.set()
+
+    # -- connection handling -----------------------------------------------
+    async def _on_connect(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        with obs_span("front.accept"):
+            peer = writer.get_extra_info("peername") or ("?", 0)
+            self._conn_seq += 1
+            cid = f"{peer[0]}:{peer[1]}#{self._conn_seq}"
+            conn = _Conn(cid, reader, writer)
+            self._conns[cid] = conn
+            self._registry.inc("front_connections_total")
+            self._registry.set_gauge("front_connections", len(self._conns))
+        writer_task = asyncio.ensure_future(self._conn_writer(conn))
+        try:
+            await self._conn_reader(conn)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # abrupt disconnect: same cleanup as EOF
+        finally:
+            conn.alive = False
+            self._abort_queued(conn)
+            conn.replies.put_nowait(_CLOSE)
+            try:
+                await writer_task
+            except asyncio.CancelledError:
+                pass
+            self._conns.pop(cid, None)
+            self._registry.set_gauge("front_connections", len(self._conns))
+            self._registry.set_gauge("front_queue_depth", 0, client=cid)
+
+    async def _conn_reader(self, conn: _Conn) -> None:
+        lines = BoundedLineReader(conn.reader.read,
+                                  self.config.max_line_bytes)
+        while True:
+            try:
+                raw = await lines.readline()
+            except LineTooLong as e:
+                self._registry.inc("front_protocol_errors_total",
+                                   kind="oversize")
+                self._reply_now(conn, error_reply(str(e)))
+                continue
+            if raw is None:
+                return  # EOF
+            line = raw.strip()
+            if not line:
+                self._flush_conn(conn)  # blank line: force-flush (stdio
+                continue                # parity, scoped to this client)
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                self._registry.inc("front_protocol_errors_total",
+                                   kind="json")
+                self._reply_now(conn, error_reply(str(e)))
+                continue
+            cmd = obj.get("cmd") if isinstance(obj, dict) else None
+            if cmd is not None:
+                await self._handle_cmd(conn, cmd, obj)
+            elif isinstance(obj, dict):
+                self._handle_request(conn, obj)
+            else:
+                self._registry.inc("front_protocol_errors_total",
+                                   kind="json")
+                self._reply_now(conn, error_reply(
+                    f"expected a JSON object, got {type(obj).__name__}"))
+
+    async def _conn_writer(self, conn: _Conn) -> None:
+        """Drain the reply queue in order; replies may be dicts, futures of
+        dicts, or zero-arg callables evaluated at WRITE time (metrics/trace
+        snapshots must reflect everything already replied to)."""
+        try:
+            while True:
+                entry = await conn.replies.get()
+                if entry is _CLOSE:
+                    return
+                if asyncio.isfuture(entry):
+                    try:
+                        entry = await entry
+                    except asyncio.CancelledError:
+                        continue
+                if callable(entry):
+                    entry = entry()
+                if entry is None:
+                    continue
+                conn.writer.write(encode(entry))
+                await conn.writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass  # peer gone: stop writing, reader cleanup owns state
+        finally:
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    # -- reply plumbing ----------------------------------------------------
+    def _reply_now(self, conn: _Conn, obj: dict) -> None:
+        conn.replies.put_nowait(obj)
+
+    def _reply_future(self, conn: _Conn) -> asyncio.Future:
+        fut = self._loop.create_future()
+        conn.replies.put_nowait(fut)
+        return fut
+
+    # -- score-request path ------------------------------------------------
+    def _handle_request(self, conn: _Conn, obj: dict) -> None:
+        try:
+            req = request_from_json(obj)
+        except (ValueError, TypeError) as e:
+            self._registry.inc("front_protocol_errors_total", kind="request")
+            self._reply_now(conn, error_reply(str(e), uid=obj.get("uid")))
+            return
+        self._registry.inc("front_requests_total")
+        if self._draining or self._closing:
+            self._shed(conn, req,
+                       SHED_SHUTDOWN if self._closing else SHED_DRAINING,
+                       self.config.admission.budget_s)
+            return
+        estimate = self._batcher.queue_wait_estimate(
+            extra=self._queue.depth())
+        verdict = self._admission.decide(estimate)
+        if not verdict.admitted:
+            self._shed(conn, req, verdict.reason, verdict.predicted_wait_s,
+                       verdict.retry_after_ms)
+            return
+        obs_instant("front.admit", uid=req.uid, client=conn.cid,
+                    predicted_wait_us=int(estimate * 1e6))
+        self._inflight += 1
+        self._idle.clear()
+        pending = _Pending(conn, req, self._reply_future(conn))
+        self._queue.enqueue(conn.cid, pending)
+        self._registry.set_gauge("front_queue_depth",
+                                 self._queue.depth_of(conn.cid),
+                                 client=conn.cid)
+        self._pump()
+
+    def _shed(self, conn: _Conn, req, reason: str, predicted_wait_s: float,
+              retry_after_ms: Optional[float] = None) -> None:
+        obs_instant("front.shed", uid=req.uid, client=conn.cid,
+                    reason=reason)
+        self._registry.inc("requests_shed_total", reason=reason)
+        if retry_after_ms is None:
+            retry_after_ms = self._admission.retry_after_ms(predicted_wait_s)
+        self._reply_now(conn, {
+            "uid": req.uid, "error": "overloaded", "reason": reason,
+            "retry_after_ms": retry_after_ms,
+            "predicted_wait_ms": round(predicted_wait_s * 1e3, 3)})
+
+    def _pump(self) -> None:
+        """Fill the dispatch window round-robin from the fair queue."""
+        while self._outstanding < self._window:
+            nxt = self._queue.next_item()
+            if nxt is None:
+                return
+            cid, pending = nxt
+            self._registry.set_gauge("front_queue_depth",
+                                     self._queue.depth_of(cid), client=cid)
+            self._dispatch(pending)
+
+    def _dispatch(self, pending: _Pending) -> None:
+        if pending.settled:
+            return  # aborted while queued (connection died)
+        try:
+            fut = self._batcher.submit(pending.req)
+        except RuntimeError as e:  # batcher already shut down
+            self._settle(pending, error_reply(str(e), uid=pending.req.uid))
+            return
+        self._outstanding += 1
+        fut.add_done_callback(
+            lambda f: self._loop.call_soon_threadsafe(self._scored,
+                                                      pending, f))
+
+    def _scored(self, pending: _Pending, fut) -> None:
+        self._outstanding -= 1
+        if fut.cancelled():
+            reply = error_reply("request cancelled at shutdown",
+                                uid=pending.req.uid)
+        else:
+            exc = fut.exception()
+            if exc is not None:
+                reply = error_reply(str(exc), uid=pending.req.uid)
+            else:
+                reply = {"uid": pending.req.uid, "score": fut.result()}
+        self._settle(pending, reply)
+        self._pump()
+
+    def _settle(self, pending: _Pending, reply: Optional[dict]) -> None:
+        if pending.settled:
+            return
+        pending.settled = True
+        self._inflight -= 1
+        if self._inflight == 0:
+            self._idle.set()
+        if not pending.reply.done():
+            pending.reply.set_result(reply)
+
+    def _abort_queued(self, conn: _Conn) -> None:
+        """Connection died: settle its queued-but-undispatched requests
+        (dispatched ones resolve through the batcher as usual)."""
+        for pending in self._queue.drop_client(conn.cid):
+            self._settle(pending, None)
+
+    def _flush_conn(self, conn: _Conn) -> None:
+        """Blank-line semantics, scoped: THIS connection's queued requests
+        go to the batcher now (ignoring the window) and the batcher
+        flushes.  Other clients' backlogs stay in the fair queue — one
+        client's flush must not launder another's firehose past the
+        round-robin dispatcher."""
+        for pending in self._queue.drop_client(conn.cid):
+            self._dispatch(pending)
+        self._registry.set_gauge("front_queue_depth", 0, client=conn.cid)
+        self._batcher.flush()
+
+    def _flush_all(self) -> None:
+        """Drain semantics: everything queued, every client, goes to the
+        batcher now (ignoring the window) and the batcher flushes."""
+        while True:
+            nxt = self._queue.next_item()
+            if nxt is None:
+                break
+            self._dispatch(nxt[1])
+        self._batcher.flush()
+
+    # -- drain / control commands ------------------------------------------
+    async def _drain(self) -> None:
+        """Submit everything queued, flush, and wait until every admitted
+        request has settled.  Callers hold ``_state_lock`` and have set
+        ``_draining`` (so admission refuses new work meanwhile)."""
+        with obs_span("front.drain", inflight=self._inflight,
+                      queued=self._queue.depth()):
+            self._registry.inc("front_drains_total")
+            self._flush_all()
+            if self._inflight:
+                try:
+                    await asyncio.wait_for(self._idle.wait(),
+                                           self.config.drain_grace_s)
+                except asyncio.TimeoutError:
+                    logger.warning(
+                        "drain grace (%.1fs) expired with %d in flight",
+                        self.config.drain_grace_s, self._inflight)
+
+    async def _quiesced(self, fn):
+        """Run ``fn`` (blocking, in the executor) with admission stopped
+        and zero requests in flight — the swap/delta barrier."""
+        async with self._state_lock:
+            self._draining = True
+            try:
+                await self._drain()
+                return await self._loop.run_in_executor(None, fn)
+            finally:
+                self._draining = False
+
+    async def _handle_cmd(self, conn: _Conn, cmd: str, obj: dict) -> None:
+        if cmd == "swap":
+            model_dir = obj.get("model_dir")
+            if not model_dir:
+                self._reply_now(conn, error_reply("swap needs model_dir"))
+                return
+            fut = self._reply_future(conn)
+            ok = await self._quiesced(lambda: self.swapper.swap(model_dir))
+            fut.set_result({
+                "swap": "ok" if ok else "rejected",
+                "generation": self.engine.store.generation,
+                "version": self.engine.store.version,
+                "delta_version": self.swapper.delta_version})
+        elif cmd == "delta":
+            fut = self._reply_future(conn)
+            ok = await self._quiesced(
+                lambda: self.swapper.apply_delta(obj.get("coordinate"),
+                                                obj.get("entity"),
+                                                obj.get("row") or ()))
+            fut.set_result({"delta": "ok" if ok else "rejected",
+                            "delta_version": self.swapper.delta_version})
+        elif cmd == "rebalance":
+            fut = self._reply_future(conn)
+            moves = await self._loop.run_in_executor(
+                None, self.engine.store.rebalance)
+            fut.set_result({"rebalance": {cid: list(m)
+                                          for cid, m in moves.items()}})
+        elif cmd == "metrics":
+            # lazy: the snapshot is taken when the reply is WRITTEN, i.e.
+            # after every earlier reply on this connection has resolved —
+            # the stdio loop's flush-then-snapshot semantics
+            self._batcher.flush()
+            if obj.get("format") == "prometheus":
+                self._reply_now(conn, lambda: {
+                    "prometheus": self.engine.metrics.to_prometheus()})
+            else:
+                self._reply_now(
+                    conn, lambda: self.engine.metrics.snapshot())
+        elif cmd == "trace":
+            self._batcher.flush()
+
+            def _trace_reply():
+                from photon_ml_tpu import obs
+
+                tracer = obs.get_tracer()
+                if not tracer.enabled:
+                    return error_reply(
+                        "tracing disabled; rerun with --trace")
+                return tracer.chrome_trace()
+
+            self._reply_now(conn, _trace_reply)
+        elif cmd == "shutdown":
+            fut = self._reply_future(conn)
+            fut.set_result({"shutdown": "ok",
+                            "generation": self.engine.store.generation})
+            asyncio.ensure_future(self.aclose())
+        else:
+            self._reply_now(conn, error_reply(f"unknown cmd {cmd!r}"))
+
+
+class ThreadedFrontend:
+    """Run a FrontendServer on a dedicated event-loop thread.
+
+    The harness tests and the open-loop bench use: ``start()`` blocks until
+    the socket is bound (``.port`` is then live), ``stop()`` runs the
+    graceful drain and joins.  The CLI's asyncio main does NOT use this —
+    it owns its loop; this exists for callers living in blocking code.
+    """
+
+    def __init__(self, engine: ScoringEngine,
+                 swapper: Optional[HotSwapper] = None,
+                 config: Optional[FrontendConfig] = None,
+                 registry=None):
+        self.server = FrontendServer(engine, swapper, config, registry)
+        self._ready = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="photonfront")
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:  # startup failures surface in start()
+            self._error = e
+            self._ready.set()
+
+    async def _main(self) -> None:
+        try:
+            await self.server.start()
+        except BaseException as e:
+            self._error = e
+            self._ready.set()
+            raise
+        self._loop = asyncio.get_running_loop()
+        self._ready.set()
+        await self.server.wait_closed()
+
+    def start(self, timeout: float = 30.0) -> "ThreadedFrontend":
+        self._thread.start()
+        if not self._ready.wait(timeout):
+            raise RuntimeError("frontend did not start within "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise RuntimeError("frontend failed to start") from self._error
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        if self._loop is not None and self._thread.is_alive():
+            asyncio.run_coroutine_threadsafe(self.server.aclose(),
+                                             self._loop)
+        self._thread.join(timeout)

@@ -419,3 +419,55 @@ class TestChecklistPromotion:
         assert json.loads(out.read_text()) == banked
         assert "error" in json.loads(partial.read_text())["probe"]["error"] \
             or json.loads(partial.read_text())["probe"]["error"]
+
+
+class TestOpenLoopPlumbing:
+    """--serving --open-loop arg plumbing: flags reach run_open_loop_bench
+    parsed, and --open-loop alone is rejected (it has no meaning without
+    the serving edge)."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"bench": "open_loop_serving", "sweep": []}
+
+        monkeypatch.setattr(bench, "run_open_loop_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--serving", "--open-loop",
+            "--open-loop-rates", "100,250.5",
+            "--open-loop-duration", "1.5",
+            "--open-loop-connections", "7",
+            "--open-loop-budget-ms", "12.5",
+            "--serving-entities", "123",
+            "--serving-deadline-us", "300",
+            "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["bench"] == "open_loop_serving"
+        assert seen["rates"] == [100.0, 250.5]
+        assert seen["duration_s"] == 1.5
+        assert seen["n_connections"] == 7
+        assert seen["budget_ms"] == 12.5
+        assert seen["n_entities"] == 123
+        assert seen["deadline_us"] == 300.0
+        assert seen["out_path"] == "ignored.json"
+
+    def test_empty_rates_mean_calibrated_multipliers(self, monkeypatch,
+                                                     capsys):
+        seen = {}
+        monkeypatch.setattr(bench, "run_open_loop_bench",
+                            lambda **kw: seen.update(kw) or {})
+        monkeypatch.setattr(sys, "argv",
+                            ["bench.py", "--serving", "--open-loop"])
+        bench.main()
+        assert seen["rates"] is None  # runner calibrates and picks rates
+
+    def test_open_loop_requires_serving(self, monkeypatch):
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--open-loop"])
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == 2  # argparse error exit

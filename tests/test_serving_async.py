@@ -208,6 +208,100 @@ class TestAsyncBatcher:
             AsyncBatcher(lambda r: [], flush_threshold=1, deadline_s=-1.0)
 
 
+class TestBatcherIntrospection:
+    """pending_count / flush_cost_estimate / queue_wait_estimate — the
+    backlog predictor the front end's admission controller reads."""
+
+    @staticmethod
+    def _dummy(flush_threshold, deadline_s):
+        return AsyncBatcher(lambda reqs: np.zeros(len(reqs)),
+                            flush_threshold=flush_threshold,
+                            deadline_s=deadline_s)
+
+    def test_estimate_wave_arithmetic_before_any_flush(self):
+        # nothing observed yet: the flush-cost EWMA floors at the deadline,
+        # so every term of the estimate is exact arithmetic
+        ab = self._dummy(flush_threshold=4, deadline_s=10.0)
+        try:
+            assert ab.pending_count() == 0
+            assert ab.flush_cost_estimate() == 10.0
+            # empty queue: the arriving request is a non-full tail wave —
+            # one flush cost plus the residual deadline wait
+            assert ab.queue_wait_estimate() == pytest.approx(20.0)
+            # 3 ahead + itself = exactly one full wave: no deadline wait
+            assert ab.queue_wait_estimate(extra=3) == pytest.approx(10.0)
+            # 8 ahead + itself = 2 full waves + a tail
+            assert ab.queue_wait_estimate(extra=8) == pytest.approx(40.0)
+        finally:
+            ab.shutdown(drain=False)
+
+    def test_pending_count_tracks_submits_and_flush(self):
+        ab = self._dummy(flush_threshold=64, deadline_s=10.0)
+        try:
+            futs = [ab.submit(Request(uid=i, features=[], ids={}))
+                    for i in range(3)]
+            assert ab.pending_count() == 3
+            ab.flush()
+            for f in futs:
+                f.result(timeout=30)
+            assert ab.pending_count() == 0
+            # that flush was observed: the EWMA left its deadline floor
+            assert ab.flush_cost_estimate() < 10.0
+        finally:
+            ab.shutdown()
+
+    def test_ewma_converges_to_observed_flush_cost(self):
+        delay = 0.005
+
+        def slow_score(reqs):
+            time.sleep(delay)
+            return np.zeros(len(reqs))
+
+        ab = AsyncBatcher(slow_score, flush_threshold=1, deadline_s=10.0)
+        try:
+            for i in range(10):
+                ab.submit(Request(uid=i, features=[], ids={})) \
+                  .result(timeout=30)
+            est = ab.flush_cost_estimate()
+            assert delay * 0.8 < est < delay * 10
+            # empty queue + threshold 1: the next request is one full wave
+            wait = ab.queue_wait_estimate()
+            assert delay * 0.8 < wait < delay * 10
+        finally:
+            ab.shutdown()
+
+    def test_shutdown_under_concurrent_submit_never_drops(self):
+        """The drain contract under racing producers: every submit either
+        raises RuntimeError (batcher closed) or returns a future that
+        RESOLVES to a score — no future is ever silently dropped or
+        cancelled by shutdown(drain=True)."""
+        eng, _, _ = _engine(max_batch=4)
+        ab = eng.async_batcher(deadline_s=0.0005)
+        accepted = []
+
+        def producer(tid):
+            rng = np.random.default_rng(500 + tid)
+            mine = []
+            for i in range(50):
+                try:
+                    mine.append(ab.submit(_req(rng, uid=f"{tid}-{i}")))
+                except RuntimeError:
+                    break  # closed under us: the expected race outcome
+            accepted.extend(mine)  # one atomic extend per thread
+
+        threads = [threading.Thread(target=producer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.005)  # let producers race the drain
+        ab.shutdown(drain=True)
+        for t in threads:
+            t.join(60)
+        assert accepted, "no submits landed before shutdown?"
+        for f in accepted:
+            assert np.isfinite(f.result(timeout=30))
+
+
 # ---------------------------------------------------------------------------
 # frequency-ranked hot set
 # ---------------------------------------------------------------------------
