@@ -1735,6 +1735,172 @@ def run_open_loop_bench(n_entities=5000, d=16, max_batch=64, seed=0,
     return out
 
 
+def run_online_bench(n_entities=2000, d=8, max_batch=64, seed=0,
+                     batches=8, batch_size=64, out_path=None):
+    """`bench.py --online`: the photonlearn loop end to end ->
+    BENCH_ONLINE_<backend>.json.
+
+    Builds the synthetic serving engine (d=8 keeps the per-user refits
+    inside the batched SoA solver's gate), attaches a durable delta log
+    (``online.DeltaLog``) and an ``online.IncrementalTrainer``, then:
+
+      - **refit throughput**: streams ``batches`` labeled mini-batches
+        through ``consume`` WHILE a serving thread keeps scoring through
+        the same engine — entities/sec and rows/sec over the solve+publish
+        wall, plus the serving qps sustained during the refits and the
+        zero-recompile check (publishes are same-shape scatters);
+      - **publish -> visible freshness**: after each batch, a just-refit
+        entity is scored through the live engine; freshness is first
+        publish -> that score's completion (the end-to-end online-learning
+        latency a caller observes), reported p50/p99/max over batches;
+      - **catch-up replay**: a fresh replica store (same seed => identical
+        pre-refit model) replays the full log — rows/sec, and the replica
+        must then serve BITWISE the live engine's score for the probe
+        (the replicated-convergence acceptance check).
+    """
+    import tempfile
+    import threading
+
+    import jax
+
+    from photon_ml_tpu.online.catchup import replay_into_store
+    from photon_ml_tpu.online.delta_log import DeltaLog
+    from photon_ml_tpu.online.trainer import IncrementalTrainer, TrainerConfig
+    from photon_ml_tpu.serving.batcher import Request
+    from photon_ml_tpu.serving.swap import HotSwapper
+
+    rng = np.random.default_rng(seed)
+    engine, metrics, names = _synthetic_serving_engine(
+        rng, n_entities, d, max_batch, device_capacity=None)
+    t0 = time.perf_counter()
+    n_compiled = engine.warm()
+    warm_s = time.perf_counter() - t0
+
+    def mk_request(uid, user):
+        feats = [{"name": n, "term": "", "value": float(v)}
+                 for n, v in zip(names, rng.normal(size=d))]
+        return Request(uid=uid, features=feats,
+                       ids={"userId": f"user{user}"})
+
+    # labeled mini-batches, assembled up front so the timed loop is pure
+    # consume(): hot entities drawn from a small head so every batch
+    # actually refits (and re-refits) real entities
+    hot = rng.integers(0, min(256, n_entities), size=batches * batch_size)
+    feed = []
+    for b in range(batches):
+        batch = []
+        for i in range(batch_size):
+            u = int(hot[b * batch_size + i])
+            req = mk_request(None, u)
+            batch.append({"uid": None, "features": req.features,
+                          "ids": req.ids,
+                          "label": float(rng.integers(0, 2))})
+        feed.append((batch, int(hot[(b + 1) * batch_size - 1])))
+
+    with tempfile.TemporaryDirectory(prefix="photon_online_bench_") as tmp:
+        log = DeltaLog(tmp, fsync="rotate",
+                       registry=metrics.registry)
+        swapper = HotSwapper(engine, delta_log=log)
+        trainer = IncrementalTrainer(
+            swapper, TrainerConfig(coordinates=("per_user",)))
+
+        # concurrent serving load: single-request scores through the SAME
+        # engine for the whole refit phase — publishes must not stall it
+        stop = threading.Event()
+        served = [0]
+
+        def serve_loop():
+            r = np.random.default_rng(seed + 1)
+            while not stop.is_set():
+                u = int(r.integers(0, n_entities))
+                engine.score_requests([mk_request(served[0], u)])
+                served[0] += 1
+
+        compile_before = engine.compile_count
+        reports, fresh_s = [], []
+        t_serve = time.perf_counter()
+        loader = threading.Thread(target=serve_loop, daemon=True)
+        loader.start()
+        try:
+            for batch, probe_user in feed:
+                rep = trainer.consume(batch)
+                # freshness: first publish of this batch -> a live score
+                # of a just-refit entity completing
+                probe = mk_request("probe", probe_user)
+                engine.score_requests([probe])
+                if rep.publish_started:
+                    fresh_s.append(time.perf_counter() - rep.publish_started)
+                reports.append(rep)
+        finally:
+            stop.set()
+            loader.join(timeout=10.0)
+        serve_wall = time.perf_counter() - t_serve
+        recompiles = engine.compile_count - compile_before
+
+        entities = sum(r.entities for r in reports)
+        rows = sum(r.rows for r in reports)
+        published = sum(r.published for r in reports)
+        refit_wall = sum(r.wall_s for r in reports)
+
+        probe_user = feed[-1][1]
+        probe = mk_request("parity", probe_user)
+        live_score = float(engine.score_requests([probe])[0])
+
+        # catch-up: identical pre-refit model (same seed, same rng draw
+        # order), then the full log replayed into it
+        rng2 = np.random.default_rng(seed)
+        engine2, _, _ = _synthetic_serving_engine(
+            rng2, n_entities, d, max_batch, device_capacity=None)
+        t0 = time.perf_counter()
+        records = list(log.replay())
+        stats = replay_into_store(engine2.store, records)
+        catchup_s = time.perf_counter() - t0
+        replica_score = float(engine2.score_requests([probe])[0])
+
+        fr = np.asarray(fresh_s) * 1e3 if fresh_s else np.zeros(1)
+        out = {
+            "metric": "online_refit_entities_per_s", "unit": "entities/s",
+            "value": round(entities / refit_wall, 1) if refit_wall else 0.0,
+            "backend": jax.default_backend(),
+            "n_entities": n_entities, "d": d, "batches": batches,
+            "batch_size": batch_size,
+            "warm": {"executables": n_compiled, "seconds": round(warm_s, 4)},
+            "refit": {
+                "entities": entities, "rows": rows, "published": published,
+                "rejected": sum(r.rejected for r in reports),
+                "wall_s": round(refit_wall, 4),
+                "solve_s": round(sum(r.solve_s for r in reports), 4),
+                "publish_s": round(sum(r.publish_s for r in reports), 4),
+                "rows_per_s": round(rows / refit_wall, 1)
+                              if refit_wall else 0.0},
+            "freshness_ms": {
+                "p50": round(float(np.percentile(fr, 50)), 3),
+                "p99": round(float(np.percentile(fr, 99)), 3),
+                "max": round(float(fr.max()), 3)},
+            "serving_during_refit": {
+                "scores": served[0],
+                "qps": round(served[0] / serve_wall, 1)},
+            "recompiles_during_refit": int(recompiles),
+            "catchup": {
+                "records": len(records), "applied": stats.applied,
+                "rejected": stats.rejected,
+                "seconds": round(catchup_s, 4),
+                "rows_per_s": round(stats.applied / catchup_s, 1)
+                              if catchup_s else 0.0,
+                "replica_score_parity": replica_score == live_score},
+            "delta_log": {"bytes": log.bytes_written,
+                          "records": log.records_written,
+                          "segments": len(log.segments())},
+        }
+        log.close()
+    if out_path is None:
+        out_path = os.path.join(_REPO,
+                                f"BENCH_ONLINE_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def run_solve_bench(out_path=None, seed=0, n_users=96, per_user=96,
                     d_user=4, n_iterations=4) -> dict:
     """`bench.py --solve`: per-entity solve-path micro-bench ->
@@ -2149,6 +2315,17 @@ def main():
                     help="client connections the arrivals spread across")
     ap.add_argument("--open-loop-budget-ms", type=float, default=25.0,
                     help="front-end admission deadline budget")
+    ap.add_argument("--online", action="store_true",
+                    help="photonlearn loop end to end (incremental refit "
+                         "throughput under concurrent serving load, "
+                         "publish->visible freshness, delta-log catch-up "
+                         "replay rate + replica score parity) -> "
+                         "BENCH_ONLINE_<backend>.json")
+    ap.add_argument("--online-batches", type=int, default=8,
+                    help="with --online: labeled mini-batches streamed "
+                         "through the trainer")
+    ap.add_argument("--online-batch-size", type=int, default=64,
+                    help="with --online: examples per mini-batch")
     ap.add_argument("--solve", action="store_true",
                     help="per-entity solve-path micro-bench (SoA Newton "
                          "lanes/sec, host vs fused vs fused-validated sweep "
@@ -2175,6 +2352,12 @@ def main():
         return
     if a.solve:
         print(json.dumps(run_solve_bench(out_path=a.out)))
+        return
+    if a.online:
+        print(json.dumps(run_online_bench(
+            batches=a.online_batches,
+            batch_size=a.online_batch_size,
+            out_path=a.out)))
         return
     if a.serving and a.open_loop:
         rates = [float(r) for r in a.open_loop_rates.split(",")

@@ -57,6 +57,13 @@ additionally exposes ``GET /metrics`` (Prometheus text exposition) on
 localhost in either mode.  Input lines in both modes are byte-bounded
 (``--max-line-bytes``): an oversized line gets an ``{"error": ...}`` reply
 and the stream keeps going.
+
+``--delta-log DIR`` makes this process a photonlearn REPLICA: the
+delta log a ``cli/learn.py`` trainer writes is replayed into the store
+before serving, tailed on a background thread (``--delta-log-poll``), and
+replayed onto every hot-swapped-in generation before it activates — so a
+second serving process converges to the trainer's live coefficients with
+no coordination beyond the shared log directory (see online/catchup.py).
 """
 
 from __future__ import annotations
@@ -154,6 +161,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--listen mode: max requests resident in the "
                         "batcher at once; the rest queue per-client where "
                         "round-robin fairness applies (0 = 2 flush waves)")
+    p.add_argument("--client-budget-ms", type=float, default=0.0,
+                   help="--listen mode: per-CONNECTION deadline budget — a "
+                        "client whose own backlog is predicted to wait "
+                        "longer is shed alone ({\"error\": \"overloaded\", "
+                        "\"reason\": \"client_overload\"}) before the "
+                        "global latch trips for everyone (0 = off)")
+    p.add_argument("--max-connections", type=int, default=0,
+                   help="--listen mode: hard connection-count cap; excess "
+                        "accepts get one {\"error\": "
+                        "\"too_many_connections\"} reply and a clean close "
+                        "(0 = unlimited)")
+    p.add_argument("--delta-log", default="",
+                   help="FOLLOW a photonlearn delta log directory "
+                        "(online/delta_log.py): replay it into the store "
+                        "before serving, then tail it so rows a trainer "
+                        "process publishes become visible here within "
+                        "--delta-log-poll seconds; the log is read-only to "
+                        "this process and hot swaps replay it onto the "
+                        "incoming generation before activation")
+    p.add_argument("--delta-log-poll", type=float, default=0.05,
+                   help="seconds between delta-log tail polls")
     p.add_argument("--metrics-json", default="",
                    help="write the final metrics snapshot here at exit")
     p.add_argument("--trace", action="store_true",
@@ -175,9 +203,14 @@ def build_server(model_dir: str,
                  lru_capacity: int = 4096,
                  hot_decay: float = 0.5,
                  metrics: Optional[ServingMetrics] = None,
-                 warm: bool = True) -> Tuple[ScoringEngine, HotSwapper]:
+                 warm: bool = True,
+                 delta_log=None,
+                 log_owner: bool = True) -> Tuple[ScoringEngine, HotSwapper]:
     """Programmatic entry point: load -> store -> engine (+ warmed ladder)
-    -> swapper.  Raises storage.model_io.ModelLoadError on a broken dir."""
+    -> swapper.  Raises storage.model_io.ModelLoadError on a broken dir.
+    ``delta_log``/``log_owner`` attach an ``online.DeltaLog`` to the
+    swapper (serving/swap.py module docstring for the owner/follower
+    split)."""
     metrics = metrics or ServingMetrics()
     bundle = load_model_bundle(model_dir)
     config = StoreConfig(device_capacity=device_entity_capacity,
@@ -190,7 +223,8 @@ def build_server(model_dir: str,
         n = engine.warm()
         logger.info("warmed %d executable(s) over buckets %s", n,
                     engine.batcher.bucket_sizes)
-    return engine, HotSwapper(engine)
+    return engine, HotSwapper(engine, delta_log=delta_log,
+                              log_owner=log_owner)
 
 
 def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
@@ -351,10 +385,13 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
         max_line_bytes=args.max_line_bytes,
         admission=AdmissionConfig(
             budget_s=args.admission_budget_ms * 1e-3,
-            resume_fraction=args.resume_fraction),
+            resume_fraction=args.resume_fraction,
+            client_budget_s=(args.client_budget_ms * 1e-3
+                             if args.client_budget_ms else None)),
         batcher_deadline_s=args.deadline_us * 1e-6,
         dispatch_window=(args.dispatch_window or None),
-        predict_mean=args.predict_mean)
+        predict_mean=args.predict_mean,
+        max_connections=(args.max_connections or None))
 
     async def _main() -> int:
         front = FrontendServer(engine, swapper, config)
@@ -400,6 +437,14 @@ def run(argv: List[str]) -> int:
     buckets = None
     if args.buckets:
         buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    delta_log = None
+    if args.delta_log:
+        from photon_ml_tpu.online.delta_log import DeltaLog
+
+        # follower role: this process never appends (its process-local
+        # generation numbers would corrupt the writer's identity order)
+        # and never compacts; fsync is moot for a pure reader
+        delta_log = DeltaLog(args.delta_log, fsync="never")
     try:
         engine, swapper = build_server(
             args.model_dir,
@@ -408,13 +453,29 @@ def run(argv: List[str]) -> int:
             device_entity_capacity=(args.device_entity_capacity or None),
             lru_capacity=args.lru_capacity,
             hot_decay=args.hot_decay,
-            warm=not args.no_warm)
+            warm=not args.no_warm,
+            delta_log=delta_log,
+            log_owner=False)
     except (ModelLoadError, ValueError) as e:
         logger.error("--model-dir: %s", e)
         return 1
     logger.info("serving generation %d (version %r), task %s",
                 engine.store.generation, engine.store.version,
                 engine.store.task.value)
+
+    follower = None
+    if delta_log is not None:
+        from photon_ml_tpu.online.catchup import LogFollower
+
+        follower = LogFollower(delta_log, lambda: engine.store,
+                               poll_interval_s=args.delta_log_poll,
+                               registry=engine.metrics.registry)
+        stats = follower.run_once()  # initial catch-up BEFORE serving
+        logger.info("delta-log catch-up: applied %d, rejected %d "
+                    "(position %s); following %s every %.3fs",
+                    stats.applied, stats.rejected, stats.position,
+                    args.delta_log, args.delta_log_poll)
+        follower.start()
 
     hotset = None
     if args.hot_set_interval > 0:
@@ -447,6 +508,8 @@ def run(argv: List[str]) -> int:
                 if lines is not sys.stdin:
                     lines.close()
     finally:
+        if follower is not None:
+            follower.stop()
         if metrics_sidecar is not None:
             metrics_sidecar.stop()
         if hotset is not None:

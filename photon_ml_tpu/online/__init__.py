@@ -1,0 +1,28 @@
+"""photonlearn — the online-learning loop (ROADMAP item 3).
+
+Three pieces turn training and serving into one system:
+
+- ``delta_log``: durable append-only log of coefficient-row deltas keyed by
+  the swapper's ``(generation, delta_version)`` identity — segment files
+  per generation, CRC-framed records, torn-tail-tolerant replay,
+  compaction at swap boundaries.
+- ``trainer``: ``IncrementalTrainer`` — warm-started per-entity
+  random-effect refits from fresh mini-batches, solved with the batched
+  SoA Newton path, published as ordered deltas to the live store AND the
+  log.
+- ``catchup``: idempotent replay — a rotated-in generation or a second
+  replica store follows the log to convergence.
+"""
+
+from photon_ml_tpu.online.catchup import (CatchupStats, LogFollower,
+                                          replay_into_store)
+from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
+from photon_ml_tpu.online.trainer import (Example, IncrementalTrainer,
+                                          RefitReport, TrainerConfig,
+                                          example_from_json)
+
+__all__ = [
+    "CatchupStats", "DeltaLog", "DeltaRecord", "Example",
+    "IncrementalTrainer", "LogFollower", "RefitReport", "TrainerConfig",
+    "example_from_json", "replay_into_store",
+]

@@ -1,0 +1,174 @@
+"""Replicated catch-up: replay the delta log into a CoefficientStore.
+
+Two consumers share this machinery (module docstring of ``delta_log``):
+
+- **swap-in replay** (serving/swap.py): a freshly rotated-in generation
+  replays the log before ``activate`` so the flip never loses rows the
+  online trainer published while the snapshot was training/loading;
+- **replica follow** (``LogFollower``, ``cli/serve.py --delta-log``): a
+  second serving process applies the same ordered stream to its own store
+  and converges to the writer's coefficient state.
+
+**Idempotence.**  Replay tracks the last applied identity
+``(generation, delta_version)`` and skips anything at or below it, so
+overlapping replays (duplicated iterators, a follower restarted from
+scratch, a full-log replay after a partial one) apply each update once.
+The position is the LOG's identity, never the local store's generation —
+generation numbers are process-local counters and mean nothing across
+processes.
+
+**Ordering = correctness.**  Records are full-row replacements, so
+applying a prefix of the log in order always yields a state the writer
+actually had; applying the whole log yields the writer's current rows
+bitwise (tests/test_online.py asserts this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import (TYPE_CHECKING, Callable, Iterable, Optional,
+                    Tuple)
+
+import numpy as np
+
+from photon_ml_tpu.obs.registry import MetricsRegistry
+from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
+
+if TYPE_CHECKING:  # import-cycle guard: serving.swap imports this module
+    from photon_ml_tpu.serving.coefficient_store import CoefficientStore
+
+logger = logging.getLogger("photon_ml_tpu.online.catchup")
+
+
+@dataclasses.dataclass
+class CatchupStats:
+    """One replay pass: what was applied, skipped, or refused."""
+
+    applied: int = 0
+    skipped: int = 0   # identity at or below the replay position
+    rejected: int = 0  # unknown entity / unknown coordinate / bad width
+    position: Optional[Tuple[int, int]] = None  # last identity consumed
+
+    def merge(self, other: "CatchupStats") -> None:
+        self.applied += other.applied
+        self.skipped += other.skipped
+        self.rejected += other.rejected
+        if other.position is not None:
+            self.position = other.position
+
+
+def replay_into_store(store: "CoefficientStore",
+                      records: Iterable[DeltaRecord],
+                      position: Optional[Tuple[int, int]] = None,
+                      registry: Optional[MetricsRegistry] = None,
+                      ) -> CatchupStats:
+    """Apply an ordered record stream to a store; never raises.
+
+    ``position`` is the last identity already applied (None = apply all);
+    records at or below it are skipped, making any overlap idempotent.  A
+    record the store refuses — entity or coordinate the snapshot never
+    trained, row width mismatch after a schema change — is counted and
+    logged, not fatal: a replica must survive replaying a log written
+    against a slightly different snapshot.
+    """
+    stats = CatchupStats(position=position)
+    for r in records:
+        if stats.position is not None and r.identity <= stats.position:
+            stats.skipped += 1
+            continue
+        try:
+            ok = store.apply_delta(r.cid, r.entity,
+                                   np.asarray(r.row, dtype=np.float64))
+        except ValueError as e:
+            logger.warning("catchup: record %s rejected: %s", r.identity, e)
+            ok = False
+        if ok:
+            stats.applied += 1
+        else:
+            stats.rejected += 1
+        stats.position = r.identity
+    if registry is not None and (stats.applied or stats.rejected):
+        registry.inc("catchup_applied_total", stats.applied)
+        if stats.rejected:
+            registry.inc("catchup_rejected_total", stats.rejected)
+    return stats
+
+
+class LogFollower:
+    """Tail a delta log and keep a follower store converged.
+
+    ``store_getter`` returns the store to apply to on each pass — pass
+    ``lambda: engine.store`` so a hot swap in the follower process
+    retargets the follow loop automatically.  When the store's generation
+    changes between passes the position resets and the WHOLE log replays
+    into the new store: replay is an ordered overwrite, so the result
+    matches the writer regardless of what the swapped-in snapshot already
+    contained, and compaction keeps the log short enough for this to be
+    cheap.
+
+    ``run_once`` is the synchronous form (tests, initial catch-up before
+    serving); ``start``/``stop`` run it on a daemon thread at
+    ``poll_interval_s``.
+    """
+
+    def __init__(self, log: DeltaLog,
+                 store_getter: Callable[[], "CoefficientStore"],
+                 poll_interval_s: float = 0.05,
+                 registry: Optional[MetricsRegistry] = None):
+        self.log = log
+        self._store_getter = store_getter
+        self.poll_interval_s = poll_interval_s
+        self._registry = registry
+        self._position: Optional[Tuple[int, int]] = None
+        self._store_generation: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._run_lock = threading.Lock()
+
+    @property
+    def position(self) -> Optional[Tuple[int, int]]:
+        return self._position
+
+    def run_once(self) -> CatchupStats:
+        """One catch-up pass: apply everything past the current position."""
+        with self._run_lock:
+            store = self._store_getter()
+            if store.generation != self._store_generation:
+                # new local snapshot: full ordered replay re-derives the
+                # writer's state on it (idempotent overwrite — see class doc)
+                self._position = None
+                self._store_generation = store.generation
+            with obs_span("online.catchup", generation=store.generation):
+                stats = replay_into_store(store, self.log.replay(),
+                                          position=self._position,
+                                          registry=self._registry)
+            if stats.position is not None:
+                self._position = stats.position
+            return stats
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="photon-delta-follow")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("catchup: follow pass failed; retrying")
+            self._stop.wait(self.poll_interval_s)

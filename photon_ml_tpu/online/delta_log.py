@@ -1,0 +1,302 @@
+"""Durable append-only delta log: the replication spine of photonlearn.
+
+Photon ML reference counterpart: none in the batch repo — LinkedIn's
+production GLMix pushes retrained PalDB stores through offline
+infrastructure.  The paper's operational point (random effects must
+refresh far more often than the fixed effect) needs a durable, ordered
+carrier for single-row coefficient updates, which is this file.
+
+**Identity.**  Every record is keyed by the serving swapper's
+``(generation, delta_version)`` pair — the ONE version identity the
+coefficient state already has (serving/swap.py).  The log requires
+identities to be strictly increasing in lexicographic order, which the
+single-writer swapper guarantees (one swap OR delta in flight at a time)
+and the log enforces loudly, because every replay consumer depends on it
+for idempotence: a follower remembers the last identity it applied and
+skips anything at or below it.
+
+**Format.**  One directory per log.  Segment files, one per generation
+(``segment-<generation>.log``), each starting with an 8-byte magic and
+holding length-prefixed records::
+
+    [u32 payload_len][u32 crc32(payload)][payload bytes]   (little-endian)
+
+The payload is compact JSON — ``{"g": generation, "v": delta_version,
+"c": cid, "e": entity, "r": [row...]}``.  JSON float round-trips are
+exact (repr shortest-round-trip), and the store casts rows back to the
+archive dtype on apply, so a replayed row is bitwise the published row.
+
+**Crash safety.**  A crash mid-append leaves at most one torn record at
+the tail of the newest segment.  ``replay`` treats any framing violation
+— short header, length past EOF, CRC mismatch, undecodable payload — as
+the torn tail: it stops that segment cleanly and NEVER raises.  A writer
+re-opening a segment first truncates it to the last valid record, so new
+appends never land after garbage that replay would refuse to cross.
+
+**Compaction.**  At a swap boundary the new snapshot supersedes every
+delta published against earlier generations, so ``compact(active_gen)``
+drops all segments older than the active generation (the swapper calls it
+after ``activate`` when it owns the log).
+
+**Fsync policy** (``fsync=``): ``"always"`` fsyncs every append — a
+publish that returned is on disk; ``"rotate"`` fsyncs only at segment
+rotation, explicit ``sync()``, and ``close()`` — a crash can lose the
+tail of the active segment but never re-orders or corrupts it;
+``"never"`` leaves flushing to the OS (benchmark floor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import IO, Iterator, List, Optional, Tuple
+
+from photon_ml_tpu.obs.registry import MetricsRegistry
+
+logger = logging.getLogger("photon_ml_tpu.online.delta_log")
+
+_MAGIC = b"PHOTDLG1"
+_HEADER = struct.Struct("<II")  # payload length, crc32(payload)
+# a length field past this is framing garbage, not a record — refuse to
+# allocate for it even if the file claims to be that long
+_MAX_PAYLOAD = 1 << 30
+_FSYNC_POLICIES = ("always", "rotate", "never")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaRecord:
+    """One published coefficient-row update, identity included."""
+
+    generation: int
+    delta_version: int
+    cid: str
+    entity: str
+    row: Tuple[float, ...]
+
+    @property
+    def identity(self) -> Tuple[int, int]:
+        return (self.generation, self.delta_version)
+
+    def encode(self) -> bytes:
+        payload = json.dumps(
+            {"g": self.generation, "v": self.delta_version, "c": self.cid,
+             "e": self.entity, "r": list(self.row)},
+            separators=(",", ":")).encode("utf-8")
+        return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+    @classmethod
+    def decode_payload(cls, payload: bytes) -> "DeltaRecord":
+        obj = json.loads(payload.decode("utf-8"))
+        return cls(generation=int(obj["g"]), delta_version=int(obj["v"]),
+                   cid=str(obj["c"]), entity=str(obj["e"]),
+                   row=tuple(float(x) for x in obj["r"]))
+
+
+def _segment_name(generation: int) -> str:
+    return f"segment-{generation:010d}.log"
+
+
+def _scan_segment(path: str) -> Tuple[List[DeltaRecord], int]:
+    """All valid records in a segment plus the byte length of the valid
+    prefix.  Framing violations end the scan (torn tail) — never raise."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        logger.warning("delta log: unreadable segment %s: %s", path, e)
+        return [], 0
+    if len(data) < len(_MAGIC) or data[: len(_MAGIC)] != _MAGIC:
+        logger.warning("delta log: segment %s missing magic header", path)
+        return [], 0
+    records: List[DeltaRecord] = []
+    pos = len(_MAGIC)
+    while True:
+        if pos + _HEADER.size > len(data):
+            break  # torn/absent header
+        length, crc = _HEADER.unpack_from(data, pos)
+        end = pos + _HEADER.size + length
+        if length > _MAX_PAYLOAD or end > len(data):
+            break  # torn payload (or garbage length)
+        payload = data[pos + _HEADER.size: end]
+        if zlib.crc32(payload) != crc:
+            break  # corrupt record: treat as torn tail
+        try:
+            records.append(DeltaRecord.decode_payload(payload))
+        except (ValueError, KeyError, TypeError):
+            break  # CRC-valid but undecodable — still never raise
+        pos = end
+    return records, pos
+
+
+class DeltaLog:
+    """Append/replay/compact over one log directory (module docstring).
+
+    Thread-safe for one writer process: ``append`` serializes under a lock
+    (the swapper's own ``_swap_lock`` already orders publishes; this lock
+    keeps the log safe if ``sync``/``compact`` race an append).  Readers
+    in OTHER processes replay concurrently without coordination — they
+    only ever see a prefix of committed records plus at most one torn
+    tail, which replay ignores.
+    """
+
+    def __init__(self, path: str, fsync: str = "always",
+                 registry: Optional[MetricsRegistry] = None):
+        if fsync not in _FSYNC_POLICIES:
+            raise ValueError(
+                f"fsync must be one of {_FSYNC_POLICIES}, got {fsync!r}")
+        self.path = path
+        self.fsync = fsync
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._file: Optional[IO[bytes]] = None
+        self._file_generation: Optional[int] = None
+        os.makedirs(path, exist_ok=True)
+        self._last: Optional[Tuple[int, int]] = self.last_identity()
+        self.bytes_written = 0
+        self.records_written = 0
+
+    # -- inspection --------------------------------------------------------
+    def segments(self) -> List[Tuple[int, str]]:
+        """(generation, path) for every segment on disk, ascending."""
+        out = []
+        for name in os.listdir(self.path):
+            if name.startswith("segment-") and name.endswith(".log"):
+                try:
+                    gen = int(name[len("segment-"): -len(".log")])
+                except ValueError:
+                    continue
+                out.append((gen, os.path.join(self.path, name)))
+        return sorted(out)
+
+    def last_identity(self) -> Optional[Tuple[int, int]]:
+        """Identity of the newest valid record, or None for an empty log.
+        Scans segments newest-first so a header-only segment falls through
+        to the previous one."""
+        for gen, path in reversed(self.segments()):
+            records, _ = _scan_segment(path)
+            if records:
+                return records[-1].identity
+        return None
+
+    # -- writing -----------------------------------------------------------
+    def append(self, record: DeltaRecord) -> None:
+        """Durably append one record; identities must be strictly
+        increasing (a regression is a writer bug — raise, don't corrupt
+        every replica downstream)."""
+        with self._lock:
+            if self._last is not None and record.identity <= self._last:
+                raise ValueError(
+                    f"delta log: non-monotone identity {record.identity} "
+                    f"after {self._last} — writer restart without "
+                    "advance_generation_floor, or two writers on one log")
+            f = self._segment_for(record.generation)
+            frame = record.encode()
+            f.write(frame)
+            f.flush()
+            if self.fsync == "always":
+                self._fsync(f)
+            self._last = record.identity
+            self.bytes_written += len(frame)
+            self.records_written += 1
+        if self._registry is not None:
+            self._registry.inc("delta_log_bytes_total", len(frame))
+            self._registry.inc("delta_log_records_total")
+
+    def _segment_for(self, generation: int) -> IO[bytes]:
+        if self._file is not None and self._file_generation == generation:
+            return self._file
+        self._close_current()
+        path = os.path.join(self.path, _segment_name(generation))
+        if os.path.exists(path):
+            # crash recovery: never append after a torn tail — replay stops
+            # at the tear, so records beyond it would be invisible forever
+            _, valid_len = _scan_segment(path)
+            size = os.path.getsize(path)
+            if valid_len < size:
+                logger.warning(
+                    "delta log: truncating torn tail of %s (%d -> %d bytes)",
+                    path, size, valid_len)
+                with open(path, "r+b") as f:
+                    f.truncate(valid_len)
+            self._file = open(path, "ab")
+        else:
+            self._file = open(path, "ab")
+            self._file.write(_MAGIC)
+            self._file.flush()
+            if self.fsync != "never":
+                self._fsync(self._file)
+        self._file_generation = generation
+        return self._file
+
+    def _fsync(self, f: IO[bytes]) -> None:
+        t0 = time.perf_counter()
+        os.fsync(f.fileno())
+        if self._registry is not None:
+            self._registry.observe("delta_log_fsync_s",
+                                   time.perf_counter() - t0)
+
+    def _close_current(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+            if self.fsync != "never":
+                self._fsync(self._file)
+            self._file.close()
+            self._file = None
+            self._file_generation = None
+
+    def sync(self) -> None:
+        """Force the active segment to disk (no-op under ``always``)."""
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                self._fsync(self._file)
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_current()
+
+    # -- reading -----------------------------------------------------------
+    def replay(self, after: Optional[Tuple[int, int]] = None,
+               ) -> Iterator[DeltaRecord]:
+        """Every committed record in identity order, skipping identities at
+        or below ``after``.  Torn tails are ignored; never raises."""
+        for gen, path in self.segments():
+            if after is not None and gen < after[0]:
+                continue
+            records, _ = _scan_segment(path)
+            for r in records:
+                if after is not None and r.identity <= after:
+                    continue
+                yield r
+
+    # -- compaction --------------------------------------------------------
+    def compact(self, active_generation: int) -> List[int]:
+        """Drop segments older than the active generation (their deltas are
+        baked into — or superseded by — the active snapshot).  Returns the
+        dropped generations."""
+        dropped = []
+        with self._lock:
+            for gen, path in self.segments():
+                if gen >= active_generation:
+                    continue
+                if self._file_generation == gen:
+                    self._close_current()
+                try:
+                    os.remove(path)
+                    dropped.append(gen)
+                except OSError as e:
+                    logger.warning("delta log: compact could not drop %s: %s",
+                                   path, e)
+        if dropped and self._registry is not None:
+            self._registry.inc("delta_log_segments_compacted_total",
+                               len(dropped))
+        if dropped:
+            logger.info("delta log: compacted %d segment(s) older than gen "
+                        "%d", len(dropped), active_generation)
+        return dropped

@@ -1,0 +1,349 @@
+"""Incremental per-entity trainer: fresh mini-batches -> live coefficients.
+
+Photon ML reference counterpart: the paper's §"online learning" argument —
+random-effect models are per-entity and tiny, so they can (and should)
+refresh far more often than the shared fixed-effect model.  The reference
+repo retrains offline; this module is the missing producer for the
+serving stack's delta machinery (serving/swap.py, online/delta_log.py).
+
+The refit is the GLMix per-entity subproblem verbatim: for each entity
+with fresh examples, minimize ``sum_i w_i * loss(x_i . beta + offset_i,
+y_i) + l2/2 ||beta||^2`` where ``offset_i`` carries the example offset
+PLUS every OTHER coordinate's margin (the coordinate-descent contract —
+game/coordinate.py does exactly this on the batch path).  Three choices
+make it cheap enough to run continuously (Snap ML's thesis, PAPERS.md):
+
+- **warm start** from the SERVED coefficients (``dense_row``): the fresh
+  mini-batch moves the optimum a little, so Newton from the live row
+  converges in a couple of iterations instead of from scratch;
+- **batched tiny solves**: entities become lanes of one
+  ``opt/newton_soa.py`` SoA program ([d, L] lanes-last — the layout built
+  for exactly these narrow per-entity systems; Pallas-eligible on TPU for
+  free), padded to a pow2 (cap, L) grid so the jit cache stays a handful
+  of entries;
+- **in-process publish**: updated rows go straight to
+  ``HotSwapper.publish_delta`` — device scatter + durable log append under
+  one identity, no serialization hop (the Spark-perf study's data-movement
+  tax is the thing this path deletes).
+
+Serving stays zero-recompile: a published row is a same-shape scatter
+into the live table, and the solver jit cache is keyed on padded shapes
+the pow2 floors bound.
+
+``consume`` is the whole API: parse examples, group by entity, refit every
+eligible coordinate, publish.  Single-threaded by contract (one trainer
+per process — the swapper's lock already serializes publishes; the solver
+cache is not locked).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from photon_ml_tpu.core.losses import PointwiseLoss, loss_for_task
+from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.opt.newton_soa import soa_eligible, solve_newton_soa
+from photon_ml_tpu.opt.types import SolverConfig
+from photon_ml_tpu.serving.batcher import (Request, densify_features,
+                                           request_from_json)
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     FixedCoordinate,
+                                                     RandomCoordinate)
+
+logger = logging.getLogger("photon_ml_tpu.online.trainer")
+
+
+@dataclasses.dataclass(frozen=True)
+class Example:
+    """One labeled fresh example: a scoring Request plus its outcome."""
+
+    request: Request
+    label: float
+    weight: float = 1.0
+
+
+def example_from_json(obj: dict) -> Example:
+    """Wire JSON -> Example.  The request part is the serving wire format
+    (``request_from_json``); the label rides as ``label`` or ``response``
+    (the TrainingExampleAvro field name), weight defaults to 1."""
+    label = obj.get("label", obj.get("response"))
+    if label is None:
+        raise ValueError("example needs a 'label' (or 'response') field")
+    return Example(request=request_from_json(obj), label=float(label),
+                   weight=float(obj.get("weight", 1.0)))
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    """Refit knobs.
+
+    ``coordinates``: which coordinates to refit (None = every
+    random-effect coordinate the SoA gate accepts; naming an ineligible
+    one raises at construction).  ``l2``: per-entity ridge strength —
+    also the prior pulling a sparsely-observed entity toward its
+    warm-start row... the regularizer is centered at 0 exactly like batch
+    training, so l2 trades batch-parity pull-to-zero against mini-batch
+    overfit.  ``cap_floor``/``lane_floor``: pow2 padding floors for the
+    solve grid (row capacity x entity lanes) — they bound the solver jit
+    cache for arbitrary mini-batch shapes.  ``min_rows_per_entity``:
+    entities with fewer fresh rows wait for more data instead of being
+    refit on noise."""
+
+    coordinates: Optional[Tuple[str, ...]] = None
+    l2: float = 1.0
+    max_iters: int = 20
+    tolerance: float = 1e-7
+    min_rows_per_entity: int = 1
+    cap_floor: int = 4
+    lane_floor: int = 8
+
+
+@dataclasses.dataclass
+class RefitReport:
+    """What one ``consume`` call did."""
+
+    examples: int = 0
+    entities: int = 0            # entity-coordinate refits solved
+    rows: int = 0                # example-rows that entered a solve
+    published: int = 0
+    rejected: int = 0            # publish refused by the store
+    skipped_unknown: int = 0     # example rows with no trained entity row
+    coordinates: Dict[str, int] = dataclasses.field(default_factory=dict)
+    first_identity: Optional[Tuple[int, int]] = None
+    last_identity: Optional[Tuple[int, int]] = None
+    solve_s: float = 0.0
+    publish_s: float = 0.0
+    wall_s: float = 0.0
+    publish_started: float = 0.0  # perf_counter at first publish
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out.pop("publish_started")
+        for k in ("solve_s", "publish_s", "wall_s"):
+            out[k] = round(out[k], 6)
+        return out
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    p = max(1, floor)
+    while p < n:
+        p *= 2
+    return p
+
+
+class IncrementalTrainer:
+    """Mini-batch per-entity refits published through a HotSwapper.
+
+    ``swapper`` is the publish sink (``publish_delta`` — live store apply
+    + delta-log append under one identity).  Attach the log to the
+    swapper, not here: the trainer only ever sees identities.
+    """
+
+    def __init__(self, swapper, config: Optional[TrainerConfig] = None,
+                 metrics=None):
+        self.swapper = swapper
+        self.engine = swapper.engine
+        self.config = config or TrainerConfig()
+        self.metrics = metrics or self.engine.metrics
+        self._solvers: Dict[tuple, object] = {}
+        self._warned_skip: set = set()
+        self._validate_targets(self.engine.store)
+
+    # -- target selection --------------------------------------------------
+    def _validate_targets(self, store: CoefficientStore) -> None:
+        loss = loss_for_task(store.task)
+        if self.config.coordinates is None:
+            return  # auto mode validates (and warns) per consume
+        for cid in self.config.coordinates:
+            c = store.coordinates.get(cid)
+            if not isinstance(c, RandomCoordinate):
+                raise ValueError(
+                    f"online refit target {cid!r} is not a random-effect "
+                    "coordinate of the served model")
+            if not soa_eligible(c.dim, loss.name):
+                raise ValueError(
+                    f"online refit target {cid!r} (dim {c.dim}, loss "
+                    f"{loss.name!r}) is outside the batched SoA solver's "
+                    "gate — online refit targets narrow per-entity models")
+
+    def _targets(self, store: CoefficientStore,
+                 loss: PointwiseLoss) -> List[RandomCoordinate]:
+        out = []
+        wanted = self.config.coordinates
+        for cid in store.order:
+            c = store.coordinates[cid]
+            if not isinstance(c, RandomCoordinate):
+                continue
+            if wanted is not None and cid not in wanted:
+                continue
+            if not soa_eligible(c.dim, loss.name):
+                if wanted is not None:
+                    raise ValueError(
+                        f"online refit target {cid!r} became ineligible "
+                        f"(dim {c.dim}, loss {loss.name!r})")
+                if cid not in self._warned_skip:
+                    self._warned_skip.add(cid)
+                    logger.warning(
+                        "online refit: skipping coordinate %r (dim %d, "
+                        "loss %r outside the SoA gate)", cid, c.dim,
+                        loss.name)
+                continue
+            out.append(c)
+        return out
+
+    # -- solver cache ------------------------------------------------------
+    def _solver(self, loss: PointwiseLoss, d: int, cap: int, lanes: int):
+        key = (loss.name, d, cap, lanes)
+        fn = self._solvers.get(key)
+        if fn is None:
+            cfg = SolverConfig(max_iters=self.config.max_iters,
+                               tolerance=self.config.tolerance,
+                               track_states=False)
+
+            def run(w0_t, x_t, y_t, off_t, wt_t, l2):
+                return solve_newton_soa(loss, w0_t, x_t, y_t, off_t, wt_t,
+                                        l2, cfg)
+
+            fn = self._solvers[key] = jax.jit(run)
+        return fn
+
+    # -- the loop body -----------------------------------------------------
+    def consume(self, examples: Sequence[Union[Example, dict]],
+                ) -> RefitReport:
+        """Refit every target coordinate on one mini-batch and publish.
+
+        Accepts ``Example`` objects or their wire-JSON dicts.  Returns the
+        per-batch report; publishes nothing for coordinates/entities the
+        batch doesn't touch."""
+        t_wall = time.perf_counter()
+        exs = [e if isinstance(e, Example) else example_from_json(e)
+               for e in examples]
+        report = RefitReport(examples=len(exs))
+        if not exs:
+            return report
+        store = self.engine.store
+        loss = loss_for_task(store.task)
+        targets = self._targets(store, loss)
+        if not targets:
+            report.wall_s = time.perf_counter() - t_wall
+            return report
+        requests = [e.request for e in exs]
+        n = len(requests)
+        mats = densify_features(requests, store.index_maps, n,
+                                dtype=store.config.x_dtype)
+
+        # every coordinate's margin per example, so each refit's offset can
+        # carry "everything but me" — the coordinate-descent contract
+        margins: Dict[str, np.ndarray] = {}
+        eids_of: Dict[str, np.ndarray] = {}
+        for cid in store.order:
+            c = store.coordinates[cid]
+            x = mats[c.feature_shard]
+            if isinstance(c, FixedCoordinate):
+                margins[cid] = x @ np.asarray(c.weights)
+                continue
+            eids = np.fromiter(
+                (store.entity_id(c.random_effect_type,
+                                 r.ids.get(c.random_effect_type))
+                 for r in requests), np.int64, n)
+            eids_of[cid] = eids
+            m = np.zeros(n, np.float64)
+            for i in range(n):
+                if eids[i] >= 0:
+                    row = c.dense_row(int(eids[i]))
+                    if row is not None:
+                        m[i] = float(x[i] @ row)
+            margins[cid] = m
+        base = np.asarray([r.offset for r in requests], np.float64)
+        total = base + sum(margins.values())
+
+        for c in targets:
+            self._refit_coordinate(c, exs, mats[c.feature_shard],
+                                   eids_of[c.cid],
+                                   total - margins[c.cid], loss, report)
+        report.wall_s = time.perf_counter() - t_wall
+        return report
+
+    def _refit_coordinate(self, c: RandomCoordinate, exs: List[Example],
+                          x: np.ndarray, eids: np.ndarray,
+                          offsets: np.ndarray, loss: PointwiseLoss,
+                          report: RefitReport) -> None:
+        groups: Dict[int, List[int]] = {}
+        names: Dict[int, str] = {}
+        for i, e in enumerate(exs):
+            eid = int(eids[i])
+            if eid < 0 or c.dense_row(eid) is None:
+                report.skipped_unknown += 1
+                continue
+            groups.setdefault(eid, []).append(i)
+            names[eid] = e.request.ids[c.random_effect_type]
+        groups = {eid: rows for eid, rows in groups.items()
+                  if len(rows) >= self.config.min_rows_per_entity}
+        if not groups:
+            return
+        lanes = sorted(groups)
+        n_lanes, cap_real = len(lanes), max(map(len, groups.values()))
+        cap = _pow2_at_least(cap_real, self.config.cap_floor)
+        lanes_pad = _pow2_at_least(n_lanes, self.config.lane_floor)
+        d = c.dim
+        dt = np.float32
+        w0_t = np.zeros((d, lanes_pad), dt)
+        x_t = np.zeros((cap, d, lanes_pad), dt)
+        y_t = np.zeros((cap, lanes_pad), dt)
+        off_t = np.zeros((cap, lanes_pad), dt)
+        wt_t = np.zeros((cap, lanes_pad), dt)
+        l2 = np.full(lanes_pad, self.config.l2, dt)
+        for j, eid in enumerate(lanes):
+            w0_t[:, j] = c.dense_row(eid)  # warm start from served rows
+            for r_i, i in enumerate(groups[eid]):
+                x_t[r_i, :, j] = x[i]
+                y_t[r_i, j] = exs[i].label
+                off_t[r_i, j] = offsets[i]
+                wt_t[r_i, j] = exs[i].weight
+        rows_used = sum(map(len, groups.values()))
+
+        t0 = time.perf_counter()
+        with obs_span("online.refit", coordinate=c.cid, entities=n_lanes,
+                      rows=rows_used, cap=cap, lanes=lanes_pad):
+            solver = self._solver(loss, d, cap, lanes_pad)
+            res = solver(w0_t, x_t, y_t, off_t, wt_t, l2)
+            w = np.asarray(res.w)  # [d, lanes_pad]; host sync ends the span
+        solve_s = time.perf_counter() - t0
+        report.solve_s += solve_s
+        report.entities += n_lanes
+        report.rows += rows_used
+        report.coordinates[c.cid] = (
+            report.coordinates.get(c.cid, 0) + n_lanes)
+
+        reg = self.metrics.registry
+        reg.inc("online_refit_entities_total", n_lanes)
+        reg.inc("online_refit_rows_total", rows_used)
+        reg.observe("online_refit_s", solve_s)
+
+        t_pub = time.perf_counter()
+        if not report.publish_started:
+            report.publish_started = t_pub
+        with obs_span("online.publish", coordinate=c.cid,
+                      entities=n_lanes):
+            for j, eid in enumerate(lanes):
+                t_row = time.perf_counter()
+                ident = self.swapper.publish_delta(c.cid, names[eid],
+                                                   w[:, j])
+                if ident is None:
+                    report.rejected += 1
+                    continue
+                # publish -> visible: apply_delta returned, so the next
+                # resolve on ANY tier serves the new row
+                reg.observe("online_publish_visible_s",
+                            time.perf_counter() - t_row)
+                report.published += 1
+                if report.first_identity is None:
+                    report.first_identity = ident
+                report.last_identity = ident
+        report.publish_s += time.perf_counter() - t_pub

@@ -42,7 +42,6 @@ zero-recompile guarantee survives both.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import threading
 from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
                     Tuple, Union)
@@ -63,7 +62,37 @@ from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
 
-_generation = itertools.count(1)
+class _GenerationCounter:
+    """Monotone process-wide generation source with a raisable floor.
+
+    ``next()`` semantics match the ``itertools.count`` it replaces; the
+    floor exists for delta-log writers (online/delta_log.py): a restarted
+    trainer process would otherwise mint generation 1 again and append
+    records whose ``(generation, delta_version)`` identity collides with —
+    or sorts below — what the log already holds, breaking the log's
+    monotone-identity contract for every replica following it."""
+
+    def __init__(self, start: int = 1):
+        self._next = start
+        self._lock = threading.Lock()
+
+    def __next__(self) -> int:
+        with self._lock:
+            n = self._next
+            self._next += 1
+            return n
+
+    def advance_to(self, floor: int) -> None:
+        with self._lock:
+            self._next = max(self._next, floor)
+
+
+_generation = _GenerationCounter()
+
+
+def advance_generation_floor(floor: int) -> None:
+    """Ensure every generation minted from now on is >= ``floor``."""
+    _generation.advance_to(int(floor))
 
 # frequencies at or below this after decay are zeroed in the counter table —
 # the long tail of one-hit entities must not keep rows in the ranked set
@@ -393,6 +422,21 @@ class RandomCoordinate:
             self.cold.invalidate(e)
         return len(promote), len(demote)
 
+    def dense_row(self, eid: int) -> Optional[np.ndarray]:
+        """One entity's CURRENT coefficient row as a dense ``[dim]`` copy —
+        the warm-start read for online refits (online/trainer.py) and the
+        other-coordinate margin term in their offsets.  None for an entity
+        this coordinate never trained.  Taken under the lock so a
+        concurrent ``apply_delta`` can never hand back a half-written row."""
+        with self._lock:
+            slot = self.archive_slot_of.get(eid)
+            if slot is None:
+                return None
+            return self._dense_row_locked(slot)
+
+    def _dense_row_locked(self, slot: int) -> np.ndarray:
+        return np.array(self._archive[slot])
+
     # -- streaming deltas --------------------------------------------------
     def apply_delta(self, eid: int, row: np.ndarray) -> bool:
         """Replace one entity's coefficient row in place (online learning).
@@ -525,6 +569,13 @@ class CompactRandomCoordinate(RandomCoordinate):
         if slot is None:
             return None
         return self._archive_idx[slot], self._archive_val[slot]
+
+    def _dense_row_locked(self, slot: int) -> np.ndarray:
+        row = np.zeros(self.dim, self._archive_val.dtype)
+        idx = self._archive_idx[slot]
+        ok = idx < self.dim  # dim-padded tail columns are inert
+        row[idx[ok]] = self._archive_val[slot][ok]
+        return row
 
 
 class CoefficientStore:

@@ -27,12 +27,22 @@ here is the classic one for a batching accelerator backend:
 Shed replies carry ``retry_after_ms`` — the predicted time until the
 backlog is back under the resume watermark, clamped to at least one
 deadline budget — so a well-behaved client backs off instead of hammering.
+
+  **Per-client budgets** (``client_budget_s``, off by default) add a
+  second, narrower deadline checked FIRST against the wait attributable
+  to the requesting client's OWN backlog (its fair-queue depth plus the
+  shared batcher residue).  A single connection firehosing the edge trips
+  its own latch (shed reason ``client_overload``) and gets refused while
+  every other client keeps being admitted — without this, the burning
+  client drives the GLOBAL estimate over budget and the edge latches shut
+  for everyone.  Each client's latch carries the same two-watermark
+  hysteresis; ``forget_client`` drops the latch when a connection closes.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Dict, Optional
 
 from photon_ml_tpu.obs.registry import MetricsRegistry
 
@@ -40,6 +50,7 @@ from photon_ml_tpu.obs.registry import MetricsRegistry
 SHED_OVERLOAD = "overload"
 SHED_DRAINING = "draining"
 SHED_SHUTDOWN = "shutdown"
+SHED_CLIENT = "client_overload"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,11 +62,16 @@ class AdmissionConfig:
     ``resume_fraction``: the low watermark as a fraction of the budget
     (must sit strictly below 1.0 for the hysteresis to exist).
     ``retry_after_ms``: floor for the advisory backoff in shed replies.
+    ``client_budget_s``: per-connection deadline checked against the
+    client's OWN backlog wait (None = per-client budgets off; module
+    docstring).  Usually set below ``budget_s`` so a burning client sheds
+    before the whole edge latches.
     """
 
     budget_s: float = 0.050
     resume_fraction: float = 0.5
     retry_after_ms: float = 0.0  # 0 -> derive from the budget
+    client_budget_s: Optional[float] = None
 
     def __post_init__(self):
         if self.budget_s <= 0:
@@ -63,6 +79,9 @@ class AdmissionConfig:
         if not 0.0 < self.resume_fraction < 1.0:
             raise ValueError("resume_fraction must be in (0, 1), got "
                              f"{self.resume_fraction}")
+        if self.client_budget_s is not None and self.client_budget_s <= 0:
+            raise ValueError("client_budget_s must be > 0, got "
+                             f"{self.client_budget_s}")
 
 
 @dataclasses.dataclass
@@ -88,10 +107,14 @@ class AdmissionController:
         self.config = config or AdmissionConfig()
         self._registry = registry
         self._shedding = False
+        self._client_shedding: Dict[str, bool] = {}  # latched clients only
 
     @property
     def shedding(self) -> bool:
         return self._shedding
+
+    def client_shedding(self, client: str) -> bool:
+        return self._client_shedding.get(client, False)
 
     def _set_shedding(self, value: bool) -> None:
         if value != self._shedding:
@@ -99,20 +122,55 @@ class AdmissionController:
             if self._registry is not None:
                 self._registry.set_gauge("front_shedding", int(value))
 
+    def _set_client_shedding(self, client: str, value: bool) -> None:
+        if value:
+            self._client_shedding[client] = True
+        else:
+            self._client_shedding.pop(client, None)
+        if self._registry is not None:
+            self._registry.set_gauge("front_client_shedding", int(value),
+                                     client=client)
+
+    def forget_client(self, client: str) -> None:
+        """Drop a closed connection's latch (and its gauge series)."""
+        if client in self._client_shedding:
+            self._set_client_shedding(client, False)
+
+    def _retry_ms(self, predicted_wait_s: float, budget_s: float) -> float:
+        c = self.config
+        drain_s = max(predicted_wait_s - c.resume_fraction * budget_s, 0.0)
+        return round(max(drain_s, budget_s, c.retry_after_ms * 1e-3) * 1e3,
+                     3)
+
     def retry_after_ms(self, predicted_wait_s: float) -> float:
         """Advisory backoff: predicted time until the backlog is under the
         resume watermark, floored at one budget (a client that retries
         sooner than the backlog can possibly drain just re-queues itself
         for another shed reply)."""
-        c = self.config
-        drain_s = max(predicted_wait_s - c.resume_fraction * c.budget_s, 0.0)
-        return round(max(drain_s, c.budget_s, c.retry_after_ms * 1e-3) * 1e3,
-                     3)
+        return self._retry_ms(predicted_wait_s, self.config.budget_s)
 
-    def decide(self, predicted_wait_s: float) -> Verdict:
+    def decide(self, predicted_wait_s: float,
+               client: Optional[str] = None,
+               client_wait_s: float = 0.0) -> Verdict:
         """One admission decision for a request arriving now, given the
-        backlog predictor's estimate of its time-to-resolution."""
+        backlog predictor's estimate of its time-to-resolution and (with
+        per-client budgets on) the wait attributable to the requesting
+        client's own backlog."""
         c = self.config
+        if c.client_budget_s is not None and client is not None:
+            # the narrow check first: a client burning its own budget is
+            # shed alone, BEFORE its backlog can trip the global latch
+            budget = c.client_budget_s
+            if self._client_shedding.get(client, False):
+                if client_wait_s <= budget * c.resume_fraction:
+                    self._set_client_shedding(client, False)
+                else:
+                    return Verdict(False, client_wait_s, SHED_CLIENT,
+                                   self._retry_ms(client_wait_s, budget))
+            elif client_wait_s > budget:
+                self._set_client_shedding(client, True)
+                return Verdict(False, client_wait_s, SHED_CLIENT,
+                               self._retry_ms(client_wait_s, budget))
         if self._shedding:
             if predicted_wait_s <= c.budget_s * c.resume_fraction:
                 self._set_shedding(False)  # backlog drained: unlatch

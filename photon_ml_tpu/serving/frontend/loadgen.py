@@ -17,9 +17,21 @@ Arrivals are split round-robin across ``n_connections`` persistent
 connections so the fairness layer sees multiple clients and no single
 kernel socket buffer serializes the offered load.  Each connection has an
 asyncio sender (fires at the precomputed schedule) and a reader (matches
-``uid`` to its send timestamp); the measured latency is send-instant to
-reply-line, i.e. includes the time a request waits behind its own
-connection's earlier arrivals — the client-experienced number.
+``uid`` to its timestamps).
+
+Two latencies are recorded per reply, because a sender that falls behind
+schedule silently under-reports otherwise (**coordinated omission**): when
+the client loop can't fire at the drawn instant — its own event loop is
+busy, or ``drain()`` blocked on a full socket buffer — the send-to-reply
+clock starts late and the delay the request REALLY experienced (from its
+scheduled Poisson arrival) never shows up in the send-based percentiles.
+``latency_ms`` is the raw send-instant→reply number (comparable with
+earlier BENCH_NET history); ``latency_corrected_ms`` measures from the
+scheduled arrival instant on a schedule clock shared by every sender —
+the honest open-loop number.  ``max_send_lag_ms`` reports how far the
+generator fell behind its own schedule, so a sweep point where the two
+percentile sets diverge is diagnosable as client-side lag rather than
+server queueing.
 """
 
 from __future__ import annotations
@@ -45,7 +57,11 @@ class OpenLoopResult:
     errors: int              # any other {"error": ...} reply
     lost: int                # fired but no reply (should be 0)
     achieved_qps: float      # offered / wall time of the send phase
-    latency_ms: Dict[str, float]  # p50 / p99 / p999 over completed
+    latency_ms: Dict[str, float]  # RAW send->reply p50 / p99 / p999
+    # scheduled-arrival->reply percentiles (coordinated-omission corrected)
+    latency_corrected_ms: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    max_send_lag_ms: float = 0.0  # worst sender lag behind the schedule
 
     @property
     def shed_rate(self) -> float:
@@ -139,9 +155,15 @@ async def run_open_loop(host: str, port: int, rate_qps: float,
 
     sent_at: Dict[int, float] = {}
     latencies: List[float] = []
+    latencies_corrected: List[float] = []
+    max_lag = 0.0
     counts = {"completed": 0, "shed": 0, "errors": 0}
     pending = set(range(n))
     all_done = asyncio.Event()
+    # ONE schedule clock for every sender: scheduled instant of uid is
+    # t_start + arrivals[uid], and corrected latency is measured from it —
+    # a per-sender clock would hide exactly the lag being corrected for
+    t_start = time.perf_counter()
 
     async def read_replies(reader: asyncio.StreamReader) -> None:
         while pending:
@@ -163,6 +185,8 @@ async def run_open_loop(host: str, port: int, rate_qps: float,
                 if "score" in obj:
                     counts["completed"] += 1
                     latencies.append(now - sent_at[uid])
+                    latencies_corrected.append(
+                        now - (t_start + arrivals[uid]))
                 elif obj.get("error") == "overloaded":
                     counts["shed"] += 1
                 else:
@@ -173,16 +197,20 @@ async def run_open_loop(host: str, port: int, rate_qps: float,
                 all_done.set()
 
     async def send_arrivals(conn_idx: int) -> None:
+        nonlocal max_lag
         _, writer = conns[conn_idx]
-        t0 = time.perf_counter()
         for uid in range(conn_idx, n, n_connections):
             # fire at the SCHEDULED instant, not request-after-response;
             # yield even when behind schedule so this sender's hot loop
             # cannot starve the reply readers sharing the client loop
             # (that would bill server latency for client-side buffering)
-            delay = arrivals[uid] - (time.perf_counter() - t0)
+            delay = arrivals[uid] - (time.perf_counter() - t_start)
             await asyncio.sleep(delay if delay > 0 else 0)
-            sent_at[uid] = time.perf_counter()
+            now = time.perf_counter()
+            sent_at[uid] = now
+            lag = now - (t_start + arrivals[uid])
+            if lag > max_lag:
+                max_lag = lag
             writer.write((json.dumps(make_request(uid)) + "\n")
                          .encode("utf-8"))
             await writer.drain()
@@ -190,7 +218,6 @@ async def run_open_loop(host: str, port: int, rate_qps: float,
         await writer.drain()
 
     readers = [asyncio.ensure_future(read_replies(r)) for r, _ in conns]
-    t_start = time.perf_counter()
     await asyncio.gather(*(send_arrivals(i)
                            for i in range(n_connections)))
     send_wall = time.perf_counter() - t_start
@@ -211,4 +238,6 @@ async def run_open_loop(host: str, port: int, rate_qps: float,
         completed=counts["completed"], shed=counts["shed"],
         errors=counts["errors"], lost=len(pending),
         achieved_qps=round(n / send_wall, 2) if send_wall > 0 else 0.0,
-        latency_ms=_percentiles(latencies))
+        latency_ms=_percentiles(latencies),
+        latency_corrected_ms=_percentiles(latencies_corrected),
+        max_send_lag_ms=round(max_lag * 1e3, 4))

@@ -34,13 +34,24 @@ What makes it an edge rather than a socket wrapper:
     line is discarded through its newline under a hard byte bound, so one
     client cannot OOM the server.
 
+  - **Per-client admission budgets** (``AdmissionConfig.client_budget_s``,
+    off by default): before the global deadline check, the wait a client's
+    OWN backlog explains is tested against a per-connection budget with
+    its own hysteresis latch — a single firehose connection sheds with
+    reason ``client_overload`` while everyone else keeps being admitted.
+  - **Connection cap** (``FrontendConfig.max_connections``): accepts past
+    the cap get one ``{"error": "too_many_connections"}`` line and a clean
+    close before any per-connection state is allocated.
+
 Observability: photonscope spans/instants ``front.accept`` /
-``front.admit`` / ``front.shed`` / ``front.drain`` and registry series
-``front_connections`` (gauge), ``front_connections_total``,
+``front.admit`` / ``front.shed`` / ``front.refuse`` / ``front.drain`` and
+registry series ``front_connections`` (gauge),
+``front_connections_total``, ``front_connections_refused_total``,
 ``front_requests_total``, ``front_queue_depth{client=...}``,
 ``requests_shed_total{reason=...}``, ``front_protocol_errors_total{kind=
-...}``, ``front_shedding``, ``front_predicted_wait_s`` (histogram) — all
-in the engine's registry, scrapeable via ``metrics_http.py``.
+...}``, ``front_shedding``, ``front_client_shedding{client=...}``,
+``front_predicted_wait_s`` (histogram) — all in the engine's registry,
+scrapeable via ``metrics_http.py``.
 
 Concurrency model: ALL front-end state (fair queue, admission latch,
 in-flight accounting) is owned by the event loop; the only cross-thread
@@ -101,6 +112,11 @@ class FrontendConfig:
     dispatch_window: Optional[int] = None
     drain_grace_s: float = 30.0
     predict_mean: bool = False
+    # hard connection-count cap: excess accepts get ONE
+    # {"error": "too_many_connections"} reply and a clean close, so a
+    # connection storm cannot exhaust fds or per-conn task memory.
+    # None = unlimited.
+    max_connections: Optional[int] = None
 
 
 class _Conn:
@@ -206,6 +222,22 @@ class FrontendServer:
     # -- connection handling -----------------------------------------------
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
+        cap = self.config.max_connections
+        if cap is not None and len(self._conns) >= cap:
+            self._registry.inc("front_connections_refused_total")
+            obs_instant("front.refuse", connections=len(self._conns))
+            try:
+                writer.write(encode(
+                    error_reply("too_many_connections", max_connections=cap)))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            return
         with obs_span("front.accept"):
             peer = writer.get_extra_info("peername") or ("?", 0)
             self._conn_seq += 1
@@ -228,6 +260,7 @@ class FrontendServer:
             except asyncio.CancelledError:
                 pass
             self._conns.pop(cid, None)
+            self._admission.forget_client(cid)
             self._registry.set_gauge("front_connections", len(self._conns))
             self._registry.set_gauge("front_queue_depth", 0, client=cid)
 
@@ -319,7 +352,16 @@ class FrontendServer:
             return
         estimate = self._batcher.queue_wait_estimate(
             extra=self._queue.depth())
-        verdict = self._admission.decide(estimate)
+        if self.config.admission.client_budget_s is not None:
+            # the wait THIS client's own backlog explains: its fair-queue
+            # depth over the shared batcher residue (other clients' queued
+            # work is excluded — round-robin keeps it from billing here)
+            client_wait = self._batcher.queue_wait_estimate(
+                extra=self._queue.depth_of(conn.cid))
+            verdict = self._admission.decide(estimate, client=conn.cid,
+                                             client_wait_s=client_wait)
+        else:
+            verdict = self._admission.decide(estimate)
         if not verdict.admitted:
             self._shed(conn, req, verdict.reason, verdict.predicted_wait_s,
                        verdict.retry_after_ms)

@@ -26,22 +26,41 @@ Protocol (all failure paths leave the OLD version serving):
 background thread (the load/warm work happens off the request path either
 way — only the pointer flip touches the engine).
 
-Between full swaps, **streaming deltas** (``apply_delta``) scatter single
-online-learned coefficient rows into the live store
-(``CoefficientStore.apply_delta``: archive write + device scatter + LRU
-invalidation) without a generation flip.  The swapper is where they enter
-so the coefficient state has ONE version identity:
+Between full swaps, **streaming deltas** (``apply_delta`` /
+``publish_delta``) scatter single online-learned coefficient rows into the
+live store (``CoefficientStore.apply_delta``: archive write + device
+scatter + LRU invalidation) without a generation flip.  The swapper is
+where they enter so the coefficient state has ONE version identity:
 ``(generation, delta_version)`` — ``delta_version`` counts deltas applied
 to the current generation and resets to 0 at every successful swap.
+
+With a ``delta_log`` attached (online/delta_log.py) the swapper becomes
+the online-learning hub: every applied delta is also appended to the
+durable log under its identity (apply-then-log — the in-memory store is
+volatile, the log is the durable authority; a crash between the two loses
+an update the same way it would mid-apply, never re-orders), and
+``swap()`` REPLAYS the log into the incoming store after warm and before
+``activate``, so the generation flip never loses rows the trainer
+published while the new snapshot was training or loading.  When the
+swapper OWNS the log (``log_owner=True`` — the trainer/writer process) it
+also compacts segments older than the new generation after the flip.  A
+follower process (``cli/serve.py --delta-log``) attaches the same log
+with ``log_owner=False``: replay-before-activate still runs, but it never
+appends (its process-local generation numbers would corrupt the writer's
+identity order) and never compacts (the segments belong to the writer).
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
 
 from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.online.catchup import replay_into_store
+from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
 from photon_ml_tpu.serving.coefficient_store import CoefficientStore
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.storage.model_io import ModelLoadError, load_model_bundle
@@ -54,14 +73,23 @@ class HotSwapper:
     """Load-warm-flip model rotation for one ScoringEngine."""
 
     def __init__(self, engine: ScoringEngine,
-                 warm_buckets: Optional[Sequence[int]] = None):
+                 warm_buckets: Optional[Sequence[int]] = None,
+                 delta_log: Optional[DeltaLog] = None,
+                 log_owner: bool = True):
         self.engine = engine
         self.warm_buckets = warm_buckets  # None -> the batcher's ladder
+        self.delta_log = delta_log
+        self.log_owner = log_owner
         # one swap OR delta in flight at a time — deltas must not land on a
         # store that is mid-flip, and delta_version must pair with exactly
         # one generation
         self._swap_lock = threading.Lock()
         self.delta_version = 0  # deltas applied to the CURRENT generation
+
+    @property
+    def identity(self) -> Tuple[int, int]:
+        """The live coefficient state's ``(generation, delta_version)``."""
+        return (self.engine.store.generation, self.delta_version)
 
     def swap(self, model_dir: str, version: str = "") -> bool:
         """Returns True when the new version is serving; False when the new
@@ -83,8 +111,26 @@ class HotSwapper:
                              "version %r): %s", model_dir, old.generation,
                              old.version, e)
                 return False
+            if self.delta_log is not None:
+                # replay-before-activate: rows the trainer published since
+                # the incoming snapshot was cut replay onto the new store
+                # BEFORE the flip — the generation change never steps back
+                # past an online update.  Full-log ordered replay (not just
+                # the tail): full-row records make it an idempotent
+                # overwrite, and compaction at prior swap boundaries has
+                # already dropped anything the snapshot supersedes.
+                stats = replay_into_store(new, self.delta_log.replay(),
+                                          registry=metrics.registry)
+                metrics.inc("swap_replayed_deltas", stats.applied)
+                if stats.applied or stats.rejected:
+                    logger.info(
+                        "hot swap: replayed %d delta(s) onto incoming gen "
+                        "%d (%d rejected)", stats.applied, new.generation,
+                        stats.rejected)
             self.engine.activate(new)
             self.delta_version = 0  # fresh generation: no deltas yet
+            if self.delta_log is not None and self.log_owner:
+                self.delta_log.compact(new.generation)
             metrics.inc("swaps")
             logger.info("hot swap: gen %d (version %r) -> gen %d (version "
                         "%r)", old.generation, old.version, new.generation,
@@ -97,6 +143,16 @@ class HotSwapper:
         Returns True when applied; False when rejected (unknown entity,
         unknown/fixed coordinate, wrong row width) — a rejected delta
         leaves every coefficient untouched."""
+        return self.publish_delta(cid, entity, row) is not None
+
+    def publish_delta(self, cid: str, entity: str, row,
+                      ) -> Optional[Tuple[int, int]]:
+        """``apply_delta`` that returns the update's
+        ``(generation, delta_version)`` identity (None when rejected) and,
+        when a delta log is attached to an owning swapper, durably appends
+        the record under that identity.  This is the trainer's publish
+        sink: apply-then-log under the swap lock, so log order IS apply
+        order and the identity pairs with exactly one generation."""
         metrics = self.engine.metrics
         with self._swap_lock:
             store = self.engine.store
@@ -106,11 +162,17 @@ class HotSwapper:
                 logger.error("delta rejected (gen %d): %s",
                              store.generation, e)
                 ok = False
-            if ok:
-                self.delta_version += 1
-            else:
+            if not ok:
                 metrics.inc("delta_rejects")
-            return ok
+                return None
+            self.delta_version += 1
+            identity = (store.generation, self.delta_version)
+            if self.delta_log is not None and self.log_owner:
+                self.delta_log.append(DeltaRecord(
+                    generation=identity[0], delta_version=identity[1],
+                    cid=cid, entity=entity,
+                    row=tuple(float(x) for x in np.asarray(row).ravel())))
+            return identity
 
     def swap_async(self, model_dir: str, version: str = "") -> threading.Thread:
         """Run ``swap`` on a daemon thread; returns the thread (join it to

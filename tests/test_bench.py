@@ -471,3 +471,27 @@ class TestOpenLoopPlumbing:
         with pytest.raises(SystemExit) as ei:
             bench.main()
         assert ei.value.code == 2  # argparse error exit
+
+
+class TestOnlineBenchCli:
+    """--online arg plumbing: flags reach run_online_bench parsed."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"metric": "online_refit_entities_per_s"}
+
+        monkeypatch.setattr(bench, "run_online_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--online", "--online-batches", "3",
+            "--online-batch-size", "16", "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["metric"] == "online_refit_entities_per_s"
+        assert seen["batches"] == 3
+        assert seen["batch_size"] == 16
+        assert seen["out_path"] == "ignored.json"

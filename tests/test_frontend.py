@@ -702,3 +702,136 @@ class TestCliListenE2E:
         st.join(60)
         assert not st.is_alive()
         assert rc["rc"] == 0
+
+
+# ---------------------------------------------------------------------------
+# per-client admission budgets (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+class TestPerClientAdmission:
+    def _ctrl(self, registry=None):
+        from photon_ml_tpu.serving.frontend.admission import SHED_CLIENT
+        return SHED_CLIENT, AdmissionController(
+            AdmissionConfig(budget_s=1.0, client_budget_s=0.1),
+            registry=registry)
+
+    def test_client_latch_is_per_client_and_hysteretic(self):
+        SHED_CLIENT, ctrl = self._ctrl()
+        v = ctrl.decide(0.01, client="a", client_wait_s=0.2)
+        assert not v.admitted and v.reason == SHED_CLIENT
+        assert ctrl.client_shedding("a") and not ctrl.shedding
+        # the burning client's latch touches nobody else
+        assert ctrl.decide(0.01, client="b", client_wait_s=0.01).admitted
+        # hysteresis: above the resume watermark (0.5 * 0.1) stays shed...
+        assert not ctrl.decide(0.01, client="a", client_wait_s=0.06).admitted
+        # ...below it the latch opens and the request is admitted
+        assert ctrl.decide(0.01, client="a", client_wait_s=0.04).admitted
+        assert not ctrl.client_shedding("a")
+
+    def test_retry_advice_floored_at_client_budget(self):
+        _, ctrl = self._ctrl()
+        v = ctrl.decide(0.0, client="a", client_wait_s=0.5)
+        # predicted drain past the resume mark: 0.5 - 0.05 = 0.45s
+        assert v.retry_after_ms == pytest.approx(450.0)
+        v2 = ctrl.decide(0.0, client="b", client_wait_s=0.101)
+        assert v2.retry_after_ms >= 100.0  # never below one client budget
+
+    def test_forget_client_clears_latch_and_gauge(self):
+        from photon_ml_tpu.obs.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        _, ctrl = self._ctrl(registry=reg)
+        ctrl.decide(0.0, client="a", client_wait_s=0.2)
+        assert reg.gauge("front_client_shedding", client="a") == 1
+        ctrl.forget_client("a")
+        assert not ctrl.client_shedding("a")
+        assert reg.gauge("front_client_shedding", client="a") == 0
+        ctrl.forget_client("never-seen")  # no latch: a silent no-op
+
+    def test_off_by_default(self):
+        ctrl = AdmissionController(AdmissionConfig(budget_s=1.0))
+        # client args are inert without a client budget configured
+        assert ctrl.decide(0.01, client="a", client_wait_s=99.0).admitted
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="client_budget_s"):
+            AdmissionConfig(budget_s=1.0, client_budget_s=0.0)
+
+    def test_global_latch_still_wins_eventually(self):
+        _, ctrl = self._ctrl()
+        v = ctrl.decide(2.0, client="a", client_wait_s=0.01)
+        assert not v.admitted and v.reason == SHED_OVERLOAD
+
+
+# ---------------------------------------------------------------------------
+# connection cap (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+class TestConnectionCap:
+    def test_cap_refuses_cleanly_and_slot_frees_on_close(self):
+        eng = _engine()
+        front = _front(eng, max_connections=2)
+        rng = np.random.default_rng(4)
+        try:
+            c1, c2 = Client(front.port), Client(front.port)
+            c1.send(_wire_req(rng, uid=0))
+            c1.send_raw("\n")
+            assert c1.recv()["uid"] == 0  # admitted clients serve normally
+
+            c3 = Client(front.port)
+            reply = c3.recv()
+            assert reply["error"] == "too_many_connections"
+            assert reply["max_connections"] == 2
+            assert c3.f.readline() == ""  # one reply, then a clean close
+            c3.close()
+            reg = eng.metrics.registry
+            assert reg.counter("front_connections_refused_total") >= 1
+
+            c2.close()  # frees a slot (server-side teardown is async)
+            deadline = time.time() + 30
+            admitted = False
+            while time.time() < deadline and not admitted:
+                c4 = Client(front.port)
+                c4.send(_wire_req(rng, uid=9))
+                c4.send_raw("\n")
+                obj = c4.recv()
+                admitted = obj.get("uid") == 9
+                c4.close()
+                if not admitted:
+                    time.sleep(0.05)
+            assert admitted, "freed slot never became admittable"
+            c1.close()
+        finally:
+            front.stop()
+
+
+# ---------------------------------------------------------------------------
+# coordinated-omission correction in the load generator (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+class TestCoordinatedOmission:
+    def test_corrected_percentiles_dominate_raw(self):
+        from photon_ml_tpu.serving.frontend import run_open_loop
+
+        eng = _engine()
+        front = _front(eng)
+        rng = np.random.default_rng(5)
+        pool = [_wire_req(rng, uid=None) for _ in range(32)]
+
+        def make_request(uid):
+            req = dict(pool[uid % len(pool)])
+            req["uid"] = uid
+            return req
+
+        try:
+            res = asyncio.run(run_open_loop(
+                "127.0.0.1", front.port, 200.0, 0.5, make_request,
+                n_connections=2, rng=np.random.default_rng(6)))
+        finally:
+            front.stop()
+        assert res.completed > 0 and res.lost == 0
+        # a request can never fire BEFORE its scheduled arrival, so the
+        # schedule-clock latency dominates the send-clock latency pointwise
+        # and therefore at every percentile
+        for k in ("p50", "p99", "p999"):
+            assert res.latency_corrected_ms[k] >= res.latency_ms[k] - 1e-6
+        assert res.max_send_lag_ms >= 0.0
+        out = res.to_json()
+        assert set(out["latency_corrected_ms"]) == {"p50", "p99", "p999"}
+        assert "max_send_lag_ms" in out  # BENCH_NET rows carry both clocks

@@ -1,0 +1,604 @@
+"""photonlearn tests: durable delta log, replicated catch-up, incremental
+trainer, and the learn/serve CLI round trip.
+
+The contracts under test (ISSUE 9 / ROADMAP item 3):
+  - DeltaLog: append/replay round-trips bitwise; identities are strictly
+    monotone or the append raises; a file truncated at EVERY byte offset
+    replays exactly the complete-record prefix and never raises; a writer
+    re-opening a torn segment truncates the tear before appending.
+  - Catch-up: replaying the full log into a fresh identical store is
+    bitwise-equal to the live store; replay is idempotent (position skips
+    everything already applied); bad records count as rejected, never
+    raise; LogFollower resets its position when the served generation
+    changes.
+  - Trainer: a labeled mini-batch moves the served score with ZERO engine
+    recompiles, publishes under ordered identities, and skips unknown
+    entities / under-observed entities instead of failing.
+  - Swap integration: deltas published before a hot swap survive it
+    (replay-before-activate) and the swap compacts the log.
+  - CLI: learn.py writes a log a second serve.py --delta-log process
+    converges from, for both JSON-lines and Avro inputs.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.online.catchup import LogFollower, replay_into_store
+from photon_ml_tpu.online.delta_log import (_MAGIC, DeltaLog, DeltaRecord,
+                                            _segment_name)
+from photon_ml_tpu.online.trainer import (IncrementalTrainer, TrainerConfig,
+                                          example_from_json)
+from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     StoreConfig)
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.swap import HotSwapper
+from photon_ml_tpu.types import TaskType
+
+N_ENT = 40
+D = 4
+NAMES = [f"f{j}" for j in range(D)]
+
+
+def _model(seed=0):
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    return GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=D)),
+            feature_shard="all", task=task),
+        "user": RandomEffectModel(
+            w_stack=rng.normal(size=(N_ENT, D)) * 0.5,
+            slot_of={i: i for i in range(N_ENT)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    }), task
+
+
+def _engine(seed=0, max_batch=8):
+    model, task = _model(seed)
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(NAMES)})
+    eidx = EntityIndex()
+    for i in range(N_ENT):
+        eidx.get_or_add(f"user{i}")
+    metrics = ServingMetrics()
+    store = CoefficientStore.from_model(
+        model, task, {"userId": eidx}, {"all": imap},
+        config=StoreConfig(device_capacity=None), version="synthetic",
+        metrics=metrics)
+    eng = ScoringEngine(store, BucketedBatcher(max_batch), metrics=metrics)
+    eng.warm()
+    return eng
+
+
+def _req(rng, uid, user):
+    feats = [{"name": n, "term": "", "value": float(v)}
+             for n, v in zip(NAMES, rng.normal(size=D))]
+    return Request(uid=uid, features=feats, ids={"userId": f"user{user}"})
+
+
+def _rec(g, v, entity="user1", row=None):
+    return DeltaRecord(generation=g, delta_version=v, cid="user",
+                       entity=entity,
+                       row=tuple(row if row is not None else
+                                 np.arange(D, dtype=float) + v))
+
+
+# ---------------------------------------------------------------------------
+# delta log framing
+# ---------------------------------------------------------------------------
+class TestDeltaLog:
+    def test_append_replay_round_trip_across_generations(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="rotate")
+        recs = [_rec(1, 1), _rec(1, 2), _rec(2, 1), _rec(2, 2), _rec(3, 1)]
+        for r in recs:
+            log.append(r)
+        log.close()
+        assert [g for g, _ in log.segments()] == [1, 2, 3]
+        got = list(DeltaLog(str(tmp_path), fsync="never").replay())
+        assert got == recs  # frozen dataclass equality: rows bitwise too
+        assert log.last_identity() == (3, 1)
+        # position replay: strictly after an identity, across a rotation
+        assert [r.identity for r in log.replay(after=(1, 2))] == \
+            [(2, 1), (2, 2), (3, 1)]
+
+    def test_non_monotone_identity_raises(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="never")
+        log.append(_rec(2, 1))
+        with pytest.raises(ValueError, match="non-monotone"):
+            log.append(_rec(2, 1))
+        with pytest.raises(ValueError, match="non-monotone"):
+            log.append(_rec(1, 9))
+        log.append(_rec(2, 2))  # the failed appends consumed nothing
+
+    def test_reopened_writer_resumes_monotone(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="never")
+        log.append(_rec(1, 1))
+        log.close()
+        log2 = DeltaLog(str(tmp_path), fsync="never")
+        with pytest.raises(ValueError, match="non-monotone"):
+            log2.append(_rec(1, 1))
+        log2.append(_rec(1, 2))
+
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync"):
+            DeltaLog(str(tmp_path), fsync="sometimes")
+
+    def test_truncate_at_every_byte_offset(self, tmp_path):
+        """THE crash-safety property: whatever prefix of the segment
+        survives a crash, replay yields exactly the records whose frames
+        lie entirely inside it — and never raises."""
+        src = tmp_path / "src"
+        log = DeltaLog(str(src), fsync="never")
+        recs = [_rec(1, v) for v in range(1, 5)]
+        for r in recs:
+            log.append(r)
+        log.close()
+        seg = src / _segment_name(1)
+        data = seg.read_bytes()
+        # frame end offsets, in order
+        ends, pos = [], len(_MAGIC)
+        for r in recs:
+            pos += len(r.encode())
+            ends.append(pos)
+        assert ends[-1] == len(data)
+
+        scratch = tmp_path / "cut"
+        os.makedirs(scratch)
+        cut_seg = scratch / _segment_name(1)
+        for cut in range(len(data) + 1):
+            cut_seg.write_bytes(data[:cut])
+            got = list(DeltaLog(str(scratch), fsync="never").replay())
+            expect = sum(1 for e in ends if e <= cut)
+            assert len(got) == expect, f"cut at byte {cut}"
+            assert got == recs[:expect]
+
+    def test_crc_corruption_ends_segment_cleanly(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="never")
+        for v in range(1, 4):
+            log.append(_rec(1, v))
+        log.close()
+        seg = tmp_path / _segment_name(1)
+        data = bytearray(seg.read_bytes())
+        # flip one payload byte of the second record
+        off = len(_MAGIC) + len(_rec(1, 1).encode()) + 10
+        data[off] ^= 0xFF
+        seg.write_bytes(bytes(data))
+        got = list(DeltaLog(str(tmp_path), fsync="never").replay())
+        assert [r.identity for r in got] == [(1, 1)]
+
+    def test_torn_tail_truncated_before_append(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="never")
+        for v in range(1, 4):
+            log.append(_rec(1, v))
+        log.close()
+        seg = tmp_path / _segment_name(1)
+        data = seg.read_bytes()
+        seg.write_bytes(data[:-3])  # tear the last record
+        log2 = DeltaLog(str(tmp_path), fsync="never")
+        assert log2.last_identity() == (1, 2)  # the tear is invisible
+        log2.append(_rec(1, 3))  # (1,3) is free again — its frame tore
+        log2.close()
+        got = list(DeltaLog(str(tmp_path), fsync="never").replay())
+        assert [r.identity for r in got] == [(1, 1), (1, 2), (1, 3)]
+        # the re-appended record replays intact, not shadowed by garbage
+        assert got[-1] == _rec(1, 3)
+
+    def test_compact_drops_only_stale_generations(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="never")
+        for g in (1, 2, 3):
+            log.append(_rec(g, 1))
+        dropped = log.compact(3)
+        assert dropped == [1, 2]
+        assert [g for g, _ in log.segments()] == [3]
+        assert [r.identity for r in log.replay()] == [(3, 1)]
+
+
+# ---------------------------------------------------------------------------
+# catch-up replay
+# ---------------------------------------------------------------------------
+class TestCatchup:
+    def test_full_replay_is_bitwise_and_idempotent(self, tmp_path):
+        eng = _engine(seed=3)
+        log = DeltaLog(str(tmp_path), fsync="rotate")
+        swapper = HotSwapper(eng, delta_log=log)
+        rng = np.random.default_rng(0)
+        for k, u in enumerate([3, 7, 3, 11]):
+            ident = swapper.publish_delta("user", f"user{u}",
+                                          rng.normal(size=D))
+            assert ident == (eng.store.generation, k + 1)
+        assert log.last_identity() == swapper.identity
+
+        fresh = _engine(seed=3)  # same seed -> identical pre-delta model
+        stats = replay_into_store(fresh.store, log.replay())
+        assert (stats.applied, stats.rejected) == (4, 0)
+        assert stats.position == swapper.identity
+        live = eng.store.coordinates["user"]
+        rep = fresh.store.coordinates["user"]
+        assert np.array_equal(np.asarray(live.table), np.asarray(rep.table))
+        assert np.array_equal(np.asarray(live._archive),
+                              np.asarray(rep._archive))
+        # idempotence: a second replay from the recorded position is a no-op
+        again = replay_into_store(fresh.store, log.replay(),
+                                  position=stats.position)
+        assert (again.applied, again.skipped) == (0, 4)
+        # ...and replaying everything AGAIN without a position still lands
+        # on the same bytes (ordered full-row overwrites)
+        replay_into_store(fresh.store, log.replay())
+        assert np.array_equal(np.asarray(live.table), np.asarray(rep.table))
+
+    def test_bad_records_rejected_not_raised(self, tmp_path):
+        eng = _engine()
+        recs = [
+            _rec(1, 1, entity="user2"),
+            _rec(1, 2, entity="ghost"),                    # unknown entity
+            DeltaRecord(1, 3, "nope", "user2", (0.0,) * D),  # unknown cid
+            _rec(1, 4, entity="user2", row=[1.0]),         # bad width
+            _rec(1, 5, entity="user5"),
+        ]
+        stats = replay_into_store(eng.store, recs)
+        assert stats.applied == 2
+        assert stats.rejected == 3
+        assert stats.position == (1, 5)
+
+    def test_follower_tails_and_resets_on_generation_change(self, tmp_path):
+        eng = _engine(seed=5)
+        log = DeltaLog(str(tmp_path), fsync="never")
+        swapper = HotSwapper(eng, delta_log=log)
+        replica = _engine(seed=5)
+        current = {"store": replica.store}
+        follower = LogFollower(log, lambda: current["store"],
+                               registry=replica.metrics.registry)
+
+        swapper.publish_delta("user", "user1", np.ones(D))
+        assert follower.run_once().applied == 1
+        assert follower.run_once().applied == 0  # tail: nothing new
+        swapper.publish_delta("user", "user2", np.ones(D) * 2)
+        assert follower.run_once().applied == 1
+
+        # generation change (a hot swap on the replica): position resets,
+        # the WHOLE log replays into the incoming store
+        incoming = _engine(seed=5).store
+        current["store"] = incoming
+        stats = follower.run_once()
+        assert stats.applied == 2
+        live = eng.store.coordinates["user"]
+        assert np.array_equal(np.asarray(live._archive),
+                              np.asarray(incoming.coordinates["user"]
+                                         ._archive))
+
+    def test_follower_thread_converges(self, tmp_path):
+        import time
+
+        eng = _engine(seed=6)
+        log = DeltaLog(str(tmp_path), fsync="never")
+        swapper = HotSwapper(eng, delta_log=log)
+        replica = _engine(seed=6)
+        follower = LogFollower(log, lambda: replica.store,
+                               poll_interval_s=0.01)
+        follower.start()
+        try:
+            for u in range(8):
+                swapper.publish_delta("user", f"user{u}",
+                                      np.full(D, float(u)))
+            deadline = time.time() + 5.0
+            want = np.asarray(eng.store.coordinates["user"]._archive)
+            while time.time() < deadline:
+                got = np.asarray(replica.store.coordinates["user"]._archive)
+                if np.array_equal(got, want):
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("follower did not converge within 5s")
+        finally:
+            follower.stop()
+
+
+# ---------------------------------------------------------------------------
+# incremental trainer
+# ---------------------------------------------------------------------------
+class TestTrainer:
+    def test_refit_moves_score_zero_recompiles(self, tmp_path):
+        eng = _engine(seed=1)
+        log = DeltaLog(str(tmp_path), fsync="rotate",
+                       registry=eng.metrics.registry)
+        trainer = IncrementalTrainer(HotSwapper(eng, delta_log=log),
+                                     TrainerConfig(coordinates=("user",)))
+        rng = np.random.default_rng(2)
+        probe = _req(rng, "probe", 3)
+        before = float(eng.score_requests([probe])[0])
+        compiles = eng.compile_count
+
+        batch = []
+        for i in range(24):
+            r = _req(rng, i, int(rng.integers(0, 8)))
+            batch.append({"uid": i, "features": r.features, "ids": r.ids,
+                          "label": float(rng.integers(0, 2))})
+        rep = trainer.consume(batch)
+        assert rep.examples == 24
+        assert rep.entities >= 1
+        assert rep.published == rep.entities
+        assert rep.rejected == 0
+        assert rep.first_identity == (eng.store.generation, 1)
+        assert rep.last_identity == (eng.store.generation, rep.published)
+        after = float(eng.score_requests([probe])[0])
+        assert after != before  # user3 had fresh rows -> new coefficients
+        assert eng.compile_count == compiles  # publishes never recompile
+        assert log.records_written == rep.published
+        # a second batch continues the identity sequence
+        rep2 = trainer.consume(batch)
+        assert rep2.first_identity == (eng.store.generation,
+                                       rep.published + 1)
+        assert rep.to_json()["entities"] == rep.entities  # serializable
+
+    def test_consume_accepts_example_objects_and_dicts(self):
+        eng = _engine()
+        trainer = IncrementalTrainer(HotSwapper(eng))
+        rng = np.random.default_rng(0)
+        r = _req(rng, 0, 1)
+        obj = {"uid": 0, "features": r.features, "ids": r.ids, "label": 1.0}
+        rep = trainer.consume([obj, example_from_json(obj)])
+        assert rep.examples == 2
+        assert rep.published >= 1
+
+    def test_unknown_entities_skipped(self):
+        eng = _engine()
+        trainer = IncrementalTrainer(HotSwapper(eng),
+                                     TrainerConfig(coordinates=("user",)))
+        rng = np.random.default_rng(0)
+        r = _req(rng, 0, 1)
+        rep = trainer.consume([
+            {"uid": 0, "features": r.features,
+             "ids": {"userId": "stranger"}, "label": 1.0}])
+        assert rep.skipped_unknown == 1
+        assert rep.published == 0
+
+    def test_min_rows_gate_defers_sparse_entities(self):
+        eng = _engine()
+        trainer = IncrementalTrainer(
+            HotSwapper(eng),
+            TrainerConfig(coordinates=("user",), min_rows_per_entity=3))
+        rng = np.random.default_rng(0)
+        batch = []
+        for i in range(4):  # 4 rows for user1, 1 row for user2
+            r = _req(rng, i, 1 if i < 4 else 2)
+            batch.append({"uid": i, "features": r.features, "ids": r.ids,
+                          "label": 1.0})
+        r = _req(rng, 9, 2)
+        batch.append({"uid": 9, "features": r.features, "ids": r.ids,
+                      "label": 0.0})
+        rep = trainer.consume(batch)
+        assert rep.entities == 1  # only user1 met the gate
+        assert rep.published == 1
+
+    def test_label_required(self):
+        with pytest.raises(ValueError, match="label"):
+            example_from_json({"uid": 0, "features": [],
+                               "ids": {"userId": "user1"}})
+
+    def test_response_accepted_as_label(self):
+        ex = example_from_json({"uid": 0, "features": [],
+                                "ids": {"userId": "user1"},
+                                "response": 1.0, "weight": 2.5})
+        assert ex.label == 1.0 and ex.weight == 2.5
+
+    def test_bad_explicit_coordinate_raises(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="fixed"):
+            IncrementalTrainer(HotSwapper(eng),
+                               TrainerConfig(coordinates=("fixed",)))
+        with pytest.raises(ValueError, match="ghost"):
+            IncrementalTrainer(HotSwapper(eng),
+                               TrainerConfig(coordinates=("ghost",)))
+
+
+# ---------------------------------------------------------------------------
+# swap integration + the CLI round trip (trained model dir)
+# ---------------------------------------------------------------------------
+N_USERS = 6
+FEATURES = ["g0", "g1", "g2", "ux"]
+
+
+def _write_fixture(path, n=250, seed=1):
+    from photon_ml_tpu.data import avro as avro_io
+    from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+
+    rng = np.random.default_rng(seed)
+    uw = rng.normal(size=(N_USERS, 1)) * 1.5
+    gw = np.asarray([0.8, -1.2, 0.5])
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, N_USERS))
+        xg = rng.normal(size=3)
+        xu = rng.normal(size=1)
+        logit = xg @ gw + xu @ uw[u]
+        y = float(rng.random() < 1.0 / (1.0 + np.exp(-logit)))
+        feats = [{"name": f"g{j}", "term": "", "value": float(xg[j])}
+                 for j in range(3)]
+        feats.append({"name": "ux", "term": "", "value": float(xu[0])})
+        records.append({"uid": i, "response": y, "label": None,
+                        "features": feats, "weight": None, "offset": None,
+                        "metadataMap": {"userId": f"user{u}"}})
+    avro_io.write_container(path, TRAINING_EXAMPLE, records)
+    return records
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    from photon_ml_tpu.cli import train as train_cli
+
+    tmp = tmp_path_factory.mktemp("online")
+    data = str(tmp / "train.avro")
+    _write_fixture(data)
+    out = str(tmp / "model")
+    rc = train_cli.run([
+        "--train-data", data, "--feature-shards", "all",
+        "--coordinate", "name=fixed,feature.shard=all,reg.weights=1",
+        "--coordinate",
+        "name=user,random.effect.type=userId,feature.shard=all,reg.weights=1",
+        "--id-tags", "userId", "--coordinate-descent-iterations", "2",
+        "--output-dir", out])
+    assert rc == 0
+    return out
+
+
+def _probe_line(uid=0, user="user3"):
+    return {"uid": uid, "features": [[f, 0.5] for f in FEATURES],
+            "ids": {"userId": user}}
+
+
+class TestSwapIntegration:
+    def test_deltas_survive_swap_and_log_compacts(self, model_dir, tmp_path):
+        from photon_ml_tpu.cli.serve import build_server
+        from photon_ml_tpu.serving.batcher import request_from_json
+
+        log = DeltaLog(str(tmp_path / "log"), fsync="rotate")
+        engine, swapper = build_server(model_dir, warm=False,
+                                       delta_log=log, log_owner=True)
+        probe = request_from_json(_probe_line())
+        base = float(engine.score_requests([probe])[0])
+        d = engine.store.coordinates["user"].dim
+        assert swapper.publish_delta("user", "user3",
+                                     np.full(d, 2.0)) is not None
+        patched = float(engine.score_requests([probe])[0])
+        assert patched != base
+        assert len(log.segments()) == 1
+
+        gen_before = engine.store.generation
+        assert swapper.swap(model_dir) is True
+        assert engine.store.generation > gen_before
+        # replay-before-activate: the published row survives the swap
+        assert float(engine.score_requests([probe])[0]) == patched
+        # ...and the swap compacted every pre-swap segment away
+        assert log.segments() == []
+        assert swapper.identity == (engine.store.generation, 0)
+
+    def test_follower_role_never_compacts(self, model_dir, tmp_path):
+        from photon_ml_tpu.cli.serve import build_server
+
+        owner_log = DeltaLog(str(tmp_path / "log2"), fsync="never")
+        _, owner = build_server(model_dir, warm=False,
+                                delta_log=owner_log, log_owner=True)
+        d = owner.engine.store.coordinates["user"].dim
+        owner.publish_delta("user", "user1", np.full(d, 1.5))
+
+        follower_log = DeltaLog(str(tmp_path / "log2"), fsync="never")
+        engine2, replica = build_server(model_dir, warm=False,
+                                        delta_log=follower_log,
+                                        log_owner=False)
+        assert replica.swap(model_dir) is True  # replays, must NOT compact
+        assert len(follower_log.segments()) == 1
+        assert list(follower_log.replay())  # owner's record still there
+
+
+class TestLearnCli:
+    def test_jsonl_round_trip_to_follower(self, model_dir, tmp_path, capsys):
+        from photon_ml_tpu.cli import learn as learn_cli
+        from photon_ml_tpu.cli import serve as serve_cli
+
+        rng = np.random.default_rng(7)
+        lines = []
+        for i in range(20):
+            u = int(rng.integers(0, N_USERS))
+            feats = [[f, float(rng.normal())] for f in FEATURES]
+            lines.append(json.dumps({
+                "uid": i, "features": feats, "ids": {"userId": f"user{u}"},
+                "label": float(rng.integers(0, 2))}))
+        lines.insert(10, "")  # blank line: mid-stream flush
+        exfile = tmp_path / "examples.jsonl"
+        exfile.write_text("\n".join(lines) + "\n")
+        logdir = str(tmp_path / "log")
+
+        rc = learn_cli.run(["--model-dir", model_dir, "--examples",
+                            str(exfile), "--delta-log", logdir,
+                            "--batch-size", "64", "--fsync", "rotate"])
+        assert rc == 0
+        reports = [json.loads(l) for l in
+                   capsys.readouterr().out.strip().splitlines()]
+        assert len(reports) == 2  # blank-line flush + EOF flush
+        assert all(r["published"] > 0 for r in reports)
+        assert reports[1]["first_identity"][1] == \
+            reports[0]["last_identity"][1] + 1
+
+        req_file = tmp_path / "req.jsonl"
+        req_file.write_text(json.dumps(_probe_line()) + "\n")
+        rc = serve_cli.run(["--model-dir", model_dir, "--no-warm",
+                            "--requests", str(req_file)])
+        assert rc == 0
+        plain = json.loads(capsys.readouterr().out.strip()
+                           .splitlines()[0])["score"]
+        rc = serve_cli.run(["--model-dir", model_dir, "--no-warm",
+                            "--requests", str(req_file),
+                            "--delta-log", logdir])
+        assert rc == 0
+        followed = json.loads(capsys.readouterr().out.strip()
+                              .splitlines()[0])["score"]
+        assert followed != plain  # the log's refits reached the replica
+
+    def test_avro_input(self, model_dir, tmp_path, capsys):
+        from photon_ml_tpu.cli import learn as learn_cli
+
+        exfile = str(tmp_path / "fresh.avro")
+        _write_fixture(exfile, n=30, seed=9)
+        rc = learn_cli.run(["--model-dir", model_dir, "--examples", exfile,
+                            "--format", "avro", "--batch-size", "30",
+                            "--delta-log", str(tmp_path / "log")])
+        assert rc == 0
+        reports = [json.loads(l) for l in
+                   capsys.readouterr().out.strip().splitlines()]
+        assert len(reports) == 1
+        assert reports[0]["examples"] == 30
+        assert reports[0]["published"] > 0
+
+    def test_restart_resumes_past_logged_generation(self, model_dir,
+                                                    tmp_path, capsys):
+        from photon_ml_tpu.cli import learn as learn_cli
+
+        rng = np.random.default_rng(3)
+        lines = [json.dumps({
+            "uid": i, "features": [[f, float(rng.normal())]
+                                   for f in FEATURES],
+            "ids": {"userId": f"user{i % N_USERS}"},
+            "label": float(i % 2)}) for i in range(8)]
+        exfile = tmp_path / "ex.jsonl"
+        exfile.write_text("\n".join(lines) + "\n")
+        logdir = str(tmp_path / "log")
+
+        argv = ["--model-dir", model_dir, "--examples", str(exfile),
+                "--delta-log", logdir, "--fsync", "never"]
+        assert learn_cli.run(argv) == 0
+        first = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert learn_cli.run(argv) == 0
+        second = [json.loads(l) for l in
+                  capsys.readouterr().out.strip().splitlines()]
+        # the restarted writer minted a strictly newer generation, so the
+        # log stayed monotone (else its appends would have raised)
+        assert second[0]["first_identity"][0] > first[-1]["last_identity"][0]
+
+    def test_bad_example_line_reported_not_fatal(self, model_dir, tmp_path,
+                                                 capsys):
+        from photon_ml_tpu.cli import learn as learn_cli
+
+        exfile = tmp_path / "ex.jsonl"
+        exfile.write_text("this is not json\n" + json.dumps({
+            "uid": 0, "features": [[f, 0.1] for f in FEATURES],
+            "ids": {"userId": "user0"}, "label": 1.0}) + "\n")
+        rc = learn_cli.run(["--model-dir", model_dir, "--examples",
+                            str(exfile)])
+        assert rc == 0
+        out = [json.loads(l) for l in
+               capsys.readouterr().out.strip().splitlines()]
+        assert any("error" in o for o in out)
+        assert any(o.get("examples") == 1 for o in out)
