@@ -2269,6 +2269,136 @@ def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
     return out
 
 
+def run_stream_bench(n_rows: int = 50_000, n_features: int = 64,
+                     n_entities: int = 500, batch_rows: int = 1024,
+                     workers: int = 2, out_path: str = None) -> dict:
+    """`bench.py --stream`: photonstream ingest micro-bench.
+
+    Writes a synthetic TrainingExampleAvro dataset (deflate blocks, several
+    files), then measures the out-of-core ingest end to end
+    (``stream.stream_game_data``: scan -> bounded parallel decode ->
+    fixed-shape batch fill -> double-buffered device upload):
+
+      ingest_mb_per_s        container bytes consumed / wall
+      batches_per_s          fixed-shape device-feed batches / wall
+      stall_fraction         consumer time blocked on undecoded chunks /
+                             wall (0 = decode fully hidden by fill+upload)
+      peak_rss_mb            process high-water RSS after the timed pass
+      compiles_after_warm    jitted dynamic_update_slice cache growth on a
+                             second identical pass — MUST be 0 (fixed batch
+                             shapes are the whole point of the feed)
+
+    Emits BENCH_STREAM_<backend>.json.
+    """
+    import resource
+    import shutil
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu.data import avro as avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.data.schemas import TRAINING_EXAMPLE
+    from photon_ml_tpu.obs.probe import get_probe
+    from photon_ml_tpu.obs.registry import (MetricsRegistry, get_registry,
+                                            set_registry)
+    from photon_ml_tpu.stream import stream_game_data
+    from photon_ml_tpu.utils import transfer
+
+    backend = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    names = [f"f{j}" for j in range(n_features)]
+    n_files = 4
+    per_file = max(1, n_rows // n_files)
+    k = min(8, n_features)
+    tmp = tempfile.mkdtemp(prefix="photonstream_bench_")
+    try:
+        for fi in range(n_files):
+            records = []
+            for i in range(per_file):
+                idx = rng.choice(n_features, size=k, replace=False)
+                vals = rng.normal(size=k)
+                records.append({
+                    "uid": fi * per_file + i,
+                    "response": float(rng.integers(0, 2)),
+                    "label": None,
+                    "features": [{"name": names[j], "term": "",
+                                  "value": float(v)}
+                                 for j, v in zip(idx, vals)],
+                    "weight": None, "offset": None,
+                    "metadataMap":
+                        {"userId": f"u{rng.integers(0, n_entities)}"},
+                })
+            avro_io.write_container(
+                os.path.join(tmp, f"part-{fi:05d}.avro"), TRAINING_EXAMPLE,
+                records, block_records=1024)
+        file_bytes = sum(os.path.getsize(os.path.join(tmp, p))
+                         for p in os.listdir(tmp))
+        index_maps = {"global": IndexMap.from_features(
+            [(nm, "") for nm in names], add_intercept=True)}
+
+        def one_pass():
+            data, _ = stream_game_data(
+                tmp, index_maps, id_tag_names=["userId"],
+                batch_rows=batch_rows, workers=workers)
+            jax.block_until_ready(data.features["global"])
+            return data
+
+        prev_reg = get_registry()
+        try:
+            set_registry(MetricsRegistry())
+            one_pass()  # warm: compiles the batch + ragged-tail updates
+            warm_cache = transfer._UPDATE._cache_size()
+            reg = MetricsRegistry()
+            set_registry(reg)
+            bytes_before = get_probe().transfer_bytes(direction="h2d",
+                                                      site="stream_feed")
+            t0 = time.perf_counter()
+            data = one_pass()
+            wall = time.perf_counter() - t0
+            compiles_after_warm = transfer._UPDATE._cache_size() - warm_cache
+            upload_bytes = get_probe().transfer_bytes(
+                direction="h2d", site="stream_feed") - bytes_before
+        finally:
+            set_registry(prev_reg)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    n = int(data.num_samples)
+    batches = -(-n // batch_rows)  # ceil: one feed push per filled batch
+    stall_s = float(reg.gauge("stream_stall_seconds") or 0.0)
+    # ru_maxrss is the lifetime high-water mark (KB on Linux) — an upper
+    # bound on the streaming pass, tight here because the bench never
+    # materializes an [n, d] host array to inflate it first
+    peak_rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    out = {
+        "metric": "stream_ingest_mb_per_s", "unit": "MB/s",
+        "backend": backend,
+        "value": round(file_bytes / wall / 1e6, 2),
+        "ingest_mb_per_s": round(file_bytes / wall / 1e6, 2),
+        "batches_per_s": round(batches / wall, 1),
+        "stall_fraction": round(stall_s / wall, 4),
+        "peak_rss_mb": round(peak_rss_kb / 1024, 1),
+        "compiles_after_warm": int(compiles_after_warm),
+        "wall_s": round(wall, 4),
+        "file_bytes": int(file_bytes),
+        "rows": n, "features": n_features + 1, "entities": n_entities,
+        "batch_rows": batch_rows, "workers": workers,
+        "chunks": int(reg.counter("stream_chunks_total")),
+        "chunk_errors": int(reg.counter("stream_chunk_errors_total")),
+        "upload_bytes": int(upload_bytes),
+    }
+    path = out_path or os.path.join(_REPO, f"BENCH_STREAM_{backend}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    assert compiles_after_warm == 0, (
+        f"streaming ingest recompiled {compiles_after_warm} update "
+        "program(s) on an identically-shaped second pass — the fixed "
+        "batch-shape contract is broken")
+    return out
+
+
 # configs with an unconditional scipy stand-in for vs_baseline.  glmix_chip
 # is special-cased in _entry_from: at chip scale no host holds its design
 # matrix (vs_baseline stays null), but CPU-floor runs reconstruct the
@@ -2331,6 +2461,17 @@ def main():
                          "lanes/sec, host vs fused vs fused-validated sweep "
                          "wall, sparse-compact scoring throughput, pallas "
                          "A/B) -> BENCH_SOLVE_<backend>.json")
+    ap.add_argument("--stream", action="store_true",
+                    help="photonstream ingest micro-bench (ingest MB/s, "
+                         "batches/s, pipeline-stall fraction, peak RSS, "
+                         "recompiles-after-warm == 0 asserted) -> "
+                         "BENCH_STREAM_<backend>.json")
+    ap.add_argument("--stream-rows", type=int, default=50_000,
+                    help="with --stream: synthetic dataset rows")
+    ap.add_argument("--stream-batch-rows", type=int, default=1024,
+                    help="with --stream: fixed device-feed batch shape")
+    ap.add_argument("--stream-workers", type=int, default=2,
+                    help="with --stream: decode thread-pool size")
     ap.add_argument("--lint", action="store_true",
                     help="photonlint wall-time micro-bench (whole-program "
                          "pass over photon_ml_tpu/) -> BENCH_LINT.json")
@@ -2343,6 +2484,11 @@ def main():
                     help="with --serving/--lint/--obs: output JSON path "
                          "override")
     a = ap.parse_args()
+    if a.stream:
+        print(json.dumps(run_stream_bench(
+            n_rows=a.stream_rows, batch_rows=a.stream_batch_rows,
+            workers=a.stream_workers, out_path=a.out)))
+        return
     if a.obs:
         print(json.dumps(run_obs_bench(out_path=a.out)))
         return

@@ -77,6 +77,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-intercept", action="store_true")
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
+    p.add_argument("--stream", action="store_true",
+                   help="out-of-core streaming ingest (photonstream): decode "
+                        "Avro chunks on a bounded background pool and "
+                        "assemble design matrices ON DEVICE in fixed-shape "
+                        "double-buffered batches — peak host memory stays "
+                        "bounded by the pipeline window instead of the "
+                        "dataset size; coefficients match the eager reader "
+                        "bitwise")
+    p.add_argument("--stream-batch-rows", type=int, default=4096,
+                   help="device-feed batch rows (power of two; the one "
+                        "upload shape the stream compiles)")
+    p.add_argument("--stream-workers", type=int, default=2,
+                   help="background decode threads")
+    p.add_argument("--stream-on-error", default="raise",
+                   choices=["raise", "skip"],
+                   help="malformed-chunk policy: 'raise' fails the job at "
+                        "the first corrupt/torn chunk; 'skip' keeps going — "
+                        "lost rows stay allocated with weight 0 (inert) and "
+                        "are counted in stream_chunk_errors_total / "
+                        "stream_skipped_rows_total, never a silent short "
+                        "epoch")
     p.add_argument("--sparse-threshold", type=int, default=0,
                    help="shards with >= this many features load as row-padded "
                         "sparse layouts (0 = always dense); the huge-vocabulary "
@@ -315,14 +336,24 @@ def _run(args, task, t_start, emitter) -> int:
             logger.error("%s", e)
             return 1
 
+    if args.stream and "features" in input_columns and not args.index_map_dir:
+        # the streaming index scan reads the default features column; a
+        # remapped one needs prebuilt maps (eager record decode would defeat
+        # out-of-core ingest)
+        logger.error("--stream with a remapped features column requires "
+                     "--index-map-dir")
+        return 1
+
     # native columnar path only when EVERY file qualifies (and reads the
     # default reserved column names) — otherwise decode once through the
-    # Python codec and reuse the records for both steps
-    use_native = not input_columns and all(
+    # Python codec and reuse the records for both steps.  Streaming never
+    # materializes the record list: index maps come from --index-map-dir or
+    # the memory-bounded scan below.
+    use_native = not args.stream and not input_columns and all(
         schema_eligible(f) for p in args.train_data
         for f in list_avro_files(p))
     train_records = None
-    if not use_native:
+    if not use_native and not args.stream:
         from photon_ml_tpu.data.avro import read_directory
 
         train_records = []
@@ -339,6 +370,25 @@ def _run(args, task, t_start, emitter) -> int:
             raise FileNotFoundError(f"no index map for shard {s!r} in {args.index_map_dir}")
 
         index_maps = {s: _resolve(s) for s in shards}
+    elif train_records is None and args.stream:
+        # the stream's malformed-block policy must govern this pre-pass too:
+        # under --stream-on-error=skip a corrupt block costs its rows, not
+        # the whole run (the eager scan would raise before the epoch's
+        # policy ever applied)
+        logger.info("building index maps from training data (streamed scan)")
+        from photon_ml_tpu.stream.chunks import AvroStreamSource
+        from photon_ml_tpu.stream.pipeline import ChunkPipeline
+
+        def _stream_records():
+            pipe = ChunkPipeline(AvroStreamSource(args.train_data),
+                                 workers=args.stream_workers,
+                                 on_error=args.stream_on_error)
+            for _chunk, records, err in pipe:
+                if err is None:
+                    yield from records
+
+        index_maps = build_index_maps_from_records(
+            _stream_records(), shards, add_intercept=not args.no_intercept)
     elif train_records is None:
         logger.info("building index maps from training data (native scan)")
         index_maps = build_index_maps_from_avro(
@@ -380,12 +430,41 @@ def _run(args, task, t_start, emitter) -> int:
         if sparse_shards:
             logger.info("sparse shards: %s", sorted(sparse_shards))
 
-    # 2. assemble GameData (columnar fast path inside when native is up)
-    data, entity_indexes = read_game_data_avro(args.train_data, index_maps,
-                                               id_tag_names=id_tags,
-                                               records=train_records,
-                                               sparse_shards=sparse_shards,
-                                               input_columns=input_columns)
+    # 2. assemble GameData (columnar fast path inside when native is up;
+    # --stream assembles design matrices on device from the chunk pipeline)
+    if args.stream:
+        if sparse_shards:
+            logger.error("--stream does not support sparse shards yet "
+                         "(ROADMAP item 5 follow-on); drop "
+                         "--sparse-threshold or the --stream flag")
+            return 1
+        from photon_ml_tpu.stream import stream_game_data
+
+        # per-tag reservoir caps so EntityStats accumulates the capped
+        # selection in O(entities * cap); tags whose coordinates disagree on
+        # the cap accumulate full row lists (any cap answerable later)
+        active_caps = {}
+        seen_caps: Dict[str, set] = {}
+        for spec in specs:
+            t = spec.template
+            if isinstance(t, FixedEffectConfig):
+                continue
+            seen_caps.setdefault(t.random_effect_type, set()).add(t.active_cap)
+        for tag, caps in seen_caps.items():
+            if len(caps) == 1 and (cap := next(iter(caps))) is not None:
+                active_caps[tag] = cap
+        data, entity_indexes = stream_game_data(
+            args.train_data, index_maps, id_tag_names=id_tags,
+            input_columns=input_columns,
+            batch_rows=args.stream_batch_rows,
+            workers=args.stream_workers, on_error=args.stream_on_error,
+            active_caps=active_caps, seed=args.seed,
+            validate=args.data_validation != "VALIDATE_DISABLED")
+    else:
+        data, entity_indexes = read_game_data_avro(
+            args.train_data, index_maps, id_tag_names=id_tags,
+            records=train_records, sparse_shards=sparse_shards,
+            input_columns=input_columns)
     del train_records
     logger.info("train: %d samples", data.num_samples)
     val_data = None
@@ -401,7 +480,9 @@ def _run(args, task, t_start, emitter) -> int:
     clear_columnar_cache()  # decoded columns are folded into GameData now
 
     # 3. validate (reference DataValidators)
-    errors = validate_game_data(data, task, DataValidationType[args.data_validation])
+    errors = validate_game_data(
+        data, task, DataValidationType[args.data_validation],
+        allow_zero_weight=args.stream and args.stream_on_error == "skip")
     if errors:
         for e in errors:
             logger.error("validation: %s", e)

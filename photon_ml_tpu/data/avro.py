@@ -16,6 +16,7 @@ hot TrainingExample decode path when built.
 
 from __future__ import annotations
 
+import dataclasses
 import io
 import json
 import os
@@ -408,6 +409,130 @@ def write_container_raw(path: str, schema: Schema, encoded_records,
                 flush()
         flush()
     return n_total
+
+
+@dataclasses.dataclass
+class BlockSpan:
+    """One container block located by ``scan_container_blocks``.
+
+    ``offset``/``size`` frame the COMPRESSED payload (the count/size varints
+    precede ``offset``; the 16-byte sync marker follows ``offset + size``).
+    ``count`` is the record count from the block header, or -1 when the
+    header itself is truncated (record count unknowable).  ``torn`` marks a
+    block whose header or payload extends past end-of-file.
+    """
+
+    offset: int
+    size: int
+    count: int
+    torn: bool = False
+
+
+@dataclasses.dataclass
+class ContainerInfo:
+    """Header + block map of one container file (``scan_container_blocks``)."""
+
+    path: str
+    schema: dict
+    codec: str
+    sync: bytes
+    blocks: List[BlockSpan]
+
+    @property
+    def num_records(self) -> int:
+        """Records with a KNOWN count (torn-header blocks excluded)."""
+        return sum(b.count for b in self.blocks if b.count >= 0)
+
+
+def scan_container_blocks(path: str) -> ContainerInfo:
+    """Seek-based block-span scan: header + per-block (offset, size, count)
+    WITHOUT reading payloads — the streaming reader's shard map.
+
+    Unlike ``read_container_raw`` (whole file in memory), this walks only the
+    ~20-byte block headers, so a multi-GB part-file costs a few KB of reads.
+    Truncation surfaces as a ``torn`` final span instead of an exception:
+    EOF inside the count/size varints gives ``count == -1`` (rows
+    unknowable), EOF inside the payload/sync keeps the header's count (the
+    skip policy can then preserve the dataset row count).  The scan stops at
+    the first torn block — whatever follows a truncation is unframed bytes.
+    """
+    file_size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        head = f.read(min(file_size, 1 << 20))
+        r = _Reader(head)
+        if r.raw(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        try:
+            meta = decode(_META_SCHEMA, r, {})
+            sync = r.raw(16)
+        except IndexError:
+            # metadata map longer than the 1MB probe: decode from the full
+            # file (rare — the header holds a schema, not data)
+            f.seek(0)
+            r = _Reader(f.read())
+            r.raw(4)
+            meta = decode(_META_SCHEMA, r, {})
+            sync = r.raw(16)
+        raw = meta["avro.schema"]
+        schema = json.loads(raw if isinstance(raw, (str, bytes)) else bytes(raw))
+        codec = meta.get("avro.codec", b"null").decode()
+        if len(sync) != 16:
+            raise ValueError(f"{path}: truncated container header")
+
+        blocks: List[BlockSpan] = []
+        pos = r.pos
+        while pos < file_size:
+            f.seek(pos)
+            hr = _Reader(f.read(32))  # two varints: at most 20 bytes
+            try:
+                count = hr.long()
+                size = hr.long()
+            except IndexError:
+                blocks.append(BlockSpan(offset=pos, size=file_size - pos,
+                                        count=-1, torn=True))
+                break
+            data_off = pos + hr.pos
+            if count < 0 or size < 0:
+                blocks.append(BlockSpan(offset=data_off, size=size,
+                                        count=-1, torn=True))
+                break
+            if data_off + size + 16 > file_size:
+                # payload or sync truncated: the count survives, the bytes
+                # don't — downstream policy decides raise vs skip-with-count
+                blocks.append(BlockSpan(offset=data_off, size=size,
+                                        count=count, torn=True))
+                break
+            blocks.append(BlockSpan(offset=data_off, size=size, count=count))
+            pos = data_off + size + 16
+    return ContainerInfo(path=path, schema=schema, codec=codec, sync=sync,
+                         blocks=blocks)
+
+
+def read_block(path: str, span: BlockSpan, codec: str, sync: bytes) -> bytes:
+    """One block's DECOMPRESSED record bytes, sync-verified.
+
+    The streaming decode worker's read: seek + bounded read of exactly one
+    block, so concurrent workers never share file state and host memory
+    holds only in-flight blocks.  Raises ValueError for torn spans, sync
+    mismatches, and unknown codecs — one block's corruption is one chunk's
+    error, never a whole-file abort (that policy lives in the pipeline).
+    """
+    if span.torn:
+        raise ValueError(f"{path}: torn block at offset {span.offset} "
+                         f"({span.count if span.count >= 0 else 'unknown'}"
+                         " records lost to truncation)")
+    with open(path, "rb") as f:
+        f.seek(span.offset)
+        payload = f.read(span.size)
+        marker = f.read(16)
+    if len(payload) < span.size or marker != sync:
+        raise ValueError(f"{path}: sync marker mismatch at offset "
+                         f"{span.offset} (corrupt block)")
+    if codec == "deflate":
+        return zlib.decompress(payload, -15)
+    if codec != "null":
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    return payload
 
 
 def read_container_raw(path: str):

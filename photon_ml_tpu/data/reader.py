@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -27,18 +28,29 @@ class EntityIndex:
 
     The reference keeps REIds as strings everywhere; on TPU the id columns
     must be integers, so each id-tag column owns one of these.
+
+    Thread-safe: ``get_or_add``'s grow-on-first-sight is a read-check-write
+    over two fields (the dict insert and the next-id counter implied by
+    ``len``), so two threads racing on a NEW key could both claim the same
+    dense id — one lock around the grow (and around ``name_of``'s lazy
+    reverse-table rebuild, which reads ``_fwd`` while growers mutate it)
+    makes the index safe under the stream decode pool.
     """
 
     def __init__(self, ids: Optional[Dict[str, int]] = None):
         self._fwd: Dict[str, int] = dict(ids or {})
         self._rev: Optional[List[str]] = None
+        self._lock = threading.Lock()
 
     def get_or_add(self, key: str) -> int:
         i = self._fwd.get(key)
         if i is None:
-            i = len(self._fwd)
-            self._fwd[key] = i
-            self._rev = None
+            with self._lock:
+                i = self._fwd.get(key)  # re-check: another thread may have won
+                if i is None:
+                    i = len(self._fwd)
+                    self._fwd[key] = i
+                    self._rev = None
         return i
 
     def get(self, key: str) -> int:
@@ -46,10 +58,11 @@ class EntityIndex:
 
     def name_of(self, idx: int) -> Optional[str]:
         if self._rev is None:
-            rev = [""] * len(self._fwd)
-            for k, i in self._fwd.items():
-                rev[i] = k
-            self._rev = rev
+            with self._lock:
+                rev = [""] * len(self._fwd)
+                for k, i in self._fwd.items():
+                    rev[i] = k
+                self._rev = rev
         return self._rev[idx] if 0 <= idx < len(self._rev) else None
 
     @property
@@ -157,27 +170,9 @@ def read_game_data_avro(
     tags = {tag: np.full(n, -1, np.int64) for tag in id_tag_names}
 
     for i, rec in enumerate(records):
-        uids[i] = rec.get(cols["uid"])
-        y[i] = rec[cols["response"]]
-        if rec.get(cols["offset"]) is not None:
-            offset[i] = rec[cols["offset"]]
-        if rec.get(cols["weight"]) is not None:
-            weight[i] = rec[cols["weight"]]
-        meta = rec.get(cols["metadataMap"]) or {}
-        for tag in id_tag_names:
-            if tag in meta:
-                tags[tag][i] = entity_indexes[tag].get_or_add(str(meta[tag]))
-        for gid, m in group_maps.items():
-            if group_sparse[gid]:
-                continue
-            x = group_mats[gid]
-            ii = m.intercept_index
-            if ii is not None:
-                x[i, ii] = 1.0
-            for feat in rec.get(cols["features"], []):
-                j = m.get_index(feat["name"], feat.get("term") or "")
-                if j >= 0:
-                    x[i, j] += feat["value"]
+        fill_record_row(rec, cols, i, i, y, offset, weight, uids, tags,
+                        entity_indexes, id_tag_names, group_maps,
+                        group_sparse, group_mats)
 
     mats: Dict[str, object] = {}
     for gid, shards_of in groups.items():
@@ -193,6 +188,41 @@ def read_game_data_avro(
     data = GameData(y=y, features=mats, offset=offset, weight=weight, id_tags=tags,
                     uids=uids)
     return data, entity_indexes
+
+
+def fill_record_row(rec, cols, row, mat_row, y, offset, weight, uids, tags,
+                    entity_indexes, id_tag_names, group_maps, group_sparse,
+                    mats) -> None:
+    """Decode ONE TrainingExampleAvro record into row ``row`` of the columnar
+    arrays and row ``mat_row`` of the per-group dense design matrices.
+
+    The ONE place record->row semantics live: ``read_game_data_avro`` passes
+    ``mat_row == row`` (matrices span the whole dataset); the streaming
+    ingest (stream/ingest.py) passes a batch-local ``mat_row`` because its
+    design-matrix buffers only span one device-feed batch.  Sharing the fill
+    keeps the two paths bitwise-identical by construction — same float
+    accumulation order, same entity-id assignment order."""
+    uids[row] = rec.get(cols["uid"])
+    y[row] = rec[cols["response"]]
+    if rec.get(cols["offset"]) is not None:
+        offset[row] = rec[cols["offset"]]
+    if rec.get(cols["weight"]) is not None:
+        weight[row] = rec[cols["weight"]]
+    meta = rec.get(cols["metadataMap"]) or {}
+    for tag in id_tag_names:
+        if tag in meta:
+            tags[tag][row] = entity_indexes[tag].get_or_add(str(meta[tag]))
+    for gid, m in group_maps.items():
+        if group_sparse[gid]:
+            continue
+        x = mats[gid]
+        ii = m.intercept_index
+        if ii is not None:
+            x[mat_row, ii] = 1.0
+        for feat in rec.get(cols["features"], []):
+            j = m.get_index(feat["name"], feat.get("term") or "")
+            if j >= 0:
+                x[mat_row, j] += feat["value"]
 
 
 def _shard_groups(index_maps, sparse_shards):

@@ -26,13 +26,25 @@ class DataValidationType(enum.Enum):
 SAMPLE_FRACTION = 0.1
 
 
+def _is_device_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
 def validate_game_data(data: GameData, task: TaskType,
                        mode: DataValidationType = DataValidationType.VALIDATE_FULL,
-                       seed: int = 0) -> List[str]:
+                       seed: int = 0,
+                       allow_zero_weight: bool = False) -> List[str]:
     """Returns a list of human-readable violations (empty = valid).
 
     Raises nothing itself — drivers decide (the reference throws on the first
     failed check; CLI callers here do the same on a non-empty list).
+
+    ``allow_zero_weight`` relaxes the positive-weight rule to nonnegative:
+    the streamed skip policy marks rows lost to malformed chunks inert at
+    weight 0 (so ``n`` never silently shrinks), and those rows must not fail
+    the run the policy just saved.
     """
     if mode == DataValidationType.VALIDATE_DISABLED:
         return []
@@ -54,10 +66,18 @@ def validate_game_data(data: GameData, task: TaskType,
         errors.append("offsets contain non-finite values")
     if not np.all(np.isfinite(weight)):
         errors.append("weights contain non-finite values")
-    if np.any(weight <= 0):
+    if allow_zero_weight:
+        if np.any(weight < 0):
+            errors.append("weights must be nonnegative")
+    elif np.any(weight <= 0):
         errors.append("weights must be positive (reference: zero/negative weight rows rejected)")
 
     for shard, x in data.features.items():
+        if _is_device_array(x):
+            # streamed device-assembled shard: pulling [n, d] to host here
+            # would defeat out-of-core ingest — it was finite-checked per
+            # chunk at decode time (stream/ingest.py validate=True)
+            continue
         arr = x.values if hasattr(x, "indices") else np.asarray(x)  # SparseShard
         if not np.all(np.isfinite(np.asarray(arr)[idx])):
             errors.append(f"feature shard {shard!r} contains non-finite values")

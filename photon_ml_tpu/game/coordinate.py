@@ -814,7 +814,27 @@ class RandomEffectCoordinate(Coordinate):
                     base=self.buckets, buckets=proj_buckets,
                     projections=[shared] * len(proj_buckets))
         else:
-            x = np.asarray(shard_data, dtype)
+            # A streamed (device-assembled) dense shard stays on device: the
+            # bucketer gathers lanes on device, and the [n, d] array never
+            # materializes on host — the point of out-of-core ingest.
+            shard_is_device = isinstance(shard_data, jax.Array)
+            if shard_is_device and config.projector != ProjectorType.IDENTITY:
+                raise NotImplementedError(
+                    f"coordinate {coordinate_id!r}: projector "
+                    f"{config.projector.name} over a device-assembled "
+                    "(streamed) design shard would host-materialize it; "
+                    "IDENTITY only for now (ROADMAP item 5 follow-on)")
+            x = shard_data if shard_is_device else np.asarray(shard_data, dtype)
+            groups = None
+            if data.entity_stats is not None:
+                stats = data.entity_stats.get(config.random_effect_type)
+                if stats is not None:
+                    # per-entity grouping accumulated chunk-by-chunk during
+                    # streaming ingest; None on cap/seed mismatch -> the
+                    # bucketer rescans the host id column as usual
+                    groups = stats.groups(config.active_cap,
+                                          config.min_active_samples, seed,
+                                          existing_model_keys)
             self.buckets = bucket_by_entity(
                 entity_ids, x, np.asarray(data.y, dtype),
                 offset=np.asarray(data.offset, dtype),
@@ -824,6 +844,7 @@ class RandomEffectCoordinate(Coordinate):
                 lane_multiple=lane_multiple,
                 seed=seed, dtype=dtype,
                 existing_model_keys=existing_model_keys,
+                groups=groups,
             )
         # slot order for the stacked model = sorted entity id (stacked_coefficients)
         self._sorted_ids = sorted(self.buckets.lane_of)
@@ -924,8 +945,18 @@ class RandomEffectCoordinate(Coordinate):
         self._put_entity = put
         sd = _storage_np_dtype(self.config.storage_dtype)  # host-side cast:
         # transfer + HBM residency are storage-width from the start
+
+        def _narrow(bx):
+            if sd is None:
+                return bx
+            if isinstance(bx, jax.Array):
+                # streamed shard: bucket tensors are already device-resident;
+                # cast on device (transiently double-width, then freed)
+                return bx.astype(sd)
+            return np.asarray(bx).astype(sd)
+
         self._dev = [
-            dict(x=put(b.x if sd is None else np.asarray(b.x).astype(sd)),
+            dict(x=put(_narrow(b.x)),
                  y=put(b.y), w=put(b.weight),
                  rows=put(np.where(b.rows < 0, 0, b.rows)),
                  valid=put(b.rows >= 0))

@@ -59,6 +59,11 @@ class GameData:
     weight: Optional[np.ndarray] = None  # [n]
     id_tags: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)  # tag -> [n] int64
     uids: Optional[np.ndarray] = None  # [n] original unique sample ids (object)
+    #: tag -> stream.EntityStats accumulated during streaming ingest; lets
+    #: random-effect coordinates reuse the per-entity grouping computed
+    #: chunk-by-chunk instead of re-scanning the id column.  None on the
+    #: eager path (coordinates fall back to bucketing._group_rows).
+    entity_stats: Optional[Dict[str, object]] = None
 
     def __post_init__(self):
         n = len(self.y)

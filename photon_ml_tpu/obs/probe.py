@@ -15,7 +15,9 @@ Instrumented sites:
   - ``utils/compile_cache.enable_compilation_cache`` — reports cache
     residency as a gauge (a disabled cache means every process pays full
     first-compiles; that should be visible, not inferred);
-  - ``utils/transfer.chunked_device_put`` — per-chunk transfer bytes.
+  - ``utils/transfer.chunked_device_put`` — per-chunk transfer bytes;
+  - ``utils/transfer.stream_device_put`` — streaming-ingest batch uploads
+    (``site="stream_feed"``), the bench's ingest-bytes axis.
 
 Per-span device fences (``span(..., device_sync=True)``) live on the
 tracer; this module only provides the default fence wiring.
@@ -81,12 +83,19 @@ class JaxRuntimeProbe:
         self.registry.inc("jax_transfers_total", direction=direction,
                           site=site)
 
-    def transfer_bytes(self, direction: Optional[str] = None) -> int:
+    def transfer_bytes(self, direction: Optional[str] = None,
+                       site: Optional[str] = None) -> int:
+        """Transfer bytes recorded, optionally filtered by direction and/or
+        call site (e.g. ``site="stream_feed"`` isolates streaming-ingest
+        uploads from design-matrix puts)."""
         total = 0
         for lk, v in self.registry.counter_series(
                 "jax_transfer_bytes_total").items():
-            if direction is None or ("direction", direction) in lk:
-                total += v
+            if direction is not None and ("direction", direction) not in lk:
+                continue
+            if site is not None and ("site", site) not in lk:
+                continue
+            total += v
         return int(total)
 
     # -- cache residency ---------------------------------------------------

@@ -204,6 +204,7 @@ def bucket_by_entity(
     existing_model_keys: Optional[frozenset] = None,
     row_ids: Optional[np.ndarray] = None,
     num_samples: Optional[int] = None,
+    groups: Optional[Tuple[List[np.ndarray], List[int], List[float]]] = None,
 ) -> EntityBuckets:
     """Group samples by entity into power-of-two-capacity buckets.
 
@@ -219,10 +220,30 @@ def bucket_by_entity(
       local rows' GLOBAL sample ids (stored in ``Bucket.rows`` and mixed
       into reservoir keys so decisions are topology-invariant) and the
       GLOBAL score-vector length (parallel/multihost.py).
+    - ``groups``: a precomputed ``(kept_rows, kept_entities, rescale)``
+      triple (stream.EntityStats accumulated chunk-by-chunk) replacing the
+      ``_group_rows`` scan; it must have been built with the SAME cap /
+      min-active / seed / warm-start arguments (EntityStats.groups enforces
+      the cap+seed half and returns None on mismatch).
+
+    ``x`` may be a device-resident ``jax.Array`` (streaming ingest
+    assembles design shards on device): the per-lane design blocks are then
+    built by an on-device gather — bit-identical to the host fill, since a
+    gather copies rows and the padding is exact zeros either way — and the
+    [n, d] array never materializes on host.
     """
     n = len(entity_ids)
     entity_ids = np.asarray(entity_ids, np.int64)
-    x = np.asarray(x, dtype)
+    x_is_device = isinstance(x, jax.Array)
+    if x_is_device:
+        if row_ids is not None:
+            raise NotImplementedError(
+                "device-resident design shards do not support multihost "
+                "row_ids yet (ROADMAP item 5 follow-on)")
+        if x.dtype != np.dtype(dtype):
+            x = x.astype(dtype)  # on-device cast: never host-materialize
+    else:
+        x = np.asarray(x, dtype)
     y = np.asarray(y, dtype)
     offset = np.zeros(n, dtype) if offset is None else np.asarray(offset, dtype)
     weight = np.ones(n, dtype) if weight is None else np.asarray(weight, dtype)
@@ -230,9 +251,12 @@ def bucket_by_entity(
     if row_ids is not None:
         row_ids = np.asarray(row_ids, np.int64)
 
-    kept_rows, kept_entities, rescale = _group_rows(
-        entity_ids, active_cap, min_active_samples, seed,
-        existing_model_keys=existing_model_keys, row_ids=row_ids)
+    if groups is not None:
+        kept_rows, kept_entities, rescale = groups
+    else:
+        kept_rows, kept_entities, rescale = _group_rows(
+            entity_ids, active_cap, min_active_samples, seed,
+            existing_model_keys=existing_model_keys, row_ids=row_ids)
 
     # Capacity classes: next power of two of the active count.
     caps = _capacity_classes(kept_rows)
@@ -244,10 +268,18 @@ def bucket_by_entity(
         by, boff, bw, brows, bcounts, blanes = _pack_lane_meta(
             n_lanes, cap, idxs, kept_rows, kept_entities, rescale,
             y, offset, weight, dtype, lane_of, len(buckets), row_ids=row_ids)
-        bx = np.zeros((n_lanes, cap, d), dtype)
-        for lane, ei in enumerate(idxs):
-            rows = kept_rows[ei]
-            bx[lane, :len(rows)] = x[rows]
+        if x_is_device:
+            # on-device lane gather: rows copy exactly, padding lanes/slots
+            # are exact zeros — bitwise-equal to the host fill below
+            valid = brows >= 0
+            safe = np.where(valid, brows, 0).astype(np.int64)
+            bx = jnp.where(jnp.asarray(valid)[..., None],
+                           x[jnp.asarray(safe)], jnp.zeros((), x.dtype))
+        else:
+            bx = np.zeros((n_lanes, cap, d), dtype)
+            for lane, ei in enumerate(idxs):
+                rows = kept_rows[ei]
+                bx[lane, :len(rows)] = x[rows]
         buckets.append(Bucket(x=bx, y=by, offset=boff, weight=bw, rows=brows,
                               counts=bcounts, entity_lanes=blanes))
 

@@ -1,0 +1,32 @@
+"""photonstream: out-of-core streaming data plane.
+
+Shards Avro / libsvm inputs into block-aligned chunks
+(``data/avro.scan_container_blocks``), decodes them on a bounded background
+thread pool (``ChunkPipeline``), and double-buffers fixed-shape host->device
+batch uploads (``DeviceFeed`` over ``utils/transfer.stream_device_put`` /
+``stream_update``) so batch N+1's transfer overlaps batch N's device write.
+``stream_game_data`` assembles the SAME ``GameData`` the eager reader
+produces — design matrices live on device, assembled in place from the
+batch stream; scalar columns (labels, offsets, weights, id tags) stay host
+— so the existing estimator runs unchanged and coefficients match the
+in-memory path bitwise on RAM-sized data, while peak host memory on bigger
+data is bounded by ~2 in-flight chunks + pipeline buffers.
+"""
+
+from photon_ml_tpu.stream.chunks import (AvroStreamSource, Chunk,
+                                         LibsvmStreamSource)
+from photon_ml_tpu.stream.feed import DeviceFeed
+from photon_ml_tpu.stream.ingest import stream_game_data, stream_libsvm
+from photon_ml_tpu.stream.pipeline import ChunkPipeline
+from photon_ml_tpu.stream.stats import EntityStats
+
+__all__ = [
+    "AvroStreamSource",
+    "Chunk",
+    "ChunkPipeline",
+    "DeviceFeed",
+    "EntityStats",
+    "LibsvmStreamSource",
+    "stream_game_data",
+    "stream_libsvm",
+]

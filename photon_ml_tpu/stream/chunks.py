@@ -1,0 +1,157 @@
+"""Chunk sources: shard input files into independently decodable pieces.
+
+A chunk is the unit of parallel decode and of error isolation — one Avro
+container block (record count known from the block header without touching
+the payload) or one libsvm line range.  Sources do a cheap metadata-only
+scan up front so the TOTAL row count is known before the first record
+decodes (the device-side design matrices are preallocated [n, d]) and torn
+files surface at scan time as explicitly-marked torn chunks rather than as
+a mid-epoch surprise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from photon_ml_tpu.data import avro as _avro
+
+
+@dataclasses.dataclass
+class Chunk:
+    """One decodable shard of the input.
+
+    ``n_rows`` is the record count known WITHOUT decoding (Avro block
+    header / libsvm line scan), or -1 when a torn Avro block header made it
+    unknowable.  ``torn`` chunks fail in ``decode_chunk`` by construction —
+    they exist so the row-count accounting and the error policy both see
+    truncation explicitly instead of a silently short epoch.
+    """
+
+    index: int
+    path: str
+    n_rows: int
+    torn: bool = False
+    span: Optional[_avro.BlockSpan] = None          # Avro
+    byte_range: Optional[Tuple[int, int]] = None    # libsvm
+
+
+class AvroStreamSource:
+    """Avro container files -> block-aligned chunks.
+
+    ``paths`` may be files or directories (directories expand via
+    ``list_avro_files``, sorted — the same file order as the eager reader,
+    which the bitwise-parity guarantee depends on).
+    """
+
+    def __init__(self, paths):
+        if isinstance(paths, str):
+            paths = [paths]
+        self.files: List[str] = [f for p in paths
+                                 for f in _avro.list_avro_files(p)]
+        self._info = {}
+        self.chunks: List[Chunk] = []
+        for path in self.files:
+            info = _avro.scan_container_blocks(path)
+            self._info[path] = info
+            for span in info.blocks:
+                self.chunks.append(Chunk(
+                    index=len(self.chunks), path=path,
+                    n_rows=span.count if span.count >= 0 else -1,
+                    torn=span.torn, span=span))
+
+    @property
+    def num_rows(self) -> int:
+        """Rows with a KNOWN count.  Payload-torn blocks are included (the
+        header survived; skip policy keeps their rows, inert); header-torn
+        blocks are excluded — their count is unknowable, and they are
+        surfaced as chunk errors, never silently absorbed."""
+        return sum(c.n_rows for c in self.chunks if c.n_rows >= 0)
+
+    def schema(self, path: Optional[str] = None) -> dict:
+        return self._info[path or self.files[0]].schema
+
+    def decode_chunk(self, chunk: Chunk) -> List[dict]:
+        """Decode one block to records (thread-safe: bounded seek+read, no
+        shared mutable state).  Raises ValueError with file+offset context
+        for torn spans, sync mismatches, bad compression, or decode errors
+        — the pipeline's per-chunk error unit."""
+        info = self._info[chunk.path]
+        raw = _avro.read_block(chunk.path, chunk.span, info.codec, info.sync)
+        br = _avro._Reader(raw)
+        named: dict = {}  # fresh per block: decode() mutates it
+        try:
+            return [_avro.decode(info.schema, br, named)
+                    for _ in range(chunk.span.count)]
+        except ValueError:
+            raise
+        except Exception as e:
+            raise ValueError(f"{chunk.path}: corrupt block at offset "
+                             f"{chunk.span.offset}: {e!r}") from e
+
+
+#: One parsed libsvm row: (label, [(1-based index, value), ...]).
+LibsvmRow = Tuple[float, List[Tuple[int, float]]]
+
+
+class LibsvmStreamSource:
+    """A libsvm file -> line-range chunks of ``rows_per_chunk`` rows.
+
+    The scan walks the file once counting non-empty lines and recording
+    chunk byte ranges — O(1) memory.  (This is also why streaming libsvm
+    needs an explicit ``num_features``: the eager reader's max-index
+    default would cost a full parse pass.)
+    """
+
+    def __init__(self, path: str, rows_per_chunk: int = 4096):
+        self.path = path
+        self.chunks: List[Chunk] = []
+        with open(path, "rb") as f:
+            start, count = 0, 0
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                if line.split():
+                    count += 1
+                if count >= rows_per_chunk:
+                    self.chunks.append(Chunk(index=len(self.chunks),
+                                             path=path, n_rows=count,
+                                             byte_range=(start, f.tell())))
+                    start, count = f.tell(), 0
+            if count:
+                self.chunks.append(Chunk(index=len(self.chunks), path=path,
+                                         n_rows=count,
+                                         byte_range=(start, f.tell())))
+
+    @property
+    def num_rows(self) -> int:
+        return sum(c.n_rows for c in self.chunks)
+
+    def decode_chunk(self, chunk: Chunk) -> List[LibsvmRow]:
+        """Parse one line range — token-for-token the ``read_libsvm`` parse,
+        so the streamed design matrix matches the eager one bitwise."""
+        lo, hi = chunk.byte_range
+        with open(self.path, "rb") as f:
+            f.seek(lo)
+            blob = f.read(hi - lo)
+        out: List[LibsvmRow] = []
+        try:
+            for line in blob.decode().splitlines():
+                parts = line.split()
+                if not parts:
+                    continue
+                label = float(parts[0])
+                row = []
+                for tok in parts[1:]:
+                    k, _, v = tok.partition(":")
+                    row.append((int(k), float(v)))
+                out.append((label, row))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise ValueError(f"{self.path}: corrupt libsvm chunk at bytes "
+                             f"[{lo}, {hi}): {e}") from e
+        if len(out) != chunk.n_rows:
+            raise ValueError(f"{self.path}: chunk at bytes [{lo}, {hi}) "
+                             f"parsed {len(out)} rows, scan counted "
+                             f"{chunk.n_rows}")
+        return out

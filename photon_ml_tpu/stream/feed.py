@@ -1,0 +1,71 @@
+"""Double-buffered device feed: fixed-shape batch uploads -> [n, d] arrays.
+
+The ingest fills pow2-sized [batch_rows, d] host blocks and pushes them
+here; ``DeviceFeed`` uploads each block non-blocking
+(``utils/transfer.stream_device_put``) and DEFERS the donated
+``dynamic_update_slice`` write into the preallocated [n, d] device array
+until ``max_in_flight`` newer uploads are in flight — so batch N's device
+write overlaps batch N+1's host->device transfer, the double-buffering the
+tentpole names.  All uploads share ONE [batch_rows, d] shape per group
+(plus one ragged-tail shape), so the jitted update compiles twice total
+and the solve kernels downstream never see a shape they haven't AOT'd.
+
+Device-memory peak: the [n, d] outputs + ``max_in_flight`` batches (the
+update donates the output buffer — see ``utils/transfer._update_at``).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.utils.transfer import stream_device_put, stream_update
+
+
+class DeviceFeed:
+    """Assembles per-group [n, d] device arrays from a batch stream."""
+
+    def __init__(self, n: int, group_dims: Dict[object, int], dtype,
+                 max_in_flight: int = 2):
+        self._out = {gid: jnp.zeros((n, d), dtype)
+                     for gid, d in group_dims.items()}
+        self._dtype = dtype
+        self.max_in_flight = max(1, int(max_in_flight))
+        self._inflight: collections.deque = collections.deque()
+        self.batches_pushed = 0
+
+    def push(self, blocks: Dict[object, np.ndarray], lo: int,
+             rows: int) -> Dict[object, object]:
+        """Upload one batch (async) and apply the oldest deferred write.
+
+        ``blocks`` maps group id -> [B, d] host block whose first ``rows``
+        rows are valid; the caller must hand over OWNERSHIP (on CPU
+        backends ``jnp.asarray`` may alias the host buffer zero-copy, so
+        reusing a pushed block would corrupt an in-flight upload — the
+        ingest allocates a fresh block per batch).  Returns the uploaded
+        device blocks so stream consumers (opt/streamfold) can fold over
+        them without a second upload.
+        """
+        parts = {gid: stream_device_put(b, self._dtype)
+                 for gid, b in blocks.items()}
+        self._inflight.append((parts, lo, rows))
+        self.batches_pushed += 1
+        while len(self._inflight) > self.max_in_flight:
+            self._apply(self._inflight.popleft())
+        return parts
+
+    def _apply(self, item) -> None:
+        parts, lo, rows = item
+        for gid, part in parts.items():
+            self._out[gid] = stream_update(self._out[gid], part, lo, rows)
+
+    def finish(self) -> Dict[object, jnp.ndarray]:
+        """Drain deferred writes and fence; returns the [n, d] arrays."""
+        while self._inflight:
+            self._apply(self._inflight.popleft())
+        for out in self._out.values():
+            out.block_until_ready()
+        return self._out

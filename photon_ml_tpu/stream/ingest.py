@@ -1,0 +1,269 @@
+"""Streaming GameData assembly: chunk stream -> device design matrices.
+
+``stream_game_data`` is the out-of-core twin of
+``data/reader.read_game_data_avro``: same inputs, same ``GameData`` out,
+but the dense design matrices are assembled ON DEVICE from fixed-shape
+batch uploads instead of materializing [n, d] host arrays.  Bitwise parity
+with the eager path is by construction, not by luck:
+
+- chunks decode in parallel but are CONSUMED in file/block order, and each
+  record flows through the SAME ``reader.fill_record_row`` the eager loop
+  uses — identical float accumulation order, identical grow-on-first-sight
+  entity-id assignment;
+- uploads move bytes, not math: ``float32(x)`` uploaded then gathered is
+  the same bits as ``float32(x)`` indexed on host.
+
+What stays host-resident: the O(8 bytes/row) scalar columns (labels,
+offsets, weights, uids, id-tag columns) — the same columns every solve
+needs densely and repeatedly.  What never materializes on host: any
+[n, d] design block; peak host memory is ~(workers + depth) decoded
+chunks + ``max_in_flight`` batch buffers.
+
+Malformed input follows the pipeline's policy knob: ``raise`` surfaces the
+first corrupt chunk; ``skip`` keeps the epoch AND the row count honest —
+a payload-torn block's rows (count known from its header) stay allocated
+with ``weight=0`` (inert in every weighted loss and every sufficient
+statistic) and are counted in ``stream_skipped_rows_total``; a header-torn
+block (count unknowable) is excluded from ``n`` by the scan itself and
+counted as a chunk error.  No silent short epochs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.reader import (DEFAULT_INPUT_COLUMNS, EntityIndex,
+                                       _shard_groups, fill_record_row)
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.obs.registry import get_registry
+from photon_ml_tpu.stream.chunks import AvroStreamSource, LibsvmStreamSource
+from photon_ml_tpu.stream.feed import DeviceFeed
+from photon_ml_tpu.stream.pipeline import ChunkPipeline
+from photon_ml_tpu.stream.stats import EntityStats
+
+
+def stream_game_data(
+    paths: Iterable[str],
+    index_maps: Dict[str, object],
+    id_tag_names: Iterable[str] = (),
+    entity_indexes: Optional[Dict[str, EntityIndex]] = None,
+    dtype=np.float32,
+    input_columns: Optional[Dict[str, str]] = None,
+    batch_rows: int = 4096,
+    workers: int = 2,
+    depth: int = 2,
+    on_error: str = "raise",
+    active_caps: Optional[Dict[str, int]] = None,
+    seed: int = 0,
+    validate: bool = False,
+    sparse_shards: Optional[Iterable[str]] = None,
+    folds: Optional[Dict[str, object]] = None,
+) -> Tuple[GameData, Dict[str, EntityIndex]]:
+    """TrainingExampleAvro files -> GameData with DEVICE design matrices.
+
+    ``batch_rows`` should be a power of two (the fixed device-feed batch
+    shape; default 4096).  ``active_caps`` maps id-tag -> that coordinate's
+    ``active_cap`` so ``EntityStats`` can accumulate the capped reservoir
+    in O(entities * cap); tags without an entry accumulate full row lists.
+    ``validate=True`` finite-checks every batch (labels, offsets, weights,
+    design blocks) before upload and raises ValueError — data invalidity is
+    not subject to the ``on_error`` chunk policy, which covers malformed
+    FILES.  ``sparse_shards`` must be empty: streamed sparse assembly is a
+    ROADMAP follow-on.  ``folds`` maps shard name ->
+    ``opt.streamfold.StreamingFixedEffectFold``: each uploaded batch is
+    folded into that shard's fixed-effect sufficient statistics in the same
+    pass, reusing the feed's device blocks.
+    """
+    if sparse_shards and set(sparse_shards):
+        raise ValueError("streaming ingest does not support sparse shards "
+                         "yet (ROADMAP item 5 follow-on); use the eager "
+                         "reader for sparse-shard configs")
+    cols = {**DEFAULT_INPUT_COLUMNS, **(input_columns or {})}
+    if isinstance(paths, str):
+        paths = [paths]
+    source = AvroStreamSource(paths)
+    n = source.num_rows
+    batch_rows = max(1, int(batch_rows))
+
+    groups, group_maps, group_sparse = _shard_groups(index_maps, set())
+    group_dims = {gid: m.size for gid, m in group_maps.items()}
+
+    y = np.zeros(n, dtype)
+    offset = np.zeros(n, dtype)
+    weight = np.ones(n, dtype)
+    uids = np.empty(n, object)
+    id_tag_names = list(id_tag_names)
+    entity_indexes = entity_indexes or {}
+    for tag in id_tag_names:
+        entity_indexes.setdefault(tag, EntityIndex())
+    tags = {tag: np.full(n, -1, np.int64) for tag in id_tag_names}
+    stats = {tag: EntityStats((active_caps or {}).get(tag), seed)
+             for tag in id_tag_names}
+
+    feed = DeviceFeed(n, group_dims, dtype, max_in_flight=2)
+    registry = get_registry()
+
+    def fresh_bufs():
+        # fresh buffers every batch: the previous batch may still be
+        # uploading, and jnp.asarray can alias host memory zero-copy
+        return {gid: np.zeros((batch_rows, d), dtype)
+                for gid, d in group_dims.items()}
+
+    bufs = fresh_bufs()
+    lo = 0      # global row where the current batch starts
+    fill = 0    # valid rows in the current batch
+    row = 0     # next global row
+
+    folds = folds or {}
+    gid_of_shard = {shard: gid for gid, shards_of in groups.items()
+                    for shard in shards_of}
+    for shard in folds:
+        if shard not in gid_of_shard:
+            raise ValueError(f"fold for unknown shard {shard!r}")
+
+    def flush():
+        nonlocal bufs, lo, fill
+        if fill == 0:
+            return
+        if validate:
+            for gid, b in bufs.items():
+                if not np.isfinite(b[:fill]).all():
+                    shard = groups[gid][0]
+                    raise ValueError(
+                        f"non-finite feature value in shard {shard!r}, "
+                        f"rows [{lo}, {lo + fill})")
+        parts = feed.push(bufs, lo, fill)
+        for shard, fold in folds.items():
+            fold.accumulate(parts[gid_of_shard[shard]], y[lo:lo + fill],
+                            offset[lo:lo + fill], weight[lo:lo + fill], fill)
+        bufs = fresh_bufs()
+        lo += fill
+        fill = 0
+
+    pipeline = ChunkPipeline(source, workers=workers, depth=depth,
+                             on_error=on_error)
+    for chunk, records, err in pipeline:
+        if chunk.n_rows < 0:
+            continue  # header-torn: no rows allocated, error already counted
+        if err is not None:
+            # lost chunk with KNOWN count: keep its rows, inert (weight 0),
+            # so n and every downstream row range stay exact
+            weight[row:row + chunk.n_rows] = 0.0
+            registry.inc("stream_skipped_rows_total", chunk.n_rows)
+            remaining = chunk.n_rows
+            while remaining > 0:
+                take = min(remaining, batch_rows - fill)
+                fill += take
+                row += take
+                remaining -= take
+                if fill == batch_rows:
+                    flush()
+            continue
+        base = row
+        for rec in records:
+            fill_record_row(rec, cols, row, fill, y, offset, weight, uids,
+                            tags, entity_indexes, id_tag_names, group_maps,
+                            group_sparse, bufs)
+            row += 1
+            fill += 1
+            if fill == batch_rows:
+                flush()
+        if validate:
+            for name, col in (("response", y), ("offset", offset),
+                              ("weight", weight)):
+                if not np.isfinite(col[base:row]).all():
+                    raise ValueError(f"non-finite {name} in {chunk.path}, "
+                                     f"rows [{base}, {row})")
+        for tag in id_tag_names:
+            stats[tag].update(tags[tag][base:row], base)
+    flush()
+    outs = feed.finish()
+
+    mats: Dict[str, object] = {}
+    for gid, shards_of in groups.items():
+        for shard in shards_of:
+            mats[shard] = outs[gid]
+
+    data = GameData(y=y, features=mats, offset=offset, weight=weight,
+                    id_tags=tags, uids=uids,
+                    entity_stats=stats if id_tag_names else None)
+    return data, entity_indexes
+
+
+def stream_libsvm(path: str, num_features: int, add_intercept: bool = True,
+                  binary_labels_01: bool = True, dtype=np.float32,
+                  batch_rows: int = 4096, workers: int = 2, depth: int = 2,
+                  on_error: str = "raise"):
+    """Streaming twin of ``reader.read_libsvm``: (X on device, y, intercept).
+
+    ``num_features`` is REQUIRED (the eager default scans for the max
+    index, which would cost a full extra parse pass out-of-core).  Parity:
+    duplicate indices overwrite (last wins) exactly like the eager
+    assignment fill, and the -1/+1 -> 0/1 label mapping applies over the
+    FULL label vector at the end, matching the eager reader's whole-file
+    check.
+    """
+    if num_features is None:
+        raise ValueError("stream_libsvm requires explicit num_features")
+    source = LibsvmStreamSource(path, rows_per_chunk=batch_rows)
+    n = source.num_rows
+    extra = 1 if add_intercept else 0
+    d = int(num_features) + extra
+
+    y = np.zeros(n, dtype)
+    feed = DeviceFeed(n, {"x": d}, dtype, max_in_flight=2)
+    buf = np.zeros((batch_rows, d), dtype)
+    if add_intercept:
+        buf[:, 0] = 1.0
+    lo = fill = row = 0
+
+    def fresh():
+        b = np.zeros((batch_rows, d), dtype)
+        if add_intercept:
+            b[:, 0] = 1.0
+        return b
+
+    def flush():
+        nonlocal buf, lo, fill
+        if fill == 0:
+            return
+        feed.push({"x": buf}, lo, fill)
+        buf = fresh()
+        lo += fill
+        fill = 0
+
+    pipeline = ChunkPipeline(source, workers=workers, depth=depth,
+                             on_error=on_error)
+    for chunk, rows_parsed, err in pipeline:
+        if err is not None:
+            # lost chunk: rows stay allocated but fully zero (label 0,
+            # no intercept) so they contribute nothing to any Gram/moment
+            row += chunk.n_rows
+            remaining = chunk.n_rows
+            while remaining > 0:
+                take = min(remaining, batch_rows - fill)
+                if add_intercept:
+                    buf[fill:fill + take, 0] = 0.0
+                fill += take
+                remaining -= take
+                if fill == batch_rows:
+                    flush()
+            continue
+        for label, pairs in rows_parsed:
+            y[row] = label
+            for j, v in pairs:
+                if j > num_features:
+                    raise ValueError(f"{path}: feature index {j} exceeds "
+                                     f"num_features={num_features}")
+                buf[fill, j - 1 + extra] = v
+            row += 1
+            fill += 1
+            if fill == batch_rows:
+                flush()
+    flush()
+    x = feed.finish()["x"]
+    if binary_labels_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y > 0).astype(dtype)
+    return x, y, (0 if add_intercept else None)

@@ -1,0 +1,122 @@
+"""Streaming per-entity sufficient statistics for random-effect grouping.
+
+The eager path groups sample rows by entity with ``parallel/bucketing
+._group_rows`` — one pass over the FULL id column with the deterministic
+splitmix64 reservoir cap.  A streaming epoch never holds the full dataset,
+but the id columns are scalar (8 bytes/row) and stay host-resident, and the
+reservoir selection is a running min-``cap`` over a total order, so the
+same grouping can be accumulated chunk by chunk.  ``EntityStats``
+reproduces ``_group_rows``' output EXACTLY — same kept rows, same entity
+order, same ``count / cap`` rescale floats — which is what lets streamed
+random-effect solves match the in-memory path bitwise.
+
+Two accumulation modes:
+
+- ``active_cap=None`` (full): keeps every row index per entity.  Memory is
+  O(total rows) of int64 — same order as the host id column itself — and
+  any ``(active_cap, seed)`` can be answered later by recomputing keys.
+- ``active_cap=k`` (capped): keeps at most ``k`` ``(row, key)`` pairs per
+  entity — the running cap-smallest by ``(key, row)``, exactly the set
+  ``argsort(keys, kind="stable")[:cap]`` selects over ascending rows.
+  Memory is O(entities * cap); only the matching ``(active_cap, seed)``
+  can be answered (``groups`` returns None otherwise and the coordinate
+  falls back to ``_group_rows`` over the host id column).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.parallel.bucketing import _splitmix64
+
+Groups = Tuple[List[np.ndarray], List[int], List[float]]
+
+
+class EntityStats:
+    """Chunk-incremental replica of ``_group_rows`` (see module docstring)."""
+
+    def __init__(self, active_cap: Optional[int] = None, seed: int = 0):
+        self.active_cap = active_cap
+        self.seed = seed
+        self._counts: Dict[int, int] = {}
+        self._rows: Dict[int, np.ndarray] = {}
+        self._keys: Dict[int, np.ndarray] = {}  # capped mode only
+
+    @property
+    def num_entities(self) -> int:
+        return len(self._counts)
+
+    def update(self, entity_ids: np.ndarray, row_base: int) -> None:
+        """Fold one chunk's id column (GLOBAL rows ``row_base ..``).
+
+        Chunks must arrive in row order (the pipeline is ordered), so
+        full-mode row lists stay globally ascending — the property
+        ``_group_rows`` gets from its stable argsort and that the capped
+        mode's ``(key, row)`` tie-break reproduces.  Missing-tag rows
+        (id -1) are NOT filtered: ``_group_rows`` groups them too.
+        """
+        eids = np.asarray(entity_ids, np.int64)
+        if eids.size == 0:
+            return
+        uniq, inverse, counts = np.unique(eids, return_inverse=True,
+                                          return_counts=True)
+        order = np.argsort(inverse, kind="stable")
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        cap = self.active_cap
+        for e in range(len(uniq)):
+            eid = int(uniq[e])
+            rows = (order[starts[e]: starts[e + 1]]
+                    + row_base).astype(np.int64)
+            self._counts[eid] = self._counts.get(eid, 0) + len(rows)
+            prev = self._rows.get(eid)
+            if cap is None:
+                self._rows[eid] = rows if prev is None \
+                    else np.concatenate([prev, rows])
+                continue
+            keys = _splitmix64(rows.astype(np.uint64) ^ np.uint64(self.seed))
+            if prev is not None:
+                rows = np.concatenate([prev, rows])
+                keys = np.concatenate([self._keys[eid], keys])
+            if len(rows) > cap:
+                # running min-cap by (key, row): the same set a one-shot
+                # stable argsort over keys selects, since rows are unique
+                # and ascending within each incoming chunk
+                sel = np.lexsort((rows, keys))[:cap]
+                rows, keys = rows[sel], keys[sel]
+            self._rows[eid] = rows
+            self._keys[eid] = keys
+
+    def groups(self, active_cap: Optional[int], min_active_samples: int,
+               seed: int, existing_model_keys: Optional[frozenset] = None,
+               ) -> Optional[Groups]:
+        """The ``(kept_rows, kept_entities, rescale)`` triple
+        ``_group_rows`` would produce over the full id column, or None when
+        this accumulator was capped for a DIFFERENT ``(active_cap, seed)``
+        (the capped selection is irrecoverable; caller falls back)."""
+        if self.active_cap is not None and (
+                active_cap != self.active_cap or seed != self.seed):
+            return None
+        kept_rows: List[np.ndarray] = []
+        kept_entities: List[int] = []
+        rescale: List[float] = []
+        for eid in sorted(self._counts):
+            total = self._counts[eid]
+            if total < min_active_samples and (
+                    existing_model_keys is None
+                    or eid in existing_model_keys):
+                continue
+            rows = self._rows[eid]
+            scale = 1.0
+            if active_cap is not None and total > active_cap:
+                if self.active_cap is None:
+                    keys = _splitmix64(rows.astype(np.uint64)
+                                       ^ np.uint64(seed))
+                    rows = rows[np.argsort(keys, kind="stable")[:active_cap]]
+                # same float operands as _group_rows' len(keys) / active_cap
+                scale = total / active_cap
+            kept_rows.append(np.sort(rows))
+            kept_entities.append(eid)
+            rescale.append(scale)
+        return kept_rows, kept_entities, rescale

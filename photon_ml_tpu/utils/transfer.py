@@ -65,6 +65,42 @@ def _update_at(out: jax.Array, part: jax.Array, lo: int,
     return _UPDATE(out, part, start)
 
 
+def stream_device_put(arr: np.ndarray, dtype=None) -> jax.Array:
+    """Non-blocking upload of one fixed-shape stream-feed batch.
+
+    The streaming data plane's upload primitive: unlike
+    ``chunked_device_put`` it never blocks (double-buffering wants the
+    transfer in flight while the decode pool fills the next batch) and never
+    chunks (feed batches are already bounded by ``batch_rows``).  Every
+    upload is probe-accounted under ``site="stream_feed"`` so the bench's
+    ingest-bytes axis and the photonscope byte counters agree.
+    """
+    arr = np.asarray(arr, dtype)
+    get_probe().record_transfer(arr.nbytes, "h2d", site="stream_feed")
+    with _trace.span("stream.upload", bytes=int(arr.nbytes)):
+        return jnp.asarray(arr)
+
+
+def stream_update(out: jax.Array, part: jax.Array, lo: int,
+                  rows: int) -> jax.Array:
+    """Donated write of a (possibly padded) stream batch at row ``lo``.
+
+    ``rows`` is the batch's VALID row count; pow2-padded batches are sliced
+    to it first because ``lax.dynamic_update_slice`` CLAMPS out-of-range
+    start indices — writing a padded tail block at a clamped start would
+    silently overwrite the rows before it.  The slice costs one extra
+    ``_UPDATE`` compile for the single tail shape; every full batch reuses
+    the one program (start index is traced).
+    """
+    if rows != part.shape[0]:
+        part = part[:rows]
+    # photonlint: disable=donation-after-use -- documented consuming
+    # contract: DeviceFeed owns ``out`` and immediately rebinds it
+    # (self._out[gid] = stream_update(self._out[gid], ...)); donating keeps
+    # the device peak at output + in-flight batches across the whole stream
+    return _update_at(out, part, lo, 0)
+
+
 def chunked_device_put(arr: np.ndarray, dtype=None,
                        chunk_bytes: int = 32 * 1024 * 1024) -> jax.Array:
     """``jnp.asarray(np.asarray(arr, dtype))`` with bounded transfer RPCs.

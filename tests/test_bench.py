@@ -495,3 +495,41 @@ class TestOnlineBenchCli:
         assert seen["batches"] == 3
         assert seen["batch_size"] == 16
         assert seen["out_path"] == "ignored.json"
+
+
+class TestStreamBenchCli:
+    """--stream arg plumbing: flags reach run_stream_bench parsed, and the
+    early dispatch prints the runner's JSON line."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"metric": "stream_ingest_mb_per_s"}
+
+        monkeypatch.setattr(bench, "run_stream_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--stream", "--stream-rows", "777",
+            "--stream-batch-rows", "256", "--stream-workers", "3",
+            "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["metric"] == "stream_ingest_mb_per_s"
+        assert seen["n_rows"] == 777
+        assert seen["batch_rows"] == 256
+        assert seen["workers"] == 3
+        assert seen["out_path"] == "ignored.json"
+
+    def test_defaults(self, monkeypatch, capsys):
+        seen = {}
+        monkeypatch.setattr(bench, "run_stream_bench",
+                            lambda **kw: seen.update(kw) or {})
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--stream"])
+        bench.main()
+        assert seen["n_rows"] == 50_000
+        assert seen["batch_rows"] == 1024
+        assert seen["workers"] == 2
+        assert seen["out_path"] is None
