@@ -1404,11 +1404,13 @@ RUNNERS = {
 }
 
 def _synthetic_serving_engine(rng, n_entities, d, max_batch,
-                              device_capacity=None):
+                              device_capacity=None, mesh_shards=0):
     """Build the serving benches' in-memory 2-coordinate GLMix engine
     (fixed + per-user effects, no training, no disk).  Consumes from
     ``rng`` in a fixed order, so callers seeding identically get identical
-    models.  Returns (engine, metrics, feature_names)."""
+    models.  ``mesh_shards`` > 0 shards the per-user table over the serving
+    mesh (device_capacity becomes the PER-SHARD hot-row budget).  Returns
+    (engine, metrics, feature_names)."""
     from photon_ml_tpu.data.index_map import IndexMap, feature_key
     from photon_ml_tpu.data.reader import EntityIndex
     from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
@@ -1439,7 +1441,8 @@ def _synthetic_serving_engine(rng, n_entities, d, max_batch,
     metrics = ServingMetrics()
     store = CoefficientStore.from_model(
         model, task, {"userId": eidx}, {"all": imap},
-        config=StoreConfig(device_capacity=device_capacity),
+        config=StoreConfig(device_capacity=device_capacity,
+                           mesh_shards=mesh_shards),
         version="synthetic", metrics=metrics)
     engine = ScoringEngine(store, BucketedBatcher(max_batch),
                            metrics=metrics)
@@ -1616,6 +1619,148 @@ def run_serving_bench(n_entities=20000, d=16, n_requests=2000, max_batch=64,
     if out_path is None:
         out_path = os.path.join(
             _REPO, f"BENCH_SERVING_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
+def run_serving_mesh_bench(shard_counts=(1, 2, 4, 8), n_entities=20000,
+                           d=16, n_requests=1000, max_batch=64,
+                           per_shard_capacity=None, seed=0, zipf=1.1,
+                           out_path=None):
+    """`bench.py --serving --mesh`: pod-slice serving sweep ->
+    BENCH_SERVING_MESH_<backend>.json.
+
+    For each shard count N, builds the synthetic engine with the per-user
+    table sharded over an N-device serving mesh at a FIXED per-shard
+    hot-row budget (default n_entities/10 rows — the regime where the hot
+    set matters), then measures against the unsharded baseline:
+      - correctness: max |score diff| vs the unsharded engine on one fixed
+        zipf request set (must sit at fp-reorder noise — the engine psums
+        per-shard partial margins, it never all-gathers coefficient rows);
+      - single-request p50/p99 latency (bucket 1) and closed-loop scoring
+        throughput — on the CPU host mesh the psum is a memcpy-loop, so
+        these show the ORCHESTRATION cost of sharding, not ICI reality;
+      - aggregate hot capacity (rows resident across the mesh) and the
+        hot-set hit rate it buys under the zipf trace — the capacity-
+        scaling story: fixed per-chip HBM budget, aggregate grows with N;
+      - zero-recompiles-after-warm, ASSERTED across traffic + a rebalance
+        pass + a streaming delta (the invariant sharding must not break).
+    Shard counts beyond the visible device count are dropped (with a
+    note in the output) rather than failed — laptops and 1-chip hosts
+    still produce a comparable file.
+    """
+    import jax
+
+    from photon_ml_tpu.serving.batcher import Request
+
+    if per_shard_capacity is None:
+        per_shard_capacity = max(64, n_entities // 10)
+    n_dev = len(jax.devices())
+    usable = [n for n in shard_counts if n <= n_dev]
+    dropped = [n for n in shard_counts if n > n_dev]
+
+    def mk_requests(rng, names, k):
+        w = (np.arange(n_entities) + 1.0) ** -zipf
+        p = w / w.sum()
+        ids = rng.choice(n_entities, size=k, p=p)
+        unknown = rng.random(k) < 0.05
+        reqs = []
+        for i in range(k):
+            u = n_entities + i if unknown[i] else int(ids[i])
+            feats = [{"name": n, "term": "", "value": float(v)}
+                     for n, v in zip(names, rng.normal(size=d))]
+            reqs.append(Request(uid=i, features=feats,
+                                ids={"userId": f"user{u}"}))
+        return reqs
+
+    # unsharded baseline at the SAME aggregate capacity as 1 shard, so the
+    # 1-shard row is a pure sharding-overhead read
+    rng = np.random.default_rng(seed)
+    base_engine, base_metrics, names = _synthetic_serving_engine(
+        rng, n_entities, d, max_batch, device_capacity=per_shard_capacity)
+    base_engine.warm()
+    parity_reqs = mk_requests(np.random.default_rng(seed + 1), names, 256)
+    base_scores = base_engine.score_requests(parity_reqs)
+
+    results = {}
+    for n_shards in usable:
+        rng = np.random.default_rng(seed)  # identical model every round
+        engine, metrics, _ = _synthetic_serving_engine(
+            rng, n_entities, d, max_batch,
+            device_capacity=per_shard_capacity, mesh_shards=n_shards)
+        store = engine.store
+        coord = store.coordinates["per_user"]
+        t0 = time.perf_counter()
+        n_compiled = engine.warm()
+        warm_s = time.perf_counter() - t0
+
+        scores = engine.score_requests(parity_reqs)
+        max_diff = float(np.abs(scores - base_scores).max())
+
+        req_rng = np.random.default_rng(seed + 2)
+        # single-request latency (bucket 1)
+        single = mk_requests(req_rng, names, 200)
+        engine.score_requests(single[:1])
+        lat = []
+        for r in single:
+            t = time.perf_counter()
+            engine.score_requests([r])
+            lat.append(time.perf_counter() - t)
+        lat = np.asarray(lat)
+
+        # closed-loop throughput with a mid-stream rebalance + delta — the
+        # mutations the zero-recompile assert must survive
+        stream = mk_requests(req_rng, names, n_requests)
+        before_hot = metrics.counter("hot_hits")
+        t0 = time.perf_counter()
+        half = n_requests // 2
+        for start in range(0, half, max_batch):
+            engine.score_requests(stream[start:start + max_batch])
+        store.rebalance()
+        store.apply_delta("per_user", "user0",
+                          req_rng.normal(size=d) * 0.1)
+        for start in range(half, n_requests, max_batch):
+            engine.score_requests(stream[start:start + max_batch])
+        stream_s = time.perf_counter() - t0
+        hot_hits = metrics.counter("hot_hits") - before_hot
+
+        compiles_after_warm = engine.compile_count - n_compiled
+        assert compiles_after_warm == 0, (
+            f"{n_shards}-shard serving recompiled {compiles_after_warm} "
+            "executable(s) after warm — the zero-recompile invariant broke")
+
+        results[str(n_shards)] = {
+            "aggregate_hot_rows": coord.hot_capacity,
+            "per_shard_rows": (coord.shard_spec.cap
+                               if coord.shard_spec else coord.hot_capacity),
+            "max_abs_diff_vs_unsharded": max_diff,
+            "p50_s": round(float(np.percentile(lat, 50)), 6),
+            "p99_s": round(float(np.percentile(lat, 99)), 6),
+            "qps": round(n_requests / stream_s, 1),
+            "hot_hit_rate": round(hot_hits / max(n_requests, 1), 4),
+            "warm_s": round(warm_s, 4),
+            "executables": n_compiled,
+            "compiles_after_warm": compiles_after_warm,
+        }
+
+    out = {
+        "metric": "serving_mesh_scaling",
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "n_entities": n_entities, "d": d,
+        "zipf": zipf,
+        "per_shard_capacity": per_shard_capacity,
+        "n_requests": n_requests,
+        "baseline_unsharded": {
+            "device_capacity": per_shard_capacity,
+        },
+        "shards": results,
+        "dropped_shard_counts": dropped,
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            _REPO, f"BENCH_SERVING_MESH_{jax.default_backend()}.json")
     with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
     return out
@@ -2430,6 +2575,15 @@ def main():
                          "frequency-ranked hot set")
     ap.add_argument("--serving-deadline-us", type=float, default=200.0,
                     help="with --serving: async batcher deadline")
+    ap.add_argument("--mesh", action="store_true",
+                    help="with --serving: pod-slice sweep — shard the "
+                         "coefficient store over 1/2/4/8 mesh shards, "
+                         "measure throughput/p99/aggregate-capacity vs "
+                         "shard count, assert zero recompiles after warm "
+                         "-> BENCH_SERVING_MESH_<backend>.json")
+    ap.add_argument("--mesh-shard-counts", default="1,2,4,8",
+                    help="with --serving --mesh: comma list of shard "
+                         "counts to sweep")
     ap.add_argument("--open-loop", action="store_true",
                     help="with --serving: open-loop (Poisson arrival-rate "
                          "driven) overload sweep against the network front "
@@ -2505,6 +2659,26 @@ def main():
             batch_size=a.online_batch_size,
             out_path=a.out)))
         return
+    if a.serving and a.mesh:
+        counts = tuple(int(c) for c in a.mesh_shard_counts.split(",")
+                       if c.strip())
+        # the sweep needs a multi-device view; on a CPU host that means
+        # virtual devices, and the flag must land before the backend
+        # initializes (it is inert on real accelerator platforms)
+        flag = f"--xla_force_host_platform_device_count={max(counts)}"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+        print(json.dumps(run_serving_mesh_bench(
+            shard_counts=counts,
+            n_entities=a.serving_entities,
+            n_requests=a.serving_requests,
+            per_shard_capacity=a.serving_device_capacity or None,
+            zipf=a.zipf or 1.1,
+            out_path=a.out)))
+        return
+    if a.mesh:
+        ap.error("--mesh requires --serving")
     if a.serving and a.open_loop:
         rates = [float(r) for r in a.open_loop_rates.split(",")
                  if r.strip()] or None

@@ -117,6 +117,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max entity rows device-resident per coordinate "
                         "(0 = all; colder entities serve from the host LRU "
                         "fallback and rebalancing promotes the hottest)")
+    p.add_argument("--mesh-shards", type=int, default=0,
+                   help="partition every random-effect coefficient table "
+                        "over this many devices (parallel/mesh.serving_mesh "
+                        "axis 'shard'); 0 = unsharded.  When set, "
+                        "--device-entity-capacity is the PER-SHARD hot-row "
+                        "budget, so aggregate hot capacity scales with the "
+                        "shard count")
     p.add_argument("--lru-capacity", type=int, default=4096,
                    help="host LRU entries per coordinate for cold entities")
     p.add_argument("--hot-set-interval", type=float, default=0.0,
@@ -202,6 +209,7 @@ def build_server(model_dir: str,
                  device_entity_capacity: Optional[int] = None,
                  lru_capacity: int = 4096,
                  hot_decay: float = 0.5,
+                 mesh_shards: int = 0,
                  metrics: Optional[ServingMetrics] = None,
                  warm: bool = True,
                  delta_log=None,
@@ -214,7 +222,8 @@ def build_server(model_dir: str,
     metrics = metrics or ServingMetrics()
     bundle = load_model_bundle(model_dir)
     config = StoreConfig(device_capacity=device_entity_capacity,
-                         lru_capacity=lru_capacity, hot_decay=hot_decay)
+                         lru_capacity=lru_capacity, hot_decay=hot_decay,
+                         mesh_shards=mesh_shards)
     store = CoefficientStore.from_bundle(bundle, config=config,
                                          version=model_dir, metrics=metrics)
     engine = ScoringEngine(store, BucketedBatcher(max_batch, bucket_sizes),
@@ -453,6 +462,7 @@ def run(argv: List[str]) -> int:
             device_entity_capacity=(args.device_entity_capacity or None),
             lru_capacity=args.lru_capacity,
             hot_decay=args.hot_decay,
+            mesh_shards=args.mesh_shards,
             warm=not args.no_warm,
             delta_log=delta_log,
             log_owner=False)

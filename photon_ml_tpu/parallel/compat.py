@@ -1,0 +1,19 @@
+"""JAX version-compat shims for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` export only in newer JAX releases; the
+pinned toolchain (0.4.x) still ships it under experimental.  Every
+photon-ml-tpu call site imports the symbol from HERE so the whole
+repo tracks the migration in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # JAX < 0.6: the experimental home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["shard_map"]

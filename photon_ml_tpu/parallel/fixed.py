@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from photon_ml_tpu.core.batch import Batch, DenseBatch, SparseBatch
+from photon_ml_tpu.parallel.compat import shard_map
 from photon_ml_tpu.core.objective import GLMObjective
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverConfig, SolverResult
@@ -81,7 +82,7 @@ class ShardMapObjective:
             # one psum call over the tuple = one pinned fused all-reduce
             return jax.lax.psum(obj.raw_value_and_grad(w, b), axis)
 
-        rv, gr, rs = jax.shard_map(
+        rv, gr, rs = shard_map(
             local, mesh=self.mesh, in_specs=(P(), self._specs(batch)),
             out_specs=(P(), P(), P()))(w, batch)
         return obj.finish_value_and_grad(w, rv, gr, rs)
@@ -92,7 +93,7 @@ class ShardMapObjective:
         def local(w, b, v):
             return jax.lax.psum(obj.raw_hvp(w, b, v), axis)
 
-        hv, qs = jax.shard_map(
+        hv, qs = shard_map(
             local, mesh=self.mesh, in_specs=(P(), self._specs(batch), P()),
             out_specs=(P(), P()))(w, batch, v)
         return obj.finish_hvp(v, hv, qs)
@@ -110,7 +111,7 @@ class ShardMapObjective:
         def local(w, b):
             return jax.lax.psum(obj.hessian_diag(w, b) - obj.reg.l2, axis)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=self.mesh, in_specs=(P(), self._specs(batch)),
             out_specs=P())(w, batch) + obj.reg.l2
 
@@ -122,7 +123,7 @@ class ShardMapObjective:
             eye = jnp.eye(d, dtype=w.dtype)
             return jax.lax.psum(obj.hessian(w, b) - obj.reg.l2 * eye, axis)
 
-        h = jax.shard_map(
+        h = shard_map(
             local, mesh=self.mesh, in_specs=(P(), self._specs(batch)),
             out_specs=P())(w, batch)
         return h + obj.reg.l2 * jnp.eye(d, dtype=h.dtype)
@@ -219,7 +220,7 @@ class ShardSparseObjective:
                     jax.lax.psum(self._scatter(vals, lid, r), data),
                     jax.lax.psum(jnp.sum(r), data))
 
-        rv, gr, rs = jax.shard_map(
+        rv, gr, rs = shard_map(
             local, mesh=self.mesh, in_specs=(P(feat), self._specs(batch)),
             out_specs=(P(), P(feat), P()))(eff, batch)
         return obj.finish_value_and_grad(w, rv, gr, rs)
@@ -237,7 +238,7 @@ class ShardSparseObjective:
             return (jax.lax.psum(self._scatter(vals, lid, q), data),
                     jax.lax.psum(jnp.sum(q), data))
 
-        hv, qs = jax.shard_map(
+        hv, qs = shard_map(
             local, mesh=self.mesh,
             in_specs=(P(feat), P(feat), self._specs(batch)),
             out_specs=(P(feat), P()))(eff_w, eff_v, batch)
@@ -253,7 +254,7 @@ class ShardSparseObjective:
         def local(blk, b):
             return self._local_margins(blk, b)[0]
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=self.mesh,
             in_specs=(P(self.feature_axis), self._specs(batch)),
             out_specs=P(self.data_axis))(w, batch)
@@ -268,7 +269,7 @@ class ShardSparseObjective:
             q = b.weight * obj.loss.d2(z, b.y)
             return jax.lax.psum(self._scatter(vals * vals, lid, q), data)
 
-        diag = jax.shard_map(
+        diag = shard_map(
             local, mesh=self.mesh, in_specs=(P(feat), self._specs(batch)),
             out_specs=P(feat))(eff, batch)
         if obj.norm.factors is not None:

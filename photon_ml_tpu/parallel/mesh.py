@@ -40,6 +40,7 @@ from photon_ml_tpu.core.batch import Batch, DenseBatch, SparseBatch
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
 FEATURE_AXIS = "feature"
+SHARD_AXIS = "shard"  # serving-side coefficient-table entity partition
 
 
 def make_mesh(n_data: Optional[int] = None, n_entity: int = 1, n_feature: int = 1,
@@ -60,6 +61,24 @@ def make_mesh(n_data: Optional[int] = None, n_entity: int = 1, n_feature: int = 
             f"mesh {n_data}x{n_entity}x{n_feature} needs {need} devices, have {len(devices)}")
     arr = np.asarray(devices[:need]).reshape(n_data, n_entity, n_feature)
     return Mesh(arr, (DATA_AXIS, ENTITY_AXIS, FEATURE_AXIS))
+
+
+def serving_mesh(n_shards: int,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-axis ``(shard,)`` mesh over the first ``n_shards`` devices — the
+    serving-side coefficient-table partition (serving/coefficient_store.py
+    slices each random-effect table's entity axis over it; the engine's AOT
+    kernels psum shard-local margins across it).  Kept separate from
+    ``make_mesh``'s training axes: serving never shards data or features,
+    only the entity rows of the hot tables."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards < 1:
+        raise ValueError(f"serving mesh needs n_shards >= 1, got {n_shards}")
+    if n_shards > len(devices):
+        raise ValueError(
+            f"serving mesh over {n_shards} shards needs {n_shards} devices, "
+            f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_shards]), (SHARD_AXIS,))
 
 
 def replicate(mesh: Mesh) -> NamedSharding:

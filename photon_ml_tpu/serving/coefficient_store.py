@@ -50,6 +50,7 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.data.reader import EntityIndex
@@ -57,6 +58,7 @@ from photon_ml_tpu.models.game import (CompactRandomEffectModel,
                                        FixedEffectModel, GameModel,
                                        RandomEffectModel)
 from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.parallel.mesh import SHARD_AXIS, serving_mesh
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.types import TaskType
 
@@ -119,7 +121,15 @@ class StoreConfig:
     bounding the ranked candidate set at millions of entities.
     ``x_dtype``: request feature dtype (float32, matching data/reader's
     default design dtype — part of the bitwise-parity contract with batch
-    scoring)."""
+    scoring).
+    ``mesh_shards``: partition every random-effect table's entity axis
+    over the first ``mesh_shards`` devices (``parallel/mesh.serving_mesh``,
+    axis ``shard``).  0 = unsharded (the single-device layout).  When
+    sharded, ``device_capacity`` is the hot-row budget PER SHARD — one
+    chip's HBM share — so aggregate hot capacity scales with the mesh
+    (``mesh_shards * device_capacity`` rows per coordinate), which is the
+    entire point of pod-slice serving.  A 1-shard mesh serves bitwise the
+    unsharded scores.  ``hot_max_moves`` applies per shard per pass."""
 
     device_capacity: Optional[int] = None
     lru_capacity: int = 4096
@@ -127,6 +137,34 @@ class StoreConfig:
     hot_max_moves: Optional[int] = None
     hot_tracked_max: Optional[int] = None
     x_dtype: np.dtype = np.float32
+    mesh_shards: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """One coordinate's entity-axis partition over the serving mesh.
+
+    The device table is ONE logical array of ``n_shards * cap`` rows whose
+    leading axis is laid out ``NamedSharding(mesh, P(SHARD_AXIS))`` — shard
+    ``s`` physically owns global rows ``[s*cap, (s+1)*cap)``.  Entities are
+    routed round-robin by archive slot (``archive_slot % n_shards``), which
+    balances shard population to within one row and makes the 1-shard case
+    collapse to exactly the unsharded layout.  ``slot_of`` values stay
+    GLOBAL rows, so ``resolve`` and every snapshot/scatter path are layout-
+    agnostic; only the engine's kernel decomposes slot -> (shard, local
+    row), and rebalance ranks residency within each shard's own rows."""
+
+    mesh: Mesh
+    n_shards: int
+    cap: int  # hot rows per shard (>= 0; 0 = all-cold coordinate)
+
+    @property
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(SHARD_AXIS))
+
+    def shard_of_archive_slot(self, archive_slots):
+        """Which shard serves an entity, from its archive slot (vectorized)."""
+        return archive_slots % self.n_shards
 
 
 class ColdEntityCache:
@@ -238,10 +276,13 @@ class RandomCoordinate:
                  metrics: Optional[ServingMetrics] = None,
                  decay: float = 0.5,
                  max_moves: Optional[int] = None,
-                 tracked_max: Optional[int] = None):
+                 tracked_max: Optional[int] = None,
+                 shard_spec: Optional[ShardSpec] = None):
         self.cid = cid
         self.feature_shard = feature_shard
         self.random_effect_type = random_effect_type
+        self.shard_spec = shard_spec
+        self._metrics = metrics
         self._bind_archive(archive)
         self.archive_slot_of = archive_slot_of  # entity id -> archive row
         self.hot_capacity = int(hot_capacity)
@@ -261,22 +302,48 @@ class RandomCoordinate:
             # score_samples clamps missing slots to row 0, which must exist
             # to gather from — an all-cold coordinate serves a zero row
             slot_of: Dict[int, int] = {}
-        else:
+        elif shard_spec is None:
             slot_of = {eid: s for eid, s in archive_slot_of.items()
                        if s < self.hot_capacity}
+        else:
+            # round-robin routing: archive slot a lives on shard a % N at
+            # initial local row a // N — for N=1 this is exactly the
+            # unsharded first-capacity residency, row for row
+            n, cap = shard_spec.n_shards, shard_spec.cap
+            slot_of = {eid: (s % n) * cap + s // n
+                       for eid, s in archive_slot_of.items() if s // n < cap}
         self._hot = self._initial_hot(slot_of)
         self.cold = ColdEntityCache(self._fetch_cold, lru_capacity, metrics)
+        self._update_shard_gauges()
 
     # -- row-representation hooks (overridden by CompactRandomCoordinate) --
     def _bind_archive(self, archive: np.ndarray) -> None:
         self._archive = archive              # [n_ent, d] host rows
         self.num_entities, self.dim = archive.shape
 
+    def _device_rows(self) -> int:
+        """Rows of the device table: total hot capacity, or the guaranteed
+        gather row — one per shard when sharded, one overall when not."""
+        if self.shard_spec is not None:
+            return max(self.hot_capacity, self.shard_spec.n_shards)
+        return max(self.hot_capacity, 1)
+
+    def _place(self, host_table: np.ndarray) -> Array:
+        """Host table -> device, laid out over the serving mesh's shard
+        axis when this coordinate is sharded."""
+        if self.shard_spec is None:
+            return jnp.asarray(host_table)
+        return jax.device_put(jnp.asarray(host_table),
+                              self.shard_spec.sharding)
+
     def _initial_hot(self, slot_of: Dict[int, int]) -> HotSet:
-        if self.hot_capacity < 1:
-            return HotSet(jnp.zeros((1, self.dim), self._archive.dtype), {})
-        return HotSet(jnp.asarray(self._archive[: self.hot_capacity]),
-                      slot_of)
+        rows = self._device_rows()
+        table = np.zeros((rows, self.dim), self._archive.dtype)
+        if slot_of:
+            dev = np.fromiter(slot_of.values(), np.int64, len(slot_of))
+            table[dev] = self._archive[self._slot_arr[
+                np.fromiter(slot_of.keys(), np.int64, len(slot_of))]]
+        return HotSet(self._place(table), slot_of)
 
     def _archive_rows(self, slots: np.ndarray):
         """Archive rows (whatever the representation) for a slot vector."""
@@ -287,7 +354,18 @@ class RandomCoordinate:
         """New snapshot with ``payload`` scattered at ``dev_rows`` — ONE
         ``.at[rows].set`` launch per device array, shape unchanged."""
         rows = jnp.asarray(dev_rows, jnp.int32)
-        return HotSet(hot.table.at[rows].set(jnp.asarray(payload)), slot_of)
+        return HotSet(self._repin(hot.table.at[rows].set(
+            jnp.asarray(payload))), slot_of)
+
+    def _repin(self, table: Array) -> Array:
+        """Keep the shard layout pinned across eager scatters.  XLA
+        preserves the operand sharding for ``.at[rows].set`` today; the
+        re-pin (a no-copy device_put when nothing changed) makes the AOT
+        executables' layout contract independent of that inference."""
+        if self.shard_spec is not None \
+                and table.sharding != self.shard_spec.sharding:
+            table = jax.device_put(table, self.shard_spec.sharding)
+        return table
 
     def _delta_payload(self, row: np.ndarray):
         """Validate/convert one streaming-delta row into archive form."""
@@ -390,26 +468,27 @@ class RandomCoordinate:
             current = self._hot.slot_of
             cur = np.fromiter(current.keys(), np.int64, len(current))
             cand = np.union1d(np.nonzero(freq)[0].astype(np.int64), cur)
-            f = freq[cand]
-            incumbent = np.isin(cand, cur, assume_unique=True)
-            slots = self._slot_arr[cand]
-            # lexsort: last key is primary — (-freq, incumbent-first, slot),
-            # the SAME composite key the dict-era sorted() used, so hot sets
-            # stay reproducible for a fixed trace
-            ranked = cand[np.lexsort((slots, np.where(incumbent, 0, 1), -f))]
-            desired = ranked[: self.hot_capacity]
-            promote = desired[~np.isin(desired, cur, assume_unique=True)]
-            if promote.size == 0:
+            if self.shard_spec is None:
+                promote, demote = self._rank_moves(cand, cur,
+                                                   self.hot_capacity, freq)
+            else:
+                # residency is ranked WITHIN each shard: an entity can only
+                # occupy rows of the shard its archive slot routes to, so
+                # every promotion scatters into a row the same shard
+                # vacates — no row ever crosses the shard boundary and no
+                # shard's table block changes shape
+                spec = self.shard_spec
+                cand_sh = spec.shard_of_archive_slot(self._slot_arr[cand])
+                cur_sh = spec.shard_of_archive_slot(self._slot_arr[cur])
+                promote, demote = [], []
+                for sid in range(spec.n_shards):
+                    p, d = self._rank_moves(cand[cand_sh == sid],
+                                            cur[cur_sh == sid],
+                                            spec.cap, freq)
+                    promote += p
+                    demote += d
+            if not promote:
                 return 0, 0
-            # coldest incumbents vacate first; deterministic tiebreak again
-            # (freq ascending, then archive slot DEscending)
-            dem = cur[~np.isin(cur, desired, assume_unique=True)]
-            demote = dem[np.lexsort((-self._slot_arr[dem], freq[dem]))]
-            if self.max_moves is not None:
-                promote = promote[: self.max_moves]
-                demote = demote[: promote.size]
-            promote = [int(e) for e in promote]
-            demote = [int(e) for e in demote]
             rows = [current[e] for e in demote]
             new_rows = self._archive_rows(self._slot_arr[promote])
             slot_of = dict(current)
@@ -418,9 +497,46 @@ class RandomCoordinate:
             for e, r in zip(promote, rows):
                 slot_of[e] = r
             self._hot = self._scatter_rows(self._hot, rows, new_rows, slot_of)
+        self._update_shard_gauges()
         for e in promote:  # device copy supersedes any LRU copy
             self.cold.invalidate(e)
         return len(promote), len(demote)
+
+    def _rank_moves(self, cand: np.ndarray, cur: np.ndarray, capacity: int,
+                    freq: np.ndarray) -> Tuple[List[int], List[int]]:
+        """Rank one residency domain (the whole table, or one shard's rows)
+        and return (promote, demote) entity lists — always equal length."""
+        f = freq[cand]
+        incumbent = np.isin(cand, cur, assume_unique=True)
+        slots = self._slot_arr[cand]
+        # lexsort: last key is primary — (-freq, incumbent-first, slot),
+        # the SAME composite key the dict-era sorted() used, so hot sets
+        # stay reproducible for a fixed trace
+        ranked = cand[np.lexsort((slots, np.where(incumbent, 0, 1), -f))]
+        desired = ranked[:capacity]
+        promote = desired[~np.isin(desired, cur, assume_unique=True)]
+        if promote.size == 0:
+            return [], []
+        # coldest incumbents vacate first; deterministic tiebreak again
+        # (freq ascending, then archive slot DEscending)
+        dem = cur[~np.isin(cur, desired, assume_unique=True)]
+        demote = dem[np.lexsort((-self._slot_arr[dem], freq[dem]))]
+        if self.max_moves is not None:
+            promote = promote[: self.max_moves]
+            demote = demote[: promote.size]
+        return [int(e) for e in promote], [int(e) for e in demote]
+
+    def _update_shard_gauges(self) -> None:
+        """Per-shard occupancy gauges (sharded coordinates only)."""
+        spec = self.shard_spec
+        if spec is None or self._metrics is None or spec.cap < 1:
+            return
+        occ = np.zeros(spec.n_shards, np.int64)
+        for row in self._hot.slot_of.values():
+            occ[row // spec.cap] += 1
+        for sid in range(spec.n_shards):
+            self._metrics.set_shard_occupancy(self.cid, sid,
+                                              occ[sid] / spec.cap)
 
     def dense_row(self, eid: int) -> Optional[np.ndarray]:
         """One entity's CURRENT coefficient row as a dense ``[dim]`` copy —
@@ -493,13 +609,14 @@ class CompactRandomCoordinate(RandomCoordinate):
                  metrics: Optional[ServingMetrics] = None,
                  decay: float = 0.5,
                  max_moves: Optional[int] = None,
-                 tracked_max: Optional[int] = None):
+                 tracked_max: Optional[int] = None,
+                 shard_spec: Optional[ShardSpec] = None):
         self._full_dim = int(dim)
         super().__init__(cid, feature_shard, random_effect_type,
                          (archive_indices, archive_values), archive_slot_of,
                          hot_capacity, lru_capacity, metrics=metrics,
                          decay=decay, max_moves=max_moves,
-                         tracked_max=tracked_max)
+                         tracked_max=tracked_max, shard_spec=shard_spec)
 
     # -- row-representation hooks -----------------------------------------
     def _bind_archive(self, archive) -> None:
@@ -514,14 +631,18 @@ class CompactRandomCoordinate(RandomCoordinate):
         self.dim = self._full_dim  # full vocabulary width (shard contract)
 
     def _initial_hot(self, slot_of: Dict[int, int]) -> CompactHotSet:
-        if self.hot_capacity < 1:
-            # row 0 must exist to gather from; all-dim indices are inert
-            return CompactHotSet(
-                jnp.full((1, self.k), self.dim, jnp.int32),
-                jnp.zeros((1, self.k), self._archive_val.dtype), {})
-        return CompactHotSet(
-            jnp.asarray(self._archive_idx[: self.hot_capacity]),
-            jnp.asarray(self._archive_val[: self.hot_capacity]), slot_of)
+        # unpopulated rows carry all-``dim`` indices — inert to the compact
+        # gather, so padding and the all-cold guaranteed row score 0
+        rows = self._device_rows()
+        idx = np.full((rows, self.k), self.dim, np.int32)
+        val = np.zeros((rows, self.k), self._archive_val.dtype)
+        if slot_of:
+            dev = np.fromiter(slot_of.values(), np.int64, len(slot_of))
+            src = self._slot_arr[
+                np.fromiter(slot_of.keys(), np.int64, len(slot_of))]
+            idx[dev] = self._archive_idx[src]
+            val[dev] = self._archive_val[src]
+        return CompactHotSet(self._place(idx), self._place(val), slot_of)
 
     def _archive_rows(self, slots: np.ndarray):
         return self._archive_idx[slots], self._archive_val[slots]
@@ -532,9 +653,10 @@ class CompactRandomCoordinate(RandomCoordinate):
         rows = jnp.asarray(dev_rows, jnp.int32)
         # two scatters, ONE snapshot swap — readers hold the triple and can
         # never pair new values with old column ids
-        return CompactHotSet(hot.indices.at[rows].set(jnp.asarray(idx)),
-                             hot.values.at[rows].set(jnp.asarray(val)),
-                             slot_of)
+        return CompactHotSet(
+            self._repin(hot.indices.at[rows].set(jnp.asarray(idx))),
+            self._repin(hot.values.at[rows].set(jnp.asarray(val))),
+            slot_of)
 
     def _delta_payload(self, row: np.ndarray):
         row = np.asarray(row, dtype=self._archive_val.dtype)
@@ -589,7 +711,8 @@ class CoefficientStore:
                  shard_dims: Dict[str, int],
                  config: StoreConfig,
                  version: str = "",
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 mesh: Optional[Mesh] = None):
         self.task = task
         self.coordinates = coordinates
         self.order: List[str] = list(coordinates)  # additive-score order
@@ -599,6 +722,7 @@ class CoefficientStore:
         self.config = config
         self.version = version
         self.metrics = metrics
+        self.mesh = mesh  # serving mesh when config.mesh_shards > 0
         self.generation = next(_generation)
 
     # -- construction ------------------------------------------------------
@@ -627,6 +751,27 @@ class CoefficientStore:
         config = config or StoreConfig()
         coordinates: Dict[str, Union[FixedCoordinate, RandomCoordinate]] = {}
         shard_dims: Dict[str, int] = {}
+        mesh = (serving_mesh(config.mesh_shards)
+                if config.mesh_shards > 0 else None)
+
+        def _residency(n_ent: int) -> Tuple[int, Optional[ShardSpec]]:
+            """(hot_capacity, shard_spec) under the config's policy.
+
+            Sharded, ``device_capacity`` is the per-shard row budget, so the
+            table carries ``cap * n_shards`` rows — aggregate hot capacity
+            scales with the mesh.  ``cap`` is clamped to ceil(n_ent /
+            n_shards): with round-robin routing that is the largest
+            population any shard can hold, so a bigger cap would only pin
+            dead rows."""
+            if mesh is None:
+                hot = n_ent if config.device_capacity is None else min(
+                    config.device_capacity, n_ent)
+                return hot, None
+            n = config.mesh_shards
+            per = -(-n_ent // n)
+            cap = per if config.device_capacity is None else min(
+                config.device_capacity, per)
+            return cap * n, ShardSpec(mesh=mesh, n_shards=n, cap=cap)
 
         def _shard_dim(shard: str, d: int, cid: str) -> None:
             have = shard_dims.setdefault(shard, d)
@@ -646,8 +791,7 @@ class CoefficientStore:
                 w_stack = np.asarray(m.w_stack)
                 n_ent, d = w_stack.shape
                 _shard_dim(m.feature_shard, d, cid)
-                hot = n_ent if config.device_capacity is None else min(
-                    config.device_capacity, n_ent)
+                hot, spec = _residency(n_ent)
                 coordinates[cid] = RandomCoordinate(
                     cid=cid, feature_shard=m.feature_shard,
                     random_effect_type=m.random_effect_type,
@@ -658,7 +802,8 @@ class CoefficientStore:
                     metrics=metrics,
                     decay=config.hot_decay,
                     max_moves=config.hot_max_moves,
-                    tracked_max=config.hot_tracked_max)
+                    tracked_max=config.hot_tracked_max,
+                    shard_spec=spec)
             elif isinstance(m, CompactRandomEffectModel):
                 # wide-vocabulary sparse rows serve NATIVELY: the columnar
                 # (indices, values) pair goes device-resident as-is — no
@@ -666,8 +811,7 @@ class CoefficientStore:
                 idx = np.asarray(m.indices)
                 n_ent = idx.shape[0]
                 _shard_dim(m.feature_shard, m.dim, cid)
-                hot = n_ent if config.device_capacity is None else min(
-                    config.device_capacity, n_ent)
+                hot, spec = _residency(n_ent)
                 coordinates[cid] = CompactRandomCoordinate(
                     cid=cid, feature_shard=m.feature_shard,
                     random_effect_type=m.random_effect_type,
@@ -680,7 +824,8 @@ class CoefficientStore:
                     metrics=metrics,
                     decay=config.hot_decay,
                     max_moves=config.hot_max_moves,
-                    tracked_max=config.hot_tracked_max)
+                    tracked_max=config.hot_tracked_max,
+                    shard_spec=spec)
             else:
                 raise ValueError(
                     f"coordinate {cid!r}: serving supports FixedEffectModel, "
@@ -700,7 +845,7 @@ class CoefficientStore:
         return cls(task=task, coordinates=coordinates,
                    entity_indexes=entity_indexes, index_maps=index_maps,
                    shard_dims=shard_dims, config=config, version=version,
-                   metrics=metrics)
+                   metrics=metrics, mesh=mesh)
 
     # -- shape signature (compiled-executable cache key) -------------------
     def signature(self) -> Tuple:
@@ -723,7 +868,8 @@ class CoefficientStore:
                 parts.append(("random", cid, c.feature_shard,
                               c.table.shape, str(c.table.dtype)))
         return (tuple(parts), tuple(sorted(self.shard_dims.items())),
-                str(np.dtype(self.config.x_dtype)))
+                str(np.dtype(self.config.x_dtype)),
+                int(self.config.mesh_shards))
 
     # -- lookups -----------------------------------------------------------
     def entity_id(self, re_type: str, name: Optional[str]) -> int:
@@ -799,9 +945,36 @@ class CoefficientStore:
                     metrics.inc("entity_misses", misses)
                 if hot_hits:
                     metrics.inc("hot_hits", hot_hits)
+                if c.shard_spec is not None and hits:
+                    self._record_shard_stats(cid, c, hits, slots, metrics)
             if compact:
                 return hs, slots, (ov_idx, ov_val)
             return hs.table, slots, overflow
+
+    @staticmethod
+    def _record_shard_stats(cid: str, c: RandomCoordinate,
+                            hits: Dict[int, int], slots: np.ndarray,
+                            metrics: ServingMetrics) -> None:
+        """Per-shard lookup/hot-hit counters for one resolved batch.
+
+        Lookups route by archive slot (where the entity WOULD live); hot
+        hits decompose the resolved global device rows (shard-major layout:
+        shard = row // cap).  Together they give the per-shard hit rate the
+        obs gauges expose — the load-imbalance signal for a pod slice."""
+        spec = c.shard_spec
+        eids = np.fromiter(hits.keys(), np.int64, len(hits))
+        cnts = np.fromiter(hits.values(), np.int64, len(hits))
+        arch = c._slot_arr[eids]  # record_hits contract: eids are in range
+        ok = arch >= 0
+        lookups = np.bincount(spec.shard_of_archive_slot(arch[ok]),
+                              weights=cnts[ok].astype(np.float64),
+                              minlength=spec.n_shards)
+        hot_rows = slots[slots >= 0]
+        hot = np.bincount(hot_rows // max(spec.cap, 1),
+                          minlength=spec.n_shards)
+        for sid in range(spec.n_shards):
+            metrics.observe_shard_batch(cid, sid, int(lookups[sid]),
+                                        int(hot[sid]))
 
     # -- residency management ----------------------------------------------
     def rebalance(self) -> Dict[str, Tuple[int, int]]:

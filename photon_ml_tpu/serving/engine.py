@@ -120,21 +120,29 @@ class ScoringEngine:
             c = store.coordinates[cid]
             if isinstance(c, FixedCoordinate):
                 fixed_ws.append(s(c.weights.shape, c.weights.dtype))
-            elif isinstance(c, CompactRandomCoordinate):
+                continue
+            # sharded stores pin the hot tables' mesh layout into the AOT
+            # signature — lowering bakes the shard-local kernel in, and the
+            # executable rejects a mislaid table instead of silently
+            # gathering it
+            sh = None if c.shard_spec is None else c.shard_spec.sharding
+            if isinstance(c, CompactRandomCoordinate):
                 hs = c.hot
-                tables.append((s(hs.indices.shape, hs.indices.dtype),
-                               s(hs.values.shape, hs.values.dtype)))
+                tables.append(
+                    (s(hs.indices.shape, hs.indices.dtype, sharding=sh),
+                     s(hs.values.shape, hs.values.dtype, sharding=sh)))
                 slots.append(s((bucket,), np.dtype(np.int32)))
                 overflows.append((s((bucket, c.k), np.dtype(np.int32)),
                                   s((bucket, c.k), hs.values.dtype)))
             else:
-                tables.append(s(c.table.shape, c.table.dtype))
+                tables.append(s(c.table.shape, c.table.dtype, sharding=sh))
                 slots.append(s((bucket,), np.dtype(np.int32)))
                 overflows.append(s((bucket, c.dim), c.table.dtype))
         return xs, fixed_ws, tables, slots, overflows
 
     def _build_fn(self, store: CoefficientStore, bucket: int):
         order = list(store.order)
+        mesh = store.mesh
 
         def _kind(c):
             if isinstance(c, FixedCoordinate):
@@ -142,15 +150,62 @@ class ScoringEngine:
             return "compact" if isinstance(c, CompactRandomCoordinate) \
                 else "dense"
 
-        kinds = [(cid, _kind(store.coordinates[cid]),
-                  store.coordinates[cid].feature_shard) for cid in order]
+        # (cid, kind, feature shard, per-shard hot rows | None if unsharded)
+        kinds = []
+        for cid in order:
+            c = store.coordinates[cid]
+            local_rows = None
+            if getattr(c, "shard_spec", None) is not None:
+                rows = (c.hot.indices.shape[0]
+                        if isinstance(c, CompactRandomCoordinate)
+                        else c.table.shape[0])
+                local_rows = rows // c.shard_spec.n_shards
+            kinds.append((cid, _kind(c), c.feature_shard, local_rows))
+
+        if mesh is not None:
+            # pod-slice kernels: each shard scores ONLY the slots whose
+            # global device row lives in its table block, then the psum
+            # folds the per-shard partial margins — the [bucket] score
+            # vector is the only thing that crosses ICI; coefficient rows
+            # never leave their shard (no all-gather, by construction)
+            from jax.sharding import PartitionSpec as P
+
+            from photon_ml_tpu.parallel.compat import shard_map
+            from photon_ml_tpu.parallel.mesh import SHARD_AXIS
+
+            def _localize(s, cap):
+                # global row -> this shard's local row; -1 (scores 0.0 by
+                # the kernels' masking contract) for rows owned elsewhere
+                sid = jax.lax.axis_index(SHARD_AXIS)
+                loc = s - sid * cap
+                mine = (s >= 0) & (loc >= 0) & (loc < cap)
+                return jnp.where(mine, loc, -1)
+
+            def _sharded_dense(cap):
+                def local_fn(t, s, xx):
+                    return jax.lax.psum(
+                        score_samples(t, _localize(s, cap), xx), SHARD_AXIS)
+                return shard_map(local_fn, mesh=mesh,
+                                 in_specs=(P(SHARD_AXIS), P(), P()),
+                                 out_specs=P())
+
+            def _sharded_compact(cap):
+                def local_fn(ti, tv, s, xx):
+                    from photon_ml_tpu.models.game import score_compact_dense
+                    return jax.lax.psum(
+                        score_compact_dense(ti, tv, _localize(s, cap), xx),
+                        SHARD_AXIS)
+                return shard_map(
+                    local_fn, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+                    out_specs=P())
 
         def fn(xs, fixed_ws, tables, slots, overflows):
             from photon_ml_tpu.models.game import score_compact_dense
 
             margins = []
             fi = ri = 0
-            for cid, kind, shard in kinds:
+            for cid, kind, shard, local_rows in kinds:
                 x = xs[shard]
                 if kind == "fixed":
                     # == models/glm.Coefficients.score (x @ means)
@@ -164,13 +219,23 @@ class ScoringEngine:
                     # padded hot/unknown rows contribute exactly 0.0)
                     t_idx, t_val = tables[ri]
                     o_idx, o_val = overflows[ri]
-                    m = score_compact_dense(t_idx, t_val, slots[ri], x)
+                    if local_rows is None:
+                        m = score_compact_dense(t_idx, t_val, slots[ri], x)
+                    else:
+                        m = _sharded_compact(local_rows)(
+                            t_idx, t_val, slots[ri], x)
+                    # cold rows are host-gathered per sample and replicated;
+                    # they stay outside the shard_map
                     cold = score_compact_dense(
                         o_idx, o_val, jnp.arange(bucket, dtype=jnp.int32), x)
                     margins.append(m + cold)
                     ri += 1
                 else:
-                    m = score_samples(tables[ri], slots[ri], x)
+                    if local_rows is None:
+                        m = score_samples(tables[ri], slots[ri], x)
+                    else:
+                        m = _sharded_dense(local_rows)(
+                            tables[ri], slots[ri], x)
                     margins.append(m + _cold_margin(x, overflows[ri]))
                     ri += 1
             # the ONE additive composition (game/scoring.py) — shared with
@@ -257,6 +322,11 @@ class ScoringEngine:
                     tables.append(tbl)
                 slots.append(sl)
                 overflows.append(ov)
+        if store.mesh is not None:
+            # the executable's only cross-shard traffic is the margin psum
+            with obs_span("serve.psum", shards=store.config.mesh_shards,
+                          bucket=bucket):
+                return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))
         return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))
 
     # -- async front -------------------------------------------------------

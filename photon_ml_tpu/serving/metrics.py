@@ -46,6 +46,12 @@ _BUCKET_CAP = "serving_bucket_rows_capacity"
 _BATCHES_BY_BUCKET = "serving_batches_total"
 _LATENCY = "serving_latency_s"
 _PHASE = "serving_phase_seconds"
+# pod-slice serving: per-(coordinate, shard) residency + traffic families.
+# All labeled, so they ride the Prometheus export and stay OUT of the
+# snapshot()'s byte-compatible ``counters`` view automatically.
+_SHARD_LOOKUPS = "serving_shard_lookups_total"
+_SHARD_HOT = "serving_shard_hot_hits_total"
+_SHARD_OCCUPANCY = "serving_shard_occupancy"
 _RESERVED = {_PADDED, _REAL}
 
 
@@ -83,6 +89,50 @@ class ServingMetrics:
     def phase(self, label: str, seconds: float) -> None:
         """``utils/logging.Timed`` sink: cumulative wall time per phase."""
         self.registry.add_gauge(_PHASE, seconds, phase=label)
+
+    def observe_shard_batch(self, cid: str, shard: int, lookups: int,
+                            hot_hits: int) -> None:
+        """One resolved batch's traffic attributed to one mesh shard:
+        ``lookups`` entity lookups routed to it (by archive-slot routing),
+        ``hot_hits`` of them served from its device rows.  The per-shard
+        hit rate these two imply is the pod-slice load-imbalance signal."""
+        if lookups:
+            self.registry.inc(_SHARD_LOOKUPS, lookups,
+                              coordinate=cid, shard=str(shard))
+        if hot_hits:
+            self.registry.inc(_SHARD_HOT, hot_hits,
+                              coordinate=cid, shard=str(shard))
+
+    def set_shard_occupancy(self, cid: str, shard: int, frac: float) -> None:
+        """Fraction of one shard's hot-row budget currently resident."""
+        self.registry.set_gauge(_SHARD_OCCUPANCY, float(frac),
+                                coordinate=cid, shard=str(shard))
+
+    def shard_view(self) -> dict:
+        """Per-(coordinate, shard) residency/traffic summary — a SEPARATE
+        view; ``snapshot()``'s key set is a compatibility contract and does
+        not grow.  Returns ``{cid: {shard: {lookups, hot_hits, hit_rate,
+        occupancy}}}``."""
+        r = self.registry
+        out: dict = {}
+
+        def _cell(lk):
+            d = dict(lk)
+            return out.setdefault(d["coordinate"], {}).setdefault(
+                int(d["shard"]), {"lookups": 0, "hot_hits": 0,
+                                  "hit_rate": 0.0, "occupancy": 0.0})
+
+        for lk, v in r.counter_series(_SHARD_LOOKUPS).items():
+            _cell(lk)["lookups"] = int(v)
+        for lk, v in r.counter_series(_SHARD_HOT).items():
+            _cell(lk)["hot_hits"] = int(v)
+        for lk, v in r.gauge_series(_SHARD_OCCUPANCY).items():
+            _cell(lk)["occupancy"] = float(v)
+        for shards in out.values():
+            for cell in shards.values():
+                if cell["lookups"]:
+                    cell["hit_rate"] = cell["hot_hits"] / cell["lookups"]
+        return out
 
     # -- views -------------------------------------------------------------
     def counter(self, name: str) -> int:

@@ -33,6 +33,82 @@ def devices():
     return devs
 
 
+_MULTIPROCESS_VERDICT = None  # session memo: (supported: bool, reason: str)
+
+_MULTIPROCESS_PROBE = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port = int(sys.argv[1]), sys.argv[2]
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+multihost_utils.process_allgather(jnp.ones(1))  # first cross-process op
+"""
+
+
+def multiprocess_backend_supported():
+    """Probe (once per session) whether the backend can run CROSS-PROCESS
+    computations: two jax.distributed subprocesses attempt one collective.
+    ``jax.distributed.initialize`` itself succeeds everywhere — the CPU
+    backend only fails at the first multi-process computation
+    ("Multiprocess computations aren't implemented on the CPU backend"),
+    so the probe must execute a collective, not just form the cluster.
+    Returns ``(supported, reason)``."""
+    global _MULTIPROCESS_VERDICT
+    if _MULTIPROCESS_VERDICT is not None:
+        return _MULTIPROCESS_VERDICT
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    with tempfile.NamedTemporaryFile("w", suffix="_mp_probe.py",
+                                     delete=False) as f:
+        f.write(_MULTIPROCESS_PROBE)
+        probe = f.name
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, probe, str(pid), port], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+            for pid in range(2)]
+        try:
+            errs = [p.communicate(timeout=120)[1] for p in procs]
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+                p.communicate()
+            _MULTIPROCESS_VERDICT = (False, "multi-process probe timed out")
+            return _MULTIPROCESS_VERDICT
+        if all(p.returncode == 0 for p in procs):
+            _MULTIPROCESS_VERDICT = (True, "")
+        else:
+            tail = next((e for p, e in zip(procs, errs) if p.returncode),
+                        "").strip().splitlines()
+            _MULTIPROCESS_VERDICT = (
+                False, tail[-1] if tail else "probe worker failed")
+        return _MULTIPROCESS_VERDICT
+    finally:
+        os.unlink(probe)
+
+
+def require_multiprocess_backend():
+    """Skip the calling test when the runtime cannot execute true
+    multi-process computations (e.g. the CPU backend, which forms the
+    jax.distributed cluster but rejects every cross-process op)."""
+    supported, reason = multiprocess_backend_supported()
+    if not supported:
+        pytest.skip("multi-process computations unavailable on this "
+                    f"backend: {reason}")
+
+
 @pytest.fixture()
 def rng():
     import numpy as np

@@ -473,6 +473,54 @@ class TestOpenLoopPlumbing:
         assert ei.value.code == 2  # argparse error exit
 
 
+class TestServingMeshPlumbing:
+    """--serving --mesh arg plumbing: flags reach run_serving_mesh_bench
+    parsed, and --mesh alone is rejected."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"metric": "serving_mesh_scaling", "shards": {}}
+
+        monkeypatch.setattr(bench, "run_serving_mesh_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--serving", "--mesh",
+            "--mesh-shard-counts", "1,2,4",
+            "--serving-entities", "456",
+            "--serving-requests", "77",
+            "--serving-device-capacity", "32",
+            "--zipf", "1.4",
+            "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["metric"] == "serving_mesh_scaling"
+        assert seen["shard_counts"] == (1, 2, 4)
+        assert seen["n_entities"] == 456
+        assert seen["n_requests"] == 77
+        assert seen["per_shard_capacity"] == 32
+        assert seen["zipf"] == 1.4
+        assert seen["out_path"] == "ignored.json"
+
+    def test_mesh_requires_serving(self, monkeypatch):
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--mesh"])
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == 2  # argparse error exit
+
+    def test_unset_capacity_and_zipf_get_defaults(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(bench, "run_serving_mesh_bench",
+                            lambda **kw: seen.update(kw) or {})
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--serving", "--mesh"])
+        bench.main()
+        assert seen["per_shard_capacity"] is None  # runner derives n/10
+        assert seen["zipf"] == 1.1  # mesh sweep is always skewed
+
+
 class TestOnlineBenchCli:
     """--online arg plumbing: flags reach run_online_bench parsed."""
 

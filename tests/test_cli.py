@@ -999,7 +999,9 @@ def _run_multihost_train(data_path, output_dir, *, max_iter=80, extra=()):
     import sys
 
     import photon_ml_tpu
+    from conftest import require_multiprocess_backend
 
+    require_multiprocess_backend()
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=2")
     env.pop("PYTEST_CURRENT_TEST", None)

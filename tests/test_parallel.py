@@ -692,10 +692,16 @@ def _free_port() -> int:
 def _launch_workers(worker, nproc, tmp_path, local_devices=2, timeout=420):
     """Run ``worker`` as nproc jax.distributed processes (argv: pid nproc
     tmp_path) and assert they all exit 0 — the ONE definition of the
-    multi-process launch contract (env, device count, failure reporting)."""
+    multi-process launch contract (env, device count, failure reporting).
+    Skips (not fails) on backends that cannot execute cross-process
+    computations at all (conftest capability probe)."""
     import os
     import subprocess
     import sys
+
+    from conftest import require_multiprocess_backend
+
+    require_multiprocess_backend()
 
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS=f"--xla_force_host_platform_device_count={local_devices}")
@@ -1191,6 +1197,36 @@ class TestMultihostGuards:
         obj = GLMObjective(loss=losses.logistic_loss, reg=Regularization(l2=1))
         with pytest.raises(ValueError, match="num_samples"):
             multihost_glmix_sweep(mesh, dense_batch(x, y), gb, obj, obj)
+
+    def test_single_process_allgather_has_no_process_axis(self, devices,
+                                                          rng):
+        """Regression: with one process, ``process_allgather`` returns the
+        INPUT shape unchanged — no leading process axis is prepended.  The
+        agreement pass in ``global_entity_buckets`` used to index
+        ``all_vec[:, log, 0]`` as if the axis were always there and died
+        with ``IndexError: too many indices for array``; it must reshape
+        to ``[n_proc, ...]`` first."""
+        from jax.experimental import multihost_utils
+
+        from photon_ml_tpu.parallel.bucketing import bucket_by_entity
+        from photon_ml_tpu.parallel.multihost import global_entity_buckets
+
+        # pin the offending shape: the (MAXLOG, 2) metadata vector comes
+        # back (33, 2), NOT (1, 33, 2)
+        vec = np.zeros((33, 2), np.int64)
+        out = np.asarray(multihost_utils.process_allgather(vec))
+        assert out.shape == vec.shape
+
+        # and the end-to-end single-process assembly works on top of it
+        mesh = self._mesh(devices)
+        n = 16
+        uids = np.repeat(np.arange(4), 4)
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        gb = global_entity_buckets(
+            bucket_by_entity(uids, x, y, row_ids=np.arange(n),
+                             num_samples=n), mesh)
+        assert gb.num_entities == 4
 
     def test_unknown_re_scoring_key_fails(self, devices, rng):
         from photon_ml_tpu.core import GLMObjective, Regularization, losses

@@ -1,0 +1,362 @@
+"""Pod-slice serving tests: mesh-sharded coefficient store + shard-local
+scoring (photon_ml_tpu/serving/* with StoreConfig.mesh_shards > 0).
+
+The contract under test, in order of importance:
+  1. a 1-shard mesh serves BITWISE the unsharded scores (the layout
+     collapse that makes sharding a pure deployment knob);
+  2. an N-shard mesh matches the unsharded scores to fp tolerance under
+     realistic (zipf) traffic — the psum reorders one addition, nothing
+     else, and on these sizes the reduction is exact;
+  3. rebalance, streaming deltas and hot swap all preserve that parity AND
+     the zero-recompiles-after-warm invariant (no table shape or layout
+     ever changes within a generation);
+  4. residency is shard-local: rows never cross the shard boundary, and
+     aggregate hot capacity scales linearly with the shard count under a
+     fixed per-shard budget — the capacity story pod-slice serving exists
+     for.
+
+Runs on the 8 virtual CPU devices conftest forces; every mesh here is a
+host-local device mesh (the sharding/collective machinery is identical on
+a real pod slice, minus ICI).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.models.game import (CompactRandomEffectModel,
+                                       FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.parallel.mesh import SHARD_AXIS, serving_mesh
+from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     ShardSpec, StoreConfig)
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.swap import HotSwapper
+from photon_ml_tpu.types import TaskType
+
+N_ENTITIES = 100
+DIM = 6
+FEATURES = [f"f{j}" for j in range(DIM)]
+
+
+def _index_map():
+    return IndexMap({feature_key(n): j for j, n in enumerate(FEATURES)})
+
+
+def _entity_index():
+    eidx = EntityIndex()
+    for i in range(N_ENTITIES):
+        eidx.get_or_add(f"user{i}")
+    return eidx
+
+
+def _model(seed=7, compact=False):
+    """Synthetic fixed + per_user GLMix model; ``compact`` swaps the dense
+    random effect for the sparse-row container."""
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    models = {"fixed": FixedEffectModel(
+        coefficients=Coefficients(means=rng.normal(size=DIM)),
+        feature_shard="all", task=task)}
+    if compact:
+        k = 3
+        idx = np.sort(rng.integers(0, DIM, size=(N_ENTITIES, k)
+                                   ).astype(np.int32), axis=1)
+        models["per_user"] = CompactRandomEffectModel(
+            indices=idx, values=rng.normal(size=(N_ENTITIES, k)) * 0.1,
+            dim=DIM, slot_of={i: i for i in range(N_ENTITIES)},
+            random_effect_type="userId", feature_shard="all", task=task)
+    else:
+        models["per_user"] = RandomEffectModel(
+            w_stack=rng.normal(size=(N_ENTITIES, DIM)) * 0.1,
+            slot_of={i: i for i in range(N_ENTITIES)},
+            random_effect_type="userId", feature_shard="all", task=task)
+    return GameModel(models=models), task
+
+
+def _engine(mesh_shards, device_capacity, seed=7, compact=False,
+            max_batch=16):
+    model, task = _model(seed=seed, compact=compact)
+    metrics = ServingMetrics()
+    store = CoefficientStore.from_model(
+        model, task, {"userId": _entity_index()}, {"all": _index_map()},
+        config=StoreConfig(device_capacity=device_capacity,
+                           mesh_shards=mesh_shards),
+        version=f"mesh{mesh_shards}", metrics=metrics)
+    return ScoringEngine(store, BucketedBatcher(max_batch),
+                         metrics=metrics), metrics
+
+
+def _requests(k, seed, zipf=0.0, unknown_frac=0.1):
+    """Random requests; ``zipf`` skews entity draws (rank ~ archive slot)."""
+    rng = np.random.default_rng(seed)
+    if zipf:
+        w = (np.arange(N_ENTITIES) + 1.0) ** -zipf
+        ids = rng.choice(N_ENTITIES, size=k, p=w / w.sum())
+    else:
+        ids = rng.integers(0, N_ENTITIES, size=k)
+    unknown = rng.random(k) < unknown_frac
+    reqs = []
+    for i in range(k):
+        u = N_ENTITIES + i if unknown[i] else int(ids[i])
+        feats = [{"name": n, "term": "", "value": float(v)}
+                 for n, v in zip(FEATURES, rng.normal(size=DIM))]
+        reqs.append(Request(uid=i, features=feats,
+                            ids={"userId": f"user{u}"}))
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# mesh + spec plumbing
+# ---------------------------------------------------------------------------
+class TestServingMesh:
+    def test_serving_mesh_axis_and_sizes(self, devices):
+        m = serving_mesh(4)
+        assert m.axis_names == (SHARD_AXIS,)
+        assert m.shape[SHARD_AXIS] == 4
+        assert serving_mesh(1).shape[SHARD_AXIS] == 1
+
+    def test_serving_mesh_validation(self, devices):
+        with pytest.raises(ValueError, match="n_shards >= 1"):
+            serving_mesh(0)
+        with pytest.raises(ValueError, match="devices"):
+            serving_mesh(len(devices) + 1)
+
+    def test_shard_spec_routing(self, devices):
+        spec = ShardSpec(mesh=serving_mesh(4), n_shards=4, cap=5)
+        slots = np.arange(20)
+        np.testing.assert_array_equal(spec.shard_of_archive_slot(slots),
+                                      slots % 4)
+        assert spec.sharding.mesh.axis_names == (SHARD_AXIS,)
+
+    def test_store_carries_mesh_and_signature(self, devices):
+        eng, _ = _engine(4, 8)
+        eng0, _ = _engine(0, 32)
+        assert eng.store.mesh is not None and eng0.store.mesh is None
+        # mesh shape is part of the executable cache key: a sharded and an
+        # unsharded store must never share an AOT executable
+        assert eng.store.signature() != eng0.store.signature()
+
+    def test_per_shard_capacity_scales_aggregate(self, devices):
+        """Fixed per-shard budget => aggregate hot rows scale with the
+        mesh — the pod-slice capacity story."""
+        cap = 8
+        for n in (1, 2, 4, 8):
+            eng, _ = _engine(n, cap)
+            c = eng.store.coordinates["per_user"]
+            assert c.hot_capacity == cap * n
+            assert c.table.shape[0] == cap * n
+            assert len(c.hot_slot_of) == min(cap * n, N_ENTITIES)
+
+    def test_capacity_clamped_to_shard_population(self, devices):
+        """cap beyond ceil(E/N) would only pin dead rows — it is clamped."""
+        eng, _ = _engine(4, 1000)
+        c = eng.store.coordinates["per_user"]
+        assert c.shard_spec.cap == -(-N_ENTITIES // 4)
+        assert len(c.hot_slot_of) == N_ENTITIES  # everything fits hot
+
+
+# ---------------------------------------------------------------------------
+# score parity
+# ---------------------------------------------------------------------------
+class TestShardedScoringParity:
+    def test_one_shard_bitwise(self, devices):
+        """A 1-shard mesh is a deployment no-op: scores are bitwise the
+        unsharded engine's, across every bucket the plan touches."""
+        eng0, _ = _engine(0, 30)
+        eng1, _ = _engine(1, 30)
+        for k in (1, 5, 16, 50):
+            reqs = _requests(k, seed=100 + k)
+            np.testing.assert_array_equal(eng1.score_requests(reqs),
+                                          eng0.score_requests(reqs))
+
+    @pytest.mark.parametrize("shards", [2, 4, 8])
+    def test_nshard_parity_zipf(self, devices, shards):
+        """N shards under zipf traffic: the only arithmetic difference is
+        the psum's add order, exact at these magnitudes."""
+        eng0, _ = _engine(0, 32)
+        engN, _ = _engine(shards, -(-32 // shards))
+        reqs = _requests(64, seed=3, zipf=1.1)
+        np.testing.assert_allclose(engN.score_requests(reqs),
+                                   eng0.score_requests(reqs),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_compact_coordinate_parity(self, devices):
+        eng0, _ = _engine(0, 30, compact=True)
+        eng1, _ = _engine(1, 30, compact=True)
+        eng4, _ = _engine(4, 8, compact=True)
+        reqs = _requests(48, seed=5, zipf=1.1)
+        base = eng0.score_requests(reqs)
+        np.testing.assert_array_equal(eng1.score_requests(reqs), base)
+        np.testing.assert_allclose(eng4.score_requests(reqs), base,
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_all_hot_vs_cold_split_parity(self, devices):
+        """Parity must hold whatever the hot/cold split: all-hot,
+        tiny-hot (cold overflow dominant), and zero-hot."""
+        reqs = _requests(40, seed=9, zipf=1.0)
+        base = _engine(0, None)[0].score_requests(reqs)
+        for cap in (None, 2, 0):
+            engN, _ = _engine(4, cap)
+            np.testing.assert_allclose(engN.score_requests(reqs), base,
+                                       rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# mutation parity: rebalance / deltas / hot swap
+# ---------------------------------------------------------------------------
+class TestShardedMutation:
+    def test_rebalance_parity_and_shard_locality(self, devices):
+        eng0, _ = _engine(0, 32)
+        eng8, _ = _engine(8, 4)
+        # skewed traffic toward the archive tail (initially cold), then one
+        # promotion pass everywhere
+        hot_tail = _requests(200, seed=21, zipf=1.3)
+        for eng in (eng0, eng8):
+            eng.score_requests(hot_tail)
+            eng.store.rebalance()
+        c = eng8.store.coordinates["per_user"]
+        spec = c.shard_spec
+        for eid, row in c.hot_slot_of.items():
+            # residency never crosses the shard an entity routes to
+            assert row // spec.cap == eid % spec.n_shards
+        reqs = _requests(64, seed=22, zipf=1.3)
+        np.testing.assert_allclose(eng8.score_requests(reqs),
+                                   eng0.score_requests(reqs),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_delta_parity_hot_and_cold(self, devices):
+        eng0, _ = _engine(0, 20)
+        eng4, _ = _engine(4, 5)
+        rng = np.random.default_rng(31)
+        hot_row, cold_row = rng.normal(size=DIM), rng.normal(size=DIM)
+        for eng in (eng0, eng4):
+            assert eng.store.apply_delta("per_user", "user3", hot_row)
+            assert eng.store.apply_delta("per_user", "user90", cold_row)
+            assert not eng.store.apply_delta("per_user", "nobody", hot_row)
+        reqs = _requests(64, seed=32)
+        np.testing.assert_allclose(eng4.score_requests(reqs),
+                                   eng0.score_requests(reqs),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_hot_swap_preserves_sharding_and_parity(self, devices, tmp_path):
+        from photon_ml_tpu.storage.model_io import save_game_model
+
+        imap, eidx = _index_map(), _entity_index()
+        for seed, name in ((7, "v1"), (8, "v2")):
+            model, task = _model(seed=seed)
+            out = tmp_path / name
+            save_game_model(model, str(out), {"all": imap},
+                            entity_indexes={"userId": eidx}, task=task)
+            imap.save(str(out / "all.idx"))
+            eidx.save(str(out / "userId.entities.json"))
+        metrics = ServingMetrics()
+        from photon_ml_tpu.storage.model_io import load_model_bundle
+        store = CoefficientStore.from_bundle(
+            load_model_bundle(str(tmp_path / "v1")),
+            config=StoreConfig(device_capacity=8, mesh_shards=4),
+            metrics=metrics)
+        engine = ScoringEngine(store, BucketedBatcher(16), metrics=metrics)
+        engine.warm()
+        swapper = HotSwapper(engine)
+        assert swapper.swap(str(tmp_path / "v2"), version="v2")
+        new = engine.store
+        # the config (and with it the mesh layout) rides the swap
+        assert new.config.mesh_shards == 4 and new.mesh is not None
+        assert new.coordinates["per_user"].shard_spec is not None
+        reqs = _requests(48, seed=41)
+        eng0, _ = _engine(0, 32, seed=8)
+        np.testing.assert_allclose(engine.score_requests(reqs),
+                                   eng0.score_requests(reqs),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_zero_recompiles_after_warm(self, devices):
+        """The invariant the whole design protects: traffic, rebalance and
+        deltas never change a shape or layout, so nothing recompiles."""
+        eng, _ = _engine(4, 8)
+        eng.warm()
+        warmed = eng.compile_count
+        eng.score_requests(_requests(64, seed=51, zipf=1.2))
+        eng.store.rebalance()
+        eng.store.apply_delta(
+            "per_user", "user1", np.random.default_rng(52).normal(size=DIM))
+        eng.score_requests(_requests(32, seed=53, zipf=1.2))
+        assert eng.compile_count == warmed
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+class TestShardMetrics:
+    def test_occupancy_and_traffic_gauges(self, devices):
+        eng, metrics = _engine(4, 8)
+        eng.score_requests(_requests(64, seed=61, zipf=1.0))
+        view = metrics.shard_view()["per_user"]
+        assert sorted(view) == [0, 1, 2, 3]
+        # initial residency fills every shard (cap 8 x 4 < 100 entities)
+        assert all(cell["occupancy"] == 1.0 for cell in view.values())
+        total_hot = sum(cell["hot_hits"] for cell in view.values())
+        total_lookups = sum(cell["lookups"] for cell in view.values())
+        assert total_hot == metrics.counter("hot_hits")
+        assert 0 < total_hot <= total_lookups
+        for cell in view.values():
+            assert 0.0 <= cell["hit_rate"] <= 1.0
+
+    def test_snapshot_wire_format_unchanged(self, devices):
+        """Per-shard families must NOT leak into the snapshot()'s
+        byte-compatible ``counters`` view."""
+        eng, metrics = _engine(4, 8)
+        eng.score_requests(_requests(32, seed=71))
+        eng.store.rebalance()
+        snap = metrics.snapshot()
+        assert not any(k.startswith("serving_shard") for k in
+                       snap["counters"])
+        # but they DO ride the Prometheus exposition for scrapers
+        prom = metrics.to_prometheus()
+        assert "serving_shard_occupancy" in prom
+        assert "serving_shard_lookups_total" in prom
+
+
+# ---------------------------------------------------------------------------
+# cli
+# ---------------------------------------------------------------------------
+class TestServeCliMesh:
+    def test_mesh_shards_flag_parses(self):
+        from photon_ml_tpu.cli.serve import build_parser
+
+        args = build_parser().parse_args(
+            ["--model-dir", "m", "--mesh-shards", "4"])
+        assert args.mesh_shards == 4
+        assert build_parser().parse_args(
+            ["--model-dir", "m"]).mesh_shards == 0
+
+    def test_build_server_mesh(self, devices, tmp_path):
+        from photon_ml_tpu.cli.serve import build_server
+        from photon_ml_tpu.storage.model_io import save_game_model
+
+        model, task = _model(seed=7)
+        imap, eidx = _index_map(), _entity_index()
+        out = tmp_path / "m"
+        save_game_model(model, str(out), {"all": imap},
+                        entity_indexes={"userId": eidx}, task=task)
+        imap.save(str(out / "all.idx"))
+        eidx.save(str(out / "userId.entities.json"))
+        engine, swapper = build_server(
+            str(tmp_path / "m"), max_batch=8, device_entity_capacity=8,
+            mesh_shards=2, warm=True)
+        assert engine.store.config.mesh_shards == 2
+        assert engine.store.mesh is not None
+        reqs = _requests(12, seed=81)
+        eng0, _ = _engine(0, 16, seed=7, max_batch=8)
+        np.testing.assert_allclose(engine.score_requests(reqs),
+                                   eng0.score_requests(reqs),
+                                   rtol=1e-12, atol=1e-12)
