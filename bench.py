@@ -2046,6 +2046,317 @@ def run_online_bench(n_entities=2000, d=8, max_batch=64, seed=0,
     return out
 
 
+def run_repl_bench(n_entities=256, d=8, max_batch=32, n_replicas=2,
+                   batches=8, batch_size=32, seed=0, out_path=None) -> dict:
+    """`bench.py --repl`: the photonrepl network replication plane end to
+    end -> BENCH_REPL_<backend>.json.
+
+    Saves a real model directory (snapshots pack a dir, so the in-memory
+    synthetic engine is not enough), attaches an owning delta log + the
+    photonrepl log server, then boots ``n_replicas`` socket subscribers —
+    each one the full ``serve.py --subscribe`` wiring: snapshot bootstrap
+    over the socket, local mirror log, warmed serving engine, live
+    ``LogFollower`` tail.  Measured phases:
+
+      - **bootstrap**: wall time for all replicas to snapshot + warm;
+      - **live tail under refit load**: labeled mini-batches stream
+        through ``IncrementalTrainer.consume`` on the owner WHILE one
+        replica keeps serving scores; after each batch the publish-tail
+        identity's propagation to every replica's SERVING STORE is timed
+        (publish -> store-visible freshness, p50/p99/max over
+        batch x replica samples);
+      - **mid-stream reconnect**: one replica is torn down, more refits
+        land, and a fresh subscriber on the same warm spool must resume
+        via LOG REPLAY (``repl_resume_total{mode="log"}``) — asserted, a
+        snapshot fallback here would mean retention broke;
+      - **acceptance**: every replica converges BITWISE to the owner's
+        probe scores with ZERO engine recompiles after warm — both
+        asserted, not just reported.
+    """
+    import tempfile
+    import threading
+
+    import jax
+
+    from photon_ml_tpu.cli.serve import build_server
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.online.catchup import LogFollower
+    from photon_ml_tpu.online.delta_log import DeltaLog
+    from photon_ml_tpu.online.replication import (ReplicationClient,
+                                                  ReplicationClientConfig,
+                                                  ReplicationConfig,
+                                                  attach_replication)
+    from photon_ml_tpu.online.trainer import IncrementalTrainer, TrainerConfig
+    from photon_ml_tpu.serving.batcher import Request
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    from photon_ml_tpu.storage.model_io import save_game_model
+    from photon_ml_tpu.types import TaskType
+
+    assert n_replicas >= 1
+    rng = np.random.default_rng(seed)
+    names = [f"f{j}" for j in range(d)]
+    task = TaskType.LOGISTIC_REGRESSION
+
+    def save_model(path):
+        model = GameModel(models={
+            "fixed": FixedEffectModel(
+                coefficients=Coefficients(means=rng.normal(size=d)),
+                feature_shard="all", task=task),
+            "user": RandomEffectModel(
+                w_stack=rng.normal(size=(n_entities, d)) * 0.1,
+                slot_of={i: i for i in range(n_entities)},
+                random_effect_type="userId", feature_shard="all",
+                task=task),
+        })
+        imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
+        eidx = EntityIndex()
+        for i in range(n_entities):
+            eidx.get_or_add(f"user{i}")
+        save_game_model(model, path, {"all": imap}, {"userId": eidx},
+                        task=task)
+        imap.save(os.path.join(path, "all.idx"))
+        eidx.save(os.path.join(path, "userId.entities.json"))
+        return path
+
+    def mk_request(uid, user, r=None):
+        r = r if r is not None else rng
+        feats = [{"name": n, "term": "", "value": float(v)}
+                 for n, v in zip(names, r.normal(size=d))]
+        return Request(uid=uid, features=feats,
+                       ids={"userId": f"user{user}"})
+
+    # fixed probe set: owner and every replica score the SAME requests, so
+    # parity is a bitwise comparison of floats
+    probe_rng = np.random.default_rng(seed + 7)
+    probes = [mk_request(i, i % n_entities, probe_rng)
+              for i in range(min(max_batch, n_entities))]
+
+    def scores(engine):
+        return [float(s) for s in engine.score_requests(probes)]
+
+    def wait_for(pred, timeout=60.0, what="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.002)
+        raise AssertionError(f"repl bench timed out waiting for {what}")
+
+    class Replica:
+        """serve.py --subscribe wiring, in-process."""
+
+        def __init__(self, port, spool):
+            self.metrics = ServingMetrics()
+            self.client = ReplicationClient(
+                ReplicationClientConfig(host="127.0.0.1", port=port,
+                                        spool_dir=spool, ack_every=8,
+                                        ack_interval_s=0.05,
+                                        backoff_initial_s=0.05),
+                registry=self.metrics.registry).start()
+            model_dir = self.client.bootstrap(timeout=60.0)
+            self.mirror = DeltaLog(self.client.mirror_path, fsync="never")
+            self.engine, self.swapper = build_server(
+                model_dir, max_batch=max_batch, warm=True,
+                metrics=self.metrics, delta_log=self.mirror,
+                log_owner=False)
+            self.swapper.set_base(model_dir, self.client.floor or 0)
+            self.client.on_snapshot = \
+                lambda d, g: self.swapper.swap(d, replay_floor=g)
+            if self.client.model_dir != model_dir:
+                self.swapper.swap(self.client.model_dir,
+                                  replay_floor=self.client.floor)
+            self.follower = LogFollower(self.mirror,
+                                        lambda: self.engine.store,
+                                        poll_interval_s=0.005,
+                                        registry=self.metrics.registry)
+            self.follower.run_once()
+            self.follower.start()
+
+        def at_or_past(self, identity):
+            p = self.follower.position
+            return p is not None and p >= identity
+
+        def close(self):
+            self.follower.stop()
+            self.client.stop()
+            self.mirror.close()
+
+    with tempfile.TemporaryDirectory(prefix="photon_repl_bench_") as tmp:
+        base_dir = save_model(os.path.join(tmp, "base"))
+        log = DeltaLog(os.path.join(tmp, "owner-log"), fsync="rotate")
+        engine, swapper = build_server(base_dir, max_batch=max_batch,
+                                       warm=True, delta_log=log,
+                                       log_owner=True)
+        registry = engine.metrics.registry
+        repl = attach_replication(swapper, ReplicationConfig(),
+                                  registry=registry)
+        trainer = IncrementalTrainer(
+            swapper, TrainerConfig(coordinates=("user",), max_iters=5))
+
+        replicas = []
+        try:
+            t0 = time.perf_counter()
+            replicas = [Replica(repl.port, os.path.join(tmp, f"spool{i}"))
+                        for i in range(n_replicas)]
+            bootstrap_s = time.perf_counter() - t0
+
+            # settle the compile baselines: one scoring pass each, then
+            # every later score must reuse the warmed executables
+            scores(engine)
+            for r in replicas:
+                scores(r.engine)
+            compile_base = [r.engine.compile_count for r in replicas]
+
+            # labeled feed assembled up front so the timed loop is pure
+            # consume(); +1 batch is published during the reconnect window
+            feed = []
+            for _ in range(batches + 1):
+                fb = []
+                for _ in range(batch_size):
+                    u = int(rng.integers(0, n_entities))
+                    req = mk_request(None, u)
+                    fb.append({"uid": None, "features": req.features,
+                               "ids": req.ids,
+                               "label": float(rng.integers(0, 2))})
+                feed.append(fb)
+
+            # concurrent serving load on the LAST replica for the whole
+            # refit phase — live tailing must not stall or recompile it
+            stop = threading.Event()
+            served = [0]
+
+            def serve_loop():
+                r = np.random.default_rng(seed + 1)
+                while not stop.is_set():
+                    u = int(r.integers(0, n_entities))
+                    replicas[-1].engine.score_requests(
+                        [mk_request(served[0], u, r)])
+                    served[0] += 1
+
+            loader = threading.Thread(target=serve_loop, daemon=True)
+            loader.start()
+            reports, fresh_ms = [], []
+            t_load = time.perf_counter()
+            try:
+                for fb in feed[:batches]:
+                    rep = trainer.consume(fb)
+                    reports.append(rep)
+                    if not rep.published:
+                        continue
+                    tail = swapper.identity
+                    t_pub = time.perf_counter()
+                    pending = set(range(n_replicas))
+                    while pending:
+                        for i in list(pending):
+                            if replicas[i].at_or_past(tail):
+                                fresh_ms.append(
+                                    (time.perf_counter() - t_pub) * 1e3)
+                                pending.discard(i)
+                        if pending:
+                            if time.perf_counter() - t_pub > 60.0:
+                                raise AssertionError(
+                                    f"replicas {sorted(pending)} never "
+                                    f"reached {tail}")
+                            time.sleep(0.001)
+            finally:
+                stop.set()
+                loader.join(timeout=10.0)
+            load_wall = time.perf_counter() - t_load
+
+            # mid-stream reconnect: tear replica 0 down, land one more
+            # refit batch while it is away, then resubscribe on the SAME
+            # warm spool — no swap ran, so the log is fully retained and
+            # the resume MUST ride log replay, not a snapshot
+            spool0 = os.path.join(tmp, "spool0")
+            replicas[0].close()
+            reports.append(trainer.consume(feed[batches]))
+            replicas[0] = Replica(repl.port, spool0)
+            r0 = replicas[0]
+            wait_for(lambda: r0.client.last_resume_mode is not None,
+                     what="reconnect subscribe ack")
+            resume_mode = r0.client.last_resume_mode
+            assert resume_mode == "log", \
+                f"warm-spool reconnect resumed via {resume_mode!r}"
+            scores(r0.engine)  # settle the rebuilt engine's baseline
+            compile_base[0] = r0.engine.compile_count
+
+            # final convergence + the acceptance checks
+            tail = swapper.identity
+            for i, r in enumerate(replicas):
+                wait_for(lambda r=r: r.at_or_past(tail),
+                         what=f"replica {i} store at {tail}")
+            owner_scores = scores(engine)
+            parity = [scores(r.engine) == owner_scores for r in replicas]
+            recompiles = [r.engine.compile_count - compile_base[i]
+                          for i, r in enumerate(replicas)]
+            assert all(parity), f"owner/replica score divergence: {parity}"
+            assert all(c == 0 for c in recompiles), \
+                f"replica recompiles after warm: {recompiles}"
+
+            entities = sum(r.entities for r in reports)
+            rows = sum(r.rows for r in reports)
+            published = sum(r.published for r in reports)
+            refit_wall = sum(r.wall_s for r in reports)
+            fr = np.asarray(fresh_ms) if fresh_ms else np.zeros(1)
+            out = {
+                "metric": "repl_store_visible_freshness_ms_p99",
+                "unit": "ms",
+                "value": round(float(np.percentile(fr, 99)), 3),
+                "backend": jax.default_backend(),
+                "n_entities": n_entities, "d": d,
+                "n_replicas": n_replicas, "batches": batches,
+                "batch_size": batch_size,
+                "bootstrap": {
+                    "seconds": round(bootstrap_s, 4),
+                    "snapshots_total":
+                        int(registry.counter("repl_snapshots_total"))},
+                "refit": {
+                    "entities": entities, "rows": rows,
+                    "published": published,
+                    "wall_s": round(refit_wall, 4),
+                    "load_wall_s": round(load_wall, 4),
+                    "rows_per_s": round(rows / refit_wall, 1)
+                                  if refit_wall else 0.0},
+                "freshness_ms": {
+                    "samples": len(fresh_ms),
+                    "p50": round(float(np.percentile(fr, 50)), 3),
+                    "p99": round(float(np.percentile(fr, 99)), 3),
+                    "max": round(float(fr.max()), 3)},
+                "serving_during_refit": {
+                    "scores": served[0],
+                    "qps": round(served[0] / load_wall, 1)
+                           if load_wall else 0.0},
+                "reconnect": {
+                    "resume_mode": resume_mode,
+                    "resume_log_total": int(registry.counter(
+                        "repl_resume_total", mode="log")),
+                    "records_replayed": r0.client.records_applied},
+                "parity": {"bitwise_equal": parity},
+                "replica_recompiles_after_warm": recompiles,
+                "delta_log": {"bytes": log.bytes_written,
+                              "records": log.records_written,
+                              "segments": len(log.segments())},
+            }
+        finally:
+            for r in replicas:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+            repl.stop()
+            log.close()
+    if out_path is None:
+        out_path = os.path.join(_REPO,
+                                f"BENCH_REPL_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def run_solve_bench(out_path=None, seed=0, n_users=96, per_user=96,
                     d_user=4, n_iterations=4) -> dict:
     """`bench.py --solve`: per-entity solve-path micro-bench ->
@@ -2610,6 +2921,21 @@ def main():
                          "through the trainer")
     ap.add_argument("--online-batch-size", type=int, default=64,
                     help="with --online: examples per mini-batch")
+    ap.add_argument("--repl", action="store_true",
+                    help="photonrepl end to end (socket snapshot bootstrap "
+                         "+ live delta shipping to N replicas under "
+                         "concurrent refit load; bitwise owner/replica "
+                         "score parity, zero replica recompiles and "
+                         "log-replay reconnect asserted; publish->store-"
+                         "visible freshness p50/p99) -> "
+                         "BENCH_REPL_<backend>.json")
+    ap.add_argument("--repl-replicas", type=int, default=2,
+                    help="with --repl: socket subscribers to boot")
+    ap.add_argument("--repl-batches", type=int, default=8,
+                    help="with --repl: labeled mini-batches streamed "
+                         "through the owner's trainer")
+    ap.add_argument("--repl-batch-size", type=int, default=32,
+                    help="with --repl: examples per mini-batch")
     ap.add_argument("--solve", action="store_true",
                     help="per-entity solve-path micro-bench (SoA Newton "
                          "lanes/sec, host vs fused vs fused-validated sweep "
@@ -2652,6 +2978,13 @@ def main():
         return
     if a.solve:
         print(json.dumps(run_solve_bench(out_path=a.out)))
+        return
+    if a.repl:
+        print(json.dumps(run_repl_bench(
+            n_replicas=a.repl_replicas,
+            batches=a.repl_batches,
+            batch_size=a.repl_batch_size,
+            out_path=a.out)))
         return
     if a.online:
         print(json.dumps(run_online_bench(

@@ -88,9 +88,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warm", action="store_true",
                    help="AOT-warm the scoring bucket ladder too (only "
                         "useful when this process also answers scores)")
+    p.add_argument("--repl-listen", default="",
+                   help="host:port for the photonrepl log server "
+                        "(online/replication): replicas subscribe here for "
+                        "snapshot bootstrap + live delta shipping instead "
+                        "of sharing the --delta-log directory.  Requires "
+                        "--delta-log.  Port 0 = ephemeral (logged)")
+    p.add_argument("--auth-token", default=None,
+                   help="shared secret replication subscribers must "
+                        "present (constant-time compare; one error frame, "
+                        "then close).  Default: $PHOTON_AUTH_TOKEN")
     p.add_argument("--metrics-json", default="",
                    help="write the final metrics snapshot here at exit")
     return p
+
+
+def _parse_hostport(value: str) -> tuple:
+    host, sep, port = value.rpartition(":")
+    if not sep or not host:
+        raise ValueError(f"expected HOST:PORT, got {value!r}")
+    return host, int(port)
 
 
 def _avro_examples(path: str) -> Iterator[dict]:
@@ -208,6 +225,31 @@ def run(argv: List[str]) -> int:
                 engine.store.version, engine.store.task.value,
                 coords or "auto")
 
+    repl = None
+    if args.repl_listen:
+        if delta_log is None:
+            logger.error("--repl-listen needs --delta-log (the log is "
+                         "what gets replicated)")
+            return 1
+        import os as _os
+
+        from photon_ml_tpu.online.replication import (ReplicationConfig,
+                                                      attach_replication)
+
+        try:
+            host, port = _parse_hostport(args.repl_listen)
+        except ValueError as e:
+            logger.error("%s", e)
+            return 1
+        token = args.auth_token if args.auth_token is not None \
+            else _os.environ.get("PHOTON_AUTH_TOKEN") or None
+        repl = attach_replication(
+            swapper, ReplicationConfig(host=host, port=port,
+                                       auth_token=token),
+            registry=engine.metrics.registry)
+        logger.info("photonrepl serving the delta log on %s:%d%s", host,
+                    repl.port, " (auth required)" if token else "")
+
     try:
         if args.format == "avro":
             if args.examples == "-":
@@ -226,6 +268,8 @@ def run(argv: List[str]) -> int:
                 if lines is not sys.stdin:
                     lines.close()
     finally:
+        if repl is not None:
+            repl.stop()
         if delta_log is not None:
             delta_log.close()
         if args.metrics_json:

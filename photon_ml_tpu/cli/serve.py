@@ -64,6 +64,19 @@ before serving, tailed on a background thread (``--delta-log-poll``), and
 replayed onto every hot-swapped-in generation before it activates — so a
 second serving process converges to the trainer's live coefficients with
 no coordination beyond the shared log directory (see online/catchup.py).
+
+``--subscribe host:port`` removes even that shared directory: the process
+connects to a photonrepl owner (``learn.py --repl-listen``, or any
+``online.replication.ReplicationServer``), bootstraps its base model from
+a checksummed snapshot tarstream into ``--spool``, mirrors the owner's
+live record stream into a local delta log there, and serves from the
+mirror exactly as ``--delta-log`` would — including
+replay-before-activate when the owner hot-swaps mid-stream (the new base
+ships inline and this process swaps to it).  A restarted replica with a
+warm spool resumes from its last applied identity (log replay when the
+owner still retains it, fresh snapshot otherwise).  ``--auth-token``
+(default ``$PHOTON_AUTH_TOKEN``) is presented to the owner AND required
+of clients on ``--listen``.
 """
 
 from __future__ import annotations
@@ -73,6 +86,7 @@ import asyncio
 import collections
 import json
 import logging
+import os
 import signal
 import sys
 from typing import IO, List, Optional, Sequence, Tuple
@@ -97,9 +111,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-tpu-serve",
                                 description="Online scoring with a trained "
                                             "GAME model (JSON-lines)")
-    p.add_argument("--model-dir", required=True,
+    p.add_argument("--model-dir", default="",
                    help="training output dir (best/, *.idx, *.entities.json) "
-                        "or a model dir with metadata.json")
+                        "or a model dir with metadata.json.  Required "
+                        "unless --subscribe bootstraps the base instead")
     p.add_argument("--max-batch", type=int, default=64,
                    help="micro-batch flush threshold and top bucket size")
     p.add_argument("--buckets", default="",
@@ -189,6 +204,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "incoming generation before activation")
     p.add_argument("--delta-log-poll", type=float, default=0.05,
                    help="seconds between delta-log tail polls")
+    p.add_argument("--subscribe", default="",
+                   help="host:port of a photonrepl owner (learn.py "
+                        "--repl-listen): bootstrap the base model from a "
+                        "snapshot over the socket, then live-tail its "
+                        "delta stream into a local mirror under --spool — "
+                        "no shared directory.  Mutually exclusive with "
+                        "--model-dir / --delta-log")
+    p.add_argument("--spool", default="",
+                   help="replica spool directory for --subscribe "
+                        "(mirror log, extracted snapshot bases, resume "
+                        "state); reusing it across restarts enables "
+                        "identity-based resume")
+    p.add_argument("--bootstrap-timeout", type=float, default=60.0,
+                   help="--subscribe: seconds to wait for the first "
+                        "snapshot (or a warm spool) before giving up")
+    p.add_argument("--auth-token", default=None,
+                   help="shared secret: presented to the --subscribe "
+                        "owner AND required of --listen clients (first "
+                        "line {\"cmd\": \"auth\", \"token\": ...}; "
+                        "constant-time compare).  Default: "
+                        "$PHOTON_AUTH_TOKEN")
     p.add_argument("--metrics-json", default="",
                    help="write the final metrics snapshot here at exit")
     p.add_argument("--trace", action="store_true",
@@ -232,8 +268,9 @@ def build_server(model_dir: str,
         n = engine.warm()
         logger.info("warmed %d executable(s) over buckets %s", n,
                     engine.batcher.bucket_sizes)
-    return engine, HotSwapper(engine, delta_log=delta_log,
-                              log_owner=log_owner)
+    swapper = HotSwapper(engine, delta_log=delta_log, log_owner=log_owner)
+    swapper.set_base(model_dir)  # snapshot source for photonrepl owners
+    return engine, swapper
 
 
 def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
@@ -374,8 +411,15 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
 def _parse_listen(listen: str) -> Tuple[str, int]:
     host, sep, port = listen.rpartition(":")
     if not sep:
-        raise ValueError(f"--listen wants host:port, got {listen!r}")
+        raise ValueError(f"wanted host:port, got {listen!r}")
     return host or "127.0.0.1", int(port)
+
+
+def _auth_token(args: argparse.Namespace) -> Optional[str]:
+    """--auth-token, falling back to $PHOTON_AUTH_TOKEN (empty = unset)."""
+    if args.auth_token is not None:
+        return args.auth_token or None
+    return os.environ.get("PHOTON_AUTH_TOKEN") or None
 
 
 def _run_network(engine: ScoringEngine, swapper: HotSwapper,
@@ -400,7 +444,8 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
         batcher_deadline_s=args.deadline_us * 1e-6,
         dispatch_window=(args.dispatch_window or None),
         predict_mean=args.predict_mean,
-        max_connections=(args.max_connections or None))
+        max_connections=(args.max_connections or None),
+        auth_token=_auth_token(args))
 
     async def _main() -> int:
         front = FrontendServer(engine, swapper, config)
@@ -446,8 +491,54 @@ def run(argv: List[str]) -> int:
     buckets = None
     if args.buckets:
         buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+
+    client = None
+    metrics = None
+    model_dir = args.model_dir
     delta_log = None
-    if args.delta_log:
+    if args.subscribe:
+        if args.model_dir or args.delta_log:
+            logger.error("--subscribe is mutually exclusive with "
+                         "--model-dir / --delta-log (the subscription "
+                         "provides both the base and the delta feed)")
+            return 1
+        if not args.spool:
+            logger.error("--subscribe needs --spool DIR (mirror log + "
+                         "snapshot bases + resume state live there)")
+            return 1
+        from photon_ml_tpu.online.delta_log import DeltaLog
+        from photon_ml_tpu.online.replication import (
+            ReplicationClient, ReplicationClientConfig)
+
+        metrics = ServingMetrics()
+        try:
+            host, port = _parse_listen(args.subscribe)
+        except ValueError as e:
+            logger.error("--subscribe: %s", e)
+            return 1
+        client = ReplicationClient(
+            ReplicationClientConfig(host=host, port=port,
+                                    spool_dir=args.spool,
+                                    auth_token=_auth_token(args)),
+            registry=metrics.registry).start()
+        logger.info("subscribing to photonrepl owner %s:%d (spool %s)",
+                    host, port, args.spool)
+        try:
+            model_dir = client.bootstrap(timeout=args.bootstrap_timeout)
+        except RuntimeError as e:
+            logger.error("--subscribe: %s", e)
+            client.stop()
+            return 1
+        logger.info("photonrepl bootstrap: base %s (owner floor gen %s)",
+                    model_dir, client.floor)
+        # the mirror is OURS but the swapper must treat it as a follower
+        # log: identities in it belong to the owner, and the replication
+        # client is its only writer/compactor
+        delta_log = DeltaLog(client.mirror_path, fsync="never")
+    elif not args.model_dir:
+        logger.error("--model-dir is required (or --subscribe)")
+        return 1
+    elif args.delta_log:
         from photon_ml_tpu.online.delta_log import DeltaLog
 
         # follower role: this process never appends (its process-local
@@ -456,7 +547,7 @@ def run(argv: List[str]) -> int:
         delta_log = DeltaLog(args.delta_log, fsync="never")
     try:
         engine, swapper = build_server(
-            args.model_dir,
+            model_dir,
             max_batch=args.max_batch,
             bucket_sizes=buckets,
             device_entity_capacity=(args.device_entity_capacity or None),
@@ -464,14 +555,30 @@ def run(argv: List[str]) -> int:
             hot_decay=args.hot_decay,
             mesh_shards=args.mesh_shards,
             warm=not args.no_warm,
+            metrics=metrics,
             delta_log=delta_log,
             log_owner=False)
     except (ModelLoadError, ValueError) as e:
         logger.error("--model-dir: %s", e)
+        if client is not None:
+            client.stop()
         return 1
     logger.info("serving generation %d (version %r), task %s",
                 engine.store.generation, engine.store.version,
                 engine.store.task.value)
+
+    if client is not None:
+        swapper.set_base(model_dir, client.floor or 0)
+        # owner hot swap mid-stream: the client extracts the shipped base
+        # and we swap to it; replay_floor is the OWNER's generation for
+        # that base, so replay-before-activate off the mirror skips
+        # records the snapshot supersedes
+        client.on_snapshot = \
+            lambda d, g: swapper.swap(d, replay_floor=g)
+        if client.model_dir != model_dir:
+            # a snapshot landed between bootstrap() and the wiring above —
+            # catch up now instead of serving a base the owner replaced
+            swapper.swap(client.model_dir, replay_floor=client.floor)
 
     follower = None
     if delta_log is not None:
@@ -484,7 +591,7 @@ def run(argv: List[str]) -> int:
         logger.info("delta-log catch-up: applied %d, rejected %d "
                     "(position %s); following %s every %.3fs",
                     stats.applied, stats.rejected, stats.position,
-                    args.delta_log, args.delta_log_poll)
+                    delta_log.path, args.delta_log_poll)
         follower.start()
 
     hotset = None
@@ -520,6 +627,8 @@ def run(argv: List[str]) -> int:
     finally:
         if follower is not None:
             follower.stop()
+        if client is not None:
+            client.stop()
         if metrics_sidecar is not None:
             metrics_sidecar.stop()
         if hotset is not None:

@@ -55,7 +55,7 @@ import struct
 import threading
 import time
 import zlib
-from typing import IO, Iterator, List, Optional, Tuple
+from typing import IO, Callable, Iterator, List, Optional, Tuple
 
 from photon_ml_tpu.obs.registry import MetricsRegistry
 
@@ -160,6 +160,25 @@ class DeltaLog:
         self._last: Optional[Tuple[int, int]] = self.last_identity()
         self.bytes_written = 0
         self.records_written = 0
+        self._listeners: List[Callable[[DeltaRecord], None]] = []
+        # Optional retention floor provider (photonrepl installs one): a
+        # callable returning the lowest generation that must survive
+        # compaction, or None when nothing pins the log.
+        self.retention_pin: Optional[Callable[[], Optional[int]]] = None
+
+    # -- listeners ---------------------------------------------------------
+    def add_listener(self, fn: Callable[[DeltaRecord], None]) -> None:
+        """Register a callback fired after each durable append, outside the
+        log lock and in append order (single-writer log).  Listener
+        exceptions are swallowed — fan-out must never poison the publish
+        path."""
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[DeltaRecord], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     # -- inspection --------------------------------------------------------
     def segments(self) -> List[Tuple[int, str]]:
@@ -173,6 +192,11 @@ class DeltaLog:
                     continue
                 out.append((gen, os.path.join(self.path, name)))
         return sorted(out)
+
+    def min_retained_generation(self) -> Optional[int]:
+        """Oldest generation still on disk, or None for an empty log."""
+        segs = self.segments()
+        return segs[0][0] if segs else None
 
     def last_identity(self) -> Optional[Tuple[int, int]]:
         """Identity of the newest valid record, or None for an empty log.
@@ -207,6 +231,11 @@ class DeltaLog:
         if self._registry is not None:
             self._registry.inc("delta_log_bytes_total", len(frame))
             self._registry.inc("delta_log_records_total")
+        for fn in list(self._listeners):
+            try:
+                fn(record)
+            except Exception:  # noqa: BLE001 — see add_listener contract
+                logger.exception("delta log: append listener failed")
 
     def _segment_for(self, generation: int) -> IO[bytes]:
         if self._file is not None and self._file_generation == generation:
@@ -278,12 +307,29 @@ class DeltaLog:
     # -- compaction --------------------------------------------------------
     def compact(self, active_generation: int) -> List[int]:
         """Drop segments older than the active generation (their deltas are
-        baked into — or superseded by — the active snapshot).  Returns the
-        dropped generations."""
+        baked into — or superseded by — the active snapshot).  When a
+        ``retention_pin`` provider is installed (photonrepl: minimum
+        acknowledged follower generation), segments at or above the pinned
+        generation survive even if the owner has moved past them, so slow
+        followers can still resume via log replay.  Returns the dropped
+        generations."""
+        floor = active_generation
+        if self.retention_pin is not None:
+            try:
+                pin = self.retention_pin()
+            except Exception:  # noqa: BLE001 — pin must not block compaction
+                logger.exception("delta log: retention pin provider failed")
+                pin = None
+            if pin is not None and pin < floor:
+                floor = pin
+                logger.info(
+                    "delta log: compaction floor pinned at gen %d "
+                    "(active gen %d) by a connected follower",
+                    floor, active_generation)
         dropped = []
         with self._lock:
             for gen, path in self.segments():
-                if gen >= active_generation:
+                if gen >= floor:
                     continue
                 if self._file_generation == gen:
                     self._close_current()
@@ -298,5 +344,5 @@ class DeltaLog:
                                len(dropped))
         if dropped:
             logger.info("delta log: compacted %d segment(s) older than gen "
-                        "%d", len(dropped), active_generation)
+                        "%d", len(dropped), floor)
         return dropped

@@ -1,0 +1,42 @@
+"""photonrepl — the network replication plane for photonlearn.
+
+The delta log (online/delta_log.py) made coefficient updates durable and
+ordered; catch-up (online/catchup.py) made them replayable; but PR 9 left
+replicas tailing the owner's log through a SHARED DIRECTORY, with no
+bootstrap path for a brand-new replica and an owner that compacts with no
+regard for slow followers.  This package closes all three gaps:
+
+  - :mod:`server` — asyncio TCP log server on the delta-log owner.  Streams
+    CRC-carried records to subscribers, serves checksummed model-dir
+    tarstream snapshots for bootstrap, pins the owner's compaction floor at
+    the minimum acknowledged follower identity (with byte/age caps so a
+    dead follower cannot pin the log forever), and bounds per-follower
+    send queues with log catch-up on overflow.
+  - :mod:`client` — replica-side subscriber.  Bootstraps from a snapshot
+    RPC when it has no usable state, then mirrors the live record stream
+    into a LOCAL delta log, so every existing consumer — ``LogFollower``
+    tailing, ``HotSwapper`` replay-before-activate — works on the mirror
+    unchanged.  ``serve.py --subscribe host:port`` replaces the
+    shared-directory requirement end to end.
+  - :mod:`snapshot` — deterministic model-dir tar packing/unpacking with a
+    whole-stream CRC32.
+  - :mod:`wire` — the framed line schema shared by both ends (bounded
+    newline JSON via ``serving/frontend/protocol.py``, record payloads
+    bit-identical to the on-disk log frames).
+"""
+
+from photon_ml_tpu.online.replication.client import (ReplicationClient,
+                                                     ReplicationClientConfig)
+from photon_ml_tpu.online.replication.server import (ReplicationConfig,
+                                                     ReplicationServer,
+                                                     ThreadedReplicationServer,
+                                                     attach_replication)
+
+__all__ = [
+    "ReplicationClient",
+    "ReplicationClientConfig",
+    "ReplicationConfig",
+    "ReplicationServer",
+    "ThreadedReplicationServer",
+    "attach_replication",
+]

@@ -1,0 +1,344 @@
+"""photonrepl replica client: subscribe, bootstrap, mirror.
+
+The client's one structural idea: it does NOT apply records to a store.
+It mirrors the owner's record stream into a LOCAL delta log (the
+"mirror", a plain ``online/delta_log.DeltaLog`` in the spool directory),
+so every existing consumer works on the mirror unchanged — the serving
+process attaches it exactly like a shared-directory ``--delta-log``:
+``LogFollower`` tails it live, and ``HotSwapper`` replays it before
+activating any hot-swapped generation.  The wire CRC is checked before a
+record touches the mirror, and the mirror frame is bit-identical to the
+owner's durable frame.
+
+Spool layout (``spool_dir``)::
+
+    log/                 the mirror delta log
+    base-<gen>-<n>/      extracted snapshot model dirs (latest two kept)
+    state.json           {"floor": G, "base": "<dir>"}
+
+Lifecycle: connect -> subscribe (last applied identity + base floor +
+optional auth token) -> the server replies ``mode=log`` (live records
+follow immediately) or ``mode=snapshot`` (a checksummed model-dir
+tarstream precedes them).  Snapshot frames can ALSO arrive mid-stream —
+that is the owner hot-swapping; the client extracts the new base and
+invokes ``on_snapshot(model_dir, generation)`` so the serving process
+hot-swaps with replay-before-activate off the mirror.  On any error or a
+``{"repl": "restart"}`` frame the client reconnects with exponential
+backoff and re-subscribes from its mirror identity — the server decides
+log replay vs snapshot from there.
+
+Acks flow upstream every ``ack_every`` records (or ``ack_interval_s`` of
+idle): they are what the owner's retention floor and lag gauges key on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from photon_ml_tpu.obs.trace import instant as obs_instant
+from photon_ml_tpu.online.delta_log import DeltaLog
+from photon_ml_tpu.online.replication.snapshot import (SnapshotError,
+                                                       unpack_snapshot)
+from photon_ml_tpu.online.replication.wire import (WireError,
+                                                   decode_record_obj,
+                                                   parse_identity, parse_line)
+from photon_ml_tpu.serving.frontend.protocol import (DEFAULT_MAX_LINE_BYTES,
+                                                     BoundedLineReader,
+                                                     LineTooLong, encode)
+
+logger = logging.getLogger("photon_ml_tpu.online.replication")
+
+_MAX_SNAPSHOT_BYTES = 4 << 30  # refuse a header promising more than this
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicationClientConfig:
+    host: str
+    port: int
+    spool_dir: str
+    auth_token: Optional[str] = None
+    max_line_bytes: int = DEFAULT_MAX_LINE_BYTES
+    connect_timeout_s: float = 10.0
+    ack_every: int = 64
+    ack_interval_s: float = 1.0
+    backoff_initial_s: float = 0.2
+    backoff_max_s: float = 5.0
+    # mirror durability: "rotate" keeps warm-restart resume cheap without
+    # paying an fsync per record on the replica's apply path
+    mirror_fsync: str = "rotate"
+
+
+class ReplicationClient:
+    """Threaded subscriber feeding one spool directory (module docstring).
+
+    ``on_snapshot(model_dir, generation)`` runs on the client thread after
+    a snapshot is extracted and the spool state updated; the serving
+    process wires it to ``HotSwapper.swap`` (``cli/serve.py
+    --subscribe``).  It is NOT called for the snapshot consumed by
+    ``bootstrap()`` — the caller builds its first engine from
+    ``model_dir`` directly.
+    """
+
+    def __init__(self, config: ReplicationClientConfig,
+                 on_snapshot: Optional[Callable[[str, int], None]] = None,
+                 registry=None):
+        self.config = config
+        self.on_snapshot = on_snapshot
+        self._registry = registry
+        os.makedirs(config.spool_dir, exist_ok=True)
+        self.mirror_path = os.path.join(config.spool_dir, "log")
+        self._state_path = os.path.join(config.spool_dir, "state.json")
+        self.floor: Optional[int] = None
+        self.model_dir: Optional[str] = None
+        self._load_state()
+        self._mirror = DeltaLog(self.mirror_path,
+                                fsync=config.mirror_fsync)
+        if self.floor is not None:
+            # warm spool: mirror records below the base's floor describe a
+            # superseded lineage (the owner swapped mid-stream in a past
+            # life) — drop them so a replay of the mirror never applies
+            # them onto this or a newer base
+            self._mirror.compact(self.floor)
+        self._last = self._mirror.last_identity()
+        self._bootstrapped = threading.Event()
+        if self.model_dir is not None:
+            self._bootstrapped.set()  # warm spool: base already on disk
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="photonrepl-client")
+        self._snapshot_seq = 0
+        self.last_resume_mode: Optional[str] = None
+        self.records_applied = 0
+        self.snapshots_received = 0
+        self.reconnects = 0
+        self._error: Optional[BaseException] = None
+
+    # -- state file --------------------------------------------------------
+    def _load_state(self) -> None:
+        try:
+            with open(self._state_path, "r", encoding="utf-8") as f:
+                state = json.load(f)
+            floor = state.get("floor")
+            base = state.get("base")
+            if isinstance(floor, int) and isinstance(base, str) and \
+                    os.path.isdir(base):
+                self.floor = floor
+                self.model_dir = base
+        except (OSError, json.JSONDecodeError):
+            pass  # cold spool
+
+    def _save_state(self) -> None:
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"floor": self.floor, "base": self.model_dir}, f)
+        os.replace(tmp, self._state_path)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ReplicationClient":
+        self._thread.start()
+        return self
+
+    def bootstrap(self, timeout: float = 60.0) -> str:
+        """Block until a base model directory is available (warm spool, or
+        the first snapshot landed) and return it."""
+        if not self._bootstrapped.wait(timeout):
+            raise RuntimeError(
+                f"replication bootstrap did not complete within {timeout}s"
+                + (f": {self._error}" if self._error else ""))
+        assert self.model_dir is not None
+        return self.model_dir
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+
+    @property
+    def last_identity(self) -> Optional[Tuple[int, int]]:
+        return self._last
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:  # pragma: no cover - defensive
+            self._error = e
+            logger.exception("photonrepl client died")
+
+    async def _main(self) -> None:
+        backoff = self.config.backoff_initial_s
+        first = True
+        while not self._stop.is_set():
+            if not first:
+                self.reconnects += 1
+                if self._registry is not None:
+                    self._registry.inc("repl_client_reconnects_total")
+            first = False
+            try:
+                await self._session()
+                backoff = self.config.backoff_initial_s
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    EOFError, WireError, SnapshotError, LineTooLong) as e:
+                self._error = e
+                logger.warning("photonrepl client: session ended: %s", e)
+            if self._stop.is_set():
+                return
+            deadline = time.monotonic() + backoff
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            backoff = min(backoff * 2, self.config.backoff_max_s)
+
+    # -- one connection ----------------------------------------------------
+    async def _session(self) -> None:
+        cfg = self.config
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(cfg.host, cfg.port),
+            cfg.connect_timeout_s)
+        try:
+            br = BoundedLineReader(reader.read, cfg.max_line_bytes)
+            hello = {"cmd": "subscribe",
+                     "last": list(self._last) if self._last else None,
+                     "floor": self.floor}
+            if cfg.auth_token is not None:
+                hello["token"] = cfg.auth_token
+            writer.write(encode(hello))
+            await writer.drain()
+            line = await asyncio.wait_for(br.readline(),
+                                          cfg.connect_timeout_s)
+            if line is None:
+                raise ConnectionError("server closed during subscribe")
+            obj = parse_line(line)
+            if "error" in obj:
+                raise ConnectionError(f"subscribe refused: {obj['error']}")
+            if obj.get("repl") != "resume":
+                raise WireError(f"expected resume, got {obj!r}")
+            mode = obj.get("mode")
+            self.last_resume_mode = mode
+            if self._registry is not None:
+                self._registry.inc("repl_client_resume_total", mode=mode)
+            obs_instant("repl.client.resume", mode=mode)
+            if mode == "snapshot" and self._last is not None:
+                # our spool lineage is dead (owner swapped past us or we
+                # diverged): the incoming stream restarts identity-fresh
+                self._reset_mirror()
+            await self._stream(f=br, writer=writer)
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — best-effort close
+                pass
+
+    async def _stream(self, f: BoundedLineReader,
+                      writer: asyncio.StreamWriter) -> None:
+        unacked = 0
+        last_ack = time.monotonic()
+
+        async def _ack(force: bool = False) -> None:
+            nonlocal unacked, last_ack
+            now = time.monotonic()
+            due = unacked >= self.config.ack_every or (
+                unacked > 0 and now - last_ack >= self.config.ack_interval_s)
+            if not (force or due):
+                return
+            if self._last is not None:
+                writer.write(encode({"cmd": "ack",
+                                     "last": list(self._last)}))
+                await writer.drain()
+            unacked = 0
+            last_ack = now
+
+        while not self._stop.is_set():
+            try:
+                line = await asyncio.wait_for(
+                    f.readline(), self.config.ack_interval_s)
+            except asyncio.TimeoutError:
+                await _ack()
+                continue
+            if line is None:
+                await _ack(force=unacked > 0)
+                raise ConnectionError("server closed the stream")
+            if not line.strip():
+                continue
+            obj = parse_line(line)
+            kind = obj.get("repl")
+            if kind == "delta":
+                rec = decode_record_obj(obj)
+                if self._last is None or rec.identity > self._last:
+                    self._mirror.append(rec)
+                    self._last = rec.identity
+                    self.records_applied += 1
+                    unacked += 1
+                    if self._registry is not None:
+                        self._registry.inc("repl_client_records_total")
+                await _ack()
+            elif kind == "snapshot":
+                await self._take_snapshot(f, obj)
+                await _ack(force=True)
+            elif kind == "restart":
+                reason = obj.get("reason")
+                logger.info("photonrepl client: server asked for restart "
+                            "(%s)", reason)
+                raise ConnectionError(f"server restart: {reason}")
+            elif "error" in obj:
+                raise ConnectionError(f"server error: {obj['error']}")
+            # unknown repl kinds are ignored: forward compatibility
+
+    async def _take_snapshot(self, f: BoundedLineReader, obj: dict) -> None:
+        try:
+            nbytes = int(obj["bytes"])
+            crc = int(obj["crc32"])
+            gen = int(obj["generation"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f"malformed snapshot header: {e}") from e
+        if not 0 <= nbytes <= _MAX_SNAPSHOT_BYTES:
+            raise WireError(f"implausible snapshot size {nbytes}")
+        data = await f.readexactly(nbytes)
+        self._snapshot_seq += 1
+        dest = os.path.join(self.config.spool_dir,
+                            f"base-{gen:010d}-{self._snapshot_seq}")
+        unpack_snapshot(data, crc, dest)  # raises SnapshotError on mismatch
+        prev_dir = self.model_dir
+        first = not self._bootstrapped.is_set()
+        self.model_dir = dest
+        self.floor = gen
+        self._save_state()
+        # the new base supersedes every mirrored record below its
+        # generation; compacting here keeps warm restarts clean too
+        self._mirror.compact(gen)
+        self.snapshots_received += 1
+        if self._registry is not None:
+            self._registry.inc("repl_client_snapshots_total")
+        obs_instant("repl.client.snapshot", generation=gen, nbytes=nbytes)
+        logger.info("photonrepl client: snapshot gen %d (%d bytes) -> %s",
+                    gen, nbytes, dest)
+        if first:
+            self._bootstrapped.set()
+        elif self.on_snapshot is not None:
+            # mid-stream owner swap: hand the new base to the serving
+            # process (HotSwapper replays the mirror before activating)
+            self.on_snapshot(dest, gen)
+        if prev_dir and prev_dir != dest and \
+                os.path.dirname(os.path.abspath(prev_dir)) == \
+                os.path.abspath(self.config.spool_dir):
+            shutil.rmtree(prev_dir, ignore_errors=True)
+
+    def _reset_mirror(self) -> None:
+        """The spool's lineage no longer matches the owner: wipe the
+        mirror so the fresh stream starts on a clean identity chain."""
+        self._mirror.close()
+        for name in os.listdir(self.mirror_path):
+            if name.startswith("segment-") and name.endswith(".log"):
+                try:
+                    os.remove(os.path.join(self.mirror_path, name))
+                except OSError:
+                    pass
+        self._mirror = DeltaLog(self.mirror_path,
+                                fsync=self.config.mirror_fsync)
+        self._last = None
+        logger.info("photonrepl client: mirror reset for a fresh lineage")

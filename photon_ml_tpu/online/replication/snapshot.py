@@ -1,0 +1,98 @@
+"""Model-dir tarstream packing for photonrepl's snapshot bootstrap RPC.
+
+A replica with no usable state (fresh spool, or compaction passed its
+identity) cannot be caught up by log replay — it needs the owner's BASE:
+the model directory the serving store was built from.  The snapshot RPC
+ships that directory as one uncompressed tar with a whole-stream CRC32 in
+the framed header, and the replica rebuilds its engine from the extracted
+copy exactly as if it had been pointed at the directory locally
+(``storage/model_io.load_model_bundle`` resolves both the flat and
+``best/``-nested layouts, so the tar simply preserves the tree).
+
+Packing is DETERMINISTIC — sorted member order, zeroed timestamps and
+ownership — so two snapshots of an unchanged directory are byte-identical
+and the CRC is a meaningful identity, not an mtime lottery.
+
+Unpacking is DEFENSIVE: only regular files and directories, no absolute
+paths, no ``..`` traversal, no links — the tar comes over a network socket
+and must not be able to write outside its destination.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import zlib
+from typing import Tuple
+
+
+class SnapshotError(ValueError):
+    """A snapshot stream was rejected (checksum, framing, or a member that
+    tried to escape the destination directory)."""
+
+
+def pack_model_dir(model_dir: str) -> Tuple[bytes, int]:
+    """Tar ``model_dir`` (deterministically) -> ``(data, crc32)``."""
+    if not os.path.isdir(model_dir):
+        raise SnapshotError(f"snapshot source is not a directory: "
+                            f"{model_dir!r}")
+    members = []
+    for root, dirs, files in os.walk(model_dir):
+        dirs.sort()
+        rel_root = os.path.relpath(root, model_dir)
+        if rel_root != ".":
+            members.append((rel_root, None))
+        for name in sorted(files):
+            rel = os.path.join(rel_root, name) if rel_root != "." else name
+            members.append((rel, os.path.join(root, name)))
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.PAX_FORMAT) as tf:
+        for rel, path in sorted(members):
+            if path is None:
+                info = tarfile.TarInfo(rel)
+                info.type = tarfile.DIRTYPE
+                info.mode = 0o755
+                tf.addfile(info)
+                continue
+            info = tarfile.TarInfo(rel)
+            info.size = os.path.getsize(path)
+            info.mode = 0o644
+            info.mtime = 0
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            with open(path, "rb") as f:
+                tf.addfile(info, f)
+    data = buf.getvalue()
+    return data, zlib.crc32(data)
+
+
+def unpack_snapshot(data: bytes, crc32: int, dest_dir: str) -> None:
+    """Verify the stream CRC and extract into ``dest_dir`` (created fresh).
+    Raises :class:`SnapshotError` on checksum mismatch or any member that
+    is not a plain file/directory safely inside the destination."""
+    if zlib.crc32(data) != crc32:
+        raise SnapshotError("snapshot stream failed its CRC32 check")
+    os.makedirs(dest_dir, exist_ok=True)
+    dest_real = os.path.realpath(dest_dir)
+    try:
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tf:
+            for info in tf:
+                target = os.path.realpath(os.path.join(dest_dir, info.name))
+                if target != dest_real and not target.startswith(
+                        dest_real + os.sep):
+                    raise SnapshotError(
+                        f"snapshot member escapes destination: {info.name!r}")
+                if info.isdir():
+                    os.makedirs(target, exist_ok=True)
+                elif info.isreg():
+                    os.makedirs(os.path.dirname(target), exist_ok=True)
+                    src = tf.extractfile(info)
+                    assert src is not None  # isreg() members are readable
+                    with open(target, "wb") as out:
+                        out.write(src.read())
+                else:
+                    raise SnapshotError(
+                        f"snapshot member has forbidden type: {info.name!r}")
+    except tarfile.TarError as e:
+        raise SnapshotError(f"unreadable snapshot tar: {e}") from e

@@ -1,0 +1,98 @@
+"""photonrepl wire schema: bounded newline-JSON control lines, plus one
+binary interlude for the snapshot tarstream.
+
+Both ends frame control traffic exactly like the serving front end
+(``serving/frontend/protocol.py``): one JSON object per line under a hard
+byte bound, so a malformed or malicious peer cannot grow either side's
+receive buffer without limit.  Record lines carry the delta-log payload
+TEXT verbatim with the SAME CRC32 the on-disk frame carries
+(``online/delta_log.py``) — a record that survives the wire check is
+bit-identical to the owner's durable frame, and appending it to the
+replica's mirror log re-creates the owner's bytes.
+
+Lines, client -> server::
+
+    {"cmd": "subscribe", "last": [gen, ver] | null, "token": "..."?}
+    {"cmd": "ack", "last": [gen, ver]}
+
+Lines, server -> client::
+
+    {"error": "..."}                               # one frame, then close
+    {"repl": "resume", "mode": "log" | "snapshot",
+     "generation": G, "floor": F}                  # first reply
+    {"repl": "snapshot", "bytes": N, "crc32": C,
+     "generation": G, "version": "..."}            # then N raw tar bytes
+    {"repl": "delta", "crc": C, "p": "<payload>"}  # one log record
+    {"repl": "restart", "reason": "..."}           # re-subscribe from scratch
+
+``floor`` is the owner's base generation — the generation at which the
+currently-serving model directory was activated.  Every streamed record
+has ``generation >= floor``; anything older is baked into (or superseded
+by) the snapshot the client holds.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Optional, Tuple
+
+from photon_ml_tpu.online.delta_log import DeltaRecord
+
+_LEN_CRC = struct.Struct("<II")  # delta_log frame header: payload len, crc
+
+
+class WireError(ValueError):
+    """A peer sent a frame that violates the schema or its checksum."""
+
+
+def encode_record_line(record: DeltaRecord) -> bytes:
+    """One ``{"repl": "delta"}`` line.  The payload text and CRC are lifted
+    from ``DeltaRecord.encode()`` so they are bit-identical to the owner's
+    on-disk frame — no second serialization that could round differently."""
+    frame = record.encode()
+    _, crc = _LEN_CRC.unpack_from(frame)
+    payload = frame[_LEN_CRC.size:].decode("utf-8")
+    return (json.dumps({"repl": "delta", "crc": crc, "p": payload},
+                       separators=(",", ":")) + "\n").encode("utf-8")
+
+
+def decode_record_obj(obj: dict) -> DeltaRecord:
+    """Parse + CRC-verify a ``{"repl": "delta"}`` object.  Raises
+    :class:`WireError` on any mismatch — a corrupt record must never reach
+    the mirror log."""
+    try:
+        payload = str(obj["p"]).encode("utf-8")
+        crc = int(obj["crc"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed delta frame: {e}") from e
+    if zlib.crc32(payload) != crc:
+        raise WireError("delta frame failed its CRC32 check")
+    try:
+        return DeltaRecord.decode_payload(payload)
+    except (ValueError, KeyError, TypeError) as e:
+        raise WireError(f"undecodable delta payload: {e}") from e
+
+
+def parse_identity(value) -> Optional[Tuple[int, int]]:
+    """``[gen, ver]`` -> tuple, ``None`` passed through.  Raises
+    :class:`WireError` on anything else."""
+    if value is None:
+        return None
+    try:
+        gen, ver = value
+        return (int(gen), int(ver))
+    except (TypeError, ValueError) as e:
+        raise WireError(f"malformed identity {value!r}") from e
+
+
+def parse_line(line: bytes) -> dict:
+    """One wire line -> dict, schema errors typed."""
+    try:
+        obj = json.loads(line.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"undecodable wire line: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireError("wire line is not a JSON object")
+    return obj

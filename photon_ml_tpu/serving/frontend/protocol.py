@@ -119,6 +119,26 @@ class BoundedLineReader:
             else:
                 self._buf.extend(chunk)
 
+    async def readexactly(self, n: int) -> bytes:
+        """Exactly ``n`` raw bytes from the stream (photonrepl's snapshot
+        tarstream rides between two framed lines).  The line bound does not
+        apply — the caller announced the byte count in a bounded control
+        line first.  Raises :class:`EOFError` on a short stream."""
+        if n < 0:
+            raise ValueError(f"readexactly: negative count {n}")
+        while len(self._buf) < n and not self._eof:
+            chunk = await self._read(_READ_CHUNK)
+            if not chunk:
+                self._eof = True
+            else:
+                self._buf.extend(chunk)
+        if len(self._buf) < n:
+            raise EOFError(
+                f"stream ended after {len(self._buf)} of {n} bytes")
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
 
 def iter_bounded_lines(f, max_line_bytes: int = DEFAULT_MAX_LINE_BYTES
                        ) -> Iterator[Union[str, LineTooLong]]:

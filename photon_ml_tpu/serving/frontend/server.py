@@ -42,6 +42,13 @@ What makes it an edge rather than a socket wrapper:
   - **Connection cap** (``FrontendConfig.max_connections``): accepts past
     the cap get one ``{"error": "too_many_connections"}`` line and a clean
     close before any per-connection state is allocated.
+  - **Shared-secret auth** (``FrontendConfig.auth_token``, off by
+    default): the FIRST line of every connection must then be
+    ``{"cmd": "auth", "token": "..."}``; the compare is constant-time
+    (``hmac.compare_digest``) and anything else — wrong token, missing
+    line, timeout — gets exactly one ``{"error": "unauthorized"}`` frame
+    and a close (``front_auth_failures_total``).  A good token is answered
+    with ``{"auth": "ok"}`` and the normal wire protocol follows.
 
 Observability: photonscope spans/instants ``front.accept`` /
 ``front.admit`` / ``front.shed`` / ``front.refuse`` / ``front.drain`` and
@@ -69,6 +76,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hmac
 import json
 import logging
 import threading
@@ -117,6 +125,11 @@ class FrontendConfig:
     # connection storm cannot exhaust fds or per-conn task memory.
     # None = unlimited.
     max_connections: Optional[int] = None
+    # shared secret: when set, the first line of every connection must be
+    # {"cmd": "auth", "token": ...} (constant-time compare; one error
+    # frame, then close).  None = open listener.
+    auth_token: Optional[str] = None
+    auth_timeout_s: float = 10.0
 
 
 class _Conn:
@@ -264,9 +277,42 @@ class FrontendServer:
             self._registry.set_gauge("front_connections", len(self._conns))
             self._registry.set_gauge("front_queue_depth", 0, client=cid)
 
+    async def _authenticate(self, conn: _Conn,
+                            lines: BoundedLineReader) -> bool:
+        """First-line shared-secret handshake.  Anything but a good token
+        — wrong secret, malformed line, oversize, timeout — costs exactly
+        one ``{"error": "unauthorized"}`` frame and the connection."""
+        try:
+            raw = await asyncio.wait_for(lines.readline(),
+                                         self.config.auth_timeout_s)
+        except (asyncio.TimeoutError, LineTooLong):
+            raw = None
+        token = ""
+        if raw is not None:
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                obj = None
+            if isinstance(obj, dict) and obj.get("cmd") == "auth" and \
+                    isinstance(obj.get("token"), str):
+                token = obj["token"]
+        if not hmac.compare_digest(token.encode("utf-8"),
+                                   self.config.auth_token.encode("utf-8")):
+            self._registry.inc("front_auth_failures_total")
+            obs_instant("front.auth_fail", client=conn.cid)
+            logger.warning("photonfront: rejected unauthenticated "
+                           "connection %s", conn.cid)
+            self._reply_now(conn, error_reply("unauthorized"))
+            return False
+        self._reply_now(conn, {"auth": "ok"})
+        return True
+
     async def _conn_reader(self, conn: _Conn) -> None:
         lines = BoundedLineReader(conn.reader.read,
                                   self.config.max_line_bytes)
+        if self.config.auth_token is not None:
+            if not await self._authenticate(conn, lines):
+                return
         while True:
             try:
                 raw = await lines.readline()

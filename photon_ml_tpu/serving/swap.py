@@ -54,7 +54,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -85,15 +85,68 @@ class HotSwapper:
         # one generation
         self._swap_lock = threading.Lock()
         self.delta_version = 0  # deltas applied to the CURRENT generation
+        # (model_dir, replay_floor) of the serving base, written as ONE
+        # tuple so cross-thread readers (a photonrepl snapshot source)
+        # never see a torn pair.  The floor is the LOG generation the base
+        # was activated at: replay skips records below it.  Compaction
+        # normally drops those, but a photonrepl retention pin can keep
+        # pre-swap segments alive for a lagging subscriber — and those
+        # records belong to a SUPERSEDED base, never to this one.
+        self._base: Tuple[Optional[str], int] = (None, 0)
+        # photonrepl hook, called as on_swap(model_dir, generation) after a
+        # successful activate+compact — lets a replication server raise its
+        # base floor and ship the new snapshot to live subscribers
+        self.on_swap: Optional[Callable[[str, int], None]] = None
+        # When True (set by online.replication.attach_replication), an
+        # owner's swap treats the incoming base as AUTHORITATIVE: pre-swap
+        # log records are not replayed onto it.  A replicated owner's live
+        # state must stay derivable as ``snapshot dir + retained records at
+        # or above the floor`` — replaying records that compaction then
+        # drops would leave the owner serving rows no subscriber can ever
+        # bootstrap.  Without replication the default (replay everything
+        # retained) keeps standalone owners from stepping back past online
+        # updates on a same-dir reload.
+        self.base_supersedes_log = False
 
     @property
     def identity(self) -> Tuple[int, int]:
         """The live coefficient state's ``(generation, delta_version)``."""
         return (self.engine.store.generation, self.delta_version)
 
-    def swap(self, model_dir: str, version: str = "") -> bool:
+    @property
+    def model_dir(self) -> Optional[str]:
+        """Directory of the serving base (None until set_base / a swap)."""
+        return self._base[0]
+
+    @property
+    def replay_floor(self) -> int:
+        """Log generation the serving base was activated at."""
+        return self._base[1]
+
+    def set_base(self, model_dir: Optional[str], replay_floor: int = 0,
+                 ) -> None:
+        """Record the serving base pair (atomic for cross-thread
+        readers).  ``cli/serve.py build_server`` calls this with the dir
+        it loaded; a photonrepl replica passes the owner's floor too."""
+        with self._swap_lock:
+            self._base = (model_dir, int(replay_floor))
+
+    def serving_base(self) -> Tuple[Optional[str], int]:
+        """The ``(model_dir, replay_floor)`` pair, read atomically — the
+        photonrepl owner's snapshot source."""
+        return self._base
+
+    def swap(self, model_dir: str, version: str = "",
+             replay_floor: Optional[int] = None) -> bool:
         """Returns True when the new version is serving; False when the new
-        directory was rejected (the old version keeps serving untouched)."""
+        directory was rejected (the old version keeps serving untouched).
+
+        ``replay_floor`` is the LOG generation the incoming base was built
+        at: replay-before-activate skips records below it.  A photonrepl
+        replica passes the generation shipped with the snapshot (its
+        process-local store generations mean nothing to the owner's log);
+        an owning swapper leaves it None and uses the activated store's own
+        generation, which IS the log generation it mints."""
         metrics = self.engine.metrics
         with obs_span("serve.swap", model_dir=model_dir), self._swap_lock:
             old = self.engine.store
@@ -115,12 +168,27 @@ class HotSwapper:
                 # replay-before-activate: rows the trainer published since
                 # the incoming snapshot was cut replay onto the new store
                 # BEFORE the flip — the generation change never steps back
-                # past an online update.  Full-log ordered replay (not just
-                # the tail): full-row records make it an idempotent
-                # overwrite, and compaction at prior swap boundaries has
-                # already dropped anything the snapshot supersedes.
-                stats = replay_into_store(new, self.delta_log.replay(),
-                                          registry=metrics.registry)
+                # past an online update.  Ordered replay of everything at
+                # or above the current base's floor: full-row records make
+                # it an idempotent overwrite.  Records BELOW the floor are
+                # skipped — compaction usually dropped them already, but a
+                # photonrepl retention pin can keep those segments alive
+                # for a lagging subscriber, and they describe a base this
+                # store superseded.
+                if replay_floor is not None:
+                    floor = replay_floor
+                elif self.log_owner and self.base_supersedes_log:
+                    # replicated owner: the new base supersedes the whole
+                    # retained log (see __init__) — its freshly minted
+                    # generation is above every logged record
+                    floor = new.generation
+                else:
+                    floor = self._base[1]
+                stats = replay_into_store(
+                    new,
+                    (r for r in self.delta_log.replay()
+                     if r.generation >= floor),
+                    registry=metrics.registry)
                 metrics.inc("swap_replayed_deltas", stats.applied)
                 if stats.applied or stats.rejected:
                     logger.info(
@@ -129,12 +197,27 @@ class HotSwapper:
                         stats.rejected)
             self.engine.activate(new)
             self.delta_version = 0  # fresh generation: no deltas yet
+            if replay_floor is not None:
+                new_floor = replay_floor
+            elif self.log_owner:
+                # owner: the activated store's generation is the log's
+                new_floor = new.generation
+            else:
+                # follower without an explicit floor keeps its old floor —
+                # its process-local generations mean nothing to the log
+                new_floor = self._base[1]
+            self._base = (model_dir, new_floor)
             if self.delta_log is not None and self.log_owner:
                 self.delta_log.compact(new.generation)
             metrics.inc("swaps")
             logger.info("hot swap: gen %d (version %r) -> gen %d (version "
                         "%r)", old.generation, old.version, new.generation,
                         new.version)
+            if self.on_swap is not None:
+                try:
+                    self.on_swap(model_dir, new.generation)
+                except Exception:
+                    logger.exception("hot swap: on_swap hook failed")
             return True
 
     def apply_delta(self, cid: str, entity: str, row) -> bool:

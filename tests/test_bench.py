@@ -545,6 +545,44 @@ class TestOnlineBenchCli:
         assert seen["out_path"] == "ignored.json"
 
 
+class TestReplBenchCli:
+    """--repl arg plumbing: flags reach run_repl_bench parsed."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"metric": "repl_store_visible_freshness_ms_p99"}
+
+        monkeypatch.setattr(bench, "run_repl_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--repl", "--repl-replicas", "3",
+            "--repl-batches", "4", "--repl-batch-size", "16",
+            "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["metric"] == \
+            "repl_store_visible_freshness_ms_p99"
+        assert seen["n_replicas"] == 3
+        assert seen["batches"] == 4
+        assert seen["batch_size"] == 16
+        assert seen["out_path"] == "ignored.json"
+
+    def test_defaults(self, monkeypatch, capsys):
+        seen = {}
+        monkeypatch.setattr(bench, "run_repl_bench",
+                            lambda **kw: seen.update(kw) or {})
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--repl"])
+        bench.main()
+        assert seen["n_replicas"] == 2
+        assert seen["batches"] == 8
+        assert seen["batch_size"] == 32
+        assert seen["out_path"] is None
+
+
 class TestStreamBenchCli:
     """--stream arg plumbing: flags reach run_stream_bench parsed, and the
     early dispatch prints the runner's JSON line."""

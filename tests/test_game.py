@@ -606,21 +606,30 @@ def test_golden_coefficients_regression():
     re-captured 2026-07-31 after the approximate-Wolfe line-search slack
     (opt/linesearch.py: f32 solves now stop deterministically at the
     working-precision plateau, shifting iterates by ~2e-5 within the
-    plateau-flat region)."""
+    plateau-flat region);
+    re-captured 2026-08-05 on the current CPU test image — the drift
+    (~5e-4 relative on the per-user rows, ~4e-5 on the fixed effect) is an
+    XLA-version f32 fusion-order shift, present identically at every
+    repo commit back through PR 4, i.e. environmental rather than caused
+    by any code change here.  The f64 reference goldens
+    (test_reference_golden_*) pin cross-implementation correctness and
+    were unaffected.  To regenerate after a LEGITIMATE numeric change:
+    run the fit below and paste ``repr(float(x))`` of each coefficient,
+    then record the cause in this docstring."""
     rng = np.random.default_rng(20260729)
     data, *_ = _glmix_data(rng, n_users=5, per_user=40)
     res = GameEstimator(fused=False).fit(data, [_configs(num_iters=2)])[0]
 
     golden_fixed = np.asarray([
-        -0.34681886434555054, -1.5030170679092407, -0.16299223899841309,
-        1.1834702491760254, 0.5667866468429565, -0.4181666672229767])
+        -0.34681177139282227, -1.5030040740966797, -0.16299287974834442,
+        1.1834511756896973, 0.5667862892150879, -0.41815751791000366])
     np.testing.assert_allclose(res.model["fixed"].coefficients.means,
                                golden_fixed, rtol=1e-4, atol=1e-5)
 
     re_model = res.model["per-user"]
     assert sorted(re_model.slot_of) == [11, 14, 17, 20, 23]
     golden_user0 = np.asarray([
-        0.7988345623016357, 0.15702524781227112, -0.6274757385253906])
+        0.7986433506011963, 0.1569463014602661, -0.6273418068885803])
     np.testing.assert_allclose(re_model.w_stack[re_model.slot_of[11]],
                                golden_user0, rtol=1e-4, atol=1e-5)
 

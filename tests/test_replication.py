@@ -1,0 +1,740 @@
+"""photonrepl tests: delta-log shipping over the network (ISSUE 13).
+
+The contracts under test:
+  - Wire: record lines round-trip bit-identically to the on-disk log
+    frame; a tampered CRC or malformed frame is a typed WireError, never
+    a silent corruption of the mirror.
+  - Snapshot: model-dir tar packing is deterministic (byte-identical for
+    an unchanged dir), CRC-checked, and unpacking refuses traversal and
+    non-file members.
+  - Bootstrap: a replica with an empty spool snapshots the owner's base
+    over the socket and converges BITWISE to the owner's live scores
+    with zero engine recompiles after warm.
+  - Resume: a reconnecting replica with a warm spool resumes via log
+    replay (``repl_resume_total{mode="log"}``); one whose identity was
+    compacted past falls back to a fresh snapshot.
+  - In-stream hot swap: an owner swap ships the new base inline; the
+    replica hot-swaps with replay-before-activate off its mirror and
+    stays bitwise-converged.
+  - Retention: a connected follower's acknowledged identity pins the
+    owner's compaction floor; byte/age caps evict abusive pinners to
+    snapshot-bootstrap instead of letting them pin the log forever.
+  - Auth: both the replication socket and the serving front end refuse a
+    missing/wrong shared secret with exactly one error frame.
+  - Chaos (the regression ISSUE 13 names): torn log tail + owner restart
+    + compaction + follower resume still lands the replica on the
+    owner's identity chain, bitwise-converged.
+"""
+
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.online.catchup import LogFollower
+from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
+from photon_ml_tpu.online.replication import (ReplicationClient,
+                                              ReplicationClientConfig,
+                                              ReplicationConfig,
+                                              ReplicationServer,
+                                              attach_replication)
+from photon_ml_tpu.online.replication.snapshot import (SnapshotError,
+                                                       pack_model_dir,
+                                                       unpack_snapshot)
+from photon_ml_tpu.online.replication.wire import (WireError,
+                                                   decode_record_obj,
+                                                   encode_record_line,
+                                                   parse_identity, parse_line)
+from photon_ml_tpu.serving.batcher import request_from_json
+from photon_ml_tpu.types import TaskType
+
+N_ENT = 12
+D = 3
+NAMES = [f"f{j}" for j in range(D)]
+
+
+def _save_model_dir(path, seed=0):
+    from photon_ml_tpu.storage.model_io import save_game_model
+
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=D)),
+            feature_shard="all", task=task),
+        "user": RandomEffectModel(
+            w_stack=rng.normal(size=(N_ENT, D)) * 0.5,
+            slot_of={i: i for i in range(N_ENT)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    })
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(NAMES)})
+    eidx = EntityIndex()
+    for i in range(N_ENT):
+        eidx.get_or_add(f"user{i}")
+    save_game_model(model, path, {"all": imap}, {"userId": eidx}, task=task)
+    imap.save(os.path.join(path, "all.idx"))
+    eidx.save(os.path.join(path, "userId.entities.json"))
+    return path
+
+
+def _probes():
+    rng = np.random.default_rng(99)
+    out = []
+    for i in range(N_ENT):
+        out.append(request_from_json({
+            "uid": i,
+            "features": [[n, float(v)]
+                         for n, v in zip(NAMES, rng.normal(size=D))],
+            "ids": {"userId": f"user{i}"}}))
+    return out
+
+
+def _scores(engine):
+    return [float(s) for s in engine.score_requests(_probes())]
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _rec(g, v, entity="user1"):
+    return DeltaRecord(generation=g, delta_version=v, cid="user",
+                       entity=entity,
+                       row=tuple(float(j) + 0.5 * v for j in range(D)))
+
+
+class _Owner:
+    """In-process photonrepl owner: engine + owning swapper + log server."""
+
+    def __init__(self, tmp_path, warm=False, auth_token=None,
+                 config_kwargs=None):
+        from photon_ml_tpu.cli.serve import build_server
+
+        self.base_dir = _save_model_dir(str(tmp_path / "base"), seed=0)
+        self.log = DeltaLog(str(tmp_path / "owner-log"), fsync="rotate")
+        self.engine, self.swapper = build_server(
+            self.base_dir, max_batch=4, warm=warm,
+            delta_log=self.log, log_owner=True)
+        kw = dict(config_kwargs or {})
+        kw.setdefault("auth_token", auth_token)
+        self.repl = attach_replication(
+            self.swapper, ReplicationConfig(**kw),
+            registry=self.engine.metrics.registry)
+        self.port = self.repl.port
+        self.registry = self.engine.metrics.registry
+
+    def publish(self, n=1, seed=1):
+        rng = np.random.default_rng(seed)
+        dim = self.engine.store.coordinates["user"].dim
+        out = []
+        for _ in range(n):
+            ent = f"user{int(rng.integers(0, N_ENT))}"
+            identity = self.swapper.publish_delta(
+                "user", ent, rng.normal(size=dim))
+            assert identity is not None
+            out.append(identity)
+        return out
+
+    def swap(self, tmp_path, name, seed):
+        new_dir = _save_model_dir(str(tmp_path / name), seed=seed)
+        assert self.swapper.swap(new_dir) is True
+        return new_dir
+
+    def close(self):
+        self.repl.stop()
+        self.log.close()
+
+
+class _Replica:
+    """Replica: client + spool + engine fed by the mirror (serve.py
+    --subscribe wiring, in-process)."""
+
+    def __init__(self, owner_port, spool, warm=False, auth_token=None,
+                 ack_every=1, bootstrap_timeout=20.0):
+        from photon_ml_tpu.cli.serve import build_server
+        from photon_ml_tpu.serving.metrics import ServingMetrics
+
+        self.metrics = ServingMetrics()
+        self.client = ReplicationClient(
+            ReplicationClientConfig(host="127.0.0.1", port=owner_port,
+                                    spool_dir=str(spool),
+                                    auth_token=auth_token,
+                                    ack_every=ack_every,
+                                    ack_interval_s=0.05,
+                                    backoff_initial_s=0.05),
+            registry=self.metrics.registry).start()
+        model_dir = self.client.bootstrap(timeout=bootstrap_timeout)
+        self.mirror = DeltaLog(self.client.mirror_path, fsync="never")
+        self.engine, self.swapper = build_server(
+            model_dir, max_batch=4, warm=warm, metrics=self.metrics,
+            delta_log=self.mirror, log_owner=False)
+        self.swapper.set_base(model_dir, self.client.floor or 0)
+        self.client.on_snapshot = \
+            lambda d, g: self.swapper.swap(d, replay_floor=g)
+        if self.client.model_dir != model_dir:
+            self.swapper.swap(self.client.model_dir,
+                              replay_floor=self.client.floor)
+        self.follower = LogFollower(self.mirror, lambda: self.engine.store,
+                                    poll_interval_s=0.01,
+                                    registry=self.metrics.registry)
+        self.follower.run_once()
+        self.follower.start()
+
+    def converge_to(self, identity, timeout=15.0):
+        """Wait until the mirror AND the serving store reach ``identity``."""
+        _wait(lambda: self.client.last_identity == identity,
+              timeout, f"mirror at {identity}")
+        _wait(lambda: self.follower.position == identity,
+              timeout, f"store at {identity}")
+
+    def close(self):
+        self.follower.stop()
+        self.client.stop()
+        self.mirror.close()
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+class TestWire:
+    def test_record_line_round_trips_bitwise(self):
+        rec = _rec(3, 7, entity="userX")
+        line = encode_record_line(rec)
+        obj = json.loads(line.decode("utf-8"))
+        assert obj["repl"] == "delta"
+        got = decode_record_obj(obj)
+        assert got == rec  # frozen dataclass equality: rows bitwise too
+        # the wire payload IS the on-disk frame payload
+        assert obj["p"].encode("utf-8") == rec.encode()[8:]
+
+    def test_tampered_payload_rejected(self):
+        obj = json.loads(encode_record_line(_rec(1, 1)).decode("utf-8"))
+        obj["p"] = obj["p"].replace("user1", "user2")
+        with pytest.raises(WireError, match="CRC32"):
+            decode_record_obj(obj)
+
+    def test_malformed_delta_frames(self):
+        with pytest.raises(WireError):
+            decode_record_obj({"repl": "delta"})
+        with pytest.raises(WireError):
+            decode_record_obj({"repl": "delta", "p": "x", "crc": "nan"})
+
+    def test_parse_identity(self):
+        assert parse_identity(None) is None
+        assert parse_identity([3, 4]) == (3, 4)
+        with pytest.raises(WireError):
+            parse_identity("nope")
+        with pytest.raises(WireError):
+            parse_identity([1, 2, 3])
+
+    def test_parse_line(self):
+        assert parse_line(b'{"a": 1}') == {"a": 1}
+        with pytest.raises(WireError):
+            parse_line(b"[1, 2]")
+        with pytest.raises(WireError):
+            parse_line(b"{nope")
+
+
+# ---------------------------------------------------------------------------
+# snapshot tarstream
+# ---------------------------------------------------------------------------
+class TestSnapshot:
+    def test_round_trip_and_determinism(self, tmp_path):
+        src = _save_model_dir(str(tmp_path / "m"))
+        data1, crc1 = pack_model_dir(src)
+        # mtime churn must not change the bytes (CRC is an identity, not
+        # an mtime lottery)
+        for root, _, files in os.walk(src):
+            for f in files:
+                os.utime(os.path.join(root, f))
+        data2, crc2 = pack_model_dir(src)
+        assert data1 == data2 and crc1 == crc2
+        dest = str(tmp_path / "out")
+        unpack_snapshot(data1, crc1, dest)
+        walk = {os.path.relpath(os.path.join(r, f), dest)
+                for r, _, fs in os.walk(dest) for f in fs}
+        src_walk = {os.path.relpath(os.path.join(r, f), src)
+                    for r, _, fs in os.walk(src) for f in fs}
+        assert walk == src_walk
+        for rel in src_walk:
+            with open(os.path.join(src, rel), "rb") as a, \
+                    open(os.path.join(dest, rel), "rb") as b:
+                assert a.read() == b.read()
+
+    def test_crc_mismatch_rejected(self, tmp_path):
+        data, crc = pack_model_dir(_save_model_dir(str(tmp_path / "m")))
+        with pytest.raises(SnapshotError, match="CRC32"):
+            unpack_snapshot(data, crc ^ 1, str(tmp_path / "out"))
+
+    def test_traversal_member_rejected(self, tmp_path):
+        import io
+        import tarfile
+        import zlib
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            info = tarfile.TarInfo("../evil.txt")
+            info.size = 4
+            tf.addfile(info, io.BytesIO(b"boom"))
+        data = buf.getvalue()
+        with pytest.raises(SnapshotError, match="escapes"):
+            unpack_snapshot(data, zlib.crc32(data), str(tmp_path / "out"))
+        assert not os.path.exists(str(tmp_path / "evil.txt"))
+
+    def test_link_member_rejected(self, tmp_path):
+        import io
+        import tarfile
+        import zlib
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            info = tarfile.TarInfo("link")
+            info.type = tarfile.SYMTYPE
+            info.linkname = "/etc/passwd"
+            tf.addfile(info)
+        data = buf.getvalue()
+        with pytest.raises(SnapshotError):
+            unpack_snapshot(data, zlib.crc32(data), str(tmp_path / "out"))
+
+    def test_missing_source_rejected(self, tmp_path):
+        with pytest.raises(SnapshotError, match="not a directory"):
+            pack_model_dir(str(tmp_path / "nope"))
+
+
+# ---------------------------------------------------------------------------
+# bootstrap + live tail (the tentpole end-to-end)
+# ---------------------------------------------------------------------------
+class TestBootstrapAndTail:
+    def test_snapshot_bootstrap_converges_bitwise(self, tmp_path):
+        owner = _Owner(tmp_path)
+        try:
+            owner.publish(5, seed=1)  # pre-connect history
+            rep = _Replica(owner.port, tmp_path / "spool")
+            try:
+                assert rep.client.last_resume_mode == "snapshot"
+                last = owner.publish(5, seed=2)[-1]  # live tail
+                rep.converge_to(last)
+                assert _scores(rep.engine) == _scores(owner.engine)
+                assert owner.registry.counter("repl_snapshots_total") == 1
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+    def test_zero_recompiles_after_warm(self, tmp_path):
+        owner = _Owner(tmp_path, warm=True)
+        try:
+            rep = _Replica(owner.port, tmp_path / "spool", warm=True)
+            try:
+                compiles = rep.engine.compile_count
+                _scores(rep.engine)  # bucket ladder exercised once
+                compiles = rep.engine.compile_count
+                last = owner.publish(8, seed=3)[-1]
+                rep.converge_to(last)
+                assert _scores(rep.engine) == _scores(owner.engine)
+                # streamed rows are in-place scatters: no recompile, ever
+                assert rep.engine.compile_count == compiles
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+    def test_reconnect_resumes_via_log(self, tmp_path):
+        owner = _Owner(tmp_path)
+        try:
+            owner.publish(3, seed=1)
+            rep = _Replica(owner.port, tmp_path / "spool")
+            last = owner.publish(2, seed=2)[-1]
+            rep.converge_to(last)
+            rep.close()
+
+            more = owner.publish(4, seed=3)[-1]  # while replica is down
+            rep2 = _Replica(owner.port, tmp_path / "spool")
+            try:
+                assert rep2.client.last_resume_mode == "log"
+                rep2.converge_to(more)
+                assert _scores(rep2.engine) == _scores(owner.engine)
+                assert owner.registry.counter("repl_resume_total",
+                                              mode="log") == 1
+            finally:
+                rep2.close()
+        finally:
+            owner.close()
+
+    def test_compacted_past_resume_falls_back_to_snapshot(self, tmp_path):
+        owner = _Owner(tmp_path)
+        try:
+            owner.publish(3, seed=1)
+            rep = _Replica(owner.port, tmp_path / "spool")
+            last = owner.publish(1, seed=2)[-1]
+            rep.converge_to(last)
+            rep.close()
+
+            # owner swaps with no follower connected: compaction passes
+            # the replica's identity and its floor is stale
+            owner.swap(tmp_path, "base2", seed=2)
+            post = owner.publish(2, seed=4)[-1]
+            rep2 = _Replica(owner.port, tmp_path / "spool")
+            try:
+                # warm-spool bootstrap() returns from state.json at once;
+                # the fresh snapshot lands asynchronously
+                _wait(lambda: rep2.client.snapshots_received >= 1,
+                      msg="snapshot fallback")
+                assert rep2.client.last_resume_mode == "snapshot"
+                assert rep2.client.floor == owner.swapper.replay_floor
+                rep2.converge_to(post)
+                assert _scores(rep2.engine) == _scores(owner.engine)
+            finally:
+                rep2.close()
+        finally:
+            owner.close()
+
+    def test_in_stream_owner_swap_ships_snapshot(self, tmp_path):
+        owner = _Owner(tmp_path)
+        try:
+            rep = _Replica(owner.port, tmp_path / "spool")
+            try:
+                pre = owner.publish(3, seed=1)[-1]
+                rep.converge_to(pre)
+                owner.swap(tmp_path, "base2", seed=2)
+                post = owner.publish(3, seed=5)[-1]
+                _wait(lambda: rep.client.snapshots_received >= 2,
+                      msg="mid-stream snapshot")
+                rep.converge_to(post)
+                assert rep.client.floor == owner.swapper.replay_floor
+                assert _scores(rep.engine) == _scores(owner.engine)
+                # the replica hot-swapped: its serving base is the shipped
+                # dir, not the bootstrap extract
+                assert rep.swapper.replay_floor == owner.swapper.replay_floor
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+
+# ---------------------------------------------------------------------------
+# retention floor + eviction policy
+# ---------------------------------------------------------------------------
+class TestRetention:
+    def test_connected_follower_pins_compaction(self, tmp_path):
+        owner = _Owner(tmp_path)
+        try:
+            rep = _Replica(owner.port, tmp_path / "spool")
+            try:
+                last = owner.publish(3, seed=1)[-1]
+                rep.converge_to(last)
+                _wait(lambda: owner.log.min_retained_generation() is not None,
+                      msg="segment on disk")
+                gen_before = last[0]
+                # swap compacts — but the follower's acked identity is
+                # still on the old generation when compact runs (the swap
+                # raises the base floor only AFTER compaction), so the old
+                # segment must survive
+                owner.swap(tmp_path, "base2", seed=2)
+                assert owner.log.min_retained_generation() == gen_before
+                # once the follower converges onto the new base, the next
+                # swap is free to drop the old lineage
+                post = owner.publish(1, seed=6)[-1]
+                _wait(lambda: rep.client.snapshots_received >= 2,
+                      msg="mid-stream snapshot")
+                rep.converge_to(post)
+                # the ack travels the socket asynchronously: wait for the
+                # owner's pin view to reflect it before compacting again
+                srv = owner.repl.server
+                _wait(lambda: all(p is not None and p >= post[0]
+                                  for p, _ in srv._pin_view.values()),
+                      msg="ack to reach the owner's pin view")
+                owner.swap(tmp_path, "base3", seed=3)
+                mrg = owner.log.min_retained_generation()
+                assert mrg is None or mrg > gen_before
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+    def test_byte_cap_evicts_worst_pinner(self, tmp_path):
+        """Unit-level: retention_floor applies the byte cap by evicting
+        the minimum pinner until the pinned segments fit."""
+        log = DeltaLog(str(tmp_path / "log"), fsync="never")
+        for g in (1, 2, 3):
+            for v in (1, 2):
+                log.append(_rec(g, v))
+        srv = ReplicationServer(log, ReplicationConfig(pin_byte_cap=1))
+        srv._base_generation = 4
+        now = time.monotonic()
+        srv._pin_view = {1: (1, now), 2: (3, now)}
+        # fid 1 pins gens [1, 4) — way past 1 byte — and is evicted; fid 2
+        # pins [3, 4), also over the 1-byte cap, so nothing pins
+        assert srv.retention_floor() is None
+        assert srv._pin_view == {}
+
+        srv2 = ReplicationServer(log, ReplicationConfig(pin_byte_cap=1 << 20))
+        srv2._base_generation = 4
+        srv2._pin_view = {1: (2, now), 2: (3, now)}
+        assert srv2.retention_floor() == 2  # min pin, within budget
+
+    def test_age_cap_drops_stale_pinner(self, tmp_path):
+        log = DeltaLog(str(tmp_path / "log"), fsync="never")
+        log.append(_rec(1, 1))
+        srv = ReplicationServer(log, ReplicationConfig(pin_age_cap_s=0.01))
+        srv._base_generation = 3
+        srv._pin_view = {7: (1, time.monotonic() - 1.0)}
+        assert srv.retention_floor() is None  # stale ack: pin ignored
+        assert 7 not in srv._pin_view
+
+    def test_compaction_respects_pin_floor(self, tmp_path):
+        log = DeltaLog(str(tmp_path / "log"), fsync="never")
+        for g in (1, 2, 3):
+            log.append(_rec(g, 1))
+        log.retention_pin = lambda: 2
+        dropped = log.compact(3)
+        assert dropped == [1]
+        assert [g for g, _ in log.segments()] == [2, 3]
+        log.retention_pin = None
+        assert log.compact(3) == [2]
+
+
+# ---------------------------------------------------------------------------
+# backpressure: queue overflow falls back to log catch-up
+# ---------------------------------------------------------------------------
+class TestBackpressure:
+    def test_queue_overflow_catches_up_from_log(self, tmp_path):
+        owner = _Owner(tmp_path, config_kwargs={"queue_records": 2})
+        try:
+            rep = _Replica(owner.port, tmp_path / "spool")
+            try:
+                # burst far past the 2-record queue bound: overflowed
+                # records MUST still arrive (re-read from the durable log)
+                last = owner.publish(40, seed=1)[-1]
+                rep.converge_to(last)
+                assert _scores(rep.engine) == _scores(owner.engine)
+                assert rep.client.records_applied == 40
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+
+# ---------------------------------------------------------------------------
+# auth (satellite: replication socket AND serving front end)
+# ---------------------------------------------------------------------------
+class TestAuth:
+    def test_repl_socket_requires_token(self, tmp_path):
+        owner = _Owner(tmp_path, auth_token="sekrit")
+        try:
+            bad = ReplicationClient(ReplicationClientConfig(
+                host="127.0.0.1", port=owner.port,
+                spool_dir=str(tmp_path / "bad-spool"),
+                auth_token="wrong", backoff_initial_s=0.05)).start()
+            with pytest.raises(RuntimeError, match="unauthorized"):
+                bad.bootstrap(timeout=1.5)
+            bad.stop()
+            fails = owner.registry.counter_series("repl_auth_failures_total")
+            assert sum(fails.values()) >= 1
+
+            rep = _Replica(owner.port, tmp_path / "spool",
+                           auth_token="sekrit")
+            try:
+                last = owner.publish(2, seed=1)[-1]
+                rep.converge_to(last)
+                assert _scores(rep.engine) == _scores(owner.engine)
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+    def test_frontend_requires_token(self, tmp_path):
+        from photon_ml_tpu.cli.serve import build_server
+        from photon_ml_tpu.serving.frontend import (FrontendConfig,
+                                                    ThreadedFrontend)
+
+        base = _save_model_dir(str(tmp_path / "m"))
+        engine, swapper = build_server(base, max_batch=4, warm=False)
+        tf = ThreadedFrontend(engine, swapper,
+                              FrontendConfig(auth_token="sekrit")).start()
+        try:
+            probe = {"uid": 0, "features": [[n, 0.5] for n in NAMES],
+                     "ids": {"userId": "user1"}}
+
+            def _talk(lines):
+                sock = socket.create_connection(("127.0.0.1", tf.port),
+                                                timeout=10)
+                f = sock.makefile("rw", encoding="utf-8", newline="\n")
+                for obj in lines:
+                    f.write(json.dumps(obj) + "\n")
+                f.flush()
+                out = []
+                try:
+                    for line in f:
+                        out.append(json.loads(line))
+                except (OSError, ValueError):
+                    pass
+                sock.close()
+                return out
+
+            # no auth line: one unauthorized frame, then the close
+            replies = _talk([probe])
+            assert replies == [{"error": "unauthorized"}]
+            # wrong token: same
+            replies = _talk([{"cmd": "auth", "token": "nope"}, probe])
+            assert replies == [{"error": "unauthorized"}]
+            # right token: {"auth": "ok"} then normal scoring
+            replies = _talk([{"cmd": "auth", "token": "sekrit"}, probe,
+                             {"cmd": "shutdown"}])
+            assert replies[0] == {"auth": "ok"}
+            assert "score" in replies[1]
+            fails = engine.metrics.registry.counter_series(
+                "front_auth_failures_total")
+            assert sum(fails.values()) == 2
+        finally:
+            tf.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: torn tail + owner restart + compaction + follower resume
+# ---------------------------------------------------------------------------
+class TestChaos:
+    def test_torn_tail_restart_compact_resume_one_chain(self, tmp_path):
+        from photon_ml_tpu.serving.coefficient_store import \
+            advance_generation_floor
+
+        owner = _Owner(tmp_path)
+        try:
+            owner.publish(4, seed=1)
+            rep = _Replica(owner.port, tmp_path / "spool")
+            last = owner.publish(2, seed=2)[-1]
+            rep.converge_to(last)
+            rep.close()
+        finally:
+            owner.close()
+
+        # tear the newest segment's tail (crash mid-append)
+        segs = DeltaLog(str(tmp_path / "owner-log"), fsync="never").segments()
+        with open(segs[-1][1], "ab") as f:
+            f.write(b"\x99\x00\x00\x00torn")
+
+        # owner restarts on the torn log: resume past the last DURABLE
+        # identity (learn.py's restart protocol)
+        log2 = DeltaLog(str(tmp_path / "owner-log"), fsync="rotate")
+        durable_last = log2.last_identity()
+        assert durable_last == last  # the tear cost nothing committed
+        advance_generation_floor(durable_last[0] + 1)
+
+        from photon_ml_tpu.cli.serve import build_server
+
+        base2 = _save_model_dir(str(tmp_path / "restart-base"), seed=0)
+        engine2, swapper2 = build_server(base2, max_batch=4, warm=False,
+                                         delta_log=log2, log_owner=True)
+        repl2 = attach_replication(swapper2, ReplicationConfig(),
+                                   registry=engine2.metrics.registry)
+        try:
+            rng = np.random.default_rng(8)
+            dim = engine2.store.coordinates["user"].dim
+            for _ in range(3):
+                assert swapper2.publish_delta(
+                    "user", f"user{int(rng.integers(0, N_ENT))}",
+                    rng.normal(size=dim)) is not None
+            # swap → compaction passes the replica's floor entirely
+            new_dir = _save_model_dir(str(tmp_path / "base-after"), seed=3)
+            assert swapper2.swap(new_dir) is True
+            final = swapper2.publish_delta("user", "user1",
+                                           rng.normal(size=dim))
+
+            rep2 = _Replica(repl2.port, tmp_path / "spool")
+            try:
+                _wait(lambda: rep2.client.snapshots_received >= 1,
+                      msg="snapshot fallback")
+                assert rep2.client.last_resume_mode == "snapshot"
+                rep2.converge_to(final)
+                # one identity chain: the mirror's records are exactly the
+                # owner's retained records, in order
+                mirror = [r.identity for r in rep2.mirror.replay()]
+                owner_log = [r.identity for r in log2.replay()]
+                assert mirror == [i for i in owner_log
+                                  if i >= (swapper2.replay_floor, 0)]
+                assert mirror == sorted(mirror)
+                assert _scores(rep2.engine) == _scores(engine2)
+            finally:
+                rep2.close()
+        finally:
+            repl2.stop()
+            log2.close()
+
+
+# ---------------------------------------------------------------------------
+# serve.py --subscribe end to end
+# ---------------------------------------------------------------------------
+class TestServeSubscribeCli:
+    def test_subscribe_scores_match_owner(self, tmp_path, capsys):
+        from photon_ml_tpu.cli import serve as serve_cli
+
+        owner = _Owner(tmp_path)
+        try:
+            # no deltas in flight: the run() process serves right after its
+            # initial catch-up, so parity is only deterministic against a
+            # quiescent owner (live-tail convergence is covered above)
+            probe = {"uid": 0, "features": [[n, 0.5] for n in NAMES],
+                     "ids": {"userId": "user3"}}
+            want = float(owner.engine.score_requests(
+                [request_from_json(probe)])[0])
+
+            req_file = tmp_path / "req.jsonl"
+            req_file.write_text(json.dumps(probe) + "\n")
+            rc = serve_cli.run(["--subscribe", f"127.0.0.1:{owner.port}",
+                                "--spool", str(tmp_path / "cli-spool"),
+                                "--no-warm", "--requests", str(req_file)])
+            assert rc == 0
+            out = capsys.readouterr().out.strip().splitlines()
+            assert json.loads(out[0])["score"] == want
+        finally:
+            owner.close()
+
+    def test_subscribe_flag_validation(self, tmp_path):
+        from photon_ml_tpu.cli import serve as serve_cli
+
+        # --subscribe needs --spool
+        assert serve_cli.run(["--subscribe", "127.0.0.1:1"]) == 1
+        # --subscribe excludes --model-dir / --delta-log
+        assert serve_cli.run(["--subscribe", "127.0.0.1:1",
+                              "--spool", str(tmp_path / "s"),
+                              "--model-dir", str(tmp_path)]) == 1
+        # neither --model-dir nor --subscribe
+        assert serve_cli.run(["--requests", "/dev/null"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# learn.py --repl-listen wiring
+# ---------------------------------------------------------------------------
+class TestLearnCliRepl:
+    def test_repl_listen_requires_delta_log(self, tmp_path):
+        from photon_ml_tpu.cli import learn as learn_cli
+
+        base = _save_model_dir(str(tmp_path / "m"))
+        rc = learn_cli.run(["--model-dir", base,
+                            "--repl-listen", "127.0.0.1:0",
+                            "--examples", "/dev/null"])
+        assert rc == 1
+
+    def test_parse_hostport(self):
+        from photon_ml_tpu.cli.learn import _parse_hostport
+
+        assert _parse_hostport("0.0.0.0:712") == ("0.0.0.0", 712)
+        with pytest.raises(ValueError):
+            _parse_hostport("712")
