@@ -2357,6 +2357,422 @@ def run_repl_bench(n_entities=256, d=8, max_batch=32, n_replicas=2,
     return out
 
 
+def run_chaos_bench(n_entities=128, d=8, max_batch=16, rounds=9, seed=0,
+                    out_path=None) -> dict:
+    """`bench.py --chaos`: owner + replica + frontend under a seeded fault
+    schedule -> BENCH_CHAOS_<backend>.json.
+
+    The whole fault sequence derives from ``--chaos-seed`` via
+    ``chaos.build_schedule`` (same seed -> same schedule, asserted).  Per
+    round the schedule's fault point is armed fire-on-next-hit, traffic is
+    driven through the seam (trainer publishes, frontend requests, or an
+    owner hot swap for the snapshot/activate classes), the fault is
+    asserted to have FIRED, then the injector is disarmed and the
+    time-to-ready clock runs until the owner's /readyz is green again and
+    the replica's serving store reaches the owner's publish tail.
+
+    Asserted, not just reported:
+      - identity chain strictly monotone across every fault (log listener
+        over the full run);
+      - owner/replica probe scores BITWISE equal after the final heal;
+      - zero admitted frontend requests lost (every request on a surviving
+        connection gets a reply; dropped-before-admission retries are
+        counted, never lost);
+      - zero engine recompiles after warm, owner and replica;
+      - time-to-ready bounded (<30 s) for every fault class;
+      - ``GET /readyz`` over real HTTP: 503 while the delta log is
+        degraded, 200 after the heal publish.
+    """
+    import socket as socketlib
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu.chaos import (HealthState, InjectedCrash, Watchdog,
+                                     build_schedule, delta_log_check,
+                                     follower_staleness_check, get_injector)
+    from photon_ml_tpu.cli.serve import build_server
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.online.catchup import LogFollower
+    from photon_ml_tpu.online.delta_log import DeltaLog
+    from photon_ml_tpu.online.replication import (ReplicationClient,
+                                                  ReplicationClientConfig,
+                                                  ReplicationConfig,
+                                                  attach_replication)
+    from photon_ml_tpu.online.trainer import IncrementalTrainer, TrainerConfig
+    from photon_ml_tpu.serving.batcher import Request
+    from photon_ml_tpu.serving.frontend import (FrontendConfig,
+                                                ThreadedFrontend)
+    from photon_ml_tpu.serving.frontend.metrics_http import \
+        ThreadedMetricsEndpoint
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    from photon_ml_tpu.storage.model_io import save_game_model
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    names = [f"f{j}" for j in range(d)]
+    task = TaskType.LOGISTIC_REGRESSION
+
+    def save_model(path, mseed):
+        r = np.random.default_rng(mseed)
+        model = GameModel(models={
+            "fixed": FixedEffectModel(
+                coefficients=Coefficients(means=r.normal(size=d)),
+                feature_shard="all", task=task),
+            "user": RandomEffectModel(
+                w_stack=r.normal(size=(n_entities, d)) * 0.1,
+                slot_of={i: i for i in range(n_entities)},
+                random_effect_type="userId", feature_shard="all",
+                task=task),
+        })
+        imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
+        eidx = EntityIndex()
+        for i in range(n_entities):
+            eidx.get_or_add(f"user{i}")
+        save_game_model(model, path, {"all": imap}, {"userId": eidx},
+                        task=task)
+        imap.save(os.path.join(path, "all.idx"))
+        eidx.save(os.path.join(path, "userId.entities.json"))
+        return path
+
+    def mk_request(uid, user, r=None):
+        r = r if r is not None else rng
+        feats = [{"name": n, "term": "", "value": float(v)}
+                 for n, v in zip(names, r.normal(size=d))]
+        return Request(uid=uid, features=feats,
+                       ids={"userId": f"user{user}"})
+
+    probe_rng = np.random.default_rng(seed + 7)
+    probes = [mk_request(i, i % n_entities, probe_rng)
+              for i in range(min(max_batch, n_entities))]
+
+    def scores(engine):
+        return [float(s) for s in engine.score_requests(probes)]
+
+    def wait_for(pred, timeout=30.0, what="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.002)
+        raise AssertionError(f"chaos bench timed out waiting for {what}")
+
+    def http_get(port, path):
+        with socketlib.create_connection(("127.0.0.1", port),
+                                         timeout=10) as s:
+            s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        status = int(data.split(b" ", 2)[1])
+        return status, data.split(b"\r\n\r\n", 1)[1]
+
+    schedule = build_schedule(seed, rounds)
+    assert schedule == build_schedule(seed, rounds), \
+        "chaos schedule is not a pure function of the seed"
+
+    inj = get_injector()
+    inj.reset()
+
+    with tempfile.TemporaryDirectory(prefix="photon_chaos_bench_") as tmp:
+        base_dir = save_model(os.path.join(tmp, "base"), seed)
+        log = DeltaLog(os.path.join(tmp, "owner-log"), fsync="rotate")
+        engine, swapper = build_server(base_dir, max_batch=max_batch,
+                                       warm=True, delta_log=log,
+                                       log_owner=True)
+        registry = engine.metrics.registry
+        inj.registry = registry
+        repl = attach_replication(swapper, ReplicationConfig(),
+                                  registry=registry)
+        trainer = IncrementalTrainer(
+            swapper, TrainerConfig(coordinates=("user",), max_iters=3))
+
+        # identity-chain witness: every durable append, in order
+        chain = []
+        log.add_listener(lambda rec: chain.append(rec.identity))
+
+        # owner health surface, served over real HTTP
+        owner_health = HealthState(registry=registry)
+        owner_health.set_condition("engine_warmed", True, "warmed at build")
+        owner_health.add_check("delta_log", delta_log_check(log))
+        owner_watch = Watchdog(stall_after_s=20.0, registry=registry)
+        owner_health.add_check("workers", owner_watch.check)
+
+        tf = ThreadedFrontend(engine, swapper, FrontendConfig()).start()
+        tf.server.batcher.watch = owner_watch.register(
+            "batcher", tf.server.batcher.worker_thread)
+        scrape = ThreadedMetricsEndpoint(engine.metrics, port=0,
+                                         health=owner_health).start()
+
+        # replica: serve.py --subscribe wiring, in-process
+        rep_metrics = ServingMetrics()
+        client = ReplicationClient(
+            ReplicationClientConfig(host="127.0.0.1", port=repl.port,
+                                    spool_dir=os.path.join(tmp, "spool"),
+                                    ack_every=1, ack_interval_s=0.05,
+                                    backoff_initial_s=0.05),
+            registry=rep_metrics.registry).start()
+        rep_dir = client.bootstrap(timeout=60.0)
+        mirror = DeltaLog(client.mirror_path, fsync="never")
+        rep_engine, rep_swapper = build_server(
+            rep_dir, max_batch=max_batch, warm=True, metrics=rep_metrics,
+            delta_log=mirror, log_owner=False)
+        rep_swapper.set_base(rep_dir, client.floor or 0)
+        client.on_snapshot = \
+            lambda dd, g: rep_swapper.swap(dd, replay_floor=g)
+        if client.model_dir != rep_dir:
+            rep_swapper.swap(client.model_dir, replay_floor=client.floor)
+        follower = LogFollower(mirror, lambda: rep_engine.store,
+                               poll_interval_s=0.005,
+                               registry=rep_metrics.registry)
+        follower.run_once()
+        follower.start()
+
+        rep_health = HealthState(registry=rep_metrics.registry)
+        rep_health.set_condition("engine_warmed", True, "warmed at build")
+        rep_health.add_check("catchup",
+                             follower_staleness_check(follower, 10.0))
+        rep_watch = Watchdog(stall_after_s=20.0,
+                             registry=rep_metrics.registry)
+        rep_watch.register("follower", follower.worker_thread)
+        rep_watch.register("subscriber", client.worker_thread)
+        rep_health.add_check("workers", rep_watch.check)
+
+        # admitted-loss ledger.  "attempted" counts logical requests the
+        # edge client wants answered; "answered" counts real replies
+        # (score or an explicit shed frame).  A connection killed before
+        # the server read a byte (the only thing front.conn injects) is a
+        # retry — the request was never admitted, so it cannot be lost.
+        front_stats = {"attempted": 0, "answered": 0, "shed": 0,
+                       "dropped_before_admit": 0}
+
+        def front_round(n=4, uid0=0):
+            for i in range(n):
+                front_stats["attempted"] += 1
+                for _ in range(50):  # retry cap: fail loudly, never spin
+                    line = ""
+                    try:
+                        sock = socketlib.create_connection(
+                            ("127.0.0.1", tf.port), timeout=10)
+                        try:
+                            fh = sock.makefile("rw", encoding="utf-8",
+                                               newline="\n")
+                            u = int(rng.integers(0, n_entities))
+                            fh.write(json.dumps({
+                                "uid": uid0 + i,
+                                "features": [[n_, 0.5] for n_ in names],
+                                "ids": {"userId": f"user{u}"}}) + "\n")
+                            fh.flush()
+                            line = fh.readline()
+                        finally:
+                            sock.close()
+                    except OSError:
+                        line = ""
+                    if not line:
+                        front_stats["dropped_before_admit"] += 1
+                        continue
+                    reply = json.loads(line)
+                    assert "score" in reply or "error" in reply, \
+                        f"unparseable frontend reply {reply!r}"
+                    front_stats["answered"] += 1
+                    if "score" not in reply:
+                        front_stats["shed"] += 1
+                    break
+                else:
+                    raise AssertionError(
+                        "frontend request lost: no reply after 50 "
+                        "connection attempts")
+
+        def publish_batch(rows=6):
+            fb = []
+            for _ in range(rows):
+                u = int(rng.integers(0, n_entities))
+                req = mk_request(None, u)
+                fb.append({"uid": None, "features": req.features,
+                           "ids": req.ids,
+                           "label": float(rng.integers(0, 2))})
+            return trainer.consume(fb)
+
+        def heal_tail():
+            """One publish that must land durably — the heal witness."""
+            dim = engine.store.coordinates["user"].dim
+            identity = swapper.publish_delta(
+                "user", f"user{int(rng.integers(0, n_entities))}",
+                rng.normal(size=dim))
+            assert identity is not None, "heal publish blocked"
+            return identity
+
+        out = None
+        swap_seq = 0
+        try:
+            # settle compile baselines: everything after this must reuse
+            # the warmed executables
+            scores(engine)
+            scores(rep_engine)
+            front_round(n=2, uid0=10_000)
+            tail0 = heal_tail()
+            wait_for(lambda: follower.position is not None
+                     and follower.position >= tail0,
+                     what="replica initial convergence")
+            owner_compiles0 = engine.compile_count
+            rep_compiles0 = rep_engine.compile_count
+
+            status, _ = http_get(scrape.port, "/readyz")
+            assert status == 200, f"/readyz {status} on a healthy owner"
+            status, _ = http_get(scrape.port, "/healthz")
+            assert status == 200
+
+            ttr = {}
+            readyz_degraded_seen = 0
+            for ev in schedule:
+                t0 = time.perf_counter()
+                # fire-on-next-hit: hit counters persist across rounds (a
+                # point like repl.server.send has been hit hundreds of
+                # times by now), so "fire on every hit, at most once" is
+                # the right arm, not nth=1
+                inj.arm(ev.point, ev.kind, max_fires=1, data=ev.data)
+                if ev.fault_class in ("log_enospc", "log_torn"):
+                    dim = engine.store.coordinates["user"].dim
+                    blocked = swapper.publish_delta(
+                        "user", "user1", rng.normal(size=dim))
+                    assert blocked is None, \
+                        f"{ev.fault_class}: publish survived the fault"
+                    assert not log.healthy
+                    status, body = http_get(scrape.port, "/readyz")
+                    assert status == 503, \
+                        f"/readyz {status} while the delta log is degraded"
+                    assert b'"ready": false' in body
+                    readyz_degraded_seen += 1
+                elif ev.fault_class in ("swap_crash",):
+                    swap_seq += 1
+                    new_dir = save_model(
+                        os.path.join(tmp, f"gen{swap_seq}"),
+                        seed + swap_seq)
+                    before = swapper.identity
+                    try:
+                        swapper.swap(new_dir)
+                        raise AssertionError(
+                            "swap survived an armed activate crash")
+                    except InjectedCrash:
+                        pass
+                    assert swapper.identity == before, \
+                        "crashed swap moved the serving identity"
+                    # the swap lock unwound with the crash: a retry on the
+                    # same dir must succeed and mint a fresh generation
+                    assert swapper.swap(new_dir) is True, \
+                        "swap retry after injected crash failed"
+                elif ev.fault_class in ("snapshot_disconnect",):
+                    swap_seq += 1
+                    new_dir = save_model(
+                        os.path.join(tmp, f"gen{swap_seq}"),
+                        seed + swap_seq)
+                    assert swapper.swap(new_dir) is True
+                else:
+                    # socket-plane faults: drive replication + edge load
+                    publish_batch()
+                    front_round(n=3, uid0=ev.round * 100)
+                # coverage: the armed point must actually FIRE — socket
+                # seams run on event-loop/daemon threads, so wait rather
+                # than assert-immediately
+                wait_for(lambda: inj.fired(ev.point) >= 1,
+                         what=f"round {ev.round}: {ev.point} to fire")
+                inj.disarm(ev.point)
+                # heal: one durable publish, then the whole topology must
+                # be green — owner ready over HTTP, replica converged
+                tail = heal_tail()
+                wait_for(lambda t=tail: follower.position is not None
+                         and follower.position >= t,
+                         what=f"replica heal after {ev.fault_class}")
+                wait_for(lambda: owner_health.readyz()[0],
+                         what=f"owner ready after {ev.fault_class}")
+                wait_for(lambda: rep_health.readyz()[0],
+                         what=f"replica ready after {ev.fault_class}")
+                dt = time.perf_counter() - t0
+                ttr.setdefault(ev.fault_class, []).append(dt)
+
+            status, _ = http_get(scrape.port, "/readyz")
+            assert status == 200, f"/readyz {status} after final heal"
+
+            # acceptance: one identity chain, strictly monotone
+            assert chain == sorted(chain) and \
+                len(set(chain)) == len(chain), \
+                "identity chain not strictly monotone"
+            # bitwise owner/replica parity after heal
+            owner_scores = scores(engine)
+            parity = scores(rep_engine) == owner_scores
+            assert parity, "owner/replica score divergence after heal"
+            # zero recompiles after warm
+            owner_recompiles = engine.compile_count - owner_compiles0
+            rep_recompiles = rep_engine.compile_count - rep_compiles0
+            assert owner_recompiles == 0 and rep_recompiles == 0, \
+                f"recompiles after warm: owner {owner_recompiles}, " \
+                f"replica {rep_recompiles}"
+            # zero admitted-request loss: every logical request the edge
+            # client attempted got a real reply (front_round raises on a
+            # lost one; this closes the ledger)
+            assert front_stats["answered"] == front_stats["attempted"], \
+                f"admitted frontend requests lost: {front_stats}"
+            # bounded time-to-ready per fault class
+            worst = {k: max(v) for k, v in ttr.items()}
+            assert all(v < 30.0 for v in worst.values()), \
+                f"time-to-ready exceeded bound: {worst}"
+
+            out = {
+                "metric": "chaos_time_to_ready_s_max",
+                "unit": "s",
+                "value": round(max(worst.values()), 4),
+                "backend": jax.default_backend(),
+                "seed": seed, "rounds": rounds,
+                "n_entities": n_entities, "d": d,
+                "schedule": [ev.fault_class for ev in schedule],
+                "time_to_ready_s": {k: [round(x, 4) for x in v]
+                                    for k, v in sorted(ttr.items())},
+                "faults_fired": {
+                    f"{dict(lk).get('point')}|{dict(lk).get('kind')}":
+                        int(v)
+                    for lk, v in registry.counter_series(
+                        "chaos_faults_fired_total").items()},
+                "readyz_503_observed": readyz_degraded_seen,
+                "frontend": dict(front_stats),
+                "identity_chain": {"records": len(chain),
+                                   "monotone": True},
+                "parity": {"bitwise_equal": True},
+                "recompiles_after_warm": {"owner": 0, "replica": 0},
+                "delta_log": {
+                    "write_errors": log.write_errors,
+                    "records": log.records_written,
+                    "segments": len(log.segments())},
+                "replica": {
+                    "reconnects": client.reconnects,
+                    "snapshots_received": client.snapshots_received,
+                    "records_applied": client.records_applied,
+                    "catchup_errors": follower.errors_total},
+            }
+        finally:
+            inj.reset()
+            inj.registry = None
+            follower.stop()
+            client.stop()
+            mirror.close()
+            scrape.stop()
+            tf.stop()
+            repl.stop()
+            log.close()
+    if out_path is None:
+        out_path = os.path.join(_REPO,
+                                f"BENCH_CHAOS_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def run_solve_bench(out_path=None, seed=0, n_users=96, per_user=96,
                     d_user=4, n_iterations=4) -> dict:
     """`bench.py --solve`: per-entity solve-path micro-bench ->
@@ -2936,6 +3352,22 @@ def main():
                          "through the owner's trainer")
     ap.add_argument("--repl-batch-size", type=int, default=32,
                     help="with --repl: examples per mini-batch")
+    ap.add_argument("--chaos", action="store_true",
+                    help="photonchaos end to end (owner + replica + "
+                         "frontend under a seeded fault schedule: log "
+                         "ENOSPC/torn writes, replication drop/garbage/"
+                         "stall, snapshot disconnect, swap crash, edge "
+                         "connection kills; identity-chain monotonicity, "
+                         "bitwise parity after heal, zero admitted-request "
+                         "loss, zero recompiles and bounded time-to-ready "
+                         "asserted) -> BENCH_CHAOS_<backend>.json")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="with --chaos: the fault schedule is a pure "
+                         "function of this seed")
+    ap.add_argument("--chaos-rounds", type=int, default=9,
+                    help="with --chaos: fault rounds (first "
+                         "len(FAULT_CLASSES) rounds cover every class "
+                         "once)")
     ap.add_argument("--solve", action="store_true",
                     help="per-entity solve-path micro-bench (SoA Newton "
                          "lanes/sec, host vs fused vs fused-validated sweep "
@@ -2978,6 +3410,12 @@ def main():
         return
     if a.solve:
         print(json.dumps(run_solve_bench(out_path=a.out)))
+        return
+    if a.chaos:
+        print(json.dumps(run_chaos_bench(
+            seed=a.chaos_seed,
+            rounds=a.chaos_rounds,
+            out_path=a.out)))
         return
     if a.repl:
         print(json.dumps(run_repl_bench(

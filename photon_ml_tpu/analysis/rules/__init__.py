@@ -10,6 +10,7 @@
 | PL006 | donation-after-use  | reads of buffers already donated to jit      |
 | PL007 | mesh-axis           | collective axis names absent from the mesh   |
 | PL008 | sharding-annotation | unannotated mesh-path jits / bad spec axes   |
+| PL009 | swallowed-exception | silent broad except in daemon/async workers  |
 
 PL001/PL003/PL004 are trace-scoped: in whole-program mode (the default) the
 ProgramIndex resolves functions jitted across module boundaries, so they
@@ -24,6 +25,7 @@ from photon_ml_tpu.analysis.rules.locks import LockDisciplineRule
 from photon_ml_tpu.analysis.rules.donation import DonationRule
 from photon_ml_tpu.analysis.rules.mesh_axis import MeshAxisRule
 from photon_ml_tpu.analysis.rules.sharding import ShardingAnnotationRule
+from photon_ml_tpu.analysis.rules.swallowed import SwallowedExceptionRule
 
 __all__ = [
     "HostSyncRule",
@@ -34,4 +36,5 @@ __all__ = [
     "DonationRule",
     "MeshAxisRule",
     "ShardingAnnotationRule",
+    "SwallowedExceptionRule",
 ]

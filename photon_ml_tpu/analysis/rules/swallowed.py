@@ -1,0 +1,173 @@
+"""PL009 swallowed-exception: silent ``except`` in daemon workers.
+
+Why it matters here: the serving stack runs its real work on daemon
+threads and asyncio tasks — the batcher flush loop, the delta-log
+follower, the replication subscriber, the hot-swap thread.  Nothing
+joins these on the request path, so an ``except Exception: pass`` in
+one of them converts a persistent failure (sick disk, corrupt log,
+wedged socket) into permanent silent staleness: the thread keeps
+spinning, metrics stay green, and no operator signal ever fires.  That
+is precisely the failure mode the PR-14 catch-up hardening fixed
+(``catchup_follow_errors_total`` + backoff) and the chaos watchdog
+exists to surface.
+
+Scope — only code that actually runs detached, where nobody observes a
+raise:
+
+  - the body of any ``async def`` function;
+  - the body of any function or method referenced as a ``target=`` of a
+    ``threading.Thread(...)`` construction anywhere in the module
+    (``target=self._run`` marks the method ``_run``; ``target=run``
+    marks the module function ``run``).
+
+Within that scope, flags an ``except`` handler whose type is bare,
+``Exception``, or ``BaseException`` (alone or in a tuple) and whose body
+does NONE of the following:
+
+  - re-raise (any ``raise``);
+  - reference the bound exception name (``except ... as e`` where ``e``
+    is read — stored on ``self``, passed to ``set_exception``,
+    formatted into a reply);
+  - log it (a call to ``debug``/``info``/``warning``/``error``/
+    ``exception``/``critical``/``log`` on anything);
+  - count it (a call to ``inc``/``increment``/``observe``/
+    ``set_gauge``/``add_gauge``, or ``set_exception``).
+
+Exemption: a handler guarding a Try whose body is nothing but
+best-effort teardown calls (``close``/``cancel``/``stop``/
+``shutdown``/``join``/``release``/``terminate``/``unlink``/
+``remove``/``rmtree``) — ``try: writer.close() except Exception: pass``
+during cleanup is the idiom, not the bug: there is no health signal to
+emit about a socket that was already dying.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule,
+                                              Violation, register)
+from photon_ml_tpu.analysis.jit_index import dotted_name
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_METRIC_METHODS = {"inc", "increment", "observe", "set_gauge", "add_gauge",
+                   "set_exception"}
+_CLEANUP_METHODS = {"close", "cancel", "stop", "shutdown", "join",
+                    "release", "terminate", "unlink", "remove", "rmtree",
+                    "kill", "disarm"}
+
+
+def _broad_types(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except``, or a type (tuple) naming Exception/BaseException."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = (dotted_name(node) or "").rpartition(".")[2]
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _call_attr(node: ast.AST) -> Optional[str]:
+    """``anything.attr(...)`` -> "attr" (else None)."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _handles_it(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body raise, log, count, or use the exception?"""
+    bound = handler.name
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Name) and node.id == bound:
+            return True
+        attr = _call_attr(node)
+        if attr in _LOG_METHODS or attr in _METRIC_METHODS:
+            return True
+    return False
+
+
+def _cleanup_only(try_node: ast.Try) -> bool:
+    """Try body made exclusively of best-effort teardown expressions."""
+    for stmt in try_node.body:
+        if not isinstance(stmt, ast.Expr):
+            return False
+        call = stmt.value
+        if isinstance(call, ast.Await):
+            call = call.value
+        if _call_attr(call) not in _CLEANUP_METHODS:
+            return False
+    return bool(try_node.body)
+
+
+def _thread_targets(tree: ast.Module) -> Set[str]:
+    """Function/method names passed as ``target=`` to a Thread(...)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = (dotted_name(node.func) or "").rpartition(".")[2]
+        if callee != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            if isinstance(kw.value, ast.Name):
+                out.add(kw.value.id)
+            elif isinstance(kw.value, ast.Attribute):
+                out.add(kw.value.attr)
+    return out
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    name = "swallowed-exception"
+    code = "PL009"
+    severity = "error"
+    description = ("broad except in a daemon-thread/async-task body that "
+                   "neither logs, re-raises, nor increments a metric")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        targets = _thread_targets(tree)
+        for fn in ast.walk(tree):
+            if isinstance(fn, ast.AsyncFunctionDef):
+                detached = True
+            elif isinstance(fn, ast.FunctionDef):
+                detached = fn.name in targets
+            else:
+                continue
+            if not detached:
+                continue
+            yield from self._check_body(ctx, fn)
+
+    def _check_body(self, ctx: ModuleContext, fn: ast.AST,
+                    ) -> Iterator[Violation]:
+        # lexical body only: nested defs get their own detached-or-not
+        # decision in check()
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    if (_broad_types(handler)
+                            and not _handles_it(handler)
+                            and not _cleanup_only(node)):
+                        yield ctx.violation(
+                            self, handler,
+                            "broad except swallows failures in a detached "
+                            f"worker body ({getattr(fn, 'name', '?')}): "
+                            "log it, count it, re-raise, or use the bound "
+                            "exception")
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,199 @@
+"""Health and readiness state (photonchaos).
+
+One ``HealthState`` per serving process aggregates named checks into the
+single bit an orchestrator acts on: **ready** (serve traffic) or **not
+ready** (drain me).  The metrics sidecar exposes it as ``/readyz``
+(503 while any check fails, 200 when all pass) next to ``/healthz``
+(process liveness: 200 whenever the HTTP thread can answer at all).
+
+Checks come in two shapes:
+
+  - ``add_check(name, fn)`` — *pull*: ``fn() -> (ok, detail)`` evaluated
+    at request time against live state (follower staleness, delta-log
+    writability, watchdog sweep).  A check that raises counts as failed
+    with the exception text as detail — a broken probe must never report
+    healthy.
+  - ``set_condition(name, ok, detail)`` — *push*: a latched bit flipped
+    by the component itself (engine warmed after build).
+
+``Watchdog`` covers the failure the injector makes easy to produce and a
+pull check cannot see from outside: a daemon worker (batcher flusher,
+log follower, replication subscriber) that died or wedged mid-item.
+Workers wrap their per-item work in ``watch.busy()``; the watchdog calls
+a worker stalled when its registered thread is no longer alive or when
+one item has been in flight longer than ``stall_after_s``.  The watchdog
+is itself a pull check — readiness flips while a worker is stalled and
+recovers the moment it drains.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = ["HealthState", "Watchdog", "WorkerWatch",
+           "delta_log_check", "follower_staleness_check"]
+
+Check = Callable[[], Tuple[bool, str]]
+
+
+class HealthState:
+    """Named readiness checks aggregated into one ready bit."""
+
+    def __init__(self, registry=None):
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._checks: Dict[str, Check] = {}
+        self._conditions: Dict[str, Tuple[bool, str]] = {}
+
+    def add_check(self, name: str, fn: Check) -> None:
+        """Register a pull check, evaluated on every ``readyz`` call."""
+        with self._lock:
+            self._checks[name] = fn
+
+    def set_condition(self, name: str, ok: bool, detail: str = "") -> None:
+        """Latch a push condition (overwrites the previous value)."""
+        with self._lock:
+            self._conditions[name] = (bool(ok), detail)
+        if self.registry is not None:
+            self.registry.set_gauge("health_check_ok", 1.0 if ok else 0.0,
+                                    check=name)
+
+    def readyz(self) -> Tuple[bool, Dict[str, dict]]:
+        """Evaluate everything: ``(ready, {name: {"ok", "detail"}})``."""
+        with self._lock:
+            checks = list(self._checks.items())
+            results = {name: {"ok": ok, "detail": detail}
+                       for name, (ok, detail) in self._conditions.items()}
+        for name, fn in checks:
+            try:
+                ok, detail = fn()
+            except Exception as e:  # a broken probe is a failed probe
+                ok, detail = False, f"check raised: {e!r}"
+            results[name] = {"ok": bool(ok), "detail": detail}
+            if self.registry is not None:
+                self.registry.set_gauge("health_check_ok",
+                                        1.0 if ok else 0.0, check=name)
+        ready = all(r["ok"] for r in results.values())
+        if self.registry is not None:
+            self.registry.set_gauge("health_ready", 1.0 if ready else 0.0)
+        return ready, results
+
+
+class WorkerWatch:
+    """Per-worker stall tracker handed out by ``Watchdog.register``."""
+
+    def __init__(self, name: str, stall_after_s: float,
+                 thread: Optional[threading.Thread] = None):
+        self.name = name
+        self.stall_after_s = stall_after_s
+        self.thread = thread
+        self._busy_since: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def set_thread(self, thread: Optional[threading.Thread]) -> None:
+        self.thread = thread
+
+    @contextmanager
+    def busy(self):
+        """Wrap one unit of worker work; open too long = stalled."""
+        with self._lock:
+            self._busy_since = time.monotonic()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._busy_since = None
+
+    def beat(self) -> None:
+        """Re-stamp a long busy section that is legitimately making
+        progress (a snapshot ship, a big replay)."""
+        with self._lock:
+            if self._busy_since is not None:
+                self._busy_since = time.monotonic()
+
+    def stalled(self) -> Tuple[bool, str]:
+        """``(stalled, detail)`` — dead thread or over-age busy item."""
+        t = self.thread
+        if t is not None and not t.is_alive():
+            return True, f"{self.name}: worker thread not alive"
+        with self._lock:
+            since = self._busy_since
+        if since is not None:
+            age = time.monotonic() - since
+            if age > self.stall_after_s:
+                return True, (f"{self.name}: item in flight "
+                              f"{age:.1f}s > {self.stall_after_s:.1f}s")
+        return False, f"{self.name}: ok"
+
+
+class Watchdog:
+    """Stall detection over a set of daemon workers, consumed as one
+    HealthState pull check (``health.add_check("workers",
+    watchdog.check)``)."""
+
+    def __init__(self, stall_after_s: float = 10.0, registry=None):
+        self.stall_after_s = stall_after_s
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._watches: Dict[str, WorkerWatch] = {}
+
+    def register(self, name: str,
+                 thread: Optional[threading.Thread] = None,
+                 stall_after_s: Optional[float] = None) -> WorkerWatch:
+        w = WorkerWatch(name, stall_after_s if stall_after_s is not None
+                        else self.stall_after_s, thread)
+        with self._lock:
+            self._watches[name] = w
+        return w
+
+    def check(self) -> Tuple[bool, str]:
+        """``(ok, detail)``: ok iff no registered worker is stalled."""
+        with self._lock:
+            watches = list(self._watches.values())
+        bad = []
+        for w in watches:
+            stalled, detail = w.stalled()
+            if self.registry is not None:
+                self.registry.set_gauge("worker_stalled",
+                                        1.0 if stalled else 0.0,
+                                        worker=w.name)
+            if stalled:
+                bad.append(detail)
+        if bad:
+            return False, "; ".join(bad)
+        return True, f"{len(watches)} worker(s) healthy"
+
+
+def delta_log_check(log) -> Check:
+    """Ready iff the delta log's last append landed (``DeltaLog.healthy``
+    flips False on a write error and True again when an append
+    succeeds — the disk healed)."""
+
+    def _check():
+        if log.healthy:
+            return True, "delta log writable"
+        return False, (f"delta log degraded "
+                       f"({log.write_errors} write error(s))")
+
+    return _check
+
+
+def follower_staleness_check(follower, bound_s: float) -> Check:
+    """Ready iff the log follower applied the tail within ``bound_s``.
+    Never-succeeded counts as stale: a replica is not ready before its
+    first complete catch-up."""
+
+    def _check():
+        last = follower.last_success_at
+        if last is None:
+            return False, "catch-up has not completed yet"
+        age = time.monotonic() - last
+        if age > bound_s:
+            return False, (f"catch-up stale: {age:.1f}s > {bound_s:.1f}s "
+                           f"({follower.errors_total} error(s))")
+        return True, f"catch-up fresh ({age:.2f}s ago)"
+
+    return _check

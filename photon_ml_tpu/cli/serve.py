@@ -204,6 +204,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "incoming generation before activation")
     p.add_argument("--delta-log-poll", type=float, default=0.05,
                    help="seconds between delta-log tail polls")
+    p.add_argument("--staleness-bound", type=float, default=5.0,
+                   help="readiness (/readyz on --metrics-port): maximum "
+                        "age of the last successful delta-log catch-up "
+                        "pass before this replica reports not-ready; also "
+                        "the watchdog's per-worker stall bound")
     p.add_argument("--subscribe", default="",
                    help="host:port of a photonrepl owner (learn.py "
                         "--repl-listen): bootstrap the base model from a "
@@ -423,7 +428,8 @@ def _auth_token(args: argparse.Namespace) -> Optional[str]:
 
 
 def _run_network(engine: ScoringEngine, swapper: HotSwapper,
-                 args: argparse.Namespace) -> int:
+                 args: argparse.Namespace, health=None,
+                 watchdog=None) -> int:
     """--listen mode: the serving.frontend edge on an asyncio loop this
     process owns, with an optional same-loop /metrics scrape endpoint and
     SIGTERM/SIGINT wired to the graceful drain."""
@@ -450,12 +456,17 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
     async def _main() -> int:
         front = FrontendServer(engine, swapper, config)
         await front.start()
+        if watchdog is not None:
+            # the edge batcher exists only after start(): watch it too
+            front.batcher.watch = watchdog.register(
+                "batcher", front.batcher.worker_thread)
         scrape = None
         if args.metrics_port:
             scrape = await MetricsEndpoint(
-                engine.metrics, port=args.metrics_port).start()
-            logger.info("metrics scrape on http://127.0.0.1:%d/metrics",
-                        scrape.port)
+                engine.metrics, port=args.metrics_port,
+                health=health).start()
+            logger.info("metrics scrape on http://127.0.0.1:%d/metrics "
+                        "(+ /healthz, /readyz)", scrape.port)
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM, signal.SIGINT):
             try:
@@ -600,19 +611,46 @@ def run(argv: List[str]) -> int:
                                interval_s=args.hot_set_interval).start()
         logger.info("hot-set rebalancing every %.3fs", args.hot_set_interval)
 
+    # readiness surface (/readyz on --metrics-port): engine warmed AND the
+    # delta feed writable/fresh AND no registered worker stalled.  Built
+    # unconditionally — cheap, and the bench/tests read it in-process.
+    from photon_ml_tpu.chaos.health import (HealthState, Watchdog,
+                                            delta_log_check,
+                                            follower_staleness_check)
+
+    health = HealthState(registry=engine.metrics.registry)
+    watchdog = Watchdog(stall_after_s=args.staleness_bound,
+                        registry=engine.metrics.registry)
+    health.add_check("workers", watchdog.check)
+    health.set_condition(
+        "engine_warmed", True,
+        "warm skipped (--no-warm)" if args.no_warm
+        else "bucket ladder compiled at startup")
+    if delta_log is not None:
+        health.add_check("delta_log", delta_log_check(delta_log))
+    if follower is not None:
+        health.add_check("catchup", follower_staleness_check(
+            follower, args.staleness_bound))
+        follower.watch = watchdog.register("follower",
+                                           follower.worker_thread)
+    if client is not None:
+        watchdog.register("subscriber", client.worker_thread)
+
     metrics_sidecar = None
     try:
         if args.listen:
-            rc = _run_network(engine, swapper, args)
+            rc = _run_network(engine, swapper, args, health=health,
+                              watchdog=watchdog)
         else:
             if args.metrics_port:
                 from photon_ml_tpu.serving.frontend.metrics_http import \
                     ThreadedMetricsEndpoint
 
                 metrics_sidecar = ThreadedMetricsEndpoint(
-                    engine.metrics, port=args.metrics_port).start()
-                logger.info("metrics scrape on http://127.0.0.1:%d/metrics",
-                            metrics_sidecar.port)
+                    engine.metrics, port=args.metrics_port,
+                    health=health).start()
+                logger.info("metrics scrape on http://127.0.0.1:%d/metrics"
+                            " (+ /healthz, /readyz)", metrics_sidecar.port)
             lines = sys.stdin if args.requests == "-" \
                 else open(args.requests)
             try:

@@ -118,20 +118,36 @@ class LogFollower:
     def __init__(self, log: DeltaLog,
                  store_getter: Callable[[], "CoefficientStore"],
                  poll_interval_s: float = 0.05,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 backoff_max_s: float = 2.0):
         self.log = log
         self._store_getter = store_getter
         self.poll_interval_s = poll_interval_s
+        self.backoff_max_s = backoff_max_s
         self._registry = registry
         self._position: Optional[Tuple[int, int]] = None
         self._store_generation: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._run_lock = threading.Lock()
+        # follow-loop health (chaos.health reads these): a persistently
+        # failing pass must be VISIBLE, not a quiet hot loop
+        self.errors_total = 0
+        self.consecutive_errors = 0
+        self.last_success_at: Optional[float] = None
+        # optional chaos.health.WorkerWatch — wraps each pass so the
+        # watchdog can tell "wedged mid-pass" from "idle between polls"
+        self.watch = None
 
     @property
     def position(self) -> Optional[Tuple[int, int]]:
         return self._position
+
+    @property
+    def worker_thread(self) -> Optional[threading.Thread]:
+        """The follow-loop thread (None before ``start``) — what a
+        chaos.health.Watchdog registers."""
+        return self._thread
 
     def run_once(self) -> CatchupStats:
         """One catch-up pass: apply everything past the current position."""
@@ -148,6 +164,8 @@ class LogFollower:
                                           registry=self._registry)
             if stats.position is not None:
                 self._position = stats.position
+            self.last_success_at = time.monotonic()
+            self.consecutive_errors = 0
             return stats
 
     def start(self) -> None:
@@ -166,9 +184,28 @@ class LogFollower:
             self._thread = None
 
     def _loop(self) -> None:
+        # Exponential backoff on failure (capped, reset on success): a
+        # persistently broken log must not spin a hot error loop at the
+        # poll interval, and every failed pass is counted — silence here
+        # is exactly the failure mode photonlint PL009 flags.
+        delay = self.poll_interval_s
         while not self._stop.is_set():
             try:
-                self.run_once()
+                if self.watch is not None:
+                    with self.watch.busy():
+                        self.run_once()
+                else:
+                    self.run_once()
+                delay = self.poll_interval_s
             except Exception:
-                logger.exception("catchup: follow pass failed; retrying")
-            self._stop.wait(self.poll_interval_s)
+                with self._run_lock:
+                    self.errors_total += 1
+                    self.consecutive_errors += 1
+                if self._registry is not None:
+                    self._registry.inc("catchup_follow_errors_total")
+                delay = min(max(delay, self.poll_interval_s) * 2,
+                            self.backoff_max_s)
+                logger.exception(
+                    "catchup: follow pass failed (%d consecutive); "
+                    "retrying in %.2fs", self.consecutive_errors, delay)
+            self._stop.wait(delay)

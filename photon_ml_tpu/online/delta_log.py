@@ -57,6 +57,7 @@ import time
 import zlib
 from typing import IO, Callable, Iterator, List, Optional, Tuple
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs.registry import MetricsRegistry
 
 logger = logging.getLogger("photon_ml_tpu.online.delta_log")
@@ -160,6 +161,12 @@ class DeltaLog:
         self._last: Optional[Tuple[int, int]] = self.last_identity()
         self.bytes_written = 0
         self.records_written = 0
+        # Degradation state (chaos/health consume these): ``healthy``
+        # flips False on an append write error and True again on the next
+        # successful append — the disk healed.  The log NEVER takes the
+        # process down; publishes fail loudly while serving continues.
+        self.healthy = True
+        self.write_errors = 0
         self._listeners: List[Callable[[DeltaRecord], None]] = []
         # Optional retention floor provider (photonrepl installs one): a
         # callable returning the lowest generation that must survive
@@ -219,12 +226,37 @@ class DeltaLog:
                     f"delta log: non-monotone identity {record.identity} "
                     f"after {self._last} — writer restart without "
                     "advance_generation_floor, or two writers on one log")
-            f = self._segment_for(record.generation)
             frame = record.encode()
-            f.write(frame)
-            f.flush()
-            if self.fsync == "always":
-                self._fsync(f)
+            try:
+                f = self._segment_for(record.generation)
+                # valid-frame boundary BEFORE the write: "ab" mode means
+                # writes always land at EOF, but truncate() still works —
+                # this offset is what a failed append rolls back to
+                pos = f.seek(0, os.SEEK_END)
+            except OSError:
+                self._note_write_error()
+                raise
+            try:
+                act = _chaos_fault("delta_log.append")
+                if act is not None:
+                    if act.kind == "torn":
+                        # commit a partial frame first so recovery has a
+                        # REAL torn tail to truncate, not a clean boundary
+                        f.write(frame[:max(1, len(frame) // 2)])
+                        f.flush()
+                    raise act.to_error()
+                f.write(frame)
+                f.flush()
+                if self.fsync == "always":
+                    act = _chaos_fault("delta_log.fsync")
+                    if act is not None:
+                        raise act.to_error()
+                    self._fsync(f)
+            except OSError:
+                self._note_write_error()
+                self._truncate_to(f, pos)
+                raise
+            self.healthy = True
             self._last = record.identity
             self.bytes_written += len(frame)
             self.records_written += 1
@@ -236,6 +268,34 @@ class DeltaLog:
                 fn(record)
             except Exception:  # noqa: BLE001 — see add_listener contract
                 logger.exception("delta log: append listener failed")
+
+    def _note_write_error(self) -> None:
+        # only reached from append's `with self._lock` block
+        self.write_errors += 1
+        self.healthy = False  # photonlint: disable=lock-discipline -- caller holds self._lock
+        if self._registry is not None:
+            self._registry.inc("delta_log_write_errors_total")
+
+    def _truncate_to(self, f: IO[bytes], pos: int) -> None:
+        """A write failed mid-frame: the segment must stay appendable.
+        Roll back to the last valid frame boundary so the NEXT append
+        lands on clean bytes instead of extending a torn frame that
+        replay would stop at forever."""
+        try:
+            f.truncate(pos)
+        except OSError:
+            # disk too sick even to truncate: drop the handle — the next
+            # append reopens via _segment_for, whose torn-tail scan
+            # repairs the file from disk state
+            logger.exception(
+                "delta log: truncate after failed append failed; closing "
+                "segment handle for reopen-repair")
+            try:
+                f.close()
+            except OSError:
+                pass
+            self._file = None
+            self._file_generation = None
 
     def _segment_for(self, generation: int) -> IO[bytes]:
         if self._file is not None and self._file_generation == generation:

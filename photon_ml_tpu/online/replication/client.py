@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, Optional, Tuple
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.online.delta_log import DeltaLog
 from photon_ml_tpu.online.replication.snapshot import (SnapshotError,
@@ -164,6 +165,12 @@ class ReplicationClient:
     def last_identity(self) -> Optional[Tuple[int, int]]:
         return self._last
 
+    @property
+    def worker_thread(self) -> threading.Thread:
+        """The subscriber loop thread — what a chaos.health.Watchdog
+        registers."""
+        return self._thread
+
     def _run(self) -> None:
         try:
             asyncio.run(self._main())
@@ -254,6 +261,11 @@ class ReplicationClient:
             last_ack = now
 
         while not self._stop.is_set():
+            act = _chaos_fault("repl.client.read")
+            if act is not None:
+                # client-side session death: _main's backoff reconnect is
+                # the heal path — resume via log or snapshot fallback
+                raise act.to_error()
             try:
                 line = await asyncio.wait_for(
                     f.readline(), self.config.ack_interval_s)

@@ -56,6 +56,7 @@ import threading
 import time
 from typing import Callable, Deque, Dict, Optional, Tuple
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
 from photon_ml_tpu.online.replication.snapshot import (SnapshotError,
@@ -524,6 +525,22 @@ class ReplicationServer:
             self._close_follower(f)
 
     async def _send_record(self, f: _Follower, rec: DeltaRecord) -> None:
+        act = _chaos_fault("repl.server.send")
+        if act is not None:
+            # chaos seams, in the follower's terms: "drop" = the TCP
+            # session dies mid-stream (client reconnects and resumes);
+            # "stall" = a slow owner (client ack timer keeps ticking);
+            # "garbage" = a corrupt frame on the wire IN PLACE of the
+            # record (client must fail typed, reconnect, and recover the
+            # record via log catch-up — f.sent is not advanced)
+            if act.kind == "stall":
+                await asyncio.sleep(float(act.data.get("stall_s", 0.05)))
+            elif act.kind == "garbage":
+                f.writer.write(b"\x7f{not json//\n")
+                await f.writer.drain()
+                return
+            else:
+                raise act.to_error()
         line = encode_record_line(rec)
         f.writer.write(line)
         await f.writer.drain()
@@ -601,6 +618,12 @@ class ReplicationServer:
             "generation": gen, "version": os.path.basename(
                 os.path.normpath(model_dir))}))
         for off in range(0, len(data), self.config.snapshot_chunk):
+            act = _chaos_fault("repl.server.snapshot")
+            if act is not None:
+                # mid-snapshot disconnect: the follower sees a short read
+                # against the announced byte count, fails its CRC/length
+                # check, and re-bootstraps on reconnect
+                raise act.to_error()
             f.writer.write(data[off: off + self.config.snapshot_chunk])
             await f.writer.drain()
         f.floor = gen

@@ -233,9 +233,17 @@ class AsyncBatcher:
         self._closed = False
         self._flush_ewma_s: Optional[float] = None  # observed flush cost
         self._inflight_since: Optional[float] = None  # flush in progress
+        # optional chaos.health.WorkerWatch: wraps each flush so a
+        # watchdog can flip readiness on a wedged scorer
+        self.watch = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=name)
         self._thread.start()
+
+    @property
+    def worker_thread(self) -> threading.Thread:
+        """The flush worker — what a chaos.health.Watchdog registers."""
+        return self._thread
 
     # -- producer side -----------------------------------------------------
     def submit(self, request: Request) -> "Future[float]":
@@ -342,7 +350,11 @@ class AsyncBatcher:
                 forced, self._force = self._force, False
                 closed = self._closed
                 self._inflight_since = time.perf_counter()
-            self._flush_batch(batch, forced=forced or closed)
+            if self.watch is not None:
+                with self.watch.busy():
+                    self._flush_batch(batch, forced=forced or closed)
+            else:
+                self._flush_batch(batch, forced=forced or closed)
             with self._cond:
                 dt = time.perf_counter() - self._inflight_since
                 self._inflight_since = None

@@ -11,7 +11,13 @@ plumbing), and so the stdio serve loop can host it on a sidecar thread via
 :class:`ThreadedMetricsEndpoint` without dragging in a blocking server.
 
 Routes:  ``GET /metrics`` -> Prometheus text exposition;
-``GET /metrics.json`` -> the structured JSON dump.  Anything else is 404.
+``GET /metrics.json`` -> the structured JSON dump;
+``GET /healthz`` -> 200 whenever this listener can answer at all (process
+liveness); ``GET /readyz`` -> 200 when every registered readiness check
+passes, 503 with the failing checks as JSON while degraded (orchestrator
+traffic gate — see ``chaos/health.py``).  A sidecar built without a
+``HealthState`` answers ``/readyz`` 200 vacuously, so a bare metrics
+scraper deployment keeps working unchanged.  Anything else is 404.
 Connections are one-shot (``Connection: close``) — scrape traffic, not an
 API.
 """
@@ -19,9 +25,11 @@ API.
 from __future__ import annotations
 
 import asyncio
+import json
 import threading
 from typing import Optional
 
+from photon_ml_tpu.chaos.health import HealthState
 from photon_ml_tpu.serving.metrics import ServingMetrics
 
 _MAX_REQUEST_BYTES = 8192  # a scrape request line + headers; hard bound
@@ -31,10 +39,11 @@ class MetricsEndpoint:
     """One-loop asyncio scrape listener (module docstring)."""
 
     def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, health: Optional[HealthState] = None):
         self.metrics = metrics
         self.host = host
         self.config_port = port
+        self.health = health
         self.port: Optional[int] = None
         self._server: Optional[asyncio.base_events.Server] = None
 
@@ -71,17 +80,36 @@ class MetricsEndpoint:
                 writer.write(_response(405, b"method not allowed\n",
                                        b"text/plain"))
                 return
+            status = 200
             if path in ("/metrics", "/metrics/"):
                 body = self.metrics.to_prometheus().encode("utf-8")
                 ctype = b"text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = self.metrics.to_json().encode("utf-8")
                 ctype = b"application/json"
+            elif path == "/healthz":
+                # liveness: answering at all IS the signal
+                body = b'{"alive": true}\n'
+                ctype = b"application/json"
+            elif path == "/readyz":
+                if self.health is None:
+                    ready, checks = True, {}
+                else:
+                    # check evaluation can block (a pull check may take a
+                    # lock a wedged worker holds) — keep the loop live
+                    ready, checks = await asyncio.get_running_loop(
+                        ).run_in_executor(None, self.health.readyz)
+                status = 200 if ready else 503
+                body = (json.dumps({"ready": ready, "checks": checks},
+                                   sort_keys=True) + "\n").encode("utf-8")
+                ctype = b"application/json"
             else:
                 writer.write(_response(
-                    404, b"try /metrics or /metrics.json\n", b"text/plain"))
+                    404, b"try /metrics, /metrics.json, /healthz or "
+                         b"/readyz\n", b"text/plain"))
                 return
-            writer.write(_response(200, b"" if method == "HEAD" else body,
+            writer.write(_response(status,
+                                   b"" if method == "HEAD" else body,
                                    ctype, content_length=len(body)))
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -93,7 +121,8 @@ class MetricsEndpoint:
 
 
 _REASONS = {200: b"OK", 404: b"Not Found", 405: b"Method Not Allowed",
-            431: b"Request Header Fields Too Large"}
+            431: b"Request Header Fields Too Large",
+            503: b"Service Unavailable"}
 
 
 def _response(status: int, body: bytes, ctype: bytes,
@@ -111,8 +140,8 @@ class ThreadedMetricsEndpoint:
     the blocking stdio serve loop uses for ``--metrics-port``."""
 
     def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
-                 port: int = 0):
-        self.endpoint = MetricsEndpoint(metrics, host, port)
+                 port: int = 0, health: Optional[HealthState] = None):
+        self.endpoint = MetricsEndpoint(metrics, host, port, health=health)
         self._ready = threading.Event()
         self._stop: Optional[asyncio.Event] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None

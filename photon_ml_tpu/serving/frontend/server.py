@@ -82,6 +82,7 @@ import logging
 import threading
 from typing import Dict, Optional
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.batcher import request_from_json
@@ -193,6 +194,12 @@ class FrontendServer:
         self._closed: Optional[asyncio.Event] = None
         self.port: Optional[int] = None
 
+    @property
+    def batcher(self):
+        """The edge's AsyncBatcher — chaos.health wires a watchdog to
+        its worker thread."""
+        return self._batcher
+
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "FrontendServer":
         self._loop = asyncio.get_running_loop()
@@ -235,6 +242,16 @@ class FrontendServer:
     # -- connection handling -----------------------------------------------
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
+        act = _chaos_fault("front.conn")
+        if act is not None:
+            # chaos: the edge kills the connection before reading a byte —
+            # nothing was admitted, so nothing can be lost; the client
+            # retries against a fresh connection
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         cap = self.config.max_connections
         if cap is not None and len(self._conns) >= cap:
             self._registry.inc("front_connections_refused_total")

@@ -58,6 +58,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.online.catchup import replay_into_store
 from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
@@ -195,6 +196,14 @@ class HotSwapper:
                         "hot swap: replayed %d delta(s) onto incoming gen "
                         "%d (%d rejected)", stats.applied, new.generation,
                         stats.rejected)
+            act = _chaos_fault("swap.activate")
+            if act is not None:
+                # chaos: crash between the model-dir write/replay and the
+                # pointer flip — the window every swap protocol has to
+                # survive.  InjectedCrash propagates (a crash is not
+                # handled); the old generation keeps serving, exactly as
+                # a real process death would leave a restarted sibling.
+                raise act.to_error()
             self.engine.activate(new)
             self.delta_version = 0  # fresh generation: no deltas yet
             if replay_floor is not None:
@@ -239,6 +248,15 @@ class HotSwapper:
         metrics = self.engine.metrics
         with self._swap_lock:
             store = self.engine.store
+            prev = None
+            if self.delta_log is not None and self.log_owner:
+                # snapshot the row we are about to overwrite: if the log
+                # append fails the apply must be rolled back (see below)
+                c = store.coordinates.get(cid)
+                if c is not None and hasattr(c, "dense_row"):
+                    eid = store.entity_id(c.random_effect_type, entity)
+                    if eid >= 0:
+                        prev = c.dense_row(eid)
             try:
                 ok = store.apply_delta(cid, entity, row)
             except ValueError as e:
@@ -251,10 +269,30 @@ class HotSwapper:
             self.delta_version += 1
             identity = (store.generation, self.delta_version)
             if self.delta_log is not None and self.log_owner:
-                self.delta_log.append(DeltaRecord(
-                    generation=identity[0], delta_version=identity[1],
-                    cid=cid, entity=entity,
-                    row=tuple(float(x) for x in np.asarray(row).ravel())))
+                try:
+                    self.delta_log.append(DeltaRecord(
+                        generation=identity[0], delta_version=identity[1],
+                        cid=cid, entity=entity,
+                        row=tuple(float(x)
+                                  for x in np.asarray(row).ravel())))
+                except OSError as e:
+                    # Disk degradation: the log is the durable authority —
+                    # an unlogged delta must not stay live, or replicas
+                    # replaying the log can never reach this state.  Roll
+                    # the in-memory apply back, block THIS publish, and
+                    # keep serving; the log truncated itself to the last
+                    # valid frame, so the next publish retries cleanly
+                    # once the disk heals.
+                    if prev is not None:
+                        store.apply_delta(cid, entity, prev)
+                    self.delta_version -= 1
+                    metrics.registry.inc("delta_publish_blocked_total",
+                                         reason="log_append")
+                    logger.error(
+                        "delta publish blocked (gen %d): log append "
+                        "failed, apply rolled back, serving continues: %s",
+                        store.generation, e)
+                    return None
             return identity
 
     def swap_async(self, model_dir: str, version: str = "") -> threading.Thread:

@@ -30,6 +30,7 @@ import logging
 import time
 from typing import Iterator, Optional, Tuple
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs import trace as _trace
 from photon_ml_tpu.obs.registry import get_registry
 from photon_ml_tpu.stream.chunks import Chunk
@@ -59,6 +60,16 @@ class ChunkPipeline:
         self.error_count = 0
 
     def _decode(self, chunk: Chunk):
+        act = _chaos_fault("stream.decode")
+        if act is not None:
+            # "slow" exercises the pipeline-stall accounting; "corrupt"
+            # exercises the on_error raise/skip contract — both flow
+            # through the exact paths a real bad chunk would take
+            if act.kind == "slow":
+                time.sleep(float(act.data.get("stall_s", 0.05)))
+            else:
+                raise ValueError(
+                    f"injected {act.kind} chunk at index {chunk.index}")
         with _trace.span("stream.decode", chunk=chunk.index,
                          rows=chunk.n_rows):
             return self.source.decode_chunk(chunk)

@@ -583,6 +583,40 @@ class TestReplBenchCli:
         assert seen["out_path"] is None
 
 
+class TestChaosBenchCli:
+    """--chaos arg plumbing: flags reach run_chaos_bench parsed."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"metric": "chaos_time_to_ready_s_max"}
+
+        monkeypatch.setattr(bench, "run_chaos_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--chaos", "--chaos-seed", "7",
+            "--chaos-rounds", "12", "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["metric"] == "chaos_time_to_ready_s_max"
+        assert seen["seed"] == 7
+        assert seen["rounds"] == 12
+        assert seen["out_path"] == "ignored.json"
+
+    def test_defaults(self, monkeypatch, capsys):
+        seen = {}
+        monkeypatch.setattr(bench, "run_chaos_bench",
+                            lambda **kw: seen.update(kw) or {})
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--chaos"])
+        bench.main()
+        assert seen["seed"] == 0
+        assert seen["rounds"] == 9
+        assert seen["out_path"] is None
+
+
 class TestStreamBenchCli:
     """--stream arg plumbing: flags reach run_stream_bench parsed, and the
     early dispatch prints the runner's JSON line."""

@@ -1,0 +1,628 @@
+"""photonchaos tests: the deterministic fault-injection plane and the
+health/readiness surfaces it exists to exercise.
+
+The contracts under test (ISSUE 14):
+  - FaultInjector: disabled is a single boolean check that counts
+    nothing; schedules (Nth hit, seeded probability, timed window,
+    max_fires) are deterministic — same arm + same call sequence = same
+    fires; ``to_error`` maps kinds onto the exception each seam expects.
+  - Schedule: ``build_schedule`` is a pure function of the seed, and its
+    coverage pass hits every fault class once.
+  - HealthState/Watchdog: push conditions and pull checks aggregate into
+    one ready bit; a raising probe counts as failed; a worker wedged
+    mid-item (or dead) flips readiness and recovery flips it back.
+  - DeltaLog degradation (satellite 2): a failed append — including a
+    TORN one that got half a frame onto disk — leaves the segment
+    truncated at the last valid frame boundary and appendable in place;
+    ``healthy`` flips False and back True on the next landed append.
+  - publish_delta degradation: a blocked publish rolls the in-memory
+    apply back BITWISE, burns no identity, counts
+    ``delta_publish_blocked_total{reason=...}``, and serving continues.
+  - /readyz over real HTTP: 503 while a REAL injected fault holds the
+    log degraded, 200 again after the heal append — plus the vacuous
+    and push-condition paths.
+  - LogFollower (satellite 1): failed follow passes are counted
+    (``catchup_follow_errors_total``), back off, and reset on success.
+  - stream.decode seam: injected corrupt/slow chunks flow through the
+    exact on_error raise/skip contract a real bad chunk takes.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.chaos import (FAULT_CLASSES, FaultInjector, HealthState,
+                                 InjectedCrash, InjectedFault, Watchdog,
+                                 build_schedule, delta_log_check, fault,
+                                 follower_staleness_check, get_injector,
+                                 set_injector)
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.obs.registry import MetricsRegistry
+from photon_ml_tpu.online.catchup import LogFollower
+from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
+from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     StoreConfig)
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.serving.frontend.metrics_http import \
+    ThreadedMetricsEndpoint
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.swap import HotSwapper
+from photon_ml_tpu.stream.chunks import Chunk
+from photon_ml_tpu.stream.pipeline import ChunkPipeline
+from photon_ml_tpu.types import TaskType
+
+N_ENT = 16
+D = 4
+NAMES = [f"f{j}" for j in range(D)]
+
+
+@pytest.fixture(autouse=True)
+def _pristine_injector():
+    """The process-wide injector must never leak arms between tests."""
+    get_injector().reset()
+    yield
+    get_injector().reset()
+
+
+def _rec(g, v, entity="user1", row=None):
+    return DeltaRecord(generation=g, delta_version=v, cid="user",
+                       entity=entity,
+                       row=tuple(row if row is not None else
+                                 np.arange(D, dtype=float) + v))
+
+
+def _engine(seed=0, max_batch=8):
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=D)),
+            feature_shard="all", task=task),
+        "user": RandomEffectModel(
+            w_stack=rng.normal(size=(N_ENT, D)) * 0.5,
+            slot_of={i: i for i in range(N_ENT)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    })
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(NAMES)})
+    eidx = EntityIndex()
+    for i in range(N_ENT):
+        eidx.get_or_add(f"user{i}")
+    metrics = ServingMetrics()
+    store = CoefficientStore.from_model(
+        model, task, {"userId": eidx}, {"all": imap},
+        config=StoreConfig(device_capacity=None), version="synthetic",
+        metrics=metrics)
+    eng = ScoringEngine(store, BucketedBatcher(max_batch), metrics=metrics)
+    eng.warm()
+    return eng
+
+
+def _req(rng, uid, user):
+    feats = [{"name": n, "term": "", "value": float(v)}
+             for n, v in zip(NAMES, rng.normal(size=D))]
+    return Request(uid=uid, features=feats, ids={"userId": f"user{user}"})
+
+
+def _get(port, path):
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# injector core
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def test_disabled_is_noop_and_counts_nothing(self):
+        inj = get_injector()
+        assert inj.enabled is False
+        assert fault("never.armed") is None
+        # the fast path returned before the lock: not even a hit counted
+        assert inj.hits("never.armed") == 0
+
+    def test_default_arm_fires_on_every_hit(self):
+        inj = FaultInjector()
+        inj.arm("p")
+        assert all(inj.check("p") is not None for _ in range(5))
+        assert inj.fired("p") == 5
+        assert inj.hits("p") == 5
+
+    def test_nth_hit(self):
+        inj = FaultInjector()
+        inj.arm("p", "drop", nth=3)
+        assert [inj.check("p") is not None for _ in range(5)] == \
+            [False, False, True, False, False]
+
+    def test_nth_repeat(self):
+        inj = FaultInjector()
+        inj.arm("p", nth=2, repeat=True)
+        assert [inj.check("p") is not None for _ in range(6)] == \
+            [False, True, False, True, False, True]
+
+    def test_nth_zero_rejected(self):
+        with pytest.raises(ValueError, match="nth"):
+            FaultInjector().arm("p", nth=0)
+
+    def test_max_fires_caps_any_schedule(self):
+        inj = FaultInjector()
+        inj.arm("p", max_fires=2)
+        pattern = [inj.check("p") is not None for _ in range(5)]
+        assert pattern == [True, True, False, False, False]
+        assert inj.fired("p") == 2
+
+    def test_probability_deterministic_per_seed(self):
+        def run():
+            inj = FaultInjector()
+            inj.arm("p", probability=0.5, seed=123)
+            return [inj.check("p") is not None for _ in range(40)]
+
+        a, b = run(), run()
+        assert a == b
+        assert True in a and False in a  # an actual mix, not all-or-none
+
+    def test_window_gates_on_time_since_arm(self):
+        inj = FaultInjector()
+        inj.arm("p", window=(10.0, 1.0))  # opens 10s from now
+        assert inj.check("p") is None
+        inj.arm("p", window=(0.0, 30.0))  # open now
+        assert inj.check("p") is not None
+
+    def test_rearm_replaces_and_disarm_restores_fast_path(self):
+        inj = FaultInjector()
+        inj.arm("p", nth=1)
+        assert inj.check("p") is not None
+        inj.arm("p", "drop")  # replaces: fires counter starts over
+        assert inj.fired("p") == 0
+        inj.disarm("p")
+        assert inj.enabled is False
+        # hit counters survive disarm (coverage assertions); reset clears
+        assert inj.hits("p") == 1
+        inj.reset()
+        assert inj.hits("p") == 0
+
+    def test_action_carries_point_kind_data(self):
+        inj = FaultInjector()
+        inj.arm("p", "stall", data={"stall_s": 0.03})
+        act = inj.check("p")
+        assert (act.point, act.kind, act.data) == \
+            ("p", "stall", {"stall_s": 0.03})
+
+    def test_to_error_mapping(self):
+        import errno
+
+        from photon_ml_tpu.chaos import FaultAction
+        assert FaultAction("p", "enospc").to_error().errno == errno.ENOSPC
+        assert FaultAction("p", "torn").to_error().errno == errno.EIO
+        assert isinstance(FaultAction("p", "crash").to_error(),
+                          InjectedCrash)
+        assert isinstance(FaultAction("p", "drop").to_error(),
+                          ConnectionResetError)
+        assert isinstance(FaultAction("p", "disconnect").to_error(),
+                          ConnectionResetError)
+        assert isinstance(FaultAction("p", "garbage").to_error(),
+                          InjectedFault)
+
+    def test_fires_counted_in_registry(self):
+        reg = MetricsRegistry()
+        inj = FaultInjector(registry=reg)
+        inj.arm("delta_log.append", "enospc")
+        inj.check("delta_log.append")
+        assert reg.counter("chaos_faults_fired_total",
+                           point="delta_log.append", kind="enospc") == 1
+
+    def test_set_injector_swaps_the_module_entry_point(self):
+        mine = FaultInjector()
+        mine.arm("p", "drop")
+        prev = set_injector(mine)
+        try:
+            act = fault("p")
+            assert act is not None and act.kind == "drop"
+        finally:
+            set_injector(prev)
+        assert fault("p") is None  # the original injector is disabled
+
+
+# ---------------------------------------------------------------------------
+# seeded schedule
+# ---------------------------------------------------------------------------
+class TestSchedule:
+    def test_pure_function_of_seed(self):
+        assert build_schedule(5, 12) == build_schedule(5, 12)
+        assert build_schedule(5, 12) != build_schedule(6, 12)
+
+    def test_coverage_pass_hits_every_class_once(self):
+        n = len(FAULT_CLASSES)
+        ev = build_schedule(0, n + 3)
+        assert sorted(e.fault_class for e in ev[:n]) == \
+            sorted(FAULT_CLASSES)
+        assert all(e.fault_class in FAULT_CLASSES for e in ev[n:])
+
+    def test_events_match_the_catalog(self):
+        for e in build_schedule(3, 14):
+            point, kind = FAULT_CLASSES[e.fault_class]
+            assert (e.point, e.kind) == (point, kind)
+            if kind == "stall":
+                assert 0.02 <= e.data["stall_s"] <= 0.10
+            else:
+                assert e.data == {}
+
+
+# ---------------------------------------------------------------------------
+# health state + watchdog
+# ---------------------------------------------------------------------------
+class TestHealthState:
+    def test_push_conditions_and_pull_checks_aggregate(self):
+        reg = MetricsRegistry()
+        h = HealthState(registry=reg)
+        h.set_condition("warm", True, "compiled")
+        ready, checks = h.readyz()
+        assert ready and checks["warm"]["ok"]
+        h.add_check("probe", lambda: (False, "it hurts"))
+        ready, checks = h.readyz()
+        assert not ready
+        assert checks["probe"] == {"ok": False, "detail": "it hurts"}
+        assert reg.gauge("health_ready") == 0.0
+        assert reg.gauge("health_check_ok", check="probe") == 0.0
+        assert reg.gauge("health_check_ok", check="warm") == 1.0
+
+    def test_raising_probe_counts_as_failed(self):
+        h = HealthState()
+        h.add_check("boom", lambda: 1 / 0)
+        ready, checks = h.readyz()
+        assert not ready
+        assert "check raised" in checks["boom"]["detail"]
+
+    def test_condition_flip_recovers(self):
+        h = HealthState()
+        h.set_condition("warm", False, "warming")
+        assert h.readyz()[0] is False
+        h.set_condition("warm", True, "done")
+        assert h.readyz()[0] is True
+
+
+class TestWatchdog:
+    def test_overlong_busy_item_stalls_and_recovers(self):
+        reg = MetricsRegistry()
+        wd = Watchdog(stall_after_s=0.02, registry=reg)
+        w = wd.register("flusher")
+        assert wd.check()[0] is True
+        with w.busy():
+            time.sleep(0.05)
+            ok, detail = wd.check()
+            assert not ok and "in flight" in detail
+            assert reg.gauge("worker_stalled", worker="flusher") == 1.0
+        ok, detail = wd.check()
+        assert ok and "healthy" in detail
+        assert reg.gauge("worker_stalled", worker="flusher") == 0.0
+
+    def test_beat_restamps_a_legitimately_long_item(self):
+        wd = Watchdog(stall_after_s=0.04)
+        w = wd.register("shipper")
+        with w.busy():
+            time.sleep(0.03)
+            w.beat()  # still making progress
+            time.sleep(0.02)
+            assert wd.check()[0] is True
+
+    def test_dead_thread_is_stalled(self):
+        wd = Watchdog(stall_after_s=10.0)
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        wd.register("worker", t)
+        ok, detail = wd.check()
+        assert not ok and "not alive" in detail
+
+    def test_per_worker_stall_override(self):
+        wd = Watchdog(stall_after_s=10.0)
+        w = wd.register("slow-ok", stall_after_s=0.01)
+        with w.busy():
+            time.sleep(0.03)
+            assert wd.check()[0] is False
+
+    def test_health_integration(self):
+        h = HealthState()
+        wd = Watchdog(stall_after_s=0.01)
+        h.add_check("workers", wd.check)
+        w = wd.register("w")
+        assert h.readyz()[0] is True
+        with w.busy():
+            time.sleep(0.03)
+            assert h.readyz()[0] is False
+        assert h.readyz()[0] is True
+
+
+# ---------------------------------------------------------------------------
+# delta-log degradation (satellite 2)
+# ---------------------------------------------------------------------------
+class TestDeltaLogDegradation:
+    def test_torn_append_truncates_to_last_frame_boundary(self, tmp_path):
+        """THE regression: a mid-frame write failure must leave the
+        segment appendable — half a frame on disk would poison every
+        later append for replay."""
+        reg = MetricsRegistry()
+        log = DeltaLog(str(tmp_path), fsync="never", registry=reg)
+        log.append(_rec(1, 1))
+        seg_path = log.segments()[0][1]
+        size_before = os.path.getsize(seg_path)
+
+        get_injector().arm("delta_log.append", "torn", max_fires=1)
+        with pytest.raises(OSError):
+            log.append(_rec(1, 2))
+
+        assert log.healthy is False
+        assert log.write_errors == 1
+        assert reg.counter("delta_log_write_errors_total") == 1
+        # the torn half-frame was truncated away, byte for byte
+        assert os.path.getsize(seg_path) == size_before
+        assert [r.identity for r in log.replay()] == [(1, 1)]
+
+        # the SAME writer resumes in place once the disk heals
+        log.append(_rec(1, 2))
+        assert log.healthy is True
+        assert [r.identity for r in log.replay()] == [(1, 1), (1, 2)]
+        log.close()
+
+    def test_enospc_append_leaves_log_appendable(self, tmp_path):
+        reg = MetricsRegistry()
+        log = DeltaLog(str(tmp_path), fsync="never", registry=reg)
+        log.append(_rec(1, 1))
+        get_injector().arm("delta_log.append", "enospc", max_fires=1)
+        with pytest.raises(OSError) as ei:
+            log.append(_rec(1, 2))
+        import errno
+        assert ei.value.errno == errno.ENOSPC
+        assert not log.healthy and log.write_errors == 1
+        log.append(_rec(1, 2))
+        assert log.healthy
+        assert [r.identity for r in log.replay()] == [(1, 1), (1, 2)]
+        log.close()
+
+    def test_fsync_failure_degrades_too(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="always")
+        get_injector().arm("delta_log.fsync", "enospc", max_fires=1)
+        with pytest.raises(OSError):
+            log.append(_rec(1, 1))
+        assert not log.healthy
+        log.append(_rec(1, 1))  # identity was never consumed
+        assert log.healthy
+        log.close()
+
+
+class TestPublishDegradation:
+    def test_blocked_publish_rolls_back_bitwise_and_heals(self, tmp_path):
+        eng = _engine()
+        log = DeltaLog(str(tmp_path), fsync="never")
+        swapper = HotSwapper(eng, delta_log=log)
+        rng = np.random.default_rng(0)
+        dim = eng.store.coordinates["user"].dim
+
+        id1 = swapper.publish_delta("user", "user1",
+                                    rng.normal(size=dim))
+        assert id1 is not None
+
+        store = eng.store
+        c = store.coordinates["user"]
+        eid = store.entity_id(c.random_effect_type, "user1")
+        before = np.array(c.dense_row(eid), copy=True)
+        v_before = swapper.delta_version
+        probe = [_req(np.random.default_rng(7), 0, 1)]
+        score_before = [float(s) for s in eng.score_requests(probe)]
+
+        get_injector().arm("delta_log.append", "enospc", max_fires=1)
+        blocked = swapper.publish_delta("user", "user1",
+                                        rng.normal(size=dim))
+        assert blocked is None
+        # the in-memory apply was rolled back bitwise; no identity burned
+        assert np.array_equal(before, np.asarray(c.dense_row(eid)))
+        assert swapper.delta_version == v_before
+        assert eng.metrics.registry.counter(
+            "delta_publish_blocked_total", reason="log_append") == 1
+        assert not log.healthy
+        # serving CONTINUES on the pre-fault coefficients
+        assert [float(s) for s in eng.score_requests(probe)] == \
+            score_before
+
+        # heal: the next publish lands with the NEXT identity — the
+        # blocked one left no gap in the chain
+        id2 = swapper.publish_delta("user", "user1",
+                                    rng.normal(size=dim))
+        assert id2 == (id1[0], id1[1] + 1)
+        assert log.healthy
+        assert [r.identity for r in log.replay()] == [id1, id2]
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /readyz over real HTTP
+# ---------------------------------------------------------------------------
+class TestReadyzHttp:
+    def test_healthz_always_alive_readyz_vacuous_without_health(self):
+        ep = ThreadedMetricsEndpoint(ServingMetrics()).start()
+        try:
+            code, body = _get(ep.port, "/healthz")
+            assert code == 200 and json.loads(body)["alive"] is True
+            code, body = _get(ep.port, "/readyz")
+            assert code == 200 and json.loads(body)["ready"] is True
+        finally:
+            ep.stop()
+
+    def test_readyz_follows_push_conditions(self):
+        m = ServingMetrics()
+        h = HealthState(registry=m.registry)
+        h.set_condition("engine_warmed", False, "warming")
+        ep = ThreadedMetricsEndpoint(m, health=h).start()
+        try:
+            code, body = _get(ep.port, "/readyz")
+            assert code == 503
+            obj = json.loads(body)
+            assert obj["ready"] is False
+            assert obj["checks"]["engine_warmed"]["ok"] is False
+            assert _get(ep.port, "/healthz")[0] == 200  # alive regardless
+            h.set_condition("engine_warmed", True, "compiled")
+            code, body = _get(ep.port, "/readyz")
+            assert code == 200 and json.loads(body)["ready"] is True
+        finally:
+            ep.stop()
+
+    def test_readyz_degrades_on_real_injected_fault_and_recovers(
+            self, tmp_path):
+        """The acceptance path: a REAL fault through the injector flips
+        /readyz to 503; the heal append flips it back."""
+        m = ServingMetrics()
+        log = DeltaLog(str(tmp_path), fsync="never", registry=m.registry)
+        h = HealthState(registry=m.registry)
+        h.add_check("delta_log", delta_log_check(log))
+        ep = ThreadedMetricsEndpoint(m, health=h).start()
+        try:
+            log.append(_rec(1, 1))
+            assert _get(ep.port, "/readyz")[0] == 200
+
+            get_injector().arm("delta_log.append", "enospc", max_fires=1)
+            with pytest.raises(OSError):
+                log.append(_rec(1, 2))
+            code, body = _get(ep.port, "/readyz")
+            assert code == 503
+            checks = json.loads(body)["checks"]
+            assert "degraded" in checks["delta_log"]["detail"]
+
+            log.append(_rec(1, 2))  # the disk healed
+            assert _get(ep.port, "/readyz")[0] == 200
+        finally:
+            ep.stop()
+            log.close()
+
+
+# ---------------------------------------------------------------------------
+# follower backoff (satellite 1)
+# ---------------------------------------------------------------------------
+class TestCatchupBackoff:
+    def test_follow_errors_counted_backoff_resets_on_success(
+            self, tmp_path):
+        reg = MetricsRegistry()
+        log = DeltaLog(str(tmp_path), fsync="never")
+        log.append(_rec(1, 1))
+        broken = {"on": True}
+
+        class _Store:
+            generation = 1
+
+            def apply_delta(self, cid, entity, row):
+                if broken["on"]:
+                    raise RuntimeError("injected store failure")
+                return True
+
+        store = _Store()
+        f = LogFollower(log, lambda: store, poll_interval_s=0.005,
+                        registry=reg, backoff_max_s=0.05)
+        fsc = follower_staleness_check(f, bound_s=5.0)
+        assert fsc() == (False, "catch-up has not completed yet")
+        f.start()
+        try:
+            deadline = time.monotonic() + 10
+            while f.errors_total < 3 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert f.errors_total >= 3
+            assert f.consecutive_errors >= 3
+            assert f.last_success_at is None
+            assert reg.counter("catchup_follow_errors_total") >= 3
+            assert fsc()[0] is False
+
+            broken["on"] = False  # heal
+            deadline = time.monotonic() + 10
+            while f.last_success_at is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert f.last_success_at is not None
+            assert f.consecutive_errors == 0  # backoff reset
+            assert f.position == (1, 1)
+            ok, detail = fsc()
+            assert ok and "fresh" in detail
+        finally:
+            f.stop()
+            log.close()
+
+    def test_watch_wraps_follow_passes(self, tmp_path):
+        log = DeltaLog(str(tmp_path), fsync="never")
+        log.append(_rec(1, 1))
+
+        class _Store:
+            generation = 1
+
+            def apply_delta(self, cid, entity, row):
+                return True
+
+        wd = Watchdog(stall_after_s=5.0)
+        f = LogFollower(log, lambda: _Store(), poll_interval_s=0.005)
+        f.watch = wd.register("follower")
+        f.start()
+        try:
+            deadline = time.monotonic() + 10
+            while f.last_success_at is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            f.watch.set_thread(f.worker_thread)
+            assert wd.check()[0] is True
+        finally:
+            f.stop()
+            log.close()
+        # stopped follower: its thread is dead -> the watchdog sees it
+        assert wd.check()[0] is False
+
+
+# ---------------------------------------------------------------------------
+# stream.decode seam
+# ---------------------------------------------------------------------------
+class _FakeSource:
+    def __init__(self, n=4):
+        self.chunks = [Chunk(index=i, path="mem", n_rows=1)
+                       for i in range(n)]
+
+    def decode_chunk(self, chunk):
+        return [("row", chunk.index)]
+
+
+class TestStreamSeam:
+    def test_corrupt_chunk_raises_under_raise_policy(self):
+        get_injector().arm("stream.decode", "corrupt", max_fires=1)
+        pl = ChunkPipeline(_FakeSource(), workers=1, depth=0,
+                           on_error="raise")
+        with pytest.raises(ValueError, match="injected corrupt"):
+            list(pl)
+
+    def test_corrupt_chunk_skipped_under_skip_policy(self):
+        get_injector().arm("stream.decode", "corrupt", max_fires=1)
+        pl = ChunkPipeline(_FakeSource(), workers=1, depth=0,
+                           on_error="skip")
+        out = list(pl)
+        assert len(out) == 4  # order preserved, nothing dropped
+        errored = [c.index for c, recs, err in out if err is not None]
+        assert errored == [0] and pl.error_count == 1
+        good = [recs for _, recs, err in out if err is None]
+        assert len(good) == 3
+
+    def test_slow_chunk_decodes_fine_just_late(self):
+        inj = get_injector()
+        inj.arm("stream.decode", "slow", max_fires=1,
+                data={"stall_s": 0.02})
+        out = list(ChunkPipeline(_FakeSource(), workers=1, depth=0))
+        assert all(err is None for _, _, err in out)
+        assert [recs[0][1] for _, recs, _ in out] == [0, 1, 2, 3]
+        assert inj.fired("stream.decode") == 1

@@ -977,6 +977,148 @@ class TestCrossModuleResolution:
         assert kept2 == []
 
 
+# -- PL009 swallowed-exception -----------------------------------------------
+
+class TestSwallowedException:
+    def test_positive_thread_target_method(self):
+        vs = lint("""
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    while True:
+                        try:
+                            self.step()
+                        except Exception:
+                            pass
+        """, "swallowed-exception")
+        assert len(vs) == 1 and vs[0].rule == "swallowed-exception"
+        assert "detached" in vs[0].message
+
+    def test_positive_async_def_body(self):
+        vs = lint("""
+            async def pump(q):
+                while True:
+                    try:
+                        await q.drain()
+                    except Exception:
+                        continue
+        """, "swallowed-exception")
+        assert len(vs) == 1
+
+    def test_positive_tuple_containing_exception(self):
+        vs = lint("""
+            import threading
+
+            def run():
+                try:
+                    work()
+                except (ValueError, Exception):
+                    pass
+
+            threading.Thread(target=run).start()
+        """, "swallowed-exception")
+        assert len(vs) == 1
+
+    def test_positive_bare_except(self):
+        vs = lint("""
+            async def loop():
+                try:
+                    step()
+                except:
+                    pass
+        """, "swallowed-exception")
+        assert len(vs) == 1
+
+    def test_negative_logging_counts_as_handled(self):
+        assert lint("""
+            import logging
+            logger = logging.getLogger(__name__)
+
+            async def loop():
+                try:
+                    step()
+                except Exception:
+                    logger.exception("step failed")
+        """, "swallowed-exception") == []
+
+    def test_negative_metric_increment_counts_as_handled(self):
+        assert lint("""
+            async def loop(registry):
+                try:
+                    step()
+                except Exception:
+                    registry.inc("step_errors_total")
+        """, "swallowed-exception") == []
+
+    def test_negative_bound_name_use_counts_as_handled(self):
+        assert lint("""
+            async def loop(self):
+                try:
+                    step()
+                except Exception as e:
+                    self.last_error = e
+        """, "swallowed-exception") == []
+
+    def test_negative_reraise_counts_as_handled(self):
+        assert lint("""
+            async def loop():
+                try:
+                    step()
+                except Exception:
+                    raise
+        """, "swallowed-exception") == []
+
+    def test_negative_cleanup_only_try_exempt(self):
+        assert lint("""
+            async def close(writer):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+        """, "swallowed-exception") == []
+
+    def test_negative_not_a_thread_target(self):
+        # same swallow, but the function runs on the request path where a
+        # raise IS observed — out of scope
+        assert lint("""
+            def helper():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """, "swallowed-exception") == []
+
+    def test_negative_narrow_except_out_of_scope(self):
+        assert lint("""
+            async def loop():
+                try:
+                    step()
+                except ValueError:
+                    pass
+        """, "swallowed-exception") == []
+
+    def test_suppression_comment_works(self):
+        src = """
+            import threading
+
+            def run():
+                try:
+                    work()
+                except Exception:  # photonlint: disable=swallowed-exception -- fire drill
+                    pass
+
+            threading.Thread(target=run).start()
+        """
+        assert lint(src, "swallowed-exception") == []
+        assert len(suppressed(src, "swallowed-exception")) == 1
+
+
 # -- suppressions ------------------------------------------------------------
 
 SUPPRESSIBLE = """

@@ -677,6 +677,99 @@ class TestChaos:
             repl2.stop()
             log2.close()
 
+    def test_crash_between_model_write_and_activate_one_chain(
+            self, tmp_path):
+        """Satellite 3: kill the owner BETWEEN the model-dir write and
+        ``activate`` (the ``swap.activate`` fault point), restart it on
+        the fully-written dir, and assert the replica converges on ONE
+        identity chain bitwise — the crashed swap must neither fork the
+        chain nor lose the deltas published around it."""
+        from photon_ml_tpu.chaos import InjectedCrash, get_injector
+        from photon_ml_tpu.serving.coefficient_store import \
+            advance_generation_floor
+
+        inj = get_injector()
+        new_dir = None
+        owner = _Owner(tmp_path)
+        try:
+            owner.publish(3, seed=1)
+            rep = _Replica(owner.port, tmp_path / "spool")
+            try:
+                rep.converge_to(owner.publish(2, seed=2)[-1])
+
+                # the new model dir lands on disk in full; the crash hits
+                # just before the generation flip
+                new_dir = _save_model_dir(str(tmp_path / "gen-next"),
+                                          seed=5)
+                before = owner.swapper.identity
+                inj.arm("swap.activate", "crash", max_fires=1)
+                try:
+                    with pytest.raises(InjectedCrash):
+                        owner.swapper.swap(new_dir)
+                finally:
+                    inj.reset()
+                # the old generation keeps serving, no identity burned,
+                # and publishes continue on the SAME chain
+                assert owner.swapper.identity == before
+                more = owner.publish(2, seed=3)[-1]
+                rep.converge_to(more)
+                assert _scores(rep.engine) == _scores(owner.engine)
+            finally:
+                rep.close()
+        finally:
+            owner.close()
+
+        # owner restarts.  The crashed swap never ACTIVATED new_dir, so
+        # the authoritative restart base is the OLD one: come back on it,
+        # replay the retained log (learn.py restart protocol), then RETRY
+        # the swap — the dir the crash left behind is fully written, and
+        # the retry activates it under a fresh generation that every
+        # follower learns about through the snapshot broadcast
+        log2 = DeltaLog(str(tmp_path / "owner-log"), fsync="rotate")
+        durable_last = log2.last_identity()
+        assert durable_last == more  # the crash cost nothing committed
+        advance_generation_floor(durable_last[0] + 1)
+
+        from photon_ml_tpu.cli.serve import build_server
+
+        engine2, swapper2 = build_server(
+            str(tmp_path / "base"), max_batch=4, warm=False,
+            delta_log=log2, log_owner=True)
+        LogFollower(log2, lambda: engine2.store).run_once()
+        repl2 = attach_replication(swapper2, ReplicationConfig(),
+                                   registry=engine2.metrics.registry)
+        try:
+            assert swapper2.swap(new_dir) is True  # the retry completes
+            rng = np.random.default_rng(11)
+            dim = engine2.store.coordinates["user"].dim
+            final = None
+            for _ in range(3):
+                final = swapper2.publish_delta(
+                    "user", f"user{int(rng.integers(0, N_ENT))}",
+                    rng.normal(size=dim))
+                assert final is not None
+
+            rep2 = _Replica(repl2.port, tmp_path / "spool")
+            try:
+                rep2.converge_to(final)
+                # ONE identity chain, bitwise: the mirror is strictly
+                # monotone, every record is the owner's record verbatim,
+                # and it ends at the owner's tail
+                mirror = list(rep2.mirror.replay())
+                m_ids = [r.identity for r in mirror]
+                assert m_ids == sorted(m_ids)
+                assert len(set(m_ids)) == len(m_ids)
+                assert m_ids[-1] == final
+                owner_by_id = {r.identity: r for r in log2.replay()}
+                for r in mirror:
+                    assert r == owner_by_id[r.identity]  # bitwise rows
+                assert _scores(rep2.engine) == _scores(engine2)
+            finally:
+                rep2.close()
+        finally:
+            repl2.stop()
+            log2.close()
+
 
 # ---------------------------------------------------------------------------
 # serve.py --subscribe end to end
