@@ -3083,9 +3083,20 @@ def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
     record + attrs dict), plus ``instant()`` and a labeled registry ``inc``,
     and ASSERTS the disabled-path guard stays under ``budget_ns``
     (default 1µs — the acceptance budget; PHOTON_BENCH_OBS_BUDGET_NS
-    overrides).  Emits BENCH_OBS.json.  Pure host work: no jax import.
+    overrides).
+
+    photonpulse (ISSUE 15) rides the same call sites, so the same budget
+    governs it: the disabled-path span guard is re-measured UNDER A BOUND
+    TRACE CONTEXT (propagation wired in — must not add to the disabled
+    cost, asserted against the same budget), the enabled stamp tax and the
+    wire-decode cost are reported, and the cross-process merge throughput
+    (``pulse.merge.merge_traces`` events/s over a synthetic 3-process pod
+    slice) is measured for the tracemerge path.  Emits BENCH_OBS.json.
+    Pure host work: no jax import.
     """
     from photon_ml_tpu import obs
+    from photon_ml_tpu.obs.pulse import context as pulse_ctx
+    from photon_ml_tpu.obs.pulse.merge import merge_traces
     from photon_ml_tpu.obs.registry import MetricsRegistry
     from photon_ml_tpu.obs.trace import Tracer, span
 
@@ -3109,8 +3120,16 @@ def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
                 pass
 
         disabled_ns = per_call_ns(disabled_span, n_calls)
+        with pulse_ctx.bind(pulse_ctx.mint()):
+            # propagation wired in, tracing off: the bound context must
+            # cost nothing on the disabled path (same one-boolean guard)
+            disabled_bound_ns = per_call_ns(disabled_span, n_calls)
         obs.get_tracer().enable()
         enabled_ns = per_call_ns(disabled_span, min(n_calls, 50_000))
+        with pulse_ctx.bind(pulse_ctx.mint()):
+            # the stamp tax: enabled span + trace/origin attrs per record
+            enabled_bound_ns = per_call_ns(disabled_span,
+                                           min(n_calls, 50_000))
         instant_ns = per_call_ns(
             lambda: obs.instant("bench.tick", k=1), min(n_calls, 50_000))
     finally:
@@ -3118,16 +3137,46 @@ def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
     reg = MetricsRegistry()
     inc_ns = per_call_ns(lambda: reg.inc("bench_total", bucket="64"),
                          min(n_calls, 50_000))
+    wire = pulse_ctx.to_wire(pulse_ctx.mint())
+    from_wire_ns = per_call_ns(lambda: pulse_ctx.from_wire(wire),
+                               min(n_calls, 50_000))
+
+    # merge throughput: a synthetic 3-process pod slice, events spread
+    # over many trace ids like a real frontend/owner/replica export
+    n_merge_events = 30_000
+    tids = [f"{i:016x}" for i in range(256)]
+    traces = []
+    for p, label in enumerate(("frontend", "owner", "replica")):
+        evs = [{"name": "op", "ph": "X", "ts": i * 3 + p, "dur": 2,
+                "pid": 1000 + p, "tid": 1,
+                "args": {"trace": tids[i % len(tids)]}}
+               for i in range(n_merge_events // 3)]
+        clock = ({"owner": {"offset_ns": 5_000_000, "rtt_ns": 900}}
+                 if label == "replica" else {})
+        traces.append({"traceEvents": evs, "otherData":
+                       {"process_label": label, "pid": 1000 + p,
+                        "clock": clock}})
+    t0 = time.perf_counter()
+    merged = merge_traces(traces)
+    merge_s = time.perf_counter() - t0
+    assert len(merged["otherData"]["trace_ids"]) == len(tids)
+    merge_events_per_s = n_merge_events / merge_s
 
     out = {
         "metric": "obs_disabled_span_overhead", "unit": "ns",
         "value": round(disabled_ns, 1),
         "disabled_span_ns": round(disabled_ns, 1),
+        "disabled_bound_span_ns": round(disabled_bound_ns, 1),
         "enabled_span_ns": round(enabled_ns, 1),
+        "enabled_bound_span_ns": round(enabled_bound_ns, 1),
         "instant_ns": round(instant_ns, 1),
         "registry_inc_labeled_ns": round(inc_ns, 1),
+        "ctx_from_wire_ns": round(from_wire_ns, 1),
+        "merge_events_per_s": round(merge_events_per_s),
+        "merge_events": n_merge_events,
         "budget_ns": budget_ns,
-        "within_budget": disabled_ns < budget_ns,
+        "within_budget": (disabled_ns < budget_ns
+                          and disabled_bound_ns < budget_ns),
         "n_calls": n_calls,
     }
     path = out_path or os.path.join(_REPO, "BENCH_OBS.json")
@@ -3138,6 +3187,10 @@ def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
         f"disabled-tracer span guard costs {disabled_ns:.0f}ns/call — over "
         f"the {budget_ns:.0f}ns budget; the hot paths pay this on EVERY "
         "request")
+    assert disabled_bound_ns < budget_ns, (
+        f"disabled-path span guard under a bound trace context costs "
+        f"{disabled_bound_ns:.0f}ns/call — over the {budget_ns:.0f}ns "
+        "budget; photonpulse propagation broke the one-boolean discipline")
     return out
 
 

@@ -11,6 +11,7 @@
 | PL007 | mesh-axis           | collective axis names absent from the mesh   |
 | PL008 | sharding-annotation | unannotated mesh-path jits / bad spec axes   |
 | PL009 | swallowed-exception | silent broad except in daemon/async workers  |
+| PL010 | span-discipline     | trace spans discarded / escaping / unclosed  |
 
 PL001/PL003/PL004 are trace-scoped: in whole-program mode (the default) the
 ProgramIndex resolves functions jitted across module boundaries, so they
@@ -26,6 +27,7 @@ from photon_ml_tpu.analysis.rules.donation import DonationRule
 from photon_ml_tpu.analysis.rules.mesh_axis import MeshAxisRule
 from photon_ml_tpu.analysis.rules.sharding import ShardingAnnotationRule
 from photon_ml_tpu.analysis.rules.swallowed import SwallowedExceptionRule
+from photon_ml_tpu.analysis.rules.span_discipline import SpanDisciplineRule
 
 __all__ = [
     "HostSyncRule",
@@ -37,4 +39,5 @@ __all__ = [
     "MeshAxisRule",
     "ShardingAnnotationRule",
     "SwallowedExceptionRule",
+    "SpanDisciplineRule",
 ]

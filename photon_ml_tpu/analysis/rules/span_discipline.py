@@ -1,0 +1,157 @@
+"""PL010 span-discipline: trace spans that never close (or never open).
+
+Why it matters here: a photonscope span (``obs.trace.span`` /
+``Tracer.span``) is a context manager — the ring slot is claimed on
+``__enter__`` and the duration is stamped on ``__exit__``.  Used any other
+way it degrades silently: a span called and discarded records nothing at
+all, a handle that escapes its function is entered on one code path and
+leaked on another, and a manual ``__enter__`` without a paired
+``__exit__`` leaves the per-thread span stack permanently deeper — every
+LATER span in that thread then nests under a parent that never ended, which
+corrupts the merged timeline photonpulse builds across processes.  None of
+these raise; the trace just quietly lies, which is the one thing a tracing
+layer must never do.
+
+Flags, for any call whose callee is ``span`` or ``obs_span`` (module
+function or method — ``tracer.span(...)`` counts):
+
+  - **discarded** — the call is a bare expression statement: the context
+    manager is created and dropped without ever being entered, so no span
+    is recorded (``with span(...)``: was meant);
+  - **escaping handle** — the call's result is assigned to a local name
+    that is never used as a ``with`` item (and never explicitly
+    ``__enter__``-ed) in the same function: the handle is being returned
+    or stored, detaching the span's lifetime from any scope;
+  - **begin-without-end** — ``h`` holds a span and ``h.__enter__()``
+    appears in a function with no matching ``h.__exit__(...)``: the span
+    opens and the thread's span stack never pops.
+
+Exemption: none needed — ``with span(...)``, ``with span(...) as h:`` and
+balanced manual enter/exit all pass; the tracer's own implementation
+module (``obs/trace.py``) defines rather than misuses these names and
+stays clean by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule,
+                                              Violation, register)
+
+_SPAN_CALLEES = {"span", "obs_span"}
+
+
+def _callee_name(node: ast.AST) -> Optional[str]:
+    """Last path component of a call's callee (``a.b.span`` -> "span")."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _is_span_call(node: ast.AST) -> bool:
+    return _callee_name(node) in _SPAN_CALLEES
+
+
+def _dunder_target(node: ast.AST, dunder: str) -> Optional[str]:
+    """``name.__enter__()`` -> "name" (only simple-name receivers)."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == dunder
+            and isinstance(node.func.value, ast.Name)):
+        return node.func.value.id
+    return None
+
+
+def _function_nodes(tree: ast.Module) -> Iterator[ast.AST]:
+    yield tree  # module level counts as a scope too
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _lexical_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s own body, not descending into nested defs."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class SpanDisciplineRule(Rule):
+    name = "span-discipline"
+    code = "PL010"
+    severity = "error"
+    description = ("trace span context managers discarded, escaping their "
+                   "with scope, or __enter__-ed without __exit__")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        # the tracer implementation module DEFINES span(); a module that
+        # defines a function named span is the provider, not a misuser
+        defined = {n.name for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        if _SPAN_CALLEES & defined:
+            return
+        for fn in _function_nodes(tree):
+            yield from self._check_scope(ctx, fn)
+
+    def _check_scope(self, ctx: ModuleContext, fn: ast.AST,
+                     ) -> Iterator[Violation]:
+        assigned: Dict[str, ast.AST] = {}   # name -> span-call assign node
+        with_items: Set[str] = set()        # names used as `with h` items
+        entered: Dict[str, ast.AST] = {}    # name -> __enter__ call node
+        exited: Set[str] = set()            # names with an __exit__ call
+        for node in _lexical_body(fn):
+            if isinstance(node, ast.Expr):
+                if _is_span_call(node.value):
+                    yield ctx.violation(
+                        self, node,
+                        "span context manager created and discarded — no "
+                        "span is recorded; use `with span(...):`")
+                continue
+            if isinstance(node, ast.Assign) and _is_span_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        assigned[tgt.id] = node
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Name):
+                        with_items.add(expr.id)
+                continue
+            name = _dunder_target(node, "__enter__")
+            if name is not None:
+                entered.setdefault(name, node)
+            name = _dunder_target(node, "__exit__")
+            if name is not None:
+                exited.add(name)
+        for name, node in assigned.items():
+            if name in with_items or name in entered:
+                continue
+            yield ctx.violation(
+                self, node,
+                f"span handle {name!r} escapes its scope (never used as a "
+                "`with` item): the span's lifetime is detached from any "
+                "code region")
+        for name, node in entered.items():
+            if name not in assigned or name in exited:
+                continue
+            yield ctx.violation(
+                self, node,
+                f"{name}.__enter__() without a paired __exit__: the span "
+                "never closes and every later span in this thread nests "
+                "under it")

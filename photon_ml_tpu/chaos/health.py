@@ -24,6 +24,15 @@ a worker stalled when its registered thread is no longer alive or when
 one item has been in flight longer than ``stall_after_s``.  The watchdog
 is itself a pull check — readiness flips while a worker is stalled and
 recovers the moment it drains.
+
+photonpulse hooks (PR 15): an ok -> failed transition of any check or
+condition, and a worker's transition into stalled, each (a) land on the
+trace timeline as a ``chaos.degraded`` / ``chaos.stall`` instant — so the
+stall sits inline next to the spans it starved — and (b) trigger a flight
+recorder dump, spooling the ring *around* the degradation before it gets
+lapped.  Both fire on the TRANSITION only (a degraded process polled by
+``/readyz`` every second must not flood the ring), and both are a
+no-op-cost boolean/None check when tracing / the recorder are off.
 """
 
 from __future__ import annotations
@@ -32,6 +41,9 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional, Tuple
+
+from photon_ml_tpu.obs.pulse.flight import flight_dump
+from photon_ml_tpu.obs.trace import instant as obs_instant
 
 __all__ = ["HealthState", "Watchdog", "WorkerWatch",
            "delta_log_check", "follower_staleness_check"]
@@ -47,11 +59,21 @@ class HealthState:
         self._lock = threading.Lock()
         self._checks: Dict[str, Check] = {}
         self._conditions: Dict[str, Tuple[bool, str]] = {}
+        self._last_ok: Dict[str, bool] = {}  # transition edge detection
 
     def add_check(self, name: str, fn: Check) -> None:
         """Register a pull check, evaluated on every ``readyz`` call."""
         with self._lock:
             self._checks[name] = fn
+
+    def _note_transition(self, name: str, ok: bool, detail: str) -> None:
+        """Fire the degradation hooks when ``name`` flips ok -> failed."""
+        with self._lock:
+            was_ok = self._last_ok.get(name, True)
+            self._last_ok[name] = ok
+        if was_ok and not ok:
+            obs_instant("chaos.degraded", check=name, detail=detail)
+            flight_dump("health_degraded", check=name, detail=detail)
 
     def set_condition(self, name: str, ok: bool, detail: str = "") -> None:
         """Latch a push condition (overwrites the previous value)."""
@@ -60,6 +82,7 @@ class HealthState:
         if self.registry is not None:
             self.registry.set_gauge("health_check_ok", 1.0 if ok else 0.0,
                                     check=name)
+        self._note_transition(name, bool(ok), detail)
 
     def readyz(self) -> Tuple[bool, Dict[str, dict]]:
         """Evaluate everything: ``(ready, {name: {"ok", "detail"}})``."""
@@ -76,6 +99,7 @@ class HealthState:
             if self.registry is not None:
                 self.registry.set_gauge("health_check_ok",
                                         1.0 if ok else 0.0, check=name)
+            self._note_transition(name, bool(ok), detail)
         ready = all(r["ok"] for r in results.values())
         if self.registry is not None:
             self.registry.set_gauge("health_ready", 1.0 if ready else 0.0)
@@ -139,6 +163,7 @@ class Watchdog:
         self.registry = registry
         self._lock = threading.Lock()
         self._watches: Dict[str, WorkerWatch] = {}
+        self._was_stalled: Dict[str, bool] = {}  # transition edges
 
     def register(self, name: str,
                  thread: Optional[threading.Thread] = None,
@@ -160,6 +185,14 @@ class Watchdog:
                 self.registry.set_gauge("worker_stalled",
                                         1.0 if stalled else 0.0,
                                         worker=w.name)
+            with self._lock:
+                was = self._was_stalled.get(w.name, False)
+                self._was_stalled[w.name] = stalled
+            if stalled and not was:
+                # the stall appears ON the timeline, inline with the
+                # spans it starved, then the ring around it is spooled
+                obs_instant("chaos.stall", worker=w.name, detail=detail)
+                flight_dump("watchdog_stall", worker=w.name, detail=detail)
             if stalled:
                 bad.append(detail)
         if bad:

@@ -100,6 +100,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "then close).  Default: $PHOTON_AUTH_TOKEN")
     p.add_argument("--metrics-json", default="",
                    help="write the final metrics snapshot here at exit")
+    p.add_argument("--trace", action="store_true",
+                   help="enable the photonscope tracer (refit/publish "
+                        "spans; publish waves mint photonpulse trace "
+                        "contexts that ride the replication wire)")
+    p.add_argument("--trace-buffer", type=int, default=8192,
+                   help="tracer ring-buffer capacity (newest spans win)")
+    p.add_argument("--trace-out", default="",
+                   help="write the Chrome trace JSON here at exit "
+                        "(implies --trace)")
+    p.add_argument("--trace-label", default="owner",
+                   help="photonpulse process label stamped on trace "
+                        "exports and replication clock replies")
+    p.add_argument("--flight-dir", default="",
+                   help="photonpulse flight recorder spool: degradation "
+                        "transitions dump the tracer ring here")
+    p.add_argument("--flight-max-bytes", type=int, default=16 << 20,
+                   help="on-disk byte bound for the flight spool")
     return p
 
 
@@ -189,6 +206,21 @@ def run(argv: List[str]) -> int:
 
     enable_compilation_cache()
 
+    if args.trace or args.trace_out:
+        from photon_ml_tpu import obs
+
+        obs.enable_tracing(capacity=args.trace_buffer)
+        logger.info("tracing enabled (ring capacity %d)", args.trace_buffer)
+
+    from photon_ml_tpu.obs import pulse
+
+    pulse.configure(args.trace_label)
+    if args.flight_dir:
+        pulse.set_flight(pulse.FlightRecorder(
+            args.flight_dir, max_bytes=args.flight_max_bytes))
+        logger.info("flight recorder spooling to %s (cap %d bytes)",
+                    args.flight_dir, args.flight_max_bytes)
+
     from photon_ml_tpu.cli.serve import build_server
     from photon_ml_tpu.online.trainer import IncrementalTrainer, TrainerConfig
 
@@ -275,6 +307,11 @@ def run(argv: List[str]) -> int:
         if args.metrics_json:
             engine.metrics.export(args.metrics_json)
             logger.info("metrics -> %s", args.metrics_json)
+        if args.trace_out:
+            from photon_ml_tpu import obs
+
+            obs.get_tracer().export_chrome_trace(args.trace_out)
+            logger.info("trace -> %s", args.trace_out)
     return rc
 
 

@@ -40,6 +40,10 @@ Wire protocol (one JSON object per line on stdin / ``--requests`` file):
             (load in Perfetto) covering the tracer ring buffer: submit ->
             batch flush -> resolve -> AOT execute spans; needs ``--trace``
             (otherwise -> {"error": ...})
+  flight    {"cmd": "flight"} -> {"flight": {"spool_dir", "dumps",
+            "latest"}} — the flight recorder's spool index plus the most
+            recent degradation dump; needs ``--flight-dir`` (otherwise
+            -> {"error": ...})
 
 Responses are ``{"uid": ..., "score": ...}`` lines on stdout, in request
 order.  Every command drains pending requests first, so everything
@@ -241,6 +245,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-out", default="",
                    help="write the Chrome trace JSON here at exit "
                         "(implies --trace)")
+    p.add_argument("--trace-label", default="",
+                   help="photonpulse process label stamped on trace "
+                        "exports and clock replies (default: 'replica' "
+                        "with --subscribe, else 'frontend')")
+    p.add_argument("--flight-dir", default="",
+                   help="photonpulse flight recorder spool: on a "
+                        "degradation transition (health check failure, "
+                        "watchdog stall, admission shed latch) the tracer "
+                        "ring is dumped here as Chrome trace JSON; "
+                        "retrieve via {\"cmd\": \"flight\"} or "
+                        "GET /flightz on --metrics-port")
+    p.add_argument("--flight-max-bytes", type=int, default=16 << 20,
+                   help="on-disk byte bound for the flight spool "
+                        "(oldest dumps evicted first)")
+    p.add_argument("--exemplars", action="store_true",
+                   help="attach trace-id exemplars to latency histogram "
+                        "buckets in the Prometheus exposition (pairs "
+                        "with --trace: samples observed outside any "
+                        "trace context carry no exemplar)")
     return p
 
 
@@ -389,6 +412,18 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                 else:
                     out.write(json.dumps(tracer.chrome_trace()) + "\n")
                 out.flush()
+            elif cmd == "flight":
+                from photon_ml_tpu.obs.pulse import get_flight
+
+                recorder = get_flight()
+                if recorder is None:
+                    out.write(json.dumps(
+                        {"error": "flight recorder not configured; rerun "
+                                  "with --flight-dir"}) + "\n")
+                else:
+                    out.write(json.dumps(
+                        {"flight": recorder.snapshot()}) + "\n")
+                out.flush()
             elif cmd is not None:
                 out.write(json.dumps({"error": f"unknown cmd {cmd!r}"}) + "\n")
             else:
@@ -498,6 +533,20 @@ def run(argv: List[str]) -> int:
 
         obs.enable_tracing(capacity=args.trace_buffer)
         logger.info("tracing enabled (ring capacity %d)", args.trace_buffer)
+
+    from photon_ml_tpu.obs import pulse
+
+    pulse.configure(args.trace_label or
+                    ("replica" if args.subscribe else "frontend"))
+    if args.flight_dir:
+        pulse.set_flight(pulse.FlightRecorder(
+            args.flight_dir, max_bytes=args.flight_max_bytes))
+        logger.info("flight recorder spooling to %s (cap %d bytes)",
+                    args.flight_dir, args.flight_max_bytes)
+    if args.exemplars:
+        from photon_ml_tpu.obs.registry import enable_exemplars
+
+        enable_exemplars(True)
 
     buckets = None
     if args.buckets:

@@ -1,0 +1,44 @@
+"""photonpulse: the distributed half of photonscope.
+
+Photon ML reference counterpart: none — the reference is a single driver
+process; its Timed{} output needs no alignment.  The serving stack built
+in PRs 4-14 is a pod slice of cooperating processes (frontend/owner,
+replicas, the online trainer), and a request or a published delta crosses
+several of them.  photonpulse makes that crossing observable:
+
+  - ``context``  — mint/bind/carry compact trace ids across the existing
+    wire protocols; malformed wire contexts degrade to untraced;
+  - ``clock``    — NTP-style offset estimation piggybacked on handshakes
+    that already happen, exported with every Chrome trace;
+  - ``merge``    — align + join per-process traces into one
+    Perfetto-loadable pod-slice timeline (backs ``tools/tracemerge.py``);
+  - ``flight``   — degradation-triggered ring dumps to a bounded on-disk
+    spool, retrievable via ``{"cmd": "flight"}`` / ``GET /flightz``.
+
+Everything is host-side stdlib; nothing here imports jax.  All hot-path
+hooks preserve photonscope's discipline: one boolean (tracing disabled) or
+one None check (no flight recorder) when off.
+
+``configure(label)`` is the per-process entry point the CLIs call: it
+names the process for Chrome exports ("frontend", "owner", "replica") and
+installs the clock-offset export hook.
+"""
+
+from photon_ml_tpu.obs.pulse import clock  # noqa: F401
+from photon_ml_tpu.obs.pulse.context import (TraceContext,  # noqa: F401
+                                             bind, current, delta_ctx,
+                                             forwarded, from_wire, mint,
+                                             note_delta, to_wire)
+from photon_ml_tpu.obs.pulse.flight import (FlightRecorder,  # noqa: F401
+                                            flight_dump, get_flight,
+                                            set_flight)
+from photon_ml_tpu.obs.pulse.merge import (load_trace,  # noqa: F401
+                                           merge_traces, spans_by_trace)
+from photon_ml_tpu.obs.trace import (get_process_label,  # noqa: F401
+                                     set_process_label)
+
+
+def configure(label: str) -> None:
+    """Name this process and wire clock offsets into Chrome exports."""
+    set_process_label(label)
+    clock.install_export_meta()

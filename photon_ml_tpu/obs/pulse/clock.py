@@ -1,0 +1,87 @@
+"""photonpulse clock alignment: NTP-style offset estimation between peers.
+
+Each process's tracer timestamps with ``time.perf_counter_ns()`` — a
+monotonic clock with an *arbitrary per-process epoch*, so two processes'
+rings cannot be overlaid until the epoch difference is estimated.  The
+classic four-timestamp exchange does it with one round trip:
+
+    client sends t0 (its clock) -> server notes t1 on receipt,
+    replies carrying (t0, t1, t2=send time) -> client notes t3.
+
+    offset = ((t1 - t0) + (t2 - t3)) / 2        (server_clock - client_clock)
+    rtt    = (t3 - t0) - (t2 - t1)
+
+The exchange piggybacks on handshakes that already happen — the frontend
+accepts a ``{"cmd": "clock"}`` wire command, and the replication subscribe
+hello/resume exchange carries the timestamps — so no new connection or
+protocol is introduced.  Accuracy is bounded by rtt/2, which for the
+loopback/pod-slice links this serves is microseconds: far below the
+millisecond-scale spans being aligned.
+
+Estimated offsets are stored per peer label ("owner", "frontend") in a
+process-global table that ``install_export_meta()`` exposes through the
+Chrome export's ``otherData.clock`` — which is exactly where
+``tools/tracemerge.py`` reads them back to shift every event onto the
+reference process's timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from photon_ml_tpu.obs import trace as _trace
+
+_lock = threading.Lock()
+_offsets: Dict[str, dict] = {}
+
+
+def now_ns() -> int:
+    """The clock every tracer timestamp uses; exchanged on the wire."""
+    return time.perf_counter_ns()
+
+
+def estimate(t0: int, t1: int, t2: int, t3: int) -> Tuple[int, int]:
+    """``(offset_ns, rtt_ns)`` from one four-timestamp exchange.  ``offset``
+    is *peer clock minus ours*: ``t_peer ~= t_ours + offset``."""
+    offset = ((t1 - t0) + (t2 - t3)) // 2
+    rtt = (t3 - t0) - (t2 - t1)
+    return offset, rtt
+
+
+def observe_exchange(peer: str, t0: int, t1: int, t2: int,
+                     t3: Optional[int] = None) -> Tuple[int, int]:
+    """Record the result of one exchange with ``peer``.  Keeps the
+    lowest-rtt estimate seen (least queueing noise), like NTP's filter."""
+    if t3 is None:
+        t3 = now_ns()
+    offset, rtt = estimate(t0, t1, t2, t3)
+    with _lock:
+        prev = _offsets.get(peer)
+        if prev is None or rtt < prev["rtt_ns"]:
+            _offsets[peer] = {"offset_ns": offset, "rtt_ns": rtt}
+    return offset, rtt
+
+
+def set_offset(peer: str, offset_ns: int, rtt_ns: int = 0) -> None:
+    with _lock:
+        _offsets[peer] = {"offset_ns": int(offset_ns), "rtt_ns": int(rtt_ns)}
+
+
+def offsets() -> Dict[str, dict]:
+    """Copy of the per-peer offset table (label -> offset_ns/rtt_ns)."""
+    with _lock:
+        return {k: dict(v) for k, v in _offsets.items()}
+
+
+def reset() -> None:
+    """Tests: forget every estimated offset."""
+    with _lock:
+        _offsets.clear()
+
+
+def install_export_meta() -> None:
+    """Expose the offset table in every Chrome export's ``otherData`` so
+    tracemerge can align this process against its peers."""
+    _trace.set_export_meta_provider(lambda: {"clock": offsets()})

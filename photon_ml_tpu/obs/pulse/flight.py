@@ -1,0 +1,172 @@
+"""photonpulse flight recorder: dump the trace ring when health degrades.
+
+The photonscope ring is a bounded always-on window over the last N spans —
+exactly the evidence an operator needs after a chaos-plane incident, except
+that by the time anyone runs ``{"cmd": "trace"}`` the interesting spans
+have been lapped.  The flight recorder closes that gap: degradation
+triggers (a ``HealthState`` condition transitioning out of ok, a
+``Watchdog`` stall, the admission shed-latch engaging) synchronously
+snapshot the ring to a bounded on-disk spool, so the spans *surrounding*
+the degradation survive for post-hoc merge and inspection.
+
+Bounds, because an unattended flapping trigger must not fill a disk:
+
+  - ``min_interval_s`` rate-limits dumps globally (a degrading process
+    tends to fire many triggers at once — one dump covers them all);
+  - ``max_bytes`` caps the spool — oldest dumps are deleted first;
+  - each dump is one self-contained JSON file: reason, trigger detail,
+    wall-clock time, and the full Chrome export (which carries the
+    process label and clock offsets, so spooled dumps merge like live
+    exports).
+
+Retrieval: ``{"cmd": "flight"}`` on the frontend/stdio wire and
+``GET /flightz`` on the metrics endpoint both return ``snapshot()`` — the
+dump index plus the latest dump inline.
+
+The module-level ``flight_dump()`` is the trigger entry point: one None
+check when no recorder is installed, mirroring the chaos injector's
+disabled-cost discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import List, Optional
+
+from photon_ml_tpu.obs import trace as _trace
+
+_REASON_RE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+class FlightRecorder:
+    """Bounded on-disk spool of trace-ring snapshots (see module doc)."""
+
+    def __init__(self, spool_dir: str, max_bytes: int = 16 << 20,
+                 min_interval_s: float = 0.5):
+        self.spool_dir = spool_dir
+        self.max_bytes = int(max_bytes)
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_dump = 0.0
+        os.makedirs(spool_dir, exist_ok=True)
+
+    # -- dumping -----------------------------------------------------------
+    def dump(self, reason: str, **detail) -> Optional[str]:
+        """Snapshot the ring now; returns the dump path, or None when
+        rate-limited.  Never raises — a sick disk must not take the
+        triggering health path down with it."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_dump < self.min_interval_s:
+                return None
+            self._last_dump = now
+            self._seq += 1
+            seq = self._seq
+        slug = _REASON_RE.sub("-", reason)[:48] or "unknown"
+        name = f"flight-{int(time.time() * 1000):013d}-{seq:04d}-{slug}.json"
+        path = os.path.join(self.spool_dir, name)
+        payload = {
+            "reason": reason,
+            "detail": detail,
+            "at_unix": time.time(),
+            "trace": _trace.get_tracer().chrome_trace(),
+        }
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        self._enforce_bound()
+        return path
+
+    def _enforce_bound(self) -> None:
+        dumps = self._dump_files()
+        total = sum(sz for _, sz in dumps)
+        # oldest first (lexicographic name order embeds the ms timestamp)
+        for name, sz in dumps:
+            if total <= self.max_bytes:
+                break
+            try:
+                os.remove(os.path.join(self.spool_dir, name))
+                total -= sz
+            except OSError:
+                pass
+
+    def _dump_files(self) -> List[tuple]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.spool_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("flight-") and name.endswith(".json")):
+                continue
+            try:
+                out.append((name,
+                            os.path.getsize(os.path.join(self.spool_dir,
+                                                         name))))
+            except OSError:
+                continue
+        return out
+
+    # -- retrieval ---------------------------------------------------------
+    def index(self) -> List[dict]:
+        """One entry per spooled dump, oldest first: name/reason/bytes."""
+        out = []
+        for name, sz in self._dump_files():
+            parts = name[len("flight-"):-len(".json")].split("-", 2)
+            out.append({"name": name, "bytes": sz,
+                        "reason": parts[2] if len(parts) == 3 else ""})
+        return out
+
+    def latest(self) -> Optional[dict]:
+        """The newest dump, parsed; None when the spool is empty or the
+        newest file is unreadable/torn."""
+        dumps = self._dump_files()
+        if not dumps:
+            return None
+        path = os.path.join(self.spool_dir, dumps[-1][0])
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def snapshot(self) -> dict:
+        """Wire form for ``{"cmd": "flight"}`` / ``GET /flightz``."""
+        return {"spool_dir": self.spool_dir, "dumps": self.index(),
+                "latest": self.latest()}
+
+
+# ---------------------------------------------------------------------------
+# process-default recorder: the trigger entry point
+# ---------------------------------------------------------------------------
+_recorder: Optional[FlightRecorder] = None
+
+
+def get_flight() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def set_flight(recorder: Optional[FlightRecorder]
+               ) -> Optional[FlightRecorder]:
+    """Install (or clear) the process-default recorder; returns previous."""
+    global _recorder
+    prev, _recorder = _recorder, recorder
+    return prev
+
+
+def flight_dump(reason: str, **detail) -> Optional[str]:
+    """Trigger a dump if a recorder is installed: one None check when the
+    flight recorder is not configured."""
+    r = _recorder
+    if r is None:
+        return None
+    return r.dump(reason, **detail)

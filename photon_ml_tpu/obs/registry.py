@@ -25,6 +25,8 @@ import json
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from photon_ml_tpu.obs import trace as _trace
+
 LabelKey = Tuple[Tuple[str, str], ...]
 Series = Tuple[str, LabelKey]
 
@@ -56,6 +58,24 @@ def family_bounds(name: str) -> Tuple[float, ...]:
     return _family_bounds.get(name, _BOUNDS)
 
 
+# Histogram exemplars (photonpulse): when enabled, each latency bucket
+# remembers the trace id of the most recent sample that landed in it, so a
+# scraper's "what made p99 spike?" resolves to a concrete merged-timeline
+# trace.  OFF by default — the flag gates both the per-observe work and the
+# exposition suffix, keeping the existing Prometheus output byte-stable.
+_exemplars_enabled = False
+
+
+def enable_exemplars(on: bool = True) -> None:
+    """Process-wide switch for per-bucket trace-id exemplars."""
+    global _exemplars_enabled
+    _exemplars_enabled = bool(on)
+
+
+def exemplars_enabled() -> bool:
+    return _exemplars_enabled
+
+
 class LatencyHistogram:
     """Fixed-bin latency histogram with percentile estimates.
 
@@ -72,8 +92,14 @@ class LatencyHistogram:
         self.total = 0.0
         self.min = float("inf")
         self.max = 0.0
+        # per-bucket (trace_id, seconds) of the latest exemplar-eligible
+        # sample; allocated lazily on the first one so histograms stay
+        # allocation-free with exemplars off (the common case)
+        self.exemplars: Optional[List[Optional[Tuple[str, float]]]] = None
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float) -> int:
+        """Record one sample; returns the bin index it landed in (the
+        exemplar hook keys on it)."""
         bounds = self.bounds
         lo, hi = 0, len(bounds)
         while lo < hi:  # first bin whose bound >= seconds
@@ -87,6 +113,13 @@ class LatencyHistogram:
         self.total += seconds
         self.min = min(self.min, seconds)
         self.max = max(self.max, seconds)
+        return lo
+
+    def note_exemplar(self, bin_index: int, trace_id: str,
+                      seconds: float) -> None:
+        if self.exemplars is None:
+            self.exemplars = [None] * len(self.counts)
+        self.exemplars[bin_index] = (trace_id, seconds)
 
     def percentile(self, p: float) -> float:
         if self.count == 0:
@@ -189,7 +222,11 @@ class MetricsRegistry:
             if h is None:
                 h = self._histograms[key] = LatencyHistogram(
                     _family_bounds.get(name))
-            h.record(seconds)
+            bin_index = h.record(seconds)
+            if _exemplars_enabled:
+                ctx = _trace.current_context()
+                if ctx is not None:
+                    h.note_exemplar(bin_index, ctx[0], seconds)
 
     # -- reads -------------------------------------------------------------
     def counter(self, name: str, **labels) -> float:
@@ -259,9 +296,11 @@ class MetricsRegistry:
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
-            hists = sorted(((k, h.bounds, list(h.counts), h.total, h.count)
+            hists = sorted(((k, h.bounds, list(h.counts), h.total, h.count,
+                             list(h.exemplars) if h.exemplars else None)
                             for k, h in self._histograms.items()),
                            key=lambda e: e[0])
+        want_exemplars = _exemplars_enabled
         lines: List[str] = []
 
         def _family(items: Iterable, kind: str) -> None:
@@ -276,17 +315,23 @@ class MetricsRegistry:
         _family(counters, "counter")
         _family(gauges, "gauge")
         seen = None
-        for (name, labels), bounds, counts, total, count in hists:
+        for (name, labels), bounds, counts, total, count, exemplars in hists:
             pname = _prom_name(name)
             if pname != seen:
                 lines.append(f"# TYPE {pname} histogram")
                 seen = pname
             cum = 0
-            for bound, c in zip(bounds, counts):
+            for i, (bound, c) in enumerate(zip(bounds, counts)):
                 cum += c
-                lines.append(f"{pname}_bucket"
-                             f"{_prom_labels(labels, (('le', repr(bound)),))}"
-                             f" {cum}")
+                line = (f"{pname}_bucket"
+                        f"{_prom_labels(labels, (('le', repr(bound)),))}"
+                        f" {cum}")
+                if want_exemplars and exemplars and exemplars[i]:
+                    # OpenMetrics exemplar suffix: the trace id of the
+                    # latest sample that landed in this bucket
+                    tid, secs = exemplars[i]
+                    line += f' # {{trace_id="{tid}"}} {repr(float(secs))}'
+                lines.append(line)
             lines.append(f"{pname}_bucket"
                          f"{_prom_labels(labels, (('le', '+Inf'),))} {count}")
             lines.append(f"{pname}_sum{_prom_labels(labels)} {_fmt(total)}")

@@ -55,6 +55,54 @@ _ATTRS = 8
 _WIDTH = 9
 
 
+# -- cross-process trace context (photonpulse) ------------------------------
+# One thread-local cell shared by every Tracer instance: the binding is a
+# property of the THREAD doing the work (this request, this publish), not of
+# whichever ring it records into, so tracer swaps in tests never strand a
+# binding.  The cell holds an opaque ``(trace_id, origin_span)`` pair minted
+# by ``obs.pulse`` — trace.py only copies it into record attrs, keeping this
+# module free of any pulse import.  Cost: one getattr on the ENABLED record
+# path; the disabled ``span()`` guard is untouched.
+_ctx_local = threading.local()
+
+
+def current_context():
+    """The thread's bound ``(trace_id, origin_span)`` pair, or None."""
+    return getattr(_ctx_local, "ctx", None)
+
+
+def set_context(ctx) -> object:
+    """Bind ``ctx`` (or None to unbind) on this thread; returns the previous
+    binding so callers can restore it (``obs.pulse.bind`` does)."""
+    prev = getattr(_ctx_local, "ctx", None)
+    _ctx_local.ctx = ctx
+    return prev
+
+
+# Export metadata: a stable human label for this process ("frontend",
+# "owner", "replica") plus a provider hook pulse uses to attach its clock
+# offsets without trace.py importing pulse.
+_process_label: Optional[str] = None
+_export_meta_provider: Optional[Callable[[], dict]] = None
+
+
+def set_process_label(label: Optional[str]) -> None:
+    """Name this process in Chrome exports (``process_name`` metadata)."""
+    global _process_label
+    _process_label = label
+
+
+def get_process_label() -> Optional[str]:
+    return _process_label
+
+
+def set_export_meta_provider(provider: Optional[Callable[[], dict]]) -> None:
+    """Extra ``otherData`` fields for ``chrome_trace()`` (pulse installs its
+    clock-offset table here)."""
+    global _export_meta_provider
+    _export_meta_provider = provider
+
+
 def _default_device_fence() -> None:
     """Enqueue a trivial device op and block on it: on an in-order
     accelerator stream this drains previously enqueued work, giving span
@@ -142,12 +190,18 @@ class Tracer:
         self._ids = itertools.count(1)
         self._local = threading.local()
         self._fence: Callable[[], None] = _default_device_fence
+        # tid -> thread name, filled the first time a thread records; export
+        # emits these as Chrome "thread_name" metadata so merged timelines
+        # show "batcher-worker" instead of a bare ident
+        self._thread_names: Dict[int, str] = {}
 
     # -- per-thread span stack ---------------------------------------------
     def _stack(self) -> List[int]:
         s = getattr(self._local, "stack", None)
         if s is None:
             s = self._local.stack = []
+            self._thread_names[threading.get_ident()] = \
+                threading.current_thread().name
         return s
 
     # -- recording ---------------------------------------------------------
@@ -168,11 +222,32 @@ class Tracer:
         self._record("i", name, time.perf_counter_ns(), 0, next(self._ids),
                      parent, attrs or None)
 
+    def complete(self, name: str, start_ns: int, dur_ns: int,
+                 **attrs) -> None:
+        """Record a complete span with explicit timing — for work whose
+        start and end live in different callbacks (a frontend request
+        admitted on one event-loop tick and settled on another), where a
+        ``with`` block cannot bracket it."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        parent = stack[-1] if stack else 0
+        self._record("X", name, start_ns, dur_ns, next(self._ids), parent,
+                     attrs or None)
+
     def _record(self, phase: str, name: str, ts: int, dur: int,
                 span_id: int, parent: int,
                 attrs: Optional[Dict[str, Any]]) -> None:
         if not self.enabled:
             return
+        ctx = getattr(_ctx_local, "ctx", None)
+        if ctx is not None:
+            # propagation: stamp the bound trace id (and the origin span on
+            # the far side of a wire hop) into this record's attrs
+            attrs = dict(attrs) if attrs else {}
+            attrs["trace"] = ctx[0]
+            if ctx[1]:
+                attrs["origin"] = ctx[1]
         with self._cursor_lock:  # held ONLY to claim the slot
             seq = self._cursor
             self._cursor = seq + 1
@@ -237,9 +312,21 @@ class Tracer:
 
         Complete spans use phase "X" with microsecond ``ts``/``dur``;
         instants use phase "i" with thread scope.  Span/parent ids ride in
-        ``args`` so nesting survives tools that re-sort events."""
+        ``args`` so nesting survives tools that re-sort events.
+
+        The export carries the identity ``tools/tracemerge.py`` needs:
+        "M"-phase ``process_name``/``thread_name`` metadata events (the
+        label set via ``set_process_label``; thread names captured at first
+        record) and an ``otherData`` block with the label, pid, and
+        whatever the export-meta provider adds (pulse's clock-offset
+        table)."""
         pid = os.getpid()
-        events = []
+        label = _process_label or f"py-{pid}"
+        events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                   "ts": 0, "args": {"name": label}}]
+        for tid, tname in sorted(self._thread_names.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "ts": 0, "args": {"name": tname}})
         for r in sorted(self.records(), key=lambda r: (r["ts_ns"], r["id"])):
             ev = {
                 "name": r["name"], "ph": r["ph"], "pid": pid,
@@ -252,7 +339,14 @@ class Tracer:
             else:
                 ev["s"] = "t"  # thread-scoped instant
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ns"}
+        other = {"process_label": label, "pid": pid}
+        if _export_meta_provider is not None:
+            try:
+                other.update(_export_meta_provider())
+            except Exception:
+                pass  # export must never fail because a meta hook did
+        return {"traceEvents": events, "displayTimeUnit": "ns",
+                "otherData": other}
 
     def export_chrome_trace(self, path: str) -> None:
         with open(path, "w") as f:

@@ -34,7 +34,11 @@ from typing import (TYPE_CHECKING, Callable, Iterable, Optional,
 
 import numpy as np
 
+from photon_ml_tpu.obs.pulse.context import bind as ctx_bind
+from photon_ml_tpu.obs.pulse.context import delta_ctx as pulse_delta_ctx
 from photon_ml_tpu.obs.registry import MetricsRegistry
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
+from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
 
@@ -88,6 +92,15 @@ def replay_into_store(store: "CoefficientStore",
             ok = False
         if ok:
             stats.applied += 1
+            if obs_enabled():
+                # the end of the publish's causal chain: the update the
+                # owner traced is now visible in THIS process's store
+                ctx = pulse_delta_ctx(r.identity)
+                if ctx is not None:
+                    with ctx_bind(ctx):
+                        obs_instant("online.store_visible",
+                                    generation=r.generation,
+                                    version=r.delta_version)
         else:
             stats.rejected += 1
         stats.position = r.identity

@@ -44,6 +44,11 @@ import time
 from typing import Callable, Optional, Tuple
 
 from photon_ml_tpu.chaos.injector import fault as _chaos_fault
+from photon_ml_tpu.obs.pulse import clock as pulse_clock
+from photon_ml_tpu.obs.pulse.context import bind as ctx_bind
+from photon_ml_tpu.obs.pulse.context import from_wire as ctx_from_wire
+from photon_ml_tpu.obs.pulse.context import note_delta as ctx_note_delta
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.online.delta_log import DeltaLog
 from photon_ml_tpu.online.replication.snapshot import (SnapshotError,
@@ -211,13 +216,17 @@ class ReplicationClient:
             br = BoundedLineReader(reader.read, cfg.max_line_bytes)
             hello = {"cmd": "subscribe",
                      "last": list(self._last) if self._last else None,
-                     "floor": self.floor}
+                     "floor": self.floor,
+                     # photonpulse clock ping-pong: the server echoes t0
+                     # and adds its own t1/t2 stamps to the resume reply
+                     "t0": pulse_clock.now_ns()}
             if cfg.auth_token is not None:
                 hello["token"] = cfg.auth_token
             writer.write(encode(hello))
             await writer.drain()
             line = await asyncio.wait_for(br.readline(),
                                           cfg.connect_timeout_s)
+            t3 = pulse_clock.now_ns()
             if line is None:
                 raise ConnectionError("server closed during subscribe")
             obj = parse_line(line)
@@ -230,6 +239,7 @@ class ReplicationClient:
             if self._registry is not None:
                 self._registry.inc("repl_client_resume_total", mode=mode)
             obs_instant("repl.client.resume", mode=mode)
+            self._note_clock(obj, t3)
             if mode == "snapshot" and self._last is not None:
                 # our spool lineage is dead (owner swapped past us or we
                 # diverged): the incoming stream restarts identity-fresh
@@ -240,6 +250,20 @@ class ReplicationClient:
                 writer.close()
             except Exception:  # noqa: BLE001 — best-effort close
                 pass
+
+    def _note_clock(self, resume: dict, t3: int) -> None:
+        """Fold the resume reply's clock stamps into the offset table.
+        Tolerant: any missing or non-integer field means the owner did not
+        (or could not) answer the ping-pong — skip, never fail the
+        subscribe over telemetry."""
+        t0, t1, t2 = (resume.get("t0"), resume.get("t1"), resume.get("t2"))
+        who = resume.get("who")
+        if not (isinstance(who, str) and who and
+                all(isinstance(t, int) for t in (t0, t1, t2))):
+            return
+        offset, rtt = pulse_clock.observe_exchange(who, t0, t1, t2, t3)
+        obs_instant("repl.client.clock", peer=who,
+                    offset_ns=offset, rtt_ns=rtt)
 
     async def _stream(self, f: BoundedLineReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -281,6 +305,16 @@ class ReplicationClient:
             kind = obj.get("repl")
             if kind == "delta":
                 rec = decode_record_obj(obj)
+                if obs_enabled():
+                    # tolerant: a torn or garbage "tp" degrades to an
+                    # untraced record, never to a failed one
+                    ctx = ctx_from_wire(obj.get("tp"))
+                    if ctx is not None:
+                        ctx_note_delta(rec.identity, ctx)
+                        with ctx_bind(ctx):
+                            obs_instant("repl.client.recv",
+                                        generation=rec.generation,
+                                        version=rec.delta_version)
                 if self._last is None or rec.identity > self._last:
                     self._mirror.append(rec)
                     self._last = rec.identity

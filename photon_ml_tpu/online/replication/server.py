@@ -57,6 +57,12 @@ import time
 from typing import Callable, Deque, Dict, Optional, Tuple
 
 from photon_ml_tpu.chaos.injector import fault as _chaos_fault
+from photon_ml_tpu.obs.pulse import clock as pulse_clock
+from photon_ml_tpu.obs.pulse.context import delta_ctx as pulse_delta_ctx
+from photon_ml_tpu.obs.pulse.context import forwarded as ctx_forwarded
+from photon_ml_tpu.obs.pulse.context import to_wire as ctx_to_wire
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
+from photon_ml_tpu.obs.trace import get_process_label
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
 from photon_ml_tpu.online.replication.snapshot import (SnapshotError,
@@ -395,6 +401,7 @@ class ReplicationServer:
         if hello is None:
             writer.close()
             return False, None
+        t1 = pulse_clock.now_ns()  # hello receipt — clock ping-pong leg
         try:
             obj = parse_line(hello)
             last = parse_identity(obj.get("last"))
@@ -436,9 +443,18 @@ class ReplicationServer:
         obs_instant("repl.subscribe", peer=peer, mode=mode)
         logger.info("photonrepl: subscriber %s resume mode=%s last=%s "
                     "floor=%s", peer, mode, last, floor)
-        writer.write(encode({"repl": "resume", "mode": mode,
-                             "generation": self._base_generation,
-                             "floor": self._base_generation}))
+        resume = {"repl": "resume", "mode": mode,
+                  "generation": self._base_generation,
+                  "floor": self._base_generation}
+        t0 = obj.get("t0")
+        if isinstance(t0, int):
+            # complete the photonpulse clock ping-pong piggybacked on the
+            # subscribe hello: echo t0, stamp receipt (t1) and send (t2)
+            resume["who"] = get_process_label() or "owner"
+            resume["t0"] = t0
+            resume["t1"] = t1
+            resume["t2"] = pulse_clock.now_ns()
+        writer.write(encode(resume))
         await writer.drain()
         return True, f
 
@@ -541,7 +557,14 @@ class ReplicationServer:
                 return
             else:
                 raise act.to_error()
-        line = encode_record_line(rec)
+        tp = None
+        if obs_enabled():
+            # the trace context rides BESIDE the payload ("tp" field), so
+            # the record bytes stay bit-identical to the owner's frame
+            ctx = pulse_delta_ctx(rec.identity)
+            if ctx is not None:
+                tp = ctx_to_wire(ctx_forwarded(ctx))
+        line = encode_record_line(rec, tp=tp)
         f.writer.write(line)
         await f.writer.drain()
         f.sent = rec.identity

@@ -19,7 +19,12 @@ Lines, server -> client::
 
     {"error": "..."}                               # one frame, then close
     {"repl": "resume", "mode": "log" | "snapshot",
-     "generation": G, "floor": F}                  # first reply
+     "generation": G, "floor": F,
+     "who": "...", "t0": ..., "t1": ..., "t2": ...}  # first reply; the
+                                                   # who/t0/t1/t2 fields
+                                                   # close the photonpulse
+                                                   # clock ping-pong when
+                                                   # the hello carried t0
     {"repl": "snapshot", "bytes": N, "crc32": C,
      "generation": G, "version": "..."}            # then N raw tar bytes
     {"repl": "delta", "crc": C, "p": "<payload>"}  # one log record
@@ -47,15 +52,24 @@ class WireError(ValueError):
     """A peer sent a frame that violates the schema or its checksum."""
 
 
-def encode_record_line(record: DeltaRecord) -> bytes:
+def encode_record_line(record: DeltaRecord,
+                       tp: Optional[str] = None) -> bytes:
     """One ``{"repl": "delta"}`` line.  The payload text and CRC are lifted
     from ``DeltaRecord.encode()`` so they are bit-identical to the owner's
-    on-disk frame — no second serialization that could round differently."""
+    on-disk frame — no second serialization that could round differently.
+
+    ``tp``: optional photonpulse trace context (``obs.pulse.to_wire``
+    form).  It rides BESIDE the payload, never inside it — the payload/CRC
+    bit-parity with the on-disk frame is the replication invariant and
+    tracing must not perturb it.  Receivers treat a missing or malformed
+    ``tp`` as untraced."""
     frame = record.encode()
     _, crc = _LEN_CRC.unpack_from(frame)
     payload = frame[_LEN_CRC.size:].decode("utf-8")
-    return (json.dumps({"repl": "delta", "crc": crc, "p": payload},
-                       separators=(",", ":")) + "\n").encode("utf-8")
+    obj = {"repl": "delta", "crc": crc, "p": payload}
+    if tp is not None:
+        obj["tp"] = tp
+    return (json.dumps(obj, separators=(",", ":")) + "\n").encode("utf-8")
 
 
 def decode_record_obj(obj: dict) -> DeltaRecord:

@@ -38,6 +38,7 @@ cache is not locked).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import time
@@ -47,6 +48,9 @@ import jax
 import numpy as np
 
 from photon_ml_tpu.core.losses import PointwiseLoss, loss_for_task
+from photon_ml_tpu.obs.pulse.context import bind as ctx_bind
+from photon_ml_tpu.obs.pulse.context import mint as ctx_mint
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.opt.newton_soa import soa_eligible, solve_newton_soa
 from photon_ml_tpu.opt.types import SolverConfig
@@ -329,8 +333,14 @@ class IncrementalTrainer:
         t_pub = time.perf_counter()
         if not report.publish_started:
             report.publish_started = t_pub
-        with obs_span("online.publish", coordinate=c.cid,
-                      entities=n_lanes):
+        # one trace context per publish wave: it is minted HERE (the pod
+        # slice's write admission point), stamped on the owner's publish
+        # span, carried on the replication wire, and closed out by each
+        # replica's online.store_visible instant
+        bound = (ctx_bind(ctx_mint()) if obs_enabled()
+                 else contextlib.nullcontext())
+        with bound, obs_span("online.publish", coordinate=c.cid,
+                             entities=n_lanes):
             for j, eid in enumerate(lanes):
                 t_row = time.perf_counter()
                 ident = self.swapper.publish_delta(c.cid, names[eid],

@@ -28,6 +28,9 @@ from typing import (Callable, Dict, List, Optional, Sequence, Tuple)
 import numpy as np
 
 from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.obs.pulse.context import bind as ctx_bind
+from photon_ml_tpu.obs.pulse.context import from_wire as ctx_from_wire
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.obs.trace import span as obs_span
 
@@ -61,13 +64,17 @@ class Request:
     shard (exactly like a TrainingExampleAvro record — each shard's index
     map picks out the columns it knows).  ``ids``: id-tag -> entity string
     (reference GameDatum idTagToValueMap).  ``offset``: added to the raw
-    margin, never part of the model score.
+    margin, never part of the model score.  ``ctx``: optional photonpulse
+    trace context — minted at the frontend edge or adopted from the wire
+    ``"tp"`` field — carried with the request into the batcher so the
+    flush that scores it joins the same cross-process trace.
     """
 
     uid: object = None
     features: Sequence[dict] = ()
     ids: Dict[str, str] = dataclasses.field(default_factory=dict)
     offset: float = 0.0
+    ctx: Optional[Tuple[str, str]] = None
 
 
 def request_from_json(obj: dict) -> Request:
@@ -85,8 +92,14 @@ def request_from_json(obj: dict) -> Request:
         else:
             raise ValueError(f"unparseable feature entry {f!r}")
     ids = {str(k): str(v) for k, v in (obj.get("ids") or {}).items()}
+    # optional trace context: a malformed/torn "tp" decodes to None (the
+    # request proceeds untraced); skipped entirely when tracing is off
+    ctx = None
+    tp = obj.get("tp")
+    if tp is not None and obs_enabled():
+        ctx = ctx_from_wire(tp)
     return Request(uid=obj.get("uid"), features=feats, ids=ids,
-                   offset=float(obj.get("offset") or 0.0))
+                   offset=float(obj.get("offset") or 0.0), ctx=ctx)
 
 
 def densify_features(requests: Sequence[Request], index_maps: Dict[str, IndexMap],
@@ -248,7 +261,11 @@ class AsyncBatcher:
     # -- producer side -----------------------------------------------------
     def submit(self, request: Request) -> "Future[float]":
         """Enqueue one request; returns the future its score resolves on."""
-        obs_instant("serve.submit", uid=request.uid)
+        if request.ctx is not None:
+            with ctx_bind(request.ctx):
+                obs_instant("serve.submit", uid=request.uid)
+        else:
+            obs_instant("serve.submit", uid=request.uid)
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -374,14 +391,26 @@ class AsyncBatcher:
         live = [(r, f) for r, f in batch if f.set_running_or_notify_cancel()]
         if not live:
             return
-        with obs_span("serve.flush", n=len(live),
-                      reason=("full" if full else
-                              "forced" if forced else "deadline")):
+        attrs = {"n": len(live), "reason": ("full" if full else
+                                            "forced" if forced else
+                                            "deadline")}
+        if obs_enabled():
+            # one flush serves many requests: record EVERY trace id it
+            # scores so tracemerge can attach the span to each trace
+            tids = sorted({r.ctx[0] for r, _ in live if r.ctx is not None})
+            if tids:
+                attrs["traces"] = tids
+        # waiters wake only after the span closes, so a request span that
+        # awaits its score strictly encloses serve.flush in the timeline
+        err: Optional[Exception] = None
+        with obs_span("serve.flush", **attrs):
             try:
                 scores = self._score([r for r, _ in live])
             except Exception as e:  # resolve waiters, never kill the worker
-                for _, f in live:
-                    f.set_exception(e)
-                return
-            for (_, f), s in zip(live, scores):
-                f.set_result(float(s))
+                err = e
+        if err is not None:
+            for _, f in live:
+                f.set_exception(err)
+            return
+        for (_, f), s in zip(live, scores):
+            f.set_result(float(s))

@@ -44,6 +44,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional
 
+from photon_ml_tpu.obs.pulse.flight import flight_dump
 from photon_ml_tpu.obs.registry import MetricsRegistry
 
 # requests_shed_total{reason=...} reasons
@@ -121,6 +122,11 @@ class AdmissionController:
             self._shedding = value
             if self._registry is not None:
                 self._registry.set_gauge("front_shedding", int(value))
+            if value:
+                # latch ENGAGED: the spans leading into overload are in
+                # the ring right now — spool them before they get lapped
+                # (one None check when no flight recorder is configured)
+                flight_dump("admission_shed")
 
     def _set_client_shedding(self, client: str, value: bool) -> None:
         if value:

@@ -17,7 +17,11 @@ liveness); ``GET /readyz`` -> 200 when every registered readiness check
 passes, 503 with the failing checks as JSON while degraded (orchestrator
 traffic gate — see ``chaos/health.py``).  A sidecar built without a
 ``HealthState`` answers ``/readyz`` 200 vacuously, so a bare metrics
-scraper deployment keeps working unchanged.  Anything else is 404.
+scraper deployment keeps working unchanged.  ``GET /flightz`` -> the
+photonpulse flight recorder's spool index plus the latest degradation
+dump (404 when no ``--flight-dir`` recorder is installed) — the same
+payload the ``{"cmd": "flight"}`` wire command returns, reachable even
+when the serving socket itself is what degraded.  Anything else is 404.
 Connections are one-shot (``Connection: close``) — scrape traffic, not an
 API.
 """
@@ -103,10 +107,22 @@ class MetricsEndpoint:
                 body = (json.dumps({"ready": ready, "checks": checks},
                                    sort_keys=True) + "\n").encode("utf-8")
                 ctype = b"application/json"
+            elif path == "/flightz":
+                from photon_ml_tpu.obs.pulse import get_flight
+
+                recorder = get_flight()
+                if recorder is None:
+                    writer.write(_response(
+                        404, b"flight recorder not configured; rerun "
+                             b"with --flight-dir\n", b"text/plain"))
+                    return
+                body = (json.dumps(recorder.snapshot(), sort_keys=True)
+                        + "\n").encode("utf-8")
+                ctype = b"application/json"
             else:
                 writer.write(_response(
-                    404, b"try /metrics, /metrics.json, /healthz or "
-                         b"/readyz\n", b"text/plain"))
+                    404, b"try /metrics, /metrics.json, /healthz, "
+                         b"/readyz or /flightz\n", b"text/plain"))
                 return
             writer.write(_response(status,
                                    b"" if method == "HEAD" else body,

@@ -80,9 +80,16 @@ import hmac
 import json
 import logging
 import threading
+import time
 from typing import Dict, Optional
 
 from photon_ml_tpu.chaos.injector import fault as _chaos_fault
+from photon_ml_tpu.obs.pulse import clock as pulse_clock
+from photon_ml_tpu.obs.pulse.context import bind as ctx_bind
+from photon_ml_tpu.obs.pulse.context import mint as ctx_mint
+from photon_ml_tpu.obs.pulse.flight import get_flight
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
+from photon_ml_tpu.obs.trace import get_process_label, get_tracer
 from photon_ml_tpu.obs.trace import instant as obs_instant
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.batcher import request_from_json
@@ -149,15 +156,19 @@ class _Conn:
 
 
 class _Pending:
-    """One admitted score request: reply future + settle-once accounting."""
+    """One admitted score request: reply future + settle-once accounting.
+    ``t0_ns`` is the admission timestamp when tracing is on (None when
+    off): settle records the enclosing ``front.request`` span from it."""
 
-    __slots__ = ("conn", "req", "reply", "settled")
+    __slots__ = ("conn", "req", "reply", "settled", "t0_ns")
 
-    def __init__(self, conn: _Conn, req, reply: asyncio.Future):
+    def __init__(self, conn: _Conn, req, reply: asyncio.Future,
+                 t0_ns: Optional[int] = None):
         self.conn = conn
         self.req = req
         self.reply = reply
         self.settled = False
+        self.t0_ns = t0_ns
 
 
 class FrontendServer:
@@ -429,11 +440,20 @@ class FrontendServer:
             self._shed(conn, req, verdict.reason, verdict.predicted_wait_s,
                        verdict.retry_after_ms)
             return
-        obs_instant("front.admit", uid=req.uid, client=conn.cid,
-                    predicted_wait_us=int(estimate * 1e6))
+        t0_ns = None
+        if obs_enabled():
+            # the propagation edge: adopt the context the request carried
+            # on the wire ("tp", already parsed — garbage degraded to
+            # None) or mint a fresh one here at admission
+            if req.ctx is None:
+                req.ctx = ctx_mint()
+            t0_ns = time.perf_counter_ns()
+            with ctx_bind(req.ctx):
+                obs_instant("front.admit", uid=req.uid, client=conn.cid,
+                            predicted_wait_us=int(estimate * 1e6))
         self._inflight += 1
         self._idle.clear()
-        pending = _Pending(conn, req, self._reply_future(conn))
+        pending = _Pending(conn, req, self._reply_future(conn), t0_ns)
         self._queue.enqueue(conn.cid, pending)
         self._registry.set_gauge("front_queue_depth",
                                  self._queue.depth_of(conn.cid),
@@ -494,6 +514,18 @@ class FrontendServer:
         if pending.settled:
             return
         pending.settled = True
+        if pending.t0_ns is not None:
+            # explicit-timing span: admission and settle happen on
+            # different event-loop ticks, so no `with` block can bracket
+            # the request — this is the span that ENCLOSES the engine
+            # flush on the merged timeline
+            tracer = get_tracer()
+            if tracer.enabled:
+                with ctx_bind(pending.req.ctx):
+                    tracer.complete(
+                        "front.request", pending.t0_ns,
+                        time.perf_counter_ns() - pending.t0_ns,
+                        uid=pending.req.uid, client=pending.conn.cid)
         self._inflight -= 1
         if self._inflight == 0:
             self._idle.set()
@@ -607,6 +639,26 @@ class FrontendServer:
                 return tracer.chrome_trace()
 
             self._reply_now(conn, _trace_reply)
+        elif cmd == "clock":
+            # photonpulse ping-pong leg: t1 = receipt on our clock, t2 =
+            # send time (lazy: stamped when the reply is actually written).
+            # The caller combines them with its own t0/t3 to estimate the
+            # offset between our perf_counter epochs (pulse.clock).
+            t0 = obj.get("t0")
+            t1 = pulse_clock.now_ns()
+            who = get_process_label() or "frontend"
+            self._reply_now(conn, lambda: {
+                "clock": {"t0": t0, "t1": t1, "t2": pulse_clock.now_ns(),
+                          "who": who}})
+        elif cmd == "flight":
+            recorder = get_flight()
+            if recorder is None:
+                self._reply_now(conn, error_reply(
+                    "flight recorder not configured; rerun with "
+                    "--flight-dir"))
+            else:
+                self._reply_now(conn,
+                                lambda: {"flight": recorder.snapshot()})
         elif cmd == "shutdown":
             fut = self._reply_future(conn)
             fut.set_result({"shutdown": "ok",

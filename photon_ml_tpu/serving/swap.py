@@ -59,6 +59,9 @@ from typing import Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.chaos.injector import fault as _chaos_fault
+from photon_ml_tpu.obs.pulse.context import current as ctx_current
+from photon_ml_tpu.obs.pulse.context import note_delta as ctx_note_delta
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.online.catchup import replay_into_store
 from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
@@ -293,6 +296,13 @@ class HotSwapper:
                         "failed, apply rolled back, serving continues: %s",
                         store.generation, e)
                     return None
+            if obs_enabled():
+                # remember which trace published this identity: the
+                # replication sender stamps it on the wire frame, and the
+                # replica's store-visible instant closes the chain
+                ctx = ctx_current()
+                if ctx is not None:
+                    ctx_note_delta(identity, ctx)
             return identity
 
     def swap_async(self, model_dir: str, version: str = "") -> threading.Thread:

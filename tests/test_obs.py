@@ -133,20 +133,28 @@ class TestTracer:
         obs.instant("evt", y=2)
         raw = json.dumps(tracer.chrome_trace())
         trace = json.loads(raw)  # valid JSON
-        events = trace["traceEvents"]
+        meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        events = [e for e in trace["traceEvents"] if e["ph"] != "M"]
         assert len(events) == 3
         pid = os.getpid()
+        # photonpulse merge keys on the metadata rows: a process_name for
+        # the Perfetto process lane, thread_name per recording thread
+        assert any(m["name"] == "process_name" for m in meta)
+        assert any(m["name"] == "thread_name" for m in meta)
+        for m in meta:
+            assert m["pid"] == pid and m["ts"] == 0
         for e in events:
             assert e["pid"] == pid and e["tid"] and "ts" in e
             assert e["ph"] in ("X", "i")
             if e["ph"] == "X":
                 assert e["dur"] >= 0
-        ts = [e["ts"] for e in events]
-        assert ts == sorted(ts)  # monotonic export order
+        ts = [e["ts"] for e in trace["traceEvents"]]
+        assert ts == sorted(ts)  # metadata (ts 0) first, then monotonic
         by_name = {e["name"]: e for e in events}
         assert by_name["b"]["args"]["parent_id"] == \
             by_name["a"]["args"]["span_id"]
         assert by_name["evt"]["ph"] == "i" and by_name["evt"]["args"]["y"] == 2
+        assert trace["otherData"]["pid"] == pid
 
     def test_device_sync_runs_fence(self, tracer):
         fences = []
@@ -430,7 +438,7 @@ class TestDescentTrace:
             obs.set_registry(prev)
         assert rc == 0
         trace = json.load(open(trace_path))  # valid JSON on disk
-        events = trace["traceEvents"]
+        events = [e for e in trace["traceEvents"] if e["ph"] != "M"]
         pid = os.getpid()
         assert all(e["pid"] == pid and e["tid"] for e in events)
         updates = [e for e in events if e["name"] == "descent.update"]
@@ -500,7 +508,8 @@ class TestServeCliTrace:
 
         trace_line = [o for o in out if "traceEvents" in o]
         assert len(trace_line) == 1
-        events = trace_line[0]["traceEvents"]
+        events = [e for e in trace_line[0]["traceEvents"]
+                  if e["ph"] != "M"]
         names = {e["name"] for e in events}
         # the whole request path is on the timeline
         assert {"serve.submit", "serve.flush", "store.resolve",

@@ -1119,6 +1119,103 @@ class TestSwallowedException:
         assert len(suppressed(src, "swallowed-exception")) == 1
 
 
+# -- PL010 span-discipline ----------------------------------------------------
+
+class TestSpanDiscipline:
+    def test_positive_discarded_span_call(self):
+        vs = lint("""
+            from photon_ml_tpu.obs.trace import span
+
+            def f():
+                span("op", bucket=64)
+                work()
+        """, "span-discipline")
+        assert len(vs) == 1 and vs[0].rule == "span-discipline"
+        assert "discarded" in vs[0].message
+
+    def test_positive_escaping_handle(self):
+        vs = lint("""
+            from photon_ml_tpu.obs.trace import span
+
+            def begin():
+                h = span("op")
+                return h
+        """, "span-discipline")
+        assert len(vs) == 1 and "escapes" in vs[0].message
+
+    def test_positive_enter_without_exit(self):
+        vs = lint("""
+            from photon_ml_tpu.obs.trace import span
+
+            def f():
+                h = span("op")
+                h.__enter__()
+                work()
+        """, "span-discipline")
+        assert len(vs) == 1 and "__enter__" in vs[0].message
+
+    def test_positive_method_call_counts(self):
+        # Tracer.span via an instance is the same contract
+        vs = lint("""
+            def f(tracer):
+                tracer.span("op")
+        """, "span-discipline")
+        assert len(vs) == 1 and "discarded" in vs[0].message
+
+    def test_negative_with_block_and_as_handle(self):
+        assert lint("""
+            from photon_ml_tpu.obs.trace import span
+
+            def f():
+                with span("op", bucket=64):
+                    work()
+                with span("op2") as h:
+                    h  # the handle is usable inside the block
+        """, "span-discipline") == []
+
+    def test_negative_handle_used_as_with_item(self):
+        assert lint("""
+            from photon_ml_tpu.obs.trace import span
+
+            def f():
+                h = span("op")
+                with h:
+                    work()
+        """, "span-discipline") == []
+
+    def test_negative_balanced_manual_enter_exit(self):
+        assert lint("""
+            from photon_ml_tpu.obs.trace import span
+
+            def f():
+                h = span("op")
+                h.__enter__()
+                try:
+                    work()
+                finally:
+                    h.__exit__(None, None, None)
+        """, "span-discipline") == []
+
+    def test_negative_non_span_enter_ignored(self):
+        # a lock entered manually is not a span handle — out of scope
+        assert lint("""
+            def f(lock):
+                lock.__enter__()
+                work()
+        """, "span-discipline") == []
+
+    def test_negative_provider_module_exempt(self):
+        # the module DEFINING span() is the tracer implementation
+        assert lint("""
+            def span(name, **attrs):
+                return _Span(name, attrs)
+
+            def helper():
+                s = span("x")
+                return s
+        """, "span-discipline") == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 SUPPRESSIBLE = """

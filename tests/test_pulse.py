@@ -1,0 +1,916 @@
+"""photonpulse tests (ISSUE 15): cross-process tracing, merge, flight.
+
+The contracts under test:
+  - context: mint/wire round-trip, *strictly tolerant* decode (every
+    malformed wire form degrades to None — a bad trace header must never
+    fail a request), thread-local binding stamping ``trace=``/``origin=``
+    attrs on spans and instants, and the bounded delta-identity map.
+  - clock: the four-timestamp NTP-style estimate recovers a known epoch
+    offset, ``observe_exchange`` keeps the lowest-rtt sample, and
+    ``pulse.configure`` exposes the offset table through every Chrome
+    export's ``otherData``.
+  - flight: dumps are self-contained (reason/detail/trace), rate-limited,
+    byte-bounded oldest-first, and triggered by the real degradation
+    paths — a HealthState ok->failed transition (driven end-to-end by a
+    chaos fault on the delta log) and the admission shed latch — then
+    retrievable via ``{"cmd": "flight"}`` on the stdio serve wire.
+  - merge: known clock offsets shift events onto the reference timeline,
+    reference auto-detection picks the label peers measured against, pids
+    are re-numbered collision-free, and ``spans_by_trace`` buckets batched
+    spans (``traces=[...]``) under every trace they served.
+  - exemplars: latency histograms attach the bound trace id per bucket
+    and render OpenMetrics-style exemplar suffixes ONLY while enabled —
+    the Prometheus golden elsewhere stays byte-stable.
+  - propagation: ``request_from_json`` adopts/rejects wire ``"tp"``,
+    replication frames carry ``"tp"`` beside (never inside) the CRC'd
+    payload, and the network frontend mints at admission / adopts from
+    the wire with garbage degrading to untraced.
+  - the pod-slice e2e: an in-process owner publishing under a minted
+    context, a REAL ``serve --subscribe`` replica subprocess, and a
+    frontend leg merged by ``tools/tracemerge.py`` into one timeline where
+    the owner's publish precedes the replica's store-visible instant under
+    the same trace id and the frontend request span encloses its flush.
+"""
+
+import io
+import json
+import os
+import select
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.obs import pulse
+from photon_ml_tpu.obs.pulse import clock as pclock
+from photon_ml_tpu.obs.pulse import context as pctx
+from photon_ml_tpu.obs.pulse.flight import (FlightRecorder, flight_dump,
+                                            set_flight)
+from photon_ml_tpu.obs.pulse.merge import merge_traces, spans_by_trace
+from photon_ml_tpu.obs.registry import MetricsRegistry, enable_exemplars
+from photon_ml_tpu.obs.trace import (Tracer, set_export_meta_provider,
+                                     set_process_label)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tracer():
+    """A fresh enabled tracer installed as the process default; restored
+    (and tracing re-disabled) afterwards so tests never leak spans."""
+    t = Tracer(capacity=4096, enabled=True)
+    prev = obs.set_tracer(t)
+    try:
+        yield t
+    finally:
+        obs.set_tracer(prev)
+
+
+@pytest.fixture(autouse=True)
+def _pulse_clean():
+    """photonpulse keeps process-global state (clock table, delta map,
+    flight recorder, process label, export hook, exemplar flag) — every
+    test starts and ends with all of it cleared."""
+    yield
+    pclock.reset()
+    pctx.clear_delta_ctx()
+    set_flight(None)
+    set_process_label(None)
+    set_export_meta_provider(None)
+    enable_exemplars(False)
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# context
+# ---------------------------------------------------------------------------
+class TestContext:
+    def test_mint_shape_and_uniqueness(self):
+        seen = set()
+        for _ in range(64):
+            tid, origin = pctx.mint()
+            assert len(tid) == 16 and set(tid) <= set("0123456789abcdef")
+            assert len(origin) == 8 and set(origin) <= set("0123456789abcdef")
+            seen.add(tid)
+        assert len(seen) == 64  # 64-bit ids: collisions would be a bug
+
+    def test_wire_round_trip(self):
+        ctx = pctx.mint()
+        wire = pctx.to_wire(ctx)
+        assert wire == f"{ctx[0]}/{ctx[1]}"
+        assert pctx.from_wire(wire) == ctx
+
+    def test_from_wire_garbage_degrades_to_none(self):
+        good = pctx.to_wire(pctx.mint())
+        for bad in (None, 7, 1.5, b"0123456789abcdef/01234567",
+                    "", "garbage", good[:-1], good + "0",
+                    good.upper(),                       # hex is lowercase
+                    "0123456789abcdef_01234567",        # right length, no /
+                    "0123456789abcde/012345678",        # 15/9 split
+                    "0123456789abcdeg/01234567",        # non-hex trace id
+                    "0123456789abcdef/0123456z",        # non-hex origin
+                    ["0123456789abcdef", "01234567"]):
+            assert pctx.from_wire(bad) is None, bad
+
+    def test_forwarded_keeps_trace_id_fresh_origin(self):
+        ctx = pctx.mint()
+        fwd = pctx.forwarded(ctx)
+        assert fwd[0] == ctx[0]
+        assert len(fwd[1]) == 8 and fwd[1] != ctx[1]
+        assert pctx.from_wire(pctx.to_wire(fwd)) == fwd
+
+    def test_bind_stamps_span_and_instant_attrs(self, tracer):
+        ctx = pctx.mint()
+        with pctx.bind(ctx):
+            with obs.span("work", k=1):
+                obs.instant("tick")
+            inner = pctx.mint()
+            with pctx.bind(inner):       # re-entrant: innermost wins
+                obs.instant("nested")
+            obs.instant("restored")      # outer binding restored
+            with pctx.bind(None):        # explicit unbind
+                obs.instant("unbound")
+        obs.instant("outside")
+        recs = {r["name"]: r for r in tracer.records()}
+        assert recs["work"]["attrs"]["trace"] == ctx[0]
+        assert recs["work"]["attrs"]["origin"] == ctx[1]
+        assert recs["work"]["attrs"]["k"] == 1
+        assert recs["tick"]["attrs"]["trace"] == ctx[0]
+        assert recs["nested"]["attrs"]["trace"] == inner[0]
+        assert recs["restored"]["attrs"]["trace"] == ctx[0]
+        assert "trace" not in recs["unbound"]["attrs"]
+        assert "trace" not in recs["outside"]["attrs"]
+        assert pctx.current() is None
+
+    def test_delta_map_lookup_and_bounded_eviction(self):
+        ctx = pctx.mint()
+        pctx.note_delta((1, 1), ctx)
+        pctx.note_delta((1, 2), None)     # untraced publish: no entry
+        assert pctx.delta_ctx((1, 1)) == ctx
+        assert pctx.delta_ctx((1, 2)) is None
+        assert pctx.delta_ctx((9, 9)) is None
+        for v in range(pctx._DELTA_MAP_CAP + 8):
+            pctx.note_delta((2, v), ctx)
+        assert pctx.delta_ctx((1, 1)) is None      # oldest evicted
+        assert pctx.delta_ctx((2, 0)) is None
+        assert pctx.delta_ctx((2, pctx._DELTA_MAP_CAP)) == ctx
+
+
+# ---------------------------------------------------------------------------
+# clock
+# ---------------------------------------------------------------------------
+class TestClock:
+    def test_estimate_recovers_known_offset(self):
+        # server's epoch runs 7777ns ahead; symmetric 400ns network legs
+        skew, leg, proc = 7777, 400, 50
+        t0 = 1_000_000
+        t1 = t0 + leg + skew
+        t2 = t1 + proc
+        t3 = t0 + leg + proc + leg
+        offset, rtt = pclock.estimate(t0, t1, t2, t3)
+        assert offset == skew
+        assert rtt == 2 * leg
+
+    def test_observe_exchange_keeps_lowest_rtt(self):
+        pclock.observe_exchange("owner", 0, 1100, 1150, 300)   # rtt 250
+        assert pclock.offsets()["owner"]["rtt_ns"] == 250
+        pclock.observe_exchange("owner", 0, 5000, 5100, 1000)  # rtt 900
+        assert pclock.offsets()["owner"]["rtt_ns"] == 250      # noisier: kept
+        pclock.observe_exchange("owner", 0, 1050, 1060, 120)   # rtt 110
+        est = pclock.offsets()["owner"]
+        assert est["rtt_ns"] == 110
+        assert est["offset_ns"] == ((1050 - 0) + (1060 - 120)) // 2
+
+    def test_configure_exposes_offsets_in_export(self, tracer):
+        pulse.configure("replica")
+        pclock.set_offset("owner", 123_456, rtt_ns=789)
+        with obs.span("x"):
+            pass
+        other = tracer.chrome_trace()["otherData"]
+        assert other["process_label"] == "replica"
+        assert other["clock"] == {"owner": {"offset_ns": 123_456,
+                                            "rtt_ns": 789}}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+class TestFlight:
+    def test_dump_payload_and_snapshot(self, tmp_path, tracer):
+        with obs.span("before.incident", k=1):
+            pass
+        rec = FlightRecorder(str(tmp_path / "spool"), min_interval_s=0.0)
+        path = rec.dump("health_degraded", check="delta_log", detail="io")
+        assert path is not None and os.path.exists(path)
+        payload = json.load(open(path))
+        assert payload["reason"] == "health_degraded"
+        assert payload["detail"] == {"check": "delta_log", "detail": "io"}
+        names = {e["name"] for e in payload["trace"]["traceEvents"]}
+        assert "before.incident" in names  # the ring survived the incident
+        snap = rec.snapshot()
+        assert snap["spool_dir"] == str(tmp_path / "spool")
+        assert [d["reason"] for d in snap["dumps"]] == ["health_degraded"]
+        assert snap["latest"]["reason"] == "health_degraded"
+
+    def test_rate_limit_coalesces_trigger_storms(self, tmp_path, tracer):
+        rec = FlightRecorder(str(tmp_path / "spool"), min_interval_s=60.0)
+        assert rec.dump("first") is not None
+        assert rec.dump("second") is None       # within the interval
+        assert len(rec.index()) == 1
+
+    def test_byte_bound_evicts_oldest_first(self, tmp_path, tracer):
+        rec = FlightRecorder(str(tmp_path / "spool"), min_interval_s=0.0)
+        paths = [rec.dump(f"r{i}") for i in range(3)]
+        size = os.path.getsize(paths[-1])
+        rec.max_bytes = int(size * 2.5)         # room for two dumps
+        for i in range(3, 6):
+            assert rec.dump(f"r{i}") is not None
+        reasons = [d["reason"] for d in rec.index()]
+        assert reasons[-1] == "r5"              # newest always survives
+        assert "r0" not in reasons and "r1" not in reasons
+        total = sum(d["bytes"] for d in rec.index())
+        assert total <= rec.max_bytes
+
+    def test_module_trigger_is_one_none_check(self, tmp_path, tracer):
+        assert flight_dump("nothing_installed") is None
+        rec = FlightRecorder(str(tmp_path / "spool"), min_interval_s=0.0)
+        set_flight(rec)
+        assert flight_dump("installed", k=1) is not None
+
+    def test_health_transition_triggers_dump(self, tmp_path, tracer):
+        from photon_ml_tpu.chaos.health import HealthState
+
+        set_flight(FlightRecorder(str(tmp_path / "spool"),
+                                  min_interval_s=0.0))
+        hs = HealthState()
+        hs.set_condition("disk", True, "fine")
+        rec = pulse.get_flight()
+        assert rec.index() == []                # ok -> ok: no dump
+        hs.set_condition("disk", False, "enospc")
+        assert len(rec.index()) == 1            # the ok -> failed edge
+        hs.set_condition("disk", False, "still enospc")
+        assert len(rec.index()) == 1            # failed -> failed: no edge
+        hs.set_condition("disk", True, "healed")
+        hs.set_condition("disk", False, "again")
+        assert len(rec.index()) == 2            # a fresh edge dumps again
+        latest = rec.latest()
+        assert latest["reason"] == "health_degraded"
+        assert latest["detail"]["check"] == "disk"
+
+    def test_admission_shed_latch_triggers_dump(self, tmp_path, tracer):
+        from photon_ml_tpu.serving.frontend import (AdmissionConfig,
+                                                    AdmissionController)
+
+        set_flight(FlightRecorder(str(tmp_path / "spool"),
+                                  min_interval_s=0.0))
+        ac = AdmissionController(AdmissionConfig(budget_s=0.010,
+                                                 resume_fraction=0.5))
+        assert ac.decide(0.005).admitted
+        rec = pulse.get_flight()
+        assert rec.index() == []
+        assert not ac.decide(0.050).admitted    # latch engages
+        assert [d["reason"] for d in rec.index()] == ["admission_shed"]
+        assert not ac.decide(0.040).admitted    # still latched: no new dump
+        assert len(rec.index()) == 1
+
+    def test_chaos_delta_log_fault_dumps_flight(self, tmp_path, tracer):
+        """The acceptance chain: injected delta-log fault -> append fails
+        -> health check transitions -> flight dump lands on disk."""
+        from photon_ml_tpu.chaos import (FaultInjector, delta_log_check,
+                                         set_injector)
+        from photon_ml_tpu.chaos.health import HealthState
+        from photon_ml_tpu.online.delta_log import DeltaLog, DeltaRecord
+
+        set_flight(FlightRecorder(str(tmp_path / "spool"),
+                                  min_interval_s=0.0))
+        log = DeltaLog(str(tmp_path / "log"), fsync="never")
+        hs = HealthState()
+        hs.add_check("delta_log", delta_log_check(log))
+        ready, _ = hs.readyz()
+        assert ready
+        inj = FaultInjector()
+        inj.arm("delta_log.append", kind="enospc")
+        prev = set_injector(inj)
+        try:
+            with pctx.bind(pctx.mint()):
+                with pytest.raises(OSError):
+                    log.append(DeltaRecord(generation=1, delta_version=1,
+                                           cid="user", entity="u1",
+                                           row=(1.0, 2.0)))
+        finally:
+            set_injector(prev)
+            log.close()
+        ready, checks = hs.readyz()
+        assert not ready and not checks["delta_log"]["ok"]
+        rec = pulse.get_flight()
+        latest = rec.latest()
+        assert latest["reason"] == "health_degraded"
+        assert latest["detail"]["check"] == "delta_log"
+        assert "write error" in latest["detail"]["detail"]
+
+    def test_serve_stream_flight_cmd(self, tmp_path, tracer):
+        """``{"cmd": "flight"}`` on the stdio wire returns the snapshot;
+        without ``--flight-dir`` it explains how to get one."""
+        import contextlib
+
+        from test_serving import _train
+        from photon_ml_tpu.cli import serve as serve_cli
+
+        model_dir = _train(tmp_path, seed=7)
+        spool = str(tmp_path / "spool")
+        FlightRecorder(spool, min_interval_s=0.0).dump("health_degraded",
+                                                       check="delta_log")
+        req_file = str(tmp_path / "reqs.jsonl")
+        with open(req_file, "w") as f:
+            f.write(json.dumps({"cmd": "flight"}) + "\n")
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = serve_cli.run(["--model-dir", model_dir, "--requests",
+                                req_file, "--no-warm",
+                                "--flight-dir", spool])
+        assert rc == 0
+        set_flight(None)  # run() installed a recorder; drop it
+        reply = json.loads(buf.getvalue().splitlines()[0])
+        assert reply["flight"]["spool_dir"] == spool
+        assert [d["reason"] for d in reply["flight"]["dumps"]] == \
+            ["health_degraded"]
+        assert reply["flight"]["latest"]["detail"] == {"check": "delta_log"}
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = serve_cli.run(["--model-dir", model_dir, "--requests",
+                                req_file, "--no-warm"])
+        assert rc == 0
+        reply = json.loads(buf.getvalue().splitlines()[0])
+        assert "--flight-dir" in reply["error"]
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+def _mk_trace(label, events, clock=None, pid=4242):
+    other = {"process_label": label, "pid": pid}
+    if clock is not None:
+        other["clock"] = clock
+    return {"traceEvents": list(events), "displayTimeUnit": "ns",
+            "otherData": other}
+
+
+def _ev(name, ts, pid=4242, tid=1, trace=None, traces=None, ph="X", dur=10):
+    args = {}
+    if trace is not None:
+        args["trace"] = trace
+    if traces is not None:
+        args["traces"] = traces
+    ev = {"name": name, "ph": ph, "ts": ts, "pid": pid, "tid": tid,
+          "args": args}
+    if ph == "X":
+        ev["dur"] = dur
+    return ev
+
+
+class TestMerge:
+    def test_alignment_shifts_onto_reference_clock(self):
+        tid = "ab" * 8
+        owner = _mk_trace("owner", [_ev("online.publish", 1000, trace=tid)])
+        # replica measured: owner's clock = replica's clock + 5ms
+        replica = _mk_trace(
+            "replica", [_ev("online.store_visible", 100, trace=tid, ph="i")],
+            clock={"owner": {"offset_ns": 5_000_000, "rtt_ns": 900}})
+        merged = merge_traces([owner, replica])
+        other = merged["otherData"]
+        assert other["reference"] == "owner"   # auto-detected root
+        assert other["offsets_ns"] == {"owner": 0, "replica": 5_000_000}
+        by_name = {e["name"]: e for e in merged["traceEvents"]
+                   if e.get("ph") != "M"}
+        assert by_name["online.publish"]["ts"] == 1000
+        assert by_name["online.store_visible"]["ts"] == 100 + 5000.0
+        assert other["trace_ids"] == {tid: 2}
+
+    def test_reference_override_inverts_shift(self):
+        owner = _mk_trace("owner", [_ev("a", 1000)])
+        replica = _mk_trace(
+            "replica", [_ev("b", 100)],
+            clock={"owner": {"offset_ns": 5_000_000, "rtt_ns": 900}})
+        merged = merge_traces([owner, replica], reference="replica")
+        other = merged["otherData"]
+        assert other["reference"] == "replica"
+        assert other["offsets_ns"] == {"owner": -5_000_000, "replica": 0}
+
+    def test_pid_renumber_and_process_metadata(self):
+        # both processes exported the same OS pid (restart collision)
+        t1 = _mk_trace("owner", [_ev("a", 10, pid=7)], pid=7)
+        t2 = _mk_trace("replica", [_ev("b", 20, pid=7)], pid=7)
+        merged = merge_traces([t1, t2])
+        body = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+        assert {e["pid"] for e in body} == {1, 2}
+        meta = {e["pid"]: e["args"]["name"]
+                for e in merged["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert meta == {1: "owner", 2: "replica"}
+        assert merged["otherData"]["processes"] == {"1": "owner",
+                                                    "2": "replica"}
+
+    def test_unlinked_label_keeps_zero_shift(self):
+        front = _mk_trace("frontend", [_ev("front.request", 50)])
+        replica = _mk_trace(
+            "replica", [_ev("b", 100)],
+            clock={"owner": {"offset_ns": 5_000_000, "rtt_ns": 900}})
+        owner = _mk_trace("owner", [_ev("a", 10)])
+        merged = merge_traces([front, replica, owner])
+        other = merged["otherData"]
+        assert other["reference"] == "owner"
+        assert other["offsets_ns"]["frontend"] == 0  # no path: unshifted
+
+    def test_events_sorted_metadata_first(self):
+        t1 = _mk_trace("owner", [_ev("late", 500), _ev("early", 5)])
+        t2 = _mk_trace("replica", [_ev("mid", 50)])
+        merged = merge_traces([t1, t2])
+        phases = [e.get("ph") for e in merged["traceEvents"]]
+        first_body = phases.index("X")
+        assert all(p == "M" for p in phases[:first_body])
+        body_ts = [e["ts"] for e in merged["traceEvents"][first_body:]]
+        assert body_ts == sorted(body_ts)
+
+    def test_spans_by_trace_buckets_batched_spans(self):
+        ta, tb = "aa" * 8, "bb" * 8
+        merged = merge_traces([_mk_trace("owner", [
+            _ev("front.request", 10, trace=ta),
+            _ev("front.request", 12, trace=tb),
+            _ev("serve.flush", 11, traces=[ta, tb]),
+        ])])
+        by = spans_by_trace(merged)
+        assert set(by) == {ta, tb}
+        assert [e["name"] for e in by[ta]] == ["front.request", "serve.flush"]
+        assert [e["name"] for e in by[tb]] == ["serve.flush", "front.request"]
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars
+# ---------------------------------------------------------------------------
+class TestExemplars:
+    def test_exemplar_rendered_only_while_enabled(self, tracer):
+        reg = MetricsRegistry()
+        ctx = pctx.mint()
+        enable_exemplars(True)
+        with pctx.bind(ctx):
+            reg.observe("latency_seconds", 0.004, path="score")
+        text = reg.to_prometheus()
+        assert f'# {{trace_id="{ctx[0]}"}}' in text
+        exemplar_lines = [l for l in text.splitlines() if "trace_id=" in l]
+        assert exemplar_lines and all("_bucket" in l for l in exemplar_lines)
+        # the flag gates RENDERING too: stored exemplars vanish when off,
+        # so the golden Prometheus exposition elsewhere stays byte-stable
+        enable_exemplars(False)
+        assert "trace_id=" not in reg.to_prometheus()
+        enable_exemplars(True)
+        assert f'# {{trace_id="{ctx[0]}"}}' in reg.to_prometheus()
+
+    def test_disabled_observe_records_no_exemplar(self, tracer):
+        reg = MetricsRegistry()
+        with pctx.bind(pctx.mint()):
+            reg.observe("latency_seconds", 0.004, path="score")
+        enable_exemplars(True)          # enabled AFTER the observation
+        assert "trace_id=" not in reg.to_prometheus()
+
+    def test_unbound_observe_records_no_exemplar(self, tracer):
+        reg = MetricsRegistry()
+        enable_exemplars(True)
+        reg.observe("latency_seconds", 0.004, path="score")
+        assert "trace_id=" not in reg.to_prometheus()
+
+    def test_newest_sample_wins_per_bucket(self, tracer):
+        reg = MetricsRegistry()
+        enable_exemplars(True)
+        a, b = pctx.mint(), pctx.mint()
+        with pctx.bind(a):
+            reg.observe("latency_seconds", 0.0050)
+        with pctx.bind(b):
+            reg.observe("latency_seconds", 0.0051)   # same 2^k bucket
+        text = reg.to_prometheus()
+        assert f'trace_id="{b[0]}"' in text
+        assert f'trace_id="{a[0]}"' not in text
+
+
+# ---------------------------------------------------------------------------
+# wire propagation units
+# ---------------------------------------------------------------------------
+class TestWirePropagation:
+    def test_request_from_json_adopts_and_rejects_tp(self, tracer):
+        from photon_ml_tpu.serving.batcher import request_from_json
+
+        ctx = pctx.mint()
+        req = request_from_json({"uid": 1, "features": [["f0", 1.0]],
+                                 "tp": pctx.to_wire(ctx)})
+        assert req.ctx == ctx
+        req = request_from_json({"uid": 2, "features": [["f0", 1.0]],
+                                 "tp": "torn-garbage"})
+        assert req.ctx is None          # degrades, never raises
+
+    def test_request_tp_skipped_when_tracing_off(self):
+        from photon_ml_tpu.serving.batcher import request_from_json
+
+        prev = obs.set_tracer(Tracer(capacity=16, enabled=False))
+        try:
+            req = request_from_json({"uid": 1, "features": [],
+                                     "tp": pctx.to_wire(pctx.mint())})
+            assert req.ctx is None      # one-boolean disabled path
+        finally:
+            obs.set_tracer(prev)
+
+    def test_record_line_tp_rides_beside_payload(self):
+        from photon_ml_tpu.online.delta_log import DeltaRecord
+        from photon_ml_tpu.online.replication.wire import encode_record_line
+
+        rec = DeltaRecord(generation=3, delta_version=9, cid="user",
+                          entity="u1", row=(1.0, 2.0))
+        bare = json.loads(encode_record_line(rec))
+        ctx = pctx.mint()
+        traced = json.loads(encode_record_line(rec, tp=pctx.to_wire(ctx)))
+        # the replication invariant: tp must not perturb payload or CRC
+        assert traced["p"] == bare["p"] and traced["crc"] == bare["crc"]
+        assert "tp" not in bare
+        assert pctx.from_wire(traced["tp"]) == ctx
+
+
+# ---------------------------------------------------------------------------
+# frontend propagation (in-process socket)
+# ---------------------------------------------------------------------------
+N_ENT = 12
+D = 3
+NAMES = [f"f{j}" for j in range(D)]
+
+
+def _save_model_dir(path, seed=0):
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.storage.model_io import save_game_model
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=D)),
+            feature_shard="all", task=task),
+        "user": RandomEffectModel(
+            w_stack=rng.normal(size=(N_ENT, D)) * 0.5,
+            slot_of={i: i for i in range(N_ENT)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    })
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(NAMES)})
+    eidx = EntityIndex()
+    for i in range(N_ENT):
+        eidx.get_or_add(f"user{i}")
+    save_game_model(model, path, {"all": imap}, {"userId": eidx}, task=task)
+    imap.save(os.path.join(path, "all.idx"))
+    eidx.save(os.path.join(path, "userId.entities.json"))
+    return path
+
+
+def _wire_req(uid, user=0, tp=None):
+    obj = {"uid": uid,
+           "features": [[n, 0.25 * (j + 1)] for j, n in enumerate(NAMES)],
+           "ids": {"userId": f"user{user}"}}
+    if tp is not None:
+        obj["tp"] = tp
+    return obj
+
+
+class _Client:
+    """Blocking socket client speaking the JSON-lines wire protocol."""
+
+    def __init__(self, port, timeout=60):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        self.f = self.sock.makefile("rw", encoding="utf-8", newline="\n")
+
+    def ask(self, obj):
+        self.f.write(json.dumps(obj) + "\n")
+        self.f.flush()
+        line = self.f.readline()
+        assert line, "server closed the connection unexpectedly"
+        return json.loads(line)
+
+    def close(self):
+        try:
+            self.f.close()
+        finally:
+            self.sock.close()
+
+
+def _engine(max_batch=8):
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.serving.batcher import BucketedBatcher
+    from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                         StoreConfig)
+    from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    task = TaskType.LOGISTIC_REGRESSION
+    model = GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=D)),
+            feature_shard="all", task=task),
+        "user": RandomEffectModel(
+            w_stack=rng.normal(size=(N_ENT, D)) * 0.5,
+            slot_of={i: i for i in range(N_ENT)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    })
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(NAMES)})
+    eidx = EntityIndex()
+    for i in range(N_ENT):
+        eidx.get_or_add(f"user{i}")
+    metrics = ServingMetrics()
+    store = CoefficientStore.from_model(
+        model, task, {"userId": eidx}, {"all": imap},
+        config=StoreConfig(device_capacity=None), version="synthetic",
+        metrics=metrics)
+    eng = ScoringEngine(store, BucketedBatcher(max_batch), metrics=metrics)
+    eng.warm()
+    return eng
+
+
+class TestFrontendPropagation:
+    def test_mint_adopt_and_garbage_degrade(self, tracer):
+        from photon_ml_tpu.serving.frontend import (AdmissionConfig,
+                                                    FrontendConfig,
+                                                    ThreadedFrontend)
+
+        pulse.configure("frontend")
+        front = ThreadedFrontend(
+            _engine(), config=FrontendConfig(
+                admission=AdmissionConfig(budget_s=30.0),
+                batcher_deadline_s=0.002)).start()
+        supplied = pctx.mint()
+        try:
+            c = _Client(front.port)
+            try:
+                r0 = c.ask(_wire_req(0))                       # no tp: mint
+                r1 = c.ask(_wire_req(1, tp=pctx.to_wire(supplied)))
+                r2 = c.ask(_wire_req(2, tp="complete/garbage!!!!"))
+                assert all("score" in r for r in (r0, r1, r2))
+            finally:
+                c.close()
+        finally:
+            front.stop()
+        recs = tracer.records()
+        front_spans = {r["attrs"]["uid"]: r for r in recs
+                       if r["name"] == "front.request"}
+        assert set(front_spans) == {0, 1, 2}
+        # adopted: the span joins the SUPPLIED trace
+        assert front_spans[1]["attrs"]["trace"] == supplied[0]
+        # minted at admission: a fresh well-formed id, not the garbage
+        minted = front_spans[0]["attrs"]["trace"]
+        assert pctx.from_wire(f"{minted}/00000000") is not None
+        garbage = front_spans[2]["attrs"]["trace"]
+        assert garbage not in ("complete/garbage!!!!",) and len(garbage) == 16
+        assert len({minted, garbage, supplied[0]}) == 3
+        # the batched flush span lists every trace id it scored
+        flush_tids = set()
+        for r in recs:
+            if r["name"] == "serve.flush":
+                flush_tids.update(r["attrs"].get("traces", ()))
+        assert {minted, garbage, supplied[0]} <= flush_tids
+
+    def test_clock_cmd_answers_ping_pong(self, tracer):
+        from photon_ml_tpu.serving.frontend import (AdmissionConfig,
+                                                    FrontendConfig,
+                                                    ThreadedFrontend)
+
+        pulse.configure("frontend")
+        front = ThreadedFrontend(
+            _engine(), config=FrontendConfig(
+                admission=AdmissionConfig(budget_s=30.0),
+                batcher_deadline_s=0.002)).start()
+        try:
+            c = _Client(front.port)
+            try:
+                t0 = pclock.now_ns()
+                reply = c.ask({"cmd": "clock", "t0": t0})
+                t3 = pclock.now_ns()
+            finally:
+                c.close()
+        finally:
+            front.stop()
+        ck = reply["clock"]
+        assert ck["t0"] == t0 and ck["who"] == "frontend"
+        assert t0 <= ck["t1"] <= ck["t2"]
+        offset, rtt = pclock.observe_exchange("frontend", ck["t0"], ck["t1"],
+                                              ck["t2"], t3)
+        assert rtt >= 0
+        # same process, same perf_counter epoch: offset is bounded by rtt
+        assert abs(offset) <= rtt
+        assert "frontend" in pclock.offsets()
+
+
+# ---------------------------------------------------------------------------
+# the pod-slice e2e: owner (in-process) -> replica (REAL subprocess),
+# plus a frontend leg, merged by tools/tracemerge.py
+# ---------------------------------------------------------------------------
+def _read_reply(proc, err_path, timeout=60.0):
+    """One JSON line from the subprocess's stdout, with a hang guard."""
+
+    def _err_tail():
+        try:
+            with open(err_path) as f:
+                return f.read()[-2000:]
+        except OSError:
+            return "<no stderr>"
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"replica exited early (rc {proc.returncode}): "
+                f"{_err_tail()}")
+        ready, _, _ = select.select([proc.stdout], [], [], 0.25)
+        if ready:
+            line = proc.stdout.readline()
+            if line:
+                return json.loads(line)
+    raise AssertionError(
+        f"timed out waiting for replica reply; stderr: {_err_tail()}")
+
+
+class TestPodSliceTimeline:
+    def test_publish_to_store_visible_merged_across_processes(
+            self, tmp_path, tracer):
+        from photon_ml_tpu.cli.serve import build_server
+        from photon_ml_tpu.online.delta_log import DeltaLog
+        from photon_ml_tpu.online.replication import (ReplicationConfig,
+                                                      attach_replication)
+        from tools import tracemerge
+
+        # -- phase A: the owner, in-process under tracer A -----------------
+        pulse.configure("owner")
+        base_dir = _save_model_dir(str(tmp_path / "base"))
+        log = DeltaLog(str(tmp_path / "owner-log"), fsync="never")
+        engine, swapper = build_server(base_dir, max_batch=4, warm=False,
+                                       delta_log=log, log_owner=True)
+        repl = attach_replication(swapper, ReplicationConfig(),
+                                  registry=engine.metrics.registry)
+
+        # -- phase B: a REAL `serve --subscribe` replica subprocess --------
+        replica_json = str(tmp_path / "replica.json")
+        err_path = str(tmp_path / "replica.err")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # stderr to a FILE: the replica logs freely, and an undrained pipe
+        # would fill and deadlock it mid-handshake
+        err_f = open(err_path, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "photon_ml_tpu.cli.serve",
+             "--subscribe", f"127.0.0.1:{repl.port}",
+             "--spool", str(tmp_path / "spool"), "--no-warm",
+             "--trace", "--trace-out", replica_json,
+             "--trace-label", "replica", "--requests", "-"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=err_f, text=True, cwd=REPO_ROOT, env=env)
+        err_f.close()
+        try:
+            # publish ONE delta under a minted context — the trainer's
+            # per-wave pattern (trainer.py binds exactly like this)
+            ctx = pctx.mint()
+            dim = engine.store.coordinates["user"].dim
+            with pctx.bind(ctx):
+                with obs.span("online.publish", coordinate="user"):
+                    identity = swapper.publish_delta(
+                        "user", "user1", np.arange(dim, dtype=float))
+            assert identity is not None
+
+            # poll the replica's ring over the wire until the delta is
+            # store-visible UNDER OUR TRACE ID (proves tp crossed the
+            # socket and survived the mirror -> follower path)
+            def store_visible():
+                proc.stdin.write(json.dumps({"cmd": "trace"}) + "\n")
+                proc.stdin.flush()
+                trace = _read_reply(proc, err_path)
+                return any(e["name"] == "online.store_visible"
+                           and e.get("args", {}).get("trace") == ctx[0]
+                           for e in trace.get("traceEvents", ()))
+
+            deadline = time.monotonic() + 120.0
+            while not store_visible():
+                assert time.monotonic() < deadline, \
+                    "replica never marked the delta store-visible"
+                time.sleep(0.2)
+
+            # a torn wire context must not break scoring on the replica
+            # (trailing blank line: scoring replies are async and only
+            # drain on the next line / blank line / EOF)
+            proc.stdin.write(
+                json.dumps(_wire_req(77, user=1, tp="xx/torn")) + "\n\n")
+            proc.stdin.flush()
+            reply = _read_reply(proc, err_path, timeout=120.0)  # first score compiles
+            assert reply["uid"] == 77 and "score" in reply
+
+            proc.stdin.close()          # EOF: replica drains + exports
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            repl.stop()
+            log.close()
+        assert proc.returncode == 0, open(err_path).read()[-2000:]
+
+        owner_trace = tracer.chrome_trace()   # label still "owner"
+
+        # -- phase C: a frontend leg under its own tracer ------------------
+        from photon_ml_tpu.serving.frontend import (AdmissionConfig,
+                                                    FrontendConfig,
+                                                    ThreadedFrontend)
+
+        tracer_c = Tracer(capacity=4096, enabled=True)
+        prev = obs.set_tracer(tracer_c)
+        try:
+            pulse.configure("frontend")
+            front = ThreadedFrontend(
+                _engine(), config=FrontendConfig(
+                    admission=AdmissionConfig(budget_s=30.0),
+                    batcher_deadline_s=0.002)).start()
+            try:
+                c = _Client(front.port)
+                try:
+                    assert "score" in c.ask(_wire_req(5))
+                finally:
+                    c.close()
+            finally:
+                front.stop()
+            front_trace = tracer_c.chrome_trace()
+        finally:
+            obs.set_tracer(prev)
+
+        # -- merge all three through the CLI -------------------------------
+        owner_json = str(tmp_path / "owner.json")
+        front_json = str(tmp_path / "front.json")
+        json.dump(owner_trace, open(owner_json, "w"))
+        json.dump(front_trace, open(front_json, "w"))
+        merged_json = str(tmp_path / "merged.json")
+        rc = tracemerge.run([owner_json, replica_json, front_json,
+                             "--out", merged_json, "--quiet"])
+        assert rc == 0
+        merged = json.load(open(merged_json))
+        other = merged["otherData"]
+        assert other["reference"] == "owner"
+        assert other["processes"] == {"1": "owner", "2": "replica",
+                                      "3": "frontend"}
+        # the replica really did measure the owner over the resume reply
+        replica_raw = json.load(open(replica_json))
+        assert "owner" in replica_raw["otherData"]["clock"]
+
+        # causality on the merged, clock-aligned timeline: the owner's
+        # publish span starts before the replica's store-visible instant,
+        # all under ONE trace id spanning two pids
+        by = spans_by_trace(merged)
+        chain = by[ctx[0]]
+        names = [(e["pid"], e["name"]) for e in chain]
+        assert (1, "online.publish") in names
+        assert (2, "online.store_visible") in names
+        assert (2, "repl.client.recv") in names
+        publish = next(e for e in chain if e["name"] == "online.publish")
+        visible = next(e for e in chain
+                       if e["name"] == "online.store_visible")
+        recv = next(e for e in chain if e["name"] == "repl.client.recv")
+        assert publish["ts"] <= recv["ts"] <= visible["ts"]
+        # the replica adopted our trace but stamped its own hop origin
+        assert visible["args"]["origin"] != ctx[1]
+
+        # the frontend leg: front.request encloses the engine flush that
+        # scored it, both under the trace minted at admission (pid 3)
+        front_reqs = [e for e in merged["traceEvents"]
+                      if e["name"] == "front.request" and e["pid"] == 3]
+        assert front_reqs
+        fr = front_reqs[0]
+        tid = fr["args"]["trace"]
+        flushes = [e for e in merged["traceEvents"]
+                   if e["name"] == "serve.flush" and e["pid"] == 3
+                   and tid in e["args"].get("traces", ())]
+        assert flushes
+        fl = flushes[0]
+        assert fr["ts"] <= fl["ts"]
+        assert fl["ts"] + fl["dur"] <= fr["ts"] + fr["dur"]

@@ -1,0 +1,108 @@
+"""tracemerge CLI — merge per-process photonscope exports into one timeline.
+
+Usage:
+    python -m tools.tracemerge owner.json replica.json front.json \
+        --out merged.json
+    python -m tools.tracemerge *.json --reference owner
+    python -m tools.tracemerge flight-*.json --flight --out merged.json
+
+Each input is a Chrome ``trace_event`` export from one process (``{"cmd":
+"trace"}``, ``--trace-out``, or a flight-recorder dump with ``--flight``,
+which unwraps the ``"trace"`` member of the dump payload).  The merge
+(``photon_ml_tpu.obs.pulse.merge``) aligns every process onto one clock
+using the NTP-style offsets the exports carry in ``otherData.clock``,
+re-numbers pids so restarts cannot collide, and emits one Perfetto-loadable
+timeline.  ``--reference`` pins the clock every other process is shifted
+onto (default: auto-detect the label peers measured against — usually the
+owner or frontend).
+
+The summary printed to stderr lists the alignment (per-process shift, in
+ns) and the distinct request trace ids found, so "did these three
+processes actually see the same request?" is answered without opening
+Perfetto at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # direct `python tools/tracemerge.py` runs
+    sys.path.insert(0, _REPO_ROOT)
+
+from photon_ml_tpu.obs.pulse.merge import (load_trace,  # noqa: E402
+                                           merge_traces, spans_by_trace)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tracemerge",
+        description="Merge per-process photonscope Chrome traces into one "
+                    "clock-aligned Perfetto timeline")
+    p.add_argument("traces", nargs="+", metavar="TRACE.json",
+                   help="per-process Chrome trace exports")
+    p.add_argument("--out", default="-", metavar="FILE",
+                   help="merged trace destination ('-' = stdout)")
+    p.add_argument("--reference", default=None, metavar="LABEL",
+                   help="process label whose clock the timeline uses "
+                        "(default: auto-detect)")
+    p.add_argument("--flight", action="store_true",
+                   help="inputs are flight-recorder dumps: unwrap the "
+                        "'trace' member of each payload")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the alignment/trace-id summary on stderr")
+    return p
+
+
+def run(argv) -> int:
+    args = _parser().parse_args(argv)
+    traces = []
+    for path in args.traces:
+        try:
+            t = load_trace(path)
+        except (OSError, ValueError) as e:
+            print(f"tracemerge: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        if args.flight:
+            t = t.get("trace")
+            if not isinstance(t, dict):
+                print(f"tracemerge: {path} is not a flight dump "
+                      f"(no 'trace' member)", file=sys.stderr)
+                return 2
+        traces.append(t)
+    merged = merge_traces(traces, reference=args.reference)
+    text = json.dumps(merged)
+    if args.out == "-":
+        sys.stdout.write(text + "\n")
+    else:
+        with open(args.out, "w") as f:
+            f.write(text)
+    if not args.quiet:
+        other = merged["otherData"]
+        print(f"tracemerge: {len(traces)} process(es), reference "
+              f"{other['reference']!r}", file=sys.stderr)
+        for pid, label in sorted(other["processes"].items(),
+                                 key=lambda e: int(e[0])):
+            shift = other["offsets_ns"].get(label, 0)
+            print(f"  pid {pid}: {label} (shift {shift:+d} ns)",
+                  file=sys.stderr)
+        by_trace = spans_by_trace(merged)
+        print(f"tracemerge: {len(by_trace)} trace id(s) across "
+              f"{sum(len(v) for v in by_trace.values())} event(s)",
+              file=sys.stderr)
+        for tid, evs in sorted(by_trace.items()):
+            pids = sorted({ev.get("pid") for ev in evs})
+            print(f"  {tid}: {len(evs)} event(s) over pid(s) "
+                  f"{','.join(map(str, pids))}", file=sys.stderr)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()
