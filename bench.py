@@ -2773,6 +2773,169 @@ def run_chaos_bench(n_entities=128, d=8, max_batch=16, rounds=9, seed=0,
     return out
 
 
+def run_fleet_bench(n_entities=2000, d=8, n_requests=400, max_batch=32,
+                    n_models=4, seed=0, out_path=None) -> dict:
+    """`bench.py --fleet`: photonfleet multi-model serving micro-bench ->
+    BENCH_FLEET_<backend>.json.
+
+    Three numbers the fleet design promises, measured on a synthetic
+    same-shape model family:
+
+      - ``compiles_after_warm``: compiles added as the fleet grows from 1
+        to ``n_models`` equal-shape models.  The shared ``KernelCache``
+        keys executables on ``(signature, bucket)`` and the signature
+        carries no per-model state, so every entry past the first must be
+        0 — asserted, the fleet's Flare invariant.
+      - ``shadow_overhead_ratio``: wall-time ratio of dual-leg shadow
+        scoring to single-leg scoring of the same request stream (ideal
+        ~2.0; >> 2 would mean the shadow leg is compiling).
+      - canary settle times: wall time from episode start to auto-promote
+        (clean candidate) and to auto-rollback (drifting candidate under a
+        tight gate), plus zero-recompile and zero-loss checks across both
+        episodes.
+    """
+    import jax
+
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.serving.batcher import request_from_json
+    from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                         StoreConfig)
+    from photon_ml_tpu.serving.fleet import (PROMOTED, ROLLED_BACK,
+                                             CanaryController, CanaryPolicy,
+                                             ModelFleet, ShadowScorer,
+                                             shadow_overhead_ratio)
+    from photon_ml_tpu.serving.swap import HotSwapper
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    engine, metrics, names = _synthetic_serving_engine(
+        rng, n_entities, d, max_batch)
+
+    imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
+    eidx = EntityIndex()
+    for i in range(n_entities):
+        eidx.get_or_add(f"user{i}")
+    task = TaskType.LOGISTIC_REGRESSION
+
+    def make_store(version):
+        model = GameModel(models={
+            "fixed": FixedEffectModel(
+                coefficients=Coefficients(means=rng.normal(size=d)),
+                feature_shard="all", task=task),
+            "per_user": RandomEffectModel(
+                w_stack=rng.normal(size=(n_entities, d)) * 0.1,
+                slot_of={i: i for i in range(n_entities)},
+                random_effect_type="userId", feature_shard="all",
+                task=task),
+        })
+        return CoefficientStore.from_model(
+            model, task, {"userId": eidx}, {"all": imap},
+            config=StoreConfig(device_capacity=None), version=version,
+            metrics=metrics)
+
+    def make_requests(n, uid0=0):
+        return [request_from_json({
+            "uid": uid0 + i,
+            "features": [[nm, float(v)]
+                         for nm, v in zip(names, rng.normal(size=d))],
+            "ids": {"userId": f"user{int(rng.integers(0, n_entities))}"}})
+            for i in range(n)]
+
+    t0 = time.perf_counter()
+    engine.warm()
+    warm_s = time.perf_counter() - t0
+    fleet = ModelFleet(metrics=metrics)
+    fleet.adopt("m0", engine, HotSwapper(engine))
+
+    # -- fleet growth: compiles added per same-shape model (must stay 0)
+    warm_compiles = fleet.kernels.compile_count
+    compiles_after_warm = [0]
+    register_s = []
+    for k in range(1, n_models):
+        before = fleet.kernels.compile_count
+        t0 = time.perf_counter()
+        fleet.register_store(f"m{k}", make_store(f"synthetic-{k}"))
+        register_s.append(time.perf_counter() - t0)
+        compiles_after_warm.append(fleet.kernels.compile_count - before)
+    assert sum(compiles_after_warm) == 0, (
+        f"same-shape fleet growth compiled: {compiles_after_warm}")
+
+    reqs = make_requests(n_requests)
+    handle = fleet.handle("m0")
+
+    # -- shadow overhead: dual-leg vs single-leg wall over one stream
+    t0 = time.perf_counter()
+    baseline = handle.engine.score_requests(reqs)
+    single_s = time.perf_counter() - t0
+    scorer = ShadowScorer(handle, make_store("shadow"))
+    t0 = time.perf_counter()
+    served = scorer.score(reqs)
+    dual_s = time.perf_counter() - t0
+    assert np.array_equal(served, baseline)  # primary leg is what serves
+    overhead = shadow_overhead_ratio(dual_s, single_s)
+
+    # -- canary settle: clean promote, then drift rollback
+    def episode(candidate, max_drift):
+        ctl = CanaryController(handle, CanaryPolicy(
+            fraction=0.5, min_observations=max(n_requests // 8, 8),
+            max_drift=max_drift))
+        ctl.start(candidate)
+        scored = 0
+        uid0 = 10_000
+        while ctl.state == "canary":
+            scored += len(ctl.score(make_requests(max_batch, uid0=uid0)))
+            uid0 += max_batch
+        return ctl, scored
+
+    promote_ctl, promote_scored = episode(make_store("candidate-clean"),
+                                          max_drift=float("inf"))
+    assert promote_ctl.state == PROMOTED
+    rollback_ctl, rollback_scored = episode(make_store("candidate-drift"),
+                                            max_drift=1e-9)
+    assert rollback_ctl.state == ROLLED_BACK
+    assert fleet.kernels.compile_count == warm_compiles  # whole episode
+
+    out = {
+        "bench": "fleet_serving",
+        "platform": jax.default_backend(),
+        "n_entities": n_entities,
+        "d": d,
+        "max_batch": max_batch,
+        "n_models": n_models,
+        "warm_s": warm_s,
+        "warm_compiles": warm_compiles,
+        # the headline: executables compiled as models 1..N registered
+        "compiles_after_warm": compiles_after_warm,
+        "register_s": register_s,
+        "shadow": {
+            "single_leg_s": single_s,
+            "dual_leg_s": dual_s,
+            "overhead_ratio": overhead,
+            "pairs": scorer.drift_view()["pairs"],
+        },
+        "canary": {
+            "promote_settle_s": promote_ctl.settle_s,
+            "promote_observations": promote_ctl.observations,
+            "promote_requests_scored": promote_scored,
+            "rollback_settle_s": rollback_ctl.settle_s,
+            "rollback_reason": rollback_ctl.rollback_reason,
+            "rollback_requests_scored": rollback_scored,
+        },
+        "recompiles_after_warm": fleet.kernels.compile_count - warm_compiles,
+        "shadow_overhead_ratio": overhead,
+    }
+    if out_path is None:
+        out_path = os.path.join(_REPO,
+                                f"BENCH_FLEET_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def run_solve_bench(out_path=None, seed=0, n_users=96, per_user=96,
                     d_user=4, n_iterations=4) -> dict:
     """`bench.py --solve`: per-entity solve-path micro-bench ->
@@ -3421,6 +3584,19 @@ def main():
                     help="with --chaos: fault rounds (first "
                          "len(FAULT_CLASSES) rounds cover every class "
                          "once)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="photonfleet multi-model serving micro-bench "
+                         "(compiles-after-warm stays 0 as same-shape "
+                         "models grow 1->N on the shared kernel cache, "
+                         "shadow dual-leg overhead ratio, canary "
+                         "auto-promote/auto-rollback settle times) -> "
+                         "BENCH_FLEET_<backend>.json")
+    ap.add_argument("--fleet-models", type=int, default=4,
+                    help="with --fleet: same-shape models to grow to")
+    ap.add_argument("--fleet-entities", type=int, default=2000,
+                    help="with --fleet: entities per model")
+    ap.add_argument("--fleet-requests", type=int, default=400,
+                    help="with --fleet: scored requests per measurement")
     ap.add_argument("--solve", action="store_true",
                     help="per-entity solve-path micro-bench (SoA Newton "
                          "lanes/sec, host vs fused vs fused-validated sweep "
@@ -3463,6 +3639,13 @@ def main():
         return
     if a.solve:
         print(json.dumps(run_solve_bench(out_path=a.out)))
+        return
+    if a.fleet:
+        print(json.dumps(run_fleet_bench(
+            n_entities=a.fleet_entities,
+            n_requests=a.fleet_requests,
+            n_models=a.fleet_models,
+            out_path=a.out)))
         return
     if a.chaos:
         print(json.dumps(run_chaos_bench(

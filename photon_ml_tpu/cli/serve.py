@@ -69,6 +69,18 @@ replayed onto every hot-swapped-in generation before it activates — so a
 second serving process converges to the trainer's live coefficients with
 no coordination beyond the shared log directory (see online/catchup.py).
 
+``--add-model NAME=DIR[,tenant=T]`` (repeatable) turns the process into a
+photonfleet node: the primary ``--model-dir`` registers under
+``--model-name`` and every added directory becomes another model handle on
+the SAME AOT kernel cache and device hot-row budget (``--fleet-budget``,
+``--tenant-quota T=ROWS``).  Requests grow an optional ``"model"`` field
+(absent -> the default model, so existing clients keep working), control
+commands grow ``fleet`` / ``canary`` / ``promote`` / ``rollback`` /
+``shadow`` plus ``"model"`` routing on swap/delta/rebalance, and in
+``--listen`` mode ``--tenant-token T=TOK`` scopes connections to one
+tenant's models while ``--tenant-budget-ms`` sheds a bursting tenant alone
+(reason ``tenant_overload``).
+
 ``--subscribe host:port`` removes even that shared directory: the process
 connects to a photonrepl owner (``learn.py --repl-listen``, or any
 ``online.replication.ReplicationServer``), bootstraps its base model from
@@ -198,6 +210,54 @@ def build_parser() -> argparse.ArgumentParser:
                         "accepts get one {\"error\": "
                         "\"too_many_connections\"} reply and a clean close "
                         "(0 = unlimited)")
+    p.add_argument("--add-model", action="append", default=[],
+                   metavar="NAME=DIR[,tenant=T]",
+                   help="register an additional model directory as a fleet "
+                        "handle (repeatable): shares the primary engine's "
+                        "AOT kernel cache (same-shape models compile "
+                        "nothing) and the --fleet-budget hot-row budget; "
+                        "tenant defaults to 'default'")
+    p.add_argument("--model-name", default="default",
+                   help="fleet model id the primary --model-dir registers "
+                        "under (only meaningful with --add-model)")
+    p.add_argument("--fleet-budget", type=int, default=0,
+                   help="fleet-wide device hot-row cap across every "
+                        "model's hot tables (0 = unbudgeted); registration "
+                        "that would exceed it is refused")
+    p.add_argument("--tenant-quota", action="append", default=[],
+                   metavar="TENANT=ROWS",
+                   help="per-tenant carve-out of --fleet-budget "
+                        "(repeatable); a tenant over quota cannot register "
+                        "more models and rebalance re-verifies the "
+                        "invariant")
+    p.add_argument("--tenant-token", action="append", default=[],
+                   metavar="TENANT=TOKEN",
+                   help="--listen mode: auth token scoping a connection to "
+                        "one tenant's models (repeatable; requests for "
+                        "another tenant's model get {\"error\": "
+                        "\"forbidden\"}).  Turns the auth handshake on "
+                        "even without --auth-token")
+    p.add_argument("--tenant-budget-ms", type=float, default=0.0,
+                   help="--listen mode: per-TENANT deadline budget — a "
+                        "tenant whose aggregate backlog is predicted to "
+                        "wait longer is shed alone (reason "
+                        "\"tenant_overload\") before the global latch "
+                        "trips (0 = off)")
+    p.add_argument("--canary-fraction", type=float, default=0.25,
+                   help="default traffic fraction a {\"cmd\": \"canary\"} "
+                        "episode routes to the candidate (deterministic "
+                        "request-key hash split, not RNG)")
+    p.add_argument("--canary-min-observations", type=int, default=100,
+                   help="default clean-observation window before a canary "
+                        "auto-promotes")
+    p.add_argument("--canary-max-drift", type=float, default=1e-6,
+                   help="default mean |canary - control| score drift above "
+                        "which a canary auto-rolls-back")
+    p.add_argument("--trace-sample", type=int, default=0,
+                   help="sampled always-on tracing: mint a photonpulse "
+                        "trace context for every Nth request arriving "
+                        "without one (0 = --listen mints for every "
+                        "request; stdio mints only when sampling)")
     p.add_argument("--delta-log", default="",
                    help="FOLLOW a photonlearn delta log directory "
                         "(online/delta_log.py): replay it into the store "
@@ -305,18 +365,70 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                   out: IO, predict_mean: bool,
                   deadline_s: float = 500e-6,
                   sync: bool = False,
-                  max_line_bytes: int = DEFAULT_MAX_LINE_BYTES) -> int:
+                  max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
+                  fleet=None, health=None,
+                  canary_defaults: Optional[dict] = None,
+                  trace_sample_n: int = 0) -> int:
     """Drive the engine from a JSON-lines stream.
 
     Async (default): each request is submitted to an AsyncBatcher and its
     (uid, future) queued; completed scores are written opportunistically in
     submission order, and every command / blank line / EOF force-flushes
     and drains.  ``sync=True`` keeps the legacy buffer-then-score path.
+
+    Fleet mode (``fleet=ModelFleet``): requests route by their optional
+    ``"model"`` field to per-model AsyncBatchers scoring through a
+    ``FleetRouter``, so canary episodes and shadow scorers interpose per
+    model; the canary/promote/rollback/shadow/fleet commands drive them.
     """
+    router = None
+    batchers: dict = {}  # model_id -> AsyncBatcher (fleet mode)
+    if fleet is not None:
+        from photon_ml_tpu.serving.fleet.router import FleetRouter
+        router = FleetRouter(fleet, health=health)
+        if sync:
+            logger.warning("--sync-batcher is ignored in fleet mode "
+                           "(per-model async batchers)")
+            sync = False
     pending: "collections.deque" = collections.deque()  # (uid, future)
     buffered: List = []  # sync mode only
-    batcher = None if sync else engine.async_batcher(
+    batcher = None if (sync or fleet is not None) else engine.async_batcher(
         deadline_s=deadline_s, predict_mean=predict_mean)
+
+    def model_batcher(model_id: str):
+        b = batchers.get(model_id)
+        if b is None:
+            from photon_ml_tpu.serving.batcher import AsyncBatcher
+            handle = fleet.handle(model_id)
+
+            def score(reqs, _mid=model_id):
+                return router.score(_mid, reqs, predict_mean=predict_mean)
+
+            b = AsyncBatcher(score,
+                             flush_threshold=handle.engine.batcher.max_batch,
+                             deadline_s=deadline_s,
+                             metrics=handle.engine.metrics)
+            batchers[model_id] = b
+        return b
+
+    def all_batchers():
+        if fleet is not None:
+            return list(batchers.values())
+        return [] if batcher is None else [batcher]
+
+    def cmd_target(obj):
+        """(swapper, store) a control command acts on: the optional
+        ``"model"`` field routes in fleet mode.  None after writing the
+        error reply for an unknown model."""
+        if fleet is None:
+            return swapper, engine.store
+        try:
+            h = fleet.resolve(obj.get("model"))
+        except ValueError as e:
+            out.write(json.dumps({"error": str(e)}) + "\n")
+            out.flush()
+            return None
+        return h.swapper, h.engine.store
 
     def emit(uid, fut) -> None:
         with obs_span("serve.respond", uid=uid):
@@ -346,7 +458,8 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
             out.flush()
             buffered.clear()
         else:
-            batcher.flush()
+            for b in all_batchers():
+                b.flush()
             drain(block=True)
 
     try:
@@ -371,26 +484,114 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
             cmd = obj.get("cmd") if isinstance(obj, dict) else None
             if cmd == "swap":
                 flush()  # everything buffered scores on the pre-swap version
-                ok = swapper.swap(obj["model_dir"])
+                target = cmd_target(obj)
+                if target is None:
+                    continue
+                tsw, _tstore = target
+                ok = tsw.swap(obj["model_dir"])
                 out.write(json.dumps({
                     "swap": "ok" if ok else "rejected",
-                    "generation": engine.store.generation,
-                    "version": engine.store.version,
-                    "delta_version": swapper.delta_version}) + "\n")
+                    "generation": tsw.engine.store.generation,
+                    "version": tsw.engine.store.version,
+                    "delta_version": tsw.delta_version}) + "\n")
                 out.flush()
             elif cmd == "delta":
                 flush()  # pending requests score pre-delta coefficients
-                ok = swapper.apply_delta(obj.get("coordinate"),
-                                         obj.get("entity"),
-                                         obj.get("row") or ())
+                target = cmd_target(obj)
+                if target is None:
+                    continue
+                tsw, _tstore = target
+                ok = tsw.apply_delta(obj.get("coordinate"),
+                                     obj.get("entity"),
+                                     obj.get("row") or ())
                 out.write(json.dumps({
                     "delta": "ok" if ok else "rejected",
-                    "delta_version": swapper.delta_version}) + "\n")
+                    "delta_version": tsw.delta_version}) + "\n")
                 out.flush()
             elif cmd == "rebalance":
-                moves = engine.store.rebalance()
+                if fleet is not None and obj.get("model") is None:
+                    moves = fleet.rebalance()
+                    out.write(json.dumps({"rebalance": {
+                        mid: {cid: list(m) for cid, m in mm.items()}
+                        for mid, mm in moves.items()}}) + "\n")
+                    out.flush()
+                    continue
+                target = cmd_target(obj)
+                if target is None:
+                    continue
+                _tsw, tstore = target
+                moves = tstore.rebalance()
                 out.write(json.dumps({"rebalance": {
                     cid: list(m) for cid, m in moves.items()}}) + "\n")
+                out.flush()
+            elif cmd == "fleet":
+                flush()
+                if router is None:
+                    out.write(json.dumps({"error": "no fleet configured; "
+                                          "run with --add-model"}) + "\n")
+                else:
+                    out.write(json.dumps({"fleet": router.status()}) + "\n")
+                out.flush()
+            elif cmd == "canary":
+                flush()  # the episode starts with zero requests in flight
+                if router is None:
+                    out.write(json.dumps({"error": "no fleet configured; "
+                                          "run with --add-model"}) + "\n")
+                else:
+                    try:
+                        handle = fleet.resolve(obj.get("model"))
+                        policy = _canary_policy_from(obj, canary_defaults)
+                        candidate = _load_fleet_store(
+                            engine, obj["model_dir"], handle.store.config)
+                        ctl = router.start_canary(
+                            handle.model_id, candidate, policy=policy,
+                            model_dir=obj["model_dir"])
+                        out.write(json.dumps({"canary": ctl.status()})
+                                  + "\n")
+                    except (KeyError, ValueError, ModelLoadError) as e:
+                        out.write(json.dumps({"error": str(e)}) + "\n")
+                out.flush()
+            elif cmd in ("promote", "rollback"):
+                flush()  # settle with zero requests in flight (quiesce)
+                if router is None:
+                    out.write(json.dumps({"error": "no fleet configured; "
+                                          "run with --add-model"}) + "\n")
+                else:
+                    try:
+                        handle = fleet.resolve(obj.get("model"))
+                        if cmd == "promote":
+                            ctl = router.promote(handle.model_id)
+                        else:
+                            ctl = router.rollback(
+                                handle.model_id,
+                                reason=obj.get("reason", "operator"))
+                        out.write(json.dumps({cmd: ctl.status()}) + "\n")
+                    except ValueError as e:
+                        out.write(json.dumps({"error": str(e)}) + "\n")
+                out.flush()
+            elif cmd == "shadow":
+                flush()
+                if router is None:
+                    out.write(json.dumps({"error": "no fleet configured; "
+                                          "run with --add-model"}) + "\n")
+                else:
+                    try:
+                        handle = fleet.resolve(obj.get("model"))
+                        if obj.get("off"):
+                            ok = router.detach_shadow(handle.model_id)
+                            out.write(json.dumps(
+                                {"shadow": "off" if ok else "none",
+                                 "model": handle.model_id}) + "\n")
+                        else:
+                            store = _load_fleet_store(
+                                engine, obj["model_dir"],
+                                handle.store.config)
+                            router.attach_shadow(handle.model_id, store)
+                            out.write(json.dumps(
+                                {"shadow": "on", "model": handle.model_id,
+                                 "version": store.version}) + "\n")
+                    except (KeyError, ValueError, ModelLoadError) as e:
+                        out.write(json.dumps({"error": str(e)}) + "\n")
                 out.flush()
             elif cmd == "metrics":
                 flush()
@@ -433,7 +634,27 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                     logger.error("bad request: %s", e)
                     out.write(json.dumps({"error": str(e)}) + "\n")
                     continue
-                if sync:
+                if trace_sample_n > 0 and req.ctx is None:
+                    # sampled always-on tracing: deterministic 1-in-N
+                    # context minting at the admission edge
+                    from photon_ml_tpu.obs.pulse import maybe_mint
+                    req.ctx = maybe_mint(trace_sample_n)
+                if fleet is not None:
+                    try:
+                        handle = fleet.resolve(req.model)
+                    except ValueError:
+                        out.write(json.dumps(
+                            {"uid": req.uid, "error": "unknown_model",
+                             "model": req.model}) + "\n")
+                        out.flush()
+                        continue
+                    engine.metrics.observe_fleet_request(handle.model_id,
+                                                         handle.tenant)
+                    pending.append((req.uid,
+                                    model_batcher(handle.model_id)
+                                    .submit(req)))
+                    drain(block=False)
+                elif sync:
                     buffered.append(req)
                     if len(buffered) >= engine.batcher.max_batch:
                         flush()
@@ -442,8 +663,9 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                     drain(block=False)
         flush()
     finally:
-        if batcher is not None:
-            batcher.shutdown(drain=True)
+        for b in all_batchers():
+            b.shutdown(drain=True)
+        if not sync:
             drain(block=True)
     return 0
 
@@ -462,9 +684,65 @@ def _auth_token(args: argparse.Namespace) -> Optional[str]:
     return os.environ.get("PHOTON_AUTH_TOKEN") or None
 
 
+def _parse_add_model(spec: str) -> Tuple[str, str, str]:
+    """``NAME=DIR[,tenant=T]`` -> (name, dir, tenant)."""
+    name, sep, rest = spec.partition("=")
+    if not sep or not name or not rest:
+        raise ValueError(
+            f"--add-model wants NAME=DIR[,tenant=T], got {spec!r}")
+    path, tenant = rest, "default"
+    if ",tenant=" in rest:
+        path, _, tenant = rest.partition(",tenant=")
+    if not path or not tenant:
+        raise ValueError(
+            f"--add-model wants NAME=DIR[,tenant=T], got {spec!r}")
+    return name, path, tenant
+
+
+def _parse_pairs(specs: Sequence[str], flag: str) -> dict:
+    """Repeatable ``KEY=VALUE`` flags -> dict."""
+    out = {}
+    for spec in specs:
+        key, sep, value = spec.partition("=")
+        if not sep or not key or not value:
+            raise ValueError(f"{flag} wants KEY=VALUE, got {spec!r}")
+        out[key] = value
+    return out
+
+
+def _canary_defaults(args: argparse.Namespace) -> dict:
+    """CLI-level CanaryPolicy defaults for ``{"cmd": "canary"}`` lines."""
+    return {"fraction": args.canary_fraction,
+            "min_observations": args.canary_min_observations,
+            "max_drift": args.canary_max_drift}
+
+
+def _load_fleet_store(engine: ScoringEngine, model_dir: str,
+                      config: StoreConfig) -> CoefficientStore:
+    """Load a canary/shadow leg on the handle's own StoreConfig, so its
+    signature — and therefore its warmed executables — is shared with the
+    active generation."""
+    bundle = load_model_bundle(model_dir)
+    return CoefficientStore.from_bundle(bundle, config=config,
+                                        version=model_dir,
+                                        metrics=engine.metrics)
+
+
+def _canary_policy_from(obj: dict, defaults: Optional[dict] = None):
+    """CanaryPolicy for a ``{"cmd": "canary"}`` line: CLI defaults under
+    per-command overrides."""
+    from photon_ml_tpu.serving.fleet.policy import CanaryPolicy
+    kw = dict(defaults or {})
+    for key, cast in (("fraction", float), ("min_observations", int),
+                      ("max_drift", float)):
+        if obj.get(key) is not None:
+            kw[key] = cast(obj[key])
+    return CanaryPolicy(**kw)
+
+
 def _run_network(engine: ScoringEngine, swapper: HotSwapper,
                  args: argparse.Namespace, health=None,
-                 watchdog=None) -> int:
+                 watchdog=None, fleet=None) -> int:
     """--listen mode: the serving.frontend edge on an asyncio loop this
     process owns, with an optional same-loop /metrics scrape endpoint and
     SIGTERM/SIGINT wired to the graceful drain."""
@@ -474,6 +752,9 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
                                                        FrontendServer)
 
     host, port = _parse_listen(args.listen)
+    tenant_tokens = {tok: tenant for tenant, tok in
+                     _parse_pairs(args.tenant_token,
+                                  "--tenant-token").items()}
     config = FrontendConfig(
         host=host, port=port,
         max_line_bytes=args.max_line_bytes,
@@ -481,15 +762,21 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
             budget_s=args.admission_budget_ms * 1e-3,
             resume_fraction=args.resume_fraction,
             client_budget_s=(args.client_budget_ms * 1e-3
-                             if args.client_budget_ms else None)),
+                             if args.client_budget_ms else None),
+            tenant_budget_s=(args.tenant_budget_ms * 1e-3
+                             if args.tenant_budget_ms else None)),
         batcher_deadline_s=args.deadline_us * 1e-6,
         dispatch_window=(args.dispatch_window or None),
         predict_mean=args.predict_mean,
         max_connections=(args.max_connections or None),
-        auth_token=_auth_token(args))
+        auth_token=_auth_token(args),
+        tenant_tokens=tenant_tokens or None,
+        trace_sample_n=args.trace_sample,
+        canary_defaults=_canary_defaults(args))
 
     async def _main() -> int:
-        front = FrontendServer(engine, swapper, config)
+        front = FrontendServer(engine, swapper, config, fleet=fleet,
+                               health=health)
         await front.start()
         if watchdog is not None:
             # the edge batcher exists only after start(): watch it too
@@ -685,11 +972,42 @@ def run(argv: List[str]) -> int:
     if client is not None:
         watchdog.register("subscriber", client.worker_thread)
 
+    fleet = None
+    if args.add_model:
+        from photon_ml_tpu.serving.fleet import FleetError, ModelFleet
+
+        try:
+            quotas = {t: int(v) for t, v in
+                      _parse_pairs(args.tenant_quota,
+                                   "--tenant-quota").items()}
+            fleet = ModelFleet(metrics=engine.metrics,
+                               total_rows=(args.fleet_budget or None),
+                               quotas=quotas)
+            # the primary engine's warmed kernel cache becomes the fleet
+            # cache; every added model's engine is built on it
+            fleet.adopt(args.model_name, engine, swapper)
+            for spec in args.add_model:
+                name, path, tenant = _parse_add_model(spec)
+                fleet.register_dir(name, path, tenant=tenant,
+                                   config=engine.store.config)
+                logger.info("fleet: registered model %r from %s "
+                            "(tenant %r)", name, path, tenant)
+        except (FleetError, ModelLoadError, ValueError) as e:
+            logger.error("--add-model: %s", e)
+            if follower is not None:
+                follower.stop()
+            if client is not None:
+                client.stop()
+            return 1
+        logger.info("fleet: %d model(s), %d shared executable(s), "
+                    "%d compile(s)", len(fleet), len(fleet.kernels),
+                    fleet.kernels.compile_count)
+
     metrics_sidecar = None
     try:
         if args.listen:
             rc = _run_network(engine, swapper, args, health=health,
-                              watchdog=watchdog)
+                              watchdog=watchdog, fleet=fleet)
         else:
             if args.metrics_port:
                 from photon_ml_tpu.serving.frontend.metrics_http import \
@@ -707,7 +1025,10 @@ def run(argv: List[str]) -> int:
                                    args.predict_mean,
                                    deadline_s=args.deadline_us * 1e-6,
                                    sync=args.sync_batcher,
-                                   max_line_bytes=args.max_line_bytes)
+                                   max_line_bytes=args.max_line_bytes,
+                                   fleet=fleet, health=health,
+                                   canary_defaults=_canary_defaults(args),
+                                   trace_sample_n=args.trace_sample)
             finally:
                 if lines is not sys.stdin:
                     lines.close()

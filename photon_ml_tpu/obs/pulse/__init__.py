@@ -27,8 +27,9 @@ installs the clock-offset export hook.
 from photon_ml_tpu.obs.pulse import clock  # noqa: F401
 from photon_ml_tpu.obs.pulse.context import (TraceContext,  # noqa: F401
                                              bind, current, delta_ctx,
-                                             forwarded, from_wire, mint,
-                                             note_delta, to_wire)
+                                             forwarded, from_wire,
+                                             maybe_mint, mint, note_delta,
+                                             reset_sampling, to_wire)
 from photon_ml_tpu.obs.pulse.flight import (FlightRecorder,  # noqa: F401
                                             flight_dump, get_flight,
                                             set_flight)

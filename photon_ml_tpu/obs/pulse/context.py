@@ -55,6 +55,35 @@ def mint() -> TraceContext:
     return (os.urandom(8).hex(), os.urandom(4).hex())
 
 
+_sample_lock = threading.Lock()
+_sample_counter = 0
+
+
+def maybe_mint(sample_n: int) -> Optional[TraceContext]:
+    """Sampled always-on minting: every ``sample_n``-th call mints, the rest
+    return None.  The 1-in-N gate is a deterministic shared counter — not
+    RNG — so a steady request stream yields an evenly spaced trace sample
+    and tests can predict exactly which requests carry context.  The edge
+    (frontend admission) calls this when tracing is enabled but the client
+    sent no ``"tp"``, so production flight dumps always hold *some* traced
+    requests without the cost of tracing every one.  ``sample_n <= 0``
+    disables sampling; ``sample_n == 1`` mints for every request."""
+    if sample_n <= 0:
+        return None
+    global _sample_counter
+    with _sample_lock:
+        _sample_counter += 1
+        hit = _sample_counter % sample_n == 0
+    return mint() if hit else None
+
+
+def reset_sampling() -> None:
+    """Tests: restart the 1-in-N counter so sampling is phase-deterministic."""
+    global _sample_counter
+    with _sample_lock:
+        _sample_counter = 0
+
+
 def current() -> Optional[TraceContext]:
     """This thread's bound context, or None."""
     ctx = _trace.current_context()

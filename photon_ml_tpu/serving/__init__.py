@@ -27,7 +27,11 @@ package is that serving layer, TPU-native:
     control (load shedding + hysteresis), per-client round-robin fairness,
     graceful drain on swap/SIGTERM, a ``/metrics`` scrape endpoint, and
     the open-loop Poisson load generator behind
-    ``bench.py --serving --open-loop``.
+    ``bench.py --serving --open-loop``;
+  - ``fleet``: multi-model serving — a keyed family of model handles
+    sharing one AOT kernel cache and one device hot-row budget with
+    per-tenant quotas, plus canary rollout (deterministic traffic split,
+    auto-promote/auto-rollback) and shadow scoring.
 
 ``cli/serve.py`` wires these into a stdin/JSON-lines driver (or, with
 ``--listen``, the socket front end) and a programmatic ``build_server``
@@ -40,6 +44,11 @@ from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,  # noq
 from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,  # noqa: F401
                                                      HotSetManager,
                                                      StoreConfig)
-from photon_ml_tpu.serving.engine import ScoringEngine  # noqa: F401
+from photon_ml_tpu.serving.engine import KernelCache, ScoringEngine  # noqa: F401
+from photon_ml_tpu.serving.fleet import (CanaryController,  # noqa: F401
+                                         CanaryPolicy, ModelFleet,
+                                         ModelHandle, ShadowScorer,
+                                         TenantBudgetError,
+                                         UnknownModelError)
 from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from photon_ml_tpu.serving.swap import HotSwapper  # noqa: F401

@@ -67,7 +67,9 @@ class Request:
     margin, never part of the model score.  ``ctx``: optional photonpulse
     trace context — minted at the frontend edge or adopted from the wire
     ``"tp"`` field — carried with the request into the batcher so the
-    flush that scores it joins the same cross-process trace.
+    flush that scores it joins the same cross-process trace.  ``model``:
+    optional fleet model id (wire ``"model"`` field); ``None`` routes to
+    the default model, which is what every pre-fleet client sends.
     """
 
     uid: object = None
@@ -75,6 +77,7 @@ class Request:
     ids: Dict[str, str] = dataclasses.field(default_factory=dict)
     offset: float = 0.0
     ctx: Optional[Tuple[str, str]] = None
+    model: Optional[str] = None
 
 
 def request_from_json(obj: dict) -> Request:
@@ -98,8 +101,14 @@ def request_from_json(obj: dict) -> Request:
     tp = obj.get("tp")
     if tp is not None and obs_enabled():
         ctx = ctx_from_wire(tp)
+    # optional fleet model id; absent -> None -> the default model, so
+    # pre-fleet clients keep working unchanged
+    model = obj.get("model")
+    if model is not None:
+        model = str(model)
     return Request(uid=obj.get("uid"), features=feats, ids=ids,
-                   offset=float(obj.get("offset") or 0.0), ctx=ctx)
+                   offset=float(obj.get("offset") or 0.0), ctx=ctx,
+                   model=model)
 
 
 def densify_features(requests: Sequence[Request], index_maps: Dict[str, IndexMap],

@@ -129,7 +129,14 @@ class StoreConfig:
     chip's HBM share — so aggregate hot capacity scales with the mesh
     (``mesh_shards * device_capacity`` rows per coordinate), which is the
     entire point of pod-slice serving.  A 1-shard mesh serves bitwise the
-    unsharded scores.  ``hot_max_moves`` applies per shard per pass."""
+    unsharded scores.  ``hot_max_moves`` applies per shard per pass.
+    ``fleet_axis``: the MODEL axis of the executable-cache key
+    (serving/fleet).  Every store on the default axis (``""``) with equal
+    shapes shares AOT executables — N same-shape tenant models on one
+    ``KernelCache`` compile once; distinct-shape models coexist because the
+    shapes themselves are in the signature.  A tenant that must not share
+    compiled programs (e.g. a private donation/layout policy) registers
+    under its own axis value, which forces coexistence without sharing."""
 
     device_capacity: Optional[int] = None
     lru_capacity: int = 4096
@@ -138,6 +145,7 @@ class StoreConfig:
     hot_tracked_max: Optional[int] = None
     x_dtype: np.dtype = np.float32
     mesh_shards: int = 0
+    fleet_axis: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -853,7 +861,10 @@ class CoefficientStore:
         model versions with an equal signature share AOT executables, which
         is what makes same-shape hot swaps recompile-free.  Rebalance and
         streaming deltas never change a shape, so a generation's signature
-        is stable for its whole life."""
+        is stable for its whole life.  ``fleet_axis`` is the model axis:
+        same-shape models on the same axis share executables across a
+        multi-model ``KernelCache``; a non-default axis forces a private
+        compiled family without perturbing any shape."""
         parts = []
         for cid in self.order:
             c = self.coordinates[cid]
@@ -869,7 +880,8 @@ class CoefficientStore:
                               c.table.shape, str(c.table.dtype)))
         return (tuple(parts), tuple(sorted(self.shard_dims.items())),
                 str(np.dtype(self.config.x_dtype)),
-                int(self.config.mesh_shards))
+                int(self.config.mesh_shards),
+                str(self.config.fleet_axis))
 
     # -- lookups -----------------------------------------------------------
     def entity_id(self, re_type: str, name: Optional[str]) -> int:

@@ -37,6 +37,7 @@ import numpy as np
 
 from photon_ml_tpu.game.scoring import additive_total, output_scores
 from photon_ml_tpu.obs import get_probe
+from photon_ml_tpu.obs.trace import enabled as obs_enabled
 from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.parallel.bucketing import score_samples
 from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,
@@ -57,18 +58,81 @@ def _cold_margin(x: Array, overflow: Array) -> Array:
     return jnp.einsum("nd,nd->n", x, overflow)
 
 
+class KernelCache:
+    """Shared AOT-executable cache: ``(store.signature(), bucket)`` -> exe.
+
+    One engine owns a private cache by default; a ``serving.fleet.ModelFleet``
+    hands ONE cache to every per-model engine so same-signature models share
+    compiled executables outright and distinct-shape models coexist side by
+    side — the compiled-program family stays fixed as tenancy grows.
+
+    Pruning is liveness-based rather than pairwise: each engine registers
+    its ACTIVE store's signature under its own identity (``note_live``), and
+    ``prune`` drops only keys no live store (plus explicitly kept retiring
+    signatures) can ever reach again.  A single-engine cache degenerates to
+    exactly the old keep-{old, new} behavior.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._executables: Dict[Tuple, object] = {}
+        self.compile_count = 0  # compiles performed into THIS cache
+        self._live: Dict[int, Tuple] = {}  # id(owner) -> active signature
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._executables)
+
+    def note_live(self, owner: object, signature: Tuple) -> None:
+        """Record ``owner``'s (an engine's) active-store signature — the
+        set of live signatures is what ``prune`` preserves."""
+        with self._lock:
+            self._live[id(owner)] = signature
+
+    def drop_owner(self, owner: object) -> None:
+        """Forget an engine that will never score again (fleet eviction)."""
+        with self._lock:
+            self._live.pop(id(owner), None)
+
+    def get(self, key: Tuple):
+        with self._lock:
+            return self._executables.get(key)
+
+    def put(self, key: Tuple, exe: object) -> None:
+        with self._lock:
+            self._executables[key] = exe
+            self.compile_count += 1
+
+    def prune(self, keep_extra: Sequence[Tuple] = ()) -> None:
+        """Drop executables no live store can reach.  ``keep_extra`` holds
+        retiring signatures in-flight requests may still be scoring on."""
+        with self._lock:
+            keep = set(self._live.values()) | set(keep_extra)
+            self._executables = {k: v for k, v in self._executables.items()
+                                 if k[0] in keep}
+
+    def signatures(self) -> Tuple[Tuple, ...]:
+        """Distinct signatures currently cached (tests/introspection)."""
+        with self._lock:
+            return tuple({k[0] for k in self._executables})
+
+
 class ScoringEngine:
     """Low-latency scorer over a CoefficientStore (see module docstring)."""
 
     def __init__(self, store: CoefficientStore,
                  batcher: Optional[BucketedBatcher] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 kernels: Optional[KernelCache] = None):
         self._store = store
         self.batcher = batcher or BucketedBatcher()
         self.metrics = metrics or ServingMetrics()
         self._lock = threading.Lock()
-        self._executables: Dict[Tuple, object] = {}
-        self.compile_count = 0
+        # private by default; a ModelFleet passes one shared cache to every
+        # per-model engine so same-shape models never compile twice
+        self.kernels = kernels or KernelCache()
+        self.kernels.note_live(self, store.signature())
+        self.compile_count = 0  # compiles THIS engine performed
 
     # -- generation management (hot swap) ----------------------------------
     @property
@@ -81,11 +145,12 @@ class ScoringEngine:
         with obs_span("serve.activate", generation=store.generation):
             with self._lock:
                 old, self._store = self._store, store
-                # executables for generations other than (old, new) can never
-                # be reached again — drop them so repeated swaps stay bounded
-                keep = {old.signature(), store.signature()}
-                self._executables = {k: v for k, v in self._executables.items()
-                                     if k[0] in keep}
+            # executables no LIVE store (any engine on this cache) can
+            # reach again are dropped so repeated swaps stay bounded; the
+            # retiring signature is kept for in-flight requests that
+            # snapshotted the old store
+            self.kernels.note_live(self, store.signature())
+            self.kernels.prune(keep_extra=(old.signature(),))
             self.metrics.inc("activations")
         return old
 
@@ -246,7 +311,7 @@ class ScoringEngine:
 
     def _executable(self, store: CoefficientStore, bucket: int):
         key = (store.signature(), bucket)
-        exe = self._executables.get(key)
+        exe = self.kernels.get(key)
         if exe is not None:
             return exe
         fn = self._build_fn(store, bucket)
@@ -263,19 +328,24 @@ class ScoringEngine:
             jitted = jax.jit(fn, donate_argnums=donate)
             lowered = jitted.lower(*self._abstract_args(store, bucket))
             exe = lowered.compile()
+        self.kernels.put(key, exe)
         with self._lock:
-            self._executables[key] = exe
             self.compile_count += 1
         self.metrics.inc("compiles")
         return exe
 
     # -- scoring -----------------------------------------------------------
     def score_requests(self, requests: Sequence[Request],
-                       predict_mean: bool = False) -> np.ndarray:
+                       predict_mean: bool = False,
+                       store: Optional[CoefficientStore] = None) -> np.ndarray:
         """Score a request list; returns one score per request (raw margin +
         offset, or the task's inverse-link mean with ``predict_mean`` — the
-        same output contract as cli/score.py)."""
-        store = self._store  # snapshot: finish on one generation
+        same output contract as cli/score.py).  ``store`` overrides the
+        active generation for this call only — canary/shadow scoring
+        (serving/fleet) scores a staged store without flipping the
+        pointer; executables come from the same ``kernels`` cache."""
+        if store is None:
+            store = self._store  # snapshot: finish on one generation
         n = len(requests)
         self.metrics.inc("requests", n)
         if n == 0:
@@ -284,9 +354,20 @@ class ScoringEngine:
         for mb in self.batcher.plan(n):
             t0 = time.perf_counter()
             chunk = requests[mb.start:mb.stop]
+            attrs = {}
+            if obs_enabled():
+                # a chunk scores many requests: stamp every trace id it
+                # carries, so the execute (and mesh psum) spans join each
+                # request's cross-process timeline — same contract as the
+                # batcher's serve.flush span
+                tids = sorted({r.ctx[0] for r in chunk
+                               if r.ctx is not None})
+                if tids:
+                    attrs["traces"] = tids
             with obs_span("serve.execute", bucket=mb.bucket,
-                          rows=mb.real_rows):
-                scores = self._score_chunk(store, chunk, mb.bucket)
+                          rows=mb.real_rows, **attrs):
+                scores = self._score_chunk(store, chunk, mb.bucket,
+                                           trace_attrs=attrs)
             if out is None:
                 out = np.empty(n, scores.dtype)
             out[mb.start:mb.stop] = scores[: mb.real_rows]
@@ -296,7 +377,8 @@ class ScoringEngine:
         return output_scores(raw, store.task, predict_mean=predict_mean)
 
     def _score_chunk(self, store: CoefficientStore,
-                     chunk: Sequence[Request], bucket: int) -> np.ndarray:
+                     chunk: Sequence[Request], bucket: int,
+                     trace_attrs: Optional[dict] = None) -> np.ndarray:
         exe = self._executable(store, bucket)
         xs = densify_features(chunk, store.index_maps, bucket,
                               dtype=store.config.x_dtype)
@@ -323,9 +405,11 @@ class ScoringEngine:
                 slots.append(sl)
                 overflows.append(ov)
         if store.mesh is not None:
-            # the executable's only cross-shard traffic is the margin psum
+            # the executable's only cross-shard traffic is the margin psum;
+            # trace_attrs carries the chunk's trace ids so the pod-slice
+            # hop is attributable to the requests that crossed it
             with obs_span("serve.psum", shards=store.config.mesh_shards,
-                          bucket=bucket):
+                          bucket=bucket, **(trace_attrs or {})):
                 return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))
         return np.asarray(exe(xs, fixed_ws, tables, slots, overflows))
 

@@ -1,0 +1,29 @@
+"""photonfleet: multi-model serving on one engine substrate.
+
+One ``ModelFleet`` (registry.py) keys a family of model handles —
+model_id -> (CoefficientStore, HotSwapper, tenant) — that share ONE AOT
+``KernelCache`` (same-shape models share executables; distinct shapes
+coexist) and ONE device hot-row budget with per-tenant quotas.  On top of
+the handles: ``CanaryPolicy``/``CanaryController`` (policy.py) run
+deterministic-split canary rollouts with auto-promote/auto-rollback, and
+``ShadowScorer`` (shadow.py) scores a candidate against live traffic
+while serving the active generation.  Tenancy reaches the wire through
+the frontend (``Request.model``, per-tenant tokens and admission budgets)
+and the metrics through the labeled ``fleet_*`` families
+(``ServingMetrics.fleet_view``).
+"""
+
+from photon_ml_tpu.serving.fleet.policy import (CANARY,  # noqa: F401
+                                                IDLE, PROMOTED, ROLLED_BACK,
+                                                CanaryController,
+                                                CanaryPolicy, request_key,
+                                                split_preview, stable_bucket)
+from photon_ml_tpu.serving.fleet.registry import (DEFAULT_TENANT,  # noqa: F401
+                                                  FleetError, ModelFleet,
+                                                  ModelHandle,
+                                                  TenantBudgetError,
+                                                  UnknownModelError,
+                                                  store_device_rows)
+from photon_ml_tpu.serving.fleet.router import FleetRouter  # noqa: F401
+from photon_ml_tpu.serving.fleet.shadow import (ShadowScorer,  # noqa: F401
+                                                shadow_overhead_ratio)

@@ -1,0 +1,332 @@
+"""ModelFleet: a keyed family of model handles on one serving substrate.
+
+Photon ML reference counterpart: none — the reference trains and scores one
+GAME model per driver run.  LinkedIn's production stack in front of it is
+multi-tenant: per-vertical GLMix families, A/B variants, canary
+generations, all resident at once.  This module is that layer for the
+online engine built in PRs 4-15, under two resource rules the papers in
+PAPERS.md argue for:
+
+  **One AOT kernel cache** (Flare: the compiled-program family must stay
+  fixed as tenancy grows).  Every per-model ``ScoringEngine`` is
+  constructed on the fleet's shared ``KernelCache``; the cache key is
+  ``(store.signature(), bucket)`` and ``signature()`` carries the model
+  axis (``StoreConfig.fleet_axis``), so same-shape models SHARE executables
+  outright — registering model N of an equal shape compiles nothing — and
+  distinct-shape models coexist side by side without evicting each other
+  (`KernelCache` pruning is liveness-based across all registered engines).
+
+  **One device hot-row budget** (Snap ML: the fastest memory tier is a
+  shared, explicitly-budgeted resource).  ``total_rows`` bounds the
+  fleet-wide device-resident row count and per-tenant ``quotas`` carve it
+  up; registration refuses a model that would push its tenant over quota
+  (``TenantBudgetError``) and ``rebalance()`` re-verifies the invariant
+  and exports per-tenant used/quota gauges every pass.
+
+A handle is ``model_id -> (ScoringEngine, HotSwapper, tenant)``; the
+swapper keeps per-model generation identity ``(generation,
+delta_version)`` exactly as in single-model serving, so hot swap, deltas,
+canary (policy.py) and shadow (shadow.py) all operate per model while the
+executables and the row budget stay fleet-global.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from photon_ml_tpu.serving.batcher import BucketedBatcher
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     CompactRandomCoordinate,
+                                                     FixedCoordinate,
+                                                     StoreConfig)
+from photon_ml_tpu.serving.engine import KernelCache, ScoringEngine
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.swap import HotSwapper
+
+DEFAULT_TENANT = "default"
+
+
+class FleetError(ValueError):
+    """Base for fleet registration/routing failures."""
+
+
+class UnknownModelError(FleetError):
+    """A request named a model_id no handle serves."""
+
+
+class TenantBudgetError(FleetError):
+    """Registering the model would push its tenant over its row quota."""
+
+
+def store_device_rows(store: CoefficientStore) -> int:
+    """Device-resident hot rows a store pins (per mesh shard): the sum of
+    every non-fixed coordinate's device-table row count.  Fixed-effect
+    weights are dense model state, not budgeted hot rows."""
+    rows = 0
+    for cid in store.order:
+        c = store.coordinates[cid]
+        if isinstance(c, FixedCoordinate):
+            continue
+        if isinstance(c, CompactRandomCoordinate):
+            rows += int(c.hot.indices.shape[0])
+        else:
+            rows += int(c.table.shape[0])
+    return rows
+
+
+@dataclasses.dataclass
+class ModelHandle:
+    """One registered model: engine + swapper + tenant identity."""
+
+    model_id: str
+    tenant: str
+    engine: ScoringEngine
+    swapper: HotSwapper
+
+    @property
+    def store(self) -> CoefficientStore:
+        return self.engine.store
+
+    @property
+    def identity(self) -> Tuple[int, int]:
+        return self.swapper.identity
+
+    @property
+    def device_rows(self) -> int:
+        return store_device_rows(self.engine.store)
+
+
+class ModelFleet:
+    """Keyed model handles sharing one kernel cache and one row budget.
+
+    ``total_rows`` (None = unbudgeted) caps the fleet-wide device hot-row
+    count; ``quotas`` maps tenant -> row quota (a tenant without an entry
+    draws from the unreserved remainder of ``total_rows``).  All handles
+    share ONE ``ServingMetrics`` so the snapshot stays the familiar
+    single-engine aggregate; per-model/per-tenant detail rides the labeled
+    ``fleet_*`` families (``ServingMetrics.fleet_view``).
+    """
+
+    def __init__(self, metrics: Optional[ServingMetrics] = None,
+                 kernels: Optional[KernelCache] = None,
+                 total_rows: Optional[int] = None,
+                 quotas: Optional[Dict[str, int]] = None):
+        self.metrics = metrics or ServingMetrics()
+        self.kernels = kernels or KernelCache()
+        self.total_rows = total_rows
+        self.quotas: Dict[str, int] = dict(quotas or {})
+        self._lock = threading.Lock()
+        self._handles: Dict[str, ModelHandle] = {}
+        self._default: Optional[str] = None
+        self._batcher: Optional[BucketedBatcher] = None
+
+    # -- registration ------------------------------------------------------
+    @property
+    def default_model(self) -> Optional[str]:
+        return self._default
+
+    def models(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(self._handles)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._handles)
+
+    def tenant_rows(self, tenant: str) -> int:
+        """Device hot rows currently allocated to one tenant's models."""
+        with self._lock:
+            return sum(h.device_rows for h in self._handles.values()
+                       if h.tenant == tenant)
+
+    def quota_remaining(self, tenant: str) -> Optional[int]:
+        """Rows the tenant may still allocate (None = unbudgeted).  A
+        tenant without its own quota draws from what ``total_rows`` leaves
+        after every reserved quota."""
+        quota = self.quotas.get(tenant)
+        if quota is None:
+            if self.total_rows is None:
+                return None
+            reserved = sum(self.quotas.values())
+            with self._lock:
+                used = sum(h.device_rows for h in self._handles.values()
+                           if h.tenant not in self.quotas)
+            return max(self.total_rows - reserved - used, 0)
+        return max(quota - self.tenant_rows(tenant), 0)
+
+    def _check_budget(self, tenant: str, rows: int, model_id: str) -> None:
+        remaining = self.quota_remaining(tenant)
+        if remaining is not None and rows > remaining:
+            raise TenantBudgetError(
+                f"model {model_id!r} needs {rows} device rows but tenant "
+                f"{tenant!r} has {remaining} left (quota "
+                f"{self.quotas.get(tenant, self.total_rows)})")
+        if self.total_rows is not None:
+            with self._lock:
+                used = sum(h.device_rows for h in self._handles.values())
+            if used + rows > self.total_rows:
+                raise TenantBudgetError(
+                    f"model {model_id!r} needs {rows} device rows but the "
+                    f"fleet has {self.total_rows - used} of {self.total_rows}"
+                    " left")
+
+    def adopt(self, model_id: str, engine: ScoringEngine,
+              swapper: HotSwapper, tenant: str = DEFAULT_TENANT,
+              default: bool = True) -> ModelHandle:
+        """Bring an ALREADY-BUILT engine (cli/serve.py ``build_server``)
+        into the fleet.  The first adopted engine's kernel cache becomes
+        the fleet cache — its warmed executables are the family every
+        later same-shape registration reuses; later adoptions must have
+        been constructed on ``fleet.kernels``."""
+        with self._lock:
+            if not self._handles:
+                self.kernels = engine.kernels
+        if engine.kernels is not self.kernels:
+            raise FleetError(
+                f"model {model_id!r}: engine was built on a private kernel "
+                "cache; construct it with kernels=fleet.kernels")
+        self._check_budget(tenant, store_device_rows(engine.store), model_id)
+        handle = ModelHandle(model_id=model_id, tenant=tenant,
+                             engine=engine, swapper=swapper)
+        with self._lock:
+            if model_id in self._handles:
+                raise FleetError(f"model {model_id!r} already registered")
+            self._handles[model_id] = handle
+            if default or self._default is None:
+                self._default = model_id
+            if self._batcher is None:
+                # the fleet's bucket ladder: later registrations default to
+                # the first engine's, so same-shape models plan identical
+                # buckets and hit identical executables
+                self._batcher = engine.batcher
+        self._export_tenant_gauges()
+        return handle
+
+    def register_store(self, model_id: str, store: CoefficientStore,
+                       tenant: str = DEFAULT_TENANT,
+                       batcher: Optional[BucketedBatcher] = None,
+                       warm: bool = True,
+                       default: bool = False) -> ModelHandle:
+        """Register an in-memory store as a new model: builds its engine on
+        the SHARED kernel cache (+ shared metrics), warms the bucket ladder
+        (free when an equal-signature model already warmed it), and wires a
+        per-model HotSwapper."""
+        self._check_budget(tenant, store_device_rows(store), model_id)
+        engine = ScoringEngine(store, batcher=batcher or self._batcher,
+                               metrics=self.metrics, kernels=self.kernels)
+        if warm:
+            engine.warm()
+        swapper = HotSwapper(engine)
+        return self.adopt(model_id, engine, swapper, tenant=tenant,
+                          default=default)
+
+    def register_dir(self, model_id: str, model_dir: str,
+                     tenant: str = DEFAULT_TENANT,
+                     config: Optional[StoreConfig] = None,
+                     batcher: Optional[BucketedBatcher] = None,
+                     version: str = "",
+                     default: bool = False) -> ModelHandle:
+        """Register a model directory (the cli ``--add-model`` path):
+        load bundle -> store -> ``register_store``."""
+        from photon_ml_tpu.storage.model_io import load_model_bundle
+        bundle = load_model_bundle(model_dir)
+        store = CoefficientStore.from_bundle(
+            bundle, config=config or StoreConfig(),
+            version=version or model_dir, metrics=self.metrics)
+        handle = self.register_store(model_id, store, tenant=tenant,
+                                     batcher=batcher, default=default)
+        handle.swapper.set_base(model_dir)
+        return handle
+
+    def remove(self, model_id: str) -> None:
+        """Evict a model: its engine stops pinning signatures in the shared
+        cache and executables only it could reach are dropped."""
+        with self._lock:
+            handle = self._handles.pop(model_id, None)
+            if handle is None:
+                raise UnknownModelError(f"unknown model {model_id!r}")
+            if self._default == model_id:
+                self._default = next(iter(self._handles), None)
+        self.kernels.drop_owner(handle.engine)
+        self.kernels.prune()
+        self._export_tenant_gauges()
+
+    # -- routing -----------------------------------------------------------
+    def resolve(self, model_id: Optional[str]) -> ModelHandle:
+        """Request routing: ``None`` (the pre-fleet wire form) routes to
+        the default model; an unknown id raises ``UnknownModelError``."""
+        with self._lock:
+            mid = model_id if model_id is not None else self._default
+            handle = self._handles.get(mid) if mid is not None else None
+        if handle is None:
+            raise UnknownModelError(f"unknown model {model_id!r}")
+        return handle
+
+    def handle(self, model_id: str) -> ModelHandle:
+        with self._lock:
+            h = self._handles.get(model_id)
+        if h is None:
+            raise UnknownModelError(f"unknown model {model_id!r}")
+        return h
+
+    # -- maintenance -------------------------------------------------------
+    def _export_tenant_gauges(self) -> None:
+        with self._lock:
+            handles = list(self._handles.values())
+        used: Dict[str, int] = {}
+        for h in handles:
+            used[h.tenant] = used.get(h.tenant, 0) + h.device_rows
+        for tenant, rows in used.items():
+            quota = self.quotas.get(tenant, self.total_rows or 0)
+            self.metrics.set_tenant_rows(tenant, rows, quota)
+
+    def rebalance(self) -> Dict[str, Dict[str, Tuple[int, int]]]:
+        """One hot-set pass over EVERY model (model_id -> its per-cid
+        (promotions, demotions)), then re-verify the tenant row invariant
+        and export per-tenant used/quota gauges.  Rebalance moves rows
+        within each store's fixed device tables, so a quota violation here
+        means registration-time accounting was bypassed — fail loudly."""
+        with self._lock:
+            handles = dict(self._handles)
+        moves = {mid: h.store.rebalance() for mid, h in handles.items()}
+        for tenant in {h.tenant for h in handles.values()}:
+            quota = self.quotas.get(tenant)
+            if quota is not None and self.tenant_rows(tenant) > quota:
+                raise TenantBudgetError(
+                    f"tenant {tenant!r} holds {self.tenant_rows(tenant)} "
+                    f"device rows over quota {quota}")
+        self._export_tenant_gauges()
+        return moves
+
+    def status(self) -> dict:
+        """Introspection for the ``fleet`` command / tests."""
+        with self._lock:
+            handles = dict(self._handles)
+            default = self._default
+        return {
+            "default": default,
+            "models": {
+                mid: {
+                    "tenant": h.tenant,
+                    "generation": h.store.generation,
+                    "delta_version": h.swapper.delta_version,
+                    "version": h.store.version,
+                    "device_rows": h.device_rows,
+                    "compiles": h.engine.compile_count,
+                }
+                for mid, h in handles.items()
+            },
+            "kernels": {
+                "executables": len(self.kernels),
+                "signatures": len(self.kernels.signatures()),
+                "compiles": self.kernels.compile_count,
+            },
+            "budget": {
+                "total_rows": self.total_rows,
+                "quotas": dict(self.quotas),
+                "used": {t: self.tenant_rows(t)
+                         for t in {h.tenant for h in handles.values()}},
+            },
+        }

@@ -1,0 +1,112 @@
+"""FleetRouter: the score-path indirection canary and shadow hook into.
+
+Both serving edges (the stdio loop in ``cli/serve.py`` and the asyncio
+frontend) score through per-model ``AsyncBatcher``s whose score function
+is ``router.score(model_id, requests)`` — one seam where a running canary
+episode (policy.py) or an attached shadow scorer (shadow.py) interposes
+on ONE model's traffic while every other model scores straight through
+its engine.  The router owns the per-model canary/shadow registries so
+control commands (``{"cmd": "canary"}`` / ``promote`` / ``rollback`` /
+``shadow``) and the score path agree on what is active.
+
+Threading: ``score`` runs on the model's batcher worker; control methods
+run on the edge's command path AFTER a drain barrier (the same quiesce
+rule as hot swap), so an episode never starts or force-settles with that
+model's requests in flight — which is also what makes "zero admitted
+request loss across rollback" a structural property rather than a race.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.chaos.health import HealthState
+from photon_ml_tpu.serving.batcher import Request
+from photon_ml_tpu.serving.coefficient_store import CoefficientStore
+from photon_ml_tpu.serving.fleet.policy import (CANARY, CanaryController,
+                                                CanaryPolicy)
+from photon_ml_tpu.serving.fleet.registry import ModelFleet
+from photon_ml_tpu.serving.fleet.shadow import ShadowScorer
+
+
+class FleetRouter:
+    """Canary/shadow-aware per-model scoring over a ModelFleet."""
+
+    def __init__(self, fleet: ModelFleet,
+                 health: Optional[HealthState] = None):
+        self.fleet = fleet
+        self.health = health
+        self.canaries: Dict[str, CanaryController] = {}
+        self.shadows: Dict[str, ShadowScorer] = {}
+
+    # -- the scoring seam --------------------------------------------------
+    def score(self, model_id: str, requests: Sequence[Request],
+              predict_mean: bool = False) -> np.ndarray:
+        """Score one model's batch through whatever policy is active on
+        it: a RUNNING canary episode splits the batch, an attached shadow
+        dual-scores it, plain models go straight to the engine."""
+        handle = self.fleet.handle(model_id)
+        ctl = self.canaries.get(model_id)
+        if ctl is not None and ctl.state == CANARY:
+            return ctl.score(requests, predict_mean=predict_mean)
+        shadow = self.shadows.get(model_id)
+        if shadow is not None:
+            return shadow.score(requests, predict_mean=predict_mean)
+        return handle.engine.score_requests(requests,
+                                            predict_mean=predict_mean)
+
+    # -- canary control ----------------------------------------------------
+    def start_canary(self, model_id: str, candidate: CoefficientStore,
+                     policy: Optional[CanaryPolicy] = None,
+                     model_dir: Optional[str] = None) -> CanaryController:
+        ctl = CanaryController(self.fleet.handle(model_id), policy,
+                               health=self.health)
+        ctl.start(candidate, model_dir=model_dir)
+        self.canaries[model_id] = ctl
+        return ctl
+
+    def canary(self, model_id: str) -> Optional[CanaryController]:
+        return self.canaries.get(model_id)
+
+    def promote(self, model_id: str) -> CanaryController:
+        """Operator-forced promote (still via the swap lock + chaos
+        seam; an injected fault still becomes a rollback)."""
+        ctl = self._require_canary(model_id)
+        if ctl.state == CANARY:
+            ctl.promote()
+        return ctl
+
+    def rollback(self, model_id: str,
+                 reason: str = "operator") -> CanaryController:
+        ctl = self._require_canary(model_id)
+        if ctl.state == CANARY:
+            ctl.rollback(reason)
+        return ctl
+
+    def _require_canary(self, model_id: str) -> CanaryController:
+        ctl = self.canaries.get(model_id)
+        if ctl is None:
+            raise ValueError(f"no canary episode on model {model_id!r}")
+        return ctl
+
+    # -- shadow control ----------------------------------------------------
+    def attach_shadow(self, model_id: str,
+                      shadow: CoefficientStore) -> ShadowScorer:
+        scorer = ShadowScorer(self.fleet.handle(model_id), shadow)
+        self.shadows[model_id] = scorer
+        return scorer
+
+    def detach_shadow(self, model_id: str) -> bool:
+        return self.shadows.pop(model_id, None) is not None
+
+    # -- introspection -----------------------------------------------------
+    def status(self) -> dict:
+        """Fleet status + per-model policy state (the ``fleet`` cmd)."""
+        out = self.fleet.status()
+        out["canary"] = {mid: ctl.status()
+                        for mid, ctl in self.canaries.items()}
+        out["shadow"] = {mid: sh.drift_view()
+                        for mid, sh in self.shadows.items()}
+        return out

@@ -37,6 +37,20 @@ deadline budget — so a well-behaved client backs off instead of hammering.
   client drives the GLOBAL estimate over budget and the edge latches shut
   for everyone.  Each client's latch carries the same two-watermark
   hysteresis; ``forget_client`` drops the latch when a connection closes.
+
+  **Per-tenant budgets** (``tenant_budget_s``, off by default) generalize
+  the per-client machinery one level up: a tenant is a *set* of
+  connections serving one model family (serving/fleet), and its latch is
+  checked against the wait attributable to that tenant's aggregate
+  backlog.  Shed reason ``tenant_overload``; same hysteresis.  Unlike
+  clients, tenant latches persist across connection churn — tenants are
+  configured, not discovered — so there is no ``forget_tenant`` on close.
+
+  **Readiness shedding** is the one check that is not a deadline: when the
+  frontend's HealthState reports not-ready (``/readyz`` false), requests
+  are refused up front with reason ``not_ready``.  The check lives in the
+  frontend (it owns the HealthState); admission just names the reason so
+  the shed metric and wire replies stay one vocabulary.
 """
 
 from __future__ import annotations
@@ -52,6 +66,8 @@ SHED_OVERLOAD = "overload"
 SHED_DRAINING = "draining"
 SHED_SHUTDOWN = "shutdown"
 SHED_CLIENT = "client_overload"
+SHED_TENANT = "tenant_overload"
+SHED_NOT_READY = "not_ready"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,12 +83,17 @@ class AdmissionConfig:
     client's OWN backlog wait (None = per-client budgets off; module
     docstring).  Usually set below ``budget_s`` so a burning client sheds
     before the whole edge latches.
+    ``tenant_budget_s``: per-tenant deadline checked against the tenant's
+    aggregate backlog wait (None = per-tenant budgets off) — one tenant's
+    burst sheds under ``tenant_overload`` while other tenants' models keep
+    admitting.
     """
 
     budget_s: float = 0.050
     resume_fraction: float = 0.5
     retry_after_ms: float = 0.0  # 0 -> derive from the budget
     client_budget_s: Optional[float] = None
+    tenant_budget_s: Optional[float] = None
 
     def __post_init__(self):
         if self.budget_s <= 0:
@@ -83,6 +104,9 @@ class AdmissionConfig:
         if self.client_budget_s is not None and self.client_budget_s <= 0:
             raise ValueError("client_budget_s must be > 0, got "
                              f"{self.client_budget_s}")
+        if self.tenant_budget_s is not None and self.tenant_budget_s <= 0:
+            raise ValueError("tenant_budget_s must be > 0, got "
+                             f"{self.tenant_budget_s}")
 
 
 @dataclasses.dataclass
@@ -109,6 +133,7 @@ class AdmissionController:
         self._registry = registry
         self._shedding = False
         self._client_shedding: Dict[str, bool] = {}  # latched clients only
+        self._tenant_shedding: Dict[str, bool] = {}  # latched tenants only
 
     @property
     def shedding(self) -> bool:
@@ -116,6 +141,9 @@ class AdmissionController:
 
     def client_shedding(self, client: str) -> bool:
         return self._client_shedding.get(client, False)
+
+    def tenant_shedding(self, tenant: str) -> bool:
+        return self._tenant_shedding.get(tenant, False)
 
     def _set_shedding(self, value: bool) -> None:
         if value != self._shedding:
@@ -137,6 +165,15 @@ class AdmissionController:
             self._registry.set_gauge("front_client_shedding", int(value),
                                      client=client)
 
+    def _set_tenant_shedding(self, tenant: str, value: bool) -> None:
+        if value:
+            self._tenant_shedding[tenant] = True
+        else:
+            self._tenant_shedding.pop(tenant, None)
+        if self._registry is not None:
+            self._registry.set_gauge("front_tenant_shedding", int(value),
+                                     tenant=tenant)
+
     def forget_client(self, client: str) -> None:
         """Drop a closed connection's latch (and its gauge series)."""
         if client in self._client_shedding:
@@ -157,11 +194,13 @@ class AdmissionController:
 
     def decide(self, predicted_wait_s: float,
                client: Optional[str] = None,
-               client_wait_s: float = 0.0) -> Verdict:
+               client_wait_s: float = 0.0,
+               tenant: Optional[str] = None,
+               tenant_wait_s: float = 0.0) -> Verdict:
         """One admission decision for a request arriving now, given the
         backlog predictor's estimate of its time-to-resolution and (with
-        per-client budgets on) the wait attributable to the requesting
-        client's own backlog."""
+        per-client/per-tenant budgets on) the wait attributable to the
+        requesting client's and tenant's own backlogs."""
         c = self.config
         if c.client_budget_s is not None and client is not None:
             # the narrow check first: a client burning its own budget is
@@ -177,6 +216,21 @@ class AdmissionController:
                 self._set_client_shedding(client, True)
                 return Verdict(False, client_wait_s, SHED_CLIENT,
                                self._retry_ms(client_wait_s, budget))
+        if c.tenant_budget_s is not None and tenant is not None:
+            # one level wider than a client, still narrower than global: a
+            # tenant burst sheds under its own latch while other tenants'
+            # models keep admitting
+            budget = c.tenant_budget_s
+            if self._tenant_shedding.get(tenant, False):
+                if tenant_wait_s <= budget * c.resume_fraction:
+                    self._set_tenant_shedding(tenant, False)
+                else:
+                    return Verdict(False, tenant_wait_s, SHED_TENANT,
+                                   self._retry_ms(tenant_wait_s, budget))
+            elif tenant_wait_s > budget:
+                self._set_tenant_shedding(tenant, True)
+                return Verdict(False, tenant_wait_s, SHED_TENANT,
+                               self._retry_ms(tenant_wait_s, budget))
         if self._shedding:
             if predicted_wait_s <= c.budget_s * c.resume_fraction:
                 self._set_shedding(False)  # backlog drained: unlatch

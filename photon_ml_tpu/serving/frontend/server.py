@@ -70,6 +70,24 @@ in sequence, so fairness reorders work ACROSS clients, never within one.
 
 Wire protocol extension over stdio: ``{"cmd": "shutdown"}`` drains and
 stops the whole server (the socket analog of stdin EOF).
+
+Fleet mode (``fleet=ModelFleet(...)``): requests grow an optional
+``"model"`` field (absent -> the default model, so pre-fleet clients work
+unchanged) routed to per-model ``AsyncBatcher``s that score through a
+``FleetRouter`` — the seam canary episodes and shadow scorers interpose
+on.  Tenancy rides the same edge: tenant tokens
+(``FrontendConfig.tenant_tokens``) scope a connection to one tenant's
+models, per-tenant admission budgets (``AdmissionConfig.tenant_budget_s``)
+latch shed reason ``tenant_overload`` against the tenant's own
+admitted-unsettled backlog, and every admit is attributed to its
+``(model, tenant)`` pair in the labeled ``fleet_*`` metric families.
+Control commands gain ``fleet`` / ``canary`` / ``promote`` / ``rollback``
+/ ``shadow`` plus an optional ``"model"`` field on ``swap`` / ``delta`` /
+``rebalance``; all policy transitions run behind the same quiesce barrier
+as hot swap, so zero admitted requests are lost across a rollback.  A
+wired ``HealthState`` adds /readyz-driven shedding (reason ``not_ready``),
+and ``trace_sample_n`` turns always-on tracing into deterministic 1-in-N
+sampling at the admission edge.
 """
 
 from __future__ import annotations
@@ -81,11 +99,12 @@ import json
 import logging
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.obs.pulse import clock as pulse_clock
 from photon_ml_tpu.obs.pulse.context import bind as ctx_bind
+from photon_ml_tpu.obs.pulse.context import maybe_mint as ctx_maybe_mint
 from photon_ml_tpu.obs.pulse.context import mint as ctx_mint
 from photon_ml_tpu.obs.pulse.flight import get_flight
 from photon_ml_tpu.obs.trace import enabled as obs_enabled
@@ -95,6 +114,7 @@ from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.serving.batcher import request_from_json
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.serving.frontend.admission import (SHED_DRAINING,
+                                                      SHED_NOT_READY,
                                                       SHED_SHUTDOWN,
                                                       AdmissionConfig,
                                                       AdmissionController)
@@ -138,13 +158,34 @@ class FrontendConfig:
     # frame, then close).  None = open listener.
     auth_token: Optional[str] = None
     auth_timeout_s: float = 10.0
+    # fleet tenancy: token -> tenant name.  A connection authenticating
+    # with a tenant token is SCOPED to that tenant's models (requests for
+    # another tenant's model get {"error": "forbidden"}); the global
+    # auth_token (when also set) stays tenant-unscoped.  Setting this
+    # turns the auth handshake on even without auth_token.
+    tenant_tokens: Optional[Dict[str, str]] = None
+    # sampled always-on tracing: when > 0 and the client sent no "tp",
+    # mint a context for every Nth admitted request (deterministic
+    # counter, photonpulse.maybe_mint) instead of every request — bounded
+    # trace volume, but production flight dumps still carry request
+    # context.  0 = mint for every request (the pre-fleet behavior).
+    trace_sample_n: int = 0
+    # /readyz-driven admission shedding: how often the HealthState (when
+    # one is wired) is re-polled on the request path.  readyz walks every
+    # check, so the throttle keeps it off the per-request cost.
+    health_poll_s: float = 0.25
+    # default CanaryPolicy knobs for {"cmd": "canary"} episodes (fields
+    # the command itself carries win): fraction / min_observations /
+    # max_drift
+    canary_defaults: Optional[Dict[str, float]] = None
 
 
 class _Conn:
     """Per-connection state: identity, streams, and the ordered reply
-    queue its writer task drains."""
+    queue its writer task drains.  ``tenant`` is set by a tenant-token
+    auth handshake (None = unscoped)."""
 
-    __slots__ = ("cid", "reader", "writer", "replies", "alive")
+    __slots__ = ("cid", "reader", "writer", "replies", "alive", "tenant")
 
     def __init__(self, cid: str, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter):
@@ -153,22 +194,29 @@ class _Conn:
         self.writer = writer
         self.replies: asyncio.Queue = asyncio.Queue()
         self.alive = True
+        self.tenant: Optional[str] = None
 
 
 class _Pending:
     """One admitted score request: reply future + settle-once accounting.
     ``t0_ns`` is the admission timestamp when tracing is on (None when
-    off): settle records the enclosing ``front.request`` span from it."""
+    off): settle records the enclosing ``front.request`` span from it.
+    ``batcher``/``tenant`` are the fleet routing resolved at admission
+    (None = the default single-engine batcher, untenanted)."""
 
-    __slots__ = ("conn", "req", "reply", "settled", "t0_ns")
+    __slots__ = ("conn", "req", "reply", "settled", "t0_ns", "batcher",
+                 "tenant")
 
     def __init__(self, conn: _Conn, req, reply: asyncio.Future,
-                 t0_ns: Optional[int] = None):
+                 t0_ns: Optional[int] = None, batcher=None,
+                 tenant: Optional[str] = None):
         self.conn = conn
         self.req = req
         self.reply = reply
         self.settled = False
         self.t0_ns = t0_ns
+        self.batcher = batcher
+        self.tenant = tenant
 
 
 class FrontendServer:
@@ -177,18 +225,35 @@ class FrontendServer:
     def __init__(self, engine: ScoringEngine,
                  swapper: Optional[HotSwapper] = None,
                  config: Optional[FrontendConfig] = None,
-                 registry=None):
+                 registry=None, fleet=None, health=None):
         self.engine = engine
         self.swapper = swapper or HotSwapper(engine)
         self.config = config or FrontendConfig()
         self._registry = registry if registry is not None \
             else engine.metrics.registry
-        self._batcher = engine.async_batcher(
-            deadline_s=self.config.batcher_deadline_s,
-            predict_mean=self.config.predict_mean,
-            flush_threshold=self.config.flush_threshold)
+        # fleet mode: requests carry an optional "model" field routed to
+        # per-model batchers; scoring goes through a FleetRouter so canary
+        # episodes and shadow scorers can interpose per model.  None keeps
+        # the single-engine edge byte-identical.
+        self.fleet = fleet
+        self.router = None
+        self.health = health
+        self._health_ok = True
+        self._health_checked: Optional[float] = None
+        if fleet is not None:
+            from photon_ml_tpu.serving.fleet.router import FleetRouter
+            self.router = FleetRouter(fleet, health=health)
+        self._batchers: Dict[str, object] = {}  # model_id -> AsyncBatcher
+        if self.router is not None and fleet.default_model is not None:
+            self._batcher = self._model_batcher(fleet.default_model)
+        else:
+            self._batcher = engine.async_batcher(
+                deadline_s=self.config.batcher_deadline_s,
+                predict_mean=self.config.predict_mean,
+                flush_threshold=self.config.flush_threshold)
         self._window = self.config.dispatch_window or \
             2 * self._batcher.flush_threshold
+        self._tenant_inflight: Dict[str, int] = {}
         self._queue = FairQueue()
         self._admission = AdmissionController(self.config.admission,
                                               registry=self._registry)
@@ -207,9 +272,49 @@ class FrontendServer:
 
     @property
     def batcher(self):
-        """The edge's AsyncBatcher — chaos.health wires a watchdog to
-        its worker thread."""
+        """The edge's (default-model) AsyncBatcher — chaos.health wires a
+        watchdog to its worker thread."""
         return self._batcher
+
+    def _model_batcher(self, model_id: str):
+        """Fleet mode: one AsyncBatcher per model, scoring through the
+        router so canary/shadow interpose.  Built on first use; every
+        batcher shares the fleet's one metrics registry."""
+        b = self._batchers.get(model_id)
+        if b is None:
+            from photon_ml_tpu.serving.batcher import AsyncBatcher
+            handle = self.fleet.handle(model_id)
+
+            def score(reqs, _mid=model_id):
+                return self.router.score(_mid, reqs,
+                                         predict_mean=self.config.predict_mean)
+
+            b = AsyncBatcher(
+                score,
+                flush_threshold=(self.config.flush_threshold
+                                 or handle.engine.batcher.max_batch),
+                deadline_s=self.config.batcher_deadline_s,
+                metrics=handle.engine.metrics)
+            self._batchers[model_id] = b
+        return b
+
+    def _all_batchers(self):
+        seen = {id(self._batcher): self._batcher}
+        for b in self._batchers.values():
+            seen[id(b)] = b
+        return list(seen.values())
+
+    def _health_ready(self) -> bool:
+        """Cached /readyz poll (throttled; config.health_poll_s).  No
+        HealthState wired -> always ready (the pre-chaos edge)."""
+        if self.health is None:
+            return True
+        now = time.monotonic()
+        if (self._health_checked is None
+                or now - self._health_checked >= self.config.health_poll_s):
+            self._health_ok = bool(self.health.readyz()[0])
+            self._health_checked = now
+        return self._health_ok
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "FrontendServer":
@@ -242,8 +347,9 @@ class FrontendServer:
             self._draining = True
             await self._drain()
         # batcher.shutdown joins its worker thread — off the loop
-        await self._loop.run_in_executor(
-            None, lambda: self._batcher.shutdown(drain=True))
+        for b in self._all_batchers():
+            await self._loop.run_in_executor(
+                None, lambda _b=b: _b.shutdown(drain=True))
         for conn in list(self._conns.values()):
             conn.replies.put_nowait(_CLOSE)
         if self._server is not None:
@@ -305,11 +411,26 @@ class FrontendServer:
             self._registry.set_gauge("front_connections", len(self._conns))
             self._registry.set_gauge("front_queue_depth", 0, client=cid)
 
+    def _match_token(self, token: str) -> Tuple[bool, Optional[str]]:
+        """(accepted, tenant): the global token admits unscoped; a tenant
+        token admits scoped to its tenant.  EVERY candidate is compared
+        (constant-time each) so which one matched is not timeable."""
+        ok, tenant = False, None
+        tok = token.encode("utf-8")
+        if self.config.auth_token is not None and hmac.compare_digest(
+                tok, self.config.auth_token.encode("utf-8")):
+            ok = True
+        for cand, t in (self.config.tenant_tokens or {}).items():
+            if hmac.compare_digest(tok, cand.encode("utf-8")) and not ok:
+                ok, tenant = True, t
+        return ok, tenant
+
     async def _authenticate(self, conn: _Conn,
                             lines: BoundedLineReader) -> bool:
         """First-line shared-secret handshake.  Anything but a good token
         — wrong secret, malformed line, oversize, timeout — costs exactly
-        one ``{"error": "unauthorized"}`` frame and the connection."""
+        one ``{"error": "unauthorized"}`` frame and the connection.  A
+        tenant token scopes the connection to that tenant's models."""
         try:
             raw = await asyncio.wait_for(lines.readline(),
                                          self.config.auth_timeout_s)
@@ -324,21 +445,25 @@ class FrontendServer:
             if isinstance(obj, dict) and obj.get("cmd") == "auth" and \
                     isinstance(obj.get("token"), str):
                 token = obj["token"]
-        if not hmac.compare_digest(token.encode("utf-8"),
-                                   self.config.auth_token.encode("utf-8")):
+        ok, tenant = self._match_token(token)
+        if not ok:
             self._registry.inc("front_auth_failures_total")
             obs_instant("front.auth_fail", client=conn.cid)
             logger.warning("photonfront: rejected unauthenticated "
                            "connection %s", conn.cid)
             self._reply_now(conn, error_reply("unauthorized"))
             return False
-        self._reply_now(conn, {"auth": "ok"})
+        conn.tenant = tenant
+        reply = {"auth": "ok"}
+        if tenant is not None:
+            reply["tenant"] = tenant
+        self._reply_now(conn, reply)
         return True
 
     async def _conn_reader(self, conn: _Conn) -> None:
         lines = BoundedLineReader(conn.reader.read,
                                   self.config.max_line_bytes)
-        if self.config.auth_token is not None:
+        if self.config.auth_token is not None or self.config.tenant_tokens:
             if not await self._authenticate(conn, lines):
                 return
         while True:
@@ -411,6 +536,25 @@ class FrontendServer:
         return fut
 
     # -- score-request path ------------------------------------------------
+    def _resolve_fleet(self, conn: _Conn, req):
+        """Fleet routing at admission: (handle, batcher, error_reply).
+        ``None`` model -> the default handle, so pre-fleet clients work
+        unchanged; an unknown model or a tenant-scope violation is an
+        explicit error reply, never a shed (it would never succeed on
+        retry)."""
+        from photon_ml_tpu.serving.fleet.registry import UnknownModelError
+        try:
+            handle = self.fleet.resolve(req.model)
+        except UnknownModelError:
+            self._registry.inc("fleet_unknown_model_total")
+            return None, None, error_reply("unknown_model", uid=req.uid,
+                                           model=req.model)
+        if conn.tenant is not None and handle.tenant != conn.tenant:
+            self._registry.inc("fleet_forbidden_total", tenant=conn.tenant)
+            return None, None, error_reply("forbidden", uid=req.uid,
+                                           model=handle.model_id)
+        return handle, self._model_batcher(handle.model_id), None
+
     def _handle_request(self, conn: _Conn, obj: dict) -> None:
         try:
             req = request_from_json(obj)
@@ -419,23 +563,48 @@ class FrontendServer:
             self._reply_now(conn, error_reply(str(e), uid=obj.get("uid")))
             return
         self._registry.inc("front_requests_total")
+        handle, batcher, tenant = None, self._batcher, None
+        if self.fleet is not None:
+            handle, batcher, err = self._resolve_fleet(conn, req)
+            if err is not None:
+                self._reply_now(conn, err)
+                return
+            tenant = handle.tenant
         if self._draining or self._closing:
             self._shed(conn, req,
                        SHED_SHUTDOWN if self._closing else SHED_DRAINING,
                        self.config.admission.budget_s)
             return
-        estimate = self._batcher.queue_wait_estimate(
-            extra=self._queue.depth())
+        if not self._health_ready():
+            # /readyz-driven shedding: a not-ready plane (stalled worker,
+            # stale catch-up, failed check) refuses work up front — the
+            # client retries against a sibling instead of queueing here
+            self._shed(conn, req, SHED_NOT_READY,
+                       self.config.admission.budget_s)
+            return
+        estimate = batcher.queue_wait_estimate(extra=self._queue.depth())
         if self.config.admission.client_budget_s is not None:
             # the wait THIS client's own backlog explains: its fair-queue
             # depth over the shared batcher residue (other clients' queued
             # work is excluded — round-robin keeps it from billing here)
-            client_wait = self._batcher.queue_wait_estimate(
+            client_wait = batcher.queue_wait_estimate(
                 extra=self._queue.depth_of(conn.cid))
-            verdict = self._admission.decide(estimate, client=conn.cid,
-                                             client_wait_s=client_wait)
         else:
-            verdict = self._admission.decide(estimate)
+            client_wait = 0.0
+        if (self.config.admission.tenant_budget_s is not None
+                and tenant is not None):
+            # the tenant's own backlog: its admitted-unsettled requests
+            # over the model batcher's residue
+            tenant_wait = batcher.queue_wait_estimate(
+                extra=self._tenant_inflight.get(tenant, 0))
+        else:
+            tenant_wait = 0.0
+        verdict = self._admission.decide(
+            estimate,
+            client=conn.cid if self.config.admission.client_budget_s
+            is not None else None,
+            client_wait_s=client_wait,
+            tenant=tenant, tenant_wait_s=tenant_wait)
         if not verdict.admitted:
             self._shed(conn, req, verdict.reason, verdict.predicted_wait_s,
                        verdict.retry_after_ms)
@@ -444,16 +613,27 @@ class FrontendServer:
         if obs_enabled():
             # the propagation edge: adopt the context the request carried
             # on the wire ("tp", already parsed — garbage degraded to
-            # None) or mint a fresh one here at admission
+            # None), or mint here at admission — every request, or every
+            # Nth with sampled tracing (trace_sample_n); an unsampled
+            # request proceeds untraced
             if req.ctx is None:
-                req.ctx = ctx_mint()
+                req.ctx = ctx_maybe_mint(self.config.trace_sample_n) \
+                    if self.config.trace_sample_n > 0 else ctx_mint()
             t0_ns = time.perf_counter_ns()
             with ctx_bind(req.ctx):
                 obs_instant("front.admit", uid=req.uid, client=conn.cid,
                             predicted_wait_us=int(estimate * 1e6))
+        if handle is not None:
+            # per-tenant metric labels end to end: the admit is attributed
+            # to its (model, tenant) pair
+            self.engine.metrics.observe_fleet_request(handle.model_id,
+                                                      tenant)
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
         self._inflight += 1
         self._idle.clear()
-        pending = _Pending(conn, req, self._reply_future(conn), t0_ns)
+        pending = _Pending(conn, req, self._reply_future(conn), t0_ns,
+                           batcher=batcher, tenant=tenant)
         self._queue.enqueue(conn.cid, pending)
         self._registry.set_gauge("front_queue_depth",
                                  self._queue.depth_of(conn.cid),
@@ -487,7 +667,7 @@ class FrontendServer:
         if pending.settled:
             return  # aborted while queued (connection died)
         try:
-            fut = self._batcher.submit(pending.req)
+            fut = (pending.batcher or self._batcher).submit(pending.req)
         except RuntimeError as e:  # batcher already shut down
             self._settle(pending, error_reply(str(e), uid=pending.req.uid))
             return
@@ -527,6 +707,12 @@ class FrontendServer:
                         time.perf_counter_ns() - pending.t0_ns,
                         uid=pending.req.uid, client=pending.conn.cid)
         self._inflight -= 1
+        if pending.tenant is not None:
+            left = self._tenant_inflight.get(pending.tenant, 1) - 1
+            if left > 0:
+                self._tenant_inflight[pending.tenant] = left
+            else:
+                self._tenant_inflight.pop(pending.tenant, None)
         if self._inflight == 0:
             self._idle.set()
         if not pending.reply.done():
@@ -547,17 +733,19 @@ class FrontendServer:
         for pending in self._queue.drop_client(conn.cid):
             self._dispatch(pending)
         self._registry.set_gauge("front_queue_depth", 0, client=conn.cid)
-        self._batcher.flush()
+        for b in self._all_batchers():
+            b.flush()
 
     def _flush_all(self) -> None:
-        """Drain semantics: everything queued, every client, goes to the
-        batcher now (ignoring the window) and the batcher flushes."""
+        """Drain semantics: everything queued, every client, goes to its
+        batcher now (ignoring the window) and every batcher flushes."""
         while True:
             nxt = self._queue.next_item()
             if nxt is None:
                 break
             self._dispatch(nxt[1])
-        self._batcher.flush()
+        for b in self._all_batchers():
+            b.flush()
 
     # -- drain / control commands ------------------------------------------
     async def _drain(self) -> None:
@@ -588,38 +776,192 @@ class FrontendServer:
             finally:
                 self._draining = False
 
+    def _cmd_target(self, obj: dict):
+        """(swapper, store, model_id) a control command acts on: in fleet
+        mode the optional ``"model"`` field routes to that handle
+        (``UnknownModelError`` propagates to the caller's error reply);
+        without a fleet, the single engine — byte-identical pre-fleet."""
+        if self.fleet is not None:
+            h = self.fleet.resolve(obj.get("model"))
+            return h.swapper, h.engine.store, h.model_id
+        return self.swapper, self.engine.store, None
+
+    def _canary_policy(self, obj: dict):
+        from photon_ml_tpu.serving.fleet.policy import CanaryPolicy
+        kw = dict(self.config.canary_defaults or {})
+        if obj.get("fraction") is not None:
+            kw["fraction"] = float(obj["fraction"])
+        if obj.get("min_observations") is not None:
+            kw["min_observations"] = int(obj["min_observations"])
+        if obj.get("max_drift") is not None:
+            kw["max_drift"] = float(obj["max_drift"])
+        return CanaryPolicy(**kw)
+
+    def _load_store(self, model_dir: str, config):
+        """Blocking (executor-side) bundle load for canary/shadow legs —
+        built on the handle's own StoreConfig so the signature (and
+        therefore the warmed executables) is shared with the active
+        generation."""
+        from photon_ml_tpu.serving.coefficient_store import CoefficientStore
+        from photon_ml_tpu.storage.model_io import load_model_bundle
+        bundle = load_model_bundle(model_dir)
+        return CoefficientStore.from_bundle(bundle, config=config,
+                                            version=model_dir,
+                                            metrics=self.engine.metrics)
+
     async def _handle_cmd(self, conn: _Conn, cmd: str, obj: dict) -> None:
         if cmd == "swap":
             model_dir = obj.get("model_dir")
             if not model_dir:
                 self._reply_now(conn, error_reply("swap needs model_dir"))
                 return
+            try:
+                swapper, store, _mid = self._cmd_target(obj)
+            except ValueError as e:
+                self._reply_now(conn, error_reply(str(e)))
+                return
             fut = self._reply_future(conn)
-            ok = await self._quiesced(lambda: self.swapper.swap(model_dir))
+            ok = await self._quiesced(lambda: swapper.swap(model_dir))
             fut.set_result({
                 "swap": "ok" if ok else "rejected",
-                "generation": self.engine.store.generation,
-                "version": self.engine.store.version,
-                "delta_version": self.swapper.delta_version})
+                "generation": swapper.engine.store.generation,
+                "version": swapper.engine.store.version,
+                "delta_version": swapper.delta_version})
         elif cmd == "delta":
+            try:
+                swapper, store, _mid = self._cmd_target(obj)
+            except ValueError as e:
+                self._reply_now(conn, error_reply(str(e)))
+                return
             fut = self._reply_future(conn)
             ok = await self._quiesced(
-                lambda: self.swapper.apply_delta(obj.get("coordinate"),
-                                                obj.get("entity"),
-                                                obj.get("row") or ()))
+                lambda: swapper.apply_delta(obj.get("coordinate"),
+                                            obj.get("entity"),
+                                            obj.get("row") or ()))
             fut.set_result({"delta": "ok" if ok else "rejected",
-                            "delta_version": self.swapper.delta_version})
+                            "delta_version": swapper.delta_version})
         elif cmd == "rebalance":
             fut = self._reply_future(conn)
-            moves = await self._loop.run_in_executor(
-                None, self.engine.store.rebalance)
+            if self.fleet is not None and obj.get("model") is None:
+                # fleet-wide pass: every model, then the tenant-quota
+                # invariant re-check + gauge export
+                moves = await self._loop.run_in_executor(
+                    None, self.fleet.rebalance)
+                fut.set_result({"rebalance": {
+                    mid: {cid: list(m) for cid, m in mm.items()}
+                    for mid, mm in moves.items()}})
+                return
+            try:
+                _swapper, store, _mid = self._cmd_target(obj)
+            except ValueError as e:
+                fut.set_result(error_reply(str(e)))
+                return
+            moves = await self._loop.run_in_executor(None, store.rebalance)
             fut.set_result({"rebalance": {cid: list(m)
                                           for cid, m in moves.items()}})
+        elif cmd == "fleet":
+            if self.router is None:
+                self._reply_now(conn, error_reply(
+                    "no fleet configured; run with --add-model"))
+            else:
+                self._reply_now(conn,
+                                lambda: {"fleet": self.router.status()})
+        elif cmd == "canary":
+            if self.router is None:
+                self._reply_now(conn, error_reply(
+                    "no fleet configured; run with --add-model"))
+                return
+            model_dir = obj.get("model_dir")
+            if not model_dir:
+                self._reply_now(conn, error_reply("canary needs model_dir"))
+                return
+            try:
+                handle = self.fleet.resolve(obj.get("model"))
+                policy = self._canary_policy(obj)
+            except ValueError as e:
+                self._reply_now(conn, error_reply(str(e)))
+                return
+            fut = self._reply_future(conn)
+
+            def _start():
+                candidate = self._load_store(model_dir,
+                                             handle.store.config)
+                ctl = self.router.start_canary(handle.model_id, candidate,
+                                               policy=policy,
+                                               model_dir=model_dir)
+                return ctl.status()
+
+            try:
+                status = await self._quiesced(_start)
+            except Exception as e:
+                fut.set_result(error_reply(str(e)))
+                return
+            fut.set_result({"canary": status})
+        elif cmd in ("promote", "rollback"):
+            if self.router is None:
+                self._reply_now(conn, error_reply(
+                    "no fleet configured; run with --add-model"))
+                return
+            try:
+                handle = self.fleet.resolve(obj.get("model"))
+            except ValueError as e:
+                self._reply_now(conn, error_reply(str(e)))
+                return
+            fut = self._reply_future(conn)
+
+            def _ctl(_cmd=cmd, _mid=handle.model_id):
+                if _cmd == "promote":
+                    return self.router.promote(_mid).status()
+                return self.router.rollback(
+                    _mid, reason=obj.get("reason", "operator")).status()
+
+            try:
+                status = await self._quiesced(_ctl)
+            except ValueError as e:
+                fut.set_result(error_reply(str(e)))
+                return
+            fut.set_result({cmd: status})
+        elif cmd == "shadow":
+            if self.router is None:
+                self._reply_now(conn, error_reply(
+                    "no fleet configured; run with --add-model"))
+                return
+            try:
+                handle = self.fleet.resolve(obj.get("model"))
+            except ValueError as e:
+                self._reply_now(conn, error_reply(str(e)))
+                return
+            if obj.get("off"):
+                fut = self._reply_future(conn)
+                ok = await self._quiesced(
+                    lambda: self.router.detach_shadow(handle.model_id))
+                fut.set_result({"shadow": "off" if ok else "none",
+                                "model": handle.model_id})
+                return
+            model_dir = obj.get("model_dir")
+            if not model_dir:
+                self._reply_now(conn, error_reply("shadow needs model_dir"))
+                return
+            fut = self._reply_future(conn)
+
+            def _attach():
+                store = self._load_store(model_dir, handle.store.config)
+                self.router.attach_shadow(handle.model_id, store)
+                return {"shadow": "on", "model": handle.model_id,
+                        "version": store.version}
+
+            try:
+                reply = await self._quiesced(_attach)
+            except Exception as e:
+                fut.set_result(error_reply(str(e)))
+                return
+            fut.set_result(reply)
         elif cmd == "metrics":
             # lazy: the snapshot is taken when the reply is WRITTEN, i.e.
             # after every earlier reply on this connection has resolved —
             # the stdio loop's flush-then-snapshot semantics
-            self._batcher.flush()
+            for b in self._all_batchers():
+                b.flush()
             if obj.get("format") == "prometheus":
                 self._reply_now(conn, lambda: {
                     "prometheus": self.engine.metrics.to_prometheus()})
@@ -627,7 +969,8 @@ class FrontendServer:
                 self._reply_now(
                     conn, lambda: self.engine.metrics.snapshot())
         elif cmd == "trace":
-            self._batcher.flush()
+            for b in self._all_batchers():
+                b.flush()
 
             def _trace_reply():
                 from photon_ml_tpu import obs
@@ -680,8 +1023,9 @@ class ThreadedFrontend:
     def __init__(self, engine: ScoringEngine,
                  swapper: Optional[HotSwapper] = None,
                  config: Optional[FrontendConfig] = None,
-                 registry=None):
-        self.server = FrontendServer(engine, swapper, config, registry)
+                 registry=None, fleet=None, health=None):
+        self.server = FrontendServer(engine, swapper, config, registry,
+                                     fleet=fleet, health=health)
         self._ready = threading.Event()
         self._error: Optional[BaseException] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None

@@ -52,6 +52,13 @@ _PHASE = "serving_phase_seconds"
 _SHARD_LOOKUPS = "serving_shard_lookups_total"
 _SHARD_HOT = "serving_shard_hot_hits_total"
 _SHARD_OCCUPANCY = "serving_shard_occupancy"
+# multi-model serving (serving/fleet): per-(model, tenant) traffic, shadow
+# score drift, and per-tenant hot-row budget occupancy.  Labeled families
+# like the shard ones — Prometheus export only, never the snapshot.
+_FLEET_REQUESTS = "fleet_requests_total"
+_FLEET_SHADOW_PAIRS = "fleet_shadow_pairs_total"
+_FLEET_SHADOW_DRIFT = "fleet_shadow_drift"
+_FLEET_TENANT_ROWS = "fleet_tenant_rows"
 _RESERVED = {_PADDED, _REAL}
 
 
@@ -133,6 +140,54 @@ class ServingMetrics:
                 if cell["lookups"]:
                     cell["hit_rate"] = cell["hot_hits"] / cell["lookups"]
         return out
+
+    def observe_fleet_request(self, model: str, tenant: str,
+                              n: int = 1) -> None:
+        """Requests routed to one (model, tenant) pair — the end-to-end
+        per-tenant label the fleet edge stamps on every admit."""
+        self.registry.inc(_FLEET_REQUESTS, n, model=model, tenant=tenant)
+
+    def observe_shadow_drift(self, model: str, bucket: int,
+                             drift: float) -> None:
+        """One primary-vs-shadow score pair's absolute drift, bucketed by
+        the micro-batch bucket it scored under (serving/fleet/shadow.py)."""
+        self.registry.inc(_FLEET_SHADOW_PAIRS, 1, model=model)
+        self.registry.observe(_FLEET_SHADOW_DRIFT, float(drift),
+                              model=model, bucket=str(bucket))
+
+    def set_tenant_rows(self, tenant: str, used: int, quota: int) -> None:
+        """One tenant's device hot-row budget: rows allocated vs quota."""
+        self.registry.set_gauge(_FLEET_TENANT_ROWS, int(used),
+                                tenant=tenant, kind="used")
+        self.registry.set_gauge(_FLEET_TENANT_ROWS, int(quota),
+                                tenant=tenant, kind="quota")
+
+    def fleet_view(self) -> dict:
+        """Multi-model summary — a SEPARATE view like ``shard_view``;
+        ``snapshot()``'s key set is a compatibility contract and does not
+        grow.  Returns ``{"requests": {model: {tenant: n}},
+        "shadow": {model: {pairs, drift: {bucket: snapshot}}},
+        "tenant_rows": {tenant: {used, quota}}}``."""
+        r = self.registry
+        requests: dict = {}
+        for lk, v in r.counter_series(_FLEET_REQUESTS).items():
+            d = dict(lk)
+            requests.setdefault(d["model"], {})[d["tenant"]] = int(v)
+        shadow: dict = {}
+        for lk, v in r.counter_series(_FLEET_SHADOW_PAIRS).items():
+            d = dict(lk)
+            shadow.setdefault(d["model"], {"pairs": 0, "drift": {}})
+            shadow[d["model"]]["pairs"] = int(v)
+        for lk, snap in r.histogram_series(_FLEET_SHADOW_DRIFT).items():
+            d = dict(lk)
+            cell = shadow.setdefault(d["model"], {"pairs": 0, "drift": {}})
+            cell["drift"][d["bucket"]] = snap
+        tenant_rows: dict = {}
+        for lk, v in r.gauge_series(_FLEET_TENANT_ROWS).items():
+            d = dict(lk)
+            tenant_rows.setdefault(d["tenant"], {})[d["kind"]] = int(v)
+        return {"requests": requests, "shadow": shadow,
+                "tenant_rows": tenant_rows}
 
     # -- views -------------------------------------------------------------
     def counter(self, name: str) -> int:

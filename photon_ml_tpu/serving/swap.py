@@ -232,6 +232,34 @@ class HotSwapper:
                     logger.exception("hot swap: on_swap hook failed")
             return True
 
+    def activate_store(self, store: CoefficientStore,
+                       model_dir: Optional[str] = None,
+                       chaos_point: str = "swap.activate") -> None:
+        """Flip the engine to an ALREADY-warmed in-memory store — the
+        canary promote path (serving/fleet/policy.py): the candidate store
+        has been serving its traffic slice for the whole observation
+        window, so load/warm/replay have long since happened; promotion is
+        only the pointer flip, run under the same swap lock and through
+        the same ``swap.activate`` chaos seam as a full swap, so fault
+        schedules written against swap deployment exercise promotion too.
+        On an injected fault the old generation keeps serving untouched
+        (``InjectedCrash`` propagates — a crash is never handled)."""
+        metrics = self.engine.metrics
+        with self._swap_lock:
+            old = self.engine.store
+            act = _chaos_fault(chaos_point)
+            if act is not None:
+                raise act.to_error()
+            self.engine.activate(store)
+            self.delta_version = 0  # fresh generation: no deltas yet
+            if model_dir is not None:
+                floor = store.generation if self.log_owner else self._base[1]
+                self._base = (model_dir, floor)
+            metrics.inc("swaps")
+            logger.info("promote: gen %d (version %r) -> gen %d (version "
+                        "%r)", old.generation, old.version,
+                        store.generation, store.version)
+
     def apply_delta(self, cid: str, entity: str, row) -> bool:
         """Scatter one updated coefficient row into the LIVE generation
         (online-learned random effects — no generation flip, no recompile).

@@ -521,6 +521,54 @@ class TestServingMeshPlumbing:
         assert seen["zipf"] == 1.1  # mesh sweep is always skewed
 
 
+class TestFleetPlumbing:
+    """--fleet arg plumbing (flags reach run_fleet_bench parsed) plus one
+    real tiny run asserting the bench's own invariants hold and the JSON
+    lands where --out points."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"bench": "fleet_serving", "compiles_after_warm": [0]}
+
+        monkeypatch.setattr(bench, "run_fleet_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--fleet",
+            "--fleet-models", "3",
+            "--fleet-entities", "128",
+            "--fleet-requests", "64",
+            "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["bench"] == "fleet_serving"
+        assert seen["n_models"] == 3
+        assert seen["n_entities"] == 128
+        assert seen["n_requests"] == 64
+        assert seen["out_path"] == "ignored.json"
+
+    def test_tiny_real_run_holds_invariants(self, tmp_path):
+        import json
+
+        out_path = str(tmp_path / "fleet.json")
+        out = bench.run_fleet_bench(n_entities=48, d=4, n_requests=48,
+                                    max_batch=8, n_models=2,
+                                    out_path=out_path)
+        # the Flare invariant the bench exists to watch: growing the
+        # same-shape family compiled nothing after the first warm
+        assert out["compiles_after_warm"] == [0, 0]
+        assert out["recompiles_after_warm"] == 0
+        assert out["shadow"]["pairs"] == 48
+        assert out["shadow_overhead_ratio"] > 0
+        assert out["canary"]["promote_settle_s"] > 0
+        assert out["canary"]["rollback_reason"] == "score_drift"
+        with open(out_path) as f:
+            assert json.load(f)["bench"] == "fleet_serving"
+
+
 class TestOnlineBenchCli:
     """--online arg plumbing: flags reach run_online_bench parsed."""
 

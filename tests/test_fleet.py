@@ -1,0 +1,731 @@
+"""photonfleet tests: multi-model serving, canary rollout, shadow scoring.
+
+The contracts under test (ISSUE 16 / ROADMAP item 4):
+  - Kernel sharing: same-shape models on one fleet share AOT executables
+    outright (registering model N compiles NOTHING — probe-counted on the
+    shared KernelCache); distinct-shape models coexist with zero
+    cross-talk; ``StoreConfig.fleet_axis`` forces isolation when sharing
+    compiled programs across tenants is not wanted.
+  - Budget: one device hot-row budget with per-tenant quotas — over-quota
+    registration refuses with TenantBudgetError, rebalance re-verifies.
+  - Canary: DETERMINISTIC traffic split (stable key hash, not RNG),
+    auto-promote on a clean observation window, auto-rollback on score
+    drift / a not-ready health plane / an injected fault at the
+    ``swap.activate`` seam — and rollback leaves the active generation
+    serving bitwise-identically with zero admitted-request loss.
+  - Shadow: both legs scored, primary served bitwise, per-bucket drift
+    histograms, both legs under ONE photonpulse trace id.
+  - Edge: tenant tokens scope connections, per-tenant admission budgets
+    shed with reason ``tenant_overload``, /readyz gates admission with
+    reason ``not_ready``, and clients that never heard of the model field
+    keep working unchanged (wire back-compat).
+"""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.chaos.health import HealthState
+from photon_ml_tpu.chaos.injector import (FaultInjector, InjectedCrash,
+                                          set_injector)
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.reader import EntityIndex
+from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.obs.pulse import context as pctx
+from photon_ml_tpu.obs.pulse.merge import merge_traces, spans_by_trace
+from photon_ml_tpu.obs.trace import Tracer
+from photon_ml_tpu.serving.batcher import BucketedBatcher, Request
+from photon_ml_tpu.serving.coefficient_store import (CoefficientStore,
+                                                     StoreConfig)
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.serving.fleet import (CANARY, PROMOTED, ROLLED_BACK,
+                                         CanaryController, CanaryPolicy,
+                                         FleetRouter, ModelFleet,
+                                         ShadowScorer, TenantBudgetError,
+                                         UnknownModelError, split_preview,
+                                         stable_bucket, store_device_rows)
+from photon_ml_tpu.serving.frontend import (AdmissionConfig,
+                                            AdmissionController,
+                                            FrontendConfig,
+                                            ThreadedFrontend)
+from photon_ml_tpu.serving.frontend.admission import (SHED_NOT_READY,
+                                                      SHED_TENANT)
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.swap import HotSwapper
+from photon_ml_tpu.types import TaskType
+
+N_ENT = 24
+
+
+def _model(seed=0, d=4, n_ent=N_ENT):
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    return GameModel(models={
+        "fixed": FixedEffectModel(
+            coefficients=Coefficients(means=rng.normal(size=d)),
+            feature_shard="all", task=task),
+        "user": RandomEffectModel(
+            w_stack=rng.normal(size=(n_ent, d)) * 0.5,
+            slot_of={i: i for i in range(n_ent)},
+            random_effect_type="userId", feature_shard="all", task=task),
+    }), task
+
+
+def _store(seed=0, d=4, n_ent=N_ENT, fleet_axis="", metrics=None,
+           version=None):
+    model, task = _model(seed, d=d, n_ent=n_ent)
+    imap = IndexMap({feature_key(f"f{j}"): j for j in range(d)})
+    eidx = EntityIndex()
+    for i in range(n_ent):
+        eidx.get_or_add(f"user{i}")
+    return CoefficientStore.from_model(
+        model, task, {"userId": eidx}, {"all": imap},
+        config=StoreConfig(device_capacity=None, fleet_axis=fleet_axis),
+        version=version or f"seed{seed}", metrics=metrics)
+
+
+def _reqs(rng, k, d=4, model=None, uid0=0):
+    from photon_ml_tpu.serving.batcher import request_from_json
+    out = []
+    for i in range(k):
+        obj = {"uid": uid0 + i,
+               "features": [[f"f{j}", float(v)]
+                            for j, v in enumerate(rng.normal(size=d))],
+               "ids": {"userId": f"user{int(rng.integers(0, N_ENT))}"}}
+        if model is not None:
+            obj["model"] = model
+        out.append(request_from_json(obj))
+    return out
+
+
+def _fleet(max_batch=8, total_rows=None, quotas=None, metrics=None):
+    """Fleet with one adopted synthetic model ``m0`` (tenant default)."""
+    metrics = metrics or ServingMetrics()
+    engine = ScoringEngine(_store(0, metrics=metrics),
+                           BucketedBatcher(max_batch), metrics=metrics)
+    engine.warm()
+    fleet = ModelFleet(metrics=metrics, total_rows=total_rows,
+                       quotas=quotas)
+    fleet.adopt("m0", engine, HotSwapper(engine))
+    return fleet
+
+
+def _isolated_scores(seed, requests, d=4, max_batch=8):
+    """Reference: the same store scored on a private single-model engine."""
+    m = ServingMetrics()
+    eng = ScoringEngine(_store(seed, d=d, metrics=m),
+                        BucketedBatcher(max_batch), metrics=m)
+    return eng.score_requests(requests)
+
+
+# ---------------------------------------------------------------------------
+# kernel sharing on one cache
+# ---------------------------------------------------------------------------
+class TestKernelSharing:
+    def test_same_shape_models_share_executables(self):
+        fleet = _fleet()
+        warm_compiles = fleet.kernels.compile_count
+        warm_execs = len(fleet.kernels)
+        assert warm_compiles == 4  # ladder (1, 2, 4, 8)
+
+        h1 = fleet.register_store("m1", _store(1, metrics=fleet.metrics),
+                                  tenant="acme")
+        # probe-counted: registering + warming an equal-shape model
+        # compiled NOTHING and added no executables
+        assert fleet.kernels.compile_count == warm_compiles
+        assert len(fleet.kernels) == warm_execs
+        assert h1.engine.kernels is fleet.kernels
+        assert len(fleet.kernels.signatures()) == 1
+
+        rng = np.random.default_rng(7)
+        reqs = _reqs(rng, 11)
+        s0 = fleet.handle("m0").engine.score_requests(reqs)
+        s1 = h1.engine.score_requests(reqs)
+        # zero cross-talk: each model scores exactly as it would alone
+        np.testing.assert_array_equal(s0, _isolated_scores(0, reqs))
+        np.testing.assert_array_equal(s1, _isolated_scores(1, reqs))
+        assert fleet.kernels.compile_count == warm_compiles
+
+    def test_distinct_shape_models_coexist(self):
+        fleet = _fleet()
+        base = fleet.kernels.compile_count
+        h6 = fleet.register_store("wide", _store(3, d=6,
+                                                 metrics=fleet.metrics))
+        # a new shape compiles its own ladder, alongside — not instead of —
+        # the old one
+        assert fleet.kernels.compile_count == 2 * base
+        assert len(fleet.kernels.signatures()) == 2
+
+        rng = np.random.default_rng(11)
+        reqs4, reqs6 = _reqs(rng, 9, d=4), _reqs(rng, 9, d=6)
+        np.testing.assert_array_equal(
+            fleet.handle("m0").engine.score_requests(reqs4),
+            _isolated_scores(0, reqs4))
+        np.testing.assert_array_equal(
+            h6.engine.score_requests(reqs6),
+            _isolated_scores(3, reqs6, d=6))
+
+    def test_fleet_axis_isolates_same_shape(self):
+        # the model axis of the cache key: an equal-shape store under its
+        # own fleet_axis refuses to share compiled programs
+        fleet = _fleet()
+        base = fleet.kernels.compile_count
+        fleet.register_store("iso", _store(1, fleet_axis="iso",
+                                           metrics=fleet.metrics))
+        assert fleet.kernels.compile_count == 2 * base
+        assert len(fleet.kernels.signatures()) == 2
+
+    def test_remove_prunes_only_orphaned_executables(self):
+        fleet = _fleet()
+        fleet.register_store("wide", _store(3, d=6, metrics=fleet.metrics))
+        n_all = len(fleet.kernels)
+        fleet.remove("wide")
+        assert len(fleet.kernels) < n_all
+        assert len(fleet.kernels.signatures()) == 1
+        with pytest.raises(UnknownModelError):
+            fleet.handle("wide")
+
+
+# ---------------------------------------------------------------------------
+# tenancy: budget + routing
+# ---------------------------------------------------------------------------
+class TestTenancy:
+    def test_over_quota_registration_refused(self):
+        rows = store_device_rows(_store(0))
+        assert rows == N_ENT  # dense random-effect table, fixed excluded
+        fleet = _fleet(quotas={"acme": rows - 1})
+        with pytest.raises(TenantBudgetError):
+            fleet.register_store("big", _store(1, metrics=fleet.metrics),
+                                 tenant="acme")
+        assert fleet.models() == ("m0",)
+
+    def test_fleet_budget_caps_total(self):
+        rows = store_device_rows(_store(0))
+        fleet = _fleet(total_rows=rows + 1)
+        with pytest.raises(TenantBudgetError):
+            fleet.register_store("m1", _store(1, metrics=fleet.metrics))
+
+    def test_resolve_default_and_unknown(self):
+        fleet = _fleet()
+        assert fleet.resolve(None).model_id == "m0"  # pre-fleet wire form
+        with pytest.raises(UnknownModelError):
+            fleet.resolve("nope")
+
+    def test_rebalance_exports_tenant_gauges(self):
+        fleet = _fleet(quotas={"default": 1000})
+        fleet.rebalance()
+        view = fleet.metrics.fleet_view()
+        assert view["tenant_rows"]["default"] == \
+            {"used": N_ENT, "quota": 1000}
+
+
+# ---------------------------------------------------------------------------
+# canary policy
+# ---------------------------------------------------------------------------
+class TestCanarySplit:
+    def test_stable_bucket_frozen_values(self):
+        # regression-frozen: the split must never move between releases —
+        # a replayed log has to split identically forever
+        assert stable_bucket("1") == 3910
+        assert stable_bucket("42") == 1738
+        assert stable_bucket("user3") == 494
+
+    def test_split_preview_matches_controller(self):
+        fleet = _fleet()
+        ctl = CanaryController(fleet.handle("m0"),
+                               CanaryPolicy(fraction=0.25,
+                                            min_observations=10**6))
+        ctl.start(_store(1, metrics=fleet.metrics))
+        uids = list(range(400))
+        canary, control = split_preview(uids, 0.25)
+        assert sorted(canary + control) == uids
+        assert 0.15 < len(canary) / len(uids) < 0.35
+        for uid in uids:
+            req = Request(uid=uid, features=[], ids={})
+            assert ctl.is_canary(req) == (uid in set(canary))
+
+
+class TestCanaryEpisode:
+    def _controller(self, fleet, seed, **policy):
+        ctl = CanaryController(fleet.handle("m0"), CanaryPolicy(**policy))
+        ctl.start(_store(seed, metrics=fleet.metrics))
+        return ctl
+
+    def test_auto_promote_on_clean_window(self):
+        fleet = _fleet()
+        handle = fleet.handle("m0")
+        gen0 = handle.store.generation
+        compiles = fleet.kernels.compile_count
+        # candidate == active coefficients (same seed) -> zero drift
+        ctl = self._controller(fleet, 0, fraction=0.5, min_observations=8)
+        rng = np.random.default_rng(5)
+        scores = ctl.score(_reqs(rng, 40))
+        assert len(scores) == 40 and np.all(np.isfinite(scores))
+        assert ctl.state == PROMOTED
+        assert ctl.settle_s is not None and ctl.settle_s >= 0
+        assert handle.store.generation > gen0  # pointer flipped
+        assert handle.swapper.delta_version == 0
+        # the whole episode — warm, split, dual-score, promote — compiled
+        # nothing (acceptance: zero engine recompiles across the episode)
+        assert fleet.kernels.compile_count == compiles
+
+    def test_auto_rollback_on_score_drift(self):
+        fleet = _fleet()
+        handle = fleet.handle("m0")
+        rng = np.random.default_rng(6)
+        probe = _reqs(rng, 13)
+        baseline = handle.engine.score_requests(probe)
+        ctl = self._controller(fleet, 9, fraction=0.5, min_observations=8,
+                               max_drift=1e-9)
+        served = ctl.score(probe + _reqs(rng, 27, uid0=100))
+        assert len(served) == 40  # every admitted request got a score
+        assert ctl.state == ROLLED_BACK
+        assert ctl.rollback_reason == "score_drift"
+        assert ctl.candidate is None
+        # the active generation was never touched: bitwise-identical serve
+        np.testing.assert_array_equal(handle.engine.score_requests(probe),
+                                      baseline)
+
+    def test_auto_rollback_on_health_not_ready(self):
+        fleet = _fleet()
+        health = HealthState()
+        health.set_condition("plane", False, "injected degradation")
+        ctl = CanaryController(fleet.handle("m0"),
+                               CanaryPolicy(fraction=0.5,
+                                            min_observations=10**6,
+                                            health_poll_s=0.0),
+                               health=health)
+        ctl.start(_store(0, metrics=fleet.metrics))
+        ctl.score(_reqs(np.random.default_rng(3), 8))
+        assert ctl.state == ROLLED_BACK
+        assert ctl.rollback_reason == "health_not_ready"
+
+    def test_injected_fault_at_promotion_rolls_back_bitwise(self):
+        # satellite (c): the rollback edge — a fault at the swap.activate
+        # seam turns the promote into a rollback; the old generation keeps
+        # serving bitwise-identically and zero admitted requests are lost
+        fleet = _fleet()
+        handle = fleet.handle("m0")
+        gen0 = handle.store.generation
+        compiles = fleet.kernels.compile_count
+        rng = np.random.default_rng(8)
+        probe = _reqs(rng, 16)
+        baseline = handle.engine.score_requests(probe)
+
+        inj = FaultInjector()
+        inj.arm("swap.activate", kind="error")
+        prev = set_injector(inj)
+        try:
+            ctl = self._controller(fleet, 0, fraction=0.5,
+                                   min_observations=4)
+            served = ctl.score(probe)
+        finally:
+            set_injector(prev)
+        assert len(served) == len(probe)  # zero admitted-request loss
+        assert ctl.state == ROLLED_BACK
+        assert ctl.rollback_reason == "promotion_fault"
+        assert handle.store.generation == gen0  # flip never happened
+        np.testing.assert_array_equal(handle.engine.score_requests(probe),
+                                      baseline)
+        assert fleet.kernels.compile_count == compiles
+        rollbacks = fleet.metrics.registry.counter_series(
+            "fleet_canary_rollbacks_total")
+        assert rollbacks[(("model", "m0"),
+                          ("reason", "promotion_fault"))] == 1
+
+    def test_injected_crash_at_promotion_propagates(self):
+        fleet = _fleet()
+        inj = FaultInjector()
+        inj.arm("swap.activate", kind="crash")
+        prev = set_injector(inj)
+        try:
+            ctl = self._controller(fleet, 0, fraction=1.0,
+                                   min_observations=1)
+            with pytest.raises(InjectedCrash):
+                ctl.score(_reqs(np.random.default_rng(2), 4))
+        finally:
+            set_injector(prev)
+
+
+# ---------------------------------------------------------------------------
+# shadow scoring
+# ---------------------------------------------------------------------------
+class TestShadow:
+    def test_serves_primary_bitwise_and_records_drift(self):
+        fleet = _fleet()
+        handle = fleet.handle("m0")
+        compiles = fleet.kernels.compile_count
+        rng = np.random.default_rng(4)
+        reqs = _reqs(rng, 19)
+        baseline = handle.engine.score_requests(reqs)
+
+        scorer = ShadowScorer(handle, _store(9, metrics=fleet.metrics))
+        served = scorer.score(reqs)
+        np.testing.assert_array_equal(served, baseline)  # old leg served
+        assert fleet.kernels.compile_count == compiles   # shadow warm free
+
+        view = scorer.drift_view()
+        assert view["pairs"] == 19
+        # drift attributed to the micro-batch buckets 19 rows plan to
+        assert set(view["drift"]) == {"8", "4"}
+        assert all(h["count"] > 0 for h in view["drift"].values())
+        assert fleet.metrics.fleet_view()["shadow"]["m0"]["pairs"] == 19
+
+    def test_both_legs_under_one_trace_id(self):
+        fleet = _fleet()
+        handle = fleet.handle("m0")
+        scorer = ShadowScorer(handle, _store(9, metrics=fleet.metrics))
+        t = Tracer(capacity=4096, enabled=True)
+        prev = obs.set_tracer(t)
+        try:
+            ctx = pctx.mint()
+            reqs = _reqs(np.random.default_rng(1), 5)
+            for r in reqs:
+                r.ctx = ctx
+            scorer.score(reqs)
+        finally:
+            obs.set_tracer(prev)
+        # the tracemerge timeline joins primary and shadow executions of
+        # one request under one trace id (acceptance criterion)
+        by_trace = spans_by_trace(merge_traces([t.chrome_trace()]))
+        names = {e["name"] for e in by_trace[ctx[0]]}
+        assert {"fleet.serve", "fleet.shadow"} <= names
+
+    def test_router_interposes_and_detaches(self):
+        fleet = _fleet()
+        router = FleetRouter(fleet)
+        rng = np.random.default_rng(2)
+        reqs = _reqs(rng, 7)
+        plain = router.score("m0", reqs)
+        router.attach_shadow("m0", _store(9, metrics=fleet.metrics))
+        np.testing.assert_array_equal(router.score("m0", reqs), plain)
+        assert router.shadows["m0"].drift_view()["pairs"] == 7
+        assert router.detach_shadow("m0")
+        assert not router.detach_shadow("m0")
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission + readiness shedding (edge policy units)
+# ---------------------------------------------------------------------------
+class TestTenantAdmission:
+    def test_tenant_latch_and_hysteresis(self):
+        ctl = AdmissionController(AdmissionConfig(budget_s=1.0,
+                                                  tenant_budget_s=0.1))
+        v = ctl.decide(0.0, tenant="acme", tenant_wait_s=0.5)
+        assert not v.admitted and v.reason == SHED_TENANT
+        assert ctl.tenant_shedding("acme")
+        # latched: under budget but over the low watermark still sheds
+        assert not ctl.decide(0.0, tenant="acme",
+                              tenant_wait_s=0.08).admitted
+        # other tenants keep admitting
+        assert ctl.decide(0.0, tenant="beta", tenant_wait_s=0.0).admitted
+        # unlatch at the low watermark
+        assert ctl.decide(0.0, tenant="acme", tenant_wait_s=0.01).admitted
+        assert not ctl.tenant_shedding("acme")
+
+    def test_off_without_budget(self):
+        ctl = AdmissionController(AdmissionConfig(budget_s=1.0))
+        assert ctl.decide(0.0, tenant="acme", tenant_wait_s=99.0).admitted
+
+
+# ---------------------------------------------------------------------------
+# the network edge in fleet mode
+# ---------------------------------------------------------------------------
+def _two_tenant_fleet():
+    fleet = _fleet()
+    fleet.register_store("acme-model", _store(1, metrics=fleet.metrics),
+                         tenant="acme")
+    return fleet
+
+
+def _wire_req(rng, uid, model=None):
+    obj = {"uid": uid,
+           "features": [[f"f{j}", float(v)]
+                        for j, v in enumerate(rng.normal(size=4))],
+           "ids": {"userId": f"user{int(rng.integers(0, N_ENT))}"}}
+    if model is not None:
+        obj["model"] = model
+    return obj
+
+
+class TestFrontendFleet:
+    def _front(self, fleet, health=None, **over):
+        kw = dict(admission=AdmissionConfig(budget_s=30.0),
+                  batcher_deadline_s=0.002, health_poll_s=0.0)
+        kw.update(over)
+        engine = fleet.handle("m0").engine
+        return ThreadedFrontend(engine, config=FrontendConfig(**kw),
+                                fleet=fleet, health=health).start()
+
+    def test_model_routing_and_backcompat(self):
+        from test_frontend import Client
+
+        fleet = _two_tenant_fleet()
+        front = self._front(fleet)
+        try:
+            c = Client(front.port)
+            rng = np.random.default_rng(3)
+            wire = [_wire_req(rng, 0),                    # no model field
+                    _wire_req(rng, 1, model="acme-model"),
+                    _wire_req(rng, 2, model="ghost")]
+            for obj in wire:
+                c.send(obj)
+            c.send_raw("\n")
+            replies = {}
+            for _ in range(3):
+                r = c.recv()
+                replies[r["uid"]] = r
+            # model-field-less client rides the default model unchanged
+            from photon_ml_tpu.serving.batcher import request_from_json
+            np.testing.assert_allclose(
+                replies[0]["score"],
+                float(_isolated_scores(0, [request_from_json(wire[0])])[0]),
+                rtol=1e-6)
+            np.testing.assert_allclose(
+                replies[1]["score"],
+                float(_isolated_scores(1, [request_from_json(wire[1])])[0]),
+                rtol=1e-6)
+            assert replies[2]["error"] == "unknown_model"
+            assert replies[2]["model"] == "ghost"
+            c.close()
+        finally:
+            front.stop()
+        view = fleet.metrics.fleet_view()["requests"]
+        assert view["m0"]["default"] == 1
+        assert view["acme-model"]["acme"] == 1
+
+    def test_tenant_token_scopes_connection(self):
+        from test_frontend import Client
+
+        fleet = _two_tenant_fleet()
+        front = self._front(fleet,
+                            tenant_tokens={"tok-acme": "acme"})
+        try:
+            c = Client(front.port)
+            c.send({"cmd": "auth", "token": "tok-acme"})
+            assert c.recv() == {"auth": "ok", "tenant": "acme"}
+            rng = np.random.default_rng(4)
+            c.send(_wire_req(rng, 1, model="acme-model"))
+            c.send(_wire_req(rng, 2, model="m0"))  # other tenant's model
+            c.send_raw("\n")
+            # reply order per connection is submission order
+            first, second = c.recv(), c.recv()
+            got = {first["uid"]: first, second["uid"]: second}
+            assert "score" in got[1]
+            assert got[2]["error"] == "forbidden"
+            c.close()
+
+            bad = Client(front.port)
+            bad.send({"cmd": "auth", "token": "wrong"})
+            assert bad.recv() == {"error": "unauthorized"}
+            bad.close()
+        finally:
+            front.stop()
+
+    def test_not_ready_sheds_admission(self):
+        from test_frontend import Client
+
+        fleet = _fleet()
+        health = HealthState(registry=fleet.metrics.registry)
+        health.set_condition("plane", True)
+        front = self._front(fleet, health=health)
+        try:
+            c = Client(front.port)
+            rng = np.random.default_rng(5)
+            c.send(_wire_req(rng, 1))
+            c.send_raw("\n")
+            assert "score" in c.recv()
+
+            health.set_condition("plane", False, "chaos says no")
+            c.send(_wire_req(rng, 2))
+            shed = c.recv()
+            assert shed["error"] == "overloaded"
+            assert shed["reason"] == SHED_NOT_READY
+
+            health.set_condition("plane", True)
+            c.send(_wire_req(rng, 3))
+            c.send_raw("\n")
+            assert "score" in c.recv()
+            c.close()
+        finally:
+            front.stop()
+        sheds = fleet.metrics.registry.counter_series("requests_shed_total")
+        assert sheds[(("reason", SHED_NOT_READY),)] == 1
+
+
+# ---------------------------------------------------------------------------
+# sampled always-on tracing
+# ---------------------------------------------------------------------------
+class TestSampledMinting:
+    def test_every_nth_deterministic(self):
+        pctx.reset_sampling()
+        got = [pctx.maybe_mint(3) is not None for _ in range(7)]
+        assert got == [False, False, True, False, False, True, False]
+
+    def test_edge_rates(self):
+        pctx.reset_sampling()
+        assert pctx.maybe_mint(0) is None
+        assert pctx.maybe_mint(-5) is None
+        assert all(pctx.maybe_mint(1) is not None for _ in range(3))
+
+
+# ---------------------------------------------------------------------------
+# cli/serve.py end to end (trained model dirs)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    from test_serving import _train
+
+    tmp = tmp_path_factory.mktemp("fleet_cli")
+    return _train(tmp, seed=1), _train(tmp, seed=2)
+
+
+FEATURES = ["g0", "g1", "g2", "ux"]
+
+
+def _cli_req(rng, uid, model=None):
+    obj = {"uid": uid,
+           "features": [[f, float(v)]
+                        for f, v in zip(FEATURES, rng.normal(size=4))],
+           "ids": {"userId": f"user{int(rng.integers(0, 6))}"}}
+    if model is not None:
+        obj["model"] = model
+    return obj
+
+
+def _run_cli(argv, req_lines, tmp_path, name="reqs"):
+    import contextlib
+
+    from photon_ml_tpu.cli import serve as serve_cli
+
+    req = tmp_path / f"{name}.jsonl"
+    req.write_text("\n".join(req_lines) + "\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = serve_cli.run(argv + ["--requests", str(req)])
+    return rc, [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+
+
+class TestServeCliFleet:
+    def test_canary_promote_rollback_e2e(self, model_dirs, tmp_path):
+        """One stdio session: rollback episode (drift gate) then promote
+        episode (clean gate) on the default model — zero admitted-request
+        loss, zero recompiles, bitwise-identical serving after rollback."""
+        dir1, dir2 = model_dirs
+        rng = np.random.default_rng(21)
+        probe = json.dumps(_cli_req(np.random.default_rng(99), 1))
+        lines = [probe, ""]
+        lines.append(json.dumps({"cmd": "fleet"}))
+        # episode 1: candidate drifts (different training seed), tiny gate
+        lines.append(json.dumps({"cmd": "canary", "model_dir": dir2,
+                                 "min_observations": 8, "fraction": 0.5,
+                                 "max_drift": 1e-9}))
+        uids = list(range(100, 140))
+        for uid in uids:
+            lines.append(json.dumps(_cli_req(rng, uid)))
+        lines.append("")
+        lines.append(json.dumps({"cmd": "fleet"}))
+        lines.append(probe)  # must score bitwise as before the episode
+        lines.append("")
+        # episode 2: same candidate, gate wide open -> clean window
+        lines.append(json.dumps({"cmd": "canary", "model_dir": dir2,
+                                 "min_observations": 8, "fraction": 0.5,
+                                 "max_drift": 1e9}))
+        for uid in range(200, 240):
+            lines.append(json.dumps(_cli_req(rng, uid)))
+        lines.append("")
+        lines.append(json.dumps({"cmd": "fleet"}))
+
+        rc, out = _run_cli(["--model-dir", dir1, "--max-batch", "8",
+                            "--add-model", f"alt={dir2}"],
+                           lines, tmp_path, "canary")
+        assert rc == 0
+        scores = {o["uid"]: o["score"] for o in out if "score" in o}
+        # zero admitted-request loss across both episodes
+        assert set(scores) == {1} | set(uids) | set(range(200, 240))
+        fleets = [o["fleet"] for o in out if "fleet" in o]
+        assert len(fleets) == 3
+        ep1, ep2 = fleets[1]["canary"]["default"], \
+            fleets[2]["canary"]["default"]
+        assert ep1["state"] == ROLLED_BACK
+        assert ep1["rollback_reason"] == "score_drift"
+        assert ep2["state"] == PROMOTED
+        assert ep2["settle_s"] > 0
+        # same-shape candidate stores + shared cache: the whole session —
+        # two episodes included — never compiled past the startup warm
+        assert all(f["kernels"]["compiles"] ==
+                   fleets[0]["kernels"]["compiles"] for f in fleets)
+        # bitwise-identical serving after the rollback: the probe line
+        # appears twice in the stream and must score identically
+        probe_scores = [o["score"] for o in out if o.get("uid") == 1]
+        assert probe_scores[0] == probe_scores[1]
+        # ...and the promote flipped the default model's generation
+        assert fleets[2]["models"]["default"]["generation"] > \
+            fleets[0]["models"]["default"]["generation"]
+
+    def test_wire_backcompat_model_field_less_clients(self, model_dirs,
+                                                      tmp_path):
+        dir1, dir2 = model_dirs
+        rng = np.random.default_rng(31)
+        lines = [json.dumps(_cli_req(rng, uid)) for uid in range(9)]
+        rng = np.random.default_rng(31)
+        lines2 = [json.dumps(_cli_req(rng, uid)) for uid in range(9)]
+        assert lines == lines2
+        rc1, out1 = _run_cli(["--model-dir", dir1, "--max-batch", "8"],
+                             lines, tmp_path, "plain")
+        rc2, out2 = _run_cli(["--model-dir", dir1, "--max-batch", "8",
+                              "--add-model", f"alt={dir2}"],
+                             lines, tmp_path, "fleetmode")
+        assert rc1 == 0 and rc2 == 0
+        s1 = {o["uid"]: o["score"] for o in out1 if "score" in o}
+        s2 = {o["uid"]: o["score"] for o in out2 if "score" in o}
+        assert s1 == s2  # pre-fleet clients observe nothing
+
+    def test_unknown_model_error_reply(self, model_dirs, tmp_path):
+        dir1, dir2 = model_dirs
+        rng = np.random.default_rng(41)
+        lines = [json.dumps(_cli_req(rng, 7, model="ghost"))]
+        rc, out = _run_cli(["--model-dir", dir1, "--max-batch", "8",
+                            "--add-model", f"alt={dir2}"],
+                           lines, tmp_path, "unknown")
+        assert rc == 0
+        assert out[0] == {"uid": 7, "error": "unknown_model",
+                          "model": "ghost"}
+
+    def test_over_budget_tenant_refused_at_startup(self, model_dirs,
+                                                   tmp_path):
+        from photon_ml_tpu.cli import serve as serve_cli
+
+        dir1, dir2 = model_dirs
+        rc = serve_cli.run(["--model-dir", dir1, "--max-batch", "8",
+                            "--add-model", f"alt={dir2},tenant=acme",
+                            "--tenant-quota", "acme=1",
+                            "--requests", os.devnull])
+        assert rc == 1
+
+    def test_shadow_cmd_e2e(self, model_dirs, tmp_path):
+        dir1, dir2 = model_dirs
+        rng = np.random.default_rng(51)
+        lines = [json.dumps({"cmd": "shadow", "model_dir": dir2})]
+        lines += [json.dumps(_cli_req(rng, uid)) for uid in range(12)]
+        lines.append("")
+        lines.append(json.dumps({"cmd": "fleet"}))
+        lines.append(json.dumps({"cmd": "shadow", "off": True}))
+        rc, out = _run_cli(["--model-dir", dir1, "--max-batch", "8",
+                            "--add-model", f"alt={dir2}"],
+                           lines, tmp_path, "shadow")
+        assert rc == 0
+        assert out[0] == {"shadow": "on", "model": "default",
+                          "version": dir2}
+        fleet_view = [o["fleet"] for o in out if "fleet" in o][0]
+        assert fleet_view["shadow"]["default"]["pairs"] == 12
+        assert [o for o in out if o.get("shadow") == "off"]
