@@ -3200,28 +3200,39 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
     Static analysis sits on the tier-1 path (tests/test_photonlint.py) and
     in the pre-commit loop (``tools/photonlint.py --paths``), so its cost is
     tracked like any other hot path: BENCH_LINT.json records wall time per
-    run (best + mean), the ProgramIndex build share, and the finding counts
-    — a lint-time regression shows up in the same place a kernel regression
-    would.  Pure AST work: no jax import, runs identically on any backend.
+    run (best + mean), the ProgramIndex build share, the v3 dataflow-pass
+    share (CFG fixpoints + call-graph reachability, accounted by
+    analysis/dataflow.py), and the finding counts — a lint-time regression
+    shows up in the same place a kernel regression would.  ASSERTS the
+    full-package wall stays under the 6s budget (PHOTON_BENCH_LINT_BUDGET_S
+    overrides).  Pure AST work: no jax import, identical on any backend.
     """
     import time as _time
 
     from photon_ml_tpu.analysis import run_analysis
 
     pkg = os.path.join(_REPO, "photon_ml_tpu")
-    times, idx_times, result = [], [], None
+    times, idx_times, flow_times, result = [], [], [], None
     for _ in range(max(1, repeats)):
         t0 = _time.perf_counter()
         result = run_analysis([pkg], root=_REPO, whole_program=True)
         times.append(_time.perf_counter() - t0)
         idx_times.append(result.index_build_s)
+        flow_times.append(result.dataflow_s)
+    budget_s = float(os.environ.get("PHOTON_BENCH_LINT_BUDGET_S", "6.0"))
+    assert min(times) < budget_s, (
+        f"photonlint full-package wall {min(times):.2f}s exceeds the "
+        f"{budget_s:.1f}s budget — profile the rule prechecks and the "
+        "dataflow pass (dataflow_s below) before shipping")
     out = {
         "metric": "photonlint_full_package_wall_s",
         "value": round(min(times), 4),
         "unit": "s",
+        "budget_s": budget_s,
         "wall_s_mean": round(sum(times) / len(times), 4),
         "wall_s_all": [round(t, 4) for t in times],
         "index_build_s": round(min(idx_times), 4),
+        "dataflow_s": round(min(flow_times), 4),
         "files_scanned": result.files_scanned,
         "violations": len(result.violations),
         "suppressed": len(result.suppressed),

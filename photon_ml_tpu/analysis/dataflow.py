@@ -1,0 +1,609 @@
+"""Per-function forward dataflow + call-graph reachability for photonlint.
+
+The v1/v2 rules are lexical: PL005 only sees mutations spelled ``self.X``,
+PL007 only sees collectives lexically inside a shard_map target, and nothing
+at all connects an ``async def`` body to the synchronous helpers it calls.
+The concurrency and distributed-protocol rules (PL011–PL014, PL005v2) need
+two things the lexical passes cannot answer:
+
+  1. **"what does this name alias here?"** — a per-function forward dataflow
+     over a CFG lowered from the AST (branches, loops run to convergence,
+     try/except/finally with per-statement exception edges).  The abstract
+     state maps each local name to (a) the set of ``self.<attr>`` objects it
+     may alias and (b) the line numbers of the reaching definitions.
+     ``a = self._store; b = a`` makes both ``a`` and ``b`` aliases of
+     ``_store``; ``self._x = buf`` makes ``buf`` an alias of ``_x``; any
+     other assignment kills.  Joins are set unions, so the analysis is
+     monotone and the loop fixpoint terminates.
+
+  2. **"is this call reachable from an async body / a jit root / a
+     lock-held region?"** — a module-local call graph (``Name`` → module
+     def, unique by-name fallback; ``self.method`` → unique method, the
+     same convention ``ProgramIndex._resolve_callee`` uses) with seeded
+     reachability: event-loop seeds are every ``async def`` plus the
+     callback targets of ``loop.call_soon[_threadsafe]/call_later/call_at``;
+     lock seeds are the callees invoked inside ``with self.<lock>:`` blocks;
+     jit reachability reuses the (program-augmented) ``JitIndex`` walk.
+     Propagation follows only real ``Call`` nodes — a function REFERENCE
+     handed to ``run_in_executor``/``to_thread``/``Thread(target=...)`` is
+     not a call, so executor hand-offs are exempt by construction.
+
+Everything here is best-effort and conservative in the same direction as
+the rest of the analysis stack: unresolvable facts contribute nothing, so
+dataflow can only ADD precision, never invent phantom findings.  The time
+spent in this module is accounted separately (``reset_cost``/
+``cost_seconds``) so ``bench.py --lint`` can report the dataflow pass cost
+next to the ProgramIndex build.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from typing import (Dict, FrozenSet, Iterable, Iterator, List, Optional,
+                    Sequence, Set, Tuple)
+
+from photon_ml_tpu.analysis.jit_index import FunctionNode, dotted_name
+
+# -- cost accounting ---------------------------------------------------------
+
+_COST = {"s": 0.0}
+
+
+def reset_cost() -> None:
+    _COST["s"] = 0.0
+
+
+def cost_seconds() -> float:
+    return _COST["s"]
+
+
+class _timed:
+    """Context manager accumulating wall time into the dataflow cost."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _COST["s"] += time.perf_counter() - self._t0
+        return False
+
+
+# -- abstract state ----------------------------------------------------------
+# name -> (frozenset of aliased self-attrs, frozenset of reaching-def lines)
+VarFact = Tuple[FrozenSet[str], FrozenSet[int]]
+AliasState = Dict[str, VarFact]
+
+_EMPTY: FrozenSet = frozenset()
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _value_aliases(state: AliasState, expr: ast.AST,
+                   depth: int = 0) -> FrozenSet[str]:
+    """Self-attrs the VALUE expression may alias under ``state``."""
+    if depth > 6 or expr is None:
+        return _EMPTY
+    if isinstance(expr, ast.Name):
+        return state.get(expr.id, (_EMPTY, _EMPTY))[0]
+    attr = _self_attr(expr)
+    if attr is not None:
+        return frozenset((attr,))
+    if isinstance(expr, ast.IfExp):
+        return (_value_aliases(state, expr.body, depth + 1)
+                | _value_aliases(state, expr.orelse, depth + 1))
+    if isinstance(expr, ast.NamedExpr):
+        return _value_aliases(state, expr.value, depth + 1)
+    return _EMPTY
+
+
+def _kill_target(new: AliasState, tgt: ast.AST, line: int) -> None:
+    for sub in ast.walk(tgt):
+        if isinstance(sub, ast.Name):
+            new[sub.id] = (_EMPTY, frozenset((line,)))
+
+
+def _apply_assign(new: AliasState, old: AliasState, tgt: ast.AST,
+                  value: Optional[ast.AST], line: int) -> None:
+    if isinstance(tgt, ast.Name):
+        aliases = _value_aliases(old, value) if value is not None else _EMPTY
+        new[tgt.id] = (aliases, frozenset((line,)))
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        elts = tgt.elts
+        if (isinstance(value, (ast.Tuple, ast.List))
+                and len(value.elts) == len(elts)
+                and not any(isinstance(e, ast.Starred) for e in elts)):
+            for t, v in zip(elts, value.elts):
+                _apply_assign(new, old, t, v, line)
+        else:
+            _kill_target(new, tgt, line)
+    elif isinstance(tgt, ast.Starred):
+        _kill_target(new, tgt.value, line)
+    else:
+        # attribute/subscript target: binds no local — but `self.X = name`
+        # makes `name` an alias of X from here on (the object is shared)
+        attr = _self_attr(tgt)
+        if attr is not None and isinstance(value, ast.Name):
+            aliases, defs = new.get(value.id, (_EMPTY, _EMPTY))
+            new[value.id] = (aliases | {attr}, defs)
+
+
+def _header_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """Expressions evaluated AT a CFG node for a compound statement (its
+    body statements are separate CFG nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try) or stmt.__class__.__name__ == "TryStar":
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                         ast.ExceptHandler)):
+        return []
+    return [stmt]  # simple statement: whole subtree
+
+
+def _transfer(state: AliasState, stmt: ast.AST) -> AliasState:
+    new = dict(state)
+    line = getattr(stmt, "lineno", 0)
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            _apply_assign(new, state, tgt, stmt.value, line)
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        _apply_assign(new, state, stmt.target, stmt.value, line)
+    elif isinstance(stmt, ast.AugAssign):
+        if isinstance(stmt.target, ast.Name):
+            # x += v rebinds x for immutables; conservatively drop aliases
+            _kill_target(new, stmt.target, line)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _kill_target(new, stmt.target, line)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                # `with self._lock as l:` — l aliases the context object
+                _apply_assign(new, state, item.optional_vars,
+                              item.context_expr, line)
+    elif isinstance(stmt, ast.ExceptHandler):
+        if stmt.name:
+            new[stmt.name] = (_EMPTY, frozenset((line,)))
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            new[bound] = (_EMPTY, frozenset((line,)))
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        new[stmt.name] = (_EMPTY, frozenset((line,)))
+    elif isinstance(stmt, ast.Delete):
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                new[tgt.id] = (_EMPTY, frozenset((line,)))
+    # walrus bindings in the expressions this node evaluates
+    for expr in _header_exprs(stmt):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.NamedExpr) \
+                    and isinstance(sub.target, ast.Name):
+                new[sub.target.id] = (_value_aliases(state, sub.value),
+                                      frozenset((getattr(sub, "lineno",
+                                                         line),)))
+    return new
+
+
+def _join(states: Iterable[AliasState]) -> AliasState:
+    out: AliasState = {}
+    for st in states:
+        for name, (aliases, defs) in st.items():
+            if name in out:
+                a0, d0 = out[name]
+                out[name] = (a0 | aliases, d0 | defs)
+            else:
+                out[name] = (aliases, defs)
+    return out
+
+
+# -- CFG ---------------------------------------------------------------------
+
+class _Loop:
+    __slots__ = ("header", "breaks")
+
+    def __init__(self, header: int):
+        self.header = header
+        self.breaks: Set[int] = set()
+
+
+class _CFG:
+    """Statement-level control-flow graph of one function body.  Each
+    statement (and each ``except`` handler head) is one node; edges follow
+    branch/loop/try structure, with per-statement exception edges from try
+    bodies to their handlers."""
+
+    def __init__(self, body: Sequence[ast.stmt]):
+        self.stmts: List[ast.AST] = []
+        self.succ: List[Set[int]] = []
+        self._seq(body, frontier=set(), loops=[], handlers=[])
+
+    def _add(self, stmt: ast.AST) -> int:
+        self.stmts.append(stmt)
+        self.succ.append(set())
+        return len(self.stmts) - 1
+
+    def _seq(self, body: Sequence[ast.stmt], frontier: Set[int],
+             loops: List[_Loop], handlers: List[int]) -> Set[int]:
+        for stmt in body:
+            idx = self._add(stmt)
+            for f in frontier:
+                self.succ[f].add(idx)
+            for h in handlers:
+                self.succ[idx].add(h)  # an exception may fire mid-statement
+            frontier = self._stmt(stmt, idx, loops, handlers)
+        return frontier
+
+    def _stmt(self, stmt: ast.AST, idx: int, loops: List[_Loop],
+              handlers: List[int]) -> Set[int]:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return set()
+        if isinstance(stmt, ast.Break):
+            if loops:
+                loops[-1].breaks.add(idx)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            if loops:
+                self.succ[idx].add(loops[-1].header)
+            return set()
+        if isinstance(stmt, ast.If):
+            f_then = self._seq(stmt.body, {idx}, loops, handlers)
+            f_else = (self._seq(stmt.orelse, {idx}, loops, handlers)
+                      if stmt.orelse else {idx})
+            return f_then | f_else
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            loop = _Loop(header=idx)
+            f_body = self._seq(stmt.body, {idx}, loops + [loop], handlers)
+            for f in f_body:
+                self.succ[f].add(idx)  # back edge — fixpoint converges it
+            f_exit = (self._seq(stmt.orelse, {idx}, loops, handlers)
+                      if stmt.orelse else {idx})
+            return f_exit | loop.breaks
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._seq(stmt.body, {idx}, loops, handlers)
+        if isinstance(stmt, ast.Try) \
+                or stmt.__class__.__name__ == "TryStar":
+            region_lo = len(self.stmts)
+            h_idx = [self._add(h) for h in stmt.handlers]
+            for h in h_idx:
+                self.succ[idx].add(h)
+            f_body = self._seq(stmt.body, {idx}, loops, handlers + h_idx)
+            f_handlers: Set[int] = set()
+            for h, hi in zip(stmt.handlers, h_idx):
+                f_handlers |= self._seq(h.body, {hi}, loops, handlers)
+            f_else = (self._seq(stmt.orelse, f_body, loops, handlers)
+                      if stmt.orelse else f_body)
+            after = f_else | f_handlers
+            if stmt.finalbody:
+                # the finally runs whether or not the protected region
+                # completed: feed it the Try head (pre-body state, for an
+                # exception before the first assignment lands) and every
+                # statement lowered in the region (mid-region exceptions),
+                # not just the normal-completion frontier
+                region = set(range(region_lo, len(self.stmts)))
+                after = self._seq(stmt.finalbody, after | {idx} | region,
+                                  loops, handlers)
+            return after
+        return {idx}
+
+
+# -- per-function flow -------------------------------------------------------
+
+class FunctionFlow:
+    """Alias-set + reaching-definition facts for one function, queryable at
+    any AST node inside it."""
+
+    def __init__(self, fn: FunctionNode):
+        with _timed():
+            self.fn = fn
+            if isinstance(fn, ast.Lambda):
+                body: List[ast.stmt] = [ast.Expr(value=fn.body)]
+            else:
+                body = list(fn.body)
+            self._cfg = _CFG(body)
+            self._in: List[AliasState] = []
+            self._fixpoint()
+            # any node -> index of its (innermost) CFG statement.  Nodes are
+            # visited in CFG order; inner statements were added after their
+            # enclosing compound, so later writes win = innermost wins.
+            self._stmt_of: Dict[int, int] = {}
+            for i, s in enumerate(self._cfg.stmts):
+                for sub in ast.walk(s):
+                    self._stmt_of[id(sub)] = i
+
+    def _entry_state(self) -> AliasState:
+        a = getattr(self.fn, "args", None)
+        state: AliasState = {}
+        if a is None:
+            return state
+        line = getattr(self.fn, "lineno", 0)
+        params = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        if a.vararg:
+            params.append(a.vararg)
+        if a.kwarg:
+            params.append(a.kwarg)
+        for p in params:
+            state[p.arg] = (_EMPTY, frozenset((line,)))
+        return state
+
+    def _fixpoint(self) -> None:
+        cfg = self._cfg
+        n = len(cfg.stmts)
+        preds: List[List[int]] = [[] for _ in range(n)]
+        for i, succs in enumerate(cfg.succ):
+            for j in succs:
+                preds[j].append(i)
+        entry = self._entry_state()
+        self._in = [{} for _ in range(n)]
+        out: List[Optional[AliasState]] = [None] * n
+        work: List[int] = list(range(n))
+        guard = 0
+        while work:
+            guard += 1
+            if guard > 50 * (n + 1):  # safety valve; cannot trip for
+                break                 # monotone transfer, kept for hygiene
+            i = work.pop(0)
+            incoming = [out[p] for p in preds[i] if out[p] is not None]
+            state = _join(incoming) if incoming else {}
+            if not preds[i]:
+                state = dict(entry)
+            self._in[i] = state
+            new_out = _transfer(state, cfg.stmts[i])
+            if new_out != out[i]:
+                out[i] = new_out
+                for j in sorted(cfg.succ[i]):
+                    if j not in work:
+                        work.append(j)
+
+    # -- queries -------------------------------------------------------------
+    def state_at(self, node: ast.AST) -> AliasState:
+        """Abstract state just BEFORE the statement enclosing ``node``
+        ({} when the node is not inside this function)."""
+        idx = self._stmt_of.get(id(node))
+        return self._in[idx] if idx is not None else {}
+
+    def attr_aliases(self, name: str, at: ast.AST) -> FrozenSet[str]:
+        """``self.<attr>`` objects the local ``name`` may alias at ``at``."""
+        return self.state_at(at).get(name, (_EMPTY, _EMPTY))[0]
+
+    def reaching_defs(self, name: str, at: ast.AST) -> FrozenSet[int]:
+        """Line numbers of the definitions of ``name`` reaching ``at``."""
+        return self.state_at(at).get(name, (_EMPTY, _EMPTY))[1]
+
+
+# -- module call graph -------------------------------------------------------
+
+# loop.<scheduler>(callback, ...) — positional index of the callback
+_LOOP_SCHEDULERS: Dict[str, int] = {
+    "call_soon": 0, "call_soon_threadsafe": 0, "call_later": 1, "call_at": 1,
+}
+_LOCKISH = ("lock", "cond", "mutex")
+
+
+def lexical_calls(fn: FunctionNode) -> Iterator[ast.Call]:
+    """Call nodes in ``fn``'s own body, excluding nested function/lambda
+    bodies (their execution context is their own)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def loop_callback_exprs(tree: ast.AST) -> Iterator[ast.expr]:
+    """Callback argument expressions of every event-loop scheduling call
+    (``call_soon``/``call_soon_threadsafe``/``call_later``/``call_at``) —
+    these callbacks RUN ON the loop, so they seed event-loop reachability."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        pos = _LOOP_SCHEDULERS.get(node.func.attr)
+        if pos is not None and len(node.args) > pos:
+            yield node.args[pos]
+
+
+def resolve_local_callee(func: ast.AST, defs: Dict[str, FunctionNode],
+                         defs_by_name: Dict[str, List[FunctionNode]]
+                         ) -> Optional[FunctionNode]:
+    """Module-local callee resolution: ``Name`` -> module-level def (unique
+    by-name fallback for nested/method helpers), ``self.attr`` -> unique
+    method by name.  Mirrors ``ProgramIndex._resolve_callee``."""
+    if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return func
+    if isinstance(func, ast.Name):
+        fn = defs.get(func.id)
+        if fn is not None:
+            return fn
+        cands = defs_by_name.get(func.id)
+        if cands is not None and len(cands) == 1:
+            return cands[0]
+        return None
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name) \
+            and func.value.id == "self":
+        cands = defs_by_name.get(func.attr)
+        if cands is not None and len(cands) == 1:
+            return cands[0]
+    return None
+
+
+def _lockish_context(item: ast.withitem) -> bool:
+    """Does a ``with`` item look like taking a lock (``self._lock`` /
+    ``self.cv`` / a name bound to one — name-based heuristic)?"""
+    expr = item.context_expr
+    name = dotted_name(expr) or ""
+    leaf = name.rpartition(".")[2].lower()
+    return any(k in leaf for k in _LOCKISH)
+
+
+class ModuleCallGraph:
+    """Module-local call graph with seeded reachability queries."""
+
+    def __init__(self, tree: Optional[ast.Module]):
+        with _timed():
+            self.tree = tree
+            self.defs: Dict[str, FunctionNode] = {}
+            self.defs_by_name: Dict[str, List[FunctionNode]] = {}
+            self.fns: List[FunctionNode] = []
+            self._edges: Dict[int, List[FunctionNode]] = {}
+            if tree is None:
+                return
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.fns.append(node)
+                    self.defs_by_name.setdefault(node.name, []).append(node)
+            for stmt in tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.defs[stmt.name] = stmt
+
+    def resolve(self, func: ast.AST) -> Optional[FunctionNode]:
+        return resolve_local_callee(func, self.defs, self.defs_by_name)
+
+    def callees(self, fn: FunctionNode) -> List[FunctionNode]:
+        got = self._edges.get(id(fn))
+        if got is None:
+            got = []
+            for call in lexical_calls(fn):
+                target = self.resolve(call.func)
+                if target is not None:
+                    got.append(target)
+            self._edges[id(fn)] = got
+        return got
+
+    def reachable(self, seeds: Iterable[FunctionNode]) -> Set[int]:
+        """ids of every function reachable from ``seeds`` through module-
+        local calls (seeds included)."""
+        with _timed():
+            out: Set[int] = set()
+            stack: List[FunctionNode] = []
+            for fn in seeds:
+                if id(fn) not in out:
+                    out.add(id(fn))
+                    stack.append(fn)
+            while stack:
+                fn = stack.pop()
+                for callee in self.callees(fn):
+                    if id(callee) not in out:
+                        out.add(id(callee))
+                        stack.append(callee)
+            return out
+
+    def event_loop_fns(self) -> Set[int]:
+        """ids of functions that run on the asyncio event loop: every
+        ``async def``, every scheduled loop callback, and everything they
+        transitively CALL.  Hand-offs (``run_in_executor``/``to_thread``/
+        ``Thread(target=...)``) pass function references, not calls, so
+        they do not propagate — the exemption the rules rely on."""
+        if self.tree is None:
+            return set()
+        seeds: List[FunctionNode] = [fn for fn in self.fns
+                                     if isinstance(fn, ast.AsyncFunctionDef)]
+        for cb in loop_callback_exprs(self.tree):
+            if isinstance(cb, ast.Lambda):
+                seeds.append(cb)
+                continue
+            target = self.resolve(cb)
+            if target is not None:
+                seeds.append(target)
+        return self.reachable(seeds)
+
+    def lock_held_fns(self) -> Set[int]:
+        """ids of functions invoked (transitively) from inside a
+        ``with self.<lock>:`` region."""
+        if self.tree is None:
+            return set()
+        seeds: List[FunctionNode] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_lockish_context(i) for i in node.items):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    target = self.resolve(sub.func)
+                    if target is not None:
+                        seeds.append(target)
+        return self.reachable(seeds)
+
+
+# -- module facade -----------------------------------------------------------
+
+class ModuleDataflow:
+    """Lazy per-module dataflow facade exposed as ``ctx.dataflow``: cached
+    per-function flows, the module call graph, and reachability sets."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._flows: Dict[int, FunctionFlow] = {}
+        self._graph: Optional[ModuleCallGraph] = None
+        self._traced_ids: Optional[Set[int]] = None
+        self._loop_fns: Optional[Set[int]] = None
+        self._lock_fns: Optional[Set[int]] = None
+
+    def function_flow(self, fn: FunctionNode) -> FunctionFlow:
+        flow = self._flows.get(id(fn))
+        if flow is None:
+            flow = FunctionFlow(fn)
+            self._flows[id(fn)] = flow
+        return flow
+
+    @property
+    def call_graph(self) -> ModuleCallGraph:
+        if self._graph is None:
+            self._graph = ModuleCallGraph(self.ctx.tree)
+        return self._graph
+
+    def traced_node_ids(self) -> Set[int]:
+        """ids of every AST node that executes under a jit trace (per the
+        program-augmented JitIndex) — "reachable from a jit root"."""
+        if self._traced_ids is None:
+            from photon_ml_tpu.analysis.jit_index import walk_jit_code
+            with _timed():
+                ids = {id(node) for node, _
+                       in walk_jit_code(self.ctx.jit_index)}
+                # a helper CALLED from traced code executes under the same
+                # trace even though the JitIndex only walks root bodies —
+                # close over the module call graph from the jit roots
+                graph = self.call_graph
+                reach = graph.reachable(
+                    fn for fn, _ in self.ctx.jit_index.roots)
+                for fn in graph.fns:
+                    if id(fn) in reach:
+                        for sub in ast.walk(fn):
+                            ids.add(id(sub))
+                self._traced_ids = ids
+        return self._traced_ids
+
+    def event_loop_fns(self) -> Set[int]:
+        """ids of functions on the event loop — module-local seeds plus, in
+        whole-program mode, functions proven reachable from another
+        module's async code by the ProgramIndex."""
+        if self._loop_fns is None:
+            fns = set(self.call_graph.event_loop_fns())
+            program = getattr(self.ctx, "program", None)
+            if program is not None:
+                fns |= {id(fn) for fn
+                        in program.async_reachable_in(self.ctx.relpath)}
+            self._loop_fns = fns
+        return self._loop_fns
+
+    def lock_held_fns(self) -> Set[int]:
+        if self._lock_fns is None:
+            self._lock_fns = self.call_graph.lock_held_fns()
+        return self._lock_fns

@@ -99,6 +99,9 @@ class ModuleContext:
                 self.parse_error = e
         self._jit_index = None
         self._resolver = None
+        self._dataflow = None
+        self._walked: Optional[Tuple[ast.AST, ...]] = None
+        self._node_buckets: Dict[tuple, Tuple[ast.AST, ...]] = {}
 
     @property
     def jit_index(self):
@@ -107,12 +110,42 @@ class ModuleContext:
         cross-module traced roots the ProgramIndex resolved."""
         if self._jit_index is None:
             from photon_ml_tpu.analysis.jit_index import JitIndex
-            idx = JitIndex(self.tree) if self.tree else JitIndex(None)
+            # the ProgramIndex already built this module's index over the
+            # SAME tree during construction and never re-reads it after —
+            # adopt it instead of paying a second full-tree walk (augmenting
+            # is idempotent: extra_roots skips roots the base already walks)
+            info = (self.program.modules.get(self.relpath)
+                    if self.program is not None else None)
+            if info is not None and info.tree is self.tree:
+                idx = info.jit_index
+            else:
+                idx = JitIndex(self.tree) if self.tree else JitIndex(None)
             if self.program is not None and self.tree is not None:
                 for fn, params in self.program.extra_roots(self.relpath, idx):
                     idx.add_root(fn, params)
             self._jit_index = idx
         return self._jit_index
+
+    @property
+    def walked(self) -> Tuple[ast.AST, ...]:
+        """The module's full preorder walk, computed once and shared by
+        every rule — ``ast.walk`` per rule is the linter's dominant cost
+        (a deque-driven traversal is ~7x slower than iterating this
+        tuple)."""
+        if self._walked is None:
+            self._walked = (tuple(ast.walk(self.tree))
+                            if self.tree is not None else ())
+        return self._walked
+
+    def nodes_of(self, *types: type) -> Tuple[ast.AST, ...]:
+        """All nodes of the given AST types, bucketed once per type-key
+        from the shared walk — the fast replacement for the
+        ``for node in ast.walk(tree): if isinstance(node, T)`` loop."""
+        got = self._node_buckets.get(types)
+        if got is None:
+            got = tuple(n for n in self.walked if isinstance(n, types))
+            self._node_buckets[types] = got
+        return got
 
     @property
     def resolver(self):
@@ -121,6 +154,16 @@ class ModuleContext:
             from photon_ml_tpu.analysis.resolve import Resolver
             self._resolver = Resolver(self)
         return self._resolver
+
+    @property
+    def dataflow(self):
+        """Shared per-module dataflow facade (analysis/dataflow.py): cached
+        per-function alias/reaching-def flows, the module call graph, and
+        event-loop / lock-region / jit reachability sets."""
+        if self._dataflow is None:
+            from photon_ml_tpu.analysis.dataflow import ModuleDataflow
+            self._dataflow = ModuleDataflow(self)
+        return self._dataflow
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -236,6 +279,7 @@ class AnalysisResult:
     suppressed: List[Violation]
     files_scanned: int
     index_build_s: float = 0.0  # ProgramIndex build time (0 in per-module mode)
+    dataflow_s: float = 0.0     # time spent in the dataflow engine this run
     whole_program: bool = False
 
     def by_rule(self) -> Dict[str, int]:
@@ -318,6 +362,8 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     """
     rules = list(rules) if rules is not None else build_rules()
     root = os.path.abspath(root or os.getcwd())
+    from photon_ml_tpu.analysis import dataflow as _dataflow
+    _dataflow.reset_cost()
     program = None
     index_build_s = 0.0
     if whole_program:
@@ -340,4 +386,5 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.code))
     return AnalysisResult(violations=violations, suppressed=suppressed,
                           files_scanned=n_files, index_build_s=index_build_s,
+                          dataflow_s=_dataflow.cost_seconds(),
                           whole_program=whole_program)

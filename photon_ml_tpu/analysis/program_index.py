@@ -146,6 +146,13 @@ class ProgramIndex:
         self._traced: Dict[int, Tuple[ModuleInfo, FunctionNode, Set[str]]] = {}
         self._propagate()
         self.axis_universe: Set[str] = self._collect_mesh_axes()
+        # lazy caches for the dataflow-backed cross-module queries
+        self._on_loop: Optional[Dict[int, Tuple[ModuleInfo,
+                                                FunctionNode]]] = None
+        self._mesh_scoped: Optional[Dict[int, Tuple[ModuleInfo,
+                                                    FunctionNode]]] = None
+        self._donor_exports: Optional[Dict[str, Dict[str, Tuple[Tuple[int, ...],
+                                                                Tuple[str, ...]]]]] = None
         self.build_seconds = time.perf_counter() - t0
 
     @classmethod
@@ -342,6 +349,237 @@ class ProgramIndex:
                if mod.relpath == relpath]
         out.sort(key=lambda t: t[0].lineno)
         return out
+
+    # -- event-loop reachability (PL013) --------------------------------------
+    def _compute_on_loop(self) -> Dict[int, Tuple[ModuleInfo, FunctionNode]]:
+        """Cross-module fixpoint of "runs on the asyncio event loop": seeds
+        are every ``async def`` plus loop-scheduled callbacks anywhere in
+        the program; propagation follows resolvable CALLS only (function
+        references handed to executors are exempt by construction)."""
+        from photon_ml_tpu.analysis.dataflow import (_timed, lexical_calls,
+                                                     loop_callback_exprs)
+
+        on: Dict[int, Tuple[ModuleInfo, FunctionNode]] = {}
+        stack: List[Tuple[ModuleInfo, FunctionNode]] = []
+
+        def seed(info: ModuleInfo, fn: FunctionNode) -> None:
+            if id(fn) not in on:
+                on[id(fn)] = (info, fn)
+                stack.append((info, fn))
+
+        with _timed():
+            for info in self.modules.values():
+                if info.tree is None:
+                    continue
+                for fns in info.defs_by_name.values():
+                    for fn in fns:
+                        if isinstance(fn, ast.AsyncFunctionDef):
+                            seed(info, fn)
+                for cb in loop_callback_exprs(info.tree):
+                    if isinstance(cb, ast.Lambda):
+                        seed(info, cb)
+                        continue
+                    got = self._resolve_callee(info, cb)
+                    if got is not None:
+                        seed(got[0], got[1])
+            while stack:
+                info, fn = stack.pop()
+                for call in lexical_calls(fn):
+                    got = self._resolve_callee(info, call.func)
+                    if got is not None:
+                        seed(got[0], got[1])
+        return on
+
+    def async_reachable_in(self, relpath: str) -> List[FunctionNode]:
+        """Functions of ``relpath`` that run on (or are call-graph-reachable
+        from) the asyncio event loop anywhere in the program."""
+        if self._on_loop is None:
+            self._on_loop = self._compute_on_loop()
+        relpath = relpath.replace(os.sep, "/")
+        return [fn for (mod, fn) in self._on_loop.values()
+                if mod.relpath == relpath]
+
+    # -- mesh-scoped functions (PL012) ----------------------------------------
+    _MESH_BINDERS = {"shard_map", "pmap", "xmap"}
+
+    def _compute_mesh_scoped(self) -> Dict[int, Tuple[ModuleInfo,
+                                                      FunctionNode]]:
+        """Functions executing under a collective-binding transform anywhere
+        in the program: shard_map/pmap/xmap targets (plus vmap targets that
+        bind an ``axis_name``) and everything they transitively call."""
+        from photon_ml_tpu.analysis.dataflow import _timed, lexical_calls
+
+        scoped: Dict[int, Tuple[ModuleInfo, FunctionNode]] = {}
+        stack: List[Tuple[ModuleInfo, FunctionNode]] = []
+
+        def seed(info: ModuleInfo, fn: FunctionNode) -> None:
+            if id(fn) not in scoped:
+                scoped[id(fn)] = (info, fn)
+                stack.append((info, fn))
+
+        with _timed():
+            for info in self.modules.values():
+                if info.tree is None:
+                    continue
+                for node in ast.walk(info.tree):
+                    if not (isinstance(node, ast.Call) and node.args):
+                        continue
+                    fname = dotted_name(node.func)
+                    term = (fname or "").rpartition(".")[2]
+                    binds = term in self._MESH_BINDERS or (
+                        term == "vmap"
+                        and any(kw.arg == "axis_name"
+                                for kw in node.keywords))
+                    if not binds:
+                        continue
+                    target = _unwrap_transform(node.args[0])
+                    if isinstance(target, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef, ast.Lambda)):
+                        seed(info, target)
+                        continue
+                    got = (self._resolve_callee(info, target)
+                           if target is not None else None)
+                    if got is not None:
+                        seed(got[0], got[1])
+            while stack:
+                info, fn = stack.pop()
+                for call in lexical_calls(fn):
+                    got = self._resolve_callee(info, call.func)
+                    if got is not None:
+                        seed(got[0], got[1])
+        return scoped
+
+    def mesh_scoped_in(self, relpath: str) -> List[FunctionNode]:
+        if self._mesh_scoped is None:
+            self._mesh_scoped = self._compute_mesh_scoped()
+        relpath = relpath.replace(os.sep, "/")
+        return [fn for (mod, fn) in self._mesh_scoped.values()
+                if mod.relpath == relpath]
+
+    # -- cross-module donor table (PL014) -------------------------------------
+    def donor_exports(self) -> Dict[str, Dict[str, Tuple[Tuple[int, ...],
+                                                         Tuple[str, ...]]]]:
+        """Per module relpath: symbol -> (donate_argnums, donate_argnames)
+        for every module-level name whose value donates buffers — direct
+        ``jax.jit(..., donate_argnums=...)`` bindings, AOT ``.lower().
+        compile()`` chains over one, and (to a cross-module fixpoint)
+        module-level functions that forward their own parameters into a
+        donated position of another donor."""
+        if self._donor_exports is not None:
+            return self._donor_exports
+        from photon_ml_tpu.analysis.dataflow import _timed
+
+        exports: Dict[str, Dict[str, Tuple[Tuple[int, ...],
+                                           Tuple[str, ...]]]] = {
+            relpath: {} for relpath in self.modules}
+
+        def as_ints(val) -> Tuple[int, ...]:
+            if isinstance(val, bool):
+                return ()
+            if isinstance(val, int):
+                return (val,)
+            if isinstance(val, tuple):
+                return tuple(v for v in val if isinstance(v, int)
+                             and not isinstance(v, bool))
+            return ()
+
+        def as_strs(val) -> Tuple[str, ...]:
+            if isinstance(val, str):
+                return (val,)
+            if isinstance(val, tuple):
+                return tuple(v for v in val if isinstance(v, str))
+            return ()
+
+        def spec_of(info: ModuleInfo, expr: ast.AST, depth: int = 0
+                    ) -> Optional[Tuple[Tuple[int, ...], Tuple[str, ...]]]:
+            if depth > 6 or expr is None:
+                return None
+            if isinstance(expr, ast.Name):
+                return exports[info.relpath].get(expr.id)
+            if isinstance(expr, ast.Call):
+                if is_jit_call(expr):
+                    nums: Tuple[int, ...] = ()
+                    names: Tuple[str, ...] = ()
+                    for kw in expr.keywords:
+                        if kw.arg == "donate_argnums":
+                            nums = as_ints(self.const_value(info, kw.value))
+                        elif kw.arg == "donate_argnames":
+                            names = as_strs(self.const_value(info, kw.value))
+                    return (nums, names) if (nums or names) else None
+                f = expr.func
+                if isinstance(f, ast.Attribute) and f.attr in ("lower",
+                                                               "compile"):
+                    return spec_of(info, f.value, depth + 1)
+                return None
+            if isinstance(expr, ast.Attribute):
+                if expr.attr in ("lower", "compile"):
+                    return spec_of(info, expr.value, depth + 1)
+                dn = dotted_name(expr)
+                if dn is not None and "." in dn:
+                    got = self.resolve_symbol(info, dn)
+                    if got is not None:
+                        mod, sym = got
+                        return exports[mod.relpath].get(sym)
+            return None
+
+        with _timed():
+            # pass 1: direct module-level donor bindings
+            for info in self.modules.values():
+                if info.tree is None:
+                    continue
+                for name, expr in info.constants.items():
+                    spec = spec_of(info, expr)
+                    if spec is not None:
+                        exports[info.relpath][name] = spec
+            # pass 2 (fixpoint): imported donors + derived donor functions —
+            # a module-level fn forwarding its own params into a donated
+            # position exports those positions, across module boundaries
+            changed = True
+            guard = 0
+            while changed and guard < 10:
+                changed = False
+                guard += 1
+                for info in self.modules.values():
+                    if info.tree is None:
+                        continue
+                    for name, expr in info.constants.items():
+                        if name in exports[info.relpath]:
+                            continue
+                        spec = spec_of(info, expr)
+                        if spec is not None:
+                            exports[info.relpath][name] = spec
+                            changed = True
+                    for fname, fn in info.defs.items():
+                        a = fn.args
+                        ordered = [p.arg for p in
+                                   list(a.posonlyargs) + list(a.args)]
+                        nums: Set[int] = set()
+                        old = exports[info.relpath].get(fname)
+                        if old:
+                            nums.update(old[0])
+                        for node in ast.walk(fn):
+                            if not isinstance(node, ast.Call):
+                                continue
+                            spec = spec_of(info, node.func)
+                            if spec is None:
+                                continue
+                            for i, arg in enumerate(node.args):
+                                if i in spec[0] and isinstance(arg, ast.Name) \
+                                        and arg.id in ordered:
+                                    nums.add(ordered.index(arg.id))
+                            for kw in node.keywords:
+                                if kw.arg in spec[1] \
+                                        and isinstance(kw.value, ast.Name) \
+                                        and kw.value.id in ordered:
+                                    nums.add(ordered.index(kw.value.id))
+                        if nums:
+                            new = (tuple(sorted(nums)),
+                                   old[1] if old else ())
+                            if new != old:
+                                exports[info.relpath][fname] = new
+                                changed = True
+        self._donor_exports = exports
+        return exports
 
     def extra_roots(self, relpath: str, base: JitIndex
                     ) -> List[Tuple[FunctionNode, Set[str]]]:

@@ -36,7 +36,8 @@ def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
         by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
     detail = (" (" + ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
               + ")") if by_rule else ""
-    mode = (f", index {result.index_build_s:.2f}s"
+    mode = (f", index {result.index_build_s:.2f}s, "
+            f"dataflow {result.dataflow_s:.2f}s"
             if result.whole_program else ", per-module mode")
     out.append(
         f"photonlint: {result.files_scanned} files scanned, "
@@ -69,6 +70,7 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
             "files_scanned": result.files_scanned,
             "whole_program": result.whole_program,
             "index_build_s": round(result.index_build_s, 4),
+            "dataflow_s": round(result.dataflow_s, 4),
             "by_rule": _counts(new, lambda v: v.rule),
             "by_severity": _counts(new, lambda v: v.severity),
         },

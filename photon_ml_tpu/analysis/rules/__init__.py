@@ -1,21 +1,30 @@
 """photonlint rule catalog — importing this package registers every rule.
 
-| code  | rule                | guards                                       |
-|-------|---------------------|----------------------------------------------|
-| PL001 | host-sync           | device→host syncs inside jit-traced code     |
-| PL002 | recompile-hazard    | per-call / per-iteration jit construction    |
-| PL003 | tracer-safety       | Python control flow on traced values         |
-| PL004 | dtype-discipline    | float64 / numpy promotion on TPU hot paths   |
-| PL005 | lock-discipline     | unlocked mutation of lock-protected state    |
-| PL006 | donation-after-use  | reads of buffers already donated to jit      |
-| PL007 | mesh-axis           | collective axis names absent from the mesh   |
-| PL008 | sharding-annotation | unannotated mesh-path jits / bad spec axes   |
-| PL009 | swallowed-exception | silent broad except in daemon/async workers  |
-| PL010 | span-discipline     | trace spans discarded / escaping / unclosed  |
+| code  | rule                  | guards                                       |
+|-------|-----------------------|----------------------------------------------|
+| PL001 | host-sync             | device→host syncs inside jit-traced code     |
+| PL002 | recompile-hazard      | per-call / per-iteration jit construction    |
+| PL003 | tracer-safety         | Python control flow on traced values         |
+| PL004 | dtype-discipline      | float64 / numpy promotion on TPU hot paths   |
+| PL005 | lock-discipline       | unlocked mutation of lock-protected state    |
+| PL006 | donation-after-use    | reads of buffers already donated to jit      |
+| PL007 | mesh-axis             | collective axis names absent from the mesh   |
+| PL008 | sharding-annotation   | unannotated mesh-path jits / bad spec axes   |
+| PL009 | swallowed-exception   | silent broad except in daemon/async workers  |
+| PL010 | span-discipline       | trace spans discarded / escaping / unclosed  |
+| PL011 | shard-spec-arity      | shard_map specs vs target arity / site mesh  |
+| PL012 | collective-without-mesh | collectives jit-reachable with no binder   |
+| PL013 | blocking-in-async     | blocking calls on the asyncio event loop     |
+| PL014 | cross-module-donation | donated-buffer reads across module imports   |
 
 PL001/PL003/PL004 are trace-scoped: in whole-program mode (the default) the
 ProgramIndex resolves functions jitted across module boundaries, so they
 fire on helpers defined in one file and jitted in another.
+
+PL005/PL012/PL013 are dataflow-backed (analysis/dataflow.py): a per-function
+CFG fixpoint supplies alias sets, and module/program call graphs supply
+event-loop and mesh-scope reachability.  PL014 reuses PL006's taint scanner
+over the ProgramIndex's program-wide donor table.
 """
 
 from photon_ml_tpu.analysis.rules.host_sync import HostSyncRule
@@ -28,6 +37,10 @@ from photon_ml_tpu.analysis.rules.mesh_axis import MeshAxisRule
 from photon_ml_tpu.analysis.rules.sharding import ShardingAnnotationRule
 from photon_ml_tpu.analysis.rules.swallowed import SwallowedExceptionRule
 from photon_ml_tpu.analysis.rules.span_discipline import SpanDisciplineRule
+from photon_ml_tpu.analysis.rules.shard_spec import ShardSpecArityRule
+from photon_ml_tpu.analysis.rules.collective_ctx import CollectiveContextRule
+from photon_ml_tpu.analysis.rules.blocking_async import BlockingInAsyncRule
+from photon_ml_tpu.analysis.rules.donation_flow import CrossModuleDonationRule
 
 __all__ = [
     "HostSyncRule",
@@ -40,4 +53,8 @@ __all__ = [
     "ShardingAnnotationRule",
     "SwallowedExceptionRule",
     "SpanDisciplineRule",
+    "ShardSpecArityRule",
+    "CollectiveContextRule",
+    "BlockingInAsyncRule",
+    "CrossModuleDonationRule",
 ]

@@ -1,0 +1,130 @@
+"""PL013 blocking-in-async: no blocking calls on the asyncio event loop.
+
+Why it matters here: the serving frontend (``serving/frontend/server.py``)
+and the replication plane (``online/replication/``) each run ONE event loop
+that every connection shares.  A single ``time.sleep``, sync file read, or
+``Future.result()`` on that loop stalls every in-flight request — the
+Spark-ML performance literature's driver-bottleneck failure mode, ported to
+asyncio.  These bugs pass every test (tests rarely run enough concurrent
+load to notice a 10ms stall) and surface as fleet-wide p99 cliffs.
+
+Flagged: calls from the blocking catalog —
+
+  - ``time.sleep``, ``os.system``, ``subprocess.run/call/check_*``,
+    ``socket.create_connection``, ``urllib.request.urlopen``,
+    ``shutil.rmtree``/``copytree`` (dotted names);
+  - the ``open(...)`` / ``input(...)`` builtins (sync file I/O);
+  - ``<x>.result(...)`` (``concurrent.futures`` blocks until done) and
+    ``<x>.acquire(...)`` (a sync lock) — except when directly awaited
+    (``await lock.acquire()`` is the asyncio primitive);
+
+when the call executes on the event loop, which the dataflow layer proves
+three ways:
+
+  - lexically inside an ``async def`` body;
+  - inside a callback scheduled onto the loop (``loop.call_soon`` /
+    ``call_soon_threadsafe`` / ``call_later`` / ``call_at`` targets);
+  - inside a SYNC function the (module-local or cross-module) call graph
+    shows is called from either of the above.
+
+Hand-offs are exempt by construction: ``await loop.run_in_executor(None,
+fn, ...)`` / ``asyncio.to_thread(fn)`` / ``Thread(target=fn)`` pass ``fn``
+as a REFERENCE, not a call, so reachability never propagates into it.  The
+sanctioned fixes are exactly those hand-offs (or ``call_soon_threadsafe``
+from foreign threads).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from photon_ml_tpu.analysis.dataflow import (_LOOP_SCHEDULERS, lexical_calls,
+                                             loop_callback_exprs)
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import FunctionNode, dotted_name
+
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.system", "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "urllib.request.urlopen",
+    "shutil.rmtree", "shutil.copytree",
+}
+_BLOCKING_BUILTINS = {"open", "input"}
+# attribute calls that block: Future.result() / Lock.acquire() — exempt
+# when directly awaited (asyncio primitives)
+_BLOCKING_ATTRS = {
+    "result": "concurrent.futures result() blocks until the future settles",
+    "acquire": "a synchronous lock acquire blocks the whole loop",
+}
+
+
+def _blocking_reason(node: ast.Call, ctx: ModuleContext) -> Optional[str]:
+    f = node.func
+    dn = dotted_name(f)
+    if dn in _BLOCKING_DOTTED:
+        return f"{dn}() is synchronous"
+    if isinstance(f, ast.Name) and f.id in _BLOCKING_BUILTINS:
+        return f"builtin {f.id}() does blocking I/O"
+    if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+        if isinstance(ctx.resolver.parent(node), ast.Await):
+            return None  # await x.acquire() — the asyncio form
+        return _BLOCKING_ATTRS[f.attr]
+    return None
+
+
+@register
+class BlockingInAsyncRule(Rule):
+    name = "blocking-in-async"
+    code = "PL013"
+    severity = "error"
+    description = ("no blocking calls (sleep/sync I/O/result()/acquire()) "
+                   "on the asyncio event loop, through any call chain")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        # textual precheck before building the call graph: module-local
+        # loop seeds need `async def` or a scheduler call, and without
+        # those only cross-module reachability can put a function on the
+        # loop — ask the (once-per-run) program table directly
+        src = ctx.source
+        if "async" not in src and not any(s in src
+                                          for s in _LOOP_SCHEDULERS):
+            if ctx.program is None \
+                    or not ctx.program.async_reachable_in(ctx.relpath):
+                return
+        on_loop = ctx.dataflow.event_loop_fns()
+        if not on_loop:
+            return
+        # candidate bodies: every def in the module plus scheduled lambdas
+        candidates: List[FunctionNode] = list(ctx.dataflow.call_graph.fns)
+        candidates.extend(cb for cb in loop_callback_exprs(ctx.tree)
+                          if isinstance(cb, ast.Lambda))
+        seen = set()
+        for fn in candidates:
+            if id(fn) not in on_loop or id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx: ModuleContext,
+                  fn: FunctionNode) -> Iterator[Violation]:
+        if isinstance(fn, ast.AsyncFunctionDef):
+            where = f"inside `async def {fn.name}`"
+        elif isinstance(fn, ast.Lambda):
+            where = "in a callback scheduled onto the event loop"
+        else:
+            where = (f"in `{fn.name}`, which the call graph shows runs on "
+                     "the event loop")
+        for call in lexical_calls(fn):
+            reason = _blocking_reason(call, ctx)
+            if reason is None:
+                continue
+            yield ctx.violation(
+                self, call,
+                f"blocking call {where}: {reason} — it stalls every "
+                "coroutine sharing this loop; hand it off with `await "
+                "loop.run_in_executor(...)` / `asyncio.to_thread(...)` "
+                "(threads signal back via `call_soon_threadsafe`)")

@@ -1,0 +1,148 @@
+"""PL012 collective-without-mesh: collectives in traced code need a
+binding context.
+
+Why it matters here: ``jax.lax.psum``/``all_gather``/``ppermute`` only mean
+something when the surrounding trace binds the named axis — a ``shard_map``
+/ ``pmap`` / ``xmap`` target (or a ``vmap`` with ``axis_name=``).  A
+collective that is reachable from a plain ``jax.jit`` root with NO such
+binding anywhere on its call path raises ``NameError: unbound axis`` at
+trace time — but only when that jit path actually executes, which for the
+sharded serving kernels means "on the pod, under traffic", not in the CPU
+unit tests.  The refactor hazard is real: hoisting a helper out of a
+``shard_map`` target (or jitting a function that was only ever called from
+inside one) silently severs the binding.
+
+Using the dataflow layer this rule flags every collective call site that
+
+  - executes under a jit trace (the per-module ``JitIndex`` walk, augmented
+    with the ProgramIndex's cross-module traced roots), and
+  - is NOT lexically inside a shard_map/pmap/xmap/vmap-with-axis_name
+    target, NOT inside a ``with <mesh>:`` block, and NOT inside a function
+    the (module-local or program-wide) call graph shows is only entered
+    from such a target.
+
+Unresolvable targets contribute exemptions, not findings — the usual
+conservative direction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import (FunctionNode, _unwrap_transform,
+                                              dotted_name)
+from photon_ml_tpu.analysis.rules.mesh_axis import (_bare_lax_collectives,
+                                                    _COLLECTIVES,
+                                                    _def_in_scope_chain)
+
+_MESH_BINDER_TERMINALS = {"shard_map", "pmap", "xmap"}
+_MESH_WITH_TERMINALS = {"Mesh", "use_mesh", "set_mesh"}
+
+
+def collective_call_name(node: ast.Call, bare) -> Optional[str]:
+    """The collective's name when ``node`` is a collective call (axis
+    argument present or not), else None."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    prefix, _, term = name.rpartition(".")
+    if prefix:
+        if not (prefix == "lax" or prefix.endswith(".lax")):
+            return None
+        return name if term in _COLLECTIVES else None
+    return name if bare.get(name) else None
+
+
+def _is_mesh_binder(call: ast.Call) -> bool:
+    fname = dotted_name(call.func)
+    term = (fname or "").rpartition(".")[2]
+    if term in _MESH_BINDER_TERMINALS:
+        return True
+    return term == "vmap" and any(kw.arg == "axis_name"
+                                  for kw in call.keywords)
+
+
+def _mesh_with_context(item: ast.withitem) -> bool:
+    """``with mesh:`` / ``with self.mesh:`` / ``with Mesh(...):`` /
+    ``with jax.sharding.use_mesh(m):`` — loose on purpose (quietness
+    bias)."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        term = (dotted_name(expr.func) or "").rpartition(".")[2]
+        return term in _MESH_WITH_TERMINALS
+    leaf = (dotted_name(expr) or "").rpartition(".")[2].lower()
+    return "mesh" in leaf
+
+
+@register
+class CollectiveContextRule(Rule):
+    name = "collective-without-mesh"
+    code = "PL012"
+    severity = "error"
+    description = ("collectives reachable from a jit root need an enclosing "
+                   "shard_map/pmap/mesh context somewhere on the call path")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        # findings anchor on collective calls — skip modules whose text
+        # never names one
+        if not any(c in ctx.source for c in _COLLECTIVES):
+            return
+        bare = _bare_lax_collectives(ctx.tree)
+        traced = ctx.dataflow.traced_node_ids()
+        if not traced:
+            return
+        exempt = self._exempt_ids(ctx)
+        for node in ctx.nodes_of(ast.Call):
+            name = collective_call_name(node, bare)
+            if name is None:
+                continue
+            if id(node) not in traced or id(node) in exempt:
+                continue
+            yield ctx.violation(
+                self, node,
+                f"{name} is reachable from a jit root but no shard_map/"
+                "pmap/mesh context binds its axis on this call path — the "
+                "trace fails with an unbound axis name exactly when this "
+                "path first runs on the real mesh; keep the collective "
+                "inside the shard_map target (or bind the axis at this "
+                "jit boundary)")
+
+    def _exempt_ids(self, ctx: ModuleContext) -> Set[int]:
+        """ids of nodes that DO have a binding context."""
+        out: Set[int] = set()
+        seeds = []
+        for call in ctx.nodes_of(ast.With, ast.AsyncWith, ast.Call):
+            is_with = isinstance(call, (ast.With, ast.AsyncWith))
+            if is_with and any(_mesh_with_context(i) for i in call.items):
+                for sub in ast.walk(call):
+                    out.add(id(sub))
+                continue
+            if not (isinstance(call, ast.Call) and call.args
+                    and _is_mesh_binder(call)):
+                continue
+            target = _unwrap_transform(call.args[0])
+            if isinstance(target, ast.Name):
+                target = _def_in_scope_chain(ctx, call, target.id)
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self":
+                target = ctx.dataflow.call_graph.resolve(target)
+            if isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                seeds.append(target)
+        # everything the binder targets transitively call is mesh-scoped
+        scoped = ctx.dataflow.call_graph.reachable(seeds)
+        fns: list = [fn for fn in ctx.dataflow.call_graph.fns
+                     if id(fn) in scoped]
+        fns.extend(s for s in seeds if isinstance(s, ast.Lambda))
+        if ctx.program is not None:
+            fns.extend(ctx.program.mesh_scoped_in(ctx.relpath))
+        for fn in fns:
+            for sub in ast.walk(fn):
+                out.add(id(sub))
+        return out

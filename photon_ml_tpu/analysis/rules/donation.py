@@ -276,6 +276,13 @@ class DonationRule(Rule):
     def check(self, ctx: ModuleContext) -> Iterator[Violation]:
         if ctx.tree is None:
             return
+        # every DonateSpec roots in a jit call carrying donate_argnums /
+        # donate_argnames (module-level, method-local, or scope-local — the
+        # .lower()/.compile() and derived-donor chains only FORWARD specs),
+        # so a module whose text never names them cannot produce one; skip
+        # the O(scopes × stmts) scan outright
+        if "donate_arg" not in ctx.source:
+            return
         # pass 1: module-level donating names + methods returning donors
         module_donors: Dict[str, DonateSpec] = {}
         probe = _ScopeScanner(self, ctx, {}, {}, ())

@@ -1,0 +1,137 @@
+"""PL014 cross-module-donation: PL006's donated-buffer taint, propagated
+through the ProgramIndex call graph.
+
+Why it matters here: the donation contracts in this codebase deliberately
+cross module boundaries — ``utils/transfer.py`` exports helpers that donate
+their buffer argument into a ``lax.dynamic_update_slice`` executable, and
+``serving/``/``stream/`` call them from other files.  PL006 is per-module
+by design: it sees ``f = jax.jit(fn, donate_argnums=0)`` and flags reads
+after ``f(x)`` in the SAME file, but a caller in another module that reads
+a buffer after passing it to an imported donating helper is invisible to
+it.  That is precisely the "passes every CPU test, corrupts data on the
+pod" hazard donation creates (CPU jax ignores donation; TPU reuses the
+buffer).
+
+The ProgramIndex computes a program-wide donor table
+(:meth:`~photon_ml_tpu.analysis.program_index.ProgramIndex.donor_exports`):
+module-level jit bindings with ``donate_argnums``/``donate_argnames``, AOT
+``.lower().compile()`` chains over them, and — to a cross-module fixpoint —
+functions that forward their own parameters into a donated position (so a
+chain ``a.update → b._update_at → jitted donor`` donates through two
+imports).  This rule then reruns PL006's scope scanner per module with ONLY
+the cross-module donors seeded (imported names and ``module.fn`` dotted
+references); local donors stay PL006's, so the two rules never double-
+report.  Requires whole-program mode; per-module runs stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import dotted_name
+from photon_ml_tpu.analysis.rules.donation import DonateSpec, _ScopeScanner
+
+
+class _CrossModuleScanner(_ScopeScanner):
+    """PL006's scanner, extended to resolve ``module.fn`` dotted callees
+    through the program's donor table."""
+
+    def __init__(self, rule, ctx, donors, fn_params, xresolve):
+        super().__init__(rule, ctx, donors, {}, fn_params)
+        self._xresolve = xresolve
+
+    def _spec_of_expr(self, expr: ast.AST, depth: int = 0
+                      ) -> Optional[DonateSpec]:
+        spec = super()._spec_of_expr(expr, depth)
+        if spec is not None:
+            return spec
+        if isinstance(expr, ast.Attribute):
+            dn = dotted_name(expr)
+            if dn is not None and "." in dn and not dn.startswith("self."):
+                return self._xresolve(dn)
+        return None
+
+    def _donate_name(self, arg: ast.Name, donor: str) -> None:
+        # taint only — no function-boundary warning here: forwarding an own
+        # parameter into an imported donor is the sanctioned wrapper pattern
+        # the program-wide fixpoint models (the wrapper becomes a derived
+        # donor and ITS callers are checked); the actionable cross-module
+        # finding is the read-after-donate error
+        self.tainted[arg.id] = (arg.lineno, donor)
+
+
+@register
+class CrossModuleDonationRule(Rule):
+    name = "cross-module-donation"
+    code = "PL014"
+    severity = "error"
+    description = ("no reads of a buffer after donating it through an "
+                   "imported (cross-module) donating callable")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None or ctx.program is None:
+            return
+        info = ctx.program.modules.get(ctx.relpath)
+        if info is None:
+            return
+        exports = ctx.program.donor_exports()
+
+        def spec_for(mod_relpath: str, sym: str) -> Optional[DonateSpec]:
+            got = exports.get(mod_relpath, {}).get(sym)
+            if got is None:
+                return None
+            spec = DonateSpec(argnums=tuple(got[0]), argnames=tuple(got[1]))
+            return spec if spec else None
+
+        # imported names bound to donors defined in ANOTHER module
+        donors: Dict[str, DonateSpec] = {}
+        for bound in info.imports:
+            got = ctx.program.resolve_symbol(info, bound)
+            if got is None:
+                continue
+            mod, sym = got
+            if mod.relpath == ctx.relpath:
+                continue  # local donor — PL006's jurisdiction
+            spec = spec_for(mod.relpath, sym)
+            if spec is not None:
+                donors[bound] = spec
+
+        def xresolve(dn: str) -> Optional[DonateSpec]:
+            """``alias.fn`` dotted reference -> cross-module donor spec."""
+            got = ctx.program.resolve_symbol(info, dn)
+            if got is None:
+                return None
+            mod, sym = got
+            if mod.relpath == ctx.relpath:
+                return None
+            return spec_for(mod.relpath, sym)
+
+        # precheck: the scanner is the expensive part, and a module can only
+        # trip this rule by reaching a donor-exporting module through its
+        # import table (bound names above, or `alias.fn` dotted references
+        # below) — skip the scan entirely otherwise
+        if not donors:
+            exporting = {name for name, m in ctx.program.by_name.items()
+                         if exports.get(m.relpath)}
+            reach = any(en == tm or en.startswith(tm + ".")
+                        for tm, _sym in info.imports.values()
+                        for en in exporting)
+            if not reach:
+                return
+        yield from self._scan(ctx, ctx.tree.body, donors, (), xresolve)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                params = [p.arg for p in list(a.posonlyargs) + list(a.args)
+                          + list(a.kwonlyargs)]
+                yield from self._scan(ctx, node.body, donors, params,
+                                      xresolve)
+
+    def _scan(self, ctx, body, donors, params, xresolve
+              ) -> Iterator[Violation]:
+        scanner = _CrossModuleScanner(self, ctx, donors, params, xresolve)
+        scanner.run(body)
+        yield from scanner.violations

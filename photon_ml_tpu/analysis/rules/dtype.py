@@ -86,7 +86,8 @@ class DtypeDisciplineRule(Rule):
         if ctx.tree is None or not _in_hot_path(ctx.relpath):
             return
         # module-wide: jnp.float64 and float64 dtype args in jnp calls
-        for node in ast.walk(ctx.tree):
+        # (dotted_name only resolves Attribute chains; calls carry dtype=)
+        for node in ctx.nodes_of(ast.Attribute, ast.Call):
             name = dotted_name(node)
             if name is not None:
                 alias, attr = _split_alias(name)

@@ -27,6 +27,19 @@ assignment/deletion ``self.X[k]``, calls of mutating container methods
 self.X, v)`` / ``op.setitem(self.X, k, v)`` through any import alias —
 which mutate exactly like ``+=`` / ``self.X[k] = v`` but previously slipped
 past the target extraction.
+
+v2 (dataflow-backed): mutation targets and lock context managers are now
+resolved through the per-method alias analysis in ``analysis/dataflow.py``.
+Two escape hatches the purely-syntactic v1 missed are closed:
+
+  - **alias mutation** — ``store = self._store; store.table = ...`` (or
+    ``store[k] = v`` / ``store.update(...)``) mutates the same object as
+    ``self._store.…``; the local name's alias set identifies the root
+    attribute, so the site participates in lock discipline.  Chained
+    targets like ``self._store.table[k] = v`` root at ``_store`` too.
+  - **alias locking** — ``lock = self._lock; with lock:`` counts as
+    holding the class lock, so correctly-locked code that names the lock
+    locally no longer produces false positives.
 """
 
 from __future__ import annotations
@@ -118,11 +131,13 @@ class _MethodScanner(ast.NodeVisitor):
 
     def __init__(self, method_name: str, locks: Set[str],
                  op_modules: Set[str] = frozenset(),
-                 op_funcs: Optional[Dict[str, str]] = None):
+                 op_funcs: Optional[Dict[str, str]] = None,
+                 flow=None):
         self.method = method_name
         self.locks = locks
         self.op_modules = op_modules
         self.op_funcs = op_funcs or {}
+        self.flow = flow  # FunctionFlow for alias queries (None = v1 mode)
         self.depth = 0
         self.took_lock = False
         self.sites: List[_Site] = []
@@ -133,9 +148,36 @@ class _MethodScanner(ast.NodeVisitor):
         self.sites.append(_Site(attr=attr, method=self.method,
                                 locked=self.depth > 0, node=node, kind=kind))
 
+    def _roots(self, obj: ast.AST) -> Set[str]:
+        """The self-attribute(s) whose object ``obj`` reaches: walk the
+        attribute/subscript chain to its base — ``self`` roots at the
+        innermost attribute (``self._store.table[k]`` -> ``_store``), any
+        other name roots at its dataflow alias set (``store = self._store``
+        makes ``store.…`` root at ``_store``)."""
+        node, chain_attr = obj, None
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            if isinstance(node, ast.Attribute):
+                chain_attr = node.attr
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return set()
+        if node.id == "self":
+            return {chain_attr} if chain_attr is not None else set()
+        if self.flow is not None:
+            return set(self.flow.attr_aliases(node.id, obj))
+        return set()
+
     # -- lock scope --------------------------------------------------------
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        if _self_attr(expr) in self.locks:
+            return True
+        # `lock = self._lock; with lock:` — holding through an alias
+        return (isinstance(expr, ast.Name) and self.flow is not None
+                and bool(self.flow.attr_aliases(expr.id, expr)
+                         & self.locks))
+
     def visit_With(self, node: ast.With) -> None:
-        is_lock = any(_self_attr(i.context_expr) in self.locks
+        is_lock = any(self._is_lock_expr(i.context_expr)
                       for i in node.items)
         if is_lock:
             self.took_lock = True
@@ -152,17 +194,22 @@ class _MethodScanner(ast.NodeVisitor):
 
     # -- mutations ---------------------------------------------------------
     def _target(self, tgt: ast.AST) -> None:
-        attr = _self_attr(tgt)
-        if attr is not None:
-            self._add(attr, tgt, "assign")
-        elif isinstance(tgt, ast.Subscript):
-            self._add(_self_attr(tgt.value), tgt, "item")
-        elif isinstance(tgt, (ast.Tuple, ast.List)):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
             for elt in tgt.elts:
                 self._target(elt)
-        elif isinstance(tgt, ast.Starred):
+            return
+        if isinstance(tgt, ast.Starred):
             # `self.head, *self.rest = xs` — the starred slot rebinds too
             self._target(tgt.value)
+            return
+        if isinstance(tgt, ast.Attribute):
+            kind = "assign"
+        elif isinstance(tgt, ast.Subscript):
+            kind = "item"
+        else:
+            return
+        for attr in self._roots(tgt):
+            self._add(attr, tgt, kind)
 
     def visit_Assign(self, node: ast.Assign) -> None:
         for tgt in node.targets:
@@ -181,15 +228,18 @@ class _MethodScanner(ast.NodeVisitor):
     def visit_Delete(self, node: ast.Delete) -> None:
         for tgt in node.targets:
             if isinstance(tgt, ast.Subscript):
-                self._add(_self_attr(tgt.value), tgt, "item")
+                for attr in self._roots(tgt):
+                    self._add(attr, tgt, "item")
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         f = node.func
         if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
-            self._add(_self_attr(f.value), node, "call")
+            for attr in self._roots(f.value):
+                self._add(attr, node, "call")
         elif self._is_op_mutator(f) and node.args:
-            self._add(_self_attr(node.args[0]), node, "call")
+            for attr in self._roots(node.args[0]):
+                self._add(attr, node, "call")
         self.generic_visit(node)
 
     def _is_op_mutator(self, f: ast.AST) -> bool:
@@ -214,9 +264,8 @@ class LockDisciplineRule(Rule):
         if ctx.tree is None:
             return
         op_modules, op_funcs = _operator_aliases(ctx.tree)
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ClassDef):
-                yield from self._check_class(ctx, node, op_modules, op_funcs)
+        for node in ctx.nodes_of(ast.ClassDef):
+            yield from self._check_class(ctx, node, op_modules, op_funcs)
 
     def _check_class(self, ctx: ModuleContext, cls: ast.ClassDef,
                      op_modules: Set[str],
@@ -231,7 +280,8 @@ class LockDisciplineRule(Rule):
                 continue
             if item.name in _EXEMPT_METHODS:
                 continue
-            scanner = _MethodScanner(item.name, locks, op_modules, op_funcs)
+            scanner = _MethodScanner(item.name, locks, op_modules, op_funcs,
+                                     flow=ctx.dataflow.function_flow(item))
             # generic_visit: enter the method body without tripping the
             # nested-def skip on the method node itself
             scanner.generic_visit(item)

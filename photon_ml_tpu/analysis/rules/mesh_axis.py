@@ -158,6 +158,10 @@ class MeshAxisRule(Rule):
     def check(self, ctx: ModuleContext) -> Iterator[Violation]:
         if ctx.tree is None:
             return
+        # findings anchor on collective calls — skip modules whose text
+        # never names one (the overwhelmingly common case)
+        if not any(c in ctx.source for c in _COLLECTIVES):
+            return
         universe = axis_universe(ctx)
         bound = _shard_map_bindings(ctx)
         bare = _bare_lax_collectives(ctx.tree)

@@ -1,0 +1,189 @@
+"""PL011 shard-spec-arity: shard_map in_specs/out_specs must match the
+target's signature and the mesh they are bound to.
+
+Why it matters here: every sharded scoring/solve kernel in ``parallel/``
+and ``serving/engine.py`` is a ``shard_map(local, mesh=..., in_specs=(...),
+out_specs=(...))`` site.  jax checks the spec/args pytree match only when
+the wrapped callable is CALLED — and the arity errors it raises at that
+point name pytree paths, not source lines.  Worse, a spec tuple that is
+the wrong LENGTH for the local function is often silently "fixed" during a
+refactor by whoever adds the next argument, while the axis strings inside
+drift from the mesh they run on (which only fails on the pod — same
+failure class PL007/PL008 police).  This rule checks, statically, at the
+shard_map call site:
+
+  - ``in_specs``: when written as a literal tuple and the target function
+    is resolvable (inline def/lambda, or a Name defined in an enclosing
+    scope) with a fixed positional signature, the tuple length must equal
+    the number of positional parameters;
+  - ``out_specs``: when written as a literal tuple and every ``return`` of
+    the target is a literal tuple of one consistent length, the lengths
+    must agree (a single non-tuple out_spec is a valid pytree prefix and
+    stays quiet);
+  - each ``P(...)``/``PartitionSpec(...)`` inside the specs: no mesh axis
+    may appear twice in one spec, and every definitely-resolved axis name
+    must be an axis of the mesh bound at THIS site when that mesh
+    expression resolves to a ``Mesh(...)`` construction (the program-wide
+    universe membership check for unresolvable meshes is PL008's).
+
+Resolution is best-effort through analysis/resolve.py and the ProgramIndex
+mesh universe; anything unresolvable stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import (FunctionNode, _unwrap_transform,
+                                              dotted_name)
+from photon_ml_tpu.analysis.resolve import mesh_axes_of_expr
+from photon_ml_tpu.analysis.rules.mesh_axis import (_def_in_scope_chain,
+                                                    _SHARD_MAP_TERMINALS)
+from photon_ml_tpu.analysis.rules.sharding import (_is_pspec_call,
+                                                   _pspec_aliases)
+
+
+def _arg_or_kw(call: ast.Call, name: str, pos: int) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _positional_param_count(fn: FunctionNode) -> Optional[int]:
+    a = fn.args
+    if a.vararg is not None or a.kwarg is not None:
+        return None  # variadic: any spec arity can be legal
+    return len(a.posonlyargs) + len(a.args)
+
+
+def _return_tuple_len(fn: FunctionNode) -> Optional[int]:
+    """Length of the target's literal return tuple when EVERY lexical return
+    is a tuple of the same length (None = unknown / inconsistent input —
+    stay quiet)."""
+    values: List[ast.expr] = []
+    if isinstance(fn, ast.Lambda):
+        values = [fn.body]
+    else:
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Return) and node.value is not None:
+                values.append(node.value)
+            stack.extend(ast.iter_child_nodes(node))
+    if not values:
+        return None
+    lens: Set[int] = set()
+    for v in values:
+        if not isinstance(v, ast.Tuple):
+            return None
+        lens.add(len(v.elts))
+    return lens.pop() if len(lens) == 1 else None
+
+
+def _definite_spec_axes(ctx: ModuleContext,
+                        spec: ast.Call) -> List[Tuple[str, ast.expr]]:
+    """(axis, arg-expr) pairs for spec arguments whose resolution is
+    DEFINITE (exactly one possible string) — ambiguous args are skipped so
+    alternatives never manufacture duplicates."""
+    out: List[Tuple[str, ast.expr]] = []
+    for arg in spec.args:
+        if isinstance(arg, ast.Starred):
+            continue
+        got = ctx.resolver.strings(arg)
+        if len(got) == 1:
+            out.append((got[0], arg))
+    return out
+
+
+@register
+class ShardSpecArityRule(Rule):
+    name = "shard-spec-arity"
+    code = "PL011"
+    severity = "error"
+    description = ("shard_map in_specs/out_specs arity must match the "
+                   "target signature and name axes of the bound mesh")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        # findings anchor on shard_map call sites — skip modules whose
+        # text never names one
+        if not any(t in ctx.source for t in _SHARD_MAP_TERMINALS):
+            return
+        aliases = _pspec_aliases(ctx.tree)
+        for call in ctx.nodes_of(ast.Call):
+            if not call.args:
+                continue
+            fname = dotted_name(call.func)
+            if fname is None \
+                    or fname.rpartition(".")[2] not in _SHARD_MAP_TERMINALS:
+                continue
+            yield from self._check_site(ctx, call, aliases)
+
+    def _check_site(self, ctx: ModuleContext, call: ast.Call,
+                    aliases: Set[str]) -> Iterator[Violation]:
+        target = _unwrap_transform(call.args[0])
+        if isinstance(target, ast.Name):
+            target = _def_in_scope_chain(ctx, call, target.id)
+        fn = target if isinstance(target, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.Lambda)) else None
+        in_specs = _arg_or_kw(call, "in_specs", 2)
+        out_specs = _arg_or_kw(call, "out_specs", 3)
+        tname = getattr(fn, "name", "<target>") if fn is not None else None
+
+        if fn is not None and isinstance(in_specs, ast.Tuple):
+            n_params = _positional_param_count(fn)
+            if n_params is not None and len(in_specs.elts) != n_params:
+                yield ctx.violation(
+                    self, in_specs,
+                    f"shard_map in_specs has {len(in_specs.elts)} spec(s) "
+                    f"but `{tname}` takes {n_params} positional "
+                    "argument(s) — the pytree/spec mismatch only surfaces "
+                    "when the wrapped callable is invoked, far from this "
+                    "site")
+        if fn is not None and isinstance(out_specs, ast.Tuple):
+            n_out = _return_tuple_len(fn)
+            if n_out is not None and len(out_specs.elts) != n_out:
+                yield ctx.violation(
+                    self, out_specs,
+                    f"shard_map out_specs has {len(out_specs.elts)} spec(s) "
+                    f"but `{tname}` returns a {n_out}-tuple — every output "
+                    "leaf needs a spec (or use a single pytree-prefix spec)")
+
+        mesh_expr = _arg_or_kw(call, "mesh", 1)
+        site_axes = (mesh_axes_of_expr(ctx.resolver, mesh_expr)
+                     if mesh_expr is not None else set())
+        for specs in (in_specs, out_specs):
+            if specs is None:
+                continue
+            for node in ast.walk(specs):
+                if not _is_pspec_call(node, aliases):
+                    continue
+                definite = _definite_spec_axes(ctx, node)
+                seen: Set[str] = set()
+                for axis, arg in definite:
+                    if axis in seen:
+                        yield ctx.violation(
+                            self, arg,
+                            f"mesh axis '{axis}' appears more than once in "
+                            "this PartitionSpec — an axis may shard at most "
+                            "one dimension; this spec is rejected on any "
+                            "real mesh")
+                    seen.add(axis)
+                    if site_axes and axis not in site_axes:
+                        yield ctx.violation(
+                            self, arg,
+                            f"PartitionSpec axis '{axis}' is not an axis of "
+                            "the mesh bound at this shard_map site (axes: "
+                            f"{sorted(site_axes)}) — the spec only fails "
+                            "when this program runs on its real mesh")

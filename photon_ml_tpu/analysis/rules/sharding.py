@@ -101,9 +101,7 @@ class ShardingAnnotationRule(Rule):
         universe = axis_universe(ctx)
         paired: Set[int] = set()  # P(...) nodes validated against their mesh
         # -- NamedSharding(mesh, spec): validate spec against THAT mesh ------
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes_of(ast.Call):
             fname = dotted_name(node.func)
             if fname is None \
                     or fname.rpartition(".")[2] != "NamedSharding":
@@ -131,7 +129,7 @@ class ShardingAnnotationRule(Rule):
                         "this NamedSharding fails on any real mesh")
         # -- every other PartitionSpec: validate against the universe --------
         if universe:
-            for node in ast.walk(ctx.tree):
+            for node in ctx.nodes_of(ast.Call):
                 if not _is_pspec_call(node, aliases) or id(node) in paired:
                     continue
                 for axis, arg in _spec_axis_strings(ctx, node):
@@ -146,7 +144,8 @@ class ShardingAnnotationRule(Rule):
         if not _on_mesh_path(ctx.relpath):
             return
         flagged: Set[int] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Call):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     if dotted_name(dec) in JIT_NAMES:

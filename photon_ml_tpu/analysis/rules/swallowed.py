@@ -106,12 +106,10 @@ def _cleanup_only(try_node: ast.Try) -> bool:
     return bool(try_node.body)
 
 
-def _thread_targets(tree: ast.Module) -> Set[str]:
+def _thread_targets(calls) -> Set[str]:
     """Function/method names passed as ``target=`` to a Thread(...)."""
     out: Set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in calls:
         callee = (dotted_name(node.func) or "").rpartition(".")[2]
         if callee != "Thread":
             continue
@@ -137,14 +135,12 @@ class SwallowedExceptionRule(Rule):
         tree = ctx.tree
         if tree is None:
             return
-        targets = _thread_targets(tree)
-        for fn in ast.walk(tree):
+        targets = _thread_targets(ctx.nodes_of(ast.Call))
+        for fn in ctx.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
             if isinstance(fn, ast.AsyncFunctionDef):
                 detached = True
-            elif isinstance(fn, ast.FunctionDef):
-                detached = fn.name in targets
             else:
-                continue
+                detached = fn.name in targets
             if not detached:
                 continue
             yield from self._check_body(ctx, fn)

@@ -143,6 +143,10 @@ class ReplicationClient:
 
     def _save_state(self) -> None:
         tmp = self._state_path + ".tmp"
+        # photonlint: disable=blocking-in-async -- ~100-byte atomic
+        # state-file write on the spool volume; an executor hop costs more
+        # than the write, and the floor/base pair must be durable before
+        # the snapshot is acted on
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"floor": self.floor, "base": self.model_dir}, f)
         os.replace(tmp, self._state_path)
@@ -348,7 +352,11 @@ class ReplicationClient:
         self._snapshot_seq += 1
         dest = os.path.join(self.config.spool_dir,
                             f"base-{gen:010d}-{self._snapshot_seq}")
-        unpack_snapshot(data, crc, dest)  # raises SnapshotError on mismatch
+        # CRC + tar extraction scale with snapshot size (up to
+        # _MAX_SNAPSHOT_BYTES): off the loop, or the stream's heartbeats
+        # stall for the whole unpack.  Raises SnapshotError on mismatch.
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, unpack_snapshot, data, crc, dest)
         prev_dir = self.model_dir
         first = not self._bootstrapped.is_set()
         self.model_dir = dest
@@ -372,7 +380,9 @@ class ReplicationClient:
         if prev_dir and prev_dir != dest and \
                 os.path.dirname(os.path.abspath(prev_dir)) == \
                 os.path.abspath(self.config.spool_dir):
-            shutil.rmtree(prev_dir, ignore_errors=True)
+            # deleting a whole model directory is as slow as unpacking one
+            await loop.run_in_executor(
+                None, lambda: shutil.rmtree(prev_dir, ignore_errors=True))
 
     def _reset_mirror(self) -> None:
         """The spool's lineage no longer matches the owner: wipe the

@@ -686,6 +686,10 @@ class FrontendServer:
             if exc is not None:
                 reply = error_reply(str(exc), uid=pending.req.uid)
             else:
+                # photonlint: disable=blocking-in-async -- `_scored` is
+                # scheduled from the future's OWN done-callback, so the
+                # future is already settled here and result() returns
+                # without blocking
                 reply = {"uid": pending.req.uid, "score": fut.result()}
         self._settle(pending, reply)
         self._pump()

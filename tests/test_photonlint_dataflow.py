@@ -1,0 +1,784 @@
+"""photonlint v3 dataflow suite (tier-1).
+
+Covers the layers PR 17 added on top of the rule framework:
+
+  1. ``FunctionFlow`` — the per-function CFG fixpoint: alias sets and
+     reaching definitions through branches, loops, try/finally, and kills;
+  2. ``ModuleCallGraph`` — event-loop reachability (async defs + scheduled
+     callbacks, executor hand-offs exempt by construction) and lock-held
+     regions;
+  3. the four new rules (PL011 shard-spec-arity, PL012
+     collective-without-mesh, PL013 blocking-in-async, PL014
+     cross-module-donation) and the alias-aware PL005 v2, each with
+     positive AND negative fixtures;
+  4. ``--diff`` incremental mode: findings must equal a full run restricted
+     to the changed files (exercised against a real throwaway git repo);
+  5. the dataflow gate: the real package stays clean, the index builds
+     inside its budget, and the JSON summary reports the dataflow cost.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.analysis import (analyze_source, build_rules,  # noqa: E402
+                                    run_analysis)
+from photon_ml_tpu.analysis.dataflow import (FunctionFlow,  # noqa: E402
+                                             ModuleCallGraph)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "photon_ml_tpu")
+HOT = "photon_ml_tpu/core/fixture.py"
+
+
+def lint(src, rule=None, path=HOT):
+    rules = build_rules([rule]) if rule else build_rules()
+    kept, _ = analyze_source(path, textwrap.dedent(src), rules)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# FunctionFlow: alias sets + reaching defs over the CFG
+# ---------------------------------------------------------------------------
+
+def _flow(src, name="f"):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n.name == name)
+    return fn, FunctionFlow(fn)
+
+
+def _call_named(fn, callee):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == callee:
+            return node
+    raise AssertionError(f"no call to {callee}")
+
+
+class TestFunctionFlow:
+    def test_straightline_alias_chain(self):
+        fn, flow = _flow("""
+            def f(self):
+                s = self._store
+                s2 = s
+                sink(s2)
+        """)
+        at = _call_named(fn, "sink")
+        assert flow.attr_aliases("s2", at) == frozenset({"_store"})
+        assert flow.attr_aliases("s", at) == frozenset({"_store"})
+
+    def test_branch_joins_union_aliases(self):
+        fn, flow = _flow("""
+            def f(self, cond):
+                if cond:
+                    x = self._primary
+                else:
+                    x = self._fallback
+                sink(x)
+        """)
+        at = _call_named(fn, "sink")
+        assert flow.attr_aliases("x", at) == \
+            frozenset({"_primary", "_fallback"})
+
+    def test_loop_reaches_fixpoint(self):
+        fn, flow = _flow("""
+            def f(self, xs):
+                cur = self._head
+                for _ in xs:
+                    cur = self._next
+                sink(cur)
+        """)
+        # after the loop either zero or more iterations ran: union
+        at = _call_named(fn, "sink")
+        assert flow.attr_aliases("cur", at) == \
+            frozenset({"_head", "_next"})
+
+    def test_reassignment_kills_alias(self):
+        fn, flow = _flow("""
+            def f(self):
+                x = self._a
+                x = make()
+                sink(x)
+        """)
+        at = _call_named(fn, "sink")
+        assert flow.attr_aliases("x", at) == frozenset()
+
+    def test_reaching_defs_through_branch(self):
+        fn, flow = _flow("""
+            def f(cond):
+                x = 1
+                if cond:
+                    x = 2
+                sink(x)
+        """)
+        at = _call_named(fn, "sink")
+        # both the initial def and the conditional redefinition reach
+        assert len(flow.reaching_defs("x", at)) == 2
+
+    def test_reaching_defs_straightline_kill(self):
+        fn, flow = _flow("""
+            def f():
+                x = 1
+                x = 2
+                sink(x)
+        """)
+        at = _call_named(fn, "sink")
+        assert len(flow.reaching_defs("x", at)) == 1
+
+    def test_try_finally_sees_both_states(self):
+        fn, flow = _flow("""
+            def f(self):
+                x = self._a
+                try:
+                    x = self._b
+                    maybe_raise()
+                finally:
+                    sink(x)
+        """)
+        # the finally body runs whether or not the try completed: the
+        # exception edges feed the pre-assignment state in too
+        at = _call_named(fn, "sink")
+        assert flow.attr_aliases("x", at) == frozenset({"_a", "_b"})
+
+    def test_with_as_binds_context_aliases(self):
+        fn, flow = _flow("""
+            def f(self):
+                with self._lock as held:
+                    sink(held)
+        """)
+        at = _call_named(fn, "sink")
+        assert flow.attr_aliases("held", at) == frozenset({"_lock"})
+
+    def test_reverse_store_aliases_name(self):
+        # `self.X = name` makes the NAME an alias of X from then on
+        fn, flow = _flow("""
+            def f(self, store):
+                self._store = store
+                sink(store)
+        """)
+        at = _call_named(fn, "sink")
+        assert "_store" in flow.attr_aliases("store", at)
+
+
+# ---------------------------------------------------------------------------
+# ModuleCallGraph: event-loop + lock-held reachability
+# ---------------------------------------------------------------------------
+
+def _graph(src):
+    return ModuleCallGraph(ast.parse(textwrap.dedent(src)))
+
+
+def _fn_ids(graph, *names):
+    out = set()
+    for fn in graph.fns:
+        if getattr(fn, "name", None) in names:
+            out.add(id(fn))
+    return out
+
+
+class TestModuleCallGraph:
+    def test_async_body_reaches_sync_helper(self):
+        g = _graph("""
+            def helper():
+                pass
+
+            def untouched():
+                pass
+
+            async def serve():
+                helper()
+        """)
+        on_loop = g.event_loop_fns()
+        assert _fn_ids(g, "serve", "helper") <= on_loop
+        assert not (_fn_ids(g, "untouched") & on_loop)
+
+    def test_executor_handoff_is_exempt(self):
+        g = _graph("""
+            import asyncio
+
+            def work():
+                pass
+
+            async def serve(loop):
+                await loop.run_in_executor(None, work)
+        """)
+        # work is passed as a REFERENCE, never called on the loop
+        assert not (_fn_ids(g, "work") & g.event_loop_fns())
+
+    def test_scheduled_callback_is_on_loop(self):
+        g = _graph("""
+            def callback():
+                pass
+
+            def arrange(loop):
+                loop.call_soon_threadsafe(callback)
+        """)
+        assert _fn_ids(g, "callback") <= g.event_loop_fns()
+        assert not (_fn_ids(g, "arrange") & g.event_loop_fns())
+
+    def test_lock_held_reachability(self):
+        g = _graph("""
+            class C:
+                def locked(self):
+                    with self._lock:
+                        self.flush()
+
+                def flush(self):
+                    pass
+
+                def free(self):
+                    pass
+        """)
+        held = g.lock_held_fns()
+        assert _fn_ids(g, "flush") <= held
+        assert not (_fn_ids(g, "free") & held)
+
+
+# ---------------------------------------------------------------------------
+# PL011 shard-spec-arity
+# ---------------------------------------------------------------------------
+
+class TestShardSpecArity:
+    def test_positive_in_specs_arity_mismatch(self):
+        vs = lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def local(a, b):
+                return a + b
+
+            f = shard_map(local, mesh=MESH, in_specs=(P("x"),),
+                          out_specs=P("x"))
+        """, "shard-spec-arity")
+        assert len(vs) == 1 and "2 positional" in vs[0].message
+
+    def test_positive_out_specs_arity_mismatch(self):
+        vs = lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def local(a):
+                return a, a
+
+            f = shard_map(local, mesh=MESH, in_specs=(P("x"),),
+                          out_specs=(P("x"),))
+        """, "shard-spec-arity")
+        assert len(vs) == 1 and "2-tuple" in vs[0].message
+
+    def test_positive_duplicate_axis_in_spec(self):
+        vs = lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def local(a):
+                return a
+
+            f = shard_map(local, mesh=MESH,
+                          in_specs=(P("x", "x"),), out_specs=P("x"))
+        """, "shard-spec-arity")
+        assert len(vs) == 1 and "more than once" in vs[0].message
+
+    def test_positive_axis_not_in_site_mesh(self):
+        vs = lint("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(jax.devices(), ("data", "model"))
+
+            def local(a):
+                return a
+
+            f = shard_map(local, mesh=mesh,
+                          in_specs=(P("batch"),), out_specs=P("data"))
+        """, "shard-spec-arity")
+        assert len(vs) == 1 and "'batch'" in vs[0].message
+
+    def test_negative_correct_site(self):
+        assert lint("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(jax.devices(), ("data",))
+
+            def local(a, b):
+                return a + b
+
+            f = shard_map(local, mesh=mesh,
+                          in_specs=(P("data"), P()), out_specs=P("data"))
+        """, "shard-spec-arity") == []
+
+    def test_negative_pytree_prefix_and_variadic_stay_quiet(self):
+        assert lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def local(*parts):
+                return parts
+
+            # non-tuple in_specs is a valid pytree prefix; variadic target
+            # accepts any arity
+            f = shard_map(local, mesh=MESH, in_specs=P("x"),
+                          out_specs=P("x"))
+            g = shard_map(local, mesh=MESH, in_specs=(P("x"), P("x")),
+                          out_specs=P("x"))
+        """, "shard-spec-arity") == []
+
+
+# ---------------------------------------------------------------------------
+# PL012 collective-without-mesh
+# ---------------------------------------------------------------------------
+
+class TestCollectiveContext:
+    def test_positive_collective_under_bare_jit(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return jax.lax.psum(x, "i")
+        """, "collective-without-mesh")
+        assert len(vs) == 1 and "psum" in vs[0].message
+
+    def test_positive_reached_through_helper(self):
+        vs = lint("""
+            import jax
+
+            def reduce_it(x):
+                return jax.lax.psum(x, "i")
+
+            @jax.jit
+            def f(x):
+                return reduce_it(x)
+        """, "collective-without-mesh")
+        assert len(vs) == 1
+
+    def test_negative_inside_shard_map_target(self):
+        assert lint("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def local(x):
+                return jax.lax.psum(x, "data")
+
+            @jax.jit
+            def f(x):
+                return shard_map(local, mesh=MESH, in_specs=(P("data"),),
+                                 out_specs=P())(x)
+        """, "collective-without-mesh") == []
+
+    def test_negative_under_mesh_with_block(self):
+        assert lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                with mesh:
+                    return jax.lax.psum(x, "i")
+        """, "collective-without-mesh") == []
+
+    def test_negative_untraced_code_is_quiet(self):
+        assert lint("""
+            import jax
+
+            def helper(x):
+                return jax.lax.psum(x, "i")
+        """, "collective-without-mesh") == []
+
+    def test_negative_pmap_target_reachable(self):
+        assert lint("""
+            import jax
+
+            def local(x):
+                return jax.lax.psum(x, "batch")
+
+            g = jax.pmap(local, axis_name="batch")
+        """, "collective-without-mesh") == []
+
+
+# ---------------------------------------------------------------------------
+# PL013 blocking-in-async
+# ---------------------------------------------------------------------------
+
+class TestBlockingInAsync:
+    def test_positive_sleep_in_async_def(self):
+        vs = lint("""
+            import time
+
+            async def handler():
+                time.sleep(0.1)
+        """, "blocking-in-async")
+        assert len(vs) == 1 and "time.sleep" in vs[0].message
+
+    def test_positive_through_call_graph(self):
+        vs = lint("""
+            def helper():
+                with open("f") as fh:
+                    return fh.read()
+
+            async def handler():
+                return helper()
+        """, "blocking-in-async")
+        assert len(vs) == 1 and "call graph" in vs[0].message
+
+    def test_positive_future_result_in_scheduled_callback(self):
+        vs = lint("""
+            def _scored(pending, fut):
+                return fut.result()
+
+            def dispatch(loop, pending, fut):
+                loop.call_soon_threadsafe(_scored, pending, fut)
+        """, "blocking-in-async")
+        assert len(vs) == 1 and "result()" in vs[0].message
+
+    def test_negative_awaited_acquire_is_the_asyncio_form(self):
+        assert lint("""
+            async def guard(lock):
+                await lock.acquire()
+        """, "blocking-in-async") == []
+
+    def test_positive_sync_acquire_in_async(self):
+        vs = lint("""
+            async def guard(lock):
+                lock.acquire()
+        """, "blocking-in-async")
+        assert len(vs) == 1 and "acquire" in vs[0].message
+
+    def test_negative_executor_handoff(self):
+        assert lint("""
+            import time
+
+            def work():
+                time.sleep(1.0)
+
+            async def handler(loop):
+                await loop.run_in_executor(None, work)
+        """, "blocking-in-async") == []
+
+    def test_negative_sync_module_is_quiet(self):
+        assert lint("""
+            import time
+
+            def batch_job():
+                time.sleep(5.0)
+        """, "blocking-in-async") == []
+
+
+# ---------------------------------------------------------------------------
+# PL014 cross-module-donation (whole-program fixtures)
+# ---------------------------------------------------------------------------
+
+DONOR_MOD = """
+    import jax
+
+    def update(w, g):
+        return w - g
+
+    fit = jax.jit(update, donate_argnums=0)
+"""
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _by_rule(result, rule):
+    return [v for v in result.violations if v.rule == rule]
+
+
+class TestCrossModuleDonation:
+    def _run(self, root):
+        return run_analysis([os.path.join(root, "pkg")], root=root)
+
+    def test_positive_read_after_imported_donor_call(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "donor.py": DONOR_MOD,
+            "user.py": """
+                from pkg.donor import fit
+
+                def step(w, g):
+                    out = fit(w, g)
+                    return out + w
+            """,
+        })
+        vs = _by_rule(self._run(root), "cross-module-donation")
+        assert len(vs) == 1
+        assert vs[0].path.endswith("user.py") and "w" in vs[0].message
+
+    def test_positive_dotted_module_alias_reference(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "donor.py": DONOR_MOD,
+            "user.py": """
+                from pkg import donor
+
+                def step(w, g):
+                    out = donor.fit(w, g)
+                    return out + w
+            """,
+        })
+        vs = _by_rule(self._run(root), "cross-module-donation")
+        assert len(vs) == 1
+
+    def test_positive_donation_through_forwarding_wrapper(self, tmp_path):
+        # donor exports a plain function that FORWARDS into the jitted
+        # donor — the program-wide fixpoint must carry the spec through
+        root = _write_pkg(tmp_path, {
+            "donor.py": DONOR_MOD + """
+    def apply(w, g):
+        return fit(w, g)
+""",
+            "user.py": """
+                from pkg.donor import apply
+
+                def step(w, g):
+                    out = apply(w, g)
+                    return out + w
+            """,
+        })
+        vs = _by_rule(self._run(root), "cross-module-donation")
+        assert len(vs) == 1
+
+    def test_negative_rebound_buffer_is_fine(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "donor.py": DONOR_MOD,
+            "user.py": """
+                from pkg.donor import fit
+
+                def step(w, g):
+                    w = fit(w, g)
+                    return w + g
+            """,
+        })
+        assert _by_rule(self._run(root), "cross-module-donation") == []
+
+    def test_local_donors_stay_pl006_jurisdiction(self, tmp_path):
+        # same-module read-after-donate: PL006's finding, not PL014's
+        root = _write_pkg(tmp_path, {
+            "donor.py": DONOR_MOD + """
+    def local_step(w, g):
+        out = fit(w, g)
+        return out + w
+""",
+        })
+        result = self._run(root)
+        assert _by_rule(result, "cross-module-donation") == []
+        # PL006 reports both its findings: the read-after-donate error and
+        # the donated-parameter boundary warning
+        pl006 = _by_rule(result, "donation-after-use")
+        assert {v.severity for v in pl006} == {"error", "warning"}
+
+
+# ---------------------------------------------------------------------------
+# PL005 v2: alias-aware lock discipline
+# ---------------------------------------------------------------------------
+
+class TestLockAliasTracking:
+    def test_positive_mutation_through_local_alias(self):
+        vs = lint("""
+            import threading
+
+            class Store:
+                def __init__(self, table):
+                    self._lock = threading.Lock()
+                    self._table = table
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._table[k] = v
+
+                def leak(self, k, v):
+                    table = self._table
+                    table[k] = v
+        """, "lock-discipline")
+        assert len(vs) == 1 and "_table" in vs[0].message
+        assert vs[0].line > 0
+
+    def test_positive_chained_attribute_roots_at_self(self):
+        vs = lint("""
+            import threading
+
+            class Store:
+                def __init__(self, state):
+                    self._lock = threading.Lock()
+                    self._state = state
+
+                def put(self, v):
+                    with self._lock:
+                        self._state.update(v)
+
+                def leak(self, k, v):
+                    self._state.table[k] = v
+        """, "lock-discipline")
+        assert len(vs) == 1 and "_state" in vs[0].message
+
+    def test_negative_lock_held_through_alias(self):
+        assert lint("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._table = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._table[k] = v
+
+                def also_fine(self, k, v):
+                    lock = self._lock
+                    with lock:
+                        self._table[k] = v
+        """, "lock-discipline") == []
+
+    def test_negative_unrelated_local_object(self):
+        assert lint("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._table = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._table[k] = v
+
+                def scratch(self, k, v):
+                    fresh = {}
+                    fresh[k] = v
+                    return fresh
+        """, "lock-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# --diff incremental mode (real git repo)
+# ---------------------------------------------------------------------------
+
+CLEAN_MOD = """
+def add(a, b):
+    return a + b
+"""
+
+BLOCKY_MOD = """
+import time
+
+
+async def handler():
+    time.sleep(0.1)
+"""
+
+
+def _git(root, *args):
+    subprocess.run(["git", "-C", root, "-c", "user.email=t@t",
+                    "-c", "user.name=t", *args],
+                   check=True, capture_output=True, text=True)
+
+
+def _cli(root, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.photonlint", "--root", root,
+         "--no-baseline", "--format", "json", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+
+
+@pytest.fixture
+def diff_repo(tmp_path):
+    pkg = tmp_path / "photon_ml_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "steady.py").write_text(BLOCKY_MOD)   # committed violation
+    (pkg / "mod.py").write_text(CLEAN_MOD)
+    root = str(tmp_path)
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    return root
+
+
+class TestDiffMode:
+    def test_no_changes_is_clean_exit_zero(self, diff_repo):
+        proc = _cli(diff_repo, "--diff", "HEAD")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "nothing to lint" in proc.stdout
+
+    def test_diff_equals_full_run_restricted_to_changed(self, diff_repo):
+        # introduce a violation in mod.py; steady.py's committed violation
+        # must appear in the full run but NOT in the diff run
+        with open(os.path.join(diff_repo, "photon_ml_tpu", "mod.py"),
+                  "w") as f:
+            f.write(BLOCKY_MOD)
+        full = _cli(diff_repo, os.path.join(diff_repo, "photon_ml_tpu"))
+        diff = _cli(diff_repo, "--diff", "HEAD")
+        assert full.returncode == 1 and diff.returncode == 1
+        full_new = json.loads(full.stdout)["new"]
+        diff_new = json.loads(diff.stdout)["new"]
+        changed = {"photon_ml_tpu/mod.py"}
+        want = {(v["rule"], v["path"], v["line"]) for v in full_new
+                if v["path"] in changed}
+        got = {(v["rule"], v["path"], v["line"]) for v in diff_new}
+        assert want and got == want
+        assert any(v["path"] == "photon_ml_tpu/steady.py" for v in full_new)
+        assert all(v["path"] != "photon_ml_tpu/steady.py" for v in diff_new)
+
+    def test_untracked_files_are_linted(self, diff_repo):
+        with open(os.path.join(diff_repo, "photon_ml_tpu", "fresh.py"),
+                  "w") as f:
+            f.write(BLOCKY_MOD)
+        proc = _cli(diff_repo, "--diff", "HEAD")
+        assert proc.returncode == 1
+        paths = {v["path"] for v in json.loads(proc.stdout)["new"]}
+        assert paths == {"photon_ml_tpu/fresh.py"}
+
+    def test_diff_rejects_explicit_paths(self, diff_repo):
+        proc = _cli(diff_repo, "--diff", "HEAD",
+                    os.path.join(diff_repo, "photon_ml_tpu"))
+        assert proc.returncode == 2
+
+    def test_bad_ref_is_usage_error(self, diff_repo):
+        proc = _cli(diff_repo, "--diff", "no-such-ref")
+        assert proc.returncode == 2
+        assert "git failed" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the dataflow gate over the real package
+# ---------------------------------------------------------------------------
+
+class TestDataflowGate:
+    def test_package_clean_and_index_inside_budget(self):
+        result = run_analysis([PKG_DIR], root=REPO_ROOT)
+        assert result.violations == [], \
+            "\n".join(f"{v.path}:{v.line}: {v.rule}: {v.message}"
+                      for v in result.violations)
+        assert result.index_build_s < 5.0
+        # the dataflow pass ran and was accounted separately
+        assert result.dataflow_s >= 0.0
+
+    def test_json_summary_reports_dataflow_cost(self):
+        from photon_ml_tpu.analysis import render_json
+        result = run_analysis([PKG_DIR], root=REPO_ROOT)
+        payload = json.loads(render_json([], [], [], result))
+        assert "dataflow_s" in payload["summary"]
+        assert payload["summary"]["dataflow_s"] >= 0.0
+
+    def test_new_rules_are_registered(self):
+        from photon_ml_tpu.analysis import registered_rules
+        registry = registered_rules()
+        codes = {cls.code for cls in registry.values()}
+        assert {"PL011", "PL012", "PL013", "PL014"} <= codes

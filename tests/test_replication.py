@@ -831,3 +831,61 @@ class TestLearnCliRepl:
         assert _parse_hostport("0.0.0.0:712") == ("0.0.0.0", 712)
         with pytest.raises(ValueError):
             _parse_hostport("712")
+
+
+class TestSnapshotOffLoop:
+    """Regression: snapshot unpack / old-base deletion must not run ON the
+    client's event loop (they scale with model size and used to stall the
+    stream's acks and heartbeats for the whole extraction)."""
+
+    def test_unpack_runs_off_the_event_loop(self, tmp_path, monkeypatch):
+        import asyncio
+        import threading
+
+        from photon_ml_tpu.online.replication import client as client_mod
+
+        cl = ReplicationClient(
+            ReplicationClientConfig(host="127.0.0.1", port=1,
+                                    spool_dir=str(tmp_path / "spool")))
+        unpack_threads = []
+
+        def slow_unpack(data, crc, dest):
+            unpack_threads.append(threading.current_thread())
+            time.sleep(0.3)  # a big model extracting
+            os.makedirs(dest, exist_ok=True)
+
+        monkeypatch.setattr(client_mod, "unpack_snapshot", slow_unpack)
+
+        class _FakeReader:
+            async def readexactly(self, n):
+                return b"x" * n
+
+        ticks = []
+
+        async def main():
+            async def ticker():
+                while True:
+                    ticks.append(time.monotonic())
+                    await asyncio.sleep(0.01)
+
+            t = asyncio.ensure_future(ticker())
+            await asyncio.sleep(0)  # let the ticker start
+            await cl._take_snapshot(
+                _FakeReader(), {"bytes": 8, "crc32": 0, "generation": 3})
+            t.cancel()
+
+        try:
+            asyncio.run(main())
+        finally:
+            cl._mirror.close()
+
+        # the unpack ran in an executor worker, not the loop thread ...
+        assert unpack_threads and \
+            unpack_threads[0] is not threading.main_thread()
+        # ... so the loop kept serving other coroutines throughout the
+        # 0.3s extraction (a blocking unpack yields ~1 tick, not dozens)
+        assert len(ticks) >= 10, f"loop starved: {len(ticks)} tick(s)"
+        # and the snapshot still landed
+        assert cl.floor == 3
+        assert cl.model_dir is not None and os.path.isdir(cl.model_dir)
+        assert cl._bootstrapped.is_set()

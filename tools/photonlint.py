@@ -17,6 +17,12 @@ named files, but still build the ProgramIndex over the whole package so
 cross-module results match a full run — a violation in f1.py caused by a
 jit site elsewhere is found without scanning everything.
 
+``--diff [REF]`` (default REF: HEAD) asks git which package files changed —
+tracked changes vs REF plus untracked files — and lints exactly those under
+the same whole-package-index contract as ``--paths``.  Findings are
+identical to a full run restricted to the changed files.  No changed
+files: exit 0 without analysing anything.
+
 Exit codes: 0 = clean (every finding baselined or suppressed, no stale
 baseline entries); 1 = new violations OR stale baseline entries (paid-down
 debt must be pruned — rerun with --prune-baseline to remove it); 2 = usage
@@ -56,6 +62,11 @@ def _parser() -> argparse.ArgumentParser:
                    help="incremental mode: lint ONLY these files but index "
                         "the whole package, so cross-module findings match "
                         "a full run (fast pre-commit loop)")
+    p.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="incremental mode driven by git: lint the package "
+                        "files changed vs REF (default HEAD), tracked and "
+                        "untracked, under the whole-package index")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE",
                    help="baseline file of accepted debt "
@@ -88,6 +99,35 @@ def _parser() -> argparse.ArgumentParser:
     return p
 
 
+def _changed_package_files(root: str, ref: str):
+    """Package .py files changed vs ``ref`` (tracked diff + untracked),
+    as absolute paths; None on git failure (error already printed)."""
+    import subprocess
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, check=True)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        print(f"photonlint: --diff {ref}: git failed: {detail.strip()}",
+              file=sys.stderr)
+        return None
+    names = set(diff.stdout.splitlines()) | set(untracked.stdout.splitlines())
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py") \
+                or not name.startswith("photon_ml_tpu/"):
+            continue  # outside the default lint scope
+        fp = os.path.join(root, name)
+        if os.path.exists(fp):  # deletions have nothing to lint
+            out.append(fp)
+    return out
+
+
 def main(argv=None) -> int:
     args = _parser().parse_args(argv)
 
@@ -100,7 +140,23 @@ def main(argv=None) -> int:
         return 0
 
     pkg_default = os.path.join(args.root, "photon_ml_tpu")
-    if args.only_paths:
+    if (args.diff is not None) and (args.paths or args.only_paths):
+        print("photonlint: --diff computes its own file list and is "
+              "mutually exclusive with positional paths / --paths",
+              file=sys.stderr)
+        return 2
+    if args.diff is not None:
+        changed = _changed_package_files(args.root, args.diff)
+        if changed is None:
+            return 2
+        if not changed:
+            print(f"photonlint: no package files changed vs {args.diff} — "
+                  "nothing to lint")
+            return 0
+        paths = changed
+        # same incremental contract as --paths: whole-package index
+        index_paths = [pkg_default]
+    elif args.only_paths:
         if args.paths:
             print("photonlint: positional paths and --paths are mutually "
                   "exclusive (--paths lints only the named files)",
@@ -146,7 +202,7 @@ def main(argv=None) -> int:
     # an incremental run can't vouch for files it didn't lint, a --rules
     # subset can't vouch for other rules' entries
     entries = baseline.get("entries", {})
-    if args.only_paths:
+    if args.only_paths or args.diff is not None:
         linted = {os.path.relpath(os.path.abspath(p), args.root)
                   .replace(os.sep, "/") for p in paths}
         stale = [fp for fp in stale
