@@ -3202,7 +3202,8 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
     tracked like any other hot path: BENCH_LINT.json records wall time per
     run (best + mean), the ProgramIndex build share, the v3 dataflow-pass
     share (CFG fixpoints + call-graph reachability, accounted by
-    analysis/dataflow.py), and the finding counts — a lint-time regression
+    analysis/dataflow.py), the v4 interprocedural-summary share
+    (``summaries_s``), and the finding counts — a lint-time regression
     shows up in the same place a kernel regression would.  ASSERTS the
     full-package wall stays under the 6s budget (PHOTON_BENCH_LINT_BUDGET_S
     overrides).  Pure AST work: no jax import, identical on any backend.
@@ -3212,13 +3213,14 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
     from photon_ml_tpu.analysis import run_analysis
 
     pkg = os.path.join(_REPO, "photon_ml_tpu")
-    times, idx_times, flow_times, result = [], [], [], None
+    times, idx_times, flow_times, summ_times, result = [], [], [], [], None
     for _ in range(max(1, repeats)):
         t0 = _time.perf_counter()
         result = run_analysis([pkg], root=_REPO, whole_program=True)
         times.append(_time.perf_counter() - t0)
         idx_times.append(result.index_build_s)
         flow_times.append(result.dataflow_s)
+        summ_times.append(result.summaries_s)
     budget_s = float(os.environ.get("PHOTON_BENCH_LINT_BUDGET_S", "6.0"))
     assert min(times) < budget_s, (
         f"photonlint full-package wall {min(times):.2f}s exceeds the "
@@ -3233,6 +3235,7 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
         "wall_s_all": [round(t, 4) for t in times],
         "index_build_s": round(min(idx_times), 4),
         "dataflow_s": round(min(flow_times), 4),
+        "summaries_s": round(min(summ_times), 4),
         "files_scanned": result.files_scanned,
         "violations": len(result.violations),
         "suppressed": len(result.suppressed),

@@ -17,7 +17,8 @@ from photon_ml_tpu.analysis.framework import (AnalysisResult, ModuleContext,
 from photon_ml_tpu.analysis.baseline import (BaselineError, empty_baseline,
                                              load_baseline, make_baseline,
                                              partition, save_baseline)
-from photon_ml_tpu.analysis.reporters import render_json, render_text
+from photon_ml_tpu.analysis.reporters import (render_json, render_sarif,
+                                              render_text)
 
 __all__ = [
     "AnalysisResult", "ModuleContext", "Rule", "Violation",
@@ -25,5 +26,5 @@ __all__ = [
     "run_analysis",
     "BaselineError", "empty_baseline", "load_baseline", "make_baseline",
     "partition", "save_baseline",
-    "render_json", "render_text",
+    "render_json", "render_sarif", "render_text",
 ]

@@ -280,6 +280,7 @@ class AnalysisResult:
     files_scanned: int
     index_build_s: float = 0.0  # ProgramIndex build time (0 in per-module mode)
     dataflow_s: float = 0.0     # time spent in the dataflow engine this run
+    summaries_s: float = 0.0    # time in the interprocedural summary layer
     whole_program: bool = False
 
     def by_rule(self) -> Dict[str, int]:
@@ -387,4 +388,5 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     return AnalysisResult(violations=violations, suppressed=suppressed,
                           files_scanned=n_files, index_build_s=index_build_s,
                           dataflow_s=_dataflow.cost_seconds(),
+                          summaries_s=_dataflow.summary_seconds(),
                           whole_program=whole_program)

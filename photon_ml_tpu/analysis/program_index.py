@@ -153,6 +153,7 @@ class ProgramIndex:
                                                     FunctionNode]]] = None
         self._donor_exports: Optional[Dict[str, Dict[str, Tuple[Tuple[int, ...],
                                                                 Tuple[str, ...]]]]] = None
+        self._summaries: Optional["ProgramSummaries"] = None
         self.build_seconds = time.perf_counter() - t0
 
     @classmethod
@@ -581,6 +582,14 @@ class ProgramIndex:
         self._donor_exports = exports
         return exports
 
+    # -- interprocedural summaries (v4, PL015–PL018) --------------------------
+    def summaries(self) -> "ProgramSummaries":
+        """Program-wide join of the per-module function summaries (built
+        lazily on first use, cached for the run)."""
+        if self._summaries is None:
+            self._summaries = ProgramSummaries(self)
+        return self._summaries
+
     def extra_roots(self, relpath: str, base: JitIndex
                     ) -> List[Tuple[FunctionNode, Set[str]]]:
         """Traced functions of ``relpath`` the per-module ``base`` index does
@@ -596,3 +605,298 @@ class ProgramIndex:
             extras.append((fn, params))
             covered.update(id(n) for n in ast.walk(fn))
         return extras
+
+
+# -- program-wide summary fixpoints (v4) --------------------------------------
+
+# an escape fact: (class key "relpath::Class", protected attr, lock attr)
+EscapeFact = Tuple[str, str, str]
+# a lock-order edge witness: (relpath, function name, AST site)
+LockWitness = Tuple[str, str, ast.AST]
+
+
+# method names of the builtin containers/strings: a call spelled with one
+# of these is near-certainly a dict/list/set/str operation, not a program
+# def, whatever unique name the program happens to hold
+_BUILTIN_METHOD_NAMES = frozenset(
+    m for t in (dict, list, set, tuple, str, bytes)
+    for m in dir(t) if not m.startswith("__"))
+
+
+class ProgramSummaries:
+    """Join of the per-module ``dataflow.ModuleSummaries`` across the
+    program call graph.  Three fixpoints:
+
+      * **escapes** — which lock-protected ``self.<attr>`` objects a
+        function's return value may alias, closed over ``return f(...)``
+        chains so an accessor-of-an-accessor still leaks (PL016);
+      * **return ranks** — definite array rank of return values, closed
+        over single-call return chains (PL017);
+      * **lock-order graph** — directed edges ``outer -> inner`` from
+        direct lexical nesting AND from calls made while holding a lock
+        into the callee's transitive acquisitions; strongly-connected
+        components of size >= 2 are deadlock cycles (PL018).  Reentrant
+        RLock self-nesting never forms an edge (self-edges are dropped),
+        and lock identity is class-level, so a cycle here means two code
+        paths take the same two locks in opposite orders somewhere.
+    """
+
+    def __init__(self, index: ProgramIndex):
+        from photon_ml_tpu.analysis.dataflow import (ModuleSummaries,
+                                                     _timed_summary)
+
+        self.index = index
+        self.mod: Dict[str, "ModuleSummaries"] = {}
+        # id(fn) -> (owning ModuleInfo, its FunctionSummary)
+        self._owner: Dict[int, Tuple[ModuleInfo, object]] = {}
+        for relpath, info in index.modules.items():
+            ms = ModuleSummaries(info.tree, relpath)
+            self.mod[relpath] = ms
+            for fid, summ in ms.by_id.items():
+                self._owner[fid] = (info, summ)
+        with _timed_summary():
+            # program-wide def-name census (for the cautious unique-by-name
+            # fallback PL016 uses on non-self attribute calls)
+            self._name_count: Dict[str, int] = {}
+            for info in index.modules.values():
+                for name, fns in info.defs_by_name.items():
+                    self._name_count[name] = (self._name_count.get(name, 0)
+                                              + len(fns))
+            self.escapes: Dict[int, frozenset] = self._fix_escapes()
+            self._ranks: Dict[int, Optional[int]] = self._fix_ranks()
+            self.lock_edges: Dict[Tuple[str, str], LockWitness] = {}
+            self.lock_cycles: List[Tuple[Tuple[str, ...],
+                                         Dict[Tuple[str, str],
+                                              LockWitness]]] = []
+            self._build_lock_graph()
+
+    # -- shared resolution ----------------------------------------------------
+    def _resolve_call(self, info: ModuleInfo,
+                      func: ast.AST) -> Optional[int]:
+        got = self.index._resolve_callee(info, func)
+        if got is None:
+            return None
+        fid = id(got[1])
+        return fid if fid in self._owner else None
+
+    # -- escape fixpoint ------------------------------------------------------
+    def _fix_escapes(self) -> Dict[int, frozenset]:
+        esc: Dict[int, frozenset] = {}
+        for fid, (info, s) in self._owner.items():
+            if s.cls is None or not s.return_attrs:
+                continue
+            ms = self.mod[info.relpath]
+            hits = s.return_attrs & ms.locked_attrs_of(s.cls)
+            if hits:
+                # immutable-valued attrs cannot be mutated through the
+                # alias — classified lazily, only when a hit exists
+                hits -= ms.immutable_attrs_of(s.cls)
+            if hits:
+                lock = ms.lock_display.get(s.cls, "_lock")
+                key = f"{info.relpath}::{s.cls}"
+                esc[fid] = frozenset((key, a, lock) for a in hits)
+        changed, guard = True, 0
+        while changed and guard < 12:
+            changed, guard = False, guard + 1
+            for fid, (info, s) in self._owner.items():
+                if not s.return_calls:
+                    continue
+                cur = esc.get(fid, frozenset())
+                new = cur
+                for call in s.return_calls:
+                    callee = self._resolve_call(info, call.func)
+                    if callee is not None:
+                        new = new | esc.get(callee, frozenset())
+                if new != cur:
+                    esc[fid] = new
+                    changed = True
+        return esc
+
+    def escape_facts(self, fn: ast.AST) -> frozenset:
+        """Escape facts of a function node (empty when it leaks nothing)."""
+        return self.escapes.get(id(fn), frozenset())
+
+    def resolve_escape_source(self, relpath: str, expr: ast.AST
+                              ) -> Optional[Tuple[frozenset, str]]:
+        """Escape facts of the function a VALUE expression was produced by:
+        ``store.table()`` / ``self.hot()`` calls, or a bare attribute access
+        hitting a @property.  Unresolvable receivers fall back to a
+        program-wide unique-name match — only when exactly ONE def in the
+        whole program carries that name, so the match cannot be wrong.
+        Returns (facts, display name of the source) or None."""
+        info = self.index.modules.get(relpath)
+        if info is None:
+            return None
+        if isinstance(expr, ast.Call):
+            fid = self._resolve_call(info, expr.func)
+            if fid is None and isinstance(expr.func, ast.Attribute):
+                fid = self._unique_by_name(expr.func.attr)
+            if fid is not None and self.escapes.get(fid):
+                _, s = self._owner[fid]
+                return self.escapes[fid], self._display(fid)
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and not (isinstance(expr.value, ast.Name)
+                         and expr.value.id == "self"):
+            fid = self._unique_by_name(expr.attr)
+            if fid is not None:
+                _, s = self._owner[fid]
+                if s.is_property and self.escapes.get(fid):
+                    return self.escapes[fid], self._display(fid)
+        return None
+
+    def _unique_by_name(self, name: str) -> Optional[int]:
+        if self._name_count.get(name) != 1:
+            return None
+        for fid, (info, s) in self._owner.items():
+            if s.name == name:
+                return fid
+        return None
+
+    def _display(self, fid: int) -> str:
+        info, s = self._owner[fid]
+        qual = f"{s.cls}.{s.name}" if s.cls else s.name
+        return f"{qual} ({info.relpath})"
+
+    # -- return-rank fixpoint -------------------------------------------------
+    def _fix_ranks(self) -> Dict[int, Optional[int]]:
+        ranks: Dict[int, Optional[int]] = {
+            fid: s.return_rank for fid, (_, s) in self._owner.items()}
+        changed, guard = True, 0
+        while changed and guard < 12:
+            changed, guard = False, guard + 1
+            for fid, (info, s) in self._owner.items():
+                if ranks.get(fid) is not None or s.return_rank_call is None:
+                    continue
+                callee = self._resolve_call(info, s.return_rank_call.func)
+                if callee is not None and ranks.get(callee) is not None:
+                    ranks[fid] = ranks[callee]
+                    changed = True
+        return ranks
+
+    def call_rank(self, relpath: str, call: ast.Call) -> Optional[int]:
+        """Definite return rank of a call expression, through the summary
+        fixpoint (None when the callee or its rank is unknown)."""
+        info = self.index.modules.get(relpath)
+        if info is None:
+            return None
+        fid = self._resolve_call(info, call.func)
+        return self._ranks.get(fid) if fid is not None else None
+
+    # -- lock-order graph -----------------------------------------------------
+    def _resolve_lock_call(self, info: ModuleInfo,
+                           func: ast.AST) -> Optional[int]:
+        """``_resolve_call`` plus a cautious unique-by-name fallback for
+        method calls through an object attribute (``self.beta.grab()``) —
+        the shape cross-object lock nesting actually takes in the serving
+        plane.  Two guards keep the fallback honest: builtin-container/str
+        method names never match (``dropped.append`` must not resolve to a
+        class's own ``append``), and a chain rooted at an imported module
+        alias never matches (``os.remove`` is not a method call).  A unique
+        program-wide def name past both guards cannot mis-resolve; anything
+        ambiguous stays unresolved and forms no edge."""
+        fid = self._resolve_call(info, func)
+        if fid is not None or not isinstance(func, ast.Attribute):
+            return fid
+        if func.attr in _BUILTIN_METHOD_NAMES:
+            return None
+        node: ast.AST = func.value
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        if isinstance(node, ast.Name) and node.id in info.imports:
+            return None
+        return self._unique_by_name(func.attr)
+
+    def _transitive_acquires(self, fid: int, memo: Dict[int, Set[str]],
+                             seen: Set[int]) -> Set[str]:
+        got = memo.get(fid)
+        if got is not None:
+            return got
+        if fid in seen:  # call cycle — contribute what is known so far
+            return set()
+        seen.add(fid)
+        info, s = self._owner[fid]
+        acc: Set[str] = set(s.lock_acquires)
+        for call in s.calls:
+            callee = self._resolve_lock_call(info, call.func)
+            if callee is not None:
+                acc |= self._transitive_acquires(callee, memo, seen)
+        memo[fid] = acc
+        return acc
+
+    def _build_lock_graph(self) -> None:
+        edges = self.lock_edges
+        memo: Dict[int, Set[str]] = {}
+        for fid, (info, s) in self._owner.items():
+            for outer, inner, site in s.lock_pairs:
+                if outer != inner:
+                    edges.setdefault((outer, inner),
+                                     (info.relpath, s.name, site))
+            for outer, call in s.held_calls:
+                callee = self._resolve_lock_call(info, call.func)
+                if callee is None:
+                    continue
+                for inner in self._transitive_acquires(callee, memo, set()):
+                    if inner != outer:
+                        edges.setdefault((outer, inner),
+                                         (info.relpath, s.name, call))
+        # Tarjan SCC over the key graph; every SCC with >= 2 nodes is a
+        # deadlock cycle (self-edges were never added)
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index_of: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(adj[v]))]
+            index_of[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index_of:
+                        index_of[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(adj[w])))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index_of[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index_of[node]:
+                    comp: List[str] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) >= 2:
+                        sccs.append(comp)
+
+        for v in sorted(adj):
+            if v not in index_of:
+                strongconnect(v)
+        for comp in sccs:
+            keys = tuple(sorted(comp))
+            members = set(comp)
+            cyc_edges = {e: w for e, w in edges.items()
+                         if e[0] in members and e[1] in members}
+            self.lock_cycles.append((keys, cyc_edges))
+        self.lock_cycles.sort(key=lambda c: c[0])

@@ -1,8 +1,13 @@
-"""Render photonlint results as text (human/CI logs) or JSON (tooling).
+"""Render photonlint results as text (human/CI logs), JSON (tooling), or
+SARIF 2.1.0 (code-scanning upload).
 
-Both reporters consume the same inputs: the violations split against the
+All reporters consume the same inputs: the violations split against the
 baseline (analysis/baseline.py) plus scan counts, so the CLI and the tier-1
-test print identical findings.
+test print identical findings.  SARIF results reuse the baseline
+fingerprint as ``partialFingerprints`` so code-scanning dedupes findings
+across pushes exactly as the baseline does across runs; baselined and
+in-source-suppressed findings are emitted WITH ``suppressions`` entries so
+the upload reflects accepted debt instead of silently dropping it.
 """
 
 from __future__ import annotations
@@ -37,7 +42,8 @@ def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
     detail = (" (" + ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
               + ")") if by_rule else ""
     mode = (f", index {result.index_build_s:.2f}s, "
-            f"dataflow {result.dataflow_s:.2f}s"
+            f"dataflow {result.dataflow_s:.2f}s, "
+            f"summaries {result.summaries_s:.2f}s"
             if result.whole_program else ", per-module mode")
     out.append(
         f"photonlint: {result.files_scanned} files scanned, "
@@ -71,8 +77,87 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
             "whole_program": result.whole_program,
             "index_build_s": round(result.index_build_s, 4),
             "dataflow_s": round(result.dataflow_s, 4),
+            "summaries_s": round(result.summaries_s, 4),
             "by_rule": _counts(new, lambda v: v.rule),
             "by_severity": _counts(new, lambda v: v.severity),
         },
+    }
+    return json.dumps(payload, indent=2)
+
+
+_SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_result(v: Violation, rule_index: Dict[str, int],
+                  suppression: Optional[str] = None) -> dict:
+    out = {
+        "ruleId": v.code,
+        "ruleIndex": rule_index[v.code],
+        "level": "error" if v.severity == "error" else "warning",
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": v.path, "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(v.line, 1),
+                           "startColumn": max(v.col + 1, 1)},
+            },
+        }],
+        # the baseline fingerprint: code-scanning dedupes on it across
+        # pushes the same way analysis/baseline.py does across runs
+        "partialFingerprints": {"photonlint/v1": v.fingerprint()},
+    }
+    if v.snippet:
+        out["locations"][0]["physicalLocation"]["region"]["snippet"] = {
+            "text": v.snippet}
+    if suppression is not None:
+        out["suppressions"] = [{"kind": suppression}]
+    return out
+
+
+def render_sarif(new: Sequence[Violation], baselined: Sequence[Violation],
+                 stale: Sequence[str], result: AnalysisResult) -> str:
+    """SARIF 2.1.0 for code-scanning upload: new findings active,
+    baselined debt carried as externally-suppressed results, in-source
+    ``# photonlint: disable`` sites as inSource-suppressed results."""
+    from photon_ml_tpu.analysis.framework import (_ParseErrorRule,
+                                                  registered_rules)
+    registry = registered_rules()
+    rules_sorted = sorted(registry.items(), key=lambda kv: kv[1].code)
+    # PL000 parse failures are findings too — the pseudo-rule leads the
+    # array so broken files upload instead of vanishing
+    rules_sorted.insert(0, (_ParseErrorRule.name, _ParseErrorRule))
+    rule_index = {cls.code: i for i, (_, cls) in enumerate(rules_sorted)}
+    rules = [{
+        "id": cls.code,
+        "name": name,
+        "shortDescription": {"text": cls.description},
+        "defaultConfiguration": {
+            "level": "error" if cls.severity == "error" else "warning"},
+    } for name, cls in rules_sorted]
+    results = [_sarif_result(v, rule_index) for v in new]
+    results += [_sarif_result(v, rule_index, suppression="external")
+                for v in baselined]
+    results += [_sarif_result(v, rule_index, suppression="inSource")
+                for v in result.suppressed]
+    payload = {
+        "$schema": _SARIF_SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "photonlint",
+                "informationUri":
+                    "https://github.com/photon-ml-tpu/photon-ml-tpu",
+                "version": "4.0.0",
+                "rules": rules,
+            }},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+            "properties": {
+                "filesScanned": result.files_scanned,
+                "wholeProgram": result.whole_program,
+                "staleBaselineFingerprints": list(stale),
+            },
+        }],
     }
     return json.dumps(payload, indent=2)

@@ -16,6 +16,10 @@
 | PL012 | collective-without-mesh | collectives jit-reachable with no binder   |
 | PL013 | blocking-in-async     | blocking calls on the asyncio event loop     |
 | PL014 | cross-module-donation | donated-buffer reads across module imports   |
+| PL015 | container-donation-taint | donated-buffer taint through containers / pytrees |
+| PL016 | alias-escape          | unlocked mutation via accessor-returned aliases |
+| PL017 | out-spec-rank         | shard_map out_specs deeper than returned rank |
+| PL018 | lock-order            | cycles in the global lock acquisition order  |
 
 PL001/PL003/PL004 are trace-scoped: in whole-program mode (the default) the
 ProgramIndex resolves functions jitted across module boundaries, so they
@@ -25,6 +29,12 @@ PL005/PL012/PL013 are dataflow-backed (analysis/dataflow.py): a per-function
 CFG fixpoint supplies alias sets, and module/program call graphs supply
 event-loop and mesh-scope reachability.  PL014 reuses PL006's taint scanner
 over the ProgramIndex's program-wide donor table.
+
+PL015–PL018 are summary-backed (v4): per-function interprocedural summaries
+(return-value aliases, container provenance, definite return ranks, lock
+acquisition order) joined to program-wide fixpoints by
+``program_index.ProgramSummaries``.  PL016/PL018 need whole-program mode;
+PL015/PL017 also run per-module with module-local resolution.
 """
 
 from photon_ml_tpu.analysis.rules.host_sync import HostSyncRule
@@ -41,6 +51,11 @@ from photon_ml_tpu.analysis.rules.shard_spec import ShardSpecArityRule
 from photon_ml_tpu.analysis.rules.collective_ctx import CollectiveContextRule
 from photon_ml_tpu.analysis.rules.blocking_async import BlockingInAsyncRule
 from photon_ml_tpu.analysis.rules.donation_flow import CrossModuleDonationRule
+from photon_ml_tpu.analysis.rules.donation_containers import \
+    ContainerDonationRule
+from photon_ml_tpu.analysis.rules.alias_escape import AliasEscapeRule
+from photon_ml_tpu.analysis.rules.out_spec_rank import OutSpecRankRule
+from photon_ml_tpu.analysis.rules.lock_order import LockOrderRule
 
 __all__ = [
     "HostSyncRule",
@@ -57,4 +72,8 @@ __all__ = [
     "CollectiveContextRule",
     "BlockingInAsyncRule",
     "CrossModuleDonationRule",
+    "ContainerDonationRule",
+    "AliasEscapeRule",
+    "OutSpecRankRule",
+    "LockOrderRule",
 ]

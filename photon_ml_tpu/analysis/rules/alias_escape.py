@@ -1,0 +1,193 @@
+"""PL016 alias-escape: lock-protected state reached through a value
+RETURNED by another function — PL005 upgraded from intraprocedural to
+program-wide.
+
+Why it matters here: the serving plane's discipline is "mutate under
+``self._lock``, hand out snapshots" — ``coefficient_store.RandomCoordinate``
+swaps ``self._hot`` under its lock and exposes it through unlocked
+properties; ``swap.HotSwapper`` does the same with its base tuple.  PL005
+polices mutations *inside the owning class*; nothing polices the caller
+that does ``t = store.table; t[k] = v``.  That write lands on the same
+object the swap thread replaces under the lock — a data race two modules
+apart that no intraprocedural rule can connect.
+
+The v4 summary layer computes, per function, which lock-protected
+``self.<attr>`` objects its return value may alias (through the
+``FunctionFlow`` alias state, so ``t = self._table; return t`` counts),
+and ``ProgramSummaries`` closes the set over ``return f(...)`` chains
+program-wide.  Two findings land on it:
+
+  - **warning**, at the accessor: a ``return`` whose value aliases an attr
+    mutated under the class lock — the escape hatch itself.  Legitimate
+    snapshot-read APIs suppress with their documented contract.
+  - **error**, at the caller: a mutation (attribute/item assignment,
+    augmented assignment, mutating container method) through a name bound
+    from an escape-returning call or property — resolved through the
+    program call graph, with a program-wide unique-name fallback that only
+    fires when exactly one def in the whole program carries the name.
+    Mutations inside a ``with <lock-ish>:`` block are exempt (the caller
+    took *a* lock; deciding whether it is the RIGHT lock is PL018's
+    order-graph territory, not this rule's).
+
+Whole-program mode only; per-module runs stay silent (like PL014).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from photon_ml_tpu.analysis.dataflow import (MUTATOR_METHODS,
+                                             _lockish_context)
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+
+
+def _base_name(expr: ast.AST) -> Optional[str]:
+    """Base ``Name`` of an attribute/subscript chain (``t.table[k]`` ->
+    ``t``); None when the chain roots elsewhere (incl. ``self``)."""
+    node: ast.AST = expr
+    saw_chain = False
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        saw_chain = True
+        node = node.value
+    if isinstance(node, ast.Name) and node.id != "self" and saw_chain:
+        return node.id
+    return None
+
+
+def _lockish_with(node: ast.AST) -> bool:
+    return isinstance(node, (ast.With, ast.AsyncWith)) \
+        and any(_lockish_context(i) for i in node.items)
+
+
+@register
+class AliasEscapeRule(Rule):
+    name = "alias-escape"
+    code = "PL016"
+    severity = "error"
+    description = ("no unlocked mutation through a value returned by an "
+                   "accessor that aliases lock-protected state; accessors "
+                   "leaking such aliases are flagged at the return")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None or ctx.program is None:
+            return
+        summ = ctx.program.summaries()
+        ms = summ.mod.get(ctx.relpath)
+        if ms is None:
+            return
+        # (a) the escape hatches in THIS module: returns aliasing an attr
+        # mutated under the class lock
+        for fid, s in ms.by_id.items():
+            if s.cls is None or not s.return_attr_sites:
+                continue
+            protected = ms.locked_attrs_of(s.cls)
+            lock = ms.lock_display.get(s.cls, "_lock")
+            for ret, attrs in s.return_attr_sites:
+                hits = set(attrs) & protected
+                if hits:
+                    # attrs only ever assigned definitely-immutable values
+                    # cannot be mutated through an alias — their accessors
+                    # are clean (classified lazily: only on a hit)
+                    hits -= ms.immutable_attrs_of(s.cls)
+                hits = sorted(hits)
+                if not hits:
+                    continue
+                listed = ", ".join(f"`self.{a}`" for a in hits)
+                yield ctx.violation(
+                    self, ret,
+                    f"`{s.cls}.{s.name}` returns {listed}, mutated elsewhere "
+                    f"under `self.{lock}` — the caller receives an unlocked "
+                    "alias of lock-protected state; return a copy/snapshot, "
+                    "or suppress with the documented read contract",
+                    severity="warning")
+        # (b) callers in THIS module mutating through an escaped alias
+        for fid, s in ms.by_id.items():
+            fn = ms.fn_of_id[fid]
+            yield from self._scan_caller(ctx, summ, fn)
+
+    def _scan_caller(self, ctx: ModuleContext, summ,
+                     fn: ast.AST) -> Iterator[Violation]:
+        # bound name -> (escape facts, source display, bind line)
+        bound: Dict[str, Tuple[frozenset, str, int]] = {}
+
+        def mutation_roots(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+            out: List[Tuple[str, ast.AST]] = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    name = _base_name(t)
+                    if name is not None:
+                        out.append((name, t))
+            elif isinstance(node, ast.AugAssign):
+                name = _base_name(node.target)
+                if name is not None:
+                    out.append((name, node.target))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    name = _base_name(t)
+                    if name is not None:
+                        out.append((name, t))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATOR_METHODS:
+                name = _base_name(node.func)
+                if name is not None:
+                    out.append((name, node))
+            return out
+
+        violations: List[Violation] = []
+
+        def flag(name: str, site: ast.AST) -> None:
+            got = bound.get(name)
+            if got is None:
+                return
+            facts, src, line = got
+            cls_key, attr, lock = sorted(facts)[0]
+            violations.append(ctx.violation(
+                self, site,
+                f"`{name}` was returned by `{src}` (line {line}) and may "
+                f"alias `{attr}` of {cls_key}, which is guarded by "
+                f"`{lock}` — mutating it here bypasses the owner's lock; "
+                "mutate through the owning API or under its lock"))
+
+        def visit(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if bound:
+                # mutation/lock tracking only matters once something IS
+                # bound — before that the scan just looks for bindings
+                if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                             ast.Store):
+                    bound.pop(node.id, None)  # a rebind kills the binding
+                    return
+                if _lockish_with(node):
+                    locked = True
+                if not locked:
+                    for name, site in mutation_roots(node):
+                        flag(name, site)
+            elif _lockish_with(node):
+                locked = True
+            # `hot = store.hot` / `t = store.table()` — bind BEFORE the
+            # statements that follow; skip the target so the generic
+            # store-kill above doesn't immediately erase it
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                visit(node.value, locked)
+                got = summ.resolve_escape_source(ctx.relpath, node.value)
+                tname = node.targets[0].id
+                if got is not None:
+                    facts, src = got
+                    bound[tname] = (facts, src, node.lineno)
+                else:
+                    bound.pop(tname, None)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked)
+
+        for stmt in getattr(fn, "body", []):
+            visit(stmt, False)
+        yield from violations

@@ -265,6 +265,58 @@ def _derived_donor_spec(ctx: ModuleContext, fn, donors: Dict[str, DonateSpec],
     return DonateSpec(tuple(sorted(nums)))
 
 
+def discover_module_donors(rule, ctx: ModuleContext
+                           ) -> Tuple[Dict[str, DonateSpec],
+                                      Dict[str, DonateSpec]]:
+    """(module-level donor names, self.method donors) of one module — the
+    PL006 discovery passes, shared with PL015's container-taint scan."""
+    # pass 1: module-level donating names + methods returning donors
+    module_donors: Dict[str, DonateSpec] = {}
+    probe = _ScopeScanner(rule, ctx, {}, {}, ())
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            spec = probe._spec_of_expr(stmt.value)
+            if spec:
+                module_donors[stmt.targets[0].id] = spec
+    self_donors = _method_donors(rule, ctx, module_donors)
+    # pass 2: derived donors — module functions forwarding their params
+    for name, fn in _module_functions(ctx.tree):
+        spec = _derived_donor_spec(ctx, fn, module_donors, self_donors)
+        if spec and name not in module_donors:
+            module_donors[name] = spec
+    return module_donors, self_donors
+
+
+def _method_donors(rule, ctx: ModuleContext,
+                   module_donors: Dict[str, DonateSpec]
+                   ) -> Dict[str, DonateSpec]:
+    """Methods whose RETURN value is a donating executable — resolved
+    through the method's own local bindings (engine._executable's
+    ``jitted -> lowered -> exe`` chain)."""
+    out: Dict[str, DonateSpec] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scanner = _ScopeScanner(rule, ctx, module_donors, {}, ())
+            spec: Optional[DonateSpec] = None
+            for stmt in ast.walk(item):
+                if isinstance(stmt, ast.Assign):
+                    scanner._bind_donors(stmt)
+                elif isinstance(stmt, ast.Return) \
+                        and stmt.value is not None:
+                    got = scanner._spec_of_expr(stmt.value)
+                    if got:
+                        spec = got
+            if spec:
+                out[item.name] = spec
+    return out
+
+
 @register
 class DonationRule(Rule):
     name = "donation-after-use"
@@ -283,21 +335,7 @@ class DonationRule(Rule):
         # the O(scopes × stmts) scan outright
         if "donate_arg" not in ctx.source:
             return
-        # pass 1: module-level donating names + methods returning donors
-        module_donors: Dict[str, DonateSpec] = {}
-        probe = _ScopeScanner(self, ctx, {}, {}, ())
-        for stmt in ctx.tree.body:
-            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
-                    and isinstance(stmt.targets[0], ast.Name):
-                spec = probe._spec_of_expr(stmt.value)
-                if spec:
-                    module_donors[stmt.targets[0].id] = spec
-        self_donors = self._method_donors(ctx, module_donors)
-        # pass 2: derived donors — module functions forwarding their params
-        for name, fn in _module_functions(ctx.tree):
-            spec = _derived_donor_spec(ctx, fn, module_donors, self_donors)
-            if spec and name not in module_donors:
-                module_donors[name] = spec
+        module_donors, self_donors = discover_module_donors(self, ctx)
         # pass 3: scan every scope linearly
         yield from self._scan_scope(ctx, ctx.tree.body, module_donors,
                                     self_donors, ())
@@ -314,34 +352,6 @@ class DonationRule(Rule):
         scanner = _ScopeScanner(self, ctx, donors, self_donors, params)
         scanner.run(body)
         yield from scanner.violations
-
-    def _method_donors(self, ctx: ModuleContext,
-                       module_donors: Dict[str, DonateSpec]
-                       ) -> Dict[str, DonateSpec]:
-        """Methods whose RETURN value is a donating executable — resolved
-        through the method's own local bindings (engine._executable's
-        ``jitted -> lowered -> exe`` chain)."""
-        out: Dict[str, DonateSpec] = {}
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            for item in node.body:
-                if not isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                scanner = _ScopeScanner(self, ctx, module_donors, {}, ())
-                spec: Optional[DonateSpec] = None
-                for stmt in ast.walk(item):
-                    if isinstance(stmt, ast.Assign):
-                        scanner._bind_donors(stmt)
-                    elif isinstance(stmt, ast.Return) \
-                            and stmt.value is not None:
-                        got = scanner._spec_of_expr(stmt.value)
-                        if got:
-                            spec = got
-                if spec:
-                    out[item.name] = spec
-        return out
 
 
 def _module_functions(tree: ast.Module):

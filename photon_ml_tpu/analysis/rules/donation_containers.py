@@ -1,0 +1,296 @@
+"""PL015 container-donation-taint: PL006/PL014's donated-buffer taint,
+driven through container literals, subscripts, unpacks, and pytree helpers.
+
+Why it matters here: the serving and transfer planes pack buffers before
+handing them to donating executables — ``(features, slots)`` tuples into an
+AOT scorer, ``dict(grads=g)`` into an update step, ``jax.tree_util``
+flatten/map chains over parameter trees.  PL006 deliberately taints only
+plain-``Name`` arguments; a buffer smuggled into a donated position inside
+a tuple is invisible to it, and so is a read of the TUPLE after one of its
+leaves was donated.  Both directions are use-after-frees on TPU/GPU that
+CPU runs silently tolerate.
+
+On top of the v4 summary layer's container-provenance tracking, this rule
+extends the PL006 scope scan with an *element table*: which local names a
+container name holds, per position where the literal is ordered.  It is
+populated by tuple/list/dict literals, ``dict(x=buf)`` calls, positional
+unpacking of a known literal, constant-index subscripts, and the pytree
+helpers (``tree_leaves``/``tree_flatten``/``tree_map``/...; per the repo's
+donation contracts a mapped tree is treated as aliasing its input's
+leaves).  At a donating call:
+
+  - a **container argument** in a donated position taints every
+    contributing name (the packed leaves), so a later read of a leaf is
+    flagged;
+  - a **Name argument** in a donated position taints its known elements
+    (the name itself stays PL006's jurisdiction — no double report) and
+    every container that holds the name, so reading ``pair`` after
+    ``donating(a)`` with ``pair = (a, b)`` is flagged too.
+
+Donors are PL006's module-local discovery plus, in whole-program mode,
+PL014's cross-module donor table — the same donor universe, one more level
+of provenance.  Re-assignment of a name clears both taint and elements
+(the rebind idiom stays sanctioned).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import dotted_name
+from photon_ml_tpu.analysis.rules.donation import (DonateSpec,
+                                                   discover_module_donors)
+from photon_ml_tpu.analysis.rules.donation_flow import (_CrossModuleScanner,
+                                                        cross_module_donors)
+
+_EMPTY: FrozenSet[str] = frozenset()
+_TREE_TERMINALS = {"tree_map", "tree_multimap", "tree_leaves", "tree_flatten",
+                   "tree_unflatten", "tree_transpose"}
+_TREE_SHORT = {"map", "leaves", "flatten", "unflatten", "transpose"}
+_PACKERS = {"tuple", "list", "dict"}
+
+
+def _is_tree_helper(call: ast.Call) -> bool:
+    dn = dotted_name(call.func) or ""
+    head, _, term = dn.rpartition(".")
+    return term in _TREE_TERMINALS or (
+        term in _TREE_SHORT and (head == "tree" or head.endswith(".tree")))
+
+
+def _tree_value_args(call: ast.Call) -> List[ast.AST]:
+    term = (dotted_name(call.func) or "").rpartition(".")[2]
+    args = list(call.args)
+    if term in ("tree_map", "tree_multimap", "map", "tree_unflatten",
+                "unflatten") and args:
+        args = args[1:]  # first arg is the mapped fn / the treedef
+    return args
+
+
+class _ContainerScanner(_CrossModuleScanner):
+    """PL006's scope scanner plus the container element table.  Taint text
+    is stored pre-rendered (the base scanner's message assumes the tainted
+    name was donated directly, which is exactly what PL015 is NOT about)."""
+
+    def __init__(self, rule, ctx, donors, fn_params, xresolve):
+        super().__init__(rule, ctx, donors, fn_params, xresolve)
+        # container name -> ordered per-slot contributing-name sets; helpers
+        # and unordered literals collapse to a single slot
+        self.slots: Dict[str, Tuple[FrozenSet[str], ...]] = {}
+
+    # -- provenance ----------------------------------------------------------
+    def _flat(self, name: str) -> FrozenSet[str]:
+        got = self.slots.get(name)
+        return frozenset().union(*got) if got else _EMPTY
+
+    def _contrib(self, expr: ast.AST, depth: int = 0) -> FrozenSet[str]:
+        """Names whose buffers the VALUE expression may hold."""
+        if expr is None or depth > 5:
+            return _EMPTY
+        if isinstance(expr, ast.Name):
+            return frozenset((expr.id,)) | self._flat(expr.id)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = _EMPTY
+            for e in expr.elts:
+                out |= self._contrib(e, depth + 1)
+            return out
+        if isinstance(expr, ast.Starred):
+            return self._contrib(expr.value, depth + 1)
+        if isinstance(expr, ast.Dict):
+            out = _EMPTY
+            for v in expr.values:
+                out |= self._contrib(v, depth + 1)
+            return out
+        if isinstance(expr, ast.Subscript):
+            slot = self._subscript_slot(expr)
+            if slot is not None:
+                return slot
+            if isinstance(expr.value, ast.Name):
+                return self._flat(expr.value.id)
+            return self._contrib(expr.value, depth + 1)
+        if isinstance(expr, ast.IfExp):
+            return (self._contrib(expr.body, depth + 1)
+                    | self._contrib(expr.orelse, depth + 1))
+        if isinstance(expr, ast.Call):
+            if _is_tree_helper(expr):
+                out = _EMPTY
+                for a in _tree_value_args(expr):
+                    out |= self._contrib(a, depth + 1)
+                return out
+            if isinstance(expr.func, ast.Name) and expr.func.id in _PACKERS:
+                out = _EMPTY
+                for a in expr.args:
+                    out |= self._contrib(a, depth + 1)
+                for kw in expr.keywords:
+                    out |= self._contrib(kw.value, depth + 1)
+                return out
+        return _EMPTY
+
+    def _subscript_slot(self, expr: ast.Subscript
+                        ) -> Optional[FrozenSet[str]]:
+        """``pair[0]`` with an ordered provenance for ``pair`` -> the exact
+        slot; None when the index or the ordering is unknown."""
+        if not (isinstance(expr.value, ast.Name)
+                and isinstance(expr.slice, ast.Constant)
+                and isinstance(expr.slice.value, int)):
+            return None
+        got = self.slots.get(expr.value.id)
+        if got is None or len(got) < 2:
+            return None
+        idx = expr.slice.value
+        return got[idx] if -len(got) <= idx < len(got) else None
+
+    def _ordered_slots(self, expr: ast.AST
+                       ) -> Optional[Tuple[FrozenSet[str], ...]]:
+        if isinstance(expr, (ast.Tuple, ast.List)) \
+                and not any(isinstance(e, ast.Starred) for e in expr.elts):
+            return tuple(self._contrib(e, 1) for e in expr.elts)
+        if isinstance(expr, ast.Name):
+            return self.slots.get(expr.id)
+        return None
+
+    # -- scanner overrides ---------------------------------------------------
+    def _bind_donors(self, stmt: ast.stmt) -> None:
+        super()._bind_donors(stmt)
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            return
+        tgt = stmt.targets[0]
+        if isinstance(tgt, ast.Name):
+            slots = self._ordered_slots(stmt.value)
+            if slots is None:
+                flat = self._contrib(stmt.value) - {tgt.id}
+                slots = (flat,) if flat else None
+            if slots:
+                self.slots[tgt.id] = slots
+            else:
+                self.slots.pop(tgt.id, None)
+        elif isinstance(tgt, ast.Tuple) \
+                and not any(isinstance(e, ast.Starred) for e in tgt.elts):
+            # positional unpack of a KNOWN ordered literal only — a generic
+            # `a, b = pair` stays unbound rather than over-aliasing slots
+            src = self._ordered_slots(stmt.value)
+            names = [e.id if isinstance(e, ast.Name) else None
+                     for e in tgt.elts]
+            if src is not None and len(src) == len(names):
+                for name, slot in zip(names, src):
+                    if name is None:
+                        continue
+                    if slot:
+                        self.slots[name] = (slot,)
+                    else:
+                        self.slots.pop(name, None)
+            elif isinstance(stmt.value, ast.Call) \
+                    and _is_tree_helper(stmt.value) and names and names[0]:
+                # flat, treedef = tree_flatten(bufs) — leaves land first
+                flat = self._contrib(stmt.value)
+                if flat:
+                    self.slots[names[0]] = (flat,)
+
+    def _clear_stores(self, stmt: ast.stmt) -> None:
+        super()._clear_stores(stmt)
+        # every store kills provenance; _bind_donors re-derives it right
+        # after for the single-Assign / known-unpack shapes
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                self.slots.pop(node.id, None)
+
+    def _taint_calls(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = self._spec_of_expr(node.func)
+            if not spec:
+                continue
+            donor = dotted_name(node.func) or "<donating executable>"
+            for i, arg in enumerate(node.args):
+                if i in spec.argnums:
+                    self._donate_value(arg, donor)
+            for kw in node.keywords:
+                if kw.arg in spec.argnames:
+                    self._donate_value(kw.value, donor)
+
+    def _donate_value(self, arg: ast.AST, donor: str) -> None:
+        line = getattr(arg, "lineno", 0)
+        if isinstance(arg, ast.Name):
+            # the name itself is PL006's finding; here: its packed leaves
+            # and every container that holds it
+            for leaf in self._flat(arg.id):
+                self._taint(leaf, line,
+                            f"was packed into `{arg.id}`, which was donated "
+                            f"to `{donor}`")
+            for holder, slots in self.slots.items():
+                if any(arg.id in s for s in slots):
+                    self._taint(holder, line,
+                                f"holds `{arg.id}`, which was donated to "
+                                f"`{donor}`")
+            return
+        # container literal / dict() / pytree-helper argument: every
+        # contributing name is donated with it
+        for leaf in sorted(self._contrib(arg)):
+            self._taint(leaf, line,
+                        f"was packed into a container donated to `{donor}`")
+
+    def _taint(self, name: str, line: int, why: str) -> None:
+        self.tainted[name] = (line, why)
+
+    def _expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in self.tainted \
+                    and id(sub) not in self._flagged:
+                self._flagged.add(id(sub))
+                line, why = self.tainted[sub.id]
+                self.violations.append(self.ctx.violation(
+                    self.rule, sub,
+                    f"`{sub.id}` {why} (line {line}) and is read again — "
+                    "donation invalidates every pytree leaf; on TPU/GPU "
+                    "this is a use-after-free that CPU runs hide. Rebind "
+                    "the result or drop the donation"))
+
+
+@register
+class ContainerDonationRule(Rule):
+    name = "container-donation-taint"
+    code = "PL015"
+    severity = "error"
+    description = ("no reads of a buffer donated inside a container "
+                   "(tuple/list/dict literal, unpack, or pytree helper), "
+                   "nor of a container whose leaf was donated")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        xresolve = None
+        donors: Dict[str, DonateSpec] = {}
+        if ctx.program is not None:
+            got = cross_module_donors(ctx)
+            if got is not None:
+                donors, xresolve = got
+        if "donate_arg" not in ctx.source and not donors \
+                and xresolve is None:
+            return
+        if "donate_arg" in ctx.source:
+            local, self_donors = discover_module_donors(self, ctx)
+            donors = {**local, **donors}
+        else:
+            self_donors = {}
+        if xresolve is None:
+            xresolve = lambda dn: None  # noqa: E731 — per-module mode
+        yield from self._scan(ctx, ctx.tree.body, donors, self_donors, (),
+                              xresolve)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                params = [p.arg for p in list(a.posonlyargs) + list(a.args)
+                          + list(a.kwonlyargs)]
+                yield from self._scan(ctx, node.body, donors, self_donors,
+                                      params, xresolve)
+
+    def _scan(self, ctx, body, donors, self_donors, params, xresolve
+              ) -> Iterator[Violation]:
+        scanner = _ContainerScanner(self, ctx, donors, params, xresolve)
+        scanner.self_donors = self_donors
+        scanner.run(body)
+        yield from scanner.violations

@@ -35,6 +35,71 @@ from photon_ml_tpu.analysis.jit_index import dotted_name
 from photon_ml_tpu.analysis.rules.donation import DonateSpec, _ScopeScanner
 
 
+def cross_module_donors(ctx: ModuleContext):
+    """(imported donor names, dotted-reference resolver) for ``ctx``, or
+    None when this module cannot reach a donor-exporting module at all —
+    the precheck that lets PL014/PL015 skip the scan.  Shared with the
+    container-taint rule so both see the same donor universe; memoized on
+    the context since both rules ask."""
+    cached = getattr(ctx, "_xmod_donors", False)
+    if cached is not False:
+        return cached
+    got = _cross_module_donors(ctx)
+    ctx._xmod_donors = got
+    return got
+
+
+def _cross_module_donors(ctx: ModuleContext):
+    info = ctx.program.modules.get(ctx.relpath)
+    if info is None:
+        return None
+    exports = ctx.program.donor_exports()
+
+    def spec_for(mod_relpath: str, sym: str) -> Optional[DonateSpec]:
+        got = exports.get(mod_relpath, {}).get(sym)
+        if got is None:
+            return None
+        spec = DonateSpec(argnums=tuple(got[0]), argnames=tuple(got[1]))
+        return spec if spec else None
+
+    # imported names bound to donors defined in ANOTHER module
+    donors: Dict[str, DonateSpec] = {}
+    for bound in info.imports:
+        got = ctx.program.resolve_symbol(info, bound)
+        if got is None:
+            continue
+        mod, sym = got
+        if mod.relpath == ctx.relpath:
+            continue  # local donor — PL006's jurisdiction
+        spec = spec_for(mod.relpath, sym)
+        if spec is not None:
+            donors[bound] = spec
+
+    def xresolve(dn: str) -> Optional[DonateSpec]:
+        """``alias.fn`` dotted reference -> cross-module donor spec."""
+        got = ctx.program.resolve_symbol(info, dn)
+        if got is None:
+            return None
+        mod, sym = got
+        if mod.relpath == ctx.relpath:
+            return None
+        return spec_for(mod.relpath, sym)
+
+    # precheck: the scanner is the expensive part, and a module can only
+    # trip these rules by reaching a donor-exporting module through its
+    # import table (bound names above, or `alias.fn` dotted references) —
+    # skip the scan entirely otherwise
+    if not donors:
+        exporting = {name for name, m in ctx.program.by_name.items()
+                     if exports.get(m.relpath)}
+        reach = any(en == tm or en.startswith(tm + ".")
+                    for tm, _sym in info.imports.values()
+                    for en in exporting)
+        if not reach:
+            return None
+    return donors, xresolve
+
+
 class _CrossModuleScanner(_ScopeScanner):
     """PL006's scanner, extended to resolve ``module.fn`` dotted callees
     through the program's donor table."""
@@ -74,53 +139,10 @@ class CrossModuleDonationRule(Rule):
     def check(self, ctx: ModuleContext) -> Iterator[Violation]:
         if ctx.tree is None or ctx.program is None:
             return
-        info = ctx.program.modules.get(ctx.relpath)
-        if info is None:
+        got = cross_module_donors(ctx)
+        if got is None:
             return
-        exports = ctx.program.donor_exports()
-
-        def spec_for(mod_relpath: str, sym: str) -> Optional[DonateSpec]:
-            got = exports.get(mod_relpath, {}).get(sym)
-            if got is None:
-                return None
-            spec = DonateSpec(argnums=tuple(got[0]), argnames=tuple(got[1]))
-            return spec if spec else None
-
-        # imported names bound to donors defined in ANOTHER module
-        donors: Dict[str, DonateSpec] = {}
-        for bound in info.imports:
-            got = ctx.program.resolve_symbol(info, bound)
-            if got is None:
-                continue
-            mod, sym = got
-            if mod.relpath == ctx.relpath:
-                continue  # local donor — PL006's jurisdiction
-            spec = spec_for(mod.relpath, sym)
-            if spec is not None:
-                donors[bound] = spec
-
-        def xresolve(dn: str) -> Optional[DonateSpec]:
-            """``alias.fn`` dotted reference -> cross-module donor spec."""
-            got = ctx.program.resolve_symbol(info, dn)
-            if got is None:
-                return None
-            mod, sym = got
-            if mod.relpath == ctx.relpath:
-                return None
-            return spec_for(mod.relpath, sym)
-
-        # precheck: the scanner is the expensive part, and a module can only
-        # trip this rule by reaching a donor-exporting module through its
-        # import table (bound names above, or `alias.fn` dotted references
-        # below) — skip the scan entirely otherwise
-        if not donors:
-            exporting = {name for name, m in ctx.program.by_name.items()
-                         if exports.get(m.relpath)}
-            reach = any(en == tm or en.startswith(tm + ".")
-                        for tm, _sym in info.imports.values()
-                        for en in exporting)
-            if not reach:
-                return
+        donors, xresolve = got
         yield from self._scan(ctx, ctx.tree.body, donors, (), xresolve)
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

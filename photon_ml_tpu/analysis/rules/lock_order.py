@@ -1,0 +1,87 @@
+"""PL018 lock-order: a cycle in the global lock acquisition-order graph is
+a deadlock.
+
+Why it matters here: the serving plane holds locks across object
+boundaries — the batcher's condition variable, the coefficient store's
+swap lock, the fleet registry's tenant lock — and the hot-swap path runs
+methods of ALL of them from one background thread while request threads
+come the other way.  Two locks taken in opposite orders on two such paths
+deadlock under load, cross-module, with no single function to point at —
+exactly what per-module analysis (PL005's discipline check, the
+``lock_held_fns`` reachability) cannot see.
+
+The v4 summary layer records, per function, which locks it acquires
+(``with self.<lock>:``, module-level locks, flow-resolved local aliases;
+``Condition(self._lock)`` canonicalises to the lock it wraps) and which
+calls it makes while holding one.  ``ProgramSummaries`` joins these into a
+directed order graph: ``A -> B`` when some function nests B inside A
+lexically, or calls — while holding A — a function that (transitively)
+acquires B.  Every strongly-connected component of size >= 2 is a
+deadlock finding, reported at each edge witness in the current module
+with the full cycle and the opposing path's location in the message.
+Lock identity is class-level (instances conflated — the conservative
+direction for ordering), self-edges never form (so RLock re-entry and
+same-class sibling instances cannot false-positive), and only resolvable
+callees propagate.  Whole-program mode only; per-module runs stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+
+
+def _short(key: str) -> str:
+    """``serving/batcher.py::AsyncBatcher._lock`` -> ``AsyncBatcher._lock``
+    (module kept only when needed for disambiguation in the message)."""
+    return key.rpartition("::")[2]
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    code = "PL018"
+    severity = "error"
+    description = ("the program-wide lock acquisition-order graph must be "
+                   "acyclic — any cycle is a deadlock under load")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None or ctx.program is None:
+            return
+        summ = ctx.program.summaries()
+        if not summ.lock_cycles:
+            return
+        for keys, edges in summ.lock_cycles:
+            cycle_desc = " ; ".join(
+                f"{_short(a)} -> {_short(b)} in {fn} ({relpath}:"
+                f"{getattr(site, 'lineno', 0)})"
+                for (a, b), (relpath, fn, site) in sorted(edges.items()))
+            for (a, b), (relpath, fn, site) in sorted(edges.items()):
+                if relpath != ctx.relpath:
+                    continue
+                others = self._opposing(edges, (a, b))
+                yield ctx.violation(
+                    self, site,
+                    f"lock-order cycle over {{{', '.join(_short(k) for k in keys)}}}: "
+                    f"`{fn}` takes {_short(a)} then {_short(b)}, but "
+                    f"{others} — two threads on these paths deadlock; "
+                    f"impose one global order (full cycle: {cycle_desc})")
+
+    @staticmethod
+    def _opposing(edges, edge: Tuple[str, str]) -> str:
+        a, b = edge
+        # the path that closes the cycle back from b to a — prefer the
+        # direct reverse edge, else name any edge leaving b
+        rev = edges.get((b, a))
+        if rev is not None:
+            relpath, fn, site = rev
+            return (f"`{fn}` ({relpath}:{getattr(site, 'lineno', 0)}) takes "
+                    f"{_short(b)} then {_short(a)}")
+        for (x, y), (relpath, fn, site) in sorted(edges.items()):
+            if x == b:
+                return (f"`{fn}` ({relpath}:{getattr(site, 'lineno', 0)}) "
+                        f"continues {_short(b)} -> {_short(y)}")
+        return "another path closes the cycle"

@@ -48,17 +48,12 @@ import ast
 import dataclasses
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from photon_ml_tpu.analysis.dataflow import (LOCK_FACTORIES as
+                                             _LOCK_FACTORIES,
+                                             MUTATOR_METHODS as _MUTATORS,
+                                             class_lock_info)
 from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
                                               register)
-from photon_ml_tpu.analysis.jit_index import dotted_name
-
-_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
-                   "BoundedSemaphore"}
-_MUTATORS = {
-    "append", "extend", "insert", "add", "update", "setdefault", "pop",
-    "popitem", "remove", "discard", "clear", "move_to_end", "appendleft",
-    "popleft", "sort", "reverse",
-}
 # operator-module functions that mutate their FIRST argument in place
 _OP_MUTATORS = {
     "iadd", "isub", "imul", "imatmul", "itruediv", "ifloordiv", "imod",
@@ -94,24 +89,9 @@ def _self_attr(node: ast.AST) -> Optional[str]:
 
 
 def _lock_names(cls: ast.ClassDef) -> Set[str]:
-    names: Set[str] = set()
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Assign):
-            value_fn = (dotted_name(node.value.func)
-                        if isinstance(node.value, ast.Call) else None)
-            factory = (value_fn or "").rpartition(".")[2]
-            if factory in _LOCK_FACTORIES:
-                for tgt in node.targets:
-                    attr = _self_attr(tgt)
-                    if attr is not None:
-                        names.add(attr)
-        elif isinstance(node, ast.With):
-            # with self._lock: — treat any self.*lock* context manager as a
-            # lock even when constructed elsewhere
-            for item in node.items:
-                attr = _self_attr(item.context_expr)
-                if attr is not None and "lock" in attr.lower():
-                    names.add(attr)
+    """Lock-attr detection shared with the v4 summary layer (factory
+    assignments plus any ``with self.*lock*:`` context manager)."""
+    names, _canon, _factory = class_lock_info(cls)
     return names
 
 

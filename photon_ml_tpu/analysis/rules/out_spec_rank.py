@@ -1,0 +1,179 @@
+"""PL017 out-spec-rank: each shard_map out_spec leaf must not name more
+dimensions than the returned expression has.
+
+Why it matters here: PL011 checks out_specs ARITY (tuple length vs the
+target's return tuple), but a spec of the right arity can still be deeper
+than the value it shards — ``out_specs=P("data", None)`` over a kernel
+that returns ``x.sum()`` (rank 0) or a ``jnp.zeros((n,))`` accumulator
+(rank 1).  jax rejects a PartitionSpec longer than the output's rank only
+at trace time on the real mesh; on the CPU fallback path these sites pass
+every test.  (A spec SHORTER than the rank is legal — trailing dimensions
+replicate — so only the definite over-length case is flagged.)
+
+Per-leaf ranks come from the v4 shape inference in ``analysis/dataflow``:
+literal scalars, shape-literal constructors (``zeros``/``ones``/``full``),
+axis-free reductions (``x.sum()``, ``jnp.mean(x)``), ``reshape`` with a
+literal shape, ``ravel``, rank-preserving elementwise ops and collectives
+(``psum``/``pmean``), closed over single-assignment locals — and, through
+``ProgramSummaries``' return-rank fixpoint, over helper CALLS, so
+``return _accumulate(x)`` resolves to the helper's inferred rank across
+modules (a module-local resolver stands in when there is no program
+index).  Anything not definitely known stays quiet.
+
+Pairing mirrors jax's pytree-prefix semantics: a tuple out_specs pairs
+element-wise with literal tuple returns; a single spec broadcasts to
+every returned leaf.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from photon_ml_tpu.analysis.dataflow import infer_rank, local_rank_env
+from photon_ml_tpu.analysis.framework import (ModuleContext, Rule, Violation,
+                                              register)
+from photon_ml_tpu.analysis.jit_index import (FunctionNode, _unwrap_transform,
+                                              dotted_name)
+from photon_ml_tpu.analysis.rules.mesh_axis import (_def_in_scope_chain,
+                                                    _SHARD_MAP_TERMINALS)
+from photon_ml_tpu.analysis.rules.shard_spec import _arg_or_kw
+from photon_ml_tpu.analysis.rules.sharding import (_is_pspec_call,
+                                                   _pspec_aliases)
+
+
+def _lexical_returns(fn: FunctionNode) -> List[ast.expr]:
+    if isinstance(fn, ast.Lambda):
+        return [fn.body]
+    values: List[ast.expr] = []
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            values.append(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    return values
+
+
+def _spec_rank(spec: ast.Call) -> Optional[int]:
+    """Number of output dimensions a P(...)/PartitionSpec(...) literal
+    names (None entries included — each positional argument addresses one
+    dimension).  None when a Starred makes the length unknown."""
+    if any(isinstance(a, ast.Starred) for a in spec.args):
+        return None
+    return len(spec.args)
+
+
+def _local_rank_hook(ctx: ModuleContext):
+    """Module-local callee return-rank resolver — the per-module stand-in
+    for ProgramSummaries.call_rank."""
+    graph = ctx.dataflow.call_graph
+    memo: Dict[int, Optional[int]] = {}
+
+    def fn_rank(fn: FunctionNode, depth: int = 0) -> Optional[int]:
+        if id(fn) in memo:
+            return memo[id(fn)]
+        if depth > 6:
+            return None
+        memo[id(fn)] = None  # recursion/cycle guard
+        values = _lexical_returns(fn)
+        if values:
+            def inner(call: ast.Call) -> Optional[int]:
+                target = graph.resolve(call.func)
+                return fn_rank(target, depth + 1) \
+                    if target is not None else None
+            env = local_rank_env(fn, inner)
+            ranks = [infer_rank(v, env, inner) for v in values]
+            if all(k is not None for k in ranks) and len(set(ranks)) == 1:
+                memo[id(fn)] = ranks[0]
+        return memo[id(fn)]
+
+    def hook(call: ast.Call) -> Optional[int]:
+        target = graph.resolve(call.func)
+        return fn_rank(target) if target is not None else None
+
+    return hook
+
+
+@register
+class OutSpecRankRule(Rule):
+    name = "out-spec-rank"
+    code = "PL017"
+    severity = "error"
+    description = ("no shard_map out_spec may name more dimensions than "
+                   "the returned expression's (inferred) rank")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        if not any(t in ctx.source for t in _SHARD_MAP_TERMINALS):
+            return
+        aliases = _pspec_aliases(ctx.tree)
+        if ctx.program is not None:
+            summ = ctx.program.summaries()
+            hook = lambda call: summ.call_rank(ctx.relpath, call)  # noqa: E731
+        else:
+            hook = _local_rank_hook(ctx)
+        for call in ctx.nodes_of(ast.Call):
+            if not call.args:
+                continue
+            fname = dotted_name(call.func)
+            if fname is None \
+                    or fname.rpartition(".")[2] not in _SHARD_MAP_TERMINALS:
+                continue
+            yield from self._check_site(ctx, call, aliases, hook)
+
+    def _check_site(self, ctx: ModuleContext, call: ast.Call, aliases,
+                    hook) -> Iterator[Violation]:
+        target = _unwrap_transform(call.args[0])
+        if isinstance(target, ast.Name):
+            target = _def_in_scope_chain(ctx, call, target.id)
+        if not isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+            return
+        out_specs = _arg_or_kw(call, "out_specs", 3)
+        if out_specs is None:
+            return
+        returns = _lexical_returns(target)
+        if not returns:
+            return
+        env = local_rank_env(target, hook)
+        tname = getattr(target, "name", "<lambda>")
+
+        def leaf_pairs() -> Iterator[Tuple[ast.expr, ast.expr]]:
+            if isinstance(out_specs, ast.Tuple):
+                for ret in returns:
+                    if isinstance(ret, ast.Tuple) \
+                            and len(ret.elts) == len(out_specs.elts):
+                        yield from zip(out_specs.elts, ret.elts)
+            else:
+                # single spec: a pytree prefix — broadcasts to every leaf
+                for ret in returns:
+                    leaves = ret.elts if isinstance(ret, ast.Tuple) else [ret]
+                    for leaf in leaves:
+                        yield out_specs, leaf
+
+        seen: set = set()
+        for spec, leaf in leaf_pairs():
+            if not _is_pspec_call(spec, aliases):
+                continue
+            srank = _spec_rank(spec)
+            if not srank:
+                continue  # P() shards nothing — always legal
+            lrank = infer_rank(leaf, env, hook)
+            if lrank is None or lrank >= srank:
+                continue
+            key = (id(spec), id(leaf))
+            if key in seen:
+                continue
+            seen.add(key)
+            yield ctx.violation(
+                self, spec,
+                f"out_spec names {srank} dimension(s) but `{tname}` returns "
+                f"an expression of rank {lrank} here (line {leaf.lineno}) — "
+                "a PartitionSpec longer than the output rank is rejected at "
+                "trace time on the real mesh; drop the extra entries or "
+                "reshape the output")

@@ -321,9 +321,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(oldest dumps evicted first)")
     p.add_argument("--exemplars", action="store_true",
                    help="attach trace-id exemplars to latency histogram "
-                        "buckets in the Prometheus exposition (pairs "
-                        "with --trace: samples observed outside any "
-                        "trace context carry no exemplar)")
+                        "buckets; with --metrics-port the /metrics route "
+                        "switches to OpenMetrics 1.0.0 exposition, the "
+                        "format exemplars are specified in (pairs with "
+                        "--trace: samples observed outside any trace "
+                        "context carry no exemplar)")
     return p
 
 
@@ -786,7 +788,7 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
         if args.metrics_port:
             scrape = await MetricsEndpoint(
                 engine.metrics, port=args.metrics_port,
-                health=health).start()
+                health=health, exemplars=args.exemplars).start()
             logger.info("metrics scrape on http://127.0.0.1:%d/metrics "
                         "(+ /healthz, /readyz)", scrape.port)
         loop = asyncio.get_running_loop()
@@ -1015,7 +1017,7 @@ def run(argv: List[str]) -> int:
 
                 metrics_sidecar = ThreadedMetricsEndpoint(
                     engine.metrics, port=args.metrics_port,
-                    health=health).start()
+                    health=health, exemplars=args.exemplars).start()
                 logger.info("metrics scrape on http://127.0.0.1:%d/metrics"
                             " (+ /healthz, /readyz)", metrics_sidecar.port)
             lines = sys.stdin if args.requests == "-" \

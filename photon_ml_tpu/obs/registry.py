@@ -338,6 +338,71 @@ class MetricsRegistry:
             lines.append(f"{pname}_count{_prom_labels(labels)} {count}")
         return "\n".join(lines) + "\n"
 
+    def to_openmetrics(self) -> str:
+        """OpenMetrics 1.0.0 text exposition — the format exemplars are
+        actually SPECIFIED in (Prometheus 0.0.4 parsers merely tolerate the
+        suffix; an OpenMetrics scraper ingests it and links the trace id).
+
+        Differences from :meth:`to_prometheus`: counter samples carry the
+        mandatory ``_total`` suffix (family name loses it in the TYPE line),
+        exemplars attach whenever the histogram recorded one (the
+        ``enable_exemplars`` switch gates recording, not exposition), and
+        the stream ends with the required ``# EOF`` terminator."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(((k, h.bounds, list(h.counts), h.total, h.count,
+                             list(h.exemplars) if h.exemplars else None)
+                            for k, h in self._histograms.items()),
+                           key=lambda e: e[0])
+        lines: List[str] = []
+
+        def _exemplar_suffix(ex) -> str:
+            if not ex:
+                return ""
+            tid, secs = ex
+            return f' # {{trace_id="{_prom_escape(tid)}"}} ' \
+                   f'{repr(float(secs))}'
+
+        seen = None
+        for (name, labels), value in counters:
+            fam = _prom_name(name)
+            if fam.endswith("_total"):
+                fam = fam[:-len("_total")]
+            if fam != seen:
+                lines.append(f"# TYPE {fam} counter")
+                seen = fam
+            lines.append(f"{fam}_total{_prom_labels(labels)} {_fmt(value)}")
+        seen = None
+        for (name, labels), value in gauges:
+            fam = _prom_name(name)
+            if fam != seen:
+                lines.append(f"# TYPE {fam} gauge")
+                seen = fam
+            lines.append(f"{fam}{_prom_labels(labels)} {_fmt(value)}")
+        seen = None
+        for (name, labels), bounds, counts, total, count, exemplars in hists:
+            fam = _prom_name(name)
+            if fam != seen:
+                lines.append(f"# TYPE {fam} histogram")
+                seen = fam
+            cum = 0
+            for i, (bound, c) in enumerate(zip(bounds, counts)):
+                cum += c
+                lines.append(
+                    f"{fam}_bucket"
+                    f"{_prom_labels(labels, (('le', repr(bound)),))} {cum}"
+                    + _exemplar_suffix(exemplars[i] if exemplars else None))
+            lines.append(
+                f"{fam}_bucket{_prom_labels(labels, (('le', '+Inf'),))} "
+                f"{count}"
+                + _exemplar_suffix(exemplars[len(bounds)] if exemplars
+                                   else None))
+            lines.append(f"{fam}_sum{_prom_labels(labels)} {_fmt(total)}")
+            lines.append(f"{fam}_count{_prom_labels(labels)} {count}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
     def export(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.to_json(indent=2))

@@ -154,6 +154,9 @@ class LogFollower:
 
     @property
     def position(self) -> Optional[Tuple[int, int]]:
+        # photonlint: disable=alias-escape -- the position is an
+        # immutable (generation, offset) tuple the catch-up pass
+        # REPLACES under _run_lock, never mutates in place
         return self._position
 
     @property

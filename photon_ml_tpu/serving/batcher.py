@@ -307,6 +307,9 @@ class AsyncBatcher:
             return self._flush_cost_locked()
 
     def _flush_cost_locked(self) -> float:
+        # photonlint: disable=alias-escape -- returns a float (EWMA
+        # sample); the _locked suffix is the calling convention: every
+        # caller already holds self._cond
         return (self._flush_ewma_s if self._flush_ewma_s is not None
                 else self.deadline_s)
 

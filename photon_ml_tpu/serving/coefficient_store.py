@@ -399,14 +399,24 @@ class RandomCoordinate:
     @property
     def hot(self) -> HotSet:
         """The current residency snapshot (read once per resolve)."""
+        # photonlint: disable=alias-escape -- documented snapshot-read
+        # contract: the swap thread builds a NEW HotSet and replaces
+        # self._hot under the lock; readers treat the handed-out set
+        # as frozen (read once per resolve, never written)
         return self._hot
 
     @property
     def table(self) -> Array:
+        # photonlint: disable=alias-escape -- same snapshot-read
+        # contract as `hot`: the device table is replaced wholesale on
+        # swap, and jax arrays are immutable to readers anyway
         return self._hot.table
 
     @property
     def hot_slot_of(self) -> Dict[int, int]:
+        # photonlint: disable=alias-escape -- same snapshot-read
+        # contract as `hot`: slot_of is built once per HotSet and
+        # never updated in place after publication
         return self._hot.slot_of
 
     # -- frequency tracking ------------------------------------------------

@@ -125,6 +125,9 @@ class ModelFleet:
     # -- registration ------------------------------------------------------
     @property
     def default_model(self) -> Optional[str]:
+        # photonlint: disable=alias-escape -- Optional[str] snapshot;
+        # strings cannot be mutated through the alias, and a stale
+        # read races benignly with deregistration by design
         return self._default
 
     def models(self) -> Tuple[str, ...]:

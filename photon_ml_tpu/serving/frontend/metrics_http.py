@@ -10,7 +10,10 @@ event loop as the socket front end (one thread, one loop, no handler-class
 plumbing), and so the stdio serve loop can host it on a sidecar thread via
 :class:`ThreadedMetricsEndpoint` without dragging in a blocking server.
 
-Routes:  ``GET /metrics`` -> Prometheus text exposition;
+Routes:  ``GET /metrics`` -> Prometheus text exposition (or, for an
+endpoint built with ``exemplars=True``, OpenMetrics 1.0.0 with photonpulse
+trace-id exemplars on the histogram buckets — the content type flips to
+``application/openmetrics-text`` so scrapers negotiate the richer parse);
 ``GET /metrics.json`` -> the structured JSON dump;
 ``GET /healthz`` -> 200 whenever this listener can answer at all (process
 liveness); ``GET /readyz`` -> 200 when every registered readiness check
@@ -43,11 +46,13 @@ class MetricsEndpoint:
     """One-loop asyncio scrape listener (module docstring)."""
 
     def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
-                 port: int = 0, health: Optional[HealthState] = None):
+                 port: int = 0, health: Optional[HealthState] = None,
+                 exemplars: bool = False):
         self.metrics = metrics
         self.host = host
         self.config_port = port
         self.health = health
+        self.exemplars = exemplars
         self.port: Optional[int] = None
         self._server: Optional[asyncio.base_events.Server] = None
 
@@ -86,8 +91,13 @@ class MetricsEndpoint:
                 return
             status = 200
             if path in ("/metrics", "/metrics/"):
-                body = self.metrics.to_prometheus().encode("utf-8")
-                ctype = b"text/plain; version=0.0.4; charset=utf-8"
+                if self.exemplars:
+                    body = self.metrics.to_openmetrics().encode("utf-8")
+                    ctype = (b"application/openmetrics-text; "
+                             b"version=1.0.0; charset=utf-8")
+                else:
+                    body = self.metrics.to_prometheus().encode("utf-8")
+                    ctype = b"text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = self.metrics.to_json().encode("utf-8")
                 ctype = b"application/json"
@@ -156,8 +166,10 @@ class ThreadedMetricsEndpoint:
     the blocking stdio serve loop uses for ``--metrics-port``."""
 
     def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
-                 port: int = 0, health: Optional[HealthState] = None):
-        self.endpoint = MetricsEndpoint(metrics, host, port, health=health)
+                 port: int = 0, health: Optional[HealthState] = None,
+                 exemplars: bool = False):
+        self.endpoint = MetricsEndpoint(metrics, host, port, health=health,
+                                        exemplars=exemplars)
         self._ready = threading.Event()
         self._stop: Optional[asyncio.Event] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None

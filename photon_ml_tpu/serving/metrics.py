@@ -258,6 +258,12 @@ class ServingMetrics:
         serving family, labels included)."""
         return self.registry.to_prometheus()
 
+    def to_openmetrics(self) -> str:
+        """OpenMetrics 1.0.0 exposition with histogram exemplars — what
+        ``/metrics`` serves when the scrape endpoint is built with
+        ``exemplars=True`` (photonpulse trace-id bucket exemplars)."""
+        return self.registry.to_openmetrics()
+
     def export(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.to_json(indent=2))

@@ -138,6 +138,10 @@ class HotSwapper:
     def serving_base(self) -> Tuple[Optional[str], int]:
         """The ``(model_dir, replay_floor)`` pair, read atomically — the
         photonrepl owner's snapshot source."""
+        # photonlint: disable=alias-escape -- the base pair is an
+        # immutable tuple REBOUND under _swap_lock (never mutated);
+        # returning it is exactly the atomic-pair-read this class
+        # exists to provide
         return self._base
 
     def swap(self, model_dir: str, version: str = "",

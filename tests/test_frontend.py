@@ -638,6 +638,31 @@ class TestScrapeEndpoint:
         finally:
             ep.stop()
 
+    def test_openmetrics_exemplars_endpoint(self):
+        from photon_ml_tpu.obs.pulse import context as pctx
+        from photon_ml_tpu.obs.registry import enable_exemplars
+
+        m = ServingMetrics()
+        ctx = pctx.mint()
+        enable_exemplars(True)
+        try:
+            with pctx.bind(ctx):
+                m.registry.observe("solve_seconds", 0.004)
+        finally:
+            enable_exemplars(False)
+        ep = ThreadedMetricsEndpoint(m, exemplars=True).start()
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/metrics", timeout=10)
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text; version=1.0.0")
+            body = resp.read().decode()
+            assert body.endswith("# EOF\n")
+            assert f'# {{trace_id="{ctx[0]}"}}' in body
+            assert "solve_seconds_bucket" in body
+        finally:
+            ep.stop()
+
     def test_scrape_sees_frontend_series(self):
         eng = _engine(max_batch=8)
         tf = _front(eng)

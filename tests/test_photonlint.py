@@ -1546,3 +1546,6 @@ class TestPackageGate:
         assert payload["files_scanned"] >= 100
         assert 0 < payload["value"] < 10  # the acceptance budget, on CPU
         assert payload["index_build_s"] < payload["value"]
+        # v4: the summary-layer share is accounted beside the dataflow one
+        assert 0 <= payload["summaries_s"] < payload["value"]
+        assert 0 <= payload["dataflow_s"] < payload["value"]

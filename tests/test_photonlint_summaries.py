@@ -1,0 +1,830 @@
+"""photonlint v4 interprocedural-summary suite (tier-1).
+
+Covers the layers PR 18 added on top of the v3 dataflow engine:
+
+  1. the four summary-driven rules, each with positive AND negative
+     fixtures: PL015 container-donation-taint, PL016 alias-escape,
+     PL017 out-spec-rank, PL018 lock-order;
+  2. the summary fixpoints themselves: escape closure over
+     ``return f(...)`` chains, termination on recursion and call cycles,
+     the immutable-valued-attr classifier that keeps scalar accessors
+     clean;
+  3. ``--diff`` incremental mode must equal a full run restricted to the
+     changed files FOR THE NEW RULES too (whole-package index contract);
+  4. the SARIF 2.1.0 reporter: output validates against a structural
+     subset of the official schema (embedded — CI has no network),
+     carries rule metadata, fingerprints, and suppression kinds.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.analysis import (analyze_source, build_rules,  # noqa: E402
+                                    render_sarif, run_analysis)
+from photon_ml_tpu.analysis.dataflow import (immutable_valued_attrs,  # noqa: E402
+                                             infer_rank)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOT = "photon_ml_tpu/core/fixture.py"
+
+
+def lint(src, rule=None, path=HOT):
+    rules = build_rules([rule]) if rule else build_rules()
+    kept, _ = analyze_source(path, textwrap.dedent(src), rules)
+    return kept
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _run(root):
+    return run_analysis([os.path.join(root, "pkg")], root=root)
+
+
+def _by_rule(result, rule):
+    return [v for v in result.violations if v.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# PL015 container-donation-taint
+# ---------------------------------------------------------------------------
+
+DONATING_HEADER = """
+    import jax
+
+    def update(buf, g):
+        return buf
+
+    fit = jax.jit(update, donate_argnums=0)
+"""
+
+
+class TestContainerDonationTaint:
+    def test_positive_leaf_read_after_container_donated(self):
+        vs = lint(DONATING_HEADER + """
+    def step(w, g):
+        fit((w, g), g)
+        return w + 1
+""", "container-donation-taint")
+        assert len(vs) == 1
+        assert "packed into a container" in vs[0].message
+        assert "`w`" in vs[0].message
+
+    def test_positive_container_read_after_leaf_donated(self):
+        vs = lint(DONATING_HEADER + """
+    def step(w, g):
+        pair = (w, g)
+        fit(w, g)
+        return pair
+""", "container-donation-taint")
+        assert len(vs) == 1
+        assert "holds `w`" in vs[0].message
+
+    def test_positive_pytree_helper_aliases_leaves(self):
+        vs = lint(DONATING_HEADER + """
+    import jax.tree_util
+
+    def step(params, g):
+        leaves = jax.tree_util.tree_leaves(params)
+        fit(leaves, g)
+        return params
+""", "container-donation-taint")
+        assert len(vs) == 1
+        assert "params" in vs[0].message
+
+    def test_positive_constant_subscript_tracks_slot(self):
+        # pair[1] is g — donating pair then reading g's slot holder is
+        # covered by the container read; reading the OTHER slot through a
+        # fresh unpack of the donated container is too
+        vs = lint(DONATING_HEADER + """
+    def step(w, g):
+        pair = (w, g)
+        fit(pair, g)
+        return w
+""", "container-donation-taint")
+        assert len(vs) == 1
+
+    def test_negative_rebind_clears_taint(self):
+        assert lint(DONATING_HEADER + """
+    def step(w, g):
+        w = fit((w, g), g)
+        return w
+""", "container-donation-taint") == []
+
+    def test_negative_unread_after_donation_is_quiet(self):
+        assert lint(DONATING_HEADER + """
+    def step(w, g):
+        out = fit((w, g), g)
+        return out
+""", "container-donation-taint") == []
+
+    def test_cross_module_donor_via_program_index(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "donor.py": DONATING_HEADER,
+            "user.py": """
+                from pkg.donor import fit
+
+                def step(w, g):
+                    fit((w, g), g)
+                    return w
+            """,
+        })
+        vs = _by_rule(_run(root), "container-donation-taint")
+        assert len(vs) == 1 and vs[0].path.endswith("user.py")
+
+
+# ---------------------------------------------------------------------------
+# PL016 alias-escape
+# ---------------------------------------------------------------------------
+
+STORE_MOD = """
+    import threading
+
+    class Store:
+        def __init__(self, table):
+            self._lock = threading.Lock()
+            self._table = table
+
+        def put(self, k, v):
+            with self._lock:
+                self._table[k] = v
+
+        def view(self):
+            return self._table
+"""
+
+
+class TestAliasEscape:
+    def test_positive_accessor_warning_and_caller_error(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "store.py": STORE_MOD,
+            "user.py": """
+                def poke(store, k, v):
+                    t = store.view()
+                    t[k] = v
+            """,
+        })
+        vs = _by_rule(_run(root), "alias-escape")
+        sev = {(v.path.rpartition("/")[2], v.severity) for v in vs}
+        assert ("store.py", "warning") in sev   # the escape hatch
+        assert ("user.py", "error") in sev      # the unlocked mutation
+        err = next(v for v in vs if v.severity == "error")
+        assert "_table" in err.message and "lock" in err.message.lower()
+
+    def test_positive_escape_closes_over_return_chain(self, tmp_path):
+        # grab() leaks only THROUGH view() — the program-wide fixpoint
+        # must close `return self.view()` over the callee's facts
+        root = _write_pkg(tmp_path, {
+            "store.py": STORE_MOD + """
+    def grab(self):
+        return self.view()
+""",
+            "user.py": """
+                def poke(store, k, v):
+                    t = store.grab()
+                    t[k] = v
+            """,
+        })
+        vs = _by_rule(_run(root), "alias-escape")
+        assert any(v.severity == "error" and v.path.endswith("user.py")
+                   for v in vs)
+
+    def test_negative_mutation_under_a_lock_is_exempt(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "store.py": STORE_MOD,
+            "user.py": """
+                def poke(store, k, v):
+                    t = store.view()
+                    with store._lock:
+                        t[k] = v
+            """,
+        })
+        vs = _by_rule(_run(root), "alias-escape")
+        assert all(v.severity != "error" for v in vs)
+
+    def test_negative_rebind_kills_escaped_binding(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "store.py": STORE_MOD,
+            "user.py": """
+                def poke(store, k, v):
+                    t = store.view()
+                    t = {}
+                    t[k] = v
+            """,
+        })
+        vs = _by_rule(_run(root), "alias-escape")
+        assert all(v.severity != "error" for v in vs)
+
+    def test_negative_immutable_valued_attr_accessor_is_clean(self, tmp_path):
+        # _n only ever holds ints: no mutation can travel through the alias
+        root = _write_pkg(tmp_path, {
+            "counter.py": """
+                import threading
+
+                class Counter:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self._n = self._n + 1
+
+                    def value(self):
+                        return self._n
+            """,
+        })
+        assert _by_rule(_run(root), "alias-escape") == []
+
+    def test_fixpoint_terminates_on_recursion_and_cycles(self, tmp_path):
+        # a self-recursive accessor and a two-function return cycle must
+        # reach the fixpoint (bounded iteration), not hang or crash
+        root = _write_pkg(tmp_path, {
+            "cyclic.py": STORE_MOD + """
+    def spin(self):
+        return self.spin()
+
+    def ping(self):
+        return self.pong()
+
+    def pong(self):
+        return self.ping()
+""",
+        })
+        result = _run(root)  # completes == terminates
+        assert isinstance(result.violations, list)
+
+
+# ---------------------------------------------------------------------------
+# PL017 out-spec-rank
+# ---------------------------------------------------------------------------
+
+class TestOutSpecRank:
+    def test_positive_scalar_return_under_rank1_spec(self):
+        vs = lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def kernel(x):
+                return x.sum()
+
+            f = shard_map(kernel, mesh=MESH, in_specs=P("data"),
+                          out_specs=P("data"))
+        """, "out-spec-rank")
+        assert len(vs) == 1
+        assert "rank 0" in vs[0].message and "1 dimension" in vs[0].message
+
+    def test_positive_rank_resolved_through_helper_call(self):
+        vs = lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def _reduce(x):
+                return x.sum()
+
+            def kernel(x):
+                return _reduce(x)
+
+            f = shard_map(kernel, mesh=MESH, in_specs=P("data"),
+                          out_specs=P("data", None))
+        """, "out-spec-rank")
+        assert len(vs) == 1 and "rank 0" in vs[0].message
+
+    def test_positive_tuple_specs_pair_elementwise(self):
+        vs = lint("""
+            import jax.numpy as jnp
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def kernel(x):
+                return x.sum(), jnp.zeros((4,))
+
+            f = shard_map(kernel, mesh=MESH, in_specs=P("data"),
+                          out_specs=(P("data"), P("data")))
+        """, "out-spec-rank")
+        # only the scalar leaf violates; the rank-1 accumulator matches
+        assert len(vs) == 1 and "rank 0" in vs[0].message
+
+    def test_negative_shorter_spec_replicates_trailing_dims(self):
+        assert lint("""
+            import jax.numpy as jnp
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def kernel(x):
+                return jnp.zeros((4, 4))
+
+            f = shard_map(kernel, mesh=MESH, in_specs=P("data"),
+                          out_specs=P("data"))
+        """, "out-spec-rank") == []
+
+    def test_negative_unknown_rank_stays_quiet(self):
+        assert lint("""
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def kernel(x):
+                return x @ x.T
+
+            f = shard_map(kernel, mesh=MESH, in_specs=P("data"),
+                          out_specs=P("data", None))
+        """, "out-spec-rank") == []
+
+
+class TestRankInference:
+    def _rank(self, expr_src, env=None):
+        return infer_rank(ast.parse(expr_src, mode="eval").body, env)
+
+    def test_literals_and_constructors(self):
+        assert self._rank("1.5") == 0
+        assert self._rank("jnp.zeros((4, 8))") == 2
+        assert self._rank("jnp.ones((n,))") == 1
+        assert self._rank("x.sum()", {"x": 3}) == 0
+
+    def test_elementwise_and_env(self):
+        assert self._rank("x + y", {"x": 2, "y": 2}) == 2
+        assert self._rank("x.reshape((2, 2))") == 2
+        assert self._rank("unknown_call(x)") is None
+
+
+# ---------------------------------------------------------------------------
+# PL018 lock-order
+# ---------------------------------------------------------------------------
+
+DEADLOCK_MOD = """
+    import threading
+
+    class Alpha:
+        def __init__(self, beta):
+            self._lock = threading.Lock()
+            self.beta = beta
+
+        def forward(self):
+            with self._lock:
+                self.beta.grab_beta()
+
+        def poke_alpha(self):
+            with self._lock:
+                pass
+
+    class Beta:
+        def __init__(self, alpha):
+            self._lock = threading.Lock()
+            self.alpha = alpha
+
+        def grab_beta(self):
+            with self._lock:
+                pass
+
+        def backward(self):
+            with self._lock:
+                self.alpha.poke_alpha()
+"""
+
+
+class TestLockOrder:
+    def test_positive_opposite_order_cycle(self, tmp_path):
+        root = _write_pkg(tmp_path, {"locks.py": DEADLOCK_MOD})
+        vs = _by_rule(_run(root), "lock-order")
+        assert vs, "opposite-order lock paths must report a cycle"
+        assert any("deadlock" in v.message for v in vs)
+        assert any("Alpha._lock" in v.message and "Beta._lock" in v.message
+                   for v in vs)
+
+    def test_positive_cycle_across_modules(self, tmp_path):
+        head, _, tail = DEADLOCK_MOD.partition("    class Beta:")
+        root = _write_pkg(tmp_path, {
+            "alpha.py": head,
+            "beta.py": "\n    import threading\n\n    class Beta:" + tail,
+        })
+        vs = _by_rule(_run(root), "lock-order")
+        assert vs and any("deadlock" in v.message for v in vs)
+
+    def test_negative_consistent_order_is_quiet(self, tmp_path):
+        # both paths take Alpha then Beta — an order, not a cycle
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import threading
+
+            class Alpha:
+                def __init__(self, beta):
+                    self._lock = threading.Lock()
+                    self.beta = beta
+
+                def forward(self):
+                    with self._lock:
+                        self.beta.grab_beta()
+
+                def also_forward(self):
+                    with self._lock:
+                        self.beta.grab_beta()
+
+            class Beta:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def grab_beta(self):
+                    with self._lock:
+                        pass
+        """})
+        assert _by_rule(_run(root), "lock-order") == []
+
+    def test_negative_builtin_and_module_calls_form_no_edges(self, tmp_path):
+        # the live tree's compact() shape: `os.remove(path)` and
+        # `dropped.append(...)` under a held lock must NOT resolve to the
+        # program's own unique `remove`/`append` defs — if they did, the
+        # reverse path through flush_log would close a bogus cycle
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import os
+            import threading
+
+            class Fleet:
+                def __init__(self, log):
+                    self._lock = threading.Lock()
+                    self.log = log
+
+                def remove(self, path):
+                    with self._lock:
+                        self.log.flush_log()
+
+            class Log:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush_log(self):
+                    with self._lock:
+                        pass
+
+                def compact(self, path, dropped):
+                    with self._lock:
+                        os.remove(path)
+                        dropped.append(path)
+        """})
+        assert _by_rule(_run(root), "lock-order") == []
+
+    def test_negative_reentrant_self_nesting_is_quiet(self, tmp_path):
+        # same class, same lock: RLock re-entry must not form a self-edge
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import threading
+
+            class Tower:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer_t(self):
+                    with self._lock:
+                        self.inner_t()
+
+                def inner_t(self):
+                    with self._lock:
+                        pass
+        """})
+        assert _by_rule(_run(root), "lock-order") == []
+
+
+# ---------------------------------------------------------------------------
+# the immutable-valued-attr classifier
+# ---------------------------------------------------------------------------
+
+def _cls(src):
+    tree = ast.parse(textwrap.dedent(src))
+    return next(n for n in ast.walk(tree) if isinstance(n, ast.ClassDef))
+
+
+class TestImmutableValuedAttrs:
+    def test_scalar_writes_classify_immutable(self):
+        got = immutable_valued_attrs(_cls("""
+            class C:
+                def __init__(self, n: int, name):
+                    self._n = 0
+                    self._name = str(name)
+                    self._pair = (1, "a")
+                    self._table = {}
+
+                def bump(self):
+                    self._n = self._n + 1
+        """))
+        assert {"_n", "_name", "_pair"} <= got
+        assert "_table" not in got
+
+    def test_any_mutable_write_disqualifies(self):
+        got = immutable_valued_attrs(_cls("""
+            class C:
+                def __init__(self):
+                    self._x = 0
+
+                def reset(self, xs):
+                    self._x = xs
+        """))
+        assert "_x" not in got
+
+    def test_chain_mutation_disqualifies(self):
+        got = immutable_valued_attrs(_cls("""
+            class C:
+                def __init__(self):
+                    self._buf = ()
+
+                def push(self, v):
+                    self._buf = ()
+                    self._buf.append(v)
+        """))
+        assert "_buf" not in got
+
+    def test_annotated_param_write_is_immutable(self):
+        got = immutable_valued_attrs(_cls("""
+            from typing import Optional
+
+            class C:
+                def __init__(self, start: int, tag: Optional[str]):
+                    self._start = start
+                    self._tag = tag
+        """))
+        assert {"_start", "_tag"} <= got
+
+
+# ---------------------------------------------------------------------------
+# --diff equivalence for the new rules
+# ---------------------------------------------------------------------------
+
+def _git(root, *args):
+    subprocess.run(["git", "-C", root, "-c", "user.email=t@t",
+                    "-c", "user.name=t", *args],
+                   check=True, capture_output=True, text=True)
+
+
+def _cli(root, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.photonlint", "--root", root,
+         "--no-baseline", "--format", "json", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+
+
+class TestDiffEquivalenceNewRules:
+    def test_diff_matches_full_run_for_alias_escape(self, tmp_path):
+        # store.py (committed, unchanged) holds the accessor; the NEW
+        # user.py holds the caller-side mutation — --diff lints only
+        # user.py but must still connect it through the whole-package index
+        pkg = tmp_path / "photon_ml_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "store.py").write_text(textwrap.dedent(STORE_MOD))
+        root = str(tmp_path)
+        _git(root, "init", "-q")
+        _git(root, "add", "-A")
+        _git(root, "commit", "-qm", "seed")
+        (pkg / "user.py").write_text(textwrap.dedent("""
+            def poke(store, k, v):
+                t = store.view()
+                t[k] = v
+        """))
+        full = _cli(root, os.path.join(root, "photon_ml_tpu"))
+        diff = _cli(root, "--diff", "HEAD")
+        assert full.returncode == 1 and diff.returncode == 1
+        full_new = json.loads(full.stdout)["new"]
+        diff_new = json.loads(diff.stdout)["new"]
+        want = {(v["rule"], v["path"], v["line"]) for v in full_new
+                if v["path"] == "photon_ml_tpu/user.py"}
+        got = {(v["rule"], v["path"], v["line"]) for v in diff_new}
+        assert want and got == want
+        assert any(v["rule"] == "alias-escape" for v in diff_new)
+        # the unchanged accessor's warning belongs to the full run only
+        assert any(v["path"] == "photon_ml_tpu/store.py" for v in full_new)
+        assert all(v["path"] != "photon_ml_tpu/store.py" for v in diff_new)
+
+
+# ---------------------------------------------------------------------------
+# SARIF reporter
+# ---------------------------------------------------------------------------
+
+# Structural subset of the official SARIF 2.1.0 schema (oasis-tcs/
+# sarif-spec Schemata/sarif-schema-2.1.0.json): required top-level shape,
+# run/tool/rule metadata, result locations/fingerprints/suppressions.  CI
+# has no network, so validating against the full published schema is not
+# an option; this subset pins every field the reporter emits.
+SARIF_SUBSET_SCHEMA = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "$schema": {"type": "string"},
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "columnKind": {"enum": ["utf16CodeUnits",
+                                            "unicodeCodePoints"]},
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "version": {"type": "string"},
+                                    "informationUri": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                            "properties": {
+                                                "id": {"type": "string"},
+                                                "name": {"type": "string"},
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["message"],
+                            "properties": {
+                                "ruleId": {"type": "string"},
+                                "ruleIndex": {"type": "integer",
+                                              "minimum": 0},
+                                "level": {"enum": ["none", "note",
+                                                   "warning", "error"]},
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                    "properties": {
+                                        "text": {"type": "string"}},
+                                },
+                                "locations": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "required": ["uri"],
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum": 1,
+                                                            },
+                                                            "startColumn": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum": 1,
+                                                            },
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                                "partialFingerprints": {
+                                    "type": "object",
+                                    "additionalProperties": {
+                                        "type": "string"},
+                                },
+                                "suppressions": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["kind"],
+                                        "properties": {
+                                            "kind": {"enum": ["inSource",
+                                                              "external"]},
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+class TestSarifReporter:
+    def _result(self, tmp_path, src):
+        pkg = tmp_path / "photon_ml_tpu"
+        pkg.mkdir(exist_ok=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text(textwrap.dedent(src))
+        return run_analysis([str(pkg)], root=str(tmp_path))
+
+    def test_output_validates_against_schema(self, tmp_path):
+        import jsonschema
+
+        result = self._result(tmp_path, """
+            import time
+
+            async def handler():
+                time.sleep(0.1)
+
+            def fine():  # photonlint: disable=blocking-in-async -- n/a
+                return 1
+        """)
+        doc = json.loads(render_sarif(result.violations, [], [], result))
+        jsonschema.validate(doc, SARIF_SUBSET_SCHEMA)
+
+    def test_rule_indices_fingerprints_and_levels(self, tmp_path):
+        result = self._result(tmp_path, """
+            import time
+
+            async def handler():
+                time.sleep(0.1)
+        """)
+        assert result.violations
+        doc = json.loads(render_sarif(result.violations, [], [], result))
+        run = doc["runs"][0]
+        rules = run["tool"]["driver"]["rules"]
+        assert rules[0]["id"] == "PL000"  # parse failures upload too
+        ids = [r["id"] for r in rules]
+        for res in run["results"]:
+            assert ids[res["ruleIndex"]] == res["ruleId"]
+            assert res["partialFingerprints"]["photonlint/v1"]
+            region = res["locations"][0]["physicalLocation"]["region"]
+            assert region["startLine"] >= 1 and region["startColumn"] >= 1
+
+    def test_suppression_kinds(self, tmp_path):
+        result = self._result(tmp_path, """
+            import time
+
+            async def a_handler():
+                time.sleep(0.1)
+
+            async def b_handler():
+                # photonlint: disable=blocking-in-async -- fixture reason
+                time.sleep(0.1)
+        """)
+        # route the unsuppressed finding through the BASELINED channel
+        doc = json.loads(render_sarif([], result.violations, [], result))
+        kinds = {s["kind"] for res in doc["runs"][0]["results"]
+                 for s in res.get("suppressions", [])}
+        assert kinds == {"external", "inSource"}
+
+    def test_cli_format_sarif(self, tmp_path):
+        pkg = tmp_path / "photon_ml_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text(
+            "import time\n\n\nasync def handler():\n    time.sleep(0.1)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.photonlint", "--root",
+             str(tmp_path), "--no-baseline", "--format", "sarif"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1  # findings still gate the exit code
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        assert doc["runs"][0]["tool"]["driver"]["name"] == "photonlint"
+        assert doc["runs"][0]["results"]
+
+
+# ---------------------------------------------------------------------------
+# registration + accounting
+# ---------------------------------------------------------------------------
+
+class TestV4Registration:
+    def test_new_rules_are_registered(self):
+        from photon_ml_tpu.analysis import registered_rules
+        registry = registered_rules()
+        codes = {cls.code for cls in registry.values()}
+        assert {"PL015", "PL016", "PL017", "PL018"} <= codes
+
+    def test_summary_cost_is_accounted(self, tmp_path):
+        from photon_ml_tpu.analysis import render_json
+        pkg = tmp_path / "photon_ml_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text(textwrap.dedent(STORE_MOD))
+        result = run_analysis([str(pkg)], root=str(tmp_path))
+        assert result.summaries_s >= 0.0
+        payload = json.loads(render_json([], [], [], result))
+        assert payload["summary"]["summaries_s"] >= 0.0

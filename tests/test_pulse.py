@@ -508,6 +508,46 @@ class TestExemplars:
 
 
 # ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+class TestOpenMetrics:
+    def test_counter_total_suffix_and_eof_terminator(self, tracer):
+        reg = MetricsRegistry()
+        reg.inc("plain", 2)
+        reg.inc("requests_total", 3, model="a")  # suffix already present
+        reg.set_gauge("depth", 4.0)
+        om = reg.to_openmetrics()
+        assert om.endswith("# EOF\n")
+        assert "# TYPE plain counter" in om and "plain_total 2" in om
+        # the family name loses the _total suffix; the sample keeps it
+        assert "# TYPE requests counter" in om
+        assert 'requests_total{model="a"} 3' in om
+        assert "# TYPE depth gauge" in om and "depth 4.0" in om
+
+    def test_bucket_exemplars_render_without_the_render_gate(self, tracer):
+        # the switch gates RECORDING; OpenMetrics exposes whatever was
+        # recorded (an OpenMetrics scraper asked for the richer parse)
+        reg = MetricsRegistry()
+        ctx = pctx.mint()
+        enable_exemplars(True)
+        with pctx.bind(ctx):
+            reg.observe("latency_seconds", 0.004, path="score")
+        enable_exemplars(False)
+        om = reg.to_openmetrics()
+        exemplar_lines = [l for l in om.splitlines() if "trace_id=" in l]
+        assert exemplar_lines and all("_bucket" in l for l in exemplar_lines)
+        assert f'# {{trace_id="{ctx[0]}"}} 0.004' in om
+        assert "latency_seconds_sum" in om and "latency_seconds_count" in om
+
+    def test_histogram_without_exemplars_is_plain(self, tracer):
+        reg = MetricsRegistry()
+        reg.observe("latency_seconds", 0.004)
+        om = reg.to_openmetrics()
+        assert "trace_id=" not in om
+        assert 'latency_seconds_bucket{le="+Inf"} 1' in om
+
+
+# ---------------------------------------------------------------------------
 # wire propagation units
 # ---------------------------------------------------------------------------
 class TestWirePropagation:

@@ -47,7 +47,8 @@ if _REPO_ROOT not in sys.path:  # direct `python tools/photonlint.py` runs
 from photon_ml_tpu.analysis import (BaselineError, build_rules,  # noqa: E402
                                     load_baseline, make_baseline, partition,
                                     registered_rules, render_json,
-                                    render_text, run_analysis, save_baseline)
+                                    render_sarif, render_text, run_analysis,
+                                    save_baseline)
 
 DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "photonlint_baseline.json")
 
@@ -67,7 +68,11 @@ def _parser() -> argparse.ArgumentParser:
                    help="incremental mode driven by git: lint the package "
                         "files changed vs REF (default HEAD), tracked and "
                         "untracked, under the whole-package index")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="sarif: SARIF 2.1.0 for code-scanning upload "
+                        "(baselined/suppressed findings carried with "
+                        "suppressions entries)")
     p.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE",
                    help="baseline file of accepted debt "
                         "(default: %(default)s)")
@@ -223,6 +228,8 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         print(render_json(new, baselined, stale, result))
+    elif args.format == "sarif":
+        print(render_sarif(new, baselined, stale, result))
     else:
         print(render_text(new, baselined, stale, result,
                           verbose=args.verbose))
