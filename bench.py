@@ -1404,13 +1404,16 @@ RUNNERS = {
 }
 
 def _synthetic_serving_engine(rng, n_entities, d, max_batch,
-                              device_capacity=None, mesh_shards=0):
+                              device_capacity=None, mesh_shards=0,
+                              load_aware_routing=True, replicate_top_k=0):
     """Build the serving benches' in-memory 2-coordinate GLMix engine
     (fixed + per-user effects, no training, no disk).  Consumes from
     ``rng`` in a fixed order, so callers seeding identically get identical
     models.  ``mesh_shards`` > 0 shards the per-user table over the serving
-    mesh (device_capacity becomes the PER-SHARD hot-row budget).  Returns
-    (engine, metrics, feature_names)."""
+    mesh (device_capacity becomes the PER-SHARD hot-row budget);
+    ``load_aware_routing=False`` pins the pre-placement ``slot % N``
+    router and ``replicate_top_k`` hot-replicates the traffic head.
+    Returns (engine, metrics, feature_names)."""
     from photon_ml_tpu.data.index_map import IndexMap, feature_key
     from photon_ml_tpu.data.reader import EntityIndex
     from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
@@ -1442,7 +1445,9 @@ def _synthetic_serving_engine(rng, n_entities, d, max_batch,
     store = CoefficientStore.from_model(
         model, task, {"userId": eidx}, {"all": imap},
         config=StoreConfig(device_capacity=device_capacity,
-                           mesh_shards=mesh_shards),
+                           mesh_shards=mesh_shards,
+                           load_aware_routing=load_aware_routing,
+                           replicate_top_k=replicate_top_k),
         version="synthetic", metrics=metrics)
     engine = ScoringEngine(store, BucketedBatcher(max_batch),
                            metrics=metrics)
@@ -1761,6 +1766,204 @@ def run_serving_mesh_bench(shard_counts=(1, 2, 4, 8), n_entities=20000,
     if out_path is None:
         out_path = os.path.join(
             _REPO, f"BENCH_SERVING_MESH_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
+def run_skew_sweep_bench(skews=(0.8, 1.0, 1.2, 1.5), n_shards=4,
+                         n_entities=8000, d=16, n_requests=800,
+                         max_batch=64, per_shard_capacity=None,
+                         replicate_top_k=None, seed=0, out_path=None):
+    """`bench.py --serving --skew-sweep`: traffic-skew robustness ->
+    BENCH_SKEW_<backend>.json.
+
+    The headline proof for traffic-aware placement: sweep the zipf
+    exponent from mild (0.8) to brutal (1.5) skew over a sharded store
+    and record, for BOTH routers —
+      - the traffic-aware router (load-aware greedy bin-pack over EWMA
+        hit counters + hot-row replication; the default), and
+      - the pre-placement router (``load_aware_routing=False``:
+        ``slot % N`` homes, no replicas) as the comparison curve, not
+        asserted —
+    the measured-epoch hot-set hit rate and single-request p99 after an
+    adaptation epoch with periodic rebalances.  ASSERTS, on the new
+    router only:
+      - hit rate and p99 at the harshest skew degrade at most 10% from
+        the mildest (PHOTON_BENCH_SKEW_TOL / PHOTON_BENCH_SKEW_P99_TOL
+        override) — skew concentrates load, it must not crater service;
+      - zero recompiles after warm at every point — placement moves
+        rows, never shapes;
+      - the two bitwise anchors: at 1 shard and under uniform traffic
+        the new router's scores equal the old router's EXACTLY
+        (max |diff| == 0.0).  Resolution hands the kernels GLOBAL rows
+        and the mesh kernels' _localize is placement-agnostic, so
+        routing policy can never touch a score.
+    """
+    import jax
+
+    from photon_ml_tpu.serving.batcher import Request
+
+    if per_shard_capacity is None:
+        per_shard_capacity = max(64, n_entities // 10)
+    if replicate_top_k is None:
+        replicate_top_k = max(8, 4 * n_shards)
+    n_dev = len(jax.devices())
+    shards = min(n_shards, n_dev)
+
+    # zipf ranks shuffled over training slots (same convention as the
+    # serving bench): the initial residency starts uncorrelated with the
+    # traffic head, so routing has to EARN its curve
+    slot_of_rank = np.random.default_rng(seed + 17).permutation(n_entities)
+
+    def mk_requests(rng, names, k, zipf):
+        if zipf > 0.0:
+            w = (np.arange(n_entities) + 1.0) ** -zipf
+            ids = slot_of_rank[rng.choice(n_entities, size=k, p=w / w.sum())]
+        else:
+            ids = rng.integers(0, n_entities, size=k)
+        unknown = rng.random(k) < 0.05
+        reqs = []
+        for i in range(k):
+            u = n_entities + i if unknown[i] else int(ids[i])
+            feats = [{"name": n, "term": "", "value": float(v)}
+                     for n, v in zip(names, rng.normal(size=d))]
+            reqs.append(Request(uid=i, features=feats,
+                                ids={"userId": f"user{u}"}))
+        return reqs
+
+    def build_point(zipf, load_aware, top_k):
+        rng = np.random.default_rng(seed)  # identical model every point
+        engine, metrics, names = _synthetic_serving_engine(
+            rng, n_entities, d, max_batch,
+            device_capacity=per_shard_capacity, mesh_shards=shards,
+            load_aware_routing=load_aware, replicate_top_k=top_k)
+        store = engine.store
+        n_compiled = engine.warm()
+        req_rng = np.random.default_rng(seed + int(zipf * 1000) + 3)
+        # adaptation epoch: periodic rebalances chase the observed head
+        adapt = mk_requests(req_rng, names, n_requests, zipf)
+        for start in range(0, n_requests, max_batch):
+            engine.score_requests(adapt[start:start + max_batch])
+            if (start // max_batch) % 3 == 2:
+                store.rebalance()
+        store.rebalance()
+        # measured epoch: hit rate over a fresh stream at the same skew
+        measured = mk_requests(req_rng, names, n_requests, zipf)
+        before_hot = metrics.counter("hot_hits")
+        for start in range(0, n_requests, max_batch):
+            engine.score_requests(measured[start:start + max_batch])
+        hot_hits = metrics.counter("hot_hits") - before_hot
+        rec = {"hot_hit_rate": round(hot_hits / max(n_requests, 1), 4)}
+        return engine, rec, n_compiled, names, req_rng
+
+    curves = {"traffic_aware": {}, "pre_placement_router": {}}
+    points = []
+    for router, la, tk in (("traffic_aware", True, replicate_top_k),
+                           ("pre_placement_router", False, 0)):
+        for s in skews:
+            engine, rec, n_compiled, names, req_rng = build_point(s, la, tk)
+            curves[router][str(s)] = rec
+            points.append((router, s, engine, rec, n_compiled, names,
+                           req_rng, []))
+
+    # latency sampling is INTERLEAVED across every point, with the order
+    # ROTATED each rep so periodic host stalls (scheduler ticks, flusher
+    # threads) cannot phase-align with any one point, and the asserted
+    # p99 is the MIN over per-rep p99s — the noise-floor tail, same idea
+    # as run_lint_bench's min(times): a rep that dodged the stalls shows
+    # what the path actually costs
+    n_reps = 5
+    for _rep in range(n_reps):
+        shift = _rep % len(points)
+        for router, zipf, engine, rec, _, names, req_rng, lat in (
+                points[shift:] + points[:shift]):
+            rep_lat = []
+            for r in mk_requests(req_rng, names, 120, zipf):
+                t = time.perf_counter()
+                engine.score_requests([r])
+                rep_lat.append(time.perf_counter() - t)
+            lat.append(rep_lat)
+    for router, zipf, engine, rec, n_compiled, _, _, lat in points:
+        pooled = np.asarray([x for rep in lat for x in rep])
+        rec["p50_s"] = round(float(np.percentile(pooled, 50)), 6)
+        rec["p99_s"] = round(min(float(np.percentile(np.asarray(rep), 99))
+                                 for rep in lat), 6)
+        compiles_after_warm = engine.compile_count - n_compiled
+        assert compiles_after_warm == 0, (
+            f"skew {zipf} ({router}) recompiled {compiles_after_warm} "
+            "executable(s) after warm — the zero-recompile invariant "
+            "broke")
+        rec["compiles_after_warm"] = compiles_after_warm
+
+    new_curve = curves["traffic_aware"]
+    old_curve = curves["pre_placement_router"]
+
+    tol = float(os.environ.get("PHOTON_BENCH_SKEW_TOL", "0.10"))
+    p99_tol = float(os.environ.get("PHOTON_BENCH_SKEW_P99_TOL", "0.10"))
+    lo, hi = str(min(skews)), str(max(skews))
+    hit_lo = new_curve[lo]["hot_hit_rate"]
+    hit_hi = new_curve[hi]["hot_hit_rate"]
+    assert hit_hi >= hit_lo * (1.0 - tol), (
+        f"hot-set hit rate degraded past {tol:.0%} under skew: "
+        f"s={lo} -> {hit_lo:.4f}, s={hi} -> {hit_hi:.4f}")
+    p99_lo = new_curve[lo]["p99_s"]
+    p99_hi = new_curve[hi]["p99_s"]
+    assert p99_hi <= p99_lo * (1.0 + p99_tol), (
+        f"single-request p99 degraded past {p99_tol:.0%} under skew: "
+        f"s={lo} -> {p99_lo * 1e6:.0f}us, s={hi} -> {p99_hi * 1e6:.0f}us")
+
+    def parity_diff(mesh_shards, zipf):
+        # score the SAME probe stream through both routers after each has
+        # observed identical traffic and rebalanced; placement differs,
+        # scores must not
+        scores = {}
+        for la, tk in ((False, 0), (True, replicate_top_k)):
+            rng = np.random.default_rng(seed)
+            engine, metrics, names = _synthetic_serving_engine(
+                rng, n_entities, d, max_batch,
+                device_capacity=per_shard_capacity,
+                mesh_shards=mesh_shards, load_aware_routing=la,
+                replicate_top_k=tk)
+            engine.warm()
+            req_rng = np.random.default_rng(seed + 99)
+            warmup = mk_requests(req_rng, names, 256, zipf)
+            for start in range(0, 256, max_batch):
+                engine.score_requests(warmup[start:start + max_batch])
+            engine.store.rebalance()
+            probe = mk_requests(req_rng, names, 256, zipf)
+            scores[la] = engine.score_requests(probe)
+        return float(np.abs(scores[True] - scores[False]).max())
+
+    one_shard_diff = parity_diff(1, 1.1)
+    assert one_shard_diff == 0.0, (
+        f"1-shard scores drifted {one_shard_diff} from the old router — "
+        "routing policy leaked into scoring")
+    uniform_diff = parity_diff(shards, 0.0)
+    assert uniform_diff == 0.0, (
+        f"uniform-traffic scores drifted {uniform_diff} from the old "
+        "router — routing policy leaked into scoring")
+
+    out = {
+        "metric": "serving_skew_robustness",
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "n_shards": shards,
+        "n_entities": n_entities, "d": d,
+        "n_requests": n_requests,
+        "per_shard_capacity": per_shard_capacity,
+        "replicate_top_k": replicate_top_k,
+        "skews": list(skews),
+        "traffic_aware": new_curve,
+        "pre_placement_router": old_curve,
+        "hit_rate_tolerance": tol,
+        "p99_tolerance": p99_tol,
+        "one_shard_max_abs_diff_vs_old_router": one_shard_diff,
+        "uniform_max_abs_diff_vs_old_router": uniform_diff,
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            _REPO, f"BENCH_SKEW_{jax.default_backend()}.json")
     with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
     return out
@@ -3221,6 +3424,9 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
         idx_times.append(result.index_build_s)
         flow_times.append(result.dataflow_s)
         summ_times.append(result.summaries_s)
+    # repeats 2+ hit the digest summary cache (unchanged sources), so the
+    # recorded count shows what an incremental --diff run actually skips
+    summaries_cached = result.summaries_cached
     budget_s = float(os.environ.get("PHOTON_BENCH_LINT_BUDGET_S", "6.0"))
     assert min(times) < budget_s, (
         f"photonlint full-package wall {min(times):.2f}s exceeds the "
@@ -3236,6 +3442,7 @@ def run_lint_bench(repeats: int = 3, out_path: str = None) -> dict:
         "index_build_s": round(min(idx_times), 4),
         "dataflow_s": round(min(flow_times), 4),
         "summaries_s": round(min(summ_times), 4),
+        "summaries_cached": summaries_cached,
         "files_scanned": result.files_scanned,
         "violations": len(result.violations),
         "suppressed": len(result.suppressed),
@@ -3541,6 +3748,18 @@ def main():
     ap.add_argument("--mesh-shard-counts", default="1,2,4,8",
                     help="with --serving --mesh: comma list of shard "
                          "counts to sweep")
+    ap.add_argument("--skew-sweep", action="store_true",
+                    help="with --serving: traffic-skew robustness sweep — "
+                         "zipf s=0.8..1.5 over a sharded store, traffic-"
+                         "aware vs pre-placement router curves, asserts "
+                         "flat hit rate + p99 and bitwise 1-shard/uniform "
+                         "parity -> BENCH_SKEW_<backend>.json")
+    ap.add_argument("--skew-values", default="0.8,1.0,1.2,1.5",
+                    help="with --serving --skew-sweep: comma list of zipf "
+                         "exponents to sweep")
+    ap.add_argument("--skew-shards", type=int, default=4,
+                    help="with --serving --skew-sweep: mesh shards for the "
+                         "sweep")
     ap.add_argument("--open-loop", action="store_true",
                     help="with --serving: open-loop (Poisson arrival-rate "
                          "driven) overload sweep against the network front "
@@ -3700,6 +3919,23 @@ def main():
         return
     if a.mesh:
         ap.error("--mesh requires --serving")
+    if a.serving and a.skew_sweep:
+        skews = tuple(float(s) for s in a.skew_values.split(",")
+                      if s.strip())
+        # same multi-device trick as --mesh: must land before the backend
+        # initializes (inert on real accelerator platforms)
+        flag = f"--xla_force_host_platform_device_count={a.skew_shards}"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+        print(json.dumps(run_skew_sweep_bench(
+            skews=skews,
+            n_shards=a.skew_shards,
+            per_shard_capacity=a.serving_device_capacity or None,
+            out_path=a.out)))
+        return
+    if a.skew_sweep:
+        ap.error("--skew-sweep requires --serving")
     if a.serving and a.open_loop:
         rates = [float(r) for r in a.open_loop_rates.split(",")
                  if r.strip()] or None

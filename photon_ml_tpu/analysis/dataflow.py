@@ -48,12 +48,13 @@ from photon_ml_tpu.analysis.jit_index import FunctionNode, dotted_name
 
 # -- cost accounting ---------------------------------------------------------
 
-_COST = {"s": 0.0, "summary_s": 0.0}
+_COST = {"s": 0.0, "summary_s": 0.0, "summary_cached": 0}
 
 
 def reset_cost() -> None:
     _COST["s"] = 0.0
     _COST["summary_s"] = 0.0
+    _COST["summary_cached"] = 0
 
 
 def cost_seconds() -> float:
@@ -64,6 +65,12 @@ def summary_seconds() -> float:
     """Time spent computing interprocedural function summaries (v4),
     reported as ``summaries_s`` next to ``dataflow_s``."""
     return _COST["summary_s"]
+
+
+def summaries_cached_count() -> int:
+    """Modules whose summary pass was skipped this run because the
+    digest-keyed cache held them (``summaries_cached`` in BENCH_LINT)."""
+    return int(_COST["summary_cached"])
 
 
 class _timed:
@@ -1024,6 +1031,36 @@ def _is_property(fn: FunctionNode) -> bool:
         if name in ("property", "cached_property"):
             return True
     return False
+
+
+# relpath -> (source digest, tree object, ModuleSummaries).  Cross-run
+# reuse: summaries key functions by ``id(fn)`` (AST node identity), so a
+# hit additionally REQUIRES the caller's tree to be the SAME object the
+# cached summaries were computed over — program_index's parse cache
+# guarantees that for unchanged sources, and the identity check below
+# makes a violated assumption a miss instead of silent corruption.
+_SUMMARY_CACHE: Dict[str, Tuple[str, object, "ModuleSummaries"]] = {}
+
+
+def cached_module_summaries(tree: Optional[ast.Module], relpath: str,
+                            digest: Optional[str] = None
+                            ) -> "ModuleSummaries":
+    """``ModuleSummaries`` with a digest-keyed per-process cache.
+
+    With a ``digest`` (the module source's content hash), an unchanged
+    module's whole summary pass is skipped on every build after the first
+    — the ``--diff`` fast path, where re-linting a handful of changed
+    files no longer re-summarises the rest of the package.  Counted in
+    ``summaries_cached_count()``; ``digest=None`` bypasses the cache."""
+    if digest is not None:
+        hit = _SUMMARY_CACHE.get(relpath)
+        if hit is not None and hit[0] == digest and hit[1] is tree:
+            _COST["summary_cached"] += 1
+            return hit[2]
+    ms = ModuleSummaries(tree, relpath)
+    if digest is not None and tree is not None:
+        _SUMMARY_CACHE[relpath] = (digest, tree, ms)
+    return ms
 
 
 class ModuleSummaries:

@@ -281,6 +281,7 @@ class AnalysisResult:
     index_build_s: float = 0.0  # ProgramIndex build time (0 in per-module mode)
     dataflow_s: float = 0.0     # time spent in the dataflow engine this run
     summaries_s: float = 0.0    # time in the interprocedural summary layer
+    summaries_cached: int = 0   # modules served from the digest summary cache
     whole_program: bool = False
 
     def by_rule(self) -> Dict[str, int]:
@@ -389,4 +390,6 @@ def run_analysis(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
                           files_scanned=n_files, index_build_s=index_build_s,
                           dataflow_s=_dataflow.cost_seconds(),
                           summaries_s=_dataflow.summary_seconds(),
+                          summaries_cached=(
+                              _dataflow.summaries_cached_count()),
                           whole_program=whole_program)

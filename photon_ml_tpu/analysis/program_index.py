@@ -37,6 +37,7 @@ findings relative to per-module mode, never invent phantom context.
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -62,6 +63,21 @@ def module_name_for(relpath: str) -> str:
     return name
 
 
+def _source_digest(source: str) -> str:
+    return hashlib.sha1(source.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+# relpath -> (source digest, parsed tree).  Cross-run parse reuse: the
+# summary cache (analysis/dataflow) is keyed by AST-node identity
+# (``id(fn)``), so reusing a cached ModuleSummaries REQUIRES the index to
+# adopt the very tree object those summaries were built over — this cache
+# is what makes the two identities coincide across ProgramIndex builds in
+# one process (e.g. photonlint --diff linting several changed files, or
+# the lint bench's repeat loop).  Unbounded but tiny: one tree per module
+# file actually linted.
+_PARSE_CACHE: Dict[str, Tuple[str, ast.Module]] = {}
+
+
 class ModuleInfo:
     """Symbol table of one parsed module."""
 
@@ -69,13 +85,20 @@ class ModuleInfo:
         self.relpath = relpath.replace(os.sep, "/")
         self.name = module_name_for(self.relpath)
         self.source = source
+        self.digest = _source_digest(source)
         self.tree: Optional[ast.Module] = None
-        try:
-            self.tree = ast.parse(source)
-        except SyntaxError:
-            # the framework re-parses and surfaces this as a PL000 finding;
-            # an unparseable module just contributes nothing to the index
-            pass
+        cached = _PARSE_CACHE.get(self.relpath)
+        if cached is not None and cached[0] == self.digest:
+            self.tree = cached[1]
+        else:
+            try:
+                self.tree = ast.parse(source)
+                _PARSE_CACHE[self.relpath] = (self.digest, self.tree)
+            except SyntaxError:
+                # the framework re-parses and surfaces this as a PL000
+                # finding; an unparseable module just contributes nothing
+                # to the index
+                pass
         # local alias -> (module dotted path, symbol-in-module or None)
         self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
         # module-level function defs (jit targets / call-graph callees)
@@ -642,15 +665,20 @@ class ProgramSummaries:
     """
 
     def __init__(self, index: ProgramIndex):
-        from photon_ml_tpu.analysis.dataflow import (ModuleSummaries,
-                                                     _timed_summary)
+        from photon_ml_tpu.analysis.dataflow import (_timed_summary,
+                                                     cached_module_summaries)
 
         self.index = index
         self.mod: Dict[str, "ModuleSummaries"] = {}
         # id(fn) -> (owning ModuleInfo, its FunctionSummary)
         self._owner: Dict[int, Tuple[ModuleInfo, object]] = {}
         for relpath, info in index.modules.items():
-            ms = ModuleSummaries(info.tree, relpath)
+            # digest-keyed summary reuse: a module whose source (and
+            # therefore, via the index's parse cache, whose TREE object)
+            # is unchanged since the last run in this process skips the
+            # whole per-function summary pass — the id(fn) keys stay
+            # valid because the tree is the same object
+            ms = cached_module_summaries(info.tree, relpath, info.digest)
             self.mod[relpath] = ms
             for fid, summ in ms.by_id.items():
                 self._owner[fid] = (info, summ)

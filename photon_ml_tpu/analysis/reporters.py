@@ -43,7 +43,8 @@ def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
               + ")") if by_rule else ""
     mode = (f", index {result.index_build_s:.2f}s, "
             f"dataflow {result.dataflow_s:.2f}s, "
-            f"summaries {result.summaries_s:.2f}s"
+            f"summaries {result.summaries_s:.2f}s, "
+            f"{result.summaries_cached} summary cache hit(s)"
             if result.whole_program else ", per-module mode")
     out.append(
         f"photonlint: {result.files_scanned} files scanned, "
@@ -78,6 +79,7 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
             "index_build_s": round(result.index_build_s, 4),
             "dataflow_s": round(result.dataflow_s, 4),
             "summaries_s": round(result.summaries_s, 4),
+            "summaries_cached": result.summaries_cached,
             "by_rule": _counts(new, lambda v: v.rule),
             "by_severity": _counts(new, lambda v: v.severity),
         },

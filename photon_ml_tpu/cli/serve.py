@@ -155,6 +155,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "--device-entity-capacity is the PER-SHARD hot-row "
                         "budget, so aggregate hot capacity scales with the "
                         "shard count")
+    p.add_argument("--no-load-aware-routing", action="store_true",
+                   help="freeze sharded entity->shard routing at the "
+                        "round-robin (archive slot %% N) layout instead of "
+                        "re-fitting it to observed traffic at each "
+                        "rebalance — the pre-traffic-aware router, kept "
+                        "for A/B comparison (scores are bitwise identical "
+                        "either way; only placement and hit rate differ)")
+    p.add_argument("--replicate-top-k", type=int, default=0,
+                   help="give the K hottest entities hot residency on "
+                        "EVERY mesh shard (reads stay shard-local, "
+                        "streaming deltas fan out to all replicas under "
+                        "one generation/delta_version) — flattens a zipf "
+                        "head that one shard's hot budget cannot hold "
+                        "(0 = off; needs --mesh-shards)")
     p.add_argument("--lru-capacity", type=int, default=4096,
                    help="host LRU entries per coordinate for cold entities")
     p.add_argument("--hot-set-interval", type=float, default=0.0,
@@ -243,6 +257,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "wait longer is shed alone (reason "
                         "\"tenant_overload\") before the global latch "
                         "trips (0 = off)")
+    p.add_argument("--shard-budget-ms", type=float, default=0.0,
+                   help="--listen mode: per-MESH-SHARD deadline budget — "
+                        "requests routed to a shard whose attributable "
+                        "backlog is predicted to wait longer are shed "
+                        "alone (reason \"shard_overload\") while the cool "
+                        "shards keep admitting (0 = off; needs "
+                        "--mesh-shards)")
     p.add_argument("--canary-fraction", type=float, default=0.25,
                    help="default traffic fraction a {\"cmd\": \"canary\"} "
                         "episode routes to the candidate (deterministic "
@@ -339,7 +360,10 @@ def build_server(model_dir: str,
                  metrics: Optional[ServingMetrics] = None,
                  warm: bool = True,
                  delta_log=None,
-                 log_owner: bool = True) -> Tuple[ScoringEngine, HotSwapper]:
+                 log_owner: bool = True,
+                 load_aware_routing: bool = True,
+                 replicate_top_k: int = 0
+                 ) -> Tuple[ScoringEngine, HotSwapper]:
     """Programmatic entry point: load -> store -> engine (+ warmed ladder)
     -> swapper.  Raises storage.model_io.ModelLoadError on a broken dir.
     ``delta_log``/``log_owner`` attach an ``online.DeltaLog`` to the
@@ -349,7 +373,9 @@ def build_server(model_dir: str,
     bundle = load_model_bundle(model_dir)
     config = StoreConfig(device_capacity=device_entity_capacity,
                          lru_capacity=lru_capacity, hot_decay=hot_decay,
-                         mesh_shards=mesh_shards)
+                         mesh_shards=mesh_shards,
+                         load_aware_routing=load_aware_routing,
+                         replicate_top_k=replicate_top_k)
     store = CoefficientStore.from_bundle(bundle, config=config,
                                          version=model_dir, metrics=metrics)
     engine = ScoringEngine(store, BucketedBatcher(max_batch, bucket_sizes),
@@ -766,7 +792,9 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
             client_budget_s=(args.client_budget_ms * 1e-3
                              if args.client_budget_ms else None),
             tenant_budget_s=(args.tenant_budget_ms * 1e-3
-                             if args.tenant_budget_ms else None)),
+                             if args.tenant_budget_ms else None),
+            shard_budget_s=(args.shard_budget_ms * 1e-3
+                            if args.shard_budget_ms else None)),
         batcher_deadline_s=args.deadline_us * 1e-6,
         dispatch_window=(args.dispatch_window or None),
         predict_mean=args.predict_mean,
@@ -906,7 +934,9 @@ def run(argv: List[str]) -> int:
             warm=not args.no_warm,
             metrics=metrics,
             delta_log=delta_log,
-            log_owner=False)
+            log_owner=False,
+            load_aware_routing=not args.no_load_aware_routing,
+            replicate_top_k=args.replicate_top_k)
     except (ModelLoadError, ValueError) as e:
         logger.error("--model-dir: %s", e)
         if client is not None:

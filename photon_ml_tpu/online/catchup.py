@@ -21,6 +21,13 @@ processes.
 applying a prefix of the log in order always yields a state the writer
 actually had; applying the whole log yields the writer's current rows
 bitwise (tests/test_online.py asserts this).
+
+**Replication-transparent.**  Replay goes through ``store.apply_delta``,
+which scatters each record to EVERY device row holding its entity (hot-row
+replication, serving/coefficient_store) — so a replica whose traffic-aware
+rebalance placed an entity on different shards, or replicated it when the
+writer did not, still converges to the writer's COEFFICIENTS bitwise.
+Placement is process-local policy; the log carries only rows.
 """
 
 from __future__ import annotations

@@ -26,6 +26,25 @@ archive into the device table and evicts the coldest — so under skewed
 training-slot order.  Hit counters decay exponentially per pass (EWMA), so
 yesterday's hot entities age out.
 
+When the store is sharded over a serving mesh, placement is **traffic
+aware**: every sharded coordinate carries an explicit entity->shard routing
+table (``_route``, indexed by archive slot) that starts as the round-robin
+``slot % n_shards`` layout and is re-fit at each ``rebalance()`` by a
+deterministic greedy bin-pack on the EWMA load — the hottest entities are
+placed first, an incumbent keeps its shard unless that shard's predicted
+load exceeds the lightest shard's by a slack factor (hysteresis: a near-tie
+never churns routing), and overflow spills to the least-loaded shard.  The
+top-``replicate_top_k`` zipf-head entities additionally get hot residency
+on EVERY shard (``HotSet.replicas``): reads stay shard-local (resolve
+spreads a batch's lookups across the replica rows), writes stay coherent
+because ``apply_delta`` scatters one payload to every replica row in one
+launch under the same (generation, delta_version) identity, and rebalance
+promotes/demotes replica rows like any other row.  None of this changes a
+table SHAPE or the store ``signature()``, so the zero-recompile contract is
+untouched — the mesh kernels localize GLOBAL rows and non-owning shards
+contribute exactly zero to the margin psum, which is also why scores are
+bitwise identical under ANY routing/replication choice.
+
 Stores are versioned: hot swap (serving/swap.py) builds a new store from a
 new model directory and flips the engine's generation pointer; in-flight
 requests keep scoring against the store they started with.  Within one
@@ -100,6 +119,13 @@ def advance_generation_floor(floor: int) -> None:
 # the long tail of one-hit entities must not keep rows in the ranked set
 _FREQ_FLOOR = 1e-3
 
+# routing hysteresis: an incumbent keeps its shard while that shard's
+# predicted load stays within this factor of the lightest shard's (plus the
+# entity's own load, so the first placements are always incumbent-kept) —
+# uniform traffic therefore never reroutes, while a zipf head whose home
+# shard carries a multiple of the lightest one spills deterministically
+_ROUTE_SLACK = 1.25
+
 
 @dataclasses.dataclass(frozen=True)
 class StoreConfig:
@@ -136,7 +162,14 @@ class StoreConfig:
     ``KernelCache`` compile once; distinct-shape models coexist because the
     shapes themselves are in the signature.  A tenant that must not share
     compiled programs (e.g. a private donation/layout policy) registers
-    under its own axis value, which forces coexistence without sharing."""
+    under its own axis value, which forces coexistence without sharing.
+    ``load_aware_routing``: re-fit the entity->shard routing table at each
+    rebalance by the greedy load bin-pack (module docstring).  ``False``
+    freezes routing at the round-robin ``slot % n_shards`` layout — the
+    pre-traffic-aware router, kept for A/B curves and as the escape hatch.
+    ``replicate_top_k``: give the top-k hottest entities (by EWMA load) hot
+    residency on every shard (0 = replication off).  Both are placement
+    policy only: no shape, no signature, no score bit changes."""
 
     device_capacity: Optional[int] = None
     lru_capacity: int = 4096
@@ -146,6 +179,8 @@ class StoreConfig:
     x_dtype: np.dtype = np.float32
     mesh_shards: int = 0
     fleet_axis: str = ""
+    load_aware_routing: bool = True
+    replicate_top_k: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,13 +189,17 @@ class ShardSpec:
 
     The device table is ONE logical array of ``n_shards * cap`` rows whose
     leading axis is laid out ``NamedSharding(mesh, P(SHARD_AXIS))`` — shard
-    ``s`` physically owns global rows ``[s*cap, (s+1)*cap)``.  Entities are
-    routed round-robin by archive slot (``archive_slot % n_shards``), which
+    ``s`` physically owns global rows ``[s*cap, (s+1)*cap)``.  Entities
+    START routed round-robin by archive slot (``archive_slot % n_shards``
+    — ``shard_of_archive_slot``, the default/fallback router), which
     balances shard population to within one row and makes the 1-shard case
-    collapse to exactly the unsharded layout.  ``slot_of`` values stay
-    GLOBAL rows, so ``resolve`` and every snapshot/scatter path are layout-
-    agnostic; only the engine's kernel decomposes slot -> (shard, local
-    row), and rebalance ranks residency within each shard's own rows."""
+    collapse to exactly the unsharded layout; the LIVE assignment is the
+    coordinate's traffic-aware routing table (``RandomCoordinate
+    .shard_of_slots``), which rebalance re-fits to observed load.
+    ``slot_of`` values stay GLOBAL rows, so ``resolve`` and every
+    snapshot/scatter path are layout-agnostic; only the engine's kernel
+    decomposes slot -> (shard, local row), and rebalance places residency
+    into each shard's own rows."""
 
     mesh: Mesh
     n_shards: int
@@ -233,23 +272,29 @@ class FixedCoordinate:
 
 class HotSet(NamedTuple):
     """One consistent device-residency snapshot: gather table + the entity
-    id -> device-row map that indexes it.  Replaced atomically as a pair —
+    id -> device-row map that indexes it.  Replaced atomically as a unit —
     a resolve that grabbed this snapshot can never pair stale slots with a
-    rebalanced table."""
+    rebalanced table.  ``replicas`` lists EVERY device row holding a
+    replicated zipf-head entity (``slot_of`` keeps its primary row);
+    entities absent from it live on exactly one row.  Never mutated in
+    place — rebalance publishes a whole new dict with the snapshot."""
 
     table: Array            # [max(capacity, 1), d] device-resident rows
-    slot_of: Dict[int, int]  # entity id -> device row
+    slot_of: Dict[int, int]  # entity id -> primary device row
+    replicas: Dict[int, Tuple[int, ...]] = {}  # eid -> all rows (hot heads)
 
 
 class CompactHotSet(NamedTuple):
     """The sparse twin: one consistent (indices, values, slot map) triple.
     ``indices[row]`` are that entity's observed column ids (``dim``-padded,
     ascending — CompactRandomEffectModel's row layout verbatim), ``values``
-    align.  Replaced atomically as ONE object, same contract as HotSet."""
+    align.  Replaced atomically as ONE object, same contract as HotSet
+    (including the ``replicas`` map for replicated zipf-head rows)."""
 
     indices: Array           # [max(capacity, 1), k] int32 device rows
     values: Array            # [max(capacity, 1), k] device rows
-    slot_of: Dict[int, int]  # entity id -> device row
+    slot_of: Dict[int, int]  # entity id -> primary device row
+    replicas: Dict[int, Tuple[int, ...]] = {}  # eid -> all rows (hot heads)
 
 
 class RandomCoordinate:
@@ -285,7 +330,9 @@ class RandomCoordinate:
                  decay: float = 0.5,
                  max_moves: Optional[int] = None,
                  tracked_max: Optional[int] = None,
-                 shard_spec: Optional[ShardSpec] = None):
+                 shard_spec: Optional[ShardSpec] = None,
+                 load_aware: bool = True,
+                 replicate_top_k: int = 0):
         self.cid = cid
         self.feature_shard = feature_shard
         self.random_effect_type = random_effect_type
@@ -297,6 +344,8 @@ class RandomCoordinate:
         self.decay = float(decay)
         self.max_moves = max_moves
         self.tracked_max = tracked_max
+        self.load_aware = bool(load_aware)
+        self.replicate_top_k = int(replicate_top_k)
         self._lock = threading.Lock()
         # array-backed frequency table + eid -> archive row as an array
         # (-1 = not this coordinate's entity); indexed by the dense entity
@@ -306,6 +355,16 @@ class RandomCoordinate:
         for eid, slot in archive_slot_of.items():
             self._slot_arr[eid] = slot
         self._freq = np.zeros(n_ids, np.float64)
+        # traffic-aware routing table, archive slot -> serving shard.
+        # Starts as the round-robin layout (exactly ShardSpec
+        # .shard_of_archive_slot); rebalance replaces it WHOLESALE under
+        # the lock, so readers (stats, admission) never see a torn table.
+        if shard_spec is not None:
+            self._route = (np.arange(max(self.num_entities, 1),
+                                     dtype=np.int64)
+                           % shard_spec.n_shards).astype(np.int32)
+        else:
+            self._route = None
         if self.hot_capacity < 1:
             # score_samples clamps missing slots to row 0, which must exist
             # to gather from — an all-cold coordinate serves a zero row
@@ -358,12 +417,13 @@ class RandomCoordinate:
         return self._archive[slots]
 
     def _scatter_rows(self, hot, dev_rows: List[int], payload,
-                      slot_of: Dict[int, int]):
+                      slot_of: Dict[int, int], replicas=None):
         """New snapshot with ``payload`` scattered at ``dev_rows`` — ONE
         ``.at[rows].set`` launch per device array, shape unchanged."""
         rows = jnp.asarray(dev_rows, jnp.int32)
         return HotSet(self._repin(hot.table.at[rows].set(
-            jnp.asarray(payload))), slot_of)
+            jnp.asarray(payload))), slot_of,
+            hot.replicas if replicas is None else replicas)
 
     def _repin(self, table: Array) -> Array:
         """Keep the shard layout pinned across eager scatters.  XLA
@@ -419,6 +479,14 @@ class RandomCoordinate:
         # never updated in place after publication
         return self._hot.slot_of
 
+    @property
+    def hot_replicas(self) -> Dict[int, Tuple[int, ...]]:
+        """eid -> EVERY device row holding the entity; entities resident
+        on a single row are omitted (replicated zipf heads only)."""
+        # photonlint: disable=alias-escape -- same snapshot-read
+        # contract as `hot`: replicas is replaced wholesale on publish
+        return self._hot.replicas
+
     # -- frequency tracking ------------------------------------------------
     def record_hits(self, counts: Dict[int, int]) -> None:
         """Fold one batch's per-entity hit counts into the EWMA counters —
@@ -444,6 +512,22 @@ class RandomCoordinate:
                 return float(self._freq[eid])
             return 0.0
 
+    # -- routing -----------------------------------------------------------
+    def shard_of_slots(self, archive_slots: np.ndarray) -> np.ndarray:
+        """Serving shard per archive slot, via the LIVE routing table
+        (vectorized).  The table reference is replaced wholesale by
+        rebalance, so reading it without the lock is snapshot-consistent."""
+        return self._route[archive_slots]
+
+    def route_of(self, eid: int) -> int:
+        """Serving shard this entity routes to; -1 for an unsharded
+        coordinate or an entity outside the training index."""
+        route = self._route
+        if route is None or not 0 <= eid < self._slot_arr.shape[0]:
+            return -1
+        slot = self._slot_arr[eid]
+        return -1 if slot < 0 else int(route[slot])
+
     def _decay_and_prune(self) -> None:
         """EWMA decay + tracked-set bound; caller holds ``self._lock``.
 
@@ -463,20 +547,29 @@ class RandomCoordinate:
 
     # -- promotion / demotion ----------------------------------------------
     def rebalance(self) -> Tuple[int, int]:
-        """One frequency-ranked promotion/demotion pass, ranked in numpy.
+        """One placement pass: EWMA decay, then frequency-ranked
+        promotion/demotion — and, when sharded, the traffic-aware routing
+        re-fit plus zipf-head replication (module docstring).
 
-        Decays every hit counter by ``decay`` (EWMA), ranks all entities
-        with recorded traffic plus the incumbents by frequency (incumbents
-        win ties — hysteresis against churn; archive slot breaks the rest,
-        so a fixed request trace yields a reproducible hot set), then
-        scatters the promoted rows into the device rows the demoted ones
-        vacate — ONE ``.at[rows].set`` launch, table shape unchanged.
-        The ranking is a ``lexsort`` over the candidate arrays (traffic ∪
-        incumbents — bounded by ``tracked_max`` + capacity), not a Python
-        sort over every tracked entity.  Returns (promotions, demotions);
-        they are always equal.
+        Unsharded this is the classic pass: rank all entities with
+        recorded traffic plus the incumbents by frequency (incumbents win
+        ties — hysteresis against churn; archive slot breaks the rest, so
+        a fixed request trace yields a reproducible hot set), then scatter
+        the promoted rows into the device rows the demoted ones vacate —
+        ONE ``.at[rows].set`` launch, table shape unchanged.  Sharded, the
+        same ranking runs per shard over the candidates the routing table
+        assigns there (plus the replica heads), with promotions paired
+        against that shard's explicit free-row pool: never-occupied rows
+        first, then the coldest incumbents' rows.  The ranking is a
+        ``lexsort`` over the candidate arrays (traffic ∪ incumbents —
+        bounded by ``tracked_max`` + capacity), not a Python sort over
+        every tracked entity.  Returns (promotions, demotions); sharded,
+        demotions can be fewer when free rows absorb the difference.
         """
-        if self.hot_capacity < 1 or self.hot_capacity >= self.num_entities:
+        spec = self.shard_spec
+        if self.hot_capacity < 1 or (
+                self.hot_capacity >= self.num_entities
+                and (spec is None or self.replicate_top_k == 0)):
             with self._lock:  # keep counters EWMA even when residency is fixed
                 self._decay_and_prune()
             return 0, 0
@@ -484,41 +577,143 @@ class RandomCoordinate:
             self._decay_and_prune()
             freq = self._freq
             current = self._hot.slot_of
-            cur = np.fromiter(current.keys(), np.int64, len(current))
-            cand = np.union1d(np.nonzero(freq)[0].astype(np.int64), cur)
-            if self.shard_spec is None:
+            if spec is not None:
+                promote, rows, demote, slot_of, replicas, route = \
+                    self._place_sharded_locked(freq)
+                self._route = route
+                if promote:
+                    payload = self._archive_rows(
+                        self._slot_arr[np.asarray(promote, np.int64)])
+                    self._hot = self._scatter_rows(self._hot, rows, payload,
+                                                   slot_of, replicas)
+                elif slot_of != current or replicas != self._hot.replicas:
+                    # routing/primary-row change only: same device arrays,
+                    # new maps — still one atomic snapshot swap
+                    self._hot = self._hot._replace(slot_of=slot_of,
+                                                   replicas=replicas)
+                else:
+                    return 0, 0
+            else:
+                cur = np.fromiter(current.keys(), np.int64, len(current))
+                cand = np.union1d(np.nonzero(freq)[0].astype(np.int64), cur)
                 promote, demote = self._rank_moves(cand, cur,
                                                    self.hot_capacity, freq)
-            else:
-                # residency is ranked WITHIN each shard: an entity can only
-                # occupy rows of the shard its archive slot routes to, so
-                # every promotion scatters into a row the same shard
-                # vacates — no row ever crosses the shard boundary and no
-                # shard's table block changes shape
-                spec = self.shard_spec
-                cand_sh = spec.shard_of_archive_slot(self._slot_arr[cand])
-                cur_sh = spec.shard_of_archive_slot(self._slot_arr[cur])
-                promote, demote = [], []
-                for sid in range(spec.n_shards):
-                    p, d = self._rank_moves(cand[cand_sh == sid],
-                                            cur[cur_sh == sid],
-                                            spec.cap, freq)
-                    promote += p
-                    demote += d
-            if not promote:
-                return 0, 0
-            rows = [current[e] for e in demote]
-            new_rows = self._archive_rows(self._slot_arr[promote])
-            slot_of = dict(current)
-            for e in demote:
-                del slot_of[e]
-            for e, r in zip(promote, rows):
-                slot_of[e] = r
-            self._hot = self._scatter_rows(self._hot, rows, new_rows, slot_of)
+                if not promote:
+                    return 0, 0
+                rows = [current[e] for e in demote]
+                new_rows = self._archive_rows(self._slot_arr[promote])
+                slot_of = dict(current)
+                for e in demote:
+                    del slot_of[e]
+                for e, r in zip(promote, rows):
+                    slot_of[e] = r
+                self._hot = self._scatter_rows(self._hot, rows, new_rows,
+                                               slot_of)
         self._update_shard_gauges()
         for e in promote:  # device copy supersedes any LRU copy
             self.cold.invalidate(e)
         return len(promote), len(demote)
+
+    def _place_sharded_locked(self, freq: np.ndarray):
+        """Traffic-aware sharded placement; caller holds ``self._lock``.
+
+        Returns ``(promote, promote_rows, demote, slot_of, replicas,
+        route)``: entities to scatter (paired row-for-row with
+        ``promote_rows``), entities losing a row, and the new snapshot
+        maps + routing table.  Deterministic end to end — every iteration
+        order is sorted or rank-ordered — so two stores fed the same trace
+        converge to the same placement."""
+        spec = self.shard_spec
+        n, cap = spec.n_shards, spec.cap
+        current = self._hot.slot_of
+        # eid -> every row currently holding its coefficients (replicas
+        # included): residency and eviction bookkeeping is per ROW
+        rows_of = {e: self._hot.replicas.get(e, (r,))
+                   for e, r in current.items()}
+        cur = np.sort(np.fromiter(rows_of.keys(), np.int64, len(rows_of)))
+        cand = np.union1d(np.nonzero(freq)[0].astype(np.int64), cur)
+        route = self._route
+        if cand.size == 0:
+            return [], [], [], dict(current), dict(self._hot.replicas), route
+        ranked = cand[np.lexsort((self._slot_arr[cand], -freq[cand]))]
+        # 1) routing re-fit: greedy bin-pack on predicted load, hottest
+        # entity placed first; an incumbent keeps its shard inside the
+        # slack (hysteresis), overflow spills to the lightest shard
+        if self.load_aware:
+            route = route.copy()
+            load = np.zeros(n, np.float64)
+            for e in ranked:
+                slot = self._slot_arr[e]
+                home = int(route[slot])
+                fe = float(freq[e])
+                if load[home] > _ROUTE_SLACK * (load.min() + fe):
+                    home = int(np.argmin(load))  # ties: lowest shard id
+                    route[slot] = home
+                load[home] += fe
+        # 2) replication candidacy: the zipf head competes for residency
+        # on EVERY shard, not just its routed one
+        heads = [int(e) for e in ranked[:self.replicate_top_k]
+                 if freq[e] > 0.0] if self.replicate_top_k > 0 else []
+        # 3) per-shard frequency ranking against explicit row pools
+        eid_shard = {int(e): int(route[self._slot_arr[e]]) for e in cand}
+        by_shard: List[List[int]] = [[] for _ in range(n)]
+        for e in ranked:
+            by_shard[eid_shard[int(e)]].append(int(e))
+        for s in range(n):
+            for e in heads:
+                if eid_shard[e] != s:
+                    by_shard[s].append(e)
+        owner = {r: e for e, rs in rows_of.items() for r in rs}
+        new_rows_of: Dict[int, List[int]] = {}
+        promote: List[int] = []
+        promote_rows: List[int] = []
+        demote: List[int] = []
+        for s in range(n):
+            cand_s = np.asarray(by_shard[s], np.int64)
+            base = s * cap
+            resident = [(r, owner[r]) for r in range(base, base + cap)
+                        if r in owner]
+            res_eids = {e for _, e in resident}
+            if cand_s.size:
+                inc = np.fromiter((int(e) in res_eids for e in cand_s),
+                                  bool, cand_s.size)
+                sel = np.lexsort((self._slot_arr[cand_s],
+                                  np.where(inc, 0, 1), -freq[cand_s]))
+                desired = [int(e) for e in cand_s[sel][:cap]]
+            else:
+                desired = []
+            desired_set = set(desired)
+            kept, evictable = [], []
+            for r, e in resident:
+                (kept if e in desired_set else evictable).append((e, r))
+            # coldest evicted first; deterministic slot tiebreak — but a
+            # not-desired incumbent KEEPS its row until a promotion
+            # actually needs it (the same retain-until-reused hysteresis
+            # max_moves always implied)
+            evictable.sort(key=lambda er: (freq[er[0]],
+                                           -int(self._slot_arr[er[0]])))
+            free = [r for r in range(base, base + cap) if r not in owner]
+            want = [e for e in desired if e not in res_eids]
+            nmov = len(want) if self.max_moves is None \
+                else min(len(want), self.max_moves)
+            pool = free + [r for _, r in evictable]
+            nmov = min(nmov, len(pool))
+            n_evict = max(0, nmov - len(free))
+            promote += want[:nmov]
+            promote_rows += pool[:nmov]
+            demote += [e for e, _ in evictable[:n_evict]]
+            for e, r in (kept + evictable[n_evict:]
+                         + list(zip(want[:nmov], pool[:nmov]))):
+                new_rows_of.setdefault(e, []).append(r)
+        slot_of: Dict[int, int] = {}
+        replicas: Dict[int, Tuple[int, ...]] = {}
+        for e in sorted(new_rows_of):
+            rs = sorted(new_rows_of[e])
+            home = eid_shard[e]
+            slot_of[e] = next((r for r in rs if r // cap == home), rs[0])
+            if len(rs) > 1:
+                replicas[e] = tuple(rs)
+        return promote, promote_rows, demote, slot_of, replicas, route
 
     def _rank_moves(self, cand: np.ndarray, cur: np.ndarray, capacity: int,
                     freq: np.ndarray) -> Tuple[List[int], List[int]]:
@@ -550,8 +745,10 @@ class RandomCoordinate:
         if spec is None or self._metrics is None or spec.cap < 1:
             return
         occ = np.zeros(spec.n_shards, np.int64)
-        for row in self._hot.slot_of.values():
-            occ[row // spec.cap] += 1
+        hot = self._hot
+        for e, row in hot.slot_of.items():
+            for r in hot.replicas.get(e, (row,)):  # replicas occupy rows too
+                occ[r // spec.cap] += 1
         for sid in range(spec.n_shards):
             self._metrics.set_shard_occupancy(self.cid, sid,
                                               occ[sid] / spec.cap)
@@ -591,8 +788,12 @@ class RandomCoordinate:
             self._write_archive_row(slot, payload)
             dev = self._hot.slot_of.get(eid)
             if dev is not None:
+                # replica coherence: ONE scatter hits every row holding
+                # this entity, all under the same (generation,
+                # delta_version) identity — no replica can serve stale
+                rows = list(self._hot.replicas.get(eid, (dev,)))
                 self._hot = self._scatter_rows(
-                    self._hot, [dev], self._stack_rows([payload]),
+                    self._hot, rows, self._stack_rows([payload] * len(rows)),
                     self._hot.slot_of)
         self.cold.invalidate(eid)
         return True
@@ -628,13 +829,17 @@ class CompactRandomCoordinate(RandomCoordinate):
                  decay: float = 0.5,
                  max_moves: Optional[int] = None,
                  tracked_max: Optional[int] = None,
-                 shard_spec: Optional[ShardSpec] = None):
+                 shard_spec: Optional[ShardSpec] = None,
+                 load_aware: bool = True,
+                 replicate_top_k: int = 0):
         self._full_dim = int(dim)
         super().__init__(cid, feature_shard, random_effect_type,
                          (archive_indices, archive_values), archive_slot_of,
                          hot_capacity, lru_capacity, metrics=metrics,
                          decay=decay, max_moves=max_moves,
-                         tracked_max=tracked_max, shard_spec=shard_spec)
+                         tracked_max=tracked_max, shard_spec=shard_spec,
+                         load_aware=load_aware,
+                         replicate_top_k=replicate_top_k)
 
     # -- row-representation hooks -----------------------------------------
     def _bind_archive(self, archive) -> None:
@@ -666,7 +871,8 @@ class CompactRandomCoordinate(RandomCoordinate):
         return self._archive_idx[slots], self._archive_val[slots]
 
     def _scatter_rows(self, hot: CompactHotSet, dev_rows: List[int], payload,
-                      slot_of: Dict[int, int]) -> CompactHotSet:
+                      slot_of: Dict[int, int],
+                      replicas=None) -> CompactHotSet:
         idx, val = payload
         rows = jnp.asarray(dev_rows, jnp.int32)
         # two scatters, ONE snapshot swap — readers hold the triple and can
@@ -674,7 +880,7 @@ class CompactRandomCoordinate(RandomCoordinate):
         return CompactHotSet(
             self._repin(hot.indices.at[rows].set(jnp.asarray(idx))),
             self._repin(hot.values.at[rows].set(jnp.asarray(val))),
-            slot_of)
+            slot_of, hot.replicas if replicas is None else replicas)
 
     def _delta_payload(self, row: np.ndarray):
         row = np.asarray(row, dtype=self._archive_val.dtype)
@@ -821,7 +1027,9 @@ class CoefficientStore:
                     decay=config.hot_decay,
                     max_moves=config.hot_max_moves,
                     tracked_max=config.hot_tracked_max,
-                    shard_spec=spec)
+                    shard_spec=spec,
+                    load_aware=config.load_aware_routing,
+                    replicate_top_k=config.replicate_top_k)
             elif isinstance(m, CompactRandomEffectModel):
                 # wide-vocabulary sparse rows serve NATIVELY: the columnar
                 # (indices, values) pair goes device-resident as-is — no
@@ -843,7 +1051,9 @@ class CoefficientStore:
                     decay=config.hot_decay,
                     max_moves=config.hot_max_moves,
                     tracked_max=config.hot_tracked_max,
-                    shard_spec=spec)
+                    shard_spec=spec,
+                    load_aware=config.load_aware_routing,
+                    replicate_top_k=config.replicate_top_k)
             else:
                 raise ValueError(
                     f"coordinate {cid!r}: serving supports FixedEffectModel, "
@@ -902,6 +1112,20 @@ class CoefficientStore:
         eidx = self.entity_indexes.get(re_type)
         return -1 if eidx is None else eidx.get(str(name))
 
+    def shard_of_request(self, ids: Dict[str, str]) -> int:
+        """Serving shard a request's hot-path work lands on, or -1 when it
+        has none (unsharded store, unknown entity, no sharded coordinate).
+        Routes via the FIRST sharded random coordinate's live table — the
+        frontend's per-shard pressure signal; deliberately cheap (one dict
+        walk + one table read), since it runs once per admitted request."""
+        for cid in self.order:
+            c = self.coordinates[cid]
+            if isinstance(c, RandomCoordinate) and c.shard_spec is not None:
+                eid = self.entity_id(c.random_effect_type,
+                                     ids.get(c.random_effect_type))
+                return c.route_of(eid) if eid >= 0 else -1
+        return -1
+
     def resolve(self, cid: str, entity_names: Sequence[Optional[str]],
                 n_rows: Optional[int] = None,
                 metrics: Optional[ServingMetrics] = None
@@ -951,6 +1175,9 @@ class CoefficientStore:
                 hits[eid] = hits.get(eid, 0) + 1
                 slot = hs.slot_of.get(eid)
                 if slot is not None:
+                    reps = hs.replicas.get(eid)
+                    if reps:  # spread a replicated head's reads round-robin
+                        slot = reps[i % len(reps)]
                     slots[i] = slot
                     hot_hits += 1
                     continue
@@ -979,16 +1206,17 @@ class CoefficientStore:
                             metrics: ServingMetrics) -> None:
         """Per-shard lookup/hot-hit counters for one resolved batch.
 
-        Lookups route by archive slot (where the entity WOULD live); hot
-        hits decompose the resolved global device rows (shard-major layout:
-        shard = row // cap).  Together they give the per-shard hit rate the
-        obs gauges expose — the load-imbalance signal for a pod slice."""
+        Lookups route by the LIVE routing table (where the entity WOULD be
+        resident); hot hits decompose the resolved global device rows
+        (shard-major layout: shard = row // cap).  Together they give the
+        per-shard hit rate the obs gauges expose — the load-imbalance
+        signal the traffic-aware rebalance consumes."""
         spec = c.shard_spec
         eids = np.fromiter(hits.keys(), np.int64, len(hits))
         cnts = np.fromiter(hits.values(), np.int64, len(hits))
         arch = c._slot_arr[eids]  # record_hits contract: eids are in range
         ok = arch >= 0
-        lookups = np.bincount(spec.shard_of_archive_slot(arch[ok]),
+        lookups = np.bincount(c.shard_of_slots(arch[ok]),
                               weights=cnts[ok].astype(np.float64),
                               minlength=spec.n_shards)
         hot_rows = slots[slots >= 0]

@@ -240,7 +240,14 @@ class ScoringEngine:
 
             def _localize(s, cap):
                 # global row -> this shard's local row; -1 (scores 0.0 by
-                # the kernels' masking contract) for rows owned elsewhere
+                # the kernels' masking contract) for rows owned elsewhere.
+                # PLACEMENT-AGNOSTIC: the kernel only asks "is this global
+                # row in my block", so traffic-aware routing and hot-row
+                # replication (coefficient_store) change WHICH rows hold
+                # an entity without touching this path — exactly one shard
+                # owns any resolved row and the rest contribute 0.0 to the
+                # psum, which is why scores stay bitwise identical under
+                # any routing table
                 sid = jax.lax.axis_index(SHARD_AXIS)
                 loc = s - sid * cap
                 mine = (s >= 0) & (loc >= 0) & (loc < cap)

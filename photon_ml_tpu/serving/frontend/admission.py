@@ -46,6 +46,17 @@ deadline budget — so a well-behaved client backs off instead of hammering.
   clients, tenant latches persist across connection churn — tenants are
   configured, not discovered — so there is no ``forget_tenant`` on close.
 
+  **Per-shard budgets** (``shard_budget_s``, off by default) point the
+  same machinery DOWN the stack: a shard is one slice of the pod-slice
+  mesh, and the wait attributable to it is the frontend's estimate of the
+  backlog headed for that shard (its share of recent traffic times the
+  global estimate, scaled by the shard count — a hot shard's queue is the
+  fleet p99 long before the average trips the global budget).  Shed
+  reason ``shard_overload``; same two-watermark hysteresis, keyed by
+  shard id.  The traffic-aware rebalance (serving/coefficient_store) is
+  the slow corrective loop; this latch is the fast one that protects p99
+  while placement catches up.
+
   **Readiness shedding** is the one check that is not a deadline: when the
   frontend's HealthState reports not-ready (``/readyz`` false), requests
   are refused up front with reason ``not_ready``.  The check lives in the
@@ -67,6 +78,7 @@ SHED_DRAINING = "draining"
 SHED_SHUTDOWN = "shutdown"
 SHED_CLIENT = "client_overload"
 SHED_TENANT = "tenant_overload"
+SHED_SHARD = "shard_overload"
 SHED_NOT_READY = "not_ready"
 
 
@@ -87,6 +99,10 @@ class AdmissionConfig:
     aggregate backlog wait (None = per-tenant budgets off) — one tenant's
     burst sheds under ``tenant_overload`` while other tenants' models keep
     admitting.
+    ``shard_budget_s``: per-mesh-shard deadline checked against the wait
+    attributable to the shard a request's hot-path work routes to (None =
+    per-shard budgets off) — one overloaded slice sheds its own traffic
+    under ``shard_overload`` instead of dragging the fleet p99.
     """
 
     budget_s: float = 0.050
@@ -94,6 +110,7 @@ class AdmissionConfig:
     retry_after_ms: float = 0.0  # 0 -> derive from the budget
     client_budget_s: Optional[float] = None
     tenant_budget_s: Optional[float] = None
+    shard_budget_s: Optional[float] = None
 
     def __post_init__(self):
         if self.budget_s <= 0:
@@ -107,6 +124,9 @@ class AdmissionConfig:
         if self.tenant_budget_s is not None and self.tenant_budget_s <= 0:
             raise ValueError("tenant_budget_s must be > 0, got "
                              f"{self.tenant_budget_s}")
+        if self.shard_budget_s is not None and self.shard_budget_s <= 0:
+            raise ValueError("shard_budget_s must be > 0, got "
+                             f"{self.shard_budget_s}")
 
 
 @dataclasses.dataclass
@@ -134,6 +154,7 @@ class AdmissionController:
         self._shedding = False
         self._client_shedding: Dict[str, bool] = {}  # latched clients only
         self._tenant_shedding: Dict[str, bool] = {}  # latched tenants only
+        self._shard_shedding: Dict[int, bool] = {}   # latched shards only
 
     @property
     def shedding(self) -> bool:
@@ -144,6 +165,9 @@ class AdmissionController:
 
     def tenant_shedding(self, tenant: str) -> bool:
         return self._tenant_shedding.get(tenant, False)
+
+    def shard_shedding(self, shard: int) -> bool:
+        return self._shard_shedding.get(shard, False)
 
     def _set_shedding(self, value: bool) -> None:
         if value != self._shedding:
@@ -157,6 +181,7 @@ class AdmissionController:
                 flight_dump("admission_shed")
 
     def _set_client_shedding(self, client: str, value: bool) -> None:
+        newly_latched = value and not self._client_shedding.get(client, False)
         if value:
             self._client_shedding[client] = True
         else:
@@ -164,6 +189,11 @@ class AdmissionController:
         if self._registry is not None:
             self._registry.set_gauge("front_client_shedding", int(value),
                                      client=client)
+        if newly_latched:
+            # per-client latch ENGAGED (edge-triggered, not per shed
+            # reply): spool the flight ring so the burning client's spans
+            # are retrievable from /flightz after the fact
+            flight_dump("client_overload", client=client)
 
     def _set_tenant_shedding(self, tenant: str, value: bool) -> None:
         if value:
@@ -173,6 +203,15 @@ class AdmissionController:
         if self._registry is not None:
             self._registry.set_gauge("front_tenant_shedding", int(value),
                                      tenant=tenant)
+
+    def _set_shard_shedding(self, shard: int, value: bool) -> None:
+        if value:
+            self._shard_shedding[shard] = True
+        else:
+            self._shard_shedding.pop(shard, None)
+        if self._registry is not None:
+            self._registry.set_gauge("front_shard_shedding", int(value),
+                                     shard=str(shard))
 
     def forget_client(self, client: str) -> None:
         """Drop a closed connection's latch (and its gauge series)."""
@@ -196,11 +235,15 @@ class AdmissionController:
                client: Optional[str] = None,
                client_wait_s: float = 0.0,
                tenant: Optional[str] = None,
-               tenant_wait_s: float = 0.0) -> Verdict:
+               tenant_wait_s: float = 0.0,
+               shard: Optional[int] = None,
+               shard_wait_s: float = 0.0) -> Verdict:
         """One admission decision for a request arriving now, given the
         backlog predictor's estimate of its time-to-resolution and (with
-        per-client/per-tenant budgets on) the wait attributable to the
-        requesting client's and tenant's own backlogs."""
+        per-client/per-tenant/per-shard budgets on) the wait attributable
+        to the requesting client's, tenant's, and target shard's own
+        backlogs.  ``shard`` < 0 means the request has no shard affinity
+        (unsharded store, cold entity) and skips the shard check."""
         c = self.config
         if c.client_budget_s is not None and client is not None:
             # the narrow check first: a client burning its own budget is
@@ -231,6 +274,21 @@ class AdmissionController:
                 self._set_tenant_shedding(tenant, True)
                 return Verdict(False, tenant_wait_s, SHED_TENANT,
                                self._retry_ms(tenant_wait_s, budget))
+        if c.shard_budget_s is not None and shard is not None and shard >= 0:
+            # narrower than global, orthogonal to client/tenant: one hot
+            # mesh slice sheds ITS requests while the cool shards (and
+            # shard-less traffic) keep admitting
+            budget = c.shard_budget_s
+            if self._shard_shedding.get(shard, False):
+                if shard_wait_s <= budget * c.resume_fraction:
+                    self._set_shard_shedding(shard, False)
+                else:
+                    return Verdict(False, shard_wait_s, SHED_SHARD,
+                                   self._retry_ms(shard_wait_s, budget))
+            elif shard_wait_s > budget:
+                self._set_shard_shedding(shard, True)
+                return Verdict(False, shard_wait_s, SHED_SHARD,
+                               self._retry_ms(shard_wait_s, budget))
         if self._shedding:
             if predicted_wait_s <= c.budget_s * c.resume_fraction:
                 self._set_shedding(False)  # backlog drained: unlatch

@@ -262,6 +262,17 @@ class FrontendServer:
         self._outstanding = 0  # resident in the batcher (dispatch window)
         self._inflight = 0     # admitted, not yet settled (drain barrier)
         self._draining = False
+        # per-model drain barriers: models currently quiescing (their
+        # requests shed; siblings keep serving), plus per-batcher
+        # admitted-unsettled counts + idle events so a scoped drain can
+        # wait on ONE model's batcher instead of the whole edge
+        self._draining_models: set = set()
+        self._batcher_inflight: Dict[int, int] = {}   # id(batcher) -> n
+        self._batcher_idle: Dict[int, asyncio.Event] = {}
+        # per-shard admission pressure: EWMA-ish share of recent admits
+        # headed to each mesh shard (periodic halving keeps it recent)
+        self._shard_counts: Dict[int, float] = {}
+        self._shard_seen = 0.0
         self._closing = False
         self._server: Optional[asyncio.base_events.Server] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -575,6 +586,12 @@ class FrontendServer:
                        SHED_SHUTDOWN if self._closing else SHED_DRAINING,
                        self.config.admission.budget_s)
             return
+        if handle is not None and handle.model_id in self._draining_models:
+            # scoped barrier: only THIS model is quiescing (sibling
+            # models keep admitting through their own batchers)
+            self._shed(conn, req, SHED_DRAINING,
+                       self.config.admission.budget_s)
+            return
         if not self._health_ready():
             # /readyz-driven shedding: a not-ready plane (stalled worker,
             # stale catch-up, failed check) refuses work up front — the
@@ -599,12 +616,17 @@ class FrontendServer:
                 extra=self._tenant_inflight.get(tenant, 0))
         else:
             tenant_wait = 0.0
+        if self.config.admission.shard_budget_s is not None:
+            shard, shard_wait = self._shard_pressure(handle, req, estimate)
+        else:
+            shard, shard_wait = None, 0.0
         verdict = self._admission.decide(
             estimate,
             client=conn.cid if self.config.admission.client_budget_s
             is not None else None,
             client_wait_s=client_wait,
-            tenant=tenant, tenant_wait_s=tenant_wait)
+            tenant=tenant, tenant_wait_s=tenant_wait,
+            shard=shard, shard_wait_s=shard_wait)
         if not verdict.admitted:
             self._shed(conn, req, verdict.reason, verdict.predicted_wait_s,
                        verdict.retry_after_ms)
@@ -632,6 +654,7 @@ class FrontendServer:
                 self._tenant_inflight.get(tenant, 0) + 1
         self._inflight += 1
         self._idle.clear()
+        self._track_admit(batcher)
         pending = _Pending(conn, req, self._reply_future(conn), t0_ns,
                            batcher=batcher, tenant=tenant)
         self._queue.enqueue(conn.cid, pending)
@@ -639,6 +662,54 @@ class FrontendServer:
                                  self._queue.depth_of(conn.cid),
                                  client=conn.cid)
         self._pump()
+
+    def _shard_pressure(self, handle, req, estimate: float):
+        """(shard, predicted wait) attributable to the mesh shard this
+        request's hot-path work routes to — the admission signal for
+        ``shard_budget_s``.  The wait model is the global backlog estimate
+        scaled by the shard's share of recent admits times the shard
+        count: uniform traffic gives every shard exactly the global
+        estimate, a shard drawing k× its fair share shows k× the
+        pressure.  Returns (None, 0.0) when the request has no shard
+        affinity (unsharded store, unknown entity)."""
+        engine = handle.engine if handle is not None else self.engine
+        store = engine.store
+        n = store.config.mesh_shards
+        if n <= 1:
+            return None, 0.0
+        shard = store.shard_of_request(req.ids)
+        if shard < 0:
+            return None, 0.0
+        self._shard_counts[shard] = self._shard_counts.get(shard, 0.0) + 1.0
+        self._shard_seen += 1.0
+        if self._shard_seen >= 512.0:  # halve: keep the share RECENT
+            self._shard_counts = {s: c * 0.5
+                                  for s, c in self._shard_counts.items()}
+            self._shard_seen *= 0.5
+        share = self._shard_counts[shard] / self._shard_seen
+        wait = estimate * share * n
+        engine.metrics.set_shard_pressure(shard, wait)
+        return shard, wait
+
+    def _track_admit(self, batcher) -> None:
+        """Per-batcher admitted-unsettled count (scoped drain barrier)."""
+        bid = id(batcher)
+        self._batcher_inflight[bid] = self._batcher_inflight.get(bid, 0) + 1
+        ev = self._batcher_idle.get(bid)
+        if ev is None:
+            ev = self._batcher_idle[bid] = asyncio.Event()
+        ev.clear()
+
+    def _track_settle(self, batcher) -> None:
+        bid = id(batcher)
+        left = self._batcher_inflight.get(bid, 1) - 1
+        if left > 0:
+            self._batcher_inflight[bid] = left
+        else:
+            self._batcher_inflight.pop(bid, None)
+            ev = self._batcher_idle.get(bid)
+            if ev is not None:
+                ev.set()
 
     def _shed(self, conn: _Conn, req, reason: str, predicted_wait_s: float,
               retry_after_ms: Optional[float] = None) -> None:
@@ -711,6 +782,7 @@ class FrontendServer:
                         time.perf_counter_ns() - pending.t0_ns,
                         uid=pending.req.uid, client=pending.conn.cid)
         self._inflight -= 1
+        self._track_settle(pending.batcher or self._batcher)
         if pending.tenant is not None:
             left = self._tenant_inflight.get(pending.tenant, 1) - 1
             if left > 0:
@@ -769,16 +841,60 @@ class FrontendServer:
                         "drain grace (%.1fs) expired with %d in flight",
                         self.config.drain_grace_s, self._inflight)
 
-    async def _quiesced(self, fn):
+    async def _drain_model(self, model_id: str) -> None:
+        """Scoped drain: submit only ``model_id``'s queued requests (the
+        rest go back to the fair queue), flush ITS batcher, and wait until
+        its admitted requests settle.  Callers hold ``_state_lock`` and
+        have added the model to ``_draining_models``."""
+        batcher = self._model_batcher(model_id)
+        bid = id(batcher)
+        with obs_span("front.drain_model", model=model_id,
+                      inflight=self._batcher_inflight.get(bid, 0)):
+            self._registry.inc("front_drains_total")
+            requeue = []
+            while True:
+                nxt = self._queue.next_item()
+                if nxt is None:
+                    break
+                cid, pending = nxt
+                if pending.batcher is batcher:
+                    self._dispatch(pending)
+                else:
+                    requeue.append((cid, pending))
+            for cid, pending in requeue:  # per-client FIFO order preserved
+                self._queue.enqueue(cid, pending)
+            batcher.flush()
+            if self._batcher_inflight.get(bid, 0):
+                try:
+                    await asyncio.wait_for(self._batcher_idle[bid].wait(),
+                                           self.config.drain_grace_s)
+                except asyncio.TimeoutError:
+                    logger.warning(
+                        "model %s drain grace (%.1fs) expired with %d in "
+                        "flight", model_id, self.config.drain_grace_s,
+                        self._batcher_inflight.get(bid, 0))
+
+    async def _quiesced(self, fn, model_id: Optional[str] = None):
         """Run ``fn`` (blocking, in the executor) with admission stopped
-        and zero requests in flight — the swap/delta barrier."""
+        and zero requests in flight — the swap/delta barrier.  With a
+        ``model_id`` (fleet mode), the barrier is SCOPED: only that
+        model's admission pauses and only its batcher drains, so an
+        untouched sibling model keeps serving straight through a
+        neighbor's swap/canary/promote."""
         async with self._state_lock:
-            self._draining = True
+            if model_id is None or self.fleet is None:
+                self._draining = True
+                try:
+                    await self._drain()
+                    return await self._loop.run_in_executor(None, fn)
+                finally:
+                    self._draining = False
+            self._draining_models.add(model_id)
             try:
-                await self._drain()
+                await self._drain_model(model_id)
                 return await self._loop.run_in_executor(None, fn)
             finally:
-                self._draining = False
+                self._draining_models.discard(model_id)
 
     def _cmd_target(self, obj: dict):
         """(swapper, store, model_id) a control command acts on: in fleet
@@ -825,7 +941,8 @@ class FrontendServer:
                 self._reply_now(conn, error_reply(str(e)))
                 return
             fut = self._reply_future(conn)
-            ok = await self._quiesced(lambda: swapper.swap(model_dir))
+            ok = await self._quiesced(lambda: swapper.swap(model_dir),
+                                      model_id=_mid)
             fut.set_result({
                 "swap": "ok" if ok else "rejected",
                 "generation": swapper.engine.store.generation,
@@ -841,7 +958,8 @@ class FrontendServer:
             ok = await self._quiesced(
                 lambda: swapper.apply_delta(obj.get("coordinate"),
                                             obj.get("entity"),
-                                            obj.get("row") or ()))
+                                            obj.get("row") or ()),
+                model_id=_mid)
             fut.set_result({"delta": "ok" if ok else "rejected",
                             "delta_version": swapper.delta_version})
         elif cmd == "rebalance":
@@ -896,7 +1014,8 @@ class FrontendServer:
                 return ctl.status()
 
             try:
-                status = await self._quiesced(_start)
+                status = await self._quiesced(_start,
+                                              model_id=handle.model_id)
             except Exception as e:
                 fut.set_result(error_reply(str(e)))
                 return
@@ -920,7 +1039,8 @@ class FrontendServer:
                     _mid, reason=obj.get("reason", "operator")).status()
 
             try:
-                status = await self._quiesced(_ctl)
+                status = await self._quiesced(_ctl,
+                                              model_id=handle.model_id)
             except ValueError as e:
                 fut.set_result(error_reply(str(e)))
                 return
@@ -938,7 +1058,8 @@ class FrontendServer:
             if obj.get("off"):
                 fut = self._reply_future(conn)
                 ok = await self._quiesced(
-                    lambda: self.router.detach_shadow(handle.model_id))
+                    lambda: self.router.detach_shadow(handle.model_id),
+                    model_id=handle.model_id)
                 fut.set_result({"shadow": "off" if ok else "none",
                                 "model": handle.model_id})
                 return
@@ -955,7 +1076,8 @@ class FrontendServer:
                         "version": store.version}
 
             try:
-                reply = await self._quiesced(_attach)
+                reply = await self._quiesced(_attach,
+                                             model_id=handle.model_id)
             except Exception as e:
                 fut.set_result(error_reply(str(e)))
                 return

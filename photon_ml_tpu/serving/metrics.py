@@ -52,6 +52,7 @@ _PHASE = "serving_phase_seconds"
 _SHARD_LOOKUPS = "serving_shard_lookups_total"
 _SHARD_HOT = "serving_shard_hot_hits_total"
 _SHARD_OCCUPANCY = "serving_shard_occupancy"
+_SHARD_PRESSURE = "serving_shard_pressure"
 # multi-model serving (serving/fleet): per-(model, tenant) traffic, shadow
 # score drift, and per-tenant hot-row budget occupancy.  Labeled families
 # like the shard ones — Prometheus export only, never the snapshot.
@@ -114,6 +115,14 @@ class ServingMetrics:
         """Fraction of one shard's hot-row budget currently resident."""
         self.registry.set_gauge(_SHARD_OCCUPANCY, float(frac),
                                 coordinate=cid, shard=str(shard))
+
+    def set_shard_pressure(self, shard: int, seconds: float) -> None:
+        """The frontend's estimate of the backlog wait attributable to one
+        mesh shard — the per-shard signal AdmissionController's
+        ``shard_budget_s`` latch decides on.  Labeled family: Prometheus
+        export only, never the ``snapshot()`` wire view."""
+        self.registry.set_gauge(_SHARD_PRESSURE, float(seconds),
+                                shard=str(shard))
 
     def shard_view(self) -> dict:
         """Per-(coordinate, shard) residency/traffic summary — a SEPARATE

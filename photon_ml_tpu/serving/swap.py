@@ -279,7 +279,14 @@ class HotSwapper:
         when a delta log is attached to an owning swapper, durably appends
         the record under that identity.  This is the trainer's publish
         sink: apply-then-log under the swap lock, so log order IS apply
-        order and the identity pairs with exactly one generation."""
+        order and the identity pairs with exactly one generation.
+
+        Replicated rows need nothing extra here: the store's
+        ``apply_delta`` scatters one payload to EVERY device row holding
+        the entity (hot-row replication, coefficient_store module
+        docstring) in one snapshot swap, so all replicas carry this
+        identity — and the rollback below (re-applying ``prev``) fans out
+        the same way, keeping replicas coherent through a failed append."""
         metrics = self.engine.metrics
         with self._swap_lock:
             store = self.engine.store

@@ -521,6 +521,51 @@ class TestServingMeshPlumbing:
         assert seen["zipf"] == 1.1  # mesh sweep is always skewed
 
 
+class TestSkewSweepPlumbing:
+    """--serving --skew-sweep arg plumbing: flags reach
+    run_skew_sweep_bench parsed, and --skew-sweep alone is rejected."""
+
+    def test_flags_reach_runner_parsed(self, monkeypatch, capsys):
+        import json
+
+        seen = {}
+
+        def fake_runner(**kw):
+            seen.update(kw)
+            return {"metric": "serving_skew_robustness"}
+
+        monkeypatch.setattr(bench, "run_skew_sweep_bench", fake_runner)
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--serving", "--skew-sweep",
+            "--skew-values", "0.9,1.3",
+            "--skew-shards", "2",
+            "--serving-device-capacity", "64",
+            "--out", "ignored.json"])
+        bench.main()
+        out = capsys.readouterr().out
+        assert json.loads(out)["metric"] == "serving_skew_robustness"
+        assert seen["skews"] == (0.9, 1.3)
+        assert seen["n_shards"] == 2
+        assert seen["per_shard_capacity"] == 64
+        assert seen["out_path"] == "ignored.json"
+
+    def test_skew_sweep_requires_serving(self, monkeypatch):
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--skew-sweep"])
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == 2  # argparse error exit
+
+    def test_unset_capacity_and_skews_get_defaults(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(bench, "run_skew_sweep_bench",
+                            lambda **kw: seen.update(kw) or {})
+        monkeypatch.setattr(sys, "argv",
+                            ["bench.py", "--serving", "--skew-sweep"])
+        bench.main()
+        assert seen["per_shard_capacity"] is None  # runner derives n/10
+        assert seen["skews"] == (0.8, 1.0, 1.2, 1.5)  # the headline sweep
+
+
 class TestFleetPlumbing:
     """--fleet arg plumbing (flags reach run_fleet_bench parsed) plus one
     real tiny run asserting the bench's own invariants hold and the JSON

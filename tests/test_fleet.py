@@ -563,6 +563,81 @@ class TestFrontendFleet:
 
 
 # ---------------------------------------------------------------------------
+# per-model drain barriers
+# ---------------------------------------------------------------------------
+class TestPerModelDrain:
+    """Control-plane quiesce is scoped to the TARGET model's batcher: a
+    sibling model keeps serving, uninterrupted, while its neighbor
+    drains for a swap/delta/canary transition."""
+
+    def test_sibling_serves_through_model_scoped_drain(self):
+        import threading
+
+        from test_frontend import Client, _slow
+
+        from photon_ml_tpu.serving.frontend.admission import SHED_DRAINING
+
+        fleet = _two_tenant_fleet()
+        # the TARGET model is slow, so its drain has real in-flight work
+        _slow(fleet.handle("acme-model").engine, delay_s=0.005)
+        engine = fleet.handle("m0").engine
+        front = ThreadedFrontend(engine, config=FrontendConfig(
+            admission=AdmissionConfig(budget_s=30.0),
+            batcher_deadline_s=0.002, health_poll_s=0.0),
+            fleet=fleet).start()
+        load, ctrl, acme = (Client(front.port), Client(front.port),
+                            Client(front.port))
+        rng = np.random.default_rng(9)
+        n = 80
+        replies = {}
+        reader_err = []
+
+        def read_load():
+            try:
+                for _ in range(n):
+                    rep = load.recv()
+                    replies[rep["uid"]] = rep
+            except Exception as e:
+                reader_err.append(e)
+
+        rt = threading.Thread(target=read_load)
+        rt.start()
+        try:
+            for i in range(n):
+                load.send(_wire_req(rng, i))  # m0: the untouched model
+                if i % 4 == 0:  # keep the target's batcher busy
+                    acme.send(_wire_req(rng, f"a{i}", model="acme-model"))
+                if i == n // 2:
+                    # model-scoped quiesce lands with load in flight on
+                    # BOTH models
+                    ctrl.send({"cmd": "delta", "model": "acme-model",
+                               "coordinate": "user", "entity": "user0",
+                               "row": [0.1, -0.2, 0.3, 0.05]})
+            load.send_raw("\n")
+            acme.send_raw("\n")
+            rep = ctrl.recv()
+            assert rep["delta"] == "ok", rep
+            rt.join(120)
+            assert not reader_err, reader_err
+            assert len(replies) == n
+            # the acceptance gate: the sibling NEVER sheds for a drain it
+            # is not part of — every m0 request resolves to a score
+            bad = [r for r in replies.values() if "score" not in r]
+            assert not bad, bad[:3]
+            # the target itself may shed while draining, but only with
+            # the explicit draining reason — never silently dropped
+            for _ in range(n // 4):
+                r = acme.recv()
+                assert ("score" in r
+                        or r.get("reason") == SHED_DRAINING), r
+        finally:
+            load.close()
+            ctrl.close()
+            acme.close()
+            front.stop()
+
+
+# ---------------------------------------------------------------------------
 # sampled always-on tracing
 # ---------------------------------------------------------------------------
 class TestSampledMinting:

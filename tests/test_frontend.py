@@ -787,6 +787,89 @@ class TestPerClientAdmission:
 
 
 # ---------------------------------------------------------------------------
+# per-shard admission (traffic-aware placement: pressure to the edge)
+# ---------------------------------------------------------------------------
+class TestPerShardAdmission:
+    def _ctrl(self, registry=None):
+        from photon_ml_tpu.serving.frontend.admission import SHED_SHARD
+        return SHED_SHARD, AdmissionController(
+            AdmissionConfig(budget_s=1.0, shard_budget_s=0.1),
+            registry=registry)
+
+    def test_shard_latch_is_per_shard_and_hysteretic(self):
+        SHED_SHARD, ctrl = self._ctrl()
+        v = ctrl.decide(0.01, shard=2, shard_wait_s=0.2)
+        assert not v.admitted and v.reason == SHED_SHARD
+        assert ctrl.shard_shedding(2) and not ctrl.shedding
+        # the hot shard's latch touches nobody else
+        assert ctrl.decide(0.01, shard=0, shard_wait_s=0.01).admitted
+        # hysteresis: above the resume watermark (0.5 * 0.1) stays shed...
+        assert not ctrl.decide(0.01, shard=2, shard_wait_s=0.06).admitted
+        # ...below it the latch opens and the request is admitted
+        assert ctrl.decide(0.01, shard=2, shard_wait_s=0.04).admitted
+        assert not ctrl.shard_shedding(2)
+
+    def test_off_by_default_and_unsharded_inert(self):
+        ctrl = AdmissionController(AdmissionConfig(budget_s=1.0))
+        # shard args are inert without a shard budget configured
+        assert ctrl.decide(0.01, shard=1, shard_wait_s=99.0).admitted
+        _, ctrl2 = self._ctrl()
+        # shard < 0 / None mean "unsharded store": the shard path skips
+        assert ctrl2.decide(0.01, shard=-1, shard_wait_s=99.0).admitted
+        assert ctrl2.decide(0.01, shard=None, shard_wait_s=99.0).admitted
+
+    def test_gauge_tracks_latch(self):
+        from photon_ml_tpu.obs.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        _, ctrl = self._ctrl(registry=reg)
+        ctrl.decide(0.0, shard=3, shard_wait_s=0.2)
+        assert reg.gauge("front_shard_shedding", shard="3") == 1
+        ctrl.decide(0.0, shard=3, shard_wait_s=0.01)
+        assert reg.gauge("front_shard_shedding", shard="3") == 0
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="shard_budget_s"):
+            AdmissionConfig(budget_s=1.0, shard_budget_s=0.0)
+
+
+class TestClientOverloadFlightDump:
+    def test_client_latch_edge_dumps_once(self, tmp_path):
+        """A client budget breach snapshots the trace ring exactly once
+        per latch EDGE — the evidence survives for /flightz without a
+        flapping client filling the spool."""
+        from photon_ml_tpu.obs.pulse.flight import (FlightRecorder,
+                                                    set_flight)
+        rec = FlightRecorder(str(tmp_path / "spool"), min_interval_s=0.0)
+        set_flight(rec)
+        try:
+            ctrl = AdmissionController(
+                AdmissionConfig(budget_s=1.0, client_budget_s=0.1))
+            ctrl.decide(0.0, client="a", client_wait_s=0.2)  # latch edge
+            ctrl.decide(0.0, client="a", client_wait_s=0.2)  # still latched
+            dumps = [d for d in rec.index()
+                     if d["reason"] == "client_overload"]
+            assert len(dumps) == 1
+            latest = rec.latest()
+            assert latest["reason"] == "client_overload"
+            assert latest["detail"]["client"] == "a"
+            # unlatch, breach again: a NEW edge, a new dump
+            ctrl.decide(0.0, client="a", client_wait_s=0.01)
+            ctrl.decide(0.0, client="a", client_wait_s=0.2)
+            assert len([d for d in rec.index()
+                        if d["reason"] == "client_overload"]) == 2
+        finally:
+            set_flight(None)
+
+    def test_no_recorder_no_crash(self):
+        from photon_ml_tpu.obs.pulse.flight import set_flight
+        set_flight(None)
+        ctrl = AdmissionController(
+            AdmissionConfig(budget_s=1.0, client_budget_s=0.1))
+        assert not ctrl.decide(0.0, client="a",
+                               client_wait_s=0.2).admitted
+
+
+# ---------------------------------------------------------------------------
 # connection cap (ISSUE 9 satellite)
 # ---------------------------------------------------------------------------
 class TestConnectionCap:

@@ -828,3 +828,59 @@ class TestV4Registration:
         assert result.summaries_s >= 0.0
         payload = json.loads(render_json([], [], [], result))
         assert payload["summary"]["summaries_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# summary cache (digest-keyed skip of unchanged modules)
+# ---------------------------------------------------------------------------
+
+class TestSummaryCache:
+    @pytest.fixture(autouse=True)
+    def _fresh_caches(self):
+        # caches key on RELPATH + digest, and every _write_pkg tree shares
+        # `pkg/__init__.py` with identical content — an earlier test in the
+        # same process would legitimately pre-seed a hit; start empty so
+        # the counts below are exact regardless of suite order
+        from photon_ml_tpu.analysis import dataflow, program_index
+        program_index._PARSE_CACHE.clear()
+        dataflow._SUMMARY_CACHE.clear()
+        yield
+        program_index._PARSE_CACHE.clear()
+        dataflow._SUMMARY_CACHE.clear()
+
+    def test_second_run_hits_cache_and_edit_invalidates(self, tmp_path):
+        """Unchanged sources skip the interprocedural summary pass on the
+        next run (digest + parse-tree identity both match); an edited
+        module re-summarises alone while its neighbours stay cached."""
+        root = _write_pkg(tmp_path, {
+            "a.py": """
+                def f(x):
+                    return x + 1
+            """,
+            "b.py": """
+                def g(y):
+                    return y * 2
+            """,
+        })
+        first = _run(root)
+        assert first.summaries_cached == 0  # never seen these paths
+        n_modules = first.files_scanned
+
+        second = _run(root)
+        assert second.summaries_cached == n_modules
+        assert second.violations == first.violations
+
+        # an edit flips the digest: ONLY that module re-summarises
+        (tmp_path / "pkg" / "a.py").write_text(
+            "def f(x):\n    return x - 1\n")
+        third = _run(root)
+        assert third.summaries_cached == n_modules - 1
+
+    def test_cached_count_rides_json_report(self, tmp_path):
+        from photon_ml_tpu.analysis import render_json
+        root = _write_pkg(tmp_path, {"m.py": "def h(z):\n    return z\n"})
+        _run(root)
+        result = _run(root)
+        payload = json.loads(render_json([], [], [], result))
+        assert payload["summary"]["summaries_cached"] == \
+            result.summaries_cached > 0

@@ -84,13 +84,15 @@ def _model(seed=7, compact=False):
 
 
 def _engine(mesh_shards, device_capacity, seed=7, compact=False,
-            max_batch=16):
+            max_batch=16, load_aware=True, replicate_top_k=0):
     model, task = _model(seed=seed, compact=compact)
     metrics = ServingMetrics()
     store = CoefficientStore.from_model(
         model, task, {"userId": _entity_index()}, {"all": _index_map()},
         config=StoreConfig(device_capacity=device_capacity,
-                           mesh_shards=mesh_shards),
+                           mesh_shards=mesh_shards,
+                           load_aware_routing=load_aware,
+                           replicate_top_k=replicate_top_k),
         version=f"mesh{mesh_shards}", metrics=metrics)
     return ScoringEngine(store, BucketedBatcher(max_batch),
                          metrics=metrics), metrics
@@ -226,9 +228,17 @@ class TestShardedMutation:
             eng.store.rebalance()
         c = eng8.store.coordinates["per_user"]
         spec = c.shard_spec
+        owned = []
         for eid, row in c.hot_slot_of.items():
-            # residency never crosses the shard an entity routes to
-            assert row // spec.cap == eid % spec.n_shards
+            rows = c.hot_replicas.get(eid, (row,))
+            owned.extend(rows)
+            # the primary row sits on the shard the LIVE routing table
+            # homes the entity on whenever a row exists there (a
+            # rerouted incumbent may retain its old row until a
+            # promotion overwrites it — placement hysteresis)
+            if any(r // spec.cap == c.route_of(eid) for r in rows):
+                assert row // spec.cap == c.route_of(eid)
+        assert len(owned) == len(set(owned))  # no row double-ownership
         reqs = _requests(64, seed=22, zipf=1.3)
         np.testing.assert_allclose(eng8.score_requests(reqs),
                                    eng0.score_requests(reqs),
@@ -290,6 +300,115 @@ class TestShardedMutation:
         eng.store.apply_delta(
             "per_user", "user1", np.random.default_rng(52).normal(size=DIM))
         eng.score_requests(_requests(32, seed=53, zipf=1.2))
+        assert eng.compile_count == warmed
+
+
+# ---------------------------------------------------------------------------
+# traffic-aware placement: routing table, hot-row replication, determinism
+# ---------------------------------------------------------------------------
+def _head_requests(users, k, seed):
+    """Requests cycling over ``users`` — a traffic head with no tail."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(k):
+        feats = [{"name": n, "term": "", "value": float(v)}
+                 for n, v in zip(FEATURES, rng.normal(size=DIM))]
+        reqs.append(Request(uid=i, features=feats,
+                            ids={"userId": f"user{users[i % len(users)]}"}))
+    return reqs
+
+
+class TestTrafficAwarePlacement:
+    def test_replica_delta_coherence(self, devices):
+        """A zipf-head entity replicated across 3+ shards stays BITWISE
+        coherent through a streaming delta: apply_delta fans the row out
+        to every replica under one (generation, delta_version)."""
+        eng, _ = _engine(4, 8, replicate_top_k=2)
+        store = eng.store
+        c = store.coordinates["per_user"]
+        # user0 dominates traffic 4:1 -> an unambiguous replication head
+        eng.score_requests(_head_requests([0, 0, 0, 0, 1], 100, seed=91))
+        store.rebalance()
+        eid = store.entity_id("userId", "user0")
+        rows = c.hot_replicas.get(eid, ())
+        assert len(rows) >= 3, f"head not replicated: rows={rows}"
+        new_row = np.random.default_rng(92).normal(size=DIM)
+        assert store.apply_delta("per_user", "user0", new_row)
+        tbl = np.asarray(c.table)
+        for r in c.hot_replicas[eid]:
+            np.testing.assert_array_equal(tbl[r], tbl[rows[0]])
+        np.testing.assert_array_equal(
+            tbl[rows[0]], np.asarray(new_row, dtype=tbl.dtype))
+        # and the replicated state still scores like the unsharded store
+        eng0, _ = _engine(0, 32)
+        assert eng0.store.apply_delta("per_user", "user0", new_row)
+        reqs = _requests(48, seed=93, zipf=1.2)
+        np.testing.assert_allclose(eng.score_requests(reqs),
+                                   eng0.score_requests(reqs),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_load_aware_rebalance_determinism(self, devices):
+        """Identical traffic -> identical placement: slot_of, replicas and
+        the routing table are pure functions of the observed trace."""
+        placements = []
+        for _ in range(2):
+            eng, _ = _engine(4, 8, replicate_top_k=3)
+            for batch in range(3):
+                eng.score_requests(_requests(64, seed=300 + batch,
+                                             zipf=1.2))
+                eng.store.rebalance()
+            c = eng.store.coordinates["per_user"]
+            placements.append((
+                dict(c.hot_slot_of), dict(c.hot_replicas),
+                tuple(c.shard_of_slots(np.arange(N_ENTITIES)).tolist())))
+        assert placements[0] == placements[1]
+
+    def test_uniform_traffic_keeps_round_robin_route(self, devices):
+        """Exactly balanced traffic gives the greedy bin-pack no reason
+        to move anything: the route stays the slot % N it started as
+        (and with it, the pre-PR placement exactly).  Sampled 'uniform'
+        streams carry sampling noise, so the invariant is stated on
+        equal EWMA counters — what perfectly balanced load looks like
+        to the ranker."""
+        eng, _ = _engine(4, 8)
+        c = eng.store.coordinates["per_user"]
+        c.record_hits({eid: 3 for eid in range(N_ENTITIES)})
+        eng.store.rebalance()
+        slots = np.arange(N_ENTITIES)
+        np.testing.assert_array_equal(c.shard_of_slots(slots), slots % 4)
+        assert c.hot_replicas == {}
+
+    @pytest.mark.parametrize("shards,cap,zipf", [(1, 30, 1.2), (4, 8, 0.0)])
+    def test_router_parity_one_shard_and_uniform(self, devices, shards,
+                                                 cap, zipf):
+        """The bitwise anchors: at 1 shard, and at N shards under uniform
+        traffic, the traffic-aware router scores EXACTLY what the
+        pre-placement router scores — resolution hands the kernels
+        global rows, so placement policy can never touch a score."""
+        drive = _requests(128, seed=400, zipf=zipf)
+        probe = _requests(48, seed=401, zipf=zipf)
+        scores = []
+        for la, tk in ((False, 0), (True, 3)):
+            eng, _ = _engine(shards, cap, load_aware=la,
+                             replicate_top_k=tk)
+            eng.score_requests(drive)
+            eng.store.rebalance()
+            scores.append(eng.score_requests(probe))
+        np.testing.assert_array_equal(scores[0], scores[1])
+
+    def test_zero_recompiles_with_replication(self, devices):
+        """Promotion, demotion, replica fan-out and routing moves all ride
+        snapshot swaps of FIXED-shape tables — nothing recompiles."""
+        eng, _ = _engine(4, 8, replicate_top_k=3)
+        eng.warm()
+        warmed = eng.compile_count
+        eng.score_requests(_requests(64, seed=601, zipf=1.3))
+        eng.store.rebalance()
+        eng.store.apply_delta(
+            "per_user", "user0",
+            np.random.default_rng(602).normal(size=DIM))
+        eng.score_requests(_requests(32, seed=603, zipf=1.3))
+        eng.store.rebalance()
         assert eng.compile_count == warmed
 
 
