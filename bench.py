@@ -3578,6 +3578,402 @@ def run_obs_bench(n_calls: int = 200_000, budget_ns: float = 1000.0,
     return out
 
 
+def run_watch_bench(n_entities=128, d=8, max_batch=16, seed=0,
+                    out_path=None) -> dict:
+    """`bench.py --watch`: photonwatch fleet metrics plane end to end ->
+    BENCH_WATCH_<backend>.json.
+
+    Three live "processes" (frontend with a real scoring engine + two
+    synthetic peers labeled owner/replica), each serving the federation
+    pull on its own ``ThreadedMetricsEndpoint``, are merged by a
+    ``FleetView`` over real HTTP.  Asserted, not just reported:
+
+      - the fleet view merges all 3 sources (counters summed, build-info
+        gauges per-process) and federation freshness p99 stays bounded
+        (<1 s: counter bump -> visible in the merged registry);
+      - a seeded ``serve.execute`` stall_dist episode fires EXACTLY the
+        expected burn-rate alert — the latency SLO latches (firing edge,
+        then resolves after the heal) while the availability SLO stays
+        quiet — and the published ``fleet_slo_burn_rate`` gauge drives the
+        admission controller's fleet-pressure shed;
+      - the SLO firing edge dumped the flight recorder, retrievable over
+        ``GET /flightz``;
+      - the socket federation stream (``{"cmd": "watch"}``) returns a full
+        frame then a smaller delta frame, both ingestible;
+      - with photonwatch OFF, the span guard and the attribution guard
+        each stay under the photonscope disabled-path budget (default 1µs,
+        PHOTON_BENCH_OBS_BUDGET_NS) — watch rides the hot path for free;
+      - zero engine recompiles after warm across the whole run.
+    """
+    import math
+    import socket as socketlib
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.chaos import get_injector
+    from photon_ml_tpu.cli.serve import build_server
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+    from photon_ml_tpu.data.reader import EntityIndex
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import Coefficients
+    from photon_ml_tpu.obs import pulse
+    from photon_ml_tpu.obs.registry import export_build_info
+    from photon_ml_tpu.obs.trace import span
+    from photon_ml_tpu.obs.watch import (SLO, FleetView, SLOEngine,
+                                         attribute, disable_attribution,
+                                         enable_attribution)
+    from photon_ml_tpu.serving.batcher import Request
+    from photon_ml_tpu.serving.frontend import (FrontendConfig,
+                                                ThreadedFrontend)
+    from photon_ml_tpu.serving.frontend.admission import (AdmissionConfig,
+                                                          AdmissionController)
+    from photon_ml_tpu.serving.frontend.metrics_http import \
+        ThreadedMetricsEndpoint
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    from photon_ml_tpu.storage.model_io import save_game_model
+    from photon_ml_tpu.types import TaskType
+
+    budget_ns = float(os.environ.get("PHOTON_BENCH_OBS_BUDGET_NS", 1000.0))
+    rng = np.random.default_rng(seed)
+    names = [f"f{j}" for j in range(d)]
+    task = TaskType.LOGISTIC_REGRESSION
+
+    def save_model(path, mseed):
+        r = np.random.default_rng(mseed)
+        model = GameModel(models={
+            "fixed": FixedEffectModel(
+                coefficients=Coefficients(means=r.normal(size=d)),
+                feature_shard="all", task=task),
+            "user": RandomEffectModel(
+                w_stack=r.normal(size=(n_entities, d)) * 0.1,
+                slot_of={i: i for i in range(n_entities)},
+                random_effect_type="userId", feature_shard="all",
+                task=task),
+        })
+        imap = IndexMap({feature_key(n): j for j, n in enumerate(names)})
+        eidx = EntityIndex()
+        for i in range(n_entities):
+            eidx.get_or_add(f"user{i}")
+        save_game_model(model, path, {"all": imap}, {"userId": eidx},
+                        task=task)
+        imap.save(os.path.join(path, "all.idx"))
+        eidx.save(os.path.join(path, "userId.entities.json"))
+        return path
+
+    def http_get(port, path):
+        with socketlib.create_connection(("127.0.0.1", port),
+                                         timeout=10) as s:
+            s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        status = int(data.split(b" ", 2)[1])
+        return status, data.split(b"\r\n\r\n", 1)[1]
+
+    def per_call_ns(thunk, n):
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                thunk()
+            best = min(best, (time.perf_counter_ns() - t0) / n)
+        return best
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    probes = [Request(uid=i, features=[{"name": n, "term": "",
+                                        "value": float(v)}
+                                       for n, v in zip(
+                                           names, rng.normal(size=d))],
+                      ids={"userId": f"user{i % n_entities}"})
+              for i in range(max_batch)]
+
+    inj = get_injector()
+    inj.reset()
+    out = None
+    with tempfile.TemporaryDirectory(prefix="photon_watch_bench_") as tmp:
+        pulse.set_flight(pulse.FlightRecorder(
+            os.path.join(tmp, "flight")))
+        # -- the 3-process topology -------------------------------------
+        front_metrics = ServingMetrics()
+        engine, swapper = build_server(
+            save_model(os.path.join(tmp, "base"), seed),
+            max_batch=max_batch, warm=True, metrics=front_metrics)
+        export_build_info(front_metrics.registry, role="frontend")
+        enable_attribution(front_metrics.registry)
+        owner_metrics = ServingMetrics()
+        export_build_info(owner_metrics.registry, role="owner")
+        rep_metrics = ServingMetrics()
+        export_build_info(rep_metrics.registry, role="replica")
+        endpoints = {}
+        tf = None
+        try:
+            for label, m in (("front", front_metrics),
+                             ("owner", owner_metrics),
+                             ("replica", rep_metrics)):
+                endpoints[label] = ThreadedMetricsEndpoint(m, port=0).start()
+            tf = ThreadedFrontend(engine, swapper, FrontendConfig(
+                admission=AdmissionConfig(budget_s=5.0))).start()
+
+            view = FleetView(stale_after_s=5.0)
+
+            def poll_all():
+                for label, ep in endpoints.items():
+                    status, body = http_get(ep.port, "/watchz")
+                    assert status == 200, f"/watchz {status} on {label}"
+                    assert view.ingest(label, json.loads(body)), \
+                        f"fleet ingest rejected a /watchz frame ({label})"
+
+            # settle compile baseline: everything below must reuse it
+            [float(s) for s in engine.score_requests(probes)]
+            compiles0 = engine.compile_count
+
+            # -- federation freshness: bump -> merged visibility --------
+            fresh_s = []
+            for i in range(40):
+                owner_metrics.registry.inc("train_batches_total")
+                rep_metrics.registry.inc("catchup_records_total")
+                front_metrics.registry.inc("watch_ping_total")
+                t0 = time.perf_counter()
+                poll_all()
+                got = sum(view.registry.counter_series(
+                    "watch_ping_total").values())
+                assert got == i + 1, \
+                    f"merged counter lagged: {got} != {i + 1}"
+                fresh_s.append(time.perf_counter() - t0)
+            snap = view.fleet_snapshot()
+            assert snap["processes"] == 3
+            assert not any(s["stale"] for s in snap["sources"].values())
+            build = view.registry.gauge_series("photon_build_info")
+            assert len(build) == 3, \
+                f"expected 3 per-process build_info gauges, got {build}"
+            roles = {dict(lk).get("role") for lk in build}
+            assert roles == {"frontend", "owner", "replica"}
+            fresh_p99 = pctl(fresh_s, 0.99)
+            assert fresh_p99 < 1.0, \
+                f"federation freshness p99 {fresh_p99:.3f}s over bound"
+
+            # -- socket federation stream: full frame then delta --------
+            sock = socketlib.create_connection(("127.0.0.1", tf.port),
+                                               timeout=10)
+            stream_view = FleetView()
+            try:
+                fh = sock.makefile("rw", encoding="utf-8", newline="\n")
+                fh.write(json.dumps({"cmd": "watch"}) + "\n")
+                fh.flush()
+                full = json.loads(fh.readline())["watch"]
+                assert full["full"] and full["seq"] == 1
+                assert stream_view.ingest("front", full)
+                front_metrics.registry.inc("watch_ping_total")
+                fh.write(json.dumps({"cmd": "watch"}) + "\n")
+                fh.flush()
+                delta = json.loads(fh.readline())["watch"]
+                assert not delta["full"] and delta["seq"] == 2
+                assert stream_view.ingest("front", delta)
+                full_series = sum(len(full[k]) for k in
+                                  ("counters", "gauges", "histograms"))
+                delta_series = sum(len(delta[k]) for k in
+                                   ("counters", "gauges", "histograms"))
+                assert delta_series < full_series, \
+                    "delta frame did not shrink vs the full snapshot"
+            finally:
+                sock.close()
+
+            # -- SLO episode: stall_dist burns latency, not availability
+            slos = [
+                SLO(name="availability", objective=0.99,
+                    kind="availability", total="front_requests_total",
+                    bad=("requests_shed_total",),
+                    fast=(0.5, 2.0), slow=(1.0, 4.0),
+                    fast_burn=2.0, slow_burn=1.5),
+                SLO(name="latency_p99", objective=0.95, kind="latency",
+                    histogram="serving_latency_s", threshold_s=0.016,
+                    fast=(0.5, 2.0), slow=(1.0, 4.0),
+                    fast_burn=2.0, slow_burn=1.5),
+            ]
+            slo_engine = SLOEngine(slos, publish=front_metrics.registry)
+
+            def front_round(n=3, uid0=0):
+                # organic front_requests_total for the availability SLO
+                s = socketlib.create_connection(("127.0.0.1", tf.port),
+                                                timeout=10)
+                try:
+                    fh = s.makefile("rw", encoding="utf-8", newline="\n")
+                    for i in range(n):
+                        u = int(rng.integers(0, n_entities))
+                        fh.write(json.dumps({
+                            "uid": uid0 + i,
+                            "features": [[n_, 0.5] for n_ in names],
+                            "ids": {"userId": f"user{u}"}}) + "\n")
+                        fh.flush()
+                        reply = json.loads(fh.readline())
+                        assert "score" in reply, f"shed/err: {reply!r}"
+                finally:
+                    s.close()
+
+            def tick(drive_front=False):
+                if drive_front:
+                    front_round(n=2, uid0=int(time.monotonic() * 1e3) % 10**6)
+                else:
+                    engine.score_requests(probes)
+                poll_all()
+                slo_engine.evaluate(view.registry)
+                time.sleep(0.02)
+
+            warm_t = time.monotonic()
+            while time.monotonic() - warm_t < 1.2:  # clean baseline window
+                tick(drive_front=True)
+            assert slo_engine.events() == [], \
+                f"SLO alerts on a healthy fleet: {slo_engine.events()}"
+
+            # the episode: every serve.execute hit holds ~50ms (> the
+            # 16ms SLO threshold), sampled from the seeded lognormal;
+            # stalls keep coming until the alert latches, so the episode
+            # self-scales past whatever good traffic the warm window left
+            # in the burn windows
+            inj.arm("serve.execute", "stall_dist",
+                    data={"mu": math.log(0.05), "sigma": 0.1,
+                          "cap_s": 0.08})
+            fire_t = time.monotonic()
+            while "latency_p99" not in slo_engine.firing():
+                assert time.monotonic() - fire_t < 30.0, \
+                    "latency SLO never fired under the stall episode"
+                tick()
+            stall_fires = inj.fired("serve.execute")  # before disarm zeroes
+            inj.disarm("serve.execute")
+            assert stall_fires >= 3, \
+                f"alert latched after only {stall_fires} stalls?"
+
+            # published burn gauge drives the fleet-pressure admission shed
+            burn = max(front_metrics.registry.gauge_series(
+                "fleet_slo_burn_rate").values())
+            assert burn > 2.0, f"published burn gauge too low: {burn}"
+            adm = AdmissionController(
+                AdmissionConfig(budget_s=5.0, fleet_burn_budget=1.0),
+                registry=front_metrics.registry)
+            verdict = adm.decide(0.0)
+            assert not verdict.admitted \
+                and verdict.reason == "fleet_pressure", \
+                f"fleet-pressure shed did not engage: {verdict}"
+
+            # heal: good traffic until the alert resolves
+            heal_t = time.monotonic()
+            while "latency_p99" in slo_engine.firing():
+                assert time.monotonic() - heal_t < 30.0, \
+                    "latency SLO never resolved after the heal"
+                tick()
+            events = slo_engine.events()
+            fired = [(e["slo"], e["state"]) for e in events]
+            assert fired.count(("latency_p99", "firing")) == 1, fired
+            assert fired.count(("latency_p99", "resolved")) == 1, fired
+            assert not any(s == "availability" for s, _ in fired), \
+                f"availability SLO fired spuriously: {fired}"
+
+            # the firing edge dumped the flight recorder -> /flightz
+            status, body = http_get(endpoints["front"].port, "/flightz")
+            assert status == 200, f"/flightz {status}"
+            flight = json.loads(body)
+            assert flight["dumps"], "SLO firing edge left no flight dump"
+            assert any("slo_burn" in d["reason"]
+                       for d in flight["dumps"]), flight["dumps"]
+
+            # fleet endpoint end to end: /fleetz off a FleetView-wired
+            # scrape endpoint (the tools/fleetwatch.py serving shape)
+            fleet_ep = ThreadedMetricsEndpoint(
+                ServingMetrics(registry=view.registry), port=0,
+                fleet_view=view).start()
+            try:
+                status, body = http_get(fleet_ep.port, "/fleetz")
+                assert status == 200, f"/fleetz {status}"
+                fleetz = json.loads(body)
+                assert fleetz["processes"] == 3
+            finally:
+                fleet_ep.stop()
+
+            # -- disabled-path cost: watch must ride the hot path free --
+            disable_attribution()
+            prev = obs.set_tracer(obs.Tracer(capacity=64, enabled=False))
+            try:
+                def guarded():
+                    with span("bench.op", bucket=64):
+                        pass
+
+                def attributed():
+                    with attribute("bench.op"):
+                        pass
+
+                disabled_span_ns = per_call_ns(guarded, 100_000)
+                disabled_attr_ns = per_call_ns(attributed, 100_000)
+            finally:
+                obs.set_tracer(prev)
+            assert disabled_span_ns < budget_ns, (
+                f"disabled span guard {disabled_span_ns:.0f}ns/call over "
+                f"the {budget_ns:.0f}ns budget")
+            assert disabled_attr_ns < budget_ns, (
+                f"disabled attribution guard {disabled_attr_ns:.0f}ns/call "
+                f"over the {budget_ns:.0f}ns budget")
+
+            compiles_after_warm = engine.compile_count - compiles0
+            assert compiles_after_warm == 0, \
+                f"recompiles after warm: {compiles_after_warm}"
+
+            device_sites = {dict(lk).get("site") for lk in
+                            front_metrics.registry.gauge_series(
+                                "xla_device_seconds")}
+            assert "serve.execute" in device_sites, \
+                f"attribution left no xla_device_seconds: {device_sites}"
+
+            out = {
+                "metric": "watch_federation_freshness_p99_s",
+                "unit": "s",
+                "value": round(fresh_p99, 4),
+                "backend": jax.default_backend(),
+                "seed": seed,
+                "processes_merged": 3,
+                "freshness_s": {"p50": round(pctl(fresh_s, 0.50), 4),
+                                "p99": round(fresh_p99, 4),
+                                "rounds": len(fresh_s)},
+                "socket_stream": {"full_series": full_series,
+                                  "delta_series": delta_series},
+                "slo": {
+                    "events": [(e["slo"], e["state"]) for e in events],
+                    "stall_fires": stall_fires,
+                    "burn_at_fire": round(burn, 2),
+                    "availability_quiet": True,
+                    "fleet_pressure_shed": True},
+                "flight_dumps": len(flight["dumps"]),
+                "disabled_span_ns": round(disabled_span_ns, 1),
+                "disabled_attribution_ns": round(disabled_attr_ns, 1),
+                "budget_ns": budget_ns,
+                "within_budget": (disabled_span_ns < budget_ns
+                                  and disabled_attr_ns < budget_ns),
+                "recompiles_after_warm": compiles_after_warm,
+            }
+        finally:
+            disable_attribution()
+            inj.reset()
+            pulse.set_flight(None)
+            if tf is not None:
+                tf.stop()
+            for ep in endpoints.values():
+                ep.stop()
+    if out_path is None:
+        out_path = os.path.join(_REPO,
+                                f"BENCH_WATCH_{jax.default_backend()}.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return out
+
+
 def run_stream_bench(n_rows: int = 50_000, n_features: int = 64,
                      n_entities: int = 500, batch_rows: int = 1024,
                      workers: int = 2, out_path: str = None) -> dict:
@@ -3813,7 +4209,7 @@ def main():
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="with --chaos: the fault schedule is a pure "
                          "function of this seed")
-    ap.add_argument("--chaos-rounds", type=int, default=9,
+    ap.add_argument("--chaos-rounds", type=int, default=10,
                     help="with --chaos: fault rounds (first "
                          "len(FAULT_CLASSES) rounds cover every class "
                          "once)")
@@ -3854,10 +4250,24 @@ def main():
                     help="photonscope overhead micro-bench (disabled-path "
                          "span guard ns/call vs enabled; asserts the "
                          "disabled guard under budget) -> BENCH_OBS.json")
+    ap.add_argument("--watch", action="store_true",
+                    help="photonwatch fleet metrics plane end to end "
+                         "(3 live processes merged over real /watchz "
+                         "HTTP with bounded federation freshness p99, "
+                         "socket delta stream, seeded stall_dist episode "
+                         "firing EXACTLY the latency burn-rate alert — "
+                         "availability stays quiet — fleet-pressure "
+                         "admission shed, flight dump over /flightz, "
+                         "disabled span+attribution guards under the "
+                         "photonscope budget, zero recompiles after "
+                         "warm) -> BENCH_WATCH_<backend>.json")
     ap.add_argument("--out", default=None,
-                    help="with --serving/--lint/--obs: output JSON path "
-                         "override")
+                    help="with --serving/--lint/--obs/--watch: output "
+                         "JSON path override")
     a = ap.parse_args()
+    if a.watch:
+        print(json.dumps(run_watch_bench(out_path=a.out)))
+        return
     if a.stream:
         print(json.dumps(run_stream_bench(
             n_rows=a.stream_rows, batch_rows=a.stream_batch_rows,

@@ -1183,6 +1183,23 @@ class ModuleSummaries:
                     return self._lock_key(cls_name, hits[0])
         return None
 
+    def _resolve_lock_expr(self, expr: ast.AST,
+                           cls_name: Optional[str]) -> Optional[str]:
+        """Lock key for the receiver of a bare ``.acquire()``/``.release()``
+        — ``self.<lock attr>`` (Condition wrappers canonicalise onto their
+        base lock via ``_lock_key``) or a module-level lock name.  No alias
+        flow: bare lock calls on a local alias are rare enough that the
+        self-attr/module-name forms carry the rule."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if cls_name is not None \
+                    and attr in self.lock_attrs.get(cls_name, set()):
+                return self._lock_key(cls_name, attr)
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"{self.relpath}::{expr.id}"
+        return None
+
     def _return_roots(self, expr: ast.AST, fn: FunctionNode,
                       use_flow: bool, depth: int = 0) -> FrozenSet[str]:
         if expr is None or depth > 4:
@@ -1218,6 +1235,7 @@ class ModuleSummaries:
         rank_assigns: List[ast.Assign] = []
         name_counts: Dict[str, int] = {}
         has_with = False
+        has_lock_calls = False
         has_self_src = False
 
         def _selfish(v: Optional[ast.AST]) -> bool:
@@ -1235,6 +1253,10 @@ class ModuleSummaries:
                 returns.append(node)
             elif isinstance(node, ast.Call):
                 fast_calls.append(node)
+                if not has_lock_calls \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("acquire", "release"):
+                    has_lock_calls = True
             elif isinstance(node, (ast.With, ast.AsyncWith)):
                 has_with = True
             elif isinstance(node, ast.Assign):
@@ -1305,14 +1327,20 @@ class ModuleSummaries:
             if all(k is not None for k in ranks) and len(set(ranks)) == 1:
                 return_rank = ranks[0]
 
-        # lock walk — only functions with a with-block pay for it
+        # lock walk — only functions with a with-block or a bare
+        # acquire()/release() call pay for it.  `bare` is the function-wide
+        # document-order stack of locks taken by bare ``.acquire()`` and not
+        # yet ``.release()``d: unlike with-blocks the hold outlives the
+        # statement, so it participates in every pair/held-call formed after
+        # it (branch-insensitive, like the rest of the walk).
         pairs: List[Tuple[str, str, ast.AST]] = []
         held_calls: List[Tuple[str, ast.Call]] = []
         acquires: List[str] = []
         calls: List[ast.Call] = fast_calls
-        if has_with:
+        if has_with or has_lock_calls:
             calls = []
-            lockish_names = cls_name is not None and any(
+            bare: List[str] = []
+            lockish_names = has_with and cls_name is not None and any(
                 isinstance(i.context_expr, ast.Name) and _lockish_context(i)
                 for n in ast.walk(fn)
                 if isinstance(n, (ast.With, ast.AsyncWith))
@@ -1331,7 +1359,7 @@ class ModuleSummaries:
                         if key is not None:
                             if key not in acquires:
                                 acquires.append(key)
-                            for h in held + got:
+                            for h in held + bare + got:
                                 if h != key:
                                     pairs.append((h, key,
                                                   item.context_expr))
@@ -1341,8 +1369,26 @@ class ModuleSummaries:
                     return
                 if isinstance(node, ast.Call):
                     calls.append(node)
-                    for h in held:
+                    for h in held + bare:
                         held_calls.append((h, node))
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in ("acquire", "release"):
+                        key = self._resolve_lock_expr(node.func.value,
+                                                      cls_name)
+                        if key is not None:
+                            if node.func.attr == "acquire":
+                                if key not in acquires:
+                                    acquires.append(key)
+                                for h in held + bare:
+                                    if h != key:
+                                        pairs.append((h, key, node))
+                                bare.append(key)
+                            else:
+                                # release the innermost matching hold
+                                for i in range(len(bare) - 1, -1, -1):
+                                    if bare[i] == key:
+                                        del bare[i]
+                                        break
                 for child in ast.iter_child_nodes(node):
                     visit(child, held)
 

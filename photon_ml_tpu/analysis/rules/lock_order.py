@@ -11,9 +11,11 @@ exactly what per-module analysis (PL005's discipline check, the
 ``lock_held_fns`` reachability) cannot see.
 
 The v4 summary layer records, per function, which locks it acquires
-(``with self.<lock>:``, module-level locks, flow-resolved local aliases;
+(``with self.<lock>:``, bare ``self.<lock>.acquire()``/``.release()``
+pairs, module-level locks, flow-resolved local aliases;
 ``Condition(self._lock)`` canonicalises to the lock it wraps) and which
-calls it makes while holding one.  ``ProgramSummaries`` joins these into a
+calls it makes while holding one.  A bare acquire holds from the call
+site to the matching release (or function end), in document order.  ``ProgramSummaries`` joins these into a
 directed order graph: ``A -> B`` when some function nests B inside A
 lexically, or calls — while holding A — a function that (transitively)
 acquires B.  Every strongly-connected component of size >= 2 is a

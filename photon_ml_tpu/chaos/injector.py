@@ -32,6 +32,7 @@ misspelled point fails loudly instead of testing nothing.
 from __future__ import annotations
 
 import errno
+import math
 import random
 import threading
 import time
@@ -57,9 +58,12 @@ class FaultAction:
     """What an armed fault point should do on this hit.
 
     ``kind`` is interpreted by the seam (``"enospc"``, ``"torn"``,
-    ``"drop"``, ``"stall"``, ``"garbage"``, ``"disconnect"``,
-    ``"crash"``, ``"corrupt"``, ``"slow"``, ``"error"``); ``data``
-    carries kind-specific knobs (e.g. ``stall_s``)."""
+    ``"drop"``, ``"stall"``, ``"stall_dist"``, ``"garbage"``,
+    ``"disconnect"``, ``"crash"``, ``"corrupt"``, ``"slow"``,
+    ``"error"``); ``data`` carries kind-specific knobs (e.g.
+    ``stall_s``).  ``stall_dist`` is ``stall`` with the hold sampled per
+    fire from the rule's seeded lognormal (see ``FaultInjector.check``) —
+    stall-interpreting seams treat the two identically."""
 
     point: str
     kind: str
@@ -81,6 +85,14 @@ class FaultAction:
             return ConnectionResetError(
                 f"injected {self.kind} at {self.point}")
         return InjectedFault(f"injected {self.kind} at {self.point}")
+
+
+# stall_dist defaults: median 30ms holds, heavy-tailed (sigma 0.6 puts the
+# p99 near 4x the median), capped so a pathological draw cannot wedge a
+# bench; all three overridable via the rule's data
+_STALL_DIST_MU = math.log(0.03)
+_STALL_DIST_SIGMA = 0.6
+_STALL_DIST_CAP_S = 0.25
 
 
 class _Rule:
@@ -179,8 +191,19 @@ class FaultInjector:
             rule = self._rules.get(point)
             if rule is None or not rule.decide(hit_no, time.monotonic()):
                 return None
-            action = FaultAction(point=point, kind=rule.kind,
-                                 data=rule.data)
+            data = rule.data
+            if rule.kind == "stall_dist":
+                # latency-distribution stall: every fire samples its OWN
+                # hold from the rule's seeded lognormal — one armed rule
+                # yields a realistic heavy-tailed degradation instead of a
+                # square pulse.  Sampled under the lock from the per-rule
+                # RNG, so same seed + same call sequence -> same holds.
+                mu = float(data.get("mu", _STALL_DIST_MU))
+                sigma = float(data.get("sigma", _STALL_DIST_SIGMA))
+                cap = float(data.get("cap_s", _STALL_DIST_CAP_S))
+                data = dict(data, stall_s=min(
+                    rule._rng.lognormvariate(mu, sigma), cap))
+            action = FaultAction(point=point, kind=rule.kind, data=data)
         if self.registry is not None:
             self.registry.inc("chaos_faults_fired_total", point=point,
                               kind=action.kind)

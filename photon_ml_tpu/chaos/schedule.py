@@ -25,6 +25,10 @@ FAULT_CLASSES = {
     "repl_drop": ("repl.server.send", "drop"),
     "repl_garbage": ("repl.server.send", "garbage"),
     "repl_stall": ("repl.server.send", "stall"),
+    # stall with the hold sampled per fire from the injector's seeded
+    # lognormal (no fixed stall_s in the event data) — the heavy-tailed
+    # degradation the photonwatch SLO burn episodes alarm on
+    "repl_stall_dist": ("repl.server.send", "stall_dist"),
     "client_drop": ("repl.client.read", "drop"),
     "front_drop": ("front.conn", "drop"),
     "snapshot_disconnect": ("repl.server.snapshot", "disconnect"),

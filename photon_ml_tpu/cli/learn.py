@@ -117,6 +117,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "transitions dump the tracer ring here")
     p.add_argument("--flight-max-bytes", type=int, default=16 << 20,
                    help="on-disk byte bound for the flight spool")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="expose GET /metrics, /metrics.json and the "
+                        "photonwatch /watchz federation pull on this "
+                        "localhost port via a sidecar thread (0 = off)")
+    p.add_argument("--watch", action="store_true",
+                   help="photonwatch: enable span-aligned XLA device-time "
+                        "attribution (xla_device_seconds{site=} + "
+                        "device_us/host_us span attrs on solve.bucket)")
+    p.add_argument("--slo", default="", metavar="FILE",
+                   help="photonwatch SLO objectives (JSON list, "
+                        "obs/watch/slo.py) evaluated against this "
+                        "process's registry on a background thread")
+    p.add_argument("--slo-interval", type=float, default=1.0,
+                   help="seconds between --slo evaluation passes")
     return p
 
 
@@ -257,6 +271,40 @@ def run(argv: List[str]) -> int:
                 engine.store.version, engine.store.task.value,
                 coords or "auto")
 
+    # photonwatch: identity gauges always; attribution / SLO eval /
+    # federation pull opt-in
+    from photon_ml_tpu.obs.registry import export_build_info
+
+    export_build_info(engine.metrics.registry, role="owner")
+    if args.watch:
+        from photon_ml_tpu.obs.watch import enable_attribution
+
+        enable_attribution(engine.metrics.registry)
+        logger.info("photonwatch: device-time attribution enabled")
+    slo_thread = None
+    if args.slo:
+        from photon_ml_tpu.obs.watch import SLOEngine, SLOEvalThread, load_slos
+
+        try:
+            slos = load_slos(args.slo)
+        except (OSError, ValueError) as e:
+            logger.error("--slo: %s", e)
+            return 1
+        slo_thread = SLOEvalThread(SLOEngine(slos),
+                                   lambda: engine.metrics.registry,
+                                   interval_s=args.slo_interval).start()
+        logger.info("photonwatch: evaluating %d SLO(s) every %.3fs",
+                    len(slos), args.slo_interval)
+    metrics_sidecar = None
+    if args.metrics_port:
+        from photon_ml_tpu.serving.frontend.metrics_http import \
+            ThreadedMetricsEndpoint
+
+        metrics_sidecar = ThreadedMetricsEndpoint(
+            engine.metrics, port=args.metrics_port).start()
+        logger.info("metrics scrape on http://127.0.0.1:%d/metrics "
+                    "(+ /watchz)", metrics_sidecar.port)
+
     repl = None
     if args.repl_listen:
         if delta_log is None:
@@ -300,6 +348,10 @@ def run(argv: List[str]) -> int:
                 if lines is not sys.stdin:
                     lines.close()
     finally:
+        if slo_thread is not None:
+            slo_thread.stop()
+        if metrics_sidecar is not None:
+            metrics_sidecar.stop()
         if repl is not None:
             repl.stop()
         if delta_log is not None:

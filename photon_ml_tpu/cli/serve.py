@@ -44,6 +44,12 @@ Wire protocol (one JSON object per line on stdin / ``--requests`` file):
             "latest"}} — the flight recorder's spool index plus the most
             recent degradation dump; needs ``--flight-dir`` (otherwise
             -> {"error": ...})
+  watch     {"cmd": "watch"} -> {"watch": <snapshot frame>} — photonwatch
+            federation: the first reply per stream is a full structured
+            registry snapshot, every later one a delta of the series that
+            moved since (obs/watch/federation.py); feed the frames to a
+            ``FleetView`` (or ``tools/fleetwatch.py``) to aggregate many
+            processes into one fleet registry
 
 Responses are ``{"uid": ..., "score": ...}`` lines on stdout, in request
 order.  Every command drains pending requests first, so everything
@@ -340,6 +346,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flight-max-bytes", type=int, default=16 << 20,
                    help="on-disk byte bound for the flight spool "
                         "(oldest dumps evicted first)")
+    p.add_argument("--watch", action="store_true",
+                   help="photonwatch: enable span-aligned XLA device-time "
+                        "attribution (xla_device_seconds{site=} + "
+                        "device_us/host_us span attrs on serve.execute) — "
+                        "the {\"cmd\": \"watch\"} federation stream and "
+                        "GET /watchz are always on")
+    p.add_argument("--slo", default="", metavar="FILE",
+                   help="photonwatch SLO objectives (JSON list, "
+                        "obs/watch/slo.py): evaluate multi-window burn "
+                        "rates against this process's registry on a "
+                        "background thread, publishing "
+                        "fleet_slo_burn_rate{slo=} / fleet_slo_alert{slo=} "
+                        "and dumping the flight recorder on alert edges")
+    p.add_argument("--slo-interval", type=float, default=1.0,
+                   help="seconds between --slo evaluation passes")
+    p.add_argument("--fleet-burn-budget", type=float, default=0.0,
+                   help="--listen mode: shed new requests (reason "
+                        "\"fleet_pressure\") while the largest published "
+                        "fleet_slo_burn_rate gauge in this process's "
+                        "registry exceeds this burn multiple — the hook a "
+                        "fleetwatch aggregator (or a local --slo engine) "
+                        "drives (0 = off)")
     p.add_argument("--exemplars", action="store_true",
                    help="attach trace-id exemplars to latency histogram "
                         "buckets; with --metrics-port the /metrics route "
@@ -420,6 +448,7 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
             sync = False
     pending: "collections.deque" = collections.deque()  # (uid, future)
     buffered: List = []  # sync mode only
+    watch_exporter: List = []  # per-stream photonwatch DeltaExporter (lazy)
     batcher = None if (sync or fleet is not None) else engine.async_batcher(
         deadline_s=deadline_s, predict_mean=predict_mean)
 
@@ -653,6 +682,18 @@ def _serve_stream(engine: ScoringEngine, swapper: HotSwapper, lines: IO,
                     out.write(json.dumps(
                         {"flight": recorder.snapshot()}) + "\n")
                 out.flush()
+            elif cmd == "watch":
+                flush()  # pending work lands in the counters first
+                if not watch_exporter:
+                    from photon_ml_tpu.obs.trace import get_process_label
+                    from photon_ml_tpu.obs.watch import DeltaExporter
+
+                    watch_exporter.append(DeltaExporter(
+                        engine.metrics.registry,
+                        label=get_process_label() or "serve"))
+                out.write(json.dumps(
+                    {"watch": watch_exporter[0].frame()}) + "\n")
+                out.flush()
             elif cmd is not None:
                 out.write(json.dumps({"error": f"unknown cmd {cmd!r}"}) + "\n")
             else:
@@ -794,7 +835,8 @@ def _run_network(engine: ScoringEngine, swapper: HotSwapper,
             tenant_budget_s=(args.tenant_budget_ms * 1e-3
                              if args.tenant_budget_ms else None),
             shard_budget_s=(args.shard_budget_ms * 1e-3
-                            if args.shard_budget_ms else None)),
+                            if args.shard_budget_ms else None),
+            fleet_burn_budget=(args.fleet_burn_budget or None)),
         batcher_deadline_s=args.deadline_us * 1e-6,
         dispatch_window=(args.dispatch_window or None),
         predict_mean=args.predict_mean,
@@ -946,6 +988,18 @@ def run(argv: List[str]) -> int:
                 engine.store.generation, engine.store.version,
                 engine.store.task.value)
 
+    # photonwatch: every process exports who it is; --watch additionally
+    # turns on span-aligned device-time attribution for serve.execute
+    from photon_ml_tpu.obs.registry import export_build_info
+
+    export_build_info(engine.metrics.registry,
+                      role="replica" if args.subscribe else "frontend")
+    if args.watch:
+        from photon_ml_tpu.obs.watch import enable_attribution
+
+        enable_attribution(engine.metrics.registry)
+        logger.info("photonwatch: device-time attribution enabled")
+
     if client is not None:
         swapper.set_base(model_dir, client.floor or 0)
         # owner hot swap mid-stream: the client extracts the shipped base
@@ -1035,6 +1089,25 @@ def run(argv: List[str]) -> int:
                     "%d compile(s)", len(fleet), len(fleet.kernels),
                     fleet.kernels.compile_count)
 
+    slo_thread = None
+    if args.slo:
+        from photon_ml_tpu.obs.watch import SLOEngine, SLOEvalThread, load_slos
+
+        try:
+            slos = load_slos(args.slo)
+        except (OSError, ValueError) as e:
+            logger.error("--slo: %s", e)
+            if follower is not None:
+                follower.stop()
+            if client is not None:
+                client.stop()
+            return 1
+        slo_thread = SLOEvalThread(SLOEngine(slos),
+                                   lambda: engine.metrics.registry,
+                                   interval_s=args.slo_interval).start()
+        logger.info("photonwatch: evaluating %d SLO(s) every %.3fs",
+                    len(slos), args.slo_interval)
+
     metrics_sidecar = None
     try:
         if args.listen:
@@ -1065,6 +1138,8 @@ def run(argv: List[str]) -> int:
                 if lines is not sys.stdin:
                     lines.close()
     finally:
+        if slo_thread is not None:
+            slo_thread.stop()
         if follower is not None:
             follower.stop()
         if client is not None:

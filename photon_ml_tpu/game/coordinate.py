@@ -39,6 +39,7 @@ from photon_ml_tpu.models.game import DatumScoringModel, FixedEffectModel, Rando
 from photon_ml_tpu.models.glm import Coefficients
 from photon_ml_tpu.obs import get_registry, set_family_bounds
 from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.obs.watch.attribution import attribute as obs_attribute
 from photon_ml_tpu.opt.solve import make_solver
 from photon_ml_tpu.opt.types import SolverResult
 from photon_ml_tpu.parallel.bucketing import bucket_by_entity, stacked_coefficients
@@ -1465,11 +1466,17 @@ class RandomEffectCoordinate(Coordinate):
             # (block inside the span — the host-paced loop is per-phase
             # dispatch anyway; the fused sweep is where pipelining lives)
             with obs_span("solve.bucket", coordinate=self.coordinate_id,
-                          bucket=bi, lanes=b.num_lanes, soa=self._use_soa):
+                          bucket=bi, lanes=b.num_lanes,
+                          soa=self._use_soa) as sp:
                 t0 = _time.perf_counter()
-                res = self._vsolve(w0, dev["x"], dev["y"], off_b, dev["w"],
-                                   lane_regs[bi], *self._solve_extras(bi))
-                jax.block_until_ready(res.w)
+                # photonwatch attribution: host (vsolve dispatch) vs
+                # device (the block) split, stamped into the span's attrs
+                # and the xla_*_seconds{site=} families
+                with obs_attribute("solve.bucket", sp):
+                    res = self._vsolve(w0, dev["x"], dev["y"], off_b,
+                                       dev["w"], lane_regs[bi],
+                                       *self._solve_extras(bi))
+                    jax.block_until_ready(res.w)
                 get_registry().observe(
                     "solve_bucket_seconds", _time.perf_counter() - t0,
                     coordinate=self.coordinate_id,

@@ -14,7 +14,10 @@ shared by training AND serving:
     exposition and JSON snapshots (``serving.ServingMetrics`` is a facade
     over it);
   - ``probe``: ``JaxRuntimeProbe`` counting XLA compiles per call site and
-    host<->device transfer bytes at the chunked-upload path.
+    host<->device transfer bytes at the chunked-upload path;
+  - ``watch``: the fleet-global plane (photonwatch) — metrics federation
+    (``DeltaExporter``/``FleetView``), multi-window SLO burn-rate alerting,
+    and span-aligned device-time attribution.
 
 Tracing is disabled by default; the module-level ``span()``/``instant()``
 fast paths cost one boolean check when off (``bench.py --obs`` holds the
@@ -24,8 +27,9 @@ guard under 1µs/call).  Enable with ``photon_ml_tpu.obs.enable_tracing()``,
 
 from photon_ml_tpu.obs.probe import JaxRuntimeProbe, get_probe  # noqa: F401
 from photon_ml_tpu.obs.registry import (LatencyHistogram,  # noqa: F401
-                                        MetricsRegistry, family_bounds,
-                                        get_registry, series_name,
+                                        MetricsRegistry, export_build_info,
+                                        family_bounds, get_registry,
+                                        process_start_time, series_name,
                                         set_family_bounds, set_registry)
 from photon_ml_tpu.obs.trace import (Tracer, enabled, get_tracer,  # noqa: F401
                                      instant, set_tracer, span)

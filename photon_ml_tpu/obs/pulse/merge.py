@@ -50,8 +50,18 @@ def _clock_shifts(traces: Sequence[dict], labels: List[str],
 
     Offsets are directed (``clock[peer] = peer_clock - my_clock``); the
     graph walks them in both directions so a replica that measured the
-    owner aligns even though the owner measured nobody.  Labels with no
-    path to the reference keep shift 0 (surfaced in ``otherData``)."""
+    owner aligns even though the owner measured nobody.
+
+    A DISCONNECTED offset graph (two islands of processes that never
+    exchanged clock pings — e.g. traces from two separate deployments
+    merged after the fact) cannot be aligned onto one clock; pretending
+    otherwise by zero-shifting the unreachable island would silently
+    interleave unrelated timelines.  Instead the merge degrades: each
+    extra component gets its OWN local reference (BFS from its first
+    label), and a warning per component is smuggled out under
+    ``__warnings__`` for ``otherData.clock_warnings`` /
+    ``tools/tracemerge.py`` stderr.  Within a component, relative timing
+    is still exact."""
     # adjacency: edge (a -> b, w) means t_b = t_a + w
     edges: Dict[str, List[tuple]] = {lb: [] for lb in labels}
     for lb, t in zip(labels, traces):
@@ -75,21 +85,42 @@ def _clock_shifts(traces: Sequence[dict], labels: List[str],
                  if lb in measured
                  and not ((t.get("otherData") or {}).get("clock") or {})]
         reference = roots[0] if roots else labels[0]
-    shifts = {reference: 0}
-    frontier = [reference]
-    while frontier:
-        nxt = []
-        for a in frontier:
-            for b, w in edges[a]:
-                if b in shifts:
-                    continue
-                # t_ref = t_a + shifts[a] and t_b = t_a + w
-                shifts[b] = shifts[a] - w
-                nxt.append(b)
-        frontier = nxt
+    def _bfs(root: str) -> None:
+        shifts[root] = shifts.get(root, 0)
+        frontier = [root]
+        while frontier:
+            nxt = []
+            for a in frontier:
+                for b, w in edges[a]:
+                    if b in shifts:
+                        continue
+                    # t_ref = t_a + shifts[a] and t_b = t_a + w
+                    shifts[b] = shifts[a] - w
+                    nxt.append(b)
+            frontier = nxt
+
+    shifts: Dict[str, int] = {reference: 0}
+    _bfs(reference)
+    warnings: List[str] = []
+    component_refs = {reference: reference}
     for lb in labels:
-        shifts.setdefault(lb, 0)
+        if lb in shifts:
+            component_refs.setdefault(lb, reference)
+            continue
+        # disconnected component: align it to its own local reference
+        before = set(shifts)
+        _bfs(lb)
+        members = sorted((set(shifts) - before) & set(labels))
+        for m in members:
+            component_refs[m] = lb
+        warnings.append(
+            f"clock-offset graph disconnected: {members} share no "
+            f"measured peer with reference {reference!r}; aligned to "
+            f"local reference {lb!r} instead (cross-component timing "
+            "is NOT comparable)")
     shifts["__reference__"] = reference  # smuggled out; popped by caller
+    shifts["__warnings__"] = warnings
+    shifts["__component_refs__"] = component_refs
     return shifts
 
 
@@ -111,6 +142,8 @@ def merge_traces(traces: Sequence[dict],
     labels = _labels(traces)
     shifts = _clock_shifts(traces, labels, reference)
     reference = shifts.pop("__reference__")
+    clock_warnings = shifts.pop("__warnings__")
+    component_refs = shifts.pop("__component_refs__")
     events: List[dict] = []
     processes: Dict[str, str] = {}
     trace_counts: Dict[str, int] = {}
@@ -144,6 +177,11 @@ def merge_traces(traces: Sequence[dict],
             "offsets_ns": {lb: shifts[lb] for lb in labels},
             "processes": processes,
             "trace_ids": dict(sorted(trace_counts.items())),
+            # degradation record: per-label local reference (== the global
+            # reference when the offset graph was connected) and one
+            # warning per disconnected component
+            "component_references": component_refs,
+            "clock_warnings": clock_warnings,
         },
     }
 

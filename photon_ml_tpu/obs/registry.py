@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from photon_ml_tpu.obs import trace as _trace
@@ -145,6 +146,43 @@ class LatencyHistogram:
             "min_s": self.min if self.count else 0.0,
             "max_s": self.max,
         }
+
+    def to_state(self) -> dict:
+        """Lossless JSON-safe form (full bucket counts, not percentiles) —
+        the federation wire unit.  ``min`` is reported as 0.0 when empty so
+        the payload never carries a non-JSON ``inf``."""
+        return {
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
+            "count": self.count,
+            "total": self.total,
+            "min": self.min if self.count else 0.0,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "LatencyHistogram":
+        h = cls(tuple(state["bounds"]))
+        h.counts = [int(c) for c in state["counts"]]
+        h.count = int(state["count"])
+        h.total = float(state["total"])
+        h.min = float(state["min"]) if h.count else float("inf")
+        h.max = float(state["max"])
+        return h
+
+    def merge_state(self, state: dict) -> None:
+        """Fold another process's bucket counts into this histogram.  Only
+        legal when the bin ladders match — the caller (FleetView) checks and
+        falls back to per-process series otherwise."""
+        if tuple(state["bounds"]) != self.bounds:
+            raise ValueError("histogram bounds mismatch")
+        for i, c in enumerate(state["counts"]):
+            self.counts[i] += int(c)
+        self.count += int(state["count"])
+        self.total += float(state["total"])
+        if int(state["count"]):
+            self.min = min(self.min, float(state["min"]))
+            self.max = max(self.max, float(state["max"]))
 
 
 def _label_key(labels: Dict[str, object]) -> LabelKey:
@@ -406,6 +444,90 @@ class MetricsRegistry:
     def export(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.to_json(indent=2))
+
+    # -- federation (obs/watch) --------------------------------------------
+    def export_state(self) -> dict:
+        """Every series in structured, MERGEABLE form: labels as pair lists
+        (not rendered strings) and histograms as full fixed-bin bucket
+        counts.  This is what a :class:`~photon_ml_tpu.obs.watch.FleetView`
+        needs to sum counters, re-label gauges, and bucket-merge histograms
+        across processes — ``snapshot()`` cannot serve that role because it
+        collapses histograms to percentile summaries."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted((k, h.to_state())
+                           for k, h in self._histograms.items())
+        return {
+            "counters": [[n, [list(p) for p in lk], v]
+                         for (n, lk), v in counters],
+            "gauges": [[n, [list(p) for p in lk], v]
+                       for (n, lk), v in gauges],
+            "histograms": [[n, [list(p) for p in lk], st]
+                           for (n, lk), st in hists],
+        }
+
+    def put_counter(self, name: str, labels: LabelKey, value: float) -> None:
+        """Install/overwrite one counter series by structured key — the
+        FleetView merge path, not an instrument-site mutator (use ``inc``)."""
+        with self._lock:
+            self._counters[(name, tuple(labels))] = value
+
+    def put_gauge(self, name: str, labels: LabelKey, value: float) -> None:
+        with self._lock:
+            self._gauges[(name, tuple(labels))] = value
+
+    def put_histogram(self, name: str, labels: LabelKey,
+                      hist: LatencyHistogram) -> None:
+        with self._lock:
+            self._histograms[(name, tuple(labels))] = hist
+
+    def histogram_state_series(self, name: str) -> Dict[LabelKey, dict]:
+        """Raw bucket state per label set for one family — what the SLO
+        engine's latency ladders read (``histogram_series`` returns
+        percentile summaries, which can't answer "how many observations
+        exceeded the threshold bound")."""
+        with self._lock:
+            return {lk: h.to_state()
+                    for (n, lk), h in self._histograms.items() if n == name}
+
+    def replace_content(self, counters: Dict[Series, float],
+                        gauges: Dict[Series, float],
+                        histograms: Dict[Series, LatencyHistogram]) -> None:
+        """Atomically replace every series — the FleetView merge target
+        rebuilds the same registry object in place so long-lived readers
+        (the /fleetz endpoint's facade) never hold a stale reference."""
+        with self._lock:
+            self._counters = dict(counters)
+            self._gauges = dict(gauges)
+            self._histograms = dict(histograms)
+
+
+# ---------------------------------------------------------------------------
+# build info / process identity
+# ---------------------------------------------------------------------------
+# Stamped once at import so every registry in the process reports the same
+# start time regardless of when a role wires its metrics surface up.
+_PROCESS_START_UNIX = time.time()
+
+
+def process_start_time() -> float:
+    """Unix time this process imported the metrics module."""
+    return _PROCESS_START_UNIX
+
+
+def export_build_info(registry: Optional["MetricsRegistry"] = None,
+                      role: str = "unknown",
+                      version: Optional[str] = None) -> None:
+    """Export ``photon_build_info{version=,role=}`` (constant 1) and
+    ``process_start_time_seconds`` into ``registry`` (process default when
+    None) so federation can label and age each source it merges."""
+    if registry is None:
+        registry = get_registry()
+    if version is None:
+        from photon_ml_tpu import __version__ as version  # avoid import cycle
+    registry.set_gauge("photon_build_info", 1, version=version, role=role)
+    registry.set_gauge("process_start_time_seconds", _PROCESS_START_UNIX)
 
 
 # ---------------------------------------------------------------------------

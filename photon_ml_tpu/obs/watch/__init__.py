@@ -1,0 +1,36 @@
+"""photonwatch: the fleet-global metrics plane.
+
+Per-process observability (PR 5's registry/tracer, PR 15's pulse) answers
+"what is THIS process doing"; photonwatch answers the questions that only
+exist across the constellation:
+
+* :mod:`federation` — each process exports its ``MetricsRegistry`` as a
+  delta-compressed stream (``{"cmd": "watch"}`` on the serving socket, the
+  ``/watchz`` HTTP route for pull), and a :class:`FleetView` merges N
+  labeled snapshots into one global registry with staleness tracking.
+* :mod:`slo` — declarative objectives evaluated as multi-window burn
+  rates, publishing ``fleet_slo_burn_rate{slo=}`` gauges, latching alerts,
+  and dumping the flight recorder on each burn edge.
+* :mod:`attribution` — span-aligned device-vs-host time split for the XLA
+  execute sites (``serve.execute``, ``solve.bucket``), exported as
+  ``xla_device_seconds{site=}`` and stamped into the Chrome trace.
+"""
+
+from photon_ml_tpu.obs.watch.federation import (  # noqa: F401
+    DeltaExporter,
+    FleetView,
+    apply_frame,
+)
+from photon_ml_tpu.obs.watch.slo import (  # noqa: F401
+    SLO,
+    SLOEngine,
+    SLOEvalThread,
+    load_slos,
+)
+from photon_ml_tpu.obs.watch.attribution import (  # noqa: F401
+    attribute,
+    attribution_enabled,
+    disable_attribution,
+    enable_attribution,
+    set_device_timer,
+)

@@ -1,0 +1,139 @@
+"""Span-aligned XLA device-time attribution (the PR-5 follow-on).
+
+Wall-clocking a host block around ``jit``-compiled work measures dispatch,
+not execution: JAX returns as soon as the computation is enqueued.  This
+module splits an execute site's elapsed time into **host** (python +
+dispatch, up to the moment the call returns) and **device** (what is still
+draining on the accelerator stream afterwards):
+
+    fence                       # drain prior work off the stream
+    t0 = perf_counter
+    <body: dispatch the computation>
+    t_ret = perf_counter        # host side done, device maybe still running
+    fence                       # block until the stream drains
+    t1 = perf_counter
+
+    host_s   = t_ret - t0
+    device_s = t1 - t_ret
+
+On an in-order stream this brackets the actual device execution; on CPU
+(where jax executes synchronously inside the call) ``device_s`` collapses
+toward the fence cost, which is itself the honest answer — there IS no
+async device tail.  A real profiler-derived timer (``jax.profiler`` hooks,
+a TPU runtime counter) can replace the fence arithmetic via
+:func:`set_device_timer` without touching call sites.
+
+Output lands in two places per sample:
+
+* ``xla_device_seconds{site=}`` / ``xla_host_seconds{site=}`` accumulating
+  gauges in the process registry — federation sums these into the fleet
+  view, answering "what fraction of fleet time is device execution",
+* ``device_us`` / ``host_us`` attrs stamped onto the ENCLOSING tracer span
+  (``serve.execute``, ``solve.bucket``) — ``_Span.__exit__`` records its
+  attrs dict by reference, so mutating it before the ``with`` block closes
+  lands the split in the Chrome export next to the span it explains.
+
+Disabled cost: call sites hold one module boolean and get a shared no-op
+context manager back — same discipline as ``trace.span`` (held under the
+1µs ``bench.py --obs``/``--watch`` budget).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from photon_ml_tpu.obs import trace as _trace
+from photon_ml_tpu.obs.registry import MetricsRegistry, get_registry
+
+_enabled = False
+_registry: Optional[MetricsRegistry] = None
+# Optional replacement for the fence arithmetic: called as timer() -> float
+# device-seconds consumed since the previous call on this thread.  None
+# means "fence and subtract" (the portable default).
+_device_timer: Optional[Callable[[], float]] = None
+
+
+def enable_attribution(registry: Optional[MetricsRegistry] = None) -> None:
+    """Turn the split on; samples accumulate into ``registry`` (the process
+    default when None, resolved per sample so registry swaps in tests
+    behave)."""
+    global _enabled, _registry
+    _registry = registry
+    _enabled = True
+
+
+def disable_attribution() -> None:
+    global _enabled, _registry
+    _enabled = False
+    _registry = None
+
+
+def attribution_enabled() -> bool:
+    return _enabled
+
+
+def set_device_timer(timer: Optional[Callable[[], float]]) -> None:
+    """Install a profiler-derived device-seconds source (None restores the
+    fence-based split)."""
+    global _device_timer
+    _device_timer = timer
+
+
+class _NoopAttribution:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopAttribution":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopAttribution()
+
+
+class _Attribution:
+    __slots__ = ("_site", "_span", "_t0", "_timer")
+
+    def __init__(self, site: str, span) -> None:
+        self._site = site
+        self._span = span
+
+    def __enter__(self) -> "_Attribution":
+        self._timer = _device_timer
+        if self._timer is not None:
+            self._timer()  # reset the interval
+        else:
+            _trace.get_tracer().device_fence()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        t_ret = time.perf_counter()
+        if self._timer is not None:
+            device_s = float(self._timer())
+            host_s = max(t_ret - self._t0 - device_s, 0.0)
+        else:
+            _trace.get_tracer().device_fence()
+            t1 = time.perf_counter()
+            host_s = t_ret - self._t0
+            device_s = t1 - t_ret
+        if exc_type is None:
+            reg = _registry if _registry is not None else get_registry()
+            reg.add_gauge("xla_device_seconds", device_s, site=self._site)
+            reg.add_gauge("xla_host_seconds", host_s, site=self._site)
+            attrs = getattr(self._span, "_attrs", None)
+            if attrs is not None:
+                attrs["device_us"] = round(device_s * 1e6, 3)
+                attrs["host_us"] = round(host_s * 1e6, 3)
+        return False
+
+
+def attribute(site: str, span=None):
+    """``with attribute("serve.execute", span_handle):`` around the device
+    dispatch.  ``span`` is the enclosing tracer span handle (may be the
+    no-op span or None; the split is then registry-only)."""
+    if not _enabled:
+        return _NOOP
+    return _Attribution(site, span)

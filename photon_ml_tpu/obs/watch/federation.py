@@ -1,0 +1,244 @@
+"""Metrics federation: delta-compressed registry export + fleet merge.
+
+Wire unit
+---------
+A **frame** is what one process ships to one subscriber:
+
+.. code-block:: python
+
+    {"seq": 3, "full": False, "label": "replica-1", "at_unix": 1723...,
+     "counters":   [[name, [[k, v], ...], value], ...],
+     "gauges":     [[name, [[k, v], ...], value], ...],
+     "histograms": [[name, [[k, v], ...], {bounds, counts, ...}], ...]}
+
+Frame 1 is always the full registry; later frames carry only series whose
+value changed since the last frame (registries never delete series, so
+there are no tombstones).  Delta state is **per subscriber** — each
+``{"cmd": "watch"}`` connection gets its own :class:`DeltaExporter`; the
+``/watchz`` HTTP route is stateless and always serves a full state.
+
+Merge semantics (:class:`FleetView`)
+------------------------------------
+* counters: summed across processes (they are rates of the same event),
+* gauges: kept per process under an added ``process=<label>`` label (a
+  gauge is a statement about one process; summing queue depths across
+  owner and replica would be a lie),
+* histograms: bucket-merged into one series when every process shares the
+  family's bin ladder (the fixed-bin design exists for this); on a ladder
+  mismatch the family degrades to per-process series, also
+  ``process``-labeled, so nothing is silently dropped.
+
+Each source carries a freshness timestamp; :meth:`FleetView.fleet_snapshot`
+reports per-process age so ``/fleetz`` consumers can spot a wedged or
+partitioned exporter before trusting the merged numbers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from photon_ml_tpu.obs.registry import (
+    LabelKey,
+    LatencyHistogram,
+    MetricsRegistry,
+    Series,
+)
+
+# series key inside exporter/ingest state: ("c"|"g"|"h", name, label_key)
+_Key = Tuple[str, str, LabelKey]
+
+
+def _decode_labels(pairs: List[List[str]]) -> LabelKey:
+    return tuple((str(k), str(v)) for k, v in pairs)
+
+
+class DeltaExporter:
+    """Per-subscriber delta compression over ``registry.export_state()``.
+
+    Holds the last-sent value of every series; ``frame()`` diffs the live
+    registry against it.  Histogram change detection keys on ``(count,
+    total)`` — a histogram that recorded anything moved both.
+    """
+
+    def __init__(self, registry: MetricsRegistry,
+                 label: Optional[str] = None) -> None:
+        self._registry = registry
+        self._label = label
+        self._seq = 0
+        self._last: Dict[_Key, object] = {}
+
+    def frame(self) -> dict:
+        state = self._registry.export_state()
+        self._seq += 1
+        full = self._seq == 1
+        out = {
+            "seq": self._seq,
+            "full": full,
+            "at_unix": time.time(),
+            "counters": [],
+            "gauges": [],
+            "histograms": [],
+        }
+        if self._label is not None:
+            out["label"] = self._label
+        for kind, field in (("c", "counters"), ("g", "gauges")):
+            for name, pairs, value in state[field]:
+                key = (kind, name, _decode_labels(pairs))
+                if full or self._last.get(key) != value:
+                    self._last[key] = value
+                    out[field].append([name, pairs, value])
+        for name, pairs, hist_state in state["histograms"]:
+            key = ("h", name, _decode_labels(pairs))
+            mark = (hist_state["count"], hist_state["total"])
+            if full or self._last.get(key) != mark:
+                self._last[key] = mark
+                out["histograms"].append([name, pairs, hist_state])
+        return out
+
+
+def apply_frame(state: Dict[_Key, object], frame: dict) -> None:
+    """Fold one frame (or a bare ``export_state()`` dump) into a flat
+    per-process series dict — the FleetView ingest primitive."""
+    for name, pairs, value in frame.get("counters", ()):
+        state[("c", name, _decode_labels(pairs))] = value
+    for name, pairs, value in frame.get("gauges", ()):
+        state[("g", name, _decode_labels(pairs))] = value
+    for name, pairs, hist_state in frame.get("histograms", ()):
+        state[("h", name, _decode_labels(pairs))] = hist_state
+
+
+class _Process:
+    """One federated source: its series state and freshness bookkeeping."""
+
+    def __init__(self, label: str) -> None:
+        self.label = label
+        self.series: Dict[_Key, object] = {}
+        self.last_seq = 0
+        self.frames = 0
+        self.resyncs = 0
+        self.last_at: Optional[float] = None     # exporter's at_unix
+        self.last_seen: Optional[float] = None   # local ingest time
+
+
+class FleetView:
+    """Merges N labeled process snapshots into one global registry.
+
+    ``ingest`` accepts delta frames (from a ``watch`` subscription) or full
+    ``export_state`` dumps (from ``/watchz`` pulls); a sequence gap on a
+    delta stream marks the source for resync and drops the frame rather
+    than merging a hole.  ``registry`` is a long-lived
+    :class:`MetricsRegistry` rebuilt in place on each ``refresh()``, so a
+    metrics endpoint can hold it once and serve the merged view forever.
+    """
+
+    def __init__(self, stale_after_s: float = 5.0) -> None:
+        self._lock = threading.Lock()
+        self._procs: Dict[str, _Process] = {}
+        self._stale_after_s = float(stale_after_s)
+        self.registry = MetricsRegistry()
+
+    # -- ingest ------------------------------------------------------------
+    def ingest(self, label: str, frame: dict,
+               at: Optional[float] = None) -> bool:
+        """Apply one frame from process ``label``.  Returns False when a
+        delta frame arrived with a sequence gap (caller should re-subscribe
+        to get a fresh full frame)."""
+        now = time.time() if at is None else at
+        with self._lock:
+            proc = self._procs.get(label)
+            if proc is None:
+                proc = self._procs[label] = _Process(label)
+            seq = int(frame.get("seq", 0))
+            full = bool(frame.get("full", seq == 0))
+            if full:
+                proc.series = {}
+            elif seq and seq != proc.last_seq + 1:
+                proc.resyncs += 1
+                proc.last_seq = 0
+                return False
+            apply_frame(proc.series, frame)
+            proc.last_seq = seq
+            proc.frames += 1
+            proc.last_at = float(frame.get("at_unix", now))
+            proc.last_seen = now
+        self.refresh()
+        return True
+
+    def forget(self, label: str) -> None:
+        with self._lock:
+            self._procs.pop(label, None)
+        self.refresh()
+
+    # -- merge -------------------------------------------------------------
+    def refresh(self) -> None:
+        """Rebuild the merged registry from current per-process state."""
+        counters: Dict[Series, float] = {}
+        gauges: Dict[Series, float] = {}
+        # histogram families first group by series key so the bounds check
+        # sees every contributing process before deciding merge vs degrade
+        hist_groups: Dict[Tuple[str, LabelKey],
+                          List[Tuple[str, dict]]] = {}
+        with self._lock:
+            procs = [(p.label, dict(p.series))
+                     for p in self._procs.values()]
+        for label, series in procs:
+            for (kind, name, lk), value in series.items():
+                if kind == "c":
+                    key = (name, lk)
+                    counters[key] = counters.get(key, 0) + value
+                elif kind == "g":
+                    relabeled = tuple(sorted(lk + (("process", label),)))
+                    gauges[(name, relabeled)] = value
+                else:
+                    hist_groups.setdefault((name, lk), []).append(
+                        (label, value))
+        histograms: Dict[Series, LatencyHistogram] = {}
+        for (name, lk), members in hist_groups.items():
+            ladders = {tuple(st["bounds"]) for _, st in members}
+            if len(ladders) == 1:
+                merged = LatencyHistogram.from_state(members[0][1])
+                for _, st in members[1:]:
+                    merged.merge_state(st)
+                histograms[(name, lk)] = merged
+            else:
+                for label, st in members:
+                    relabeled = tuple(sorted(lk + (("process", label),)))
+                    histograms[(name, relabeled)] = \
+                        LatencyHistogram.from_state(st)
+        self.registry.replace_content(counters, gauges, histograms)
+
+    # -- reads -------------------------------------------------------------
+    def processes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._procs)
+
+    def freshness(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Seconds since each source's last exporter-side timestamp."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return {p.label: (now - p.last_at) if p.last_at else float("inf")
+                    for p in self._procs.values()}
+
+    def fleet_snapshot(self, now: Optional[float] = None) -> dict:
+        """The ``/fleetz`` payload: merged series plus per-source health."""
+        now = time.time() if now is None else now
+        with self._lock:
+            sources = {
+                p.label: {
+                    "frames": p.frames,
+                    "resyncs": p.resyncs,
+                    "last_seq": p.last_seq,
+                    "age_s": (now - p.last_at) if p.last_at else None,
+                    "stale": (p.last_at is None
+                              or now - p.last_at > self._stale_after_s),
+                }
+                for p in self._procs.values()
+            }
+        return {
+            "processes": len(sources),
+            "stale_after_s": self._stale_after_s,
+            "sources": dict(sorted(sources.items())),
+            "metrics": self.registry.snapshot(),
+        }

@@ -545,11 +545,12 @@ class ReplicationServer:
         if act is not None:
             # chaos seams, in the follower's terms: "drop" = the TCP
             # session dies mid-stream (client reconnects and resumes);
-            # "stall" = a slow owner (client ack timer keeps ticking);
+            # "stall"/"stall_dist" = a slow owner (client ack timer keeps
+            # ticking; stall_dist holds are sampled by the injector);
             # "garbage" = a corrupt frame on the wire IN PLACE of the
             # record (client must fail typed, reconnect, and recover the
             # record via log catch-up — f.sent is not advanced)
-            if act.kind == "stall":
+            if act.kind in ("stall", "stall_dist"):
                 await asyncio.sleep(float(act.data.get("stall_s", 0.05)))
             elif act.kind == "garbage":
                 f.writer.write(b"\x7f{not json//\n")

@@ -35,10 +35,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.chaos.injector import fault as _chaos_fault
 from photon_ml_tpu.game.scoring import additive_total, output_scores
 from photon_ml_tpu.obs import get_probe
 from photon_ml_tpu.obs.trace import enabled as obs_enabled
 from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.obs.watch.attribution import attribute as _attribute
 from photon_ml_tpu.parallel.bucketing import score_samples
 from photon_ml_tpu.serving.batcher import (AsyncBatcher, BucketedBatcher,
                                            Request, densify_features)
@@ -360,6 +362,16 @@ class ScoringEngine:
         out: Optional[np.ndarray] = None
         for mb in self.batcher.plan(n):
             t0 = time.perf_counter()
+            act = _chaos_fault("serve.execute")
+            if act is not None:
+                # chaos: hold the scoring path itself (stall/stall_dist) —
+                # the latency-SLO degradation episodes alarm on; requests
+                # still succeed, so availability objectives stay quiet.
+                # Any other kind at this point is a seam misuse.
+                if act.kind in ("stall", "stall_dist"):
+                    time.sleep(float(act.data.get("stall_s", 0.05)))
+                else:
+                    raise act.to_error()
             chunk = requests[mb.start:mb.stop]
             attrs = {}
             if obs_enabled():
@@ -372,9 +384,13 @@ class ScoringEngine:
                 if tids:
                     attrs["traces"] = tids
             with obs_span("serve.execute", bucket=mb.bucket,
-                          rows=mb.real_rows, **attrs):
-                scores = self._score_chunk(store, chunk, mb.bucket,
-                                           trace_attrs=attrs)
+                          rows=mb.real_rows, **attrs) as sp:
+                # photonwatch attribution: split this span into host
+                # (dispatch) vs device (drain) time — stamped into the
+                # span's attrs and the xla_*_seconds{site=} families
+                with _attribute("serve.execute", sp):
+                    scores = self._score_chunk(store, chunk, mb.bucket,
+                                               trace_attrs=attrs)
             if out is None:
                 out = np.empty(n, scores.dtype)
             out[mb.start:mb.stop] = scores[: mb.real_rows]

@@ -62,11 +62,23 @@ deadline budget — so a well-behaved client backs off instead of hammering.
   are refused up front with reason ``not_ready``.  The check lives in the
   frontend (it owns the HealthState); admission just names the reason so
   the shed metric and wire replies stay one vocabulary.
+
+  **Fleet-pressure shedding** (``fleet_burn_budget``, off by default) is
+  the photonwatch hook: the SLO engine publishes
+  ``fleet_slo_burn_rate{slo=}`` gauges (into this process's registry in
+  local mode, or pushed down from the fleet aggregator), and when the max
+  across objectives exceeds the configured burn budget the edge sheds with
+  reason ``fleet_pressure`` — skew visible only ACROSS frontends (every
+  per-process estimate healthy, the fleet p99 burning) still gets load off
+  the floor.  The gauge read is throttled (``fleet_burn_poll_s``) so the
+  per-request cost is a float compare; the latch carries the same
+  two-watermark hysteresis as every other shed reason.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, Optional
 
 from photon_ml_tpu.obs.pulse.flight import flight_dump
@@ -80,6 +92,7 @@ SHED_CLIENT = "client_overload"
 SHED_TENANT = "tenant_overload"
 SHED_SHARD = "shard_overload"
 SHED_NOT_READY = "not_ready"
+SHED_FLEET = "fleet_pressure"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +116,13 @@ class AdmissionConfig:
     attributable to the shard a request's hot-path work routes to (None =
     per-shard budgets off) — one overloaded slice sheds its own traffic
     under ``shard_overload`` instead of dragging the fleet p99.
+    ``fleet_burn_budget``: max ``fleet_slo_burn_rate`` gauge value (across
+    objectives) tolerated before shedding with reason ``fleet_pressure``
+    (None = fleet-pressure shedding off; module docstring) — burn 1.0
+    spends the error budget exactly on plan, so a sensible setting sits
+    well above 1 (e.g. the SLO's page threshold).
+    ``fleet_burn_poll_s``: how often the burn gauges are re-read; between
+    polls ``decide`` compares against the cached value.
     """
 
     budget_s: float = 0.050
@@ -111,6 +131,8 @@ class AdmissionConfig:
     client_budget_s: Optional[float] = None
     tenant_budget_s: Optional[float] = None
     shard_budget_s: Optional[float] = None
+    fleet_burn_budget: Optional[float] = None
+    fleet_burn_poll_s: float = 0.25
 
     def __post_init__(self):
         if self.budget_s <= 0:
@@ -127,6 +149,12 @@ class AdmissionConfig:
         if self.shard_budget_s is not None and self.shard_budget_s <= 0:
             raise ValueError("shard_budget_s must be > 0, got "
                              f"{self.shard_budget_s}")
+        if self.fleet_burn_budget is not None and self.fleet_burn_budget <= 0:
+            raise ValueError("fleet_burn_budget must be > 0, got "
+                             f"{self.fleet_burn_budget}")
+        if self.fleet_burn_poll_s <= 0:
+            raise ValueError("fleet_burn_poll_s must be > 0, got "
+                             f"{self.fleet_burn_poll_s}")
 
 
 @dataclasses.dataclass
@@ -155,6 +183,9 @@ class AdmissionController:
         self._client_shedding: Dict[str, bool] = {}  # latched clients only
         self._tenant_shedding: Dict[str, bool] = {}  # latched tenants only
         self._shard_shedding: Dict[int, bool] = {}   # latched shards only
+        self._fleet_shedding = False
+        self._fleet_burn = 0.0                 # cached gauge read
+        self._fleet_burn_checked: Optional[float] = None
 
     @property
     def shedding(self) -> bool:
@@ -168,6 +199,34 @@ class AdmissionController:
 
     def shard_shedding(self, shard: int) -> bool:
         return self._shard_shedding.get(shard, False)
+
+    @property
+    def fleet_shedding(self) -> bool:
+        return self._fleet_shedding
+
+    def _fleet_burn_now(self) -> float:
+        """Max ``fleet_slo_burn_rate`` across objectives, re-read from the
+        registry at most every ``fleet_burn_poll_s`` (the ``_health_ready``
+        throttled-cache pattern) so per-request cost is a float compare."""
+        now = time.monotonic()
+        if (self._fleet_burn_checked is None
+                or now - self._fleet_burn_checked
+                >= self.config.fleet_burn_poll_s):
+            series = self._registry.gauge_series("fleet_slo_burn_rate") \
+                if self._registry is not None else {}
+            self._fleet_burn = max(series.values(), default=0.0)
+            self._fleet_burn_checked = now
+        return self._fleet_burn
+
+    def _set_fleet_shedding(self, value: bool) -> None:
+        if value != self._fleet_shedding:
+            self._fleet_shedding = value
+            if self._registry is not None:
+                self._registry.set_gauge("front_fleet_shedding", int(value))
+            if value:
+                # fleet latch ENGAGED: the burn the aggregator saw started
+                # before this process shed — spool what this process has
+                flight_dump("fleet_pressure", burn_rate=self._fleet_burn)
 
     def _set_shedding(self, value: bool) -> None:
         if value != self._shedding:
@@ -289,6 +348,21 @@ class AdmissionController:
                 self._set_shard_shedding(shard, True)
                 return Verdict(False, shard_wait_s, SHED_SHARD,
                                self._retry_ms(shard_wait_s, budget))
+        if c.fleet_burn_budget is not None:
+            # the widest check: the fleet aggregator's burn-rate gauges say
+            # the WHOLE constellation is spending its error budget too fast
+            # — shed here even though this process's own backlog is healthy
+            burn = self._fleet_burn_now()
+            if self._fleet_shedding:
+                if burn <= c.fleet_burn_budget * c.resume_fraction:
+                    self._set_fleet_shedding(False)
+                else:
+                    return Verdict(False, predicted_wait_s, SHED_FLEET,
+                                   self.retry_after_ms(predicted_wait_s))
+            elif burn > c.fleet_burn_budget:
+                self._set_fleet_shedding(True)
+                return Verdict(False, predicted_wait_s, SHED_FLEET,
+                               self.retry_after_ms(predicted_wait_s))
         if self._shedding:
             if predicted_wait_s <= c.budget_s * c.resume_fraction:
                 self._set_shedding(False)  # backlog drained: unlatch

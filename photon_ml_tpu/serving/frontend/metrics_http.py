@@ -24,7 +24,15 @@ scraper deployment keeps working unchanged.  ``GET /flightz`` -> the
 photonpulse flight recorder's spool index plus the latest degradation
 dump (404 when no ``--flight-dir`` recorder is installed) — the same
 payload the ``{"cmd": "flight"}`` wire command returns, reachable even
-when the serving socket itself is what degraded.  Anything else is 404.
+when the serving socket itself is what degraded.
+``GET /watchz`` -> the photonwatch federation pull unit: the full
+structured registry dump (labels structured, histograms as bucket counts)
+wrapped with the process label and a timestamp — what a ``FleetView``
+poller ingests; always a full state, never a delta (delta compression is
+per-subscriber and lives on the ``{"cmd": "watch"}`` socket stream).
+``GET /fleetz`` -> the merged fleet view with per-source staleness, served
+only by an endpoint built with ``fleet_view=`` (the aggregator —
+``tools/fleetwatch.py``); 404 elsewhere.  Anything else is 404.
 Connections are one-shot (``Connection: close``) — scrape traffic, not an
 API.
 """
@@ -47,12 +55,13 @@ class MetricsEndpoint:
 
     def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
                  port: int = 0, health: Optional[HealthState] = None,
-                 exemplars: bool = False):
+                 exemplars: bool = False, fleet_view=None):
         self.metrics = metrics
         self.host = host
         self.config_port = port
         self.health = health
         self.exemplars = exemplars
+        self.fleet_view = fleet_view
         self.port: Optional[int] = None
         self._server: Optional[asyncio.base_events.Server] = None
 
@@ -129,10 +138,24 @@ class MetricsEndpoint:
                 body = (json.dumps(recorder.snapshot(), sort_keys=True)
                         + "\n").encode("utf-8")
                 ctype = b"application/json"
+            elif path == "/watchz":
+                body = (json.dumps(self.metrics.watch_state())
+                        + "\n").encode("utf-8")
+                ctype = b"application/json"
+            elif path == "/fleetz":
+                if self.fleet_view is None:
+                    writer.write(_response(
+                        404, b"no fleet view here; /fleetz is served by "
+                             b"the aggregator (tools/fleetwatch.py)\n",
+                        b"text/plain"))
+                    return
+                body = (json.dumps(self.fleet_view.fleet_snapshot(),
+                                   sort_keys=True) + "\n").encode("utf-8")
+                ctype = b"application/json"
             else:
                 writer.write(_response(
-                    404, b"try /metrics, /metrics.json, /healthz, "
-                         b"/readyz or /flightz\n", b"text/plain"))
+                    404, b"try /metrics, /metrics.json, /healthz, /readyz, "
+                         b"/flightz, /watchz or /fleetz\n", b"text/plain"))
                 return
             writer.write(_response(status,
                                    b"" if method == "HEAD" else body,
@@ -167,9 +190,10 @@ class ThreadedMetricsEndpoint:
 
     def __init__(self, metrics: ServingMetrics, host: str = "127.0.0.1",
                  port: int = 0, health: Optional[HealthState] = None,
-                 exemplars: bool = False):
+                 exemplars: bool = False, fleet_view=None):
         self.endpoint = MetricsEndpoint(metrics, host, port, health=health,
-                                        exemplars=exemplars)
+                                        exemplars=exemplars,
+                                        fleet_view=fleet_view)
         self._ready = threading.Event()
         self._stop: Optional[asyncio.Event] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None

@@ -258,6 +258,9 @@ class FrontendServer:
         self._admission = AdmissionController(self.config.admission,
                                               registry=self._registry)
         self._conns: Dict[str, _Conn] = {}
+        # photonwatch subscriptions: delta-compression state is per
+        # SUBSCRIBER, keyed by connection id (dropped with the connection)
+        self._watch_exporters: Dict[str, object] = {}
         self._conn_seq = 0
         self._outstanding = 0  # resident in the batcher (dispatch window)
         self._inflight = 0     # admitted, not yet settled (drain barrier)
@@ -418,6 +421,7 @@ class FrontendServer:
             except asyncio.CancelledError:
                 pass
             self._conns.pop(cid, None)
+            self._watch_exporters.pop(cid, None)
             self._admission.forget_client(cid)
             self._registry.set_gauge("front_connections", len(self._conns))
             self._registry.set_gauge("front_queue_depth", 0, client=cid)
@@ -1128,6 +1132,19 @@ class FrontendServer:
             else:
                 self._reply_now(conn,
                                 lambda: {"flight": recorder.snapshot()})
+        elif cmd == "watch":
+            # photonwatch federation subscription: the first frame on a
+            # connection is the full registry; every later ``watch`` gets
+            # only the series that changed since (frames are lazy like
+            # ``metrics``, snapshotted when the reply is written)
+            for b in self._all_batchers():
+                b.flush()
+            exporter = self._watch_exporters.get(conn.cid)
+            if exporter is None:
+                from photon_ml_tpu.obs.watch import DeltaExporter
+                exporter = self._watch_exporters[conn.cid] = DeltaExporter(
+                    self._registry, label=get_process_label() or "frontend")
+            self._reply_now(conn, lambda: {"watch": exporter.frame()})
         elif cmd == "shutdown":
             fut = self._reply_future(conn)
             fut.set_result({"shutdown": "ok",

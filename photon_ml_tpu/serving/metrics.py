@@ -31,6 +31,7 @@ Registry mapping:
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Optional
 
@@ -197,6 +198,20 @@ class ServingMetrics:
             tenant_rows.setdefault(d["tenant"], {})[d["kind"]] = int(v)
         return {"requests": requests, "shadow": shadow,
                 "tenant_rows": tenant_rows}
+
+    def watch_state(self) -> dict:
+        """The photonwatch federation pull unit (the ``/watchz`` route): the
+        full structured registry dump wrapped with this process's label and
+        an exporter-side timestamp, so a :class:`~photon_ml_tpu.obs.watch.
+        FleetView` can merge and age it.  A SEPARATE view like
+        ``shard_view`` — ``snapshot()``'s key set does not grow."""
+        from photon_ml_tpu.obs.trace import get_process_label
+        return {
+            "label": get_process_label() or f"pid-{os.getpid()}",
+            "at_unix": time.time(),
+            "full": True,
+            **self.registry.export_state(),
+        }
 
     # -- views -------------------------------------------------------------
     def counter(self, name: str) -> int:

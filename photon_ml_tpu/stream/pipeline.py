@@ -62,10 +62,11 @@ class ChunkPipeline:
     def _decode(self, chunk: Chunk):
         act = _chaos_fault("stream.decode")
         if act is not None:
-            # "slow" exercises the pipeline-stall accounting; "corrupt"
-            # exercises the on_error raise/skip contract — both flow
-            # through the exact paths a real bad chunk would take
-            if act.kind == "slow":
+            # "slow"/"stall_dist" exercise the pipeline-stall accounting
+            # (stall_dist holds come pre-sampled by the injector);
+            # "corrupt" exercises the on_error raise/skip contract — both
+            # flow through the exact paths a real bad chunk would take
+            if act.kind in ("slow", "stall_dist"):
                 time.sleep(float(act.data.get("stall_s", 0.05)))
             else:
                 raise ValueError(

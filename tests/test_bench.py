@@ -706,7 +706,8 @@ class TestChaosBenchCli:
         monkeypatch.setattr(sys, "argv", ["bench.py", "--chaos"])
         bench.main()
         assert seen["seed"] == 0
-        assert seen["rounds"] == 9
+        # one coverage round per fault class (stall_dist joined in PR 20)
+        assert seen["rounds"] == 10
         assert seen["out_path"] is None
 
 

@@ -242,6 +242,81 @@ class TestFaultInjector:
 # ---------------------------------------------------------------------------
 # seeded schedule
 # ---------------------------------------------------------------------------
+class TestStallDist:
+    def test_each_fire_samples_its_own_hold(self):
+        inj = FaultInjector()
+        inj.arm("p", "stall_dist")
+        holds = [inj.check("p").data["stall_s"] for _ in range(8)]
+        assert len(set(holds)) > 1          # a distribution, not a pulse
+        assert all(0.0 < h <= 0.25 for h in holds)
+
+    def test_seeded_same_call_sequence_same_holds(self):
+        a, b = FaultInjector(), FaultInjector()
+        a.arm("p", "stall_dist", seed=7)
+        b.arm("p", "stall_dist", seed=7)
+        assert [a.check("p").data["stall_s"] for _ in range(6)] == \
+            [b.check("p").data["stall_s"] for _ in range(6)]
+        c = FaultInjector()
+        c.arm("p", "stall_dist", seed=8)
+        assert [c.check("p").data["stall_s"] for _ in range(6)] != \
+            [a.check("p").data["stall_s"] for _ in range(6)]
+
+    def test_cap_and_distribution_overrides(self):
+        import math
+        inj = FaultInjector()
+        inj.arm("p", "stall_dist",
+                data={"mu": math.log(10.0), "sigma": 0.1, "cap_s": 0.07})
+        # median 10s holds all land on the cap
+        assert all(inj.check("p").data["stall_s"] == 0.07
+                   for _ in range(5))
+        inj.arm("p", "stall_dist", data={"mu": math.log(0.01),
+                                         "sigma": 0.05})
+        holds = [inj.check("p").data["stall_s"] for _ in range(8)]
+        assert all(0.005 < h < 0.02 for h in holds)
+
+    def test_armed_rule_data_not_mutated_by_sampling(self):
+        inj = FaultInjector()
+        data = {"cap_s": 0.05}
+        inj.arm("p", "stall_dist", data=data)
+        act = inj.check("p")
+        assert "stall_s" in act.data
+        assert data == {"cap_s": 0.05}      # per-fire copy, not in place
+        assert "stall_s" not in inj.check("p").data or \
+            inj.check("p").data is not act.data
+
+    def test_catalog_carries_repl_stall_dist(self):
+        assert FAULT_CLASSES["repl_stall_dist"] == \
+            ("repl.server.send", "stall_dist")
+        assert len(FAULT_CLASSES) == 10
+
+    def test_serve_execute_seam_holds_scoring_and_counts_in_latency(self):
+        import math
+        eng = _engine()
+        rng = np.random.default_rng(0)
+        reqs = [_req(rng, i, i % N_ENT) for i in range(8)]
+        eng.score_requests(reqs)            # warm + baseline observation
+        inj = get_injector()
+        inj.arm("serve.execute", "stall_dist", max_fires=1,
+                data={"mu": math.log(0.08), "sigma": 0.01, "cap_s": 0.1})
+        before = eng.metrics.registry.histogram_state_series(
+            "serving_latency_s")
+        total_before = sum(st["total"] for st in before.values())
+        t0 = time.perf_counter()
+        stalled = eng.score_requests(reqs)
+        held = time.perf_counter() - t0
+        assert inj.fired("serve.execute") == 1
+        assert held >= 0.05                 # the hold really blocked
+        after = eng.metrics.registry.histogram_state_series(
+            "serving_latency_s")
+        total_after = sum(st["total"] for st in after.values())
+        # the SLO's latency source saw the stall, not just the wall clock
+        assert total_after - total_before >= 0.05
+        # requests still succeed: a stall degrades, never errors
+        inj.reset()
+        clean = eng.score_requests(reqs)
+        np.testing.assert_allclose(np.asarray(stalled), np.asarray(clean))
+
+
 class TestSchedule:
     def test_pure_function_of_seed(self):
         assert build_schedule(5, 12) == build_schedule(5, 12)

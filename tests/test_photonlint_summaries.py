@@ -494,6 +494,155 @@ class TestLockOrder:
         """})
         assert _by_rule(_run(root), "lock-order") == []
 
+    def test_positive_bare_acquire_forms_edges(self, tmp_path):
+        # same deadlock as DEADLOCK_MOD, but Alpha.forward holds its lock
+        # through bare acquire()/release() instead of a with-block — the
+        # hold spans the beta call between them
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import threading
+
+            class Alpha:
+                def __init__(self, beta):
+                    self._lock = threading.Lock()
+                    self.beta = beta
+
+                def forward(self):
+                    self._lock.acquire()
+                    try:
+                        self.beta.grab_beta()
+                    finally:
+                        self._lock.release()
+
+                def poke_alpha(self):
+                    self._lock.acquire()
+                    self._lock.release()
+
+            class Beta:
+                def __init__(self, alpha):
+                    self._lock = threading.Lock()
+                    self.alpha = alpha
+
+                def grab_beta(self):
+                    with self._lock:
+                        pass
+
+                def backward(self):
+                    with self._lock:
+                        self.alpha.poke_alpha()
+        """})
+        vs = _by_rule(_run(root), "lock-order")
+        assert vs, "bare acquire()/release() holds must form order edges"
+        assert any("Alpha._lock" in v.message and "Beta._lock" in v.message
+                   for v in vs)
+
+    def test_positive_condition_wrapper_bare_acquire(self, tmp_path):
+        # cv.acquire() on a Condition wrapping self._lock canonicalises to
+        # the base lock — the cycle must name Alpha._lock, not Alpha._cv
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import threading
+
+            class Alpha:
+                def __init__(self, beta):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self.beta = beta
+
+                def forward(self):
+                    self._cv.acquire()
+                    try:
+                        self.beta.grab_beta()
+                    finally:
+                        self._cv.release()
+
+                def poke_alpha(self):
+                    with self._lock:
+                        pass
+
+            class Beta:
+                def __init__(self, alpha):
+                    self._lock = threading.Lock()
+                    self.alpha = alpha
+
+                def grab_beta(self):
+                    with self._lock:
+                        pass
+
+                def backward(self):
+                    with self._lock:
+                        self.alpha.poke_alpha()
+        """})
+        vs = _by_rule(_run(root), "lock-order")
+        assert vs, "Condition wrapper holds must canonicalise to the base"
+        assert any("Alpha._lock" in v.message for v in vs)
+        assert not any("Alpha._cv" in v.message for v in vs)
+
+    def test_negative_release_before_call_is_quiet(self, tmp_path):
+        # Alpha releases BEFORE calling into Beta — no overlap, no edge,
+        # even though Beta's path comes the other way
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import threading
+
+            class Alpha:
+                def __init__(self, beta):
+                    self._lock = threading.Lock()
+                    self.beta = beta
+
+                def forward(self):
+                    self._lock.acquire()
+                    self._lock.release()
+                    self.beta.grab_beta()
+
+                def poke_alpha(self):
+                    self._lock.acquire()
+                    self._lock.release()
+
+            class Beta:
+                def __init__(self, alpha):
+                    self._lock = threading.Lock()
+                    self.alpha = alpha
+
+                def grab_beta(self):
+                    with self._lock:
+                        pass
+
+                def backward(self):
+                    with self._lock:
+                        self.alpha.poke_alpha()
+        """})
+        assert _by_rule(_run(root), "lock-order") == []
+
+    def test_negative_foreign_acquire_receiver_is_quiet(self, tmp_path):
+        # .acquire() on something that is not a known lock (a semaphore
+        # object passed in, an attr of another object) must not register
+        root = _write_pkg(tmp_path, {"locks.py": """
+            import threading
+
+            class Alpha:
+                def __init__(self, beta, gate):
+                    self._lock = threading.Lock()
+                    self.beta = beta
+                    self.gate = gate
+
+                def forward(self):
+                    self.gate.acquire()
+                    self.beta.grab_beta()
+                    self.gate.release()
+
+            class Beta:
+                def __init__(self, alpha):
+                    self._lock = threading.Lock()
+                    self.alpha = alpha
+
+                def grab_beta(self):
+                    with self._lock:
+                        pass
+
+                def backward(self):
+                    with self._lock:
+                        self.alpha.forward()
+        """})
+        assert _by_rule(_run(root), "lock-order") == []
+
 
 # ---------------------------------------------------------------------------
 # the immutable-valued-attr classifier

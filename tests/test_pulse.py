@@ -437,6 +437,61 @@ class TestMerge:
         assert other["reference"] == "owner"
         assert other["offsets_ns"]["frontend"] == 0  # no path: unshifted
 
+    def test_disconnected_graph_degrades_to_component_references(self):
+        # two deployments merged after the fact: {owner, replica} pinged
+        # each other, {edge-a, edge-b} pinged each other, no cross edges
+        owner = _mk_trace("owner", [_ev("a", 10)])
+        replica = _mk_trace(
+            "replica", [_ev("b", 100)],
+            clock={"owner": {"offset_ns": 5_000_000, "rtt_ns": 900}})
+        edge_a = _mk_trace("edge-a", [_ev("c", 20)])
+        edge_b = _mk_trace(
+            "edge-b", [_ev("d", 200)],
+            clock={"edge-a": {"offset_ns": -2_000_000, "rtt_ns": 800}})
+        merged = merge_traces([owner, replica, edge_a, edge_b])
+        other = merged["otherData"]
+        assert other["reference"] == "owner"
+        # the island got its OWN local reference, not a silent zero-shift
+        refs = other["component_references"]
+        assert refs["owner"] == "owner" and refs["replica"] == "owner"
+        assert refs["edge-a"] == refs["edge-b"] == "edge-a"
+        # within the island relative timing is still exact
+        assert other["offsets_ns"]["edge-b"] \
+            - other["offsets_ns"]["edge-a"] == -2_000_000
+        warnings = other["clock_warnings"]
+        assert len(warnings) == 1 and "disconnected" in warnings[0]
+        assert "edge-a" in warnings[0] and "edge-b" in warnings[0]
+
+    def test_connected_graph_has_no_clock_warnings(self):
+        owner = _mk_trace("owner", [_ev("a", 10)])
+        replica = _mk_trace(
+            "replica", [_ev("b", 100)],
+            clock={"owner": {"offset_ns": 5_000_000, "rtt_ns": 900}})
+        merged = merge_traces([owner, replica])
+        other = merged["otherData"]
+        assert other["clock_warnings"] == []
+        assert set(other["component_references"].values()) == {"owner"}
+
+    def test_tracemerge_cli_warns_on_disconnect_even_quiet(
+            self, tmp_path, capsys):
+        from tools.tracemerge import run
+        paths = []
+        for label, clock in (("owner", None),
+                             ("edge", {"nowhere": {"offset_ns": 1,
+                                                   "rtt_ns": 1}})):
+            # "edge" measured a peer that is not in the merge set: its
+            # component is disconnected from the owner's
+            p = tmp_path / f"{label}.json"
+            p.write_text(json.dumps(_mk_trace(label, [_ev("x", 1)],
+                                              clock=clock)))
+            paths.append(str(p))
+        out = tmp_path / "merged.json"
+        assert run(paths + ["--out", str(out), "--quiet"]) == 0
+        err = capsys.readouterr().err
+        assert "warning" in err and "disconnected" in err
+        merged = json.loads(out.read_text())
+        assert merged["otherData"]["clock_warnings"]
+
     def test_events_sorted_metadata_first(self):
         t1 = _mk_trace("owner", [_ev("late", 500), _ev("early", 5)])
         t2 = _mk_trace("replica", [_ev("mid", 50)])

@@ -1,0 +1,172 @@
+"""fleetwatch CLI — one fleet-global metrics plane over many processes.
+
+Usage:
+    python -m tools.fleetwatch owner=127.0.0.1:9100 \
+        replica=127.0.0.1:9101 front=127.0.0.1:9102 \
+        --interval 1.0 --slo slos.json --port 9200
+    python -m tools.fleetwatch front=127.0.0.1:9102 --once
+
+Each positional peer is ``LABEL=HOST:PORT`` of a process serving the
+photonwatch federation pull (``GET /watchz`` on its ``--metrics-port`` —
+``cli/serve.py``, ``cli/learn.py --metrics-port``, or anything holding a
+``ThreadedMetricsEndpoint``).  fleetwatch polls every peer each
+``--interval``, merges the snapshots in a ``FleetView`` (counters summed,
+gauges labeled ``process=``, histograms bucket-merged), evaluates
+``--slo`` burn rates against the MERGED registry, and serves the result:
+
+  * ``GET /fleetz`` on ``--port`` — the fleet snapshot (per-source
+    freshness/staleness + merged metrics), plus the standard
+    ``/metrics`` / ``/metrics.json`` routes over the merged registry, so
+    one Prometheus scrape sees the whole fleet;
+  * SLO alert edges printed to stderr as they latch/resolve.
+
+``--once`` does a single poll round, prints the fleet snapshot JSON to
+stdout (or ``--out``), and exits — the scriptable/testable path.  Exit
+status 1 if every peer was unreachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # direct `python tools/fleetwatch.py` runs
+    sys.path.insert(0, _REPO_ROOT)
+
+from photon_ml_tpu.obs.watch import (FleetView, SLOEngine,  # noqa: E402
+                                     load_slos)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fleetwatch",
+        description="Aggregate photonwatch /watchz feeds from many "
+                    "processes into one fleet registry with SLO burn-rate "
+                    "alerting")
+    p.add_argument("peers", nargs="+", metavar="LABEL=HOST:PORT",
+                   help="processes to poll (their --metrics-port)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between poll rounds")
+    p.add_argument("--stale-after", type=float, default=5.0,
+                   help="seconds without a successful poll before a "
+                        "source reports stale in /fleetz")
+    p.add_argument("--slo", default="", metavar="FILE",
+                   help="SLO objectives (JSON list, obs/watch/slo.py) "
+                        "evaluated against the MERGED fleet registry")
+    p.add_argument("--port", type=int, default=0,
+                   help="serve GET /fleetz (+ /metrics over the merged "
+                        "registry) on this localhost port (0 = off)")
+    p.add_argument("--once", action="store_true",
+                   help="one poll round, print the fleet snapshot JSON, "
+                        "exit")
+    p.add_argument("--rounds", type=int, default=0,
+                   help="stop after this many poll rounds (0 = forever; "
+                        "implies nothing about --once, which is 1 round)")
+    p.add_argument("--out", default="-", metavar="FILE",
+                   help="--once snapshot destination ('-' = stdout)")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-peer HTTP timeout in seconds")
+    return p
+
+
+def _parse_peer(spec: str):
+    label, sep, hostport = spec.partition("=")
+    host, sep2, port = hostport.rpartition(":")
+    if not sep or not sep2 or not label or not host:
+        raise ValueError(f"peer wants LABEL=HOST:PORT, got {spec!r}")
+    return label, host, int(port)
+
+
+def poll_once(view: FleetView, peers, timeout: float = 2.0) -> int:
+    """One round: pull /watchz from every peer, ingest into ``view``.
+    Returns how many peers answered; failures warn on stderr and the
+    source simply goes stale in the fleet snapshot."""
+    ok = 0
+    for label, host, port in peers:
+        url = f"http://{host}:{port}/watchz"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                frame = json.loads(resp.read().decode("utf-8"))
+        except (OSError, ValueError, urllib.error.URLError) as e:
+            print(f"fleetwatch: {label} ({url}): {e}", file=sys.stderr)
+            continue
+        view.ingest(label, frame)
+        ok += 1
+    return ok
+
+
+def run(argv) -> int:
+    args = _parser().parse_args(argv)
+    try:
+        peers = [_parse_peer(s) for s in args.peers]
+    except ValueError as e:
+        print(f"fleetwatch: {e}", file=sys.stderr)
+        return 2
+    view = FleetView(stale_after_s=args.stale_after)
+    engine = None
+    if args.slo:
+        try:
+            slos = load_slos(args.slo)
+        except (OSError, ValueError) as e:
+            print(f"fleetwatch: --slo: {e}", file=sys.stderr)
+            return 2
+
+        def on_alert(edge: dict) -> None:
+            print(f"fleetwatch: SLO {edge['slo']!r} {edge['state']} "
+                  f"(burn fast={edge['burn_fast']:.2f} "
+                  f"slow={edge['burn_slow']:.2f})", file=sys.stderr)
+
+        engine = SLOEngine(slos, on_alert=on_alert)
+
+    if args.once:
+        ok = poll_once(view, peers, timeout=args.timeout)
+        if engine is not None:
+            engine.evaluate(view.registry)
+        text = json.dumps(view.fleet_snapshot(), sort_keys=True)
+        if args.out == "-":
+            sys.stdout.write(text + "\n")
+        else:
+            with open(args.out, "w") as f:
+                f.write(text)
+        return 0 if ok else 1
+
+    endpoint = None
+    if args.port:
+        from photon_ml_tpu.serving.frontend.metrics_http import \
+            ThreadedMetricsEndpoint
+        from photon_ml_tpu.serving.metrics import ServingMetrics
+
+        endpoint = ThreadedMetricsEndpoint(
+            ServingMetrics(registry=view.registry), port=args.port,
+            fleet_view=view).start()
+        print(f"fleetwatch: fleet plane on "
+              f"http://127.0.0.1:{endpoint.port}/fleetz", file=sys.stderr)
+    rounds = 0
+    try:
+        while True:
+            poll_once(view, peers, timeout=args.timeout)
+            if engine is not None:
+                engine.evaluate(view.registry)
+            rounds += 1
+            if args.rounds and rounds >= args.rounds:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if endpoint is not None:
+            endpoint.stop()
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,11 @@ def run(argv) -> int:
                 return 2
         traces.append(t)
     merged = merge_traces(traces, reference=args.reference)
+    # a disconnected clock-offset graph degrades to per-component local
+    # references — always worth a warning, even under --quiet: timing is
+    # not comparable across the components the merge just interleaved
+    for w in merged["otherData"].get("clock_warnings", ()):
+        print(f"tracemerge: warning: {w}", file=sys.stderr)
     text = json.dumps(merged)
     if args.out == "-":
         sys.stdout.write(text + "\n")
